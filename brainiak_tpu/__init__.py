@@ -1,0 +1,26 @@
+"""brainiak_tpu: a TPU-native brain imaging analysis framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of BrainIAK
+(reference: /root/reference, brainiak/brainiak): scalable fMRI analysis with
+device-mesh parallelism (pjit/shard_map over ICI/DCN) replacing MPI, fused
+XLA/Pallas kernels replacing C++/Cython extensions, and pure-JAX optimization
+replacing TensorFlow/pymanopt components.
+
+Layout
+------
+- ``ops``            pure-JAX jittable kernels (correlation, Fisher-z, RBF
+                     factors, masked log, Gram accumulation, phase
+                     randomization) — the analog of the reference's native
+                     extensions (cython_blas.pyx, fcma_extension.cc,
+                     tfa_extension.cpp, _utils.pyx).
+- ``parallel``       device-mesh / sharding / collective helpers — the analog
+                     of the reference's mpi4py layer.
+- ``io`` / ``image`` host-side data plane (NIfTI, masking, condition specs).
+- domain packages    ``fcma``, ``funcalign``, ``factoranalysis``,
+                     ``eventseg``, ``searchlight``, ``isc``, ``reprsimil``,
+                     ``matnormal``, ``reconstruct``, ``hyperparamopt``,
+                     ``utils`` — sklearn-style estimators and free functions
+                     matching the reference API surface.
+"""
+
+__version__ = "0.1.0"

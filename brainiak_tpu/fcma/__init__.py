@@ -1,0 +1,6 @@
+"""Full Correlation Matrix Analysis (FCMA), TPU-native.
+
+Correlation-based voxel selection and classification where the reference's
+Cython BLAS + C++/OpenMP + MPI master-worker pipeline
+(/root/reference/src/brainiak/fcma/) becomes fused XLA/Pallas kernels sharded
+over a device mesh."""

@@ -1,0 +1,30 @@
+"""Public FCMA correlation routines (host-friendly API).
+
+Re-design of /root/reference/src/brainiak/fcma/util.py: the normalize +
+BLAS-sgemm pipeline is one jitted XLA computation on TPU
+(:mod:`brainiak_tpu.ops.correlation`).
+"""
+
+import numpy as np
+
+from ..ops import correlation as _corr_ops
+
+__all__ = ["compute_correlation"]
+
+
+def compute_correlation(matrix1, matrix2, return_nans=False):
+    """Pearson correlation of the rows of matrix1 with the rows of matrix2.
+
+    Accepts [r1, c] and [r2, c] arrays; returns float32 [r1, r2].
+    Rows with zero variance yield 0 (or NaN when ``return_nans``).
+    Contract: reference fcma/util.py:63-134.
+    """
+    matrix1 = np.asarray(matrix1)
+    matrix2 = np.asarray(matrix2)
+    if matrix1.ndim != 2 or matrix2.ndim != 2:
+        raise ValueError("Input matrices must be 2D")
+    if matrix1.shape[1] != matrix2.shape[1]:
+        raise ValueError('Dimension discrepancy')
+    return np.asarray(
+        _corr_ops.compute_correlation(matrix1, matrix2,
+                                      return_nans=return_nans))

@@ -1,0 +1,3 @@
+"""Pure-JAX jittable kernels — the TPU-native analog of the reference's
+native extensions (cython_blas.pyx, fcma_extension.cc, tfa_extension.cpp,
+eventseg/_utils.pyx)."""

@@ -1,0 +1,55 @@
+"""Fisher-z transform + within-subject epoch normalization.
+
+TPU-native replacement for the reference's C++/OpenMP extension
+(/root/reference/src/brainiak/fcma/src/fcma_extension.cc:29-92,
+``normalization``).  The OpenMP parallel-for over (voxel, subject) becomes a
+single fused elementwise+reduction XLA computation.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fisher_z", "within_subject_normalization"]
+
+_CLAMP = 1e-4
+
+
+@jax.jit
+def fisher_z(r):
+    """Fisher z-transform ``0.5*log((1+r)/(1-r))`` with the reference's
+    clamping: numerator/denominator floored at 1e-4 when non-positive
+    (fcma_extension.cc:68-72)."""
+    r = jnp.asarray(r, dtype=jnp.float32)
+    num = 1.0 + r
+    den = 1.0 - r
+    num = jnp.where(num <= 0.0, _CLAMP, num)
+    den = jnp.where(den <= 0.0, _CLAMP, den)
+    return 0.5 * jnp.log(num / den)
+
+
+@partial(jax.jit, static_argnames=("epochs_per_subj",))
+def within_subject_normalization(corr, epochs_per_subj):
+    """Fisher-z then z-score each correlation across a subject's epochs.
+
+    Parameters
+    ----------
+    corr : [n_selected_voxels, n_epochs, n_voxels]
+        Raw correlations; epochs of each subject are contiguous and
+        ``n_epochs % epochs_per_subj == 0``.
+    epochs_per_subj : int
+
+    Returns
+    -------
+    Normalized array, same shape.  Population std computed as
+    ``E[x^2] - mean^2``; non-positive variance yields zeros
+    (fcma_extension.cc:74-84).
+    """
+    b, e, v = corr.shape
+    n_subjs = e // epochs_per_subj
+    z = fisher_z(corr).reshape(b, n_subjs, epochs_per_subj, v)
+    mean = jnp.mean(z, axis=2, keepdims=True)
+    var = jnp.mean(z * z, axis=2, keepdims=True) - mean * mean
+    inv_std = jnp.where(var <= 0.0, 0.0, jax.lax.rsqrt(var))
+    return ((z - mean) * inv_std).reshape(b, e, v)

@@ -1,0 +1,18 @@
+"""Masked elementwise ops.
+
+Replacement for the reference's Cython ``masked_log``
+(/root/reference/src/brainiak/eventseg/_utils.pyx:27): elementwise log with
+non-positive entries mapped to -inf, as one jittable op.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["masked_log"]
+
+
+@jax.jit
+def masked_log(x):
+    """log(x) with x<=0 mapped to -inf (no warnings), any shape."""
+    x = jnp.asarray(x)
+    return jnp.where(x > 0, jnp.log(jnp.where(x > 0, x, 1.0)), -jnp.inf)

@@ -1,0 +1,70 @@
+"""Jittable statistical ops for on-device resampling.
+
+JAX counterparts of host utilities in :mod:`brainiak_tpu.utils.utils`
+(reference: utils/utils.py:720-872).  These take explicit ``jax.random`` keys
+so resampling nulls (bootstrap/permutation/phase-shift in
+:mod:`brainiak_tpu.isc`) can be built as ``vmap`` over keys instead of
+Python ``for`` loops over a stateful RandomState (reference isc.py:739-787).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["phase_randomize", "p_from_null"]
+
+
+@partial(jax.jit, static_argnames=("voxelwise",))
+def phase_randomize(key, data, voxelwise=False):
+    """Phase-randomize time series (axis 0 = time), preserving power spectra.
+
+    data : [n_TRs, n_voxels, n_subjects] (or [n_TRs, n_subjects] — treated
+    as one voxel).  Same phase shifts across voxels unless ``voxelwise``.
+    Mirrors utils.phase_randomize (reference utils/utils.py:720-801) with a
+    jax.random key instead of a RandomState.
+    """
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[:, None, :]
+    n_TRs, n_voxels, n_subjects = data.shape
+
+    # Positive-frequency bins 1..ceil((n-1)/2); conjugate bins mirrored.
+    n_pos = (n_TRs - 1) // 2 if n_TRs % 2 else n_TRs // 2 - 1
+    pos = jnp.arange(1, n_pos + 1)
+    neg = n_TRs - pos
+
+    shift_vox = n_voxels if voxelwise else 1
+    shifts = jax.random.uniform(
+        key, (n_pos, shift_vox, n_subjects)) * 2 * jnp.pi
+
+    f = jnp.fft.fft(data, axis=0)
+    rot = jnp.exp(1j * shifts)
+    f = f.at[pos].multiply(rot)
+    f = f.at[neg].multiply(jnp.conj(rot))
+    out = jnp.real(jnp.fft.ifft(f, axis=0))
+    if squeeze:
+        out = out[:, 0, :]
+    return out
+
+
+@partial(jax.jit, static_argnames=("side", "exact"))
+def p_from_null(observed, distribution, side="two-sided", exact=False):
+    """p-value of observed vs a null distribution whose axis 0 indexes
+    resampling iterations (broadcasting over remaining axes).
+
+    Mirrors utils.p_from_null (reference utils/utils.py:804-872).
+    """
+    n = distribution.shape[0]
+    if side == "two-sided":
+        numerator = jnp.sum(
+            jnp.abs(distribution) >= jnp.abs(observed), axis=0)
+    elif side == "left":
+        numerator = jnp.sum(distribution <= observed, axis=0)
+    elif side == "right":
+        numerator = jnp.sum(distribution >= observed, axis=0)
+    else:
+        raise ValueError("side must be 'two-sided', 'left' or 'right'")
+    if exact:
+        return numerator / n
+    return (numerator + 1) / (n + 1)

@@ -1,0 +1,472 @@
+"""Shared statistical / utility layer.
+
+TPU-native re-design of the reference's ``brainiak.utils.utils``
+(/root/reference/src/brainiak/utils/utils.py).  Host-side helpers stay NumPy;
+everything on a hot path (correlation, phase randomization, p-values) also has
+a pure-JAX jittable counterpart in :mod:`brainiak_tpu.ops` so resampling loops
+can be ``vmap``-ed on device.
+
+Behavior contracts follow the reference (cited per function) but the
+implementations are new.
+"""
+
+import logging
+import os
+import re
+import warnings
+
+import numpy as np
+
+__all__ = [
+    "array_correlation",
+    "center_mass_exp",
+    "circ_dist",
+    "concatenate_not_none",
+    "cov2corr",
+    "from_sym_2_tri",
+    "from_tri_2_sym",
+    "gen_design",
+    "p_from_null",
+    "phase_randomize",
+    "ReadDesign",
+    "sumexp_stable",
+    "usable_cpu_count",
+]
+
+logger = logging.getLogger(__name__)
+
+
+def circ_dist(x, y):
+    """Pairwise circular distance (radians) between two equal-size vectors.
+
+    Reference contract: utils/utils.py:48-66.
+    """
+    x = np.asarray(x)
+    y = np.asarray(y)
+    if x.size != y.size:
+        raise ValueError("Input sizes must match to compute pairwise "
+                         "comparisons.")
+    return np.angle(np.exp(1j * (x - y)))
+
+
+def from_tri_2_sym(tri, dim):
+    """Expand an upper-triangular 1-D vector into a dim×dim symmetric matrix.
+
+    Only the upper triangle of the result is populated (matching the
+    reference, utils/utils.py:69-92, which leaves the strict lower triangle
+    zero).
+    """
+    symm = np.zeros((dim, dim), dtype=np.asarray(tri).dtype)
+    symm[np.triu_indices(dim)] = tri
+    return symm
+
+
+def from_sym_2_tri(symm):
+    """Extract the upper triangle (incl. diagonal) of a symmetric matrix as 1-D.
+
+    Reference contract: utils/utils.py:95-115.
+    """
+    symm = np.asarray(symm)
+    return symm[np.triu_indices_from(symm)]
+
+
+def sumexp_stable(data):
+    """Stable sum of exponentials over axis 0.
+
+    Returns ``(result_sum, max_value, result_exp)`` with
+    ``result_exp = exp(data - max)``, ``result_sum = sum(result_exp, axis=0)``.
+    Reference contract: utils/utils.py:118-151.
+    """
+    data = np.asarray(data)
+    max_value = data.max(axis=0)
+    result_exp = np.exp(data - max_value)
+    result_sum = np.sum(result_exp, axis=0)
+    return result_sum, max_value, result_exp
+
+
+def concatenate_not_none(data, axis=0):
+    """Concatenate the non-None entries of a list of arrays.
+
+    Reference contract: utils/utils.py:154-182.
+    """
+    return np.concatenate([d for d in data if d is not None], axis=axis)
+
+
+def cov2corr(cov):
+    """Convert a covariance matrix to a correlation matrix.
+
+    Reference contract: utils/utils.py:185-206.
+    """
+    cov = np.asarray(cov)
+    assert cov.ndim == 2, 'covariance matrix should be 2D array'
+    inv_sd = 1.0 / np.sqrt(np.diag(cov))
+    return cov * inv_sd[None, :] * inv_sd[:, None]
+
+
+def center_mass_exp(interval, scale=1.0):
+    """Center of mass of an exponential distribution on an interval.
+
+    Reference contract: utils/utils.py:657-697.
+    """
+    assert isinstance(interval, tuple), 'interval must be a tuple'
+    assert len(interval) == 2, 'interval must be length two'
+    left, right = interval
+    assert left >= 0, 'interval_left must be non-negative'
+    assert right > left, 'interval_right must be bigger than interval_left'
+    assert scale > 0, 'scale must be positive'
+    if not np.isfinite(right):
+        return left + scale
+    el = np.exp(-left / scale)
+    er = np.exp(-right / scale)
+    return ((left + scale) * el - (right + scale) * er) / (el - er)
+
+
+def usable_cpu_count():
+    """Number of CPUs usable by the current process (cpuset-aware).
+
+    Reference contract: utils/utils.py:700-717.
+    """
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:
+        return os.cpu_count() or 1
+
+
+def _check_timeseries_input(data):
+    """Standardize time-series input to (data3d, n_TRs, n_voxels, n_subjects).
+
+    Accepts a list of per-subject (n_TRs, n_voxels) arrays, a 2-D array
+    (n_TRs, n_subjects), or a 3-D array (n_TRs, n_voxels, n_subjects).
+    Reference contract: utils/utils.py:875-935.
+    """
+    if isinstance(data, list):
+        shape0 = data[0].shape
+        arrays = []
+        for d in data:
+            d = np.asarray(d)
+            if d.shape != shape0:
+                raise ValueError("All ndarrays in input list "
+                                 "must be the same shape!")
+            arrays.append(d[:, np.newaxis] if d.ndim == 1 else d)
+        data = np.dstack(arrays)
+    else:
+        data = np.asarray(data)
+        if data.ndim == 2:
+            data = data[:, np.newaxis, :]
+        elif data.ndim != 3:
+            raise ValueError("Input ndarray should have 2 "
+                             "or 3 dimensions (got {0})!".format(data.ndim))
+
+    n_TRs, n_voxels, n_subjects = data.shape
+    logger.debug(
+        "Assuming %d subjects with %d time points and %d voxel(s) or ROI(s)",
+        n_subjects, n_TRs, n_voxels)
+    return data, n_TRs, n_voxels, n_subjects
+
+
+def array_correlation(x, y, axis=0):
+    """Column- (axis=0) or row-wise (axis=1) Pearson correlation of two arrays.
+
+    Reference contract: utils/utils.py:938-996.
+    """
+    x = np.asarray(x)
+    y = np.asarray(y)
+    if x.shape != y.shape:
+        raise ValueError("Input arrays must be the same shape")
+    if axis == 1:
+        x, y = x.T, y.T
+    xd = x - x.mean(axis=0)
+    yd = y - y.mean(axis=0)
+    num = np.sum(xd * yd, axis=0)
+    den = np.sqrt(np.sum(xd ** 2, axis=0) * np.sum(yd ** 2, axis=0))
+    return num / den
+
+
+def phase_randomize(data, voxelwise=False, random_state=None):
+    """Randomize the phase of time series, preserving the power spectrum.
+
+    Same phase shift across voxels by default; per-voxel shifts when
+    ``voxelwise=True``.  Accepts 2-D (TR × subject) or 3-D
+    (TR × voxel × subject) input.  Reference contract:
+    utils/utils.py:720-801.  A jittable JAX counterpart lives in
+    :func:`brainiak_tpu.ops.stats.phase_randomize`.
+    """
+    data_ndim = np.ndim(data)
+    data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
+
+    if isinstance(random_state, np.random.RandomState):
+        prng = random_state
+    else:
+        prng = np.random.RandomState(random_state)
+
+    if n_TRs % 2 == 0:
+        pos_freq = np.arange(1, n_TRs // 2)
+        neg_freq = np.arange(n_TRs - 1, n_TRs // 2, -1)
+    else:
+        pos_freq = np.arange(1, (n_TRs - 1) // 2 + 1)
+        neg_freq = np.arange(n_TRs - 1, (n_TRs - 1) // 2, -1)
+
+    shift_voxels = n_voxels if voxelwise else 1
+    phase_shifts = prng.rand(len(pos_freq), shift_voxels, n_subjects) \
+        * 2 * np.pi
+
+    fft_data = np.fft.fft(data, axis=0)
+    fft_data[pos_freq, :, :] *= np.exp(1j * phase_shifts)
+    fft_data[neg_freq, :, :] *= np.exp(-1j * phase_shifts)
+    shifted_data = np.real(np.fft.ifft(fft_data, axis=0))
+
+    if data_ndim == 2:
+        shifted_data = shifted_data[:, 0, :]
+    return shifted_data
+
+
+def p_from_null(observed, distribution, side='two-sided', exact=False,
+                axis=None):
+    """p-value of an observed statistic under a resampling null distribution.
+
+    Adjusts for the observed statistic unless ``exact`` (Phipson & Smyth
+    2010).  Reference contract: utils/utils.py:804-872.
+    """
+    if side not in ('two-sided', 'left', 'right'):
+        raise ValueError("The value for 'side' must be either "
+                         "'two-sided', 'left', or 'right', got {0}".
+                         format(side))
+    distribution = np.asarray(distribution)
+    n_samples = len(distribution)
+
+    if side == 'two-sided':
+        numerator = np.sum(np.abs(distribution) >= np.abs(observed),
+                           axis=axis)
+    elif side == 'left':
+        numerator = np.sum(distribution <= observed, axis=axis)
+    else:
+        numerator = np.sum(distribution >= observed, axis=axis)
+
+    if exact:
+        return numerator / n_samples
+    return (numerator + 1) / (n_samples + 1)
+
+
+class ReadDesign:
+    """Reader for AFNI 3dDeconvolve design matrices (``.1D``/``.1d``/``.txt``).
+
+    Parses the ``ni_type``, ``ColumnGroups`` and ``StimLabels`` header
+    comments to classify columns into task (>0), orthogonal/motion (0) and
+    polynomial-drift (-1) regressors.  Reference contract:
+    utils/utils.py:208-363.
+    """
+
+    _RE_NCOL = re.compile(r'^#\s+ni_type\s+=\s+"(\d+)[*]', re.MULTILINE)
+    _RE_GROUPS = re.compile(r'^#\s+ColumnGroups\s+=\s+"(.+)"', re.MULTILINE)
+    _RE_LABELS = re.compile(r'^#\s+StimLabels\s+=\s+"(.+)"', re.MULTILINE)
+
+    def __init__(self, fname=None, include_orth=True, include_pols=True):
+        self.design = np.zeros([0, 0])
+        self.n_col = 0
+        self.column_types = np.ones(0)
+        self.n_basis = 0
+        self.n_stim = 0
+        self.n_orth = 0
+        self.StimLabels = []
+
+        if fname is not None:
+            _, ext = os.path.splitext(fname)
+            if ext in ('.1D', '.1d', '.txt'):
+                self.read_afni(fname)
+
+        self.include_orth = include_orth
+        self.include_pols = include_pols
+
+        self.cols_task = np.where(self.column_types == 1)[0]
+        self.design_task = self.design[:, self.cols_task]
+        self.n_TR = self.design_task.shape[0]
+
+        nuisance_cols = []
+        if self.include_orth:
+            nuisance_cols.append(np.where(self.column_types == 0)[0])
+        if self.include_pols:
+            nuisance_cols.append(np.where(self.column_types == -1)[0])
+        self.cols_nuisance = np.intp(np.sort(np.concatenate(nuisance_cols))) \
+            if nuisance_cols else np.array([], dtype=np.intp)
+        if self.cols_nuisance.size > 0:
+            self.reg_nuisance = self.design[:, self.cols_nuisance]
+        else:
+            self.reg_nuisance = None
+
+    def read_afni(self, fname):
+        self.design = np.loadtxt(fname, ndmin=2)
+        with open(fname) as f:
+            text = f.read()
+
+        m = self._RE_NCOL.search(text)
+        if m:
+            self.n_col = int(m.group(1))
+            if self.n_col != self.design.shape[1]:
+                warnings.warn('The number of columns in the design matrix'
+                              'does not match the header information')
+                self.n_col = self.design.shape[1]
+        else:
+            self.n_col = self.design.shape[1]
+
+        self.column_types = np.ones(self.n_col)
+        m = self._RE_GROUPS.search(text)
+        if m:
+            idx = 0
+            for group in m.group(1).split(','):
+                parts = group.split('@')
+                if len(parts) == 2:
+                    # "<count>@<type>": count columns of the given type
+                    count, ctype = int(parts[0]), int(parts[1])
+                    self.column_types[idx:idx + count] = ctype
+                    idx += count
+                elif len(parts) == 1 and not re.search(r'\..', parts[0]):
+                    self.column_types[idx] = int(parts[0])
+                    idx += 1
+                else:
+                    # "<label>..<count>": a run of stimulus columns
+                    count = int(group.split('..')[1])
+                    self.column_types[idx:idx + count] = 1
+                    idx += count
+            self.n_basis = int(np.sum(self.column_types == -1))
+            self.n_stim = int(np.sum(self.column_types > 0))
+            self.n_orth = int(np.sum(self.column_types == 0))
+
+        m = self._RE_LABELS.search(text)
+        self.StimLabels = re.split(r'[ ;]+', m.group(1)) if m else []
+
+
+def gen_design(stimtime_files, scan_duration, TR, style='FSL',
+               temp_res=0.01, hrf_para=None):
+    """Generate design matrix columns from stimulus timing files.
+
+    Convolves boxcar (or parametrically modulated) event trains with a
+    double-gamma HRF at high temporal resolution, then downsamples to TR
+    grid.  Supports FSL 3-column and AFNI stimtime formats, and multiple
+    runs via list-of-files (concatenated along time).
+
+    Reference contract: utils/utils.py:365-655.
+
+    Parameters
+    ----------
+    stimtime_files : str or list of str
+        One file (or a list of per-condition files).  FSL style: three
+        columns (onset, duration, weight); AFNI style: one row per run of
+        onsets, ``*`` for empty runs, optionally ``onset*weight`` or
+        ``onset:duration`` annotations.
+    scan_duration : float or list/array of float
+        Duration (s) of each fMRI run; scalar for a single run.
+    TR : float
+        Repetition time (s).
+    style : 'FSL' or 'AFNI'
+    temp_res : float
+        Temporal resolution (s) at which convolution is performed.
+    hrf_para : dict or None
+        Double-gamma parameters: keys ``response_delay``,
+        ``undershoot_delay``, ``response_dispersion``,
+        ``undershoot_dispersion``, ``undershoot_scale``.
+
+    Returns
+    -------
+    design : ndarray, shape (n_TRs_total, n_conditions)
+    """
+    if hrf_para is None:
+        hrf_para = {'response_delay': 6, 'undershoot_delay': 12,
+                    'response_dispersion': 0.9, 'undershoot_dispersion': 0.9,
+                    'undershoot_scale': 0.035}
+    if style not in ('FSL', 'AFNI'):
+        raise ValueError("style must be 'FSL' or 'AFNI'")
+    if isinstance(stimtime_files, str):
+        stimtime_files = [stimtime_files]
+    scan_duration = np.atleast_1d(np.asarray(scan_duration, dtype=float))
+    if TR <= 0:
+        raise ValueError("TR must be positive")
+    if np.any(scan_duration <= TR):
+        raise ValueError("scan_duration must exceed TR for every run")
+    n_runs = scan_duration.size
+    run_TRs = np.round(scan_duration / TR).astype(int)
+
+    # High-resolution double-gamma HRF (same parameterization family as the
+    # reference / SPM): gamma-pdf response minus scaled gamma-pdf undershoot.
+    from scipy.stats import gamma as gamma_dist
+    hrf_len = int(np.round(32.0 / temp_res))
+    t = np.arange(hrf_len) * temp_res
+    response = gamma_dist.pdf(
+        t, hrf_para['response_delay'] / hrf_para['response_dispersion'],
+        scale=hrf_para['response_dispersion'])
+    undershoot = gamma_dist.pdf(
+        t, hrf_para['undershoot_delay'] / hrf_para['undershoot_dispersion'],
+        scale=hrf_para['undershoot_dispersion'])
+    hrf = response - hrf_para['undershoot_scale'] * undershoot
+    hrf = hrf / np.max(hrf)
+
+    run_starts = np.concatenate([[0.0], np.cumsum(scan_duration)])
+
+    def parse_events(fname):
+        """Return per-run lists of (onset, duration, weight).
+
+        FSL: one event per line, columns onset[, duration[, weight]],
+        onsets on the concatenated-run timeline; events outside every run
+        are dropped.  AFNI: one line per run, tokens
+        ``onset[*weight][:duration]``; ``*`` marks an empty run; events
+        with onset < 0 or beyond the run duration are dropped.  Defaults:
+        duration 1.0, weight 1.0.  (Reference utils/utils.py:500-655.)
+        """
+        events = [[] for _ in range(n_runs)]
+        if style == 'FSL':
+            with open(fname) as f:
+                for line in f:
+                    cols = line.split()
+                    if not cols:
+                        continue
+                    onset = float(cols[0])
+                    duration = float(cols[1]) if len(cols) >= 2 else 1.0
+                    weight = float(cols[2]) if len(cols) >= 3 else 1.0
+                    run = int(np.searchsorted(run_starts, onset,
+                                              side='right')) - 1
+                    if 0 <= run < n_runs:
+                        events[run].append((onset - run_starts[run],
+                                            duration, weight))
+        else:  # AFNI
+            with open(fname) as f:
+                lines = [ln.strip() for ln in f if ln.strip() != '']
+            if len(lines) != n_runs:
+                raise ValueError(
+                    'Number of lines does not match number of runs!')
+            for run, line in enumerate(lines):
+                toks = line.split()
+                if toks and toks[0] == '*':
+                    continue
+                for tok in toks:
+                    duration, weight = 1.0, 1.0
+                    if ':' in tok:
+                        tok, dur_s = tok.rsplit(':', 1)
+                        duration = float(dur_s)
+                    if '*' in tok:
+                        tok, weight_s = tok.split('*')
+                        weight = float(weight_s)
+                    onset = float(tok)
+                    if 0 <= onset < scan_duration[run]:
+                        events[run].append((onset, duration, weight))
+        return events
+
+    n_cond = len(stimtime_files)
+    design = np.zeros((int(run_TRs.sum()), n_cond))
+    for c, fname in enumerate(stimtime_files):
+        events = parse_events(fname)
+        col_runs = []
+        stride = int(round(TR / temp_res))
+        for run in range(n_runs):
+            n_hi = int(np.round(scan_duration[run] / temp_res))
+            boxcar = np.zeros(n_hi)
+            for onset, duration, weight in events[run]:
+                lo = int(np.round(onset / temp_res))
+                hi = int(np.round((onset + duration) / temp_res))
+                boxcar[lo:min(hi, n_hi)] += weight
+            # Scale by temp_res so the amplitude approximates the integral
+            # of weight x HRF (reference utils/utils.py:136-138); sample at
+            # mid-TR (slice-time-corrected convention, fmrisim convolve_hrf).
+            conv = np.convolve(boxcar, hrf)[:n_hi] * temp_res
+            idx = stride // 2 + np.arange(run_TRs[run]) * stride
+            col_runs.append(conv[np.minimum(idx, n_hi - 1)])
+        design[:, c] = np.concatenate(col_runs)
+    return design

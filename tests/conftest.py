@@ -1,0 +1,39 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths
+(shard_map/pjit over a jax.sharding.Mesh) are exercised without TPU pod
+hardware — the TPU-native analog of the reference's pytest-mpiexec
+subprocess re-execution trick (reference tests/pytest_mpiexec_plugin.py).
+The env vars must be set before jax is first imported.
+"""
+
+import os
+import sys
+
+# Force CPU even when the ambient environment points JAX at a TPU
+# (JAX_PLATFORMS=axon, registered by a sitecustomize before this file runs):
+# the unit-test mesh is 8 virtual CPU devices.  The env var alone is not
+# enough because jax may already be imported, so also update jax.config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def seeded_rng():
+    """Seed global RNGs for tests that use library-internal randomness."""
+    import random
+    random.seed(0)
+    np.random.seed(0)
+    return np.random.RandomState(0)

@@ -1,0 +1,117 @@
+import numpy as np
+import pytest
+
+from brainiak_tpu.fcma.util import compute_correlation
+from brainiak_tpu.ops.correlation import (
+    correlate_epochs,
+    normalize_for_correlation,
+)
+from brainiak_tpu.ops.fisherz import fisher_z, within_subject_normalization
+from brainiak_tpu.ops.masked import masked_log
+from brainiak_tpu.ops import stats as jstats
+
+
+def _np_reference_normalization(corr, epochs_per_subj):
+    """Independent NumPy oracle for the reference C++ normalization
+    (fcma_extension.cc:29-92)."""
+    out = np.array(corr, dtype=np.float32, copy=True)
+    b, e, v = out.shape
+    n_subjs = e // epochs_per_subj
+    num = 1.0 + out
+    den = 1.0 - out
+    num[num <= 0] = 1e-4
+    den[den <= 0] = 1e-4
+    out = 0.5 * np.log(num / den)
+    for s in range(n_subjs):
+        sl = slice(s * epochs_per_subj, (s + 1) * epochs_per_subj)
+        blockv = out[:, sl, :]
+        mean = blockv.mean(axis=1, keepdims=True)
+        var = (blockv ** 2).mean(axis=1, keepdims=True) - mean ** 2
+        inv = np.where(var <= 0, 0.0, 1.0 / np.sqrt(np.maximum(var, 1e-30)))
+        out[:, sl, :] = (blockv - mean) * inv
+    return out
+
+
+def test_compute_correlation_matches_corrcoef():
+    rng = np.random.RandomState(0)
+    m1 = rng.randn(10, 40).astype(np.float32)
+    m2 = rng.randn(7, 40).astype(np.float32)
+    corr = compute_correlation(m1, m2)
+    assert corr.shape == (10, 7)
+    expected = np.corrcoef(np.vstack([m1, m2]))[:10, 10:]
+    assert np.allclose(corr, expected, atol=1e-5)
+
+
+def test_compute_correlation_zero_variance():
+    rng = np.random.RandomState(1)
+    m1 = rng.randn(3, 20).astype(np.float32)
+    m1[1] = 5.0  # constant row
+    corr = compute_correlation(m1, m1)
+    assert np.allclose(corr[1], 0.0)
+    corr_nan = compute_correlation(m1, m1, return_nans=True)
+    assert np.all(np.isnan(corr_nan[1]))
+    with pytest.raises(ValueError):
+        compute_correlation(m1, rng.randn(3, 21))
+
+
+def test_correlate_epochs_layout():
+    rng = np.random.RandomState(2)
+    E, V, T, B = 4, 12, 30, 5
+    data = rng.randn(E, V, T).astype(np.float32)
+    norm = np.asarray(normalize_for_correlation(data, 2))
+    corr = np.asarray(correlate_epochs(norm[:, :B], norm))
+    assert corr.shape == (B, E, V)
+    # spot-check against per-epoch corrcoef
+    for e in range(E):
+        expected = np.corrcoef(data[e])[:B, :]
+        assert np.allclose(corr[:, e, :], expected, atol=1e-5)
+
+
+def test_fisher_z_clamps():
+    r = np.array([0.0, 0.5, 1.0, -1.0], dtype=np.float32)
+    z = np.asarray(fisher_z(r))
+    assert z[0] == 0.0
+    assert np.isclose(z[1], np.arctanh(0.5), atol=1e-6)
+    assert np.isfinite(z[2]) and np.isfinite(z[3])
+
+
+def test_within_subject_normalization_matches_oracle():
+    rng = np.random.RandomState(3)
+    corr = (rng.rand(6, 8, 10).astype(np.float32) * 1.8 - 0.9)
+    got = np.asarray(within_subject_normalization(corr, epochs_per_subj=4))
+    expected = _np_reference_normalization(corr, 4)
+    assert np.allclose(got, expected, atol=1e-4)
+    # each subject-block now has ~zero mean, unit variance per (voxel, col)
+    assert np.allclose(got[:, :4].mean(axis=1), 0.0, atol=1e-5)
+
+
+def test_masked_log():
+    x = np.array([-1.0, 0.0, 1.0, np.e], dtype=np.float32)
+    out = np.asarray(masked_log(x))
+    assert out[0] == -np.inf and out[1] == -np.inf
+    assert np.isclose(out[2], 0.0) and np.isclose(out[3], 1.0, atol=1e-6)
+
+
+def test_jax_phase_randomize_preserves_spectrum():
+    import jax
+    rng = np.random.RandomState(4)
+    data = rng.randn(40, 3, 2).astype(np.float32)
+    out = np.asarray(jstats.phase_randomize(jax.random.PRNGKey(0), data))
+    assert out.shape == data.shape
+    assert not np.allclose(out, data)
+    assert np.allclose(np.abs(np.fft.fft(data, axis=0)),
+                       np.abs(np.fft.fft(out, axis=0)), atol=1e-3)
+    # odd length
+    out_odd = np.asarray(
+        jstats.phase_randomize(jax.random.PRNGKey(1), data[:39]))
+    assert np.allclose(np.abs(np.fft.fft(data[:39], axis=0)),
+                       np.abs(np.fft.fft(out_odd, axis=0)), atol=1e-3)
+
+
+def test_jax_p_from_null():
+    null = np.array([-2.0, -1.0, 0.0, 1.0, 2.0])
+    assert np.isclose(
+        np.asarray(jstats.p_from_null(3.0, null, side="right", exact=True)),
+        0.0)
+    assert np.isclose(
+        np.asarray(jstats.p_from_null(3.0, null, side="right")), 1 / 6)

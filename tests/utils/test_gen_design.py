@@ -1,0 +1,85 @@
+import numpy as np
+import pytest
+
+from brainiak_tpu.utils.utils import ReadDesign, gen_design
+
+# Stimulus timing fixtures (FSL 3-column and equivalent AFNI married format).
+FSL_1 = "5.2 2.0 2.0\n40.0 1.5 4.0\n50.0 1.0 2.0\n"
+FSL_HALF = "5.2 2.0 1.0\n40.0 1.5 2.0\n50.0 1.0 1.0\n"
+AFNI_1 = "5.2*2.0:2.0 40.0*4.0:1.5\n2.0*2.0:1.0\n"
+AFNI_NEG = "-1.0\n"
+
+
+@pytest.fixture
+def stim_files(tmp_path):
+    paths = {}
+    for name, content in [("fsl1", FSL_1), ("fsl_half", FSL_HALF),
+                          ("afni1", AFNI_1), ("afni_neg", AFNI_NEG)]:
+        p = tmp_path / f"{name}.txt"
+        p.write_text(content)
+        paths[name] = str(p)
+    return paths
+
+
+def test_gen_design_fsl(stim_files):
+    d1 = gen_design([stim_files["fsl1"]], scan_duration=[48, 20], TR=2,
+                    style='FSL')
+    assert d1.shape == (34, 1)
+    # runs are separate timelines: first TR of run 2 precedes any response
+    assert d1[24] == 0
+    # single long run: 8 s after the 40 s onset there is a response
+    d3 = gen_design([stim_files["fsl1"]], scan_duration=68, TR=2, style='FSL')
+    assert d3[24] != 0
+    # weights scale the response linearly
+    d4 = gen_design([stim_files["fsl_half"]], scan_duration=[48, 20], TR=2,
+                    style='FSL')
+    assert np.allclose(d1 * 0.5, d4)
+    # TR=1 sampling agrees with TR=2 at shared time points
+    d5 = gen_design([stim_files["fsl_half"]], scan_duration=[48, 20], TR=1,
+                    style='FSL')
+    assert np.abs(d4 - d5[::2]).mean() < 0.1
+    # multiple conditions stack as columns
+    d2 = gen_design([stim_files["fsl1"], stim_files["fsl_half"]],
+                    scan_duration=[48, 20], TR=2, style='FSL')
+    assert d2.shape == (34, 2)
+
+
+def test_gen_design_afni_equals_fsl(stim_files):
+    # AFNI events: run 1 has (5.2, w2, d2) and (40, w4, d1.5); run 2 has
+    # (2.0+48=50 globally, w2, d1) -> same events as the FSL file.
+    d_fsl = gen_design([stim_files["fsl1"]], scan_duration=[48, 20], TR=2,
+                       style='FSL')
+    d_afni = gen_design([stim_files["afni1"]], scan_duration=[48, 20], TR=2,
+                        style='AFNI')
+    assert np.allclose(d_fsl, d_afni)
+
+
+def test_gen_design_afni_negative_onset(stim_files):
+    d = gen_design([stim_files["afni_neg"]], scan_duration=[48], TR=2,
+                   style='AFNI')
+    assert np.all(d == 0.0)
+
+
+def test_gen_design_bad_style(stim_files):
+    with pytest.raises(ValueError):
+        gen_design([stim_files["fsl1"]], scan_duration=[48], TR=2,
+                   style='SPM')
+    with pytest.raises(ValueError):
+        # AFNI line count must match run count
+        gen_design([stim_files["afni1"]], scan_duration=[48], TR=2,
+                   style='AFNI')
+
+
+def test_read_design_afni_fixture():
+    # Real AFNI 3dDeconvolve output from the reference test data (read-only).
+    d = ReadDesign("/root/reference/tests/utils/example_design.1D")
+    assert d.n_TR == 186
+    assert d.n_col == 27
+    assert d.n_basis == 4
+    assert d.n_stim > 0
+    assert d.design_task.shape[0] == 186
+    assert d.reg_nuisance is not None
+    # excluding nuisance terms
+    d2 = ReadDesign("/root/reference/tests/utils/example_design.1D",
+                    include_orth=False, include_pols=False)
+    assert d2.reg_nuisance is None

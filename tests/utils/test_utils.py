@@ -1,0 +1,134 @@
+import numpy as np
+import pytest
+
+from brainiak_tpu.utils.utils import (
+    array_correlation,
+    center_mass_exp,
+    circ_dist,
+    concatenate_not_none,
+    cov2corr,
+    from_sym_2_tri,
+    from_tri_2_sym,
+    p_from_null,
+    phase_randomize,
+    sumexp_stable,
+    usable_cpu_count,
+    _check_timeseries_input,
+)
+
+
+def test_tri_sym_roundtrip():
+    rng = np.random.RandomState(0)
+    dim = 5
+    sym = rng.rand(dim, dim)
+    sym = sym + sym.T
+    tri = from_sym_2_tri(sym)
+    assert tri.shape == (dim * (dim + 1) // 2,)
+    back = from_tri_2_sym(tri, dim)
+    assert np.allclose(np.triu(back), np.triu(sym))
+
+
+def test_sumexp_stable():
+    rng = np.random.RandomState(1)
+    data = rng.randn(4, 3) * 50
+    s, m, e = sumexp_stable(data)
+    assert np.allclose(m, data.max(axis=0))
+    assert np.all(np.isfinite(s))
+    # softmax reconstruction
+    soft = e / s
+    assert np.allclose(soft.sum(axis=0), 1.0)
+
+
+def test_concatenate_not_none():
+    a = np.ones((2, 3))
+    out = concatenate_not_none([None, a, None, 2 * a], axis=0)
+    assert out.shape == (4, 3)
+    assert np.allclose(out[2:], 2.0)
+
+
+def test_cov2corr():
+    rng = np.random.RandomState(2)
+    x = rng.randn(100, 4)
+    cov = np.cov(x.T)
+    corr = cov2corr(cov)
+    assert np.allclose(np.diag(corr), 1.0)
+    assert np.allclose(corr, np.corrcoef(x.T))
+
+
+def test_circ_dist():
+    x = np.array([0.0, np.pi / 2])
+    y = np.array([np.pi / 2, 0.0])
+    d = circ_dist(x, y)
+    assert np.allclose(d, [-np.pi / 2, np.pi / 2])
+    with pytest.raises(ValueError):
+        circ_dist(np.zeros(2), np.zeros(3))
+
+
+def test_center_mass_exp():
+    # whole support: mean of exponential = scale
+    assert np.isclose(center_mass_exp((0, np.inf), scale=2.0), 2.0)
+    m = center_mass_exp((0.0, 1.0), scale=1.0)
+    assert 0 < m < 0.5
+    with pytest.raises(AssertionError):
+        center_mass_exp((1.0, 0.5))
+
+
+def test_array_correlation():
+    rng = np.random.RandomState(3)
+    x = rng.randn(50, 7)
+    y = rng.randn(50, 7)
+    r = array_correlation(x, y)
+    expected = [np.corrcoef(x[:, i], y[:, i])[0, 1] for i in range(7)]
+    assert np.allclose(r, expected)
+    # axis=1 equals transposed computation
+    assert np.allclose(array_correlation(x, y, axis=1),
+                       array_correlation(x.T, y.T, axis=0))
+    with pytest.raises(ValueError):
+        array_correlation(x, y[:, :3])
+
+
+def test_p_from_null():
+    null = np.array([-2.0, -1.0, 0.0, 1.0, 2.0])
+    assert p_from_null(3.0, null, side='right', exact=True) == 0.0
+    assert p_from_null(3.0, null, side='right') == pytest.approx(1 / 6)
+    assert p_from_null(0.0, null, side='two-sided', exact=True) == 1.0
+    assert p_from_null(-3.0, null, side='left', exact=True) == 0.0
+    with pytest.raises(ValueError):
+        p_from_null(0.0, null, side='up')
+
+
+def test_phase_randomize_preserves_spectrum():
+    rng = np.random.RandomState(4)
+    data = rng.randn(60, 3, 2)
+    shifted = phase_randomize(data, random_state=0)
+    assert shifted.shape == data.shape
+    assert not np.allclose(shifted, data)
+    # power spectrum preserved per voxel/subject
+    p0 = np.abs(np.fft.fft(data, axis=0))
+    p1 = np.abs(np.fft.fft(shifted, axis=0))
+    assert np.allclose(p0, p1, atol=1e-8)
+    # odd-length series too
+    shifted_odd = phase_randomize(data[:59], random_state=0)
+    assert np.allclose(np.abs(np.fft.fft(data[:59], axis=0)),
+                       np.abs(np.fft.fft(shifted_odd, axis=0)), atol=1e-8)
+    # 2-D input keeps its shape
+    d2 = rng.randn(40, 3)
+    assert phase_randomize(d2, random_state=1).shape == d2.shape
+
+
+def test_check_timeseries_input():
+    rng = np.random.RandomState(5)
+    arrays = [rng.randn(10, 4) for _ in range(3)]
+    data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(arrays)
+    assert data.shape == (10, 4, 3)
+    assert (n_TRs, n_voxels, n_subjects) == (10, 4, 3)
+    data2, *_ = _check_timeseries_input(rng.randn(10, 3))
+    assert data2.shape == (10, 1, 3)
+    with pytest.raises(ValueError):
+        _check_timeseries_input(rng.randn(10))
+    with pytest.raises(ValueError):
+        _check_timeseries_input([rng.randn(10, 4), rng.randn(10, 5)])
+
+
+def test_usable_cpu_count():
+    assert usable_cpu_count() >= 1
