@@ -1,0 +1,111 @@
+"""Headline benchmark: FCMA voxel-selection kernel throughput on TPU.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The metric is the BASELINE.json north star "FCMA voxels/sec/chip": how many
+selected voxels per second one chip pushes through FCMA stage 1+2
+(per-epoch full-brain correlation + Fisher-z within-subject normalization,
+reference voxelselector.py:284-328 + fcma_extension.cc).  ``vs_baseline``
+is the speedup over the same pipeline run with NumPy/BLAS on this host's
+CPU — the reference implementation's compute path without MPI.
+
+Timing notes: on the tunneled TPU platform ``block_until_ready`` does not
+synchronize and host<->device transfers are slow, so the benchmark
+generates data on-device, chains k pipeline repetitions in a fori_loop,
+synchronizes by fetching a scalar, and subtracts the k=1 dispatch overhead.
+"""
+
+import json
+import time
+from functools import partial
+
+import numpy as np
+
+N_VOXELS = 16384
+N_TRS = 150
+N_EPOCHS = 16
+BLOCK = 256
+EPOCHS_PER_SUBJ = 4
+
+
+def _tpu_voxels_per_sec():
+    import jax
+    import jax.numpy as jnp
+
+    from brainiak_tpu.ops.correlation import correlate_epochs
+    from brainiak_tpu.ops.fisherz import within_subject_normalization
+
+    n_blocks = N_VOXELS // BLOCK
+
+    @partial(jax.jit, static_argnames="k")
+    def run(key, k):
+        data = jax.random.normal(key, (N_EPOCHS, N_VOXELS, N_TRS),
+                                 jnp.float32)
+        mean = jnp.mean(data, axis=2, keepdims=True)
+        std = jnp.std(data, axis=2, keepdims=True)
+        norm = (data - mean) / (std * np.sqrt(N_TRS))
+
+        def body(i, acc):
+            blk = jax.lax.dynamic_slice_in_dim(
+                norm, (i % n_blocks) * BLOCK, BLOCK, axis=1)
+            corr = correlate_epochs(blk, norm)
+            out = within_subject_normalization(corr, EPOCHS_PER_SUBJ)
+            return acc + jnp.sum(out[:, 0, ::1024])
+
+        return jax.lax.fori_loop(0, k, body, 0.0)
+
+    key = jax.random.PRNGKey(0)
+    k_lo, k_hi = 1, 17
+    for k in (k_lo, k_hi):
+        float(run(key, k))  # warm compile caches
+    t0 = time.perf_counter()
+    float(run(key, k_lo))
+    d_lo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(run(key, k_hi))
+    d_hi = time.perf_counter() - t0
+    voxels = (k_hi - k_lo) * BLOCK
+    return voxels / (d_hi - d_lo)
+
+
+def _cpu_voxels_per_sec():
+    rng = np.random.RandomState(0)
+    data = rng.randn(N_EPOCHS, N_VOXELS, N_TRS).astype(np.float32)
+    mean = data.mean(axis=2, keepdims=True)
+    std = data.std(axis=2, keepdims=True)
+    norm = (data - mean) / (std * np.sqrt(N_TRS))
+
+    block = 64  # smaller block: CPU throughput is per-voxel linear
+    t0 = time.perf_counter()
+    blk = norm[:, :block]
+    # BLAS per-epoch GEMM (the reference's cython sgemm path)
+    corr = np.stack([blk[e] @ norm[e].T for e in range(N_EPOCHS)], axis=1)
+    num = 1.0 + corr
+    den = 1.0 - corr
+    num[num <= 0] = 1e-4
+    den[den <= 0] = 1e-4
+    z = 0.5 * np.log(num / den)
+    zr = z.reshape(block, N_EPOCHS // EPOCHS_PER_SUBJ, EPOCHS_PER_SUBJ,
+                   N_VOXELS)
+    m = zr.mean(axis=2, keepdims=True)
+    var = (zr ** 2).mean(axis=2, keepdims=True) - m ** 2
+    inv = np.where(var <= 0, 0.0, 1.0 / np.sqrt(np.maximum(var, 1e-30)))
+    _ = ((zr - m) * inv).reshape(block, N_EPOCHS, N_VOXELS)
+    dt = time.perf_counter() - t0
+    return block / dt
+
+
+def main():
+    tpu_vps = _tpu_voxels_per_sec()
+    cpu_vps = _cpu_voxels_per_sec()
+    print(json.dumps({
+        "metric": "fcma_voxel_selection_corrnorm_voxels_per_sec_chip",
+        "value": round(tpu_vps, 2),
+        "unit": "voxels/sec",
+        "vs_baseline": round(tpu_vps / cpu_vps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Multi-subject functional alignment (SRM family), TPU-native.
+
+The reference's MPI EM loops (/root/reference/src/brainiak/funcalign/) become
+pure jitted JAX functions over stacked subject arrays, sharded over a device
+mesh with XLA-inserted collectives."""

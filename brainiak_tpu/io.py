@@ -1,0 +1,71 @@
+"""I/O functionality: NIfTI images, boolean masks, condition-label files.
+
+Re-design of /root/reference/src/brainiak/io.py with the same public surface,
+backed by the self-contained :mod:`brainiak_tpu.nifti` codec instead of
+nibabel.
+"""
+
+import logging
+from pathlib import Path
+from typing import Callable, Iterable, List, Optional, Union
+
+import numpy as np
+
+from . import nifti
+from .image import SingleConditionSpec
+
+__all__ = [
+    "load_boolean_mask",
+    "load_images",
+    "load_images_from_dir",
+    "load_labels",
+    "save_as_nifti_file",
+]
+
+logger = logging.getLogger(__name__)
+
+
+def load_images_from_dir(in_dir: Union[str, Path], suffix: str = "nii.gz",
+                         ) -> Iterable[nifti.NiftiImage]:
+    """Lazily load all images in a directory whose names end with ``suffix``,
+    in sorted order (reference io.py:39-72)."""
+    if isinstance(in_dir, str):
+        in_dir = Path(in_dir)
+    for f in sorted(in_dir.glob("*" + suffix)):
+        logger.debug('Starting to read file %s', f)
+        yield nifti.load(str(f))
+
+
+def load_images(image_paths: Iterable[Union[str, Path]]
+                ) -> Iterable[nifti.NiftiImage]:
+    """Lazily load images from explicit paths (reference io.py:75-103)."""
+    for image_path in image_paths:
+        string_path = str(image_path)
+        logger.debug('Starting to read file %s', string_path)
+        yield nifti.load(string_path)
+
+
+def load_boolean_mask(path: Union[str, Path],
+                      predicate: Optional[
+                          Callable[[np.ndarray], np.ndarray]] = None
+                      ) -> np.ndarray:
+    """Load a boolean mask volume; ``predicate`` maps data to booleans
+    (default: truthiness) (reference io.py:106-132)."""
+    data = nifti.load(str(path)).get_fdata()
+    if predicate is not None:
+        return predicate(data)
+    return data.astype(bool)
+
+
+def load_labels(path: Union[str, Path]) -> List[SingleConditionSpec]:
+    """Load an ``.npy`` of condition-spec arrays as SingleConditionSpec views
+    (reference io.py:135-149)."""
+    condition_specs = np.load(str(path))
+    return [c.view(SingleConditionSpec) for c in condition_specs]
+
+
+def save_as_nifti_file(data: np.ndarray, affine: np.ndarray,
+                       path: Union[str, Path]) -> None:
+    """Save a data volume with the given affine as a NIfTI file
+    (reference io.py:152-168)."""
+    nifti.save(nifti.NiftiImage(data, affine), str(path))

@@ -39,7 +39,7 @@ def phase_randomize(key, data, voxelwise=False):
         key, (n_pos, shift_vox, n_subjects)) * 2 * jnp.pi
 
     f = jnp.fft.fft(data, axis=0)
-    rot = jnp.exp(1j * shifts)
+    rot = jnp.exp(1j * shifts).astype(f.dtype)
     f = f.at[pos].multiply(rot)
     f = f.at[neg].multiply(jnp.conj(rot))
     out = jnp.real(jnp.fft.ifft(f, axis=0))

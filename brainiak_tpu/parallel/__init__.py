@@ -1,0 +1,18 @@
+"""Device-mesh / sharding helpers — the TPU-native analog of the reference's
+mpi4py communication layer (SURVEY.md §2.2).
+
+Instead of MPI ranks exchanging messages, algorithms here are pure jitted
+functions over stacked arrays; parallelism is expressed by placing those
+arrays on a :class:`jax.sharding.Mesh` and letting XLA's SPMD partitioner
+insert the collectives (psum/all_gather over ICI/DCN).
+"""
+
+from .mesh import (  # noqa: F401
+    DEFAULT_SUBJECT_AXIS,
+    DEFAULT_VOXEL_AXIS,
+    initialize_distributed,
+    make_mesh,
+    replicated,
+    shard_along,
+    subject_voxel_mesh,
+)

@@ -1,0 +1,90 @@
+"""Mesh construction and sharding helpers.
+
+Replaces the reference's injectable ``comm=MPI.COMM_WORLD`` parameter
+(e.g. srm.py:211, htfa.py:171, fcma/preprocessing.py:157): estimators
+accept an optional ``mesh=`` and place their stacked per-subject /
+per-voxel arrays accordingly.  Collectives are inserted by XLA (GSPMD)
+rather than called explicitly.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "DEFAULT_SUBJECT_AXIS",
+    "DEFAULT_VOXEL_AXIS",
+    "initialize_distributed",
+    "make_mesh",
+    "replicated",
+    "shard_along",
+    "subject_voxel_mesh",
+]
+
+DEFAULT_SUBJECT_AXIS = "subject"
+DEFAULT_VOXEL_AXIS = "voxel"
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Initialize multi-host JAX (DCN) — the analog of MPI_Init.
+
+    No-op for single-process runs; on a pod slice each host calls this
+    before building meshes so ``jax.devices()`` spans the slice.
+    """
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+
+
+def make_mesh(axis_names: Sequence[str], axis_sizes: Sequence[int],
+              devices=None) -> Mesh:
+    """Build a Mesh with the given axes over ``devices`` (default: all).
+
+    ``axis_sizes`` may contain one -1, filled with the remaining devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    sizes = list(axis_sizes)
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if known <= 0 or n % known:
+            raise ValueError(
+                f"Cannot infer -1 axis from {n} devices and sizes {sizes}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"Mesh of {sizes} needs {total} devices, have {n}")
+    mesh_devices = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(mesh_devices, tuple(axis_names))
+
+
+def subject_voxel_mesh(n_subject_shards: int = -1,
+                       n_voxel_shards: int = 1,
+                       devices=None) -> Mesh:
+    """The framework's standard 2-D mesh ``('subject', 'voxel')``.
+
+    Subject-parallel algorithms (SRM/HTFA/ISC) shard the leading subject
+    axis; voxel-parallel ones (FCMA/searchlight) the voxel axis.
+    """
+    return make_mesh((DEFAULT_SUBJECT_AXIS, DEFAULT_VOXEL_AXIS),
+                     (n_subject_shards, n_voxel_shards), devices)
+
+
+def shard_along(array, mesh: Mesh, axis_name: str, array_dim: int = 0):
+    """Place ``array`` on ``mesh`` sharded over ``axis_name`` at dim
+    ``array_dim`` (other dims replicated)."""
+    spec = [None] * np.ndim(array)
+    spec[array_dim] = axis_name
+    return jax.device_put(array, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+def replicated(array, mesh: Mesh):
+    """Place ``array`` on ``mesh`` fully replicated."""
+    return jax.device_put(array, NamedSharding(mesh, PartitionSpec()))

@@ -1,0 +1,154 @@
+import numpy as np
+import pytest
+
+from brainiak_tpu.funcalign import srm as srm_mod
+from brainiak_tpu.funcalign.srm import SRM, DetSRM, load
+
+
+def make_synthetic(n_subjects=4, voxels=30, samples=40, features=4,
+                   noise=0.1, seed=0, ragged=False):
+    """X_i = W_i S + noise with orthonormal W_i."""
+    rng = np.random.RandomState(seed)
+    S = rng.randn(features, samples)
+    X, W = [], []
+    for i in range(n_subjects):
+        v = voxels + (i if ragged else 0)
+        q, _ = np.linalg.qr(rng.randn(v, features))
+        W.append(q)
+        X.append(q @ S + noise * rng.randn(v, samples))
+    return X, W, S
+
+
+def shared_space_correlation(model, X):
+    """Mean pairwise correlation of per-subject shared responses."""
+    s = model.transform(X)
+    corrs = []
+    for i in range(len(s)):
+        for j in range(i + 1, len(s)):
+            corrs.append(np.corrcoef(s[i].ravel(), s[j].ravel())[0, 1])
+    return np.mean(corrs)
+
+
+@pytest.mark.parametrize("cls", [SRM, DetSRM])
+def test_srm_recovers_shared_structure(cls):
+    X, _, S = make_synthetic()
+    model = cls(n_iter=10, features=4)
+    model.fit(X)
+    assert len(model.w_) == len(X)
+    for i, w in enumerate(model.w_):
+        assert w.shape == (X[i].shape[0], 4)
+        # orthonormality
+        assert np.allclose(w.T @ w, np.eye(4), atol=1e-5)
+    assert model.s_.shape == (4, 40)
+    # subjects agree in shared space
+    assert shared_space_correlation(model, X) > 0.9
+
+
+@pytest.mark.parametrize("cls", [SRM, DetSRM])
+def test_srm_ragged_voxel_counts(cls):
+    X, _, _ = make_synthetic(ragged=True)
+    model = cls(n_iter=8, features=4)
+    model.fit(X)
+    for i, w in enumerate(model.w_):
+        assert w.shape == (X[i].shape[0], 4)
+        assert np.allclose(w.T @ w, np.eye(4), atol=1e-5)
+    assert shared_space_correlation(model, X) > 0.9
+
+
+def test_srm_attributes_and_logprob():
+    X, _, _ = make_synthetic()
+    model = SRM(n_iter=10, features=4)
+    model.fit(X)
+    assert model.sigma_s_.shape == (4, 4)
+    assert model.rho2_.shape == (4,)
+    assert np.all(model.rho2_ > 0)
+    assert len(model.mu_) == 4
+    assert np.isfinite(model.logprob_)
+    # rho2 should approximate the injected noise variance (0.1^2)
+    assert np.all(model.rho2_ < 0.1)
+
+
+def test_srm_errors():
+    X, _, _ = make_synthetic(n_subjects=2)
+    with pytest.raises(ValueError):
+        SRM(n_iter=2, features=4).fit([X[0]])
+    with pytest.raises(ValueError):
+        SRM(n_iter=2, features=4).fit([X[0], X[1][:, :-3]])
+    with pytest.raises(ValueError):
+        SRM(n_iter=2, features=100).fit(X)
+    model = SRM(n_iter=2, features=4)
+    from sklearn.exceptions import NotFittedError
+    with pytest.raises(NotFittedError):
+        model.transform(X)
+    with pytest.raises(NotFittedError):
+        model.transform_subject(X[0])
+    model.fit(X)
+    with pytest.raises(ValueError):
+        model.transform([X[0]])
+    with pytest.raises(ValueError):
+        model.transform_subject(X[0][:, :-2])
+
+
+def test_transform_subject_new():
+    X, _, _ = make_synthetic(n_subjects=5)
+    model = SRM(n_iter=10, features=4)
+    model.fit(X[:4])
+    w_new = model.transform_subject(X[4])
+    assert w_new.shape == (X[4].shape[0], 4)
+    assert np.allclose(w_new.T @ w_new, np.eye(4), atol=1e-5)
+    # held-out subject maps into shared space consistently
+    s_new = w_new.T @ X[4]
+    s0 = model.w_[0].T @ X[0]
+    assert np.corrcoef(s_new.ravel(), s0.ravel())[0, 1] > 0.8
+
+
+def test_save_load_roundtrip(tmp_path):
+    X, _, _ = make_synthetic()
+    model = SRM(n_iter=5, features=4)
+    model.fit(X)
+    path = tmp_path / "model.npz"
+    model.save(path)
+    loaded = load(path)
+    assert loaded.features == 4 and loaded.n_iter == 5
+    for w0, w1 in zip(model.w_, loaded.w_):
+        assert np.allclose(w0, w1)
+    assert np.allclose(model.s_, loaded.s_)
+    assert np.allclose(model.sigma_s_, loaded.sigma_s_)
+    assert np.allclose(model.rho2_, loaded.rho2_)
+    # loaded model is usable
+    s = loaded.transform(X)
+    assert s[0].shape == (4, 40)
+
+
+def test_unfitted_save(tmp_path):
+    from sklearn.exceptions import NotFittedError
+    with pytest.raises(NotFittedError):
+        SRM().save(tmp_path / "x.npz")
+
+
+def test_srm_distributed_mesh_matches_single_device():
+    """Sharding subjects over the 8-device CPU mesh must reproduce the
+    single-device fit (the analog of the reference's MPI test
+    tests/funcalign/test_srm_distributed.py)."""
+    from brainiak_tpu.parallel import make_mesh
+
+    X, _, _ = make_synthetic(n_subjects=8, voxels=20, samples=30, features=3)
+    single = SRM(n_iter=6, features=3).fit(X)
+    mesh = make_mesh(("subject",), (8,))
+    dist = SRM(n_iter=6, features=3, mesh=mesh).fit(X)
+    for w0, w1 in zip(single.w_, dist.w_):
+        assert np.allclose(w0, w1, atol=1e-8)
+    assert np.allclose(single.s_, dist.s_, atol=1e-8)
+    assert np.allclose(single.rho2_, dist.rho2_, atol=1e-8)
+
+
+def test_detsrm_distributed_mesh_matches_single_device():
+    from brainiak_tpu.parallel import make_mesh
+
+    X, _, _ = make_synthetic(n_subjects=8, voxels=20, samples=30, features=3)
+    single = DetSRM(n_iter=6, features=3).fit(X)
+    mesh = make_mesh(("subject",), (8,))
+    dist = DetSRM(n_iter=6, features=3, mesh=mesh).fit(X)
+    for w0, w1 in zip(single.w_, dist.w_):
+        assert np.allclose(w0, w1, atol=1e-8)
+    assert np.allclose(single.s_, dist.s_, atol=1e-8)

@@ -1,0 +1,115 @@
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from brainiak_tpu import io, nifti
+from brainiak_tpu.image import (
+    MaskedMultiSubjectData,
+    SingleConditionSpec,
+    mask_image,
+    mask_images,
+    multimask_images,
+)
+
+# Real NIfTI fixtures from the reference test data (read-only).
+DATA_DIR = Path("/root/reference/tests/io/data")
+
+
+def test_load_images_from_dir_shape():
+    images = list(io.load_images_from_dir(DATA_DIR, "bet.nii.gz"))
+    assert len(images) == 2
+    for img in images:
+        assert img.shape == (64, 64, 26, 10)
+        data = img.get_fdata()
+        assert np.all(np.isfinite(data))
+        assert data.max() > 0
+
+
+def test_load_images_explicit_paths():
+    paths = [DATA_DIR / "subject1_bet.nii.gz",
+             DATA_DIR / "subject2_bet.nii.gz"]
+    images = list(io.load_images(paths))
+    assert len(images) == 2
+    assert images[0].shape == (64, 64, 26, 10)
+
+
+def test_load_boolean_mask():
+    mask = io.load_boolean_mask(DATA_DIR / "mask.nii.gz")
+    assert mask.dtype == bool
+    assert mask.shape == (64, 64, 26)
+    assert 0 < mask.sum() < mask.size
+    # predicate variant
+    mask2 = io.load_boolean_mask(DATA_DIR / "mask.nii.gz", lambda x: x > 0)
+    assert np.array_equal(mask, mask2)
+
+
+def test_load_labels():
+    specs = io.load_labels(DATA_DIR / "epoch_labels.npy")
+    assert len(specs) == 2
+    for spec in specs:
+        assert isinstance(spec, SingleConditionSpec)
+        assert spec.shape == (2, 2, 10)
+        labels = spec.extract_labels()
+        assert labels.shape == (2,)
+        assert set(labels) <= {0, 1}
+
+
+def test_mask_image_and_multisubject_stack():
+    mask = io.load_boolean_mask(DATA_DIR / "mask.nii.gz")
+    images = list(io.load_images_from_dir(DATA_DIR, "bet.nii.gz"))
+    masked = [mask_image(img, mask) for img in images]
+    n_vox = int(mask.sum())
+    for m in masked:
+        assert m.shape == (n_vox, 10)
+    data = MaskedMultiSubjectData.from_masked_images(iter(masked), 2)
+    assert data.shape == (10, n_vox, 2)
+    assert np.allclose(data[:, :, 0], masked[0].T)
+    with pytest.raises(ValueError):
+        MaskedMultiSubjectData.from_masked_images(iter(masked), 3)
+    with pytest.raises(ValueError):
+        MaskedMultiSubjectData.from_masked_images(
+            iter([masked[0], masked[1][:-1]]), 2)
+
+
+def test_mask_images_generators():
+    mask = io.load_boolean_mask(DATA_DIR / "mask.nii.gz")
+    images = io.load_images_from_dir(DATA_DIR, "bet.nii.gz")
+    out = list(mask_images(images, mask, np.float32))
+    assert len(out) == 2
+    assert out[0].dtype == np.float32
+    images = io.load_images_from_dir(DATA_DIR, "bet.nii.gz")
+    multi = list(multimask_images(images, (mask, mask)))
+    assert len(multi) == 2 and len(multi[0]) == 2
+    with pytest.raises(ValueError):
+        mask_image(np.zeros((2, 2, 2, 5)), np.ones((3, 3, 3), dtype=bool))
+
+
+def test_nifti_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.rand(7, 6, 5, 4).astype(np.float32)
+    affine = np.array([[2.0, 0, 0, -10], [0, 2.0, 0, -20],
+                       [0, 0, 3.0, 5], [0, 0, 0, 1]])
+    for name in ["img.nii", "img.nii.gz"]:
+        path = tmp_path / name
+        io.save_as_nifti_file(data, affine, path)
+        img = nifti.load(path)
+        assert img.shape == data.shape
+        assert np.allclose(img.get_fdata(), data, atol=1e-6)
+        assert np.allclose(img.affine, affine)
+
+
+def test_nifti_int_dtype_roundtrip(tmp_path):
+    data = np.arange(24, dtype=np.int16).reshape(2, 3, 4)
+    path = tmp_path / "int.nii.gz"
+    nifti.save(nifti.NiftiImage(data, np.eye(4)), path)
+    img = nifti.load(path)
+    assert np.array_equal(img.dataobj, data)
+    assert img.dataobj.dtype == np.int16
+
+
+def test_nifti_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.nii"
+    p.write_bytes(b"\x00" * 400)
+    with pytest.raises(ValueError):
+        nifti.load(p)
