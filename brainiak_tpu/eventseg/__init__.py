@@ -1,0 +1,4 @@
+"""Event segmentation (HMM with left-to-right event chains), TPU-native.
+
+Re-design of /root/reference/src/brainiak/eventseg/: the Python
+forward-backward loops become ``lax.scan`` programs."""

@@ -1,0 +1,620 @@
+"""Intersubject correlation (ISC/ISFC) with resampling statistics.
+
+Re-design of /root/reference/src/brainiak/isc.py.  Public surface and
+statistical semantics match the reference; the compute core is jitted JAX:
+
+- leave-one-out / pairwise ISC and ISFC are batched einsums instead of
+  per-voxel / per-pair Python loops (reference isc.py:164-192, 310-349);
+- the resampling nulls (bootstrap, permutation, circular time-shift, phase
+  randomization) are ``lax.map`` over ``jax.random`` keys on device instead
+  of stateful RandomState chains (reference isc.py:739-787, 1200-1247,
+  1344-1398, 1500-1547).  Seeds therefore produce different (but
+  statistically equivalent) resamples than the reference.
+
+Deviation noted: in the pairwise bootstrap the reference censors resampled
+same-subject pairs by testing ``isc == 1.0`` (isc.py:769); we censor by
+resampled-index equality, which is equivalent except it cannot
+accidentally censor a genuine ISC of exactly 1.0.
+"""
+
+import logging
+import math
+from functools import partial
+from itertools import permutations, product
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.spatial.distance import squareform
+
+from .utils.utils import _check_timeseries_input, p_from_null
+
+__all__ = [
+    "bootstrap_isc",
+    "compute_summary_statistic",
+    "isc",
+    "isfc",
+    "permutation_isc",
+    "phaseshift_isc",
+    "squareform_isfc",
+    "timeshift_isc",
+]
+
+logger = logging.getLogger(__name__)
+
+MAX_RANDOM_SEED = 2 ** 32 - 1
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+def _threshold_nans(data, tolerate_nans):
+    """Exclude voxels exceeding the NaN threshold; returns (data, keep_mask).
+    Contract: reference isc.py:592-647."""
+    nans = np.all(np.any(np.isnan(data), axis=0), axis=1)
+    if tolerate_nans is True:
+        pass
+    elif isinstance(tolerate_nans, float):
+        if not 0.0 <= tolerate_nans <= 1.0:
+            raise ValueError("If threshold to tolerate NaNs is a float, "
+                             "it must be between 0.0 and 1.0; got {0}".format(
+                                 tolerate_nans))
+        nans += ~(np.sum(~np.any(np.isnan(data), axis=0), axis=1) >=
+                  data.shape[-1] * tolerate_nans)
+    mask = ~nans
+    return data[:, mask, :], mask
+
+
+def _check_isc_input(iscs, pairwise=False):
+    """Standardize ISC stat-test input; returns (iscs, n_subjects, n_voxels).
+    Contract: reference isc.py:373-428."""
+    if isinstance(iscs, list):
+        iscs = np.array(iscs)[:, np.newaxis]
+    elif isinstance(iscs, np.ndarray) and iscs.ndim == 1:
+        iscs = iscs[:, np.newaxis]
+    if pairwise:
+        try:
+            test_square = squareform(iscs[:, 0], force='tomatrix')
+            n_subjects = test_square.shape[0]
+        except ValueError:
+            raise ValueError("For pairwise input, ISCs must be the "
+                             "vectorized triangle of a square matrix.")
+    else:
+        n_subjects = iscs.shape[0]
+    return iscs, n_subjects, iscs.shape[1]
+
+
+def compute_summary_statistic(iscs, summary_statistic='mean', axis=None):
+    """'mean' (Fisher-z averaged) or 'median' of ISC values
+    (reference isc.py:483-527)."""
+    if summary_statistic not in ('mean', 'median'):
+        raise ValueError("Summary statistic must be 'mean' or 'median'")
+    if summary_statistic == 'mean':
+        return np.tanh(np.nanmean(np.arctanh(iscs), axis=axis))
+    return np.nanmedian(iscs, axis=axis)
+
+
+def _jnp_summary(iscs, summary_statistic, axis=0):
+    if summary_statistic == 'mean':
+        return jnp.tanh(jnp.nanmean(jnp.arctanh(iscs), axis=axis))
+    return jnp.nanmedian(iscs, axis=axis)
+
+
+def squareform_isfc(isfcs, iscs=None):
+    """Square<->condensed ISFC conversion retaining diagonal ISCs
+    (reference isc.py:529-590)."""
+    if not isinstance(iscs, np.ndarray) and isfcs.shape[-2] == \
+            isfcs.shape[-1]:
+        if isfcs.ndim == 2:
+            isfcs = isfcs[np.newaxis, ...]
+        if isfcs.ndim == 3:
+            iscs = np.diagonal(isfcs, axis1=1, axis2=2)
+            isfcs = np.vstack([squareform(m, checks=False)[np.newaxis, :]
+                               for m in isfcs])
+        else:
+            raise ValueError("Square (redundant) ISFCs must be square "
+                             "with multiple subjects or pairs of subjects "
+                             "indexed by the first dimension")
+        if isfcs.shape[0] == iscs.shape[0] == 1:
+            isfcs, iscs = isfcs[0], iscs[0]
+        return isfcs, iscs
+    else:
+        if isfcs.ndim == iscs.ndim == 1:
+            isfcs, iscs = isfcs[np.newaxis, :], iscs[np.newaxis, :]
+        stack = []
+        for isfc_v, isc_v in zip(isfcs, iscs):
+            sq = squareform(isfc_v, checks=False)
+            np.fill_diagonal(sq, isc_v)
+            stack.append(sq[np.newaxis, ...])
+        out = np.vstack(stack)
+        return out[0] if out.shape[0] == 1 else out
+
+
+# ---------------------------------------------------------------------------
+# jitted cores
+
+@partial(jax.jit, static_argnames=("tolerate_nans",))
+def _loo_means_core(data, tolerate_nans=True):
+    """Mean of all-but-subject-s along the last axis: [T, V, S] -> same."""
+    if tolerate_nans:
+        total = jnp.nansum(data, axis=2, keepdims=True)
+        count = jnp.sum(~jnp.isnan(data), axis=2, keepdims=True)
+        centered = jnp.where(jnp.isnan(data), 0.0, data)
+    else:
+        total = jnp.sum(data, axis=2, keepdims=True)
+        count = jnp.full(data.shape[:2] + (1,), data.shape[2],
+                         dtype=data.dtype)
+        centered = data
+    return (total - centered) / (count - 1)
+
+
+@jax.jit
+def _columnwise_corr(x, y):
+    """Pearson r between matching columns of x and y over axis 0.
+
+    x, y: [T, V, S] -> [S, V]
+    """
+    xd = x - jnp.mean(x, axis=0)
+    yd = y - jnp.mean(y, axis=0)
+    num = jnp.sum(xd * yd, axis=0)
+    den = jnp.sqrt(jnp.sum(xd ** 2, axis=0) * jnp.sum(yd ** 2, axis=0))
+    return (num / den).T
+
+
+@partial(jax.jit, static_argnames=("tolerate_nans",))
+def _isc_loo_core(data, tolerate_nans=True):
+    """Leave-one-out ISC: corr(subject, mean-of-others) per voxel.
+
+    data: [T, V, S] -> [S, V]
+    """
+    return _columnwise_corr(data, _loo_means_core(data, tolerate_nans))
+
+
+@jax.jit
+def _isc_pairwise_core(data):
+    """Pairwise per-voxel subject-by-subject correlation matrix.
+
+    data: [T, V, S] -> [S, S, V]
+    """
+    xd = data - jnp.mean(data, axis=0)
+    norm = jnp.sqrt(jnp.sum(xd ** 2, axis=0))
+    z = xd / norm
+    return jnp.einsum('tvs,tvr->srv', z, z)
+
+
+@jax.jit
+def _pearson_rows(x, y):
+    """Correlate rows of x [A, T] with rows of y [B, T] -> [A, B]."""
+    xd = x - jnp.mean(x, axis=1, keepdims=True)
+    yd = y - jnp.mean(y, axis=1, keepdims=True)
+    xn = xd / jnp.sqrt(jnp.sum(xd ** 2, axis=1, keepdims=True))
+    yn = yd / jnp.sqrt(jnp.sum(yd ** 2, axis=1, keepdims=True))
+    return xn @ yn.T
+
+
+@partial(jax.jit, static_argnames=("symmetric",))
+def _isfc_loo_core(data, target_means, symmetric=True):
+    """Leave-one-out ISFC matrices for all subjects in one program.
+
+    data, target_means: [T, V, S] / [T, W, S] -> [V, W, S]
+    """
+    def per_subject(subj, tgt):
+        m = _pearson_rows(subj.T, tgt.T)
+        return (m + m.T) / 2 if symmetric else m
+
+    return jnp.moveaxis(
+        jax.vmap(per_subject, in_axes=(2, 2))(data, target_means), 0, 2)
+
+
+@jax.jit
+def _isfc_pairwise_core(data, idx_i, idx_j):
+    """Pairwise symmetrized ISFC matrices, batched over pairs.
+
+    data: [T, V, S]; idx_i/idx_j: [P] -> [V, V, P]
+    """
+    def per_pair(i, j):
+        m = _pearson_rows(data[..., i].T, data[..., j].T)
+        return (m + m.T) / 2
+
+    return jnp.moveaxis(jax.vmap(per_pair)(idx_i, idx_j), 0, 2)
+
+
+# ---------------------------------------------------------------------------
+# public API
+
+def isc(data, pairwise=False, summary_statistic=None, tolerate_nans=True):
+    """Intersubject correlation per voxel (reference isc.py:81-210).
+
+    Leave-one-out (default) or pairwise; optional 'mean'/'median' summary.
+    """
+    data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
+    if n_subjects == 2:
+        summary_statistic = None
+    data, mask = _threshold_nans(data, tolerate_nans)
+
+    if n_subjects == 2:
+        from .utils.utils import array_correlation
+        iscs_stack = array_correlation(data[..., 0],
+                                       data[..., 1])[np.newaxis, :]
+    elif pairwise:
+        corr = np.asarray(_isc_pairwise_core(jnp.asarray(data)))
+        iu = np.triu_indices(n_subjects, k=1)
+        iscs_stack = corr[iu[0], iu[1], :]
+    else:
+        iscs_stack = np.asarray(
+            _isc_loo_core(jnp.asarray(data), bool(tolerate_nans)))
+
+    iscs = np.full((iscs_stack.shape[0], n_voxels), np.nan)
+    iscs[:, np.where(mask)[0]] = iscs_stack
+
+    if summary_statistic:
+        iscs = compute_summary_statistic(
+            iscs, summary_statistic=summary_statistic, axis=0)[np.newaxis, :]
+    if iscs.shape[0] == 1:
+        iscs = iscs[0]
+    return iscs
+
+
+def _check_targets_input(targets, data):
+    """Standardize optional ISFC targets (reference isc.py:430-481)."""
+    if isinstance(targets, (np.ndarray, list)):
+        targets, n_TRs, n_voxels, n_subjects = (
+            _check_timeseries_input(targets))
+        if data.shape[0] != n_TRs:
+            raise ValueError("Targets array must have same number of "
+                             "TRs as input data")
+        if data.shape[2] != n_subjects:
+            raise ValueError("Targets array must have same number of "
+                             "subjects as input data")
+        symmetric = False
+    else:
+        targets = data
+        n_TRs, n_voxels, n_subjects = data.shape
+        symmetric = True
+    return targets, n_TRs, n_voxels, n_subjects, symmetric
+
+
+def isfc(data, targets=None, pairwise=False, summary_statistic=None,
+         vectorize_isfcs=True, tolerate_nans=True):
+    """Intersubject functional correlation (reference isc.py:211-370).
+
+    Correlates each subject's voxel time series with (a) the average of the
+    other subjects' series (leave-one-out), or (b) each other subject's
+    series (pairwise); optionally against a separate ``targets`` array.
+    """
+    data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
+    targets, t_n_TRs, t_n_voxels, _, symmetric = (
+        _check_targets_input(targets, data))
+    if not symmetric:
+        pairwise = False
+    data, mask = _threshold_nans(data, tolerate_nans)
+    targets, targets_mask = _threshold_nans(targets, tolerate_nans)
+
+    if symmetric and n_subjects == 2:
+        m = np.asarray(_pearson_rows(jnp.asarray(data[..., 0].T),
+                                     jnp.asarray(data[..., 1].T)))
+        isfcs = ((m + m.T) / 2)[..., np.newaxis]
+        summary_statistic = None
+    elif pairwise:
+        iu = np.triu_indices(n_subjects, k=1)
+        isfcs = np.asarray(_isfc_pairwise_core(
+            jnp.asarray(data), jnp.asarray(iu[0]), jnp.asarray(iu[1])))
+    else:
+        target_means = _loo_means_core(jnp.asarray(targets),
+                                       bool(tolerate_nans))
+        isfcs = np.asarray(_isfc_loo_core(
+            jnp.asarray(data), target_means, symmetric=symmetric))
+
+    isfcs_all = np.full((n_voxels, t_n_voxels, isfcs.shape[2]), np.nan)
+    isfcs_all[np.ix_(np.where(mask)[0], np.where(targets_mask)[0])] = isfcs
+    isfcs = np.moveaxis(isfcs_all, 2, 0)
+
+    if summary_statistic:
+        isfcs = compute_summary_statistic(
+            isfcs, summary_statistic=summary_statistic, axis=0)
+    if isfcs.shape[0] == 1:
+        isfcs = isfcs[0]
+    if vectorize_isfcs and symmetric:
+        return squareform_isfc(isfcs)
+    return isfcs
+
+
+# ---------------------------------------------------------------------------
+# resampling statistics
+
+def _reinsert_nan_voxels(observed, distribution, mask, n_voxels):
+    """Restore NaN columns for voxels excluded by _threshold_nans so output
+    stays positionally aligned with the input voxel axis."""
+    if np.all(mask):
+        return observed, distribution
+    idx = np.where(mask)[0]
+    obs_full = np.full(observed.shape[:-1] + (n_voxels,), np.nan)
+    obs_full[..., idx] = observed
+    dist_full = np.full(distribution.shape[:-1] + (n_voxels,), np.nan)
+    dist_full[..., idx] = distribution
+    return obs_full, dist_full
+
+
+def _resolve_seed(random_state):
+    if isinstance(random_state, np.random.RandomState):
+        return int(random_state.randint(0, MAX_RANDOM_SEED))
+    if random_state is None:
+        return int(np.random.randint(0, MAX_RANDOM_SEED))
+    return int(random_state)
+
+
+def bootstrap_isc(iscs, pairwise=False, summary_statistic='median',
+                  n_bootstraps=1000, ci_percentile=95, side='right',
+                  random_state=None):
+    """Subject-wise bootstrap test for ISCs (reference isc.py:649-810).
+
+    Resamples subjects with replacement, shifts the bootstrap distribution
+    by the observed statistic (Hall & Wilson 1991), and returns
+    (observed, ci, p, distribution).
+    """
+    iscs, n_subjects, n_voxels = _check_isc_input(iscs, pairwise=pairwise)
+    if summary_statistic not in ('mean', 'median'):
+        raise ValueError("Summary statistic must be 'mean' or 'median'")
+
+    observed = compute_summary_statistic(
+        iscs, summary_statistic=summary_statistic, axis=0)
+
+    iscs_j = jnp.asarray(iscs)
+    if pairwise:
+        # Rebuild the square matrix once; each bootstrap gathers rows/cols.
+        sq = np.stack([squareform(v, force='tomatrix') for v in iscs.T],
+                      axis=-1)  # [S, S, V]
+        for v in range(sq.shape[-1]):
+            np.fill_diagonal(sq[..., v], 1.0)
+        sq_j = jnp.asarray(sq)
+        iu = np.triu_indices(n_subjects, k=1)
+
+        def one_boot(key):
+            sample = jnp.sort(
+                jax.random.choice(key, n_subjects, (n_subjects,)))
+            resq = sq_j[sample][:, sample]
+            same = sample[:, None] == sample[None, :]
+            resq = jnp.where(same[..., None], jnp.nan, resq)
+            tri = resq[iu[0], iu[1]]
+            return _jnp_summary(tri, summary_statistic, axis=0)
+    else:
+        def one_boot(key):
+            sample = jax.random.choice(key, n_subjects, (n_subjects,))
+            return _jnp_summary(iscs_j[sample], summary_statistic, axis=0)
+
+    keys = jax.random.split(jax.random.PRNGKey(_resolve_seed(random_state)),
+                            n_bootstraps)
+    distribution = np.asarray(jax.lax.map(one_boot, keys, batch_size=64))
+
+    ci = (np.percentile(distribution, (100 - ci_percentile) / 2, axis=0),
+          np.percentile(distribution,
+                        ci_percentile + (100 - ci_percentile) / 2, axis=0))
+    shifted = distribution - observed
+    p = p_from_null(observed, shifted, side=side, exact=False, axis=0)
+    return observed, ci, p, distribution
+
+
+def _check_group_assignment(group_assignment, n_subjects):
+    if isinstance(group_assignment, list):
+        group_assignment = np.array(group_assignment)
+    if group_assignment is not None and \
+            len(group_assignment) != n_subjects:
+        raise ValueError("Group assignments ({0}) do not match number of "
+                         "subjects ({1})!".format(len(group_assignment),
+                                                  n_subjects))
+    return group_assignment
+
+
+def permutation_isc(iscs, group_assignment=None, pairwise=False,
+                    summary_statistic='median', n_permutations=1000,
+                    side='right', random_state=None):
+    """Group-label permutation test for ISCs (reference isc.py:1057-1251).
+
+    One group: sign-flipping (exact when 2**N <= n_permutations).  Two
+    groups: group-assignment shuffling (exact when N! <= n_permutations).
+    Returns (observed, p, distribution).
+    """
+    iscs, n_subjects, n_voxels = _check_isc_input(iscs, pairwise=pairwise)
+    if summary_statistic not in ('mean', 'median'):
+        raise ValueError("Summary statistic must be 'mean' or 'median'")
+    group_assignment = _check_group_assignment(group_assignment, n_subjects)
+
+    labels = (np.unique(group_assignment)
+              if group_assignment is not None else np.array([0]))
+    n_groups = len(labels)
+    if n_groups > 2:
+        raise ValueError("This test is not valid for more than "
+                         "2 groups! (got {0})".format(n_groups))
+
+    iscs_j = jnp.asarray(iscs)
+
+    if n_groups == 1:
+        observed = compute_summary_statistic(
+            iscs, summary_statistic=summary_statistic, axis=0)[np.newaxis, :]
+        exact = n_permutations >= 2 ** n_subjects
+
+        if pairwise:
+            iu = np.triu_indices(n_subjects, k=1)
+
+            def apply_flips(flips):
+                pairflip = flips[iu[0]] * flips[iu[1]]
+                return _jnp_summary(iscs_j * pairflip[:, None],
+                                    summary_statistic, axis=0)
+        else:
+            def apply_flips(flips):
+                return _jnp_summary(iscs_j * flips[:, None],
+                                    summary_statistic, axis=0)
+
+        if exact:
+            n_permutations = 2 ** n_subjects
+            flips = jnp.asarray(list(product([-1.0, 1.0],
+                                             repeat=n_subjects)))
+            distribution = np.asarray(
+                jax.lax.map(apply_flips, flips, batch_size=64))
+        else:
+            keys = jax.random.split(
+                jax.random.PRNGKey(_resolve_seed(random_state)),
+                n_permutations)
+
+            def one_perm(key):
+                flips = jax.random.choice(key, jnp.array([-1.0, 1.0]),
+                                          (n_subjects,))
+                return apply_flips(flips)
+
+            distribution = np.asarray(
+                jax.lax.map(one_perm, keys, batch_size=64))
+    else:
+        group_selector = np.asarray(group_assignment)
+        if pairwise:
+            # Group label of each pair: valid only within-group;
+            # between-group pairs get NaN and are excluded from summaries.
+            sq_labels = np.full((n_subjects, n_subjects), np.nan)
+            for g in labels:
+                idx = np.where(group_selector == g)[0]
+                sq_labels[np.ix_(idx, idx)] = g
+            np.fill_diagonal(sq_labels, np.nan)
+            pair_labels = squareform(sq_labels, checks=False)
+
+            def stat_for(pair_labels_j):
+                s0 = _jnp_summary(
+                    jnp.where((pair_labels_j == labels[0])[:, None],
+                              iscs_j, jnp.nan), summary_statistic, axis=0)
+                s1 = _jnp_summary(
+                    jnp.where((pair_labels_j == labels[1])[:, None],
+                              iscs_j, jnp.nan), summary_statistic, axis=0)
+                return s0 - s1
+
+            observed = np.asarray(stat_for(jnp.asarray(pair_labels)))
+
+            sq_labels_j = jnp.asarray(sq_labels)
+            iu = np.triu_indices(n_subjects, k=1)
+
+            def permute_stat(perm):
+                shuffled = sq_labels_j[perm][:, perm]
+                return stat_for(shuffled[iu[0], iu[1]])
+        else:
+            sel_j = jnp.asarray(group_selector)
+
+            def stat_groups(sel):
+                s0 = _jnp_summary(
+                    jnp.where((sel == labels[0])[:, None], iscs_j, jnp.nan),
+                    summary_statistic, axis=0)
+                s1 = _jnp_summary(
+                    jnp.where((sel == labels[1])[:, None], iscs_j, jnp.nan),
+                    summary_statistic, axis=0)
+                return s0 - s1
+
+            observed = np.asarray(stat_groups(sel_j))
+
+            def permute_stat(perm):
+                return stat_groups(sel_j[perm])
+
+        exact = n_permutations >= math.factorial(n_subjects)
+        if exact:
+            n_permutations = math.factorial(n_subjects)
+            perms = jnp.asarray(
+                list(permutations(np.arange(n_subjects))))
+            distribution = np.asarray(
+                jax.lax.map(permute_stat, perms, batch_size=64))
+        else:
+            keys = jax.random.split(
+                jax.random.PRNGKey(_resolve_seed(random_state)),
+                n_permutations)
+
+            def one_perm(key):
+                return permute_stat(
+                    jax.random.permutation(key, n_subjects))
+
+            distribution = np.asarray(
+                jax.lax.map(one_perm, keys, batch_size=64))
+
+    p = p_from_null(observed, distribution, side=side, exact=exact, axis=0)
+    return observed, p, distribution
+
+
+def timeshift_isc(data, pairwise=False, summary_statistic='median',
+                  n_shifts=1000, side='right', tolerate_nans=True,
+                  random_state=None):
+    """Circular time-shift null for ISC (reference isc.py:1253-1410).
+
+    Returns (observed, p, distribution)."""
+    data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
+    data, mask = _threshold_nans(data, tolerate_nans)
+
+    observed = isc(data, pairwise=pairwise,
+                   summary_statistic=summary_statistic,
+                   tolerate_nans=tolerate_nans)
+
+    data_j = jnp.asarray(data)
+    tol = bool(tolerate_nans)
+
+    if pairwise:
+        iu = np.triu_indices(n_subjects, k=1)
+
+        def one_shift(key):
+            shifts = jax.random.choice(key, n_TRs, (n_subjects,))
+            rolled = jax.vmap(
+                lambda s, shift: jnp.roll(s, shift, axis=0),
+                in_axes=(2, 0), out_axes=2)(data_j, shifts)
+            corr = _isc_pairwise_core(rolled)
+            return _jnp_summary(corr[iu[0], iu[1], :],
+                                summary_statistic, axis=0)
+    else:
+        # shift only the left-out subject against the unshifted others
+        others = _loo_means_core(data_j, tol)
+
+        def one_shift(key):
+            shifts = jax.random.choice(key, n_TRs, (n_subjects,))
+            rolled = jax.vmap(
+                lambda s, shift: jnp.roll(s, shift, axis=0),
+                in_axes=(2, 0), out_axes=2)(data_j, shifts)
+            return _jnp_summary(_columnwise_corr(rolled, others),
+                                summary_statistic, axis=0)
+
+    keys = jax.random.split(jax.random.PRNGKey(_resolve_seed(random_state)),
+                            n_shifts)
+    distribution = np.asarray(jax.lax.map(one_shift, keys, batch_size=16))
+
+    observed, distribution = _reinsert_nan_voxels(
+        observed, distribution, mask, n_voxels)
+    p = p_from_null(observed, distribution, side=side, exact=False, axis=0)
+    return observed, p, distribution
+
+
+def phaseshift_isc(data, pairwise=False, summary_statistic='median',
+                   n_shifts=1000, voxelwise=False, side='right',
+                   tolerate_nans=True, random_state=None):
+    """Phase-randomization null for ISC (reference isc.py:1410-1551).
+
+    Returns (observed, p, distribution)."""
+    from .ops.stats import phase_randomize as phase_randomize_jax
+
+    data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
+    data, mask = _threshold_nans(data, tolerate_nans)
+
+    observed = isc(data, pairwise=pairwise,
+                   summary_statistic=summary_statistic,
+                   tolerate_nans=tolerate_nans)
+
+    data_j = jnp.asarray(data)
+    tol = bool(tolerate_nans)
+    iu = np.triu_indices(n_subjects, k=1)
+    others = _loo_means_core(data_j, tol)
+
+    def one_shift(key):
+        shifted = phase_randomize_jax(key, data_j, voxelwise=voxelwise)
+        if pairwise:
+            corr = _isc_pairwise_core(shifted)
+            return _jnp_summary(corr[iu[0], iu[1], :],
+                                summary_statistic, axis=0)
+        return _jnp_summary(_columnwise_corr(shifted, others),
+                            summary_statistic, axis=0)
+
+    keys = jax.random.split(jax.random.PRNGKey(_resolve_seed(random_state)),
+                            n_shifts)
+    distribution = np.asarray(jax.lax.map(one_shift, keys, batch_size=16))
+
+    observed, distribution = _reinsert_nan_voxels(
+        observed, distribution, mask, n_voxels)
+    p = p_from_null(observed, distribution, side=side, exact=False, axis=0)
+    return observed, p, distribution

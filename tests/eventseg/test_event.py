@@ -1,0 +1,163 @@
+import numpy as np
+import pytest
+from scipy.special import comb
+from sklearn.exceptions import NotFittedError
+
+from brainiak_tpu.eventseg.event import EventSegment
+
+
+def test_fit_shapes():
+    K, V, T = 5, 3, 10
+    es = EventSegment(K, n_iter=2)
+    rng = np.random.RandomState(0)
+    es.fit(rng.rand(V, T).T)
+    assert es.segments_[0].shape == (T, K)
+    assert np.allclose(np.sum(es.segments_[0], axis=1), 1.0)
+
+    T2 = 15
+    test_segments, test_ll = es.find_events(rng.rand(V, T2).T)
+    assert test_segments.shape == (T2, K)
+    assert np.allclose(np.sum(test_segments, axis=1), 1.0)
+    assert np.isfinite(test_ll)
+
+    with pytest.raises(ValueError):
+        EventSegment(K).model_prior(K - 1)
+    with pytest.raises(ValueError):
+        EventSegment(K).set_event_patterns(np.zeros((V, K - 1)))
+
+
+def test_simple_boundary():
+    es = EventSegment(2)
+    rng = np.random.RandomState(0)
+    sample_data = np.array([[1, 1, 1, 0, 0, 0, 0],
+                            [0, 0, 0, 1, 1, 1, 1]]) + rng.rand(2, 7) * 10
+    es.fit(sample_data.T)
+    events = np.argmax(es.segments_[0], axis=1)
+    assert np.array_equal(events, [0, 0, 0, 1, 1, 1, 1])
+    assert np.array_equal(es.predict(sample_data.T),
+                          [0, 0, 0, 1, 1, 1, 1])
+
+
+def test_event_transfer():
+    es = EventSegment(2)
+    sample_data = np.asarray([[1, 1, 1, 0, 0, 0, 0],
+                              [0, 0, 0, 1, 1, 1, 1]], dtype=float)
+    with pytest.raises(NotFittedError):
+        es.find_events(sample_data.T)
+    with pytest.raises(NotFittedError):
+        es.find_events(sample_data.T, np.asarray([1, 1]))
+    es.set_event_patterns(np.asarray([[1, 0], [0, 1]], dtype=float))
+    seg = es.find_events(sample_data.T, np.asarray([1.0, 1.0]))[0]
+    assert np.array_equal(np.argmax(seg, axis=1), [0, 0, 0, 1, 1, 1, 1])
+
+
+def test_weighted_var():
+    es = EventSegment(2)
+    D = np.zeros((8, 4))
+    for t in range(4):
+        D[t, :] = (1 / np.sqrt(4 / 3)) * np.array([-1, -1, 1, 1])
+    for t in range(4, 8):
+        D[t, :] = (1 / np.sqrt(4 / 3)) * np.array([1, 1, -1, -1])
+    mean_pat = D[[0, 4], :].T
+    weights = np.zeros((8, 2))
+    weights[:, 0] = [1, 1, 1, 1, 0, 0, 0, 0]
+    weights[:, 1] = [0, 0, 0, 0, 1, 1, 1, 1]
+    assert np.array_equal(
+        es.calc_weighted_event_var(D, weights, mean_pat), [0, 0])
+    weights[:, 0] = [1, 1, 1, 1, 0.5, 0.5, 0.5, 0.5]
+    weights[:, 1] = [0.5, 0.5, 0.5, 0.5, 1, 1, 1, 1]
+    true_var = (4 * 0.5 * 12) / (6 - 5 / 6) * np.ones(2) / 4
+    assert np.allclose(
+        es.calc_weighted_event_var(D, weights, mean_pat), true_var)
+
+
+def test_sym():
+    es = EventSegment(4)
+    evpat = np.repeat(np.arange(10).reshape(-1, 1), 4, axis=1)
+    es.set_event_patterns(evpat.astype(float))
+    D = np.repeat(np.arange(10).reshape(1, -1), 20, axis=0).astype(float)
+    ev = es.find_events(D, var=1)[0]
+    assert np.allclose(ev[:, :2], np.fliplr(np.flipud(ev[:, 2:])))
+
+
+def test_chains():
+    es = EventSegment(5, event_chains=np.array(['A', 'A', 'B', 'B', 'B']))
+    sample_data = np.array([[0, 0, 0], [1, 1, 1]], dtype=float)
+    with pytest.raises(RuntimeError):
+        es.fit(sample_data.T)
+    es.set_event_patterns(np.array([[1, 1, 0, 0, 0],
+                                    [0, 0, 1, 1, 1]], dtype=float))
+    seg = es.find_events(sample_data.T, 0.1)[0]
+    ev = np.nonzero(seg > 0.99)[1]
+    assert np.array_equal(ev, [2, 3, 4])
+
+
+def test_prior():
+    K, T = 10, 100
+    es = EventSegment(K)
+    mp = es.model_prior(T)[0]
+
+    p_bound = np.zeros((T, K - 1))
+    norm = comb(T - 1, K - 1)
+    for t in range(T - 1):
+        for k in range(K - 1):
+            p_bound[t + 1, k] = comb(t, k) * comb(T - t - 2, K - k - 2) \
+                / norm
+    p_bound = np.cumsum(p_bound, axis=0)
+
+    mp_gt = np.zeros((T, K))
+    for k in range(K):
+        if k == 0:
+            mp_gt[:, k] = 1 - p_bound[:, 0]
+        elif k == K - 1:
+            mp_gt[:, k] = p_bound[:, k - 1]
+        else:
+            mp_gt[:, k] = p_bound[:, k - 1] - p_bound[:, k]
+    assert np.allclose(mp, mp_gt)
+
+
+def test_split_merge():
+    ev = np.array(
+        [0, 0, 0, 1, 1, 1, 2, 2, 2, 3, 3, 3, 3, 3, 3, 3, 3, 3, 3, 3, 3, 3,
+         3, 3, 3, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4])
+    rng = np.random.RandomState(0)
+    ev_pat = rng.rand(5, 10)
+    D = np.zeros((len(ev), 10))
+    for t in range(len(ev)):
+        D[t, :] = ev_pat[ev[t], :] + 0.1 * rng.rand(10)
+    hmm_sm = EventSegment(5, split_merge=True, split_merge_proposals=2)
+    hmm_sm.fit(D)
+    assert np.array_equal(np.argmax(hmm_sm.segments_[0], axis=1), ev)
+
+
+def test_sym_ll():
+    """Forward and time-reversed data give the same log-likelihood."""
+    ev = np.array([0, 0, 0, 1, 1, 1, 1, 1, 1, 2, 2])
+    rng = np.random.RandomState(0)
+    ev_pat = rng.rand(3, 10)
+    D_forward = np.zeros((len(ev), 10))
+    for t in range(len(ev)):
+        D_forward[t, :] = ev_pat[ev[t], :] + 0.1 * rng.rand(10)
+    D_backward = np.flip(D_forward, axis=0)
+
+    hmm_f = EventSegment(3)
+    hmm_f.set_event_patterns(ev_pat.T)
+    _, ll_forward = hmm_f.find_events(D_forward, var=1)
+
+    hmm_b = EventSegment(3)
+    hmm_b.set_event_patterns(np.flip(ev_pat.T, axis=1))
+    _, ll_backward = hmm_b.find_events(D_backward, var=1)
+    assert np.isclose(ll_forward, ll_backward)
+
+
+def test_multiple_datasets_fit():
+    rng = np.random.RandomState(1)
+    base = np.array([[1, 1, 1, 0, 0, 0, 0], [0, 0, 0, 1, 1, 1, 1]],
+                    dtype=float)
+    X = [(base + rng.rand(2, 7)).T, (base + rng.rand(2, 7)).T]
+    es = EventSegment(2).fit(X)
+    assert len(es.segments_) == 2
+    assert es.ll_.shape[1] == 2
+    for seg in es.segments_:
+        assert np.array_equal(np.argmax(seg, axis=1),
+                              [0, 0, 0, 1, 1, 1, 1])

@@ -1,0 +1,258 @@
+import numpy as np
+import pytest
+
+from brainiak_tpu.isc import (
+    bootstrap_isc,
+    compute_summary_statistic,
+    isc,
+    isfc,
+    permutation_isc,
+    phaseshift_isc,
+    squareform_isfc,
+    timeshift_isc,
+)
+
+
+def simulated_timeseries(n_subjects, n_TRs, n_voxels=30, noise=1.0,
+                         random_state=None):
+    """Shared signal + independent noise per subject -> [T, V, S]."""
+    prng = np.random.RandomState(random_state)
+    signal = prng.randn(n_TRs, n_voxels)
+    return np.dstack([signal + prng.randn(n_TRs, n_voxels) * noise
+                      for _ in range(n_subjects)])
+
+
+def correlated_timeseries(n_subjects, n_TRs, noise=0.0, random_state=None):
+    """3 voxels: first two share a signal, third is independent noise."""
+    prng = np.random.RandomState(random_state)
+    signal = prng.randn(n_TRs)
+    data = np.repeat(np.column_stack((signal, signal))[..., np.newaxis],
+                     n_subjects, axis=2)
+    uncorrelated = prng.randn(n_TRs, 1, n_subjects)
+    data = np.concatenate((data, uncorrelated), axis=1)
+    return data + prng.randn(n_TRs, 3, n_subjects) * noise
+
+
+def test_isc_shapes_and_inputs():
+    n_subjects, n_TRs, n_voxels = 8, 60, 5
+    data = simulated_timeseries(n_subjects, n_TRs, n_voxels, random_state=0)
+    iscs_loo = isc(data, pairwise=False)
+    assert iscs_loo.shape == (n_subjects, n_voxels)
+    iscs_pw = isc(data, pairwise=True)
+    assert iscs_pw.shape == (n_subjects * (n_subjects - 1) // 2, n_voxels)
+    assert isc(data, summary_statistic='mean').shape == (n_voxels,)
+    assert isc(data, summary_statistic='median').shape == (n_voxels,)
+    # list input == array input
+    data_list = [data[:, :, s] for s in range(n_subjects)]
+    assert np.allclose(isc(data_list), iscs_loo)
+    # two subjects: plain correlation, 1-D output
+    iscs2 = isc(data[..., :2])
+    assert iscs2.shape == (n_voxels,)
+    with pytest.raises(ValueError):
+        isc(data, summary_statistic='std')
+
+
+def test_isc_detects_correlation():
+    data = correlated_timeseries(10, 120, noise=0.1, random_state=42)
+    for pairwise in (False, True):
+        iscs = isc(data, pairwise=pairwise)
+        assert np.all(iscs[:, :2] > 0.8)
+        assert np.all(np.abs(iscs[:, 2]) < 0.5)
+
+
+def test_isc_matches_numpy_oracle():
+    data = simulated_timeseries(5, 40, 3, random_state=1)
+    iscs = isc(data, pairwise=False)
+    # oracle: plain numpy loop
+    for s in range(5):
+        others = np.mean(np.delete(data, s, axis=2), axis=2)
+        for v in range(3):
+            r = np.corrcoef(data[:, v, s], others[:, v])[0, 1]
+            assert np.isclose(iscs[s, v], r, atol=1e-10)
+    iscs_pw = isc(data, pairwise=True)
+    k = 0
+    for i in range(5):
+        for j in range(i + 1, 5):
+            for v in range(3):
+                r = np.corrcoef(data[:, v, i], data[:, v, j])[0, 1]
+                assert np.isclose(iscs_pw[k, v], r, atol=1e-10)
+            k += 1
+
+
+def test_isc_nans():
+    n_subjects, n_TRs, n_voxels = 6, 30, 4
+    data = simulated_timeseries(n_subjects, n_TRs, n_voxels, random_state=2)
+    data[0, 0, 0] = np.nan
+    # tolerant: only the NaN subject's own voxel ISC is NaN
+    iscs_t = isc(data, pairwise=False, tolerate_nans=True)
+    assert np.sum(np.isnan(iscs_t)) == 1
+    # intolerant: every subject's ISC at that voxel is NaN
+    iscs_f = isc(data, pairwise=False, tolerate_nans=False)
+    assert np.sum(np.isnan(iscs_f)) == n_subjects
+    # threshold float below requirement excludes voxel entirely
+    iscs_80 = isc(data, pairwise=False, tolerate_nans=0.9)
+    assert np.all(np.isnan(iscs_80[:, 0]))
+    with pytest.raises(ValueError):
+        isc(data, tolerate_nans=1.5)
+
+
+def test_isfc_shapes_and_symmetry():
+    n_subjects, n_TRs, n_voxels = 6, 50, 4
+    data = simulated_timeseries(n_subjects, n_TRs, n_voxels, random_state=3)
+    isfcs, iscs = isfc(data, pairwise=False)
+    n_pairs_vox = n_voxels * (n_voxels - 1) // 2
+    assert isfcs.shape == (n_subjects, n_pairs_vox)
+    assert iscs.shape == (n_subjects, n_voxels)
+    # consistency with isc()
+    assert np.allclose(iscs, isc(data, pairwise=False), atol=1e-10)
+    # square form
+    sq = isfc(data, pairwise=False, vectorize_isfcs=False)
+    assert sq.shape == (n_subjects, n_voxels, n_voxels)
+    assert np.allclose(sq, np.swapaxes(sq, 1, 2))
+    # squareform round-trip
+    isfcs2, iscs2 = squareform_isfc(sq)
+    assert np.allclose(isfcs2, isfcs) and np.allclose(iscs2, iscs)
+    back = squareform_isfc(isfcs2, iscs2)
+    assert np.allclose(back, sq)
+    # pairwise shape
+    isfcs_pw, iscs_pw = isfc(data, pairwise=True)
+    assert isfcs_pw.shape == (n_subjects * (n_subjects - 1) // 2,
+                              n_pairs_vox)
+
+
+def test_isfc_targets_asymmetric():
+    data = simulated_timeseries(5, 40, 4, random_state=4)
+    targets = simulated_timeseries(5, 40, 7, random_state=5)
+    out = isfc(data, targets=targets)
+    assert out.shape == (5, 4, 7)
+    # summary statistic collapses subjects
+    out_m = isfc(data, targets=targets, summary_statistic='mean')
+    assert out_m.shape == (4, 7)
+    with pytest.raises(ValueError):
+        isfc(data, targets=targets[:-1])
+
+
+def test_compute_summary_statistic():
+    iscs = np.array([[0.2, 0.4], [0.6, 0.8]])
+    m = compute_summary_statistic(iscs, 'mean', axis=0)
+    assert np.allclose(m, np.tanh(np.mean(np.arctanh(iscs), axis=0)))
+    med = compute_summary_statistic(iscs, 'median', axis=0)
+    assert np.allclose(med, [0.4, 0.6])
+    with pytest.raises(ValueError):
+        compute_summary_statistic(iscs, 'mode')
+
+
+def test_bootstrap_isc():
+    n_bootstraps = 100
+    data = correlated_timeseries(15, 80, noise=0.5, random_state=42)
+    for pairwise in (False, True):
+        iscs = isc(data, pairwise=pairwise)
+        observed, ci, p, distribution = bootstrap_isc(
+            iscs, pairwise=pairwise, summary_statistic='median',
+            n_bootstraps=n_bootstraps, random_state=0)
+        assert distribution.shape == (n_bootstraps, 3)
+        assert len(ci) == 2
+        # correlated voxels significant; noise voxel not
+        assert p[0] < 0.05 and p[1] < 0.05
+        assert p[2] > 0.01
+    # reproducible with same seed
+    iscs = isc(data, pairwise=False)
+    _, _, _, d1 = bootstrap_isc(iscs, n_bootstraps=50, random_state=7)
+    _, _, _, d2 = bootstrap_isc(iscs, n_bootstraps=50, random_state=7)
+    _, _, _, d3 = bootstrap_isc(iscs, n_bootstraps=50, random_state=8)
+    assert np.array_equal(d1, d2)
+    assert not np.array_equal(d1, d3)
+    with pytest.raises(ValueError):
+        bootstrap_isc(iscs, summary_statistic='mode')
+
+
+def test_permutation_isc_one_sample():
+    data = correlated_timeseries(12, 80, noise=0.5, random_state=42)
+    for pairwise in (False, True):
+        iscs = isc(data, pairwise=pairwise)
+        observed, p, distribution = permutation_isc(
+            iscs, pairwise=pairwise, summary_statistic='median',
+            n_permutations=200, random_state=0)
+        assert distribution.shape == (200, 3)
+        assert p[0] < 0.05 and p[1] < 0.05
+        assert p[2] > 0.01
+
+
+def test_permutation_isc_one_sample_exact():
+    data = correlated_timeseries(5, 60, noise=0.5, random_state=1)
+    iscs = isc(data, pairwise=False)
+    observed, p, distribution = permutation_isc(
+        iscs, pairwise=False, n_permutations=100)  # 2**5=32 <= 100 -> exact
+    assert distribution.shape == (32, 3)
+
+
+def test_permutation_isc_two_sample():
+    # group 1 strongly correlated, group 2 noisy
+    g1 = simulated_timeseries(8, 60, 4, noise=0.5, random_state=3)
+    g2 = simulated_timeseries(8, 60, 4, noise=20.0, random_state=4)
+    iscs = np.vstack([isc(g1, pairwise=False), isc(g2, pairwise=False)])
+    group_assignment = [1] * 8 + [2] * 8
+    observed, p, distribution = permutation_isc(
+        iscs, group_assignment=group_assignment, pairwise=False,
+        summary_statistic='mean', n_permutations=200, random_state=0)
+    assert distribution.shape == (200, 4)
+    # group difference should be significant
+    assert np.all(np.asarray(p) < 0.05)
+    # pairwise two-sample on combined data
+    data = np.dstack([g1, g2])
+    iscs_pw = isc(data, pairwise=True)
+    observed2, p2, dist2 = permutation_isc(
+        iscs_pw, group_assignment=group_assignment, pairwise=True,
+        summary_statistic='mean', n_permutations=200, random_state=0)
+    assert dist2.shape == (200, 4)
+    assert np.all(np.asarray(p2) < 0.1)
+
+
+def test_permutation_isc_two_sample_exact():
+    g1 = simulated_timeseries(3, 40, 3, noise=0.5, random_state=3)
+    g2 = simulated_timeseries(3, 40, 3, noise=10.0, random_state=4)
+    iscs = np.vstack([isc(g1, pairwise=False), isc(g2, pairwise=False)])
+    observed, p, distribution = permutation_isc(
+        iscs, group_assignment=[1, 1, 1, 2, 2, 2], pairwise=False,
+        summary_statistic='mean', n_permutations=1000)  # 6! = 720 -> exact
+    assert distribution.shape == (720, 3)
+    with pytest.raises(ValueError):
+        permutation_isc(iscs, group_assignment=[1, 1, 2, 2, 3, 3])
+    with pytest.raises(ValueError):
+        permutation_isc(iscs, group_assignment=[1, 1, 2])
+
+
+def test_timeshift_isc():
+    data = correlated_timeseries(10, 80, noise=0.5, random_state=42)
+    observed, p, distribution = timeshift_isc(
+        data, pairwise=False, n_shifts=100, random_state=0)
+    assert distribution.shape == (100, 3)
+    assert p[0] < 0.05 and p[1] < 0.05 and p[2] > 0.01
+    observed, p, distribution = timeshift_isc(
+        data, pairwise=True, n_shifts=50, random_state=0)
+    assert distribution.shape == (50, 3)
+
+
+def test_phaseshift_isc():
+    data = correlated_timeseries(10, 80, noise=0.5, random_state=42)
+    observed, p, distribution = phaseshift_isc(
+        data, pairwise=False, n_shifts=100, random_state=0)
+    assert distribution.shape == (100, 3)
+    assert p[0] < 0.05 and p[1] < 0.05 and p[2] > 0.01
+    observed, p, distribution = phaseshift_isc(
+        data, pairwise=True, n_shifts=50, random_state=0)
+    assert distribution.shape == (50, 3)
+
+
+def test_resampling_preserves_nan_voxel_columns():
+    """Voxels excluded by the NaN threshold must come back as NaN columns,
+    keeping outputs positionally aligned with the input voxel axis."""
+    rng = np.random.RandomState(0)
+    data = rng.randn(30, 4, 6)
+    data[:, 1, :] = np.nan
+    for fn in (timeshift_isc, phaseshift_isc):
+        obs, p, dist = fn(data, n_shifts=10, random_state=0)
+        assert obs.shape == (4,)
+        assert dist.shape == (10, 4)
+        assert np.isnan(obs[1]) and np.all(np.isnan(dist[:, 1]))
+        assert np.all(np.isfinite(dist[:, [0, 2, 3]]))
