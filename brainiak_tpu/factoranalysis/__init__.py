@@ -1,0 +1,6 @@
+"""Topographic factor analysis (TFA/HTFA), TPU-native.
+
+The reference's C++ RBF kernels + scipy bounded least squares + MPI
+hierarchical gather (/root/reference/src/brainiak/factoranalysis/) become
+fused XLA ops + a jitted L-BFGS with box reparameterization + host-side
+hierarchical updates over stacked posteriors."""

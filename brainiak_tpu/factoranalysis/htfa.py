@@ -1,0 +1,274 @@
+"""Hierarchical Topographical Factor Analysis (HTFA), TPU-native.
+
+Re-design of /root/reference/src/brainiak/factoranalysis/htfa.py.  A global
+template over factor centers/widths (mean + covariance/variance) is
+MAP-updated from per-subject TFA posteriors.  The reference distributes
+subjects over MPI ranks with Bcast/Gatherv stitching
+(htfa.py:515-558, :672-764); in the single-controller design the per-subject
+fits run locally (each one a jitted L-BFGS program) and the gather is a
+plain array concatenation — on a pod slice the subject loop becomes a
+sharded vmap with the same math.
+
+Deviation noted: the reference's ``_assign_posterior`` (htfa.py:560-590)
+reorders only the covariance/variance fields by the Hungarian assignment
+while leaving centers/widths unpermuted — inconsistent with TFA's version;
+here all four fields are reordered consistently.
+"""
+
+import logging
+
+import numpy as np
+from scipy.optimize import linear_sum_assignment
+from scipy.spatial import distance
+
+from ..utils.utils import from_sym_2_tri, from_tri_2_sym
+from .tfa import TFA
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["HTFA"]
+
+
+class HTFA(TFA):
+    """Hierarchical TFA over multiple subjects (reference htfa.py:62-841).
+
+    Parameters follow the reference: K, n_subj, max_global_iter /
+    max_local_iter, threshold, weight_method, bounds ratios, subsampling
+    ratios/caps (voxel_ratio, tr_ratio, max_voxel, max_tr).
+
+    Attributes after fit: ``global_prior_``, ``global_posterior_``,
+    ``local_posterior_`` (concatenated per-subject centers+widths),
+    ``local_weights_`` (concatenated per-subject weight matrices).
+    """
+
+    def __init__(self, K, n_subj, max_global_iter=10, max_local_iter=10,
+                 threshold=0.01, nlss_method='trf', nlss_loss='soft_l1',
+                 jac='2-point', x_scale='jac', tr_solver=None,
+                 weight_method='rr', upper_ratio=1.8, lower_ratio=0.02,
+                 voxel_ratio=0.25, tr_ratio=0.1, max_voxel=5000,
+                 max_tr=500, verbose=False, lbfgs_iters=60):
+        self.K = K
+        self.n_subj = n_subj
+        self.max_global_iter = max_global_iter
+        self.max_local_iter = max_local_iter
+        self.threshold = threshold
+        self.nlss_method = nlss_method
+        self.nlss_loss = nlss_loss
+        self.jac = jac
+        self.x_scale = x_scale
+        self.tr_solver = tr_solver
+        self.weight_method = weight_method
+        self.upper_ratio = upper_ratio
+        self.lower_ratio = lower_ratio
+        self.voxel_ratio = voxel_ratio
+        self.tr_ratio = tr_ratio
+        self.max_voxel = max_voxel
+        self.max_tr = max_tr
+        self.verbose = verbose
+        self.lbfgs_iters = lbfgs_iters
+
+    # -- convergence over the global template -----------------------------
+    def _converged(self):
+        prior = self.global_prior_[0:self.prior_size]
+        posterior = self.global_posterior_[0:self.prior_size]
+        max_diff = np.max(np.fabs(prior - posterior))
+        return max_diff <= self.threshold, max_diff
+
+    def _mse_converged(self):
+        prior = self.global_prior_[0:self.prior_size]
+        posterior = self.global_posterior_[0:self.prior_size]
+        mse = np.mean((prior - posterior) ** 2)
+        return mse <= self.threshold, mse
+
+    # -- MAP update -------------------------------------------------------
+    def _map_update(self, prior_mean, prior_cov, global_cov_scaled,
+                    new_observation):
+        """Gaussian MAP update of one factor's center parameters
+        (reference htfa.py:246-288)."""
+        common = np.linalg.inv(prior_cov + global_cov_scaled)
+        observation_mean = np.mean(new_observation, axis=1)
+        posterior_mean = prior_cov.dot(common.dot(observation_mean)) + \
+            global_cov_scaled.dot(common.dot(prior_mean))
+        posterior_cov = prior_cov.dot(common.dot(global_cov_scaled))
+        return posterior_mean, posterior_cov
+
+    def _map_update_posterior(self):
+        """MAP-update the global template from gathered subject posteriors
+        (reference htfa.py:290-341)."""
+        self.global_posterior_ = self.global_prior_.copy()
+        prior_centers = self.get_centers(self.global_prior_)
+        prior_widths = self.get_widths(self.global_prior_)
+        prior_centers_mean_cov = \
+            self.get_centers_mean_cov(self.global_prior_)
+        prior_widths_mean_var = \
+            self.get_widths_mean_var(self.global_prior_)
+        center_size = self.K * self.n_dim
+        posterior_size = center_size + self.K
+        gathered = self.gather_posterior.reshape(self.n_subj,
+                                                 posterior_size)
+        all_centers = gathered[:, :center_size].reshape(
+            self.n_subj, self.K, self.n_dim)
+        all_widths = gathered[:, center_size:]
+        for k in np.arange(self.K):
+            next_centers = all_centers[:, k, :].T  # [n_dim, n_subj]
+            next_widths = all_widths[:, k]
+
+            posterior_mean, posterior_cov = self._map_update(
+                prior_centers[k].T.copy(),
+                from_tri_2_sym(prior_centers_mean_cov[k], self.n_dim),
+                self.global_centers_cov_scaled,
+                next_centers)
+            self.global_posterior_[k * self.n_dim:(k + 1) * self.n_dim] = \
+                posterior_mean.T
+            start_idx = self.map_offset[2] + k * self.cov_vec_size
+            end_idx = self.map_offset[2] + (k + 1) * self.cov_vec_size
+            self.global_posterior_[start_idx:end_idx] = \
+                from_sym_2_tri(posterior_cov)
+
+            pw_var = float(prior_widths_mean_var[k, 0])
+            pw = float(prior_widths[k, 0])
+            common = 1.0 / (pw_var + self.global_widths_var_scaled)
+            observation_mean = np.mean(next_widths)
+            tmp = common * self.global_widths_var_scaled
+            self.global_posterior_[self.map_offset[1] + k] = \
+                pw_var * common * observation_mean + tmp * pw
+            self.global_posterior_[self.map_offset[3] + k] = pw_var * tmp
+        return self
+
+    def _assign_posterior(self):
+        """Hungarian matching of global posterior factors to the prior,
+        reordering all four fields consistently (see module docstring)."""
+        prior_centers = self.get_centers(self.global_prior_)
+        posterior_centers = self.get_centers(self.global_posterior_)
+        posterior_widths = self.get_widths(self.global_posterior_)
+        posterior_centers_mean_cov = \
+            self.get_centers_mean_cov(self.global_posterior_)
+        posterior_widths_mean_var = \
+            self.get_widths_mean_var(self.global_posterior_)
+        cost = distance.cdist(prior_centers, posterior_centers,
+                              'euclidean')
+        _, col_ind = linear_sum_assignment(cost)
+        self.set_centers(self.global_posterior_,
+                         posterior_centers[col_ind])
+        self.set_widths(self.global_posterior_, posterior_widths[col_ind])
+        self.set_centers_mean_cov(self.global_posterior_,
+                                  posterior_centers_mean_cov[col_ind])
+        self.set_widths_mean_var(self.global_posterior_,
+                                 posterior_widths_mean_var[col_ind])
+        return self
+
+    # -- fitting ----------------------------------------------------------
+    def _fit_htfa(self, data, R):
+        """Outer template loop over per-subject TFA fits
+        (reference htfa.py:672-764)."""
+        n_subj = len(R)
+        tfa = []
+        for s in range(n_subj):
+            nvoxel, ntr = data[s].shape
+            sub = TFA(max_iter=self.max_local_iter,
+                      threshold=self.threshold,
+                      K=self.K, nlss_method=self.nlss_method,
+                      nlss_loss=self.nlss_loss,
+                      weight_method=self.weight_method,
+                      upper_ratio=self.upper_ratio,
+                      lower_ratio=self.lower_ratio,
+                      max_num_voxel=min(self.max_voxel,
+                                        int(self.voxel_ratio * nvoxel)),
+                      max_num_tr=min(self.max_tr,
+                                     int(self.tr_ratio * ntr)),
+                      verbose=self.verbose,
+                      lbfgs_iters=self.lbfgs_iters)
+            tfa.append(sub)
+
+        self.local_posterior_ = np.zeros(n_subj * self.prior_size)
+        # Template initialized from a random subject's coordinates
+        # (reference htfa.py:475-513).
+        idx = np.random.choice(n_subj, 1)[0]
+        self.global_prior_, self.global_centers_cov, \
+            self.global_widths_var = self.get_template(R[idx])
+        self.global_centers_cov_scaled = \
+            self.global_centers_cov / float(self.n_subj)
+        self.global_widths_var_scaled = \
+            self.global_widths_var / float(self.n_subj)
+
+        m = 0
+        outer_converged = False
+        while m < self.max_global_iter and not outer_converged:
+            if self.verbose:
+                logger.info("HTFA global iter %d", m)
+            for s in range(n_subj):
+                tfa[s].set_seed(m * self.max_local_iter)
+                tfa[s].fit(data[s], R[s],
+                           template_prior=self.global_prior_.copy())
+                start = s * self.prior_size
+                self.local_posterior_[start:start + self.prior_size] = \
+                    tfa[s].local_posterior_
+            self.gather_posterior = self.local_posterior_.copy()
+            self._map_update_posterior()
+            self._assign_posterior()
+            outer_converged, max_diff = self._converged()
+            if outer_converged:
+                logger.info("converged at %d outer iter", m)
+            else:
+                self.global_prior_ = self.global_posterior_
+            m += 1
+
+        self._update_weight(data, R)
+        return self
+
+    def _update_weight(self, data, R):
+        """Final per-subject factor + weight solves
+        (reference htfa.py:626-670)."""
+        import jax.numpy as jnp
+
+        from ..ops.rbf import rbf_factors
+
+        weights = []
+        for s, subj_data in enumerate(data):
+            base = s * self.prior_size
+            centers = self.local_posterior_[
+                base:base + self.K * self.n_dim].reshape(self.K,
+                                                         self.n_dim)
+            widths = self.local_posterior_[
+                base + self.K * self.n_dim:base + self.prior_size] \
+                .reshape(self.K, 1)
+            F = np.asarray(rbf_factors(jnp.asarray(R[s]),
+                                       jnp.asarray(centers),
+                                       jnp.asarray(widths)))
+            weights.append(self.get_weights(subj_data, F).ravel())
+        self.local_weights_ = np.concatenate(weights)
+        return self
+
+    def _check_input(self, X, R):
+        if not isinstance(X, list):
+            raise TypeError("Input data should be a list")
+        if not isinstance(R, list):
+            raise TypeError("Coordinates should be a list")
+        if len(X) != len(R):
+            raise TypeError("Data and coordinates lists must have equal "
+                            "length")
+        for x, r in zip(X, R):
+            if not isinstance(x, np.ndarray) or x.ndim != 2:
+                raise TypeError("Each subject data should be a 2D array")
+            if not isinstance(r, np.ndarray) or r.ndim != 2:
+                raise TypeError("Each coordinate matrix should be a 2D "
+                                "array")
+            if x.shape[0] != r.shape[0]:
+                raise TypeError("The numbers of voxels in data and "
+                                "coordinates differ")
+
+    def fit(self, X, R):
+        """Fit HTFA (reference htfa.py:766-841).
+
+        X : list of [n_voxel, n_tr] per-subject data
+        R : list of [n_voxel, n_dim] per-subject coordinates
+        """
+        self._check_input(X, R)
+        if self.verbose:
+            logger.info("Start to fit HTFA")
+        self.n_dim = R[0].shape[1]
+        self.cov_vec_size = np.sum(np.arange(self.n_dim) + 1)
+        self.map_offset = self.get_map_offset()
+        self.prior_size = self.K * (self.n_dim + 1)
+        self._fit_htfa(X, R)
+        return self

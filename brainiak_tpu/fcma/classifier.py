@@ -1,0 +1,222 @@
+"""Correlation-based classification (FCMA stage 2), TPU-native.
+
+Re-design of /root/reference/src/brainiak/fcma/classifier.py.  The feature
+space is the flattened region1×region2 correlation pattern of each epoch;
+the memory-bounded trick of accumulating the SVM Gram matrix voxel-portion
+by voxel-portion without ever materializing the full correlation
+(classifier.py:279-348) is kept, with each portion's
+correlate→normalize→Gram-accumulate step as one jitted XLA program.
+The final classifier fit runs on host sklearn — the Gram is only
+[samples × samples].
+"""
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import sklearn
+import sklearn.svm
+from sklearn.base import BaseEstimator
+
+from ..ops.correlation import PRECISION
+from ..ops.fisherz import within_subject_normalization
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Classifier"]
+
+
+@partial(jax.jit, static_argnames=("length", "norm_unit"))
+def _chunk_features(x1, x2, start, length, norm_unit):
+    """Correlation features for a voxel chunk of region 1 vs all of region 2.
+
+    x1: [N, T, V1], x2: [N, T, V2] (already epoch-normalized)
+    Returns [N, length, V2], within-subject normalized when norm_unit > 1.
+    """
+    blk = jax.lax.dynamic_slice_in_dim(x1, start, length, axis=2)
+    corr = jnp.einsum('ntb,ntv->nbv', blk, x2, precision=PRECISION,
+                      preferred_element_type=jnp.float32)
+    if norm_unit > 1:
+        n, b, v = corr.shape
+        corr = within_subject_normalization(
+            corr.reshape(1, n, b * v), norm_unit).reshape(n, b, v)
+    return corr
+
+
+@partial(jax.jit, static_argnames=("length", "norm_unit"))
+def _chunk_gram_update(x1, x2, start, kernel, length, norm_unit):
+    """Accumulate one voxel portion's contribution to the sample Gram."""
+    corr = _chunk_features(x1, x2, start, length, norm_unit)
+    feats = corr.reshape(corr.shape[0], -1)
+    return kernel + jnp.matmul(feats, feats.T, precision=PRECISION), corr
+
+
+class Classifier(BaseEstimator):
+    """FCMA classifier over correlation features (reference
+    classifier.py:37-690).
+
+    Parameters
+    ----------
+    clf : an sklearn classifier; ``SVC(kernel='precomputed')`` activates the
+        memory-bounded Gram path.
+    num_processed_voxels : int, voxel-portion size for the Gram accumulation.
+    epochs_per_subj : int, 0 disables within-subject normalization.
+    """
+
+    def __init__(self, clf, num_processed_voxels=2000, epochs_per_subj=0):
+        self.clf = clf
+        self.num_processed_voxels = num_processed_voxels
+        self.epochs_per_subj = epochs_per_subj
+        self.num_digits_ = 0
+
+    # -- helpers ----------------------------------------------------------
+    def _is_precomputed_svm(self):
+        return isinstance(self.clf, sklearn.svm.SVC) and \
+            self.clf.kernel == 'precomputed'
+
+    @staticmethod
+    def _stack_pairs(X):
+        for x in X:
+            assert len(x) == 2, \
+                'there must be two parts for each correlation computation'
+        X1, X2 = zip(*X)
+        num_voxels1 = X1[0].shape[1]
+        num_voxels2 = X2[0].shape[1]
+        if num_voxels1 < num_voxels2:
+            X1, X2 = X2, X1
+            num_voxels1, num_voxels2 = num_voxels2, num_voxels1
+        x1 = jnp.asarray(np.stack(X1), dtype=jnp.float32)
+        x2 = jnp.asarray(np.stack(X2), dtype=jnp.float32)
+        return x1, x2, num_voxels1, num_voxels2
+
+    def _full_features(self, x1, x2, norm_unit):
+        """Correlation features [N, V1*V2] computed in one portion."""
+        corr = _chunk_features(x1, x2, 0, x1.shape[2], norm_unit)
+        return np.asarray(corr).reshape(corr.shape[0], -1)
+
+    def _portioned_gram(self, x1, x2, norm_unit):
+        """Gram matrix accumulated portion by portion
+        (reference classifier.py:279-348)."""
+        n = x1.shape[0]
+        v1 = x1.shape[2]
+        kernel = jnp.zeros((n, n), dtype=jnp.float32)
+        last_corr = None
+        portion = min(self.num_processed_voxels, v1)
+        sr = 0
+        while sr < v1:
+            length = min(portion, v1 - sr)
+            kernel, last_corr = _chunk_gram_update(
+                x1, x2, sr, kernel, length, norm_unit)
+            sr += length
+        kernel = np.array(kernel)  # writable host copy
+        num_digits = len(str(int(kernel[0, 0])))
+        self.num_digits_ = num_digits
+        if num_digits > 2:
+            kernel *= 10 ** (2 - num_digits)
+        # last_corr stays on device; only the single-portion fit path (which
+        # stores training_data_) pays the host transfer.
+        return kernel, last_corr
+
+    # -- sklearn API ------------------------------------------------------
+    def fit(self, X, y, num_training_samples=None):
+        """Train on correlation features of (region1, region2) pairs
+        (reference classifier.py:426-505)."""
+        assert len(X) == len(y), \
+            'the number of samples must be equal to the number of labels'
+        x1, x2, num_voxels1, num_voxels2 = self._stack_pairs(X)
+        if not self._is_precomputed_svm() and \
+                num_training_samples is not None:
+            num_training_samples = None
+            logger.warning(
+                'num_training_samples should not be set for classifiers '
+                'other than SVM with precomputed kernels')
+        self.num_voxels_ = num_voxels1
+        self.num_features_ = num_voxels1 * num_voxels2
+        self.num_samples_ = len(X)
+        norm_unit = self.epochs_per_subj
+
+        if not self._is_precomputed_svm():
+            data = self._full_features(x1, x2, norm_unit)
+            self.training_data_ = None
+        else:
+            if self.num_processed_voxels < self.num_voxels_:
+                if num_training_samples is None:
+                    raise RuntimeError(
+                        'the kernel matrix will be computed portion by '
+                        'portion, the test samples must be predefined by '
+                        'specifying num_training_samples')
+                if num_training_samples >= self.num_samples_:
+                    raise ValueError('the number of training samples '
+                                     'must be smaller than '
+                                     'the number of total samples')
+                data, _ = self._portioned_gram(x1, x2, norm_unit)
+                self.training_data_ = None
+            else:
+                data, corr = self._portioned_gram(x1, x2, norm_unit)
+                self.training_data_ = np.asarray(corr).reshape(
+                    self.num_samples_, self.num_features_)
+
+        if num_training_samples is not None:
+            self.test_raw_data_ = None
+            self.test_data_ = data[num_training_samples:,
+                                   0:num_training_samples]
+            data = data[0:num_training_samples, 0:num_training_samples]
+        else:
+            self.test_raw_data_ = None
+            self.test_data_ = None
+        self.clf = self.clf.fit(data, y[0:num_training_samples])
+        return self
+
+    def _prepare_test_data(self, X):
+        x1, x2, num_voxels1, num_voxels2 = self._stack_pairs(X)
+        assert self.num_features_ == num_voxels1 * num_voxels2, \
+            'the number of features does not match the model'
+        num_test_samples = len(X)
+        self.test_raw_data_ = X
+        feats = self._full_features(x1, x2, num_test_samples)
+        if self._is_precomputed_svm():
+            assert self.training_data_ is not None, \
+                'when using precomputed kernel of SVM, ' \
+                'all training data must be provided'
+            data = feats @ self.training_data_.T
+            if self.num_digits_ > 2:
+                data *= 10 ** (2 - self.num_digits_)
+        else:
+            data = feats
+        self.test_data_ = data
+
+    def predict(self, X=None):
+        """Predict labels; X=None reuses test data prepared during fit
+        (reference classifier.py:507-570)."""
+        if X is not None:
+            self._prepare_test_data(X)
+        return self.clf.predict(self.test_data_)
+
+    def _is_equal_to_test_raw_data(self, X):
+        if self.test_raw_data_ is None or \
+                len(X) != len(self.test_raw_data_):
+            return False
+        for new, old in zip(X, self.test_raw_data_):
+            if not np.array_equal(new[0], old[0]) or \
+                    not np.array_equal(new[1], old[1]):
+                return False
+        return True
+
+    def decision_function(self, X=None):
+        """Decision values (reference classifier.py:597-650)."""
+        if X is not None and not self._is_equal_to_test_raw_data(X):
+            self._prepare_test_data(X)
+        return self.clf.decision_function(self.test_data_)
+
+    def score(self, X, y, sample_weight=None):
+        """Mean accuracy; X is ignored when the Gram was portioned and test
+        similarity vectors were precomputed in fit
+        (reference classifier.py:652-690)."""
+        from sklearn.metrics import accuracy_score
+        if self._is_precomputed_svm() and self.training_data_ is None:
+            return accuracy_score(y, self.predict(),
+                                  sample_weight=sample_weight)
+        return accuracy_score(y, self.predict(X),
+                              sample_weight=sample_weight)

@@ -1,0 +1,63 @@
+"""Activity-based (MVPA) voxel selection via searchlight.
+
+Re-design of /root/reference/src/brainiak/fcma/mvpa_voxelselector.py with
+the same API, minus the MPI rank checks."""
+
+import logging
+
+import numpy as np
+from sklearn import model_selection
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["MVPAVoxelSelector"]
+
+
+def _sfn(data, mask, myrad, bcast_var):
+    """Searchlight voxel function: CV accuracy of the masked activity
+    vectors (reference mvpa_voxelselector.py:34-49)."""
+    labels, num_folds, clf = bcast_var[0], bcast_var[1], bcast_var[2]
+    masked_data = data[0][mask, :].T
+    skf = model_selection.StratifiedKFold(n_splits=num_folds,
+                                          shuffle=False)
+    return np.mean(model_selection.cross_val_score(
+        clf, masked_data, y=labels, cv=skf, n_jobs=1))
+
+
+class MVPAVoxelSelector:
+    """Searchlight CV-accuracy voxel ranking (reference
+    mvpa_voxelselector.py:52-136).
+
+    Parameters
+    ----------
+    data : 4D array [x, y, z, epoch] (from prepare_searchlight_mvpa_data)
+    mask : 3D boolean array
+    labels : per-epoch condition labels
+    num_folds : CV folds
+    sl : a brainiak_tpu.searchlight.Searchlight instance
+    """
+
+    def __init__(self, data, mask, labels, num_folds, sl):
+        self.data = data
+        self.mask = mask.astype(bool)
+        self.labels = labels
+        self.num_folds = num_folds
+        self.sl = sl
+        if np.sum(self.mask) == 0:
+            raise ValueError('Zero processed voxels')
+
+    def run(self, clf):
+        """Returns (result_volume, [(voxel_id, accuracy)] sorted desc)."""
+        logger.info('running activity-based voxel selection via '
+                    'Searchlight')
+        self.sl.distribute([self.data], self.mask)
+        self.sl.broadcast((self.labels, self.num_folds, clf))
+        result_volume = self.sl.run_searchlight(_sfn)
+        result_list = result_volume[self.mask]
+        results = []
+        for idx, value in enumerate(result_list):
+            if value is None:
+                value = 0
+            results.append((idx, value))
+        results.sort(key=lambda tup: tup[1], reverse=True)
+        return result_volume, results

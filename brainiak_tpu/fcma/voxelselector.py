@@ -1,0 +1,195 @@
+"""Correlation-based voxel selection (FCMA stage 1), TPU-native.
+
+Re-design of /root/reference/src/brainiak/fcma/voxelselector.py.  The
+reference runs an MPI master-worker task farm handing 64-voxel blocks to
+workers, each doing Cython sgemm + C++/OpenMP normalization + a
+multiprocessing pool of sklearn SVC fits (voxelselector.py:176-282,
+:284-516).  Here the whole per-block pipeline —
+
+    per-epoch correlation (MXU einsum)
+    -> Fisher-z within-subject normalization (fused elementwise)
+    -> per-voxel linear-kernel Gram + magnitude shrink (batched matmul)
+    -> batched dual-SVM k-fold cross validation (vmap)
+
+— is ONE jitted XLA program; voxel blocks are a host loop (or sharded over
+a mesh's ``voxel`` axis), and the dynamic master-worker load balancing
+disappears because TPU chips are homogeneous.
+"""
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.correlation import PRECISION
+from ..ops.fisherz import within_subject_normalization
+from ..ops.svm import svm_cv_accuracy
+from ..parallel.mesh import DEFAULT_VOXEL_AXIS
+from jax.sharding import NamedSharding, PartitionSpec
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["VoxelSelector"]
+
+
+@partial(jax.jit, static_argnames=("epochs_per_subj",))
+def _block_kernel_matrices(blk, data2, epochs_per_subj):
+    """Correlate a voxel block against all voxels and build per-voxel SVM
+    Gram matrices.
+
+    blk : [E, T, block] the voxel block (sharded over a mesh's voxel axis
+        when one is in use); data2 : [E, T, V] normalized epoch data.
+    Returns (kernels [block, E, E], corr [block, E, V2]), both sharded
+    over the leading (block) axis when ``blk`` is.
+    """
+    corr = jnp.einsum('etb,etv->bev', blk, data2,
+                      precision=PRECISION,
+                      preferred_element_type=jnp.float32)
+    corr = within_subject_normalization(corr, epochs_per_subj)
+    kernels = jnp.einsum('bev,bfv->bef', corr, corr, precision=PRECISION,
+                         preferred_element_type=jnp.float32)
+    # Magnitude shrink: scale so K[0,0] has at most 2 integer digits
+    # (reference cython_blas.pyx compute_kernel_matrix + digit shrink,
+    # voxelselector.py:407-412) for stable SVM duals.
+    k00 = jnp.clip(kernels[:, 0, 0], 1.0, None)
+    ndigits = jnp.floor(jnp.log10(k00)) + 1
+    proportion = jnp.where(ndigits > 2, 10.0 ** (2 - ndigits), 1.0)
+    kernels = kernels * proportion[:, None, None]
+    return kernels, corr
+
+
+class VoxelSelector:
+    """FCMA voxel selection by per-voxel correlation-pattern classification.
+
+    Parameters (reference voxelselector.py:56-139)
+    ----------
+    labels : list/array of per-epoch condition labels
+    epochs_per_subj : int (epochs of one subject are adjacent)
+    num_folds : int, k for stratified CV
+    raw_data : list of [epoch_len, n_voxels] normalized epoch arrays
+        (from :func:`brainiak_tpu.fcma.preprocessing.prepare_fcma_data`)
+    raw_data2 : optional second-mask epoch list for region×region FCMA
+    voxel_unit : int, voxels per compiled block (default 256)
+    mesh : optional jax.sharding.Mesh — blocks are additionally sharded
+        over its ``voxel`` axis (the analog of adding MPI workers)
+    svm_C, svm_iters : on-device dual-SVM hyperparameters
+    """
+
+    def __init__(self, labels, epochs_per_subj, num_folds, raw_data,
+                 raw_data2=None, voxel_unit=256, mesh=None,
+                 svm_C=1.0, svm_iters=50, process_num=None,
+                 master_rank=0):
+        self.labels = np.asarray(labels)
+        self.epochs_per_subj = epochs_per_subj
+        self.num_folds = num_folds
+        self.raw_data = raw_data
+        self.raw_data2 = raw_data2
+        self.voxel_unit = voxel_unit
+        self.mesh = mesh
+        self.svm_C = svm_C
+        self.svm_iters = svm_iters
+        # process_num / master_rank accepted for API compatibility with the
+        # reference's multiprocessing/MPI knobs; they have no effect here.
+        self.num_voxels = raw_data[0].shape[1]
+        self.num_voxels2 = raw_data2[0].shape[1] if raw_data2 is not None \
+            else self.num_voxels
+        if raw_data2 is not None and len(raw_data) != len(raw_data2):
+            raise ValueError('The raw data lists must have the same number '
+                             'of elements for computing the correlations '
+                             'element by element')
+        if self.num_voxels == 0 or self.num_voxels2 == 0:
+            raise ValueError('Zero processed voxels')
+
+    def _stack(self):
+        data1 = jnp.asarray(np.stack(self.raw_data),
+                            dtype=jnp.float32)  # [E, T, V]
+        if self.raw_data2 is not None:
+            data2 = jnp.asarray(np.stack(self.raw_data2),
+                                dtype=jnp.float32)
+        else:
+            data2 = data1
+        if self.mesh is not None:
+            # data2 (the "all voxels" side) is replicated; each block of
+            # data1 is sharded over the voxel axis below.
+            data1 = jax.device_put(
+                data1, NamedSharding(self.mesh, PartitionSpec()))
+            data2 = jax.device_put(
+                data2, NamedSharding(self.mesh, PartitionSpec()))
+        return data1, data2
+
+    def _slice_block(self, data1, start, block):
+        """Take [E, T, block] starting at ``start`` (wrapping by tiling for
+        a volume smaller than one block) and shard it over the mesh's
+        voxel axis so correlation, Gram, and SVM CV all partition over
+        the block dimension — the analog of handing the block to MPI
+        workers (reference voxelselector.py:176-253)."""
+        if self.num_voxels < block:
+            reps = -(-block // self.num_voxels)
+            blk = jnp.tile(data1, (1, 1, reps))[:, :, :block]
+        else:
+            blk = jax.lax.dynamic_slice_in_dim(data1, start, block, axis=2)
+        if self.mesh is not None:
+            blk = jax.device_put(
+                blk, NamedSharding(self.mesh,
+                                   PartitionSpec(None, None,
+                                                 DEFAULT_VOXEL_AXIS)))
+        return blk
+
+    def run(self, clf='svm'):
+        """Score every voxel; returns [(voxel_id, accuracy)] sorted by
+        accuracy descending (reference voxelselector.py:149-174).
+
+        clf : 'svm' runs the batched on-device kernel-SVM CV; an sklearn
+            estimator runs host cross-validation per voxel (parity path —
+            SVC(kernel='precomputed') gets the Gram matrices, anything else
+            gets raw correlation vectors).
+        """
+        data1, data2 = self._stack()
+        n_shards = 1
+        if self.mesh is not None:
+            n_shards = self.mesh.shape.get(DEFAULT_VOXEL_AXIS, 1)
+        block = self.voxel_unit * n_shards
+
+        results = []
+        for start in range(0, self.num_voxels, block):
+            cur = min(block, self.num_voxels - start)
+            pad_start = min(start, self.num_voxels - block) \
+                if self.num_voxels >= block else 0
+            offset = start - pad_start
+            blk = self._slice_block(data1, pad_start, block)
+            kernels, corr = _block_kernel_matrices(
+                blk, data2, self.epochs_per_subj)
+            kernels = kernels[offset:offset + cur]
+            corr = corr[offset:offset + cur]
+            if isinstance(clf, str) and clf == 'svm':
+                accs = svm_cv_accuracy(kernels, self.labels,
+                                       self.num_folds, C=self.svm_C,
+                                       n_iters=self.svm_iters)
+            else:
+                accs = self._host_cv(clf, np.asarray(kernels),
+                                     np.asarray(corr))
+            results.extend(
+                (start + i, float(accs[i])) for i in range(cur))
+
+        results.sort(key=lambda tup: tup[1], reverse=True)
+        return results
+
+    def _host_cv(self, clf, kernels, corr):
+        """sklearn cross-validation parity path
+        (reference voxelselector.py:41-53, :423-465)."""
+        import sklearn.svm
+        from sklearn import model_selection
+
+        precomputed = isinstance(clf, sklearn.svm.SVC) and \
+            clf.kernel == 'precomputed'
+        data = kernels if precomputed else corr
+        skf = model_selection.StratifiedKFold(n_splits=self.num_folds,
+                                              shuffle=False)
+        accs = np.empty(data.shape[0])
+        for i in range(data.shape[0]):
+            scores = model_selection.cross_val_score(
+                clf, data[i], y=self.labels, cv=skf, n_jobs=1)
+            accs[i] = scores.mean()
+        return accs

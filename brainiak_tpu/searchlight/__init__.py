@@ -1,0 +1,7 @@
+from .searchlight import (  # noqa: F401
+    Ball,
+    Cube,
+    Diamond,
+    Searchlight,
+    Shape,
+)

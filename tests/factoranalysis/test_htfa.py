@@ -1,0 +1,45 @@
+import numpy as np
+import pytest
+
+from brainiak_tpu.factoranalysis.htfa import HTFA
+from tests.factoranalysis.test_tfa import make_rbf_data
+
+
+def make_multi_subject(n_subj=3, seed=0):
+    X, R = [], []
+    centers = None
+    for s in range(n_subj):
+        x, r, centers, widths = make_rbf_data(noise=0.05, seed=seed + s)
+        X.append(x)
+        R.append(r)
+    return X, R, centers, widths
+
+
+def test_htfa_fit_recovers_template():
+    np.random.seed(0)
+    X, R, true_centers, true_widths = make_multi_subject()
+    htfa = HTFA(K=2, n_subj=3, max_global_iter=3, max_local_iter=3,
+                threshold=0.5, voxel_ratio=1.0, tr_ratio=1.0,
+                max_voxel=512, max_tr=60)
+    htfa.fit(X, R)
+    assert htfa.global_posterior_.shape[0] == 2 * (3 + 2 + 6)
+    est_c = htfa.get_centers(htfa.global_posterior_)
+    order = np.argsort(est_c[:, 0])
+    true_order = np.argsort(true_centers[:, 0])
+    assert np.allclose(est_c[order], true_centers[true_order], atol=1.0)
+    # per-subject posteriors and weights populated
+    assert htfa.local_posterior_.shape == (3 * 2 * 4,)
+    n_tr = X[0].shape[1]
+    assert htfa.local_weights_.shape == (3 * 2 * n_tr,)
+    assert np.all(np.isfinite(htfa.local_weights_))
+
+
+def test_htfa_input_validation():
+    X, R, _, _ = make_multi_subject(n_subj=2)
+    htfa = HTFA(K=2, n_subj=2)
+    with pytest.raises(TypeError):
+        htfa.fit(X[0], R)
+    with pytest.raises(TypeError):
+        htfa.fit(X, R[:1])
+    with pytest.raises(TypeError):
+        htfa.fit([X[0], X[1][:-3]], R)

@@ -1,0 +1,96 @@
+import numpy as np
+import pytest
+
+from brainiak_tpu.factoranalysis.tfa import TFA
+
+
+def make_rbf_data(n_grid=8, K=2, n_tr=60, noise=0.05, seed=0):
+    rng = np.random.RandomState(seed)
+    grid = np.array(np.meshgrid(*[np.arange(n_grid)] * 3)) \
+        .reshape(3, -1).T.astype(float)
+    centers = np.array([[2.0, 2.0, 2.0], [6.0, 6.0, 5.0]])[:K]
+    widths = np.array([[3.0], [4.0]])[:K]
+    F = np.exp(-((grid[:, None, :] - centers[None]) ** 2).sum(-1)
+               / widths.T)
+    W = rng.randn(K, n_tr)
+    X = F @ W + noise * rng.randn(grid.shape[0], n_tr)
+    return X, grid, centers, widths
+
+
+def test_tfa_recovers_centers_and_widths():
+    X, R, true_centers, true_widths = make_rbf_data()
+    tfa = TFA(K=2, max_iter=8, threshold=0.1,
+              max_num_voxel=512, max_num_tr=60)
+    tfa.fit(X, R)
+    est_c = tfa.get_centers(tfa.local_posterior_)
+    est_w = tfa.get_widths(tfa.local_posterior_)
+    # match factors to truth by nearest center
+    order = np.argsort(est_c[:, 0])
+    true_order = np.argsort(true_centers[:, 0])
+    assert np.allclose(est_c[order], true_centers[true_order], atol=0.5)
+    assert np.allclose(est_w[order], true_widths[true_order], atol=1.5)
+    assert tfa.F_.shape == (X.shape[0], 2)
+    assert tfa.W_.shape == (2, X.shape[1])
+
+
+def test_tfa_subsampled_fit():
+    X, R, true_centers, _ = make_rbf_data(noise=0.02)
+    tfa = TFA(K=2, max_iter=10, threshold=0.5,
+              max_num_voxel=200, max_num_tr=30, seed=7)
+    tfa.fit(X, R)
+    est_c = tfa.get_centers(tfa.local_posterior_)
+    order = np.argsort(est_c[:, 0])
+    true_order = np.argsort(true_centers[:, 0])
+    assert np.allclose(est_c[order], true_centers[true_order], atol=1.0)
+
+
+def test_tfa_with_template_prior():
+    X, R, _, _ = make_rbf_data()
+    tfa = TFA(K=2, max_iter=3, threshold=0.5,
+              max_num_voxel=256, max_num_tr=40)
+    tfa.n_dim = 3
+    tfa.cov_vec_size = 6
+    tfa.get_map_offset()
+    template_prior, _, _ = tfa.get_template(R)
+    tfa2 = TFA(K=2, max_iter=3, threshold=0.5,
+               max_num_voxel=256, max_num_tr=40, nlss_loss='soft_l1')
+    tfa2.fit(X, R, template_prior=template_prior)
+    assert tfa2.local_posterior_.shape == (2 * 4,)
+    # template path does not set F_/W_ (matches reference tfa.py:1017-1023)
+    assert not hasattr(tfa2, "F_")
+
+
+def test_tfa_weight_methods():
+    X, R, _, _ = make_rbf_data(noise=0.01)
+    for method in ("rr", "ols"):
+        tfa = TFA(K=2, max_iter=2, threshold=5.0, weight_method=method,
+                  max_num_voxel=256, max_num_tr=40)
+        tfa.fit(X, R)
+        assert np.all(np.isfinite(tfa.W_))
+
+
+def test_tfa_input_validation():
+    X, R, _, _ = make_rbf_data()
+    with pytest.raises(TypeError):
+        TFA(K=2).fit(list(X), R)
+    with pytest.raises(TypeError):
+        TFA(K=2).fit(X, R[:, 0])
+    with pytest.raises(TypeError):
+        TFA(K=2).fit(X[:-5], R)
+    with pytest.raises(ValueError):
+        TFA(K=2, weight_method='lasso').fit(X, R)
+
+
+def test_map_offset_and_packing():
+    tfa = TFA(K=3)
+    tfa.n_dim = 3
+    tfa.cov_vec_size = 6
+    offs = tfa.get_map_offset()
+    assert list(offs) == [0, 9, 12, 30]
+    est = np.zeros(3 * (3 + 2 + 6))
+    centers = np.arange(9.0).reshape(3, 3)
+    tfa.set_centers(est, centers)
+    assert np.allclose(tfa.get_centers(est), centers)
+    widths = np.array([[1.0], [2.0], [3.0]])
+    tfa.set_widths(est, widths)
+    assert np.allclose(tfa.get_widths(est), widths)

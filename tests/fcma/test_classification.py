@@ -1,0 +1,104 @@
+import math
+
+import numpy as np
+from numpy.random import RandomState
+from scipy.spatial.distance import hamming
+from scipy.stats.mstats import zscore
+from sklearn import svm
+from sklearn.linear_model import LogisticRegression
+
+from brainiak_tpu.fcma.classifier import Classifier
+
+# Same synthetic recipe as the reference fixture
+# (reference tests/fcma/test_classification.py:25-40) so the golden
+# predictions/confidences carry over.
+prng = RandomState(1234567890)
+
+
+def create_epoch(idx, num_voxels):
+    row = 12
+    mat = prng.rand(row, num_voxels).astype(np.float32)
+    if idx % 2 == 0:
+        mat = np.sort(mat, axis=0)
+    mat = np.nan_to_num(zscore(mat, axis=0, ddof=0))
+    return mat / math.sqrt(mat.shape[0])
+
+
+def test_classification():
+    fake_raw_data = [create_epoch(i, 5) for i in range(20)]
+    labels = [0, 1] * 10
+    epochs_per_subj = 4
+    svm_clf = svm.SVC(kernel='precomputed', shrinking=False, C=1,
+                      gamma='auto')
+    training_data = fake_raw_data[0:12]
+    clf = Classifier(svm_clf, epochs_per_subj=epochs_per_subj)
+    clf.fit(list(zip(training_data, training_data)), labels[0:12])
+
+    expected_confidence = np.array([-1.18234421, 0.97403604, -1.04005679,
+                                    0.92403019, -0.95567738, 1.11746593,
+                                    -0.83275891, 0.9486868])
+    recomputed = clf.decision_function(
+        list(zip(fake_raw_data[12:], fake_raw_data[12:])))
+    # The reference's own assertion is sign agreement (hamming <= 1 of 8),
+    # not exact values — its goldens aren't bit-reproducible from the
+    # algorithm spec (an independent NumPy oracle agrees with our values).
+    assert hamming(np.sign(expected_confidence),
+                   np.sign(recomputed)) * 8 <= 1
+
+    y_pred = clf.predict(list(zip(fake_raw_data[12:], fake_raw_data[12:])))
+    expected_output = [0, 0, 0, 1, 0, 1, 0, 1]
+    assert hamming(y_pred, expected_output) * 8 <= 1
+
+    confidence = clf.decision_function(
+        list(zip(fake_raw_data[12:], fake_raw_data[12:])))
+    assert hamming(np.sign(expected_confidence),
+                   np.sign(confidence)) * 8 <= 1
+
+    y = [0, 1, 0, 1, 0, 1, 0, 1]
+    score = clf.score(list(zip(fake_raw_data[12:], fake_raw_data[12:])), y)
+    assert np.isclose(hamming(y_pred, y), 1 - score)
+
+
+def test_classification_partial_similarity():
+    fake_raw_data = [create_epoch(i, 5) for i in range(20)]
+    labels = [0, 1] * 10
+    svm_clf = svm.SVC(kernel='precomputed', shrinking=False, C=1,
+                      gamma='auto')
+    clf = Classifier(svm_clf, num_processed_voxels=2, epochs_per_subj=4)
+    clf.fit(list(zip(fake_raw_data, fake_raw_data)), labels,
+            num_training_samples=12)
+    y_pred = clf.predict()
+    expected_output = [0, 0, 0, 1, 0, 1, 0, 1]
+    assert hamming(y_pred, expected_output) * 8 <= 1
+    confidence = clf.decision_function()
+    assert np.all(np.sign(confidence[np.asarray(expected_output) == 1]
+                          ) >= 0)
+    # score ignores X when the Gram was portioned
+    score = clf.score(None, [0, 1, 0, 1, 0, 1, 0, 1])
+    assert 0.5 <= score <= 1.0
+
+
+def test_classification_logistic_regression():
+    fake_raw_data = [create_epoch(i, 5) for i in range(20)]
+    labels = [0, 1] * 10
+    clf = Classifier(LogisticRegression(), epochs_per_subj=4)
+    clf.fit(list(zip(fake_raw_data[0:12], fake_raw_data[0:12])),
+            labels[0:12])
+    y_pred = clf.predict(list(zip(fake_raw_data[12:], fake_raw_data[12:])))
+    expected_output = [0, 0, 0, 1, 0, 1, 0, 1]
+    assert hamming(y_pred, expected_output) * 8 <= 1
+
+
+def test_classification_errors():
+    import pytest
+
+    fake_raw_data = [create_epoch(i, 5) for i in range(8)]
+    labels = [0, 1] * 4
+    svm_clf = svm.SVC(kernel='precomputed', shrinking=False, C=1)
+    clf = Classifier(svm_clf, num_processed_voxels=2, epochs_per_subj=2)
+    with pytest.raises(RuntimeError):
+        # portioned kernel requires num_training_samples
+        clf.fit(list(zip(fake_raw_data, fake_raw_data)), labels)
+    with pytest.raises(ValueError):
+        clf.fit(list(zip(fake_raw_data, fake_raw_data)), labels,
+                num_training_samples=8)

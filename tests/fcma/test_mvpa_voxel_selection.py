@@ -1,0 +1,41 @@
+import numpy as np
+from sklearn import svm
+
+from brainiak_tpu.fcma.mvpa_voxelselector import MVPAVoxelSelector
+from brainiak_tpu.searchlight import Cube, Searchlight
+
+
+def test_mvpa_voxel_selection_finds_informative_region():
+    rng = np.random.RandomState(0)
+    dims = (5, 5, 5)
+    n_epochs = 20
+    labels = np.array([0, 1] * (n_epochs // 2))
+    data = rng.randn(*dims, n_epochs).astype(np.float32)
+    # informative corner: activity differs by condition
+    data[:2, :2, :2, :] += labels[None, None, None, :] * 3.0
+    mask = np.ones(dims, dtype=bool)
+
+    sl = Searchlight(sl_rad=1, shape=Cube, pool_size=1)
+    mvs = MVPAVoxelSelector(data, mask, labels, 2, sl)
+    clf = svm.SVC(kernel='linear', shrinking=False, C=1)
+    result_volume, results = mvs.run(clf)
+
+    assert result_volume.shape == dims
+    assert len(results) == mask.sum()
+    # accuracies sorted descending
+    accs = [r[1] for r in results]
+    assert accs == sorted(accs, reverse=True)
+    # a voxel inside the informative region classifies well
+    assert result_volume[1, 1, 1] > 0.9
+    # a distant noise voxel does not
+    assert result_volume[3, 3, 3] < result_volume[1, 1, 1]
+
+
+def test_mvpa_voxel_selection_empty_mask():
+    import pytest
+
+    data = np.zeros((4, 4, 4, 6), dtype=np.float32)
+    mask = np.zeros((4, 4, 4), dtype=bool)
+    sl = Searchlight(sl_rad=1)
+    with pytest.raises(ValueError):
+        MVPAVoxelSelector(data, mask, np.array([0, 1] * 3), 2, sl)

@@ -1,0 +1,97 @@
+from pathlib import Path
+
+import numpy as np
+
+from brainiak_tpu import io
+from brainiak_tpu.fcma.preprocessing import (
+    RandomType,
+    generate_epochs_info,
+    prepare_fcma_data,
+    prepare_mvpa_data,
+    prepare_searchlight_mvpa_data,
+)
+
+# Real data + golden outputs from the reference test suite (read-only).
+DATA_DIR = Path("/root/reference/tests/io/data")
+EXPECTED_DIR = Path("/root/reference/tests/fcma/data")
+SUFFIX = "bet.nii.gz"
+MASK_FILE = DATA_DIR / "mask.nii.gz"
+EPOCH_FILE = DATA_DIR / "epoch_labels.npy"
+EXPECTED_LABELS = np.array([0, 1, 0, 1])
+
+
+def test_prepare_fcma_data_matches_reference_golden():
+    images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
+    mask = io.load_boolean_mask(MASK_FILE)
+    conditions = io.load_labels(EPOCH_FILE)
+    raw_data, raw_data2, labels = prepare_fcma_data(images, conditions, mask)
+    expected_raw_data = np.load(EXPECTED_DIR / "expected_raw_data.npy")
+    assert raw_data2 is None
+    assert len(raw_data) == len(expected_raw_data)
+    for idx in range(len(raw_data)):
+        assert np.allclose(raw_data[idx], expected_raw_data[idx])
+    assert np.array_equal(labels, EXPECTED_LABELS)
+
+
+def test_prepare_fcma_data_randomized():
+    mask = io.load_boolean_mask(MASK_FILE)
+    conditions = io.load_labels(EPOCH_FILE)
+    for random in (RandomType.REPRODUCIBLE, RandomType.UNREPRODUCIBLE):
+        images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
+        raw_data, _, labels = prepare_fcma_data(images, conditions, mask,
+                                                random=random)
+        assert len(raw_data) == 4
+    # reproducible randomization is deterministic across runs
+    images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
+    r1, _, _ = prepare_fcma_data(images, conditions, mask,
+                                 random=RandomType.REPRODUCIBLE)
+    images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
+    r2, _, _ = prepare_fcma_data(images, conditions, mask,
+                                 random=RandomType.REPRODUCIBLE)
+    for a, b in zip(r1, r2):
+        assert np.array_equal(a, b)
+
+
+def test_prepare_fcma_data_two_masks():
+    images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
+    mask = io.load_boolean_mask(MASK_FILE)
+    conditions = io.load_labels(EPOCH_FILE)
+    raw_data, raw_data2, labels = prepare_fcma_data(images, conditions,
+                                                    mask, mask2=mask)
+    assert raw_data2 is not None
+    assert len(raw_data) == len(raw_data2) == 4
+    for a, b in zip(raw_data, raw_data2):
+        assert np.allclose(a, b)
+
+
+def test_prepare_mvpa_data_matches_reference_golden():
+    images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
+    mask = io.load_boolean_mask(MASK_FILE)
+    conditions = io.load_labels(EPOCH_FILE)
+    processed_data, labels = prepare_mvpa_data(images, conditions, mask)
+    expected = np.load(EXPECTED_DIR / "expected_processed_data.npy")
+    assert processed_data.shape == expected.shape
+    assert np.allclose(processed_data, expected)
+    assert np.array_equal(labels, EXPECTED_LABELS)
+
+
+def test_prepare_searchlight_mvpa_data_matches_reference_golden():
+    images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
+    conditions = io.load_labels(EPOCH_FILE)
+    processed_data, labels = prepare_searchlight_mvpa_data(images,
+                                                           conditions)
+    expected = np.load(
+        EXPECTED_DIR / "expected_searchlight_processed_data.npy")
+    assert processed_data.shape == expected.shape
+    assert np.allclose(processed_data, expected)
+    assert np.array_equal(labels, EXPECTED_LABELS)
+
+
+def test_generate_epochs_info():
+    conditions = io.load_labels(EPOCH_FILE)
+    info = generate_epochs_info(conditions)
+    assert len(info) == 4
+    for cond, sid, start, end in info:
+        assert cond in (0, 1)
+        assert sid in (0, 1)
+        assert end > start

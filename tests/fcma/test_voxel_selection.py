@@ -1,0 +1,137 @@
+import math
+
+import numpy as np
+from numpy.random import RandomState
+from scipy.stats.mstats import zscore
+from sklearn import svm
+from sklearn.linear_model import LogisticRegression
+
+from brainiak_tpu.fcma.voxelselector import VoxelSelector
+from brainiak_tpu.ops.fisherz import within_subject_normalization
+
+
+def create_epoch(prng, col=5):
+    """Same synthetic epoch recipe as the reference test fixture
+    (reference tests/fcma/test_voxel_selection.py:27-36), so the golden
+    accuracies below carry over."""
+    row = 12
+    mat = prng.rand(row, col).astype(np.float32)
+    mat = np.nan_to_num(zscore(mat, axis=0, ddof=0))
+    return mat / math.sqrt(mat.shape[0])
+
+
+def test_within_subject_normalization_golden():
+    """Reference golden values (tests/fcma/test_voxel_selection.py:58-66)."""
+    prng = RandomState(1234567890)
+    _ = [create_epoch(prng) for _ in range(8)]
+    fake_corr = prng.rand(1, 4, 5).astype(np.float32)
+    out = np.asarray(within_subject_normalization(fake_corr, 4))
+    expected = [[[1.06988919, 0.51641309, -0.46790636, -1.31926763,
+                  0.2270218],
+                 [-1.22142744, -1.39881694, -1.2979387, 1.05702305,
+                  -0.6525566],
+                 [0.89795232, 1.27406132, 0.36460185, 0.87538344,
+                  1.5227468],
+                 [-0.74641371, -0.39165771, 1.40124381, -0.61313909,
+                  -1.0972116]]]
+    assert np.allclose(out, expected, atol=1e-4)
+
+
+def _accuracy_counts(results, n_voxels, n_epochs=8):
+    output = [None] * n_voxels
+    for vid, acc in results:
+        output[vid] = int(round(n_epochs * acc))
+    return output
+
+
+def test_voxel_selection_sklearn_parity():
+    """Host-sklearn CV path reproduces the reference golden accuracies
+    (tests/fcma/test_voxel_selection.py:68-90)."""
+    prng = RandomState(1234567890)
+    fake_raw_data = [create_epoch(prng) for _ in range(8)]
+    labels = [0, 1, 0, 1, 0, 1, 0, 1]
+    vs = VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=1)
+
+    clf = svm.SVC(kernel='precomputed', shrinking=False, C=1, gamma='auto')
+    output = _accuracy_counts(vs.run(clf), 5)
+    assert np.allclose(output, [7, 4, 6, 4, 4], atol=1)
+
+    output = _accuracy_counts(vs.run(LogisticRegression()), 5)
+    assert np.allclose(output, [6, 3, 6, 4, 4], atol=1)
+
+
+def test_voxel_selection_on_device_svm():
+    """The batched on-device dual-SVM CV matches the sklearn SVC goldens
+    within the reference's own tolerance band (atol=1 epoch)."""
+    prng = RandomState(1234567890)
+    fake_raw_data = [create_epoch(prng) for _ in range(8)]
+    labels = [0, 1, 0, 1, 0, 1, 0, 1]
+    vs = VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=1)
+    output = _accuracy_counts(vs.run('svm'), 5)
+    assert np.allclose(output, [7, 4, 6, 4, 4], atol=1)
+
+
+def test_voxel_selection_two_masks():
+    """Region x region golden accuracies
+    (tests/fcma/test_voxel_selection.py:95-130)."""
+    prng = RandomState(1234567890)
+    fake_raw_data1 = [create_epoch(prng) for _ in range(8)]
+    fake_raw_data2 = [create_epoch(prng) for _ in range(8)]
+    labels = [0, 1, 0, 1, 0, 1, 0, 1]
+    vs = VoxelSelector(labels, 4, 2, fake_raw_data1,
+                       raw_data2=fake_raw_data2, voxel_unit=1)
+    clf = svm.SVC(kernel='precomputed', shrinking=False, C=1, gamma='auto')
+    output = _accuracy_counts(vs.run(clf), 5)
+    assert np.allclose(output, [3, 3, 7, 5, 7], atol=1)
+
+    output = _accuracy_counts(vs.run(LogisticRegression()), 5)
+    assert np.allclose(output, [4, 3, 7, 4, 6], atol=1)
+
+    output = _accuracy_counts(vs.run('svm'), 5)
+    assert np.allclose(output, [3, 3, 7, 5, 7], atol=1)
+
+
+def test_voxel_selection_block_sizes_agree():
+    """Different voxel_unit values give identical results (the block
+    decomposition is an implementation detail)."""
+    prng = RandomState(1234567890)
+    fake_raw_data = [create_epoch(prng, col=11) for _ in range(8)]
+    labels = [0, 1, 0, 1, 0, 1, 0, 1]
+    rs = []
+    for unit in (3, 11, 64):
+        vs = VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=unit)
+        rs.append(sorted(vs.run('svm')))
+    for vid in range(11):
+        assert np.isclose(rs[0][vid][1], rs[1][vid][1], atol=1e-5)
+        assert np.isclose(rs[0][vid][1], rs[2][vid][1], atol=1e-5)
+
+
+def test_voxel_selection_mesh():
+    """Sharding blocks over the CPU mesh voxel axis reproduces the
+    single-device result."""
+    from brainiak_tpu.parallel import make_mesh
+
+    prng = RandomState(1234567890)
+    fake_raw_data = [create_epoch(prng, col=16) for _ in range(8)]
+    labels = [0, 1, 0, 1, 0, 1, 0, 1]
+    single = sorted(VoxelSelector(labels, 4, 2, fake_raw_data,
+                                  voxel_unit=4).run('svm'))
+    mesh = make_mesh(("subject", "voxel"), (1, 8))
+    dist = sorted(VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=2,
+                                mesh=mesh).run('svm'))
+    for (v0, a0), (v1, a1) in zip(single, dist):
+        assert v0 == v1
+        assert np.isclose(a0, a1, atol=1e-5)
+
+
+def test_voxel_selection_errors():
+    import pytest
+
+    prng = RandomState(0)
+    data = [create_epoch(prng) for _ in range(4)]
+    with pytest.raises(ValueError):
+        VoxelSelector([0, 1, 0, 1], 2, 2, data,
+                      raw_data2=data[:-1])
+    with pytest.raises(ValueError):
+        VoxelSelector([0, 1, 0, 1], 2, 2,
+                      [d[:, :0] for d in data])

@@ -1,0 +1,159 @@
+import numpy as np
+import pytest
+
+from brainiak_tpu.searchlight import Ball, Cube, Diamond, Searchlight
+
+
+def test_shapes():
+    c = Cube(1)
+    assert c.mask_.shape == (3, 3, 3) and c.mask_.all()
+    d = Diamond(1)
+    assert d.mask_.sum() == 7  # center + 6 face neighbors
+    assert d.mask_[1, 1, 1] and d.mask_[0, 1, 1] and not d.mask_[0, 0, 0]
+    b = Ball(2)
+    assert b.mask_[2, 2, 2] and b.mask_[0, 2, 2]
+    assert not b.mask_[0, 0, 0]
+    # Ball(r) contains Diamond(r) and is inside Cube(r)
+    assert np.all(Ball(2).mask_ >= Diamond(2).mask_)
+
+
+def test_run_searchlight_matches_oracle():
+    rng = np.random.RandomState(0)
+    dims = (6, 6, 6, 4)
+    data = rng.randn(*dims)
+    mask = np.ones(dims[:3], dtype=bool)
+    rad = 1
+
+    def voxel_fn(subjects, msk, myrad, bcast):
+        return float(np.sum(subjects[0][msk]))
+
+    sl = Searchlight(sl_rad=rad, shape=Cube, pool_size=1)
+    sl.distribute([data], mask)
+    sl.broadcast(None)
+    out = sl.run_searchlight(voxel_fn)
+
+    # border voxels skipped
+    assert out[0, 0, 0] is None
+    for (i, j, k) in [(1, 1, 1), (2, 3, 4), (4, 4, 4)]:
+        expected = data[i - 1:i + 2, j - 1:j + 2, k - 1:k + 2].sum()
+        assert np.isclose(out[i, j, k], expected)
+
+
+def test_searchlight_min_active_proportion():
+    dims = (5, 5, 5, 2)
+    data = np.ones(dims)
+    mask = np.zeros(dims[:3], dtype=bool)
+    mask[2, 2, 2] = True  # single active voxel: 1/27 of Cube(1)
+
+    def voxel_fn(subjects, msk, myrad, bcast):
+        return 1.0
+
+    sl = Searchlight(sl_rad=1, shape=Cube,
+                     min_active_voxels_proportion=0.5, pool_size=1)
+    sl.distribute([data], mask)
+    out = sl.run_searchlight(voxel_fn)
+    assert out[2, 2, 2] is None  # filtered by proportion
+
+    sl2 = Searchlight(sl_rad=1, shape=Cube,
+                      min_active_voxels_proportion=0, pool_size=1)
+    sl2.distribute([data], mask)
+    out2 = sl2.run_searchlight(voxel_fn)
+    assert out2[2, 2, 2] == 1.0
+
+
+def test_run_block_function():
+    dims = (5, 5, 5, 3)
+    data = np.arange(np.prod(dims), dtype=float).reshape(dims)
+    mask = np.ones(dims[:3], dtype=bool)
+    sl = Searchlight(sl_rad=1, pool_size=1)
+    sl.distribute([data], mask)
+    sl.broadcast(42)
+
+    def block_fn(subjects, msk, rad, bcast, extra):
+        assert bcast == 42 and extra == ('x',)
+        inner = np.empty((3, 3, 3), dtype=object)
+        inner[:] = 7.0
+        return inner
+
+    out = sl.run_block_function(block_fn, extra_block_fn_params=('x',))
+    assert out[2, 2, 2] == 7.0
+    assert out[0, 0, 0] is None
+
+
+def test_traced_tier_matches_generic():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    dims = (7, 6, 5, 8)
+    subjects = [rng.randn(*dims) for _ in range(2)]
+    mask = rng.rand(*dims[:3]) > 0.2
+
+    # traced fn: mean over valid voxels of the correlation between the two
+    # subjects' time series at each voxel
+    def voxel_fn_jax(patches, mpatch, rad, bcast):
+        x, y = patches[0], patches[1]
+        xd = x - jnp.mean(x, axis=1, keepdims=True)
+        yd = y - jnp.mean(y, axis=1, keepdims=True)
+        r = jnp.sum(xd * yd, axis=1) / jnp.sqrt(
+            jnp.sum(xd ** 2, axis=1) * jnp.sum(yd ** 2, axis=1))
+        return jnp.sum(jnp.where(mpatch, r, 0.0)) / jnp.sum(mpatch)
+
+    def voxel_fn_host(subj, msk, rad, bcast):
+        vals = []
+        flat0 = subj[0][msk]
+        flat1 = subj[1][msk]
+        for v in range(flat0.shape[0]):
+            vals.append(np.corrcoef(flat0[v], flat1[v])[0, 1])
+        return float(np.mean(vals))
+
+    sl = Searchlight(sl_rad=1, shape=Diamond, pool_size=1)
+    sl.distribute(subjects, mask)
+    sl.broadcast(None)
+    host_out = sl.run_searchlight(voxel_fn_host)
+    jax_out = sl.run_searchlight_jax(voxel_fn_jax)
+
+    centers = np.argwhere(mask[1:-1, 1:-1, 1:-1]) + 1
+    checked = 0
+    for (i, j, k) in centers:
+        if host_out[i, j, k] is not None:
+            assert np.isclose(jax_out[i, j, k], host_out[i, j, k],
+                              atol=1e-6)
+            checked += 1
+    assert checked > 10
+    # skipped voxels are NaN in the traced tier
+    assert np.isnan(jax_out[0, 0, 0])
+
+
+def test_traced_tier_mesh_matches_single():
+    import jax.numpy as jnp
+
+    from brainiak_tpu.parallel import make_mesh
+
+    rng = np.random.RandomState(2)
+    dims = (6, 6, 6, 5)
+    subjects = [rng.randn(*dims)]
+    mask = np.ones(dims[:3], dtype=bool)
+
+    def voxel_fn_jax(patches, mpatch, rad, bcast):
+        return jnp.sum(patches[0] * mpatch[:, None])
+
+    sl = Searchlight(sl_rad=1, shape=Cube)
+    sl.distribute(subjects, mask)
+    single = sl.run_searchlight_jax(voxel_fn_jax)
+
+    mesh = make_mesh(("subject", "voxel"), (1, 8))
+    sl_m = Searchlight(sl_rad=1, shape=Cube, mesh=mesh)
+    sl_m.distribute(subjects, mask)
+    dist = sl_m.run_searchlight_jax(voxel_fn_jax)
+    assert np.allclose(single, dist, equal_nan=True)
+
+
+def test_searchlight_validation():
+    sl = Searchlight(sl_rad=1)
+    with pytest.raises(ValueError):
+        sl.distribute([np.zeros((4, 4, 4, 2))], np.ones((5, 5, 5),
+                                                        dtype=bool))
+    with pytest.raises(AssertionError):
+        Searchlight(sl_rad=-1)
+    with pytest.raises(AssertionError):
+        Searchlight(max_blk_edge=0)
