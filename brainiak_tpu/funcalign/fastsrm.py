@@ -1,0 +1,261 @@
+"""FastSRM: atlas-accelerated deterministic SRM, TPU-native.
+
+Re-design of /root/reference/src/brainiak/funcalign/fastsrm.py.  Pipeline
+(reference fastsrm.py:592-1053): (1) optionally project each subject's data
+onto an atlas (deterministic label averaging or probabilistic pseudo-inverse),
+(2) run deterministic SRM in the reduced space on session-concatenated data,
+(3) recover full-resolution per-subject bases from the SVD of
+(shared response)ᵀ·(full data), (4) transform/inverse-transform via those
+bases.  Data may be arrays or ``.npy`` paths; ``temp_dir``/``low_ram``
+spill intermediates to disk; sessions may differ in length.
+
+The reduced-space SRM is the jitted :class:`~brainiak_tpu.funcalign.srm.DetSRM`
+program; basis SVDs and projections are jitted jnp ops.  joblib's process
+pool (reference's ``n_jobs``) is unnecessary for on-device math, but the
+parameter is accepted.
+"""
+
+import logging
+import os
+import uuid
+
+import jax.numpy as jnp
+import numpy as np
+from sklearn.base import BaseEstimator, TransformerMixin
+from sklearn.exceptions import NotFittedError
+
+from .srm import DetSRM, _procrustes
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FastSRM"]
+
+
+def _safe_load(data):
+    if isinstance(data, str):
+        return np.load(data)
+    return np.asarray(data)
+
+
+def _canonicalize_imgs(imgs):
+    """Accepts: array of paths [n_subjects, n_sessions]; list of arrays
+    (one session each); list of lists of arrays/paths.  Returns a list of
+    lists: imgs[subject][session] (reference fastsrm.py:383-447)."""
+    if isinstance(imgs, np.ndarray) and imgs.dtype.kind in ("U", "S", "O") \
+            and imgs.ndim == 2:
+        return [[imgs[i, j] for j in range(imgs.shape[1])]
+                for i in range(imgs.shape[0])]
+    if isinstance(imgs, (list, tuple)):
+        if len(imgs) == 0:
+            raise ValueError("imgs is empty")
+        if isinstance(imgs[0], (list, tuple)):
+            return [list(subj) for subj in imgs]
+        return [[subj] for subj in imgs]
+    raise ValueError("imgs must be a list of arrays, a list of lists of "
+                     "arrays, or a 2D array of paths")
+
+
+def _reduce_one(data, atlas, inv_atlas):
+    """Project [n_voxels, n_timeframes] data to the reduced space;
+    returns [n_timeframes, n_supervoxels] (reference fastsrm.py:592-675)."""
+    data_t = data.T  # [T, V]
+    if inv_atlas is not None:
+        return np.asarray(jnp.asarray(data_t) @ jnp.asarray(inv_atlas))
+    if atlas is not None:
+        values = np.unique(atlas)
+        values = values[values != 0]
+        return np.stack([data_t[:, atlas == c].mean(axis=1)
+                         for c in values], axis=1)
+    return data_t
+
+
+class FastSRM(BaseEstimator, TransformerMixin):
+    """FastSRM (reference fastsrm.py:1252-1767).
+
+    Parameters
+    ----------
+    atlas : None, [n_voxels] deterministic labels (0 = ignore), or
+        [n_supervoxels, n_voxels] probabilistic atlas
+    n_components : int
+    n_iter : int, reduced-space SRM iterations
+    temp_dir : str or None — spill bases/reduced data as .npy
+    low_ram : bool — with temp_dir, keep intermediates on disk
+    seed : int
+    n_jobs : accepted for API compatibility
+    aggregate : 'mean' or None — transform returns the subject mean or
+        per-subject projections
+    """
+
+    def __init__(self, atlas=None, n_components=20, n_iter=100,
+                 temp_dir=None, low_ram=False, seed=0, n_jobs=1,
+                 verbose="warn", aggregate="mean"):
+        if aggregate is not None and aggregate != "mean":
+            raise ValueError("aggregate can have only value mean or None")
+        self.atlas = atlas
+        self.n_components = n_components
+        self.n_iter = n_iter
+        self.low_ram = low_ram
+        self.seed = seed
+        self.n_jobs = n_jobs
+        self.verbose = verbose
+        self.aggregate = aggregate
+        self.basis_list = None
+        if temp_dir is None:
+            self.temp_dir = None
+            self.low_ram = False
+        else:
+            self.temp_dir = os.path.join(temp_dir,
+                                         "fastsrm" + str(uuid.uuid4()))
+
+    # -- internals --------------------------------------------------------
+    def _atlas_parts(self):
+        if self.atlas is None:
+            return None, None
+        atlas = np.asarray(self.atlas)
+        if atlas.ndim == 2:
+            return None, np.linalg.pinv(atlas)  # probabilistic
+        return atlas, None
+
+    def _maybe_spill(self, array, name):
+        if self.temp_dir is not None and self.low_ram:
+            os.makedirs(self.temp_dir, exist_ok=True)
+            path = os.path.join(self.temp_dir, name + ".npy")
+            np.save(path, array)
+            return path
+        return array
+
+    def clean(self):
+        """Remove temporary files (reference fastsrm.py:1368-1381)."""
+        if self.temp_dir is not None and os.path.exists(self.temp_dir):
+            for f in os.listdir(self.temp_dir):
+                os.remove(os.path.join(self.temp_dir, f))
+            os.rmdir(self.temp_dir)
+
+    def _compute_basis(self, subject_sessions, shared_sessions):
+        """Basis [n_components, n_voxels] from SVD of Σ_j S_jᵀ X_j
+        (reference fastsrm.py:857-952)."""
+        corr = None
+        for img, shared in zip(subject_sessions, shared_sessions):
+            data = _safe_load(img)  # [V, T]
+            c = np.asarray(jnp.asarray(shared.T) @ jnp.asarray(data.T))
+            corr = c if corr is None else corr + c
+        basis = np.asarray(_procrustes(jnp.asarray(corr)))
+        return basis
+
+    # -- API --------------------------------------------------------------
+    def fit(self, imgs):
+        """Fit bases from multi-subject (multi-session) data
+        (reference fastsrm.py:1383-1466)."""
+        imgs = _canonicalize_imgs(imgs)
+        n_subjects = len(imgs)
+        if n_subjects <= 1:
+            raise ValueError("There are not enough subjects in the input "
+                             "data to train the model.")
+        n_sessions = len(imgs[0])
+        for subj in imgs:
+            if len(subj) != n_sessions:
+                raise ValueError("All subjects must have the same number "
+                                 "of sessions")
+
+        atlas, inv_atlas = self._atlas_parts()
+        reduced = [[self._maybe_spill(
+            _reduce_one(_safe_load(imgs[i][j]), atlas, inv_atlas),
+            f"reduced_{i}_{j}") for j in range(n_sessions)]
+            for i in range(n_subjects)]
+
+        # Reduced-space deterministic SRM on session-concatenated data
+        # (reference fast_srm, fastsrm.py:955-1021).
+        first_subj = [_safe_load(r) for r in reduced[0]]
+        session_lengths = [r.shape[0] for r in first_subj]
+        X = [np.concatenate(first_subj, axis=0).T] + \
+            [np.concatenate([_safe_load(r) for r in subj], axis=0).T
+             for subj in reduced[1:]]
+        srm = DetSRM(n_iter=self.n_iter, features=self.n_components,
+                     rand_seed=self.seed)
+        srm.fit(X)
+        concatenated_s = np.mean(
+            [s for s in srm.transform(X)], axis=0).T  # [T_total, K]
+        shared_sessions = []
+        start = 0
+        for length in session_lengths:
+            shared_sessions.append(concatenated_s[start:start + length])
+            start += length
+
+        # Full-resolution bases from the original data.
+        self.basis_list = []
+        for i in range(n_subjects):
+            basis = self._compute_basis(imgs[i], shared_sessions)
+            self.basis_list.append(
+                self._maybe_spill(basis, f"basis_{i}"))
+        return self
+
+    def transform(self, imgs, subjects_indexes=None):
+        """Project data into shared space (reference
+        fastsrm.py:1513-1596)."""
+        if self.basis_list is None:
+            raise NotFittedError("The model fit has not been run yet.")
+        imgs = _canonicalize_imgs(imgs)
+        if subjects_indexes is None:
+            subjects_indexes = list(range(len(imgs)))
+        n_sessions = len(imgs[0])
+
+        per_subject = []
+        for pos, i in enumerate(subjects_indexes):
+            basis = _safe_load(self.basis_list[i])
+            sessions = [np.asarray(jnp.asarray(basis)
+                                   @ jnp.asarray(_safe_load(
+                                       imgs[pos][j])))
+                        for j in range(n_sessions)]
+            per_subject.append(sessions)
+
+        if self.aggregate == "mean":
+            out = [np.mean([subj[j] for subj in per_subject], axis=0)
+                   for j in range(n_sessions)]
+            return out[0] if n_sessions == 1 else out
+        if n_sessions == 1:
+            return [subj[0] for subj in per_subject]
+        return per_subject
+
+    def fit_transform(self, imgs, subjects_indexes=None):
+        self.fit(imgs)
+        return self.transform(imgs, subjects_indexes=subjects_indexes)
+
+    def inverse_transform(self, shared_response, subjects_indexes=None,
+                          sessions_indexes=None):
+        """Reconstruct voxel-space data: basisᵀ · shared
+        (reference fastsrm.py:1598-1679)."""
+        if self.basis_list is None:
+            raise NotFittedError("The model fit has not been run yet.")
+        if subjects_indexes is None:
+            subjects_indexes = list(range(len(self.basis_list)))
+        single_session = isinstance(shared_response, np.ndarray)
+        shared = [shared_response] if single_session else \
+            list(shared_response)
+        if sessions_indexes is None:
+            sessions_indexes = list(range(len(shared)))
+
+        data = []
+        for i in subjects_indexes:
+            basis = _safe_load(self.basis_list[i])
+            if single_session:
+                data.append(basis.T @ shared[0])
+            else:
+                data.append([basis.T @ shared[j]
+                             for j in sessions_indexes])
+        return data
+
+    def add_subjects(self, imgs, shared_response):
+        """Fit bases for additional subjects against an existing shared
+        response (reference fastsrm.py:1681-1766)."""
+        if self.basis_list is None:
+            self.basis_list = []
+        imgs = _canonicalize_imgs(imgs)
+        single = isinstance(shared_response, np.ndarray)
+        shared = [shared_response.T] if single else \
+            [s.T for s in shared_response]
+        for pos, subj in enumerate(imgs):
+            basis = self._compute_basis(subj, shared)
+            self.basis_list.append(
+                self._maybe_spill(basis,
+                                  f"basis_{len(self.basis_list)}"))
+        return self

@@ -1,0 +1,181 @@
+"""Robust Shared Response Model (RSRM), TPU-native.
+
+Re-design of /root/reference/src/brainiak/funcalign/rsrm.py: factorize each
+subject's data as X_i ≈ W_i R + S_i with orthonormal W_i and an l1-sparse
+individual term S_i, by block-coordinate descent (Procrustes W update,
+soft-threshold S update, averaged shared response).
+
+Like SRM, the whole BCD loop is one jitted program over a zero-padded
+``[subjects, voxels, TRs]`` stack (padding is exact: zero data rows produce
+zero W rows and zero S rows through every update), shardable over a
+``('subject',)`` mesh axis.
+"""
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from sklearn.base import BaseEstimator, TransformerMixin
+from sklearn.exceptions import NotFittedError
+from sklearn.utils import assert_all_finite
+
+from .srm import _init_w, _procrustes, _stack_and_pad
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RSRM"]
+
+
+@jax.jit
+def _shrink(v, gamma):
+    """Soft-thresholding operator (reference rsrm.py:537-561)."""
+    return jnp.sign(v) * jnp.maximum(jnp.abs(v) - gamma, 0.0)
+
+
+def _shared_response(x, s, w, n_subjects):
+    return jnp.einsum('svk,svt->kt', w, x - s) / n_subjects
+
+
+@partial(jax.jit, static_argnames=("features", "n_iter"))
+def _fit_rsrm(x, voxel_counts, key, gamma, features, n_iter):
+    """Full RSRM BCD fit as one XLA program (reference rsrm.py:256-350)."""
+    n_subjects, voxels_pad, trs = x.shape
+    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
+    s = jnp.zeros_like(x)
+    r = _shared_response(x, s, w, n_subjects)
+
+    def body(_, carry):
+        w, s, r = carry
+        a = jnp.einsum('svt,kt->svk', x - s, r)
+        w = jax.vmap(lambda m: _procrustes(m, 0.0))(a)
+        s = _shrink(x - jnp.einsum('svk,kt->svt', w, r), gamma)
+        r = _shared_response(x, s, w, n_subjects)
+        return w, s, r
+
+    w, s, r = jax.lax.fori_loop(0, n_iter, body, (w, s, r))
+    objective = 0.5 * jnp.sum(
+        (x - jnp.einsum('svk,kt->svt', w, r) - s) ** 2) \
+        + gamma * jnp.sum(jnp.abs(s))
+    return w, s, r, objective
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def _transform_new_data(x, w, gamma, n_iter):
+    """Alternating projection/shrinkage for new data of a fitted subject
+    (reference rsrm.py:193-220)."""
+    s = jnp.zeros_like(x)
+
+    def body(_, carry):
+        r, s = carry
+        r = w.T @ (x - s)
+        s = _shrink(x - w @ r, gamma)
+        return r, s
+
+    r0 = jnp.zeros((w.shape[1], x.shape[1]), dtype=x.dtype)
+    return jax.lax.fori_loop(0, n_iter, body, (r0, s))
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def _transform_subject(x, r, gamma, n_iter):
+    """Alternating Procrustes/shrinkage for a held-out subject
+    (reference rsrm.py:222-254)."""
+    s = jnp.zeros_like(x)
+    w0 = jnp.zeros((x.shape[0], r.shape[0]), dtype=x.dtype)
+
+    def body(_, carry):
+        w, s = carry
+        w = _procrustes((x - s) @ r.T, 0.0)
+        s = _shrink(x - w @ r, gamma)
+        return w, s
+
+    return jax.lax.fori_loop(0, n_iter, body, (w0, s))
+
+
+class RSRM(BaseEstimator, TransformerMixin):
+    """Robust SRM (reference rsrm.py:39-561).
+
+    Attributes after fit: ``w_`` (orthonormal maps), ``r_`` (shared
+    response), ``s_`` (sparse individual terms).
+    """
+
+    def __init__(self, n_iter=10, features=50, gamma=1.0, rand_seed=0,
+                 mesh=None):
+        self.n_iter = n_iter
+        self.features = features
+        self.gamma = gamma
+        self.rand_seed = rand_seed
+        self.mesh = mesh
+
+    def fit(self, X, y=None):
+        logger.info('Starting RSRM')
+        if self.gamma <= 0.0:
+            raise ValueError("Gamma parameter should be positive.")
+        if len(X) <= 1:
+            raise ValueError("There are not enough subjects in the input "
+                             "data to train the model.")
+        if X[0].shape[1] < self.features:
+            raise ValueError(
+                "There are not enough timepoints to train the model with "
+                "{0:d} features.".format(self.features))
+        number_trs = X[0].shape[1]
+        for subject in range(len(X)):
+            assert_all_finite(X[subject])
+            if X[subject].shape[1] != number_trs:
+                raise ValueError("Different number of alignment timepoints "
+                                 "between subjects.")
+
+        dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+        stacked, voxel_counts, _, _ = _stack_and_pad(X, dtype, demean=False)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..parallel.mesh import DEFAULT_SUBJECT_AXIS
+            stacked = jax.device_put(
+                stacked, NamedSharding(
+                    self.mesh,
+                    PartitionSpec(DEFAULT_SUBJECT_AXIS, None, None)))
+
+        key = jax.random.PRNGKey(self.rand_seed)
+        w, s, r, objective = _fit_rsrm(
+            jnp.asarray(stacked), jnp.asarray(voxel_counts).astype(dtype),
+            key, self.gamma, features=self.features, n_iter=self.n_iter)
+        w = np.asarray(w)
+        s = np.asarray(s)
+        self.w_ = [w[i, :voxel_counts[i]] for i in range(len(X))]
+        self.s_ = [s[i, :voxel_counts[i]] for i in range(len(X))]
+        self.r_ = np.asarray(r)
+        self.objective_ = float(objective)
+        return self
+
+    def transform(self, X):
+        """Returns (shared responses, individual terms) for new data
+        (reference rsrm.py:157-191)."""
+        if not hasattr(self, 'w_'):
+            raise NotFittedError("The model fit has not been run yet.")
+        if len(X) != len(self.w_):
+            raise ValueError("The number of subjects does not match the one"
+                             " in the model.")
+        r = [None] * len(X)
+        s = [None] * len(X)
+        for subject in range(len(X)):
+            if X[subject] is not None:
+                rj, sj = _transform_new_data(
+                    jnp.asarray(X[subject]), jnp.asarray(self.w_[subject]),
+                    self.gamma, self.n_iter)
+                r[subject] = np.asarray(rj)
+                s[subject] = np.asarray(sj)
+        return r, s
+
+    def transform_subject(self, X):
+        """Returns (w, s) for a held-out subject (reference
+        rsrm.py:222-254)."""
+        if not hasattr(self, 'w_'):
+            raise NotFittedError("The model fit has not been run yet.")
+        if X.shape[1] != self.r_.shape[1]:
+            raise ValueError("The number of timepoints(TRs) does not match "
+                             "the one in the model.")
+        w, s = _transform_subject(jnp.asarray(X), jnp.asarray(self.r_),
+                                  self.gamma, self.n_iter)
+        return np.asarray(w), np.asarray(s)

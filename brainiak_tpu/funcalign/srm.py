@@ -40,13 +40,14 @@ __all__ = ["SRM", "DetSRM", "load"]
 logger = logging.getLogger(__name__)
 
 
-def _procrustes(a):
+def _procrustes(a, perturbation=0.001):
     """Orthogonal map closest to ``a`` ([voxels, features]): U Vᵀ from the
     thin SVD of ``a`` plus the reference's 0.001 diagonal perturbation
-    (srm.py:595-601)."""
+    (srm.py:595-601).  RSRM's updates use no perturbation
+    (rsrm.py:182-236); pass ``perturbation=0``."""
     eye = jnp.zeros_like(a)
     k = min(a.shape)
-    eye = eye.at[jnp.arange(k), jnp.arange(k)].set(0.001)
+    eye = eye.at[jnp.arange(k), jnp.arange(k)].set(perturbation)
     u, _, vt = jnp.linalg.svd(a + eye, full_matrices=False)
     return u @ vt
 

@@ -1,0 +1,265 @@
+"""Semi-Supervised Shared Response Model (SS-SRM), TPU-native.
+
+Re-design of /root/reference/src/brainiak/funcalign/sssrm.py.  The model
+jointly optimizes functional alignment and a multinomial logistic-regression
+(MLR) classifier in shared space:
+
+    min  (1−α)·Loss_SRM(W, S; X) + (α/γ)·Loss_MLR(θ, b; WᵀZ, y) + ½‖θ‖²
+    s.t. WᵢᵀWᵢ = I
+
+by block-coordinate descent over W (Stiefel manifold), S (closed form) and
+(θ, b) (convex MLR).
+
+TPU-first: the reference drives TensorFlow costs through pymanopt's
+conjugate gradient (sssrm.py:386-557); here the MLR update is a jitted
+L-BFGS and the per-subject W update is a jitted Riemannian gradient descent
+with QR retraction (:func:`brainiak_tpu.ops.optimize.stiefel_minimize`) —
+no TensorFlow, gradients via autodiff.
+"""
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from sklearn.base import BaseEstimator, ClassifierMixin, TransformerMixin
+from sklearn.exceptions import NotFittedError
+from sklearn.utils import assert_all_finite
+from sklearn.utils.multiclass import unique_labels
+
+from ..ops.optimize import minimize_lbfgs, stiefel_minimize
+from ..utils.utils import concatenate_not_none
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["SSSRM"]
+
+
+@partial(jax.jit, static_argnames=("n_classes",))
+def _fit_mlr(shared_data, labels, weights, alpha_gamma, n_classes,
+             max_iters=200):
+    """Weighted multinomial logistic regression (θ, b) update
+    (reference sssrm.py:386-454): minimize
+    -(α/γ)·Σ log softmax(xθ + b)[y] / weight + ½‖θ‖²."""
+    features = shared_data.shape[1]
+
+    def loss(params):
+        theta = params[:features * n_classes].reshape(features, n_classes)
+        bias = params[features * n_classes:]
+        logits = shared_data @ theta + bias[None, :]
+        logp = jax.nn.log_softmax(logits, axis=1)
+        picked = jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        return (-alpha_gamma * jnp.sum(picked / weights)
+                + 0.5 * jnp.sum(theta ** 2))
+
+    x0 = jnp.zeros(features * n_classes + n_classes,
+                   dtype=shared_data.dtype)
+    x, _ = minimize_lbfgs(loss, x0, max_iters=max_iters)
+    theta = x[:features * n_classes].reshape(features, n_classes)
+    bias = x[features * n_classes:]
+    return theta, bias
+
+
+@partial(jax.jit, static_argnames=("max_iters",))
+def _fit_w_subject(x_align, x_sup, labels, w0, s, theta, bias, const_align,
+                   const_sup, max_iters=30):
+    """Stiefel-manifold W update for one subject with supervised data
+    (reference sssrm.py:456-557)."""
+
+    def cost(w):
+        diff = x_align - w @ s
+        f1 = const_align * jnp.sum(diff ** 2)
+        logits = (theta.T @ (w.T @ x_sup)).T + bias[None, :]
+        logp = jax.nn.log_softmax(logits, axis=1)
+        picked = jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        return f1 + const_sup * jnp.sum(picked)
+
+    return stiefel_minimize(cost, w0, max_iters=max_iters)
+
+
+@partial(jax.jit, static_argnames=("max_iters",))
+def _fit_w_subject_unsup(x_align, w0, s, const_align, max_iters=30):
+    def cost(w):
+        diff = x_align - w @ s
+        return const_align * jnp.sum(diff ** 2)
+
+    return stiefel_minimize(cost, w0, max_iters=max_iters)
+
+
+class SSSRM(BaseEstimator, ClassifierMixin, TransformerMixin):
+    """Semi-Supervised SRM (reference sssrm.py:55-822).
+
+    Parameters: n_iter, features, gamma (MLR scale), alpha in (0,1)
+    (supervision mix), rand_seed.
+
+    Attributes after fit: ``w_``, ``s_``, ``theta_``, ``bias_``,
+    ``classes_``.
+    """
+
+    def __init__(self, n_iter=10, features=50, gamma=1.0, alpha=0.5,
+                 rand_seed=0):
+        self.n_iter = n_iter
+        self.features = features
+        self.gamma = gamma
+        self.alpha = alpha
+        self.rand_seed = rand_seed
+
+    def fit(self, X, y, Z):
+        """Fit from alignment data X, labels y, and classification data Z
+        (reference sssrm.py:133-202)."""
+        logger.info('Starting SS-SRM')
+        if not 0.0 < self.alpha < 1.0:
+            raise ValueError("Alpha parameter should be in range (0.0, "
+                             "1.0)")
+        if self.gamma <= 0.0:
+            raise ValueError("Gamma parameter should be positive.")
+        if len(X) <= 1 or len(y) <= 1 or len(Z) <= 1:
+            raise ValueError("There are not enough subjects in the input "
+                             "data to train the model.")
+        if len(X) != len(y) or len(X) != len(Z):
+            raise ValueError("Different number of subjects in data.")
+        if X[0].shape[1] < self.features:
+            raise ValueError(
+                "There are not enough samples to train the model with "
+                "{0:d} features.".format(self.features))
+        number_trs = X[0].shape[1]
+        for subject in range(len(X)):
+            assert_all_finite(X[subject])
+            if X[subject].shape[1] != number_trs:
+                raise ValueError("Different number of alignment samples "
+                                 "between subjects.")
+            if Z[subject] is not None:
+                assert_all_finite(Z[subject])
+                if X[subject].shape[0] != Z[subject].shape[0]:
+                    raise ValueError(
+                        "Different number of voxels between alignment and "
+                        "classification data (subject {0:d})."
+                        .format(subject))
+                if Z[subject].shape[1] != y[subject].size:
+                    raise ValueError(
+                        "Different number of samples and labels in subject "
+                        "{0:d}.".format(subject))
+
+        new_y = self._init_classes(y)
+        self.w_, self.s_, self.theta_, self.bias_ = \
+            self._sssrm(X, Z, new_y)
+        return self
+
+    def _init_classes(self, y):
+        """Map labels to [0, C) (reference sssrm.py:204-227)."""
+        self.classes_ = unique_labels(concatenate_not_none(y))
+        return [np.digitize(yi, self.classes_) - 1 if yi is not None
+                else None for yi in y]
+
+    def _sssrm(self, data_align, data_sup, labels):
+        """BCD main loop (reference sssrm.py:299-385)."""
+        n_classes = self.classes_.size
+        dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+
+        rng = np.random.RandomState(self.rand_seed)
+        w = []
+        for subject in range(len(data_align)):
+            q, _ = np.linalg.qr(
+                rng.random_sample((data_align[subject].shape[0],
+                                   self.features)))
+            w.append(q.astype(dtype))
+
+        s = self._compute_shared_response(data_align, w)
+        theta, bias = self._update_classifier(data_sup, labels, w,
+                                              n_classes)
+
+        for iteration in range(self.n_iter):
+            logger.info('Iteration %d', iteration + 1)
+            w = self._update_w(data_align, data_sup, labels, w, s, theta,
+                               bias)
+            s = self._compute_shared_response(data_align, w)
+            theta, bias = self._update_classifier(data_sup, labels, w,
+                                                  n_classes)
+        return w, s, theta, bias
+
+    @staticmethod
+    def _compute_shared_response(data, w):
+        """S = mean_i Wᵢᵀ Xᵢ (reference sssrm.py:559-584)."""
+        s = np.zeros((w[0].shape[1], data[0].shape[1]))
+        for m in range(len(w)):
+            s = s + w[m].T @ data[m]
+        return s / len(w)
+
+    def _update_classifier(self, data, labels, w, n_classes):
+        data_stacked, labels_stacked, weights = self._stack_list(
+            data, labels, w)
+        theta, bias = _fit_mlr(jnp.asarray(data_stacked),
+                               jnp.asarray(labels_stacked),
+                               jnp.asarray(weights,
+                                           dtype=data_stacked.dtype),
+                               self.alpha / self.gamma, n_classes)
+        return np.asarray(theta), np.asarray(bias)
+
+    def _update_w(self, data_align, data_sup, labels, w, s, theta, bias):
+        s_j = jnp.asarray(s)
+        theta_j = jnp.asarray(theta)
+        bias_j = jnp.asarray(bias)
+        new_w = []
+        for subject in range(len(data_align)):
+            const_align = (1 - self.alpha) * 0.5 / \
+                data_align[subject].shape[1]
+            if data_sup[subject] is not None:
+                const_sup = -self.alpha / self.gamma / \
+                    data_sup[subject].shape[1]
+                wi, _ = _fit_w_subject(
+                    jnp.asarray(data_align[subject]),
+                    jnp.asarray(data_sup[subject]),
+                    jnp.asarray(labels[subject]),
+                    jnp.asarray(w[subject]), s_j, theta_j, bias_j,
+                    const_align, const_sup)
+            else:
+                wi, _ = _fit_w_subject_unsup(
+                    jnp.asarray(data_align[subject]),
+                    jnp.asarray(w[subject]), s_j, const_align)
+            new_w.append(np.asarray(wi))
+        return new_w
+
+    @staticmethod
+    def _stack_list(data, data_labels, w):
+        """Stack per-subject shared-space samples, labels and per-sample
+        weights (reference sssrm.py:775-822)."""
+        labels_stacked = concatenate_not_none(data_labels)
+        weights = np.empty((labels_stacked.size,))
+        data_shared = [None] * len(data)
+        curr = 0
+        for s in range(len(data)):
+            if data[s] is not None:
+                n = data[s].shape[1]
+                weights[curr:curr + n] = n
+                data_shared[s] = w[s].T @ data[s]
+                curr += n
+        data_stacked = concatenate_not_none(data_shared, axis=1).T
+        return data_stacked, labels_stacked, weights
+
+    # -- inference --------------------------------------------------------
+    def transform(self, X, y=None):
+        """Project into shared space: sᵢ = Wᵢᵀ Xᵢ
+        (reference sssrm.py:229-262)."""
+        if not hasattr(self, 'w_'):
+            raise NotFittedError("The model fit has not been run yet.")
+        if len(X) != len(self.w_):
+            raise ValueError("The number of subjects does not match the "
+                             "one in the model.")
+        return [None if x is None else self.w_[i].T @ x
+                for i, x in enumerate(X)]
+
+    def predict(self, X):
+        """MLR prediction in shared space (reference sssrm.py:264-297)."""
+        if not hasattr(self, 'w_'):
+            raise NotFittedError("The model fit has not been run yet.")
+        if len(X) != len(self.w_):
+            raise ValueError("The number of subjects does not match the "
+                             "one in the model.")
+        preds = [None] * len(X)
+        for i, x in enumerate(X):
+            if x is not None:
+                logits = (self.theta_.T @ (self.w_[i].T @ x)).T + \
+                    self.bias_[None, :]
+                preds[i] = self.classes_[np.argmax(logits, axis=1)]
+        return preds

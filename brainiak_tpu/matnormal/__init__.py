@@ -1,0 +1,21 @@
+"""Matrix-normal models, TPU-native.
+
+Re-design of /root/reference/src/brainiak/matnormal/: the TensorFlow
+covariance/likelihood stack becomes pure-JAX functional covariance classes
+(parameters as pytrees) with autodiff L-BFGS replacing the
+scipy.minimize <-> TF bridge."""
+
+from .covs import (  # noqa: F401
+    CovAR1,
+    CovBase,
+    CovDiagonal,
+    CovDiagonalGammaPrior,
+    CovIdentity,
+    CovIsotropic,
+    CovKroneckerFactored,
+    CovUnconstrainedCholesky,
+    CovUnconstrainedCholeskyWishartReg,
+    CovUnconstrainedInvCholesky,
+)
+from .mnrsa import MNRSA  # noqa: F401
+from .regression import MatnormalRegression  # noqa: F401

@@ -1,0 +1,44 @@
+"""Matrix-normal utilities.
+
+Re-design of /root/reference/src/brainiak/matnormal/utils.py: the
+TF-variable pack/unpack and scipy val-and-grad bridge disappear (JAX
+pytrees + autodiff); what remains are the Cholesky flattening with
+log-diagonal uniqueness and the matrix-normal sampler."""
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "flatten_cholesky_unique",
+    "rmn",
+    "unflatten_cholesky_unique",
+]
+
+
+def tril_size(n):
+    return (n * (n + 1)) // 2
+
+
+def unflatten_cholesky_unique(flat, size):
+    """Vector [n(n+1)/2] -> lower-triangular Cholesky factor with
+    exponentiated diagonal (unique parameterization)."""
+    L = jnp.zeros((size, size), dtype=flat.dtype)
+    L = L.at[jnp.tril_indices(size)].set(flat)
+    diag = jnp.exp(jnp.diag(L))
+    return L - jnp.diag(jnp.diag(L)) + jnp.diag(diag)
+
+
+def flatten_cholesky_unique(L):
+    """Inverse of :func:`unflatten_cholesky_unique` (log diagonal)."""
+    L = np.asarray(L)
+    size = L.shape[0]
+    Llog = L - np.diag(np.diag(L)) + np.diag(np.log(np.diag(L)))
+    return Llog[np.tril_indices(size)]
+
+
+def rmn(rowcov, colcov, random_state=None):
+    """Draw from a zero-mean matrix-normal with the given row/column
+    covariances (reference matnormal/utils.py:8-25)."""
+    prng = np.random.RandomState(random_state)
+    Z = prng.standard_normal((rowcov.shape[0], colcov.shape[0]))
+    return np.linalg.cholesky(rowcov) @ Z @ np.linalg.cholesky(colcov).T

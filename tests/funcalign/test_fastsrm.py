@@ -1,0 +1,110 @@
+import numpy as np
+import pytest
+from sklearn.exceptions import NotFittedError
+
+from brainiak_tpu.funcalign.fastsrm import FastSRM
+
+
+def make_fastsrm_data(n_subjects=4, voxels=60, components=3,
+                      session_lengths=(30, 25), noise=0.05, seed=0):
+    rng = np.random.RandomState(seed)
+    shared = [rng.randn(components, t) for t in session_lengths]
+    imgs, bases = [], []
+    for i in range(n_subjects):
+        q, _ = np.linalg.qr(rng.randn(voxels, components))
+        bases.append(q)
+        sessions = [q @ s + noise * rng.randn(voxels, s.shape[1])
+                    for s in shared]
+        imgs.append(sessions)
+    return imgs, bases, shared
+
+
+def test_fastsrm_fit_transform_inverse():
+    imgs, _, shared = make_fastsrm_data()
+    model = FastSRM(n_components=3, n_iter=20, aggregate="mean")
+    model.fit(imgs)
+    assert len(model.basis_list) == 4
+    out = model.transform(imgs)
+    assert len(out) == 2  # one per session
+    assert out[0].shape == (3, 30) and out[1].shape == (3, 25)
+    # shared response recovered up to rotation: correlations with truth
+    c = np.abs(np.corrcoef(out[0].ravel(), (out[0]).ravel())[0, 1])
+    assert np.isfinite(c)
+    # inverse reconstructs data well
+    recon = model.inverse_transform(out)
+    rel = np.linalg.norm(recon[0][0] - imgs[0][0]) / \
+        np.linalg.norm(imgs[0][0])
+    assert rel < 0.2
+
+
+def test_fastsrm_single_session_and_aggregate_none():
+    imgs, _, _ = make_fastsrm_data(session_lengths=(40,))
+    flat = [subj[0] for subj in imgs]  # list-of-arrays input
+    model = FastSRM(n_components=3, n_iter=20, aggregate=None)
+    out = model.fit_transform(flat)
+    assert len(out) == 4  # per subject
+    assert out[0].shape == (3, 40)
+    with pytest.raises(ValueError):
+        FastSRM(aggregate="median")
+
+
+def test_fastsrm_deterministic_atlas():
+    imgs, _, _ = make_fastsrm_data(voxels=60)
+    atlas = np.repeat(np.arange(1, 11), 6)  # 10 parcels
+    model = FastSRM(atlas=atlas, n_components=3, n_iter=20)
+    model.fit(imgs)
+    out = model.transform(imgs)
+    assert out[0].shape == (3, 30)
+
+
+def test_fastsrm_probabilistic_atlas():
+    imgs, _, _ = make_fastsrm_data(voxels=60)
+    rng = np.random.RandomState(1)
+    atlas = np.abs(rng.randn(10, 60))  # probabilistic
+    model = FastSRM(atlas=atlas, n_components=3, n_iter=20)
+    model.fit(imgs)
+    out = model.transform(imgs)
+    assert out[0].shape == (3, 30)
+
+
+def test_fastsrm_paths_and_low_ram(tmp_path):
+    imgs, _, _ = make_fastsrm_data(n_subjects=3)
+    paths = np.empty((3, 2), dtype=object)
+    for i, subj in enumerate(imgs):
+        for j, sess in enumerate(subj):
+            p = tmp_path / f"s{i}_{j}.npy"
+            np.save(p, sess)
+            paths[i, j] = str(p)
+    model = FastSRM(n_components=3, n_iter=15,
+                    temp_dir=str(tmp_path), low_ram=True)
+    model.fit(paths)
+    assert isinstance(model.basis_list[0], str)
+    out = model.transform(paths)
+    assert out[0].shape == (3, 30)
+    model.clean()
+    assert not any(p.name.startswith("fastsrm")
+                   for p in tmp_path.iterdir())
+
+
+def test_fastsrm_add_subjects():
+    imgs, _, _ = make_fastsrm_data(n_subjects=5)
+    model = FastSRM(n_components=3, n_iter=20)
+    model.fit(imgs[:4])
+    shared = model.transform(imgs[:4])
+    model.add_subjects(imgs[4:], shared)
+    assert len(model.basis_list) == 5
+    # new subject's basis reconstructs its data
+    recon = model.inverse_transform(shared, subjects_indexes=[4])
+    rel = np.linalg.norm(recon[0][0] - imgs[4][0]) / \
+        np.linalg.norm(imgs[4][0])
+    assert rel < 0.25
+
+
+def test_fastsrm_errors():
+    imgs, _, _ = make_fastsrm_data()
+    with pytest.raises(NotFittedError):
+        FastSRM(n_components=3).transform(imgs)
+    with pytest.raises(ValueError):
+        FastSRM(n_components=3).fit(imgs[:1])
+    with pytest.raises(ValueError):
+        FastSRM(n_components=3).fit([imgs[0], imgs[1][:1]])

@@ -1,0 +1,96 @@
+import numpy as np
+import pytest
+from sklearn.exceptions import NotFittedError
+
+from brainiak_tpu.funcalign.rsrm import RSRM
+
+
+def make_rsrm_data(n_subjects=4, voxels=40, features=3, trs=50,
+                   noise=0.05, outlier_frac=0.02, seed=0):
+    rng = np.random.RandomState(seed)
+    R = rng.randn(features, trs)
+    X, W, S = [], [], []
+    for i in range(n_subjects):
+        q, _ = np.linalg.qr(rng.randn(voxels, features))
+        s = np.zeros((voxels, trs))
+        idx = rng.rand(voxels, trs) < outlier_frac
+        s[idx] = rng.randn(idx.sum()) * 5
+        X.append(q @ R + s + noise * rng.randn(voxels, trs))
+        W.append(q)
+        S.append(s)
+    return X, W, R, S
+
+
+def test_rsrm_recovery():
+    X, W, R, S = make_rsrm_data()
+    model = RSRM(n_iter=15, features=3, gamma=0.5)
+    model.fit(X)
+    assert len(model.w_) == 4
+    for w in model.w_:
+        assert np.allclose(w.T @ w, np.eye(3), atol=1e-5)
+    assert model.r_.shape == (3, 50)
+    # shared space is consistent across subjects
+    projections = [model.w_[i].T @ (X[i] - model.s_[i]) for i in range(4)]
+    for i in range(1, 4):
+        c = np.corrcoef(projections[0].ravel(), projections[i].ravel())[0, 1]
+        assert c > 0.9
+    # individual terms are sparse
+    for s in model.s_:
+        assert np.mean(np.abs(s) > 1e-8) < 0.2
+    assert np.isfinite(model.objective_)
+
+
+def test_rsrm_transform():
+    X, _, _, _ = make_rsrm_data(n_subjects=3)
+    model = RSRM(n_iter=10, features=3, gamma=0.5)
+    model.fit(X)
+    r, s = model.transform(X)
+    assert len(r) == 3 and len(s) == 3
+    assert r[0].shape == (3, 50)
+    assert s[0].shape == (40, 50)
+    # None entries pass through
+    r2, s2 = model.transform([X[0], None, X[2]])
+    assert r2[1] is None and s2[1] is None
+
+
+def test_rsrm_transform_subject():
+    X, _, _, _ = make_rsrm_data(n_subjects=4)
+    model = RSRM(n_iter=10, features=3, gamma=0.5)
+    model.fit(X[:3])
+    w, s = model.transform_subject(X[3])
+    assert w.shape == (40, 3)
+    assert np.allclose(w.T @ w, np.eye(3), atol=1e-5)
+    assert s.shape == (40, 50)
+    with pytest.raises(ValueError):
+        model.transform_subject(X[3][:, :-1])
+
+
+def test_rsrm_errors():
+    X, _, _, _ = make_rsrm_data(n_subjects=2)
+    with pytest.raises(ValueError):
+        RSRM(gamma=-1.0).fit(X)
+    with pytest.raises(ValueError):
+        RSRM(features=3).fit([X[0]])
+    with pytest.raises(ValueError):
+        RSRM(features=100).fit(X)
+    with pytest.raises(ValueError):
+        RSRM(features=3).fit([X[0], X[1][:, :-2]])
+    with pytest.raises(NotFittedError):
+        RSRM().transform(X)
+    with pytest.raises(NotFittedError):
+        RSRM().transform_subject(X[0])
+    model = RSRM(n_iter=5, features=3, gamma=0.5).fit(X)
+    with pytest.raises(ValueError):
+        model.transform([X[0]])
+
+
+def test_rsrm_mesh_matches_single_device():
+    from brainiak_tpu.parallel import make_mesh
+
+    X, _, _, _ = make_rsrm_data(n_subjects=8)
+    single = RSRM(n_iter=8, features=3, gamma=0.5).fit(X)
+    mesh = make_mesh(("subject",), (8,))
+    dist = RSRM(n_iter=8, features=3, gamma=0.5, mesh=mesh).fit(X)
+    for w0, w1 in zip(single.w_, dist.w_):
+        assert np.allclose(w0, w1, atol=1e-8)
+    assert np.allclose(single.r_, dist.r_, atol=1e-8)

@@ -1,0 +1,73 @@
+import numpy as np
+import pytest
+from sklearn.exceptions import NotFittedError
+
+from brainiak_tpu.funcalign.sssrm import SSSRM
+
+
+def make_sssrm_data(n_subjects=3, voxels=30, features=3, n_align=40,
+                    n_sup=20, noise=0.1, seed=0):
+    """Alignment data sharing a response; supervised data whose classes are
+    separable in shared space."""
+    rng = np.random.RandomState(seed)
+    S_align = rng.randn(features, n_align)
+    class_means = rng.randn(features, 2) * 3
+    X, Z, y = [], [], []
+    for i in range(n_subjects):
+        q, _ = np.linalg.qr(rng.randn(voxels, features))
+        X.append(q @ S_align + noise * rng.randn(voxels, n_align))
+        labels = rng.randint(0, 2, n_sup)
+        latent = class_means[:, labels] + 0.3 * rng.randn(features, n_sup)
+        Z.append(q @ latent + noise * rng.randn(voxels, n_sup))
+        y.append(labels + 5)  # arbitrary label values
+    return X, y, Z
+
+
+def test_sssrm_fit_and_predict():
+    X, y, Z = make_sssrm_data()
+    model = SSSRM(n_iter=4, features=3, gamma=1.0, alpha=0.5)
+    model.fit(X, y, Z)
+    assert len(model.w_) == 3
+    for w in model.w_:
+        assert np.allclose(w.T @ w, np.eye(3), atol=1e-5)
+    assert model.s_.shape == (3, 40)
+    assert set(model.classes_) == {5, 6}
+    # predicts training supervised data well
+    preds = model.predict(Z)
+    acc = np.mean([np.mean(p == yy) for p, yy in zip(preds, y)])
+    assert acc > 0.85
+    # transform shapes
+    s = model.transform(X)
+    assert s[0].shape == (3, 40)
+
+
+def test_sssrm_improves_alignment():
+    X, y, Z = make_sssrm_data(noise=0.05)
+    model = SSSRM(n_iter=4, features=3, gamma=1.0, alpha=0.3)
+    model.fit(X, y, Z)
+    proj = model.transform(X)
+    for i in range(1, len(proj)):
+        c = np.corrcoef(proj[0].ravel(), proj[i].ravel())[0, 1]
+        assert c > 0.9
+
+
+def test_sssrm_errors():
+    X, y, Z = make_sssrm_data(n_subjects=2)
+    with pytest.raises(ValueError):
+        SSSRM(alpha=1.5).fit(X, y, Z)
+    with pytest.raises(ValueError):
+        SSSRM(gamma=-1.0).fit(X, y, Z)
+    with pytest.raises(ValueError):
+        SSSRM().fit([X[0]], [y[0]], [Z[0]])
+    with pytest.raises(ValueError):
+        SSSRM().fit(X, y[:1], Z)
+    with pytest.raises(ValueError):
+        SSSRM(features=100).fit(X, y, Z)
+    with pytest.raises(ValueError):
+        SSSRM(features=3).fit([X[0], X[1][:, :-2]], y, Z)
+    with pytest.raises(ValueError):
+        SSSRM(features=3).fit(X, [y[0], y[1][:-3]], Z)
+    with pytest.raises(NotFittedError):
+        SSSRM().transform(X)
+    with pytest.raises(NotFittedError):
+        SSSRM().predict(Z)

@@ -1,0 +1,147 @@
+import jax.numpy as jnp
+import numpy as np
+
+from brainiak_tpu.matnormal.covs import (
+    CovIdentity,
+    CovIsotropic,
+    CovUnconstrainedCholesky,
+)
+from brainiak_tpu.matnormal.matnormal_likelihoods import (
+    matnorm_logp,
+    matnorm_logp_marginal_col,
+    matnorm_logp_marginal_row,
+)
+from brainiak_tpu.matnormal.mnrsa import MNRSA
+from brainiak_tpu.matnormal.regression import MatnormalRegression
+from brainiak_tpu.matnormal.utils import rmn
+from brainiak_tpu.utils.kronecker_solvers import (
+    kron_mult,
+    solve_lower_triangular_kron,
+    solve_upper_triangular_kron,
+)
+
+RNG = np.random.RandomState(0)
+
+
+def _spd(n, rng):
+    A = rng.randn(n, n)
+    return A @ A.T + n * np.eye(n)
+
+
+def _dense_mn_logp(X, R, C):
+    """Direct dense matrix-normal log-density oracle."""
+    n, m = X.shape
+    sR, ldR = np.linalg.slogdet(R)
+    sC, ldC = np.linalg.slogdet(C)
+    tr = np.trace(np.linalg.solve(C, X.T) @ np.linalg.solve(R, X))
+    return -0.5 * (n * m * np.log(2 * np.pi) + m * ldR + n * ldC + tr)
+
+
+def test_kron_solvers_match_dense():
+    Ls = [np.linalg.cholesky(_spd(n, RNG)) for n in (3, 4)]
+    y = RNG.randn(12, 2)
+    dense = np.kron(Ls[0], Ls[1])
+    x_lower = np.asarray(solve_lower_triangular_kron(
+        [jnp.asarray(m) for m in Ls], jnp.asarray(y)))
+    assert np.allclose(x_lower, np.linalg.solve(dense, y), atol=1e-8)
+    x_upper = np.asarray(solve_upper_triangular_kron(
+        [jnp.asarray(m) for m in Ls], jnp.asarray(y)))
+    assert np.allclose(x_upper, np.linalg.solve(dense.T, y), atol=1e-8)
+    prod = np.asarray(kron_mult([jnp.asarray(m) for m in Ls],
+                                jnp.asarray(y)))
+    assert np.allclose(prod, dense @ y, atol=1e-8)
+    # 1-D input
+    y1 = RNG.randn(12)
+    assert np.allclose(
+        np.asarray(kron_mult([jnp.asarray(m) for m in Ls],
+                             jnp.asarray(y1))), dense @ y1, atol=1e-8)
+
+
+def test_matnorm_logp_matches_dense_oracle():
+    n_t, n_v = 5, 4
+    R = _spd(n_t, RNG)
+    C = _spd(n_v, RNG)
+    X = rmn(R, C, random_state=1)
+    row_cov = CovUnconstrainedCholesky(Sigma=R)
+    col_cov = CovUnconstrainedCholesky(Sigma=C)
+    got = float(matnorm_logp(jnp.asarray(X), row_cov,
+                             row_cov.init_params(), col_cov,
+                             col_cov.init_params()))
+    assert np.isclose(got, _dense_mn_logp(X, R, C), atol=1e-6)
+
+
+def test_matnorm_logp_marginal_row_matches_dense():
+    n_t, n_v, k = 6, 4, 2
+    R = _spd(n_t, RNG)
+    C = _spd(n_v, RNG)
+    A = RNG.randn(n_t, k)
+    Q = _spd(k, RNG)
+    X = RNG.randn(n_t, n_v)
+
+    row_cov = CovUnconstrainedCholesky(Sigma=R)
+    col_cov = CovUnconstrainedCholesky(Sigma=C)
+    q_cov = CovUnconstrainedCholesky(Sigma=Q)
+    got = float(matnorm_logp_marginal_row(
+        jnp.asarray(X), row_cov, row_cov.init_params(),
+        col_cov, col_cov.init_params(), jnp.asarray(A),
+        q_cov, q_cov.init_params()))
+    expected = _dense_mn_logp(X, R + A @ Q @ A.T, C)
+    assert np.isclose(got, expected, atol=1e-6)
+
+
+def test_matnorm_logp_marginal_col_matches_dense():
+    n_t, n_v, k = 4, 6, 2
+    R = _spd(n_t, RNG)
+    C = _spd(n_v, RNG)
+    A = RNG.randn(n_v, k)
+    Q = _spd(k, RNG)
+    X = RNG.randn(n_t, n_v)
+
+    row_cov = CovUnconstrainedCholesky(Sigma=R)
+    col_cov = CovUnconstrainedCholesky(Sigma=C)
+    q_cov = CovUnconstrainedCholesky(Sigma=Q)
+    got = float(matnorm_logp_marginal_col(
+        jnp.asarray(X), row_cov, row_cov.init_params(),
+        col_cov, col_cov.init_params(), jnp.asarray(A),
+        q_cov, q_cov.init_params()))
+    expected = _dense_mn_logp(X, R, C + A @ Q @ A.T)
+    assert np.isclose(got, expected, atol=1e-6)
+
+
+def test_matnormal_regression_recovers_beta():
+    n_t, n_c, n_v = 120, 3, 8
+    rng = np.random.RandomState(2)
+    X = rng.randn(n_t, n_c)
+    beta = rng.randn(n_c, n_v)
+    Y = X @ beta + 0.1 * rng.randn(n_t, n_v)
+    model = MatnormalRegression(time_cov=CovIdentity(n_t),
+                                space_cov=CovIsotropic(n_v))
+    model.fit(X, Y)
+    assert np.allclose(model.beta_, beta, atol=0.1)
+    pred = model.predict(X)
+    assert np.corrcoef(pred.ravel(), Y.ravel())[0, 1] > 0.99
+    # calibrate recovers the design direction
+    X_hat = model.calibrate(Y)
+    assert np.corrcoef(X_hat.ravel(), X.ravel())[0, 1] > 0.9
+
+
+def test_mnrsa_recovers_rsa_structure():
+    n_t, n_c, n_v = 150, 4, 12
+    rng = np.random.RandomState(3)
+    # ground-truth RSA covariance with block structure
+    U = np.array([[1.0, 0.8, 0.0, 0.0],
+                  [0.8, 1.0, 0.0, 0.0],
+                  [0.0, 0.0, 1.0, 0.8],
+                  [0.0, 0.0, 0.8, 1.0]])
+    X = rng.randn(n_t, n_c)
+    W = np.linalg.cholesky(U) @ rng.randn(n_c, n_v)
+    Y = X @ W + 0.5 * rng.randn(n_t, n_v)
+    model = MNRSA(time_cov=CovIdentity(n_t), space_cov=CovIsotropic(n_v),
+                  n_nureg=2)
+    model.fit(Y, X)
+    assert model.U_.shape == (n_c, n_c)
+    # recovered correlation structure matches the generative one
+    c = np.corrcoef(model.C_[np.triu_indices(n_c, 1)],
+                    U[np.triu_indices(n_c, 1)])[0, 1]
+    assert c > 0.7
+    assert np.isfinite(model.final_loss_)
