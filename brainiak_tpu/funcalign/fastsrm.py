@@ -116,8 +116,10 @@ class FastSRM(BaseEstimator, TransformerMixin):
             return None, np.linalg.pinv(atlas)  # probabilistic
         return atlas, None
 
-    def _maybe_spill(self, array, name):
-        if self.temp_dir is not None and self.low_ram:
+    def _maybe_spill(self, array, name, bases=False):
+        # bases spill whenever temp_dir is set; reduced data only under
+        # low_ram (reference fastsrm.py:592-676, :879-923)
+        if self.temp_dir is not None and (bases or self.low_ram):
             os.makedirs(self.temp_dir, exist_ok=True)
             path = os.path.join(self.temp_dir, name + ".npy")
             np.save(path, array)
@@ -186,7 +188,7 @@ class FastSRM(BaseEstimator, TransformerMixin):
         for i in range(n_subjects):
             basis = self._compute_basis(imgs[i], shared_sessions)
             self.basis_list.append(
-                self._maybe_spill(basis, f"basis_{i}"))
+                self._maybe_spill(basis, f"basis_{i}", bases=True))
         return self
 
     def transform(self, imgs, subjects_indexes=None):
@@ -253,9 +255,9 @@ class FastSRM(BaseEstimator, TransformerMixin):
         single = isinstance(shared_response, np.ndarray)
         shared = [shared_response.T] if single else \
             [s.T for s in shared_response]
-        for pos, subj in enumerate(imgs):
+        for subj in imgs:
             basis = self._compute_basis(subj, shared)
             self.basis_list.append(
-                self._maybe_spill(basis,
-                                  f"basis_{len(self.basis_list)}"))
+                self._maybe_spill(basis, f"basis_{len(self.basis_list)}",
+                                  bases=True))
         return self

@@ -1,0 +1,3 @@
+"""Bayesian hyperparameter optimization (TPE-style)."""
+
+from .hpo import fmin, get_next_sample, get_sigma, gmm_1d_distribution  # noqa: F401,E501

@@ -1,0 +1,3 @@
+"""Inverted encoding models (1D and 2D feature reconstruction)."""
+
+from .iem import InvertedEncoding1D, InvertedEncoding2D  # noqa: F401

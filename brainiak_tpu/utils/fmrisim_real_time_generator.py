@@ -1,0 +1,236 @@
+"""Real-time fMRI data generator (the framework's one CLI).
+
+Re-design of /root/reference/src/brainiak/utils/fmrisim_real_time_generator.py:
+streams simulated TR-by-TR volumes to disk for testing real-time analysis
+pipelines.  Differences from the reference: inputs that the reference ships
+as packaged files (ROIs, template, noise dict) are synthesized when not
+provided; DICOM output requires pydicom and raises a clear error when it is
+absent (it is an optional dependency there too).
+
+Run as ``python -m brainiak_tpu.utils.fmrisim_real_time_generator -o DIR``.
+"""
+
+import argparse
+import logging
+import os
+import time
+from pathlib import Path
+
+import numpy as np
+
+from . import fmrisim as sim
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["generate_data", "default_settings"]
+
+default_settings = {
+    'ROI_A_file': None,
+    'ROI_B_file': None,
+    'template_path': None,
+    'noise_dict_file': None,
+    'numTRs': 200,
+    'event_duration': 10,
+    'scale_percentage': 0.5,
+    'multivariate_pattern': False,
+    'different_ROIs': False,
+    'save_dicom': False,
+    'save_realtime': False,
+    'trDuration': 2,
+    'isi': 6,
+    'burn_in': 6,
+}
+
+
+def _default_inputs(data_dict):
+    """Synthesize template/ROIs/noise parameters when not supplied
+    (the reference loads packaged files, fmrisim_real_time_generator
+    .py:117-186)."""
+    dims = np.array([24, 24, 16])
+    if data_dict['template_path'] is None:
+        _, template = sim.mask_brain(dims, mask_self=False)
+        template = template * 1000
+    else:
+        template = np.load(data_dict['template_path'])
+        dims = np.array(template.shape[:3])
+
+    def roi(center):
+        vol = sim.generate_signal(dimensions=dims,
+                                  feature_coordinates=np.array([center]),
+                                  feature_type=['cube'],
+                                  feature_size=[4],
+                                  signal_magnitude=[1])
+        return vol
+
+    roi_a = np.load(data_dict['ROI_A_file']) \
+        if data_dict['ROI_A_file'] else roi([8, 8, 8])
+    roi_b = np.load(data_dict['ROI_B_file']) \
+        if data_dict['ROI_B_file'] else roi([16, 16, 8])
+
+    if data_dict['noise_dict_file']:
+        with open(data_dict['noise_dict_file']) as f:
+            noise_dict = eval(f.read())  # reference behavior
+    else:
+        noise_dict = {'snr': 30, 'sfnr': 70, 'max_activity': 1000,
+                      'matched': 0}
+    return roi_a, roi_b, template, noise_dict, dims
+
+
+def _save_volume(volume, out_file, save_dicom):
+    if save_dicom:
+        try:
+            import pydicom  # noqa: F401
+        except ImportError:
+            raise ImportError(
+                "DICOM output requires pydicom, which is not installed; "
+                "use save_dicom=False for .npy output")
+        _write_dicom(volume, out_file)
+    else:
+        np.save(out_file, volume.astype(np.int16))
+
+
+def _write_dicom(volume, out_file):
+    """Minimal secondary-capture DICOM writer (reference
+    fmrisim_real_time_generator.py:187-265)."""
+    import pydicom
+    from pydicom.dataset import FileDataset, FileMetaDataset
+
+    meta = FileMetaDataset()
+    meta.MediaStorageSOPClassUID = \
+        pydicom.uid.SecondaryCaptureImageStorage
+    meta.MediaStorageSOPInstanceUID = pydicom.uid.generate_uid()
+    meta.TransferSyntaxUID = pydicom.uid.ImplicitVRLittleEndian
+    ds = FileDataset(out_file, {}, file_meta=meta, preamble=b"\0" * 128)
+    ds.NumberOfFrames = volume.shape[2]
+    ds.Rows = volume.shape[0]
+    ds.Columns = volume.shape[1]
+    ds.SamplesPerPixel = 1
+    ds.BitsAllocated = 16
+    ds.BitsStored = 16
+    ds.HighBit = 15
+    ds.PixelRepresentation = 0
+    ds.PhotometricInterpretation = "MONOCHROME2"
+    ds.PixelData = volume.astype(np.uint16).tobytes()
+    ds.save_as(out_file, write_like_original=False)
+
+
+def generate_data(outputDir, user_settings):
+    """Generate and stream simulated realtime data to ``outputDir``
+    (reference fmrisim_real_time_generator.py:349-533).
+
+    Writes mask.npy, labels.npy, and one rt_<TR>.npy (or .dcm) per TR.
+    """
+    data_dict = default_settings.copy()
+    data_dict.update(user_settings)
+    Path(outputDir).mkdir(parents=True, exist_ok=True)
+
+    roi_a, roi_b, template, noise_dict, dims = _default_inputs(data_dict)
+    mask, template = sim.mask_brain(volume=template, mask_self=True)
+    np.save(os.path.join(outputDir, 'mask.npy'), mask.astype(np.uint8))
+
+    noise_dict['matched'] = 0
+    num_trs = data_dict['numTRs']
+    tr_dur = data_dict['trDuration']
+    logger.info('Generating noise')
+    noise = sim.generate_noise(
+        dimensions=dims,
+        stimfunction_tr=np.zeros((num_trs, 1)),
+        tr_duration=int(tr_dur),
+        template=template,
+        mask=mask,
+        noise_dict=noise_dict)
+
+    total_time = int(num_trs * tr_dur)
+    onsets_a, onsets_b = [], []
+    curr_time = data_dict['burn_in']
+    while curr_time < total_time - data_dict['event_duration']:
+        (onsets_a if np.random.randint(0, 2) == 1
+         else onsets_b).append(curr_time)
+        curr_time += data_dict['event_duration'] + data_dict['isi']
+
+    temporal_res = 1 / tr_dur
+    stimfunc_a = sim.generate_stimfunction(
+        onsets=onsets_a, event_durations=[data_dict['event_duration']],
+        total_time=total_time, temporal_resolution=temporal_res)
+    stimfunc_b = sim.generate_stimfunction(
+        onsets=onsets_b, event_durations=[data_dict['event_duration']],
+        total_time=total_time, temporal_resolution=temporal_res)
+    np.save(os.path.join(outputDir, 'labels.npy'),
+            stimfunc_a + stimfunc_b * 2)
+
+    def roi_signal(roi_vol, stimfunc, scale):
+        """Evoked signal within an ROI scaled as percent signal change."""
+        sf = sim.convolve_hrf(stimfunc, tr_dur,
+                              temporal_resolution=temporal_res)
+        n_vox = int((roi_vol > 0).sum())
+        if data_dict['multivariate_pattern']:
+            pattern = np.random.rand(1, n_vox)
+            sf = sf @ pattern
+        sig_func = np.tile(sf, (1, n_vox)) if sf.shape[1] == 1 else sf
+        noise_fn = noise[roi_vol > 0].T
+        sig_func = sim.compute_signal_change(
+            sig_func, noise_fn, noise_dict, [scale], 'PSC')
+        return sim.apply_signal(sig_func, roi_vol)
+
+    scale = data_dict['scale_percentage']
+    signal_a = roi_signal(roi_a, stimfunc_a, scale)
+    if data_dict['different_ROIs']:
+        signal_b = roi_signal(roi_b, stimfunc_b, scale)
+    elif data_dict['multivariate_pattern']:
+        signal_b = roi_signal(roi_a, stimfunc_b, scale)
+    else:
+        signal_b = roi_signal(roi_a, stimfunc_b, scale * 0.5)
+
+    brain = noise + signal_a + signal_b
+    for tr in range(num_trs):
+        start = time.time()
+        vol = brain[:, :, :, tr]
+        ext = 'dcm' if data_dict['save_dicom'] else 'npy'
+        out_file = os.path.join(outputDir, 'rt_{0:0>3}.{1}'.format(tr, ext))
+        _save_volume(vol, out_file, data_dict['save_dicom'])
+        if data_dict['save_realtime']:
+            elapsed = time.time() - start
+            time.sleep(max(0.0, tr_dur - elapsed))
+    logger.info('Generated %d volumes in %s', num_trs, outputDir)
+
+
+def main():
+    p = argparse.ArgumentParser(description="Generate simulated realtime "
+                                            "fMRI data")
+    p.add_argument('--output-dir', '-o', required=True, type=str)
+    p.add_argument('--ROI-A-file', default=None, type=str)
+    p.add_argument('--ROI-B-file', default=None, type=str)
+    p.add_argument('--template-path', default=None, type=str)
+    p.add_argument('--noise-dict-file', default=None, type=str)
+    p.add_argument('--numTRs', '-n', default=200, type=int)
+    p.add_argument('--event-duration', '-d', default=10, type=int)
+    p.add_argument('--trDuration', default=2, type=int)
+    p.add_argument('--isi', default=6, type=int)
+    p.add_argument('--burn-in', default=6, type=int)
+    p.add_argument('--scale-percentage', '-s', default=0.5, type=float)
+    p.add_argument('--multivariate-pattern', '-m', action='store_true')
+    p.add_argument('--different-ROIs', '-r', action='store_true')
+    p.add_argument('--save-dicom', action='store_true')
+    p.add_argument('--save-realtime', action='store_true')
+    args = p.parse_args()
+    settings = {
+        'ROI_A_file': args.ROI_A_file,
+        'ROI_B_file': args.ROI_B_file,
+        'template_path': args.template_path,
+        'noise_dict_file': args.noise_dict_file,
+        'numTRs': args.numTRs,
+        'event_duration': args.event_duration,
+        'trDuration': args.trDuration,
+        'isi': args.isi,
+        'burn_in': args.burn_in,
+        'scale_percentage': args.scale_percentage,
+        'multivariate_pattern': args.multivariate_pattern,
+        'different_ROIs': args.different_ROIs,
+        'save_dicom': args.save_dicom,
+        'save_realtime': args.save_realtime,
+    }
+    generate_data(args.output_dir, settings)
+
+
+if __name__ == "__main__":
+    main()

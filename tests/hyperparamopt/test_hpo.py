@@ -1,0 +1,81 @@
+import numpy as np
+import pytest
+import scipy.stats as st
+
+from brainiak_tpu.hyperparamopt.hpo import (
+    fmin,
+    get_next_sample,
+    get_sigma,
+    gmm_1d_distribution,
+)
+
+
+def test_get_sigma():
+    x = np.array([1.0, 2.0, 5.0])
+    sigma = get_sigma(x, min_limit=0.0, max_limit=6.0)
+    # farthest of the two nearest neighbors
+    assert np.allclose(sigma, [1.0, 3.0, 3.0])
+    # unbounded: infinities fall back to the nearer gap
+    sigma_u = get_sigma(np.array([1.0]), min_limit=0.0)
+    assert sigma_u[0] == 1.0
+
+
+def test_gmm_pdf_and_samples():
+    np.random.seed(0)
+    x = np.array([0.2, 0.5, 0.8])
+    gmm = gmm_1d_distribution(x, min_limit=0.0, max_limit=1.0)
+    # pdf is positive inside, zero outside
+    assert gmm(0.5) > 0
+    assert gmm(-0.1) == 0 and gmm(1.1) == 0
+    vals = gmm(np.array([0.1, 0.5, 2.0]))
+    assert vals.shape == (3,) and vals[2] == 0
+    # each truncation-corrected component integrates to 1, so the mixture
+    # integrates to N / W_sum (the reference's normalization behaves
+    # identically)
+    grid = np.linspace(0, 1, 2000)
+    integral = np.trapezoid(gmm(grid), grid)
+    assert np.isclose(integral, gmm.N / gmm.W_sum, atol=0.01)
+    samples = gmm.get_samples(500)
+    assert samples.shape == (500,)
+    assert np.all((samples >= 0) & (samples <= 1))
+
+
+def test_get_next_sample_prefers_good_region():
+    np.random.seed(1)
+    # loss minimized near x=0.3
+    x = np.random.rand(40)
+    y = (x - 0.3) ** 2
+    nxt = get_next_sample(x, y, min_limit=0.0, max_limit=1.0)
+    assert 0.0 <= nxt <= 1.0
+    assert abs(nxt - 0.3) < 0.25
+
+
+def test_fmin_minimizes_quadratic():
+    np.random.seed(2)
+
+    def loss(params):
+        return (params['x'] - 0.7) ** 2
+
+    space = {'x': {'dist': st.uniform(0, 1), 'lo': 0, 'hi': 1}}
+    trials = []
+    best = fmin(loss, space, max_evals=60, trials=trials,
+                init_random_evals=15)
+    assert len(trials) == 60
+    assert abs(best['x'] - 0.7) < 0.1
+    assert best['loss'] < 0.01
+
+
+def test_fmin_validation_and_seeding():
+    def loss(params):
+        return params['x'] ** 2
+
+    with pytest.raises(ValueError):
+        fmin(loss, {'x': {'dist': "not-a-dist"}}, 5, [])
+    # pre-seeded trials skip random init
+    np.random.seed(3)
+    trials = [{'x': v, 'loss': v ** 2}
+              for v in np.linspace(-1, 1, 40)]
+    best = fmin(loss, {'x': {'dist': st.uniform(-1, 2), 'lo': -1,
+                             'hi': 1}},
+                max_evals=10, trials=trials)
+    assert abs(best['x']) < 0.2

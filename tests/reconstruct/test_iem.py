@@ -1,0 +1,114 @@
+import numpy as np
+import pytest
+
+from brainiak_tpu.reconstruct.iem import (
+    InvertedEncoding1D,
+    InvertedEncoding2D,
+)
+
+
+def make_1d_data(n_per=12, n_voxels=30, noise=0.2, seed=0,
+                 mode='halfcircular'):
+    """Voxels with random tuning to the feature domain."""
+    rng = np.random.RandomState(seed)
+    span = 180.0 if mode == 'halfcircular' else 360.0
+    features = np.repeat(np.linspace(0, span - span / 6, 6), n_per)
+    prefs = rng.rand(n_voxels) * span
+    factor = 2.0 if mode == 'halfcircular' else 1.0
+    tuning = np.cos(np.deg2rad(factor * (features[:, None]
+                                         - prefs[None, :]))) ** 2
+    X = tuning + noise * rng.randn(len(features), n_voxels)
+    return X, features
+
+
+def test_iem1d_recovers_features():
+    X, y = make_1d_data()
+    model = InvertedEncoding1D(n_channels=6, channel_exp=5,
+                               stimulus_mode='halfcircular')
+    model.fit(X, y)
+    pred = model.predict(X)
+    err = np.abs(((pred - y) + 90) % 180 - 90)
+    assert np.median(err) < 20
+    score = model.score(X, y)
+    assert score > 0.5
+
+
+def test_iem1d_circular():
+    X, y = make_1d_data(mode='circular')
+    model = InvertedEncoding1D(n_channels=6, channel_exp=5,
+                               stimulus_mode='circular',
+                               range_stop=360.)
+    model.fit(X, y)
+    pred = model.predict(X)
+    err = np.abs(((pred - y) + 180) % 360 - 180)
+    assert np.median(err) < 40
+
+
+def test_iem1d_validation():
+    X, y = make_1d_data()
+    with pytest.raises(ValueError):
+        InvertedEncoding1D(range_start=100, range_stop=80)
+    with pytest.raises(ValueError):
+        InvertedEncoding1D(stimulus_mode='halfcircular', range_stop=90.)
+    with pytest.raises(ValueError):
+        InvertedEncoding1D(stimulus_mode='circular', range_stop=180.)
+    with pytest.raises(ValueError):
+        InvertedEncoding1D(n_channels=1)
+    with pytest.raises(ValueError):
+        InvertedEncoding1D(stimulus_mode='oval')
+    model = InvertedEncoding1D()
+    with pytest.raises(ValueError):
+        model.fit(X[:3], y[:3])  # fewer trials than channels
+    with pytest.raises(ValueError):
+        model.fit(X, y[:-2])
+    params = model.get_params()
+    assert params["n_channels"] == 6
+    model.set_params(n_channels=8)
+    assert model.n_channels == 8
+
+
+def test_iem2d_recovers_positions():
+    rng = np.random.RandomState(1)
+    n_trials, n_voxels = 60, 20
+    centers = rng.rand(n_trials, 2) * 8 + 1  # inside [1, 9]
+    model = InvertedEncoding2D(stim_xlim=[0, 10], stim_ylim=[0, 10],
+                               stimulus_resolution=20, stim_radius=1.5)
+    channels, chan_centers = model.define_basis_functions_sqgrid(5)
+    assert channels.shape[0] == 25
+    # voxels = random linear combination of channel responses
+    C = model._define_trial_activations(centers)
+    W = rng.rand(n_voxels, 25)
+    X = C @ W.T + 0.1 * rng.randn(n_trials, n_voxels)
+    model.fit(X, centers)
+    pred = model.predict(X)
+    err = np.linalg.norm(pred - centers, axis=1)
+    assert np.median(err) < 2.0
+    scores = model.score(X, centers)
+    assert np.mean(scores) > 0.0
+    # reconstruction-space scoring
+    recon = model.predict_feature_responses(X)
+    d = model.score_against_reconstructed(X, recon[:, :1])
+    assert d.shape == (n_trials,)
+
+
+def test_iem2d_trigrid_and_validation():
+    model = InvertedEncoding2D(stim_xlim=[0, 10], stim_ylim=[0, 10],
+                               stimulus_resolution=15, stim_radius=1.0)
+    channels, centers = model.define_basis_functions_trigrid(3)
+    assert channels.shape[1] == 15 * 15
+    assert centers.shape[1] == 2
+    with pytest.raises(ValueError):
+        InvertedEncoding2D(stim_xlim=[10, 0], stim_ylim=[0, 10],
+                           stimulus_resolution=10)
+    with pytest.raises(ValueError):
+        InvertedEncoding2D(stim_xlim=5, stim_ylim=[0, 10],
+                           stimulus_resolution=10)
+    m2 = InvertedEncoding2D(stim_xlim=[0, 10], stim_ylim=[0, 10],
+                            stimulus_resolution=10)
+    with pytest.raises(ValueError):
+        m2.fit(np.random.rand(20, 5), np.random.rand(20, 2))  # no channels
+    with pytest.raises(ValueError):
+        m3 = InvertedEncoding2D(stim_xlim=[0, 10], stim_ylim=[0, 10],
+                                stimulus_resolution=10)
+        m3.define_basis_functions_sqgrid(4)
+        m3._define_trial_activations(np.random.rand(5, 2))  # no radius

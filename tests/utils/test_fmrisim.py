@@ -1,0 +1,243 @@
+import math
+
+import numpy as np
+import pytest
+
+import brainiak_tpu.utils.fmrisim as sim
+
+
+def test_generate_signal():
+    dimensions = np.array([10, 10, 10])
+    volume = sim.generate_signal(dimensions=dimensions,
+                                 feature_coordinates=np.array([[5, 5, 5]]),
+                                 feature_type=['cube'],
+                                 feature_size=[3],
+                                 signal_magnitude=[30])
+    assert np.all(volume.shape == dimensions)
+    assert np.max(volume) == 30
+    assert np.sum(volume > 0) == math.pow(3, 3)
+    assert volume[5, 5, 5] == 30
+    assert volume[5, 5, 1] == 0
+
+    coords = np.array([[5, 5, 5], [3, 3, 3], [7, 7, 7]])
+    volume = sim.generate_signal(dimensions=dimensions,
+                                 feature_coordinates=coords,
+                                 feature_type=['loop', 'cavity', 'sphere'],
+                                 feature_size=[3],
+                                 signal_magnitude=[30])
+    assert volume[5, 5, 5] == 0, "Loop is empty"
+    assert volume[3, 3, 3] == 0, "Cavity is empty"
+    assert volume[7, 7, 7] != 0, "Sphere is not empty"
+
+    # out-of-bounds corrections
+    x, y, z = sim._insert_idxs(np.array([0, 2, 10]), 3, dimensions)
+    assert x[1] - x[0] == 2
+    assert y[1] - y[0] == 3
+    assert z[1] - z[0] == 1
+
+    # random patterns
+    volume = sim.generate_signal(dimensions=dimensions,
+                                 feature_coordinates=np.array([[5, 5, 5]]),
+                                 feature_type=['cube'],
+                                 feature_size=[3],
+                                 signal_magnitude=[30],
+                                 signal_constant=0)
+    assert volume[4:7, 4:7, 4:7].std() > 0
+
+
+def test_generate_stimfunction_and_convolve(tmp_path):
+    onsets = [10, 30, 50, 70, 90]
+    stimfunction = sim.generate_stimfunction(onsets=onsets,
+                                             event_durations=[6],
+                                             total_time=100)
+    assert stimfunction.shape[0] == 100 * 100
+    assert np.sum(stimfunction) == 6 * len(onsets) * 100
+
+    signal_function = sim.convolve_hrf(stimfunction=stimfunction,
+                                       tr_duration=2)
+    assert signal_function.shape[0] == 50
+
+    # HRF has ~30 s support and an undershoot
+    stimfunction1 = sim.generate_stimfunction(onsets=[0],
+                                              event_durations=[1],
+                                              total_time=100)
+    sf = sim.convolve_hrf(stimfunction=stimfunction1, tr_duration=1)
+    max_response = np.where(sf != 0)[0].max()
+    assert 25 < max_response <= 30
+    assert np.sum(sf < 0) > 0
+
+    # export / import round trip
+    path = str(tmp_path / "timing.txt")
+    sim.export_3_column(stimfunction, path)
+    stimfunc_new = sim.generate_stimfunction(onsets=None,
+                                             event_durations=None,
+                                             total_time=100,
+                                             timing_file=path)
+    assert np.all(stimfunc_new == stimfunction)
+
+    with pytest.raises(ValueError):
+        sim.generate_stimfunction(onsets=onsets, event_durations=[5],
+                                  total_time=89)
+
+    # epoch-file export
+    cond_a = sim.generate_stimfunction(onsets=onsets, event_durations=[5],
+                                       total_time=110)
+    cond_b = sim.generate_stimfunction(onsets=[x + 5 for x in onsets],
+                                       event_durations=[5],
+                                       total_time=110)
+    group = [np.hstack((cond_a, cond_b))] * 2
+    epoch_path = str(tmp_path / "epochs.npy")
+    sim.export_epoch_file(group, epoch_path, 2)
+    epochs = np.load(epoch_path, allow_pickle=True)
+    assert len(epochs) == 2
+    assert epochs[0].shape[0] == 2  # conditions
+    assert epochs[0].shape[1] >= 5  # epochs
+
+
+def test_apply_signal_and_compute_signal_change():
+    np.random.seed(0)
+    dimensions = np.array([10, 10, 10])
+    volume = sim.generate_signal(dimensions=dimensions,
+                                 feature_coordinates=np.array([[5, 5, 5]]),
+                                 feature_type=['cube'],
+                                 feature_size=[2],
+                                 signal_magnitude=[30])
+    stimfunction = sim.generate_stimfunction(onsets=[10, 30, 50, 70, 90],
+                                             event_durations=[6],
+                                             total_time=100)
+    signal_function = sim.convolve_hrf(stimfunction=stimfunction,
+                                       tr_duration=2)
+    stimfunction_tr = stimfunction[::200]
+    mask, template = sim.mask_brain(dimensions, mask_self=False)
+    noise_dict = sim._noise_dict_update({})
+    noise = sim.generate_noise(dimensions=dimensions,
+                               stimfunction_tr=stimfunction_tr,
+                               tr_duration=2,
+                               template=template,
+                               mask=mask,
+                               noise_dict=noise_dict,
+                               iterations=[0, 0])
+    nf = noise[5, 5, 5, :].reshape(50, 1)
+
+    with pytest.raises(ValueError):
+        sim.compute_signal_change(signal_function, nf.T, noise_dict,
+                                  [0.5], 'PSC')
+
+    # all methods scale linearly in magnitude
+    for method in ['PSC', 'SFNR', 'CNR_Amp/Noise-SD',
+                   'CNR_Signal-SD/Noise-SD']:
+        sig_a = sim.compute_signal_change(signal_function, nf, noise_dict,
+                                          [0.5], method)
+        sig_b = sim.compute_signal_change(signal_function, nf, noise_dict,
+                                          [1.0], method)
+        assert np.isclose(sig_b.max() / sig_a.max(), 2), method
+
+    signal = sim.apply_signal(signal_function=signal_function,
+                              volume_signal=volume)
+    assert signal.shape == (10, 10, 10, 50)
+    signal = sim.apply_signal(signal_function=stimfunction,
+                              volume_signal=volume)
+    assert np.any(signal == 30)
+
+    with pytest.raises(IndexError):
+        sig_vox = (volume > 0).sum()
+        vox_pattern = np.tile(stimfunction, (1, sig_vox - 1))
+        sim.apply_signal(signal_function=vox_pattern, volume_signal=volume)
+
+
+def test_generate_noise_properties():
+    np.random.seed(1)
+    dimensions = np.array([10, 10, 10])
+    stimfunction = sim.generate_stimfunction(onsets=[10, 30, 50, 70, 90],
+                                             event_durations=[6],
+                                             total_time=200)
+    stimfunction_tr = stimfunction[::200]
+    mask, template = sim.mask_brain(dimensions, mask_self=False)
+    noise_dict = sim._noise_dict_update({'sfnr': 90, 'snr': 50})
+    noise = sim.generate_noise(dimensions=dimensions,
+                               stimfunction_tr=stimfunction_tr,
+                               tr_duration=2,
+                               template=template,
+                               mask=mask,
+                               noise_dict=noise_dict,
+                               iterations=[3, 0])
+    assert noise.shape == (10, 10, 10, 100)
+    assert np.all(noise >= 0)
+    # noise in brain >> noise outside
+    assert noise[mask > 0].mean() > 10 * noise[mask == 0].mean()
+    # the fitted SNR is in the right ballpark
+    est_snr = sim._calc_snr(noise, mask)
+    assert 0.3 * noise_dict['snr'] < est_snr < 3 * noise_dict['snr']
+
+
+def test_calc_noise_roundtrip():
+    np.random.seed(2)
+    dimensions = np.array([12, 12, 12])
+    stimfunction = sim.generate_stimfunction(onsets=[], event_durations=[1],
+                                             total_time=150)
+    stimfunction_tr = stimfunction[::100]
+    mask, template = sim.mask_brain(dimensions, mask_self=False)
+    gen_dict = sim._noise_dict_update({'sfnr': 60, 'snr': 40,
+                                       'matched': 0})
+    noise = sim.generate_noise(dimensions=dimensions,
+                               stimfunction_tr=stimfunction_tr,
+                               tr_duration=1.5,
+                               template=template,
+                               mask=mask,
+                               noise_dict=gen_dict,
+                               iterations=[5, 5])
+    est = sim.calc_noise(noise, mask, template)
+    assert 0.4 * gen_dict['sfnr'] < est['sfnr'] < 2.5 * gen_dict['sfnr']
+    assert 0.4 * gen_dict['snr'] < est['snr'] < 2.5 * gen_dict['snr']
+    assert -1 < est['auto_reg_rho'][0] < 1
+    assert est['fwhm'] > 0
+
+
+def test_mask_brain():
+    mask, template = sim.mask_brain(np.array([10, 10, 10]),
+                                    mask_self=False)
+    assert mask.shape == (10, 10, 10)
+    assert template.max() <= 1.0
+    assert 0 < mask.sum() < mask.size
+    # center in brain, corner not
+    assert mask[5, 5, 5] == 1
+    assert mask[0, 0, 0] == 0
+    # self-masking from a 4D volume
+    vol = np.zeros((8, 8, 8, 3))
+    vol[2:6, 2:6, 2:6, :] = 100
+    mask2, template2 = sim.mask_brain(vol, mask_self=True)
+    assert mask2[3, 3, 3] == 1
+    assert mask2[0, 0, 0] == 0
+
+
+def test_drift_and_phys_components():
+    np.random.seed(3)
+    drift = sim._generate_noise_temporal_drift(200, 2.0)
+    assert drift.shape == (200,)
+    assert np.isclose(drift.std(), 1.0, atol=0.01)
+    drift_sine = sim._generate_noise_temporal_drift(100, 2.0, basis="sine")
+    assert np.isclose(drift_sine.std(), 1.0, atol=0.01)
+    phys = sim._generate_noise_temporal_phys(list(np.arange(0, 100, 2.0)))
+    assert phys.shape == (50,)
+    task = sim._generate_noise_temporal_task(
+        np.array([0, 1, 0, 1, 1, 0] * 10))
+    assert task.shape == (60,)
+
+
+def test_gen_1d_gaussian_rfs():
+    np.random.seed(4)
+    rfs, tuning = sim.generate_1d_gaussian_rfs(
+        20, 360, (0, 359), rf_size=15, random_tuning=True)
+    assert rfs.shape == (20, 360)
+    assert np.allclose(rfs.max(axis=1), 1.0)
+    assert np.all((tuning >= 0) & (tuning < 360))
+    # even spacing
+    rfs2, tuning2 = sim.generate_1d_gaussian_rfs(
+        10, 360, (0, 360), random_tuning=False)
+    spacing = np.diff(tuning2)
+    assert len(np.unique(spacing)) == 1
+    # responses peak near the presented feature
+    trials = np.array([45, 180, 300])
+    data = sim.generate_1d_rf_responses(rfs2, trials, 360, (0, 360),
+                                        trial_noise=0.01)
+    assert data.shape == (10, 3)

@@ -1,0 +1,46 @@
+import os
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.utils.fmrisim_real_time_generator import (
+    default_settings,
+    generate_data,
+)
+
+
+def test_generate_realtime_data(tmp_path):
+    np.random.seed(0)
+    out = str(tmp_path / "rt")
+    settings = dict(default_settings)
+    settings.update({'numTRs': 20, 'save_dicom': False,
+                     'save_realtime': False})
+    generate_data(out, settings)
+    files = sorted(os.listdir(out))
+    assert 'mask.npy' in files and 'labels.npy' in files
+    vols = [f for f in files if f.startswith('rt_')]
+    assert len(vols) == 20
+    vol = np.load(os.path.join(out, vols[0]))
+    assert vol.ndim == 3
+    mask = np.load(os.path.join(out, 'mask.npy'))
+    assert vol[mask > 0].mean() > vol[mask == 0].mean()
+    labels = np.load(os.path.join(out, 'labels.npy'))
+    assert set(np.unique(labels)).issubset({0.0, 1.0, 2.0})
+
+
+def test_generate_realtime_multivariate(tmp_path):
+    np.random.seed(1)
+    out = str(tmp_path / "rt_mv")
+    settings = dict(default_settings)
+    settings.update({'numTRs': 12, 'multivariate_pattern': True})
+    generate_data(out, settings)
+    assert len([f for f in os.listdir(out)
+                if f.startswith('rt_')]) == 12
+
+
+def test_dicom_gated(tmp_path):
+    np.random.seed(2)
+    settings = dict(default_settings)
+    settings.update({'numTRs': 3, 'save_dicom': True})
+    with pytest.raises(ImportError):
+        generate_data(str(tmp_path / "rt_dcm"), settings)
