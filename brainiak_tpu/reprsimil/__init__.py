@@ -1,0 +1,3 @@
+"""Bayesian representational similarity analysis (BRSA/GBRSA)."""
+
+from .brsa import BRSA, GBRSA  # noqa: F401

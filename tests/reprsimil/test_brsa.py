@@ -1,0 +1,153 @@
+import numpy as np
+import pytest
+
+from brainiak_tpu.reprsimil.brsa import BRSA, GBRSA
+from brainiak_tpu.utils.utils import gen_design  # noqa: F401
+
+
+def make_brsa_data(n_t=150, n_v=30, n_c=4, seed=0, snr_scale=1.0,
+                   n_runs=2):
+    """Synthetic data following the BRSA generative model."""
+    rng = np.random.RandomState(seed)
+    # smooth-ish design with known covariance structure between conditions
+    design = np.zeros((n_t, n_c))
+    for c in range(n_c):
+        onsets = rng.choice(n_t - 12, size=6, replace=False)
+        for o in onsets:
+            design[o:o + 6, c] += 1.0
+    from scipy.ndimage import gaussian_filter1d
+    design = gaussian_filter1d(design, 2, axis=0)
+
+    U = np.array([[1.0, 0.8, 0.0, 0.0],
+                  [0.8, 1.0, 0.0, 0.0],
+                  [0.0, 0.0, 1.0, 0.8],
+                  [0.0, 0.0, 0.8, 1.0]])[:n_c, :n_c]
+    L = np.linalg.cholesky(U + 1e-9 * np.eye(n_c))
+    snr = np.exp(rng.randn(n_v) * 0.3) * snr_scale
+    sigma = 1.0 + 0.2 * rng.rand(n_v)
+    rho = 0.3 + 0.2 * rng.rand(n_v)
+
+    onsets = np.arange(0, n_t, n_t // n_runs)[:n_runs]
+    beta = (L @ rng.randn(n_c, n_v)) * snr * sigma
+    noise = np.zeros((n_t, n_v))
+    for v in range(n_v):
+        e = rng.randn(n_t)
+        for t in range(1, n_t):
+            if t not in onsets:
+                e[t] = rho[v] * e[t - 1] + \
+                    np.sqrt(1 - rho[v] ** 2) * e[t]
+        noise[:, v] = e * sigma[v]
+    Y = design @ beta + noise
+    return Y, design, U, snr, onsets
+
+
+def test_brsa_recovers_structure():
+    Y, design, U, snr, onsets = make_brsa_data(seed=1)
+    model = BRSA(n_iter=1, auto_nuisance=False, lbfgs_iters=150,
+                 random_state=0)
+    model.fit(Y, design, scan_onsets=onsets)
+    assert model.U_.shape == (4, 4)
+    assert model.C_.shape == (4, 4)
+    # recovered correlation structure: within-pair >> across-pair
+    within = (model.C_[0, 1] + model.C_[2, 3]) / 2
+    across = np.mean([abs(model.C_[0, 2]), abs(model.C_[0, 3]),
+                      abs(model.C_[1, 2]), abs(model.C_[1, 3])])
+    assert within > across + 0.2
+    assert within > 0.4
+    # SNR map correlates with the generative SNR
+    c = np.corrcoef(np.log(model.nSNR_), np.log(snr))[0, 1]
+    assert c > 0.3
+    # noise parameters sensible
+    assert np.all(model.sigma_ > 0)
+    assert np.all(np.abs(model.rho_) < 1)
+    assert model.beta_.shape == (4, Y.shape[1])
+
+
+def test_brsa_auto_nuisance_and_transform():
+    Y, design, U, snr, onsets = make_brsa_data(seed=2)
+    model = BRSA(n_iter=2, auto_nuisance=True, n_nureg=3,
+                 lbfgs_iters=100, random_state=0)
+    model.fit(Y, design, scan_onsets=onsets)
+    assert model.X0_.shape[1] >= 3
+    ts, ts0 = model.transform(Y, scan_onsets=onsets)
+    assert ts.shape == (Y.shape[0], 4)
+    # decoded task time course correlates with the true design
+    c = np.corrcoef(ts[:, 0], design[:, 0])[0, 1]
+    assert c > 0.3
+
+
+def test_brsa_score_prefers_true_model():
+    Y, design, U, snr, onsets = make_brsa_data(seed=3)
+    Y2, design2, _, _, _ = make_brsa_data(seed=30)
+    model = BRSA(n_iter=1, auto_nuisance=False, lbfgs_iters=100,
+                 random_state=0)
+    model.fit(Y, design, scan_onsets=onsets)
+    ll, ll_null = model.score(Y, design, scan_onsets=onsets)
+    assert ll > ll_null  # removing the fitted response helps
+
+
+def test_brsa_validation():
+    Y, design, _, _, _ = make_brsa_data()
+    model = BRSA()
+    with pytest.raises(AssertionError):
+        model.fit(Y[:, :5] * 0, design)  # constant voxels
+    with pytest.raises(AssertionError):
+        model.fit(Y[:-5], design)  # length mismatch
+    with pytest.raises(AssertionError):
+        bad_design = np.column_stack([design, design[:, 0]])
+        model.fit(Y, bad_design)  # rank-deficient design
+    with pytest.raises(AssertionError):
+        BRSA(GP_inten=True, GP_space=False).fit(Y, design)
+
+
+def test_brsa_gp_prior_runs():
+    Y, design, _, _, onsets = make_brsa_data(n_v=20, seed=4)
+    coords = np.random.RandomState(0).rand(20, 3) * 10
+    model = BRSA(n_iter=1, auto_nuisance=False, GP_space=True,
+                 lbfgs_iters=60, random_state=0)
+    model.fit(Y, design, scan_onsets=onsets, coords=coords)
+    assert np.all(np.isfinite(model.nSNR_))
+
+
+def test_gbrsa_multi_subject():
+    datasets, designs = [], []
+    for s in range(2):
+        Y, design, U, _, onsets = make_brsa_data(n_v=20, seed=10 + s)
+        datasets.append(Y)
+        designs.append(design)
+    # auto_nuisance off: with only 20 voxels, residual PCs absorb real
+    # signal (the reference's Gavish-Donoho n_nureg selection addresses
+    # this at realistic voxel counts)
+    model = GBRSA(rank=None, lbfgs_iters=80, SNR_bins=5, rho_bins=5,
+                  auto_nuisance=False, random_state=0)
+    model.fit(datasets, designs)
+    assert model.U_.shape == (4, 4)
+    within = (model.C_[0, 1] + model.C_[2, 3]) / 2
+    across = np.mean([abs(model.C_[0, 2]), abs(model.C_[0, 3]),
+                      abs(model.C_[1, 2]), abs(model.C_[1, 3])])
+    assert within > across
+    assert len(model.nSNR_) == 2
+    ll, ll_null = model.score(datasets, designs)
+    assert len(ll) == 2
+    with pytest.raises(NotImplementedError):
+        model.transform(datasets[0])
+
+
+def test_gbrsa_auto_nuisance_and_priors():
+    Y, design, _, _, onsets = make_brsa_data(n_v=25, seed=20)
+    model = GBRSA(lbfgs_iters=40, SNR_bins=4, rho_bins=4, n_nureg=2,
+                  auto_nuisance=True, random_state=0)
+    model.fit(Y, design)
+    assert np.all(np.isfinite(model.U_))
+    # per-subject scan_onsets list + nuisance array accepted
+    nuis = np.random.RandomState(0).randn(Y.shape[0], 2)
+    model2 = GBRSA(lbfgs_iters=30, SNR_bins=4, rho_bins=4,
+                   auto_nuisance=False, SNR_prior='lognorm',
+                   random_state=0)
+    model2.fit([Y], [design], nuisance=[nuis],
+               scan_onsets=[onsets])
+    ll, ll_null = model2.score([Y], [design], scan_onsets=[onsets])
+    # single-subject results are unwrapped to scalars
+    assert np.isfinite(ll) and np.isfinite(ll_null)
+    with pytest.raises(ValueError):
+        GBRSA(SNR_prior='gaussian').fit(Y, design)
