@@ -8,13 +8,13 @@ data on a mesh, so the ``comm`` parameter disappears.
 """
 
 import logging
-import math
 from enum import Enum
 
 import numpy as np
 from scipy.stats import zscore
 
 from ..image import mask_images, multimask_images
+from ..native import epoch_zscore
 
 logger = logging.getLogger(__name__)
 
@@ -67,10 +67,9 @@ def _separate_epochs(activity_data, epoch_list):
                 r = np.sum(sub_epoch[eid, :])
                 if r > 0:
                     mat = activity_data[sid][:, sub_epoch[eid, :] == 1]
-                    mat = np.ascontiguousarray(mat.T)
-                    mat = np.nan_to_num(zscore(mat, axis=0, ddof=0))
-                    mat = mat / math.sqrt(r)
-                    raw_data.append(mat)
+                    mat = np.ascontiguousarray(mat.T, dtype=np.float32)
+                    # native OpenMP kernel (NumPy fallback inside)
+                    raw_data.append(epoch_zscore(mat))
                     labels.append(cond)
     return raw_data, labels
 

@@ -34,6 +34,41 @@ logger = logging.getLogger(__name__)
 __all__ = ["VoxelSelector"]
 
 
+def _gram_and_shrink(corr):
+    """Per-voxel linear-kernel Gram with the reference's magnitude
+    shrink: scale so K[0,0] has at most 2 integer digits for stable SVM
+    duals (reference cython_blas.pyx compute_kernel_matrix + digit
+    shrink, voxelselector.py:407-412)."""
+    kernels = jnp.einsum('bev,bfv->bef', corr, corr, precision=PRECISION,
+                         preferred_element_type=jnp.float32)
+    k00 = jnp.clip(kernels[:, 0, 0], 1.0, None)
+    ndigits = jnp.floor(jnp.log10(k00)) + 1
+    proportion = jnp.where(ndigits > 2, 10.0 ** (2 - ndigits), 1.0)
+    return kernels * proportion[:, None, None]
+
+
+@partial(jax.jit, static_argnames=("epochs_per_subj", "interpret"))
+def _block_kernel_matrices_pallas(blk, data2, epochs_per_subj,
+                                  interpret=False):
+    """Pallas-fused variant of :func:`_block_kernel_matrices`: the
+    correlation + Fisher-z + normalization tile never round-trips to HBM
+    (see :mod:`brainiak_tpu.ops.pallas_kernels`)."""
+    from ..ops.pallas_kernels import fcma_corr_normalize, pick_tiles
+
+    n_e, n_t, n_b = blk.shape
+    n_v = data2.shape[2]
+    tile_b, tile_v = pick_tiles(n_e, n_t, n_b, n_v)
+    pad_b = (-n_b) % tile_b
+    pad_v = (-n_v) % tile_v
+    blk_p = jnp.pad(blk, ((0, 0), (0, 0), (0, pad_b)))
+    data_p = jnp.pad(data2, ((0, 0), (0, 0), (0, pad_v)))
+    corr = fcma_corr_normalize(blk_p, data_p, epochs_per_subj,
+                               tile_b=tile_b, tile_v=tile_v,
+                               interpret=interpret)
+    corr = corr[:n_b, :, :n_v]
+    return _gram_and_shrink(corr), corr
+
+
 @partial(jax.jit, static_argnames=("epochs_per_subj",))
 def _block_kernel_matrices(blk, data2, epochs_per_subj):
     """Correlate a voxel block against all voxels and build per-voxel SVM
@@ -48,16 +83,7 @@ def _block_kernel_matrices(blk, data2, epochs_per_subj):
                       precision=PRECISION,
                       preferred_element_type=jnp.float32)
     corr = within_subject_normalization(corr, epochs_per_subj)
-    kernels = jnp.einsum('bev,bfv->bef', corr, corr, precision=PRECISION,
-                         preferred_element_type=jnp.float32)
-    # Magnitude shrink: scale so K[0,0] has at most 2 integer digits
-    # (reference cython_blas.pyx compute_kernel_matrix + digit shrink,
-    # voxelselector.py:407-412) for stable SVM duals.
-    k00 = jnp.clip(kernels[:, 0, 0], 1.0, None)
-    ndigits = jnp.floor(jnp.log10(k00)) + 1
-    proportion = jnp.where(ndigits > 2, 10.0 ** (2 - ndigits), 1.0)
-    kernels = kernels * proportion[:, None, None]
-    return kernels, corr
+    return _gram_and_shrink(corr), corr
 
 
 class VoxelSelector:
@@ -80,7 +106,7 @@ class VoxelSelector:
     def __init__(self, labels, epochs_per_subj, num_folds, raw_data,
                  raw_data2=None, voxel_unit=256, mesh=None,
                  svm_C=1.0, svm_iters=50, process_num=None,
-                 master_rank=0):
+                 master_rank=0, use_pallas='auto'):
         self.labels = np.asarray(labels)
         self.epochs_per_subj = epochs_per_subj
         self.num_folds = num_folds
@@ -90,6 +116,10 @@ class VoxelSelector:
         self.mesh = mesh
         self.svm_C = svm_C
         self.svm_iters = svm_iters
+        # 'auto': the fused Pallas kernel on TPU, plain XLA elsewhere
+        if use_pallas == 'auto':
+            use_pallas = jax.default_backend() == 'tpu'
+        self.use_pallas = bool(use_pallas)
         # process_num / master_rank accepted for API compatibility with the
         # reference's multiprocessing/MPI knobs; they have no effect here.
         self.num_voxels = raw_data[0].shape[1]
@@ -159,8 +189,13 @@ class VoxelSelector:
                 if self.num_voxels >= block else 0
             offset = start - pad_start
             blk = self._slice_block(data1, pad_start, block)
-            kernels, corr = _block_kernel_matrices(
-                blk, data2, self.epochs_per_subj)
+            if self.use_pallas:
+                kernels, corr = _block_kernel_matrices_pallas(
+                    blk, data2, self.epochs_per_subj,
+                    interpret=jax.default_backend() == 'cpu')
+            else:
+                kernels, corr = _block_kernel_matrices(
+                    blk, data2, self.epochs_per_subj)
             kernels = kernels[offset:offset + cur]
             corr = corr[offset:offset + cur]
             if isinstance(clf, str) and clf == 'svm':

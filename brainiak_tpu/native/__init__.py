@@ -1,0 +1,101 @@
+"""Native (C++/OpenMP) host-runtime kernels with ctypes bindings.
+
+The device compute path is JAX/XLA/Pallas; this package covers host-side
+hot loops (data ingest normalization) the way the reference uses
+C++/OpenMP and Cython for its host kernels.  The shared library is
+compiled on demand with the system g++ and cached next to the sources;
+every entry point has a NumPy fallback, so the framework works without a
+toolchain.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import sysconfig
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["epoch_zscore", "column_mean", "native_available"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "epoch_norm.cc")
+_LIB_PATH = os.path.join(_HERE, "_epoch_norm" +
+                         (sysconfig.get_config_var("EXT_SUFFIX") or ".so"))
+_lib = None
+_tried = False
+
+
+def _build():
+    # compile to a unique temp name and rename into place so concurrent
+    # processes (e.g. the distributed test harness) never load a
+    # partially written library
+    tmp = _LIB_PATH + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-march=native", "-fPIC", "-shared", "-fopenmp",
+           _SRC, "-o", tmp]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _LIB_PATH)
+
+
+def _load():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        if not os.path.exists(_LIB_PATH) or \
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.epoch_zscore_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_int64]
+        lib.column_mean_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float)]
+        _lib = lib
+    except Exception as exc:  # toolchain missing / build failure
+        logger.info("native kernels unavailable (%s); using NumPy "
+                    "fallbacks", exc)
+        _lib = None
+    return _lib
+
+
+def native_available():
+    return _load() is not None
+
+
+def epoch_zscore(mat):
+    """In-place column z-score (population) + 1/sqrt(rows) scaling of a
+    C-contiguous float32 [rows, cols] array; zero-variance columns become
+    zero.  Returns ``mat``."""
+    assert mat.dtype == np.float32 and mat.flags.c_contiguous
+    lib = _load()
+    if lib is None:
+        rows = mat.shape[0]
+        mean = mat.mean(axis=0)
+        std = mat.std(axis=0)
+        with np.errstate(divide='ignore', invalid='ignore'):
+            out = (mat - mean) / (std * np.sqrt(rows))
+        mat[:] = np.nan_to_num(out, nan=0.0, posinf=0.0, neginf=0.0)
+        return mat
+    lib.epoch_zscore_f32(
+        mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        mat.shape[0], mat.shape[1])
+    return mat
+
+
+def column_mean(mat):
+    """Column means of a C-contiguous float32 [rows, cols] array."""
+    assert mat.dtype == np.float32 and mat.flags.c_contiguous
+    lib = _load()
+    if lib is None:
+        return mat.mean(axis=0)
+    out = np.empty(mat.shape[1], dtype=np.float32)
+    lib.column_mean_f32(
+        mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        mat.shape[0], mat.shape[1],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out

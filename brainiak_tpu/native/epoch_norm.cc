@@ -1,0 +1,64 @@
+// Host-side data-plane kernels for brainiak_tpu.
+//
+// The TPU compute path is JAX/XLA/Pallas; these C++ routines cover the
+// host runtime's hot loops, the niche the reference fills with
+// C++/OpenMP+Cython (fcma_extension.cc, cython_blas.pyx): epoch
+// normalization during data ingest, which runs on CPU while staging data
+// for the device and benefits from multithreading across voxels.
+//
+// Built as a plain shared library and bound with ctypes (no pybind11).
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// Z-score each column of a row-major [rows, cols] float32 matrix over the
+// row (time) axis with population variance, scale by 1/sqrt(rows), and
+// map zero-variance columns to zero — the exact semantics of FCMA epoch
+// preparation (reference fcma/preprocessing.py:41-92).
+void epoch_zscore_f32(float* mat, int64_t rows, int64_t cols) {
+  const float inv_rows = 1.0f / static_cast<float>(rows);
+  const float scale = 1.0f / std::sqrt(static_cast<float>(rows));
+#pragma omp parallel for schedule(static)
+  for (int64_t c = 0; c < cols; ++c) {
+    // two-pass variance with double accumulators: raw BOLD intensities
+    // have means ~1e4, where single-pass float32 E[x^2]-mean^2 suffers
+    // catastrophic cancellation
+    double mean_acc = 0.0;
+    for (int64_t r = 0; r < rows; ++r) {
+      mean_acc += static_cast<double>(mat[r * cols + c]);
+    }
+    const float mean = static_cast<float>(mean_acc * inv_rows);
+    double var_acc = 0.0;
+    for (int64_t r = 0; r < rows; ++r) {
+      const double d = static_cast<double>(mat[r * cols + c]) - mean;
+      var_acc += d * d;
+    }
+    const float var = static_cast<float>(var_acc * inv_rows);
+    if (var <= 0.0f || !std::isfinite(var)) {
+      for (int64_t r = 0; r < rows; ++r) mat[r * cols + c] = 0.0f;
+    } else {
+      const float inv_std = scale / std::sqrt(var);
+      for (int64_t r = 0; r < rows; ++r) {
+        mat[r * cols + c] = (mat[r * cols + c] - mean) * inv_std;
+      }
+    }
+  }
+}
+
+// Mean over the time axis for a row-major [rows, cols] float32 matrix —
+// the epoch-averaging loop of MVPA preparation
+// (reference fcma/preprocessing.py:274-326).
+void column_mean_f32(const float* mat, int64_t rows, int64_t cols,
+                     float* out) {
+  const float inv_rows = 1.0f / static_cast<float>(rows);
+#pragma omp parallel for schedule(static)
+  for (int64_t c = 0; c < cols; ++c) {
+    float acc = 0.0f;
+    for (int64_t r = 0; r < rows; ++r) acc += mat[r * cols + c];
+    out[c] = acc * inv_rows;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,126 @@
+"""Pallas TPU kernels for the FCMA hot path.
+
+The FCMA stage-1 inner loop (reference fcma/cython_blas.pyx:20-115 +
+fcma_extension.cc:29-92) computes, per voxel block: per-epoch correlations
+against all voxels, then Fisher-z + within-subject epoch normalization.
+The XLA path (:mod:`brainiak_tpu.ops.correlation` /
+:mod:`brainiak_tpu.ops.fisherz`) materializes the [block, epochs, voxels]
+correlation tensor in HBM between the two steps; this kernel fuses the
+epoch-batched MXU matmuls with the normalization while the tile is still in
+VMEM, writing the normalized tensor exactly once.
+
+Grid: (block_tiles, voxel_tiles).  Each program loads the whole epoch/TR
+extent of its two voxel tiles ([E, T, TB] and [E, T, TV]), runs E matmuls
+on the MXU accumulating the [TB, E, TV] tile, applies the clamped Fisher-z
+and per-subject epoch z-scoring on the VPU, and stores the tile.
+
+On non-TPU backends the kernel runs in interpreter mode (tests), and
+callers can always fall back to the XLA path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .fisherz import _CLAMP
+
+__all__ = ["fcma_corr_normalize", "pick_tiles"]
+
+# VMEM budget per program (floats): two input tiles [E,T,tile] plus the
+# output tile [tile_b, E, tile_v] must fit comfortably in ~16 MB of VMEM.
+_VMEM_BUDGET_FLOATS = 2_500_000
+
+
+def pick_tiles(n_epochs, n_trs, n_b, n_v):
+    """Choose (tile_b, tile_v) multiples of 128 (or the full extent when
+    smaller) so the working set stays within the VMEM budget even for
+    large epoch counts."""
+    tile_b = min(128, n_b)
+    tile_v = min(512, n_v)
+    while tile_v > 128:
+        used = (n_epochs * n_trs * (tile_b + tile_v)
+                + tile_b * n_epochs * tile_v)
+        if used <= _VMEM_BUDGET_FLOATS:
+            break
+        tile_v //= 2
+    return tile_b, max(tile_v, min(128, n_v))
+
+
+def _kernel(blk_ref, data_ref, out_ref, *, n_epochs, epochs_per_subj):
+    """One (TB, TV) tile: correlate, Fisher-z, normalize, store."""
+    n_subjs = n_epochs // epochs_per_subj
+
+    # per-epoch correlation on the MXU: [TB, T] @ [T, TV]
+    def corr_epoch(e):
+        b = blk_ref[e, :, :]   # [T, TB]
+        d = data_ref[e, :, :]  # [T, TV]
+        return jax.lax.dot_general(
+            b, d, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+    corr = jnp.stack([corr_epoch(e) for e in range(n_epochs)], axis=1)
+    # Fisher z with the reference's clamping (fcma_extension.cc:68-72)
+    num = 1.0 + corr
+    den = 1.0 - corr
+    num = jnp.where(num <= 0.0, _CLAMP, num)
+    den = jnp.where(den <= 0.0, _CLAMP, den)
+    z = 0.5 * jnp.log(num / den)
+    # z-score across each subject's epochs (population std, zero when
+    # non-positive; fcma_extension.cc:74-84)
+    tb, _, tv = z.shape
+    zr = z.reshape(tb, n_subjs, epochs_per_subj, tv)
+    mean = jnp.mean(zr, axis=2, keepdims=True)
+    var = jnp.mean(zr * zr, axis=2, keepdims=True) - mean * mean
+    inv = jnp.where(var <= 0.0, 0.0, jax.lax.rsqrt(var))
+    out_ref[:, :, :] = ((zr - mean) * inv).reshape(tb, n_epochs, tv)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("epochs_per_subj", "tile_b", "tile_v",
+                                    "interpret"))
+def fcma_corr_normalize(blk, data, epochs_per_subj, tile_b=None,
+                        tile_v=None, interpret=False):
+    """Fused FCMA correlation + within-subject normalization.
+
+    blk : [E, T, B] normalized epoch data for the voxel block
+    data : [E, T, V] normalized epoch data for all voxels
+    Returns [B, E, V] float32 — identical (to fp32 tolerance) to
+    ``within_subject_normalization(correlate_epochs(blk, data), eps)``.
+
+    B and V must be multiples of tile_b/tile_v (callers pad).
+    """
+    n_epochs, n_trs, n_b = blk.shape
+    n_v = data.shape[2]
+    auto_b, auto_v = pick_tiles(n_epochs, n_trs, n_b, n_v)
+    tile_b = auto_b if tile_b is None else tile_b
+    tile_v = auto_v if tile_v is None else tile_v
+    assert n_b % tile_b == 0 and n_v % tile_v == 0, \
+        "block/voxel sizes must be multiples of the tile sizes"
+
+    grid = (n_b // tile_b, n_v // tile_v)
+    kernel = functools.partial(_kernel, n_epochs=n_epochs,
+                               epochs_per_subj=epochs_per_subj)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_b, n_epochs, n_v),
+                                       jnp.float32),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((n_epochs, n_trs, tile_b),
+                             lambda i, j: (0, 0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((n_epochs, n_trs, tile_v),
+                             lambda i, j: (0, 0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((tile_b, n_epochs, tile_v),
+                                   lambda i, j: (i, 0, j),
+                                   memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(blk, jnp.float32), jnp.asarray(data, jnp.float32))

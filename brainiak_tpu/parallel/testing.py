@@ -1,0 +1,101 @@
+"""Multi-process distributed test harness.
+
+The TPU-native analog of the reference's vendored pytest-mpiexec plugin
+(reference tests/pytest_mpiexec_plugin.py): where the reference re-executes
+tests under ``mpiexec -n N`` to exercise MPI collectives on one machine,
+this harness launches N OS processes that form a ``jax.distributed``
+cluster over a local coordinator, each backed by virtual CPU devices — the
+same code path (multi-controller runtime + GSPMD collectives over what
+would be DCN on a pod) without TPU hardware.
+
+Usage: write a worker function in an importable module with signature
+``worker(process_id, num_processes)`` (it runs after jax.distributed is
+initialized) and call :func:`run_distributed`.
+"""
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+
+__all__ = ["run_distributed"]
+
+_WORKER_TEMPLATE = """
+import os, pickle, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count={local_devices}")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", {x64})
+jax.distributed.initialize(coordinator_address="{coord}",
+                           num_processes={n},
+                           process_id={pid})
+sys.path.insert(0, {extra_path!r})
+from {module} import {fn} as worker
+result = worker({pid}, {n})
+with open({out!r}, "wb") as f:
+    pickle.dump(result, f)
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_distributed(module, fn, n_procs=2, local_devices=2, timeout=240,
+                    extra_path=None, x64=True):
+    """Run ``module.fn(process_id, num_processes)`` in ``n_procs``
+    OS processes forming one jax.distributed cluster.
+
+    Each process sees ``local_devices`` virtual CPU devices, so the global
+    device count is ``n_procs * local_devices``.  Returns the list of
+    per-process return values (must be picklable).
+    """
+    coord = f"127.0.0.1:{_free_port()}"
+    if extra_path is None:
+        extra_path = os.getcwd()
+    procs = []
+    outs = []
+    with tempfile.TemporaryDirectory() as tmp:
+        for pid in range(n_procs):
+            out = os.path.join(tmp, f"result_{pid}.pkl")
+            outs.append(out)
+            code = _WORKER_TEMPLATE.format(
+                coord=coord, n=n_procs, pid=pid, module=module, fn=fn,
+                out=out, local_devices=local_devices,
+                extra_path=extra_path, x64=x64)
+            env = dict(os.environ)
+            env.pop("PYTHONPATH", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", code], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        results = []
+        errors = []
+        for pid, p in enumerate(procs):
+            try:
+                stdout, stderr = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                # a dead peer leaves survivors blocked in the collective;
+                # kill everyone but surface the real failure, not the
+                # timeout
+                for q in procs:
+                    q.kill()
+                errors.append(f"process {pid} timed out after "
+                              f"{timeout}s (likely blocked on a peer "
+                              "failure)")
+                continue
+            if p.returncode != 0:
+                errors.append(
+                    f"process {pid} failed (rc={p.returncode}):\n"
+                    f"{stderr.decode()[-2000:]}")
+        if errors:
+            raise RuntimeError("\n".join(errors))
+        for out in outs:
+            with open(out, "rb") as f:
+                results.append(pickle.load(f))
+    return results

@@ -135,3 +135,18 @@ def test_voxel_selection_errors():
     with pytest.raises(ValueError):
         VoxelSelector([0, 1, 0, 1], 2, 2,
                       [d[:, :0] for d in data])
+
+
+def test_voxel_selection_pallas_path_matches_xla():
+    """The fused Pallas kernel path (interpreter mode on CPU) gives the
+    same rankings as the XLA path."""
+    prng = RandomState(1234567890)
+    fake_raw_data = [create_epoch(prng, col=12) for _ in range(8)]
+    labels = [0, 1, 0, 1, 0, 1, 0, 1]
+    xla = sorted(VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=6,
+                               use_pallas=False).run('svm'))
+    pallas = sorted(VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=6,
+                                  use_pallas=True).run('svm'))
+    for (v0, a0), (v1, a1) in zip(xla, pallas):
+        assert v0 == v1
+        assert np.isclose(a0, a1, atol=1e-4)

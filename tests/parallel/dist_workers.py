@@ -1,0 +1,60 @@
+"""Worker functions for the multi-process distributed harness tests.
+
+These run inside jax.distributed processes spawned by
+brainiak_tpu.parallel.testing.run_distributed.
+"""
+
+import numpy as np
+
+
+def psum_worker(process_id, num_processes):
+    """Global psum across all processes' devices."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("subject",))
+    n_global = len(devices)
+    # each process contributes its local slice of a global array
+    local = np.arange(jax.local_device_count(), dtype=np.float64) + \
+        process_id * jax.local_device_count()
+    global_shape = (n_global,)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, PartitionSpec("subject")), local, global_shape)
+    total = jax.jit(lambda x: jnp.sum(x))(arr)
+    return float(total), n_global
+
+
+def srm_worker(process_id, num_processes):
+    """Distributed DetSRM over a global mesh: subjects sharded across
+    processes; returns the shared response computed with multi-process
+    collectives."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from brainiak_tpu.funcalign.srm import _fit_det_srm
+
+    rng = np.random.RandomState(0)
+    n_subjects, voxels, samples, features = 4, 12, 16, 3
+    S = rng.randn(features, samples)
+    data = np.zeros((n_subjects, voxels, samples))
+    for i in range(n_subjects):
+        q, _ = np.linalg.qr(rng.randn(voxels, features))
+        data[i] = q @ S + 0.01 * rng.randn(voxels, samples)
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("subject",))
+    sharding = NamedSharding(mesh, PartitionSpec("subject", None, None))
+    n_local = n_subjects // num_processes
+    local = data[process_id * n_local:(process_id + 1) * n_local]
+    arr = jax.make_array_from_process_local_data(sharding, local,
+                                                 data.shape)
+    voxel_counts = jnp.full((n_subjects,), voxels, jnp.float64)
+    key = jax.random.PRNGKey(0)
+    fit = jax.jit(_fit_det_srm, static_argnames=("features", "n_iter"))
+    w, shared, objective = fit(arr, voxel_counts, key, features=features,
+                               n_iter=5)
+    # shared response is replicated; fetch it on every process
+    return np.asarray(shared), float(objective)
