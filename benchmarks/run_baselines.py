@@ -1,0 +1,199 @@
+"""Benchmark harness for the five BASELINE.json configs.
+
+Runs each config end-to-end through the public API on the current JAX
+backend and prints one JSON line per config:
+
+    {"config": ..., "seconds": ..., "detail": {...}}
+
+Usage:
+    python benchmarks/run_baselines.py [--scale small|full] [--config NAME]
+
+``small`` (default) finishes in ~a minute on CPU for smoke-testing the
+harness; ``full`` is the TPU-scale measurement.  Timing includes a final
+host fetch of (small) outputs, which synchronizes device work — see
+.claude/skills/verify/SKILL.md for why block_until_ready is not used.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SCALES = {
+    "small": dict(srm=dict(S=4, V=2000, T=100, K=10, iters=10),
+                  eventseg=dict(V=50, T=200, K=10),
+                  isc=dict(S=10, T=150, V=100, boots=200, perms=200),
+                  searchlight=dict(dim=16, S=4, T=20, rad=1),
+                  fcma=dict(V=2048, T=100, E=8, unit=256)),
+    "full": dict(srm=dict(S=20, V=40000, T=300, K=50, iters=10),
+                 eventseg=dict(V=100, T=500, K=40),
+                 isc=dict(S=20, T=300, V=500, boots=1000, perms=1000),
+                 searchlight=dict(dim=32, S=8, T=40, rad=2),
+                 fcma=dict(V=16384, T=150, E=16, unit=512)),
+}
+
+
+def bench_srm(S, V, T, K, iters):
+    from brainiak_tpu.funcalign.srm import SRM
+
+    rng = np.random.RandomState(0)
+    shared = rng.randn(K, T)
+    X = []
+    for _ in range(S):
+        q, _ = np.linalg.qr(rng.randn(V, K))
+        X.append((q @ shared
+                  + 0.1 * rng.randn(V, T)).astype(np.float32))
+    SRM(n_iter=iters, features=K).fit(X)  # warm: identical statics
+    t0 = time.perf_counter()
+    model = SRM(n_iter=iters, features=K).fit(X)
+    dt = time.perf_counter() - t0
+    return dt, {"logprob": model.logprob_,
+                "subjects": S, "voxels": V, "iters": iters}
+
+
+def bench_eventseg(V, T, K):
+    from brainiak_tpu.eventseg.event import EventSegment
+
+    rng = np.random.RandomState(0)
+    bounds = np.sort(rng.choice(np.arange(1, T), K - 1, replace=False))
+    labels = np.searchsorted(bounds, np.arange(T), side='right')
+    pat = rng.randn(K, V)
+    D = pat[labels] + 0.5 * rng.randn(T, V)
+    EventSegment(K).fit(D)  # warm: identical shapes
+    t0 = time.perf_counter()
+    es = EventSegment(K).fit(D)
+    dt = time.perf_counter() - t0
+    found = np.argmax(es.segments_[0], axis=1)
+    acc = np.mean(found == labels)
+    return dt, {"boundary_accuracy": float(acc),
+                "n_iters_run": int(es.ll_.shape[0])}
+
+
+def bench_isc(S, T, V, boots, perms):
+    from brainiak_tpu.isc import bootstrap_isc, isc, permutation_isc, \
+        phaseshift_isc
+
+    rng = np.random.RandomState(0)
+    signal = rng.randn(T, V)
+    data = np.dstack([signal + rng.randn(T, V) for _ in range(S)]) \
+        .astype(np.float32)
+    iscs = isc(data)
+    # warm with identical shapes/statics so the timed region excludes
+    # compilation
+    bootstrap_isc(iscs, n_bootstraps=boots, random_state=0)
+    permutation_isc(iscs, n_permutations=perms, random_state=0)
+    phaseshift_isc(data, n_shifts=min(200, boots), random_state=0)
+    t0 = time.perf_counter()
+    _, _, p_b, _ = bootstrap_isc(iscs, n_bootstraps=boots,
+                                 random_state=0)
+    _, p_p, _ = permutation_isc(iscs, n_permutations=perms,
+                                random_state=0)
+    _, p_s, _ = phaseshift_isc(data, n_shifts=min(200, boots),
+                               random_state=0)
+    dt = time.perf_counter() - t0
+    return dt, {"voxels": V, "bootstraps": boots, "permutations": perms,
+                "median_p_boot": float(np.median(p_b))}
+
+
+def bench_searchlight(dim, S, T, rad):
+    import jax.numpy as jnp
+
+    from brainiak_tpu.searchlight import Ball, Searchlight
+
+    rng = np.random.RandomState(0)
+    subjects = [rng.randn(dim, dim, dim, T).astype(np.float32)
+                for _ in range(S)]
+    mask = np.ones((dim, dim, dim), dtype=bool)
+    # RSA voxel function: correlation between the neighborhood RDM of the
+    # first subject and the mean RDM of the others
+    half = T // 2
+
+    def voxel_fn(patches, mpatch, myrad, bcast):
+        def rdm(p):
+            a = p[:, :half].mean(axis=1)
+            b = p[:, half:].mean(axis=1)
+            return a - b
+
+        d0 = rdm(patches[0])
+        rest = jnp.mean(jnp.stack([rdm(patches[i])
+                                   for i in range(1, S)]), axis=0)
+        d0 = jnp.where(mpatch, d0, 0.0)
+        rest = jnp.where(mpatch, rest, 0.0)
+        num = jnp.sum(d0 * rest)
+        den = jnp.sqrt(jnp.sum(d0 ** 2) * jnp.sum(rest ** 2)) + 1e-12
+        return num / den
+
+    sl = Searchlight(sl_rad=rad, shape=Ball)
+    sl.distribute(subjects, mask)
+    sl.run_searchlight_jax(voxel_fn, batch_size=256)  # warm
+    t0 = time.perf_counter()
+    out = sl.run_searchlight_jax(voxel_fn, batch_size=256)
+    dt = time.perf_counter() - t0
+    n_centers = int(np.isfinite(out).sum())
+    return dt, {"centers": n_centers,
+                "centers_per_sec": n_centers / dt}
+
+
+def bench_fcma(V, T, E, unit):
+    from brainiak_tpu.fcma.voxelselector import VoxelSelector
+
+    rng = np.random.RandomState(0)
+    data = []
+    for _ in range(E):
+        mat = rng.randn(T, V).astype(np.float32)
+        mat = (mat - mat.mean(0)) / (mat.std(0) * math.sqrt(T))
+        data.append(mat)
+    labels = [0, 1] * (E // 2)
+    vs = VoxelSelector(labels, max(E // 4, 2), 2, data, voxel_unit=unit)
+    vs.run('svm')  # warm compile
+    t0 = time.perf_counter()
+    results = vs.run('svm')
+    dt = time.perf_counter() - t0
+    return dt, {"voxels": V, "voxels_per_sec": V / dt,
+                "top_acc": results[0][1]}
+
+
+CONFIGS = {
+    "srm_synthetic_fit": bench_srm,
+    "eventseg_hmm_fit": bench_eventseg,
+    "isc_with_nulls": bench_isc,
+    "searchlight_rsa": bench_searchlight,
+    "fcma_voxel_selection": bench_fcma,
+}
+_PARAM_KEY = {"srm_synthetic_fit": "srm", "eventseg_hmm_fit": "eventseg",
+              "isc_with_nulls": "isc", "searchlight_rsa": "searchlight",
+              "fcma_voxel_selection": "fcma"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", choices=list(SCALES), default="small")
+    ap.add_argument("--config", choices=list(CONFIGS), default=None)
+    ap.add_argument("--backend", default=None,
+                    help="force a JAX platform (e.g. cpu) — more reliable "
+                         "than the env var when a sitecustomize has "
+                         "already registered a TPU plugin")
+    args = ap.parse_args()
+    import jax
+    if args.backend:
+        jax.config.update("jax_platforms", args.backend)
+    params = SCALES[args.scale]
+    names = [args.config] if args.config else list(CONFIGS)
+    backend = jax.default_backend()
+    for name in names:
+        seconds, detail = CONFIGS[name](**params[_PARAM_KEY[name]])
+        print(json.dumps({"config": name, "backend": backend,
+                          "scale": args.scale,
+                          "seconds": round(seconds, 3),
+                          "detail": detail}))
+
+
+if __name__ == "__main__":
+    main()

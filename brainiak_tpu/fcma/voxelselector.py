@@ -57,7 +57,10 @@ def _block_kernel_matrices_pallas(blk, data2, epochs_per_subj,
 
     n_e, n_t, n_b = blk.shape
     n_v = data2.shape[2]
-    tile_b, tile_v = pick_tiles(n_e, n_t, n_b, n_v)
+    tile_b, tile_v, fits = pick_tiles(n_e, n_t, n_b, n_v)
+    if not fits:
+        # epoch x TR extent too large for VMEM tiles — use the XLA path
+        return _block_kernel_matrices(blk, data2, epochs_per_subj)
     pad_b = (-n_b) % tile_b
     pad_v = (-n_v) % tile_v
     blk_p = jnp.pad(blk, ((0, 0), (0, 0), (0, pad_b)))
@@ -192,7 +195,7 @@ class VoxelSelector:
             if self.use_pallas:
                 kernels, corr = _block_kernel_matrices_pallas(
                     blk, data2, self.epochs_per_subj,
-                    interpret=jax.default_backend() == 'cpu')
+                    interpret=jax.default_backend() != 'tpu')
             else:
                 kernels, corr = _block_kernel_matrices(
                     blk, data2, self.epochs_per_subj)

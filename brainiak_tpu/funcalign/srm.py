@@ -24,6 +24,7 @@ loop is ONE jitted function over a stacked ``[subjects, voxels, TRs]`` array:
 """
 
 import logging
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -140,6 +141,23 @@ def _fit_prob_srm(x, trace_xtx, voxel_counts, key, features, n_iter):
 
 _fit_prob_srm_jit = jax.jit(_fit_prob_srm,
                             static_argnames=("features", "n_iter"))
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def _em_chunk(x, trace_xtx, voxel_counts, w, rho2, sigma_s, shared,
+              n_steps):
+    """Run ``n_steps`` EM iterations from explicit state — the
+    checkpointable unit for preemption-safe fits."""
+    samples = x.shape[2]
+
+    def body(_, carry):
+        w, rho2, sigma_s, shared = carry
+        w, rho2, sigma_s, shared, _, _ = _em_iteration(
+            x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples)
+        return w, rho2, sigma_s, shared
+
+    return jax.lax.fori_loop(0, n_steps, body,
+                             (w, rho2, sigma_s, shared))
 
 
 def _fit_det_srm(x, voxel_counts, key, features, n_iter):
@@ -275,7 +293,11 @@ class SRM(_SRMBase):
     logprob_ : final marginal log-likelihood (up to a constant)
     """
 
-    def fit(self, X, y=None):
+    def fit(self, X, y=None, checkpoint_dir=None, checkpoint_every=5):
+        """Fit the model.  With ``checkpoint_dir``, EM state is saved
+        every ``checkpoint_every`` iterations and a later call resumes
+        from the latest checkpoint — mid-iteration resume the reference
+        lacks (SURVEY.md §5.4)."""
         logger.info('Starting Probabilistic SRM')
         self._validate(X)
         dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
@@ -283,10 +305,15 @@ class SRM(_SRMBase):
         stacked = self._device_place(stacked)
 
         key = jax.random.PRNGKey(self.rand_seed)
-        w, rho2, sigma_s, shared, ll = _fit_prob_srm_jit(
-            stacked, jnp.asarray(trace_xtx),
-            jnp.asarray(voxel_counts).astype(dtype), key,
-            features=self.features, n_iter=self.n_iter)
+        if checkpoint_dir is None:
+            w, rho2, sigma_s, shared, ll = _fit_prob_srm_jit(
+                stacked, jnp.asarray(trace_xtx),
+                jnp.asarray(voxel_counts).astype(dtype), key,
+                features=self.features, n_iter=self.n_iter)
+        else:
+            w, rho2, sigma_s, shared, ll = self._fit_checkpointed(
+                stacked, trace_xtx, voxel_counts, key, dtype,
+                checkpoint_dir, checkpoint_every)
 
         w = np.asarray(w)
         self.w_ = [w[i, :voxel_counts[i]] for i in range(len(X))]
@@ -297,6 +324,78 @@ class SRM(_SRMBase):
         self.logprob_ = float(ll)
         logger.info('Objective function %f', self.logprob_)
         return self
+
+    def _fit_checkpointed(self, stacked, trace_xtx, voxel_counts, key,
+                          dtype, checkpoint_dir, checkpoint_every):
+        """Chunked EM with orbax checkpoints between chunks."""
+        from ..utils.checkpoint import CheckpointManager
+
+        n_subjects, voxels_pad, samples = stacked.shape
+        trace_j = jnp.asarray(trace_xtx)
+        counts_j = jnp.asarray(voxel_counts).astype(dtype)
+
+        mngr = CheckpointManager(checkpoint_dir)
+        # fingerprint ties a checkpoint to this (data, config); resuming
+        # against different data or settings is an error, not a silent
+        # wrong answer
+        fingerprint = np.array(
+            [float(np.sum(np.asarray(trace_xtx))), float(samples),
+             float(voxels_pad), float(n_subjects),
+             float(self.features), float(self.rand_seed)])
+        template = {
+            "w": np.zeros((n_subjects, voxels_pad, self.features),
+                          dtype=dtype),
+            "rho2": np.zeros(n_subjects, dtype=dtype),
+            "sigma_s": np.zeros((self.features, self.features),
+                                dtype=dtype),
+            "shared": np.zeros((self.features, samples), dtype=dtype),
+            "fingerprint": np.zeros_like(fingerprint),
+        }
+        step, state = mngr.restore(template=template)
+        if state is None:
+            w = _init_w(key, voxels_pad, n_subjects, self.features,
+                        counts_j)
+            rho2 = jnp.ones(n_subjects, dtype=dtype)
+            sigma_s = jnp.eye(self.features, dtype=dtype)
+            shared = jnp.zeros((self.features, samples), dtype=dtype)
+            step = 0
+        else:
+            if not np.allclose(np.asarray(state["fingerprint"]),
+                               fingerprint, rtol=1e-10):
+                raise ValueError(
+                    "Checkpoint in {} was written for different data or "
+                    "model settings; use a fresh checkpoint_dir".format(
+                        checkpoint_dir))
+            if step > self.n_iter:
+                raise ValueError(
+                    "Checkpoint is at iteration {} but n_iter={}; use a "
+                    "fresh checkpoint_dir or raise n_iter".format(
+                        step, self.n_iter))
+            w = jnp.asarray(state["w"], dtype=dtype)
+            rho2 = jnp.asarray(state["rho2"], dtype=dtype)
+            sigma_s = jnp.asarray(state["sigma_s"], dtype=dtype)
+            shared = jnp.asarray(state["shared"], dtype=dtype)
+            logger.info("resumed SRM fit from iteration %d", step)
+
+        while step < self.n_iter:
+            n_steps = min(checkpoint_every, self.n_iter - step)
+            w, rho2, sigma_s, shared = _em_chunk(
+                stacked, trace_j, counts_j, w, rho2, sigma_s, shared,
+                n_steps=n_steps)
+            step += n_steps
+            mngr.save(step, {"w": np.asarray(w),
+                             "rho2": np.asarray(rho2),
+                             "sigma_s": np.asarray(sigma_s),
+                             "shared": np.asarray(shared),
+                             "fingerprint": fingerprint})
+
+        trace_xt_invsigma2_x = jnp.sum(trace_j / rho2)
+        _, _, _, _, wt_invpsi_x, inv_sigma_s_rhos = _em_iteration(
+            stacked, w, rho2, sigma_s, trace_j, counts_j, samples)
+        ll = _srm_log_likelihood(sigma_s, rho2, counts_j, wt_invpsi_x,
+                                 inv_sigma_s_rhos, trace_xt_invsigma2_x,
+                                 samples)
+        return w, rho2, sigma_s, shared, ll
 
     def save(self, file):
         """Persist the fitted model as .npz (srm.py:451-481)."""

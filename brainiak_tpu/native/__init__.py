@@ -33,7 +33,9 @@ def _build():
     # processes (e.g. the distributed test harness) never load a
     # partially written library
     tmp = _LIB_PATH + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O3", "-march=native", "-fPIC", "-shared", "-fopenmp",
+    # no -march=native: the cached .so may travel with the repo across
+    # heterogeneous hosts (the OpenMP threading is the dominant win)
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-fopenmp",
            _SRC, "-o", tmp]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, _LIB_PATH)

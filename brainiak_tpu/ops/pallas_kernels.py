@@ -35,18 +35,24 @@ _VMEM_BUDGET_FLOATS = 2_500_000
 
 
 def pick_tiles(n_epochs, n_trs, n_b, n_v):
-    """Choose (tile_b, tile_v) multiples of 128 (or the full extent when
-    smaller) so the working set stays within the VMEM budget even for
-    large epoch counts."""
+    """Choose (tile_b, tile_v, fits): tile sizes (multiples of 8/128 or
+    the full extent when smaller) keeping the working set within the VMEM
+    budget.  ``fits`` is False when even the smallest tiles exceed the
+    budget (very large epoch x TR extents) — callers should fall back to
+    the XLA path then."""
+
+    def used(tb, tv):
+        return n_epochs * n_trs * (tb + tv) + tb * n_epochs * tv
+
     tile_b = min(128, n_b)
     tile_v = min(512, n_v)
-    while tile_v > 128:
-        used = (n_epochs * n_trs * (tile_b + tile_v)
-                + tile_b * n_epochs * tile_v)
-        if used <= _VMEM_BUDGET_FLOATS:
-            break
+    while tile_v > 128 and used(tile_b, tile_v) > _VMEM_BUDGET_FLOATS:
         tile_v //= 2
-    return tile_b, max(tile_v, min(128, n_v))
+    tile_v = max(tile_v, min(128, n_v))
+    while tile_b > 8 and used(tile_b, tile_v) > _VMEM_BUDGET_FLOATS:
+        tile_b //= 2
+    tile_b = max(tile_b, min(8, n_b))
+    return tile_b, tile_v, used(tile_b, tile_v) <= _VMEM_BUDGET_FLOATS
 
 
 def _kernel(blk_ref, data_ref, out_ref, *, n_epochs, epochs_per_subj):
@@ -95,7 +101,7 @@ def fcma_corr_normalize(blk, data, epochs_per_subj, tile_b=None,
     """
     n_epochs, n_trs, n_b = blk.shape
     n_v = data.shape[2]
-    auto_b, auto_v = pick_tiles(n_epochs, n_trs, n_b, n_v)
+    auto_b, auto_v, _ = pick_tiles(n_epochs, n_trs, n_b, n_v)
     tile_b = auto_b if tile_b is None else tile_b
     tile_v = auto_v if tile_v is None else tile_v
     assert n_b % tile_b == 0 and n_v % tile_v == 0, \

@@ -74,22 +74,34 @@ def run_distributed(module, fn, n_procs=2, local_devices=2, timeout=240,
             procs.append(subprocess.Popen(
                 [sys.executable, "-c", code], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE))
-        results = []
+        # Poll: the moment any worker dies with an error, kill the rest —
+        # peers blocked in a collective would otherwise hang to timeout.
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
         errors = []
+        results = []
+        timed_out = False
+        while True:
+            rcs = [p.poll() for p in procs]
+            failed = [pid for pid, rc in enumerate(rcs)
+                      if rc is not None and rc != 0]
+            if failed or all(rc is not None for rc in rcs):
+                break
+            if _time.monotonic() > deadline:
+                timed_out = True
+                break
+            _time.sleep(0.1)
+        killed = set()
         for pid, p in enumerate(procs):
-            try:
-                stdout, stderr = p.communicate(timeout=timeout)
-            except subprocess.TimeoutExpired:
-                # a dead peer leaves survivors blocked in the collective;
-                # kill everyone but surface the real failure, not the
-                # timeout
-                for q in procs:
-                    q.kill()
-                errors.append(f"process {pid} timed out after "
-                              f"{timeout}s (likely blocked on a peer "
-                              "failure)")
-                continue
-            if p.returncode != 0:
+            if p.poll() is None:
+                p.kill()
+                killed.add(pid)
+        if timed_out:
+            errors.append(f"distributed run timed out after {timeout}s")
+        for pid, p in enumerate(procs):
+            stdout, stderr = p.communicate()
+            if p.returncode != 0 and pid not in killed:
                 errors.append(
                     f"process {pid} failed (rc={p.returncode}):\n"
                     f"{stderr.decode()[-2000:]}")

@@ -1,0 +1,76 @@
+"""Checkpoint / resume for iterative fits.
+
+The reference has model-level persistence only (SRM.save/load npz,
+FastSRM temp_dir spill — SURVEY.md §5.4) and no mid-iteration resume.
+This module is the strict superset the TPU design calls for: any pytree of
+EM/BCD state can be checkpointed every k iterations through orbax and a
+fit resumed after preemption — the standard discipline for long TPU jobs.
+"""
+
+import logging
+import os
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CheckpointManager"]
+
+
+class CheckpointManager:
+    """Thin orbax-backed manager for (step, state-pytree) checkpoints.
+
+    Falls back to ``np.savez`` of flattened leaves when orbax is
+    unavailable (the state pytrees used here are flat dicts of arrays).
+    """
+
+    def __init__(self, directory, max_to_keep=2):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        try:
+            import orbax.checkpoint as ocp
+            self._ocp = ocp
+            self._mngr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep, create=True))
+        except Exception as exc:  # pragma: no cover - orbax is installed
+            logger.info("orbax unavailable (%s); using npz checkpoints",
+                        exc)
+            self._ocp = None
+            self._mngr = None
+
+    def save(self, step, state):
+        """Persist ``state`` (a pytree of arrays) at ``step``."""
+        if self._mngr is not None:
+            self._mngr.save(step, args=self._ocp.args.StandardSave(state))
+            self._mngr.wait_until_finished()
+        else:
+            path = os.path.join(self.directory, f"ckpt_{step}.npz")
+            np.savez(path, **{k: np.asarray(v) for k, v in state.items()})
+
+    def latest_step(self):
+        if self._mngr is not None:
+            return self._mngr.latest_step()
+        steps = [int(f[5:-4]) for f in os.listdir(self.directory)
+                 if f.startswith("ckpt_") and f.endswith(".npz")]
+        return max(steps) if steps else None
+
+    def restore(self, step=None, template=None):
+        """Load the checkpoint at ``step`` (default latest); returns
+        (step, state) or (None, None) when nothing exists."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None, None
+        if self._mngr is not None:
+            if template is not None:
+                state = self._mngr.restore(
+                    step, args=self._ocp.args.StandardRestore(template))
+            else:
+                state = self._mngr.restore(step)
+            return step, state
+        path = os.path.join(self.directory, f"ckpt_{step}.npz")
+        loaded = np.load(path)
+        return step, {k: loaded[k] for k in loaded.files}

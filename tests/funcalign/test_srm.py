@@ -152,3 +152,40 @@ def test_detsrm_distributed_mesh_matches_single_device():
     for w0, w1 in zip(single.w_, dist.w_):
         assert np.allclose(w0, w1, atol=1e-8)
     assert np.allclose(single.s_, dist.s_, atol=1e-8)
+
+
+def test_srm_checkpoint_resume(tmp_path):
+    """Checkpointed fit matches the plain fit, and an interrupted fit
+    resumes from its checkpoint rather than starting over."""
+    X, _, _ = make_synthetic(n_subjects=4, voxels=20, samples=30,
+                             features=3)
+    plain = SRM(n_iter=9, features=3).fit(X)
+    ckpt = SRM(n_iter=9, features=3).fit(
+        X, checkpoint_dir=str(tmp_path / "full"), checkpoint_every=4)
+    for w0, w1 in zip(plain.w_, ckpt.w_):
+        assert np.allclose(w0, w1, atol=1e-8)
+    assert np.allclose(plain.s_, ckpt.s_, atol=1e-8)
+
+    # simulate preemption: run 4 of 9 iterations, then resume to 9
+    partial_dir = str(tmp_path / "partial")
+    SRM(n_iter=4, features=3).fit(X, checkpoint_dir=partial_dir,
+                                  checkpoint_every=4)
+    resumed = SRM(n_iter=9, features=3).fit(X, checkpoint_dir=partial_dir,
+                                            checkpoint_every=4)
+    for w0, w1 in zip(plain.w_, resumed.w_):
+        assert np.allclose(w0, w1, atol=1e-8)
+    assert np.allclose(plain.s_, resumed.s_, atol=1e-8)
+
+
+def test_srm_checkpoint_rejects_mismatched_data(tmp_path):
+    X, _, _ = make_synthetic(n_subjects=4, voxels=20, samples=30,
+                             features=3)
+    d = str(tmp_path / "ck")
+    SRM(n_iter=4, features=3).fit(X, checkpoint_dir=d)
+    # different data of the same shape must be refused
+    X2 = [x + 1.0 for x in X]
+    with pytest.raises(ValueError, match="different data"):
+        SRM(n_iter=8, features=3).fit(X2, checkpoint_dir=d)
+    # lower n_iter than the checkpoint step must be refused
+    with pytest.raises(ValueError, match="iteration"):
+        SRM(n_iter=2, features=3).fit(X, checkpoint_dir=d)

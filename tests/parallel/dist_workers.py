@@ -58,3 +58,14 @@ def srm_worker(process_id, num_processes):
                                n_iter=5)
     # shared response is replicated; fetch it on every process
     return np.asarray(shared), float(objective)
+
+
+def failing_worker(process_id, num_processes):
+    """Process 0 fails immediately; peers would block in the collective."""
+    import jax
+    if process_id == 0:
+        raise RuntimeError("intentional worker failure")
+    # peer enters a collective and waits
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    return None

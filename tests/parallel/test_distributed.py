@@ -46,3 +46,15 @@ def test_distributed_detsrm_matches_single_process():
         jnp.asarray(data), jnp.full((n_subjects,), voxels, jnp.float64),
         jax.random.PRNGKey(0), features=features, n_iter=5)
     assert np.allclose(np.asarray(shared), shared_0, atol=1e-8)
+
+
+def test_distributed_fast_failure_reporting():
+    """A worker that dies immediately is reported promptly with its real
+    traceback, not a timeout."""
+    import time
+
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match="intentional worker failure"):
+        run_distributed("tests.parallel.dist_workers", "failing_worker",
+                        n_procs=2, local_devices=1, timeout=180)
+    assert time.time() - t0 < 60  # far less than the 180s timeout

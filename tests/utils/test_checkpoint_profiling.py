@@ -1,0 +1,38 @@
+import time
+
+import numpy as np
+
+from brainiak_tpu.utils.checkpoint import CheckpointManager
+from brainiak_tpu.utils.profiling import (
+    reset_stage_times,
+    stage_timer,
+    stage_times,
+)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    mngr = CheckpointManager(str(tmp_path / "ckpts"))
+    assert mngr.latest_step() is None
+    state = {"a": np.arange(6.0).reshape(2, 3), "b": np.float64(3.5)}
+    mngr.save(2, state)
+    mngr.save(5, {"a": state["a"] * 2, "b": np.float64(7.0)})
+    assert mngr.latest_step() == 5
+    step, restored = mngr.restore(template=state)
+    assert step == 5
+    assert np.allclose(np.asarray(restored["a"]), state["a"] * 2)
+    step2, restored2 = mngr.restore(step=2, template=state)
+    assert step2 == 2
+    assert np.allclose(np.asarray(restored2["a"]), state["a"])
+
+
+def test_stage_timer():
+    reset_stage_times()
+    with stage_timer("stage_a"):
+        time.sleep(0.01)
+    with stage_timer("stage_a"):
+        time.sleep(0.01)
+    times = stage_times()
+    assert len(times["stage_a"]) == 2
+    assert all(t >= 0.01 for t in times["stage_a"])
+    reset_stage_times()
+    assert stage_times() == {}
