@@ -114,35 +114,6 @@ def _srm_log_likelihood(sigma_s, rho2, voxel_counts, wt_invpsi_x,
     return ll
 
 
-def _fit_prob_srm(x, trace_xtx, voxel_counts, key, features, n_iter):
-    """Full probabilistic-SRM EM fit as one XLA program."""
-    n_subjects, voxels_pad, samples = x.shape
-    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
-    rho2 = jnp.ones(n_subjects, dtype=x.dtype)
-    sigma_s = jnp.eye(features, dtype=x.dtype)
-    shared = jnp.zeros((features, samples), dtype=x.dtype)
-
-    def body(_, carry):
-        w, rho2, sigma_s, shared = carry
-        w, rho2, sigma_s, shared, _, _ = _em_iteration(
-            x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples)
-        return w, rho2, sigma_s, shared
-
-    w, rho2, sigma_s, shared = jax.lax.fori_loop(
-        0, n_iter, body, (w, rho2, sigma_s, shared))
-
-    trace_xt_invsigma2_x = jnp.sum(trace_xtx / rho2)
-    _, _, _, _, wt_invpsi_x, inv_sigma_s_rhos = _em_iteration(
-        x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples)
-    ll = _srm_log_likelihood(sigma_s, rho2, voxel_counts, wt_invpsi_x,
-                             inv_sigma_s_rhos, trace_xt_invsigma2_x, samples)
-    return w, rho2, sigma_s, shared, ll
-
-
-_fit_prob_srm_jit = jax.jit(_fit_prob_srm,
-                            static_argnames=("features", "n_iter"))
-
-
 @partial(jax.jit, static_argnames=("n_steps",))
 def _em_chunk(x, trace_xtx, voxel_counts, w, rho2, sigma_s, shared,
               n_steps):
@@ -158,6 +129,38 @@ def _em_chunk(x, trace_xtx, voxel_counts, w, rho2, sigma_s, shared,
 
     return jax.lax.fori_loop(0, n_steps, body,
                              (w, rho2, sigma_s, shared))
+
+
+def _final_log_likelihood(x, w, rho2, sigma_s, trace_xtx, voxel_counts):
+    """Marginal log-likelihood at the current EM state (shared by the
+    plain and checkpointed fit paths)."""
+    samples = x.shape[2]
+    trace_xt_invsigma2_x = jnp.sum(trace_xtx / rho2)
+    _, _, _, _, wt_invpsi_x, inv_sigma_s_rhos = _em_iteration(
+        x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples)
+    return _srm_log_likelihood(sigma_s, rho2, voxel_counts, wt_invpsi_x,
+                               inv_sigma_s_rhos, trace_xt_invsigma2_x,
+                               samples)
+
+
+def _fit_prob_srm(x, trace_xtx, voxel_counts, key, features, n_iter):
+    """Full probabilistic-SRM EM fit as one XLA program."""
+    n_subjects, voxels_pad, samples = x.shape
+    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
+    rho2 = jnp.ones(n_subjects, dtype=x.dtype)
+    sigma_s = jnp.eye(features, dtype=x.dtype)
+    shared = jnp.zeros((features, samples), dtype=x.dtype)
+    w, rho2, sigma_s, shared = _em_chunk(
+        x, trace_xtx, voxel_counts, w, rho2, sigma_s, shared,
+        n_steps=n_iter)
+    ll = _final_log_likelihood(x, w, rho2, sigma_s, trace_xtx,
+                               voxel_counts)
+    return w, rho2, sigma_s, shared, ll
+
+
+_fit_prob_srm_jit = jax.jit(_fit_prob_srm,
+                            static_argnames=("features", "n_iter"))
+
 
 
 def _fit_det_srm(x, voxel_counts, key, features, n_iter):
@@ -389,12 +392,8 @@ class SRM(_SRMBase):
                              "shared": np.asarray(shared),
                              "fingerprint": fingerprint})
 
-        trace_xt_invsigma2_x = jnp.sum(trace_j / rho2)
-        _, _, _, _, wt_invpsi_x, inv_sigma_s_rhos = _em_iteration(
-            stacked, w, rho2, sigma_s, trace_j, counts_j, samples)
-        ll = _srm_log_likelihood(sigma_s, rho2, counts_j, wt_invpsi_x,
-                                 inv_sigma_s_rhos, trace_xt_invsigma2_x,
-                                 samples)
+        ll = _final_log_likelihood(stacked, w, rho2, sigma_s, trace_j,
+                                   counts_j)
         return w, rho2, sigma_s, shared, ll
 
     def save(self, file):

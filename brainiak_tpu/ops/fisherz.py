@@ -47,6 +47,11 @@ def within_subject_normalization(corr, epochs_per_subj):
     (fcma_extension.cc:74-84).
     """
     b, e, v = corr.shape
+    if e % epochs_per_subj != 0:
+        raise ValueError(
+            f"number of epochs ({e}) must be a multiple of "
+            f"epochs_per_subj ({epochs_per_subj}); check that data "
+            "splits respect subject boundaries")
     n_subjs = e // epochs_per_subj
     z = fisher_z(corr).reshape(b, n_subjs, epochs_per_subj, v)
     mean = jnp.mean(z, axis=2, keepdims=True)

@@ -101,7 +101,12 @@ def fcma_corr_normalize(blk, data, epochs_per_subj, tile_b=None,
     """
     n_epochs, n_trs, n_b = blk.shape
     n_v = data.shape[2]
-    auto_b, auto_v, _ = pick_tiles(n_epochs, n_trs, n_b, n_v)
+    auto_b, auto_v, fits = pick_tiles(n_epochs, n_trs, n_b, n_v)
+    if tile_b is None and tile_v is None and not fits:
+        raise ValueError(
+            "epoch x TR extent too large for VMEM tiles "
+            f"(E={n_epochs}, T={n_trs}); use the XLA path "
+            "(ops.correlation + ops.fisherz) instead")
     tile_b = auto_b if tile_b is None else tile_b
     tile_v = auto_v if tile_v is None else tile_v
     assert n_b % tile_b == 0 and n_v % tile_v == 0, \

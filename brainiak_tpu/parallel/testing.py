@@ -35,7 +35,15 @@ jax.distributed.initialize(coordinator_address="{coord}",
                            process_id={pid})
 sys.path.insert(0, {extra_path!r})
 from {module} import {fn} as worker
-result = worker({pid}, {n})
+try:
+    result = worker({pid}, {n})
+except BaseException:
+    import traceback
+    traceback.print_exc(file=sys.stderr)
+    sys.stderr.flush()
+    # skip atexit: jax.distributed shutdown would block on peers that
+    # are themselves blocked in a collective waiting for this process
+    os._exit(1)
 with open({out!r}, "wb") as f:
     pickle.dump(result, f)
 """
@@ -71,9 +79,13 @@ def run_distributed(module, fn, n_procs=2, local_devices=2, timeout=240,
                 extra_path=extra_path, x64=x64)
             env = dict(os.environ)
             env.pop("PYTHONPATH", None)
-            procs.append(subprocess.Popen(
+            # redirect output to files: PIPEs would fill and deadlock
+            # verbose workers since the poll loop does not drain them
+            err_path = os.path.join(tmp, f"stderr_{pid}.log")
+            err_f = open(err_path, "wb")
+            procs.append((subprocess.Popen(
                 [sys.executable, "-c", code], env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+                stdout=err_f, stderr=err_f), err_path, err_f))
         # Poll: the moment any worker dies with an error, kill the rest —
         # peers blocked in a collective would otherwise hang to timeout.
         import time as _time
@@ -83,7 +95,7 @@ def run_distributed(module, fn, n_procs=2, local_devices=2, timeout=240,
         results = []
         timed_out = False
         while True:
-            rcs = [p.poll() for p in procs]
+            rcs = [p.poll() for p, _, _ in procs]
             failed = [pid for pid, rc in enumerate(rcs)
                       if rc is not None and rc != 0]
             if failed or all(rc is not None for rc in rcs):
@@ -93,18 +105,21 @@ def run_distributed(module, fn, n_procs=2, local_devices=2, timeout=240,
                 break
             _time.sleep(0.1)
         killed = set()
-        for pid, p in enumerate(procs):
+        for pid, (p, _, _) in enumerate(procs):
             if p.poll() is None:
                 p.kill()
                 killed.add(pid)
         if timed_out:
             errors.append(f"distributed run timed out after {timeout}s")
-        for pid, p in enumerate(procs):
-            stdout, stderr = p.communicate()
+        for pid, (p, err_path, err_f) in enumerate(procs):
+            p.wait()
+            err_f.close()
             if p.returncode != 0 and pid not in killed:
+                with open(err_path, "rb") as f:
+                    tail = f.read()[-2000:].decode(errors="replace")
                 errors.append(
                     f"process {pid} failed (rc={p.returncode}):\n"
-                    f"{stderr.decode()[-2000:]}")
+                    f"{tail}")
         if errors:
             raise RuntimeError("\n".join(errors))
         for out in outs:

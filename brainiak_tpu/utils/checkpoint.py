@@ -48,7 +48,9 @@ class CheckpointManager:
             self._mngr.wait_until_finished()
         else:
             path = os.path.join(self.directory, f"ckpt_{step}.npz")
-            np.savez(path, **{k: np.asarray(v) for k, v in state.items()})
+            tmp = path + f".tmp{os.getpid()}"
+            np.savez(tmp, **{k: np.asarray(v) for k, v in state.items()})
+            os.replace(tmp, path)  # atomic: survive preemption mid-save
 
     def latest_step(self):
         if self._mngr is not None:

@@ -38,9 +38,12 @@ def stage_timer(name, sync=None):
         if target is not None:
             try:
                 import jax
+            except ImportError:
+                jax = None
+            if jax is not None:
+                # computation errors surfaced here must propagate — a
+                # swallowed failure would record a bogus (unsynced) time
                 jax.block_until_ready(target)
-            except Exception:
-                pass
         dt = time.perf_counter() - t0
         _times[name].append(dt)
         logger.debug("stage %s took %.3fs", name, dt)

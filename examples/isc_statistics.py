@@ -1,0 +1,72 @@
+"""Intersubject correlation with resampling statistics.
+
+TPU-native counterpart of the reference's isc examples: simulate
+multi-subject data with fmrisim-style shared signal, compute leave-one-out
+ISC and ISFC, and assess significance with on-device bootstrap and
+phase-randomization nulls.
+
+Usage:
+    python examples/isc_statistics.py [--backend cpu]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default=None)
+    ap.add_argument("--subjects", type=int, default=15)
+    ap.add_argument("--trs", type=int, default=200)
+    ap.add_argument("--voxels", type=int, default=30)
+    ap.add_argument("--n-resamples", type=int, default=500)
+    args = ap.parse_args()
+    import jax
+    if args.backend:
+        jax.config.update("jax_platforms", args.backend)
+
+    from brainiak_tpu.isc import bootstrap_isc, isc, isfc, phaseshift_isc
+
+    rng = np.random.RandomState(0)
+    # half the voxels carry a shared signal, half are idiosyncratic noise
+    n_sig = args.voxels // 2
+    signal = rng.randn(args.trs, n_sig)
+    data = np.zeros((args.trs, args.voxels, args.subjects),
+                    dtype=np.float32)
+    for s in range(args.subjects):
+        data[:, :n_sig, s] = signal + rng.randn(args.trs, n_sig)
+        data[:, n_sig:, s] = rng.randn(args.trs,
+                                       args.voxels - n_sig) * 1.5
+
+    iscs = isc(data)
+    print("mean ISC (signal voxels):",
+          round(float(iscs[:, :n_sig].mean()), 3))
+    print("mean ISC (noise voxels):",
+          round(float(iscs[:, n_sig:].mean()), 3))
+
+    observed, ci, p, _ = bootstrap_isc(iscs,
+                                       n_bootstraps=args.n_resamples,
+                                       random_state=0)
+    sig = np.where(np.asarray(p) < 0.05)[0]
+    print(f"bootstrap: {len(sig)}/{args.voxels} voxels significant "
+          f"(expected ~{n_sig})")
+
+    _, p_phase, _ = phaseshift_isc(data, n_shifts=args.n_resamples // 2,
+                                   random_state=0)
+    sig_p = np.where(np.asarray(p_phase) < 0.05)[0]
+    print(f"phase-shift null: {len(sig_p)}/{args.voxels} significant")
+
+    isfcs, iscs_diag = isfc(data)
+    print("ISFC matrix (condensed):", isfcs.shape,
+          "mean within-signal ISFC:",
+          round(float(np.nanmean(isfcs[:, :n_sig])), 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -61,11 +61,20 @@ def srm_worker(process_id, num_processes):
 
 
 def failing_worker(process_id, num_processes):
-    """Process 0 fails immediately; peers would block in the collective."""
+    """Process 0 fails immediately; the peer genuinely blocks in a
+    cross-process collective, so the harness must kill it."""
     import jax
-    if process_id == 0:
-        raise RuntimeError("intentional worker failure")
-    # peer enters a collective and waits
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
-    return None
+
+    if process_id == 0:
+        raise RuntimeError("intentional worker failure")
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("subject",))
+    local = np.ones(jax.local_device_count())
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, PartitionSpec("subject")), local,
+        (len(devices),))
+    # global reduction requires the dead peer -> blocks until killed
+    total = jax.jit(lambda x: jnp.sum(x))(arr)
+    return float(total)
