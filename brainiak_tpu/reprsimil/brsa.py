@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from sklearn.base import BaseEstimator, TransformerMixin
 from sklearn.decomposition import PCA
+from sklearn.exceptions import NotFittedError
 from sklearn.utils import assert_all_finite
 from sklearn.utils.validation import check_random_state
 
@@ -133,6 +134,21 @@ def _ar1_ll_all_voxels(resid, rho, sigma, starts, n_runs):
                  - n_runs * jnp.log(1 - jnp.asarray(rho) ** 2)
                  + quads / s2)
     return float(jnp.sum(ll))
+
+
+def _gls_decode(W, sigma, X, X0=None):
+    """Weighted GLS decode of time courses against spatial patterns W
+    [components, voxels] with per-voxel noise sd, after projecting the
+    per-run DC / nuisance subspace out of X (matching what fit() removed
+    before estimating the patterns).  Returns [T, components]."""
+    X = np.asarray(X, dtype=float)
+    if X0 is not None and X0.shape[1] > 0:
+        Q, _ = np.linalg.qr(X0)
+        X = X - Q @ (Q.T @ X)
+    weights = 1.0 / (np.asarray(sigma) ** 2)
+    WtW = (W * weights) @ W.T
+    WtY = (W * weights) @ X.T
+    return np.linalg.solve(WtW + 1e-6 * np.eye(WtW.shape[0]), WtY).T
 
 
 def _make_L(l_flat, n_c, rank):
@@ -411,15 +427,10 @@ class BRSA(BaseEstimator, TransformerMixin):
         assert X.ndim == 2 and X.shape[1] == self.beta_.shape[1], \
             'The shape of X is not consistent with the shape of data ' \
             'used in the fitting step.'
-        n_t = X.shape[0]
         W = np.vstack([self.beta_, self.beta0_[:min(
             self.beta0_.shape[0], self.X0_.shape[1])]])  # [C+n0, V]
         n_c = self.beta_.shape[0]
-        # per-voxel noise weights
-        weights = 1.0 / (self.sigma_ ** 2)
-        WtW = (W * weights) @ W.T
-        WtY = (W * weights) @ np.asarray(X).T
-        ts_all = np.linalg.solve(WtW + 1e-6 * np.eye(WtW.shape[0]), WtY).T
+        ts_all = _gls_decode(W, self.sigma_, X)
         return ts_all[:, :n_c], ts_all[:, n_c:]
 
     def score(self, X, design, scan_onsets=None):
@@ -658,10 +669,35 @@ class GBRSA(BRSA):
         return snr_v, rho_v, sig_v, beta_v
 
     def transform(self, X, y=None, scan_onsets=None):
-        raise NotImplementedError(
-            "GBRSA.transform: use the per-subject beta_ estimates; the "
-            "reference's marginalized decoding (brsa.py:3190-3250) is not "
-            "yet implemented")
+        """Decode per-subject task time courses from new data via GLS
+        against the fitted response patterns (reference
+        brsa.py:3190-3250).  Accepts one array or a per-subject list;
+        returns (ts, ts0) lists (ts0 is empty — GBRSA projects nuisance
+        out before fitting rather than estimating its spatial pattern)."""
+        if not hasattr(self, 'U_'):
+            raise NotFittedError("The model fit has not been run yet.")
+        single = isinstance(X, np.ndarray)
+        Xs = [X] if single else list(X)
+        betas = [self.beta_] if not isinstance(self.beta_, list) \
+            else self.beta_
+        sigmas = [self.sigma_] if not isinstance(self.sigma_, list) \
+            else self.sigma_
+        if len(Xs) != len(betas):
+            raise ValueError(
+                "The number of subjects ({}) does not match the fitted "
+                "model ({})".format(len(Xs), len(betas)))
+        ts_all, ts0_all = [], []
+        for s, (x, beta, sigma) in enumerate(zip(Xs, betas, sigmas)):
+            n_t = x.shape[0]
+            raw = scan_onsets[s] if isinstance(scan_onsets, list) \
+                else scan_onsets
+            onsets = self._check_onsets(raw, n_t)
+            X0 = self._dc_regressors(n_t, onsets)
+            ts_all.append(_gls_decode(beta, sigma, x, X0=X0))
+            ts0_all.append(np.zeros((n_t, 0)))
+        if single:
+            return ts_all[0], ts0_all[0]
+        return ts_all, ts0_all
 
     def score(self, X, design, scan_onsets=None):
         """Held-out log-likelihood per subject (see BRSA.score)."""

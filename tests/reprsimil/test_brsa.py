@@ -129,8 +129,13 @@ def test_gbrsa_multi_subject():
     assert len(model.nSNR_) == 2
     ll, ll_null = model.score(datasets, designs)
     assert len(ll) == 2
-    with pytest.raises(NotImplementedError):
-        model.transform(datasets[0])
+    ts, ts0 = model.transform(datasets)
+    assert len(ts) == 2 and ts[0].shape == (datasets[0].shape[0], 4)
+    # decoded time course genuinely correlates with the true design
+    c = np.corrcoef(ts[0][:, 0], designs[0][:, 0])[0, 1]
+    assert c > 0.3
+    with pytest.raises(ValueError):
+        model.transform([datasets[0]])  # subject count mismatch
 
 
 def test_gbrsa_auto_nuisance_and_priors():
