@@ -70,55 +70,94 @@ def svm_decision(train_test_kernel, alpha, y, bias):
     return train_test_kernel @ (alpha * y) + bias
 
 
-@partial(jax.jit, static_argnames=("n_iters",))
-def _cv_one_voxel(kernel, y_signed, train_masks, c, n_iters):
-    """Mean CV accuracy of one voxel's kernel over all folds.
+@partial(jax.jit, static_argnames=("n_iters", "n_classes"))
+def _cv_one_voxel(kernel, pair_y, pair_classes, truth, train_masks,
+                  c, n_iters, n_classes):
+    """Mean one-vs-one CV accuracy of one voxel's kernel over all folds.
 
-    kernel : [n, n]; y_signed : [n]; train_masks : [F, n] (1=train)
+    kernel : [n, n]
+    pair_y : [P, n] ±1 labels per class pair (0 for samples outside it)
+    pair_classes : [P, 2] int (positive-side class, negative-side class)
+    truth : [n] int class indices
+    train_masks : [F, n] (1 = train)
+
+    Each of the P·F binary SVMs trains only on its pair's training
+    samples (the box constraint is zero elsewhere); test samples collect
+    one-vs-one votes and the predicted class is the vote argmax
+    (sklearn SVC's multiclass scheme; see svm_cv_accuracy's note on
+    tie-breaking).
     """
     def one_fold(train_mask):
         train_mask = train_mask.astype(kernel.dtype)
-        box = c * train_mask
-        alpha, bias = svm_fit_dual(kernel, y_signed, box, n_iters=n_iters)
-        dec = svm_decision(kernel, alpha, y_signed, bias)
-        pred = jnp.where(dec >= 0, 1.0, -1.0)
+
+        def one_pair(y_p, classes_p):
+            # |y_p| is the pair membership mask
+            box = c * train_mask * jnp.abs(y_p)
+            alpha, bias = svm_fit_dual(kernel, y_p, box,
+                                       n_iters=n_iters)
+            dec = svm_decision(kernel, alpha, y_p, bias)
+            vote_class = jnp.where(dec >= 0, classes_p[0], classes_p[1])
+            return jax.nn.one_hot(vote_class, n_classes)
+
+        votes = jnp.sum(jax.vmap(one_pair)(pair_y, pair_classes), axis=0)
+        pred = jnp.argmax(votes, axis=1)
         test_mask = 1.0 - train_mask
-        correct = jnp.sum((pred == y_signed) * test_mask)
+        correct = jnp.sum((pred == truth) * test_mask)
         return correct / jnp.clip(jnp.sum(test_mask), 1, None)
 
     return jnp.mean(jax.vmap(one_fold)(train_masks))
 
 
-@partial(jax.jit, static_argnames=("n_iters",))
-def _cv_batch(kernels, y_signed, train_masks, c, n_iters):
-    return jax.vmap(lambda k: _cv_one_voxel(k, y_signed, train_masks, c,
-                                            n_iters))(kernels)
+@partial(jax.jit, static_argnames=("n_iters", "n_classes"))
+def _cv_batch(kernels, pair_y, pair_classes, truth, train_masks, c,
+              n_iters, n_classes):
+    return jax.vmap(lambda k: _cv_one_voxel(
+        k, pair_y, pair_classes, truth, train_masks, c, n_iters,
+        n_classes))(kernels)
 
 
 def svm_cv_accuracy(kernels, labels, num_folds, C=1.0, n_iters=50):
     """Stratified k-fold CV accuracy for a batch of precomputed kernels.
 
     kernels : [B, n, n] per-voxel Gram matrices
-    labels : [n] binary condition labels
+    labels : [n] condition labels (two or more classes; multiclass uses
+        one-vs-one voting like sklearn SVC)
     Returns [B] mean fold accuracies, matching
     ``cross_val_score(SVC(kernel='precomputed'), ...)`` semantics
-    (StratifiedKFold without shuffling, unweighted fold mean).
+    (StratifiedKFold without shuffling, unweighted fold mean).  For more
+    than two classes, vote TIE-BREAKING differs from libsvm (argmax picks
+    the lowest class index; libsvm uses training order and a strict
+    dec > 0), so multiclass accuracies agree within the reference's
+    per-epoch tolerance rather than exactly.
     """
+    from itertools import combinations
+
     from sklearn.model_selection import StratifiedKFold
 
     labels = np.asarray(labels)
     classes = np.unique(labels)
-    if len(classes) != 2:
-        raise ValueError("On-device SVM CV supports binary labels; got "
-                         f"{len(classes)} classes")
-    y_signed = np.where(labels == classes[0], -1.0, 1.0)
+    if len(classes) < 2:
+        raise ValueError("Need at least two classes; got "
+                         f"{len(classes)}")
+    n = len(labels)
+    class_idx = np.searchsorted(classes, labels)
+
+    pair_y, pair_classes = [], []
+    for a, b in combinations(range(len(classes)), 2):
+        y = np.zeros(n)
+        y[class_idx == a] = 1.0
+        y[class_idx == b] = -1.0
+        pair_y.append(y)
+        pair_classes.append([a, b])
 
     skf = StratifiedKFold(n_splits=num_folds, shuffle=False)
-    train_masks = np.zeros((num_folds, len(labels)))
-    for f, (train_idx, _) in enumerate(skf.split(np.zeros(len(labels)),
-                                                 labels)):
+    train_masks = np.zeros((num_folds, n))
+    for f, (train_idx, _) in enumerate(skf.split(np.zeros(n), labels)):
         train_masks[f, train_idx] = 1.0
 
-    out = _cv_batch(jnp.asarray(kernels), jnp.asarray(y_signed),
-                    jnp.asarray(train_masks), float(C), int(n_iters))
+    out = _cv_batch(jnp.asarray(kernels), jnp.asarray(np.stack(pair_y)),
+                    jnp.asarray(np.asarray(pair_classes)),
+                    jnp.asarray(class_idx),
+                    jnp.asarray(train_masks), float(C), int(n_iters),
+                    len(classes))
     return np.asarray(out)

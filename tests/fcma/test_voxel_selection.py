@@ -150,3 +150,19 @@ def test_voxel_selection_pallas_path_matches_xla():
     for (v0, a0), (v1, a1) in zip(xla, pallas):
         assert v0 == v1
         assert np.isclose(a0, a1, atol=1e-4)
+
+
+def test_voxel_selection_multiclass_on_device():
+    """Three-condition voxel selection: the on-device one-vs-one SVM
+    matches sklearn SVC's multiclass CV within the reference tolerance."""
+    prng = RandomState(7)
+    n_e = 12  # 2 subjects x 6 epochs, 3 conditions
+    fake_raw_data = [create_epoch(prng, col=6) for _ in range(n_e)]
+    labels = [0, 1, 2, 0, 1, 2, 0, 1, 2, 0, 1, 2]
+    vs = VoxelSelector(labels, 6, 3, fake_raw_data, voxel_unit=3)
+    clf = svm.SVC(kernel='precomputed', shrinking=False, C=1)
+    skl = sorted(vs.run(clf))
+    dev = sorted(vs.run('svm'))
+    for (v0, a0), (v1, a1) in zip(skl, dev):
+        assert v0 == v1
+        assert abs(a0 - a1) * n_e <= 2  # within 2 epochs of SVC
