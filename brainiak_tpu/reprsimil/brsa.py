@@ -46,7 +46,29 @@ from ..utils.utils import cov2corr
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["BRSA", "GBRSA"]
+__all__ = ["BRSA", "GBRSA", "Ncomp_SVHT_MG_DLD_approx"]
+
+
+def Ncomp_SVHT_MG_DLD_approx(X, zscore=True):
+    """Optimal number of principal components by the Gavish & Donoho
+    singular-value hard threshold ("the optimal hard threshold is
+    4/sqrt(3)"), using their omega(beta) approximation
+    (reference brsa.py:157-187).  Used to auto-select ``n_nureg``."""
+    X = np.asarray(X, dtype=float)
+    beta = X.shape[0] / X.shape[1]
+    if beta > 1:
+        beta = 1 / beta
+    omega = 0.56 * beta ** 3 - 0.95 * beta ** 2 + 1.82 * beta + 1.43
+    if zscore:
+        std = X.std(axis=0)
+        Xz = np.where(std > 0, (X - X.mean(axis=0)) / np.where(
+            std > 0, std, 1.0), 0.0)
+        sing = np.linalg.svd(Xz, compute_uv=False)
+    else:
+        sing = np.linalg.svd(X, compute_uv=False)
+    thresh = omega * np.median(sing)
+    return int(np.sum(np.logical_and(
+        sing > thresh, np.logical_not(np.isclose(sing, thresh)))))
 
 
 def _ar1_quad(y, rho, scan_starts_mask):
@@ -333,7 +355,13 @@ class BRSA(BaseEstimator, TransformerMixin):
                     (resid.std(0) + 1e-12)
             else:
                 resid_n = resid
-            n_comp = min(self.n_nureg, n_v - 1, n_t - 1)
+            n_nureg = self.n_nureg
+            if n_nureg is None:
+                # Gavish-Donoho auto-selection (reference brsa.py:460-466)
+                # on the already-normalized residuals
+                n_nureg = max(Ncomp_SVHT_MG_DLD_approx(
+                    resid_n, zscore=False), 1)
+            n_comp = min(n_nureg, n_v - 1, n_t - 1)
             pca = PCA(n_components=n_comp)
             comps = pca.fit_transform(resid_n)
             X0 = np.column_stack(
@@ -608,7 +636,11 @@ class GBRSA(BRSA):
                 if self.nureg_zscore:
                     resid = (resid - resid.mean(0)) / \
                         (resid.std(0) + 1e-12)
-                n_comp = min(self.n_nureg, resid.shape[1] - 1,
+                n_nureg = self.n_nureg
+                if n_nureg is None:
+                    n_nureg = max(Ncomp_SVHT_MG_DLD_approx(
+                        resid, False), 1)
+                n_comp = min(n_nureg, resid.shape[1] - 1,
                              resid.shape[0] - 1)
                 comps = PCA(n_components=n_comp).fit_transform(resid)
                 new_subj.append(build_subject(

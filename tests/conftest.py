@@ -24,13 +24,25 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 # Reference algorithms are float64 (NumPy defaults); tests mirror that.
-# The TPU production path passes float32 data explicitly.
-jax.config.update("jax_enable_x64", True)
+# The TPU production path passes float32 data explicitly.  Set
+# BRAINIAK_TPU_TEST_X64=0 to sweep the suite in fp32 (TPU-like numerics).
+jax.config.update("jax_enable_x64",
+                  os.environ.get("BRAINIAK_TPU_TEST_X64", "1") != "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mesh_atol():
+    """Sharded-vs-single comparisons are bit-exact in f64 but only
+    reduction-order-close in fp32 (the TPU-like sweep)."""
+    import jax
+    return 1e-8 if jax.config.jax_enable_x64 else 2e-4
 
 
 @pytest.fixture

@@ -17,7 +17,7 @@ def psum_worker(process_id, num_processes):
     mesh = Mesh(devices, ("subject",))
     n_global = len(devices)
     # each process contributes its local slice of a global array
-    local = np.arange(jax.local_device_count(), dtype=np.float64) + \
+    local = np.arange(jax.local_device_count(), dtype=float) + \
         process_id * jax.local_device_count()
     global_shape = (n_global,)
     arr = jax.make_array_from_process_local_data(
@@ -51,7 +51,7 @@ def srm_worker(process_id, num_processes):
     local = data[process_id * n_local:(process_id + 1) * n_local]
     arr = jax.make_array_from_process_local_data(sharding, local,
                                                  data.shape)
-    voxel_counts = jnp.full((n_subjects,), voxels, jnp.float64)
+    voxel_counts = jnp.full((n_subjects,), voxels)
     key = jax.random.PRNGKey(0)
     fit = jax.jit(_fit_det_srm, static_argnames=("features", "n_iter"))
     w, shared, objective = fit(arr, voxel_counts, key, features=features,

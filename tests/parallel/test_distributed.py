@@ -6,12 +6,18 @@ import numpy as np
 import pytest
 
 from brainiak_tpu.parallel.testing import run_distributed
+from tests.conftest import REPO_ROOT, mesh_atol
+
+
+def _x64():
+    import jax
+    return bool(jax.config.jax_enable_x64)
 
 
 def test_distributed_psum():
     results = run_distributed("tests.parallel.dist_workers", "psum_worker",
-                              n_procs=2, local_devices=2,
-                              extra_path="/root/repo")
+                              n_procs=2, local_devices=2, x64=_x64(),
+                              extra_path=REPO_ROOT)
     totals = [r[0] for r in results]
     n_global = results[0][1]
     assert n_global == 4
@@ -21,8 +27,8 @@ def test_distributed_psum():
 
 def test_distributed_detsrm_matches_single_process():
     results = run_distributed("tests.parallel.dist_workers", "srm_worker",
-                              n_procs=2, local_devices=2,
-                              extra_path="/root/repo")
+                              n_procs=2, local_devices=2, x64=_x64(),
+                              extra_path=REPO_ROOT)
     shared_0, obj_0 = results[0]
     shared_1, obj_1 = results[1]
     # both processes agree on the replicated shared response
@@ -43,9 +49,10 @@ def test_distributed_detsrm_matches_single_process():
         q, _ = np.linalg.qr(rng.randn(voxels, features))
         data[i] = q @ S + 0.01 * rng.randn(voxels, samples)
     w, shared, objective = _fit_det_srm_jit(
-        jnp.asarray(data), jnp.full((n_subjects,), voxels, jnp.float64),
+        jnp.asarray(data), jnp.full((n_subjects,), voxels),
         jax.random.PRNGKey(0), features=features, n_iter=5)
-    assert np.allclose(np.asarray(shared), shared_0, atol=1e-8)
+    atol = mesh_atol()
+    assert np.allclose(np.asarray(shared), shared_0, atol=atol)
 
 
 def test_distributed_fast_failure_reporting():
@@ -56,5 +63,6 @@ def test_distributed_fast_failure_reporting():
     t0 = time.time()
     with pytest.raises(RuntimeError, match="intentional worker failure"):
         run_distributed("tests.parallel.dist_workers", "failing_worker",
-                        n_procs=2, local_devices=1, timeout=180)
+                        n_procs=2, local_devices=1, timeout=180,
+                        extra_path=REPO_ROOT)
     assert time.time() - t0 < 60  # far less than the 180s timeout

@@ -156,3 +156,27 @@ def test_gbrsa_auto_nuisance_and_priors():
     assert np.isfinite(ll) and np.isfinite(ll_null)
     with pytest.raises(ValueError):
         GBRSA(SNR_prior='gaussian').fit(Y, design)
+
+
+def test_ncomp_svht():
+    from brainiak_tpu.reprsimil.brsa import Ncomp_SVHT_MG_DLD_approx
+
+    rng = np.random.RandomState(0)
+    # low-rank signal + noise: SVHT should find ~the true rank
+    U = rng.randn(200, 3)
+    V = rng.randn(3, 100)
+    X = U @ V + 0.1 * rng.randn(200, 100)
+    ncomp = Ncomp_SVHT_MG_DLD_approx(X, zscore=False)
+    assert 2 <= ncomp <= 5
+    # pure noise: very few components survive
+    ncomp_noise = Ncomp_SVHT_MG_DLD_approx(rng.randn(200, 100),
+                                           zscore=False)
+    assert ncomp_noise <= ncomp
+
+
+def test_brsa_auto_n_nureg():
+    Y, design, _, _, onsets = make_brsa_data(n_v=40, seed=5)
+    model = BRSA(n_iter=2, auto_nuisance=True, n_nureg=None,
+                 lbfgs_iters=60, random_state=0)
+    model.fit(Y, design, scan_onsets=onsets)
+    assert model.X0_.shape[1] >= 2  # DC components + selected PCs
