@@ -11,8 +11,26 @@ import numpy as np
 __all__ = [
     "flatten_cholesky_unique",
     "rmn",
+    "scaled_I",
     "unflatten_cholesky_unique",
+    "x_tx",
+    "xx_t",
 ]
+
+
+def xx_t(x):
+    """x xᵀ (reference matnormal/utils.py:28-37)."""
+    return x @ x.T
+
+
+def x_tx(x):
+    """xᵀ x (reference matnormal/utils.py:40-48)."""
+    return x.T @ x
+
+
+def scaled_I(scale, size):
+    """scale · I (reference matnormal/utils.py:51-62)."""
+    return jnp.eye(size) * scale
 
 
 def tril_size(n):

@@ -32,6 +32,8 @@ Documented deviations from the reference's internals:
 import logging
 from functools import partial
 
+import scipy.stats
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -46,7 +48,36 @@ from ..utils.utils import cov2corr
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["BRSA", "GBRSA", "Ncomp_SVHT_MG_DLD_approx"]
+__all__ = ["BRSA", "GBRSA", "Ncomp_SVHT_MG_DLD_approx",
+           "prior_GP_var_half_cauchy", "prior_GP_var_inv_gamma"]
+
+
+def prior_GP_var_inv_gamma(y_invK_y, n_y, tau_range):
+    """MAP estimate of a Gaussian-Process variance tau^2 under an
+    inverse-Gamma(2, tau_range^2) prior, plus the log posterior density
+    at the MAP (reference brsa.py:70-155).  y_invK_y = y K^{-1} yᵀ for
+    n_y observations of the GP-distributed function (e.g. log-SNR)."""
+    import scipy.stats
+
+    alpha = 2
+    tau2 = (y_invK_y + 2 * tau_range ** 2) / (alpha * 2 + 2 + n_y)
+    log_ptau = scipy.stats.invgamma.logpdf(tau2, scale=tau_range ** 2,
+                                           a=2)
+    return tau2, log_ptau
+
+
+def prior_GP_var_half_cauchy(y_invK_y, n_y, tau_range):
+    """MAP estimate of a Gaussian-Process variance tau^2 under a
+    half-Cauchy(tau_range) prior on tau, plus the log prior density at
+    the MAP (reference brsa.py:120-155)."""
+    import scipy.stats
+
+    tau2 = (y_invK_y - n_y * tau_range ** 2
+            + np.sqrt(n_y ** 2 * tau_range ** 4 + (2 * n_y + 8)
+                      * tau_range ** 2 * y_invK_y + y_invK_y ** 2))         / 2 / (n_y + 2)
+    log_ptau = scipy.stats.halfcauchy.logpdf(tau2 ** 0.5,
+                                             scale=tau_range)
+    return tau2, log_ptau
 
 
 def Ncomp_SVHT_MG_DLD_approx(X, zscore=True):

@@ -22,11 +22,16 @@ from jax.scipy.linalg import solve_triangular
 
 __all__ = [
     "kron_mult",
+    "masked_triangular_solve",
     "solve_lower_triangular_kron",
     "solve_lower_triangular_masked_kron",
     "solve_upper_triangular_kron",
     "solve_upper_triangular_masked_kron",
 ]
+
+# Naming note: the reference exports these with a ``tf_`` prefix
+# (tf_solve_lower_triangular_kron etc.); the prefix is dropped here since
+# there is no TensorFlow.
 
 
 def _axiswise(Ls, y, op):
@@ -68,18 +73,25 @@ def _dense_kron(Ls):
 
 
 def _masked_solve(Ls, y, mask, upper):
-    """Solve the mask-restricted triangular system; masked rows of the
-    output are zero."""
-    L = _dense_kron(Ls)
+    """Solve the mask-restricted triangular Kronecker system via the
+    single-matrix primitive; masked rows of the output are zero."""
+    return masked_triangular_solve(_dense_kron(Ls), y, mask,
+                                   lower=True, adjoint=upper)
+
+
+def masked_triangular_solve(L, y, mask, lower=True, adjoint=False):
+    """Triangular solve restricted to the masked principal submatrix
+    (masked rows of the output are zero) — the single-matrix primitive
+    underlying the masked Kronecker solves (reference
+    kronecker_solvers.py:150-267, ``tf_masked_triangular_solve``)."""
     mask = jnp.asarray(mask, bool)
     idx = jnp.where(mask)[0]
     sub = L[jnp.ix_(idx, idx)]
     y2 = y if y.ndim == 2 else y[:, None]
     rhs = y2[idx]
-    if upper:
-        out = solve_triangular(sub.T, rhs, lower=False)
-    else:
-        out = solve_triangular(sub, rhs, lower=True)
+    use_lower = lower != adjoint
+    mat = sub.T if adjoint else sub
+    out = solve_triangular(mat, rhs, lower=use_lower)
     full = jnp.zeros_like(y2)
     full = full.at[idx].set(out)
     return full if y.ndim == 2 else full[:, 0]

@@ -145,3 +145,42 @@ def test_mnrsa_recovers_rsa_structure():
                     U[np.triu_indices(n_c, 1)])[0, 1]
     assert c > 0.7
     assert np.isfinite(model.final_loss_)
+
+
+def test_parity_helpers():
+    from brainiak_tpu.matnormal.utils import scaled_I, x_tx, xx_t
+    from brainiak_tpu.utils.kronecker_solvers import \
+        masked_triangular_solve
+
+    x = jnp.asarray(RNG.randn(4, 3))
+    assert np.allclose(np.asarray(xx_t(x)), np.asarray(x) @ np.asarray(x).T)
+    assert np.allclose(np.asarray(x_tx(x)), np.asarray(x).T @ np.asarray(x))
+    assert np.allclose(np.asarray(scaled_I(2.5, 3)), 2.5 * np.eye(3))
+
+    L = np.linalg.cholesky(_spd(5, RNG))
+    y = RNG.randn(5, 2)
+    mask = np.array([1, 0, 1, 1, 0])
+    got = np.asarray(masked_triangular_solve(jnp.asarray(L),
+                                             jnp.asarray(y), mask))
+    idx = np.where(mask)[0]
+    expected = np.zeros_like(y)
+    expected[idx] = np.linalg.solve(L[np.ix_(idx, idx)], y[idx])
+    assert np.allclose(got, expected)
+    # adjoint solve
+    got_a = np.asarray(masked_triangular_solve(
+        jnp.asarray(L), jnp.asarray(y), mask, adjoint=True))
+    expected_a = np.zeros_like(y)
+    expected_a[idx] = np.linalg.solve(L[np.ix_(idx, idx)].T, y[idx])
+    assert np.allclose(got_a, expected_a)
+
+
+def test_gp_var_priors():
+    from brainiak_tpu.reprsimil.brsa import (
+        prior_GP_var_half_cauchy,
+        prior_GP_var_inv_gamma,
+    )
+
+    tau2, logp = prior_GP_var_inv_gamma(5.0, 20, 1.0)
+    assert tau2 > 0 and np.isfinite(logp)
+    tau2_hc, logp_hc = prior_GP_var_half_cauchy(5.0, 20, 1.0)
+    assert tau2_hc > 0 and np.isfinite(logp_hc)
