@@ -10,7 +10,6 @@ features the framework needs — not a general neuroimaging library.
 
 import gzip
 import struct
-from pathlib import Path
 
 import numpy as np
 

@@ -11,8 +11,6 @@ criterion is recovery quality, not iterate-level parity with scipy
 (SURVEY.md §7 hard part #2).
 """
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import optax

@@ -20,7 +20,6 @@ Here the engine is two-tier:
 """
 
 import logging
-from functools import partial
 
 import jax
 import jax.numpy as jnp
