@@ -43,18 +43,19 @@ def make_data(n_voxels=N_VOXELS):
     return data, labels
 
 
-def tpu_voxels_per_sec():
+def tpu_voxels_per_sec(n_voxels=N_VOXELS, unit=512, warm=True):
     from brainiak_tpu.fcma.voxelselector import VoxelSelector
 
-    data, labels = make_data()
+    data, labels = make_data(n_voxels)
     vs = VoxelSelector(labels, EPOCHS_PER_SUBJ, NUM_FOLDS, data,
-                       voxel_unit=512)
-    vs.run('svm')  # warm compile caches
+                       voxel_unit=unit)
+    if warm:
+        vs.run('svm')  # warm compile caches
     t0 = time.perf_counter()
     results = vs.run('svm')
     dt = time.perf_counter() - t0
-    assert len(results) == N_VOXELS
-    return N_VOXELS / dt
+    assert len(results) == n_voxels
+    return n_voxels / dt
 
 
 def cpu_voxels_per_sec(block=64):
@@ -90,7 +91,43 @@ def cpu_voxels_per_sec(block=64):
     return block / dt
 
 
+def _device_responsive(timeout=150):
+    """Probe the accelerator in a subprocess: a wedged TPU tunnel hangs
+    forever on the first dispatch (even block_until_ready is a no-op), so
+    never touch the device in-process before knowing it answers."""
+    import subprocess
+    import sys
+    code = ("import jax, jax.numpy as jnp;"
+            "print(float((jnp.ones((64,64))@jnp.ones((64,64)))[0,0]))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    # Probe BEFORE any in-process jax backend touch: on a wedged TPU
+    # tunnel even backend initialization (jax.default_backend()) hangs.
+    responsive = _device_responsive()
+    import jax
+
+    if not responsive:
+        # fall back to CPU so the driver records a number instead of a
+        # hung process (reduced size: the full problem takes tens of
+        # minutes on CPU)
+        jax.config.update("jax_platforms", "cpu")
+        vps = tpu_voxels_per_sec(n_voxels=2048, unit=256)
+        cpu_vps = cpu_voxels_per_sec(block=32)
+        print(json.dumps({
+            "metric": "fcma_voxel_selection_voxels_per_sec_chip"
+                      "_CPU_FALLBACK_tpu_unresponsive",
+            "value": round(vps, 2),
+            "unit": "voxels/sec",
+            "vs_baseline": round(vps / cpu_vps, 2),
+        }))
+        return
     tpu_vps = tpu_voxels_per_sec()
     cpu_vps = cpu_voxels_per_sec()
     print(json.dumps({
