@@ -10,9 +10,10 @@ bases.  Data may be arrays or ``.npy`` paths; ``temp_dir``/``low_ram``
 spill intermediates to disk; sessions may differ in length.
 
 The reduced-space SRM is the jitted :class:`~brainiak_tpu.funcalign.srm.DetSRM`
-program; basis SVDs and projections are jitted jnp ops.  joblib's process
-pool (reference's ``n_jobs``) is unnecessary for on-device math, but the
-parameter is accepted.
+program; basis SVDs and projections are jitted jnp ops.  ``n_jobs``
+parallelizes only the host-side load+reduce stage over subjects with
+joblib threads (useful for .npy path datasets where IO dominates); the
+device math needs no process pool.
 """
 
 import logging
@@ -81,7 +82,9 @@ class FastSRM(BaseEstimator, TransformerMixin):
     temp_dir : str or None — spill bases/reduced data as .npy
     low_ram : bool — with temp_dir, keep intermediates on disk
     seed : int
-    n_jobs : accepted for API compatibility
+    n_jobs : joblib threads for the host-side load+reduce stage over
+        subjects (IO-bound for .npy path datasets); device math is
+        unaffected
     aggregate : 'mean' or None — transform returns the subject mean or
         per-subject projections
     """
@@ -160,10 +163,20 @@ class FastSRM(BaseEstimator, TransformerMixin):
                                  "of sessions")
 
         atlas, inv_atlas = self._atlas_parts()
-        reduced = [[self._maybe_spill(
-            _reduce_one(_safe_load(imgs[i][j]), atlas, inv_atlas),
-            f"reduced_{i}_{j}") for j in range(n_sessions)]
-            for i in range(n_subjects)]
+
+        def reduce_subject(i):
+            return [self._maybe_spill(
+                _reduce_one(_safe_load(imgs[i][j]), atlas, inv_atlas),
+                f"reduced_{i}_{j}") for j in range(n_sessions)]
+
+        if self.n_jobs not in (None, 1):
+            from joblib import Parallel, delayed
+
+            # threads: the work is IO + NumPy/jnp releasing the GIL
+            reduced = Parallel(n_jobs=self.n_jobs, prefer="threads")(
+                delayed(reduce_subject)(i) for i in range(n_subjects))
+        else:
+            reduced = [reduce_subject(i) for i in range(n_subjects)]
 
         # Reduced-space deterministic SRM on session-concatenated data
         # (reference fast_srm, fastsrm.py:955-1021).

@@ -67,6 +67,21 @@ def test_fastsrm_probabilistic_atlas():
     assert out[0].shape == (3, 30)
 
 
+def test_fastsrm_parallel_reduce_matches_serial(tmp_path):
+    imgs, _, _ = make_fastsrm_data(n_subjects=3)
+    serial = FastSRM(n_components=3, n_iter=15, n_jobs=1).fit(imgs)
+    parallel = FastSRM(n_components=3, n_iter=15, n_jobs=3).fit(imgs)
+    for b0, b1 in zip(serial.basis_list, parallel.basis_list):
+        assert np.allclose(b0, b1, atol=1e-10)
+    # threaded reduce combined with the disk-spill path
+    spill = FastSRM(n_components=3, n_iter=15, n_jobs=3,
+                    temp_dir=str(tmp_path), low_ram=True).fit(imgs)
+    for b0, b1 in zip(serial.basis_list, spill.basis_list):
+        assert np.allclose(b0, np.load(b1) if isinstance(b1, str) else b1,
+                           atol=1e-10)
+    spill.clean()
+
+
 def test_fastsrm_paths_and_low_ram(tmp_path):
     imgs, _, _ = make_fastsrm_data(n_subjects=3)
     paths = np.empty((3, 2), dtype=object)
