@@ -18,6 +18,7 @@ __all__ = [
     "DEFAULT_VOXEL_AXIS",
     "initialize_distributed",
     "make_mesh",
+    "max_divisible_shards",
     "replicated",
     "shard_along",
     "subject_voxel_mesh",
@@ -40,6 +41,14 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id)
+
+
+def max_divisible_shards(axis_length: int, devices=None) -> int:
+    """Largest shard count that evenly divides ``axis_length`` and fits
+    the available devices — sharded array dimensions must divide the mesh
+    axis, so e.g. 6 subjects on 8 devices shard 6 ways."""
+    n = len(jax.devices() if devices is None else devices)
+    return max(d for d in range(1, n + 1) if axis_length % d == 0)
 
 
 def make_mesh(axis_names: Sequence[str], axis_sizes: Sequence[int],

@@ -36,6 +36,7 @@ def main():
 
     from brainiak_tpu.funcalign.srm import SRM
     from brainiak_tpu.parallel import make_mesh
+    from brainiak_tpu.parallel.mesh import max_divisible_shards
 
     rng = np.random.RandomState(0)
     S = rng.randn(args.features, args.trs)
@@ -51,9 +52,10 @@ def main():
 
     mesh = None
     if args.mesh:
-        n = len(jax.devices())
-        mesh = make_mesh(("subject",), (n,))
-        print(f"sharding subjects over {n} devices")
+        shards = max_divisible_shards(args.subjects)
+        mesh = make_mesh(("subject",), (shards,))
+        print(f"sharding subjects over {shards} of "
+              f"{len(jax.devices())} devices")
 
     model = SRM(n_iter=15, features=args.features, mesh=mesh)
     model.fit(train)

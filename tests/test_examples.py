@@ -1,0 +1,54 @@
+"""Smoke tests: every example script runs end-to-end as a CLI.
+
+The analog of the reference's notebook-execution tests
+(tests/test_notebooks.py), but on the runnable example scripts with small
+parameters.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import REPO_ROOT
+
+EXAMPLES = os.path.join(REPO_ROOT, "examples")
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), "--backend",
+         "cpu", *args],
+        capture_output=True, timeout=timeout, env=env, cwd=REPO_ROOT)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    return r.stdout.decode()
+
+
+def test_example_fcma():
+    out = _run("fcma_voxel_selection_and_classification.py", "--top", "10")
+    assert "classification accuracy" in out
+
+
+def test_example_srm_with_mesh():
+    out = _run("srm_image_reconstruction.py", "--subjects", "4",
+               "--voxels", "120", "--trs", "80", "--features", "5",
+               "--mesh")
+    assert "shared-space correlation" in out
+
+
+def test_example_isc():
+    out = _run("isc_statistics.py", "--subjects", "8", "--trs", "120",
+               "--n-resamples", "100")
+    assert "bootstrap:" in out
+
+
+@pytest.mark.slow
+def test_example_htfa():
+    out = _run("htfa_template.py", "--subjects", "2")
+    assert "max center error" in out
