@@ -1,0 +1,85 @@
+"""Ring-sharded pairwise correlation over the voxel dimension.
+
+The framework's "long context" is the voxel axis (SURVEY.md §5.7): a full
+V×V correlation (the FCMA feature space, ISFC matrices, RSA kernels) at
+whole-brain V cannot replicate the data on every chip.  This module
+computes it the way ring attention computes long-sequence scores: the
+voxel axis is sharded over the mesh, each device keeps its local shard
+resident, and the peer shards ROTATE around the ring via
+``jax.lax.ppermute`` — after n_shards steps every [local × remote] block
+of the correlation matrix has been produced with only nearest-neighbor
+ICI traffic and O(V/n) memory per device, never materializing the full
+data anywhere.
+
+For data that fits replicated, prefer the plain einsum
+(:func:`brainiak_tpu.ops.correlation.correlate_epochs`); the ring pays
+communication to buy memory.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+from jax.experimental.shard_map import shard_map
+
+from .correlation import PRECISION
+
+__all__ = ["ring_correlation"]
+
+
+def ring_correlation(data, mesh, axis_name="voxel"):
+    """All-pairs Pearson correlation of the columns of ``data`` with the
+    voxel axis sharded around a ring.
+
+    data : [T, V] float array (V divisible by the mesh axis size);
+        columns are variables, rows observations.
+    mesh : jax.sharding.Mesh with ``axis_name``.
+    Returns corr [V, V], sharded over its first axis.
+    """
+    n_shards = mesh.shape[axis_name]
+    t, v = data.shape
+    assert v % n_shards == 0, \
+        f"voxel count {v} must divide the {axis_name} axis ({n_shards})"
+
+    # z-score + 1/sqrt(T) once, so each block is a plain matmul
+    mean = data.mean(axis=0, keepdims=True)
+    std = data.std(axis=0, keepdims=True)
+    safe_std = jnp.where(std > 0, std, 1.0)
+    z = jnp.where(std > 0, (data - mean) / (safe_std * np.sqrt(t)), 0.0)
+    z = jax.device_put(
+        z, NamedSharding(mesh, PartitionSpec(None, axis_name)))
+
+    def ring_fn(z_local):
+        # z_local: [T, V/n] — this device's resident shard
+        my_idx = jax.lax.axis_index(axis_name)
+        block_cols = z_local.shape[1]
+
+        def step(rotating, _):
+            # block of corr rows (local) x cols (the shard currently held)
+            block = jax.lax.dot_general(
+                z_local, rotating, (((0,), (0,)), ((), ())),
+                precision=PRECISION,
+                preferred_element_type=z_local.dtype)
+            # pass the visiting shard to the next device on the ring
+            rotating = jax.lax.ppermute(
+                rotating, axis_name,
+                [(i, (i + 1) % n_shards) for i in range(n_shards)])
+            return rotating, block
+
+        _, blocks = jax.lax.scan(step, z_local, None, length=n_shards)
+        # blocks[s] holds corr[local, owner] where the owner of the shard
+        # seen at step s is (my_idx - s) mod n_shards; scatter into place
+        owners = (my_idx - jnp.arange(n_shards)) % n_shards
+        out = jnp.zeros((z_local.shape[1], n_shards, block_cols),
+                        dtype=z_local.dtype)
+        out = out.at[:, owners, :].set(
+            jnp.transpose(blocks, (1, 0, 2)))
+        return out.reshape(z_local.shape[1], n_shards * block_cols)
+
+    corr = shard_map(
+        ring_fn, mesh=mesh,
+        in_specs=PartitionSpec(None, axis_name),
+        out_specs=PartitionSpec(axis_name, None))(z)
+    return corr

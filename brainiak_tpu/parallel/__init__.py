@@ -12,6 +12,7 @@ from .mesh import (  # noqa: F401
     DEFAULT_VOXEL_AXIS,
     initialize_distributed,
     make_mesh,
+    max_divisible_shards,
     replicated,
     shard_along,
     subject_voxel_mesh,

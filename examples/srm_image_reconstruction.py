@@ -35,8 +35,7 @@ def main():
         jax.config.update("jax_platforms", args.backend)
 
     from brainiak_tpu.funcalign.srm import SRM
-    from brainiak_tpu.parallel import make_mesh
-    from brainiak_tpu.parallel.mesh import max_divisible_shards
+    from brainiak_tpu.parallel import make_mesh, max_divisible_shards
 
     rng = np.random.RandomState(0)
     S = rng.randn(args.features, args.trs)
