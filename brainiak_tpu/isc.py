@@ -275,12 +275,20 @@ def _check_targets_input(targets, data):
 
 
 def isfc(data, targets=None, pairwise=False, summary_statistic=None,
-         vectorize_isfcs=True, tolerate_nans=True):
+         vectorize_isfcs=True, tolerate_nans=True, mesh=None):
     """Intersubject functional correlation (reference isc.py:211-370).
 
     Correlates each subject's voxel time series with (a) the average of the
     other subjects' series (leave-one-out), or (b) each other subject's
     series (pairwise); optionally against a separate ``targets`` array.
+
+    mesh : optional :class:`jax.sharding.Mesh` with a ``voxel`` axis — the
+        leave-one-out V×V matrices are then computed by
+        :func:`brainiak_tpu.ops.ring.ring_correlation` with the voxel axis
+        sharded around the ring (O(V/n) per-device memory), for voxel
+        counts too large to replicate per device.  Requires > 2 subjects,
+        leave-one-out mode, targets with the same voxel count as data, and
+        the post-NaN-threshold voxel count divisible by the mesh axis.
     """
     data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
     targets, t_n_TRs, t_n_voxels, _, symmetric = (
@@ -291,14 +299,42 @@ def isfc(data, targets=None, pairwise=False, summary_statistic=None,
     targets, targets_mask = _threshold_nans(targets, tolerate_nans)
 
     if symmetric and n_subjects == 2:
+        if mesh is not None:
+            raise ValueError("mesh-sharded ISFC requires more than 2 "
+                             "subjects (the 2-subject case has no "
+                             "leave-one-out mean)")
         m = np.asarray(_pearson_rows(jnp.asarray(data[..., 0].T),
                                      jnp.asarray(data[..., 1].T)))
         isfcs = ((m + m.T) / 2)[..., np.newaxis]
         summary_statistic = None
     elif pairwise:
+        if mesh is not None:
+            raise ValueError("mesh-sharded ISFC only supports "
+                             "leave-one-out (pairwise=False)")
         iu = np.triu_indices(n_subjects, k=1)
         isfcs = np.asarray(_isfc_pairwise_core(
             jnp.asarray(data), jnp.asarray(iu[0]), jnp.asarray(iu[1])))
+    elif mesh is not None:
+        from .ops.ring import ring_correlation
+        if data.shape[1] != targets.shape[1]:
+            raise ValueError("mesh-sharded ISFC requires targets with the "
+                             "same voxel count as data")
+        n_shards = mesh.shape["voxel"]
+        if data.shape[1] % n_shards != 0:
+            raise ValueError(
+                f"mesh-sharded ISFC requires the voxel count after NaN "
+                f"thresholding ({data.shape[1]} of {n_voxels} input "
+                f"voxels) to be divisible by the mesh 'voxel' axis "
+                f"size ({n_shards})")
+        target_means = _loo_means_core(jnp.asarray(targets),
+                                       bool(tolerate_nans))
+        data_j = jnp.asarray(data)
+        per_subj = []
+        for s in range(n_subjects):
+            m = np.asarray(ring_correlation(
+                data_j[..., s], mesh, data_b=target_means[..., s]))
+            per_subj.append((m + m.T) / 2 if symmetric else m)
+        isfcs = np.stack(per_subj, axis=2)
     else:
         target_means = _loo_means_core(jnp.asarray(targets),
                                        bool(tolerate_nans))

@@ -16,7 +16,7 @@ For data that fits replicated, prefer the plain einsum
 communication to buy memory.
 """
 
-from functools import partial
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -29,32 +29,29 @@ from .correlation import PRECISION
 __all__ = ["ring_correlation"]
 
 
-def ring_correlation(data, mesh, axis_name="voxel"):
-    """All-pairs Pearson correlation of the columns of ``data`` with the
-    voxel axis sharded around a ring.
-
-    data : [T, V] float array (V divisible by the mesh axis size);
-        columns are variables, rows observations.
-    mesh : jax.sharding.Mesh with ``axis_name``.
-    Returns corr [V, V], sharded over its first axis.
-    """
-    n_shards = mesh.shape[axis_name]
-    t, v = data.shape
-    assert v % n_shards == 0, \
-        f"voxel count {v} must divide the {axis_name} axis ({n_shards})"
-
-    # z-score + 1/sqrt(T) once, so each block is a plain matmul
+def _zscore_cols(data):
+    """Column z-score + 1/sqrt(T), zero for constant columns (matching
+    compute_correlation) and NaN for NaN-containing columns (so missing
+    data propagates instead of fabricating finite correlations), making a
+    plain dot of two normalized columns their Pearson r."""
+    t = data.shape[0]
     mean = data.mean(axis=0, keepdims=True)
     std = data.std(axis=0, keepdims=True)
     safe_std = jnp.where(std > 0, std, 1.0)
     z = jnp.where(std > 0, (data - mean) / (safe_std * np.sqrt(t)), 0.0)
-    z = jax.device_put(
-        z, NamedSharding(mesh, PartitionSpec(None, axis_name)))
+    return jnp.where(jnp.isnan(std), jnp.nan, z)
 
-    def ring_fn(z_local):
-        # z_local: [T, V/n] — this device's resident shard
+
+@functools.lru_cache(maxsize=None)
+def _ring_program(mesh, axis_name):
+    """Build (once per mesh/axis) the jitted ring program; jit caching
+    keeps repeated calls — e.g. per-subject ISFC — from re-tracing."""
+    n_shards = mesh.shape[axis_name]
+
+    def ring_fn(z_local, zb_local):
+        # z_local stays resident; zb shards visit around the ring
         my_idx = jax.lax.axis_index(axis_name)
-        block_cols = z_local.shape[1]
+        block_cols = zb_local.shape[1]
 
         def step(rotating, _):
             # block of corr rows (local) x cols (the shard currently held)
@@ -68,7 +65,7 @@ def ring_correlation(data, mesh, axis_name="voxel"):
                 [(i, (i + 1) % n_shards) for i in range(n_shards)])
             return rotating, block
 
-        _, blocks = jax.lax.scan(step, z_local, None, length=n_shards)
+        _, blocks = jax.lax.scan(step, zb_local, None, length=n_shards)
         # blocks[s] holds corr[local, owner] where the owner of the shard
         # seen at step s is (my_idx - s) mod n_shards; scatter into place
         owners = (my_idx - jnp.arange(n_shards)) % n_shards
@@ -78,8 +75,40 @@ def ring_correlation(data, mesh, axis_name="voxel"):
             jnp.transpose(blocks, (1, 0, 2)))
         return out.reshape(z_local.shape[1], n_shards * block_cols)
 
-    corr = shard_map(
+    return jax.jit(shard_map(
         ring_fn, mesh=mesh,
-        in_specs=PartitionSpec(None, axis_name),
-        out_specs=PartitionSpec(axis_name, None))(z)
-    return corr
+        in_specs=(PartitionSpec(None, axis_name),
+                  PartitionSpec(None, axis_name)),
+        out_specs=PartitionSpec(axis_name, None)))
+
+
+def ring_correlation(data, mesh, data_b=None, axis_name="voxel"):
+    """All-pairs Pearson correlation of the columns of ``data`` (against
+    the columns of ``data_b`` when given) with the voxel axis sharded
+    around a ring.
+
+    data : [T, V] float array (V divisible by the mesh axis size);
+        columns are variables, rows observations.
+    data_b : optional [T, V] second array — computes the
+        cross-correlation corr[i, j] = r(data[:, i], data_b[:, j]) (the
+        LOO-ISFC pattern); ``data``'s shard stays resident while
+        ``data_b``'s shards rotate.
+    mesh : jax.sharding.Mesh with ``axis_name``.
+    Returns corr [V, V], sharded over its first axis.
+    """
+    n_shards = mesh.shape[axis_name]
+    v = data.shape[1]
+    assert v % n_shards == 0, \
+        f"voxel count {v} must be divisible by the {axis_name} axis " \
+        f"size ({n_shards})"
+    if data_b is not None:
+        assert data_b.shape == data.shape, \
+            "data_b must have the same shape as data"
+
+    # shard FIRST, z-score after: the full [T, V] array is never resident
+    # on one device (z-scoring is columnwise, so it runs shard-local)
+    spec = NamedSharding(mesh, PartitionSpec(None, axis_name))
+    z = _zscore_cols(jax.device_put(data, spec))
+    z_b = z if data_b is None else _zscore_cols(
+        jax.device_put(data_b, spec))
+    return _ring_program(mesh, axis_name)(z, z_b)

@@ -120,6 +120,34 @@ def test_isfc_shapes_and_symmetry():
                               n_pairs_vox)
 
 
+def test_isfc_mesh_matches_dense():
+    """Ring-sharded leave-one-out ISFC equals the replicated einsum path."""
+    from brainiak_tpu.parallel import make_mesh
+    from tests.conftest import mesh_atol
+
+    rng = np.random.RandomState(3)
+    data = rng.randn(40, 16, 5)
+    mesh = make_mesh(("voxel",), (8,))
+    dense = isfc(data, vectorize_isfcs=False)
+    ringed = isfc(data, vectorize_isfcs=False, mesh=mesh)
+    assert ringed.shape == dense.shape
+    assert np.allclose(ringed, dense, atol=mesh_atol())
+    with pytest.raises(ValueError):
+        isfc(data, pairwise=True, mesh=mesh)
+    # a partially-NaN voxel (kept by tolerate_nans) must propagate NaN the
+    # same way the dense path does, not fabricate finite correlations
+    d = data.copy()
+    d[:5, 2, 1] = np.nan
+    dense_nan = isfc(d, vectorize_isfcs=False)
+    ring_nan = isfc(d, vectorize_isfcs=False, mesh=mesh)
+    assert np.array_equal(np.isnan(ring_nan), np.isnan(dense_nan))
+    assert np.allclose(ring_nan, dense_nan, atol=mesh_atol(),
+                       equal_nan=True)
+    # 2 subjects + mesh: explicit error, not silent dense fallback
+    with pytest.raises(ValueError):
+        isfc(data[..., :2], mesh=mesh)
+
+
 def test_isfc_targets_asymmetric():
     data = simulated_timeseries(5, 40, 4, random_state=4)
     targets = simulated_timeseries(5, 40, 7, random_state=5)

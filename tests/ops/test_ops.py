@@ -170,3 +170,8 @@ def test_ring_correlation_matches_dense():
     data2[:, 5] = 3.0
     corr2 = np.asarray(ring_correlation(data2, mesh))
     assert np.allclose(corr2[5], 0.0) and np.allclose(corr2[:, 5], 0.0)
+    # cross-correlation against a second array (the LOO-ISFC pattern)
+    other = rng.randn(T, V)
+    cross = np.asarray(ring_correlation(data, mesh, data_b=other))
+    dense_cross = np.corrcoef(data.T, other.T)[:V, V:]
+    assert np.allclose(cross, dense_cross, atol=mesh_atol())
