@@ -21,9 +21,11 @@ log-σ, transformed ρ, and nuisance amplitudes.
 Documented deviations from the reference's internals:
 - nuisance regressors are marginalized with learned per-voxel amplitudes
   instead of the reference's alternating explicit β₀ updates;
-- ``score`` evaluates the fitted per-voxel noise model on held-out data
-  after removing the predicted task response (the reference additionally
-  marginalizes an unknown shared nuisance time course, brsa.py:852-952);
+- ``score``'s null model marginalizes the nuisance time course through
+  the SAME fitted beta0 as the full model; the reference fits a separate
+  task-free nuisance model for the null (brsa.py:781-790).  The
+  state-space decoder in transform/score also treats the first sample of
+  each scan as stationary AR(1) noise rather than white;
 - the Gaussian-Process prior on log-SNR uses a squared-exponential kernel
   over coordinates (plus optional intensity) with fixed length scales
   taken from the data scale, rather than learned GP hyperparameters.
@@ -176,32 +178,165 @@ def _grid_marginal_ll(y, XL, s, r, starts, n_runs):
     return -0.5 * (t * jnp.log(quad) + logdet), quad / t
 
 
-def _ar1_ll_all_voxels(resid, rho, sigma, starts, n_runs):
-    """Vectorized AR(1) log-likelihood summed over voxels (used by score)."""
-    resid = jnp.asarray(resid)
-    n_t = resid.shape[0]
-    quads = jax.vmap(lambda y, r: _ar1_quad(y, r, starts),
-                     in_axes=(1, 0))(resid, jnp.asarray(rho))
-    s2 = jnp.asarray(sigma) ** 2
-    ll = -0.5 * (n_t * jnp.log(2 * jnp.pi * s2)
-                 - n_runs * jnp.log(1 - jnp.asarray(rho) ** 2)
-                 + quads / s2)
-    return float(jnp.sum(ll))
+def _ar1_yw(x, same_para=False):
+    """Yule-Walker AR(1) estimates per column of x (reference
+    brsa.py:1632-1660 via nitime AR_est_YW): rho from the lag-1/lag-0
+    autocovariance ratio and the innovation variance from the residual.
+    Raw (non-demeaned) autocovariances are used so constant regressors
+    (e.g. per-run DC columns) get a high-rho, small-innovation prior
+    rather than an undefined one.  With ``same_para`` all columns are
+    treated as one concatenated process (the reference's treatment of
+    the task design matrix)."""
+    x = np.asarray(x, dtype=float)
+
+    def one(v):
+        c0 = float(np.dot(v, v)) / len(v)
+        if c0 <= 1e-12:
+            return 0.0, 1e-6
+        c1 = float(np.dot(v[:-1], v[1:])) / len(v)
+        rho = float(np.clip(c1 / c0, -0.99, 0.99))
+        return rho, max(c0 - rho * c1, 1e-6 * c0)
+
+    if same_para:
+        rho, sig2 = one(x.reshape(-1, order='F'))
+        return (np.full(x.shape[1], rho), np.full(x.shape[1], sig2))
+    pairs = [one(x[:, c]) for c in range(x.shape[1])]
+    return (np.array([p[0] for p in pairs]),
+            np.array([p[1] for p in pairs]))
 
 
-def _gls_decode(W, sigma, X, X0=None):
-    """Weighted GLS decode of time courses against spatial patterns W
-    [components, voxels] with per-voxel noise sd, after projecting the
-    per-run DC / nuisance subspace out of X (matching what fit() removed
-    before estimating the patterns).  Returns [T, components]."""
-    X = np.asarray(X, dtype=float)
-    if X0 is not None and X0.shape[1] > 0:
-        Q, _ = np.linalg.qr(X0)
-        X = X - Q @ (Q.T @ X)
-    weights = 1.0 / (np.asarray(sigma) ** 2)
-    WtW = (W * weights) @ W.T
-    WtY = (W * weights) @ X.T
-    return np.linalg.solve(WtW + 1e-6 * np.eye(WtW.shape[0]), WtY).T
+def _whiten_segment(M, rho_e):
+    """AR(1)-whiten the rows of one within-scan segment: the first row is
+    scaled to the stationary marginal, subsequent rows become innovations.
+    M: [T, V]; rho_e: [V]."""
+    head = jnp.sqrt(1.0 - rho_e ** 2)[None, :] * M[:1]
+    return jnp.concatenate([head, M[1:] - rho_e[None, :] * M[:-1]], 0)
+
+
+@jax.jit
+def _lgssm_segment(Y, W, sigma2_e, rho_e, rho_x, sigma2_x):
+    """Exact posterior of latent time courses for one scan segment of the
+    linear-Gaussian model the reference decodes with a forward-backward
+    pass (reference brsa.py:1530-1582, 1664-1818):
+
+        z_t = diag(rho_x)·z_{t-1} + w_t,  w ~ N(0, diag(sigma2_x)),
+        Y_t = zₜ·W + e_t,                 e ~ stationary AR(1)(rho_e,
+                                               sigma2_e) per voxel,
+
+    with z_1 at the stationary AR(1) marginal.  TPU-native design: instead
+    of sequential Kalman recursions over Python lists, the joint posterior
+    precision is block-tridiagonal with K×K blocks shared across time, so
+    the smoother is a Cholesky block-Thomas solve as two ``lax.scan``s;
+    the linear term comes from autodiff of the explicit quadratic energy,
+    eliminating hand-derived cross terms.  Returns (mu [T, K],
+    log p(Y)); the noise model deviates from the reference in treating
+    the first sample of each segment as stationary AR(1).
+    """
+    t_n, v_n = Y.shape
+    k_n = W.shape[0]
+
+    def energy(Z):
+        resid_w = _whiten_segment(Y - Z @ W, rho_e)
+        e_term = 0.5 * jnp.sum(resid_w ** 2 / sigma2_e[None, :])
+        p_head = 0.5 * jnp.sum(Z[0] ** 2 * (1 - rho_x ** 2) / sigma2_x)
+        p_tail = 0.5 * jnp.sum(
+            (Z[1:] - rho_x[None, :] * Z[:-1]) ** 2 / sigma2_x[None, :])
+        return e_term + p_head + p_tail
+
+    b = -jax.grad(energy)(jnp.zeros((t_n, k_n), dtype=Y.dtype))
+
+    # shared K x K emission blocks (weighted Gram matrices of W)
+    def gram(wt):
+        return (W * wt[None, :]) @ W.T
+
+    A = gram(1.0 / sigma2_e)
+    B = gram(rho_e ** 2 / sigma2_e)
+    C = gram(rho_e / sigma2_e)
+    A1 = gram((1.0 - rho_e ** 2) / sigma2_e)
+
+    Pd = jnp.diag(1.0 / sigma2_x)
+    Pmid = jnp.diag((1.0 + rho_x ** 2) / sigma2_x)
+    R = jnp.diag(rho_x / sigma2_x)
+
+    if t_n == 1:
+        # single-sample segment: only the stationary prior and the
+        # stationary-noise emission enter (no transition terms)
+        D = (jnp.diag((1.0 - rho_x ** 2) / sigma2_x) + A1)[None]
+    else:
+        D = jnp.tile((Pmid + A + B)[None], (t_n, 1, 1))
+        D = D.at[0].set(Pd + A1 + B)
+        D = D.at[-1].set(Pd + A)
+    O = -(R + C)
+
+    # forward block-Thomas elimination
+    chol0 = jnp.linalg.cholesky(D[0])
+
+    def fwd(carry, inp):
+        chol_prev, m_prev = carry
+        d_t, b_t = inp
+        SO = jax.scipy.linalg.cho_solve((chol_prev, True), O)
+        Sm = jax.scipy.linalg.cho_solve((chol_prev, True), m_prev)
+        S_t = d_t - O.T @ SO
+        m_t = b_t - O.T @ Sm
+        chol_t = jnp.linalg.cholesky(S_t)
+        return (chol_t, m_t), (chol_t, m_t)
+
+    (_, _), (chols_tail, ms_tail) = jax.lax.scan(
+        fwd, (chol0, b[0]), (D[1:], b[1:]))
+    chols = jnp.concatenate([chol0[None], chols_tail], 0)
+    ms = jnp.concatenate([b[:1], ms_tail], 0)
+    logdet_h = 2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(chols, axis1=1, axis2=2)))
+
+    # backward substitution
+    mu_last = jax.scipy.linalg.cho_solve((chols[-1], True), ms[-1])
+
+    def bwd(mu_next, inp):
+        chol_t, m_t = inp
+        mu_t = jax.scipy.linalg.cho_solve(
+            (chol_t, True), m_t - O @ mu_next)
+        return mu_t, mu_t
+
+    _, mu_rev = jax.lax.scan(bwd, mu_last, (chols[:-1], ms[:-1]),
+                             reverse=True)
+    mu = jnp.concatenate([mu_rev, mu_last[None]], 0)
+
+    # marginal log-likelihood: -E(mu) + Gaussian integral + normalizers
+    noise_norm = -0.5 * t_n * jnp.sum(jnp.log(
+        2 * jnp.pi * sigma2_e)) + 0.5 * jnp.sum(jnp.log1p(-rho_e ** 2))
+    prior_norm = (
+        -0.5 * jnp.sum(jnp.log(2 * jnp.pi * sigma2_x / (1 - rho_x ** 2)))
+        - 0.5 * (t_n - 1) * jnp.sum(jnp.log(2 * jnp.pi * sigma2_x)))
+    log_p = (-energy(mu) + noise_norm + prior_norm - 0.5 * logdet_h +
+             0.5 * t_n * k_n * jnp.log(2 * jnp.pi))
+    return mu, log_p
+
+
+def _latent_ar1_params(design, X0):
+    """AR(1) smoothness priors for the decoded task and nuisance time
+    courses, estimated Yule-Walker from the training design matrix
+    (shared parameters) and nuisance regressors (per column) — the
+    reference estimates the same quantities at fit time
+    (brsa.py:778-780)."""
+    rho_d, sig2_d = _ar1_yw(design, same_para=True)
+    rho_0, sig2_0 = _ar1_yw(X0)
+    return rho_d, sig2_d, rho_0, sig2_0
+
+
+def _decode_timecourses(Y, weight, sigma2_e, rho_e, rho_x, sigma2_x,
+                        onsets):
+    """Run the smoother per scan segment; returns (mu [T, K], log_p)."""
+    n_t = Y.shape[0]
+    bounds = list(onsets) + [n_t]
+    mus, log_p = [], 0.0
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        mu, lp = _lgssm_segment(
+            jnp.asarray(Y[a:b]), jnp.asarray(weight),
+            jnp.asarray(sigma2_e), jnp.asarray(rho_e),
+            jnp.asarray(rho_x), jnp.asarray(sigma2_x))
+        mus.append(np.asarray(mu))
+        log_p += float(lp)
+    return np.concatenate(mus, 0), log_p
 
 
 def _make_L(l_flat, n_c, rank):
@@ -478,38 +613,53 @@ class BRSA(BaseEstimator, TransformerMixin):
         return np.asarray(beta).reshape(n_c, n_v), \
             np.asarray(beta0).reshape(n_0, n_v)
 
+    def _latent_ar1_params(self):
+        return _latent_ar1_params(self._design, self.X0_)
+
     def transform(self, X, y=None, scan_onsets=None):
         """Decode the task time course (ts) and shared nuisance time course
-        (ts0) from new data via GLS against the fitted spatial patterns
-        (reference brsa.py:793-851)."""
+        (ts0) from new data by exact posterior inference in a
+        linear-Gaussian state-space model: the fitted spatial patterns are
+        the emission weights, AR(1) smoothness priors on the latent time
+        courses are estimated from the training design/nuisance regressors,
+        and the per-voxel AR(1) noise model is the fitted one (reference
+        brsa.py:793-851 and the forward-backward pass at 1530-1582)."""
         assert hasattr(self, 'beta_'), 'Model has not been fit'
         assert X.ndim == 2 and X.shape[1] == self.beta_.shape[1], \
             'The shape of X is not consistent with the shape of data ' \
             'used in the fitting step.'
-        W = np.vstack([self.beta_, self.beta0_[:min(
-            self.beta0_.shape[0], self.X0_.shape[1])]])  # [C+n0, V]
+        n_t = X.shape[0]
+        onsets = self._check_onsets(scan_onsets, n_t)
         n_c = self.beta_.shape[0]
-        ts_all = _gls_decode(W, self.sigma_, X)
-        return ts_all[:, :n_c], ts_all[:, n_c:]
+        weight = np.vstack([self.beta_, self.beta0_])
+        rho_d, sig2_d, rho_0, sig2_0 = self._latent_ar1_params()
+        mu, _ = _decode_timecourses(
+            X, weight, self.sigma_ ** 2, self.rho_,
+            np.concatenate([rho_d, rho_0]),
+            np.concatenate([sig2_d, sig2_0]), onsets)
+        return mu[:, :n_c], mu[:, n_c:]
 
     def score(self, X, design, scan_onsets=None):
-        """Cross-validated log-likelihood of new data under the fitted
-        model and under a null model without the task response
-        (see module docstring for the deviation).  Returns (ll, ll_null)
-        (reference brsa.py:852-952)."""
+        """Cross-validated log-likelihood of new data with the unknown
+        shared nuisance time course marginalized under its AR(1) prior
+        (reference brsa.py:852-952, 1583-1631): the predicted task
+        response is subtracted (full model only), then the data
+        likelihood is evaluated with the nuisance spatial pattern beta0
+        as emission weights.  The null model reuses the fitted beta0
+        rather than refitting a task-free nuisance model (deviation, see
+        module docstring).  Returns (ll, ll_null)."""
         assert hasattr(self, 'beta_'), 'Model has not been fit'
         n_t = X.shape[0]
-        scan_onsets = self._check_onsets(scan_onsets, n_t)
-        scan_starts = np.zeros(n_t, dtype=bool)
-        scan_starts[scan_onsets] = True
-        n_runs = len(scan_onsets)
-
-        starts_j = jnp.asarray(scan_starts)
+        onsets = self._check_onsets(scan_onsets, n_t)
+        _, _, rho_0, sig2_0 = self._latent_ar1_params()
+        beta0 = self.beta0_
         pred = np.asarray(design) @ self.beta_
-        ll = _ar1_ll_all_voxels(np.asarray(X) - pred, self.rho_,
-                                self.sigma_, starts_j, n_runs)
-        ll_null = _ar1_ll_all_voxels(np.asarray(X), self.rho_,
-                                     self.sigma_, starts_j, n_runs)
+        _, ll = _decode_timecourses(
+            np.asarray(X) - pred, beta0, self.sigma_ ** 2, self.rho_,
+            rho_0, sig2_0, onsets)
+        _, ll_null = _decode_timecourses(
+            np.asarray(X), beta0, self.sigma_ ** 2, self.rho_,
+            rho_0, sig2_0, onsets)
         return ll, ll_null
 
 
@@ -608,10 +758,12 @@ class GBRSA(BRSA):
                 cols.append(extra_nuisance)
             X0 = np.column_stack(cols)
             Q, _ = np.linalg.qr(X0)
-            x = x - Q @ (Q.T @ x)
-            return (x, d, starts, len(onsets))
+            x_proj = x - Q @ (Q.T @ x)
+            return (x_proj, d, starts, len(onsets)), (x, X0, onsets)
 
-        subj_data = [build_subject(s) for s in range(n_subj)]
+        built = [build_subject(s) for s in range(n_subj)]
+        subj_data = [b[0] for b in built]
+        subj_aux = [b[1] for b in built]
 
         n_l = len(np.tril_indices(n_c, m=rank)[0])
 
@@ -676,7 +828,8 @@ class GBRSA(BRSA):
                 comps = PCA(n_components=n_comp).fit_transform(resid)
                 new_subj.append(build_subject(
                     s, comps / (comps.std(0) + 1e-12)))
-            subj_data = new_subj
+            subj_data = [b[0] for b in new_subj]
+            subj_aux = [b[1] for b in new_subj]
             L, value = fit_U(subj_data)
 
         self.L_ = L
@@ -684,12 +837,19 @@ class GBRSA(BRSA):
         self.C_ = cov2corr(self.U_ + 1e-12 * np.eye(n_c))
         self._final_loss = value
 
-        # per-subject, per-voxel posterior over the grids -> SNR and rho
+        # per-subject, per-voxel posterior over the grids -> SNR and rho;
+        # beta0 (spatial loading of the nuisance regressors, needed for
+        # the marginalized decoding in transform/score) is estimated on
+        # the UNprojected data after removing the posterior task response
         self.nSNR_ = []
         self.rho_ = []
         self.sigma_ = []
         self.beta_ = []
-        for x, d, starts, n_runs in subj_data:
+        self.beta0_ = []
+        self._X0_list = []
+        self._design_list = []
+        for (x, d, starts, n_runs), (raw, X0, onsets) in zip(
+                subj_data, subj_aux):
             snr_v, rho_v, sig_v, beta_v = self._grid_posteriors(
                 x, d, starts, n_runs, L, snr_grid, rho_grid,
                 snr_logprior)
@@ -697,9 +857,14 @@ class GBRSA(BRSA):
             self.rho_.append(rho_v)
             self.sigma_.append(sig_v)
             self.beta_.append(beta_v)
+            self.beta0_.append(np.linalg.lstsq(
+                X0, raw - d @ beta_v, rcond=None)[0])
+            self._X0_list.append(X0)
+            self._design_list.append(d)
         if n_subj == 1:
-            self.nSNR_, self.rho_, self.sigma_, self.beta_ = \
-                self.nSNR_[0], self.rho_[0], self.sigma_[0], self.beta_[0]
+            self.nSNR_, self.rho_, self.sigma_, self.beta_, self.beta0_ \
+                = (self.nSNR_[0], self.rho_[0], self.sigma_[0],
+                   self.beta_[0], self.beta0_[0])
         return self
 
     def _grid_posteriors(self, x, d, starts, n_runs, L, snr_grid,
@@ -732,38 +897,56 @@ class GBRSA(BRSA):
         return snr_v, rho_v, sig_v, beta_v
 
     def transform(self, X, y=None, scan_onsets=None):
-        """Decode per-subject task time courses from new data via GLS
-        against the fitted response patterns (reference
-        brsa.py:3190-3250).  Accepts one array or a per-subject list;
-        returns (ts, ts0) lists (ts0 is empty — GBRSA projects nuisance
-        out before fitting rather than estimating its spatial pattern)."""
+        """Decode per-subject task time courses (ts) and nuisance time
+        courses (ts0) from new data by exact posterior inference in the
+        linear-Gaussian state-space model (reference brsa.py:3190-3250,
+        decoded there by the forward-backward pass at 1530-1582): the
+        fitted task patterns beta and nuisance patterns beta0 are the
+        emission weights, AR(1) smoothness priors come Yule-Walker from
+        the training design/nuisance regressors, and the per-voxel noise
+        model is the grid-posterior one.  Accepts one array or a
+        per-subject list; returns (ts, ts0)."""
         if not hasattr(self, 'U_'):
             raise NotFittedError("The model fit has not been run yet.")
         single = isinstance(X, np.ndarray)
         Xs = [X] if single else list(X)
         betas = [self.beta_] if not isinstance(self.beta_, list) \
             else self.beta_
+        beta0s = [self.beta0_] if not isinstance(self.beta0_, list) \
+            else self.beta0_
         sigmas = [self.sigma_] if not isinstance(self.sigma_, list) \
             else self.sigma_
+        rhos = [self.rho_] if not isinstance(self.rho_, list) \
+            else self.rho_
         if len(Xs) != len(betas):
             raise ValueError(
                 "The number of subjects ({}) does not match the fitted "
                 "model ({})".format(len(Xs), len(betas)))
         ts_all, ts0_all = [], []
-        for s, (x, beta, sigma) in enumerate(zip(Xs, betas, sigmas)):
+        for s, (x, beta, beta0, sigma, rho) in enumerate(
+                zip(Xs, betas, beta0s, sigmas, rhos)):
             n_t = x.shape[0]
             raw = scan_onsets[s] if isinstance(scan_onsets, list) \
                 else scan_onsets
             onsets = self._check_onsets(raw, n_t)
-            X0 = self._dc_regressors(n_t, onsets)
-            ts_all.append(_gls_decode(beta, sigma, x, X0=X0))
-            ts0_all.append(np.zeros((n_t, 0)))
+            rho_d, sig2_d, rho_0, sig2_0 = _latent_ar1_params(
+                self._design_list[s], self._X0_list[s])
+            n_c = beta.shape[0]
+            mu, _ = _decode_timecourses(
+                x, np.vstack([beta, beta0]), sigma ** 2, rho,
+                np.concatenate([rho_d, rho_0]),
+                np.concatenate([sig2_d, sig2_0]), onsets)
+            ts_all.append(mu[:, :n_c])
+            ts0_all.append(mu[:, n_c:])
         if single:
             return ts_all[0], ts0_all[0]
         return ts_all, ts0_all
 
     def score(self, X, design, scan_onsets=None):
-        """Held-out log-likelihood per subject (see BRSA.score)."""
+        """Held-out log-likelihood per subject with the unknown nuisance
+        time course marginalized under its AR(1) prior through the fitted
+        spatial pattern beta0 (see BRSA.score; reference
+        brsa.py:3252-3390)."""
         if isinstance(X, np.ndarray):
             X = [X]
             design = [design]
@@ -771,6 +954,8 @@ class GBRSA(BRSA):
         for s in range(len(X)):
             beta = self.beta_ if not isinstance(self.beta_, list) \
                 else self.beta_[s]
+            beta0 = self.beta0_ if not isinstance(self.beta0_, list) \
+                else self.beta0_[s]
             rho = self.rho_ if not isinstance(self.rho_, list) \
                 else self.rho_[s]
             sigma = self.sigma_ if not isinstance(self.sigma_, list) \
@@ -779,16 +964,18 @@ class GBRSA(BRSA):
             raw = scan_onsets[s] if isinstance(scan_onsets, list) \
                 else scan_onsets
             onsets = self._check_onsets(raw, n_t)
-            starts = np.zeros(n_t, bool)
-            starts[onsets] = True
-            n_runs = len(onsets)
-            starts_j = jnp.asarray(starts)
+            _, _, rho_0, sig2_0 = _latent_ar1_params(
+                self._design_list[s], self._X0_list[s])
 
             pred = np.asarray(design[s]) @ beta
-            scores.append(_ar1_ll_all_voxels(
-                np.asarray(X[s]) - pred, rho, sigma, starts_j, n_runs))
-            scores_null.append(_ar1_ll_all_voxels(
-                np.asarray(X[s]), rho, sigma, starts_j, n_runs))
+            _, ll = _decode_timecourses(
+                np.asarray(X[s]) - pred, beta0, sigma ** 2, rho,
+                rho_0, sig2_0, onsets)
+            _, ll_null = _decode_timecourses(
+                np.asarray(X[s]), beta0, sigma ** 2, rho,
+                rho_0, sig2_0, onsets)
+            scores.append(ll)
+            scores_null.append(ll_null)
         if len(scores) == 1:
             return scores[0], scores_null[0]
         return scores, scores_null
