@@ -56,6 +56,70 @@ def _canonicalize_imgs(imgs):
                      "arrays, or a 2D array of paths")
 
 
+def _shape_of(img):
+    if isinstance(img, str):
+        return np.load(img, mmap_mode="r").shape
+    return np.asarray(img).shape
+
+
+def _check_imgs_consistency(imgs, atlas, n_components):
+    """Shape validation mirroring the reference's check_imgs/check_atlas
+    layer (reference fastsrm.py:256-446): every subject needs the same
+    voxel count, sessions must agree in timeframes across subjects, the
+    atlas must cover the data voxels with more regions than components,
+    and the total timeframe count must reach n_components.  Only array
+    shapes are touched (paths are probed with mmap, the raw atlas is
+    inspected BEFORE any pseudo-inverse is built), so this stays cheap
+    for on-disk datasets."""
+    shapes = [[_shape_of(img) for img in subj] for subj in imgs]
+    for i, subj in enumerate(shapes):
+        for j, shp in enumerate(subj):
+            if len(shp) != 2:
+                raise ValueError(
+                    f"imgs[{i}][{j}] should have exactly 2 axes "
+                    f"(voxels, timeframes); got shape {shp}")
+            if shp[0] != shapes[0][0][0]:
+                raise ValueError(
+                    f"imgs[{i}][{j}] has {shp[0]} voxels whereas "
+                    f"imgs[0][0] has {shapes[0][0][0]}; all subjects "
+                    "must share the voxel space")
+            if shp[1] != shapes[0][j][1]:
+                raise ValueError(
+                    f"imgs[{i}][{j}] has {shp[1]} timeframes whereas "
+                    f"imgs[0][{j}] has {shapes[0][j][1]}; sessions must "
+                    "have the same length across subjects")
+    n_voxels = shapes[0][0][0]
+    total_t = sum(shp[1] for shp in shapes[0])
+    if n_components is not None and total_t < n_components:
+        raise ValueError(
+            f"Total number of timeframes ({total_t}) is shorter than "
+            f"the number of components ({n_components})")
+    if atlas is not None:
+        atlas = np.asarray(atlas)
+        if atlas.ndim == 2:  # probabilistic [n_supervoxels, n_voxels]
+            atlas_voxels, n_regions = atlas.shape[1], atlas.shape[0]
+        else:
+            atlas_voxels = len(atlas)
+            n_regions = len(np.setdiff1d(np.unique(atlas), [0]))
+        if atlas_voxels != n_voxels:
+            raise ValueError(
+                f"Atlas has {atlas_voxels} voxels but data have "
+                f"{n_voxels}")
+        if n_components is not None and n_regions <= n_components:
+            raise ValueError(
+                f"Atlas has {n_regions} regions which must exceed the "
+                f"number of components ({n_components})")
+
+
+def _check_indexes(indexes, n_max, name):
+    """Index-list validation (reference fastsrm.py:103-113, 448-454)."""
+    for idx in indexes:
+        if not 0 <= int(idx) < n_max:
+            raise ValueError(
+                f"Index {int(idx)} of {name} is out of range "
+                f"(0..{n_max - 1})")
+
+
 def _reduce_one(data, atlas, inv_atlas):
     """Project [n_voxels, n_timeframes] data to the reduced space;
     returns [n_timeframes, n_supervoxels] (reference fastsrm.py:592-675)."""
@@ -162,6 +226,7 @@ class FastSRM(BaseEstimator, TransformerMixin):
                 raise ValueError("All subjects must have the same number "
                                  "of sessions")
 
+        _check_imgs_consistency(imgs, self.atlas, self.n_components)
         atlas, inv_atlas = self._atlas_parts()
 
         def reduce_subject(i):
@@ -204,6 +269,19 @@ class FastSRM(BaseEstimator, TransformerMixin):
                 self._maybe_spill(basis, f"basis_{i}", bases=True))
         return self
 
+    def _check_against_basis(self, imgs):
+        """Transform-time shape validation against the fitted basis
+        voxel space (reference fastsrm.py:383-446 applies the same check
+        layer on transform inputs)."""
+        n_voxels = _safe_load(self.basis_list[0]).shape[1]
+        for i, subj in enumerate(imgs):
+            for j, img in enumerate(subj):
+                shp = _shape_of(img)
+                if len(shp) != 2 or shp[0] != n_voxels:
+                    raise ValueError(
+                        f"imgs[{i}][{j}] has shape {shp} but the fitted "
+                        f"bases expect ({n_voxels}, n_timeframes)")
+
     def transform(self, imgs, subjects_indexes=None):
         """Project data into shared space (reference
         fastsrm.py:1513-1596)."""
@@ -212,6 +290,13 @@ class FastSRM(BaseEstimator, TransformerMixin):
         imgs = _canonicalize_imgs(imgs)
         if subjects_indexes is None:
             subjects_indexes = list(range(len(imgs)))
+        _check_indexes(subjects_indexes, len(self.basis_list),
+                       "subjects_indexes")
+        if len(imgs) != len(subjects_indexes):
+            raise ValueError(
+                f"imgs has {len(imgs)} subjects but subjects_indexes "
+                f"has {len(subjects_indexes)} entries; they must match")
+        self._check_against_basis(imgs)
         n_sessions = len(imgs[0])
 
         per_subject = []
@@ -243,11 +328,14 @@ class FastSRM(BaseEstimator, TransformerMixin):
             raise NotFittedError("The model fit has not been run yet.")
         if subjects_indexes is None:
             subjects_indexes = list(range(len(self.basis_list)))
+        _check_indexes(subjects_indexes, len(self.basis_list),
+                       "subjects_indexes")
         single_session = isinstance(shared_response, np.ndarray)
         shared = [shared_response] if single_session else \
             list(shared_response)
         if sessions_indexes is None:
             sessions_indexes = list(range(len(shared)))
+        _check_indexes(sessions_indexes, len(shared), "sessions_indexes")
 
         data = []
         for i in subjects_indexes:
@@ -265,6 +353,8 @@ class FastSRM(BaseEstimator, TransformerMixin):
         if self.basis_list is None:
             self.basis_list = []
         imgs = _canonicalize_imgs(imgs)
+        if self.basis_list:
+            self._check_against_basis(imgs)
         single = isinstance(shared_response, np.ndarray)
         shared = [shared_response.T] if single else \
             [s.T for s in shared_response]

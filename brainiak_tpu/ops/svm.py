@@ -6,11 +6,15 @@ multiprocessing pool running thousands of tiny independent SVC fits, the
 dual problems for ALL voxels and ALL folds are solved simultaneously as one
 vmapped projected-gradient program on the MXU.
 
-The dual of C-SVC:  max_a  1ᵀa - ½ aᵀQa,  0 <= a_i <= C,  Q = yyᵀ∘K.
-Cyclic dual coordinate descent (the liblinear update) solves each problem
-exactly for the small epoch counts FCMA uses (tens of samples); fold
-exclusion is expressed by zeroing each test sample's box constraint, which
-keeps every (voxel, fold) problem the same static shape.
+The dual of C-SVC:  max_a  1ᵀa - ½ aᵀQa,  0 <= a_i <= C,  yᵀa = 0,
+Q = yyᵀ∘K.  The equality constraint (from the bias term) means plain
+coordinate descent solves the WRONG problem (the bias-free liblinear
+dual); each problem is instead solved by SMO with maximal-violating-pair
+working-set selection — libsvm's algorithm — expressed as a fixed-length
+``fori_loop`` of two-coordinate updates with argmax/argmin selection, so
+all (voxel, fold, pair) problems run as one vmapped program.  Fold and
+class-pair exclusion are expressed by zeroing each excluded sample's box
+constraint, which keeps every problem the same static shape.
 """
 
 from functools import partial
@@ -24,44 +28,73 @@ __all__ = ["svm_cv_accuracy", "svm_fit_dual", "svm_decision"]
 
 @partial(jax.jit, static_argnames=("n_iters",))
 def svm_fit_dual(kernel, y, box, n_iters=400):
-    """Solve the C-SVC dual exactly by cyclic dual coordinate descent
-    (the liblinear/SMO-style update, which converges to the optimum for
-    PSD kernels).
+    """Solve the C-SVC dual (WITH the yᵀa = 0 equality constraint) by SMO
+    with maximal-violating-pair working-set selection — the libsvm
+    algorithm, so solutions match ``sklearn.svm.SVC`` to optimizer
+    tolerance.
 
     kernel : [n, n] symmetric PSD Gram matrix
-    y : [n] labels in {-1, +1}
+    y : [n] labels in {-1, +1} (0 allowed for excluded samples)
     box : [n] per-sample upper bounds (C, or 0 to exclude a sample)
-    n_iters : number of full sweeps over the coordinates
+    n_iters : SMO step budget is n_iters * n two-coordinate updates
+        (converged problems keep selecting a non-violating pair, whose
+        update is a no-op, so overshooting is safe)
     Returns (alpha [n], bias).
     """
     y = y.astype(kernel.dtype)
     box = box.astype(kernel.dtype)
     n = kernel.shape[0]
     q = (y[:, None] * y[None, :]) * kernel
-    diag = jnp.clip(jnp.diag(q), 1e-12, None)
+    active = box > 0
+    inf = jnp.asarray(jnp.inf, dtype=kernel.dtype)
 
-    def body(k, carry):
-        alpha, qalpha = carry
-        i = k % n
-        grad = 1.0 - qalpha[i]
-        new = jnp.clip(alpha[i] + grad / diag[i], 0.0, box[i])
-        delta = new - alpha[i]
-        alpha = alpha.at[i].set(new)
-        qalpha = qalpha + q[:, i] * delta
-        return alpha, qalpha
+    def body(_, carry):
+        alpha, grad = carry
+        # working-set selection on -y*grad over the feasible direction
+        # sets: I_up can increase alpha along +y, I_low along -y
+        yg = -y * grad
+        in_up = active & (((y > 0) & (alpha < box)) |
+                          ((y < 0) & (alpha > 0)))
+        in_low = active & (((y < 0) & (alpha < box)) |
+                           ((y > 0) & (alpha > 0)))
+        i = jnp.argmax(jnp.where(in_up, yg, -inf))
+        j = jnp.argmin(jnp.where(in_low, yg, inf))
+        # two-variable subproblem along the constraint-preserving
+        # direction: d alpha_i = y_i * t, d alpha_j = -y_j * t
+        quad = jnp.clip(q[i, i] + q[j, j] - 2.0 * y[i] * y[j] * q[i, j],
+                        1e-12, None)
+        t = (yg[i] - yg[j]) / quad
+        # box clipping for both coordinates
+        t_hi_i = jnp.where(y[i] > 0, box[i] - alpha[i], alpha[i])
+        t_hi_j = jnp.where(y[j] > 0, alpha[j], box[j] - alpha[j])
+        t = jnp.clip(t, 0.0, jnp.minimum(t_hi_i, t_hi_j))
+        # only step when the pair actually violates optimality
+        t = jnp.where((yg[i] - yg[j] > 1e-12) & in_up[i] & in_low[j],
+                      t, 0.0)
+        di = y[i] * t
+        dj = -y[j] * t
+        alpha = alpha.at[i].add(di).at[j].add(dj)
+        grad = grad + q[:, i] * di + q[:, j] * dj
+        return alpha, grad
 
     zeros = jnp.zeros((n,), dtype=kernel.dtype)
-    alpha, _ = jax.lax.fori_loop(0, n_iters * n, body, (zeros, zeros))
+    alpha, grad = jax.lax.fori_loop(0, n_iters * n, body,
+                                    (zeros, -jnp.ones_like(zeros)))
 
-    # Bias from free support vectors (0 < alpha < C); fall back to all
-    # bounded SVs when none are free.
+    # Bias: average y - f over free SVs; with none free, the midpoint of
+    # the remaining violating-pair interval (libsvm's rho rule).
     f = kernel @ (alpha * y)
-    free = (alpha > 1e-8 * box) & (alpha < box * (1 - 1e-6)) & (box > 0)
+    free = (alpha > 1e-8 * box) & (alpha < box * (1 - 1e-6)) & active
     any_free = jnp.sum(free) > 0
-    sv = (alpha > 1e-8) & (box > 0)
-    sel = jnp.where(any_free, free, sv)
-    denom = jnp.clip(jnp.sum(sel), 1, None)
-    bias = jnp.sum(jnp.where(sel, y - f, 0.0)) / denom
+    yg = -y * grad
+    in_up = active & (((y > 0) & (alpha < box)) | ((y < 0) & (alpha > 0)))
+    in_low = active & (((y < 0) & (alpha < box)) | ((y > 0) & (alpha > 0)))
+    mid = (jnp.max(jnp.where(in_up, yg, -inf)) +
+           jnp.min(jnp.where(in_low, yg, inf))) / 2.0
+    bias_free = jnp.sum(jnp.where(free, y - f, 0.0)) / \
+        jnp.clip(jnp.sum(free), 1, None)
+    bias = jnp.where(any_free, bias_free,
+                     jnp.where(jnp.isfinite(mid), mid, 0.0))
     return alpha, bias
 
 
@@ -84,8 +117,8 @@ def _cv_one_voxel(kernel, pair_y, pair_classes, truth, train_masks,
     Each of the P·F binary SVMs trains only on its pair's training
     samples (the box constraint is zero elsewhere); test samples collect
     one-vs-one votes and the predicted class is the vote argmax
-    (sklearn SVC's multiclass scheme; see svm_cv_accuracy's note on
-    tie-breaking).
+    (sklearn SVC's multiclass scheme; libsvm vote conventions, see
+    svm_cv_accuracy).
     """
     def one_fold(train_mask):
         train_mask = train_mask.astype(kernel.dtype)
@@ -96,7 +129,8 @@ def _cv_one_voxel(kernel, pair_y, pair_classes, truth, train_masks,
             alpha, bias = svm_fit_dual(kernel, y_p, box,
                                        n_iters=n_iters)
             dec = svm_decision(kernel, alpha, y_p, bias)
-            vote_class = jnp.where(dec >= 0, classes_p[0], classes_p[1])
+            # libsvm votes the LATER class of the pair at exactly 0
+            vote_class = jnp.where(dec > 0, classes_p[0], classes_p[1])
             return jax.nn.one_hot(vote_class, n_classes)
 
         votes = jnp.sum(jax.vmap(one_pair)(pair_y, pair_classes), axis=0)
@@ -124,11 +158,13 @@ def svm_cv_accuracy(kernels, labels, num_folds, C=1.0, n_iters=50):
         one-vs-one voting like sklearn SVC)
     Returns [B] mean fold accuracies, matching
     ``cross_val_score(SVC(kernel='precomputed'), ...)`` semantics
-    (StratifiedKFold without shuffling, unweighted fold mean).  For more
-    than two classes, vote TIE-BREAKING differs from libsvm (argmax picks
-    the lowest class index; libsvm uses training order and a strict
-    dec > 0), so multiclass accuracies agree within the reference's
-    per-epoch tolerance rather than exactly.
+    (StratifiedKFold without shuffling, unweighted fold mean).  The
+    one-vs-one vote matches libsvm's conventions — strict dec > 0 votes
+    the pair's first class, vote ties go to the first class — with
+    classes in SORTED order (np.unique); libsvm orders classes by first
+    appearance in the training labels, so exact vote-tie parity holds
+    when labels first appear in sorted order (always true for FCMA's
+    0..k-1 epoch labels).
     """
     from itertools import combinations
 

@@ -61,13 +61,19 @@ def test_voxel_selection_sklearn_parity():
 
 
 def test_voxel_selection_on_device_svm():
-    """The batched on-device dual-SVM CV matches the sklearn SVC goldens
-    within the reference's own tolerance band (atol=1 epoch)."""
+    """The batched on-device SMO dual-SVM CV matches host sklearn SVC
+    EXACTLY on identical kernels (the SMO solver honors the yᵀa=0
+    equality constraint), and both sit within the reference's own
+    tolerance band (atol=1 epoch) of its golden counts."""
     prng = RandomState(1234567890)
     fake_raw_data = [create_epoch(prng) for _ in range(8)]
     labels = [0, 1, 0, 1, 0, 1, 0, 1]
     vs = VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=1)
     output = _accuracy_counts(vs.run('svm'), 5)
+    clf = svm.SVC(kernel='precomputed', shrinking=False, C=1,
+                  gamma='auto')
+    host = _accuracy_counts(vs.run(clf), 5)
+    assert output == host
     assert np.allclose(output, [7, 4, 6, 4, 4], atol=1)
 
 

@@ -123,3 +123,37 @@ def test_fastsrm_errors():
         FastSRM(n_components=3).fit(imgs[:1])
     with pytest.raises(ValueError):
         FastSRM(n_components=3).fit([imgs[0], imgs[1][:1]])
+
+
+def test_fastsrm_input_validation():
+    """Shape/atlas/index validation mirrors the reference's check layer
+    (reference fastsrm.py:256-454): clear errors instead of deep matmul
+    failures."""
+    rng = np.random.RandomState(0)
+    V, T, K = 60, 40, 4
+    imgs = [rng.randn(V, T) for _ in range(3)]
+
+    with pytest.raises(ValueError, match="voxels"):
+        FastSRM(n_components=K).fit(
+            [imgs[0], imgs[1], rng.randn(V + 5, T)])
+    with pytest.raises(ValueError, match="timeframes"):
+        FastSRM(n_components=K).fit(
+            [[imgs[0]], [rng.randn(V, T - 3)], [imgs[2]]])
+    with pytest.raises(ValueError, match="2 axes"):
+        FastSRM(n_components=K).fit([rng.randn(V), imgs[1], imgs[2]])
+    with pytest.raises(ValueError, match="shorter than"):
+        FastSRM(n_components=50).fit(imgs)
+    with pytest.raises(ValueError, match="Atlas has"):
+        atlas = np.tile(np.arange(1, 11), 5)  # 50 voxels, data have 60
+        FastSRM(atlas=atlas, n_components=K).fit(imgs)
+    with pytest.raises(ValueError, match="regions"):
+        atlas = np.tile(np.arange(1, 4), 20)  # 3 regions <= 4 components
+        FastSRM(atlas=atlas, n_components=K).fit(imgs)
+
+    model = FastSRM(n_components=K).fit(imgs)
+    with pytest.raises(ValueError, match="out of range"):
+        model.transform(imgs, subjects_indexes=[0, 1, 5])
+    with pytest.raises(ValueError, match="must match"):
+        model.transform(imgs[:2], subjects_indexes=[0, 1, 2])
+    with pytest.raises(ValueError, match="out of range"):
+        model.inverse_transform(rng.randn(K, T), subjects_indexes=[9])
