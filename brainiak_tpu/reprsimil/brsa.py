@@ -21,11 +21,10 @@ log-σ, transformed ρ, and nuisance amplitudes.
 Documented deviations from the reference's internals:
 - nuisance regressors are marginalized with learned per-voxel amplitudes
   instead of the reference's alternating explicit β₀ updates;
-- ``score``'s null model marginalizes the nuisance time course through
-  the SAME fitted beta0 as the full model; the reference fits a separate
-  task-free nuisance model for the null (brsa.py:781-790).  The
-  state-space decoder in transform/score also treats the first sample of
-  each scan as stationary AR(1) noise rather than white;
+- the state-space decoder in transform/score treats the first sample of
+  each scan as stationary AR(1) noise rather than white, and the fitted
+  (score-unused, as in the reference) X0_null_/beta0_null_ attributes
+  come from a least-squares fit rather than an alternating update;
 - the Gaussian-Process prior on log-SNR uses a squared-exponential kernel
   over coordinates (plus optional intensity) with fixed length scales
   taken from the data scale, rather than learned GP hyperparameters.
@@ -516,23 +515,9 @@ class BRSA(BaseEstimator, TransformerMixin):
             # task response and current nuisance fit
             resid = data - design @ result["beta"] - \
                 X0 @ result["beta0"]
-            if self.nureg_zscore:
-                resid_n = (resid - resid.mean(0)) / \
-                    (resid.std(0) + 1e-12)
-            else:
-                resid_n = resid
-            n_nureg = self.n_nureg
-            if n_nureg is None:
-                # Gavish-Donoho auto-selection (reference brsa.py:460-466)
-                # on the already-normalized residuals
-                n_nureg = max(Ncomp_SVHT_MG_DLD_approx(
-                    resid_n, zscore=False), 1)
-            n_comp = min(n_nureg, n_v - 1, n_t - 1)
-            pca = PCA(n_components=n_comp)
-            comps = pca.fit_transform(resid_n)
             X0 = np.column_stack(
                 [self._dc_regressors(n_t, scan_onsets),
-                 comps / (comps.std(0) + 1e-12)]
+                 self._nuisance_components(resid)]
                 + ([nuisance] if nuisance is not None else []))
 
         self.U_ = result["U"]
@@ -548,7 +533,42 @@ class BRSA(BaseEstimator, TransformerMixin):
         self._design = design
         self._scan_starts = scan_starts
         self._n_runs = n_runs
+        self.X0_null_, self.beta0_null_ = self._fit_null_nuisance(
+            data, n_t, scan_onsets, nuisance)
         return self
+
+    def _fit_null_nuisance(self, data, n_t, scan_onsets, nuisance):
+        """Task-free nuisance model for score()'s null likelihood
+        (reference brsa.py:781-790): DC + provided nuisance regressors,
+        plus — under auto_nuisance — principal components of the
+        residuals WITHOUT any task response removed, with the spatial
+        loading beta0_null estimated by least squares."""
+        X0_null = self._dc_regressors(n_t, scan_onsets)
+        if nuisance is not None:
+            X0_null = np.column_stack([X0_null, nuisance])
+        if self.auto_nuisance:
+            resid = data - X0_null @ np.linalg.lstsq(
+                X0_null, data, rcond=None)[0]
+            X0_null = np.column_stack(
+                [X0_null, self._nuisance_components(resid)])
+        beta0_null = np.linalg.lstsq(X0_null, data, rcond=None)[0]
+        return X0_null, beta0_null
+
+    def _nuisance_components(self, resid):
+        """Shared auto-nuisance recipe (reference brsa.py:757-776):
+        optionally z-score the residuals, auto-select the component count
+        by Gavish-Donoho when n_nureg is None, and return std-normalized
+        principal components."""
+        n_t, n_v = resid.shape
+        if self.nureg_zscore:
+            resid = (resid - resid.mean(0)) / (resid.std(0) + 1e-12)
+        n_nureg = self.n_nureg
+        if n_nureg is None:
+            n_nureg = max(Ncomp_SVHT_MG_DLD_approx(
+                resid, zscore=False), 1)
+        n_comp = min(n_nureg, n_v - 1, n_t - 1)
+        comps = PCA(n_components=n_comp).fit_transform(resid)
+        return comps / (comps.std(0) + 1e-12)
 
     def _fit_once(self, data, design, X0, scan_starts, n_runs, n_c, rank,
                   gp_prec, gp_on):
@@ -645,20 +665,22 @@ class BRSA(BaseEstimator, TransformerMixin):
         (reference brsa.py:852-952, 1583-1631): the predicted task
         response is subtracted (full model only), then the data
         likelihood is evaluated with the nuisance spatial pattern beta0
-        as emission weights.  The null model reuses the fitted beta0
-        rather than refitting a task-free nuisance model (deviation, see
-        module docstring).  Returns (ll, ll_null)."""
+        as emission weights.  Matching the reference, the null model
+        reuses the FULL model's beta0/X0 AR(1) priors (reference
+        brsa.py:920-928 passes beta0_ and _rho_X0_ for both
+        likelihoods); the separately fitted task-free model is exposed
+        as X0_null_/beta0_null_ (reference brsa.py:781-790) for users
+        who want a task-free baseline.  Returns (ll, ll_null)."""
         assert hasattr(self, 'beta_'), 'Model has not been fit'
         n_t = X.shape[0]
         onsets = self._check_onsets(scan_onsets, n_t)
         _, _, rho_0, sig2_0 = self._latent_ar1_params()
-        beta0 = self.beta0_
         pred = np.asarray(design) @ self.beta_
         _, ll = _decode_timecourses(
-            np.asarray(X) - pred, beta0, self.sigma_ ** 2, self.rho_,
-            rho_0, sig2_0, onsets)
+            np.asarray(X) - pred, self.beta0_, self.sigma_ ** 2,
+            self.rho_, rho_0, sig2_0, onsets)
         _, ll_null = _decode_timecourses(
-            np.asarray(X), beta0, self.sigma_ ** 2, self.rho_,
+            np.asarray(X), self.beta0_, self.sigma_ ** 2, self.rho_,
             rho_0, sig2_0, onsets)
         return ll, ll_null
 
@@ -816,18 +838,8 @@ class GBRSA(BRSA):
                     x, d, starts, n_runs, L, snr_grid, rho_grid,
                     snr_logprior)
                 resid = x - d @ beta_v
-                if self.nureg_zscore:
-                    resid = (resid - resid.mean(0)) / \
-                        (resid.std(0) + 1e-12)
-                n_nureg = self.n_nureg
-                if n_nureg is None:
-                    n_nureg = max(Ncomp_SVHT_MG_DLD_approx(
-                        resid, False), 1)
-                n_comp = min(n_nureg, resid.shape[1] - 1,
-                             resid.shape[0] - 1)
-                comps = PCA(n_components=n_comp).fit_transform(resid)
                 new_subj.append(build_subject(
-                    s, comps / (comps.std(0) + 1e-12)))
+                    s, self._nuisance_components(resid)))
             subj_data = [b[0] for b in new_subj]
             subj_aux = [b[1] for b in new_subj]
             L, value = fit_U(subj_data)
@@ -846,10 +858,12 @@ class GBRSA(BRSA):
         self.sigma_ = []
         self.beta_ = []
         self.beta0_ = []
+        self.beta0_null_ = []
         self._X0_list = []
+        self._X0_null_list = []
         self._design_list = []
-        for (x, d, starts, n_runs), (raw, X0, onsets) in zip(
-                subj_data, subj_aux):
+        for s_idx, ((x, d, starts, n_runs), (raw, X0, onsets)) in \
+                enumerate(zip(subj_data, subj_aux)):
             snr_v, rho_v, sig_v, beta_v = self._grid_posteriors(
                 x, d, starts, n_runs, L, snr_grid, rho_grid,
                 snr_logprior)
@@ -859,12 +873,17 @@ class GBRSA(BRSA):
             self.beta_.append(beta_v)
             self.beta0_.append(np.linalg.lstsq(
                 X0, raw - d @ beta_v, rcond=None)[0])
+            X0n, beta0n = self._fit_null_nuisance(
+                raw, raw.shape[0], onsets, subject_nuisance(s_idx))
+            self.beta0_null_.append(beta0n)
             self._X0_list.append(X0)
+            self._X0_null_list.append(X0n)
             self._design_list.append(d)
         if n_subj == 1:
-            self.nSNR_, self.rho_, self.sigma_, self.beta_, self.beta0_ \
-                = (self.nSNR_[0], self.rho_[0], self.sigma_[0],
-                   self.beta_[0], self.beta0_[0])
+            (self.nSNR_, self.rho_, self.sigma_, self.beta_,
+             self.beta0_, self.beta0_null_) = (
+                self.nSNR_[0], self.rho_[0], self.sigma_[0],
+                self.beta_[0], self.beta0_[0], self.beta0_null_[0])
         return self
 
     def _grid_posteriors(self, x, d, starts, n_runs, L, snr_grid,
@@ -945,8 +964,8 @@ class GBRSA(BRSA):
     def score(self, X, design, scan_onsets=None):
         """Held-out log-likelihood per subject with the unknown nuisance
         time course marginalized under its AR(1) prior through the fitted
-        spatial pattern beta0 (see BRSA.score; reference
-        brsa.py:3252-3390)."""
+        spatial pattern beta0 for BOTH likelihoods, matching the
+        reference (brsa.py:3325-3337); see BRSA.score."""
         if isinstance(X, np.ndarray):
             X = [X]
             design = [design]
