@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.correlation import PRECISION
+from ..ops.correlation import resolve_precision
 from ..ops.fisherz import within_subject_normalization
 from ..ops.svm import svm_cv_accuracy
 from ..parallel.mesh import DEFAULT_VOXEL_AXIS
@@ -34,12 +34,13 @@ logger = logging.getLogger(__name__)
 __all__ = ["VoxelSelector"]
 
 
-def _gram_and_shrink(corr):
+def _gram_and_shrink(corr, precision=None):
     """Per-voxel linear-kernel Gram with the reference's magnitude
     shrink: scale so K[0,0] has at most 2 integer digits for stable SVM
     duals (reference cython_blas.pyx compute_kernel_matrix + digit
     shrink, voxelselector.py:407-412)."""
-    kernels = jnp.einsum('bev,bfv->bef', corr, corr, precision=PRECISION,
+    kernels = jnp.einsum('bev,bfv->bef', corr, corr,
+                         precision=resolve_precision(precision),
                          preferred_element_type=jnp.float32)
     k00 = jnp.clip(kernels[:, 0, 0], 1.0, None)
     ndigits = jnp.floor(jnp.log10(k00)) + 1
@@ -47,9 +48,10 @@ def _gram_and_shrink(corr):
     return kernels * proportion[:, None, None]
 
 
-@partial(jax.jit, static_argnames=("epochs_per_subj", "interpret"))
+@partial(jax.jit, static_argnames=("epochs_per_subj", "interpret",
+                                   "precision"))
 def _block_kernel_matrices_pallas(blk, data2, epochs_per_subj,
-                                  interpret=False):
+                                  interpret=False, precision=None):
     """Pallas-fused variant of :func:`_block_kernel_matrices`: the
     correlation + Fisher-z + normalization tile never round-trips to HBM
     (see :mod:`brainiak_tpu.ops.pallas_kernels`)."""
@@ -60,20 +62,21 @@ def _block_kernel_matrices_pallas(blk, data2, epochs_per_subj,
     tile_b, tile_v, fits = pick_tiles(n_e, n_t, n_b, n_v)
     if not fits:
         # epoch x TR extent too large for VMEM tiles — use the XLA path
-        return _block_kernel_matrices(blk, data2, epochs_per_subj)
+        return _block_kernel_matrices(blk, data2, epochs_per_subj,
+                                      precision=precision)
     pad_b = (-n_b) % tile_b
     pad_v = (-n_v) % tile_v
     blk_p = jnp.pad(blk, ((0, 0), (0, 0), (0, pad_b)))
     data_p = jnp.pad(data2, ((0, 0), (0, 0), (0, pad_v)))
     corr = fcma_corr_normalize(blk_p, data_p, epochs_per_subj,
                                tile_b=tile_b, tile_v=tile_v,
-                               interpret=interpret)
+                               interpret=interpret, precision=precision)
     corr = corr[:n_b, :, :n_v]
-    return _gram_and_shrink(corr), corr
+    return _gram_and_shrink(corr, precision), corr
 
 
-@partial(jax.jit, static_argnames=("epochs_per_subj",))
-def _block_kernel_matrices(blk, data2, epochs_per_subj):
+@partial(jax.jit, static_argnames=("epochs_per_subj", "precision"))
+def _block_kernel_matrices(blk, data2, epochs_per_subj, precision=None):
     """Correlate a voxel block against all voxels and build per-voxel SVM
     Gram matrices.
 
@@ -83,10 +86,10 @@ def _block_kernel_matrices(blk, data2, epochs_per_subj):
     over the leading (block) axis when ``blk`` is.
     """
     corr = jnp.einsum('etb,etv->bev', blk, data2,
-                      precision=PRECISION,
+                      precision=resolve_precision(precision),
                       preferred_element_type=jnp.float32)
     corr = within_subject_normalization(corr, epochs_per_subj)
-    return _gram_and_shrink(corr), corr
+    return _gram_and_shrink(corr, precision), corr
 
 
 class VoxelSelector:
@@ -104,12 +107,16 @@ class VoxelSelector:
     mesh : optional jax.sharding.Mesh — blocks are additionally sharded
         over its ``voxel`` axis (the analog of adding MPI workers)
     svm_C, svm_iters : on-device dual-SVM hyperparameters
+    use_pallas : 'auto' (fused Pallas kernel on TPU) | True | False
+    precision : 'highest' (fp32-equivalent, default) | 'high' (fewer
+        bf16 MXU passes — several-x TPU throughput at ~1e-3 correlation
+        accuracy) | 'default', for the correlation/Gram matmuls
     """
 
     def __init__(self, labels, epochs_per_subj, num_folds, raw_data,
                  raw_data2=None, voxel_unit=256, mesh=None,
                  svm_C=1.0, svm_iters=50, process_num=None,
-                 master_rank=0, use_pallas='auto'):
+                 master_rank=0, use_pallas='auto', precision='highest'):
         self.labels = np.asarray(labels)
         self.epochs_per_subj = epochs_per_subj
         self.num_folds = num_folds
@@ -119,6 +126,11 @@ class VoxelSelector:
         self.mesh = mesh
         self.svm_C = svm_C
         self.svm_iters = svm_iters
+        # matmul precision for the correlation/Gram einsums: 'highest'
+        # (fp32-equivalent, default) or 'high' (fewer bf16 MXU passes,
+        # several-x throughput at ~1e-3 correlation accuracy) — the main
+        # TPU throughput lever for voxel selection
+        self.precision = resolve_precision(precision)
         # 'auto': the fused Pallas kernel on TPU, plain XLA elsewhere
         if use_pallas == 'auto':
             use_pallas = jax.default_backend() == 'tpu'
@@ -195,10 +207,12 @@ class VoxelSelector:
             if self.use_pallas:
                 kernels, corr = _block_kernel_matrices_pallas(
                     blk, data2, self.epochs_per_subj,
-                    interpret=jax.default_backend() != 'tpu')
+                    interpret=jax.default_backend() != 'tpu',
+                    precision=self.precision)
             else:
                 kernels, corr = _block_kernel_matrices(
-                    blk, data2, self.epochs_per_subj)
+                    blk, data2, self.epochs_per_subj,
+                    precision=self.precision)
             kernels = kernels[offset:offset + cur]
             corr = corr[offset:offset + cur]
             if isinstance(clf, str) and clf == 'svm':

@@ -24,12 +24,35 @@ __all__ = [
     "compute_correlation",
     "correlate_epochs",
     "normalize_for_correlation",
+    "resolve_precision",
 ]
 
 # Matmul precision for correlation statistics.  HIGHEST (fp32-equivalent via
 # bf16 passes on the MXU) keeps Pearson r within ~1e-6 of float64 references;
-# lower to 'high' for throughput once accuracy bands allow.
+# 'high' (fewer bf16 passes) trades ~1e-3 correlation accuracy for several-x
+# MXU throughput — the main FCMA perf lever on TPU.
 PRECISION = jax.lax.Precision.HIGHEST
+
+_PRECISION_NAMES = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "high": jax.lax.Precision.HIGH,
+    "default": jax.lax.Precision.DEFAULT,
+}
+
+
+def resolve_precision(precision):
+    """Map 'highest' / 'high' / 'default' (or a jax.lax.Precision, or
+    None for the module default) to a jax.lax.Precision."""
+    if precision is None:
+        return PRECISION
+    if isinstance(precision, jax.lax.Precision):
+        return precision
+    try:
+        return _PRECISION_NAMES[str(precision).lower()]
+    except KeyError:
+        raise ValueError(
+            f"precision must be one of {sorted(_PRECISION_NAMES)} or a "
+            f"jax.lax.Precision; got {precision!r}") from None
 
 
 @partial(jax.jit, static_argnames=("axis", "return_nans"))
@@ -50,12 +73,15 @@ def normalize_for_correlation(data, axis, return_nans=False):
     return z / jnp.sqrt(jnp.float32(n))
 
 
-@partial(jax.jit, static_argnames=("return_nans",))
-def compute_correlation(matrix1, matrix2, return_nans=False):
+@partial(jax.jit, static_argnames=("return_nans", "precision"))
+def compute_correlation(matrix1, matrix2, return_nans=False,
+                        precision=None):
     """Pearson correlation of the rows of ``matrix1`` with rows of ``matrix2``.
 
     Returns shape ``[r1, r2]`` in float32.  Contract: fcma/util.py:63-134
     (there: normalize + BLAS sgemm; here: one fused XLA computation).
+    ``precision``: 'highest' (default) / 'high' / 'default' — see
+    :func:`resolve_precision`.
     """
     matrix1 = jnp.asarray(matrix1, dtype=jnp.float32)
     matrix2 = jnp.asarray(matrix2, dtype=jnp.float32)
@@ -63,11 +89,11 @@ def compute_correlation(matrix1, matrix2, return_nans=False):
         raise ValueError('Dimension discrepancy')
     m1 = normalize_for_correlation(matrix1, 1, return_nans=return_nans)
     m2 = normalize_for_correlation(matrix2, 1, return_nans=return_nans)
-    return jnp.matmul(m1, m2.T, precision=PRECISION)
+    return jnp.matmul(m1, m2.T, precision=resolve_precision(precision))
 
 
-@jax.jit
-def correlate_epochs(block_data, all_data):
+@partial(jax.jit, static_argnames=("precision",))
+def correlate_epochs(block_data, all_data, precision=None):
     """Per-epoch correlation of a voxel block against all voxels.
 
     Parameters
@@ -85,5 +111,5 @@ def correlate_epochs(block_data, all_data):
         einsum instead.
     """
     return jnp.einsum('ebt,evt->bev', block_data, all_data,
-                      precision=PRECISION,
+                      precision=resolve_precision(precision),
                       preferred_element_type=jnp.float32)

@@ -55,7 +55,8 @@ def pick_tiles(n_epochs, n_trs, n_b, n_v):
     return tile_b, tile_v, used(tile_b, tile_v) <= _VMEM_BUDGET_FLOATS
 
 
-def _kernel(blk_ref, data_ref, out_ref, *, n_epochs, epochs_per_subj):
+def _kernel(blk_ref, data_ref, out_ref, *, n_epochs, epochs_per_subj,
+            precision=jax.lax.Precision.HIGHEST):
     """One (TB, TV) tile: correlate, Fisher-z, normalize, store."""
     n_subjs = n_epochs // epochs_per_subj
 
@@ -66,7 +67,7 @@ def _kernel(blk_ref, data_ref, out_ref, *, n_epochs, epochs_per_subj):
         return jax.lax.dot_general(
             b, d, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
+            precision=precision)
 
     corr = jnp.stack([corr_epoch(e) for e in range(n_epochs)], axis=1)
     # Fisher z with the reference's clamping (fcma_extension.cc:68-72)
@@ -87,18 +88,21 @@ def _kernel(blk_ref, data_ref, out_ref, *, n_epochs, epochs_per_subj):
 
 @functools.partial(jax.jit,
                    static_argnames=("epochs_per_subj", "tile_b", "tile_v",
-                                    "interpret"))
+                                    "interpret", "precision"))
 def fcma_corr_normalize(blk, data, epochs_per_subj, tile_b=None,
-                        tile_v=None, interpret=False):
+                        tile_v=None, interpret=False, precision=None):
     """Fused FCMA correlation + within-subject normalization.
 
     blk : [E, T, B] normalized epoch data for the voxel block
     data : [E, T, V] normalized epoch data for all voxels
+    precision : matmul precision for the correlation dot (see
+        :func:`brainiak_tpu.ops.correlation.resolve_precision`)
     Returns [B, E, V] float32 — identical (to fp32 tolerance) to
     ``within_subject_normalization(correlate_epochs(blk, data), eps)``.
 
     B and V must be multiples of tile_b/tile_v (callers pad).
     """
+    from .correlation import resolve_precision
     n_epochs, n_trs, n_b = blk.shape
     n_v = data.shape[2]
     auto_b, auto_v, fits = pick_tiles(n_epochs, n_trs, n_b, n_v)
@@ -114,7 +118,8 @@ def fcma_corr_normalize(blk, data, epochs_per_subj, tile_b=None,
 
     grid = (n_b // tile_b, n_v // tile_v)
     kernel = functools.partial(_kernel, n_epochs=n_epochs,
-                               epochs_per_subj=epochs_per_subj)
+                               epochs_per_subj=epochs_per_subj,
+                               precision=resolve_precision(precision))
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n_b, n_epochs, n_v),

@@ -172,3 +172,27 @@ def test_voxel_selection_multiclass_on_device():
     for (v0, a0), (v1, a1) in zip(skl, dev):
         assert v0 == v1
         assert abs(a0 - a1) * n_e <= 2  # within 2 epochs of SVC
+
+
+def test_voxel_selection_precision_knob():
+    """The matmul-precision knob ('high' = the TPU throughput lever) is
+    accepted and is numerically identical on CPU (where XLA always runs
+    fp32); bad values raise with the valid options named."""
+    import pytest
+    from brainiak_tpu.ops.correlation import resolve_precision
+
+    prng = RandomState(1234567890)
+    fake_raw_data = [create_epoch(prng) for _ in range(8)]
+    labels = [0, 1, 0, 1, 0, 1, 0, 1]
+    base = VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=1)
+    fast = VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=1,
+                         precision='high')
+    base_counts = _accuracy_counts(base.run('svm'), 5)
+    fast_counts = _accuracy_counts(fast.run('svm'), 5)
+    import jax
+    if jax.default_backend() != 'tpu':
+        assert base_counts == fast_counts
+    else:  # on TPU the precisions genuinely differ; band only
+        assert np.allclose(base_counts, fast_counts, atol=1)
+    with pytest.raises(ValueError, match="highest"):
+        resolve_precision('hihgest')
