@@ -53,6 +53,19 @@ def _rho_sum(sq, nlss_loss):
     return jnp.sum(sq)
 
 
+def _full_sym(tri, n_dim):
+    """Full symmetric matrix from its packed upper-triangular vector."""
+    u = from_tri_2_sym(tri, n_dim)
+    return u + u.T - np.diag(np.diag(u))
+
+
+def _match_centers(prior_centers, posterior_centers):
+    """Hungarian assignment of posterior factors to prior factors by
+    center distance; returns the posterior column order."""
+    cost = distance.cdist(prior_centers, posterior_centers, 'euclidean')
+    return linear_sum_assignment(cost)[1]
+
+
 @partial(jax.jit, static_argnames=("K", "n_dim", "nlss_loss", "max_iters",
                                    "has_template"))
 def _fit_centers_widths(init, lower, upper, R, X, W, data_sigma,
@@ -259,9 +272,7 @@ class TFA(BaseEstimator):
         prior_centers = self.get_centers(self.local_prior)
         posterior_centers = self.get_centers(self.local_posterior_)
         posterior_widths = self.get_widths(self.local_posterior_)
-        cost = distance.cdist(prior_centers, posterior_centers,
-                              'euclidean')
-        _, col_ind = linear_sum_assignment(cost)
+        col_ind = _match_centers(prior_centers, posterior_centers)
         self.set_centers(self.local_posterior_, posterior_centers[col_ind])
         self.set_widths(self.local_posterior_, posterior_widths[col_ind])
         return self
@@ -286,12 +297,9 @@ class TFA(BaseEstimator):
         data_sigma = 1.0 / math.sqrt(2.0) * np.std(X)
         has_template = template_centers is not None
         if has_template:
-            def sym(tri):
-                u = from_tri_2_sym(tri, self.n_dim)
-                return u + u.T - np.diag(np.diag(u))
-
             cov_inv = np.stack([
-                np.linalg.inv(sym(template_centers_mean_cov[k]))
+                np.linalg.inv(_full_sym(template_centers_mean_cov[k],
+                                        self.n_dim))
                 for k in range(self.K)])
             tmpl_centers = jnp.asarray(template_centers)
             tmpl_cov_inv = jnp.asarray(cov_inv)

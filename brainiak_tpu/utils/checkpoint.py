@@ -48,15 +48,42 @@ class CheckpointManager:
             self._mngr.wait_until_finished()
         else:
             path = os.path.join(self.directory, f"ckpt_{step}.npz")
+            # savez appends ".npz" to bare filenames, so write through an
+            # open handle to keep the tmp name exact for the atomic rename.
             tmp = path + f".tmp{os.getpid()}"
-            np.savez(tmp, **{k: np.asarray(v) for k, v in state.items()})
+            with open(tmp, "wb") as f:
+                np.savez(f, **{k: np.asarray(v) for k, v in state.items()})
             os.replace(tmp, path)  # atomic: survive preemption mid-save
+            self._prune_npz()
+
+    def _prune_npz(self):
+        # None or <=0 mean keep everything (orbax convention).
+        if not self.max_to_keep or self.max_to_keep <= 0:
+            return
+        steps = sorted(s for s in (self._npz_step(f)
+                                   for f in os.listdir(self.directory))
+                       if s is not None)
+        for s in steps[:-self.max_to_keep]:
+            try:
+                os.remove(os.path.join(self.directory, f"ckpt_{s}.npz"))
+            except OSError:  # pragma: no cover - concurrent cleanup
+                pass
+
+    @staticmethod
+    def _npz_step(fname):
+        if fname.startswith("ckpt_") and fname.endswith(".npz"):
+            try:
+                return int(fname[5:-4])
+            except ValueError:
+                return None
+        return None
 
     def latest_step(self):
         if self._mngr is not None:
             return self._mngr.latest_step()
-        steps = [int(f[5:-4]) for f in os.listdir(self.directory)
-                 if f.startswith("ckpt_") and f.endswith(".npz")]
+        steps = [s for s in (self._npz_step(f)
+                             for f in os.listdir(self.directory))
+                 if s is not None]
         return max(steps) if steps else None
 
     def restore(self, step=None, template=None):

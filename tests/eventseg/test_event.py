@@ -130,6 +130,22 @@ def test_split_merge():
     assert np.array_equal(np.argmax(hmm_sm.segments_[0], axis=1), ev)
 
 
+def test_subevent_patterns_degenerate_event():
+    """An event whose soft-assignment mass crosses 1/2 at its first
+    timepoint has an empty first half: its half-pattern must be zeros,
+    not NaN."""
+    es = EventSegment(2)
+    t, v = 6, 4
+    sp = np.zeros((t, 2))
+    sp[0, 0] = 1.0                      # event 0: all mass at t=0
+    sp[1:, 1] = 1.0 / (t - 1)           # event 1: uniform afterwards
+    X = np.arange(v * t, dtype=float).reshape(v, t)
+    first, second, pairs = es._subevent_patterns([X], [sp])
+    assert np.all(np.isfinite(first)) and np.all(np.isfinite(second))
+    assert np.allclose(first[:, 0], 0.0)
+    assert np.all(np.isfinite(pairs))
+
+
 def test_sym_ll():
     """Forward and time-reversed data give the same log-likelihood."""
     ev = np.array([0, 0, 0, 1, 1, 1, 1, 1, 1, 2, 2])

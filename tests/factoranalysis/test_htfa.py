@@ -34,6 +34,56 @@ def test_htfa_fit_recovers_template():
     assert np.all(np.isfinite(htfa.local_weights_))
 
 
+def test_htfa_mesh_matches_single_host():
+    """Sharding the subject axis over a mesh must not change the fit —
+    the analog of the reference's distributed-vs-serial HTFA equivalence
+    (reference tests/factoranalysis/test_htfa.py MPI runs)."""
+    from brainiak_tpu.parallel.mesh import make_mesh
+
+    from tests.conftest import mesh_atol
+
+    X, R, _, _ = make_multi_subject(n_subj=4)
+    common = dict(K=2, n_subj=4, max_global_iter=2, max_local_iter=2,
+                  threshold=0.5, voxel_ratio=1.0, tr_ratio=1.0,
+                  max_voxel=512, max_tr=60)
+    np.random.seed(0)
+    single = HTFA(**common).fit(X, R)
+    np.random.seed(0)
+    mesh = make_mesh(("subject",), (4,))
+    sharded = HTFA(mesh=mesh, **common).fit(X, R)
+    np.testing.assert_allclose(sharded.global_posterior_,
+                               single.global_posterior_,
+                               atol=mesh_atol())
+    np.testing.assert_allclose(sharded.local_posterior_,
+                               single.local_posterior_,
+                               atol=mesh_atol())
+
+
+def test_htfa_ragged_subjects_mesh_padding():
+    """Subjects with different voxel counts batch via masked padding, and
+    a subject count that does not divide the mesh axis is padded by
+    repetition and discarded."""
+    from brainiak_tpu.parallel.mesh import make_mesh
+
+    from tests.conftest import mesh_atol
+
+    X, R, _, _ = make_multi_subject(n_subj=3)
+    # make subject raggedness real: drop voxels from subjects 1 and 2
+    X = [X[0], X[1][:-37], X[2][:-101]]
+    R = [R[0], R[1][:-37], R[2][:-101]]
+    common = dict(K=2, n_subj=3, max_global_iter=1, max_local_iter=2,
+                  threshold=0.5, voxel_ratio=0.5, tr_ratio=1.0,
+                  max_voxel=200, max_tr=60)
+    np.random.seed(1)
+    single = HTFA(**common).fit(X, R)
+    np.random.seed(1)
+    mesh = make_mesh(("subject",), (2,))  # 3 subjects on 2 shards -> pad
+    sharded = HTFA(mesh=mesh, **common).fit(X, R)
+    np.testing.assert_allclose(sharded.local_posterior_,
+                               single.local_posterior_,
+                               atol=mesh_atol())
+
+
 def test_htfa_input_validation():
     X, R, _, _ = make_multi_subject(n_subj=2)
     htfa = HTFA(K=2, n_subj=2)

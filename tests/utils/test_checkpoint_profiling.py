@@ -25,6 +25,30 @@ def test_checkpoint_roundtrip(tmp_path):
     assert np.allclose(np.asarray(restored2["a"]), state["a"])
 
 
+def _npz_fallback_manager(path, **kwargs):
+    """Build a CheckpointManager forced onto the npz fallback path."""
+    mngr = CheckpointManager(str(path), **kwargs)
+    mngr._ocp = None
+    mngr._mngr = None
+    return mngr
+
+
+def test_npz_fallback_roundtrip_and_pruning(tmp_path):
+    mngr = _npz_fallback_manager(tmp_path / "ckpts", max_to_keep=2)
+    state = {"a": np.arange(6.0).reshape(2, 3), "b": np.float64(3.5)}
+    for step in (1, 2, 3):
+        mngr.save(step, {"a": state["a"] * step, "b": state["b"]})
+    # max_to_keep=2: step 1 pruned, 2 and 3 survive.
+    kept = sorted(f for f in (tmp_path / "ckpts").iterdir())
+    assert [f.name for f in kept] == ["ckpt_2.npz", "ckpt_3.npz"]
+    assert mngr.latest_step() == 3
+    step, restored = mngr.restore()
+    assert step == 3
+    assert np.allclose(restored["a"], state["a"] * 3)
+    step2, restored2 = mngr.restore(step=2)
+    assert np.allclose(restored2["a"], state["a"] * 2)
+
+
 def test_stage_timer():
     reset_stage_times()
     with stage_timer("stage_a"):
