@@ -25,8 +25,10 @@ from itertools import permutations, product
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 from scipy.spatial.distance import squareform
 
+from .parallel.mesh import DEFAULT_VOXEL_AXIS
 from .utils.utils import _check_timeseries_input, p_from_null
 
 __all__ = [
@@ -130,6 +132,29 @@ def squareform_isfc(isfcs, iscs=None):
         return out[0] if out.shape[0] == 1 else out
 
 
+def _shard_voxels(arr, mesh, axis):
+    """Device-place ``arr`` with its voxel axis sharded over the mesh's
+    ``'voxel'`` axis.  The voxel dimension is NaN-padded up to the next
+    multiple of the axis size (every ISC computation is voxelwise
+    independent and NaN-tolerant, so pad columns simply come back NaN);
+    callers slice padded outputs with ``[..., :n]``.  Returns the placed
+    array.  With ``mesh=None`` this is a plain ``jnp.asarray``.
+    """
+    if mesh is None:
+        return jnp.asarray(arr)
+    n_shards = mesh.shape[DEFAULT_VOXEL_AXIS]
+    pad = (-arr.shape[axis]) % n_shards
+    if pad:
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, pad)
+        arr = np.pad(np.asarray(arr, dtype=float), widths,
+                     constant_values=np.nan)
+    spec = [None] * arr.ndim
+    spec[axis] = DEFAULT_VOXEL_AXIS
+    return jax.device_put(
+        arr, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
 # ---------------------------------------------------------------------------
 # jitted cores
 
@@ -222,27 +247,35 @@ def _isfc_pairwise_core(data, idx_i, idx_j):
 # ---------------------------------------------------------------------------
 # public API
 
-def isc(data, pairwise=False, summary_statistic=None, tolerate_nans=True):
+def isc(data, pairwise=False, summary_statistic=None, tolerate_nans=True,
+        mesh=None):
     """Intersubject correlation per voxel (reference isc.py:81-210).
 
     Leave-one-out (default) or pairwise; optional 'mean'/'median' summary.
+
+    mesh : optional :class:`jax.sharding.Mesh` with a ``'voxel'`` axis —
+        the [T, V, S] stack is then sharded along voxels (every per-voxel
+        correlation is independent, so XLA partitions the whole program
+        with no collectives).  Ignored for the 2-subject host path.
     """
     data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
     if n_subjects == 2:
         summary_statistic = None
     data, mask = _threshold_nans(data, tolerate_nans)
+    n_kept = data.shape[1]
 
     if n_subjects == 2:
         from .utils.utils import array_correlation
         iscs_stack = array_correlation(data[..., 0],
                                        data[..., 1])[np.newaxis, :]
     elif pairwise:
-        corr = np.asarray(_isc_pairwise_core(jnp.asarray(data)))
+        corr = np.asarray(
+            _isc_pairwise_core(_shard_voxels(data, mesh, 1)))[..., :n_kept]
         iu = np.triu_indices(n_subjects, k=1)
         iscs_stack = corr[iu[0], iu[1], :]
     else:
-        iscs_stack = np.asarray(
-            _isc_loo_core(jnp.asarray(data), bool(tolerate_nans)))
+        iscs_stack = np.asarray(_isc_loo_core(
+            _shard_voxels(data, mesh, 1), bool(tolerate_nans)))[:, :n_kept]
 
     iscs = np.full((iscs_stack.shape[0], n_voxels), np.nan)
     iscs[:, np.where(mask)[0]] = iscs_stack
@@ -381,12 +414,17 @@ def _resolve_seed(random_state):
 
 def bootstrap_isc(iscs, pairwise=False, summary_statistic='median',
                   n_bootstraps=1000, ci_percentile=95, side='right',
-                  random_state=None):
+                  random_state=None, mesh=None, null_batch_size=64):
     """Subject-wise bootstrap test for ISCs (reference isc.py:649-810).
 
     Resamples subjects with replacement, shifts the bootstrap distribution
     by the observed statistic (Hall & Wilson 1991), and returns
     (observed, ci, p, distribution).
+
+    mesh : optional Mesh with a ``'voxel'`` axis — shards the voxel
+        dimension of the resampling program.
+    null_batch_size : resamples evaluated per device dispatch (the
+        vmap-chunk size; bound it to keep single dispatches short).
     """
     iscs, n_subjects, n_voxels = _check_isc_input(iscs, pairwise=pairwise)
     if summary_statistic not in ('mean', 'median'):
@@ -395,14 +433,13 @@ def bootstrap_isc(iscs, pairwise=False, summary_statistic='median',
     observed = compute_summary_statistic(
         iscs, summary_statistic=summary_statistic, axis=0)
 
-    iscs_j = jnp.asarray(iscs)
     if pairwise:
         # Rebuild the square matrix once; each bootstrap gathers rows/cols.
         sq = np.stack([squareform(v, force='tomatrix') for v in iscs.T],
                       axis=-1)  # [S, S, V]
         for v in range(sq.shape[-1]):
             np.fill_diagonal(sq[..., v], 1.0)
-        sq_j = jnp.asarray(sq)
+        sq_j = _shard_voxels(sq, mesh, 2)
         iu = np.triu_indices(n_subjects, k=1)
 
         def one_boot(key):
@@ -414,13 +451,16 @@ def bootstrap_isc(iscs, pairwise=False, summary_statistic='median',
             tri = resq[iu[0], iu[1]]
             return _jnp_summary(tri, summary_statistic, axis=0)
     else:
+        iscs_j = _shard_voxels(iscs, mesh, 1)
+
         def one_boot(key):
             sample = jax.random.choice(key, n_subjects, (n_subjects,))
             return _jnp_summary(iscs_j[sample], summary_statistic, axis=0)
 
     keys = jax.random.split(jax.random.PRNGKey(_resolve_seed(random_state)),
                             n_bootstraps)
-    distribution = np.asarray(jax.lax.map(one_boot, keys, batch_size=64))
+    distribution = np.asarray(jax.lax.map(
+        one_boot, keys, batch_size=null_batch_size))[:, :n_voxels]
 
     ci = (np.percentile(distribution, (100 - ci_percentile) / 2, axis=0),
           np.percentile(distribution,
@@ -443,12 +483,15 @@ def _check_group_assignment(group_assignment, n_subjects):
 
 def permutation_isc(iscs, group_assignment=None, pairwise=False,
                     summary_statistic='median', n_permutations=1000,
-                    side='right', random_state=None):
+                    side='right', random_state=None, mesh=None,
+                    null_batch_size=64):
     """Group-label permutation test for ISCs (reference isc.py:1057-1251).
 
     One group: sign-flipping (exact when 2**N <= n_permutations).  Two
     groups: group-assignment shuffling (exact when N! <= n_permutations).
     Returns (observed, p, distribution).
+
+    mesh / null_batch_size : see :func:`bootstrap_isc`.
     """
     iscs, n_subjects, n_voxels = _check_isc_input(iscs, pairwise=pairwise)
     if summary_statistic not in ('mean', 'median'):
@@ -462,7 +505,7 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
         raise ValueError("This test is not valid for more than "
                          "2 groups! (got {0})".format(n_groups))
 
-    iscs_j = jnp.asarray(iscs)
+    iscs_j = _shard_voxels(iscs, mesh, 1)
 
     if n_groups == 1:
         observed = compute_summary_statistic(
@@ -485,8 +528,9 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
             n_permutations = 2 ** n_subjects
             flips = jnp.asarray(list(product([-1.0, 1.0],
                                              repeat=n_subjects)))
-            distribution = np.asarray(
-                jax.lax.map(apply_flips, flips, batch_size=64))
+            distribution = np.asarray(jax.lax.map(
+                apply_flips, flips,
+                batch_size=null_batch_size))[:, :n_voxels]
         else:
             keys = jax.random.split(
                 jax.random.PRNGKey(_resolve_seed(random_state)),
@@ -497,8 +541,9 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
                                           (n_subjects,))
                 return apply_flips(flips)
 
-            distribution = np.asarray(
-                jax.lax.map(one_perm, keys, batch_size=64))
+            distribution = np.asarray(jax.lax.map(
+                one_perm, keys,
+                batch_size=null_batch_size))[:, :n_voxels]
     else:
         group_selector = np.asarray(group_assignment)
         if pairwise:
@@ -520,7 +565,8 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
                               iscs_j, jnp.nan), summary_statistic, axis=0)
                 return s0 - s1
 
-            observed = np.asarray(stat_for(jnp.asarray(pair_labels)))
+            observed = np.asarray(
+                stat_for(jnp.asarray(pair_labels)))[:n_voxels]
 
             sq_labels_j = jnp.asarray(sq_labels)
             iu = np.triu_indices(n_subjects, k=1)
@@ -540,7 +586,7 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
                     summary_statistic, axis=0)
                 return s0 - s1
 
-            observed = np.asarray(stat_groups(sel_j))
+            observed = np.asarray(stat_groups(sel_j))[:n_voxels]
 
             def permute_stat(perm):
                 return stat_groups(sel_j[perm])
@@ -550,8 +596,9 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
             n_permutations = math.factorial(n_subjects)
             perms = jnp.asarray(
                 list(permutations(np.arange(n_subjects))))
-            distribution = np.asarray(
-                jax.lax.map(permute_stat, perms, batch_size=64))
+            distribution = np.asarray(jax.lax.map(
+                permute_stat, perms,
+                batch_size=null_batch_size))[:, :n_voxels]
         else:
             keys = jax.random.split(
                 jax.random.PRNGKey(_resolve_seed(random_state)),
@@ -561,8 +608,9 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
                 return permute_stat(
                     jax.random.permutation(key, n_subjects))
 
-            distribution = np.asarray(
-                jax.lax.map(one_perm, keys, batch_size=64))
+            distribution = np.asarray(jax.lax.map(
+                one_perm, keys,
+                batch_size=null_batch_size))[:, :n_voxels]
 
     p = p_from_null(observed, distribution, side=side, exact=exact, axis=0)
     return observed, p, distribution
@@ -570,18 +618,20 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
 
 def timeshift_isc(data, pairwise=False, summary_statistic='median',
                   n_shifts=1000, side='right', tolerate_nans=True,
-                  random_state=None):
+                  random_state=None, mesh=None, null_batch_size=16):
     """Circular time-shift null for ISC (reference isc.py:1253-1410).
 
-    Returns (observed, p, distribution)."""
+    Returns (observed, p, distribution).
+    mesh / null_batch_size : see :func:`bootstrap_isc`."""
     data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
     data, mask = _threshold_nans(data, tolerate_nans)
+    n_kept = data.shape[1]
 
     observed = isc(data, pairwise=pairwise,
                    summary_statistic=summary_statistic,
-                   tolerate_nans=tolerate_nans)
+                   tolerate_nans=tolerate_nans, mesh=mesh)
 
-    data_j = jnp.asarray(data)
+    data_j = _shard_voxels(data, mesh, 1)
     tol = bool(tolerate_nans)
 
     if pairwise:
@@ -609,7 +659,8 @@ def timeshift_isc(data, pairwise=False, summary_statistic='median',
 
     keys = jax.random.split(jax.random.PRNGKey(_resolve_seed(random_state)),
                             n_shifts)
-    distribution = np.asarray(jax.lax.map(one_shift, keys, batch_size=16))
+    distribution = np.asarray(jax.lax.map(
+        one_shift, keys, batch_size=null_batch_size))[:, :n_kept]
 
     observed, distribution = _reinsert_nan_voxels(
         observed, distribution, mask, n_voxels)
@@ -619,20 +670,23 @@ def timeshift_isc(data, pairwise=False, summary_statistic='median',
 
 def phaseshift_isc(data, pairwise=False, summary_statistic='median',
                    n_shifts=1000, voxelwise=False, side='right',
-                   tolerate_nans=True, random_state=None):
+                   tolerate_nans=True, random_state=None, mesh=None,
+                   null_batch_size=16):
     """Phase-randomization null for ISC (reference isc.py:1410-1551).
 
-    Returns (observed, p, distribution)."""
+    Returns (observed, p, distribution).
+    mesh / null_batch_size : see :func:`bootstrap_isc`."""
     from .ops.stats import phase_randomize as phase_randomize_jax
 
     data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
     data, mask = _threshold_nans(data, tolerate_nans)
+    n_kept = data.shape[1]
 
     observed = isc(data, pairwise=pairwise,
                    summary_statistic=summary_statistic,
-                   tolerate_nans=tolerate_nans)
+                   tolerate_nans=tolerate_nans, mesh=mesh)
 
-    data_j = jnp.asarray(data)
+    data_j = _shard_voxels(data, mesh, 1)
     tol = bool(tolerate_nans)
     iu = np.triu_indices(n_subjects, k=1)
     others = _loo_means_core(data_j, tol)
@@ -648,7 +702,8 @@ def phaseshift_isc(data, pairwise=False, summary_statistic='median',
 
     keys = jax.random.split(jax.random.PRNGKey(_resolve_seed(random_state)),
                             n_shifts)
-    distribution = np.asarray(jax.lax.map(one_shift, keys, batch_size=16))
+    distribution = np.asarray(jax.lax.map(
+        one_shift, keys, batch_size=null_batch_size))[:, :n_kept]
 
     observed, distribution = _reinsert_nan_voxels(
         observed, distribution, mask, n_voxels)

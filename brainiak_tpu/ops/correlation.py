@@ -2,7 +2,8 @@
 
 TPU-native replacement for the reference's Cython BLAS layer
 (/root/reference/src/brainiak/fcma/cython_blas.pyx) and
-``fcma.util.compute_correlation`` (/root/reference/src/brainiak/fcma/util.py:63).
+``fcma.util.compute_correlation``
+(/root/reference/src/brainiak/fcma/util.py:63).
 
 Design notes (TPU-first):
 - The reference normalizes with scipy zscore on host, then calls sgemm into

@@ -75,7 +75,8 @@ def prior_GP_var_half_cauchy(y_invK_y, n_y, tau_range):
 
     tau2 = (y_invK_y - n_y * tau_range ** 2
             + np.sqrt(n_y ** 2 * tau_range ** 4 + (2 * n_y + 8)
-                      * tau_range ** 2 * y_invK_y + y_invK_y ** 2))         / 2 / (n_y + 2)
+                      * tau_range ** 2 * y_invK_y + y_invK_y ** 2)) \
+        / 2 / (n_y + 2)
     log_ptau = scipy.stats.halfcauchy.logpdf(tau2 ** 0.5,
                                              scale=tau_range)
     return tau2, log_ptau

@@ -62,7 +62,8 @@ def from_tri_2_sym(tri, dim):
 
 
 def from_sym_2_tri(symm):
-    """Extract the upper triangle (incl. diagonal) of a symmetric matrix as 1-D.
+    """Extract the upper triangle (incl. diagonal) of a symmetric matrix
+    as 1-D.
 
     Reference contract: utils/utils.py:95-115.
     """

@@ -1,7 +1,6 @@
 import numpy as np
 import pytest
 
-from brainiak_tpu.funcalign import srm as srm_mod
 from brainiak_tpu.funcalign.srm import SRM, DetSRM, load
 
 

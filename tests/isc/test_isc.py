@@ -148,6 +148,44 @@ def test_isfc_mesh_matches_dense():
         isfc(data[..., :2], mesh=mesh)
 
 
+def test_isc_and_nulls_mesh_match_single():
+    """mesh= shards the voxel axis (NaN-padded to the shard count) and
+    must reproduce the unsharded results; the null distributions are
+    seeded so mesh-vs-single is an exact comparison of the same
+    resamples."""
+    from brainiak_tpu.parallel import make_mesh
+    from tests.conftest import mesh_atol
+
+    mesh = make_mesh(("voxel",), (8,))
+    # 13 voxels: deliberately NOT divisible by 8 to exercise padding
+    data = simulated_timeseries(
+        n_subjects=5, n_TRs=40, n_voxels=13, noise=1.0, random_state=42)
+
+    for pairwise in (False, True):
+        plain = isc(data, pairwise=pairwise)
+        sharded = isc(data, pairwise=pairwise, mesh=mesh)
+        assert sharded.shape == plain.shape
+        assert np.allclose(sharded, plain, atol=mesh_atol())
+
+    iscs = isc(data)
+    for fn, kwargs in ((bootstrap_isc, dict(n_bootstraps=30)),
+                       (permutation_isc, dict(n_permutations=30))):
+        r_plain = fn(iscs, random_state=7, **kwargs)
+        r_mesh = fn(iscs, random_state=7, mesh=mesh,
+                    null_batch_size=8, **kwargs)
+        for a, b in zip(r_plain, r_mesh):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=mesh_atol())
+
+    for fn in (timeshift_isc, phaseshift_isc):
+        r_plain = fn(data, n_shifts=20, random_state=7)
+        r_mesh = fn(data, n_shifts=20, random_state=7, mesh=mesh,
+                    null_batch_size=4)
+        for a, b in zip(r_plain, r_mesh):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=mesh_atol())
+
+
 def test_isfc_targets_asymmetric():
     data = simulated_timeseries(5, 40, 4, random_state=4)
     targets = simulated_timeseries(5, 40, 7, random_state=5)

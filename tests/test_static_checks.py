@@ -1,0 +1,13 @@
+"""Static-analysis gate, run with the suite (reference run-checks.sh)."""
+
+import subprocess
+import sys
+
+from tests.conftest import REPO_ROOT
+
+
+def test_static_checks_clean():
+    r = subprocess.run(
+        [sys.executable, f"{REPO_ROOT}/tools/run_checks.py"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, f"static checks failed:\n{r.stdout}"
