@@ -25,9 +25,10 @@ Documented deviations from the reference's internals:
   each scan as stationary AR(1) noise rather than white, and the fitted
   (score-unused, as in the reference) X0_null_/beta0_null_ attributes
   come from a least-squares fit rather than an alternating update;
-- the Gaussian-Process prior on log-SNR uses a squared-exponential kernel
-  over coordinates (plus optional intensity) with fixed length scales
-  taken from the data scale, rather than learned GP hyperparameters.
+- the Gaussian-Process prior on log-SNR learns its length scales and
+  profiles its variance at the MAP exactly as the reference's fitV step
+  does (brsa.py:2425-2517), but jointly inside the single L-BFGS MAP
+  program rather than in an alternating fitU/fitV loop.
 """
 
 import logging
@@ -346,11 +347,60 @@ def _make_L(l_flat, n_c, rank):
     return L.at[rows[keep], cols[keep]].set(l_flat)
 
 
+def _gp_neg_log_prior(log_snr, c_space, c_inten, dist2, inten_d2,
+                      tau_range, space_range, inten_range, eta,
+                      gp_inten_on, tau2_prior):
+    """Negative log of the GP prior over log-SNR with LEARNED length
+    scales (reference brsa.py:2425-2517): squared-exponential kernel
+    over voxel coordinates (optionally × intensity), the GP variance
+    tau² profiled at its MAP under an inverse-gamma (or half-Cauchy)
+    prior, and half-Cauchy priors on the length scales themselves.
+    Constants independent of the parameters are dropped."""
+    n_v = log_snr.shape[0]
+    quad = dist2 / jnp.exp(c_space)
+    if gp_inten_on:
+        quad = quad + inten_d2 / jnp.exp(c_inten)
+    K = jnp.exp(-0.5 * quad) + eta * jnp.eye(n_v, dtype=log_snr.dtype)
+    cho = jnp.linalg.cholesky(K)
+    invk_y = jax.scipy.linalg.cho_solve((cho, True), log_snr)
+    # clamp: at the log_snr = 0 init the half-Cauchy MAP tau2 would be
+    # exactly 0 and its log would poison the whole fit with NaN
+    y_invk_y = jnp.maximum(log_snr @ invk_y, 1e-10)
+    logdet_k = 2.0 * jnp.sum(jnp.log(jnp.diag(cho)))
+
+    if tau2_prior == "halfcauchy":
+        tau2 = (y_invk_y - n_v * tau_range ** 2
+                + jnp.sqrt(n_v ** 2 * tau_range ** 4 + (2 * n_v + 8)
+                           * tau_range ** 2 * y_invk_y
+                           + y_invk_y ** 2)) / 2 / (n_v + 2)
+        log_ptau = jnp.log(2.0 / (jnp.pi * tau_range)) \
+            - jnp.log1p(tau2 / tau_range ** 2)
+    else:  # inverse-gamma on tau^2, shape=2, scale=tau_range^2
+        tau2 = (y_invk_y + 2 * tau_range ** 2) / (2 * 2 + 2 + n_v)
+        log_ptau = 2 * jnp.log(tau_range ** 2) \
+            - 3 * jnp.log(tau2) - tau_range ** 2 / tau2
+
+    neg = 0.5 * logdet_k + 0.5 * n_v * jnp.log(tau2) \
+        + 0.5 * y_invk_y / tau2 - log_ptau
+    # half-Cauchy priors on the length scales (reference brsa.py:2493-2496)
+    neg = neg - (jnp.log(2.0 / (jnp.pi * space_range))
+                 - jnp.log1p(jnp.exp(c_space) / space_range ** 2))
+    if gp_inten_on:
+        neg = neg - (jnp.log(2.0 / (jnp.pi * inten_range))
+                     - jnp.log1p(jnp.exp(c_inten) / inten_range ** 2))
+    return neg
+
+
 @partial(jax.jit, static_argnames=("n_c", "rank", "max_iters", "gp_on",
-                                   "tol"))
-def _fit_brsa_params(flat0, y_all, X, X0, scan_starts, n_runs, gp_prec,
-                     *, n_c, rank, max_iters, gp_on, tol=1e-8):
-    """Joint MAP fit of (L, per-voxel snr/σ²/ρ/λ²) by autodiff L-BFGS."""
+                                   "gp_inten_on", "tau2_prior", "tol"))
+def _fit_brsa_params(flat0, y_all, X, X0, scan_starts, n_runs, dist2,
+                     inten_d2, tau_range, space_range, inten_range, eta,
+                     gp_lims, *, n_c, rank, max_iters, gp_on,
+                     gp_inten_on, tau2_prior, tol=1e-8):
+    """Joint MAP fit of (L, per-voxel snr/σ²/ρ/λ², GP length scales) by
+    autodiff L-BFGS.  The GP length-scale hyperparameters (log l²) ride
+    at the tail of the flat parameter vector and are learned jointly, as
+    the reference does in its fitV step (brsa.py:2425-2517)."""
     n_v = y_all.shape[1]
     n_l = len(np.tril_indices(n_c, m=rank)[0])
 
@@ -378,7 +428,22 @@ def _fit_brsa_params(flat0, y_all, X, X0, scan_starts, n_runs, gp_prec,
         # reference normalizes SNR similarly after fitting)
         total = total + 0.5 * jnp.sum(log_snr) ** 2 / n_v
         if gp_on:
-            total = total + 0.5 * log_snr @ (gp_prec @ log_snr)
+            base = n_l + 4 * n_v
+            # The log length-scales are box-bounded (sigmoid transform)
+            # to [voxel scale, ROI extent]: the profiled-tau2 objective
+            # has a degenerate optimum at l -> inf (K -> rank-1, its
+            # logdet rewards collapse) that a joint optimizer will find;
+            # the reference sidesteps it by alternating fits from a
+            # small-l init (brsa.py:1406-1413), the box is the honest
+            # guard for the single-program fit.
+            c_space = gp_lims[0] + (gp_lims[1] - gp_lims[0]) * \
+                jax.nn.sigmoid(flat[base])
+            c_inten = gp_lims[2] + (gp_lims[3] - gp_lims[2]) * \
+                jax.nn.sigmoid(flat[base + 1])
+            total = total + _gp_neg_log_prior(
+                log_snr, c_space, c_inten, dist2, inten_d2,
+                tau_range, space_range, inten_range, eta, gp_inten_on,
+                tau2_prior)
         return total
 
     return minimize_lbfgs(loss, flat0, max_iters=max_iters, tol=tol)
@@ -401,8 +466,9 @@ class BRSA(BaseEstimator, TransformerMixin):
                  n_nureg=6, nureg_zscore=True, nureg_method='PCA',
                  baseline_single=False, GP_space=False, GP_inten=False,
                  space_smooth_range=None, inten_smooth_range=None,
-                 random_state=None, anneal_speed=10, lbfgs_iters=200,
-                 tol=1e-4):
+                 tau_range=5.0, tau2_prior=prior_GP_var_inv_gamma,
+                 eta=0.0001, random_state=None, anneal_speed=10,
+                 lbfgs_iters=200, tol=1e-4):
         if nureg_method != 'PCA':
             raise NotImplementedError(
                 "only nureg_method='PCA' is supported")
@@ -417,6 +483,9 @@ class BRSA(BaseEstimator, TransformerMixin):
         self.GP_inten = GP_inten
         self.space_smooth_range = space_smooth_range
         self.inten_smooth_range = inten_smooth_range
+        self.tau_range = tau_range
+        self.tau2_prior = tau2_prior
+        self.eta = eta
         self.random_state = random_state
         self.anneal_speed = anneal_speed
         self.lbfgs_iters = lbfgs_iters
@@ -446,18 +515,45 @@ class BRSA(BaseEstimator, TransformerMixin):
             X_dc[onsets[i]:onsets[i + 1], i] = 1.0
         return X_dc
 
-    def _gp_precision(self, coords, inten):
-        """Squared-exponential GP precision over voxel locations (+
-        intensity); see module docstring."""
+    def _gp_distances(self, coords, inten):
+        """Squared voxel-pair distances (and intensity differences) with
+        the smooth-range defaults and length-scale inits for the learned
+        GP (reference brsa.py:1212-1255: default smooth range is half
+        the ROI extent / intensity span)."""
         d2 = ((coords[:, None, :] - coords[None, :, :]) ** 2).sum(-1)
-        length2 = np.median(d2[d2 > 0]) if np.any(d2 > 0) else 1.0
-        K = np.exp(-0.5 * d2 / length2)
+        space_range = self.space_smooth_range
+        if space_range is None:
+            space_range = np.sqrt(np.max(d2)) / 2.0 if np.any(d2 > 0) \
+                else 1.0
+        # log l^2 box: [voxel scale, ROI extent].  The reference starts
+        # the length scale at the voxel scale (brsa.py:1406-1413); we
+        # additionally bound it above by the ROI extent because the
+        # joint fit can otherwise reach the degenerate l -> inf optimum.
+        if np.any(d2 > 0):
+            cs_lo = np.log(np.min(d2[d2 > 0]))
+            cs_hi = np.log(np.max(d2))
+        else:
+            cs_lo, cs_hi = -1.0, 1.0
+        if cs_hi - cs_lo < 1e-6:
+            cs_hi = cs_lo + 1.0
+        di2 = np.zeros((1, 1))
+        inten_range = 1.0
+        ci_lo, ci_hi = -1.0, 1.0
         if self.GP_inten and inten is not None:
             di2 = (inten[:, None] - inten[None, :]) ** 2
-            li2 = np.median(di2[di2 > 0]) if np.any(di2 > 0) else 1.0
-            K = K * np.exp(-0.5 * di2 / li2)
-        K += 1e-6 * np.eye(K.shape[0])
-        return np.linalg.inv(K)
+            inten_range = self.inten_smooth_range
+            if inten_range is None:
+                inten_range = np.sqrt(np.max(di2)) / 2.0 \
+                    if np.any(di2 > 0) else 1.0
+            if np.any(di2 > 0):
+                # 2nd-percentile lower edge, floored at 0.5
+                # (brsa.py:1416-1424)
+                ci_lo = np.log(max(np.percentile(di2[di2 > 0], 2), 0.5))
+                ci_hi = np.log(max(np.max(di2), np.exp(ci_lo)))
+            if ci_hi - ci_lo < 1e-6:
+                ci_hi = ci_lo + 1.0
+        return d2, di2, float(space_range), float(inten_range), \
+            (float(cs_lo), float(cs_hi), float(ci_lo), float(ci_hi))
 
     # -- API --------------------------------------------------------------
     def fit(self, X, design, nuisance=None, scan_onsets=None, coords=None,
@@ -501,15 +597,20 @@ class BRSA(BaseEstimator, TransformerMixin):
             X0 = np.column_stack([X0, nuisance])
 
         gp_on = bool(self.GP_space and coords is not None)
-        gp_prec = np.zeros((1, 1))
+        gp = None
         if gp_on:
-            gp_prec = self._gp_precision(np.asarray(coords, float),
-                                         None if inten is None
-                                         else np.asarray(inten, float))
+            d2, di2, space_range, inten_range, lims = \
+                self._gp_distances(np.asarray(coords, float),
+                                   None if inten is None
+                                   else np.asarray(inten, float))
+            gp = {"dist2": d2, "inten_d2": di2,
+                  "space_range": space_range, "inten_range": inten_range,
+                  "lims": lims,
+                  "inten_on": bool(self.GP_inten and inten is not None)}
 
         for it in range(max(self.n_iter, 1)):
             result = self._fit_once(data, design, X0, scan_starts,
-                                    n_runs, n_c, rank, gp_prec, gp_on)
+                                    n_runs, n_c, rank, gp)
             if not self.auto_nuisance or it == max(self.n_iter, 1) - 1:
                 break
             # auto-nuisance: PCA of residuals after removing the estimated
@@ -531,6 +632,13 @@ class BRSA(BaseEstimator, TransformerMixin):
         self.beta_ = result["beta"]
         self.beta0_ = result["beta0"]
         self.X0_ = X0
+        if gp_on:
+            # learned GP hyperparameters (reference exposes lGPspace_,
+            # bGP_ and lGPinten_ after fitting, brsa.py:452-474)
+            self.lGPspace_ = np.sqrt(np.exp(result["c_space"]))
+            self.bGP_ = np.sqrt(result["tau2"])
+            if gp["inten_on"]:
+                self.lGPinten_ = np.sqrt(np.exp(result["c_inten"]))
         self._design = design
         self._scan_starts = scan_starts
         self._n_runs = n_runs
@@ -572,22 +680,46 @@ class BRSA(BaseEstimator, TransformerMixin):
         return comps / (comps.std(0) + 1e-12)
 
     def _fit_once(self, data, design, X0, scan_starts, n_runs, n_c, rank,
-                  gp_prec, gp_on):
+                  gp=None):
         n_t, n_v = data.shape
         n_l = len(np.tril_indices(n_c, m=rank)[0])
         rng = self.random_state_
+        gp_on = gp is not None
         flat0 = np.concatenate([
             rng.randn(n_l) * 0.1 + 0.5,
             np.zeros(n_v),               # log snr
             np.log(np.var(data, axis=0)),  # log sigma2
             np.zeros(n_v),               # rho (unconstrained)
             np.zeros(n_v),               # log lambda2
+            # unconstrained GP length-scale params; -2 puts the sigmoid
+            # near the lower (voxel-scale) box edge, the reference's
+            # small-l starting point
+            [-2.0, -2.0] if gp_on else [],
         ])
+        if self.tau2_prior is prior_GP_var_half_cauchy:
+            tau2_prior = "halfcauchy"
+        elif self.tau2_prior is prior_GP_var_inv_gamma:
+            tau2_prior = "invgamma"
+        elif gp_on:
+            raise ValueError(
+                "tau2_prior must be prior_GP_var_inv_gamma or "
+                "prior_GP_var_half_cauchy (the profiled tau2 inside the "
+                "jitted objective supports exactly these two)")
+        else:
+            tau2_prior = "invgamma"
+        lims = gp["lims"] if gp_on else (0.0, 1.0, 0.0, 1.0)
         flat, value = _fit_brsa_params(
             jnp.asarray(flat0), jnp.asarray(data), jnp.asarray(design),
             jnp.asarray(X0), jnp.asarray(scan_starts), n_runs,
-            jnp.asarray(gp_prec), n_c=n_c, rank=rank,
-            max_iters=self.lbfgs_iters, gp_on=gp_on, tol=self.tol)
+            jnp.asarray(gp["dist2"] if gp_on else np.zeros((1, 1))),
+            jnp.asarray(gp["inten_d2"] if gp_on else np.zeros((1, 1))),
+            self.tau_range,
+            gp["space_range"] if gp_on else 1.0,
+            gp["inten_range"] if gp_on else 1.0,
+            self.eta, jnp.asarray(lims), n_c=n_c, rank=rank,
+            max_iters=self.lbfgs_iters, gp_on=gp_on,
+            gp_inten_on=bool(gp_on and gp["inten_on"]),
+            tau2_prior=tau2_prior, tol=self.tol)
         flat = np.asarray(flat)
         l_flat = flat[:n_l]
         log_snr = flat[n_l:n_l + n_v]
@@ -601,9 +733,34 @@ class BRSA(BaseEstimator, TransformerMixin):
         beta, beta0 = self._posterior_betas(
             data, design, X0, L, snr, sigma2, rho, np.exp(log_lam2),
             scan_starts)
-        return {"U": L @ L.T, "L": L, "snr": snr, "sigma2": sigma2,
-                "rho": rho, "beta": beta, "beta0": beta0,
-                "loss": float(value)}
+        result = {"U": L @ L.T, "L": L, "snr": snr, "sigma2": sigma2,
+                  "rho": rho, "beta": beta, "beta0": beta0,
+                  "loss": float(value)}
+        if gp_on:
+            def sig(z):
+                return 1.0 / (1.0 + np.exp(-z))
+
+            cs_lo, cs_hi, ci_lo, ci_hi = gp["lims"]
+            c_space = cs_lo + (cs_hi - cs_lo) * sig(flat[n_l + 4 * n_v])
+            c_inten = ci_lo + (ci_hi - ci_lo) * \
+                sig(flat[n_l + 4 * n_v + 1])
+            result["c_space"] = float(c_space)
+            result["c_inten"] = float(c_inten)
+            result["tau2"] = self._gp_tau2_map(
+                log_snr, gp, c_space, c_inten)
+        return result
+
+    def _gp_tau2_map(self, log_snr, gp, c_space, c_inten):
+        """MAP GP variance at the learned length scales (host replay of
+        the profiled tau² inside the objective)."""
+        n_v = log_snr.shape[0]
+        quad = gp["dist2"] / np.exp(c_space)
+        if gp["inten_on"]:
+            quad = quad + gp["inten_d2"] / np.exp(c_inten)
+        K = np.exp(-0.5 * quad) + self.eta * np.eye(n_v)
+        y_invk_y = float(log_snr @ np.linalg.solve(K, log_snr))
+        tau2, _ = self.tau2_prior(y_invk_y, n_v, self.tau_range)
+        return tau2
 
     def _posterior_betas(self, data, design, X0, L, snr, sigma2, rho,
                          lam2, scan_starts):

@@ -1,0 +1,96 @@
+"""Bayesian RSA: unbiased similarity structure under correlated noise.
+
+TPU-native counterpart of the reference's `docs/examples/reprsimil/`
+walkthrough: simulate multi-condition data whose point-estimate RSA is
+biased by shared noise, then recover the true condition-by-condition
+correlation structure with BRSA's marginal likelihood, optionally with a
+Gaussian-Process prior over log-SNR (learned length scales).
+
+Usage:
+    python examples/brsa_representational_analysis.py [--backend cpu]
+        [--gp]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def simulate(n_t=300, n_v=40, n_runs=2, seed=0):
+    rng = np.random.RandomState(seed)
+    n_c = 4
+    design = np.zeros((n_t, n_c))
+    for c in range(n_c):
+        for o in rng.choice(n_t - 12, size=8, replace=False):
+            design[o:o + 6, c] += 1.0
+    from scipy.ndimage import gaussian_filter1d
+    design = gaussian_filter1d(design, 2, axis=0)
+
+    # two clusters of conditions (1,2) and (3,4)
+    U = np.array([[1.0, 0.8, 0.0, 0.0],
+                  [0.8, 1.0, 0.0, 0.0],
+                  [0.0, 0.0, 1.0, 0.8],
+                  [0.0, 0.0, 0.8, 1.0]])
+    L = np.linalg.cholesky(U + 1e-9 * np.eye(n_c))
+    coords = np.column_stack([np.linspace(0, 20, n_v),
+                              np.zeros(n_v), np.zeros(n_v)])
+    # spatially smooth SNR profile (what the GP prior models)
+    log_snr = 1.0 * np.exp(-0.5 * (coords[:, 0] - 10.0) ** 2 / 9.0)
+    snr = np.exp(log_snr - log_snr.mean())
+    beta = (L @ rng.randn(n_c, n_v)) * snr
+    onsets = np.arange(0, n_t, n_t // n_runs)[:n_runs]
+    noise = rng.randn(n_t, n_v) + \
+        0.8 * rng.randn(n_t, 1)  # shared noise biases naive RSA
+    return design @ beta + noise, design, U, coords, onsets
+
+
+def offdiag_corr(a, b):
+    iu = np.triu_indices(a.shape[0], k=1)
+    return float(np.corrcoef(a[iu], b[iu])[0, 1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default=None)
+    ap.add_argument("--voxels", type=int, default=40)
+    ap.add_argument("--trs", type=int, default=300)
+    ap.add_argument("--gp", action="store_true",
+                    help="learn a GP prior over log-SNR")
+    args = ap.parse_args()
+    import jax
+    if args.backend:
+        jax.config.update("jax_platforms", args.backend)
+
+    from brainiak_tpu.reprsimil.brsa import BRSA
+
+    data, design, U, coords, onsets = simulate(n_t=args.trs,
+                                               n_v=args.voxels)
+
+    # naive RSA: correlation of least-squares beta estimates
+    beta_hat = np.linalg.lstsq(design, data, rcond=None)[0]
+    naive_c = np.corrcoef(beta_hat)
+
+    model = BRSA(n_iter=1, auto_nuisance=True, n_nureg=2,
+                 GP_space=args.gp, lbfgs_iters=150, random_state=0)
+    model.fit(data, design, scan_onsets=onsets,
+              coords=coords if args.gp else None)
+
+    print("true-vs-naive RSA correlation:",
+          round(offdiag_corr(naive_c, U), 3))
+    print("true-vs-BRSA correlation:",
+          round(offdiag_corr(model.C_, U), 3))
+    if args.gp:
+        print("learned GP spatial length scale:",
+              round(float(model.lGPspace_), 2))
+    ll, ll_null = model.score(data, design, scan_onsets=onsets)
+    print("cross-validated log-likelihood margin:",
+          round(float(ll - ll_null), 2))
+
+
+if __name__ == "__main__":
+    main()

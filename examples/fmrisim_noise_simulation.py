@@ -1,0 +1,86 @@
+"""fmrisim: simulate realistic fMRI data with matched noise.
+
+TPU-native counterpart of the reference's `docs/examples/fmrisim/`
+walkthrough: build a task signal (stimfunction -> HRF convolution),
+estimate noise properties from a (here: synthetic) "real" volume with
+calc_noise, regenerate matched noise with generate_noise, and verify the
+round-trip reproduces the target noise statistics.
+
+Usage:
+    python examples/fmrisim_noise_simulation.py [--backend cpu]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default=None)
+    ap.add_argument("--dim", type=int, default=18,
+                    help="volume edge length")
+    ap.add_argument("--trs", type=int, default=80)
+    args = ap.parse_args()
+    import jax
+    if args.backend:
+        jax.config.update("jax_platforms", args.backend)
+
+    from brainiak_tpu.utils import fmrisim
+
+    np.random.seed(0)
+    dims = [args.dim, args.dim, args.dim]
+    tr = 2.0
+
+    # --- task signal: two event types -> stimfunction -> HRF ---
+    onsets = np.arange(10, args.trs * tr - 20, 20.0)
+    stimfunction = fmrisim.generate_stimfunction(
+        onsets=list(onsets), event_durations=[4.0],
+        total_time=int(args.trs * tr))
+    signal_function = fmrisim.convolve_hrf(stimfunction, tr_duration=tr)
+
+    c = args.dim // 2
+    volume_signal = fmrisim.generate_signal(
+        dimensions=np.array(dims),
+        feature_coordinates=np.array([[c, c, c]]),
+        feature_size=[2], feature_type=['cube'],
+        signal_magnitude=[1.0])
+    signal = fmrisim.apply_signal(signal_function, volume_signal)
+
+    # --- a synthetic "measured" volume to estimate noise from ---
+    # brain occupies the interior; the wide border is non-brain (the SNR
+    # estimate contrasts brain against background OUTSIDE a 5-voxel
+    # dilation of the mask, so the border must be deeper than that)
+    b = max(args.dim // 3, 6)
+    template = np.zeros(dims)
+    template[b:-b, b:-b, b:-b] = 0.8
+    mask = (template > 0.5).astype(float)
+    target_dict = {'sfnr': 60.0, 'snr': 30.0, 'auto_reg_rho': [0.5],
+                   'voxel_size': [1.0, 1.0, 1.0], 'matched': 0}
+    stim_tr = stimfunction[::int(tr * 100)]
+    measured = fmrisim.generate_noise(
+        dims, stim_tr, tr, template, mask=mask,
+        noise_dict=dict(target_dict))
+
+    est = fmrisim.calc_noise(measured, mask, template)
+    print("estimated SFNR:", round(float(est['sfnr']), 1))
+    print("estimated AR(1) rho:", round(float(est['auto_reg_rho'][0]), 3))
+
+    # --- regenerate matched noise and combine with the signal ---
+    est['matched'] = 0
+    noise = fmrisim.generate_noise(dims, stim_tr, tr, template,
+                                   mask=mask, noise_dict=est)
+    brain = signal * 10.0 + noise
+    print("simulated 4-D volume:", brain.shape)
+    est2 = fmrisim.calc_noise(noise, mask, template)
+    print("round-trip SFNR:", round(float(est2['sfnr']), 1),
+          "(target", round(float(est['sfnr']), 1), ")")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Matrix-normal models: RSA and regression with structured noise.
+
+TPU-native counterpart of the reference's `docs/examples/matnormal/`
+walkthrough: simulate data whose rows (time) carry AR(1) noise and whose
+columns (space) share variance, then (a) recover a condition covariance
+with MNRSA and (b) fit a matrix-normal regression, both by autodiff
+L-BFGS over the structured-covariance marginal likelihood.
+
+Usage:
+    python examples/matnormal_rsa.py [--backend cpu]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default=None)
+    ap.add_argument("--trs", type=int, default=150)
+    ap.add_argument("--voxels", type=int, default=40)
+    args = ap.parse_args()
+    import jax
+    if args.backend:
+        jax.config.update("jax_platforms", args.backend)
+
+    from brainiak_tpu.matnormal.covs import (
+        CovAR1,
+        CovIdentity,
+        CovIsotropic,
+    )
+    from brainiak_tpu.matnormal.mnrsa import MNRSA
+    from brainiak_tpu.matnormal.regression import MatnormalRegression
+
+    rng = np.random.RandomState(0)
+    n_t, n_v, n_c = args.trs, args.voxels, 4
+    U = np.array([[1.0, 0.7, 0.0, 0.0],
+                  [0.7, 1.0, 0.0, 0.0],
+                  [0.0, 0.0, 1.0, 0.7],
+                  [0.0, 0.0, 0.7, 1.0]])
+    X = rng.randn(n_t, n_c)
+    W = np.linalg.cholesky(U) @ rng.randn(n_c, n_v)
+    # AR(1) noise over time
+    noise = np.zeros((n_t, n_v))
+    e = rng.randn(n_t, n_v)
+    noise[0] = e[0]
+    for t in range(1, n_t):
+        noise[t] = 0.5 * noise[t - 1] + np.sqrt(1 - 0.25) * e[t]
+    Y = X @ W + 0.7 * noise
+
+    model = MNRSA(time_cov=CovAR1(n_t), space_cov=CovIsotropic(n_v),
+                  n_nureg=2)
+    model.fit(Y, X)
+    iu = np.triu_indices(n_c, 1)
+    c = np.corrcoef(model.C_[iu], U[iu])[0, 1]
+    print("MNRSA similarity recovery (off-diag corr):",
+          round(float(c), 3))
+
+    reg = MatnormalRegression(time_cov=CovAR1(n_t),
+                              space_cov=CovIdentity(n_v))
+    reg.fit(X, Y)
+    w_corr = np.corrcoef(reg.beta_.ravel(), W.ravel())[0, 1]
+    print("matnormal regression weight recovery:",
+          round(float(w_corr), 3))
+
+
+if __name__ == "__main__":
+    main()

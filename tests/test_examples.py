@@ -52,3 +52,30 @@ def test_example_isc():
 def test_example_htfa():
     out = _run("htfa_template.py", "--subjects", "2")
     assert "max center error" in out
+
+
+def test_example_brsa():
+    out = _run("brsa_representational_analysis.py", "--voxels", "20",
+               "--trs", "200")
+    assert "true-vs-BRSA correlation" in out
+
+
+def test_example_eventseg():
+    out = _run("eventseg_boundaries.py", "--events", "4",
+               "--voxels", "12")
+    assert "max boundary error" in out
+
+
+def test_example_iem():
+    out = _run("iem_orientation.py", "--voxels", "30", "--trials", "60")
+    assert "median circular error" in out
+
+
+def test_example_matnormal():
+    out = _run("matnormal_rsa.py", "--trs", "100", "--voxels", "20")
+    assert "MNRSA similarity recovery" in out
+
+
+def test_example_fmrisim():
+    out = _run("fmrisim_noise_simulation.py", "--trs", "40")
+    assert "round-trip SFNR" in out

@@ -23,7 +23,8 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SKIP_DIRS = {".git", "__pycache__", ".claude", "build", "dist",
-             ".pytest_cache", "node_modules"}
+             ".pytest_cache", "node_modules", ".venv", "venv", ".tox",
+             ".eggs", ".ruff_cache", ".mypy_cache"}
 MAX_COLS = 79
 
 
@@ -51,10 +52,10 @@ def check_line_length(path, lines, noqa, findings):
     for i, line in enumerate(lines, 1):
         if i in noqa:
             continue
-        if len(line.rstrip("\n")) > MAX_COLS:
+        n = len(line.rstrip("\n"))
+        if n > MAX_COLS:
             findings.append(
-                f"{path}:{i}: line too long "
-                f"({len(line.rstrip())} > {MAX_COLS})")
+                f"{path}:{i}: line too long ({n} > {MAX_COLS})")
 
 
 class _ImportCollector(ast.NodeVisitor):
