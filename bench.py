@@ -58,10 +58,13 @@ def tpu_voxels_per_sec(n_voxels=N_VOXELS, unit=512, warm=True):
     return n_voxels / dt
 
 
-def cpu_voxels_per_sec(block=64):
+def cpu_voxels_per_sec(n_voxels=N_VOXELS, block=64):
+    """Reference-path throughput on host BLAS, at the SAME voxel count as
+    the jax path being compared (per-voxel cost scales with the full
+    correlation width, so mismatched sizes would skew vs_baseline)."""
     from sklearn import model_selection, svm
 
-    data, labels = make_data()
+    data, labels = make_data(n_voxels)
     stacked = np.stack(data)  # [E, T, V]
     t0 = time.perf_counter()
     blk = stacked[:, :, :block]
@@ -73,11 +76,11 @@ def cpu_voxels_per_sec(block=64):
     den[den <= 0] = 1e-4
     z = 0.5 * np.log(num / den)
     zr = z.reshape(block, N_EPOCHS // EPOCHS_PER_SUBJ, EPOCHS_PER_SUBJ,
-                   N_VOXELS)
+                   n_voxels)
     m = zr.mean(axis=2, keepdims=True)
     var = (zr ** 2).mean(axis=2, keepdims=True) - m ** 2
     inv = np.where(var <= 0, 0.0, 1.0 / np.sqrt(np.maximum(var, 1e-30)))
-    normed = ((zr - m) * inv).reshape(block, N_EPOCHS, N_VOXELS)
+    normed = ((zr - m) * inv).reshape(block, N_EPOCHS, n_voxels)
     clf = svm.SVC(kernel='precomputed', shrinking=False, C=1)
     skf = model_selection.StratifiedKFold(n_splits=NUM_FOLDS,
                                           shuffle=False)
@@ -119,7 +122,7 @@ def main():
         # minutes on CPU)
         jax.config.update("jax_platforms", "cpu")
         vps = tpu_voxels_per_sec(n_voxels=2048, unit=256)
-        cpu_vps = cpu_voxels_per_sec(block=32)
+        cpu_vps = cpu_voxels_per_sec(n_voxels=2048, block=32)
         print(json.dumps({
             "metric": "fcma_voxel_selection_voxels_per_sec_chip"
                       "_CPU_FALLBACK_tpu_unresponsive",

@@ -7,22 +7,19 @@ components scaled to target SNR/SFNR), plus noise-parameter estimation from
 real data and receptive-field generators.
 
 This is a host-side data generator (NumPy), as in the reference — it feeds
-the TPU analysis pipelines rather than running on device.  Documented
-deviations from the reference internals:
+the TPU analysis pipelines rather than running on device.  Spatial noise
+is a power-law spectral Gaussian random field with a self-calibrated
+FWHM→exponent map (reference fmrisim.py:1890-1971), and the
+``cos_power_drop`` drift is the DCT ladder with a 99%-power cutoff
+(reference fmrisim.py:1546-1693).  Documented deviations from the
+reference internals:
 
-- spatial noise fields are white noise smoothed with a Gaussian kernel of
-  the requested FWHM (the reference uses an FFT Gaussian-field sampler with
-  an empirically tuned FWHM→sigma map, fmrisim.py:1389-1500);
 - ARMA coefficient estimation uses closed-form Yule-Walker / moment
   estimators instead of statsmodels ARIMA MLE (fmrisim.py:1205-1289) —
   statsmodels is not a dependency of this framework;
 - ``mask_brain`` without ``mask_self`` synthesizes a smooth ellipsoidal
   head template instead of loading the packaged grey-matter atlas
-  (fmrisim.py:2230-2366);
-- the ``cos_power_drop`` drift basis is approximated by a 1/b-weighted
-  cosine ladder rather than the reference's DCT with a 99%-power cutoff
-  (fmrisim.py:1546-1628) — same slow-drift character, different exact
-  spectrum.
+  (fmrisim.py:2230-2366).
 """
 
 import logging
@@ -365,14 +362,78 @@ def _noise_dict_update(noise_dict):
     return noise_dict
 
 
+def _spectral_field(dimensions, exponent, white):
+    """Filter a white-noise volume to a |k|^(-exponent/2) power-law
+    spectrum (the standard spectral Gaussian-random-field recipe, as the
+    reference adopts at fmrisim.py:1890-1971)."""
+    freqs = np.meshgrid(*[np.fft.fftfreq(d, d=1.0 / d)
+                          for d in dimensions], indexing="ij")
+    k = np.sqrt(sum(f ** 2 for f in freqs))
+    amplitude = np.zeros_like(k)
+    amplitude[k > 0] = k[k > 0] ** (-exponent / 2.0)
+    return np.real(np.fft.ifftn(np.fft.fftn(white) * amplitude))
+
+
+_SPECTRAL_CALIBRATION = {}
+
+
+def _spectral_exponent_for_fwhm(dimensions, fwhm):
+    """Spectral exponent realizing the requested FWHM on THIS grid.
+
+    A pure power-law field is scale-free, so a fixed exponent yields a
+    smoothness proportional to the box size (the reference's empirical
+    FWHM→sigma map admits the same grid dependence,
+    fmrisim.py:1923-1934).  Instead of a fixed fit, calibrate at
+    runtime: measured FWHM is monotone in the exponent, so bisect on
+    trial fields measured with :func:`_calc_fwhm`.  Results are cached
+    per (grid, fwhm); a private RNG keeps the global NumPy stream
+    untouched by calibration."""
+    key = (tuple(dimensions), round(float(fwhm), 3))
+    if key in _SPECTRAL_CALIBRATION:
+        return _SPECTRAL_CALIBRATION[key]
+    rng = np.random.default_rng(1234)
+    ones = np.ones(dimensions)
+
+    def measure(exponent, reps=3):
+        vals = []
+        for _ in range(reps):
+            f = _spectral_field(dimensions, exponent,
+                                rng.standard_normal(dimensions))
+            f = (f - f.mean()) / (f.std() + 1e-12)
+            vals.append(_calc_fwhm(f, ones))
+        return float(np.mean(vals))
+
+    lo, hi = 0.0, 10.0
+    if measure(lo) >= fwhm:
+        result = lo
+    elif measure(hi) <= fwhm:
+        result = hi
+    else:
+        for _ in range(7):
+            mid = 0.5 * (lo + hi)
+            if measure(mid) < fwhm:
+                lo = mid
+            else:
+                hi = mid
+        result = 0.5 * (lo + hi)
+    _SPECTRAL_CALIBRATION[key] = result
+    return result
+
+
 def _generate_noise_spatial(dimensions, template=None, mask=None, fwhm=4.0):
-    """Smooth Gaussian random field (white noise smoothed to ~fwhm,
-    z-scored; see module docstring for deviation)."""
+    """Gaussian random field with a power-law spatial spectrum whose
+    exponent is calibrated so the realized smoothness matches ``fwhm``
+    on this grid.  Masked voxels are z-scored within the mask."""
     dimensions = tuple(int(d) for d in dimensions[:3])
-    field = np.random.randn(*dimensions)
-    sigma = max(fwhm, 1e-3) / 2.355
-    field = ndimage.gaussian_filter(field, sigma)
-    field = (field - field.mean()) / (field.std() + 1e-12)
+    exponent = _spectral_exponent_for_fwhm(dimensions, fwhm)
+    field = _spectral_field(dimensions, exponent,
+                            np.random.randn(*dimensions))
+    if mask is not None:
+        field = field * mask
+        inside = mask > 0
+        field[inside] = stats.zscore(field[inside])
+    else:
+        field = (field - field.mean()) / (field.std() + 1e-12)
     return field
 
 
@@ -391,27 +452,62 @@ def _generate_noise_temporal_task(stimfunction_tr, motion_noise='gaussian'):
     return np.nan_to_num(stats.zscore(noise_task)).flatten()
 
 
+def _drift_power_drop_rate(duration, period, tr_duration,
+                           retained=0.99):
+    """Per-basis geometric weight decay r such that the DCT ladder keeps
+    ``retained`` of its highest-frequency power at the requested period:
+    (1 - r^(2L/F)) / (1 - r^(2L/tr)) = retained, solved by bisection on
+    (0, 1) — the ratio decreases monotonically from 1 (r->0) to tr/F
+    (r->1), so the root is unique (semantics of reference
+    fmrisim.py:1634-1680)."""
+    if period < tr_duration:
+        raise ValueError(
+            'Drift period (%0.0f s) must be at least the TR duration '
+            '(%0.0f s)' % (period, tr_duration))
+
+    def ratio(r):
+        return (1 - r ** (2 * duration / period)) / \
+            (1 - r ** (2 * duration / tr_duration))
+
+    lo, hi = 1e-12, 1 - 1e-12
+    for _ in range(200):
+        mid = 0.5 * (lo + hi)
+        if ratio(mid) > retained:
+            lo = mid
+        else:
+            hi = mid
+    return 0.5 * (lo + hi)
+
+
 def _generate_noise_temporal_drift(trs, tr_duration, basis="cos_power_drop",
                                    period=150):
-    """Slow scanner drift (reference fmrisim.py:1546-1628)."""
+    """Slow scanner drift (reference fmrisim.py:1546-1693).
+
+    ``cos_power_drop`` (default) is a full DCT ladder (one basis per TR,
+    frequency proportional to the basis index) with geometrically
+    decaying weights chosen so 99% of the power sits below the requested
+    period; ``discrete_cos`` is the equal-power harmonic ladder;
+    ``sine`` a single randomized-phase sinusoid."""
     timepoints = np.linspace(0, trs - 1, trs) * tr_duration
     duration = trs * tr_duration
-    if basis in ("discrete_cos", "cos_power_drop"):
+    if basis == "discrete_cos":
         rad = (timepoints / period) * 2 * np.pi
         basis_funcs = int(np.floor(duration / period))
         if basis_funcs == 0:
             logger.warning('Too few timepoints (%d) to accurately model '
                            'drift', trs)
             basis_funcs = 1
-        drift = np.zeros((trs, basis_funcs))
-        for b in range(1, basis_funcs + 1):
-            phase = np.random.rand() * np.pi * 2
-            if basis == "discrete_cos":
-                drift[:, b - 1] = np.cos(rad / b + phase)
-            else:
-                # power drops off for higher-frequency bases
-                drift[:, b - 1] = np.cos(rad * b + phase) / b
-        noise_drift = drift.mean(axis=1)
+        b = np.arange(1, basis_funcs + 1)
+        phases = np.random.rand(basis_funcs) * np.pi * 2
+        ladder = np.cos(rad[:, None] / b[None, :] + phases[None, :])
+        noise_drift = ladder.mean(axis=1)
+    elif basis == "cos_power_drop":
+        b = np.arange(1, trs + 1)
+        phases = np.random.rand(trs) * np.pi * 2
+        ladder = np.cos(timepoints[:, None] / duration * np.pi *
+                        b[None, :] + phases[None, :])
+        r = _drift_power_drop_rate(duration, period, tr_duration)
+        noise_drift = (ladder * r ** (b - 1)[None, :]).mean(axis=1)
     elif basis == "sine":
         phase = np.random.rand() * np.pi * 2
         noise_drift = np.sin(timepoints / period * 2 * np.pi + phase)

@@ -193,6 +193,41 @@ def test_calc_noise_roundtrip():
     assert est['fwhm'] > 0
 
 
+def test_spatial_noise_fwhm_calibration():
+    """The spectral field sampler must realize the requested smoothness:
+    measured FWHM tracks the request across the usual range (the
+    reference's empirical FWHM→sigma map contract,
+    fmrisim.py:1917-1934)."""
+    np.random.seed(5)
+    for n in (16, 32):  # calibration must be grid-size independent
+        dims = (n, n, n)
+        mask = np.ones(dims)
+        est = {}
+        for f in (2.0, 4.0, 6.0):
+            est[f] = np.mean([
+                sim._calc_fwhm(sim._generate_noise_spatial(dims, fwhm=f),
+                               mask) for _ in range(8)])
+        assert est[2.0] < est[4.0] < est[6.0]
+        for f, e in est.items():
+            assert abs(e - f) / f < 0.35, (n, f, e)
+
+
+def test_drift_power_drop_spectrum():
+    """cos_power_drop concentrates drift power below the requested
+    period and suppresses the high-frequency tail (the reference's
+    99%-power DCT criterion, fmrisim.py:1634-1680)."""
+    np.random.seed(6)
+    trs, tr, period = 300, 2.0, 150
+    drift = sim._generate_noise_temporal_drift(
+        trs, tr, basis="cos_power_drop", period=period)
+    p = np.abs(np.fft.rfft(drift)) ** 2
+    freqs = np.fft.rfftfreq(trs, d=tr)
+    assert p[freqs <= 1.0 / period].sum() / p.sum() > 0.7
+    assert p[freqs > 10.0 / period].sum() / p.sum() < 0.05
+    with pytest.raises(ValueError):
+        sim._generate_noise_temporal_drift(100, 2.0, period=1.0)
+
+
 def test_mask_brain():
     mask, template = sim.mask_brain(np.array([10, 10, 10]),
                                     mask_self=False)
