@@ -34,18 +34,62 @@ logger = logging.getLogger(__name__)
 __all__ = ["VoxelSelector"]
 
 
-def _gram_and_shrink(corr, precision=None):
-    """Per-voxel linear-kernel Gram with the reference's magnitude
-    shrink: scale so K[0,0] has at most 2 integer digits for stable SVM
-    duals (reference cython_blas.pyx compute_kernel_matrix + digit
-    shrink, voxelselector.py:407-412)."""
-    kernels = jnp.einsum('bev,bfv->bef', corr, corr,
-                         precision=resolve_precision(precision),
-                         preferred_element_type=jnp.float32)
+def _shrink(kernels):
+    """The reference's magnitude shrink: scale so K[0,0] has at most 2
+    integer digits for stable SVM duals (reference cython_blas.pyx
+    compute_kernel_matrix + digit shrink, voxelselector.py:407-412)."""
     k00 = jnp.clip(kernels[:, 0, 0], 1.0, None)
     ndigits = jnp.floor(jnp.log10(k00)) + 1
     proportion = jnp.where(ndigits > 2, 10.0 ** (2 - ndigits), 1.0)
     return kernels * proportion[:, None, None]
+
+
+def _gram_and_shrink(corr, precision=None):
+    """Per-voxel linear-kernel Gram with the magnitude shrink."""
+    kernels = jnp.einsum('bev,bfv->bef', corr, corr,
+                         precision=resolve_precision(precision),
+                         preferred_element_type=jnp.float32)
+    return _shrink(kernels)
+
+
+def _pad_to_tiles(blk, data2):
+    """Shared Pallas preamble: pick VMEM tile sizes and zero-pad the
+    block/voxel axes to tile multiples (zero columns normalize to zero,
+    so they are inert downstream).  Returns (blk_p, data_p, tile_b,
+    tile_v, fits)."""
+    from ..ops.pallas_kernels import pick_tiles
+
+    n_e, n_t, n_b = blk.shape
+    n_v = data2.shape[2]
+    tile_b, tile_v, fits = pick_tiles(n_e, n_t, n_b, n_v)
+    if not fits:
+        return blk, data2, tile_b, tile_v, False
+    blk_p = jnp.pad(blk, ((0, 0), (0, 0), (0, (-n_b) % tile_b)))
+    data_p = jnp.pad(data2, ((0, 0), (0, 0), (0, (-n_v) % tile_v)))
+    return blk_p, data_p, tile_b, tile_v, True
+
+
+@partial(jax.jit, static_argnames=("epochs_per_subj", "interpret",
+                                   "precision"))
+def _block_gram_pallas(blk, data2, epochs_per_subj, interpret=False,
+                       precision=None):
+    """Gram-only Pallas path: the [block, E, V] normalized-correlation
+    tensor is reduced in VMEM and never reaches HBM (see
+    :func:`brainiak_tpu.ops.pallas_kernels.fcma_gram`) — the SVM CV only
+    needs the [block, E, E] kernels."""
+    from ..ops.pallas_kernels import fcma_gram
+
+    n_b = blk.shape[2]
+    blk_p, data_p, tile_b, tile_v, fits = _pad_to_tiles(blk, data2)
+    if not fits:
+        # epoch x TR extent too large for VMEM tiles — use the XLA path
+        kernels, _ = _block_kernel_matrices(blk, data2, epochs_per_subj,
+                                            precision=precision)
+        return kernels
+    kernels = fcma_gram(blk_p, data_p, epochs_per_subj, tile_b=tile_b,
+                        tile_v=tile_v, interpret=interpret,
+                        precision=precision)
+    return _shrink(kernels[:n_b])
 
 
 @partial(jax.jit, static_argnames=("epochs_per_subj", "interpret",
@@ -55,19 +99,15 @@ def _block_kernel_matrices_pallas(blk, data2, epochs_per_subj,
     """Pallas-fused variant of :func:`_block_kernel_matrices`: the
     correlation + Fisher-z + normalization tile never round-trips to HBM
     (see :mod:`brainiak_tpu.ops.pallas_kernels`)."""
-    from ..ops.pallas_kernels import fcma_corr_normalize, pick_tiles
+    from ..ops.pallas_kernels import fcma_corr_normalize
 
-    n_e, n_t, n_b = blk.shape
+    n_b = blk.shape[2]
     n_v = data2.shape[2]
-    tile_b, tile_v, fits = pick_tiles(n_e, n_t, n_b, n_v)
+    blk_p, data_p, tile_b, tile_v, fits = _pad_to_tiles(blk, data2)
     if not fits:
         # epoch x TR extent too large for VMEM tiles — use the XLA path
         return _block_kernel_matrices(blk, data2, epochs_per_subj,
                                       precision=precision)
-    pad_b = (-n_b) % tile_b
-    pad_v = (-n_v) % tile_v
-    blk_p = jnp.pad(blk, ((0, 0), (0, 0), (0, pad_b)))
-    data_p = jnp.pad(data2, ((0, 0), (0, 0), (0, pad_v)))
     corr = fcma_corr_normalize(blk_p, data_p, epochs_per_subj,
                                tile_b=tile_b, tile_v=tile_v,
                                interpret=interpret, precision=precision)
@@ -204,7 +244,16 @@ class VoxelSelector:
                 if self.num_voxels >= block else 0
             offset = start - pad_start
             blk = self._slice_block(data1, pad_start, block)
-            if self.use_pallas:
+            on_device_svm = isinstance(clf, str) and clf == 'svm'
+            if self.use_pallas and on_device_svm:
+                # Gram-only fusion: the [block, E, V] tensor never
+                # round-trips through HBM
+                kernels = _block_gram_pallas(
+                    blk, data2, self.epochs_per_subj,
+                    interpret=jax.default_backend() != 'tpu',
+                    precision=self.precision)
+                corr = None
+            elif self.use_pallas:
                 kernels, corr = _block_kernel_matrices_pallas(
                     blk, data2, self.epochs_per_subj,
                     interpret=jax.default_backend() != 'tpu',
@@ -214,8 +263,9 @@ class VoxelSelector:
                     blk, data2, self.epochs_per_subj,
                     precision=self.precision)
             kernels = kernels[offset:offset + cur]
-            corr = corr[offset:offset + cur]
-            if isinstance(clf, str) and clf == 'svm':
+            if corr is not None:
+                corr = corr[offset:offset + cur]
+            if on_device_svm:
                 accs = svm_cv_accuracy(kernels, self.labels,
                                        self.num_folds, C=self.svm_C,
                                        n_iters=self.svm_iters)

@@ -27,7 +27,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .fisherz import _CLAMP
 
-__all__ = ["fcma_corr_normalize", "pick_tiles"]
+__all__ = ["fcma_corr_normalize", "fcma_gram", "pick_tiles"]
 
 # VMEM budget per program (floats): two input tiles [E,T,tile] plus the
 # output tile [tile_b, E, tile_v] must fit comfortably in ~16 MB of VMEM.
@@ -55,9 +55,11 @@ def pick_tiles(n_epochs, n_trs, n_b, n_v):
     return tile_b, tile_v, used(tile_b, tile_v) <= _VMEM_BUDGET_FLOATS
 
 
-def _kernel(blk_ref, data_ref, out_ref, *, n_epochs, epochs_per_subj,
-            precision=jax.lax.Precision.HIGHEST):
-    """One (TB, TV) tile: correlate, Fisher-z, normalize, store."""
+def _normalized_corr_tile(blk_ref, data_ref, n_epochs, epochs_per_subj,
+                          precision):
+    """Compute one (TB, TV) tile of normalized correlation in VMEM:
+    per-epoch MXU matmuls, clamped Fisher-z, per-subject epoch z-score
+    (fcma_extension.cc:68-84 semantics).  Returns [TB, E, TV]."""
     n_subjs = n_epochs // epochs_per_subj
 
     # per-epoch correlation on the MXU: [TB, T] @ [T, TV]
@@ -83,7 +85,36 @@ def _kernel(blk_ref, data_ref, out_ref, *, n_epochs, epochs_per_subj,
     mean = jnp.mean(zr, axis=2, keepdims=True)
     var = jnp.mean(zr * zr, axis=2, keepdims=True) - mean * mean
     inv = jnp.where(var <= 0.0, 0.0, jax.lax.rsqrt(var))
-    out_ref[:, :, :] = ((zr - mean) * inv).reshape(tb, n_epochs, tv)
+    return ((zr - mean) * inv).reshape(tb, n_epochs, tv)
+
+
+def _kernel(blk_ref, data_ref, out_ref, *, n_epochs, epochs_per_subj,
+            precision=jax.lax.Precision.HIGHEST):
+    """One (TB, TV) tile: correlate, Fisher-z, normalize, store."""
+    out_ref[:, :, :] = _normalized_corr_tile(
+        blk_ref, data_ref, n_epochs, epochs_per_subj, precision)
+
+
+def _gram_kernel(blk_ref, data_ref, out_ref, *, n_epochs,
+                 epochs_per_subj, precision=jax.lax.Precision.HIGHEST):
+    """One (TB, TV) tile reduced straight into per-voxel Gram matrices.
+
+    The voxel grid axis is a reduction: each program adds its tile's
+    contribution z @ z^T to the [TB, E, E] accumulator, so the [B, E, V]
+    normalized-correlation tensor never exists in HBM at all — the
+    payoff of fusing, since for whole-brain V that tensor dominates
+    memory traffic (the on-chip analog of the reference's portioned-Gram
+    accumulation, classifier.py:279-348)."""
+    z = _normalized_corr_tile(blk_ref, data_ref, n_epochs,
+                              epochs_per_subj, precision)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[:, :, :] = jnp.zeros_like(out_ref)
+
+    out_ref[:, :, :] += jax.lax.dot_general(
+        z, z, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32, precision=precision)
 
 
 @functools.partial(jax.jit,
@@ -136,6 +167,65 @@ def fcma_corr_normalize(blk, data, epochs_per_subj, tile_b=None,
             ],
             out_specs=pl.BlockSpec((tile_b, n_epochs, tile_v),
                                    lambda i, j: (i, 0, j),
+                                   memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(blk, jnp.float32), jnp.asarray(data, jnp.float32))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("epochs_per_subj", "tile_b", "tile_v",
+                                    "interpret", "precision"))
+def fcma_gram(blk, data, epochs_per_subj, tile_b=None, tile_v=None,
+              interpret=False, precision=None):
+    """Fused FCMA correlation + normalization + per-voxel Gram reduction.
+
+    Like :func:`fcma_corr_normalize` followed by
+    ``einsum('bev,bfv->bef')``, but the [B, E, V] normalized-correlation
+    tensor is reduced tile-by-tile in VMEM and never written to HBM —
+    the voxel grid axis accumulates into the [B, E, E] output (TPU grids
+    iterate the last axis innermost, so the accumulator tile stays
+    resident).
+
+    blk : [E, T, B]; data : [E, T, V]; returns [B, E, E] float32
+    (un-shrunk — callers apply the digit shrink, which needs K[0,0]).
+    B and V must be multiples of tile_b/tile_v (callers pad; zero
+    padding on V contributes exactly zero to the Gram).
+    """
+    from .correlation import resolve_precision
+    n_epochs, n_trs, n_b = blk.shape
+    n_v = data.shape[2]
+    auto_b, auto_v, fits = pick_tiles(n_epochs, n_trs, n_b, n_v)
+    if tile_b is None and tile_v is None and not fits:
+        raise ValueError(
+            "epoch x TR extent too large for VMEM tiles "
+            f"(E={n_epochs}, T={n_trs}); use the XLA path instead")
+    tile_b = auto_b if tile_b is None else tile_b
+    tile_v = auto_v if tile_v is None else tile_v
+    assert n_b % tile_b == 0 and n_v % tile_v == 0, \
+        "block/voxel sizes must be multiples of the tile sizes"
+
+    grid = (n_b // tile_b, n_v // tile_v)
+    kernel = functools.partial(_gram_kernel, n_epochs=n_epochs,
+                               epochs_per_subj=epochs_per_subj,
+                               precision=resolve_precision(precision))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_b, n_epochs, n_epochs),
+                                       jnp.float32),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((n_epochs, n_trs, tile_b),
+                             lambda i, j: (0, 0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((n_epochs, n_trs, tile_v),
+                             lambda i, j: (0, 0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            # independent of j: the voxel axis reduces into this tile
+            out_specs=pl.BlockSpec((tile_b, n_epochs, n_epochs),
+                                   lambda i, j: (i, 0, 0),
                                    memory_space=pltpu.VMEM),
         ),
         interpret=interpret,

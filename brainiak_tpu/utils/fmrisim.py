@@ -365,9 +365,12 @@ def _noise_dict_update(noise_dict):
 def _spectral_field(dimensions, exponent, white):
     """Filter a white-noise volume to a |k|^(-exponent/2) power-law
     spectrum (the standard spectral Gaussian-random-field recipe, as the
-    reference adopts at fmrisim.py:1890-1971)."""
-    freqs = np.meshgrid(*[np.fft.fftfreq(d, d=1.0 / d)
-                          for d in dimensions], indexing="ij")
+    reference adopts at fmrisim.py:1890-1971).  Wavenumbers are in
+    cycles per VOXEL (plain fftfreq), so the weighting is isotropic in
+    voxel units on non-cubic grids — per-box integer wavenumbers would
+    make the short axis rougher per voxel."""
+    freqs = np.meshgrid(*[np.fft.fftfreq(d) for d in dimensions],
+                        indexing="ij")
     k = np.sqrt(sum(f ** 2 for f in freqs))
     amplitude = np.zeros_like(k)
     amplitude[k > 0] = k[k > 0] ** (-exponent / 2.0)
@@ -454,12 +457,14 @@ def _generate_noise_temporal_task(stimfunction_tr, motion_noise='gaussian'):
 
 def _drift_power_drop_rate(duration, period, tr_duration,
                            retained=0.99):
-    """Per-basis geometric weight decay r such that the DCT ladder keeps
-    ``retained`` of its highest-frequency power at the requested period:
-    (1 - r^(2L/F)) / (1 - r^(2L/tr)) = retained, solved by bisection on
-    (0, 1) — the ratio decreases monotonically from 1 (r->0) to tr/F
-    (r->1), so the root is unique (semantics of reference
-    fmrisim.py:1634-1680)."""
+    """Per-basis geometric weight decay r solving the reference's
+    power-drop criterion (1 - r^(2L/F)) / (1 - r^(2L/tr)) = retained,
+    by bisection on (0, 1) — the ratio decreases monotonically from 1
+    (r->0) to tr/F (r->1), so the root is unique.  Reproduces reference
+    fmrisim.py:1634-1680 exactly; note its exponents index the basis
+    whose PERIOD is 2F (DCT basis b has period 2L/b), so the realized
+    cutoff is stronger than a literal 99%-of-power-below-F reading —
+    drift comes out at least as smooth as requested."""
     if period < tr_duration:
         raise ValueError(
             'Drift period (%0.0f s) must be at least the TR duration '
@@ -502,11 +507,16 @@ def _generate_noise_temporal_drift(trs, tr_duration, basis="cos_power_drop",
         ladder = np.cos(rad[:, None] / b[None, :] + phases[None, :])
         noise_drift = ladder.mean(axis=1)
     elif basis == "cos_power_drop":
-        b = np.arange(1, trs + 1)
-        phases = np.random.rand(trs) * np.pi * 2
+        r = _drift_power_drop_rate(duration, period, tr_duration)
+        # geometric weights vanish quickly: keep only bases above 1e-8
+        # weight (identical output after the z-score; avoids an
+        # O(trs^2) ladder on long runs)
+        n_keep = trs if r >= 1.0 - 1e-12 else \
+            min(trs, int(np.ceil(1 - 8 * np.log(10) / np.log(r))))
+        b = np.arange(1, n_keep + 1)
+        phases = np.random.rand(n_keep) * np.pi * 2
         ladder = np.cos(timepoints[:, None] / duration * np.pi *
                         b[None, :] + phases[None, :])
-        r = _drift_power_drop_rate(duration, period, tr_duration)
         noise_drift = (ladder * r ** (b - 1)[None, :]).mean(axis=1)
     elif basis == "sine":
         phase = np.random.rand() * np.pi * 2

@@ -150,6 +150,41 @@ def test_pallas_fcma_kernel_matches_xla_path():
     assert np.allclose(got[mask], expected[mask], atol=1e-4)
 
 
+def test_pallas_gram_kernel_matches_unfused():
+    """The Gram-accumulating kernel (voxel grid axis as an in-VMEM
+    reduction) equals corr-normalize followed by the Gram einsum."""
+    import jax.numpy as jnp
+
+    from brainiak_tpu.ops.pallas_kernels import (
+        fcma_corr_normalize,
+        fcma_gram,
+    )
+
+    rng = np.random.RandomState(1)
+    E, T, B, V = 8, 40, 16, 48
+    data = rng.randn(E, T, V).astype(np.float32)
+    norm = np.asarray(normalize_for_correlation(
+        jnp.asarray(data).transpose(0, 2, 1), 2)).transpose(0, 2, 1)
+    blk = norm[:, :, :B]
+
+    corr = np.asarray(fcma_corr_normalize(
+        jnp.asarray(blk), jnp.asarray(norm), 4, tile_b=8, tile_v=16,
+        interpret=True))
+    expected = np.einsum('bev,bfv->bef', corr, corr)
+    got = np.asarray(fcma_gram(
+        jnp.asarray(blk), jnp.asarray(norm), 4, tile_b=8, tile_v=16,
+        interpret=True))
+    assert got.shape == (B, E, E)
+    assert np.allclose(got, expected, atol=1e-3)
+    # zero-padded voxel columns must contribute exactly nothing
+    norm_pad = np.concatenate(
+        [norm, np.zeros((E, T, 16), np.float32)], axis=2)
+    got_pad = np.asarray(fcma_gram(
+        jnp.asarray(blk), jnp.asarray(norm_pad), 4, tile_b=8, tile_v=16,
+        interpret=True))
+    assert np.allclose(got_pad, got, atol=1e-5)
+
+
 def test_ring_correlation_matches_dense():
     """Ring-sharded V x V correlation over an 8-way voxel mesh equals the
     dense corrcoef, with only shard-resident data per device."""

@@ -210,6 +210,18 @@ def test_spatial_noise_fwhm_calibration():
         assert est[2.0] < est[4.0] < est[6.0]
         for f, e in est.items():
             assert abs(e - f) / f < 0.35, (n, f, e)
+    # non-cubic grids: isotropic in voxel units, still calibrated
+    dims = (32, 32, 12)
+    diffs = {ax: [] for ax in range(3)}
+    fwhms = []
+    for _ in range(8):
+        f = sim._generate_noise_spatial(dims, fwhm=4.0)
+        for ax in range(3):
+            diffs[ax].append(np.std(np.diff(f, axis=ax)))
+        fwhms.append(sim._calc_fwhm(f, np.ones(dims)))
+    per_axis = [np.mean(diffs[ax]) for ax in range(3)]
+    assert max(per_axis) / min(per_axis) < 1.3, per_axis
+    assert abs(np.mean(fwhms) - 4.0) / 4.0 < 0.35
 
 
 def test_drift_power_drop_spectrum():
