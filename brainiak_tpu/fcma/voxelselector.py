@@ -83,9 +83,8 @@ def _block_gram_pallas(blk, data2, epochs_per_subj, interpret=False,
     blk_p, data_p, tile_b, tile_v, fits = _pad_to_tiles(blk, data2)
     if not fits:
         # epoch x TR extent too large for VMEM tiles — use the XLA path
-        kernels, _ = _block_kernel_matrices(blk, data2, epochs_per_subj,
-                                            precision=precision)
-        return kernels
+        return _block_gram_xla(blk, data2, epochs_per_subj,
+                               precision=precision)
     kernels = fcma_gram(blk_p, data_p, epochs_per_subj, tile_b=tile_b,
                         tile_v=tile_v, interpret=interpret,
                         precision=precision)
@@ -113,6 +112,18 @@ def _block_kernel_matrices_pallas(blk, data2, epochs_per_subj,
                                interpret=interpret, precision=precision)
     corr = corr[:n_b, :, :n_v]
     return _gram_and_shrink(corr, precision), corr
+
+
+@partial(jax.jit, static_argnames=("epochs_per_subj", "precision"))
+def _block_gram_xla(blk, data2, epochs_per_subj, precision=None):
+    """Kernels-only XLA variant: not returning the [block, E, V]
+    correlation tensor lets XLA fuse it away instead of shipping it out
+    of the program for a caller that only needs the Grams."""
+    corr = jnp.einsum('etb,etv->bev', blk, data2,
+                      precision=resolve_precision(precision),
+                      preferred_element_type=jnp.float32)
+    corr = within_subject_normalization(corr, epochs_per_subj)
+    return _gram_and_shrink(corr, precision)
 
 
 @partial(jax.jit, static_argnames=("epochs_per_subj", "precision"))
@@ -251,6 +262,11 @@ class VoxelSelector:
                 kernels = _block_gram_pallas(
                     blk, data2, self.epochs_per_subj,
                     interpret=jax.default_backend() != 'tpu',
+                    precision=self.precision)
+                corr = None
+            elif on_device_svm:
+                kernels = _block_gram_xla(
+                    blk, data2, self.epochs_per_subj,
                     precision=self.precision)
                 corr = None
             elif self.use_pallas:

@@ -137,7 +137,7 @@ def fcma_corr_normalize(blk, data, epochs_per_subj, tile_b=None,
     n_epochs, n_trs, n_b = blk.shape
     n_v = data.shape[2]
     auto_b, auto_v, fits = pick_tiles(n_epochs, n_trs, n_b, n_v)
-    if tile_b is None and tile_v is None and not fits:
+    if (tile_b is None or tile_v is None) and not fits:
         raise ValueError(
             "epoch x TR extent too large for VMEM tiles "
             f"(E={n_epochs}, T={n_trs}); use the XLA path "
@@ -196,7 +196,7 @@ def fcma_gram(blk, data, epochs_per_subj, tile_b=None, tile_v=None,
     n_epochs, n_trs, n_b = blk.shape
     n_v = data.shape[2]
     auto_b, auto_v, fits = pick_tiles(n_epochs, n_trs, n_b, n_v)
-    if tile_b is None and tile_v is None and not fits:
+    if (tile_b is None or tile_v is None) and not fits:
         raise ValueError(
             "epoch x TR extent too large for VMEM tiles "
             f"(E={n_epochs}, T={n_trs}); use the XLA path instead")

@@ -369,10 +369,13 @@ def _gp_neg_log_prior(log_snr, c_space, c_inten, dist2, inten_d2,
     logdet_k = 2.0 * jnp.sum(jnp.log(jnp.diag(cho)))
 
     if tau2_prior == "halfcauchy":
-        tau2 = (y_invk_y - n_v * tau_range ** 2
-                + jnp.sqrt(n_v ** 2 * tau_range ** 4 + (2 * n_v + 8)
-                           * tau_range ** 2 * y_invk_y
-                           + y_invk_y ** 2)) / 2 / (n_v + 2)
+        # MAP tau2 = (y - a + sqrt(a^2 + b)) / (2(n+2)) with a = n*tau_r^2,
+        # b = (2n+8)*tau_r^2*y + y^2 — rationalized as y + b/(sqrt(a^2+b)+a)
+        # because the direct form cancels catastrophically in fp32 when
+        # y << a (it rounded to <= 0 and NaN'd the log below)
+        a = n_v * tau_range ** 2
+        b = (2 * n_v + 8) * tau_range ** 2 * y_invk_y + y_invk_y ** 2
+        tau2 = (y_invk_y + b / (jnp.sqrt(a * a + b) + a)) / 2 / (n_v + 2)
         log_ptau = jnp.log(2.0 / (jnp.pi * tau_range)) \
             - jnp.log1p(tau2 / tau_range ** 2)
     else:  # inverse-gamma on tau^2, shape=2, scale=tau_range^2
@@ -856,7 +859,7 @@ class GBRSA(BRSA):
                  n_nureg=6, nureg_zscore=True, nureg_method='PCA',
                  baseline_single=False, logS_range=1.0, SNR_prior='exp',
                  SNR_bins=11, rho_bins=10, random_state=None,
-                 anneal_speed=10, lbfgs_iters=200, tol=1e-4):
+                 anneal_speed=10, lbfgs_iters=200, tol=1e-4, mesh=None):
         super().__init__(n_iter=n_iter, rank=rank,
                          auto_nuisance=auto_nuisance, n_nureg=n_nureg,
                          nureg_zscore=nureg_zscore,
@@ -869,6 +872,10 @@ class GBRSA(BRSA):
         self.SNR_prior = SNR_prior
         self.SNR_bins = SNR_bins
         self.rho_bins = rho_bins
+        # mesh with a 'voxel' axis: the grid-marginal likelihood is
+        # voxelwise independent, so each subject's voxel dimension is
+        # sharded across devices (NaN-free zero padding, mask-weighted)
+        self.mesh = mesh
 
     def _snr_grid_and_logprior(self):
         """Grid of SNR values plus log prior weights (reference
@@ -919,8 +926,12 @@ class GBRSA(BRSA):
         def subject_onsets(s, n_t):
             if scan_onsets is None:
                 return np.array([0], dtype=int)
-            raw = scan_onsets[s] if isinstance(scan_onsets, list) \
-                else scan_onsets
+            # a list of per-subject onset arrays vs one shared onset
+            # vector: a plain list of ints is the latter
+            per_subject = isinstance(scan_onsets, list) and \
+                len(scan_onsets) > 0 and \
+                not np.isscalar(scan_onsets[0])
+            raw = scan_onsets[s] if per_subject else scan_onsets
             return self._check_onsets(raw, n_t)
 
         def build_subject(s, extra_nuisance=None):
@@ -953,7 +964,7 @@ class GBRSA(BRSA):
         logprior = jnp.asarray(snr_logprior)[:, None] - \
             jnp.log(float(len(rho_grid)))
 
-        def neg_ll(l_flat, x, d, starts, n_runs):
+        def neg_ll(l_flat, x, mask, d, starts, n_runs):
             L = _make_L(l_flat, n_c, rank)
             XL = d @ L
 
@@ -963,15 +974,45 @@ class GBRSA(BRSA):
                                                 n_runs))(rho_g))(snr_g)
                 return jax.scipy.special.logsumexp(lls + logprior)
 
-            return -jnp.sum(jax.vmap(voxel_ll, in_axes=1)(x))
+            # mask zero-weights padded voxel columns (their grid LL is
+            # parameter-dependent, so padding must not contribute)
+            return -jnp.sum(mask * jax.vmap(voxel_ll, in_axes=1)(x))
+
+        def place_voxels(x):
+            """Shard a [T, V] array's voxel axis over the mesh; padding
+            repeats the first voxel column — zero columns would make the
+            grid LL (and, through the 0*NaN vjp trap, the whole
+            gradient) NaN even though the mask zero-weights them.
+            Returns (array, mask)."""
+            mask = np.ones(x.shape[1])
+            if self.mesh is not None:
+                from ..parallel.mesh import DEFAULT_VOXEL_AXIS
+                from jax.sharding import NamedSharding, PartitionSpec
+                n_shards = self.mesh.shape[DEFAULT_VOXEL_AXIS]
+                pad = (-x.shape[1]) % n_shards
+                x = np.concatenate(
+                    [x, np.repeat(x[:, :1], pad, axis=1)], axis=1)
+                mask = np.pad(mask, (0, pad))
+                spec = NamedSharding(
+                    self.mesh, PartitionSpec(None, DEFAULT_VOXEL_AXIS))
+                return (jax.device_put(x, spec),
+                        jax.device_put(mask, NamedSharding(
+                            self.mesh,
+                            PartitionSpec(DEFAULT_VOXEL_AXIS))))
+            return jnp.asarray(x), jnp.asarray(mask)
 
         def fit_U(subjects):
+            placed = []
+            for x, d, starts, n_runs in subjects:
+                x_j, mask_j = place_voxels(x)
+                placed.append((x_j, mask_j, jnp.asarray(d),
+                               jnp.asarray(starts), n_runs))
+
             def total_loss(l_flat):
                 total = 0.0
-                for x, d, starts, n_runs in subjects:
-                    total = total + neg_ll(l_flat, jnp.asarray(x),
-                                           jnp.asarray(d),
-                                           jnp.asarray(starts), n_runs)
+                for x_j, mask_j, d_j, starts_j, n_runs in placed:
+                    total = total + neg_ll(l_flat, x_j, mask_j, d_j,
+                                           starts_j, n_runs)
                 return total
 
             flat0 = self.random_state_.randn(n_l) * 0.1 + 0.5

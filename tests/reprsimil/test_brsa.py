@@ -170,6 +170,32 @@ def test_brsa_gp_learns_smoothness():
     assert gp.lGPspace_ > 2.0
 
 
+def test_gbrsa_mesh_matches_single():
+    """Voxel-sharding the GBRSA grid likelihood must not change the fit
+    (padding is mask-weighted because a zero-data voxel's grid LL still
+    depends on the parameters)."""
+    from brainiak_tpu.parallel.mesh import make_mesh
+
+    from tests.conftest import mesh_atol
+
+    Y, design, _, _, onsets = make_brsa_data(n_v=21, seed=12)
+    kw = dict(rank=None, lbfgs_iters=60, SNR_bins=4, rho_bins=4,
+              auto_nuisance=False, random_state=0)
+    single = GBRSA(**kw).fit([Y], [design], scan_onsets=onsets)
+    # 21 voxels on 8 shards exercises the padding path
+    mesh = make_mesh(("voxel",), (8,))
+    sharded = GBRSA(mesh=mesh, **kw).fit([Y], [design],
+                                         scan_onsets=onsets)
+    import jax
+    # U_ entries are O(30): under fp32 the sharded reduction order shifts
+    # the L-BFGS trajectory at relative ~1e-5, so compare relatively
+    rtol = 0.0 if jax.config.jax_enable_x64 else 1e-3
+    np.testing.assert_allclose(sharded.U_, single.U_, atol=mesh_atol(),
+                               rtol=rtol)
+    np.testing.assert_allclose(sharded.nSNR_[0], single.nSNR_[0],
+                               atol=mesh_atol(), rtol=rtol)
+
+
 def test_gbrsa_multi_subject():
     datasets, designs = [], []
     for s in range(2):
