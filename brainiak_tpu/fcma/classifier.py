@@ -65,11 +65,17 @@ class Classifier(BaseEstimator):
     epochs_per_subj : int, 0 disables within-subject normalization.
     """
 
-    def __init__(self, clf, num_processed_voxels=2000, epochs_per_subj=0):
+    def __init__(self, clf, num_processed_voxels=2000, epochs_per_subj=0,
+                 use_pallas='auto'):
         self.clf = clf
         self.num_processed_voxels = num_processed_voxels
         self.epochs_per_subj = epochs_per_subj
         self.num_digits_ = 0
+        # 'auto': fused sample-Gram Pallas kernel on TPU when the
+        # correlation features themselves are not needed
+        if use_pallas == 'auto':
+            use_pallas = jax.default_backend() == 'tpu'
+        self.use_pallas = bool(use_pallas)
 
     # -- helpers ----------------------------------------------------------
     def _is_precomputed_svm(self):
@@ -96,6 +102,34 @@ class Classifier(BaseEstimator):
         corr = _chunk_features(x1, x2, 0, x1.shape[2], norm_unit)
         return np.asarray(corr).reshape(corr.shape[0], -1)
 
+    def _pallas_sample_gram(self, x1, x2, norm_unit):
+        """Fused in-VMEM sample Gram (no [N, V1*V2] feature matrix in
+        HBM); returns the shrunk Gram, or None when the sample x TR
+        extent exceeds the kernel's VMEM tiles."""
+        from ..ops.pallas_kernels import fcma_sample_gram, pick_tiles
+
+        n, n_t, v1 = x1.shape
+        v2 = x2.shape[2]
+        tile_1, tile_2, fits = pick_tiles(n, n_t, v1, v2)
+        if not fits:
+            return None
+        x1_p = jnp.pad(x1, ((0, 0), (0, 0), (0, (-v1) % tile_1)))
+        x2_p = jnp.pad(x2, ((0, 0), (0, 0), (0, (-v2) % tile_2)))
+        kernel = np.array(fcma_sample_gram(
+            x1_p, x2_p, norm_unit, tile_1=tile_1, tile_2=tile_2,
+            interpret=jax.default_backend() != 'tpu'))
+        return self._digit_shrink(kernel)
+
+    def _digit_shrink(self, kernel):
+        """The reference's magnitude shrink, recorded in num_digits_
+        so test similarity vectors scale identically
+        (reference classifier.py:343-347)."""
+        num_digits = len(str(int(kernel[0, 0])))
+        self.num_digits_ = num_digits
+        if num_digits > 2:
+            kernel *= 10 ** (2 - num_digits)
+        return kernel
+
     def _portioned_gram(self, x1, x2, norm_unit):
         """Gram matrix accumulated portion by portion
         (reference classifier.py:279-348)."""
@@ -110,11 +144,7 @@ class Classifier(BaseEstimator):
             kernel, last_corr = _chunk_gram_update(
                 x1, x2, sr, kernel, length, norm_unit)
             sr += length
-        kernel = np.array(kernel)  # writable host copy
-        num_digits = len(str(int(kernel[0, 0])))
-        self.num_digits_ = num_digits
-        if num_digits > 2:
-            kernel *= 10 ** (2 - num_digits)
+        kernel = self._digit_shrink(np.array(kernel))
         # last_corr stays on device; only the single-portion fit path (which
         # stores training_data_) pays the host transfer.
         return kernel, last_corr
@@ -151,7 +181,13 @@ class Classifier(BaseEstimator):
                     raise ValueError('the number of training samples '
                                      'must be smaller than '
                                      'the number of total samples')
-                data, _ = self._portioned_gram(x1, x2, norm_unit)
+                data = None
+                if self.use_pallas:
+                    # features are discarded on this path, so the fused
+                    # sample-Gram kernel applies
+                    data = self._pallas_sample_gram(x1, x2, norm_unit)
+                if data is None:
+                    data, _ = self._portioned_gram(x1, x2, norm_unit)
                 self.training_data_ = None
             else:
                 data, corr = self._portioned_gram(x1, x2, norm_unit)

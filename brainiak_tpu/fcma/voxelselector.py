@@ -248,6 +248,25 @@ class VoxelSelector:
             n_shards = self.mesh.shape.get(DEFAULT_VOXEL_AXIS, 1)
         block = self.voxel_unit * n_shards
 
+        # mesh + Pallas: GSPMD cannot partition a pallas_call, so the
+        # Gram kernel runs per shard under shard_map.  Built ONCE here —
+        # block shapes are constant across iterations, so a fresh
+        # closure per block would recompile every iteration.
+        sharded_gram = None
+        if self.mesh is not None and self.use_pallas:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+            sharded_gram = jax.jit(shard_map(
+                partial(_block_gram_pallas,
+                        epochs_per_subj=self.epochs_per_subj,
+                        interpret=jax.default_backend() != 'tpu',
+                        precision=self.precision),
+                mesh=self.mesh,
+                in_specs=(P(None, None, DEFAULT_VOXEL_AXIS), P()),
+                out_specs=P(DEFAULT_VOXEL_AXIS, None, None),
+                # pallas_call's out_shape carries no vma info
+                check_vma=False))
+
         results = []
         for start in range(0, self.num_voxels, block):
             cur = min(block, self.num_voxels - start)
@@ -259,22 +278,28 @@ class VoxelSelector:
             if self.use_pallas and on_device_svm:
                 # Gram-only fusion: the [block, E, V] tensor never
                 # round-trips through HBM
-                kernels = _block_gram_pallas(
-                    blk, data2, self.epochs_per_subj,
-                    interpret=jax.default_backend() != 'tpu',
-                    precision=self.precision)
+                if sharded_gram is not None:
+                    kernels = sharded_gram(blk, data2)
+                else:
+                    kernels = _block_gram_pallas(
+                        blk, data2, self.epochs_per_subj,
+                        interpret=jax.default_backend() != 'tpu',
+                        precision=self.precision)
                 corr = None
             elif on_device_svm:
                 kernels = _block_gram_xla(
                     blk, data2, self.epochs_per_subj,
                     precision=self.precision)
                 corr = None
-            elif self.use_pallas:
+            elif self.use_pallas and self.mesh is None:
                 kernels, corr = _block_kernel_matrices_pallas(
                     blk, data2, self.epochs_per_subj,
                     interpret=jax.default_backend() != 'tpu',
                     precision=self.precision)
             else:
+                # host-CV path (and any mesh-sharded non-svm path: a
+                # sharded block cannot feed a plain-jitted pallas_call,
+                # so use the partitionable XLA program)
                 kernels, corr = _block_kernel_matrices(
                     blk, data2, self.epochs_per_subj,
                     precision=self.precision)

@@ -27,7 +27,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .fisherz import _CLAMP
 
-__all__ = ["fcma_corr_normalize", "fcma_gram", "pick_tiles"]
+__all__ = ["fcma_corr_normalize", "fcma_gram", "fcma_sample_gram",
+           "pick_tiles"]
 
 # VMEM budget per program (floats): two input tiles [E,T,tile] plus the
 # output tile [tile_b, E, tile_v] must fit comfortably in ~16 MB of VMEM.
@@ -55,14 +56,10 @@ def pick_tiles(n_epochs, n_trs, n_b, n_v):
     return tile_b, tile_v, used(tile_b, tile_v) <= _VMEM_BUDGET_FLOATS
 
 
-def _normalized_corr_tile(blk_ref, data_ref, n_epochs, epochs_per_subj,
-                          precision):
-    """Compute one (TB, TV) tile of normalized correlation in VMEM:
-    per-epoch MXU matmuls, clamped Fisher-z, per-subject epoch z-score
-    (fcma_extension.cc:68-84 semantics).  Returns [TB, E, TV]."""
-    n_subjs = n_epochs // epochs_per_subj
+def _corr_tile(blk_ref, data_ref, n_epochs, precision):
+    """Raw per-epoch correlation tile on the MXU: [TB, T] @ [T, TV] per
+    epoch, stacked to [TB, E, TV]."""
 
-    # per-epoch correlation on the MXU: [TB, T] @ [T, TV]
     def corr_epoch(e):
         b = blk_ref[e, :, :]   # [T, TB]
         d = data_ref[e, :, :]  # [T, TV]
@@ -71,7 +68,17 @@ def _normalized_corr_tile(blk_ref, data_ref, n_epochs, epochs_per_subj,
             preferred_element_type=jnp.float32,
             precision=precision)
 
-    corr = jnp.stack([corr_epoch(e) for e in range(n_epochs)], axis=1)
+    return jnp.stack([corr_epoch(e) for e in range(n_epochs)], axis=1)
+
+
+def _normalized_corr_tile(blk_ref, data_ref, n_epochs, epochs_per_subj,
+                          precision):
+    """Compute one (TB, TV) tile of normalized correlation in VMEM:
+    per-epoch MXU matmuls, clamped Fisher-z, per-subject epoch z-score
+    (fcma_extension.cc:68-84 semantics).  Returns [TB, E, TV]."""
+    n_subjs = n_epochs // epochs_per_subj
+
+    corr = _corr_tile(blk_ref, data_ref, n_epochs, precision)
     # Fisher z with the reference's clamping (fcma_extension.cc:68-72)
     num = 1.0 + corr
     den = 1.0 - corr
@@ -230,3 +237,85 @@ def fcma_gram(blk, data, epochs_per_subj, tile_b=None, tile_v=None,
         ),
         interpret=interpret,
     )(jnp.asarray(blk, jnp.float32), jnp.asarray(data, jnp.float32))
+
+
+def _sample_gram_kernel(x1_ref, x2_ref, out_ref, *, n_samples, norm_unit,
+                        precision=jax.lax.Precision.HIGHEST):
+    """One (V1, V2) feature tile reduced into the [N, N] sample Gram.
+
+    BOTH grid axes are reductions: the correlation features of this
+    voxel-pair tile (optionally within-subject normalized, matching
+    Classifier's feature pipeline) contribute z·zᵀ over their flattened
+    feature extent, so the [N, V1·V2] feature matrix never exists —
+    the on-chip form of the reference's portion-by-portion Gram
+    accumulation (classifier.py:279-348)."""
+    if norm_unit > 1:
+        z = _normalized_corr_tile(x1_ref, x2_ref, n_samples, norm_unit,
+                                  precision)
+    else:
+        z = _corr_tile(x1_ref, x2_ref, n_samples, precision)
+
+    @pl.when((pl.program_id(0) == 0) & (pl.program_id(1) == 0))
+    def _init():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    # z: [TB, N, TV] -> out[n, m] += sum_{tb, tv} z[tb,n,tv]*z[tb,m,tv]
+    out_ref[:, :] += jax.lax.dot_general(
+        z, z, (((0, 2), (0, 2)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("norm_unit", "tile_1", "tile_2",
+                                    "interpret", "precision"))
+def fcma_sample_gram(x1, x2, norm_unit, tile_1=None, tile_2=None,
+                     interpret=False, precision=None):
+    """Fused correlation-feature sample Gram for the FCMA classifier.
+
+    Equivalent to building the per-sample correlation features of
+    region1 x region2 (with within-subject normalization when
+    ``norm_unit > 1``) and computing features @ features.T, but the
+    feature matrix is reduced tile-by-tile in VMEM.
+
+    x1 : [N, T, V1]; x2 : [N, T, V2]; returns [N, N] float32 (un-shrunk).
+    V1 and V2 must be multiples of the tile sizes (callers pad; zero
+    columns contribute exactly zero).
+    """
+    from .correlation import resolve_precision
+    n_samples, n_trs, v1 = x1.shape
+    v2 = x2.shape[2]
+    auto_1, auto_2, fits = pick_tiles(n_samples, n_trs, v1, v2)
+    if (tile_1 is None or tile_2 is None) and not fits:
+        raise ValueError(
+            "sample x TR extent too large for VMEM tiles "
+            f"(N={n_samples}, T={n_trs}); use the XLA path instead")
+    tile_1 = auto_1 if tile_1 is None else tile_1
+    tile_2 = auto_2 if tile_2 is None else tile_2
+    assert v1 % tile_1 == 0 and v2 % tile_2 == 0, \
+        "voxel counts must be multiples of the tile sizes"
+
+    grid = (v1 // tile_1, v2 // tile_2)
+    kernel = functools.partial(_sample_gram_kernel, n_samples=n_samples,
+                               norm_unit=norm_unit,
+                               precision=resolve_precision(precision))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_samples, n_samples),
+                                       jnp.float32),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((n_samples, n_trs, tile_1),
+                             lambda i, j: (0, 0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((n_samples, n_trs, tile_2),
+                             lambda i, j: (0, 0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            # constant block index: both grid axes reduce into the Gram
+            out_specs=pl.BlockSpec((n_samples, n_samples),
+                                   lambda i, j: (0, 0),
+                                   memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(x1, jnp.float32), jnp.asarray(x2, jnp.float32))

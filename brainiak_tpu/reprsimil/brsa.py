@@ -509,6 +509,18 @@ class BRSA(BaseEstimator, TransformerMixin):
             'scan_onsets out of range'
         return np.unique(scan_onsets)
 
+    @classmethod
+    def _subject_onsets(cls, scan_onsets, s, n_t):
+        """Per-subject onsets from either a list of per-subject onset
+        arrays or one shared onset vector (a plain list of ints is the
+        latter); used consistently by GBRSA fit/transform/score."""
+        if scan_onsets is None:
+            return np.array([0], dtype=int)
+        per_subject = isinstance(scan_onsets, list) and \
+            len(scan_onsets) > 0 and not np.isscalar(scan_onsets[0])
+        raw = scan_onsets[s] if per_subject else scan_onsets
+        return cls._check_onsets(raw, n_t)
+
     @staticmethod
     def _dc_regressors(n_t, scan_onsets):
         """Per-run DC components (reference includes these always)."""
@@ -924,15 +936,7 @@ class GBRSA(BRSA):
             return nuisance[s] if isinstance(nuisance, list) else nuisance
 
         def subject_onsets(s, n_t):
-            if scan_onsets is None:
-                return np.array([0], dtype=int)
-            # a list of per-subject onset arrays vs one shared onset
-            # vector: a plain list of ints is the latter
-            per_subject = isinstance(scan_onsets, list) and \
-                len(scan_onsets) > 0 and \
-                not np.isscalar(scan_onsets[0])
-            raw = scan_onsets[s] if per_subject else scan_onsets
-            return self._check_onsets(raw, n_t)
+            return self._subject_onsets(scan_onsets, s, n_t)
 
         def build_subject(s, extra_nuisance=None):
             x = np.asarray(X[s], dtype=float)
@@ -1144,9 +1148,7 @@ class GBRSA(BRSA):
         for s, (x, beta, beta0, sigma, rho) in enumerate(
                 zip(Xs, betas, beta0s, sigmas, rhos)):
             n_t = x.shape[0]
-            raw = scan_onsets[s] if isinstance(scan_onsets, list) \
-                else scan_onsets
-            onsets = self._check_onsets(raw, n_t)
+            onsets = self._subject_onsets(scan_onsets, s, n_t)
             rho_d, sig2_d, rho_0, sig2_0 = _latent_ar1_params(
                 self._design_list[s], self._X0_list[s])
             n_c = beta.shape[0]
@@ -1179,9 +1181,7 @@ class GBRSA(BRSA):
             sigma = self.sigma_ if not isinstance(self.sigma_, list) \
                 else self.sigma_[s]
             n_t = X[s].shape[0]
-            raw = scan_onsets[s] if isinstance(scan_onsets, list) \
-                else scan_onsets
-            onsets = self._check_onsets(raw, n_t)
+            onsets = self._subject_onsets(scan_onsets, s, n_t)
             _, _, rho_0, sig2_0 = _latent_ar1_params(
                 self._design_list[s], self._X0_list[s])
 

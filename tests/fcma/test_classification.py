@@ -78,6 +78,38 @@ def test_classification_partial_similarity():
     assert 0.5 <= score <= 1.0
 
 
+def test_classification_pallas_sample_gram_matches():
+    """The fused sample-Gram kernel (interpret mode) gives the same
+    portioned-Gram classifier as the XLA accumulation path."""
+    fake_raw_data = [create_epoch(i, 5) for i in range(20)]
+    labels = [0, 1] * 10
+    pairs = list(zip(fake_raw_data, fake_raw_data))
+
+    def run(use_pallas):
+        svm_clf = svm.SVC(kernel='precomputed', shrinking=False, C=1,
+                          gamma='auto')
+        clf = Classifier(svm_clf, num_processed_voxels=2,
+                         epochs_per_subj=4, use_pallas=use_pallas)
+        clf.fit(pairs, labels, num_training_samples=12)
+        return clf
+
+    ref = run(False)
+    fused = run(True)
+    assert np.allclose(fused.test_data_, ref.test_data_, atol=1e-4)
+    assert np.array_equal(fused.predict(), ref.predict())
+    # un-normalized feature path (epochs_per_subj=0) also agrees
+    def run_raw(use_pallas):
+        svm_clf = svm.SVC(kernel='precomputed', shrinking=False, C=1,
+                          gamma='auto')
+        clf = Classifier(svm_clf, num_processed_voxels=2,
+                         epochs_per_subj=0, use_pallas=use_pallas)
+        clf.fit(pairs, labels, num_training_samples=12)
+        return clf
+
+    assert np.allclose(run_raw(True).test_data_,
+                       run_raw(False).test_data_, atol=1e-4)
+
+
 def test_classification_logistic_regression():
     fake_raw_data = [create_epoch(i, 5) for i in range(20)]
     labels = [0, 1] * 10

@@ -158,6 +158,26 @@ def test_voxel_selection_pallas_path_matches_xla():
         assert np.isclose(a0, a1, atol=1e-4)
 
 
+def test_voxel_selection_pallas_with_mesh():
+    """mesh + use_pallas compose: the Gram kernel runs per shard under
+    shard_map (GSPMD cannot partition a pallas_call) and matches the
+    unsharded XLA path."""
+    from brainiak_tpu.parallel import make_mesh
+
+    prng = RandomState(1234567890)
+    fake_raw_data = [create_epoch(prng, col=16) for _ in range(8)]
+    labels = [0, 1, 0, 1, 0, 1, 0, 1]
+    xla = sorted(VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=16,
+                               use_pallas=False).run('svm'))
+    mesh = make_mesh(("voxel",), (8,))
+    sharded = sorted(VoxelSelector(labels, 4, 2, fake_raw_data,
+                                   voxel_unit=2, mesh=mesh,
+                                   use_pallas=True).run('svm'))
+    for (v0, a0), (v1, a1) in zip(xla, sharded):
+        assert v0 == v1
+        assert np.isclose(a0, a1, atol=1e-4)
+
+
 def test_voxel_selection_multiclass_on_device():
     """Three-condition voxel selection: the on-device one-vs-one SVM
     matches sklearn SVC's multiclass CV within the reference tolerance."""

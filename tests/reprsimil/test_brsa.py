@@ -194,6 +194,12 @@ def test_gbrsa_mesh_matches_single():
                                rtol=rtol)
     np.testing.assert_allclose(sharded.nSNR_[0], single.nSNR_[0],
                                atol=mesh_atol(), rtol=rtol)
+    # a plain int list is one shared onset vector, consistently across
+    # fit/transform/score (fit already consumed it above)
+    ts, ts0 = single.transform(Y, scan_onsets=list(onsets))
+    assert np.all(np.isfinite(ts))
+    ll, ll_null = single.score(Y, design, scan_onsets=list(onsets))
+    assert np.all(np.isfinite(ll))
 
 
 def test_gbrsa_multi_subject():
