@@ -1,0 +1,191 @@
+"""Real-TPU validation of the fused Pallas FCMA kernels + precision knob.
+
+Round-1 verdict items 3 and 4: every Pallas run to date was interpreter
+mode on CPU, and the ``precision='high'`` knob was implemented but never
+measured.  This script runs on a real TPU chip and records:
+
+1. **Compile-mode parity**: ``fcma_corr_normalize`` / ``fcma_gram`` /
+   ``fcma_sample_gram`` compiled (interpret=False) vs the XLA einsum path,
+   max |delta| at fp32 tolerance.  Target semantics: reference
+   ``fcma/src/fcma_extension.cc:29-92`` + ``fcma/cython_blas.pyx:20-115``.
+2. **Throughput**: compiled-Pallas vs XLA-path voxels/sec on the same
+   block shapes, plus end-to-end ``VoxelSelector(use_pallas=True/False)``.
+3. **Precision sweep**: ``precision='highest'`` vs ``'high'`` — throughput
+   and per-voxel CV-accuracy deltas against the 'highest' accuracies
+   (the reference accuracy band check lives in
+   tests/fcma/test_voxel_selection.py).
+
+Each dispatch stays at a few hundred ms (wedge-safe).  Writes one JSON
+artifact to ``benchmarks/TPU_VALIDATION.json`` and prints a summary.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_VOXELS = 8192
+N_BLOCK = 256
+N_TRS = 150
+N_EPOCHS = 16
+EPOCHS_PER_SUBJ = 4
+NUM_FOLDS = 4
+
+
+def _fetch(x):
+    """Host fetch: synchronizes on the tunneled TPU platform (where
+    block_until_ready is a no-op)."""
+    import jax
+    return jax.tree.map(np.asarray, x)
+
+
+def make_epoch_data(n_voxels, n_trs=N_TRS, n_epochs=N_EPOCHS, seed=0):
+    rng = np.random.RandomState(seed)
+    data = []
+    for _ in range(n_epochs):
+        mat = rng.randn(n_trs, n_voxels).astype(np.float32)
+        mat = (mat - mat.mean(0)) / (mat.std(0) * math.sqrt(n_trs))
+        data.append(mat)
+    return np.stack(data)  # [E, T, V]
+
+
+def time_call(fn, *args, repeats=5, **kw):
+    """Amortized timing: one warm (compile) fetch, then ``repeats``
+    dispatches with a single trailing fetch — the tunnel round-trip is
+    paid once, not per repeat (block_until_ready is a no-op here)."""
+    out = fn(*args, **kw)
+    _fetch(out)  # warm: compile + first run
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn(*args, **kw)
+    _fetch(out)
+    return out, (time.perf_counter() - t0) / repeats
+
+
+def kernel_parity_and_throughput():
+    """Compiled-Pallas vs XLA on the exact production block helpers
+    (tile picking + padding included)."""
+    import jax.numpy as jnp
+
+    from brainiak_tpu.fcma.voxelselector import (
+        _block_gram_pallas, _block_gram_xla, _block_kernel_matrices,
+        _block_kernel_matrices_pallas)
+    from brainiak_tpu.ops.pallas_kernels import fcma_sample_gram
+
+    data = jnp.asarray(make_epoch_data(N_VOXELS))   # [E, T, V]
+    blk = data[:, :, :N_BLOCK]
+    res = {}
+
+    # --- corr + normalize (+ per-voxel Gram): full kernel-matrices path
+    (ref_k, ref_c), t_xla = time_call(_block_kernel_matrices, blk, data,
+                                      EPOCHS_PER_SUBJ)
+    (out_k, out_c), t_pal = time_call(_block_kernel_matrices_pallas,
+                                      blk, data, EPOCHS_PER_SUBJ)
+    delta = float(jnp.max(jnp.abs(out_c - ref_c)))
+    res["corr_normalize"] = {
+        "max_abs_delta_corr": delta,
+        "max_abs_delta_gram": float(jnp.max(jnp.abs(out_k - ref_k))),
+        "xla_s": round(t_xla, 4), "pallas_s": round(t_pal, 4),
+        "pallas_speedup": round(t_xla / t_pal, 2),
+        "voxel_pairs_per_s_pallas": round(N_BLOCK * N_VOXELS / t_pal),
+    }
+
+    # --- fused Gram-only reduction (corr tensor never reaches HBM) ---
+    ref_g, t_xla_g = time_call(_block_gram_xla, blk, data,
+                               EPOCHS_PER_SUBJ)
+    out_g, t_pal_g = time_call(_block_gram_pallas, blk, data,
+                               EPOCHS_PER_SUBJ)
+    scale = float(jnp.max(jnp.abs(ref_g)))
+    delta_g = float(jnp.max(jnp.abs(out_g - ref_g))) / scale
+    res["gram"] = {
+        "max_rel_delta": delta_g,
+        "xla_s": round(t_xla_g, 4), "pallas_s": round(t_pal_g, 4),
+        "pallas_speedup": round(t_xla_g / t_pal_g, 2),
+    }
+
+    # --- fcma_sample_gram (classifier feature Gram) ---
+    n_samples, v1, v2 = 16, 1024, N_VOXELS
+    x1 = jnp.asarray(make_epoch_data(v1, n_epochs=n_samples, seed=1))
+    x2 = jnp.asarray(make_epoch_data(v2, n_epochs=n_samples, seed=2))
+
+    import jax
+
+    from brainiak_tpu.ops.fisherz import within_subject_normalization
+
+    @jax.jit
+    def xla_sample_gram(x1, x2):
+        corr = jnp.einsum("ntb,ntv->bnv", x1, x2,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+        z = within_subject_normalization(corr, EPOCHS_PER_SUBJ)
+        zt = jnp.swapaxes(z, 0, 1).reshape(n_samples, -1)
+        return zt @ zt.T
+
+    ref_s, t_xla_s = time_call(xla_sample_gram, x1, x2)
+    out_s, t_pal_s = time_call(fcma_sample_gram, x1, x2,
+                               EPOCHS_PER_SUBJ, interpret=False)
+    scale_s = float(jnp.max(jnp.abs(ref_s)))
+    delta_s = float(jnp.max(jnp.abs(out_s - ref_s))) / scale_s
+    res["sample_gram"] = {
+        "max_rel_delta": delta_s,
+        "xla_s": round(t_xla_s, 4), "pallas_s": round(t_pal_s, 4),
+        "pallas_speedup": round(t_xla_s / t_pal_s, 2),
+    }
+    return res
+
+
+def end_to_end(n_voxels=N_VOXELS, unit=512):
+    """VoxelSelector end-to-end: pallas vs xla, precision sweep."""
+    from brainiak_tpu.fcma.voxelselector import VoxelSelector
+
+    data = list(make_epoch_data(n_voxels))
+    labels = [0, 1] * (N_EPOCHS // 2)
+    res = {}
+    accs = {}
+    for name, kw in [
+            ("xla_highest", dict(use_pallas=False, precision="highest")),
+            ("pallas_highest", dict(use_pallas=True, precision="highest")),
+            ("xla_high", dict(use_pallas=False, precision="high")),
+            ("pallas_high", dict(use_pallas=True, precision="high"))]:
+        vs = VoxelSelector(labels, EPOCHS_PER_SUBJ, NUM_FOLDS, data,
+                           voxel_unit=unit, **kw)
+        vs.run("svm")  # warm compile caches
+        t0 = time.perf_counter()
+        results = vs.run("svm")
+        dt = time.perf_counter() - t0
+        accs[name] = dict(results)
+        res[name] = {"voxels_per_s": round(n_voxels / dt, 1),
+                     "seconds": round(dt, 2)}
+
+    base = accs["xla_highest"]
+    for name in ("pallas_highest", "xla_high", "pallas_high"):
+        deltas = [abs(accs[name][v] - base[v]) for v in base]
+        res[name]["max_acc_delta_vs_xla_highest"] = round(max(deltas), 4)
+        res[name]["mean_acc_delta"] = round(float(np.mean(deltas)), 5)
+    return res
+
+
+def main():
+    import jax
+    backend = jax.default_backend()
+    out = {"backend": backend, "n_voxels": N_VOXELS, "n_trs": N_TRS,
+           "n_epochs": N_EPOCHS}
+    print(f"backend: {backend}", file=sys.stderr)
+    out["kernels"] = kernel_parity_and_throughput()
+    print(json.dumps(out["kernels"], indent=2), file=sys.stderr)
+    out["end_to_end"] = end_to_end()
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "TPU_VALIDATION.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

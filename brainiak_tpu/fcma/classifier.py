@@ -106,15 +106,11 @@ class Classifier(BaseEstimator):
         """Fused in-VMEM sample Gram (no [N, V1*V2] feature matrix in
         HBM); returns the shrunk Gram, or None when the sample x TR
         extent exceeds the kernel's VMEM tiles."""
-        from ..ops.pallas_kernels import fcma_sample_gram, pick_tiles
+        from ..ops.pallas_kernels import fcma_sample_gram, pad_to_tiles
 
-        n, n_t, v1 = x1.shape
-        v2 = x2.shape[2]
-        tile_1, tile_2, fits = pick_tiles(n, n_t, v1, v2)
+        x1_p, x2_p, tile_1, tile_2, fits = pad_to_tiles(x1, x2)
         if not fits:
             return None
-        x1_p = jnp.pad(x1, ((0, 0), (0, 0), (0, (-v1) % tile_1)))
-        x2_p = jnp.pad(x2, ((0, 0), (0, 0), (0, (-v2) % tile_2)))
         kernel = np.array(fcma_sample_gram(
             x1_p, x2_p, norm_unit, tile_1=tile_1, tile_2=tile_2,
             interpret=jax.default_backend() != 'tpu'))

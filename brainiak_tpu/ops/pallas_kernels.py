@@ -10,9 +10,15 @@ epoch-batched MXU matmuls with the normalization while the tile is still in
 VMEM, writing the normalized tensor exactly once.
 
 Grid: (block_tiles, voxel_tiles).  Each program loads the whole epoch/TR
-extent of its two voxel tiles ([E, T, TB] and [E, T, TV]), runs E matmuls
-on the MXU accumulating the [TB, E, TV] tile, applies the clamped Fisher-z
-and per-subject epoch z-scoring on the VPU, and stores the tile.
+extent of its two voxel tiles ([E, T, TB] and [E, T, TV]), runs ONE
+E-batched matmul on the MXU producing [E, TB, TV], and applies the
+clamped Fisher-z and per-subject epoch z-scoring on the VPU **with the
+epoch axis leading**: Mosaic tiles the last two dims of a vector, so
+group reshapes/reductions over the untiled leading axis are free, while
+the [TB, E, TV] layout (epochs in the middle) forces a relayout per
+reshape — measured 50x slower on a real v5e chip.  A single transpose to
+the caller-facing [TB, E, ...] layout happens once, right before the
+MXU-side Gram reduction / the output store.
 
 On non-TPU backends the kernel runs in interpreter mode (tests), and
 callers can always fall back to the XLA path.
@@ -28,11 +34,23 @@ from jax.experimental.pallas import tpu as pltpu
 from .fisherz import _CLAMP
 
 __all__ = ["fcma_corr_normalize", "fcma_gram", "fcma_sample_gram",
-           "pick_tiles"]
+           "pick_tiles", "pad_to_tiles"]
 
-# VMEM budget per program (floats): two input tiles [E,T,tile] plus the
-# output tile [tile_b, E, tile_v] must fit comfortably in ~16 MB of VMEM.
-_VMEM_BUDGET_FLOATS = 2_500_000
+
+def _mosaic_precision(precision):
+    """Mosaic lowers only DEFAULT/HIGHEST dot precisions (a HIGH dot is
+    a hard NotImplementedError at kernel compile); clamp the in-between
+    setting up — the XLA paths keep the true 3-pass 'high' lever."""
+    from .correlation import resolve_precision
+    p = resolve_precision(precision)
+    return jax.lax.Precision.HIGHEST if p == jax.lax.Precision.HIGH else p
+
+# VMEM budget per program, in floats.  Leaves headroom under the 16 MB
+# scoped-VMEM limit for the cost model below (double-buffered I/O tiles
+# plus the normalization chain's live intermediates); exceeding the real
+# limit is a hard Mosaic compile error on TPU (observed at round-2
+# tile probing: (128, 512) tiles -> "17.64M > 16.00M" OOM).
+_VMEM_BUDGET_FLOATS = 3_900_000
 
 
 def pick_tiles(n_epochs, n_trs, n_b, n_v):
@@ -43,7 +61,12 @@ def pick_tiles(n_epochs, n_trs, n_b, n_v):
     the XLA path then."""
 
     def used(tb, tv):
-        return n_epochs * n_trs * (tb + tv) + tb * n_epochs * tv
+        # Pipelined input tiles are double-buffered (2x); the Fisher-z /
+        # z-score chain keeps ~3 [E, tb, tv]-sized vectors live at once,
+        # and the worst-case output tile ([tb, E, tv], corr_normalize)
+        # is double-buffered too.
+        return (2 * n_epochs * n_trs * (tb + tv)
+                + 5 * n_epochs * tb * tv)
 
     tile_b = min(128, n_b)
     tile_v = min(512, n_v)
@@ -56,26 +79,39 @@ def pick_tiles(n_epochs, n_trs, n_b, n_v):
     return tile_b, tile_v, used(tile_b, tile_v) <= _VMEM_BUDGET_FLOATS
 
 
+def pad_to_tiles(blk, data2):
+    """Shared Pallas preamble: pick VMEM tile sizes and zero-pad the two
+    voxel axes to tile multiples (zero columns correlate/normalize to
+    exactly zero, so they are inert downstream).  Returns
+    (blk_p, data_p, tile_b, tile_v, fits); when ``fits`` is False the
+    inputs are returned unpadded and callers should take the XLA path."""
+    n_e, n_t, n_b = blk.shape
+    n_v = data2.shape[2]
+    tile_b, tile_v, fits = pick_tiles(n_e, n_t, n_b, n_v)
+    if not fits:
+        return blk, data2, tile_b, tile_v, False
+    blk_p = jnp.pad(blk, ((0, 0), (0, 0), (0, (-n_b) % tile_b)))
+    data_p = jnp.pad(data2, ((0, 0), (0, 0), (0, (-n_v) % tile_v)))
+    return blk_p, data_p, tile_b, tile_v, True
+
+
 def _corr_tile(blk_ref, data_ref, n_epochs, precision):
-    """Raw per-epoch correlation tile on the MXU: [TB, T] @ [T, TV] per
-    epoch, stacked to [TB, E, TV]."""
-
-    def corr_epoch(e):
-        b = blk_ref[e, :, :]   # [T, TB]
-        d = data_ref[e, :, :]  # [T, TV]
-        return jax.lax.dot_general(
-            b, d, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=precision)
-
-    return jnp.stack([corr_epoch(e) for e in range(n_epochs)], axis=1)
+    """Raw per-epoch correlation tile: one E-batched MXU matmul
+    [E, T, TB] x [E, T, TV] -> [E, TB, TV] (batch dim 0, the only batch
+    position Mosaic lowers)."""
+    del n_epochs  # shape-carried
+    return jax.lax.dot_general(
+        blk_ref[...], data_ref[...], (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=precision)
 
 
 def _normalized_corr_tile(blk_ref, data_ref, n_epochs, epochs_per_subj,
                           precision):
     """Compute one (TB, TV) tile of normalized correlation in VMEM:
-    per-epoch MXU matmuls, clamped Fisher-z, per-subject epoch z-score
-    (fcma_extension.cc:68-84 semantics).  Returns [TB, E, TV]."""
+    E-batched MXU matmul, clamped Fisher-z, per-subject epoch z-score
+    (fcma_extension.cc:68-84 semantics).  Returns [E, TB, TV] — epoch
+    axis leading so the subject-group reshapes stay on the untiled dim."""
     n_subjs = n_epochs // epochs_per_subj
 
     corr = _corr_tile(blk_ref, data_ref, n_epochs, precision)
@@ -87,19 +123,20 @@ def _normalized_corr_tile(blk_ref, data_ref, n_epochs, epochs_per_subj,
     z = 0.5 * jnp.log(num / den)
     # z-score across each subject's epochs (population std, zero when
     # non-positive; fcma_extension.cc:74-84)
-    tb, _, tv = z.shape
-    zr = z.reshape(tb, n_subjs, epochs_per_subj, tv)
-    mean = jnp.mean(zr, axis=2, keepdims=True)
-    var = jnp.mean(zr * zr, axis=2, keepdims=True) - mean * mean
+    _, tb, tv = z.shape
+    zr = z.reshape(n_subjs, epochs_per_subj, tb, tv)
+    mean = jnp.mean(zr, axis=1, keepdims=True)
+    var = jnp.mean(zr * zr, axis=1, keepdims=True) - mean * mean
     inv = jnp.where(var <= 0.0, 0.0, jax.lax.rsqrt(var))
-    return ((zr - mean) * inv).reshape(tb, n_epochs, tv)
+    return ((zr - mean) * inv).reshape(n_epochs, tb, tv)
 
 
 def _kernel(blk_ref, data_ref, out_ref, *, n_epochs, epochs_per_subj,
             precision=jax.lax.Precision.HIGHEST):
     """One (TB, TV) tile: correlate, Fisher-z, normalize, store."""
-    out_ref[:, :, :] = _normalized_corr_tile(
+    z = _normalized_corr_tile(
         blk_ref, data_ref, n_epochs, epochs_per_subj, precision)
+    out_ref[:, :, :] = jnp.transpose(z, (1, 0, 2))
 
 
 def _gram_kernel(blk_ref, data_ref, out_ref, *, n_epochs,
@@ -114,13 +151,14 @@ def _gram_kernel(blk_ref, data_ref, out_ref, *, n_epochs,
     accumulation, classifier.py:279-348)."""
     z = _normalized_corr_tile(blk_ref, data_ref, n_epochs,
                               epochs_per_subj, precision)
+    zt = jnp.transpose(z, (1, 0, 2))  # [TB, E, TV]; batch dim -> pos 0
 
     @pl.when(pl.program_id(1) == 0)
     def _init():
         out_ref[:, :, :] = jnp.zeros_like(out_ref)
 
     out_ref[:, :, :] += jax.lax.dot_general(
-        z, z, (((2,), (2,)), ((0,), (0,))),
+        zt, zt, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32, precision=precision)
 
 
@@ -140,7 +178,6 @@ def fcma_corr_normalize(blk, data, epochs_per_subj, tile_b=None,
 
     B and V must be multiples of tile_b/tile_v (callers pad).
     """
-    from .correlation import resolve_precision
     n_epochs, n_trs, n_b = blk.shape
     n_v = data.shape[2]
     auto_b, auto_v, fits = pick_tiles(n_epochs, n_trs, n_b, n_v)
@@ -157,7 +194,7 @@ def fcma_corr_normalize(blk, data, epochs_per_subj, tile_b=None,
     grid = (n_b // tile_b, n_v // tile_v)
     kernel = functools.partial(_kernel, n_epochs=n_epochs,
                                epochs_per_subj=epochs_per_subj,
-                               precision=resolve_precision(precision))
+                               precision=_mosaic_precision(precision))
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n_b, n_epochs, n_v),
@@ -199,7 +236,6 @@ def fcma_gram(blk, data, epochs_per_subj, tile_b=None, tile_v=None,
     B and V must be multiples of tile_b/tile_v (callers pad; zero
     padding on V contributes exactly zero to the Gram).
     """
-    from .correlation import resolve_precision
     n_epochs, n_trs, n_b = blk.shape
     n_v = data.shape[2]
     auto_b, auto_v, fits = pick_tiles(n_epochs, n_trs, n_b, n_v)
@@ -215,7 +251,7 @@ def fcma_gram(blk, data, epochs_per_subj, tile_b=None, tile_v=None,
     grid = (n_b // tile_b, n_v // tile_v)
     kernel = functools.partial(_gram_kernel, n_epochs=n_epochs,
                                epochs_per_subj=epochs_per_subj,
-                               precision=resolve_precision(precision))
+                               precision=_mosaic_precision(precision))
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n_b, n_epochs, n_epochs),
@@ -259,10 +295,14 @@ def _sample_gram_kernel(x1_ref, x2_ref, out_ref, *, n_samples, norm_unit,
     def _init():
         out_ref[:, :] = jnp.zeros_like(out_ref)
 
-    # z: [TB, N, TV] -> out[n, m] += sum_{tb, tv} z[tb,n,tv]*z[tb,m,tv]
-    out_ref[:, :] += jax.lax.dot_general(
-        z, z, (((0, 2), (0, 2)), ((), ())),
+    # z: [N, T1, T2].  Mosaic lowers neither two contracting dims nor
+    # non-leading batch dims, so batch over T1 (transpose to pos 0) and
+    # reduce the T1-batched [T1, N, N] grams over the untiled lead axis.
+    zt = jnp.transpose(z, (1, 0, 2))  # [T1, N, T2]
+    g = jax.lax.dot_general(
+        zt, zt, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32, precision=precision)
+    out_ref[:, :] += jnp.sum(g, axis=0)
 
 
 @functools.partial(jax.jit,
@@ -281,7 +321,6 @@ def fcma_sample_gram(x1, x2, norm_unit, tile_1=None, tile_2=None,
     V1 and V2 must be multiples of the tile sizes (callers pad; zero
     columns contribute exactly zero).
     """
-    from .correlation import resolve_precision
     n_samples, n_trs, v1 = x1.shape
     v2 = x2.shape[2]
     auto_1, auto_2, fits = pick_tiles(n_samples, n_trs, v1, v2)
@@ -297,7 +336,7 @@ def fcma_sample_gram(x1, x2, norm_unit, tile_1=None, tile_2=None,
     grid = (v1 // tile_1, v2 // tile_2)
     kernel = functools.partial(_sample_gram_kernel, n_samples=n_samples,
                                norm_unit=norm_unit,
-                               precision=resolve_precision(precision))
+                               precision=_mosaic_precision(precision))
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n_samples, n_samples),
