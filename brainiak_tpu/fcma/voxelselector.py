@@ -196,13 +196,21 @@ class VoxelSelector:
         # ~100 MB of epoch data per call dominates wall time on a
         # tunneled device (the reference likewise keeps raw data resident
         # in worker memory across task assignments).  Keyed on the input
-        # OBJECTS (held alive in the key, so an `is` match can never be a
-        # recycled id() of a freed list) — rebinding raw_data/raw_data2/
-        # mesh between runs invalidates the cache.
-        key = (self.raw_data, self.raw_data2, self.mesh)
+        # OBJECTS — the lists, their element arrays, and the mesh — held
+        # alive in the key so an `is` match can never be a recycled id()
+        # of a freed object.  Rebinding the lists OR replacing an element
+        # (raw_data[0] = new_arr) invalidates; mutating an ndarray's
+        # contents in place is not detected (no data hashing).
+        def _key():
+            elems = tuple(self.raw_data) + (
+                tuple(self.raw_data2) if self.raw_data2 is not None
+                else ())
+            return (self.raw_data, self.raw_data2, self.mesh) + elems
+
+        key = _key()
         cached = getattr(self, "_stack_cache", None)
-        if cached is not None and all(a is b
-                                      for a, b in zip(cached[0], key)):
+        if cached is not None and len(cached[0]) == len(key) and \
+                all(a is b for a, b in zip(cached[0], key)):
             return cached[1]
         data1 = jnp.asarray(np.stack(self.raw_data),
                             dtype=jnp.float32)  # [E, T, V]
