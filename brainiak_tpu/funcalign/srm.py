@@ -45,11 +45,45 @@ def _procrustes(a, perturbation=0.001):
     """Orthogonal map closest to ``a`` ([voxels, features]): U Vᵀ from the
     thin SVD of ``a`` plus the reference's 0.001 diagonal perturbation
     (srm.py:595-601).  RSRM's updates use no perturbation
-    (rsrm.py:182-236); pass ``perturbation=0``."""
+    (rsrm.py:182-236); pass ``perturbation=0``.
+
+    For tall inputs (voxels >> features — the whole-brain SRM regime) the
+    tall SVD is replaced by the Gram-eigh polar factor:
+    ``U Vᵀ = A (AᵀA)^(-1/2)``, i.e. one [V,K]x[K,K] matmul plus a K x K
+    eigendecomposition instead of an iterative [V,K] SVD — the SVD is the
+    serial bottleneck of the whole-brain EM step on TPU.  Squaring the
+    condition number in AᵀA costs ~half the working precision, so one
+    Newton-Schulz step ``W(3I - WᵀW)/2`` scrubs the orthogonality error
+    (quadratic convergence; the eigh-based W is already near-orthogonal).
+    """
     eye = jnp.zeros_like(a)
     k = min(a.shape)
     eye = eye.at[jnp.arange(k), jnp.arange(k)].set(perturbation)
-    u, _, vt = jnp.linalg.svd(a + eye, full_matrices=False)
+    ap = a + eye
+    v, kk = a.shape
+    if v >= 4 * kk:
+        hp = jax.lax.Precision.HIGHEST
+        c = jnp.einsum('vi,vj->ij', ap, ap, precision=hp)
+        lam, q = jnp.linalg.eigh(c)
+        # RELATIVE floor (plus a sqrt-tiny absolute guard for an
+        # all-zero input): rank-deficient Grams — RSRM passes
+        # perturbation=0 — have eigenvalues rounding to ~0 or slightly
+        # negative, and an absolute tiny floor would send lam**-0.5 to
+        # ~1e19 and overflow the Newton-Schulz products to Inf/NaN
+        floor = jnp.maximum(jnp.finfo(a.dtype).eps * jnp.max(lam),
+                            jnp.asarray(jnp.finfo(a.dtype).tiny,
+                                        a.dtype) ** 0.5)
+        lam = jnp.clip(lam, floor)
+        inv_sqrt = jnp.einsum('ik,k,jk->ij', q, lam ** -0.5, q,
+                              precision=hp)
+        w = jnp.einsum('vk,kj->vj', ap, inv_sqrt, precision=hp)
+        eye_k = jnp.eye(kk, dtype=a.dtype)
+        for _ in range(2):
+            wtw = jnp.einsum('vi,vj->ij', w, w, precision=hp)
+            w = 0.5 * jnp.einsum('vk,kj->vj', w, 3.0 * eye_k - wtw,
+                                 precision=hp)
+        return w
+    u, _, vt = jnp.linalg.svd(ap, full_matrices=False)
     return u @ vt
 
 

@@ -591,16 +591,16 @@ def bootstrap_isc(iscs, pairwise=False, summary_statistic='median',
         for v in range(sq.shape[-1]):
             np.fill_diagonal(sq[..., v], 1.0)
         sq_j = _shard_voxels(sq, mesh, 2)
+    else:
+        iscs_j = _shard_voxels(iscs, mesh, 1)
+    keys = jax.random.split(
+        jax.random.PRNGKey(_resolve_seed(random_state)), n_bootstraps)
+    if pairwise:
         iu = np.triu_indices(n_subjects, k=1)
-        keys = jax.random.split(
-            jax.random.PRNGKey(_resolve_seed(random_state)), n_bootstraps)
         distribution = np.asarray(_boot_pairwise_map(
             sq_j, keys, jnp.asarray(iu[0]), jnp.asarray(iu[1]),
             summary_statistic, null_batch_size))[:, :n_voxels]
     else:
-        iscs_j = _shard_voxels(iscs, mesh, 1)
-        keys = jax.random.split(
-            jax.random.PRNGKey(_resolve_seed(random_state)), n_bootstraps)
         distribution = np.asarray(_boot_loo_map(
             iscs_j, keys, summary_statistic,
             null_batch_size))[:, :n_voxels]

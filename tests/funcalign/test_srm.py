@@ -193,3 +193,30 @@ def test_srm_checkpoint_rejects_mismatched_data(tmp_path):
     # lower n_iter than the checkpoint step must be refused
     with pytest.raises(ValueError, match="iteration"):
         SRM(n_iter=2, features=3).fit(X, checkpoint_dir=d)
+
+
+def test_procrustes_polar_matches_svd_and_survives_rank_deficiency():
+    """The tall-input Gram-eigh polar path must match U@Vt from the SVD
+    and stay finite on rank-deficient input (RSRM passes
+    perturbation=0)."""
+    import jax.numpy as jnp
+
+    from brainiak_tpu.funcalign.srm import _procrustes
+
+    rng = np.random.RandomState(0)
+    a = rng.randn(600, 12)
+    w = np.asarray(_procrustes(jnp.asarray(a)))
+    u, _, vt = np.linalg.svd(a + 0.001 * np.eye(600, 12),
+                             full_matrices=False)
+    assert np.allclose(w, u @ vt, atol=1e-8)
+    assert np.allclose(w.T @ w, np.eye(12), atol=1e-10)
+
+    # rank-1 input, no perturbation: finite, orthogonal columns where
+    # defined (old absolute-tiny floor overflowed to Inf/NaN here)
+    a1 = np.outer(rng.randn(600), np.ones(12))
+    w1 = np.asarray(_procrustes(jnp.asarray(a1), perturbation=0.0))
+    assert np.all(np.isfinite(w1))
+
+    # all-zero input: finite (0 @ inf would be NaN without the guard)
+    w0 = np.asarray(_procrustes(jnp.zeros((600, 12))))
+    assert np.all(np.isfinite(w0))
