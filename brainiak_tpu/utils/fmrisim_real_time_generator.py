@@ -127,6 +127,12 @@ def generate_data(outputDir, user_settings):
     roi_a, roi_b, template, noise_dict, dims = _default_inputs(data_dict)
     mask, template = sim.mask_brain(volume=template, mask_self=True)
     np.save(os.path.join(outputDir, 'mask.npy'), mask.astype(np.uint8))
+    # the analysis side needs the ROI geometry (the reference ships its
+    # ROI volumes as package data next to the generated stream)
+    np.save(os.path.join(outputDir, 'roi_a.npy'),
+            (roi_a > 0).astype(np.uint8))
+    np.save(os.path.join(outputDir, 'roi_b.npy'),
+            (roi_b > 0).astype(np.uint8))
 
     noise_dict['matched'] = 0
     num_trs = data_dict['numTRs']

@@ -79,3 +79,9 @@ def test_example_matnormal():
 def test_example_fmrisim():
     out = _run("fmrisim_noise_simulation.py", "--trs", "40")
     assert "round-trip SFNR" in out
+
+
+def test_example_realtime_decoding():
+    out = _run("realtime_decoding.py", "--num-trs", "100")
+    assert "incremental decoder accuracy" in out
+    assert out.strip().endswith("OK")
