@@ -66,6 +66,13 @@ def main():
     vol_files = sorted(
         glob.glob(os.path.join(out_dir, "rt_*.npy")),
         key=lambda f: int(os.path.basename(f)[3:-4]))
+    # A reused --keep directory may hold stale volumes from an earlier,
+    # longer run; this run's labels only describe the first num_trs.
+    if len(vol_files) != len(labels_tr):
+        raise SystemExit(
+            f"{out_dir} holds {len(vol_files)} volumes but this run "
+            f"generated {len(labels_tr)} TRs — remove stale rt_*.npy "
+            "files (reused --keep directory?)")
     print(f"streaming {len(vol_files)} TR volumes from {out_dir}")
 
     series, cond = [], []
@@ -73,7 +80,7 @@ def main():
     for tr, f in enumerate(vol_files):
         vol = np.load(f)
         series.append(vol[roi])
-        cond.append(int(labels_tr[min(tr, len(labels_tr) - 1)]))
+        cond.append(int(labels_tr[tr]))
 
         # every 20 TRs, re-train on what has arrived so far (shifting
         # labels ~2 TRs for the hemodynamic lag) and report leave-one-

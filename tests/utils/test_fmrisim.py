@@ -271,6 +271,54 @@ def test_drift_and_phys_components():
     assert task.shape == (60,)
 
 
+def test_arma_mle_recovery():
+    """The Kalman-filter ARMA(1,1) MLE must recover known coefficients
+    (the contract of the reference's statsmodels-based estimator,
+    fmrisim.py:1205-1289) — including the MA term that a Yule-Walker
+    moment estimate gets badly biased."""
+    rng = np.random.RandomState(7)
+    n_vox, n_tr, burn = 40, 300, 50
+    rho, theta = 0.5, 0.3
+    e = rng.randn(n_vox, n_tr + burn)
+    x = np.zeros((n_vox, n_tr + burn))
+    for t in range(1, n_tr + burn):
+        x[:, t] = rho * x[:, t - 1] + e[:, t] + theta * e[:, t - 1]
+    x = x[:, burn:]
+    np.random.seed(8)
+    ar, ma = sim._calc_ARMA_noise(x, np.ones(n_vox), sample_num=40)
+    assert abs(ar[0] - rho) < 0.1
+    assert abs(ma[0] - theta) < 0.12
+
+
+def test_arma_mle_white_noise_is_zero():
+    """On white data the likelihood is flat along the rho = -theta
+    cancellation ridge; the near-tie break must keep the estimate at
+    ~(0, 0) rather than an arbitrary ridge point."""
+    rng = np.random.RandomState(12)
+    w = rng.randn(40, 400)
+    np.random.seed(13)
+    ar, ma = sim._calc_ARMA_noise(w, np.ones(40), sample_num=40)
+    assert abs(ar[0]) < 0.1
+    assert abs(ma[0]) < 0.1
+
+
+def test_arma_loglik_prefers_truth():
+    """The concentrated exact likelihood must rank the generating
+    parameters above clearly wrong ones."""
+    rng = np.random.RandomState(9)
+    n_tr, burn = 400, 50
+    e = rng.randn(1, n_tr + burn)
+    x = np.zeros((1, n_tr + burn))
+    for t in range(1, n_tr + burn):
+        x[:, t] = 0.6 * x[:, t - 1] + e[:, t] - 0.2 * e[:, t - 1]
+    x = x[:, burn:]
+    x = (x - x.mean()) / x.std()
+    cand_r = np.array([[0.6, 0.0, -0.6]])
+    cand_t = np.array([[-0.2, 0.0, 0.5]])
+    ll = sim._arma11_loglik_grid(x, cand_r, cand_t)
+    assert np.argmax(ll[0]) == 0
+
+
 def test_gen_1d_gaussian_rfs():
     np.random.seed(4)
     rfs, tuning = sim.generate_1d_gaussian_rfs(
