@@ -699,6 +699,11 @@ def _arma11_loglik_grid(x, rhos, thetas):
                    + sum_log_f + t * (1.0 + np.log(2.0 * np.pi)))
 
 
+# chi2(2).ppf(0.95)/2 nats: the 95% likelihood-ratio bar for the two
+# extra ARMA(1,1) parameters over the white-noise model.
+_ARMA_LRT_GATE = 3.0
+
+
 def _calc_ARMA_noise(volume, mask, auto_reg_order=1, ma_order=1,
                      sample_num=100):
     """Exact per-voxel ARMA(1,1) maximum-likelihood estimates averaged
@@ -709,6 +714,15 @@ def _calc_ARMA_noise(volume, mask, auto_reg_order=1, ma_order=1,
     estimator: the exact Kalman-filter likelihood is evaluated on a
     zooming (rho, theta) grid, batched over all sampled voxels in one
     vectorized recursion instead of a per-voxel optimizer loop.
+
+    ARMA(1,1) is unidentified on white data — every point of the
+    ``rho = -theta`` ridge is exactly the white-noise model, so the
+    per-voxel argmax lands at an arbitrary (often extreme) near-ridge
+    point there.  Each voxel therefore passes a likelihood-ratio gate
+    against the white model: when the MLE improves on (0, 0) by less
+    than ``_ARMA_LRT_GATE`` nats (the chi-square(2) 95% bar — the
+    autocorrelation is statistically undetectable), that voxel reports
+    (0, 0).  Identified coefficients are untouched pure MLEs.
     """
     if volume.ndim > 1:
         brain_timecourse = volume[mask > 0]
@@ -719,16 +733,16 @@ def _calc_ARMA_noise(volume, mask, auto_reg_order=1, ma_order=1,
     x = brain_timecourse[idxs].astype('float64')
     x = x - x.mean(axis=1, keepdims=True)
     sd = x.std(axis=1)
-    x = x[sd > 0]
+    x = x[sd > 0] / sd[sd > 0][:, None]
     if x.shape[0] == 0 or x.shape[1] < 3:
         return [0.0] * auto_reg_order, [0.0] * ma_order
-    x = x / x.std(axis=1, keepdims=True)
 
     # Zooming grid search: coarse sweep of the invertible region, then
     # two refinements around each voxel's best cell.
     n_pts = 13
-    centers_r = np.zeros(x.shape[0])
-    centers_t = np.zeros(x.shape[0])
+    n_sampled = x.shape[0]
+    centers_r = np.zeros(n_sampled)
+    centers_t = np.zeros(n_sampled)
     half = 0.94
     for _zoom in range(3):
         offs = np.linspace(-half, half, n_pts)
@@ -738,16 +752,18 @@ def _calc_ARMA_noise(volume, mask, auto_reg_order=1, ma_order=1,
         cand_t = np.clip(centers_t[:, None] + tt.ravel()[None], -0.97,
                          0.97)
         ll = _arma11_loglik_grid(x, cand_r, cand_t)
-        # The ARMA(1,1) likelihood is flat along the rho = -theta
-        # cancellation ridge (on white data every point of the ridge is
-        # near-optimal), so break near-ties toward the smallest
-        # coefficient magnitudes instead of an arbitrary ridge point.
-        near = ll >= ll.max(axis=1, keepdims=True) - 2.0
-        size = np.abs(cand_r) + np.abs(cand_t)
-        best = np.argmax(np.where(near, -size, -np.inf), axis=1)
-        centers_r = cand_r[np.arange(x.shape[0]), best]
-        centers_t = cand_t[np.arange(x.shape[0]), best]
+        best = np.argmax(ll, axis=1)
+        rows = np.arange(n_sampled)
+        centers_r = cand_r[rows, best]
+        centers_t = cand_t[rows, best]
+        ll_best = ll[rows, best]
         half /= (n_pts - 1) / 2.0
+    # White-model likelihood-ratio gate (see docstring).
+    ll_white = _arma11_loglik_grid(x, np.zeros((n_sampled, 1)),
+                                   np.zeros((n_sampled, 1)))[:, 0]
+    undetectable = ll_best - ll_white < _ARMA_LRT_GATE
+    centers_r[undetectable] = 0.0
+    centers_t[undetectable] = 0.0
     ar = float(np.nanmean(centers_r))
     ma = float(np.nanmean(centers_t))
     return [ar] * auto_reg_order, [ma] * ma_order

@@ -302,6 +302,24 @@ def test_arma_mle_white_noise_is_zero():
     assert abs(ma[0]) < 0.1
 
 
+def test_arma_mle_weak_signal_not_shrunk():
+    """Weak-but-identified autocorrelation (AR(1) rho=0.3 at T=100)
+    must survive the white-noise likelihood-ratio gate — a regression
+    guard against near-tie heuristics that collapse the whole
+    confidence region toward zero."""
+    rng = np.random.RandomState(21)
+    n_vox, n_tr, burn, rho = 50, 100, 50, 0.3
+    e = rng.randn(n_vox, n_tr + burn)
+    x = np.zeros((n_vox, n_tr + burn))
+    for t in range(1, n_tr + burn):
+        x[:, t] = rho * x[:, t - 1] + e[:, t]
+    x = x[:, burn:]
+    np.random.seed(22)
+    ar, ma = sim._calc_ARMA_noise(x, np.ones(n_vox), sample_num=n_vox)
+    # the effective lag-1 dependence (ar + ma for AR-dominated data)
+    assert 0.15 < ar[0] + ma[0] < 0.45
+
+
 def test_arma_loglik_prefers_truth():
     """The concentrated exact likelihood must rank the generating
     parameters above clearly wrong ones."""
