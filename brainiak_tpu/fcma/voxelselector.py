@@ -142,11 +142,17 @@ class VoxelSelector:
         over its ``voxel`` axis (the analog of adding MPI workers)
     svm_C, svm_iters : on-device dual-SVM hyperparameters.  The SMO step
         budget is ``svm_iters * n_epochs`` two-coordinate updates per
-        dual; the default (20) is ~2x the budget at which accuracies
-        measured bit-identical to a 50-iteration run on a real v5e
-        (converged SMO steps are no-ops, so headroom is cheap there,
-        but each sequential step is latency-bound — halving the budget
-        nearly halves CV wall time)
+        dual.  Measured at the whole-brain bench config: the default
+        (10) is bit-identical to a 50-iteration run on CPU fp32, and on
+        a real v5e differs only by single near-boundary test samples on
+        ~2% of voxels (max one sample per fold — the same noise band
+        fp32 rounding already produces vs the sklearn f64 oracle).
+        Each sequential SMO step is latency-bound, so CV wall time
+        scales almost linearly with the budget; ``run`` checks the
+        returned KKT gaps and warns when any dual needed more budget —
+        raise ``svm_iters`` if that fires (or cross-check with
+        ``ops.svm.svm_cv_accuracy(..., solver='ipm')``, the exact
+        interior-point solver)
     use_pallas : 'auto' (fused Pallas kernel on TPU) | True | False
     precision : 'highest' (fp32-equivalent, default) | 'high' (3-pass
         bf16 MXU, ~1e-3 correlation accuracy) | 'default', for the
@@ -159,7 +165,7 @@ class VoxelSelector:
 
     def __init__(self, labels, epochs_per_subj, num_folds, raw_data,
                  raw_data2=None, voxel_unit=256, mesh=None,
-                 svm_C=1.0, svm_iters=20, process_num=None,
+                 svm_C=1.0, svm_iters=10, process_num=None,
                  master_rank=0, use_pallas='auto', precision='highest'):
         self.labels = np.asarray(labels)
         self.epochs_per_subj = epochs_per_subj

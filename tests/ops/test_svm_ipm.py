@@ -1,0 +1,95 @@
+"""The interior-point C-SVC dual solver must match libsvm exactly.
+
+The IPM (`ops.svm.svm_fit_dual_ipm`) is the independent cross-check for
+the SMO budget: same dual problem, different algorithm, so agreement
+with both the SMO path and sklearn's SVC is strong evidence either
+solver is converged (reference semantics:
+tests/fcma/test_voxel_selection.py + sklearn SVC precomputed).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+from sklearn import model_selection
+from sklearn.svm import SVC
+
+from brainiak_tpu.ops.svm import svm_cv_accuracy, svm_fit_dual_ipm
+
+
+def test_ipm_matches_sklearn_duals():
+    rng = np.random.RandomState(0)
+    checked = 0
+    for _ in range(12):
+        n = int(rng.choice([8, 12, 16, 24]))
+        feat = rng.randn(n, 40)
+        kernel = feat @ feat.T
+        y = np.where(rng.rand(n) > 0.5, 1.0, -1.0)
+        if np.abs(y.sum()) == n:
+            y[0] = -y[0]
+        box = np.ones(n)
+        box[rng.rand(n) < 0.3] = 0.0  # random fold/pair exclusions
+        act = box > 0
+        if not ((y[act] > 0).any() and (y[act] < 0).any()):
+            continue
+        alpha, bias, gap = svm_fit_dual_ipm(
+            jnp.asarray(kernel), jnp.asarray(y), jnp.asarray(box),
+            n_iters=40)
+        ref = SVC(kernel='precomputed', C=1.0).fit(
+            kernel[np.ix_(act, act)], y[act])
+        a_ref = np.zeros(n)
+        a_ref[np.where(act)[0][ref.support_]] = np.abs(ref.dual_coef_[0])
+        assert np.max(np.abs(np.asarray(alpha) - a_ref)) < 1e-3
+        # the violating-pair gap is a gradient-space sup-norm: a dual
+        # error eps moves it by up to eps * n * max|K|, so scale the
+        # tolerance accordingly (the dual parity above is the contract)
+        assert float(gap) < 1e-3 * n * (1.0 + np.abs(kernel).max())
+        checked += 1
+    assert checked >= 8
+
+
+def test_ipm_cv_float32():
+    """fp32 regression: as the interior path converges, ``ub - a``
+    underflows at fp32 ulp and the barrier divisions NaN without the
+    boundary floor — the f64 suite cannot catch that."""
+    rng = np.random.RandomState(3)
+    n_epochs = 16
+    labels = np.array([0, 1] * 8)
+    kernels = []
+    for _ in range(32):
+        feat = rng.randn(n_epochs, 64).astype(np.float32)
+        feat += 0.5 * labels[:, None].astype(np.float32) \
+            * rng.randn(1, 64).astype(np.float32)
+        kernels.append(feat @ feat.T / 64)
+    kernels = np.stack(kernels).astype(np.float32)
+    acc_ipm = svm_cv_accuracy(kernels, labels, 4, n_iters=30,
+                              solver='ipm')
+    acc_smo = svm_cv_accuracy(kernels, labels, 4, n_iters=50,
+                              solver='smo')
+    assert np.all(np.isfinite(acc_ipm))
+    # identical up to single near-boundary test samples (1/16 epochs)
+    assert np.abs(acc_ipm - acc_smo).max() <= 1.0 / n_epochs + 1e-9
+    assert abs(float(acc_ipm.mean() - acc_smo.mean())) < 0.01
+
+
+def test_ipm_cv_matches_smo_and_sklearn():
+    rng = np.random.RandomState(1)
+    for n_classes, n_epochs in [(2, 16), (3, 18)]:
+        labels = np.tile(np.arange(n_classes), n_epochs // n_classes)
+        kernels = []
+        for _ in range(20):
+            feat = rng.randn(n_epochs, 30) \
+                + 0.8 * np.eye(n_classes)[labels] @ rng.randn(n_classes,
+                                                              30)
+            kernels.append(feat @ feat.T)
+        kernels = np.stack(kernels)
+        acc_ipm = svm_cv_accuracy(kernels, labels, 4, n_iters=40,
+                                  solver='ipm')
+        acc_smo = svm_cv_accuracy(kernels, labels, 4, n_iters=50,
+                                  solver='smo')
+        np.testing.assert_allclose(acc_ipm, acc_smo, atol=1e-9)
+        skf = model_selection.StratifiedKFold(n_splits=4, shuffle=False)
+        acc_ref = np.array([
+            model_selection.cross_val_score(
+                SVC(kernel='precomputed', C=1.0), k, labels,
+                cv=skf).mean()
+            for k in kernels])
+        np.testing.assert_allclose(acc_ipm, acc_ref, atol=1e-9)
