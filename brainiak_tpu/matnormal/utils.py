@@ -1,21 +1,48 @@
 """Matrix-normal utilities.
 
 Re-design of /root/reference/src/brainiak/matnormal/utils.py: the
-TF-variable pack/unpack and scipy val-and-grad bridge disappear (JAX
-pytrees + autodiff); what remains are the Cholesky flattening with
-log-diagonal uniqueness and the matrix-normal sampler."""
+TF-variable pack/unpack disappears (JAX pytrees + autodiff); what
+remains are the Cholesky flattening with log-diagonal uniqueness, the
+matrix-normal sampler, and a scipy val-and-grad bridge
+(:func:`make_val_and_grad`, the analog of the reference's
+utils.py:107-124 TF bridge) for users optimizing custom matnormal
+losses with ``scipy.optimize.minimize``."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
     "flatten_cholesky_unique",
+    "make_val_and_grad",
     "rmn",
     "scaled_I",
     "unflatten_cholesky_unique",
     "x_tx",
     "xx_t",
 ]
+
+
+def make_val_and_grad(loss_fn, *, jit=True):
+    """Bridge a JAX scalar loss to ``scipy.optimize.minimize``.
+
+    Returns ``f(x, *args) -> (val, grad)`` with float64 NumPy outputs,
+    suitable for ``minimize(..., jac=True)`` — the JAX analog of the
+    reference's TF session bridge (matnormal/utils.py:107-124), with
+    autodiff replacing the TF graph gradients.
+
+    loss_fn : callable taking a flat parameter vector (plus optional
+        fixed args) and returning a scalar.
+    """
+    vg = jax.value_and_grad(loss_fn)
+    if jit:
+        vg = jax.jit(vg)
+
+    def val_and_grad(x, *args):
+        val, grad = vg(jnp.asarray(x), *args)
+        return float(val), np.asarray(grad, dtype=np.float64)
+
+    return val_and_grad
 
 
 def xx_t(x):

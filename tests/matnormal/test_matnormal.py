@@ -1,3 +1,4 @@
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -172,6 +173,31 @@ def test_parity_helpers():
     expected_a = np.zeros_like(y)
     expected_a[idx] = np.linalg.solve(L[np.ix_(idx, idx)].T, y[idx])
     assert np.allclose(got_a, expected_a)
+
+
+def test_make_val_and_grad_scipy_bridge():
+    """The scipy bridge (reference matnormal/utils.py:107-124 analog)
+    must drive scipy.optimize.minimize with jac=True to the optimum."""
+    from scipy.optimize import minimize
+
+    from brainiak_tpu.matnormal.utils import make_val_and_grad
+
+    a = jnp.asarray(_spd(4, RNG))
+    b = jnp.asarray(RNG.randn(4))
+
+    def loss(x):
+        return 0.5 * x @ a @ x - b @ x
+
+    vg = make_val_and_grad(loss)
+    val, grad = vg(np.zeros(4))
+    assert isinstance(val, float)
+    assert grad.dtype == np.float64
+    assert np.allclose(grad, -np.asarray(b), atol=1e-6)
+    res = minimize(vg, np.zeros(4), jac=True, method='L-BFGS-B')
+    # fp32 gradients limit L-BFGS-B convergence to ~1e-4
+    atol = 1e-5 if jax.config.jax_enable_x64 else 5e-4
+    assert np.allclose(res.x, np.linalg.solve(np.asarray(a),
+                                              np.asarray(b)), atol=atol)
 
 
 def test_gp_var_priors():
