@@ -1,0 +1,93 @@
+"""Stage-level timing of the whole-brain SRM EM iteration on the live
+accelerator: which of (big einsums | batched eigh polar | K x K
+cholesky solves | full iteration) dominates wall time.
+
+The full-scale SRM fit measured 37.3 s for S=20, V=40k, T=300, K=50,
+10 iters — ~100x above both the compute and HBM rooflines measured on
+the same chip (BASELINE.md), so one stage must be pathological; the
+prime suspect is the [S, K, K] batched eigh (TPU lowers eigh as many
+small sequential ops).  Run when a healthy chip is available:
+
+    python benchmarks/srm_stage_timing.py [--subjects 20 --voxels 40000]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--subjects", type=int, default=20)
+    ap.add_argument("--voxels", type=int, default=40000)
+    ap.add_argument("--trs", type=int, default=300)
+    ap.add_argument("--features", type=int, default=50)
+    ap.add_argument("--backend", default=None)
+    args = ap.parse_args()
+    if args.backend:
+        import jax
+        jax.config.update("jax_platforms", args.backend)
+    import jax
+    import jax.numpy as jnp
+
+    from brainiak_tpu.funcalign.srm import _em_iteration, _procrustes
+
+    s, v, t, k = args.subjects, args.voxels, args.trs, args.features
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(s, v, t), jnp.float32)
+    w = jnp.tile(jnp.eye(v, k, dtype=jnp.float32)[None], (s, 1, 1))
+    rho2 = jnp.ones(s, jnp.float32)
+    sigma_s = jnp.eye(k, dtype=jnp.float32)
+    trace_xtx = jnp.sum(x * x, axis=(1, 2))
+    voxel_counts = jnp.full((s,), v, jnp.float32)
+    shared = jnp.asarray(rng.randn(k, t), jnp.float32)
+    a_stack = jnp.einsum('svt,kt->svk', x, shared)
+    gram = jnp.einsum('svi,svj->sij', a_stack, a_stack)
+
+    def timeit(fn, *fargs, n=3):
+        out = fn(*fargs)
+        jax.tree_util.tree_map(
+            lambda l: float(jnp.sum(l)), out)  # sync (scalar fetch)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*fargs)
+        jax.tree_util.tree_map(lambda l: float(jnp.sum(l)), out)
+        return (time.perf_counter() - t0) / n
+
+    hp = jax.lax.Precision.HIGHEST
+    stages = {}
+    stages["einsum_wtx [S,V,K]x[S,V,T]->KT"] = timeit(
+        jax.jit(lambda w_, x_: jnp.einsum('svk,svt->kt', w_, x_,
+                                          precision=hp)), w, x)
+    stages["einsum_a [S,V,T]x[K,T]->SVK"] = timeit(
+        jax.jit(lambda x_, sh: jnp.einsum('svt,kt->svk', x_, sh,
+                                          precision=hp)), x, shared)
+    stages["batched_eigh [S,K,K]"] = timeit(
+        jax.jit(lambda g: jnp.linalg.eigh(g)[1]), gram)
+    stages["batched_procrustes (eigh+NS)"] = timeit(
+        jax.jit(jax.vmap(_procrustes)), a_stack)
+    stages["cho_factor+solve KxK"] = timeit(
+        jax.jit(lambda m: jax.scipy.linalg.cho_solve(
+            jax.scipy.linalg.cho_factor(m + jnp.eye(k)),
+            jnp.eye(k))), sigma_s)
+    stages["full_em_iteration"] = timeit(
+        jax.jit(lambda *a_: _em_iteration(*a_, t),
+                static_argnums=()), x, w, rho2, sigma_s, trace_xtx,
+        voxel_counts)
+
+    for name, dt in stages.items():
+        print(f"{name:42s} {dt * 1e3:9.1f} ms")
+    print(json.dumps({"metric": "srm_stage_timing",
+                      "stages_ms": {n: round(dt * 1e3, 1)
+                                    for n, dt in stages.items()}}))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,8 +2,10 @@
 
 Re-design of /root/reference/src/brainiak/matnormal/: the TensorFlow
 covariance/likelihood stack becomes pure-JAX functional covariance classes
-(parameters as pytrees) with autodiff L-BFGS replacing the
-scipy.minimize <-> TF bridge."""
+(parameters as pytrees).  The built-in models fit with autodiff L-BFGS
+on device; for custom losses driven by ``scipy.optimize.minimize``,
+:func:`matnormal.utils.make_val_and_grad` provides the jac=True bridge
+(the JAX analog of the reference's TF session bridge)."""
 
 from .covs import (  # noqa: F401
     CovAR1,
