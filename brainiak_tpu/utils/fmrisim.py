@@ -17,9 +17,11 @@ zooming grid (an own estimator with the same contract as the
 reference's statsmodels ARIMA MLE, fmrisim.py:1205-1289).  Documented
 deviation from the reference internals:
 
-- ``mask_brain`` without ``mask_self`` synthesizes a smooth ellipsoidal
-  head template instead of loading the packaged grey-matter atlas
-  (fmrisim.py:2230-2366).
+- ``mask_brain`` without ``mask_self`` synthesizes a brain-like
+  template (hemispheres, cortical shell, ventricles, smooth falloff)
+  instead of loading the packaged grey-matter atlas
+  (fmrisim.py:2230-2366) — gross statistical structure matches, voxel
+  anatomy does not.
 """
 
 import logging
@@ -293,14 +295,63 @@ def apply_signal(signal_function, volume_signal):
 # ---------------------------------------------------------------------------
 # brain mask / template
 
+def _synthetic_brain_template(dims):
+    """Procedural stand-in for the packaged grey-matter atlas: union of
+    two hemisphere ellipsoids with a bright cortical shell, darker
+    interior, and central ventricles, smoothed to scanner-like
+    spatial continuity.  Values in [0, 1]."""
+    grids = np.meshgrid(*[np.linspace(-1, 1, d) for d in dims],
+                        indexing='ij')
+    if len(dims) != 3:
+        # non-3-D volumes: dims-agnostic radial falloff
+        r = np.sqrt(sum((g / 0.8) ** 2 for g in grids))
+        t = np.clip(1.2 - r, 0, None)
+        return t / t.max() if t.max() > 0 else t
+    gx, gy, gz = grids
+
+    def ellipsoid_dist(cx, cy, cz, rx, ry, rz):
+        return np.sqrt(((gx - cx) / rx) ** 2 + ((gy - cy) / ry) ** 2
+                       + ((gz - cz) / rz) ** 2)
+
+    # two hemispheres, slightly separated along x
+    left = ellipsoid_dist(-0.22, 0.0, 0.0, 0.52, 0.72, 0.62)
+    right = ellipsoid_dist(0.22, 0.0, 0.0, 0.52, 0.72, 0.62)
+    d_brain = np.minimum(left, right)
+    template = np.zeros(dims)
+    interior = d_brain < 1.0
+    # mid-intensity interior (white-matter-like)
+    template[interior] = 0.75
+    # bright cortical shell: the outer ~15% of the radial profile
+    shell = (d_brain >= 0.85) & (d_brain < 1.0)
+    template[shell] = 1.0
+    # dark central ventricles, one per hemisphere
+    vent = np.minimum(
+        ellipsoid_dist(-0.12, 0.05, 0.05, 0.12, 0.22, 0.15),
+        ellipsoid_dist(0.12, 0.05, 0.05, 0.12, 0.22, 0.15))
+    template[vent < 1.0] = 0.3
+    # smooth to scanner-like continuity (also softens the inter-
+    # hemispheric gap) and renormalize
+    sigma = max(1.0, min(dims) / 24.0)
+    template = ndimage.gaussian_filter(template, sigma)
+    if template.max() > 0:
+        template = template / template.max()
+    return template
+
+
 def mask_brain(volume, template_name=None, mask_threshold=None,
                mask_self=True):
     """Produce a binary mask + continuous template for a volume
     (reference fmrisim.py:2230-2366).
 
-    With ``mask_self`` the template comes from the volume itself; otherwise
-    a smooth synthetic ellipsoidal head template is generated (documented
-    deviation: the reference ships a grey-matter atlas)."""
+    With ``mask_self`` the template comes from the volume itself;
+    otherwise a synthetic brain-like template is generated (documented
+    deviation: the reference ships a packaged grey-matter atlas).  The
+    synthetic template has the atlas's gross statistical structure —
+    two hemispheres, a bright cortical shell around a mid-intensity
+    interior, dark central ventricles, and a smooth falloff — so
+    template-scaled noise components (SFNR maps, spatial scaling)
+    exhibit realistic spatial heterogeneity and the histogram stays
+    bimodal for the automatic mask threshold."""
     volume = np.asarray(volume, dtype=float)
     if volume.ndim == 1:
         volume = np.ones(volume.astype(int))
@@ -308,11 +359,7 @@ def mask_brain(volume, template_name=None, mask_threshold=None,
     if mask_self:
         mask_raw = volume
     else:
-        dims = volume.shape[:3]
-        grids = np.meshgrid(*[np.linspace(-1, 1, d) for d in dims],
-                            indexing='ij')
-        r = np.sqrt(sum((g / 0.8) ** 2 for g in grids))
-        mask_raw = np.clip(1.2 - r, 0, None)
+        mask_raw = _synthetic_brain_template(volume.shape[:3])
 
     if mask_raw.ndim == 4:
         mask_raw = mask_raw[..., 0] if mask_raw.shape[3] == 1 \

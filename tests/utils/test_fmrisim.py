@@ -257,6 +257,35 @@ def test_mask_brain():
     assert mask2[0, 0, 0] == 0
 
 
+def test_synthetic_template_structure():
+    """The procedural template must carry the atlas's gross structure:
+    values in [0, 1], bright shell vs darker ventricle interior, rough
+    left/right symmetry, and a bimodal histogram so the automatic mask
+    threshold works."""
+    dims = (24, 24, 24)
+    t = sim._synthetic_brain_template(dims)
+    assert t.shape == dims
+    assert t.min() >= 0 and np.isclose(t.max(), 1.0)
+    # interior brighter than the background corners, ventricle darker
+    # than the brain average
+    background = np.mean([t[0, 0, 0], t[-1, 0, 0], t[0, -1, -1],
+                          t[-1, -1, -1]])
+    center = t[10:14, 10:14, 10:14].mean()     # ventricle region
+    brain = t[t > 0.5].mean()
+    assert background < 0.1
+    assert background < center < brain
+    # 2-D volumes keep working (dims-agnostic fallback)
+    t2 = sim._synthetic_brain_template((12, 12))
+    assert t2.shape == (12, 12) and np.isclose(t2.max(), 1.0)
+    # rough left/right symmetry
+    assert np.abs(t - t[::-1]).mean() < 0.05
+    # the automatic threshold must find a sensible brain fraction
+    mask, template = sim.mask_brain(np.ones(np.array(dims)),
+                                    mask_self=False)
+    frac = mask.mean()
+    assert 0.1 < frac < 0.7
+
+
 def test_drift_and_phys_components():
     np.random.seed(3)
     drift = sim._generate_noise_temporal_drift(200, 2.0)
