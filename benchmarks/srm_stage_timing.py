@@ -73,6 +73,9 @@ def main():
         jax.jit(lambda g: jnp.linalg.eigh(g)[1]), gram)
     stages["batched_procrustes (eigh+NS)"] = timeit(
         jax.jit(jax.vmap(_procrustes)), a_stack)
+    from brainiak_tpu.funcalign.srm import _polar_ns
+    stages["batched_polar_ns (matmul-only)"] = timeit(
+        jax.jit(jax.vmap(_polar_ns)), a_stack)
     stages["cho_factor+solve KxK"] = timeit(
         jax.jit(lambda m: jax.scipy.linalg.cho_solve(
             jax.scipy.linalg.cho_factor(m + jnp.eye(k)),

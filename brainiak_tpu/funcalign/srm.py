@@ -40,6 +40,55 @@ __all__ = ["SRM", "DetSRM", "load"]
 
 logger = logging.getLogger(__name__)
 
+# Polar-factor algorithm for the tall W update: "eigh" (Gram
+# eigendecomposition — exact, default) or "ns" (matmul-only
+# Newton-Schulz — for accelerators where batched small eigh lowers to
+# long sequential loops; see _polar_ns).  Baked in at TRACE time: the
+# jitted EM programs do not key their cache on this flag, so flip it
+# before the first fit in the process (or call jax.clear_caches()).
+POLAR_METHOD = "eigh"
+
+
+def _polar_ns(ap, n_iters=24):
+    """Matmul-only polar factor via the coupled Newton-Schulz iteration
+    on the K x K Gram: ``Y -> c^(1/2)``, ``Z -> c^(-1/2)`` for
+    ``c = apᵀap / s`` (``s`` a row-sum bound on the spectral radius),
+    then ``W = ap (c/s)^(-1/2) / sqrt(s)``.
+
+    An alternative to the Gram-eigh path for accelerators where batched
+    small-matrix eigh lowers to long sequential loops: every operation
+    here is a K x K matmul.  Quadratic convergence once the spectrum
+    enters (0, 1]; small singular values converge slowest, so severely
+    rank-deficient inputs (RSRM's perturbation=0 regime) should keep
+    the eigh path.  The caller's Newton-Schulz orthogonality scrub runs
+    after either path.
+    """
+    hp = jax.lax.Precision.HIGHEST
+    k = ap.shape[1]
+    c = jnp.einsum('vi,vj->ij', ap, ap, precision=hp)
+    # spectral bound: max absolute row sum (>= lambda_max); guard zeros
+    s = jnp.maximum(jnp.max(jnp.sum(jnp.abs(c), axis=1)),
+                    jnp.asarray(jnp.finfo(ap.dtype).tiny, ap.dtype))
+    eye = jnp.eye(k, dtype=ap.dtype)
+    # RELATIVE spectrum floor (the analog of the eigh path's eigenvalue
+    # floor): Gram eigenvalues that round NEGATIVE in floating point
+    # diverge under the Newton-Schulz map p -> p(3-p)^2/4 instead of
+    # converging slowly — a ridge of ~100 ulp pins them just inside
+    # (0, 1] at accuracy cost far below fp32 noise.
+    floor = 100.0 * jnp.finfo(ap.dtype).eps
+    y, z = c / s + floor * eye, eye
+
+    def body(_, carry):
+        y, z = carry
+        m = 0.5 * (3.0 * eye - jnp.einsum('ij,jk->ik', z, y,
+                                          precision=hp))
+        return (jnp.einsum('ij,jk->ik', y, m, precision=hp),
+                jnp.einsum('ij,jk->ik', m, z, precision=hp))
+
+    _, z = jax.lax.fori_loop(0, n_iters, body, (y, z))
+    inv_sqrt = z / jnp.sqrt(s)
+    return jnp.einsum('vk,kj->vj', ap, inv_sqrt, precision=hp)
+
 
 def _procrustes(a, perturbation=0.001):
     """Orthogonal map closest to ``a`` ([voxels, features]): U Vᵀ from the
@@ -63,20 +112,32 @@ def _procrustes(a, perturbation=0.001):
     v, kk = a.shape
     if v >= 4 * kk:
         hp = jax.lax.Precision.HIGHEST
-        c = jnp.einsum('vi,vj->ij', ap, ap, precision=hp)
-        lam, q = jnp.linalg.eigh(c)
-        # RELATIVE floor (plus a sqrt-tiny absolute guard for an
-        # all-zero input): rank-deficient Grams — RSRM passes
-        # perturbation=0 — have eigenvalues rounding to ~0 or slightly
-        # negative, and an absolute tiny floor would send lam**-0.5 to
-        # ~1e19 and overflow the Newton-Schulz products to Inf/NaN
-        floor = jnp.maximum(jnp.finfo(a.dtype).eps * jnp.max(lam),
-                            jnp.asarray(jnp.finfo(a.dtype).tiny,
-                                        a.dtype) ** 0.5)
-        lam = jnp.clip(lam, floor)
-        inv_sqrt = jnp.einsum('ik,k,jk->ij', q, lam ** -0.5, q,
-                              precision=hp)
-        w = jnp.einsum('vk,kj->vj', ap, inv_sqrt, precision=hp)
+        # The "ns" path is gated to perturbation != 0 call sites (the
+        # probabilistic/deterministic SRM W updates): RSRM's and
+        # FastSRM's perturbation=0 calls can be severely rank-deficient,
+        # where the eigh spectrum handling is the safer choice.
+        if POLAR_METHOD == "ns" and perturbation != 0:
+            w = _polar_ns(ap)
+        else:
+            c = jnp.einsum('vi,vj->ij', ap, ap, precision=hp)
+            lam, q = jnp.linalg.eigh(c)
+            # RELATIVE floor (plus a sqrt-tiny absolute guard for an
+            # all-zero input): rank-deficient Grams — RSRM passes
+            # perturbation=0 — have eigenvalues rounding to ~0 or
+            # slightly negative, and an absolute tiny floor would send
+            # lam**-0.5 to ~1e19 and overflow the Newton-Schulz
+            # products to Inf/NaN
+            floor = jnp.maximum(jnp.finfo(a.dtype).eps * jnp.max(lam),
+                                jnp.asarray(jnp.finfo(a.dtype).tiny,
+                                            a.dtype) ** 0.5)
+            lam = jnp.clip(lam, floor)
+            inv_sqrt = jnp.einsum('ik,k,jk->ij', q, lam ** -0.5, q,
+                                  precision=hp)
+            w = jnp.einsum('vk,kj->vj', ap, inv_sqrt, precision=hp)
+        # Newton-Schulz orthogonality scrub, shared by both polar paths
+        # (squaring the condition number in the Gram costs ~half the
+        # working precision; two quadratically-convergent steps scrub
+        # the near-orthogonal result).
         eye_k = jnp.eye(kk, dtype=a.dtype)
         for _ in range(2):
             wtw = jnp.einsum('vi,vj->ij', w, w, precision=hp)

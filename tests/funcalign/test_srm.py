@@ -220,3 +220,29 @@ def test_procrustes_polar_matches_svd_and_survives_rank_deficiency():
     # all-zero input: finite (0 @ inf would be NaN without the guard)
     w0 = np.asarray(_procrustes(jnp.zeros((600, 12))))
     assert np.all(np.isfinite(w0))
+
+
+def test_procrustes_newton_schulz_matches_svd():
+    """The matmul-only Newton-Schulz polar path (POLAR_METHOD='ns', the
+    batched-eigh alternative for accelerators) must match the SVD polar
+    factor through condition numbers ~1e3."""
+    import jax.numpy as jnp
+
+    import brainiak_tpu.funcalign.srm as srm_mod
+
+    rng = np.random.RandomState(1)
+    v, k = 600, 20
+    u, _ = np.linalg.qr(rng.randn(v, k))
+    vv, _ = np.linalg.qr(rng.randn(k, k))
+    try:
+        srm_mod.POLAR_METHOD = "ns"
+        for kappa in [1.0, 100.0, 1000.0]:
+            a = (u * np.logspace(0, -np.log10(kappa), k)) @ vv.T
+            w = np.asarray(srm_mod._procrustes(jnp.asarray(a),
+                                               perturbation=0.0))
+            uu, _, vt = np.linalg.svd(a, full_matrices=False)
+            tol = 1e-6 if w.dtype == np.float64 else 1e-3
+            assert np.abs(w - uu @ vt).max() < tol, kappa
+            assert np.abs(w.T @ w - np.eye(k)).max() < tol
+    finally:
+        srm_mod.POLAR_METHOD = "eigh"
