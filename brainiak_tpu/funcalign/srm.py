@@ -57,11 +57,21 @@ def _polar_ns(ap, n_iters=24):
 
     An alternative to the Gram-eigh path for accelerators where batched
     small-matrix eigh lowers to long sequential loops: every operation
-    here is a K x K matmul.  Quadratic convergence once the spectrum
-    enters (0, 1]; small singular values converge slowest, so severely
-    rank-deficient inputs (RSRM's perturbation=0 regime) should keep
-    the eigh path.  The caller's Newton-Schulz orthogonality scrub runs
-    after either path.
+    here is a K x K matmul.  Severely rank-deficient inputs (RSRM's
+    perturbation=0 regime) should keep the eigh path.  The caller's
+    Newton-Schulz orthogonality scrub runs after either path.
+
+    Accuracy (measured, 600x20): the iteration converges well inside the
+    default budget — more iterations do not move the result.  What
+    limits accuracy is the working precision applied to the SQUARED
+    condition number of the Gram: max error vs the SVD polar factor is
+    ~eps * kappa(a)^2 (within ~10x).  float64: ~1e-11 at kappa=100,
+    ~1e-9 at kappa=1000.  float32: ~6e-4 at kappa=30, ~6e-3 at
+    kappa=100, ~3e-2 at kappa=300 — so in fp32 (the TPU production
+    dtype) this path is only a faithful polar factor for
+    kappa ≲ 30-100; beyond that the scrub restores orthogonality but
+    not proximity to the true factor, and the eigh path (same Gram
+    floor, but exact spectrum handling) or f64 should be used.
     """
     hp = jax.lax.Precision.HIGHEST
     k = ap.shape[1]

@@ -79,8 +79,12 @@ def svm_fit_dual(kernel, y, box, n_iters=400):
         vals = jnp.stack([yg, y, box, alpha,
                           in_up.astype(kernel.dtype),
                           in_low.astype(kernel.dtype)])   # [6, n]
-        at = e2 @ vals.T                                  # [2, 6]
-        qij = e2 @ q                                      # [2, n]
+        # One-hot contractions are exact elementwise reads in disguise:
+        # pin them to HIGHEST so the MXU's default bf16 truncation cannot
+        # round the carried grad/alpha state each sequential step.
+        hp = jax.lax.Precision.HIGHEST
+        at = jnp.matmul(e2, vals.T, precision=hp)         # [2, 6]
+        qij = jnp.matmul(e2, q, precision=hp)             # [2, n]
         yg_i, y_i, box_i, alpha_i, up_i = (at[0, 0], at[0, 1], at[0, 2],
                                            at[0, 3], at[0, 4])
         yg_j, y_j, alpha_j, low_j = (at[1, 0], at[1, 1], at[1, 3],
@@ -103,8 +107,8 @@ def svm_fit_dual(kernel, y, box, n_iters=400):
         t = jnp.where((yg_i - yg_j > 1e-12) & (up_i > 0) & (low_j > 0),
                       t, 0.0)
         d2 = jnp.stack([y_i * t, -y_j * t])               # [2]
-        alpha = alpha + d2 @ e2
-        grad = grad + d2 @ qij
+        alpha = alpha + jnp.matmul(d2, e2, precision=hp)
+        grad = grad + jnp.matmul(d2, qij, precision=hp)
         return alpha, grad
 
     zeros = jnp.zeros((n,), dtype=kernel.dtype)

@@ -104,6 +104,80 @@ def check_unused_imports(path, tree, noqa, findings):
                 f"{path}:{lineno}: '{name}' imported but unused")
 
 
+def _code_defaults():
+    """Map parameter name -> set of repr'd default values across every
+    function/method signature in the package."""
+    defaults = {}
+    pkg = os.path.join(REPO, "brainiak_tpu")
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            with open(path, encoding="utf-8") as fh:
+                try:
+                    tree = ast.parse(fh.read(), filename=path)
+                except SyntaxError:
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                args = node.args
+                pos = args.posonlyargs + args.args
+                for arg, dflt in zip(pos[len(pos) - len(args.defaults):],
+                                     args.defaults):
+                    if isinstance(dflt, ast.Constant):
+                        defaults.setdefault(arg.arg, set()).add(
+                            repr(dflt.value))
+                for arg, dflt in zip(args.kwonlyargs, args.kw_defaults):
+                    if dflt is not None and isinstance(dflt, ast.Constant):
+                        defaults.setdefault(arg.arg, set()).add(
+                            repr(dflt.value))
+    return defaults
+
+
+def check_doc_defaults(findings):
+    """Docs-vs-code default drift gate: every ``**`name=`** (default X)``
+    claim in docs/*.md must match at least one signature default for a
+    parameter of that name somewhere in the package (the round-2
+    ``svm_iters`` 20-vs-10 drift is the motivating case)."""
+    import re
+    pattern = re.compile(
+        r"`(?P<name>[A-Za-z_][A-Za-z0-9_]*)=?`\*{0,2}\s*"
+        r"\(\s*(?:`)?default(?:s to)?[\s:`]+(?P<value>[^)`\s,;]+)")
+    docs_dir = os.path.join(REPO, "docs")
+    if not os.path.isdir(docs_dir):
+        return
+    defaults = None
+    for root, dirs, files in os.walk(docs_dir):
+        dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+        for f in sorted(files):
+            if not f.endswith(".md"):
+                continue
+            path = os.path.join(root, f)
+            with open(path, encoding="utf-8") as fh:
+                for i, line in enumerate(fh, 1):
+                    if "# noqa" in line:
+                        continue
+                    for m in pattern.finditer(line):
+                        if defaults is None:
+                            defaults = _code_defaults()
+                        name = m.group("name")
+                        doc_val = m.group("value").strip("'\"")
+                        code_vals = defaults.get(name)
+                        if not code_vals:
+                            continue  # not a signature param (knob alias)
+                        normalized = {v.strip("'\"") for v in code_vals}
+                        if doc_val not in normalized:
+                            opts = ", ".join(sorted(code_vals))
+                            findings.append(
+                                f"{path}:{i}: documented default "
+                                f"`{name}={doc_val}` does not match "
+                                f"any signature default ({opts})")
+
+
 def run_external(findings):
     """Run ruff/flake8 + mypy when available (full CI environments)."""
     ran = []
@@ -133,6 +207,7 @@ def run_external(findings):
 def main(argv=None):
     findings = []
     ran = run_external(findings)
+    check_doc_defaults(findings)
     n = 0
     for path in python_sources():
         n += 1
