@@ -267,28 +267,49 @@ class HTFA(TFA):
     def _dispatch_batched_step(self, bdata, bR, vmask, tmask, centers,
                                widths, beta, sigma, tmpl):
         """Run the batched inner step, sharding the subject axis over the
-        mesh when one is set (the subject count is padded by repetition
-        to divide the mesh axis; padded rows are discarded)."""
+        mesh when one is set.
+
+        A subject count that does not divide the mesh axis is padded to
+        the next multiple, with pad lanes ZERO-MASKED rather than
+        repeated: data/coords/voxel/TR masks and the template-penalty
+        scaling pad with zeros (so the pad objective is identically 0
+        and its L-BFGS lane converges on the first iteration instead of
+        re-running subject 0's full trajectory), the ridge coefficient
+        pads with 1 (keeps the weight solve nonsingular: W = I⁻¹·0 = 0),
+        and the box bounds/inits pad by repetition (any valid box).
+        SPMD lockstep still executes ceil(S/shards) lanes per shard —
+        that cost is forced by static shapes — but pad lanes no longer
+        carry a duplicated subject's optimization, and their outputs are
+        inert template values rather than copies of a real subject.
+        Padded rows are discarded on fetch."""
         S = bdata.shape[0]
         pad = 0
         if self.mesh is not None and \
                 DEFAULT_SUBJECT_AXIS in self.mesh.shape:
             pad = (-S) % self.mesh.shape[DEFAULT_SUBJECT_AXIS]
 
-        def prep(a):
+        def prep(a, pad_mode):
             a = np.asarray(a)
             if pad:
-                a = np.concatenate([a, np.repeat(a[:1], pad, axis=0)])
+                if pad_mode == "zero":
+                    fill = np.zeros((pad,) + a.shape[1:], a.dtype)
+                elif pad_mode == "one":
+                    fill = np.ones((pad,) + a.shape[1:], a.dtype)
+                else:  # "repeat": any valid value; bounds/inits
+                    fill = np.repeat(a[:1], pad, axis=0)
+                a = np.concatenate([a, fill])
             if self.mesh is not None:
                 spec = PartitionSpec(DEFAULT_SUBJECT_AXIS,
                                      *([None] * (a.ndim - 1)))
                 return jax.device_put(a, NamedSharding(self.mesh, spec))
             return jnp.asarray(a)
 
-        batch = [prep(a) for a in
+        modes = ("zero", "zero", "zero", "zero", "repeat", "repeat",
+                 "repeat", "repeat", "one", "repeat", "zero")
+        batch = [prep(a, m) for a, m in zip(
                  (bdata, bR, vmask, tmask, centers, widths,
                   self.sub_lower, self.sub_upper, beta, sigma,
-                  self.sub_scaling)]
+                  self.sub_scaling), modes)]
         if self.mesh is not None:
             tmpl = [jax.device_put(
                 np.asarray(t), NamedSharding(self.mesh, PartitionSpec()))

@@ -61,8 +61,8 @@ def test_htfa_mesh_matches_single_host():
 
 def test_htfa_ragged_subjects_mesh_padding():
     """Subjects with different voxel counts batch via masked padding, and
-    a subject count that does not divide the mesh axis is padded by
-    repetition and discarded."""
+    a subject count that does not divide the mesh axis is padded with
+    ZERO-MASKED lanes (inert: objective identically 0) and discarded."""
     from brainiak_tpu.parallel.mesh import make_mesh
 
     from tests.conftest import mesh_atol
@@ -82,6 +82,49 @@ def test_htfa_ragged_subjects_mesh_padding():
     np.testing.assert_allclose(sharded.local_posterior_,
                                single.local_posterior_,
                                atol=mesh_atol())
+
+
+def test_htfa_zero_masked_pad_lane_is_inert():
+    """A zero-masked pad lane (zero data/coords/masks/scaling, unit ridge
+    coefficient) must contribute an identically-zero objective: its
+    L-BFGS converges immediately and returns the init unchanged —
+    the property the mesh padding in ``_dispatch_batched_step`` relies
+    on so pad lanes never re-run a real subject's optimization."""
+    import jax.numpy as jnp
+
+    from brainiak_tpu.factoranalysis.htfa import _batched_subject_step
+
+    K, n_dim, V, T = 2, 3, 50, 20
+    rng = np.random.RandomState(0)
+    # lane 0: a real subject; lane 1: the zero-masked pad
+    data = np.stack([rng.randn(V, T), np.zeros((V, T))])
+    R = np.stack([rng.randn(V, n_dim), np.zeros((V, n_dim))])
+    vmask = np.stack([np.ones(V), np.zeros(V)])
+    tmask = np.stack([np.ones(T), np.zeros(T)])
+    centers = np.tile(rng.randn(K, n_dim), (2, 1, 1))
+    widths = np.tile(np.full(K, 1.0), (2, 1))
+    lower = np.tile(np.concatenate([-5 * np.ones(K * n_dim),
+                                    0.1 * np.ones(K)]), (2, 1))
+    upper = np.tile(np.concatenate([5 * np.ones(K * n_dim),
+                                    10.0 * np.ones(K)]), (2, 1))
+    beta = np.array([1.0, 1.0])
+    sigma = np.array([1.0, 1.0])
+    scaling = np.array([0.5, 0.0])
+    tmpl_centers = rng.randn(K, n_dim)
+    tmpl_cov_inv = np.tile(np.eye(n_dim), (K, 1, 1))
+    tmpl_widths = np.full(K, 1.0)
+    tmpl_reci = np.full(K, 1.0)
+    x, cost = _batched_subject_step(
+        *[jnp.asarray(a) for a in
+          (data, R, vmask, tmask, centers, widths, lower, upper,
+           beta, sigma, scaling, tmpl_centers, tmpl_cov_inv,
+           tmpl_widths, tmpl_reci)],
+        K=K, n_dim=n_dim, nlss_loss="soft_l1", max_iters=8)
+    assert float(cost[1]) == 0.0
+    init = np.concatenate([centers[1].ravel(), widths[1]])
+    np.testing.assert_allclose(np.asarray(x)[1], init, atol=1e-6)
+    # the real lane actually optimized
+    assert float(cost[0]) > 0.0
 
 
 def test_htfa_input_validation():
