@@ -17,14 +17,18 @@ zooming grid (an own estimator with the same contract as the
 reference's statsmodels ARIMA MLE, fmrisim.py:1205-1289).  Documented
 deviation from the reference internals:
 
-- ``mask_brain`` without ``mask_self`` synthesizes a brain-like
-  template (hemispheres, cortical shell, ventricles, smooth falloff)
-  instead of loading the packaged grey-matter atlas
-  (fmrisim.py:2230-2366) — gross statistical structure matches, voxel
-  anatomy does not.
+- ``mask_brain`` without ``mask_self`` loads a PACKAGED brain template
+  (``sim_parameters/brain_template.npz``, zoomed to the volume) through
+  the same pipeline the reference uses for its grey-matter atlas
+  (fmrisim.py:2230-2366).  The packaged template is procedurally
+  generated once on the MNI-like grid (hemispheres, cortical shell,
+  ventricles, smooth falloff; ``tools/gen_brain_template.py``) — gross
+  statistical structure matches the atlas, voxel-level anatomical
+  provenance does not.
 """
 
 import logging
+import os
 
 import numpy as np
 from scipy import ndimage, signal, stats
@@ -338,26 +342,52 @@ def _synthetic_brain_template(dims):
     return template
 
 
+_PACKAGED_TEMPLATE_CACHE = {}
+
+
+def _load_packaged_template():
+    """The packaged brain template (91 x 109 x 91 uint8 -> [0, 1]),
+    generated once by ``_synthetic_brain_template`` on the MNI152-like
+    grid via ``tools/gen_brain_template.py`` and stored as package data
+    — the analog of the reference's grey-matter atlas loading
+    (reference fmrisim.py:2288-2292)."""
+    if "template" not in _PACKAGED_TEMPLATE_CACHE:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "sim_parameters", "brain_template.npz")
+        with np.load(path) as payload:
+            _PACKAGED_TEMPLATE_CACHE["template"] = \
+                payload["template"].astype(np.float64) / 255.0
+    return _PACKAGED_TEMPLATE_CACHE["template"]
+
+
 def mask_brain(volume, template_name=None, mask_threshold=None,
                mask_self=True):
     """Produce a binary mask + continuous template for a volume
     (reference fmrisim.py:2230-2366).
 
-    With ``mask_self`` the template comes from the volume itself;
-    otherwise a synthetic brain-like template is generated (documented
-    deviation: the reference ships a packaged grey-matter atlas).  The
-    synthetic template has the atlas's gross statistical structure —
-    two hemispheres, a bright cortical shell around a mid-intensity
-    interior, dark central ventricles, and a smooth falloff — so
-    template-scaled noise components (SFNR maps, spatial scaling)
-    exhibit realistic spatial heterogeneity and the histogram stays
-    bimodal for the automatic mask threshold."""
+    With ``mask_self`` the template comes from the volume itself; with
+    ``template_name`` from that ``.npy`` file (reference
+    fmrisim.py:2292-2294); otherwise from the PACKAGED brain template
+    (``sim_parameters/brain_template.npz``), zoomed to the volume shape
+    exactly like the reference zooms its grey-matter atlas.  The
+    packaged template is procedurally generated (documented deviation:
+    the reference's atlas is derived from MNI152 anatomy; voxel-level
+    provenance differs, gross structure matches) — two hemispheres, a
+    bright cortical shell around a mid-intensity interior, dark central
+    ventricles, and a smooth falloff — so template-scaled noise
+    components (SFNR maps, spatial scaling) exhibit realistic spatial
+    heterogeneity and the histogram stays bimodal for the automatic
+    mask threshold."""
     volume = np.asarray(volume, dtype=float)
     if volume.ndim == 1:
         volume = np.ones(volume.astype(int))
 
     if mask_self:
         mask_raw = volume
+    elif template_name is not None:
+        mask_raw = np.load(template_name)
+    elif volume.ndim >= 3:
+        mask_raw = _load_packaged_template()
     else:
         mask_raw = _synthetic_brain_template(volume.shape[:3])
 
@@ -746,6 +776,40 @@ def _arma11_loglik_grid(x, rhos, thetas):
                    + sum_log_f + t * (1.0 + np.log(2.0 * np.pi)))
 
 
+def _arma11_mle(x, n_pts=13, n_zooms=3, half=0.94, clip=0.97):
+    """Exact ARMA(1,1) Gaussian MLEs for every row of the centered batch
+    ``x`` [B, T]: zooming grid search over (rho, theta) on the Kalman
+    likelihood (:func:`_arma11_loglik_grid`) — coarse sweep of the
+    invertible region, then refinements around each row's best cell.
+
+    The single source of the grid recipe: used by ``_calc_ARMA_noise``
+    (with the white-noise LRT gate on top) and by the parity suite's
+    statsmodels-ARIMA stand-in (tests/parity/conftest.py), which must
+    share the estimator exactly.
+
+    Returns (rho [B], theta [B], ll_best [B]).
+    """
+    n_sampled = x.shape[0]
+    centers_r = np.zeros(n_sampled)
+    centers_t = np.zeros(n_sampled)
+    ll_best = np.full(n_sampled, -np.inf)
+    for _zoom in range(n_zooms):
+        offs = np.linspace(-half, half, n_pts)
+        rr, tt = np.meshgrid(offs, offs, indexing='ij')
+        cand_r = np.clip(centers_r[:, None] + rr.ravel()[None], -clip,
+                         clip)
+        cand_t = np.clip(centers_t[:, None] + tt.ravel()[None], -clip,
+                         clip)
+        ll = _arma11_loglik_grid(x, cand_r, cand_t)
+        best = np.argmax(ll, axis=1)
+        rows = np.arange(n_sampled)
+        centers_r = cand_r[rows, best]
+        centers_t = cand_t[rows, best]
+        ll_best = ll[rows, best]
+        half /= (n_pts - 1) / 2.0
+    return centers_r, centers_t, ll_best
+
+
 # chi2(2).ppf(0.95)/2 nats: the 95% likelihood-ratio bar for the two
 # extra ARMA(1,1) parameters over the white-noise model.
 _ARMA_LRT_GATE = 3.0
@@ -784,27 +848,8 @@ def _calc_ARMA_noise(volume, mask, auto_reg_order=1, ma_order=1,
     if x.shape[0] == 0 or x.shape[1] < 3:
         return [0.0] * auto_reg_order, [0.0] * ma_order
 
-    # Zooming grid search: coarse sweep of the invertible region, then
-    # two refinements around each voxel's best cell.
-    n_pts = 13
+    centers_r, centers_t, ll_best = _arma11_mle(x)
     n_sampled = x.shape[0]
-    centers_r = np.zeros(n_sampled)
-    centers_t = np.zeros(n_sampled)
-    half = 0.94
-    for _zoom in range(3):
-        offs = np.linspace(-half, half, n_pts)
-        rr, tt = np.meshgrid(offs, offs, indexing='ij')
-        cand_r = np.clip(centers_r[:, None] + rr.ravel()[None], -0.97,
-                         0.97)
-        cand_t = np.clip(centers_t[:, None] + tt.ravel()[None], -0.97,
-                         0.97)
-        ll = _arma11_loglik_grid(x, cand_r, cand_t)
-        best = np.argmax(ll, axis=1)
-        rows = np.arange(n_sampled)
-        centers_r = cand_r[rows, best]
-        centers_t = cand_t[rows, best]
-        ll_best = ll[rows, best]
-        half /= (n_pts - 1) / 2.0
     # White-model likelihood-ratio gate (see docstring).
     ll_white = _arma11_loglik_grid(x, np.zeros((n_sampled, 1)),
                                    np.zeros((n_sampled, 1)))[:, 0]

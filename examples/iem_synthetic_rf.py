@@ -1,0 +1,110 @@
+"""Inverted encoding model on synthetic receptive-field data (circular).
+
+TPU-native analog of the reference's `docs/examples/iem_synthetic_RF/`
+notebook: stimuli are motion-direction patches spanning a CIRCULAR
+360-degree feature space; voxels are simulated with Gaussian receptive
+fields tiling that space (fmrisim RF helpers, reference
+fmrisim.py:3273-3388); a 6-channel inverted encoding model is fit, the
+channel basis is inspected, held-out directions are predicted, the
+model-based reconstruction curves are summarized, and an R^2-vs-voxel-
+count sweep closes the walkthrough (the notebook's sanity check).
+
+Usage:
+    python examples/iem_synthetic_rf.py [--backend cpu]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def simulate(n_voxels, n_trials, noise, rng):
+    from brainiak_tpu.utils.fmrisim import (
+        generate_1d_gaussian_rfs,
+        generate_1d_rf_responses,
+    )
+
+    feature_resolution = 360
+    rfs, tuning = generate_1d_gaussian_rfs(
+        n_voxels, feature_resolution, (0, 359), rf_size=40)
+    stimuli = rng.randint(0, 360, size=n_trials).astype(float)
+    responses = generate_1d_rf_responses(
+        rfs, stimuli, feature_resolution, (0, 359),
+        trial_noise=noise).T  # [trials, voxels]
+    return responses, stimuli, tuning
+
+
+def fit_and_score(responses, stimuli, n_train):
+    from brainiak_tpu.reconstruct.iem import InvertedEncoding1D
+
+    model = InvertedEncoding1D(n_channels=6, channel_exp=5,
+                               stimulus_mode='circular',
+                               range_start=0., range_stop=360.)
+    model.fit(responses[:n_train], stimuli[:n_train])
+    r2 = float(model.score(responses[n_train:], stimuli[n_train:]))
+    return model, r2
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default=None)
+    ap.add_argument("--voxels", type=int, default=100)
+    ap.add_argument("--trials", type=int, default=200)
+    ap.add_argument("--noise", type=float, default=0.25)
+    args = ap.parse_args()
+    import jax
+    if args.backend:
+        jax.config.update("jax_platforms", args.backend)
+
+    np.random.seed(0)  # RF helpers use the global RNG, as the reference
+    rng = np.random.RandomState(1)
+    responses, stimuli, tuning = simulate(
+        args.voxels, args.trials, args.noise, rng)
+    n_train = args.trials * 3 // 4
+
+    model, r2 = fit_and_score(responses, stimuli, n_train)
+
+    # the fitted basis: 6 half-cosine^5 channels tiling the circle
+    channels, centers = model._define_channels()
+    peaks = np.asarray(model.channel_domain)[np.argmax(channels, axis=1)]
+    print("channel peaks (deg):",
+          np.round(np.sort(peaks)).astype(int).tolist())
+
+    # held-out prediction quality (circular error)
+    pred = np.asarray(model.predict(responses[n_train:]),
+                      dtype=np.float64)
+    true = stimuli[n_train:]
+    err = np.abs(pred - true)
+    err = np.minimum(err, 360.0 - err)
+    print("median circular error (deg):",
+          round(float(np.median(err)), 2))
+    print("R^2 score:", round(r2, 3))
+
+    # model-based reconstructions: each held-out trial yields a curve
+    # over the feature domain that should peak near the true direction
+    recon = np.asarray(model._predict_feature_responses(
+        responses[n_train:]))  # [features, trials]
+    recon_peak = np.asarray(model.channel_domain)[np.argmax(recon,
+                                                            axis=0)]
+    peak_err = np.abs(recon_peak - true)
+    peak_err = np.minimum(peak_err, 360.0 - peak_err)
+    print("median reconstruction-peak error (deg):",
+          round(float(np.median(peak_err)), 2))
+
+    # the notebook's sanity sweep: R^2 grows with voxel count
+    print("R^2 by voxel count:")
+    for n_vox in (10, 30, args.voxels):
+        np.random.seed(2)
+        resp_i, stim_i, _ = simulate(n_vox, args.trials, args.noise,
+                                     np.random.RandomState(3))
+        _, r2_i = fit_and_score(resp_i, stim_i, n_train)
+        print(f"  {n_vox:4d} voxels: R^2 = {r2_i:.3f}")
+
+
+if __name__ == "__main__":
+    main()

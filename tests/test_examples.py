@@ -71,6 +71,13 @@ def test_example_iem():
     assert "median circular error" in out
 
 
+def test_example_iem_synthetic_rf():
+    out = _run("iem_synthetic_rf.py", "--voxels", "40", "--trials", "80")
+    assert "channel peaks" in out
+    assert "reconstruction-peak error" in out
+    assert "R^2 by voxel count" in out
+
+
 def test_example_matnormal():
     out = _run("matnormal_rsa.py", "--trs", "100", "--voxels", "20")
     assert "MNRSA similarity recovery" in out

@@ -170,6 +170,51 @@ def test_generate_noise_properties():
     assert 0.3 * noise_dict['snr'] < est_snr < 3 * noise_dict['snr']
 
 
+def test_packaged_brain_template(tmp_path):
+    """mask_brain(mask_self=False) must load the PACKAGED template (the
+    analog of the reference's grey-matter atlas, reference
+    fmrisim.py:2288-2292) rather than regenerating one per call, and
+    the packaged file must be bit-reproducible from the procedural
+    generator (provenance pin for tools/gen_brain_template.py)."""
+    import os
+
+    path = os.path.join(os.path.dirname(sim.__file__),
+                        "sim_parameters", "brain_template.npz")
+    with np.load(path) as payload:
+        stored = payload["template"]
+    assert stored.shape == (91, 109, 91)
+    assert stored.dtype == np.uint8
+    regen = np.round(sim._synthetic_brain_template((91, 109, 91))
+                     * 255.0).astype(np.uint8)
+    np.testing.assert_array_equal(stored, regen)
+
+    # the packaged template drives mask_brain and zooms to any 3-D shape
+    mask, template = sim.mask_brain(np.array([12, 14, 12]),
+                                    mask_self=False)
+    assert mask.shape == (12, 14, 12) and template.shape == (12, 14, 12)
+    # normalization happens BEFORE the zoom (as in the reference), so
+    # the interpolated peak can land slightly under 1
+    assert 0.0 <= template.min() and 0.9 < template.max() <= 1.0
+    assert 0.05 < mask.mean() < 0.7
+    # deterministic: two calls agree exactly (no per-call regeneration)
+    mask2, template2 = sim.mask_brain(np.array([12, 14, 12]),
+                                      mask_self=False)
+    np.testing.assert_array_equal(template, template2)
+
+    # template_name= loads a user-supplied .npy (reference
+    # fmrisim.py:2292-2294), previously accepted but ignored
+    custom = np.zeros((10, 10, 10))
+    custom[3:7, 3:7, 3:7] = 1.0
+    custom_path = tmp_path / "custom_template.npy"
+    np.save(custom_path, custom)
+    cmask, ctemplate = sim.mask_brain(np.ones((10, 10, 10)),
+                                      template_name=str(custom_path),
+                                      mask_threshold=0.5,
+                                      mask_self=False)
+    np.testing.assert_array_equal(ctemplate, custom)
+    assert cmask.sum() == 4 ** 3
+
+
 def test_calc_noise_roundtrip():
     np.random.seed(2)
     dimensions = np.array([12, 12, 12])

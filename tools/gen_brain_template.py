@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""Regenerate the packaged brain template for ``fmrisim.mask_brain``.
+
+The reference ships an MNI152 grey-matter atlas as package data and
+``mask_brain(mask_self=False)`` zooms it to the requested volume
+(reference fmrisim.py:2230-2366).  This repo's analog is a PACKAGED,
+fixed template with the same loading pipeline: generated ONCE by the
+procedural model in ``fmrisim._synthetic_brain_template`` on the
+MNI152-like 91 x 109 x 91 grid, quantized to uint8 (1/255 ~ 0.004 of
+the [0, 1] range — far below the atlas's own probabilistic resolution)
+and stored deflate-compressed.  Provenance is therefore reproducible:
+running this script must regenerate the packaged file bit-for-bit
+(pinned by tests/utils/test_fmrisim.py::test_packaged_brain_template).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "brainiak_tpu", "utils", "sim_parameters",
+                   "brain_template.npz")
+GRID = (91, 109, 91)  # MNI152 2 mm grid, like the reference's atlas
+
+
+def main():
+    from brainiak_tpu.utils.fmrisim import _synthetic_brain_template
+    template = _synthetic_brain_template(GRID)
+    quantized = np.round(template * 255.0).astype(np.uint8)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    np.savez_compressed(OUT, template=quantized)
+    print(f"wrote {OUT}: shape={quantized.shape} "
+          f"size={os.path.getsize(OUT)} bytes")
+
+
+if __name__ == "__main__":
+    main()
