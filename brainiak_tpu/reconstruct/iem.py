@@ -318,6 +318,7 @@ class InvertedEncoding2D(BaseEstimator):
     def fit(self, X, y, C=None):
         """Estimate W from betas X [trials, voxels] and stimulus centers y
         [trials, 2] (or an explicit design C) (reference iem.py:667-710)."""
+        self._check_params()  # channels may have changed (ref iem.py:810)
         X = np.asarray(X)
         if np.linalg.cond(X) > MAX_CONDITION_CHECK:
             raise ValueError("Data matrix is nearly singular.")

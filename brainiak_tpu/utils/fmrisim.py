@@ -219,8 +219,21 @@ def export_epoch_file(stimfunction, filename, tr_duration,
         arr = np.zeros((n_conditions, len(epochs), trs), dtype=np.int8)
         for e_idx, (cond, start, end) in enumerate(epochs):
             arr[cond, e_idx, start:end] = 1
-        epoch_file[ppt_counter] = arr
-    np.save(filename, np.asarray(epoch_file, dtype=object))
+        epoch_file[ppt_counter] = arr.astype(bool)
+    # Same-shaped subjects stack into a plain bool array (the reference's
+    # np.save(filename, epoch_file) behavior, fmrisim.py:720) which
+    # io.load_labels reads back WITHOUT allow_pickle; only genuinely
+    # ragged subjects need the pickled object-array form.
+    shapes = {a.shape for a in epoch_file}
+    if len(shapes) == 1:
+        np.save(filename, np.stack(epoch_file))
+    else:
+        # ragged: build the object array explicitly (np.asarray on
+        # partially-matching shapes attempts a broadcast and raises)
+        obj = np.empty(len(epoch_file), dtype=object)
+        for i, arr in enumerate(epoch_file):
+            obj[i] = arr
+        np.save(filename, obj)
 
 
 def _double_gamma_hrf(response_delay=6, undershoot_delay=12,

@@ -1,0 +1,161 @@
+"""Dataset-shaped FCMA workflow: simulate -> files on disk -> analyze.
+
+The reference's FCMA examples operate on a DIRECTORY of per-subject
+NIfTI images plus an epoch-spec ``.npy`` and a mask (the layout its
+``docs/examples/download_data.sh`` fetches).  Real downloads are not
+possible here, so this walkthrough builds that exact dataset shape with
+the simulator and then runs the same file-based pipeline a reference
+user would:
+
+1. fmrisim: per-subject 4-D volumes where the two task conditions
+   differ in ROI CONNECTIVITY (FCMA's signal), written with
+   ``io.save_as_nifti_file`` (suffix ``bet.nii.gz``), plus
+   ``mask.nii.gz`` and an epoch file from
+   ``fmrisim.export_epoch_file``;
+2. ``io.load_images_from_dir`` / ``load_boolean_mask`` /
+   ``load_labels`` -> ``prepare_fcma_data`` (epoch z-scoring);
+3. ``VoxelSelector.run('svm')`` stage-1 screening, then a
+   ``Classifier`` fit on the top voxels with held-out accuracy.
+
+Usage:
+    python examples/fcma_file_workflow.py [--backend cpu] [--keep DIR]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_dataset(out_dir, n_subjects, epochs_per_cond, epoch_len_trs,
+                  dim, tr_duration=2.0):
+    """Write <sub>_bet.nii.gz per subject + mask.nii.gz + epoch file."""
+    from brainiak_tpu import io
+    from brainiak_tpu.utils import fmrisim as sim
+
+    rng = np.random.RandomState(0)
+    n_epochs = 2 * epochs_per_cond
+    trs = n_epochs * epoch_len_trs
+    affine = np.diag([3.0, 3.0, 3.0, 1.0])
+
+    # two ROIs; condition 1 couples them, condition 0 leaves them
+    # independent — an activity-matched connectivity difference
+    coords = np.transpose(np.nonzero(np.ones((dim, dim, dim))))
+    roi_a = coords[(coords ** 2).sum(1) < (dim * 0.3) ** 2]
+    corner = coords - np.array([dim - 1, dim - 1, dim - 1])
+    roi_b = coords[(corner ** 2).sum(1) < (dim * 0.3) ** 2]
+
+    stimfunctions = []
+    for s in range(n_subjects):
+        vol = np.zeros((dim, dim, dim, trs), dtype=np.float32)
+        vol += rng.randn(dim, dim, dim, trs).astype(np.float32)
+        for e in range(n_epochs):
+            cond = e % 2
+            t0, t1 = e * epoch_len_trs, (e + 1) * epoch_len_trs
+            driver = rng.randn(epoch_len_trs).astype(np.float32)
+            for vx, vy, vz in roi_a:
+                vol[vx, vy, vz, t0:t1] += 1.5 * driver
+            if cond == 1:
+                for vx, vy, vz in roi_b:
+                    vol[vx, vy, vz, t0:t1] += 1.5 * driver
+            else:
+                other = rng.randn(epoch_len_trs).astype(np.float32)
+                for vx, vy, vz in roi_b:
+                    vol[vx, vy, vz, t0:t1] += 1.5 * other
+        io.save_as_nifti_file(
+            vol, affine,
+            os.path.join(out_dir, f"sub{s:02d}_bet.nii.gz"))
+
+        # per-condition boxcar stimfunctions for the epoch file
+        total_time = int(trs * tr_duration)
+        onsets = {0: [], 1: []}
+        for e in range(n_epochs):
+            onsets[e % 2].append(e * epoch_len_trs * tr_duration)
+        stim = np.hstack([
+            sim.generate_stimfunction(
+                onsets=onsets[c],
+                event_durations=[epoch_len_trs * tr_duration],
+                total_time=total_time)
+            for c in (0, 1)])
+        stimfunctions.append(stim)
+
+    mask = np.ones((dim, dim, dim), dtype=np.int8)
+    io.save_as_nifti_file(mask, affine,
+                          os.path.join(out_dir, "mask.nii.gz"))
+    sim.export_epoch_file(stimfunctions,
+                          os.path.join(out_dir, "epoch_labels.npy"),
+                          tr_duration)
+    return roi_a, roi_b
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default=None)
+    ap.add_argument("--subjects", type=int, default=4)
+    ap.add_argument("--epochs-per-cond", type=int, default=4)
+    ap.add_argument("--epoch-len", type=int, default=16)
+    ap.add_argument("--dim", type=int, default=7)
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--keep", default=None,
+                    help="write the dataset here instead of a tempdir")
+    args = ap.parse_args()
+    import jax
+    if args.backend:
+        jax.config.update("jax_platforms", args.backend)
+
+    from brainiak_tpu import io
+    from brainiak_tpu.fcma.classifier import Classifier
+    from brainiak_tpu.fcma.preprocessing import prepare_fcma_data
+    from brainiak_tpu.fcma.voxelselector import VoxelSelector
+
+    work = args.keep or tempfile.mkdtemp(prefix="fcma_dataset_")
+    os.makedirs(work, exist_ok=True)
+    print(f"dataset directory: {work}")
+    build_dataset(work, args.subjects, args.epochs_per_cond,
+                  args.epoch_len, args.dim)
+    files = sorted(os.listdir(work))
+    print(f"files on disk: {files}")
+
+    # --- the file-based pipeline a reference user runs -------------
+    images = io.load_images_from_dir(work, suffix="bet.nii.gz")
+    mask = io.load_boolean_mask(os.path.join(work, "mask.nii.gz"))
+    conditions = io.load_labels(os.path.join(work, "epoch_labels.npy"))
+    raw, _, labels = prepare_fcma_data(images, conditions, mask)
+    n_epochs = len(labels)
+    epochs_per_subj = n_epochs // args.subjects
+    print(f"epochs: {n_epochs} ({epochs_per_subj}/subject), "
+          f"voxels: {raw[0].shape[1]}")
+
+    # hold one subject out of EVERYTHING (selection included): voxels
+    # chosen using the test subject would leak into the held-out score
+    test_subj = args.subjects - 1
+    test_idx = [i for i in range(n_epochs)
+                if i // epochs_per_subj == test_subj]
+    train_idx = [i for i in range(n_epochs) if i not in test_idx]
+
+    vs = VoxelSelector([labels[i] for i in train_idx], epochs_per_subj,
+                       args.subjects - 1, [raw[i] for i in train_idx],
+                       voxel_unit=64)
+    results = vs.run("svm")
+    top = [vid for vid, _ in results[:args.top]]
+    print(f"top-{args.top} voxel mean CV accuracy: "
+          f"{np.mean([acc for _, acc in results[:args.top]]):.3f}")
+    from sklearn.svm import SVC
+
+    sub = [(raw[i][:, top], raw[i]) for i in range(n_epochs)]
+    clf = Classifier(SVC(kernel="precomputed"),
+                     epochs_per_subj=epochs_per_subj)
+    clf.fit([sub[i] for i in train_idx],
+            [labels[i] for i in train_idx])
+    acc = clf.score([sub[i] for i in test_idx],
+                    [labels[i] for i in test_idx])
+    print(f"held-out-subject classification accuracy: {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

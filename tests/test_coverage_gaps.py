@@ -168,3 +168,153 @@ def test_condition_spec_extract_labels():
         spec[cond, epoch, 2:6] = 1
     labels = spec.view(SingleConditionSpec).extract_labels()
     np.testing.assert_array_equal(labels, [2, 0, 1, 0])
+
+
+# ---- round-3 additions: paths the suite only reached via subprocesses
+
+def test_nifti_qform_affine_roundtrip(tmp_path):
+    """The qform quaternion branch of the own NIfTI codec: a header
+    with qform_code>0 and sform_code=0 reconstructs the rotation from
+    the stored quaternion (NIfTI-1 method 2)."""
+    import gzip
+    import struct
+
+    from brainiak_tpu import nifti
+
+    data = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    path = str(tmp_path / "q.nii")
+    nifti.save(nifti.NiftiImage(data, np.eye(4)), path)
+    raw = bytearray(open(path, "rb").read())
+    # qform_code=1, sform_code=0; quaternion for a 90-degree rotation
+    # about z: (a, b, c, d) = (cos45, 0, 0, sin45)
+    struct.pack_into("<2h", raw, 252, 1, 0)
+    struct.pack_into("<3f", raw, 256, 0.0, 0.0, np.sqrt(0.5))
+    struct.pack_into("<3f", raw, 268, 7.0, 8.0, 9.0)
+    qpath = str(tmp_path / "q2.nii.gz")
+    with gzip.open(qpath, "wb") as f:
+        f.write(bytes(raw))
+    img = nifti.load(qpath)
+    want_rot = np.array([[0.0, -1.0, 0.0],
+                         [1.0, 0.0, 0.0],
+                         [0.0, 0.0, 1.0]])
+    np.testing.assert_allclose(img.affine[:3, :3], want_rot, atol=1e-6)
+    np.testing.assert_allclose(img.affine[:3, 3], [7.0, 8.0, 9.0])
+    np.testing.assert_array_equal(np.asarray(img.dataobj), data)
+
+
+def test_realtime_generator_cli_main(tmp_path, monkeypatch):
+    """The argparse entry point (the package's one CLI, reference
+    fmrisim_real_time_generator.py:536-601) runs in-process."""
+    import sys as _sys
+
+    from brainiak_tpu.utils import fmrisim_real_time_generator as rtg
+
+    out_dir = str(tmp_path / "rt")
+    monkeypatch.setattr(_sys, "argv", [
+        "fmrisim_real_time_generator", "-o", out_dir,
+        "--numTRs", "12", "--event-duration", "4", "--isi", "2",
+        "--burn-in", "2", "--trDuration", "2"])
+    rtg.main()
+    vols = [f for f in os.listdir(out_dir) if f.startswith("rt_")]
+    assert len(vols) == 12
+    labels = np.load(os.path.join(out_dir, "labels.npy"))
+    assert labels.shape[0] == 12
+
+
+def test_realtime_generator_dicom_requires_pydicom(tmp_path):
+    """Without pydicom the save_dicom path must fail loudly, not write
+    garbage."""
+    import importlib.util
+
+    import pytest as _pytest
+
+    from brainiak_tpu.utils import fmrisim_real_time_generator as rtg
+
+    if importlib.util.find_spec("pydicom") is not None:
+        _pytest.skip("pydicom installed; error path not reachable")
+    with _pytest.raises(ImportError, match="pydicom"):
+        rtg._save_volume(np.zeros((4, 4, 4)),
+                         str(tmp_path / "v.dcm"), save_dicom=True)
+
+
+def test_fmrisim_temporal_noise_components():
+    """physiological + task temporal components mix into the noise
+    volume (reference fmrisim.py:1782-1906)."""
+    from brainiak_tpu.utils import fmrisim as sim
+
+    np.random.seed(0)
+    dims = np.array([6, 6, 6])
+    mask, template = sim.mask_brain(dims, mask_self=False)
+    stim = np.zeros(20)
+    stim[5:10] = 1.0
+    nd = sim._noise_dict_update({
+        "physiological_sigma": 1.0, "task_sigma": 1.0,
+        "auto_reg_sigma": 1.0, "drift_sigma": 1.0})
+    noise = sim._generate_noise_temporal(stim, 2.0, dims, template,
+                                         mask, nd)
+    assert noise.shape == (6, 6, 6, 20)
+    assert np.isfinite(noise).all() and noise.std() > 0
+
+
+def test_fmrisim_fit_temporal_iterates():
+    """The SFNR fitting loop converges (or clamps) rather than running
+    away (reference fmrisim.py:2613-2831)."""
+    from brainiak_tpu.utils import fmrisim as sim
+
+    np.random.seed(1)
+    dims = np.array([8, 8, 8])
+    mask, template = sim.mask_brain(dims, mask_self=False)
+    trs = 15
+    stim = np.zeros(trs)
+    nd = sim._noise_dict_update({"sfnr": 50, "snr": 30, "matched": 1})
+    noise = np.random.randn(8, 8, 8, trs) + \
+        (template * nd["max_activity"])[..., None]
+    drift = np.zeros((8, 8, 8, trs))
+    fitted = sim._fit_temporal(
+        noise, mask, template, stim, 2.0, spatial_sd=5.0,
+        temporal_proportion=0.5, temporal_sd=10.0, drift_noise=drift,
+        noise_dict=nd, fit_thresh=0.05, fit_delta=0.5, iterations=3)
+    assert fitted.shape == noise.shape
+    assert np.isfinite(fitted).all()
+
+
+def test_fmrisim_rf_responses_direct():
+    """generate_1d_rf_responses end-to-end in-process (the examples
+    exercise it only in subprocesses)."""
+    from brainiak_tpu.utils import fmrisim as sim
+
+    np.random.seed(2)
+    rfs, tuning = sim.generate_1d_gaussian_rfs(
+        10, 180, (0, 179), rf_size=20, random_tuning=False)
+    resp = sim.generate_1d_rf_responses(
+        rfs, np.array([0.0, 45.0, 90.0]), 180, (0, 179),
+        trial_noise=0.05)
+    assert resp.shape == (10, 3)
+    assert np.isfinite(resp).all()
+    # evenly-spaced non-random tuning: each trial drives the voxel
+    # tuned nearest to it hardest (up to the noise floor)
+    for t, stim in enumerate([0.0, 45.0, 90.0]):
+        best_voxel = int(np.argmax(resp[:, t]))
+        assert abs(tuning[best_voxel] - stim) <= \
+            np.min(np.abs(np.asarray(tuning) - stim)) + 18
+
+
+def test_iem2d_param_validation_and_get_params():
+    from brainiak_tpu.reconstruct.iem import InvertedEncoding2D
+
+    model = InvertedEncoding2D(stim_xlim=[-5, 5], stim_ylim=[-5, 5],
+                               stimulus_resolution=10)
+    model.define_basis_functions_sqgrid(4)
+    params = model.get_params()
+    assert params["channels"] is not None
+    assert params["xp"].shape == (10, 10)  # the pixel meshgrid
+    # channel/pixel mismatch must fail loudly at fit time
+    import pytest as _pytest
+
+    model2 = InvertedEncoding2D(stim_xlim=[-5, 5], stim_ylim=[-5, 5],
+                                stimulus_resolution=10, stim_radius=1.0)
+    model2.define_basis_functions_sqgrid(4)
+    model2.channels = model2.channels[:, :50]
+    with _pytest.raises(ValueError, match="pixels"):
+        model2.fit(np.random.randn(20, 8),
+                   np.random.rand(20, 2) * 4 - 2)

@@ -71,6 +71,18 @@ def test_example_iem():
     assert "median circular error" in out
 
 
+def test_example_fcma_file_workflow(tmp_path):
+    out = _run("fcma_file_workflow.py", "--subjects", "3",
+               "--epochs-per-cond", "3", "--epoch-len", "12",
+               "--dim", "6", "--top", "10", "--keep", str(tmp_path))
+    assert "files on disk" in out
+    assert "held-out-subject classification accuracy" in out
+    # the dataset really was written in the reference layout
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert "epoch_labels.npy" in files and "mask.nii.gz" in files
+    assert any(f.endswith("bet.nii.gz") for f in files)
+
+
 def test_example_iem_synthetic_rf():
     out = _run("iem_synthetic_rf.py", "--voxels", "40", "--trials", "80")
     assert "channel peaks" in out

@@ -93,6 +93,22 @@ def test_generate_stimfunction_and_convolve(tmp_path):
     assert epochs[0].shape[0] == 2  # conditions
     assert epochs[0].shape[1] >= 5  # epochs
 
+    # same-shaped subjects must save as a PLAIN array readable by
+    # io.load_labels (allow_pickle=False, as in the reference io.py:148)
+    # — regression: dtype=object was forced unconditionally once
+    from brainiak_tpu.io import load_labels
+    specs = load_labels(epoch_path)
+    assert len(specs) == 2
+    assert specs[0].shape == epochs[0].shape
+
+    # genuinely ragged subjects still export (pickled object form)
+    ragged = [np.hstack((cond_a, cond_b)),
+              np.hstack((cond_a[:5500], cond_b[:5500]))]
+    ragged_path = str(tmp_path / "epochs_ragged.npy")
+    sim.export_epoch_file(ragged, ragged_path, 2)
+    loaded = np.load(ragged_path, allow_pickle=True)
+    assert len(loaded) == 2 and loaded[0].shape != loaded[1].shape
+
 
 def test_apply_signal_and_compute_signal_change():
     np.random.seed(0)
