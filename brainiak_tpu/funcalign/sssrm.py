@@ -189,10 +189,10 @@ class SSSRM(BaseEstimator, ClassifierMixin, TransformerMixin):
     def _update_classifier(self, data, labels, w, n_classes):
         data_stacked, labels_stacked, weights = self._stack_list(
             data, labels, w)
-        theta, bias = _fit_mlr(jnp.asarray(data_stacked),
+        data_j = jnp.asarray(data_stacked)
+        theta, bias = _fit_mlr(data_j,
                                jnp.asarray(labels_stacked),
-                               jnp.asarray(weights,
-                                           dtype=data_stacked.dtype),
+                               jnp.asarray(weights, dtype=data_j.dtype),
                                self.alpha / self.gamma, n_classes)
         return np.asarray(theta), np.asarray(bias)
 

@@ -95,6 +95,23 @@ def test_example_matnormal():
     assert "MNRSA similarity recovery" in out
 
 
+def test_example_searchlight():
+    out = _run("searchlight_decoding.py", "--dim", "12", "--ntr", "60")
+    assert "traced tier: peak" in out
+    assert "host tier" in out
+
+
+def test_example_hpo():
+    out = _run("hpo_branin.py", "--max-evals", "60")
+    assert "hpo best" in out and "grid best" in out
+
+
+def test_example_funcalign_variants():
+    out = _run("funcalign_variants.py", "--subjects", "4", "--voxels",
+               "100", "--trs", "80")
+    assert "RSRM" in out and "SSSRM" in out and "FastSRM" in out
+
+
 def test_example_fmrisim():
     out = _run("fmrisim_noise_simulation.py", "--trs", "40")
     assert "round-trip SFNR" in out
