@@ -231,7 +231,10 @@ def main():
 
     backend = jax.default_backend()
     print(f"backend: {backend}", file=sys.stderr)
+    import datetime
     out = {"backend": backend,
+           "ts": datetime.datetime.now(datetime.timezone.utc)
+                 .isoformat(timespec="seconds"),
            "peak_bf16_tflops": PEAK_BF16 / 1e12,
            "peak_fp32_highest_tflops": round(PEAK_FP32_HIGHEST / 1e12,
                                              1)}

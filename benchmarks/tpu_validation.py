@@ -172,9 +172,14 @@ def end_to_end(n_voxels=N_VOXELS, unit=512):
 
 
 def main():
+    import datetime
+
     import jax
     backend = jax.default_backend()
-    out = {"backend": backend, "n_voxels": N_VOXELS, "n_trs": N_TRS,
+    out = {"backend": backend,
+           "ts": datetime.datetime.now(datetime.timezone.utc)
+                 .isoformat(timespec="seconds"),
+           "n_voxels": N_VOXELS, "n_trs": N_TRS,
            "n_epochs": N_EPOCHS}
     print(f"backend: {backend}", file=sys.stderr)
     out["kernels"] = kernel_parity_and_throughput()

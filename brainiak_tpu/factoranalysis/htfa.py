@@ -317,7 +317,12 @@ class HTFA(TFA):
         x, cost = _batched_subject_step(
             *batch, *tmpl, K=self.K, n_dim=self.n_dim,
             nlss_loss=self.nlss_loss, max_iters=self.lbfgs_iters)
-        return np.asarray(x)[:S], np.asarray(cost)[:S]
+        # every process needs all subjects' posteriors for the (host,
+        # replicated) MAP template update — the analog of the
+        # reference's Gatherv+Bcast (htfa.py:746-764)
+        from ..parallel.mesh import fetch_replicated
+        return (fetch_replicated(x, self.mesh)[:S],
+                fetch_replicated(cost, self.mesh)[:S])
 
     def _match_to_prior(self, prior_vec, posterior_vec):
         """Hungarian-match one subject's posterior factors to its prior

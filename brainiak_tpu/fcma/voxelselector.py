@@ -354,6 +354,10 @@ class VoxelSelector:
         results = []
         if block_accs and on_device_svm:
             all_kernels = jnp.concatenate([k for _, _, k in block_accs])
+            # svm_cv_accuracy fetches replicated: in a multi-process
+            # run every process gets the full per-voxel scores (the
+            # analog of the reference's MPI score gather,
+            # voxelselector.py:208-238)
             all_accs, gaps = svm_cv_accuracy(
                 all_kernels, self.labels, self.num_folds, C=self.svm_C,
                 n_iters=self.svm_iters, return_gap=True)

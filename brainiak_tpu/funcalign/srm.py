@@ -505,11 +505,19 @@ class SRM(_SRMBase):
         """Persist the fitted model as .npz (srm.py:451-481)."""
         if not hasattr(self, 'w_'):
             raise NotFittedError("The model fit has not been run yet.")
-        w_arr = np.empty(len(self.w_), dtype=object)
-        mu_arr = np.empty(len(self.mu_), dtype=object)
-        for i in range(len(self.w_)):
-            w_arr[i] = self.w_[i]
-            mu_arr[i] = self.mu_[i]
+        if len({w.shape for w in self.w_}) == 1:
+            # uniform voxel counts: save plain stacked arrays so the
+            # file is readable WITHOUT allow_pickle — the reference's
+            # load() (srm.py:126) calls np.load with pickle disabled,
+            # and this is exactly what its own save() produces
+            w_arr = np.stack(self.w_)
+            mu_arr = np.stack(self.mu_)
+        else:
+            w_arr = np.empty(len(self.w_), dtype=object)
+            mu_arr = np.empty(len(self.mu_), dtype=object)
+            for i in range(len(self.w_)):
+                w_arr[i] = self.w_[i]
+                mu_arr[i] = self.mu_[i]
         np.savez_compressed(
             file,
             w_=w_arr,

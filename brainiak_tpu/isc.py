@@ -28,7 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 from scipy.spatial.distance import squareform
 
-from .parallel.mesh import DEFAULT_VOXEL_AXIS
+from .parallel.mesh import DEFAULT_VOXEL_AXIS, fetch_replicated
 from .utils.utils import _check_timeseries_input, p_from_null
 
 __all__ = [
@@ -269,13 +269,15 @@ def isc(data, pairwise=False, summary_statistic=None, tolerate_nans=True,
         iscs_stack = array_correlation(data[..., 0],
                                        data[..., 1])[np.newaxis, :]
     elif pairwise:
-        corr = np.asarray(
-            _isc_pairwise_core(_shard_voxels(data, mesh, 1)))[..., :n_kept]
+        corr = fetch_replicated(
+            _isc_pairwise_core(_shard_voxels(data, mesh, 1)),
+            mesh)[..., :n_kept]
         iu = np.triu_indices(n_subjects, k=1)
         iscs_stack = corr[iu[0], iu[1], :]
     else:
-        iscs_stack = np.asarray(_isc_loo_core(
-            _shard_voxels(data, mesh, 1), bool(tolerate_nans)))[:, :n_kept]
+        iscs_stack = fetch_replicated(_isc_loo_core(
+            _shard_voxels(data, mesh, 1), bool(tolerate_nans)),
+            mesh)[:, :n_kept]
 
     iscs = np.full((iscs_stack.shape[0], n_voxels), np.nan)
     iscs[:, np.where(mask)[0]] = iscs_stack
@@ -597,13 +599,13 @@ def bootstrap_isc(iscs, pairwise=False, summary_statistic='median',
         jax.random.PRNGKey(_resolve_seed(random_state)), n_bootstraps)
     if pairwise:
         iu = np.triu_indices(n_subjects, k=1)
-        distribution = np.asarray(_boot_pairwise_map(
+        distribution = fetch_replicated(_boot_pairwise_map(
             sq_j, keys, jnp.asarray(iu[0]), jnp.asarray(iu[1]),
-            summary_statistic, null_batch_size))[:, :n_voxels]
+            summary_statistic, null_batch_size), mesh)[:, :n_voxels]
     else:
-        distribution = np.asarray(_boot_loo_map(
+        distribution = fetch_replicated(_boot_loo_map(
             iscs_j, keys, summary_statistic,
-            null_batch_size))[:, :n_voxels]
+            null_batch_size), mesh)[:, :n_voxels]
 
     ci = (np.percentile(distribution, (100 - ci_percentile) / 2, axis=0),
           np.percentile(distribution,
@@ -665,14 +667,14 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
                 n_permutations)
         if pairwise:
             iu = np.triu_indices(n_subjects, k=1)
-            distribution = np.asarray(_perm_flip_pairwise_map(
+            distribution = fetch_replicated(_perm_flip_pairwise_map(
                 iscs_j, xs, jnp.asarray(iu[0]), jnp.asarray(iu[1]),
                 summary_statistic, null_batch_size, not exact,
-                n_subjects))[:, :n_voxels]
+                n_subjects), mesh)[:, :n_voxels]
         else:
-            distribution = np.asarray(_perm_flip_loo_map(
+            distribution = fetch_replicated(_perm_flip_loo_map(
                 iscs_j, xs, summary_statistic, null_batch_size,
-                not exact, n_subjects))[:, :n_voxels]
+                not exact, n_subjects), mesh)[:, :n_voxels]
     else:
         group_selector = np.asarray(group_assignment)
         labels_j = jnp.asarray(labels.astype(float))
@@ -694,23 +696,24 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
             np.fill_diagonal(sq_labels, np.nan)
             pair_labels = squareform(sq_labels, checks=False)
 
-            observed = np.asarray(_group_diff_stat(
+            observed = fetch_replicated(_group_diff_stat(
                 iscs_j, jnp.asarray(pair_labels), labels_j,
-                summary_statistic))[:n_voxels]
+                summary_statistic), mesh)[:n_voxels]
 
             iu = np.triu_indices(n_subjects, k=1)
-            distribution = np.asarray(_perm_group_pairwise_map(
+            distribution = fetch_replicated(_perm_group_pairwise_map(
                 iscs_j, jnp.asarray(sq_labels), labels_j,
                 jnp.asarray(iu[0]), jnp.asarray(iu[1]), xs,
                 summary_statistic, null_batch_size,
-                not exact))[:, :n_voxels]
+                not exact), mesh)[:, :n_voxels]
         else:
             sel_j = jnp.asarray(group_selector)
-            observed = np.asarray(_group_diff_stat(
-                iscs_j, sel_j, labels_j, summary_statistic))[:n_voxels]
-            distribution = np.asarray(_perm_group_loo_map(
+            observed = fetch_replicated(_group_diff_stat(
+                iscs_j, sel_j, labels_j, summary_statistic),
+                mesh)[:n_voxels]
+            distribution = fetch_replicated(_perm_group_loo_map(
                 iscs_j, sel_j, labels_j, xs, summary_statistic,
-                null_batch_size, not exact))[:, :n_voxels]
+                null_batch_size, not exact), mesh)[:, :n_voxels]
 
     p = p_from_null(observed, distribution, side=side, exact=exact, axis=0)
     return observed, p, distribution
@@ -741,9 +744,10 @@ def timeshift_isc(data, pairwise=False, summary_statistic='median',
     others = data_j if pairwise else _loo_means_core(data_j, tol)
     keys = jax.random.split(jax.random.PRNGKey(_resolve_seed(random_state)),
                             n_shifts)
-    distribution = np.asarray(_timeshift_map(
+    distribution = fetch_replicated(_timeshift_map(
         data_j, others, keys, jnp.asarray(iu[0]), jnp.asarray(iu[1]),
-        summary_statistic, null_batch_size, bool(pairwise)))[:, :n_kept]
+        summary_statistic, null_batch_size, bool(pairwise)),
+        mesh)[:, :n_kept]
 
     observed, distribution = _reinsert_nan_voxels(
         observed, distribution, mask, n_voxels)
@@ -775,10 +779,10 @@ def phaseshift_isc(data, pairwise=False, summary_statistic='median',
     others = data_j if pairwise else _loo_means_core(data_j, tol)
     keys = jax.random.split(jax.random.PRNGKey(_resolve_seed(random_state)),
                             n_shifts)
-    distribution = np.asarray(_phaseshift_map(
+    distribution = fetch_replicated(_phaseshift_map(
         data_j, others, keys, jnp.asarray(iu[0]), jnp.asarray(iu[1]),
         summary_statistic, null_batch_size, bool(pairwise),
-        bool(voxelwise)))[:, :n_kept]
+        bool(voxelwise)), mesh)[:, :n_kept]
 
     observed, distribution = _reinsert_nan_voxels(
         observed, distribution, mask, n_voxels)

@@ -398,6 +398,10 @@ def svm_cv_accuracy(kernels, labels, num_folds, C=1.0, n_iters=50,
                  for s in range(0, kernels.shape[0], chunk)]
         accs = jnp.concatenate([a for a, _ in parts])
         gaps = jnp.concatenate([g for _, g in parts])
+    # fetch_replicated: a mesh-sharded kernels batch in a multi-process
+    # run yields cross-process-sharded outputs that np.asarray cannot
+    # read; replicate them first (no-op single-process)
+    from ..parallel.mesh import fetch_replicated
     if return_gap:
-        return np.asarray(accs), np.asarray(gaps)
-    return np.asarray(accs)
+        return fetch_replicated(accs), fetch_replicated(gaps)
+    return fetch_replicated(accs)

@@ -16,6 +16,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 __all__ = [
     "DEFAULT_SUBJECT_AXIS",
     "DEFAULT_VOXEL_AXIS",
+    "fetch_replicated",
     "initialize_distributed",
     "make_mesh",
     "max_divisible_shards",
@@ -41,6 +42,31 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id)
+
+
+def fetch_replicated(x, mesh: Optional[Mesh] = None):
+    """Host-fetch a possibly mesh-sharded array as a full numpy array on
+    EVERY process — the analog of the reference's MPI gather of results
+    to all ranks (e.g. voxel scores in fcma, reference
+    voxelselector.py:208-238).
+
+    Single-process (every shard addressable): a plain ``np.asarray``.
+    Multi-process: relayout to a replicated sharding first (one
+    all-gather over ICI/DCN), because indexing or ``np.asarray`` on a
+    cross-process-sharded array raises.  Results in this framework are
+    small (per-voxel scalars, factor parameters), so replication is
+    cheap relative to the compute that produced them.
+    """
+    if mesh is None and isinstance(x, jax.Array) \
+            and not x.is_fully_addressable:
+        mesh = x.sharding.mesh
+    if mesh is None or jax.process_count() == 1:
+        return np.asarray(x)
+    rep = jax.jit(
+        lambda a: a,
+        out_shardings=jax.tree.map(
+            lambda _: NamedSharding(mesh, PartitionSpec()), x))(x)
+    return np.asarray(rep)
 
 
 def max_divisible_shards(axis_length: int, devices=None) -> int:

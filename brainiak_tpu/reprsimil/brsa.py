@@ -466,15 +466,15 @@ class BRSA(BaseEstimator, TransformerMixin):
     """
 
     def __init__(self, n_iter=2, rank=None, auto_nuisance=True,
-                 n_nureg=6, nureg_zscore=True, nureg_method='PCA',
+                 n_nureg=None, nureg_zscore=True, nureg_method='PCA',
                  baseline_single=False, GP_space=False, GP_inten=False,
                  space_smooth_range=None, inten_smooth_range=None,
                  tau_range=5.0, tau2_prior=prior_GP_var_inv_gamma,
                  eta=0.0001, random_state=None, anneal_speed=10,
                  lbfgs_iters=200, tol=1e-4):
-        if nureg_method != 'PCA':
-            raise NotImplementedError(
-                "only nureg_method='PCA' is supported")
+        if nureg_method not in ('PCA', 'FA', 'ICA', 'SPCA'):
+            raise ValueError('nureg_method can only be FA, PCA, '
+                             'SPCA(for sparse PCA) or ICA')
         self.n_iter = n_iter
         self.rank = rank
         self.auto_nuisance = auto_nuisance
@@ -682,7 +682,11 @@ class BRSA(BaseEstimator, TransformerMixin):
         """Shared auto-nuisance recipe (reference brsa.py:757-776):
         optionally z-score the residuals, auto-select the component count
         by Gavish-Donoho when n_nureg is None, and return std-normalized
-        principal components."""
+        components from the configured sklearn decomposition (reference
+        brsa.py:546-558: FA / whitened PCA / SparsePCA / FastICA).
+        These run on host once per outer round — same as the reference's
+        CPU sklearn calls — while the marginal-likelihood optimization
+        stays on device."""
         n_t, n_v = resid.shape
         if self.nureg_zscore:
             resid = (resid - resid.mean(0)) / (resid.std(0) + 1e-12)
@@ -691,7 +695,24 @@ class BRSA(BaseEstimator, TransformerMixin):
             n_nureg = max(Ncomp_SVHT_MG_DLD_approx(
                 resid, zscore=False), 1)
         n_comp = min(n_nureg, n_v - 1, n_t - 1)
-        comps = PCA(n_components=n_comp).fit_transform(resid)
+        if self.nureg_method == 'FA':
+            from sklearn.decomposition import FactorAnalysis
+            est = FactorAnalysis(n_components=n_comp)
+        elif self.nureg_method == 'SPCA':
+            from sklearn.decomposition import SparsePCA
+            est = SparsePCA(n_components=n_comp, max_iter=20,
+                            tol=self.tol,
+                            random_state=getattr(
+                                self, 'random_state_', None))
+        elif self.nureg_method == 'ICA':
+            from sklearn.decomposition import FastICA
+            est = FastICA(n_components=n_comp,
+                          whiten='unit-variance',
+                          random_state=getattr(
+                              self, 'random_state_', None))
+        else:
+            est = PCA(n_components=n_comp)
+        comps = est.fit_transform(resid)
         return comps / (comps.std(0) + 1e-12)
 
     def _fit_once(self, data, design, X0, scan_starts, n_runs, n_c, rank,
@@ -868,7 +889,7 @@ class GBRSA(BRSA):
     """
 
     def __init__(self, n_iter=2, rank=None, auto_nuisance=True,
-                 n_nureg=6, nureg_zscore=True, nureg_method='PCA',
+                 n_nureg=None, nureg_zscore=True, nureg_method='PCA',
                  baseline_single=False, logS_range=1.0, SNR_prior='exp',
                  SNR_bins=11, rho_bins=10, random_state=None,
                  anneal_speed=10, lbfgs_iters=200, tol=1e-4, mesh=None):
@@ -954,7 +975,19 @@ class GBRSA(BRSA):
             X0 = np.column_stack(cols)
             Q, _ = np.linalg.qr(X0)
             x_proj = x - Q @ (Q.T @ x)
-            return (x_proj, d, starts, len(onsets)), (x, X0, onsets)
+            # Project X0 out of the DESIGN as well: profiling beta0
+            # under a flat prior (what the reference's X0TAX0 solves do,
+            # reference brsa.py:2160-2189) residualizes y AND X against
+            # X0.  Leaving the design unprojected forces the grid
+            # likelihood to explain the removed X0 span with task betas,
+            # which biases off-diagonal U toward spurious negative
+            # values (measured r4: across-block C_ of -0.8 vs the
+            # reference's -0.2 on shared data).  The identity-metric
+            # projection is exact at rho=0 and a documented
+            # approximation otherwise.
+            d_proj = d - Q @ (Q.T @ d)
+            return (x_proj, d_proj, starts, len(onsets)), \
+                (x, d, X0, onsets)
 
         built = [build_subject(s) for s in range(n_subj)]
         subj_data = [b[0] for b in built]
@@ -1065,7 +1098,8 @@ class GBRSA(BRSA):
         self._X0_list = []
         self._X0_null_list = []
         self._design_list = []
-        for s_idx, ((x, d, starts, n_runs), (raw, X0, onsets)) in \
+        for s_idx, ((x, d, starts, n_runs),
+                    (raw, raw_d, X0, onsets)) in \
                 enumerate(zip(subj_data, subj_aux)):
             snr_v, rho_v, sig_v, beta_v = self._grid_posteriors(
                 x, d, starts, n_runs, L, snr_grid, rho_grid,
@@ -1074,14 +1108,17 @@ class GBRSA(BRSA):
             self.rho_.append(rho_v)
             self.sigma_.append(sig_v)
             self.beta_.append(beta_v)
+            # beta0 against the RAW design: the X0-span part of the
+            # task response (removed from d for fitting) belongs to
+            # beta0, matching score()'s `design @ beta` subtraction
             self.beta0_.append(np.linalg.lstsq(
-                X0, raw - d @ beta_v, rcond=None)[0])
+                X0, raw - raw_d @ beta_v, rcond=None)[0])
             X0n, beta0n = self._fit_null_nuisance(
                 raw, raw.shape[0], onsets, subject_nuisance(s_idx))
             self.beta0_null_.append(beta0n)
             self._X0_list.append(X0)
             self._X0_null_list.append(X0n)
-            self._design_list.append(d)
+            self._design_list.append(raw_d)
         if n_subj == 1:
             (self.nSNR_, self.rho_, self.sigma_, self.beta_,
              self.beta0_, self.beta0_null_) = (

@@ -399,10 +399,16 @@ def mask_brain(volume, template_name=None, mask_threshold=None,
         mask_raw = volume
     elif template_name is not None:
         mask_raw = np.load(template_name)
-    elif volume.ndim >= 3:
-        mask_raw = _load_packaged_template()
     else:
-        mask_raw = _synthetic_brain_template(volume.shape[:3])
+        if volume.ndim < 3:
+            # the packaged template is 3-D and the zoom below maps it
+            # onto volume.shape[:3]; a 2-D volume has no meaningful
+            # target shape (the reference unconditionally loads its
+            # 3-D atlas and would fail the same way, just later)
+            raise ValueError(
+                "mask_brain with mask_self=False and no template_name "
+                f"requires a >=3-D volume, got shape {volume.shape}")
+        mask_raw = _load_packaged_template()
 
     if mask_raw.ndim == 4:
         mask_raw = mask_raw[..., 0] if mask_raw.shape[3] == 1 \

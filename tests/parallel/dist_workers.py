@@ -78,3 +78,70 @@ def failing_worker(process_id, num_processes):
     # global reduction requires the dead peer -> blocks until killed
     total = jax.jit(lambda x: jnp.sum(x))(arr)
     return float(total)
+
+
+def voxelselector_worker(process_id, num_processes):
+    """FCMA voxel selection with the voxel axis sharded across the
+    2-process cluster (the analog of the reference's MPI voxel-block
+    task farm, reference voxelselector.py:89-238)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from brainiak_tpu.fcma.voxelselector import VoxelSelector
+
+    mesh = Mesh(np.array(jax.devices()), ("voxel",))
+    n_e, n_t, n_v = 8, 20, 32
+    rng = np.random.RandomState(5)
+    raw = []
+    for _ in range(n_e):
+        mat = rng.randn(n_t, n_v).astype(np.float64)
+        mat = (mat - mat.mean(0)) / (mat.std(0) * np.sqrt(n_t))
+        raw.append(mat)
+    vs = VoxelSelector([0, 1] * (n_e // 2), n_e // 2, 2, raw,
+                       voxel_unit=8, mesh=mesh, use_pallas=False)
+    return vs.run('svm')
+
+
+def bootstrap_isc_worker(process_id, num_processes):
+    """ISC + bootstrap null with voxels sharded across processes
+    (the analog of distributing the reference's per-voxel resampling
+    loops)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from brainiak_tpu.isc import bootstrap_isc, isc
+
+    mesh = Mesh(np.array(jax.devices()), ("voxel",))
+    rng = np.random.RandomState(6)
+    ts = rng.randn(30, 16, 6)
+    iscs = isc(ts, mesh=mesh)
+    observed, ci, p, distribution = bootstrap_isc(
+        iscs, n_bootstraps=12, mesh=mesh, null_batch_size=4,
+        random_state=0)
+    return (np.asarray(iscs), np.asarray(observed), np.asarray(p),
+            np.asarray(distribution))
+
+
+def htfa_worker(process_id, num_processes):
+    """HTFA with the subject axis sharded across processes (the analog
+    of the reference's hierarchical MPI gather/broadcast,
+    htfa.py:672-764)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from brainiak_tpu.factoranalysis.htfa import HTFA
+
+    mesh = Mesh(np.array(jax.devices()), ("subject",))
+    rng = np.random.RandomState(7)
+    n_subj = 3  # does not divide 4 devices: pad lanes cross processes
+    R_coords = rng.rand(40, 3) * 10.0
+    true_c = np.array([[2.0, 2.0, 2.0], [8.0, 8.0, 8.0]])
+    F = np.exp(-((R_coords[:, None, :] - true_c[None]) ** 2).sum(-1)
+               / 4.0)
+    X = [np.asarray(F @ rng.randn(2, 12) + 0.05 * rng.randn(40, 12))
+         for _ in range(n_subj)]
+    htfa = HTFA(K=2, n_subj=n_subj, max_global_iter=2,
+                max_local_iter=2, voxel_ratio=1.0, tr_ratio=1.0,
+                max_voxel=40, max_tr=12, mesh=mesh)
+    htfa.fit(X, [R_coords] * n_subj)
+    return np.asarray(htfa.global_posterior_)

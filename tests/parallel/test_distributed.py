@@ -66,3 +66,90 @@ def test_distributed_fast_failure_reporting():
                         n_procs=2, local_devices=1, timeout=180,
                         extra_path=REPO_ROOT)
     assert time.time() - t0 < 60  # far less than the 180s timeout
+
+
+def test_distributed_voxelselector_matches_single_process():
+    """The sharded FCMA engine produces identical voxel rankings and
+    accuracies across process boundaries (VERDICT r3 item 7 — the
+    analog of the reference's mpiexec-marked FCMA tests)."""
+    results = run_distributed("tests.parallel.dist_workers",
+                              "voxelselector_worker",
+                              n_procs=2, local_devices=2, x64=_x64(),
+                              extra_path=REPO_ROOT)
+    # both processes return the full gathered ranking and agree exactly
+    assert results[0] == results[1]
+
+    single = _single_process_voxelselector()
+    dist = dict(results[0])
+    assert set(dist) == set(single)
+    for v, acc in single.items():
+        assert abs(dist[v] - acc) <= 0.51 / 8, (v, dist[v], acc)
+
+
+def _single_process_voxelselector():
+    from brainiak_tpu.fcma.voxelselector import VoxelSelector
+
+    n_e, n_t, n_v = 8, 20, 32
+    rng = np.random.RandomState(5)
+    raw = []
+    for _ in range(n_e):
+        mat = rng.randn(n_t, n_v).astype(np.float64)
+        mat = (mat - mat.mean(0)) / (mat.std(0) * np.sqrt(n_t))
+        raw.append(mat)
+    vs = VoxelSelector([0, 1] * (n_e // 2), n_e // 2, 2, raw,
+                       voxel_unit=8, use_pallas=False)
+    return dict(vs.run('svm'))
+
+
+def test_distributed_bootstrap_isc_matches_single_process():
+    results = run_distributed("tests.parallel.dist_workers",
+                              "bootstrap_isc_worker",
+                              n_procs=2, local_devices=2, x64=_x64(),
+                              extra_path=REPO_ROOT)
+    iscs_d, observed_d, p_d, dist_d = results[0]
+    iscs_d1, observed_d1, p_d1, dist_d1 = results[1]
+    np.testing.assert_array_equal(iscs_d, iscs_d1)
+    np.testing.assert_array_equal(dist_d, dist_d1)
+
+    from brainiak_tpu.isc import bootstrap_isc, isc
+
+    rng = np.random.RandomState(6)
+    ts = rng.randn(30, 16, 6)
+    iscs = isc(ts)
+    observed, ci, p, distribution = bootstrap_isc(
+        iscs, n_bootstraps=12, null_batch_size=4, random_state=0)
+    atol = mesh_atol()
+    np.testing.assert_allclose(iscs_d, np.asarray(iscs), atol=atol)
+    np.testing.assert_allclose(observed_d, np.asarray(observed),
+                               atol=atol)
+    np.testing.assert_allclose(dist_d, np.asarray(distribution),
+                               atol=atol)
+    np.testing.assert_allclose(p_d, np.asarray(p), atol=atol)
+
+
+def test_distributed_htfa_matches_single_process():
+    results = run_distributed("tests.parallel.dist_workers",
+                              "htfa_worker",
+                              n_procs=2, local_devices=2, x64=_x64(),
+                              timeout=480, extra_path=REPO_ROOT)
+    np.testing.assert_allclose(results[0], results[1], atol=1e-12)
+
+    from brainiak_tpu.factoranalysis.htfa import HTFA
+
+    rng = np.random.RandomState(7)
+    n_subj = 3
+    R_coords = rng.rand(40, 3) * 10.0
+    true_c = np.array([[2.0, 2.0, 2.0], [8.0, 8.0, 8.0]])
+    F = np.exp(-((R_coords[:, None, :] - true_c[None]) ** 2).sum(-1)
+               / 4.0)
+    X = [np.asarray(F @ rng.randn(2, 12) + 0.05 * rng.randn(40, 12))
+         for _ in range(n_subj)]
+    htfa = HTFA(K=2, n_subj=n_subj, max_global_iter=2,
+                max_local_iter=2, voxel_ratio=1.0, tr_ratio=1.0,
+                max_voxel=40, max_tr=12)
+    htfa.fit(X, [R_coords] * n_subj)
+    # distributed optimization follows the same trajectory up to
+    # cross-shard reduction-order noise amplified by L-BFGS steps
+    np.testing.assert_allclose(results[0],
+                               np.asarray(htfa.global_posterior_),
+                               atol=1e-3)

@@ -1,0 +1,135 @@
+"""Live-oracle parity for BRSA / GBRSA (round-3 verdict item 6).
+
+The reference ``brainiak.reprsimil.brsa`` (its largest module, 4.2k
+LoC of hand-derived gradients) runs LIVE through the ~15-line
+Yule-Walker nitime stand-in in conftest.py — everything else it needs
+is installed here.
+
+The two implementations optimize different surfaces with different
+budgets (reference: n_iter alternating fitU/fitV coordinate rounds;
+repo: outer auto-nuisance rounds around a joint L-BFGS — see
+docs/migration.md), so the comparison is estimator-level on shared
+synthetic data with known structure: recovered condition similarity
+C_/U_ and the voxelwise pseudo-SNR ordering must agree between
+implementations and with the ground truth.
+"""
+
+import numpy as np
+import pytest
+from scipy.ndimage import gaussian_filter1d
+from scipy.stats import spearmanr
+
+from brainiak_tpu.reprsimil.brsa import BRSA as OurBRSA, GBRSA as OurGBRSA
+
+
+@pytest.fixture(scope="module")
+def ref_brsa_mod(reference):
+    import importlib
+    return importlib.import_module("brainiak.reprsimil.brsa")
+
+
+def _brsa_data(seed=0, n_t=120, n_v=30, n_c=4, snr_lo=0.3, snr_hi=1.5):
+    """Event design smoothed to an HRF-ish shape, betas drawn with a
+    known condition covariance, AR(1) noise, and a voxelwise SNR ramp."""
+    rng = np.random.RandomState(seed)
+    design = np.zeros((n_t, n_c))
+    for c in range(n_c):
+        onsets = np.arange(6 + 3 * c, n_t - 8, 29)
+        for o in onsets:
+            design[o:o + 4, c] = 1.0
+    design = gaussian_filter1d(design, 2.0, axis=0)
+
+    u_true = np.array([[1.0, 0.7, 0.0, 0.0],
+                       [0.7, 1.0, 0.0, 0.0],
+                       [0.0, 0.0, 1.0, 0.5],
+                       [0.0, 0.0, 0.5, 1.0]])
+    beta = np.linalg.cholesky(u_true) @ rng.randn(n_c, n_v)
+    snr = np.linspace(snr_lo, snr_hi, n_v)
+    rng.shuffle(snr)
+
+    noise = np.zeros((n_t, n_v))
+    e = rng.randn(n_t, n_v)
+    for t in range(1, n_t):
+        noise[t] = 0.3 * noise[t - 1] + e[t]
+    data = design @ (beta * snr) + noise
+    coords = rng.rand(n_v, 3) * 10
+    return data, design, coords, u_true, snr
+
+
+def _offdiag_corr(a, b):
+    triu = np.triu_indices(a.shape[0], k=1)
+    return float(np.corrcoef(a[triu], b[triu])[0, 1])
+
+
+def test_brsa_recovery_parity(ref_brsa_mod):
+    """Recovered condition-similarity C_ and pseudo-SNR ordering agree
+    between the reference's alternating optimizer and the repo's joint
+    L-BFGS at comparable budgets (reference brsa.py:518-780)."""
+    data, design, coords, u_true, snr = _brsa_data()
+    onsets = np.array([0, 60])
+
+    ref = ref_brsa_mod.BRSA(n_iter=15, auto_nuisance=True,
+                            random_state=0)
+    ref.fit(data, design, coords=coords, scan_onsets=onsets)
+
+    ours = OurBRSA(n_iter=2, auto_nuisance=True, random_state=0)
+    ours.fit(data, design, coords=coords, scan_onsets=onsets)
+
+    ref_c = np.asarray(ref.C_)
+    our_c = np.asarray(ours.C_)
+    true_c = u_true  # unit diagonal already
+
+    # both recover the generating similarity structure...
+    assert _offdiag_corr(ref_c, true_c) > 0.8
+    assert _offdiag_corr(our_c, true_c) > 0.8
+    # ...and agree with each other
+    assert _offdiag_corr(our_c, ref_c) > 0.85
+    np.testing.assert_allclose(our_c, ref_c, atol=0.25)
+
+    # pseudo-SNR: scale is not identified (reference normalizes by the
+    # geometric mean), so compare orderings
+    rho_ref, _ = spearmanr(np.asarray(ref.nSNR_), snr)
+    rho_our, _ = spearmanr(np.asarray(ours.nSNR_), snr)
+    assert rho_ref > 0.6 and rho_our > 0.6, (rho_ref, rho_our)
+    rho_cross, _ = spearmanr(np.asarray(ours.nSNR_),
+                             np.asarray(ref.nSNR_))
+    assert rho_cross > 0.7, rho_cross
+
+    # noise AR(1) estimates center near the generating 0.3 on both
+    assert abs(np.median(np.asarray(ref.rho_)) - 0.3) < 0.2
+    assert abs(np.median(np.asarray(ours.rho_)) - 0.3) < 0.2
+
+
+def test_gbrsa_recovery_parity(ref_brsa_mod):
+    """GBRSA grid-marginalized path (reference brsa.py:2696-3390):
+    three subjects (it is a group model), matched grids.  The tight
+    atol here is load-bearing: it pinned down a real r4 bug where the
+    repo projected X0 out of the data but not the design, biasing
+    across-block C_ to -0.8 (now within 0.06 of the oracle)."""
+    datas, designs = [], []
+    u_true = None
+    for s in range(3):
+        data, design, _, u_true, _ = _brsa_data(seed=10 + s)
+        datas.append(data)
+        designs.append(design)
+    onsets = np.array([0, 60])
+
+    ref = ref_brsa_mod.GBRSA(n_iter=10, auto_nuisance=True,
+                             random_state=0, SNR_bins=11, rho_bins=10)
+    ref.fit(datas, designs, scan_onsets=onsets)
+
+    ours = OurGBRSA(n_iter=2, auto_nuisance=True, random_state=0,
+                    SNR_bins=11, rho_bins=10)
+    ours.fit(datas, designs, scan_onsets=onsets)
+
+    ref_c = np.asarray(ref.C_)
+    our_c = np.asarray(ours.C_)
+    assert _offdiag_corr(ref_c, u_true) > 0.8
+    assert _offdiag_corr(our_c, u_true) > 0.8
+    assert _offdiag_corr(our_c, ref_c) > 0.9
+    np.testing.assert_allclose(our_c, ref_c, atol=0.15)
+
+    for s in range(3):
+        rho_cross, _ = spearmanr(np.asarray(ours.nSNR_[s]).ravel(),
+                                 np.asarray(ref.nSNR_[s]).ravel())
+        assert rho_cross > 0.7, (s, rho_cross)

@@ -1,0 +1,204 @@
+"""Live-oracle parity for the funcalign family (round-3 verdict item 4).
+
+The reference ``brainiak.funcalign`` modules run LIVE from
+/root/reference/src through the single-rank mpi4py stand-in in
+conftest.py (every collective is the identity at size 1, so the
+oracle's numerics are exactly its own).  SSSRM is excluded: its oracle
+needs pymanopt (absent here) and shimming a manifold optimizer would
+replace the very compute under comparison.
+
+Both implementations start from different random W inits (the repo
+draws via jax PRNG, the reference via numpy RandomState), so tests
+compare what the algorithms CONTRACT to produce — recovery of the
+generating shared timecourse up to an orthogonal rotation, residual
+levels, noise estimates — rather than bitwise iterates, plus exact
+array round-trips through each other's .npz files
+(reference srm.py:110-142, :451-481).
+"""
+
+import numpy as np
+
+from brainiak_tpu.funcalign.fastsrm import FastSRM as OurFastSRM
+from brainiak_tpu.funcalign.rsrm import RSRM as OurRSRM
+from brainiak_tpu.funcalign.srm import (DetSRM as OurDetSRM, SRM as OurSRM,
+                                        load as our_load)
+
+
+def _spiral_data(seed, subjects=4, voxels=60, samples=150, features=3,
+                 noise=0.1):
+    """The reference test-suite's generating process (reference
+    tests/funcalign/test_srm.py:34-63): a 3-D spiral shared response
+    mapped through per-subject orthonormal bases plus white noise."""
+    rng = np.random.RandomState(seed)
+    theta = np.linspace(-4 * np.pi, 4 * np.pi, samples)
+    z = np.linspace(-2, 2, samples)
+    r = z ** 2 + 1
+    shared = np.vstack((r * np.sin(theta), r * np.cos(theta), z))
+    data, bases = [], []
+    for _ in range(subjects):
+        q, _ = np.linalg.qr(rng.randn(voxels, features))
+        bases.append(q)
+        data.append(q @ shared + noise * rng.randn(voxels, samples))
+    return data, bases, shared
+
+
+def _aligned_corr(est, truth):
+    """Mean per-component |correlation| after the best orthogonal
+    (procrustes) alignment of ``est`` onto ``truth`` — SRM identifies
+    the shared space only up to rotation."""
+    u, _, vt = np.linalg.svd(truth @ est.T)
+    rot = u @ vt
+    est_a = rot @ est
+    return float(np.mean([abs(np.corrcoef(est_a[k], truth[k])[0, 1])
+                          for k in range(truth.shape[0])]))
+
+
+def _recon_err(data, w_list, shared):
+    return float(np.mean([np.linalg.norm(x - w @ shared, 'fro')
+                          / np.linalg.norm(x, 'fro')
+                          for x, w in zip(data, w_list)]))
+
+
+def test_srm_recovery_parity(reference):
+    """Probabilistic SRM: both implementations must recover the
+    generating shared response (reference srm.py:483-624) to the same
+    quality on identical data."""
+    data, _, shared = _spiral_data(0)
+    ref = reference.srm.SRM(n_iter=10, features=3, rand_seed=0)
+    ref.fit(data)
+    ours = OurSRM(n_iter=10, features=3, rand_seed=0)
+    ours.fit(data)
+
+    ref_corr = _aligned_corr(np.asarray(ref.s_), shared)
+    our_corr = _aligned_corr(np.asarray(ours.s_), shared)
+    assert ref_corr > 0.9 and our_corr > 0.9, (ref_corr, our_corr)
+    assert abs(ref_corr - our_corr) < 0.05
+
+    ref_err = _recon_err(data, ref.w_, ref.s_)
+    our_err = _recon_err(data, ours.w_, ours.s_)
+    assert our_err < max(1.1 * ref_err, ref_err + 0.02), (our_err, ref_err)
+
+    # noise level estimates agree to the same order
+    ref_rho = np.sort(np.asarray(ref.rho2_))
+    our_rho = np.sort(np.asarray(ours.rho2_))
+    np.testing.assert_allclose(our_rho, ref_rho, rtol=0.5, atol=1e-3)
+
+
+def test_detsrm_recovery_parity(reference):
+    """Deterministic SRM (reference srm.py:626-918): same contract."""
+    data, _, shared = _spiral_data(1)
+    ref = reference.srm.DetSRM(n_iter=10, features=3, rand_seed=0)
+    ref.fit(data)
+    ours = OurDetSRM(n_iter=10, features=3, rand_seed=0)
+    ours.fit(data)
+
+    ref_corr = _aligned_corr(np.asarray(ref.s_), shared)
+    our_corr = _aligned_corr(np.asarray(ours.s_), shared)
+    assert ref_corr > 0.9 and our_corr > 0.9, (ref_corr, our_corr)
+    assert abs(ref_corr - our_corr) < 0.05
+
+    ref_err = _recon_err(data, ref.w_, ref.s_)
+    our_err = _recon_err(data, ours.w_, ours.s_)
+    assert our_err < max(1.1 * ref_err, ref_err + 0.02), (our_err, ref_err)
+
+
+def test_srm_npz_cross_load(reference, tmp_path):
+    """Each implementation's .npz save loads EXACTLY in the other
+    (reference srm.py:110-142 reads with pickle disabled, so uniform
+    voxel counts must be saved as plain stacked arrays)."""
+    data, _, _ = _spiral_data(2, subjects=3, voxels=40, samples=80)
+
+    # reference save -> our load
+    ref = reference.srm.SRM(n_iter=5, features=3, rand_seed=0)
+    ref.fit(data)
+    ref_path = tmp_path / "ref_model.npz"
+    ref.save(str(ref_path))
+    ours_loaded = our_load(str(ref_path))
+    for w_ref, w_load in zip(ref.w_, ours_loaded.w_):
+        np.testing.assert_array_equal(np.asarray(w_ref), w_load)
+    np.testing.assert_array_equal(np.asarray(ref.s_), ours_loaded.s_)
+    np.testing.assert_array_equal(np.asarray(ref.rho2_), ours_loaded.rho2_)
+    # the loaded model transforms (reference transform contract)
+    projected = ours_loaded.transform(data)
+    assert len(projected) == len(data)
+    assert projected[0].shape == (3, 80)
+
+    # our save -> reference load
+    ours = OurSRM(n_iter=5, features=3, rand_seed=0)
+    ours.fit(data)
+    our_path = tmp_path / "our_model"
+    ours.save(str(our_path))
+    ref_loaded = reference.srm.load(str(our_path) + ".npz")
+    for w_ours, w_load in zip(ours.w_, ref_loaded.w_):
+        np.testing.assert_array_equal(w_ours, np.asarray(w_load))
+    np.testing.assert_array_equal(ours.s_, np.asarray(ref_loaded.s_))
+    ref_projected = ref_loaded.transform(data)
+    assert len(ref_projected) == len(data)
+    assert ref_projected[0].shape == (3, 80)
+
+
+def test_rsrm_agreement(reference):
+    """Robust SRM (reference rsrm.py:114-260): on data with sparse
+    subject-specific outliers both implementations must recover the
+    shared response AND localize the outliers the same way.
+
+    gamma=0.5 keeps the problem in the regime where BCD converges from
+    any init; at gamma>=1 the reference's own recovery varies 0.70-0.93
+    across its rand_seeds (init-dependent local optima — measured here
+    r4), so no cross-implementation comparison is meaningful there."""
+    rng = np.random.RandomState(3)
+    data, _, shared = _spiral_data(3, subjects=3, voxels=50, samples=100)
+    # sparse corruption: a few hot voxels per subject
+    supports = []
+    for x in data:
+        idx = rng.choice(x.shape[0], size=4, replace=False)
+        x[idx] += 3.0 * rng.randn(4, x.shape[1])
+        supports.append(set(idx.tolist()))
+
+    ref = reference.rsrm.RSRM(n_iter=10, features=3, gamma=0.5,
+                              rand_seed=0)
+    ref.fit(data)
+    ours = OurRSRM(n_iter=10, features=3, gamma=0.5, rand_seed=0)
+    ours.fit(data)
+
+    ref_corr = _aligned_corr(np.asarray(ref.r_), shared)
+    our_corr = _aligned_corr(np.asarray(ours.r_), shared)
+    assert ref_corr > 0.9 and our_corr > 0.9, (ref_corr, our_corr)
+    assert abs(ref_corr - our_corr) < 0.05
+
+    # the sparse terms concentrate energy on the corrupted voxels
+    for s_ref, s_our, hot in zip(ref.s_, ours.s_, supports):
+        for s_term in (np.asarray(s_ref), np.asarray(s_our)):
+            energy = (s_term ** 2).sum(axis=1)
+            top = set(np.argsort(energy)[-4:].tolist())
+            assert len(top & hot) >= 3, (top, hot)
+
+
+def test_fastsrm_agreement(reference):
+    """FastSRM (reference fastsrm.py:1327-1466): deterministic given
+    arrays in memory, so the two implementations' shared responses must
+    agree up to rotation, and cross-projection must reconstruct."""
+    data, _, shared = _spiral_data(4, subjects=3, voxels=48,
+                                   samples=90)
+    arrays = [x.astype(np.float64) for x in data]
+
+    ref = reference.fastsrm.FastSRM(n_components=3, n_iter=10, seed=0,
+                                    aggregate="mean", verbose=False)
+    ref_shared = ref.fit_transform(arrays)
+    ours = OurFastSRM(n_components=3, n_iter=10, seed=0,
+                      aggregate="mean", verbose=False)
+    our_shared = ours.fit_transform(arrays)
+
+    ref_corr = _aligned_corr(np.asarray(ref_shared), shared)
+    our_corr = _aligned_corr(np.asarray(our_shared), shared)
+    assert ref_corr > 0.9 and our_corr > 0.9, (ref_corr, our_corr)
+    assert abs(ref_corr - our_corr) < 0.05
+
+    # mutual agreement, not just truth recovery: align ours onto the
+    # reference's and require near-identity correspondence
+    u, _, vt = np.linalg.svd(np.asarray(ref_shared)
+                             @ np.asarray(our_shared).T)
+    aligned = (u @ vt) @ np.asarray(our_shared)
+    for k in range(3):
+        c = np.corrcoef(aligned[k], np.asarray(ref_shared)[k])[0, 1]
+        assert c > 0.95, (k, c)

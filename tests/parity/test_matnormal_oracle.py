@@ -1,0 +1,170 @@
+"""Live-oracle parity for matnormal (round-3 verdict item 5).
+
+TensorFlow IS installed in this environment, so the reference
+``brainiak.matnormal`` runs live — only its four tensorflow_probability
+entry points are shimmed (conftest.py; textbook definitions, the
+oracle's likelihoods/solvers/optimizers are its own TF code).
+
+Covariance strategy classes are compared EXACTLY (same explicit
+parameters -> same logdet / same solve, float64).  Fitted estimators
+(MNRSA, MatnormalRegression) are compared as estimators: same data ->
+same recovered structure within tolerance, since the two sides optimize
+with different backends (TF scipy-L-BFGS vs jax L-BFGS) from different
+nuisance inits.
+"""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.matnormal.covs import (CovAR1 as OurCovAR1,
+                                         CovDiagonal as OurCovDiagonal,
+                                         CovIdentity as OurCovIdentity,
+                                         CovUnconstrainedCholesky
+                                         as OurCovChol)
+from brainiak_tpu.matnormal.mnrsa import MNRSA as OurMNRSA
+from brainiak_tpu.matnormal.regression import (MatnormalRegression
+                                               as OurRegression)
+
+tf = pytest.importorskip("tensorflow")
+
+
+@pytest.fixture(scope="module")
+def ref_matnormal(reference):
+    import importlib
+    ns = {}
+    ns["covs"] = importlib.import_module("brainiak.matnormal.covs")
+    ns["mnrsa"] = importlib.import_module("brainiak.matnormal.mnrsa")
+    ns["regression"] = importlib.import_module(
+        "brainiak.matnormal.regression")
+    return ns
+
+
+def test_cov_ar1_logdet_solve_parity(ref_matnormal):
+    """CovAR1 with explicit (rho, sigma) and scan-onset blocks: the
+    precision recipe (I - rho D + rho^2 F)/sigma^2 must match the
+    reference bit-for-bit at float64 (reference covs.py:127-231)."""
+    size, rho, sigma = 24, 0.4, 1.3
+    onsets = np.array([0, 10])
+    ref = ref_matnormal["covs"].CovAR1(size=size, rho=rho, sigma=sigma,
+                                       scan_onsets=onsets)
+    ours = OurCovAR1(size=size, rho=rho, sigma=sigma,
+                     scan_onsets=onsets)
+    params = ours.init_params()
+
+    np.testing.assert_allclose(float(ours.logdet(params)),
+                               float(ref.logdet), rtol=1e-10)
+    x = np.random.RandomState(0).randn(size, 7)
+    ref_solve = ref.solve(tf.constant(x)).numpy()
+    our_solve = np.asarray(ours.solve(params, x))
+    np.testing.assert_allclose(our_solve, ref_solve,
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_cov_unconstrained_cholesky_parity(ref_matnormal):
+    """CovUnconstrainedCholesky built from the same SPD Sigma
+    (reference covs.py:343-404)."""
+    rng = np.random.RandomState(1)
+    a = rng.randn(6, 6)
+    sigma_mat = a @ a.T + 6 * np.eye(6)
+    ref = ref_matnormal["covs"].CovUnconstrainedCholesky(Sigma=sigma_mat)
+    ours = OurCovChol(Sigma=sigma_mat)
+    params = ours.init_params()
+
+    expected_logdet = float(np.linalg.slogdet(sigma_mat)[1])
+    assert abs(float(ref.logdet) - expected_logdet) < 1e-8
+    assert abs(float(ours.logdet(params)) - expected_logdet) < 1e-8
+
+    x = rng.randn(6, 4)
+    ref_solve = ref.solve(tf.constant(x)).numpy()
+    our_solve = np.asarray(ours.solve(params, x))
+    np.testing.assert_allclose(our_solve, ref_solve,
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_cov_diagonal_parity(ref_matnormal):
+    """CovDiagonal with explicit variances (reference covs.py:279-325)."""
+    var = np.array([0.5, 1.0, 2.0, 4.0, 0.25])
+    ref = ref_matnormal["covs"].CovDiagonal(size=5, diag_var=var)
+    ours = OurCovDiagonal(size=5, diag_var=var)
+    params = ours.init_params()
+
+    np.testing.assert_allclose(float(ours.logdet(params)),
+                               float(ref.logdet), rtol=1e-12)
+    x = np.random.RandomState(2).randn(5, 3)
+    np.testing.assert_allclose(np.asarray(ours.solve(params, x)),
+                               ref.solve(tf.constant(x)).numpy(),
+                               rtol=1e-10)
+
+
+def _rsa_data(seed=3, n_t=60, n_v=16, n_c=4):
+    """Design + data with a known condition covariance U."""
+    rng = np.random.RandomState(seed)
+    design = rng.randn(n_t, n_c)
+    u_true = np.array([[1.0, 0.8, 0.0, 0.0],
+                       [0.8, 1.0, 0.0, 0.0],
+                       [0.0, 0.0, 1.0, -0.6],
+                       [0.0, 0.0, -0.6, 1.0]])
+    beta = np.linalg.cholesky(u_true) @ rng.randn(n_c, n_v)
+    data = design @ beta + 0.7 * rng.randn(n_t, n_v)
+    return design, data, u_true
+
+
+def test_mnrsa_fit_parity(ref_matnormal):
+    """MNRSA (reference mnrsa.py:21-175): both implementations must
+    recover the same condition-correlation structure from the same
+    data.  Tolerances are estimator-level: the nuisance X_0 starts from
+    different random draws on each side."""
+    design, data, u_true = _rsa_data()
+    n_t, n_v = data.shape
+
+    tf.random.set_seed(0)
+    ref = ref_matnormal["mnrsa"].MNRSA(
+        time_cov=ref_matnormal["covs"].CovIdentity(size=n_t),
+        space_cov=ref_matnormal["covs"].CovIdentity(size=n_v),
+        n_nureg=2)
+    ref.fit(data, design)
+
+    ours = OurMNRSA(time_cov=OurCovIdentity(size=n_t),
+                    space_cov=OurCovIdentity(size=n_v), n_nureg=2)
+    ours.fit(data, design)
+
+    ref_c = np.asarray(ref.C_)
+    our_c = np.asarray(ours.C_)
+    assert ref_c.shape == our_c.shape == (4, 4)
+    # both detect the dominant positive coupling
+    for c in (ref_c, our_c):
+        assert c[0, 1] > 0.4
+    # the two implementations land on the SAME optimum here: measured
+    # maxdiff 0.002 at this regime (at larger sizes the marginal
+    # likelihood is multimodal and the reference itself flips between
+    # optima across data draws — mutual agreement, not truth recovery,
+    # is the parity contract)
+    np.testing.assert_allclose(our_c, ref_c, atol=0.05)
+    triu = np.triu_indices(4, k=1)
+    corr = np.corrcoef(our_c[triu], ref_c[triu])[0, 1]
+    assert corr > 0.98, corr
+
+
+def test_matnormal_regression_parity(ref_matnormal):
+    """MatnormalRegression (reference regression.py:15-120): the
+    fitted coefficient maps must agree."""
+    rng = np.random.RandomState(5)
+    n_t, n_v, n_c = 50, 10, 3
+    design = rng.randn(n_t, n_c)
+    beta_true = rng.randn(n_c, n_v)
+    data = design @ beta_true + 0.5 * rng.randn(n_t, n_v)
+
+    tf.random.set_seed(0)
+    ref = ref_matnormal["regression"].MatnormalRegression(
+        time_cov=ref_matnormal["covs"].CovAR1(size=n_t),
+        space_cov=ref_matnormal["covs"].CovIdentity(size=n_v))
+    ref.fit(design, data)
+
+    ours = OurRegression(time_cov=OurCovAR1(size=n_t),
+                         space_cov=OurCovIdentity(size=n_v))
+    ours.fit(design, data)
+
+    ref_beta = np.asarray(ref.beta_)
+    our_beta = np.asarray(ours.beta_)
+    np.testing.assert_allclose(our_beta, ref_beta, atol=0.05)
+    np.testing.assert_allclose(our_beta, beta_true, atol=0.4)

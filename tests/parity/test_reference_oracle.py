@@ -237,3 +237,20 @@ def test_fmrisim_cross_oracle_noise(reference):
     for key in ("snr", "sfnr"):
         ratio = our_on_ours[key] / ref_on_ours[key]
         assert 0.5 < ratio < 2.0, (key, ratio)
+
+
+def test_arima_stand_in_rejects_high_order(reference):
+    """The statsmodels ARIMA stand-in fills every AR/MA lag with
+    rho[0]/theta[0], which is only meaningful for order (1, d, 1);
+    anything higher must fail loudly rather than silently handing the
+    reference wrong parameters (ADVICE r3)."""
+    import statsmodels.tsa.arima.model as arima_model
+
+    ARIMA = arima_model.ARIMA
+    if ARIMA.__module__.startswith("statsmodels"):
+        pytest.skip("real statsmodels installed; stand-in not in use")
+    series = np.random.RandomState(0).randn(80)
+    with pytest.raises(ValueError, match="order"):
+        ARIMA(series, order=(2, 0, 0)).fit()
+    fit = ARIMA(series, order=(1, 0, 1)).fit()
+    assert fit.params.shape == (4,)

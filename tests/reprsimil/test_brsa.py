@@ -344,3 +344,21 @@ def test_lgssm_smoother_matches_dense_oracle():
     assert abs(float(log_p1) - log_p1_dense) < (1e-8 if f64 else 5e-2)
     assert np.abs(np.asarray(mu1)[0] - mu1_dense).max() < \
         (1e-10 if f64 else 1e-3)
+
+
+def test_nureg_methods():
+    """All four reference nuisance decompositions are accepted
+    (reference brsa.py:546-558) and produce usable components; unknown
+    names fail with the reference's message."""
+    Y, design, _, _, onsets = make_brsa_data(n_v=25, seed=30)
+    for method in ("PCA", "FA", "ICA", "SPCA"):
+        model = BRSA(n_iter=2, auto_nuisance=True, n_nureg=2,
+                     nureg_method=method, lbfgs_iters=20,
+                     random_state=0)
+        comps = model._nuisance_components(
+            np.random.RandomState(0).randn(60, 25))
+        assert comps.shape == (60, 2)
+        assert np.all(np.isfinite(comps))
+        np.testing.assert_allclose(comps.std(0), 1.0, atol=1e-6)
+    with pytest.raises(ValueError, match="nureg_method"):
+        BRSA(nureg_method="kmeans")

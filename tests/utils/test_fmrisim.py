@@ -202,7 +202,10 @@ def test_packaged_brain_template(tmp_path):
     assert stored.dtype == np.uint8
     regen = np.round(sim._synthetic_brain_template((91, 109, 91))
                      * 255.0).astype(np.uint8)
-    np.testing.assert_array_equal(stored, regen)
+    # one quantization step of slack: bit-exactness would couple the
+    # suite to scipy/numpy rounding staying identical across versions
+    # (a 0.5-ulp flip at a quantization boundary is legitimate)
+    assert np.abs(stored.astype(int) - regen.astype(int)).max() <= 1
 
     # the packaged template drives mask_brain and zooms to any 3-D shape
     mask, template = sim.mask_brain(np.array([12, 14, 12]),
@@ -378,6 +381,30 @@ def test_arma_mle_recovery():
     ar, ma = sim._calc_ARMA_noise(x, np.ones(n_vox), sample_num=40)
     assert abs(ar[0] - rho) < 0.1
     assert abs(ma[0] - theta) < 0.12
+
+
+def test_arma_mle_golden_values():
+    """Pin exact _arma11_mle outputs on a fixed ARMA(0.45, 0.25)
+    series.  The parity suite's statsmodels stand-in delegates to this
+    estimator (tests/parity/conftest.py), so the cross-oracle fmrisim
+    test cannot catch drift in it; this golden pin can — any change to
+    the grid recipe or the Kalman likelihood shows up here even inside
+    the recovery tests' tolerance bands."""
+    rng = np.random.RandomState(31)
+    n_tr, burn = 250, 50
+    e = rng.randn(3, n_tr + burn)
+    x = np.zeros((3, n_tr + burn))
+    for t in range(1, n_tr + burn):
+        x[:, t] = 0.45 * x[:, t - 1] + e[:, t] + 0.25 * e[:, t - 1]
+    x = x[:, burn:]
+    x = (x - x.mean(1, keepdims=True)) / x.std(1, keepdims=True)
+    rho, theta, ll = sim._arma11_mle(x)
+    np.testing.assert_allclose(
+        rho, [0.45694444, 0.34814815, 0.4612963], atol=1e-6)
+    np.testing.assert_allclose(
+        theta, [0.20453704, 0.27851852, 0.19148148], atol=1e-6)
+    np.testing.assert_allclose(
+        ll, [-300.24724723, -309.17816001, -301.072746], atol=1e-4)
 
 
 def test_arma_mle_white_noise_is_zero():

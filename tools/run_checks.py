@@ -105,9 +105,31 @@ def check_unused_imports(path, tree, noqa, findings):
 
 
 def _code_defaults():
-    """Map parameter name -> set of repr'd default values across every
-    function/method signature in the package."""
+    """(global, by_owner): parameter name -> set of repr'd default
+    values across every function/method signature in the package, plus
+    the same map scoped per owning symbol — the function name, and for
+    methods also the enclosing class name (so docs can anchor a claim
+    to either ``fit`` or ``SRM``)."""
     defaults = {}
+    by_owner = {}
+
+    def record(owner_names, param, value):
+        defaults.setdefault(param, set()).add(value)
+        for owner in owner_names:
+            by_owner.setdefault(owner, {}).setdefault(
+                param, set()).add(value)
+
+    def visit_fn(node, owners):
+        args = node.args
+        pos = args.posonlyargs + args.args
+        for arg, dflt in zip(pos[len(pos) - len(args.defaults):],
+                             args.defaults):
+            if isinstance(dflt, ast.Constant):
+                record(owners, arg.arg, repr(dflt.value))
+        for arg, dflt in zip(args.kwonlyargs, args.kw_defaults):
+            if dflt is not None and isinstance(dflt, ast.Constant):
+                record(owners, arg.arg, repr(dflt.value))
+
     pkg = os.path.join(REPO, "brainiak_tpu")
     for root, dirs, files in os.walk(pkg):
         dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
@@ -121,21 +143,15 @@ def _code_defaults():
                 except SyntaxError:
                     continue
             for node in ast.walk(tree):
-                if not isinstance(node, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                    continue
-                args = node.args
-                pos = args.posonlyargs + args.args
-                for arg, dflt in zip(pos[len(pos) - len(args.defaults):],
-                                     args.defaults):
-                    if isinstance(dflt, ast.Constant):
-                        defaults.setdefault(arg.arg, set()).add(
-                            repr(dflt.value))
-                for arg, dflt in zip(args.kwonlyargs, args.kw_defaults):
-                    if dflt is not None and isinstance(dflt, ast.Constant):
-                        defaults.setdefault(arg.arg, set()).add(
-                            repr(dflt.value))
-    return defaults
+                if isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            visit_fn(sub, (node.name, sub.name))
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    visit_fn(node, (node.name,))
+    return defaults, by_owner
 
 
 def check_doc_defaults(findings):
@@ -150,32 +166,63 @@ def check_doc_defaults(findings):
     docs_dir = os.path.join(REPO, "docs")
     if not os.path.isdir(docs_dir):
         return
-    defaults = None
+    token_re = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+    defaults = by_owner = None
     for root, dirs, files in os.walk(docs_dir):
         dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
         for f in sorted(files):
             if not f.endswith(".md"):
                 continue
             path = os.path.join(root, f)
+            heading = ""
+            in_fence = False
             with open(path, encoding="utf-8") as fh:
                 for i, line in enumerate(fh, 1):
+                    if line.lstrip().startswith("```"):
+                        in_fence = not in_fence
+                    # markdown heading, not a comment inside a fenced
+                    # code example
+                    if not in_fence and re.match(r"^#{1,6} ", line):
+                        heading = line
                     if "# noqa" in line:
                         continue
                     for m in pattern.finditer(line):
                         if defaults is None:
-                            defaults = _code_defaults()
+                            defaults, by_owner = _code_defaults()
                         name = m.group("name")
                         doc_val = m.group("value").strip("'\"")
                         code_vals = defaults.get(name)
                         if not code_vals:
                             continue  # not a signature param (knob alias)
+                        # Scope to the owning symbol when the line or
+                        # the nearest heading names one that defines
+                        # this parameter — a claim must not be
+                        # "confirmed" by an unrelated function's
+                        # coincidentally matching default.
+                        owners = [t for t in token_re.findall(
+                                      line + " " + heading)
+                                  if t != name and name in
+                                  by_owner.get(t, ())]
+                        if owners:
+                            code_vals = set().union(
+                                *(by_owner[o][name] for o in owners))
+                        elif len(code_vals) > 1:
+                            findings.append(
+                                f"{path}:{i}: documented default "
+                                f"`{name}={doc_val}` is ambiguous — "
+                                f"{len(code_vals)} distinct signature "
+                                f"defaults ({', '.join(sorted(code_vals))})"
+                                " exist; name the owning function/class"
+                                " on the line or heading, or # noqa")
+                            continue
                         normalized = {v.strip("'\"") for v in code_vals}
                         if doc_val not in normalized:
                             opts = ", ".join(sorted(code_vals))
                             findings.append(
                                 f"{path}:{i}: documented default "
                                 f"`{name}={doc_val}` does not match "
-                                f"any signature default ({opts})")
+                                f"a signature default of "
+                                f"{'/'.join(owners) or name} ({opts})")
 
 
 def run_external(findings):
