@@ -7,6 +7,40 @@ brainiak_tpu.parallel.testing.run_distributed.
 import numpy as np
 
 
+def make_fcma_data():
+    """Shared FCMA dataset for the distributed-vs-single comparison —
+    ONE definition so the two sides cannot silently diverge."""
+    n_e, n_t, n_v = 8, 20, 32
+    rng = np.random.RandomState(5)
+    raw = []
+    for _ in range(n_e):
+        mat = rng.randn(n_t, n_v).astype(np.float64)
+        mat = (mat - mat.mean(0)) / (mat.std(0) * np.sqrt(n_t))
+        raw.append(mat)
+    return raw, [0, 1] * (n_e // 2), n_e // 2
+
+
+def make_isc_data():
+    return np.random.RandomState(6).randn(30, 16, 6)
+
+
+def make_htfa_data():
+    rng = np.random.RandomState(7)
+    n_subj = 3  # does not divide 4 devices: pad lanes cross processes
+    R_coords = rng.rand(40, 3) * 10.0
+    true_c = np.array([[2.0, 2.0, 2.0], [8.0, 8.0, 8.0]])
+    F = np.exp(-((R_coords[:, None, :] - true_c[None]) ** 2).sum(-1)
+               / 4.0)
+    X = [np.asarray(F @ rng.randn(2, 12) + 0.05 * rng.randn(40, 12))
+         for _ in range(n_subj)]
+    return X, R_coords, n_subj
+
+
+HTFA_PARAMS = dict(K=2, max_global_iter=2, max_local_iter=2,
+                   voxel_ratio=1.0, tr_ratio=1.0, max_voxel=40,
+                   max_tr=12)
+
+
 def psum_worker(process_id, num_processes):
     """Global psum across all processes' devices."""
     import jax
@@ -90,14 +124,8 @@ def voxelselector_worker(process_id, num_processes):
     from brainiak_tpu.fcma.voxelselector import VoxelSelector
 
     mesh = Mesh(np.array(jax.devices()), ("voxel",))
-    n_e, n_t, n_v = 8, 20, 32
-    rng = np.random.RandomState(5)
-    raw = []
-    for _ in range(n_e):
-        mat = rng.randn(n_t, n_v).astype(np.float64)
-        mat = (mat - mat.mean(0)) / (mat.std(0) * np.sqrt(n_t))
-        raw.append(mat)
-    vs = VoxelSelector([0, 1] * (n_e // 2), n_e // 2, 2, raw,
+    raw, labels, epochs_per_subj = make_fcma_data()
+    vs = VoxelSelector(labels, epochs_per_subj, 2, raw,
                        voxel_unit=8, mesh=mesh, use_pallas=False)
     return vs.run('svm')
 
@@ -112,8 +140,7 @@ def bootstrap_isc_worker(process_id, num_processes):
     from brainiak_tpu.isc import bootstrap_isc, isc
 
     mesh = Mesh(np.array(jax.devices()), ("voxel",))
-    rng = np.random.RandomState(6)
-    ts = rng.randn(30, 16, 6)
+    ts = make_isc_data()
     iscs = isc(ts, mesh=mesh)
     observed, ci, p, distribution = bootstrap_isc(
         iscs, n_bootstraps=12, mesh=mesh, null_batch_size=4,
@@ -132,16 +159,7 @@ def htfa_worker(process_id, num_processes):
     from brainiak_tpu.factoranalysis.htfa import HTFA
 
     mesh = Mesh(np.array(jax.devices()), ("subject",))
-    rng = np.random.RandomState(7)
-    n_subj = 3  # does not divide 4 devices: pad lanes cross processes
-    R_coords = rng.rand(40, 3) * 10.0
-    true_c = np.array([[2.0, 2.0, 2.0], [8.0, 8.0, 8.0]])
-    F = np.exp(-((R_coords[:, None, :] - true_c[None]) ** 2).sum(-1)
-               / 4.0)
-    X = [np.asarray(F @ rng.randn(2, 12) + 0.05 * rng.randn(40, 12))
-         for _ in range(n_subj)]
-    htfa = HTFA(K=2, n_subj=n_subj, max_global_iter=2,
-                max_local_iter=2, voxel_ratio=1.0, tr_ratio=1.0,
-                max_voxel=40, max_tr=12, mesh=mesh)
+    X, R_coords, n_subj = make_htfa_data()
+    htfa = HTFA(n_subj=n_subj, mesh=mesh, **HTFA_PARAMS)
     htfa.fit(X, [R_coords] * n_subj)
     return np.asarray(htfa.global_posterior_)

@@ -88,15 +88,10 @@ def test_distributed_voxelselector_matches_single_process():
 
 def _single_process_voxelselector():
     from brainiak_tpu.fcma.voxelselector import VoxelSelector
+    from tests.parallel.dist_workers import make_fcma_data
 
-    n_e, n_t, n_v = 8, 20, 32
-    rng = np.random.RandomState(5)
-    raw = []
-    for _ in range(n_e):
-        mat = rng.randn(n_t, n_v).astype(np.float64)
-        mat = (mat - mat.mean(0)) / (mat.std(0) * np.sqrt(n_t))
-        raw.append(mat)
-    vs = VoxelSelector([0, 1] * (n_e // 2), n_e // 2, 2, raw,
+    raw, labels, epochs_per_subj = make_fcma_data()
+    vs = VoxelSelector(labels, epochs_per_subj, 2, raw,
                        voxel_unit=8, use_pallas=False)
     return dict(vs.run('svm'))
 
@@ -112,9 +107,9 @@ def test_distributed_bootstrap_isc_matches_single_process():
     np.testing.assert_array_equal(dist_d, dist_d1)
 
     from brainiak_tpu.isc import bootstrap_isc, isc
+    from tests.parallel.dist_workers import make_isc_data
 
-    rng = np.random.RandomState(6)
-    ts = rng.randn(30, 16, 6)
+    ts = make_isc_data()
     iscs = isc(ts)
     observed, ci, p, distribution = bootstrap_isc(
         iscs, n_bootstraps=12, null_batch_size=4, random_state=0)
@@ -135,18 +130,11 @@ def test_distributed_htfa_matches_single_process():
     np.testing.assert_allclose(results[0], results[1], atol=1e-12)
 
     from brainiak_tpu.factoranalysis.htfa import HTFA
+    from tests.parallel.dist_workers import (HTFA_PARAMS,
+                                             make_htfa_data)
 
-    rng = np.random.RandomState(7)
-    n_subj = 3
-    R_coords = rng.rand(40, 3) * 10.0
-    true_c = np.array([[2.0, 2.0, 2.0], [8.0, 8.0, 8.0]])
-    F = np.exp(-((R_coords[:, None, :] - true_c[None]) ** 2).sum(-1)
-               / 4.0)
-    X = [np.asarray(F @ rng.randn(2, 12) + 0.05 * rng.randn(40, 12))
-         for _ in range(n_subj)]
-    htfa = HTFA(K=2, n_subj=n_subj, max_global_iter=2,
-                max_local_iter=2, voxel_ratio=1.0, tr_ratio=1.0,
-                max_voxel=40, max_tr=12)
+    X, R_coords, n_subj = make_htfa_data()
+    htfa = HTFA(n_subj=n_subj, **HTFA_PARAMS)
     htfa.fit(X, [R_coords] * n_subj)
     # distributed optimization follows the same trajectory up to
     # cross-shard reduction-order noise amplified by L-BFGS steps
