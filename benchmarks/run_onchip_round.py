@@ -1,0 +1,76 @@
+"""One-command on-chip evidence capture for a round.
+
+Runs, in order and each in its OWN subprocess (one chip process at a
+time, sized well inside its timeout — docs/performance.md operational
+rules):
+
+1. probe          — 64x64 matmul in a subprocess; abort if wedged
+2. tpu_validation — kernel parity + end-to-end VoxelSelector
+                    (refreshes benchmarks/TPU_VALIDATION.json with ts)
+3. tpu_mfu        — whole-brain MFU sweep (V>=32k, E>=32, fp32/bf16,
+                    XLA-vs-Pallas production stage)
+                    (writes benchmarks/TPU_MFU.json)
+4. bench.py       — the driver's headline metric
+5. srm timing     — benchmarks/srm_stage_timing.py compute-only split
+
+A probe runs BETWEEN steps; the first wedge stops the sequence (later
+steps would hang and the timeout kill could deepen the wedge).  Exit
+code 0 iff at least steps 1-4 completed.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+from bench import _device_responsive as probe  # noqa: E402
+
+STEPS = [
+    ("tpu_validation", [sys.executable,
+                        os.path.join(HERE, "tpu_validation.py")], 900),
+    ("tpu_mfu", [sys.executable, os.path.join(HERE, "tpu_mfu.py")],
+     1500),
+    # generous: if the chip wedges between the inter-step probe and
+    # bench's first dispatch, bench.py itself burns up to ~10 min in
+    # its own probe retries before the (minutes-long) CPU fallback —
+    # killing it mid-run is exactly the wedge-deepening kill the
+    # operational rules forbid
+    ("bench", [sys.executable, os.path.join(REPO, "bench.py")], 1800),
+    ("srm_stage_timing", [sys.executable,
+                          os.path.join(HERE, "srm_stage_timing.py")],
+     900),
+]
+
+
+def main():
+    if not probe():
+        print("chip unresponsive at start; aborting", file=sys.stderr)
+        return 1
+    done = 0
+    for name, cmd, step_timeout in STEPS:
+        t0 = time.time()
+        print(f"== {name} ==", file=sys.stderr)
+        try:
+            r = subprocess.run(cmd, timeout=step_timeout)
+        except subprocess.TimeoutExpired:
+            print(f"{name}: TIMED OUT after {step_timeout}s — chip "
+                  "likely wedged; stopping", file=sys.stderr)
+            break
+        print(f"{name}: rc={r.returncode} in {time.time() - t0:.0f}s",
+              file=sys.stderr)
+        if r.returncode != 0:
+            break
+        done += 1
+        if not probe():
+            print(f"chip wedged after {name}; stopping", file=sys.stderr)
+            break
+    print(f"{done}/{len(STEPS)} steps completed", file=sys.stderr)
+    return 0 if done >= 3 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
