@@ -1,0 +1,235 @@
+"""Minimal pymanopt stand-in for running reference SSSRM live.
+
+Implements exactly the surface reference funcalign/sssrm.py touches
+(sssrm.py:37-41, :428-552): ``manifolds.Stiefel/Euclidean/Product``,
+``function.tensorflow`` (cost + Euclidean gradient via
+``tf.GradientTape``), ``Problem``, and ``optimizers.ConjugateGradient``
+— a Riemannian Fletcher–Reeves CG with QR retraction,
+projection-based vector transport, and Armijo backtracking.
+
+Caveat (same class as the statsmodels/nibabel stand-ins, see
+conftest.py): the ORACLE here is the reference's objective functions
+and alternating optimization structure; the generic manifold optimizer
+underneath is this stand-in's, not pymanopt's, so optimizer-level
+quirks of real pymanopt are not reproduced.  Tolerance-based
+estimator-level comparisons only.
+"""
+
+import numpy as np
+
+__all__ = ["install"]
+
+
+class _Manifold:
+    def projection(self, point, vector):
+        raise NotImplementedError
+
+    def retraction(self, point, vector):
+        raise NotImplementedError
+
+    def norm(self, point, vector):
+        return float(np.sqrt(self.inner(point, vector, vector)))
+
+    def inner(self, point, u, v):
+        raise NotImplementedError
+
+
+class Euclidean(_Manifold):
+    def __init__(self, *shape):
+        self.shape = shape
+
+    def projection(self, point, vector):
+        return vector
+
+    def retraction(self, point, vector):
+        return point + vector
+
+    def inner(self, point, u, v):
+        return float(np.sum(u * v))
+
+
+class Stiefel(_Manifold):
+    """n x p matrices with orthonormal columns."""
+
+    def __init__(self, n, p):
+        self.n, self.p = n, p
+
+    def projection(self, point, vector):
+        xtv = point.T @ vector
+        sym = 0.5 * (xtv + xtv.T)
+        return vector - point @ sym
+
+    def retraction(self, point, vector):
+        q, r = np.linalg.qr(point + vector)
+        # qf retraction: fix the sign so diag(R) > 0 (unique QR)
+        signs = np.sign(np.diag(r))
+        signs[signs == 0] = 1.0
+        return q * signs[np.newaxis, :]
+
+    def inner(self, point, u, v):
+        return float(np.sum(u * v))
+
+
+class Product(_Manifold):
+    def __init__(self, manifolds):
+        self.manifolds = list(manifolds)
+
+    def projection(self, point, vector):
+        return [m.projection(x, g) for m, x, g in
+                zip(self.manifolds, point, vector)]
+
+    def retraction(self, point, vector):
+        return [m.retraction(x, g) for m, x, g in
+                zip(self.manifolds, point, vector)]
+
+    def inner(self, point, u, v):
+        return float(sum(m.inner(x, a, b) for m, x, a, b in
+                         zip(self.manifolds, point, u, v)))
+
+
+def _tensorflow(manifold):
+    """``@function.tensorflow(manifold)`` — wraps a TF cost into an
+    object exposing ``cost(point)`` and ``euclidean_gradient(point)``.
+    """
+    import tensorflow as tf
+
+    def decorator(fn):
+        class _Function:
+            def cost(self, point):
+                args = point if isinstance(point, list) else [point]
+                return float(fn(*[tf.constant(a, dtype=tf.float64)
+                                  for a in args]))
+
+            def euclidean_gradient(self, point):
+                single = not isinstance(point, list)
+                args = [point] if single else point
+                variables = [tf.Variable(a, dtype=tf.float64)
+                             for a in args]
+                with tf.GradientTape() as tape:
+                    value = fn(*variables)
+                grads = tape.gradient(value, variables)
+                out = [np.zeros_like(a) if g is None else g.numpy()
+                       for g, a in zip(grads, args)]
+                return out[0] if single else out
+
+        return _Function()
+
+    return decorator
+
+
+class Problem:
+    def __init__(self, manifold, cost):
+        self.manifold = manifold
+        self._function = cost
+
+    def cost(self, point):
+        return self._function.cost(point)
+
+    def riemannian_gradient(self, point):
+        egrad = self._function.euclidean_gradient(point)
+        return self.manifold.projection(point, egrad)
+
+
+def _scale(manifold, vector, alpha):
+    if isinstance(vector, list):
+        return [alpha * v for v in vector]
+    return alpha * vector
+
+
+def _add(vector, other):
+    if isinstance(vector, list):
+        return [a + b for a, b in zip(vector, other)]
+    return vector + other
+
+
+class ConjugateGradient:
+    """Riemannian Fletcher–Reeves CG with Armijo backtracking and
+    projection-based vector transport."""
+
+    def __init__(self, min_gradient_norm=1e-6, min_step_size=1e-10,
+                 max_iterations=200, verbosity=0):
+        self.min_gradient_norm = min_gradient_norm
+        self.min_step_size = min_step_size
+        self.max_iterations = max_iterations
+
+    def run(self, problem, initial_point):
+        man = problem.manifold
+        x = initial_point
+        cost = problem.cost(x)
+        grad = problem.riemannian_gradient(x)
+        direction = _scale(man, grad, -1.0)
+        gg = man.inner(x, grad, grad)
+        step = 1.0
+        for _ in range(self.max_iterations):
+            gnorm = np.sqrt(gg)
+            if gnorm < self.min_gradient_norm:
+                break
+            # Armijo backtracking along the retracted direction;
+            # start from a slightly grown previous step (pymanopt's
+            # adaptive-initial-step heuristic)
+            slope = man.inner(x, grad, direction)
+            if slope >= 0:  # not a descent direction: restart on -grad
+                direction = _scale(man, grad, -1.0)
+                slope = -gg
+            t = min(step * 2.0, 1.0)
+            accepted = False
+            while t >= 1e-12:
+                candidate = man.retraction(x, _scale(man, direction, t))
+                new_cost = problem.cost(candidate)
+                if new_cost <= cost + 1e-4 * t * slope:
+                    accepted = True
+                    break
+                t *= 0.5
+            if not accepted:
+                break
+            step = t
+            x = candidate
+            cost = new_cost
+            # pymanopt semantics: min_step_size is an OUTER stopping
+            # criterion on the accepted step, not a bound on the line
+            # search — the small step is still taken before stopping
+            if t < self.min_step_size:
+                grad = problem.riemannian_gradient(x)
+                break
+            new_grad = problem.riemannian_gradient(x)
+            new_gg = man.inner(x, new_grad, new_grad)
+            beta = new_gg / gg if gg > 0 else 0.0
+            # transport the previous direction by projection to the
+            # new tangent space
+            transported = man.projection(x, direction)
+            direction = _add(_scale(man, new_grad, -1.0),
+                             _scale(man, transported, beta))
+            grad, gg = new_grad, new_gg
+
+        class _Result:
+            pass
+
+        result = _Result()
+        result.point = x
+        result.cost = cost
+        return result
+
+
+def install(sys_modules):
+    """Register the stand-in under the pymanopt names."""
+    import types
+
+    pymanopt = types.ModuleType("pymanopt")
+    manifolds = types.ModuleType("pymanopt.manifolds")
+    optimizers = types.ModuleType("pymanopt.optimizers")
+    function = types.ModuleType("pymanopt.function")
+
+    manifolds.Stiefel = Stiefel
+    manifolds.Euclidean = Euclidean
+    manifolds.Product = Product
+    optimizers.ConjugateGradient = ConjugateGradient
+    function.tensorflow = _tensorflow
+    pymanopt.Problem = Problem
+    pymanopt.function = function
+    pymanopt.manifolds = manifolds
+    pymanopt.optimizers = optimizers
+
+    sys_modules["pymanopt"] = pymanopt
+    sys_modules["pymanopt.manifolds"] = manifolds
+    sys_modules["pymanopt.optimizers"] = optimizers
+    sys_modules["pymanopt.function"] = function

@@ -3,9 +3,9 @@
 The reference ``brainiak.funcalign`` modules run LIVE from
 /root/reference/src through the single-rank mpi4py stand-in in
 conftest.py (every collective is the identity at size 1, so the
-oracle's numerics are exactly its own).  SSSRM is excluded: its oracle
-needs pymanopt (absent here) and shimming a manifold optimizer would
-replace the very compute under comparison.
+oracle's numerics are exactly its own).  SSSRM is covered separately
+in test_sssrm_oracle.py through the pymanopt stand-in (substitute
+Riemannian CG — see _pymanopt_shim.py for the caveat).
 
 Both implementations start from different random W inits (the repo
 draws via jax PRNG, the reference via numpy RandomState), so tests

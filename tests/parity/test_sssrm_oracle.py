@@ -1,0 +1,102 @@
+"""Live-oracle parity for SSSRM (semi-supervised SRM).
+
+The reference runs through the mini-pymanopt stand-in
+(tests/parity/_pymanopt_shim.py — reference objectives and alternating
+loop, substitute Riemannian CG) with its TF costs on the installed
+TensorFlow.  The repo side replaced TF+pymanopt with a JAX Stiefel CG
+(funcalign/sssrm.py), so the comparison is estimator-level: both must
+classify held-out labeled data to comparable accuracy and recover the
+shared spiral to comparable alignment on identical data.
+"""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.funcalign.sssrm import SSSRM as OurSSSRM
+
+pytest.importorskip("tensorflow")
+
+
+def _semi_supervised_data(seed=0, subjects=3, voxels=30, n_align=60,
+                          features=3, n_labeled=30, classes=2,
+                          noise=0.1):
+    """Spiral shared response for alignment + class-clustered labeled
+    samples mapped through the same per-subject orthonormal bases."""
+    rng = np.random.RandomState(seed)
+    theta = np.linspace(-4 * np.pi, 4 * np.pi, n_align)
+    z = np.linspace(-2, 2, n_align)
+    r = z ** 2 + 1
+    shared = np.vstack((r * np.sin(theta), r * np.cos(theta), z))
+    class_means = rng.randn(features, classes) * 3
+
+    x_align, z_sup, labels, bases = [], [], [], []
+    for _ in range(subjects):
+        q, _ = np.linalg.qr(rng.randn(voxels, features))
+        bases.append(q)
+        x_align.append(q @ shared + noise * rng.randn(voxels, n_align))
+        y = rng.randint(0, classes, n_labeled)
+        zs = class_means[:, y] + 0.3 * rng.randn(features, n_labeled)
+        z_sup.append(q @ zs + noise * rng.randn(voxels, n_labeled))
+        labels.append(y)
+    return x_align, z_sup, labels, shared, class_means, bases
+
+
+def _heldout(rng, bases, class_means, n_test=40, noise=0.1):
+    outs, ys = [], []
+    for q in bases:
+        y = rng.randint(0, class_means.shape[1], n_test)
+        zs = class_means[:, y] + 0.3 * rng.randn(class_means.shape[0],
+                                                 n_test)
+        outs.append(q @ zs + noise * rng.randn(q.shape[0], n_test))
+        ys.append(y)
+    return outs, ys
+
+
+def _aligned_corr(est, truth):
+    u, _, vt = np.linalg.svd(truth @ est.T)
+    est_a = (u @ vt) @ est
+    return float(np.mean([abs(np.corrcoef(est_a[k], truth[k])[0, 1])
+                          for k in range(truth.shape[0])]))
+
+
+def test_sssrm_parity(reference):
+    """Reference sssrm.py:47-560 vs the JAX reimplementation on
+    identical semi-supervised data: held-out classification accuracy
+    and shared-response recovery must be comparable."""
+    import importlib
+    ref_mod = importlib.import_module("brainiak.funcalign.sssrm")
+
+    x_align, z_sup, labels, shared, class_means, bases = \
+        _semi_supervised_data()
+    test_rng = np.random.RandomState(99)
+    z_test, y_test = _heldout(test_rng, bases, class_means)
+
+    ref = ref_mod.SSSRM(n_iter=3, features=3, gamma=1.0, alpha=0.5,
+                        rand_seed=0)
+    ref.fit(x_align, labels, z_sup)
+    ref_pred = ref.predict(z_test)
+    ref_acc = float(np.mean([np.mean(p == y)
+                             for p, y in zip(ref_pred, y_test)]))
+    ref_corr = _aligned_corr(np.asarray(ref.s_), shared)
+
+    ours = OurSSSRM(n_iter=3, features=3, gamma=1.0, alpha=0.5,
+                    rand_seed=0)
+    ours.fit(x_align, labels, z_sup)
+    our_pred = ours.predict(z_test)
+    our_acc = float(np.mean([np.mean(p == y)
+                             for p, y in zip(our_pred, y_test)]))
+    our_corr = _aligned_corr(np.asarray(ours.s_), shared)
+
+    # strong signal: both should classify held-out data well and
+    # recover the spiral
+    assert ref_acc > 0.85, ref_acc
+    assert our_acc > 0.85, our_acc
+    assert abs(ref_acc - our_acc) < 0.1, (ref_acc, our_acc)
+    assert ref_corr > 0.9, ref_corr
+    assert our_corr > 0.9, our_corr
+    assert abs(ref_corr - our_corr) < 0.05, (ref_corr, our_corr)
+
+    # the two MLR decision rules agree on most held-out samples
+    agree = float(np.mean([np.mean(p == q)
+                           for p, q in zip(ref_pred, our_pred)]))
+    assert agree > 0.85, agree
