@@ -155,6 +155,29 @@ def _shard_voxels(arr, mesh, axis):
         arr, NamedSharding(mesh, PartitionSpec(*spec)))
 
 
+def _fetch_ring_matrix(m, mesh):
+    """Host-fetch the ring path's row-sharded [V, V] matrix on every
+    process WITHOUT ever replicating it on a device: the ring exists
+    precisely because V x V does not fit per device, so a blanket
+    replicated relayout (fetch_replicated) would OOM at the scales the
+    path is for.  Instead one shard's row slab is broadcast per
+    dispatch — per-device memory stays O(V^2 / n_shards) and the host
+    assembles the slabs.  Single-process: plain np.asarray (all shards
+    addressable)."""
+    if jax.process_count() == 1:
+        return np.asarray(m)
+    n_shards = mesh.shape[DEFAULT_VOXEL_AXIS]
+    chunk = m.shape[0] // n_shards
+    slab = jax.jit(
+        lambda a, i: jax.lax.dynamic_slice_in_dim(a, i, chunk, 0),
+        out_shardings=NamedSharding(mesh, PartitionSpec()))
+    out = np.empty(m.shape, dtype=m.dtype)
+    for i in range(n_shards):
+        out[i * chunk:(i + 1) * chunk] = np.asarray(
+            slab(m, jnp.asarray(i * chunk)))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # jitted cores
 
@@ -366,8 +389,9 @@ def isfc(data, targets=None, pairwise=False, summary_statistic=None,
         data_j = jnp.asarray(data)
         per_subj = []
         for s in range(n_subjects):
-            m = np.asarray(ring_correlation(
-                data_j[..., s], mesh, data_b=target_means[..., s]))
+            m = _fetch_ring_matrix(ring_correlation(
+                data_j[..., s], mesh, data_b=target_means[..., s]),
+                mesh)
             per_subj.append((m + m.T) / 2 if symmetric else m)
         isfcs = np.stack(per_subj, axis=2)
     else:

@@ -163,3 +163,22 @@ def htfa_worker(process_id, num_processes):
     htfa = HTFA(n_subj=n_subj, mesh=mesh, **HTFA_PARAMS)
     htfa.fit(X, [R_coords] * n_subj)
     return np.asarray(htfa.global_posterior_)
+
+
+def make_isfc_data():
+    return np.random.RandomState(9).randn(24, 16, 4)
+
+
+def isfc_ring_worker(process_id, num_processes):
+    """ISFC via the ppermute ring with the voxel axis sharded AROUND
+    the ring across processes — the long-context-style collective
+    (ops/ring.py) crossing real process boundaries."""
+    import jax
+    from jax.sharding import Mesh
+
+    from brainiak_tpu.isc import isfc
+
+    mesh = Mesh(np.array(jax.devices()), ("voxel",))
+    ts = make_isfc_data()
+    isfcs, iscs = isfc(ts, mesh=mesh, vectorize_isfcs=True)
+    return np.asarray(isfcs), np.asarray(iscs)

@@ -141,3 +141,25 @@ def test_distributed_htfa_matches_single_process():
     np.testing.assert_allclose(results[0],
                                np.asarray(htfa.global_posterior_),
                                atol=1e-3)
+
+
+def test_distributed_isfc_ring_matches_single_process():
+    """The ppermute ring computes V x V leave-one-out ISFC with voxels
+    sharded around a ring that crosses process boundaries; results
+    must match the replicated single-process einsum path."""
+    results = run_distributed("tests.parallel.dist_workers",
+                              "isfc_ring_worker",
+                              n_procs=2, local_devices=2, x64=_x64(),
+                              extra_path=REPO_ROOT)
+    isfcs_0, iscs_0 = results[0]
+    isfcs_1, iscs_1 = results[1]
+    np.testing.assert_array_equal(isfcs_0, isfcs_1)
+    np.testing.assert_array_equal(iscs_0, iscs_1)
+
+    from brainiak_tpu.isc import isfc
+    from tests.parallel.dist_workers import make_isfc_data
+
+    isfcs_s, iscs_s = isfc(make_isfc_data(), vectorize_isfcs=True)
+    atol = mesh_atol()
+    np.testing.assert_allclose(isfcs_0, np.asarray(isfcs_s), atol=atol)
+    np.testing.assert_allclose(iscs_0, np.asarray(iscs_s), atol=atol)
