@@ -121,3 +121,17 @@ def test_example_realtime_decoding():
     out = _run("realtime_decoding.py", "--num-trs", "100")
     assert "incremental decoder accuracy" in out
     assert out.strip().endswith("OK")
+
+
+def test_example_distributed_fcma():
+    out = _run("distributed_fcma.py", "--processes", "2",
+               "--devices-per-process", "2", "--top", "3")
+    # every process prints the same gathered ranking; process output
+    # order is racy, so assert each ranking line appears exactly twice
+    # rather than comparing positional halves
+    from collections import Counter
+    assert out.count("top voxels:") == 2
+    lines = [ln for ln in out.splitlines() if ln.startswith("  voxel ")]
+    assert len(lines) == 6
+    counts = Counter(lines)
+    assert len(counts) == 3 and set(counts.values()) == {2}, counts
