@@ -34,7 +34,7 @@ from sklearn.base import BaseEstimator, TransformerMixin
 from sklearn.exceptions import NotFittedError
 from sklearn.utils import assert_all_finite
 
-from ..parallel.mesh import DEFAULT_SUBJECT_AXIS
+from ..parallel.mesh import DEFAULT_SUBJECT_AXIS, fetch_replicated
 
 __all__ = ["SRM", "DetSRM", "load"]
 
@@ -423,12 +423,17 @@ class SRM(_SRMBase):
                 stacked, trace_xtx, voxel_counts, key, dtype,
                 checkpoint_dir, checkpoint_every)
 
-        w = np.asarray(w)
+        # fetch_replicated on every leaf: under a multi-process mesh
+        # the subject-sharded w/rho2 are not addressable for a plain
+        # np.asarray, and shared/sigma_s are only replicated by GSPMD's
+        # propagation CHOICE (no out_shardings pins it) — the helper is
+        # a no-op when they already are
+        w = fetch_replicated(w, self.mesh)
         self.w_ = [w[i, :voxel_counts[i]] for i in range(len(X))]
-        self.s_ = np.asarray(shared)
-        self.sigma_s_ = np.asarray(sigma_s)
+        self.s_ = fetch_replicated(shared, self.mesh)
+        self.sigma_s_ = fetch_replicated(sigma_s, self.mesh)
         self.mu_ = mu
-        self.rho2_ = np.asarray(rho2)
+        self.rho2_ = fetch_replicated(rho2, self.mesh)
         self.logprob_ = float(ll)
         logger.info('Objective function %f', self.logprob_)
         return self
@@ -491,8 +496,8 @@ class SRM(_SRMBase):
                 stacked, trace_j, counts_j, w, rho2, sigma_s, shared,
                 n_steps=n_steps)
             step += n_steps
-            mngr.save(step, {"w": np.asarray(w),
-                             "rho2": np.asarray(rho2),
+            mngr.save(step, {"w": fetch_replicated(w, self.mesh),
+                             "rho2": fetch_replicated(rho2, self.mesh),
                              "sigma_s": np.asarray(sigma_s),
                              "shared": np.asarray(shared),
                              "fingerprint": fingerprint})
@@ -561,9 +566,9 @@ class DetSRM(_SRMBase):
             stacked, jnp.asarray(voxel_counts).astype(dtype), key,
             features=self.features, n_iter=self.n_iter)
 
-        w = np.asarray(w)
+        w = fetch_replicated(w, self.mesh)
         self.w_ = [w[i, :voxel_counts[i]] for i in range(len(X))]
-        self.s_ = np.asarray(shared)
+        self.s_ = fetch_replicated(shared, self.mesh)
         self.objective_ = float(objective)
         logger.info('Objective function %f', self.objective_)
         return self

@@ -301,7 +301,11 @@ class Searchlight:
 
             cache["sweeps"][(voxel_fn, batch_size)] = sweep
 
-        values = np.asarray(sweep(idx_dev))
+        # fetch_replicated: per-center scalars are tiny, and in a
+        # multi-process run the center-sharded output is not
+        # addressable for a plain np.asarray
+        from ..parallel.mesh import fetch_replicated
+        values = fetch_replicated(sweep(idx_dev), self.mesh)
         if pad:
             values = values[:len(centers)]
         outmat = np.full(self.mask.shape, fill_value, dtype=values.dtype)

@@ -95,7 +95,7 @@ def launcher(args, timeout=300):
     # kill the rest — peers blocked in a collective would otherwise
     # hang to the timeout (same rationale as
     # brainiak_tpu/parallel/testing.py:run_distributed)
-    deadline = time.time() + timeout
+    deadline = time.monotonic() + timeout
     try:
         while True:
             rcs = [p.poll() for p in procs]
@@ -103,7 +103,7 @@ def launcher(args, timeout=300):
                 return
             if any(rc not in (None, 0) for rc in rcs):
                 raise SystemExit(f"worker exit codes: {rcs}")
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise SystemExit(f"timed out after {timeout}s; "
                                  f"exit codes so far: {rcs}")
             time.sleep(0.2)

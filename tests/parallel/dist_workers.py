@@ -182,3 +182,62 @@ def isfc_ring_worker(process_id, num_processes):
     ts = make_isfc_data()
     isfcs, iscs = isfc(ts, mesh=mesh, vectorize_isfcs=True)
     return np.asarray(isfcs), np.asarray(iscs)
+
+
+def make_searchlight_data():
+    rng = np.random.RandomState(11)
+    dim = 7
+    data = [rng.randn(dim, dim, dim, 10).astype(np.float64)
+            for _ in range(2)]
+    mask = np.ones((dim, dim, dim), dtype=bool)
+    return data, mask
+
+
+def searchlight_worker(process_id, num_processes):
+    """Traced-tier searchlight with the center sweep sharded across
+    processes (the analog of the reference's MPI scatter/gather,
+    searchlight.py:301-476)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from brainiak_tpu.searchlight.searchlight import Searchlight
+
+    mesh = Mesh(np.array(jax.devices()), ("voxel",))
+    data, mask = make_searchlight_data()
+    sl = Searchlight(sl_rad=1, mesh=mesh)
+    sl.distribute(data, mask)
+
+    def voxel_fn(patches, mask_patch, rad, bcast):
+        return jnp.mean(patches * mask_patch[None, :, None])
+
+    vol = sl.run_searchlight_jax(voxel_fn, batch_size=64)
+    return np.asarray(vol, dtype=float)
+
+
+def make_srm_class_data():
+    rng = np.random.RandomState(12)
+    n_subjects, voxels, samples, features = 4, 12, 16, 3
+    S = rng.randn(features, samples)
+    X = []
+    for _ in range(n_subjects):
+        q, _ = np.linalg.qr(rng.randn(voxels, features))
+        X.append(q @ S + 0.01 * rng.randn(voxels, samples))
+    return X
+
+
+def srm_class_worker(process_id, num_processes):
+    """The PUBLIC SRM estimator API (fit/w_/s_) under a cross-process
+    subject mesh — exercises the class-level fetches, not just the
+    private jitted core."""
+    import jax
+    from jax.sharding import Mesh
+
+    from brainiak_tpu.funcalign.srm import SRM
+
+    mesh = Mesh(np.array(jax.devices()), ("subject",))
+    X = make_srm_class_data()
+    srm = SRM(n_iter=5, features=3, rand_seed=0, mesh=mesh)
+    srm.fit(X)
+    return ([np.asarray(w) for w in srm.w_], np.asarray(srm.s_),
+            np.asarray(srm.rho2_))

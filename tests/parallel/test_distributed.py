@@ -163,3 +163,53 @@ def test_distributed_isfc_ring_matches_single_process():
     atol = mesh_atol()
     np.testing.assert_allclose(isfcs_0, np.asarray(isfcs_s), atol=atol)
     np.testing.assert_allclose(iscs_0, np.asarray(iscs_s), atol=atol)
+
+
+def test_distributed_searchlight_matches_single_process():
+    results = run_distributed("tests.parallel.dist_workers",
+                              "searchlight_worker",
+                              n_procs=2, local_devices=2, x64=_x64(),
+                              extra_path=REPO_ROOT)
+    np.testing.assert_array_equal(results[0], results[1])
+
+    import jax.numpy as jnp
+
+    from brainiak_tpu.searchlight.searchlight import Searchlight
+    from tests.parallel.dist_workers import make_searchlight_data
+
+    data, mask = make_searchlight_data()
+    sl = Searchlight(sl_rad=1)
+    sl.distribute(data, mask)
+
+    def voxel_fn(patches, mask_patch, rad, bcast):
+        return jnp.mean(patches * mask_patch[None, :, None])
+
+    vol = np.asarray(sl.run_searchlight_jax(voxel_fn, batch_size=64),
+                     dtype=float)
+    np.testing.assert_allclose(results[0], vol, atol=mesh_atol())
+
+
+def test_distributed_srm_class_api_matches_single_process():
+    """The public SRM estimator (not just the jitted core) works under
+    a 2-process mesh: subject-sharded w_/rho2_ are gathered so every
+    process holds the full model."""
+    results = run_distributed("tests.parallel.dist_workers",
+                              "srm_class_worker",
+                              n_procs=2, local_devices=2, x64=_x64(),
+                              extra_path=REPO_ROOT)
+    w_d, s_d, rho2_d = results[0]
+    w_d1, s_d1, rho2_d1 = results[1]
+    for a, b in zip(w_d, w_d1):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(rho2_d, rho2_d1)
+
+    from brainiak_tpu.funcalign.srm import SRM
+    from tests.parallel.dist_workers import make_srm_class_data
+
+    srm = SRM(n_iter=5, features=3, rand_seed=0)
+    srm.fit(make_srm_class_data())
+    atol = mesh_atol()
+    for a, b in zip(w_d, srm.w_):
+        np.testing.assert_allclose(a, b, atol=atol)
+    np.testing.assert_allclose(s_d, srm.s_, atol=atol)
+    np.testing.assert_allclose(rho2_d, srm.rho2_, atol=atol)
