@@ -1040,27 +1040,35 @@ class GBRSA(BRSA):
 
         def fit_U(subjects):
             placed = []
+            run_counts = []
             for x, d, starts, n_runs in subjects:
                 x_j, mask_j = place_voxels(x)
                 placed.append((x_j, mask_j, jnp.asarray(d),
-                               jnp.asarray(starts), n_runs))
-
-            def total_loss(l_flat):
-                total = 0.0
-                for x_j, mask_j, d_j, starts_j, n_runs in placed:
-                    total = total + neg_ll(l_flat, x_j, mask_j, d_j,
-                                           starts_j, n_runs)
-                return total
+                               jnp.asarray(starts)))
+                run_counts.append(n_runs)
 
             flat0 = self.random_state_.randn(n_l) * 0.1 + 0.5
 
+            # ``placed`` is passed as an ARGUMENT, not closed over: a
+            # jitted closure embeds captured arrays as constants, which
+            # requires fetching their full value — impossible for
+            # cross-process-sharded arrays in a multi-process mesh
+            # (run_counts are python ints, safe to capture)
             @jax.jit
-            def run(flat0):
+            def run(flat0, placed_args):
+                def total_loss(l_flat):
+                    total = 0.0
+                    for (x_j, mask_j, d_j, starts_j), n_runs in zip(
+                            placed_args, run_counts):
+                        total = total + neg_ll(l_flat, x_j, mask_j,
+                                               d_j, starts_j, n_runs)
+                    return total
+
                 return minimize_lbfgs(total_loss, flat0,
                                       max_iters=self.lbfgs_iters,
                                       tol=self.tol)
 
-            flat, value = run(jnp.asarray(flat0))
+            flat, value = run(jnp.asarray(flat0), placed)
             return np.asarray(_make_L(jnp.asarray(np.asarray(flat)),
                                       n_c, rank)), float(value)
 

@@ -241,3 +241,29 @@ def srm_class_worker(process_id, num_processes):
     srm.fit(X)
     return ([np.asarray(w) for w in srm.w_], np.asarray(srm.s_),
             np.asarray(srm.rho2_))
+
+
+def make_gbrsa_data():
+    rng = np.random.RandomState(13)
+    n_t, n_v, n_c = 40, 16, 2
+    design = np.zeros((n_t, n_c))
+    design[5:10, 0] = 1.0
+    design[20:25, 1] = 1.0
+    data = design @ rng.randn(n_c, n_v) + rng.randn(n_t, n_v)
+    return data, design, np.array([0, n_t // 2])
+
+
+def gbrsa_worker(process_id, num_processes):
+    """GBRSA with each subject's voxel axis sharded across processes
+    (grid-marginal likelihood is voxelwise independent)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from brainiak_tpu.reprsimil.brsa import GBRSA
+
+    mesh = Mesh(np.array(jax.devices()), ("voxel",))
+    data, design, onsets = make_gbrsa_data()
+    gb = GBRSA(SNR_bins=3, rho_bins=3, lbfgs_iters=15,
+               auto_nuisance=False, random_state=0, mesh=mesh)
+    gb.fit([data], [design], scan_onsets=onsets)
+    return np.asarray(gb.U_), np.asarray(gb.nSNR_)

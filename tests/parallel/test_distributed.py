@@ -213,3 +213,26 @@ def test_distributed_srm_class_api_matches_single_process():
         np.testing.assert_allclose(a, b, atol=atol)
     np.testing.assert_allclose(s_d, srm.s_, atol=atol)
     np.testing.assert_allclose(rho2_d, srm.rho2_, atol=atol)
+
+
+def test_distributed_gbrsa_matches_single_process():
+    results = run_distributed("tests.parallel.dist_workers",
+                              "gbrsa_worker",
+                              n_procs=2, local_devices=2, x64=_x64(),
+                              timeout=480, extra_path=REPO_ROOT)
+    u_0, snr_0 = results[0]
+    u_1, snr_1 = results[1]
+    np.testing.assert_array_equal(u_0, u_1)
+    np.testing.assert_array_equal(snr_0, snr_1)
+
+    from brainiak_tpu.reprsimil.brsa import GBRSA
+    from tests.parallel.dist_workers import make_gbrsa_data
+
+    data, design, onsets = make_gbrsa_data()
+    gb = GBRSA(SNR_bins=3, rho_bins=3, lbfgs_iters=15,
+               auto_nuisance=False, random_state=0)
+    gb.fit([data], [design], scan_onsets=onsets)
+    # cross-shard reduction-order noise is amplified through L-BFGS
+    # steps, so the bound is looser than the elementwise engines'
+    np.testing.assert_allclose(u_0, np.asarray(gb.U_), atol=1e-3)
+    np.testing.assert_allclose(snr_0, np.asarray(gb.nSNR_), atol=1e-3)
