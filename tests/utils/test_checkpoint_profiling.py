@@ -60,3 +60,22 @@ def test_stage_timer():
     assert all(t >= 0.01 for t in times["stage_a"])
     reset_stage_times()
     assert stage_times() == {}
+
+
+def test_stage_timer_sync_target():
+    """The sync branch blocks on the device value before stopping the
+    clock — both via the ``sync=`` argument and via a holder assigned
+    inside the block (the pattern for values created mid-stage)."""
+    import jax.numpy as jnp
+
+    reset_stage_times()
+    x = jnp.ones((64, 64))
+    with stage_timer("stage_sync", sync=x):
+        y = x @ x
+    with stage_timer("stage_sync") as holder:
+        holder["sync"] = {"out": x @ x}  # pytree target
+    times = stage_times()
+    assert len(times["stage_sync"]) == 2
+    assert all(t > 0 for t in times["stage_sync"])
+    assert float(y[0, 0]) == 64.0
+    reset_stage_times()
