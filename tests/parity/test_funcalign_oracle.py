@@ -202,3 +202,48 @@ def test_fastsrm_agreement(reference):
     for k in range(3):
         c = np.corrcoef(aligned[k], np.asarray(ref_shared)[k])[0, 1]
         assert c > 0.95, (k, c)
+
+
+def test_fastsrm_atlas_and_sessions_agreement(reference):
+    """FastSRM's deterministic-atlas reduction and multi-session input
+    (reference fastsrm.py:678-788, :1383-1466) against the repo's on
+    identical data: shared responses agree per session up to rotation."""
+    rng = np.random.RandomState(6)
+    subjects, voxels, features = 3, 48, 3
+    session_lens = (60, 45)
+    # one spiral per session, same per-subject bases
+    sessions_shared = []
+    for n_t in session_lens:
+        theta = np.linspace(-3 * np.pi, 3 * np.pi, n_t)
+        z = np.linspace(-2, 2, n_t)
+        r = z ** 2 + 1
+        sessions_shared.append(
+            np.vstack((r * np.sin(theta), r * np.cos(theta), z)))
+    imgs = []
+    for _ in range(subjects):
+        q, _ = np.linalg.qr(rng.randn(voxels, features))
+        imgs.append([q @ s + 0.1 * rng.randn(voxels, s.shape[1])
+                     for s in sessions_shared])
+    # deterministic atlas: contiguous parcels
+    atlas = np.repeat(np.arange(1, 13), voxels // 12)
+
+    ref = reference.fastsrm.FastSRM(atlas=atlas, n_components=3,
+                                    n_iter=10, seed=0,
+                                    aggregate="mean", verbose=False)
+    ref_shared = ref.fit_transform(imgs)
+    ours = OurFastSRM(atlas=atlas, n_components=3, n_iter=10, seed=0,
+                      aggregate="mean", verbose=False)
+    our_shared = ours.fit_transform(imgs)
+
+    assert len(ref_shared) == len(our_shared) == len(session_lens)
+    for sess, (r_s, o_s, truth) in enumerate(
+            zip(ref_shared, our_shared, sessions_shared)):
+        r_s, o_s = np.asarray(r_s), np.asarray(o_s)
+        assert r_s.shape == o_s.shape == truth.shape
+        assert _aligned_corr(r_s, truth) > 0.9, sess
+        assert _aligned_corr(o_s, truth) > 0.9, sess
+        u, _, vt = np.linalg.svd(r_s @ o_s.T)
+        aligned = (u @ vt) @ o_s
+        for k in range(features):
+            c = np.corrcoef(aligned[k], r_s[k])[0, 1]
+            assert c > 0.95, (sess, k, c)
