@@ -24,16 +24,21 @@ from brainiak_tpu.funcalign.srm import (DetSRM as OurDetSRM, SRM as OurSRM,
                                         load as our_load)
 
 
-def _spiral_data(seed, subjects=4, voxels=60, samples=150, features=3,
-                 noise=0.1):
-    """The reference test-suite's generating process (reference
-    tests/funcalign/test_srm.py:34-63): a 3-D spiral shared response
-    mapped through per-subject orthonormal bases plus white noise."""
-    rng = np.random.RandomState(seed)
-    theta = np.linspace(-4 * np.pi, 4 * np.pi, samples)
+def _spiral(samples, turns=4.0):
+    """The reference test-suite's 3-D spiral shared response
+    (reference tests/funcalign/test_srm.py:34-41)."""
+    theta = np.linspace(-turns * np.pi, turns * np.pi, samples)
     z = np.linspace(-2, 2, samples)
     r = z ** 2 + 1
-    shared = np.vstack((r * np.sin(theta), r * np.cos(theta), z))
+    return np.vstack((r * np.sin(theta), r * np.cos(theta), z))
+
+
+def _spiral_data(seed, subjects=4, voxels=60, samples=150, features=3,
+                 noise=0.1):
+    """Spiral shared response mapped through per-subject orthonormal
+    bases plus white noise (reference tests/funcalign/test_srm.py:34-63)."""
+    rng = np.random.RandomState(seed)
+    shared = _spiral(samples)
     data, bases = [], []
     for _ in range(subjects):
         q, _ = np.linalg.qr(rng.randn(voxels, features))
@@ -212,13 +217,8 @@ def test_fastsrm_atlas_and_sessions_agreement(reference):
     subjects, voxels, features = 3, 48, 3
     session_lens = (60, 45)
     # one spiral per session, same per-subject bases
-    sessions_shared = []
-    for n_t in session_lens:
-        theta = np.linspace(-3 * np.pi, 3 * np.pi, n_t)
-        z = np.linspace(-2, 2, n_t)
-        r = z ** 2 + 1
-        sessions_shared.append(
-            np.vstack((r * np.sin(theta), r * np.cos(theta), z)))
+    sessions_shared = [_spiral(n_t, turns=3.0)
+                       for n_t in session_lens]
     imgs = []
     for _ in range(subjects):
         q, _ = np.linalg.qr(rng.randn(voxels, features))
