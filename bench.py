@@ -101,6 +101,9 @@ def _ts_key(ts):
     if not ts:
         return float("-inf")
     import datetime
+    if ts.endswith("Z"):
+        # fromisoformat rejects a 'Z' suffix before Python 3.11
+        ts = ts[:-1] + "+00:00"
     try:
         return datetime.datetime.fromisoformat(ts).timestamp()
     except ValueError:

@@ -498,8 +498,9 @@ class SRM(_SRMBase):
             step += n_steps
             mngr.save(step, {"w": fetch_replicated(w, self.mesh),
                              "rho2": fetch_replicated(rho2, self.mesh),
-                             "sigma_s": np.asarray(sigma_s),
-                             "shared": np.asarray(shared),
+                             "sigma_s": fetch_replicated(sigma_s,
+                                                         self.mesh),
+                             "shared": fetch_replicated(shared, self.mesh),
                              "fingerprint": fingerprint})
 
         ll = _final_log_likelihood(stacked, w, rho2, sigma_s, trace_j,

@@ -167,6 +167,10 @@ def _fetch_ring_matrix(m, mesh):
     if jax.process_count() == 1:
         return np.asarray(m)
     n_shards = mesh.shape[DEFAULT_VOXEL_AXIS]
+    if m.shape[0] % n_shards:
+        raise ValueError(
+            "row count {} not divisible by {} shards; trailing rows "
+            "would be lost".format(m.shape[0], n_shards))
     chunk = m.shape[0] // n_shards
     slab = jax.jit(
         lambda a, i: jax.lax.dynamic_slice_in_dim(a, i, chunk, 0),

@@ -62,10 +62,10 @@ def fetch_replicated(x, mesh: Optional[Mesh] = None):
         mesh = x.sharding.mesh
     if mesh is None or jax.process_count() == 1:
         return np.asarray(x)
-    rep = jax.jit(
-        lambda a: a,
-        out_shardings=jax.tree.map(
-            lambda _: NamedSharding(mesh, PartitionSpec()), x))(x)
+    # device_put reshards across process boundaries without tracing a
+    # fresh jitted identity per call (which would recompile every fetch:
+    # jit caching keys on function identity).
+    rep = jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
     return np.asarray(rep)
 
 
