@@ -17,6 +17,13 @@ per-voxel.
 Wall-clock timing of ``run()`` is sound here because results are fetched to
 host (which synchronizes) — unlike ``block_until_ready``, which is a no-op
 on this tunneled TPU platform.
+
+Tiers (the JSON line's ``tier`` field reports which one ran): on a
+responsive chip the north-star whole-brain config is attempted first
+(V=65536 correlation width, E=32 — the BASELINE.json scale), then the
+V=8192 mid config, then a reduced CPU fallback.  Each chip tier runs in
+its own subprocess under a timeout so a tunnel wedge mid-tier cannot
+hang the driver's bench invocation.
 """
 
 import json
@@ -31,15 +38,26 @@ N_EPOCHS = 16
 EPOCHS_PER_SUBJ = 4
 NUM_FOLDS = 4
 
+# North-star scale (BASELINE.json: whole-brain FCMA): full MNI-brain
+# correlation width at E>=32.  The rate is measured on a 1024-voxel
+# selection slice against the full width (the two-mask API) — each
+# selected voxel costs exactly the whole-brain per-voxel work, so the
+# steady-state voxels/sec is the whole-brain rate without waiting for
+# all 64k voxels (~2.5 h on this chip; reference regime
+# /root/reference/src/brainiak/fcma/voxelselector.py:89-238).
+WB_VOXELS = 65536
+WB_SELECTED = 1024
+WB_EPOCHS = 32
 
-def make_data(n_voxels=N_VOXELS):
+
+def make_data(n_voxels=N_VOXELS, n_trs=N_TRS, n_epochs=N_EPOCHS):
     rng = np.random.RandomState(0)
     data = []
-    for _ in range(N_EPOCHS):
-        mat = rng.randn(N_TRS, n_voxels).astype(np.float32)
-        mat = (mat - mat.mean(0)) / (mat.std(0) * math.sqrt(N_TRS))
+    for _ in range(n_epochs):
+        mat = rng.randn(n_trs, n_voxels).astype(np.float32)
+        mat = (mat - mat.mean(0)) / (mat.std(0) * math.sqrt(n_trs))
         data.append(mat)
-    labels = [0, 1] * (N_EPOCHS // 2)
+    labels = [0, 1] * (n_epochs // 2)
     return data, labels
 
 
@@ -58,29 +76,50 @@ def tpu_voxels_per_sec(n_voxels=N_VOXELS, unit=512, warm=True):
     return n_voxels / dt
 
 
-def cpu_voxels_per_sec(n_voxels=N_VOXELS, block=64):
+def whole_brain_voxels_per_sec(n_voxels=WB_VOXELS, selected=WB_SELECTED,
+                               n_epochs=WB_EPOCHS):
+    """Steady-state whole-brain-scale selection rate on the accelerator:
+    1024 voxels scored against the full 65536-voxel correlation width
+    through the production path (``run('svm')``, two-mask form).  The
+    warm call pays the one-time upload (device stack is cached across
+    runs) and compile; the timed call is compute-only."""
+    from brainiak_tpu.fcma.voxelselector import VoxelSelector
+
+    data, labels = make_data(n_voxels, n_epochs=n_epochs)
+    sel = [m[:, :selected] for m in data]
+    vs = VoxelSelector(labels, EPOCHS_PER_SUBJ, NUM_FOLDS, sel,
+                       raw_data2=data, voxel_unit=selected)
+    vs.run('svm')
+    t0 = time.perf_counter()
+    results = vs.run('svm')
+    dt = time.perf_counter() - t0
+    assert len(results) == selected
+    return selected / dt
+
+
+def cpu_voxels_per_sec(n_voxels=N_VOXELS, block=64, n_epochs=N_EPOCHS):
     """Reference-path throughput on host BLAS, at the SAME voxel count as
     the jax path being compared (per-voxel cost scales with the full
     correlation width, so mismatched sizes would skew vs_baseline)."""
     from sklearn import model_selection, svm
 
-    data, labels = make_data(n_voxels)
+    data, labels = make_data(n_voxels, n_epochs=n_epochs)
     stacked = np.stack(data)  # [E, T, V]
     t0 = time.perf_counter()
     blk = stacked[:, :, :block]
-    corr = np.stack([blk[e].T @ stacked[e] for e in range(N_EPOCHS)],
+    corr = np.stack([blk[e].T @ stacked[e] for e in range(n_epochs)],
                     axis=1)  # [block, E, V]
     num = 1.0 + corr
     den = 1.0 - corr
     num[num <= 0] = 1e-4
     den[den <= 0] = 1e-4
     z = 0.5 * np.log(num / den)
-    zr = z.reshape(block, N_EPOCHS // EPOCHS_PER_SUBJ, EPOCHS_PER_SUBJ,
+    zr = z.reshape(block, n_epochs // EPOCHS_PER_SUBJ, EPOCHS_PER_SUBJ,
                    n_voxels)
     m = zr.mean(axis=2, keepdims=True)
     var = (zr ** 2).mean(axis=2, keepdims=True) - m ** 2
     inv = np.where(var <= 0, 0.0, 1.0 / np.sqrt(np.maximum(var, 1e-30)))
-    normed = ((zr - m) * inv).reshape(block, N_EPOCHS, n_voxels)
+    normed = ((zr - m) * inv).reshape(block, n_epochs, n_voxels)
     clf = svm.SVC(kernel='precomputed', shrinking=False, C=1)
     skf = model_selection.StratifiedKFold(n_splits=NUM_FOLDS,
                                           shuffle=False)
@@ -181,6 +220,45 @@ def _device_responsive(timeout=150):
         return False
 
 
+def _run_tier_subprocess(tier, timeout):
+    """Run one accelerator tier in a fresh subprocess (one chip process
+    at a time; a wedge mid-tier must not hang THIS process past the
+    driver's patience) and return its parsed JSON result, or None."""
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run([sys.executable, __file__, "--tier", tier],
+                           timeout=timeout, capture_output=True,
+                           text=True)
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def _tier_main(tier):
+    """Child-process entry: run one tier on the ambient (TPU) backend
+    and print its rate as a JSON line.  Env overrides exist so the
+    orchestration can be smoke-tested at toy sizes on CPU."""
+    import os
+    if tier == "wb":
+        vps = whole_brain_voxels_per_sec(
+            n_voxels=int(os.environ.get("BENCH_WB_VOXELS", WB_VOXELS)),
+            selected=int(os.environ.get("BENCH_WB_SELECTED",
+                                        WB_SELECTED)),
+            n_epochs=int(os.environ.get("BENCH_WB_EPOCHS", WB_EPOCHS)))
+    else:
+        vps = tpu_voxels_per_sec(
+            n_voxels=int(os.environ.get("BENCH_MID_VOXELS", N_VOXELS)))
+    print(json.dumps({"voxels_per_sec": vps}))
+
+
 def main():
     # Probe BEFORE any in-process jax backend touch: on a wedged TPU
     # tunnel even backend initialization (jax.default_backend()) hangs.
@@ -195,34 +273,83 @@ def main():
             break
         time.sleep(90)
         responsive = _device_responsive()
-    import jax
 
-    if not responsive:
-        # fall back to CPU so the driver records a number instead of a
-        # hung process (reduced size: the full problem takes tens of
-        # minutes on CPU)
-        jax.config.update("jax_platforms", "cpu")
-        vps = tpu_voxels_per_sec(n_voxels=2048, unit=256)
-        cpu_vps = cpu_voxels_per_sec(n_voxels=2048, block=32)
-        print(json.dumps({
-            "metric": "fcma_voxel_selection_voxels_per_sec_chip"
-                      "_CPU_FALLBACK_tpu_unresponsive",
-            "value": round(vps, 2),
-            "unit": "voxels/sec",
-            "vs_baseline": round(vps / cpu_vps, 2),
-            **_last_onchip(),
-        }))
-        return
-    tpu_vps = tpu_voxels_per_sec()
-    cpu_vps = cpu_voxels_per_sec()
+    # the same env overrides the tier children read (_tier_main), so
+    # the emitted config and the CPU-baseline scale always match what
+    # the child actually measured — even under the smoke-test sizes
+    import os
+    wb_voxels = int(os.environ.get("BENCH_WB_VOXELS", WB_VOXELS))
+    wb_selected = int(os.environ.get("BENCH_WB_SELECTED", WB_SELECTED))
+    wb_epochs = int(os.environ.get("BENCH_WB_EPOCHS", WB_EPOCHS))
+    mid_voxels = int(os.environ.get("BENCH_MID_VOXELS", N_VOXELS))
+
+    if responsive:
+        # North-star tier first (BASELINE.json scale: whole-brain
+        # width, E>=32); each tier in its own subprocess so a mid-run
+        # wedge cannot hang the bench.  The timeout is a last-resort
+        # tradeoff: killing mid-dispatch can deepen a wedge, but an
+        # unbounded child would hang the driver's bench invocation
+        # outright — so the ceiling is sized at ~2.5x the expected
+        # healthy-chip wall time (upload + compile + 2 runs ~ 8 min)
+        # and a probe runs before committing the next tier.
+        out = _run_tier_subprocess("wb", timeout=1200)
+        if out:
+            vps = out["voxels_per_sec"]
+            cpu_vps = cpu_voxels_per_sec(n_voxels=wb_voxels, block=8,
+                                         n_epochs=wb_epochs)
+            print(json.dumps({
+                "metric": "fcma_voxel_selection_voxels_per_sec_chip",
+                "value": round(vps, 2),
+                "unit": "voxels/sec",
+                "vs_baseline": round(vps / cpu_vps, 2),
+                "tier": "whole_brain",
+                "config": {"n_voxels": wb_voxels,
+                           "selected": wb_selected,
+                           "n_epochs": wb_epochs, "n_trs": N_TRS},
+                **_last_onchip(),
+            }))
+            return
+        # the wb attempt may have wedged the tunnel — re-probe cheaply
+        # before committing the mid tier to the chip
+        if _device_responsive(timeout=90):
+            out = _run_tier_subprocess("mid", timeout=420)
+            if out:
+                vps = out["voxels_per_sec"]
+                cpu_vps = cpu_voxels_per_sec(n_voxels=mid_voxels)
+                print(json.dumps({
+                    "metric": "fcma_voxel_selection_voxels_per_sec"
+                              "_chip",
+                    "value": round(vps, 2),
+                    "unit": "voxels/sec",
+                    "vs_baseline": round(vps / cpu_vps, 2),
+                    "tier": "mid_V8192",
+                    "config": {"n_voxels": mid_voxels,
+                               "n_epochs": N_EPOCHS, "n_trs": N_TRS},
+                    **_last_onchip(),
+                }))
+                return
+
+    # fall back to CPU so the driver records a number instead of a
+    # hung process (reduced size: the full problem takes tens of
+    # minutes on CPU)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    vps = tpu_voxels_per_sec(n_voxels=2048, unit=256)
+    cpu_vps = cpu_voxels_per_sec(n_voxels=2048, block=32)
     print(json.dumps({
-        "metric": "fcma_voxel_selection_voxels_per_sec_chip",
-        "value": round(tpu_vps, 2),
+        "metric": "fcma_voxel_selection_voxels_per_sec_chip"
+                  "_CPU_FALLBACK_tpu_unresponsive",
+        "value": round(vps, 2),
         "unit": "voxels/sec",
-        "vs_baseline": round(tpu_vps / cpu_vps, 2),
+        "vs_baseline": round(vps / cpu_vps, 2),
+        "tier": "cpu_fallback",
         **_last_onchip(),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if len(sys.argv) >= 3 and sys.argv[1] == "--tier":
+        _tier_main(sys.argv[2])
+    else:
+        main()

@@ -37,6 +37,12 @@ N_EPOCHS = 16
 EPOCHS_PER_SUBJ = 4
 NUM_FOLDS = 4
 
+# --smoke: interpret-mode Pallas at toy shapes on CPU — validates the
+# harness end to end (imports, call signatures, JSON assembly) without
+# a chip, so the one healthy-chip window is never spent debugging this
+# script.  Writes no artifact.
+INTERPRET = False
+
 
 def _fetch(x):
     """Host fetch: synchronizes on the tunneled TPU platform (where
@@ -45,7 +51,11 @@ def _fetch(x):
     return jax.tree.map(np.asarray, x)
 
 
-def make_epoch_data(n_voxels, n_trs=N_TRS, n_epochs=N_EPOCHS, seed=0):
+def make_epoch_data(n_voxels, n_trs=None, n_epochs=None, seed=0):
+    # None -> module globals at CALL time (def-time defaults would pin
+    # the pre---smoke sizes)
+    n_trs = N_TRS if n_trs is None else n_trs
+    n_epochs = N_EPOCHS if n_epochs is None else n_epochs
     rng = np.random.RandomState(seed)
     data = []
     for _ in range(n_epochs):
@@ -86,7 +96,8 @@ def kernel_parity_and_throughput():
     (ref_k, ref_c), t_xla = time_call(_block_kernel_matrices, blk, data,
                                       EPOCHS_PER_SUBJ)
     (out_k, out_c), t_pal = time_call(_block_kernel_matrices_pallas,
-                                      blk, data, EPOCHS_PER_SUBJ)
+                                      blk, data, EPOCHS_PER_SUBJ,
+                                      interpret=INTERPRET)
     delta = float(jnp.max(jnp.abs(out_c - ref_c)))
     res["corr_normalize"] = {
         "max_abs_delta_corr": delta,
@@ -100,7 +111,7 @@ def kernel_parity_and_throughput():
     ref_g, t_xla_g = time_call(_block_gram_xla, blk, data,
                                EPOCHS_PER_SUBJ)
     out_g, t_pal_g = time_call(_block_gram_pallas, blk, data,
-                               EPOCHS_PER_SUBJ)
+                               EPOCHS_PER_SUBJ, interpret=INTERPRET)
     scale = float(jnp.max(jnp.abs(ref_g)))
     delta_g = float(jnp.max(jnp.abs(out_g - ref_g))) / scale
     res["gram"] = {
@@ -110,7 +121,7 @@ def kernel_parity_and_throughput():
     }
 
     # --- fcma_sample_gram (classifier feature Gram) ---
-    n_samples, v1, v2 = 16, 1024, N_VOXELS
+    n_samples, v1, v2 = 16, min(1024, N_VOXELS), N_VOXELS
     x1 = jnp.asarray(make_epoch_data(v1, n_epochs=n_samples, seed=1))
     x2 = jnp.asarray(make_epoch_data(v2, n_epochs=n_samples, seed=2))
 
@@ -129,7 +140,7 @@ def kernel_parity_and_throughput():
 
     ref_s, t_xla_s = time_call(xla_sample_gram, x1, x2)
     out_s, t_pal_s = time_call(fcma_sample_gram, x1, x2,
-                               EPOCHS_PER_SUBJ, interpret=False)
+                               EPOCHS_PER_SUBJ, interpret=INTERPRET)
     scale_s = float(jnp.max(jnp.abs(ref_s)))
     delta_s = float(jnp.max(jnp.abs(out_s - ref_s))) / scale_s
     res["sample_gram"] = {
@@ -140,10 +151,12 @@ def kernel_parity_and_throughput():
     return res
 
 
-def end_to_end(n_voxels=N_VOXELS, unit=512):
+def end_to_end(n_voxels=None, unit=512):
     """VoxelSelector end-to-end: pallas vs xla, precision sweep."""
     from brainiak_tpu.fcma.voxelselector import VoxelSelector
 
+    n_voxels = N_VOXELS if n_voxels is None else n_voxels
+    unit = min(unit, n_voxels)
     data = list(make_epoch_data(n_voxels))
     labels = [0, 1] * (N_EPOCHS // 2)
     res = {}
@@ -172,9 +185,23 @@ def end_to_end(n_voxels=N_VOXELS, unit=512):
 
 
 def main():
+    import argparse
     import datetime
 
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes, interpret-mode Pallas, CPU: "
+                         "validates the harness without a chip; "
+                         "writes no artifact")
+    args = ap.parse_args()
+
     import jax
+    if args.smoke:
+        global N_VOXELS, N_BLOCK, N_TRS, N_EPOCHS, INTERPRET
+        jax.config.update("jax_platforms", "cpu")
+        N_VOXELS, N_BLOCK, N_TRS, N_EPOCHS = 512, 64, 40, 8
+        INTERPRET = True
+
     backend = jax.default_backend()
     out = {"backend": backend,
            "ts": datetime.datetime.now(datetime.timezone.utc)
@@ -185,6 +212,9 @@ def main():
     out["kernels"] = kernel_parity_and_throughput()
     print(json.dumps(out["kernels"], indent=2), file=sys.stderr)
     out["end_to_end"] = end_to_end()
+    if args.smoke:
+        print(json.dumps(out, indent=2))
+        return
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "TPU_VALIDATION.json")
     with open(path, "w") as f:

@@ -185,6 +185,113 @@ def test_pallas_gram_kernel_matches_unfused():
     assert np.allclose(got_pad, got, atol=1e-5)
 
 
+def test_pallas_production_tiles_multistep():
+    """The fused kernels at REAL production tile sizes — the tiles
+    ``pick_tiles`` returns on a TPU for whole-brain extents — with a
+    multi-step voxel reduction and nonzero padding on both voxel axes.
+
+    The tiny-tile tests above (tile_b=8/tile_v=16) cannot catch a
+    layout or padding bug that only appears at the (128, 512) tiles the
+    chip actually runs; this bounded interpret-mode case executes that
+    grid: 2 block tiles x 2 voxel tiles, 56 pad lanes on B and 24 on V.
+    """
+    import jax.numpy as jnp
+
+    from brainiak_tpu.ops.pallas_kernels import (
+        fcma_corr_normalize,
+        fcma_gram,
+        pad_to_tiles,
+        pick_tiles,
+    )
+
+    E, T, B, V, eps = 8, 16, 200, 1000, 4
+    assert pick_tiles(E, T, B, V) == (128, 512, True)
+
+    # DISJOINT selected/all voxel sets (the two-mask form): no
+    # self-correlation knife edges, so Pallas and XLA must agree
+    # tightly at EVERY entry and the Gram oracle can come from the
+    # independent XLA pipeline (clamp semantics have their own test
+    # below)
+    rng = np.random.RandomState(7)
+    data = rng.randn(E, T, V + B).astype(np.float32)
+    norm = np.asarray(normalize_for_correlation(
+        jnp.asarray(data).transpose(0, 2, 1), 2)).transpose(0, 2, 1)
+    blk, norm = norm[:, :, V:], norm[:, :, :V]
+
+    blk_p, data_p, tile_b, tile_v, fits = pad_to_tiles(
+        jnp.asarray(blk), jnp.asarray(norm))
+    assert fits and (tile_b, tile_v) == (128, 512)
+    assert blk_p.shape == (E, T, 256) and data_p.shape == (E, T, 1024)
+
+    got = np.asarray(fcma_corr_normalize(
+        blk_p, data_p, eps, tile_b=tile_b, tile_v=tile_v,
+        interpret=True))[:B, :, :V]
+    expected = np.asarray(within_subject_normalization(
+        np.asarray(correlate_epochs(
+            jnp.asarray(blk.transpose(0, 2, 1)),
+            jnp.asarray(norm.transpose(0, 2, 1)))), eps))
+    # 5e-4: the per-subject z-score divides by an across-4-epochs std
+    # that can be small, amplifying fp32 summation-order noise; layout
+    # or padding bugs produce O(1) errors, far above this
+    assert np.allclose(got, expected, atol=5e-4)
+
+    # the Gram's voxel grid axis takes TWO accumulation steps here, and
+    # the 24 zero pad lanes must contribute exactly nothing — the
+    # oracle is the XLA path's UNPADDED normalized correlation, so a
+    # pad-lane leak shared by both Pallas outputs cannot cancel
+    got_gram = np.asarray(fcma_gram(
+        blk_p, data_p, eps, tile_b=tile_b, tile_v=tile_v,
+        interpret=True))[:B]
+    expected_gram = np.einsum('bev,bfv->bef', expected, expected)
+    assert np.allclose(got_gram, expected_gram, rtol=1e-4, atol=1e-2)
+
+
+def test_pallas_clamp_confinement():
+    """Pallas-vs-XLA normalized correlation agrees to fp32 tolerance
+    everywhere EXCEPT entries whose subject-epoch group contains a
+    clamped |r| -> 1 correlation.
+
+    At |r| -> 1 the Fisher z derivative diverges, so last-ulp
+    correlation differences between the two matmul pipelines legally
+    explode there — and the per-subject z-score then spreads that
+    entry's delta across its whole (voxel-pair, subject) group.  This
+    test pins that the large deltas are CONFINED to those groups: a
+    regression leaking error into mid-range r fails the tight branch.
+    """
+    import jax.numpy as jnp
+
+    from brainiak_tpu.ops.pallas_kernels import fcma_corr_normalize
+
+    E, T, B, V, eps = 8, 20, 16, 32, 4
+    rng = np.random.RandomState(3)
+    data = rng.randn(E, T, V).astype(np.float32)
+    data[:, :, 21] = data[:, :, 5]    # r = +1 against block voxel 5
+    data[:, :, 27] = -data[:, :, 11]  # r = -1 against block voxel 11
+    norm = np.asarray(normalize_for_correlation(
+        jnp.asarray(data).transpose(0, 2, 1), 2)).transpose(0, 2, 1)
+    blk = norm[:, :, :B]
+
+    corr = np.asarray(correlate_epochs(
+        jnp.asarray(blk.transpose(0, 2, 1)),
+        jnp.asarray(norm.transpose(0, 2, 1))))  # [B, E, V]
+    expected = np.asarray(within_subject_normalization(corr, eps))
+    got = np.asarray(fcma_corr_normalize(
+        jnp.asarray(blk), jnp.asarray(norm), eps, tile_b=8, tile_v=16,
+        interpret=True))
+
+    # a near-clamp r anywhere in a subject's epochs poisons that whole
+    # (voxel-pair, subject) z-score group
+    near = (np.abs(corr) > 0.999).reshape(B, E // eps, eps, V)
+    poisoned = np.broadcast_to(
+        near.any(axis=2, keepdims=True), near.shape).reshape(B, E, V)
+    # the planted duplicates (and self-correlations) must actually be
+    # exercising the clamp, and must not drown the clean set
+    assert poisoned.any() and poisoned[5, :, 21].all() \
+        and poisoned[11, :, 27].all()
+    assert (~poisoned).mean() > 0.9
+    assert np.allclose(got[~poisoned], expected[~poisoned], atol=1e-4)
+
+
 def test_ring_correlation_matches_dense():
     """Ring-sharded V x V correlation over an 8-way voxel mesh equals the
     dense corrcoef, with only shard-resident data per device."""
