@@ -66,7 +66,7 @@ def tpu_voxels_per_sec(n_voxels=N_VOXELS, unit=512, warm=True):
 
     data, labels = make_data(n_voxels)
     vs = VoxelSelector(labels, EPOCHS_PER_SUBJ, NUM_FOLDS, data,
-                       voxel_unit=unit)
+                       voxel_unit=min(unit, n_voxels))
     if warm:
         vs.run('svm')  # warm compile caches
     t0 = time.perf_counter()
@@ -231,8 +231,15 @@ def _run_tier_subprocess(tier, timeout):
                            timeout=timeout, capture_output=True,
                            text=True)
     except subprocess.TimeoutExpired:
+        print(f"tier {tier}: timed out after {timeout}s",
+              file=sys.stderr)
         return None
     if r.returncode != 0:
+        # keep the child's traceback: a failed whole-brain attempt in
+        # the rare healthy-chip window must leave a diagnostic behind
+        tail = "\n".join((r.stderr or "").strip().splitlines()[-15:])
+        print(f"tier {tier}: rc={r.returncode}\n{tail}",
+              file=sys.stderr)
         return None
     for line in reversed(r.stdout.strip().splitlines()):
         try:
@@ -245,8 +252,15 @@ def _run_tier_subprocess(tier, timeout):
 def _tier_main(tier):
     """Child-process entry: run one tier on the ambient (TPU) backend
     and print its rate as a JSON line.  Env overrides exist so the
-    orchestration can be smoke-tested at toy sizes on CPU."""
+    orchestration can be smoke-tested at toy sizes on CPU — set
+    ``BENCH_FORCE_CPU=1`` for that (the JAX_PLATFORMS env var alone
+    HANGS once the tunnel PJRT plugin is registered; the platform must
+    be pinned in-process before backend init, docs/performance.md
+    operational rule 4)."""
     import os
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     if tier == "wb":
         vps = whole_brain_voxels_per_sec(
             n_voxels=int(os.environ.get("BENCH_WB_VOXELS", WB_VOXELS)),

@@ -136,3 +136,29 @@ def test_htfa_input_validation():
         htfa.fit(X, R[:1])
     with pytest.raises(TypeError):
         htfa.fit([X[0], X[1][:-3]], R)
+    with pytest.raises(TypeError):
+        htfa.fit(X, [R[0], R[1].ravel()])
+    with pytest.raises(ValueError, match="weight_method"):
+        HTFA(K=2, n_subj=2, weight_method='bogus').fit(X, R)
+    # a mesh without the subject axis is a config error, not a crash
+    import jax
+    from jax.sharding import Mesh
+    with pytest.raises(ValueError, match="subject"):
+        HTFA(K=2, n_subj=2,
+             mesh=Mesh(np.array(jax.devices()[:1]), ("wrong",))
+             ).fit(X, R)
+
+
+def test_htfa_verbose_logging(caplog):
+    """verbose=True routes global-iteration progress through the module
+    logger (the reference prints per-iteration diagnostics,
+    htfa.py:766-841)."""
+    import logging
+
+    X, R, _, _ = make_multi_subject(n_subj=2)
+    with caplog.at_level(logging.INFO,
+                         logger="brainiak_tpu.factoranalysis.htfa"):
+        HTFA(K=2, n_subj=2, max_global_iter=2, max_local_iter=2,
+             max_voxel=30, max_tr=20, verbose=True).fit(X, R)
+    assert any("HTFA" in r.message or "global iter" in r.message
+               for r in caplog.records)

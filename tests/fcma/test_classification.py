@@ -121,6 +121,49 @@ def test_classification_logistic_regression():
     assert hamming(y_pred, expected_output) * 8 <= 1
 
 
+def test_classification_asymmetric_regions():
+    """Region1 narrower than region2 triggers the internal swap (the
+    correlation is symmetric, so predictions must not depend on pair
+    order) — reference classifier.py:426-505 semantics."""
+    fake_small = [create_epoch(i, 3) for i in range(20)]
+    fake_large = [create_epoch(i, 7) for i in range(20)]
+    labels = [0, 1] * 10
+    svm_clf = svm.SVC(kernel='precomputed', shrinking=False, C=1,
+                      gamma='auto')
+
+    fwd = Classifier(svm_clf, epochs_per_subj=4)
+    fwd.fit(list(zip(fake_small[:12], fake_large[:12])), labels[:12])
+    pred_fwd = fwd.predict(list(zip(fake_small[12:], fake_large[12:])))
+
+    svm_clf2 = svm.SVC(kernel='precomputed', shrinking=False, C=1,
+                       gamma='auto')
+    rev = Classifier(svm_clf2, epochs_per_subj=4)
+    rev.fit(list(zip(fake_large[:12], fake_small[:12])), labels[:12])
+    pred_rev = rev.predict(list(zip(fake_large[12:], fake_small[12:])))
+
+    np.testing.assert_array_equal(pred_fwd, pred_rev)
+    assert fwd.num_features_ == rev.num_features_ == 21
+
+
+def test_classification_num_training_samples_warning(caplog):
+    """num_training_samples with a non-precomputed classifier is
+    ignored with a warning, not an error (reference
+    classifier.py:426-470)."""
+    import logging
+
+    fake_raw_data = [create_epoch(i, 5) for i in range(12)]
+    labels = [0, 1] * 6
+    clf = Classifier(LogisticRegression(), epochs_per_subj=4)
+    with caplog.at_level(logging.WARNING,
+                         logger="brainiak_tpu.fcma.classifier"):
+        clf.fit(list(zip(fake_raw_data, fake_raw_data)), labels,
+                num_training_samples=8)
+    assert any("num_training_samples" in r.message
+               for r in caplog.records)
+    preds = clf.predict(list(zip(fake_raw_data, fake_raw_data)))
+    assert len(preds) == 12
+
+
 def test_classification_errors():
     import pytest
 

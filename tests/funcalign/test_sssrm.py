@@ -51,6 +51,32 @@ def test_sssrm_improves_alignment():
         assert c > 0.9
 
 
+def test_sssrm_unsupervised_subject():
+    """A subject with no labeled data (Z/y entries None) still joins
+    alignment through the unsupervised Stiefel update (reference
+    sssrm.py:133-202 allows missing supervised data per subject), and
+    transform/predict return None for that subject's None inputs."""
+    X, y, Z = make_sssrm_data(n_subjects=3)
+    y[1], Z[1] = None, None
+    model = SSSRM(n_iter=3, features=3, gamma=1.0, alpha=0.5)
+    model.fit(X, y, Z)
+    assert len(model.w_) == 3
+    for w in model.w_:
+        assert np.allclose(w.T @ w, np.eye(3), atol=1e-5)
+    # the unlabeled subject still aligns to the shared response
+    proj = model.transform(X)
+    c = np.corrcoef(proj[0].ravel(), proj[1].ravel())[0, 1]
+    assert c > 0.9
+    preds = model.predict([Z[0], None, Z[2]])
+    assert preds[1] is None
+    acc = np.mean([np.mean(p == yy)
+                   for p, yy in zip((preds[0], preds[2]),
+                                    (y[0], y[2]))])
+    assert acc > 0.85
+    s = model.transform([X[0], None, X[2]])
+    assert s[1] is None and s[0].shape == (3, 40)
+
+
 def test_sssrm_errors():
     X, y, Z = make_sssrm_data(n_subjects=2)
     with pytest.raises(ValueError):

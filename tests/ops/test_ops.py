@@ -246,6 +246,53 @@ def test_pallas_production_tiles_multistep():
     assert np.allclose(got_gram, expected_gram, rtol=1e-4, atol=1e-2)
 
 
+def test_pick_tiles_budget_edges():
+    """The VMEM tile chooser across its regimes: shrink-to-fit on the
+    voxel then block axis, the doesn't-fit signal, and the callers'
+    fallback contract (ValueError pointing at the XLA path)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from brainiak_tpu.ops.pallas_kernels import (
+        _VMEM_BUDGET_FLOATS,
+        fcma_corr_normalize,
+        fcma_gram,
+        fcma_sample_gram,
+        pad_to_tiles,
+        pick_tiles,
+    )
+
+    def used(e, t, tb, tv):
+        return 2 * e * t * (tb + tv) + 5 * e * tb * tv
+
+    # whole-brain E=32: (128, 512) blows the budget, the chooser must
+    # shrink and what it returns must actually fit
+    tb, tv, fits = pick_tiles(32, 150, 1024, 65536)
+    assert fits and used(32, 150, tb, tv) <= _VMEM_BUDGET_FLOATS
+    assert tb in (8, 16, 32, 64, 128) and tv % 128 == 0
+
+    # epoch x TR extent so large even (8, 128) tiles exceed the budget
+    big_e, big_t = 64, 4096  # 2*64*4096*(8+128) ~ 71M floats
+    tb, tv, fits = pick_tiles(big_e, big_t, 256, 1024)
+    assert not fits
+
+    # callers refuse with a pointer to the XLA fallback...
+    blk = jnp.zeros((big_e, big_t, 8), jnp.float32)
+    data = jnp.zeros((big_e, big_t, 128), jnp.float32)
+    with pytest.raises(ValueError, match="XLA path"):
+        fcma_corr_normalize(blk, data, 4, interpret=True)
+    with pytest.raises(ValueError, match="XLA path"):
+        fcma_gram(blk, data, 4, interpret=True)
+    with pytest.raises(ValueError, match="XLA path"):
+        fcma_sample_gram(blk, data, 4, interpret=True)
+    # ...and pad_to_tiles reports the no-fit without padding anything
+    blk_p, data_p, _, _, fits = pad_to_tiles(blk, data)
+    assert not fits and blk_p is blk and data_p is data
+
+    # volumes smaller than one tile clamp to the full extent
+    assert pick_tiles(8, 40, 4, 60) == (4, 60, True)
+
+
 def test_pallas_clamp_confinement():
     """Pallas-vs-XLA normalized correlation agrees to fp32 tolerance
     everywhere EXCEPT entries whose subject-epoch group contains a

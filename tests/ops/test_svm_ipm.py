@@ -46,6 +46,39 @@ def test_ipm_matches_sklearn_duals():
     assert checked >= 8
 
 
+def test_cv_chunked_path_matches_unchunked():
+    """Voxel batches beyond the VMEM chunk budget split into multiple
+    _cv_batch dispatches; the split must be invisible in the results."""
+    import brainiak_tpu.ops.svm as svm_mod
+
+    rng = np.random.RandomState(5)
+    n_epochs = 8
+    labels = np.array([0, 1] * 4)
+    feats = rng.randn(6, n_epochs, 16).astype(np.float32)
+    kernels = np.einsum('vef,vgf->veg', feats, feats)
+    whole, whole_gap = svm_cv_accuracy(kernels, labels, 2, n_iters=30,
+                                       return_gap=True)
+    budget = svm_mod._CV_CHUNK_BUDGET_FLOATS
+    svm_mod._CV_CHUNK_BUDGET_FLOATS = 1  # force chunk=1: 6 dispatches
+    try:
+        parts, parts_gap = svm_cv_accuracy(kernels, labels, 2,
+                                           n_iters=30, return_gap=True)
+    finally:
+        svm_mod._CV_CHUNK_BUDGET_FLOATS = budget
+    np.testing.assert_allclose(np.asarray(parts), np.asarray(whole),
+                               atol=0)
+    np.testing.assert_allclose(np.asarray(parts_gap),
+                               np.asarray(whole_gap), rtol=1e-6)
+
+
+def test_cv_rejects_single_class():
+    rng = np.random.RandomState(6)
+    kernels = rng.randn(2, 8, 8).astype(np.float32)
+    import pytest
+    with pytest.raises(ValueError, match="two classes"):
+        svm_cv_accuracy(kernels, np.zeros(8, dtype=int), 2)
+
+
 def test_ipm_cv_float32():
     """fp32 regression: as the interior path converges, ``ub - a``
     underflows at fp32 ulp and the barrier divisions NaN without the

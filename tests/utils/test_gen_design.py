@@ -70,6 +70,49 @@ def test_gen_design_bad_style(stim_files):
                    style='AFNI')
 
 
+def test_gen_design_arg_validation(stim_files):
+    with pytest.raises(ValueError, match="TR"):
+        gen_design([stim_files["fsl1"]], scan_duration=[48], TR=0)
+    with pytest.raises(ValueError, match="scan_duration"):
+        gen_design([stim_files["fsl1"]], scan_duration=[1], TR=2)
+    # a single path is promoted to a one-element list
+    single = gen_design(stim_files["fsl1"], scan_duration=[48], TR=2)
+    listed = gen_design([stim_files["fsl1"]], scan_duration=[48], TR=2)
+    np.testing.assert_array_equal(single, listed)
+
+
+def test_gen_design_fsl_short_columns(tmp_path):
+    """FSL rows may omit duration and weight (default 1.0) — reference
+    utils.py gen_design accepts 1-3 column rows."""
+    full = tmp_path / "full.txt"
+    full.write_text("5.0 1.0 1.0\n20.0 1.0 1.0\n")
+    short = tmp_path / "short.txt"
+    short.write_text("5.0\n20.0\n")
+    d_full = gen_design([str(full)], scan_duration=[48], TR=2)
+    d_short = gen_design([str(short)], scan_duration=[48], TR=2)
+    np.testing.assert_allclose(d_short, d_full)
+
+
+def test_read_design_header_mismatch_warns(tmp_path):
+    """A header ncol that disagrees with the matrix falls back to the
+    matrix's column count with a warning (reference utils.py
+    ReadDesign semantics)."""
+    import warnings
+
+    ref = ReadDesign("/root/reference/tests/utils/example_design.1D")
+    text = open("/root/reference/tests/utils/example_design.1D").read()
+    bad = text.replace(f'ni_type = "{ref.n_col}*double"',
+                       f'ni_type = "{ref.n_col + 3}*double"')
+    assert bad != text
+    p = tmp_path / "bad.1D"
+    p.write_text(bad)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        d = ReadDesign(str(p))
+    assert d.n_col == ref.n_col
+    assert any("columns" in str(w.message) for w in caught)
+
+
 def test_read_design_afni_fixture():
     # Real AFNI 3dDeconvolve output from the reference test data (read-only).
     d = ReadDesign("/root/reference/tests/utils/example_design.1D")
