@@ -702,10 +702,11 @@ def _generate_noise_system(dimensions_tr, spatial_sd, temporal_sd,
 
     spatial = noise_volume(dimensions_tr[:3], spatial_noise_type)
     temporal = noise_volume(dimensions_tr, temporal_noise_type)
-    if temporal_noise_type == 'rician':
-        temporal = temporal - 1.91
-    if spatial_noise_type == 'rician':
-        spatial = spatial - 1.91
+    # the temporal component is demeaned per voxel over time — exact,
+    # not a distribution-mean constant — while the spatial pattern
+    # keeps its raw location (reference fmrisim.py:1440-1482: a rician/
+    # exponential spatial mean is part of the scanner's stable pattern)
+    temporal = temporal - temporal.mean(axis=3, keepdims=True)
     return temporal * temporal_sd + \
         np.broadcast_to(spatial[..., np.newaxis] * spatial_sd,
                         dimensions_tr)

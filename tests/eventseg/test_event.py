@@ -19,6 +19,14 @@ def test_fit_shapes():
     assert test_segments.shape == (T2, K)
     assert np.allclose(np.sum(test_segments, axis=1), 1.0)
     assert np.isfinite(test_ll)
+    # scramble=True permutes the learned patterns (the reference's
+    # null-model control for find_events)
+    np.random.seed(4)
+    scr_segments, scr_ll = es.find_events(rng.rand(V, T2).T,
+                                          scramble=True)
+    assert scr_segments.shape == (T2, K)
+    assert np.allclose(np.sum(scr_segments, axis=1), 1.0)
+    assert np.isfinite(scr_ll)
 
     with pytest.raises(ValueError):
         EventSegment(K).model_prior(K - 1)

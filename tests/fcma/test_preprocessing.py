@@ -75,6 +75,25 @@ def test_prepare_mvpa_data_matches_reference_golden():
     assert np.array_equal(labels, EXPECTED_LABELS)
 
 
+def test_prepare_searchlight_mvpa_data_randomized():
+    """Randomization permutes each subject's TRs before epoch
+    averaging (reference preprocessing.py:328-414): labels and shape
+    are unchanged, REPRODUCIBLE is deterministic across runs."""
+    conditions = io.load_labels(EPOCH_FILE)
+    images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
+    base, base_labels = prepare_searchlight_mvpa_data(images, conditions)
+    images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
+    r1, labels1 = prepare_searchlight_mvpa_data(
+        images, conditions, random=RandomType.REPRODUCIBLE)
+    images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
+    r2, _ = prepare_searchlight_mvpa_data(
+        images, conditions, random=RandomType.REPRODUCIBLE)
+    assert r1.shape == base.shape
+    assert np.array_equal(labels1, base_labels)
+    assert np.array_equal(r1, r2)
+    assert not np.allclose(r1, base)
+
+
 def test_prepare_searchlight_mvpa_data_matches_reference_golden():
     images = io.load_images_from_dir(DATA_DIR, suffix=SUFFIX)
     conditions = io.load_labels(EPOCH_FILE)

@@ -125,6 +125,24 @@ def test_unfitted_save(tmp_path):
         SRM().save(tmp_path / "x.npz")
 
 
+def test_save_load_ragged_voxel_counts(tmp_path):
+    """Subjects with DIFFERENT voxel counts save through the
+    object-array path (uniform counts use plain stacks so the file
+    stays readable with pickle disabled, matching the reference's own
+    save(); ragged counts cannot — reference srm.py:451-481)."""
+    X, _, _ = make_synthetic(ragged=True)  # 30, 31, 32, 33 voxels
+    model = SRM(n_iter=4, features=4)
+    model.fit(X)
+    path = tmp_path / "ragged.npz"
+    model.save(path)
+    loaded = load(path)
+    for w0, w1 in zip(model.w_, loaded.w_):
+        assert w0.shape == w1.shape and np.allclose(w0, w1)
+    assert np.allclose(model.s_, loaded.s_)
+    s = loaded.transform(X)
+    assert s[0].shape == (4, X[0].shape[1])
+
+
 
 from tests.conftest import mesh_atol as _mesh_atol
 

@@ -148,6 +148,36 @@ def test_apply_signal_and_compute_signal_change():
                                           [1.0], method)
         assert np.isclose(sig_b.max() / sig_a.max(), 2), method
 
+    # every method against its hand-computed formula (reference
+    # fmrisim.py:3185-3270): the dB methods' 10^(mag/20) exponent and
+    # the SD-ratio normalizations are easy to drift silently
+    sig = np.asarray(signal_function, dtype=float)
+    sig_n = sig / np.max(np.abs(sig))
+    noise_col = nf[:, 0]
+    max_amp = np.max(np.abs(sig_n[:, 0]))
+    mag = 0.7
+    expectations = {
+        'SFNR': sig_n * (noise_col.mean() / noise_dict['sfnr']) * mag,
+        'CNR_Amp/Noise-SD': sig_n * mag * np.std(noise_col),
+        'CNR_Amp2/Noise-Var_dB':
+            sig_n * (10 ** (mag / 20)) * np.std(noise_col) / max_amp,
+        'CNR_Signal-SD/Noise-SD':
+            sig_n * (mag / max_amp) * np.std(noise_col)
+            / np.std(sig_n[:, 0]),
+        'CNR_Signal-Var/Noise-Var_dB':
+            sig_n * (10 ** (mag / 20)) * np.std(noise_col)
+            / (max_amp * np.std(sig_n[:, 0])),
+        'PSC': sig_n * (noise_col.mean() / 100) * mag,
+    }
+    for method, want in expectations.items():
+        got = sim.compute_signal_change(signal_function, nf, noise_dict,
+                                        [mag], method)
+        np.testing.assert_allclose(got, want, rtol=1e-12,
+                                   err_msg=method)
+    with pytest.raises(ValueError, match="method"):
+        sim.compute_signal_change(signal_function, nf, noise_dict,
+                                  [mag], 'Z-score')
+
     signal = sim.apply_signal(signal_function=signal_function,
                               volume_signal=volume)
     assert signal.shape == (10, 10, 10, 50)
@@ -362,6 +392,48 @@ def test_drift_and_phys_components():
     task = sim._generate_noise_temporal_task(
         np.array([0, 1, 0, 1, 1, 0] * 10))
     assert task.shape == (60,)
+    # option variants (reference fmrisim.py:1502-1693): rician
+    # task-locked noise, discrete_cos harmonic drift, error contracts
+    task_r = sim._generate_noise_temporal_task(
+        np.array([0, 1, 0, 1, 1, 0] * 10), motion_noise='rician')
+    assert task_r.shape == (60,) and np.isfinite(task_r).all()
+    import pytest
+    with pytest.raises(ValueError, match="gaussian or rician"):
+        sim._generate_noise_temporal_task(np.ones(10),
+                                          motion_noise='poisson')
+    drift_dc = sim._generate_noise_temporal_drift(
+        200, 2.0, basis="discrete_cos")
+    assert np.isclose(drift_dc.std(), 1.0, atol=0.01)
+    with pytest.raises(ValueError, match="drift basis"):
+        sim._generate_noise_temporal_drift(100, 2.0, basis="spline")
+
+
+def test_system_noise_distribution_variants():
+    """Scanner-noise spatial/temporal distributions beyond the default
+    gaussian (reference fmrisim.py:1397-1482): the temporal component
+    is demeaned per voxel over time regardless of distribution, while
+    the spatial pattern keeps its raw location (a rician/exponential
+    spatial mean is part of the scanner's stable pattern)."""
+    np.random.seed(11)
+    dims = (6, 6, 6, 30)
+    for s_type, t_type in [("rician", "rician"),
+                           ("exponential", "exponential"),
+                           ("gaussian", "rician")]:
+        noise = sim._generate_noise_system(
+            dims, spatial_sd=1.0, temporal_sd=1.0,
+            spatial_noise_type=s_type, temporal_noise_type=t_type)
+        assert noise.shape == dims
+        assert np.isfinite(noise).all()
+        # per-voxel time mean == the voxel's stable spatial offset
+        spatial_part = noise.mean(axis=3)
+        temporal_part = noise - spatial_part[..., None]
+        np.testing.assert_allclose(temporal_part.mean(axis=3), 0.0,
+                                   atol=1e-12)
+        if s_type == "gaussian":
+            assert abs(spatial_part.mean()) < 0.5
+        else:
+            # unshifted rician/exponential spatial means are positive
+            assert spatial_part.mean() > 0.5
 
 
 def test_arma_mle_recovery():
