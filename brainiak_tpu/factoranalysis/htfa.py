@@ -135,7 +135,14 @@ class HTFA(TFA):
     def _converged(self):
         prior = self.global_prior_[0:self.prior_size]
         posterior = self.global_posterior_[0:self.prior_size]
-        max_diff = np.max(np.fabs(prior - posterior))
+        diff = prior - posterior
+        max_diff = np.max(np.fabs(diff))
+        if self.verbose:
+            # the reference's verbose diagnostics (htfa.py:209-214)
+            _, mse = self._mse_converged()
+            diff_ratio = np.sum(diff ** 2) / np.sum(posterior ** 2)
+            logger.info('htfa prior posterior max diff %f mse %f '
+                        'diff_ratio %f', max_diff, mse, diff_ratio)
         return max_diff <= self.threshold, max_diff
 
     def _mse_converged(self):

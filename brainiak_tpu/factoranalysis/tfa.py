@@ -280,6 +280,13 @@ class TFA(BaseEstimator):
     def _converged(self):
         diff = self.local_prior - self.local_posterior_
         max_diff = np.max(np.fabs(diff))
+        if self.verbose:
+            # the reference's verbose diagnostics (tfa.py:276-281)
+            _, mse = self._mse_converged()
+            diff_ratio = np.sum(diff ** 2) \
+                / np.sum(self.local_posterior_ ** 2)
+            logger.info('tfa prior posterior max diff %f mse %f '
+                        'diff_ratio %f', max_diff, mse, diff_ratio)
         return max_diff <= self.threshold, max_diff
 
     def _mse_converged(self):

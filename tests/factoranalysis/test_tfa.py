@@ -81,6 +81,34 @@ def test_tfa_input_validation():
         TFA(K=2, weight_method='lasso').fit(X, R)
 
 
+def test_tfa_reference_calling_conventions(caplog):
+    """API-parity surface: chained setters, the (unique_R, inds)
+    factor-evaluation convention (reference tfa.py:525-567, 879-906),
+    and the verbose convergence diagnostics."""
+    import logging
+
+    X, R, centers, widths = make_rbf_data()
+    tfa = TFA(K=1).set_K(2).set_seed(7).set_prior(None)
+    assert tfa.K == 2 and tfa.seed == 7 and tfa.local_prior is None
+
+    tfa.n_dim = R.shape[1]  # set by fit(); needed standalone
+    unique_R, inds = tfa.get_unique_R(R)
+    assert len(unique_R) == 3 and len(inds) == 3
+    recon = np.stack([u[i] for u, i in zip(unique_R, inds)], axis=1)
+    np.testing.assert_array_equal(recon, R)
+    F = tfa.get_factors(unique_R, inds, centers, widths)
+    expected = np.exp(-((R[:, None, :] - centers[None]) ** 2).sum(-1)
+                      / widths.T)
+    np.testing.assert_allclose(F, expected, atol=1e-5)
+
+    with caplog.at_level(logging.INFO,
+                         logger="brainiak_tpu.factoranalysis.tfa"):
+        TFA(K=2, max_iter=2, threshold=0.1, max_num_voxel=256,
+            max_num_tr=40, verbose=True).fit(X, R)
+    assert any("max diff" in r.message and "mse" in r.message
+               for r in caplog.records)
+
+
 def test_map_offset_and_packing():
     tfa = TFA(K=3)
     tfa.n_dim = 3

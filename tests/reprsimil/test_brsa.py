@@ -265,6 +265,13 @@ def test_ncomp_svht():
     ncomp_noise = Ncomp_SVHT_MG_DLD_approx(rng.randn(200, 100),
                                            zscore=False)
     assert ncomp_noise <= ncomp
+    # zscore=True normalizes internally (the reference's default
+    # calling convention, reference brsa.py:733): scaling a column by
+    # a large constant must not change the answer
+    X_scaled = X.copy()
+    X_scaled[:, 0] *= 1e6
+    assert Ncomp_SVHT_MG_DLD_approx(X_scaled, zscore=True) \
+        == Ncomp_SVHT_MG_DLD_approx(X, zscore=True)
 
 
 def test_brsa_auto_n_nureg():

@@ -38,6 +38,53 @@ def test_generate_realtime_multivariate(tmp_path):
                 if f.startswith('rt_')]) == 12
 
 
+def test_generate_realtime_custom_inputs(tmp_path, monkeypatch):
+    """User-supplied template/ROI/noise-dict files and the
+    different_ROIs + save_realtime branches (reference
+    fmrisim_real_time_generator.py:117-265)."""
+    import brainiak_tpu.utils.fmrisim_real_time_generator as rtg
+
+    np.random.seed(3)
+    dims = (20, 20, 12)
+    template = np.ones(dims) * 800
+    template_path = tmp_path / "template.npy"
+    np.save(template_path, template)
+    roi_a = np.zeros(dims)
+    roi_a[4:8, 4:8, 4:8] = 1
+    roi_b = np.zeros(dims)
+    roi_b[12:16, 12:16, 4:8] = 1
+    roi_a_path = tmp_path / "roi_a.npy"
+    roi_b_path = tmp_path / "roi_b.npy"
+    np.save(roi_a_path, roi_a)
+    np.save(roi_b_path, roi_b)
+    nd_path = tmp_path / "noise.txt"
+    nd_path.write_text("{'snr': 25, 'sfnr': 60, 'max_activity': 800,"
+                       " 'matched': 0}")
+
+    out = str(tmp_path / "rt_custom")
+    settings = dict(default_settings)
+    settings.update({'numTRs': 14, 'trDuration': 1,
+                     'event_duration': 2, 'isi': 1, 'burn_in': 1,
+                     'template_path': str(template_path),
+                     'ROI_A_file': str(roi_a_path),
+                     'ROI_B_file': str(roi_b_path),
+                     'noise_dict_file': str(nd_path),
+                     'different_ROIs': True,
+                     'save_realtime': True})
+    # record the pacing instead of paying ~14 s of real sleep
+    sleeps = []
+    monkeypatch.setattr(rtg.time, "sleep", sleeps.append)
+    generate_data(out, settings)
+    vols = [f for f in sorted(os.listdir(out)) if f.startswith('rt_')]
+    assert len(vols) == 14
+    vol = np.load(os.path.join(out, vols[0]))
+    assert vol.shape == dims
+    # save_realtime paces output at ~trDuration per volume
+    assert len(sleeps) == 14
+    assert all(0.0 <= s <= 1.0 for s in sleeps)
+    assert sum(sleeps) > 10
+
+
 def test_dicom_gated(tmp_path):
     np.random.seed(2)
     settings = dict(default_settings)
