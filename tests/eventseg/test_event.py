@@ -137,6 +137,17 @@ def test_split_merge():
     hmm_sm.fit(D)
     assert np.array_equal(np.argmax(hmm_sm.segments_[0], axis=1), ev)
 
+    # K=2 degenerate case: every (merge, split) pair collides with the
+    # merge position, so the proposal list is empty and the split-merge
+    # step must fall through cleanly rather than index into nothing
+    rng2 = np.random.RandomState(1)
+    pat2 = rng2.rand(2, 6)
+    ev2 = np.array([0] * 8 + [1] * 8)
+    D2 = pat2[ev2] + 0.05 * rng2.rand(16, 6)
+    hmm2 = EventSegment(2, split_merge=True)
+    hmm2.fit(D2)
+    assert np.array_equal(np.argmax(hmm2.segments_[0], axis=1), ev2)
+
 
 def test_subevent_patterns_degenerate_event():
     """An event whose soft-assignment mass crosses 1/2 at its first

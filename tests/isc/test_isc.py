@@ -120,6 +120,38 @@ def test_isfc_shapes_and_symmetry():
                               n_pairs_vox)
 
 
+def test_isfc_two_subjects_and_single_inputs():
+    """Reference edge cases (isc.py:529-590, 847-872): exactly two
+    subjects collapse to one symmetrized matrix; single-subject
+    squareform inputs round-trip without the leading axis; pairwise
+    stat input must be a valid condensed triangle."""
+    data = simulated_timeseries(2, 40, 4, random_state=5)
+    sq = isfc(data, pairwise=False, vectorize_isfcs=False)
+    assert sq.shape == (4, 4)
+
+    # single square matrix <-> condensed vector round-trip
+    n_subjects, n_voxels = 5, 4
+    many = isfc(simulated_timeseries(n_subjects, 40, n_voxels,
+                                     random_state=6),
+                pairwise=False, vectorize_isfcs=False)
+    one = many[0]
+    v, d = squareform_isfc(one)
+    assert v.shape == (n_voxels * (n_voxels - 1) // 2,)
+    assert d.shape == (n_voxels,)
+    back = squareform_isfc(v, d)
+    assert np.allclose(back, one)
+
+    # list input to the stat tests takes the 1-D promotion path
+    iscs_list = [0.2, 0.3, 0.25, 0.35, 0.3]
+    observed, ci, p, dist = bootstrap_isc(iscs_list, n_bootstraps=50)
+    assert np.isscalar(p) or np.asarray(p).size == 1
+
+    # malformed pairwise input: not a condensed triangle
+    with pytest.raises(ValueError, match="vectorized triangle"):
+        bootstrap_isc(np.array([0.1, 0.2, 0.3, 0.4]), pairwise=True,
+                      n_bootstraps=10)
+
+
 def test_isfc_mesh_matches_dense():
     """Ring-sharded leave-one-out ISFC equals the replicated einsum path."""
     from brainiak_tpu.parallel import make_mesh

@@ -115,6 +115,28 @@ def test_jax_p_from_null():
         0.0)
     assert np.isclose(
         np.asarray(jstats.p_from_null(3.0, null, side="right")), 1 / 6)
+    # left: {-2,-1,0} <= 0.5 -> (3+1)/(5+1)
+    assert np.isclose(
+        np.asarray(jstats.p_from_null(0.5, null, side="left")), 4 / 6)
+    # two-sided exact: |{-2,2}| >= 1.5 -> 2/5
+    assert np.isclose(
+        np.asarray(jstats.p_from_null(1.5, null, side="two-sided",
+                                      exact=True)), 2 / 5)
+    with pytest.raises(ValueError, match="side"):
+        jstats.p_from_null(0.0, null, side="middle")
+
+
+def test_jax_phase_randomize_2d_squeeze():
+    """A [T, subjects] input takes the 2-D squeeze path and returns the
+    same shape with the spectrum preserved (reference
+    utils/utils.py:720-801 accepts both layouts)."""
+    import jax
+    rng = np.random.RandomState(6)
+    data = rng.randn(40, 4).astype(np.float32)
+    out = np.asarray(jstats.phase_randomize(jax.random.PRNGKey(2), data))
+    assert out.shape == data.shape
+    assert np.allclose(np.abs(np.fft.fft(data, axis=0)),
+                       np.abs(np.fft.fft(out, axis=0)), atol=1e-3)
 
 
 def test_pallas_fcma_kernel_matches_xla_path():

@@ -49,6 +49,22 @@ def test_npz_fallback_roundtrip_and_pruning(tmp_path):
     assert np.allclose(restored2["a"], state["a"] * 2)
 
 
+def test_npz_fallback_edges(tmp_path):
+    # keep-everything (max_to_keep=None, orbax convention)
+    mngr = _npz_fallback_manager(tmp_path / "all", max_to_keep=None)
+    for step in (1, 2, 3):
+        mngr.save(step, {"x": np.ones(2) * step})
+    assert len(list((tmp_path / "all").iterdir())) == 3
+    # empty directory: restore reports nothing rather than raising
+    empty = _npz_fallback_manager(tmp_path / "none")
+    assert empty.latest_step() is None
+    assert empty.restore() == (None, None)
+    # stray files that look almost like checkpoints are ignored
+    (tmp_path / "none" / "ckpt_abc.npz").write_bytes(b"junk")
+    (tmp_path / "none" / "notes.txt").write_text("hi")
+    assert empty.latest_step() is None
+
+
 def test_stage_timer():
     reset_stage_times()
     with stage_timer("stage_a"):
