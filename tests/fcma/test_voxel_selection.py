@@ -178,6 +178,72 @@ def test_voxel_selection_pallas_with_mesh():
         assert np.isclose(a0, a1, atol=1e-4)
 
 
+def test_voxel_selection_pallas_host_cv_path():
+    """use_pallas=True with an sklearn classifier takes the fused
+    corr+normalize kernel into the host-CV pipeline; results equal the
+    XLA host-CV path."""
+    prng = RandomState(1234567890)
+    fake_raw_data = [create_epoch(prng, col=12) for _ in range(8)]
+    labels = [0, 1, 0, 1, 0, 1, 0, 1]
+    clf = svm.SVC(kernel='precomputed', shrinking=False, C=1,
+                  gamma='auto')
+    xla = sorted(VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=6,
+                               use_pallas=False).run(clf))
+    pallas = sorted(VoxelSelector(labels, 4, 2, fake_raw_data,
+                                  voxel_unit=6, use_pallas=True).run(clf))
+    for (v0, a0), (v1, a1) in zip(xla, pallas):
+        assert v0 == v1
+        assert np.isclose(a0, a1, atol=1e-4)
+
+
+def test_pallas_block_helpers_vmem_fallback():
+    """When the epoch x TR extent exceeds the VMEM tile budget the
+    Pallas block helpers must fall back to the XLA path rather than
+    fail (the whole-brain long-T regime)."""
+    import jax.numpy as jnp
+
+    from brainiak_tpu.fcma.voxelselector import (
+        _block_gram_pallas,
+        _block_gram_xla,
+        _block_kernel_matrices,
+        _block_kernel_matrices_pallas,
+    )
+    from brainiak_tpu.ops.pallas_kernels import pick_tiles
+
+    E, T, B, V = 64, 4096, 8, 128
+    assert not pick_tiles(E, T, B, V)[2]
+    rng = RandomState(5)
+    data = jnp.asarray(rng.randn(E, T, V).astype(np.float32) / T)
+    blk = data[:, :, :B]
+
+    g_pal = np.asarray(_block_gram_pallas(blk, data, 4))
+    g_xla = np.asarray(_block_gram_xla(blk, data, 4))
+    np.testing.assert_allclose(g_pal, g_xla, atol=1e-5)
+
+    (k_pal, c_pal) = _block_kernel_matrices_pallas(blk, data, 4)
+    (k_xla, c_xla) = _block_kernel_matrices(blk, data, 4)
+    np.testing.assert_allclose(np.asarray(k_pal), np.asarray(k_xla),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c_pal), np.asarray(c_xla),
+                               atol=1e-5)
+
+
+def test_voxel_selection_kkt_gap_warning(caplog):
+    """A starved SMO budget must warn loudly instead of silently
+    degrading accuracies (voxelselector KKT-gap guard)."""
+    import logging
+
+    prng = RandomState(1234567890)
+    fake_raw_data = [create_epoch(prng, col=8) for _ in range(8)]
+    labels = [0, 1, 0, 1, 0, 1, 0, 1]
+    vs = VoxelSelector(labels, 4, 2, fake_raw_data, voxel_unit=8,
+                       svm_iters=0)
+    with caplog.at_level(logging.WARNING,
+                         logger="brainiak_tpu.fcma.voxelselector"):
+        vs.run('svm')
+    assert any("KKT" in r.message for r in caplog.records)
+
+
 def test_voxel_selection_multiclass_on_device():
     """Three-condition voxel selection: the on-device one-vs-one SVM
     matches sklearn SVC's multiclass CV within the reference tolerance."""

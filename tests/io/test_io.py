@@ -113,3 +113,66 @@ def test_nifti_rejects_garbage(tmp_path):
     p.write_bytes(b"\x00" * 400)
     with pytest.raises(ValueError):
         nifti.load(p)
+
+
+def test_nifti_malformed_headers(tmp_path):
+    """Each header validation fires its own error: truncated file, bad
+    magic, invalid ndim, unknown datatype (NIfTI-1 spec fields)."""
+    import struct
+
+    good = tmp_path / "good.nii"
+    nifti.save(nifti.NiftiImage(np.zeros((2, 2, 2), np.float32),
+                                np.eye(4)), good)
+    raw = bytearray(good.read_bytes())
+
+    short = tmp_path / "short.nii"
+    short.write_bytes(raw[:100])
+    with pytest.raises(ValueError, match="too short"):
+        nifti.load(short)
+
+    bad_magic = bytearray(raw)
+    bad_magic[344:348] = b"xxx\x00"
+    p = tmp_path / "magic.nii"
+    p.write_bytes(bad_magic)
+    with pytest.raises(ValueError, match="magic"):
+        nifti.load(p)
+
+    bad_ndim = bytearray(raw)
+    bad_ndim[40:42] = struct.pack("<h", 0)
+    p = tmp_path / "ndim.nii"
+    p.write_bytes(bad_ndim)
+    with pytest.raises(ValueError, match="ndim"):
+        nifti.load(p)
+
+    bad_dtype = bytearray(raw)
+    bad_dtype[70:72] = struct.pack("<h", 9999)
+    p = tmp_path / "dtype.nii"
+    p.write_bytes(bad_dtype)
+    with pytest.raises(ValueError, match="datatype"):
+        nifti.load(p)
+
+
+def test_nifti_scl_slope_and_save_coercion(tmp_path):
+    """scl_slope/scl_inter rescale on read (the NIfTI-1 scaling
+    contract), save() rejects non-NiftiImage input, and unsupported
+    dtypes are coerced to float32."""
+    import struct
+
+    data = np.arange(8, dtype=np.int16).reshape(2, 2, 2)
+    p = tmp_path / "scaled.nii"
+    nifti.save(nifti.NiftiImage(data, np.eye(4)), p)
+    raw = bytearray(p.read_bytes())
+    # scl_slope at offset 112, scl_inter at 116 (NIfTI-1 layout)
+    raw[112:116] = struct.pack("<f", 2.5)
+    raw[116:120] = struct.pack("<f", 10.0)
+    p.write_bytes(raw)
+    img = nifti.load(p)
+    np.testing.assert_allclose(img.get_fdata(), data * 2.5 + 10.0)
+
+    with pytest.raises(TypeError):
+        nifti.save(np.zeros((2, 2, 2)), tmp_path / "notimg.nii")
+
+    halves = np.zeros((2, 2, 2), dtype=np.float16)  # not a NIfTI code
+    p2 = tmp_path / "coerced.nii"
+    nifti.save(nifti.NiftiImage(halves, np.eye(4)), p2)
+    assert nifti.load(p2).dataobj.dtype == np.float32

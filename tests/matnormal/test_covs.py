@@ -18,18 +18,20 @@ SIZE = 6
 RNG = np.random.RandomState(0)
 
 
-def _dense_checks(cov, params, atol=1e-8):
+def _dense_checks(cov, params, atol=1e-6, rtol=1e-5):
     """logdet and solve must agree with dense linear algebra."""
     prec = np.asarray(cov.prec(params))
     dense_cov = np.linalg.inv(prec)
     # logdet
     sign, logdet = np.linalg.slogdet(dense_cov)
     assert sign > 0
-    assert np.isclose(float(cov.logdet(params)), logdet, atol=1e-6)
+    assert np.isclose(float(cov.logdet(params)), logdet,
+                      atol=atol, rtol=rtol)
     # solve
     X = RNG.randn(cov.size, 3)
     got = np.asarray(cov.solve(params, jnp.asarray(X)))
-    assert np.allclose(got, np.linalg.solve(dense_cov, X), atol=1e-6)
+    assert np.allclose(got, np.linalg.solve(dense_cov, X),
+                       atol=atol, rtol=rtol)
 
 
 def test_cov_identity():
@@ -139,6 +141,64 @@ def test_cov_kronecker():
                        np.linalg.solve(dense, X), atol=1e-8)
     with pytest.raises(TypeError):
         CovKroneckerFactored((2, 3))
+
+
+def test_cov_random_inits_and_base():
+    """Random initialization (no values supplied) must yield usable,
+    self-consistent covariances for every learnable family; the
+    abstract base refuses logdet/solve."""
+    from brainiak_tpu.matnormal.covs import CovBase
+
+    base = CovBase(3)
+    with pytest.raises(NotImplementedError):
+        base.logdet({})
+    with pytest.raises(NotImplementedError):
+        base.solve({}, np.zeros((3, 1)))
+
+    # relative tolerance carries the fp32 sweep: a random exp-diagonal
+    # Cholesky can be ill-conditioned, putting dense-solve entries at
+    # ~1e3 where float32 round-off is far above a 1e-6 absolute band
+    import jax
+
+    fp32 = not jax.config.read("jax_enable_x64")
+    tol = dict(atol=1e-3, rtol=2e-3) if fp32 else {}
+    for cov in (CovDiagonal(SIZE), CovUnconstrainedCholesky(size=SIZE),
+                CovUnconstrainedInvCholesky(size=SIZE)):
+        params = cov.init_params(seed=1)
+        _dense_checks(cov, params, **tol)
+
+    kron = CovKroneckerFactored([2, 3])
+    params = kron.init_params(seed=2)
+    Ls = kron.L(params)
+    dense = np.kron(*[np.asarray(L) @ np.asarray(L).T for L in Ls])
+    sign, logdet = np.linalg.slogdet(dense)
+    assert sign > 0
+    assert np.isclose(float(kron.logdet(params)), logdet,
+                      atol=1e-6, **({"rtol": 2e-3} if fp32 else {}))
+    X = RNG.randn(6, 2)
+    assert np.allclose(np.asarray(kron.solve(params, jnp.asarray(X))),
+                       np.linalg.solve(dense, X),
+                       atol=1e-3 if fp32 else 1e-5,
+                       rtol=2e-3 if fp32 else 1e-5)
+
+
+def test_cov_kronecker_masked_logdet():
+    """Masked Kronecker logdet: per-factor log-diagonals weighted by
+    surviving index counts equal the dense masked-Cholesky logdet."""
+    sizes = [2, 3]
+    sigmas = []
+    for n in sizes:
+        A = RNG.randn(n, n)
+        sigmas.append(A @ A.T + n * np.eye(n))
+    mask = np.array([1, 0, 1, 1, 1, 0])
+    cov = CovKroneckerFactored(sizes, Sigmas=sigmas, mask=mask)
+    params = cov.init_params()
+    L = np.linalg.cholesky(np.kron(sigmas[0], sigmas[1]))
+    idx = np.where(mask)[0]
+    sub_chol = L[np.ix_(idx, idx)]
+    sign, expected = np.linalg.slogdet(sub_chol @ sub_chol.T)
+    assert sign > 0
+    assert np.isclose(float(cov.logdet(params)), expected, atol=1e-8)
 
 
 def test_cov_kronecker_masked():

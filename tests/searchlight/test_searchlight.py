@@ -148,6 +148,70 @@ def test_traced_tier_mesh_matches_single():
     assert np.allclose(single, dist, equal_nan=True)
 
 
+def test_searchlight_pool_tier_matches_serial():
+    """pool_size > 1 streams patches through a process Pool (the
+    reference's per-node multiprocessing, searchlight.py L4); results
+    must equal the serial tier exactly."""
+    rng = np.random.RandomState(2)
+    dims = (6, 6, 6, 3)
+    data = rng.randn(*dims)
+    mask = np.ones(dims[:3], dtype=bool)
+
+    serial = Searchlight(sl_rad=1, shape=Cube, pool_size=1)
+    serial.distribute([data], mask)
+    out_serial = serial.run_searchlight(_sum_patch)
+
+    pooled = Searchlight(sl_rad=1, shape=Cube, pool_size=2)
+    pooled.distribute([data], mask)
+    out_pool = pooled.run_searchlight(_sum_patch)
+
+    for idx in np.ndindex(*dims[:3]):
+        a, b = out_serial[idx], out_pool[idx]
+        assert (a is None and b is None) or np.isclose(a, b)
+
+
+def _sum_patch(subjects, msk, myrad, bcast):
+    # top-level so the Pool tier can pickle it
+    return float(np.sum(subjects[0][msk]))
+
+
+def test_searchlight_rad_zero():
+    """sl_rad=0: every in-mask voxel is its own neighborhood and no
+    border is skipped."""
+    rng = np.random.RandomState(3)
+    dims = (4, 4, 4, 2)
+    data = rng.randn(*dims)
+    mask = np.ones(dims[:3], dtype=bool)
+    sl = Searchlight(sl_rad=0, pool_size=1)
+    sl.distribute([data], mask)
+    out = sl.run_searchlight(_sum_patch)
+    assert out[0, 0, 0] is not None
+    for idx in np.ndindex(*dims[:3]):
+        assert np.isclose(out[idx], data[idx].sum())
+
+
+def test_traced_tier_edge_inputs():
+    """Empty active set returns a fill_value volume; None subject
+    placeholders are rejected (generic-tier-only feature)."""
+    import jax.numpy as jnp
+
+    dims = (4, 4, 4, 2)
+    data = np.ones(dims)
+
+    def jfn(patch, mpatch, rad, bcast):
+        return jnp.sum(patch)
+
+    sl = Searchlight(sl_rad=1, shape=Cube)
+    sl.distribute([data], np.zeros(dims[:3], dtype=bool))
+    out = sl.run_searchlight_jax(jfn, fill_value=-7.0)
+    assert out.shape == dims[:3] and np.all(out == -7.0)
+
+    sl2 = Searchlight(sl_rad=1, shape=Cube)
+    sl2.distribute([None, data], np.ones(dims[:3], dtype=bool))
+    with pytest.raises(ValueError, match="None"):
+        sl2.run_searchlight_jax(jfn)
+
+
 def test_searchlight_validation():
     sl = Searchlight(sl_rad=1)
     with pytest.raises(ValueError):
