@@ -72,6 +72,11 @@ def test_mask_image_and_multisubject_stack():
             iter([masked[0], masked[1][:-1]]), 2)
 
 
+def test_from_masked_images_empty_iterator():
+    with pytest.raises(ValueError, match="!= 0"):
+        MaskedMultiSubjectData.from_masked_images(iter([]), 2)
+
+
 def test_mask_images_generators():
     mask = io.load_boolean_mask(DATA_DIR / "mask.nii.gz")
     images = io.load_images_from_dir(DATA_DIR, "bet.nii.gz")

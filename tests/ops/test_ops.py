@@ -386,3 +386,30 @@ def test_ring_correlation_matches_dense():
     cross = np.asarray(ring_correlation(data, mesh, data_b=other))
     dense_cross = np.corrcoef(data.T, other.T)[:V, V:]
     assert np.allclose(cross, dense_cross, atol=mesh_atol())
+    # precondition guards: voxel count must divide the ring, and
+    # data_b must match shape
+    with pytest.raises(AssertionError, match="divisible"):
+        ring_correlation(data[:, :63], mesh)
+    with pytest.raises(AssertionError, match="same shape"):
+        ring_correlation(data, mesh, data_b=other[:, :32])
+
+
+def test_compute_correlation_validation_and_recon_residual():
+    """compute_correlation's input contract and the TFA recon kernel
+    (reference fcma/util.py + tfa_extension.cpp:169-239)."""
+    with pytest.raises(ValueError, match="2D"):
+        compute_correlation(np.ones(5), np.ones((2, 5)))
+    with pytest.raises(ValueError, match="discrepancy"):
+        compute_correlation(np.ones((2, 4)), np.ones((2, 5)))
+
+    from brainiak_tpu.ops.rbf import reconstruction_residual
+    from tests.conftest import mesh_atol
+    rng = np.random.RandomState(1)
+    X = rng.randn(10, 6).astype(np.float32)
+    F = rng.randn(10, 3).astype(np.float32)
+    W = rng.randn(3, 6).astype(np.float32)
+    got = np.asarray(reconstruction_residual(X, F, W, 0.5))
+    # mesh_atol: the kernel's matmul runs at default precision, which
+    # on TPU means bf16 passes
+    np.testing.assert_allclose(got, 0.5 * (X - F @ W),
+                               atol=max(mesh_atol(), 1e-5))
