@@ -31,7 +31,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 from ..ops.optimize import minimize_bounded
 from ..ops.rbf import rbf_factors
-from ..parallel.mesh import DEFAULT_SUBJECT_AXIS
+from ..parallel.mesh import DEFAULT_SUBJECT_AXIS, place_on_mesh
 from ..utils.utils import from_sym_2_tri, from_tri_2_sym
 from .tfa import TFA, _full_sym, _match_centers, _rho_sum
 
@@ -308,7 +308,7 @@ class HTFA(TFA):
             if self.mesh is not None:
                 spec = PartitionSpec(DEFAULT_SUBJECT_AXIS,
                                      *([None] * (a.ndim - 1)))
-                return jax.device_put(a, NamedSharding(self.mesh, spec))
+                return place_on_mesh(a, NamedSharding(self.mesh, spec))
             return jnp.asarray(a)
 
         modes = ("zero", "zero", "zero", "zero", "repeat", "repeat",
@@ -318,7 +318,7 @@ class HTFA(TFA):
                   self.sub_lower, self.sub_upper, beta, sigma,
                   self.sub_scaling), modes)]
         if self.mesh is not None:
-            tmpl = [jax.device_put(
+            tmpl = [place_on_mesh(
                 np.asarray(t), NamedSharding(self.mesh, PartitionSpec()))
                 for t in tmpl]
         x, cost = _batched_subject_step(

@@ -131,8 +131,9 @@ class RSRM(BaseEstimator, TransformerMixin):
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            from ..parallel.mesh import DEFAULT_SUBJECT_AXIS
-            stacked = jax.device_put(
+            from ..parallel.mesh import (DEFAULT_SUBJECT_AXIS,
+                                         place_on_mesh)
+            stacked = place_on_mesh(
                 stacked, NamedSharding(
                     self.mesh,
                     PartitionSpec(DEFAULT_SUBJECT_AXIS, None, None)))

@@ -34,7 +34,8 @@ from sklearn.base import BaseEstimator, TransformerMixin
 from sklearn.exceptions import NotFittedError
 from sklearn.utils import assert_all_finite
 
-from ..parallel.mesh import DEFAULT_SUBJECT_AXIS, fetch_replicated
+from ..parallel.mesh import (DEFAULT_SUBJECT_AXIS, fetch_replicated,
+                             place_on_mesh)
 
 __all__ = ["SRM", "DetSRM", "load"]
 
@@ -346,8 +347,8 @@ class _SRMBase(BaseEstimator, TransformerMixin):
     def _device_place(self, stacked):
         if self.mesh is not None:
             spec = PartitionSpec(DEFAULT_SUBJECT_AXIS, None, None)
-            return jax.device_put(stacked,
-                                  NamedSharding(self.mesh, spec))
+            return place_on_mesh(stacked,
+                                 NamedSharding(self.mesh, spec))
         return jnp.asarray(stacked)
 
     # -- shared API -------------------------------------------------------

@@ -28,7 +28,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 from scipy.spatial.distance import squareform
 
-from .parallel.mesh import DEFAULT_VOXEL_AXIS, fetch_replicated
+from .parallel.mesh import (DEFAULT_VOXEL_AXIS, fetch_replicated,
+                            place_on_mesh)
 from .utils.utils import _check_timeseries_input, p_from_null
 
 __all__ = [
@@ -151,8 +152,7 @@ def _shard_voxels(arr, mesh, axis):
                      constant_values=np.nan)
     spec = [None] * arr.ndim
     spec[axis] = DEFAULT_VOXEL_AXIS
-    return jax.device_put(
-        arr, NamedSharding(mesh, PartitionSpec(*spec)))
+    return place_on_mesh(arr, NamedSharding(mesh, PartitionSpec(*spec)))
 
 
 def _fetch_ring_matrix(m, mesh):

@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 from jax import shard_map
 
+from ..parallel.mesh import place_on_mesh
 from .correlation import PRECISION
 
 __all__ = ["ring_correlation"]
@@ -108,7 +109,7 @@ def ring_correlation(data, mesh, data_b=None, axis_name="voxel"):
     # shard FIRST, z-score after: the full [T, V] array is never resident
     # on one device (z-scoring is columnwise, so it runs shard-local)
     spec = NamedSharding(mesh, PartitionSpec(None, axis_name))
-    z = _zscore_cols(jax.device_put(data, spec))
+    z = _zscore_cols(place_on_mesh(data, spec))
     z_b = z if data_b is None else _zscore_cols(
-        jax.device_put(data_b, spec))
+        place_on_mesh(data_b, spec))
     return _ring_program(mesh, axis_name)(z, z_b)

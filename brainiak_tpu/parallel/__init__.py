@@ -13,6 +13,7 @@ from .mesh import (  # noqa: F401
     initialize_distributed,
     make_mesh,
     max_divisible_shards,
+    place_on_mesh,
     replicated,
     shard_along,
     subject_voxel_mesh,

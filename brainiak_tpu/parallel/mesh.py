@@ -20,6 +20,7 @@ __all__ = [
     "initialize_distributed",
     "make_mesh",
     "max_divisible_shards",
+    "place_on_mesh",
     "replicated",
     "shard_along",
     "subject_voxel_mesh",
@@ -112,14 +113,34 @@ def subject_voxel_mesh(n_subject_shards: int = -1,
                      (n_subject_shards, n_voxel_shards), devices)
 
 
+def place_on_mesh(array, sharding):
+    """Place a possibly-host array with ``sharding``.
+
+    Single-process, or an input that is already a ``jax.Array``:
+    plain ``device_put`` (for device arrays this is the collective
+    reshard path).  Multi-process HOST values instead fill each
+    addressable shard from THIS process's copy — the MPI-replica
+    semantic (every rank holds its own logically-identical replica).
+    ``device_put`` would assert bit-equality of the host value across
+    processes, which fp32 reduction-order divergence legally violates
+    (each process materialized its replica through its own reduction
+    order).
+    """
+    if jax.process_count() == 1 or isinstance(array, jax.Array):
+        return jax.device_put(array, sharding)
+    arr = np.asarray(array)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def shard_along(array, mesh: Mesh, axis_name: str, array_dim: int = 0):
     """Place ``array`` on ``mesh`` sharded over ``axis_name`` at dim
     ``array_dim`` (other dims replicated)."""
     spec = [None] * np.ndim(array)
     spec[array_dim] = axis_name
-    return jax.device_put(array, NamedSharding(mesh, PartitionSpec(*spec)))
+    return place_on_mesh(array, NamedSharding(mesh, PartitionSpec(*spec)))
 
 
 def replicated(array, mesh: Mesh):
     """Place ``array`` on ``mesh`` fully replicated."""
-    return jax.device_put(array, NamedSharding(mesh, PartitionSpec()))
+    return place_on_mesh(array, NamedSharding(mesh, PartitionSpec()))

@@ -1023,7 +1023,8 @@ class GBRSA(BRSA):
             Returns (array, mask)."""
             mask = np.ones(x.shape[1])
             if self.mesh is not None:
-                from ..parallel.mesh import DEFAULT_VOXEL_AXIS
+                from ..parallel.mesh import (DEFAULT_VOXEL_AXIS,
+                                             place_on_mesh)
                 from jax.sharding import NamedSharding, PartitionSpec
                 n_shards = self.mesh.shape[DEFAULT_VOXEL_AXIS]
                 pad = (-x.shape[1]) % n_shards
@@ -1032,8 +1033,8 @@ class GBRSA(BRSA):
                 mask = np.pad(mask, (0, pad))
                 spec = NamedSharding(
                     self.mesh, PartitionSpec(None, DEFAULT_VOXEL_AXIS))
-                return (jax.device_put(x, spec),
-                        jax.device_put(mask, NamedSharding(
+                return (place_on_mesh(x, spec),
+                        place_on_mesh(mask, NamedSharding(
                             self.mesh,
                             PartitionSpec(DEFAULT_VOXEL_AXIS))))
             return jnp.asarray(x), jnp.asarray(mask)

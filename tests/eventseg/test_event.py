@@ -201,7 +201,16 @@ def test_multiple_datasets_fit():
 def test_fused_fit_matches_host_loop():
     """The one-dispatch while_loop fit must reproduce the host-driven
     annealing loop iterate for iterate (same LL history, patterns,
-    segmentations, and stopping step)."""
+    segmentations, and stopping step).
+
+    f64-only: the two loops fuse reductions differently, and in fp32
+    the per-step rounding difference compounds chaotically through 60
+    annealed EM steps — iterate-for-iterate equivalence is only a
+    meaningful contract at f64 (the behavior both converge TO is pinned
+    in fp32 by the recovery/boundary tests)."""
+    import jax
+    if not jax.config.jax_enable_x64:
+        pytest.skip("iterate-level loop equivalence requires x64")
     rng = np.random.RandomState(7)
     n_vox, t, k = 12, 40, 4
     ev = np.linspace(0, t, k + 1).astype(int)

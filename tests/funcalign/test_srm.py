@@ -221,13 +221,19 @@ def test_procrustes_polar_matches_svd_and_survives_rank_deficiency():
 
     from brainiak_tpu.funcalign.srm import _procrustes
 
+    import jax
+
     rng = np.random.RandomState(0)
     a = rng.randn(600, 12)
     w = np.asarray(_procrustes(jnp.asarray(a)))
     u, _, vt = np.linalg.svd(a + 0.001 * np.eye(600, 12),
                              full_matrices=False)
-    assert np.allclose(w, u @ vt, atol=1e-8)
-    assert np.allclose(w.T @ w, np.eye(12), atol=1e-10)
+    # fp32 sweep: the Gram path squares the condition number, so
+    # proximity to the f64 SVD oracle degrades to ~eps*kappa^2
+    x64 = bool(jax.config.jax_enable_x64)
+    assert np.allclose(w, u @ vt, atol=1e-8 if x64 else 1e-4)
+    assert np.allclose(w.T @ w, np.eye(12),
+                       atol=1e-10 if x64 else 1e-5)
 
     # rank-1 input, no perturbation: finite, orthogonal columns where
     # defined (old absolute-tiny floor overflowed to Inf/NaN here)

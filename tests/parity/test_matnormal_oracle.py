@@ -27,6 +27,15 @@ from brainiak_tpu.matnormal.regression import (MatnormalRegression
 
 tf = pytest.importorskip("tensorflow")
 
+# The covariance rows assert float64 bit-parity against the live TF
+# reference; the fp32 sweep (BRAINIAK_TPU_TEST_X64=0) changes OUR
+# working precision but not TF's, so that contract is f64-only.  The
+# estimator rows (MNRSA/regression) compare within tolerance and run
+# in both modes.
+requires_x64 = pytest.mark.skipif(
+    __import__("jax").config.jax_enable_x64 is False,
+    reason="bit-parity vs the f64 TF oracle requires x64")
+
 
 @pytest.fixture(scope="module")
 def ref_matnormal(reference):
@@ -39,6 +48,7 @@ def ref_matnormal(reference):
     return ns
 
 
+@requires_x64
 def test_cov_ar1_logdet_solve_parity(ref_matnormal):
     """CovAR1 with explicit (rho, sigma) and scan-onset blocks: the
     precision recipe (I - rho D + rho^2 F)/sigma^2 must match the
@@ -60,6 +70,7 @@ def test_cov_ar1_logdet_solve_parity(ref_matnormal):
                                rtol=1e-6, atol=1e-8)
 
 
+@requires_x64
 def test_cov_unconstrained_cholesky_parity(ref_matnormal):
     """CovUnconstrainedCholesky built from the same SPD Sigma
     (reference covs.py:343-404)."""
@@ -81,6 +92,7 @@ def test_cov_unconstrained_cholesky_parity(ref_matnormal):
                                rtol=1e-8, atol=1e-10)
 
 
+@requires_x64
 def test_cov_diagonal_parity(ref_matnormal):
     """CovDiagonal with explicit variances (reference covs.py:279-325)."""
     var = np.array([0.5, 1.0, 2.0, 4.0, 0.25])
