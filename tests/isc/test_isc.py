@@ -152,6 +152,32 @@ def test_isfc_two_subjects_and_single_inputs():
                       n_bootstraps=10)
 
 
+def test_isc_api_parity_surfaces():
+    """Reference API conveniences: a RandomState instance as
+    random_state, the summary-collapsed ISFC return, and the
+    summary-statistic validation (reference isc.py:529-700)."""
+    data = simulated_timeseries(5, 40, 4, random_state=7)
+
+    # summary-statistic collapse: one condensed vector + one isc diag
+    v, d = isfc(data, pairwise=False, summary_statistic='mean')
+    assert v.shape == (4 * 3 // 2,) and d.shape == (4,)
+    many_v, many_d = isfc(data, pairwise=False)
+    np.testing.assert_allclose(
+        v, compute_summary_statistic(many_v, 'mean', axis=0),
+        atol=1e-12)
+
+    # RandomState instance accepted wherever a seed is (reference
+    # _check_random_state analog)
+    iscs = isc(data)
+    rs = np.random.RandomState(11)
+    observed, ci, p, dist = bootstrap_isc(iscs, n_bootstraps=20,
+                                          random_state=rs)
+    assert np.asarray(dist).shape[0] == 20
+
+    with pytest.raises(ValueError, match="mean"):
+        permutation_isc(iscs, summary_statistic='mode')
+
+
 def test_isfc_mesh_matches_dense():
     """Ring-sharded leave-one-out ISFC equals the replicated einsum path."""
     from brainiak_tpu.parallel import make_mesh
