@@ -271,10 +271,13 @@ def convolve_hrf(stimfunction, tr_duration, hrf_type='double_gamma',
     stride = int(temporal_resolution * tr_duration)
     duration = int(stimfunction.shape[0] / stride)
 
-    if hrf_type == 'double_gamma':
+    if isinstance(hrf_type, str) and hrf_type == 'double_gamma':
         hrf = _double_gamma_hrf(temporal_resolution=temporal_resolution)
     else:
-        hrf = hrf_type
+        # user-supplied kernel (reference fmrisim.py:869-872 takes a
+        # list; an ndarray would crash BOTH implementations at the
+        # string comparison above without the isinstance guard)
+        hrf = np.asarray(hrf_type)
 
     signal_function = np.zeros((duration, stimfunction.shape[1]))
     for col in range(stimfunction.shape[1]):

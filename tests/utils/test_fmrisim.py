@@ -408,6 +408,61 @@ def test_drift_and_phys_components():
         sim._generate_noise_temporal_drift(100, 2.0, basis="spline")
 
 
+def test_signal_feature_shapes_and_stim_file(tmp_path):
+    """Feature geometry variants (loop/cavity, unknown-type error), the
+    3-column timing-file input, a custom HRF array, and 1-D
+    apply_signal input (reference fmrisim.py:310-966)."""
+    dims = np.array([11, 11, 11])
+    center = np.array([[5, 5, 5]])
+    vols = {}
+    for ft in ('loop', 'sphere', 'cavity'):
+        vols[ft] = sim.generate_signal(dimensions=dims,
+                                       feature_coordinates=center,
+                                       feature_type=[ft],
+                                       feature_size=[5],
+                                       signal_magnitude=[1])
+        assert vols[ft].shape == tuple(dims) and vols[ft].max() == 1.0
+    # a cavity is a sphere with the interior removed
+    assert vols['cavity'].sum() < vols['sphere'].sum()
+    # a loop is planar: exactly one slice along the loop axis is active
+    active_slices = (vols['loop'].sum(axis=(0, 1)) > 0).sum()
+    assert active_slices == 1
+    with pytest.raises(ValueError, match="feature type"):
+        sim.generate_signal(dimensions=dims, feature_coordinates=center,
+                            feature_type=['pyramid'], feature_size=[3],
+                            signal_magnitude=[1])
+
+    # FSL-style 3-column timing file == the equivalent explicit args
+    tfile = tmp_path / "events.txt"
+    tfile.write_text("10.0 6.0 1.0\n30.0 6.0 1.0\n")
+    from_file = sim.generate_stimfunction(onsets=None,
+                                          event_durations=None,
+                                          total_time=60,
+                                          timing_file=str(tfile))
+    explicit = sim.generate_stimfunction(onsets=[10.0, 30.0],
+                                         event_durations=[6.0],
+                                         total_time=60)
+    np.testing.assert_array_equal(from_file, explicit)
+
+    # custom HRF array short-circuits the double-gamma
+    box = sim.generate_stimfunction(onsets=[2], event_durations=[2],
+                                    total_time=20)
+    delta = np.zeros(100)
+    delta[0] = 1.0
+    conv = sim.convolve_hrf(stimfunction=box, tr_duration=2,
+                            hrf_type=delta, scale_function=False)
+    assert conv.shape[0] == 10
+    # identity HRF: the convolved course is the mid-TR boxcar sample
+    stride = 200
+    np.testing.assert_allclose(conv[:, 0],
+                               box[stride // 2::stride, 0][:10])
+
+    # 1-D signal function is promoted to a column
+    vol = vols['sphere']
+    sig4d = sim.apply_signal(signal_function=np.ones(5), volume_signal=vol)
+    assert sig4d.shape == tuple(dims) + (5,)
+
+
 def test_system_noise_distribution_variants():
     """Scanner-noise spatial/temporal distributions beyond the default
     gaussian (reference fmrisim.py:1397-1482): the temporal component
