@@ -148,10 +148,16 @@ def test_traced_tier_mesh_matches_single():
     assert np.allclose(single, dist, equal_nan=True)
 
 
-def test_searchlight_pool_tier_matches_serial():
+def test_searchlight_pool_tier_matches_serial(monkeypatch):
     """pool_size > 1 streams patches through a process Pool (the
     reference's per-node multiprocessing, searchlight.py L4); results
-    must equal the serial tier exactly."""
+    must equal the serial tier exactly.
+
+    This container's cpuset reports ONE usable CPU, which silently
+    demotes any pool_size to the serial tier — so the CPU count is
+    forced to 2 and the test asserts the Pool actually ran."""
+    import brainiak_tpu.searchlight.searchlight as slmod
+
     rng = np.random.RandomState(2)
     dims = (6, 6, 6, 3)
     data = rng.randn(*dims)
@@ -161,9 +167,16 @@ def test_searchlight_pool_tier_matches_serial():
     serial.distribute([data], mask)
     out_serial = serial.run_searchlight(_sum_patch)
 
+    monkeypatch.setattr(slmod, "usable_cpu_count", lambda: 2)
+    orig_pool = slmod.Pool
+    pool_used = []
+    monkeypatch.setattr(
+        slmod, "Pool",
+        lambda n: (pool_used.append(n), orig_pool(n))[1])
     pooled = Searchlight(sl_rad=1, shape=Cube, pool_size=2)
     pooled.distribute([data], mask)
     out_pool = pooled.run_searchlight(_sum_patch)
+    assert pool_used == [2]
 
     for idx in np.ndindex(*dims[:3]):
         a, b = out_serial[idx], out_pool[idx]
