@@ -105,22 +105,6 @@ def Ncomp_SVHT_MG_DLD_approx(X, zscore=True):
         sing > thresh, np.logical_not(np.isclose(sing, thresh)))))
 
 
-def _ar1_quad(y, rho, scan_starts_mask):
-    """Quadratic form yᵀ P y with P the AR(1) precision (unit innovation
-    variance), blocked by scans: within-scan terms use the tridiagonal
-    precision (I − ρD + ρ²F) and each scan's first sample contributes
-    (1−ρ²)·y₀²... expressed through differences for autodiff stability.
-
-    y: [T]; scan_starts_mask: [T] bool, True at the first TR of each scan.
-    Returns (quad, logdet_correction) where the AR(1) covariance logdet is
-    T·log σ² − Σ_runs log(1−ρ²) handled by the caller.
-    """
-    y_prev = jnp.concatenate([y[:1], y[:-1]])
-    innov = jnp.where(scan_starts_mask, y * jnp.sqrt(1 - rho ** 2),
-                      y - rho * y_prev)
-    return jnp.sum(innov ** 2)
-
-
 def _ar1_whiten(M, rho, scan_starts_mask):
     """Apply the AR(1) whitening transform row-wise to M [T, C]:
     W M where WᵀW = precision."""
