@@ -50,6 +50,23 @@ WB_SELECTED = 1024
 WB_EPOCHS = 32
 
 
+def _even_epochs_env(name, default):
+    """Read an epoch-count env override, rounded UP to even.
+
+    ``make_data`` alternates condition labels 0/1 per epoch, so an odd
+    epoch count would build one more data epoch than labels and
+    VoxelSelector would see a label/epoch mismatch (ADVICE round 5).
+    """
+    import os
+    import sys
+    n = int(os.environ.get(name, default))
+    if n % 2:
+        print(f"bench: {name}={n} is odd; rounding up to {n + 1} "
+              "(labels alternate 0/1 per epoch)", file=sys.stderr)
+        n += 1
+    return n
+
+
 def make_data(n_voxels=N_VOXELS, n_trs=N_TRS, n_epochs=N_EPOCHS):
     rng = np.random.RandomState(0)
     data = []
@@ -266,7 +283,7 @@ def _tier_main(tier):
             n_voxels=int(os.environ.get("BENCH_WB_VOXELS", WB_VOXELS)),
             selected=int(os.environ.get("BENCH_WB_SELECTED",
                                         WB_SELECTED)),
-            n_epochs=int(os.environ.get("BENCH_WB_EPOCHS", WB_EPOCHS)))
+            n_epochs=_even_epochs_env("BENCH_WB_EPOCHS", WB_EPOCHS))
     else:
         vps = tpu_voxels_per_sec(
             n_voxels=int(os.environ.get("BENCH_MID_VOXELS", N_VOXELS)))
@@ -294,7 +311,7 @@ def main():
     import os
     wb_voxels = int(os.environ.get("BENCH_WB_VOXELS", WB_VOXELS))
     wb_selected = int(os.environ.get("BENCH_WB_SELECTED", WB_SELECTED))
-    wb_epochs = int(os.environ.get("BENCH_WB_EPOCHS", WB_EPOCHS))
+    wb_epochs = _even_epochs_env("BENCH_WB_EPOCHS", WB_EPOCHS)
     mid_voxels = int(os.environ.get("BENCH_MID_VOXELS", N_VOXELS))
 
     if responsive:

@@ -32,6 +32,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..ops.optimize import minimize_bounded
 from ..ops.rbf import rbf_factors
 from ..parallel.mesh import DEFAULT_SUBJECT_AXIS, place_on_mesh
+from ..resilience.guards import (array_digest, check_state,
+                                 run_resilient_loop)
 from ..utils.utils import from_sym_2_tri, from_tri_2_sym
 from .tfa import TFA, _full_sym, _match_centers, _rho_sum
 
@@ -388,39 +390,92 @@ class HTFA(TFA):
                 break
         return posterior
 
-    def _fit_htfa(self, data, R):
+    def _fit_htfa(self, data, R, checkpoint_dir=None,
+                  checkpoint_every=5):
         """Outer template loop (reference htfa.py:672-764): batched
-        subject fits -> posterior gather -> replicated MAP update."""
+        subject fits -> posterior gather -> replicated MAP update.
+
+        Driven by the resilient loop: each global iteration runs under
+        the non-finite guard (rollback to the last good template on
+        divergence) and, with ``checkpoint_dir``, the template state is
+        persisted every ``checkpoint_every`` global iterations for
+        preemption-safe resume.  The inner subject fits re-seed their
+        subsampling RNGs from the global iteration index, so a resumed
+        fit reproduces the uninterrupted iterates exactly."""
         n_subj = len(R)
         self._prepare_subject_batch(data, R)
         self.local_posterior_ = np.zeros(n_subj * self.prior_size)
 
         # Template initialized from a random subject's coordinates
-        # (reference htfa.py:475-513).
+        # (reference htfa.py:475-513).  On resume the restored template
+        # supersedes this init.
         idx = np.random.choice(n_subj, 1)[0]
         self.global_prior_, self.global_centers_cov, \
             self.global_widths_var = self.get_template(R[idx])
-        self.global_centers_cov_scaled = \
-            self.global_centers_cov / float(self.n_subj)
-        self.global_widths_var_scaled = \
-            self.global_widths_var / float(self.n_subj)
+        self.global_posterior_ = self.global_prior_.copy()
 
-        m = 0
-        outer_converged = False
-        while m < self.max_global_iter and not outer_converged:
-            if self.verbose:
-                logger.info("HTFA global iter %d", m)
-            posterior = self._fit_subjects(data, R, m)
-            self.local_posterior_ = posterior.ravel()
-            self.gather_posterior = self.local_posterior_.copy()
-            self._map_update_posterior()
-            self._assign_posterior()
-            outer_converged, max_diff = self._converged()
-            if outer_converged:
-                logger.info("converged at %d outer iter", m)
-            else:
+        def pack(done):
+            return {
+                "global_prior": np.asarray(self.global_prior_, float),
+                "global_posterior": np.asarray(self.global_posterior_,
+                                               float),
+                "local_posterior": np.asarray(self.local_posterior_,
+                                              float),
+                "centers_cov": np.asarray(self.global_centers_cov,
+                                          float),
+                "widths_var": np.array([self.global_widths_var],
+                                       dtype=float),
+                "done": np.array(float(done)),
+            }
+
+        def unpack(state):
+            self.global_prior_ = np.array(state["global_prior"], float)
+            self.global_posterior_ = np.array(state["global_posterior"],
+                                              float)
+            self.local_posterior_ = np.array(state["local_posterior"],
+                                             float)
+            self.global_centers_cov = np.array(state["centers_cov"],
+                                               float)
+            self.global_widths_var = float(
+                np.asarray(state["widths_var"]).reshape(-1)[0])
+            self.global_centers_cov_scaled = \
+                self.global_centers_cov / float(self.n_subj)
+            self.global_widths_var_scaled = \
+                self.global_widths_var / float(self.n_subj)
+
+        def run_chunk(state, step, n_steps):
+            unpack(state)
+            done = False
+            for i in range(n_steps):
+                m = step + i
+                if self.verbose:
+                    logger.info("HTFA global iter %d", m)
+                posterior = self._fit_subjects(data, R, m)
+                self.local_posterior_ = posterior.ravel()
+                self.gather_posterior = self.local_posterior_.copy()
+                self._map_update_posterior()
+                self._assign_posterior()
+                check_state(
+                    {"global_posterior": self.global_posterior_,
+                     "local_posterior": self.local_posterior_},
+                    iteration=m + 1, where="HTFA.fit")
+                done, max_diff = self._converged()
+                if done:
+                    logger.info("converged at %d outer iter", m)
+                    break
                 self.global_prior_ = self.global_posterior_
-            m += 1
+            return pack(done), done
+
+        fingerprint = np.array(
+            [array_digest(*data),
+             float(sum(d.shape[0] for d in data)), float(n_subj),
+             float(self.K)])
+        state, _ = run_resilient_loop(
+            run_chunk, pack(False), self.max_global_iter,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            fingerprint=fingerprint, name="HTFA.fit")
+        unpack(state)
 
         self._update_weight(data, R)
         return self
@@ -462,11 +517,20 @@ class HTFA(TFA):
                 raise TypeError("The numbers of voxels in data and "
                                 "coordinates differ")
 
-    def fit(self, X, R):
+    def fit(self, X, R, checkpoint_dir=None, checkpoint_every=5):
         """Fit HTFA (reference htfa.py:766-841).
 
         X : list of [n_voxel, n_tr] per-subject data
         R : list of [n_voxel, n_dim] per-subject coordinates
+
+        With ``checkpoint_dir``, the global-template loop checkpoints
+        every ``checkpoint_every`` global iterations under the
+        resilience guard and a later call resumes after preemption.
+
+        Example
+        -------
+        >>> htfa = HTFA(K=5, n_subj=len(X))
+        >>> htfa.fit(X, R, checkpoint_dir="/ckpts/htfa1")  # resumable
         """
         self._check_input(X, R)
         if self.weight_method not in ('rr', 'ols'):
@@ -484,5 +548,6 @@ class HTFA(TFA):
         self.cov_vec_size = np.sum(np.arange(self.n_dim) + 1)
         self.map_offset = self.get_map_offset()
         self.prior_size = self.K * (self.n_dim + 1)
-        self._fit_htfa(X, R)
+        self._fit_htfa(X, R, checkpoint_dir=checkpoint_dir,
+                       checkpoint_every=checkpoint_every)
         return self

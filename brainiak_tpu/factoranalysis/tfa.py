@@ -30,6 +30,9 @@ from sklearn.cluster import KMeans
 
 from ..ops.optimize import minimize_bounded
 from ..ops.rbf import rbf_factors
+from ..resilience.guards import (array_digest, check_state,
+                                 pack_rng_state, run_resilient_loop,
+                                 unpack_rng_state)
 from ..utils.utils import from_sym_2_tri, from_tri_2_sym
 
 logger = logging.getLogger(__name__)
@@ -352,9 +355,13 @@ class TFA(BaseEstimator):
                 template_widths_mean_var_reci)
         return self
 
-    def _fit_tfa(self, data, R, template_prior=None):
+    def _fit_tfa(self, data, R, template_prior=None,
+                 checkpoint_dir=None, checkpoint_every=5):
         """Outer loop: subsample-fit until converged
-        (reference tfa.py:824-877)."""
+        (reference tfa.py:824-877), driven by the resilient loop:
+        per-iteration non-finite guard with checkpoint rollback and —
+        with ``checkpoint_dir`` — preemption-safe resume including the
+        subsampling RNG stream position."""
         if template_prior is None:
             template_centers = None
             template_widths = None
@@ -368,26 +375,73 @@ class TFA(BaseEstimator):
             template_widths_mean_var_reci = \
                 1.0 / self.get_widths_mean_var(template_prior)
         self._rng = np.random.RandomState(self.seed)
-        inner_converged = False
-        n = 0
-        while n < self.miter and not inner_converged:
-            self._fit_tfa_inner(data, R, template_centers,
-                                template_widths,
-                                template_centers_mean_cov,
-                                template_widths_mean_var_reci)
-            self._assign_posterior()
-            inner_converged, max_diff = self._converged()
-            if not inner_converged:
+
+        def pack(done):
+            keys, meta = pack_rng_state(self._rng)
+            return {
+                "prior": np.asarray(self.local_prior, dtype=float),
+                "posterior": np.asarray(
+                    getattr(self, "local_posterior_", self.local_prior),
+                    dtype=float),
+                "rng_keys": keys, "rng_meta": meta,
+                "done": np.array(float(done)),
+            }
+
+        def unpack(state):
+            self.local_prior = np.array(state["prior"], dtype=float)
+            self.local_posterior_ = np.array(state["posterior"],
+                                             dtype=float)
+            unpack_rng_state(self._rng, state["rng_keys"],
+                             state["rng_meta"])
+
+        def run_chunk(state, step, n_steps):
+            unpack(state)
+            done = False
+            for i in range(n_steps):
+                self._fit_tfa_inner(data, R, template_centers,
+                                    template_widths,
+                                    template_centers_mean_cov,
+                                    template_widths_mean_var_reci)
+                self._assign_posterior()
+                check_state({"posterior": self.local_posterior_},
+                            iteration=step + i + 1, where="TFA.fit")
+                converged, max_diff = self._converged()
+                if converged:
+                    if self.verbose:
+                        logger.info("TFA converged at %d iteration.",
+                                    step + i)
+                    done = True
+                    break
                 self.local_prior = self.local_posterior_
-            elif self.verbose:
-                logger.info("TFA converged at %d iteration.", n)
-            n += 1
+            return pack(done), done
+
+        fingerprint = np.array(
+            [array_digest(data), float(data.shape[0]),
+             float(data.shape[1]), float(self.K), float(self.seed)])
+        state, _ = run_resilient_loop(
+            run_chunk, pack(False), self.miter,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            fingerprint=fingerprint, name="TFA.fit")
+        unpack(state)
         return self
 
-    def fit(self, X, R, template_prior=None):
+    def fit(self, X, R, template_prior=None, checkpoint_dir=None,
+            checkpoint_every=5):
         """Fit TFA to one subject (reference tfa.py:971-1024).
 
-        X: [n_voxel, n_tr] data; R: [n_voxel, n_dim] coordinates."""
+        X: [n_voxel, n_tr] data; R: [n_voxel, n_dim] coordinates.
+
+        With ``checkpoint_dir``, the outer subsample-fit loop
+        checkpoints every ``checkpoint_every`` iterations (including
+        the subsampling RNG stream) under the resilience guard, and a
+        later call with the same directory resumes after preemption.
+
+        Example
+        -------
+        >>> tfa = TFA(K=5, max_iter=10)
+        >>> tfa.fit(X, R, checkpoint_dir="/ckpts/tfa_s01")  # resumable
+        """
         if not isinstance(X, np.ndarray):
             raise TypeError("Input data should be an array")
         if X.ndim != 2:
@@ -416,7 +470,9 @@ class TFA(BaseEstimator):
             self.init_prior(R)
         else:
             self.local_prior = template_prior[0:self.map_offset[2]].copy()
-        self._fit_tfa(X, R, template_prior)
+        self._fit_tfa(X, R, template_prior,
+                      checkpoint_dir=checkpoint_dir,
+                      checkpoint_every=checkpoint_every)
         if template_prior is None:
             centers = self.get_centers(self.local_posterior_)
             widths = self.get_widths(self.local_posterior_)

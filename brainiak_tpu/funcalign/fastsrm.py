@@ -212,9 +212,21 @@ class FastSRM(BaseEstimator, TransformerMixin):
         return basis
 
     # -- API --------------------------------------------------------------
-    def fit(self, imgs):
+    def fit(self, imgs, checkpoint_dir=None, checkpoint_every=5):
         """Fit bases from multi-subject (multi-session) data
-        (reference fastsrm.py:1383-1466)."""
+        (reference fastsrm.py:1383-1466).
+
+        With ``checkpoint_dir``, the iterative stage (the reduced-space
+        deterministic SRM) checkpoints every ``checkpoint_every``
+        iterations under the resilience guard and resumes after
+        preemption; the surrounding projection/SVD stages are
+        single-dispatch and recomputed deterministically.
+
+        Example
+        -------
+        >>> fsrm = FastSRM(n_components=10, n_iter=100)
+        >>> fsrm.fit(imgs, checkpoint_dir="/ckpts/fast1")  # resumable
+        """
         imgs = _canonicalize_imgs(imgs)
         n_subjects = len(imgs)
         if n_subjects <= 1:
@@ -252,7 +264,13 @@ class FastSRM(BaseEstimator, TransformerMixin):
              for subj in reduced[1:]]
         srm = DetSRM(n_iter=self.n_iter, features=self.n_components,
                      rand_seed=self.seed)
-        srm.fit(X)
+        # the reduced-space SRM is the preemption-prone iterative stage;
+        # forward the checkpoint contract so it runs under the
+        # resilient loop (guard + rollback + resume)
+        srm.fit(X,
+                checkpoint_dir=None if checkpoint_dir is None else
+                os.path.join(checkpoint_dir, "reduced_srm"),
+                checkpoint_every=checkpoint_every)
         concatenated_s = np.mean(
             [s for s in srm.transform(X)], axis=0).T  # [T_total, K]
         shared_sessions = []

@@ -21,6 +21,9 @@ from sklearn.base import BaseEstimator, TransformerMixin
 from sklearn.exceptions import NotFittedError
 from sklearn.utils import assert_all_finite
 
+from ..resilience.guards import (array_digest, check_state,
+                                 make_device_carry_chunk,
+                                 run_resilient_loop)
 from .srm import _init_w, _procrustes, _stack_and_pad
 
 logger = logging.getLogger(__name__)
@@ -38,13 +41,11 @@ def _shared_response(x, s, w, n_subjects):
     return jnp.einsum('svk,svt->kt', w, x - s) / n_subjects
 
 
-@partial(jax.jit, static_argnames=("features", "n_iter"))
-def _fit_rsrm(x, voxel_counts, key, gamma, features, n_iter):
-    """Full RSRM BCD fit as one XLA program (reference rsrm.py:256-350)."""
-    n_subjects, voxels_pad, trs = x.shape
-    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
-    s = jnp.zeros_like(x)
-    r = _shared_response(x, s, w, n_subjects)
+@partial(jax.jit, static_argnames=("n_steps",))
+def _rsrm_chunk(x, w, s, r, gamma, n_steps):
+    """``n_steps`` RSRM BCD iterations from explicit state — the
+    checkpointable unit for preemption-safe fits."""
+    n_subjects = x.shape[0]
 
     def body(_, carry):
         w, s, r = carry
@@ -54,11 +55,25 @@ def _fit_rsrm(x, voxel_counts, key, gamma, features, n_iter):
         r = _shared_response(x, s, w, n_subjects)
         return w, s, r
 
-    w, s, r = jax.lax.fori_loop(0, n_iter, body, (w, s, r))
-    objective = 0.5 * jnp.sum(
+    return jax.lax.fori_loop(0, n_steps, body, (w, s, r))
+
+
+@jax.jit
+def _rsrm_objective(x, w, s, r, gamma):
+    return 0.5 * jnp.sum(
         (x - jnp.einsum('svk,kt->svt', w, r) - s) ** 2) \
         + gamma * jnp.sum(jnp.abs(s))
-    return w, s, r, objective
+
+
+@partial(jax.jit, static_argnames=("features", "n_iter"))
+def _fit_rsrm(x, voxel_counts, key, gamma, features, n_iter):
+    """Full RSRM BCD fit as one XLA program (reference rsrm.py:256-350)."""
+    n_subjects, voxels_pad, trs = x.shape
+    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
+    s = jnp.zeros_like(x)
+    r = _shared_response(x, s, w, n_subjects)
+    w, s, r = _rsrm_chunk(x, w, s, r, gamma, n_steps=n_iter)
+    return w, s, r, _rsrm_objective(x, w, s, r, gamma)
 
 
 @partial(jax.jit, static_argnames=("n_iter",))
@@ -108,7 +123,17 @@ class RSRM(BaseEstimator, TransformerMixin):
         self.rand_seed = rand_seed
         self.mesh = mesh
 
-    def fit(self, X, y=None):
+    def fit(self, X, y=None, checkpoint_dir=None, checkpoint_every=5):
+        """Fit the robust SRM.  With ``checkpoint_dir``, BCD state is
+        saved every ``checkpoint_every`` iterations under the
+        resilience guard (non-finite rollback) and a later call resumes
+        from the latest checkpoint.
+
+        Example
+        -------
+        >>> rsrm = RSRM(n_iter=20, features=10, gamma=1.0)
+        >>> rsrm.fit(data, checkpoint_dir="/ckpts/rsrm1")  # resumable
+        """
         logger.info('Starting RSRM')
         if self.gamma <= 0.0:
             raise ValueError("Gamma parameter should be positive.")
@@ -128,6 +153,9 @@ class RSRM(BaseEstimator, TransformerMixin):
 
         dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
         stacked, voxel_counts, _, _ = _stack_and_pad(X, dtype, demean=False)
+        # host-side content digest (float64-reproducible; not
+        # degenerate for z-scored data), taken before device placement
+        data_digest = array_digest(stacked) if checkpoint_dir else 0.0
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -139,16 +167,63 @@ class RSRM(BaseEstimator, TransformerMixin):
                     PartitionSpec(DEFAULT_SUBJECT_AXIS, None, None)))
 
         key = jax.random.PRNGKey(self.rand_seed)
-        w, s, r, objective = _fit_rsrm(
-            jnp.asarray(stacked), jnp.asarray(voxel_counts).astype(dtype),
-            key, self.gamma, features=self.features, n_iter=self.n_iter)
+        stacked_j = jnp.asarray(stacked)
+        counts_j = jnp.asarray(voxel_counts).astype(dtype)
+        if checkpoint_dir is None:
+            w, s, r, objective = _fit_rsrm(
+                stacked_j, counts_j, key, self.gamma,
+                features=self.features, n_iter=self.n_iter)
+        else:
+            w, s, r, objective = self._fit_checkpointed(
+                stacked_j, counts_j, key, dtype, data_digest,
+                checkpoint_dir, checkpoint_every)
         w = np.asarray(w)
         s = np.asarray(s)
         self.w_ = [w[i, :voxel_counts[i]] for i in range(len(X))]
         self.s_ = [s[i, :voxel_counts[i]] for i in range(len(X))]
         self.r_ = np.asarray(r)
         self.objective_ = float(objective)
+        check_state({"w": w, "s": s, "r": self.r_,
+                     "objective": self.objective_},
+                    iteration=self.n_iter, where="RSRM.fit")
         return self
+
+    def _fit_checkpointed(self, stacked, counts_j, key, dtype,
+                          data_digest, checkpoint_dir,
+                          checkpoint_every):
+        """Chunked BCD under the resilient-loop driver (guard +
+        rollback + checkpoint/resume + fault hooks)."""
+        n_subjects, voxels_pad, trs = stacked.shape
+        fingerprint = np.array(
+            [data_digest, float(trs),
+             float(voxels_pad), float(n_subjects),
+             float(self.features), float(self.rand_seed),
+             float(self.gamma)])
+        template = {
+            "w": np.zeros((n_subjects, voxels_pad, self.features),
+                          dtype=dtype),
+            "s": np.zeros((n_subjects, voxels_pad, trs), dtype=dtype),
+            "r": np.zeros((self.features, trs), dtype=dtype),
+        }
+        w0 = _init_w(key, voxels_pad, n_subjects, self.features,
+                     counts_j)
+        s0 = jnp.zeros_like(stacked)
+        r0 = _shared_response(stacked, s0, w0, n_subjects)
+        init_state = {"w": np.asarray(w0), "s": np.asarray(s0),
+                      "r": np.asarray(r0)}
+
+        run_chunk, final_leaves = make_device_carry_chunk(
+            lambda dev, n: _rsrm_chunk(stacked, *dev, self.gamma,
+                                       n_steps=n),
+            ("w", "s", "r"), dtype=dtype)
+        state, step = run_resilient_loop(
+            run_chunk, init_state, self.n_iter,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            fingerprint=fingerprint, template=template,
+            name="RSRM.fit")
+        w, s, r = final_leaves(state, step)
+        return w, s, r, _rsrm_objective(stacked, w, s, r, self.gamma)
 
     def transform(self, X):
         """Returns (shared responses, individual terms) for new data

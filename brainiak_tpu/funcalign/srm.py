@@ -36,6 +36,9 @@ from sklearn.utils import assert_all_finite
 
 from ..parallel.mesh import (DEFAULT_SUBJECT_AXIS, fetch_replicated,
                              place_on_mesh)
+from ..resilience.guards import (array_digest, check_state,
+                                 make_device_carry_chunk,
+                                 run_resilient_loop)
 
 __all__ = ["SRM", "DetSRM", "load"]
 
@@ -269,27 +272,35 @@ _fit_prob_srm_jit = jax.jit(_fit_prob_srm,
 
 
 
-def _fit_det_srm(x, voxel_counts, key, features, n_iter):
-    """Deterministic SRM block-coordinate descent (srm.py:859-918):
-    alternate Procrustes W updates with S = mean_i W_iᵀ X_i."""
-    n_subjects, voxels_pad, samples = x.shape
-    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
-
-    def compute_shared(w):
-        return jnp.einsum('svk,svt->kt', w, x) / n_subjects
-
-    shared = compute_shared(w)
+@partial(jax.jit, static_argnames=("n_steps",))
+def _det_chunk(x, w, shared, n_steps):
+    """``n_steps`` deterministic-SRM BCD iterations from explicit
+    state — the checkpointable unit for preemption-safe fits."""
+    n_subjects = x.shape[0]
 
     def body(_, carry):
         w, shared = carry
         a = jnp.einsum('svt,kt->svk', x, shared)
         w = jax.vmap(_procrustes)(a)
-        return w, compute_shared(w)
+        return w, jnp.einsum('svk,svt->kt', w, x) / n_subjects
 
-    w, shared = jax.lax.fori_loop(0, n_iter, body, (w, shared))
-    objective = jnp.sum(
+    return jax.lax.fori_loop(0, n_steps, body, (w, shared))
+
+
+@jax.jit
+def _det_objective(x, w, shared):
+    return jnp.sum(
         jnp.square(x - jnp.einsum('svk,kt->svt', w, shared))) / 2.0
-    return w, shared, objective
+
+
+def _fit_det_srm(x, voxel_counts, key, features, n_iter):
+    """Deterministic SRM block-coordinate descent (srm.py:859-918):
+    alternate Procrustes W updates with S = mean_i W_iᵀ X_i."""
+    n_subjects, voxels_pad, samples = x.shape
+    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
+    shared = jnp.einsum('svk,svt->kt', w, x) / n_subjects
+    w, shared = _det_chunk(x, w, shared, n_steps=n_iter)
+    return w, shared, _det_objective(x, w, shared)
 
 
 _fit_det_srm_jit = jax.jit(_fit_det_srm,
@@ -406,11 +417,28 @@ class SRM(_SRMBase):
         """Fit the model.  With ``checkpoint_dir``, EM state is saved
         every ``checkpoint_every`` iterations and a later call resumes
         from the latest checkpoint — mid-iteration resume the reference
-        lacks (SURVEY.md §5.4)."""
+        lacks (SURVEY.md §5.4).  The checkpointed loop runs under the
+        resilience guard: non-finite EM state rolls back to the last
+        good checkpoint and, if divergence persists, aborts with
+        :class:`~brainiak_tpu.resilience.DivergenceError`.
+
+        Example
+        -------
+        >>> srm = SRM(n_iter=20, features=10)
+        >>> srm.fit(data, checkpoint_dir="/ckpts/srm_run1")  # preempted
+        >>> srm.fit(data, checkpoint_dir="/ckpts/srm_run1")  # resumes
+        """
         logger.info('Starting Probabilistic SRM')
         self._validate(X)
         dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
         stacked, voxel_counts, mu, trace_xtx = _stack_and_pad(X, dtype)
+        # content digest on the HOST stack, before device placement:
+        # bit-reproducible across restarts (float64 numpy) and not
+        # degenerate for z-scored data the way sum-of-squares is.
+        # The voxel means are part of the digest — the stack itself is
+        # demeaned, so X and X+c would otherwise collide.
+        data_digest = array_digest(stacked, *mu) if checkpoint_dir \
+            else 0.0
         stacked = self._device_place(stacked)
 
         key = jax.random.PRNGKey(self.rand_seed)
@@ -422,7 +450,7 @@ class SRM(_SRMBase):
         else:
             w, rho2, sigma_s, shared, ll = self._fit_checkpointed(
                 stacked, trace_xtx, voxel_counts, key, dtype,
-                checkpoint_dir, checkpoint_every)
+                data_digest, checkpoint_dir, checkpoint_every)
 
         # fetch_replicated on every leaf: under a multi-process mesh
         # the subject-sharded w/rho2 are not addressable for a plain
@@ -436,24 +464,29 @@ class SRM(_SRMBase):
         self.mu_ = mu
         self.rho2_ = fetch_replicated(rho2, self.mesh)
         self.logprob_ = float(ll)
+        # non-finite guard on the fitted state (the checkpointed path
+        # guards every chunk; the fused path is guarded here)
+        check_state({"w": w, "rho2": self.rho2_, "sigma_s": self.sigma_s_,
+                     "shared": self.s_, "logprob": self.logprob_},
+                    iteration=self.n_iter, where="SRM.fit")
         logger.info('Objective function %f', self.logprob_)
         return self
 
     def _fit_checkpointed(self, stacked, trace_xtx, voxel_counts, key,
-                          dtype, checkpoint_dir, checkpoint_every):
-        """Chunked EM with orbax checkpoints between chunks."""
-        from ..utils.checkpoint import CheckpointManager
-
+                          dtype, data_digest, checkpoint_dir,
+                          checkpoint_every):
+        """Chunked EM under the resilient-loop driver: orbax/npz
+        checkpoints between chunks, non-finite guard with rollback, and
+        deterministic fault-injection hooks."""
         n_subjects, voxels_pad, samples = stacked.shape
         trace_j = jnp.asarray(trace_xtx)
         counts_j = jnp.asarray(voxel_counts).astype(dtype)
 
-        mngr = CheckpointManager(checkpoint_dir)
         # fingerprint ties a checkpoint to this (data, config); resuming
         # against different data or settings is an error, not a silent
         # wrong answer
         fingerprint = np.array(
-            [float(np.sum(np.asarray(trace_xtx))), float(samples),
+            [data_digest, float(samples),
              float(voxels_pad), float(n_subjects),
              float(self.features), float(self.rand_seed)])
         template = {
@@ -463,47 +496,28 @@ class SRM(_SRMBase):
             "sigma_s": np.zeros((self.features, self.features),
                                 dtype=dtype),
             "shared": np.zeros((self.features, samples), dtype=dtype),
-            "fingerprint": np.zeros_like(fingerprint),
         }
-        step, state = mngr.restore(template=template)
-        if state is None:
-            w = _init_w(key, voxels_pad, n_subjects, self.features,
-                        counts_j)
-            rho2 = jnp.ones(n_subjects, dtype=dtype)
-            sigma_s = jnp.eye(self.features, dtype=dtype)
-            shared = jnp.zeros((self.features, samples), dtype=dtype)
-            step = 0
-        else:
-            if not np.allclose(np.asarray(state["fingerprint"]),
-                               fingerprint, rtol=1e-10):
-                raise ValueError(
-                    "Checkpoint in {} was written for different data or "
-                    "model settings; use a fresh checkpoint_dir".format(
-                        checkpoint_dir))
-            if step > self.n_iter:
-                raise ValueError(
-                    "Checkpoint is at iteration {} but n_iter={}; use a "
-                    "fresh checkpoint_dir or raise n_iter".format(
-                        step, self.n_iter))
-            w = jnp.asarray(state["w"], dtype=dtype)
-            rho2 = jnp.asarray(state["rho2"], dtype=dtype)
-            sigma_s = jnp.asarray(state["sigma_s"], dtype=dtype)
-            shared = jnp.asarray(state["shared"], dtype=dtype)
-            logger.info("resumed SRM fit from iteration %d", step)
+        w0 = _init_w(key, voxels_pad, n_subjects, self.features,
+                     counts_j)
+        init_state = {
+            "w": fetch_replicated(w0, self.mesh),
+            "rho2": np.ones(n_subjects, dtype=dtype),
+            "sigma_s": np.eye(self.features, dtype=dtype),
+            "shared": np.zeros((self.features, samples), dtype=dtype),
+        }
 
-        while step < self.n_iter:
-            n_steps = min(checkpoint_every, self.n_iter - step)
-            w, rho2, sigma_s, shared = _em_chunk(
-                stacked, trace_j, counts_j, w, rho2, sigma_s, shared,
-                n_steps=n_steps)
-            step += n_steps
-            mngr.save(step, {"w": fetch_replicated(w, self.mesh),
-                             "rho2": fetch_replicated(rho2, self.mesh),
-                             "sigma_s": fetch_replicated(sigma_s,
-                                                         self.mesh),
-                             "shared": fetch_replicated(shared, self.mesh),
-                             "fingerprint": fingerprint})
-
+        run_chunk, final_leaves = make_device_carry_chunk(
+            lambda dev, n: _em_chunk(stacked, trace_j, counts_j, *dev,
+                                     n_steps=n),
+            ("w", "rho2", "sigma_s", "shared"),
+            fetch=lambda v: fetch_replicated(v, self.mesh),
+            dtype=dtype)
+        state, step = run_resilient_loop(
+            run_chunk, init_state, self.n_iter,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            fingerprint=fingerprint, template=template, name="SRM.fit")
+        w, rho2, sigma_s, shared = final_leaves(state, step)
         ll = _final_log_likelihood(stacked, w, rho2, sigma_s, trace_j,
                                    counts_j)
         return w, rho2, sigma_s, shared, ll
@@ -556,21 +570,77 @@ class DetSRM(_SRMBase):
     Σ_i ||X_i − W_i S||²_F with orthonormal W_i by block-coordinate descent.
     """
 
-    def fit(self, X, y=None):
+    def fit(self, X, y=None, checkpoint_dir=None, checkpoint_every=5):
+        """Fit the deterministic SRM.  With ``checkpoint_dir``, BCD
+        state is saved every ``checkpoint_every`` iterations under the
+        resilience guard and a later call resumes from the latest
+        checkpoint.
+
+        Example
+        -------
+        >>> det = DetSRM(n_iter=30, features=10)
+        >>> det.fit(data, checkpoint_dir="/ckpts/det_run1")  # resumable
+        """
         logger.info('Starting Deterministic SRM')
         self._validate(X)
         dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
-        stacked, voxel_counts, _, _ = _stack_and_pad(X, dtype, demean=False)
+        stacked, voxel_counts, _, _ = _stack_and_pad(
+            X, dtype, demean=False)
+        data_digest = array_digest(stacked) if checkpoint_dir else 0.0
         stacked = self._device_place(stacked)
 
         key = jax.random.PRNGKey(self.rand_seed)
-        w, shared, objective = _fit_det_srm_jit(
-            stacked, jnp.asarray(voxel_counts).astype(dtype), key,
-            features=self.features, n_iter=self.n_iter)
+        if checkpoint_dir is None:
+            w, shared, objective = _fit_det_srm_jit(
+                stacked, jnp.asarray(voxel_counts).astype(dtype), key,
+                features=self.features, n_iter=self.n_iter)
+        else:
+            w, shared, objective = self._fit_checkpointed(
+                stacked, voxel_counts, key, dtype, data_digest,
+                checkpoint_dir, checkpoint_every)
 
         w = fetch_replicated(w, self.mesh)
         self.w_ = [w[i, :voxel_counts[i]] for i in range(len(X))]
         self.s_ = fetch_replicated(shared, self.mesh)
         self.objective_ = float(objective)
+        check_state({"w": w, "shared": self.s_,
+                     "objective": self.objective_},
+                    iteration=self.n_iter, where="DetSRM.fit")
         logger.info('Objective function %f', self.objective_)
         return self
+
+    def _fit_checkpointed(self, stacked, voxel_counts, key, dtype,
+                          data_digest, checkpoint_dir,
+                          checkpoint_every):
+        """Chunked BCD under the resilient-loop driver (same shape as
+        :meth:`SRM._fit_checkpointed`)."""
+        n_subjects, voxels_pad, samples = stacked.shape
+        counts_j = jnp.asarray(voxel_counts).astype(dtype)
+        fingerprint = np.array(
+            [data_digest, float(samples),
+             float(voxels_pad), float(n_subjects),
+             float(self.features), float(self.rand_seed)])
+        template = {
+            "w": np.zeros((n_subjects, voxels_pad, self.features),
+                          dtype=dtype),
+            "shared": np.zeros((self.features, samples), dtype=dtype),
+        }
+        w0 = _init_w(key, voxels_pad, n_subjects, self.features,
+                     counts_j)
+        shared0 = jnp.einsum('svk,svt->kt', w0, stacked) / n_subjects
+        init_state = {"w": fetch_replicated(w0, self.mesh),
+                      "shared": fetch_replicated(shared0, self.mesh)}
+
+        run_chunk, final_leaves = make_device_carry_chunk(
+            lambda dev, n: _det_chunk(stacked, *dev, n_steps=n),
+            ("w", "shared"),
+            fetch=lambda v: fetch_replicated(v, self.mesh),
+            dtype=dtype)
+        state, step = run_resilient_loop(
+            run_chunk, init_state, self.n_iter,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            fingerprint=fingerprint, template=template,
+            name="DetSRM.fit")
+        w, shared = final_leaves(state, step)
+        return w, shared, _det_objective(stacked, w, shared)

@@ -13,6 +13,8 @@ import numpy as np
 
 from . import nifti
 from .image import SingleConditionSpec
+from .resilience import faults
+from .resilience.retry import retry
 
 __all__ = [
     "load_boolean_mask",
@@ -57,9 +59,13 @@ def load_boolean_mask(path: Union[str, Path],
     return data.astype(bool)
 
 
+@retry(retries=3, backoff=0.25, retriable=(OSError,),
+       name="io.load_labels")
 def load_labels(path: Union[str, Path]) -> List[SingleConditionSpec]:
     """Load an ``.npy`` of condition-spec arrays as SingleConditionSpec views
-    (reference io.py:135-149)."""
+    (reference io.py:135-149).  Retries transient filesystem errors
+    like the image loaders (which inherit retry from ``nifti.load``)."""
+    faults.io_point(str(path), site="io.load_labels")
     condition_specs = np.load(str(path))
     return [c.view(SingleConditionSpec) for c in condition_specs]
 

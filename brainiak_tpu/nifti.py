@@ -10,8 +10,12 @@ features the framework needs — not a general neuroimaging library.
 
 import gzip
 import struct
+import zlib
 
 import numpy as np
+
+from .resilience import faults
+from .resilience.retry import retry
 
 __all__ = ["NiftiImage", "load", "save"]
 
@@ -74,7 +78,16 @@ def _quaternion_to_rotation(b, c, d):
     ])
 
 
+@retry(retries=3, backoff=0.25,
+       retriable=(OSError, EOFError, zlib.error), name="nifti.read")
 def _read_bytes(path):
+    # Shared-filesystem reads of subject images are the transient-
+    # failure hot spot of long multi-subject jobs; retry with backoff.
+    # A truncated .nii.gz mid-restage surfaces as EOFError or
+    # zlib.error (NOT OSError subclasses; only BadGzipFile is), so
+    # those are retriable too.  The faults hook lets tests inject the
+    # failure deterministically.
+    faults.io_point(path, site="nifti.read")
     path = str(path)
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
@@ -82,7 +95,13 @@ def _read_bytes(path):
 
 
 def load(path):
-    """Load a ``.nii`` / ``.nii.gz`` file into a :class:`NiftiImage`."""
+    """Load a ``.nii`` / ``.nii.gz`` file into a :class:`NiftiImage`.
+
+    Reads retry transient failures (``OSError``, truncated-gzip
+    ``EOFError``/``zlib.error``) with exponential backoff (see
+    :mod:`brainiak_tpu.resilience.retry`), so a momentary shared-
+    filesystem hiccup does not kill an hours-long multi-subject fit.
+    """
     raw = _read_bytes(path)
     if len(raw) < _HDR_SIZE:
         raise ValueError(f"{path}: too short to be a NIfTI-1 file")

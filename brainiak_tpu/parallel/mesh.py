@@ -7,11 +7,17 @@ per-voxel arrays accordingly.  Collectives are inserted by XLA (GSPMD)
 rather than called explicitly.
 """
 
+import functools
+import logging
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..resilience.retry import retry
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "DEFAULT_SUBJECT_AXIS",
@@ -37,12 +43,46 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
 
     No-op for single-process runs; on a pod slice each host calls this
     before building meshes so ``jax.devices()`` spans the slice.
+
+    The coordinator connect retries with exponential backoff: on a
+    freshly scheduled (or preemption-restarted) slice the workers
+    routinely come up before the coordinator process is listening, and
+    the resulting connect failure is transient, not fatal.
     """
     if num_processes is not None and num_processes > 1:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id)
+        def _transient(exc):
+            # RuntimeError is retried only when it looks like a
+            # transient connect failure; deterministic errors (already
+            # initialized, bad config) propagate immediately instead
+            # of burning the backoff budget.
+            if not isinstance(exc, RuntimeError):
+                return True
+            msg = str(exc).lower()
+            return any(tok in msg for tok in
+                       ("deadline", "unavailable", "connect",
+                        "timed out", "timeout"))
+
+        connect = retry(
+            jax.distributed.initialize, retries=4, backoff=1.0,
+            retriable=(OSError, ConnectionError, RuntimeError),
+            retry_if=_transient,
+            name="jax.distributed.initialize")
+        connect(coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
+
+
+@functools.lru_cache(maxsize=None)
+def _replicate_identity(mesh: Mesh):
+    """Mesh-keyed cached jitted identity with replicated out_shardings —
+    the collective-reshard fallback for :func:`fetch_replicated`.
+
+    Caching per mesh matters: jit caches key on function identity, so a
+    fresh ``jax.jit(lambda a: a, ...)`` per call would recompile (and
+    re-lower the all-gather) on every fetch.
+    """
+    return jax.jit(lambda a: a,
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
 
 
 def fetch_replicated(x, mesh: Optional[Mesh] = None):
@@ -57,16 +97,35 @@ def fetch_replicated(x, mesh: Optional[Mesh] = None):
     cross-process-sharded array raises.  Results in this framework are
     small (per-voxel scalars, factor parameters), so replication is
     cheap relative to the compute that produced them.
+
+    Backend dependency: the fast path relies on ``jax.device_put``
+    supporting CROSS-PROCESS resharding (moving shards between
+    processes outside a jitted computation).  That capability landed in
+    jax 0.4.x for TPU/ICI and is still backend-dependent — plugin PJRT
+    backends (and some GPU transports) reject it.  On those backends
+    this falls back to a mesh-keyed cached jitted identity whose
+    replicated ``out_shardings`` makes XLA itself insert the
+    all-gather, which every SPMD backend supports.
     """
     if mesh is None and isinstance(x, jax.Array) \
             and not x.is_fully_addressable:
         mesh = x.sharding.mesh
     if mesh is None or jax.process_count() == 1:
         return np.asarray(x)
-    # device_put reshards across process boundaries without tracing a
-    # fresh jitted identity per call (which would recompile every fetch:
-    # jit caching keys on function identity).
-    rep = jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+    try:
+        # device_put reshards across process boundaries without tracing
+        # a fresh jitted identity per call.
+        rep = jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+    except (NotImplementedError, ValueError, RuntimeError) as exc:
+        # WARNING, not debug: if device_put failed for a reason other
+        # than a missing backend capability (mesh mismatch, OOM), the
+        # fallback will likely fail too and the root cause must not be
+        # hidden in a suppressed log.
+        logger.warning(
+            "cross-process device_put reshard failed (%s: %s); falling "
+            "back to the jitted-identity all-gather",
+            type(exc).__name__, exc)
+        rep = _replicate_identity(mesh)(x)
     return np.asarray(rep)
 
 

@@ -46,6 +46,9 @@ from sklearn.utils import assert_all_finite
 from sklearn.utils.validation import check_random_state
 
 from ..ops.optimize import minimize_lbfgs
+from ..resilience.guards import (array_digest, check_state,
+                                 pack_rng_state, run_resilient_loop,
+                                 unpack_rng_state)
 from ..utils.utils import cov2corr
 
 logger = logging.getLogger(__name__)
@@ -556,10 +559,22 @@ class BRSA(BaseEstimator, TransformerMixin):
 
     # -- API --------------------------------------------------------------
     def fit(self, X, design, nuisance=None, scan_onsets=None, coords=None,
-            inten=None):
+            inten=None, checkpoint_dir=None, checkpoint_every=5):
         """Fit the shared covariance U and per-voxel parameters
         (reference brsa.py:581-793).  Note the reference's argument
-        naming: X is the DATA [T, V]; design is [T, C]."""
+        naming: X is the DATA [T, V]; design is [T, C].
+
+        With ``checkpoint_dir``, each auto-nuisance outer round (the
+        fitted parameters, the nuisance design, and the RNG stream) is
+        checkpointed every ``checkpoint_every`` rounds under the
+        resilience guard, and a later call with the same directory
+        resumes after preemption.
+
+        Example
+        -------
+        >>> brsa = BRSA(n_iter=4, rank=2)
+        >>> brsa.fit(X, design, checkpoint_dir="/ckpts/brsa1")
+        """
         logger.info('Running Bayesian RSA')
         self.random_state_ = check_random_state(self.random_state)
         assert not self.GP_inten or self.GP_space, \
@@ -607,19 +622,75 @@ class BRSA(BaseEstimator, TransformerMixin):
                   "lims": lims,
                   "inten_on": bool(self.GP_inten and inten is not None)}
 
-        for it in range(max(self.n_iter, 1)):
-            result = self._fit_once(data, design, X0, scan_starts,
-                                    n_runs, n_c, rank, gp)
-            if not self.auto_nuisance or it == max(self.n_iter, 1) - 1:
-                break
-            # auto-nuisance: PCA of residuals after removing the estimated
-            # task response and current nuisance fit
-            resid = data - design @ result["beta"] - \
-                X0 @ result["beta0"]
-            X0 = np.column_stack(
-                [self._dc_regressors(n_t, scan_onsets),
-                 self._nuisance_components(resid)]
-                + ([nuisance] if nuisance is not None else []))
+        n_rounds = max(self.n_iter, 1)
+        res_keys = ("U", "L", "snr", "sigma2", "rho", "beta", "beta0")
+
+        def pack(X0_c, result, done):
+            keys, meta = pack_rng_state(self.random_state_)
+            state = {"X0": np.asarray(X0_c, dtype=float),
+                     "rng_keys": keys, "rng_meta": meta,
+                     "done": np.array(float(done))}
+            if result is not None:
+                for key in res_keys:
+                    state["res_" + key] = np.asarray(result[key], float)
+                state["res_loss"] = np.array(float(result["loss"]))
+                if gp_on:
+                    state["res_gp"] = np.array(
+                        [result["c_space"],
+                         result.get("c_inten", 0.0),
+                         result["tau2"]], dtype=float)
+            return state
+
+        def unpack(state):
+            unpack_rng_state(self.random_state_, state["rng_keys"],
+                             state["rng_meta"])
+            X0_c = np.array(state["X0"], dtype=float)
+            result = None
+            if "res_U" in state:
+                result = {key: np.array(state["res_" + key], float)
+                          for key in res_keys}
+                result["loss"] = float(np.asarray(state["res_loss"]))
+                if "res_gp" in state:
+                    gp_vals = np.asarray(state["res_gp"], float)
+                    result["c_space"] = float(gp_vals[0])
+                    result["c_inten"] = float(gp_vals[1])
+                    result["tau2"] = float(gp_vals[2])
+            return X0_c, result
+
+        def run_chunk(state, step, n_steps):
+            X0_c, result = unpack(state)
+            done = False
+            for i in range(n_steps):
+                it = step + i
+                result = self._fit_once(data, design, X0_c, scan_starts,
+                                        n_runs, n_c, rank, gp)
+                check_state({key: result[key] for key in res_keys},
+                            iteration=it + 1, where="BRSA.fit")
+                if not self.auto_nuisance or it == n_rounds - 1:
+                    done = True
+                    break
+                # auto-nuisance: PCA of residuals after removing the
+                # estimated task response and current nuisance fit
+                resid = data - design @ result["beta"] - \
+                    X0_c @ result["beta0"]
+                X0_c = np.column_stack(
+                    [self._dc_regressors(n_t, scan_onsets),
+                     self._nuisance_components(resid)]
+                    + ([nuisance] if nuisance is not None else []))
+            return pack(X0_c, result, done), done
+
+        # n_rounds is part of the fingerprint: the round count changes
+        # the nuisance-design sequence, so a checkpoint from a
+        # different n_iter is not resumable
+        fingerprint = np.array(
+            [array_digest(data), float(n_t), float(n_v), float(n_c),
+             float(rank), array_digest(design), float(n_rounds)])
+        state, _ = run_resilient_loop(
+            run_chunk, pack(X0, None, False), n_rounds,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            fingerprint=fingerprint, name="BRSA.fit")
+        X0, result = unpack(state)
 
         self.U_ = result["U"]
         self.L_ = result["L"]

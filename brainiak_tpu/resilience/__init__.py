@@ -1,0 +1,42 @@
+"""Resilience subsystem: preemption-safe fits, retry, and guards.
+
+Long TPU jobs get preempted, diverge, and hit transient I/O failures.
+This package gives every iterative estimator the standard recovery
+discipline of a production training stack:
+
+- :mod:`~brainiak_tpu.resilience.retry` — exponential-backoff retry for
+  transient failures (coordinator connect, NIfTI reads, checkpoint
+  I/O);
+- :mod:`~brainiak_tpu.resilience.guards` — non-finite-state guards with
+  checkpoint rollback, and :func:`run_resilient_loop`, the chunked
+  fit-loop driver every ``fit(..., checkpoint_dir=)`` runs under;
+- :mod:`~brainiak_tpu.resilience.faults` — deterministic fault
+  injection (``preempt`` / ``nan`` / ``io_error``) so the recovery
+  paths are exercised in CI.
+
+See ``docs/resilience.md`` for the full model.
+"""
+
+from . import faults  # noqa: F401
+from .faults import (  # noqa: F401
+    InjectedIOError,
+    PreemptionError,
+    inject,
+)
+from .guards import (  # noqa: F401
+    DivergenceError,
+    check_state,
+    run_resilient_loop,
+)
+from .retry import retry  # noqa: F401
+
+__all__ = [
+    "DivergenceError",
+    "InjectedIOError",
+    "PreemptionError",
+    "check_state",
+    "faults",
+    "inject",
+    "retry",
+    "run_resilient_loop",
+]

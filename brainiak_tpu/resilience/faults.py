@@ -1,0 +1,199 @@
+"""Deterministic fault injection for resilience tests.
+
+Long TPU jobs die in three characteristic ways: the scheduler preempts
+the host, an iterative state diverges to NaN/Inf, and shared-filesystem
+I/O fails transiently.  This module injects each of those — at an exact,
+reproducible point — so the recovery paths (checkpoint resume, guard
+rollback, retry) are exercised by fast CI tests instead of being claimed
+and never run.  No sleeps, subprocesses, or real preemption involved.
+
+Usage (context manager)::
+
+    from brainiak_tpu.resilience import faults
+
+    with faults.inject("preempt", at_step=4):
+        model.fit(X, checkpoint_dir=d)   # raises PreemptionError at
+                                         # the first checkpoint >= 4
+    model.fit(X, checkpoint_dir=d)       # resumes from the checkpoint
+
+Usage (environment)::
+
+    BRAINIAK_TPU_FAULT="preempt@4" python train.py
+
+Kinds
+-----
+``"preempt"``
+    :func:`preempt_point` raises :class:`PreemptionError` at the first
+    guarded-loop step ``>= at_step`` — *after* that step's checkpoint
+    was persisted, which is the recoverable half of real preemption
+    (the unrecoverable half, dying mid-save, is covered by the
+    checkpoint writer's atomic-rename discipline).
+``"nan"``
+    :func:`corrupt_state` poisons one leaf of the loop state at the
+    first step ``>= at_step``, exercising the non-finite guard's
+    rollback policy (:mod:`brainiak_tpu.resilience.guards`).
+``"io_error"``
+    :func:`io_point` raises :class:`InjectedIOError` (an ``OSError``)
+    from inside retry-wrapped I/O (NIfTI reads, checkpoint save or
+    restore), exercising :func:`brainiak_tpu.resilience.retry.retry`.
+    Here ``at_step`` counts I/O calls to let through first.
+
+Every fault fires ``times`` times (default 1) and is inert afterwards,
+so a retry or rollback that re-runs the failed operation succeeds —
+the "transient failure" contract.
+"""
+
+import logging
+import os
+from contextlib import contextmanager
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "FAULT_ENV_VAR",
+    "InjectedIOError",
+    "PreemptionError",
+    "corrupt_state",
+    "inject",
+    "io_point",
+    "preempt_point",
+]
+
+FAULT_ENV_VAR = "BRAINIAK_TPU_FAULT"
+
+KINDS = ("preempt", "nan", "io_error")
+
+
+class PreemptionError(RuntimeError):
+    """Injected preemption: the fit process was 'killed' at a step."""
+
+
+class InjectedIOError(OSError):
+    """Injected transient I/O failure (retriable)."""
+
+
+class _Fault:
+    def __init__(self, kind, at_step=0, times=1, leaf=None):
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r}; expected one of {KINDS}")
+        self.kind = kind
+        self.at_step = int(at_step)
+        self.times = int(times)
+        self.leaf = leaf
+        self.fired = 0
+        self.seen = 0  # io_error: calls observed so far
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"_Fault({self.kind!r}, at_step={self.at_step}, "
+                f"times={self.times}, fired={self.fired})")
+
+
+# Innermost-wins stack of active context-manager faults, plus at most
+# one env-var fault (parsed once per distinct spec so it fires once per
+# process, like a real environmental failure).
+_active = []
+_env_fault = None
+_env_spec_seen = None
+
+
+@contextmanager
+def inject(kind, at_step=0, times=1, leaf=None):
+    """Activate a fault for the dynamic extent of the ``with`` block.
+
+    Yields the fault record; ``fault.fired`` afterwards tells a test
+    whether the fault actually triggered.
+    """
+    fault = _Fault(kind, at_step=at_step, times=times, leaf=leaf)
+    _active.append(fault)
+    try:
+        yield fault
+    finally:
+        _active.remove(fault)
+
+
+def _from_env():
+    """Parse ``BRAINIAK_TPU_FAULT="kind@step[xtimes]"`` lazily, once per
+    distinct spec value."""
+    global _env_fault, _env_spec_seen
+    spec = os.environ.get(FAULT_ENV_VAR)
+    if not spec:
+        return None
+    if spec != _env_spec_seen:
+        _env_spec_seen = spec
+        kind, _, rest = spec.partition("@")
+        step_s, _, times_s = rest.partition("x")
+        try:
+            _env_fault = _Fault(kind.strip(),
+                                at_step=int(step_s or 0),
+                                times=int(times_s or 1))
+        except ValueError:
+            logger.warning("ignoring malformed %s=%r (expected "
+                           "'kind@step[xtimes]')", FAULT_ENV_VAR, spec)
+            _env_fault = None
+    return _env_fault
+
+
+def _match(kind):
+    for fault in reversed(_active):
+        if fault.kind == kind and fault.fired < fault.times:
+            return fault
+    env = _from_env()
+    if env is not None and env.kind == kind and env.fired < env.times:
+        return env
+    return None
+
+
+def preempt_point(step, site="fit"):
+    """Hook called by guarded fit loops after persisting ``step``'s
+    checkpoint; raises :class:`PreemptionError` when a ``"preempt"``
+    fault has reached its trigger step."""
+    fault = _match("preempt")
+    if fault is not None and step >= fault.at_step:
+        fault.fired += 1
+        raise PreemptionError(
+            f"injected preemption in {site} at step {step}")
+
+
+def corrupt_state(state, step, site="fit"):
+    """Hook called by guarded fit loops on each new chunk state; returns
+    the state with one leaf poisoned with NaN when a ``"nan"`` fault has
+    reached its trigger step.  ``state`` is a flat dict of arrays; the
+    poisoned leaf is ``fault.leaf`` or the first floating-point leaf."""
+    fault = _match("nan")
+    if fault is None or step < fault.at_step:
+        return state
+    name = fault.leaf
+    if name is None:
+        for key, leaf in state.items():
+            if np.asarray(leaf).dtype.kind == "f":
+                name = key
+                break
+    if name is None or name not in state:
+        logger.warning("nan fault at step %d: no such leaf %r", step,
+                       fault.leaf)
+        return state
+    fault.fired += 1
+    logger.info("injecting NaN into leaf %r of %s at step %d", name,
+                site, step)
+    poisoned = np.array(np.asarray(state[name]), dtype=float, copy=True)
+    poisoned.reshape(-1)[0] = np.nan
+    out = dict(state)
+    out[name] = poisoned
+    return out
+
+
+def io_point(path="", site="io"):
+    """Hook called at the top of retry-wrapped I/O operations; raises
+    :class:`InjectedIOError` while an ``"io_error"`` fault is armed.
+    ``at_step`` counts calls to let through before firing."""
+    fault = _match("io_error")
+    if fault is None:
+        return
+    fault.seen += 1
+    if fault.seen > fault.at_step:
+        fault.fired += 1
+        raise InjectedIOError(
+            f"injected io_error in {site} for {path!r}")

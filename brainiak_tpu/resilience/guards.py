@@ -1,0 +1,302 @@
+"""Non-finite-state guards and the resilient fit-loop driver.
+
+Every iterative estimator in the framework (EM, BCD, annealed HMM,
+L-BFGS outer rounds) advances a flat dict of state arrays chunk by
+chunk.  :func:`run_resilient_loop` drives that shape uniformly:
+
+- **guard** — after each chunk the new state is checked for NaN/Inf
+  (:func:`check_state`); a non-finite leaf triggers a rollback to the
+  last good state and a deterministic re-run of the chunk, and after
+  ``max_rollbacks`` consecutive failures the fit aborts with a
+  :class:`DivergenceError` naming the offending leaves and iteration;
+- **checkpoint/resume** — with ``checkpoint_dir`` the state is
+  persisted every ``checkpoint_every`` iterations through
+  :class:`~brainiak_tpu.utils.checkpoint.CheckpointManager` (orbax, npz
+  fallback) and a later call resumes from the latest step, validated
+  against a data/config ``fingerprint``;
+- **fault hooks** — :mod:`brainiak_tpu.resilience.faults` injection
+  points (``nan`` corruption before the guard, ``preempt`` after each
+  checkpoint save) so CI exercises both recovery paths without real
+  preemption.
+
+The guard granularity is the chunk (``checkpoint_every`` iterations for
+fused on-device loops, which cannot host-inspect intermediate
+iterates); host-driven loops additionally call :func:`check_state`
+per outer iteration inside their chunk callbacks.
+"""
+
+import logging
+
+import numpy as np
+
+from . import faults
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DivergenceError", "array_digest", "check_state",
+           "leaves_to_device", "make_device_carry_chunk",
+           "pack_rng_state", "run_resilient_loop", "unpack_rng_state"]
+
+
+def array_digest(*arrays):
+    """Order-sensitive content digest of arrays for checkpoint
+    fingerprints.
+
+    A plain ``sum(data)`` is ~0 for demeaned/z-scored inputs (the
+    common fMRI preprocessing), and a sum of squares is constant for
+    per-voxel z-scored data — either would let a checkpoint from one
+    dataset silently resume against another of the same shape.  The
+    cosine-ramp inner product is position- and content-sensitive;
+    the squared term additionally scales with magnitude.
+    """
+    total = 0.0
+    for a in arrays:
+        flat = np.asarray(a, dtype=float).ravel()
+        ramp = np.cos(np.arange(flat.size, dtype=float))
+        total += float(flat @ ramp) + float(flat @ flat)
+    return total
+
+
+def leaves_to_device(state, keys, dtype=None):
+    """Return ``state``'s leaves at ``keys``, in order, as device
+    arrays of ``dtype`` — the standard round-trip when a jitted chunk
+    resumes from :func:`run_resilient_loop` state (host numpy after a
+    checkpoint restore, possibly device arrays mid-run)."""
+    import jax.numpy as jnp
+    return tuple(jnp.asarray(np.asarray(state[k]), dtype=dtype)
+                 for k in keys)
+
+
+def make_device_carry_chunk(chunk_fn, leaf_keys, fetch=np.asarray,
+                            dtype=None):
+    """Build ``(run_chunk, final_leaves)`` for a fused on-device fit.
+
+    ``chunk_fn(leaves, n_steps) -> leaves`` advances the jitted loop.
+    The returned ``run_chunk`` feeds :func:`run_resilient_loop`: the
+    host dict it returns serves the guard + checkpoint, while the
+    device outputs are carried across chunks so the next chunk (and
+    ``final_leaves(state, step)`` after the loop) reuse them directly
+    — no re-upload/reshard per chunk.  On a resume or a rollback the
+    carried step no longer matches and the leaves are rebuilt from the
+    (host) driver state.
+    """
+    carry = {}
+
+    def run_chunk(state, step, n_steps):
+        if carry.get("step") == step:
+            dev = carry["leaves"]
+        else:
+            dev = leaves_to_device(state, leaf_keys, dtype)
+        dev = chunk_fn(dev, n_steps)
+        carry["step"] = step + n_steps
+        carry["leaves"] = dev
+        return {k: fetch(v) for k, v in zip(leaf_keys, dev)}, False
+
+    def final_leaves(state, step):
+        if carry.get("step") == step:
+            return carry["leaves"]
+        # resumed straight to completion: no chunk ran this process
+        return leaves_to_device(state, leaf_keys, dtype)
+
+    return run_chunk, final_leaves
+
+
+def pack_rng_state(rng):
+    """Serialize a ``np.random.RandomState`` as two checkpointable
+    arrays ``(keys uint32[624], meta float[3])`` — stochastic fit loops
+    (TFA's voxel/TR subsampling, BRSA's random restarts) must persist
+    their stream position for a resumed fit to reproduce the
+    uninterrupted iterates."""
+    kind, keys, pos, has_gauss, cached = rng.get_state()
+    assert kind == "MT19937"
+    return (np.asarray(keys, dtype=np.uint32),
+            np.array([pos, has_gauss, cached], dtype=float))
+
+
+def unpack_rng_state(rng, keys, meta):
+    """Restore a ``np.random.RandomState`` from
+    :func:`pack_rng_state` arrays (possibly round-tripped through a
+    checkpoint)."""
+    meta = np.asarray(meta, dtype=float)
+    rng.set_state(("MT19937", np.asarray(keys).astype(np.uint32),
+                   int(meta[0]), int(meta[1]), float(meta[2])))
+    return rng
+
+
+class DivergenceError(FloatingPointError):
+    """An iterative fit produced non-finite state.
+
+    Attributes
+    ----------
+    leaves : list of str
+        Names of the offending state leaves.
+    iteration : int or None
+        Iteration at which the guard tripped.
+    where : str or None
+        Estimator / loop label.
+    """
+
+    def __init__(self, leaves, iteration=None, where=None):
+        self.leaves = list(leaves)
+        self.iteration = iteration
+        self.where = where
+        at = f" at iteration {iteration}" if iteration is not None else ""
+        loop = f" in {where}" if where else ""
+        super().__init__(
+            f"non-finite values{loop}{at} in state leaves: "
+            f"{', '.join(self.leaves)}")
+
+
+def check_state(state, iteration=None, where=None, skip=(),
+                nan_only=False):
+    """Raise :class:`DivergenceError` if any floating leaf of ``state``
+    (a flat dict of arrays) is non-finite.
+
+    ``skip`` names leaves excluded from the check (e.g. log-likelihood
+    histories that are NaN-padded by design); ``nan_only=True`` accepts
+    infinities, for log-domain states where ``-inf`` is a legitimate
+    zero probability.
+    """
+    bad = []
+    for name, leaf in state.items():
+        if name in skip:
+            continue
+        arr = np.asarray(leaf)
+        if arr.dtype.kind != "f":
+            continue
+        if nan_only:
+            ok = not np.any(np.isnan(arr))
+        else:
+            ok = bool(np.all(np.isfinite(arr)))
+        if not ok:
+            bad.append(name)
+    if bad:
+        raise DivergenceError(bad, iteration=iteration, where=where)
+
+
+def _fingerprint_mismatch(saved, fingerprint):
+    if saved is None:
+        return True
+    saved = np.asarray(saved, dtype=float).reshape(-1)
+    fingerprint = np.asarray(fingerprint, dtype=float).reshape(-1)
+    if saved.shape != fingerprint.shape:
+        return True
+    # atol=0: the default atol=1e-8 would equate any two near-zero
+    # components (e.g. data sums of demeaned inputs), defeating the
+    # mismatch guard entirely
+    return not np.allclose(saved, fingerprint, rtol=1e-10, atol=0.0)
+
+
+def run_resilient_loop(run_chunk, init_state, n_iter, *,
+                       checkpoint_dir=None, checkpoint_every=5,
+                       fingerprint=None, template=None, max_rollbacks=2,
+                       name="fit", guard_skip=(), guard_nan_only=False):
+    """Drive an iterative fit resiliently; returns ``(state, step)``.
+
+    Parameters
+    ----------
+    run_chunk : callable ``(state, step, n_steps) -> (state, done)``
+        Advance the fit ``n_steps`` iterations from ``state`` (a flat
+        dict mapping leaf name to array).  ``done=True`` signals early
+        convergence.  Must be deterministic in ``(state, step)`` so a
+        rollback re-run and a resume reproduce the original iterates.
+    init_state : dict
+        Fresh-start state (ignored when a checkpoint is resumed).
+        A ``"done"`` leaf, when present, is interpreted as the early-
+        convergence flag across checkpoint round trips.
+    n_iter : int
+        Total iteration budget.
+    checkpoint_dir, checkpoint_every
+        When ``checkpoint_dir`` is set, state is persisted every
+        ``checkpoint_every`` iterations and the latest checkpoint is
+        resumed (after fingerprint validation).
+    fingerprint : 1-D float array, optional
+        Data/config digest stored with each checkpoint; resuming
+        against a different digest raises ``ValueError`` instead of
+        silently mixing runs.
+    template : dict, optional
+        Restore template (leaf name -> zeros of the right shape/dtype)
+        for sharded orbax restores; ``None`` restores the raw tree.
+    max_rollbacks : int, default 2
+        Consecutive guard-triggered rollbacks tolerated before the
+        :class:`DivergenceError` propagates.
+    name : str
+        Label for logs and errors.
+    guard_skip, guard_nan_only
+        Forwarded to :func:`check_state`.
+    """
+    from ..utils.checkpoint import CheckpointManager
+
+    if checkpoint_every < 1:
+        raise ValueError(
+            "checkpoint_every must be >= 1 (got {}); omit "
+            "checkpoint_dir to disable checkpointing".format(
+                checkpoint_every))
+    mngr = None
+    step = 0
+    state = init_state
+    if checkpoint_dir is not None:
+        mngr = CheckpointManager(checkpoint_dir)
+        tpl = template
+        if tpl is not None and fingerprint is not None:
+            tpl = dict(tpl,
+                       fingerprint=np.zeros_like(
+                           np.asarray(fingerprint, dtype=float)))
+        saved_step, saved = mngr.restore(template=tpl)
+        if saved is not None:
+            if fingerprint is not None and _fingerprint_mismatch(
+                    saved.get("fingerprint"), fingerprint):
+                raise ValueError(
+                    "Checkpoint in {} was written for different data "
+                    "or model settings; use a fresh "
+                    "checkpoint_dir".format(checkpoint_dir))
+            if saved_step > n_iter:
+                raise ValueError(
+                    "Checkpoint is at iteration {} but n_iter={}; use "
+                    "a fresh checkpoint_dir or raise n_iter".format(
+                        saved_step, n_iter))
+            state = {k: v for k, v in saved.items()
+                     if k != "fingerprint"}
+            step = saved_step
+            logger.info("%s: resumed from checkpoint at iteration %d",
+                        name, step)
+
+    done = bool(np.asarray(state.get("done", False)).reshape(-1)[0]) \
+        if isinstance(state, dict) and "done" in state else False
+    last_good = (step, state)
+    rollbacks = 0
+    while step < n_iter and not done:
+        n_steps = min(checkpoint_every, n_iter - step)
+        try:
+            # run_chunk may itself raise DivergenceError from a
+            # per-iteration check_state; it gets the same rollback.
+            new_state, done = run_chunk(state, step, n_steps)
+            new_state = faults.corrupt_state(new_state, step + n_steps,
+                                             site=name)
+            check_state(new_state, iteration=step + n_steps, where=name,
+                        skip=guard_skip, nan_only=guard_nan_only)
+        except DivergenceError as exc:
+            rollbacks += 1
+            if rollbacks > max_rollbacks:
+                logger.error("%s: %s; %d consecutive rollbacks "
+                             "exhausted", name, exc, max_rollbacks)
+                raise
+            logger.warning(
+                "%s: %s; rolling back to iteration %d "
+                "(rollback %d/%d)", name, exc, last_good[0], rollbacks,
+                max_rollbacks)
+            step, state = last_good
+            done = False
+            continue
+        rollbacks = 0
+        step += n_steps
+        state = new_state
+        last_good = (step, state)
+        if mngr is not None:
+            to_save = {k: np.asarray(v) for k, v in state.items()}
+            if fingerprint is not None:
+                to_save["fingerprint"] = np.asarray(fingerprint,
+                                                    dtype=float)
+            mngr.save(step, to_save)
+        faults.preempt_point(step, site=name)
+    return state, step

@@ -1,0 +1,91 @@
+"""Generic retry with exponential backoff for transient failures.
+
+Multi-hour multi-host jobs hit transient faults that single-process
+NumPy code never sees: the DCN coordinator is not up yet when a worker
+calls ``jax.distributed.initialize``, a shared-filesystem NIfTI read
+times out, a checkpoint write races a preemption.  The reference's MPI
+workloads simply die; here the I/O edges of the framework retry with
+exponential backoff and structured logging, and give up with the
+original exception once the budget is exhausted.
+
+Wired into :func:`brainiak_tpu.parallel.mesh.initialize_distributed`
+(coordinator connect), :func:`brainiak_tpu.nifti.load` (and through it
+``io.load_images*``), and ``CheckpointManager.save``/``restore``.
+"""
+
+import functools
+import logging
+import random
+import time
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["retry"]
+
+# Test seam: monkeypatch to avoid real sleeping in unit tests.
+_sleep = time.sleep
+
+
+def retry(fn=None, *, retries=3, backoff=0.5, jitter=0.1,
+          retriable=(OSError,), retry_if=None, name=None):
+    """Retry ``fn`` on transient exceptions with exponential backoff.
+
+    Usable bare (``@retry``), configured (``@retry(retries=5)``), or
+    inline (``retry(fn, ...)``) — the last form returns the wrapped
+    callable, it does not call it.
+
+    Parameters
+    ----------
+    retries : int, default 3
+        Additional attempts after the first failure (so up to
+        ``retries + 1`` calls).
+    backoff : float, default 0.5
+        Base delay in seconds; attempt ``i`` (0-based) sleeps
+        ``backoff * 2**i``, scaled by jitter.  ``0`` disables sleeping.
+    jitter : float, default 0.1
+        Each delay is multiplied by ``1 + uniform(0, jitter)`` so
+        simultaneously-preempted hosts do not retry in lockstep.
+    retriable : tuple of exception types, default ``(OSError,)``
+        Only these are retried; anything else propagates immediately.
+    retry_if : callable, optional
+        Extra predicate over a type-matched exception; returning False
+        propagates it immediately.  Lets a caller retry only the
+        transient subset of a broad type (e.g. connection-shaped
+        ``RuntimeError`` but not deterministic misconfiguration).
+    name : str, optional
+        Label used in log records (default: the function's name).
+    """
+
+    def decorate(func):
+        label = name or getattr(func, "__name__", repr(func))
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            for attempt in range(retries + 1):
+                try:
+                    return func(*args, **kwargs)
+                except retriable as exc:
+                    if retry_if is not None and not retry_if(exc):
+                        raise
+                    if attempt >= retries:
+                        logger.error(
+                            "retry[%s]: giving up after %d attempts "
+                            "(%s: %s)", label, attempt + 1,
+                            type(exc).__name__, exc)
+                        raise
+                    delay = backoff * (2.0 ** attempt)
+                    if jitter:
+                        delay *= 1.0 + random.random() * jitter
+                    logger.warning(
+                        "retry[%s]: attempt %d/%d failed (%s: %s); "
+                        "retrying in %.2fs", label, attempt + 1,
+                        retries + 1, type(exc).__name__, exc, delay)
+                    if delay > 0:
+                        _sleep(delay)
+            raise AssertionError("unreachable")  # pragma: no cover
+
+        return wrapper
+
+    if fn is not None:
+        return decorate(fn)
+    return decorate

@@ -12,9 +12,16 @@ import os
 
 import numpy as np
 
+from ..resilience import faults
+from ..resilience.retry import retry
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["CheckpointManager"]
+
+# Set BRAINIAK_TPU_CHECKPOINT_NPZ=1 to force the npz fallback even when
+# orbax is importable (used by tests to cover both persistence paths).
+FORCE_NPZ_ENV_VAR = "BRAINIAK_TPU_CHECKPOINT_NPZ"
 
 
 class CheckpointManager:
@@ -22,6 +29,11 @@ class CheckpointManager:
 
     Falls back to ``np.savez`` of flattened leaves when orbax is
     unavailable (the state pytrees used here are flat dicts of arrays).
+
+    ``save`` and ``restore`` retry transient ``OSError`` with
+    exponential backoff (:func:`brainiak_tpu.resilience.retry.retry`) —
+    a checkpoint writer on a shared filesystem must survive the
+    transient faults it exists to protect against.
     """
 
     def __init__(self, directory, max_to_keep=2):
@@ -29,20 +41,26 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = max_to_keep
         try:
+            if os.environ.get(FORCE_NPZ_ENV_VAR):
+                raise ImportError(
+                    f"{FORCE_NPZ_ENV_VAR} set; forcing npz checkpoints")
             import orbax.checkpoint as ocp
             self._ocp = ocp
             self._mngr = ocp.CheckpointManager(
                 self.directory,
                 options=ocp.CheckpointManagerOptions(
                     max_to_keep=max_to_keep, create=True))
-        except Exception as exc:  # pragma: no cover - orbax is installed
+        except Exception as exc:
             logger.info("orbax unavailable (%s); using npz checkpoints",
                         exc)
             self._ocp = None
             self._mngr = None
 
+    @retry(retries=2, backoff=0.2, retriable=(OSError,),
+           name="checkpoint.save")
     def save(self, step, state):
         """Persist ``state`` (a pytree of arrays) at ``step``."""
+        faults.io_point(self.directory, site="checkpoint.save")
         if self._mngr is not None:
             self._mngr.save(step, args=self._ocp.args.StandardSave(state))
             self._mngr.wait_until_finished()
@@ -86,19 +104,24 @@ class CheckpointManager:
                  if s is not None]
         return max(steps) if steps else None
 
+    @retry(retries=2, backoff=0.2, retriable=(OSError,),
+           name="checkpoint.restore")
     def restore(self, step=None, template=None):
         """Load the checkpoint at ``step`` (default latest); returns
         (step, state) or (None, None) when nothing exists."""
+        faults.io_point(self.directory, site="checkpoint.restore")
         if step is None:
             step = self.latest_step()
         if step is None:
             return None, None
         if self._mngr is not None:
-            if template is not None:
-                state = self._mngr.restore(
-                    step, args=self._ocp.args.StandardRestore(template))
-            else:
-                state = self._mngr.restore(step)
+            # StandardRestore() without a template restores the raw
+            # saved tree (needed for states whose leaf shapes are not
+            # known a priori, e.g. BRSA's round-dependent nuisance
+            # design); a bare restore(step) would require a handler
+            # registry in a fresh process.
+            state = self._mngr.restore(
+                step, args=self._ocp.args.StandardRestore(template))
             return step, state
         path = os.path.join(self.directory, f"ckpt_{step}.npz")
         loaded = np.load(path)

@@ -271,7 +271,14 @@ def convolve_hrf(stimfunction, tr_duration, hrf_type='double_gamma',
     stride = int(temporal_resolution * tr_duration)
     duration = int(stimfunction.shape[0] / stride)
 
-    if isinstance(hrf_type, str) and hrf_type == 'double_gamma':
+    if isinstance(hrf_type, str):
+        if hrf_type != 'double_gamma':
+            # An unrecognized string (e.g. the typo 'double-gamma')
+            # would otherwise coerce to a 0-d string array and fail
+            # opaquely inside np.convolve; name the problem here.
+            raise ValueError(
+                f"Unrecognized hrf_type {hrf_type!r}: expected "
+                "'double_gamma' or an array-like HRF kernel")
         hrf = _double_gamma_hrf(temporal_resolution=temporal_resolution)
     else:
         # user-supplied kernel (reference fmrisim.py:869-872 takes a

@@ -1,0 +1,147 @@
+"""Fault injection: deterministic preempt / nan / io_error triggers."""
+
+import importlib
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.resilience import faults
+from brainiak_tpu.resilience.faults import (
+    InjectedIOError,
+    PreemptionError,
+    inject,
+)
+
+retry_mod = importlib.import_module("brainiak_tpu.resilience.retry")
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    monkeypatch.setattr(retry_mod, "_sleep", lambda _d: None)
+
+
+def test_preempt_fires_at_step_crossing():
+    with inject("preempt", at_step=3) as fault:
+        faults.preempt_point(2)  # below threshold: no fire
+        with pytest.raises(PreemptionError, match="step 4"):
+            faults.preempt_point(4)
+        assert fault.fired == 1
+        faults.preempt_point(6)  # times=1 exhausted: inert
+
+
+def test_nan_corrupts_first_float_leaf():
+    state = {"count": np.arange(3), "w": np.ones(4), "b": np.ones(2)}
+    with inject("nan", at_step=2) as fault:
+        same = faults.corrupt_state(state, 1)
+        assert same is state  # below at_step
+        out = faults.corrupt_state(state, 2)
+        assert np.isnan(out["w"]).any()
+        assert not np.isnan(state["w"]).any()  # original untouched
+        assert fault.fired == 1
+
+
+def test_nan_targets_named_leaf():
+    state = {"a": np.ones(2), "b": np.ones(2)}
+    with inject("nan", at_step=0, leaf="b"):
+        out = faults.corrupt_state(state, 5)
+    assert np.isnan(out["b"]).any() and not np.isnan(out["a"]).any()
+
+
+def test_io_error_lets_through_at_step_calls():
+    with inject("io_error", at_step=2, times=1) as fault:
+        faults.io_point("f1")
+        faults.io_point("f2")
+        with pytest.raises(InjectedIOError):
+            faults.io_point("f3")
+        faults.io_point("f4")  # exhausted
+        assert fault.fired == 1
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        with inject("segfault"):
+            pass
+
+
+def test_io_error_consumed_by_nifti_retry(tmp_path):
+    from brainiak_tpu import nifti
+
+    img = nifti.NiftiImage(np.arange(24, dtype=np.float32)
+                           .reshape(2, 3, 4))
+    path = str(tmp_path / "vol.nii.gz")
+    nifti.save(img, path)
+    with inject("io_error", times=1) as fault:
+        loaded = nifti.load(path)
+    assert fault.fired == 1  # failed once, retried, succeeded
+    assert np.allclose(loaded.get_fdata(), img.get_fdata())
+
+
+def test_io_error_exhausts_nifti_retries(tmp_path):
+    from brainiak_tpu import nifti
+
+    img = nifti.NiftiImage(np.zeros((2, 2, 2), dtype=np.float32))
+    path = str(tmp_path / "vol.nii")
+    nifti.save(img, path)
+    with inject("io_error", times=10):
+        with pytest.raises(OSError):
+            nifti.load(path)
+
+
+def test_io_error_consumed_by_checkpoint_retry(tmp_path):
+    from brainiak_tpu.utils.checkpoint import CheckpointManager
+
+    mngr = CheckpointManager(str(tmp_path / "ck"))
+    with inject("io_error", times=1) as fault:
+        mngr.save(1, {"x": np.ones(3)})
+    assert fault.fired == 1
+    step, state = mngr.restore()
+    assert step == 1 and np.allclose(np.asarray(state["x"]), 1.0)
+
+
+def test_truncated_gzip_read_is_retriable(tmp_path):
+    """A .nii.gz truncated mid-restage raises EOFError/zlib.error from
+    gzip — classified retriable, so a concurrently-completed file is
+    picked up on a later attempt."""
+    from brainiak_tpu import nifti
+
+    img = nifti.NiftiImage(np.zeros((2, 2, 2), dtype=np.float32))
+    good = str(tmp_path / "vol.nii.gz")
+    nifti.save(img, good)
+    payload = open(good, "rb").read()
+    flaky = str(tmp_path / "staging.nii.gz")
+    with open(flaky, "wb") as f:
+        f.write(payload[: len(payload) // 2])  # truncated
+
+    calls = {"n": 0}
+    orig = nifti.gzip.open
+
+    def healing_open(path, mode="rb"):
+        calls["n"] += 1
+        if calls["n"] == 2:  # "re-stage" completes before retry 1
+            with open(flaky, "wb") as f:
+                f.write(payload)
+        return orig(path, mode)
+
+    nifti.gzip.open = healing_open
+    try:
+        loaded = nifti.load(flaky)
+    finally:
+        nifti.gzip.open = orig
+    assert calls["n"] >= 2
+    assert np.allclose(loaded.get_fdata(), img.get_fdata())
+
+
+def test_env_var_fault(monkeypatch):
+    monkeypatch.setattr(faults, "_env_fault", None)
+    monkeypatch.setattr(faults, "_env_spec_seen", None)
+    monkeypatch.setenv(faults.FAULT_ENV_VAR, "preempt@2")
+    with pytest.raises(PreemptionError):
+        faults.preempt_point(2)
+    faults.preempt_point(5)  # fires once per process
+
+
+def test_env_var_malformed_ignored(monkeypatch):
+    monkeypatch.setattr(faults, "_env_fault", None)
+    monkeypatch.setattr(faults, "_env_spec_seen", None)
+    monkeypatch.setenv(faults.FAULT_ENV_VAR, "preempt@banana")
+    faults.preempt_point(10)  # no raise

@@ -1,0 +1,246 @@
+"""Non-finite guard + resilient loop driver unit tests."""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.resilience.faults import PreemptionError, inject
+from brainiak_tpu.resilience.guards import (
+    DivergenceError,
+    check_state,
+    pack_rng_state,
+    run_resilient_loop,
+    unpack_rng_state,
+)
+
+
+def test_check_state_passes_finite():
+    check_state({"a": np.ones(3), "ints": np.arange(2)})
+
+
+def test_check_state_names_bad_leaves_and_iteration():
+    state = {"good": np.ones(2), "bad": np.array([1.0, np.nan]),
+             "worse": np.array([np.inf])}
+    with pytest.raises(DivergenceError) as exc:
+        check_state(state, iteration=7, where="unit")
+    assert exc.value.leaves == ["bad", "worse"]
+    assert exc.value.iteration == 7
+    assert "bad" in str(exc.value) and "iteration 7" in str(exc.value)
+
+
+def test_check_state_skip_and_nan_only():
+    state = {"hist": np.array([np.nan]), "ll": np.array([-np.inf])}
+    check_state(state, skip=("hist",), nan_only=True)
+    with pytest.raises(DivergenceError):
+        check_state(state, skip=("hist",), nan_only=False)
+
+
+def test_array_digest_distinguishes_zscored_data():
+    """Plain sums are ~0 for z-scored data; the digest must not be."""
+    from scipy import stats
+
+    from brainiak_tpu.resilience.guards import array_digest
+
+    rng = np.random.RandomState(0)
+    a = stats.zscore(rng.randn(20, 30), axis=1, ddof=1)
+    b = stats.zscore(rng.randn(20, 30), axis=1, ddof=1)
+    da, db = array_digest(a), array_digest(b)
+    assert abs(da - db) > 1e-6 * max(abs(da), abs(db))
+    assert array_digest(a) == da  # deterministic
+
+
+def test_eventsegment_rejects_checkpoint_from_other_data(tmp_path):
+    """Same-shape different data must not resume (the z-score trap)."""
+    import pytest as _pytest
+
+    from brainiak_tpu.eventseg.event import EventSegment
+
+    rng = np.random.RandomState(2)
+    d = str(tmp_path / "ck")
+    EventSegment(n_events=3, n_iter=8).fit(
+        rng.randn(30, 8), checkpoint_dir=d, checkpoint_every=4)
+    with _pytest.raises(ValueError, match="different data"):
+        EventSegment(n_events=3, n_iter=8).fit(
+            rng.randn(30, 8), checkpoint_dir=d, checkpoint_every=4)
+
+
+def test_srm_rejects_checkpoint_from_other_zscored_data(tmp_path):
+    """SRM's fingerprint must distinguish z-scored datasets whose
+    sum-of-squares (trace) is identical by construction."""
+    import pytest as _pytest
+    from scipy import stats
+
+    from brainiak_tpu.funcalign.srm import SRM
+
+    rng = np.random.RandomState(6)
+
+    def zscored_subjects(seed):
+        r = np.random.RandomState(seed)
+        return [stats.zscore(r.randn(12, 20), axis=1, ddof=1)
+                for _ in range(3)]
+
+    d = str(tmp_path / "ck")
+    SRM(n_iter=4, features=3).fit(zscored_subjects(1),
+                                  checkpoint_dir=d)
+    with _pytest.raises(ValueError, match="different data"):
+        SRM(n_iter=6, features=3).fit(zscored_subjects(2),
+                                      checkpoint_dir=d)
+
+
+def test_rng_state_roundtrip():
+    rng = np.random.RandomState(42)
+    rng.randn(17)
+    keys, meta = pack_rng_state(rng)
+    expected = rng.randn(5)
+    rng2 = unpack_rng_state(np.random.RandomState(0), keys, meta)
+    assert np.allclose(rng2.randn(5), expected)
+
+
+def _counting_chunk(state, step, n_steps):
+    return {"x": np.asarray(state["x"]) + n_steps}, False
+
+
+def test_loop_advances_in_chunks(tmp_path):
+    state, step = run_resilient_loop(
+        _counting_chunk, {"x": np.zeros(1)}, 7, checkpoint_every=3)
+    assert step == 7 and state["x"][0] == 7.0
+
+
+def test_loop_checkpoints_and_resumes(tmp_path):
+    d = str(tmp_path / "ck")
+    with inject("preempt", at_step=4):
+        with pytest.raises(PreemptionError):
+            run_resilient_loop(_counting_chunk, {"x": np.zeros(1)}, 10,
+                               checkpoint_dir=d, checkpoint_every=2)
+    # killed at step 4 with the checkpoint on disk; a fresh call
+    # resumes there rather than restarting
+    steps_run = []
+
+    def tracked(state, step, n_steps):
+        steps_run.append((step, n_steps))
+        return _counting_chunk(state, step, n_steps)
+
+    state, step = run_resilient_loop(
+        tracked, {"x": np.zeros(1)}, 10, checkpoint_dir=d,
+        checkpoint_every=2)
+    assert steps_run[0][0] == 4
+    assert step == 10 and state["x"][0] == 10.0
+
+
+def test_loop_rejects_nonpositive_checkpoint_every():
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        run_resilient_loop(_counting_chunk, {"x": np.zeros(1)}, 4,
+                           checkpoint_every=0)
+
+
+def test_loop_fingerprint_mismatch_rejected(tmp_path):
+    d = str(tmp_path / "ck")
+    run_resilient_loop(_counting_chunk, {"x": np.zeros(1)}, 2,
+                       checkpoint_dir=d, fingerprint=np.array([1.0]))
+    with pytest.raises(ValueError, match="different data"):
+        run_resilient_loop(_counting_chunk, {"x": np.zeros(1)}, 4,
+                           checkpoint_dir=d,
+                           fingerprint=np.array([2.0]))
+
+
+def test_loop_lower_budget_than_checkpoint_rejected(tmp_path):
+    d = str(tmp_path / "ck")
+    run_resilient_loop(_counting_chunk, {"x": np.zeros(1)}, 6,
+                       checkpoint_dir=d, checkpoint_every=3)
+    with pytest.raises(ValueError, match="iteration"):
+        run_resilient_loop(_counting_chunk, {"x": np.zeros(1)}, 2,
+                           checkpoint_dir=d)
+
+
+def test_loop_rollback_recovers_from_transient_nan(tmp_path):
+    with inject("nan", at_step=4) as fault:
+        state, step = run_resilient_loop(
+            _counting_chunk, {"x": np.zeros(1)}, 6, checkpoint_every=2)
+    assert fault.fired == 1
+    # the corrupted chunk was re-run from the last good state
+    assert step == 6 and state["x"][0] == 6.0
+
+
+def test_loop_aborts_after_consecutive_rollbacks():
+    def diverging(state, step, n_steps):
+        return {"x": np.full(1, np.nan)}, False
+
+    calls = []
+
+    def counted(state, step, n_steps):
+        calls.append(step)
+        return diverging(state, step, n_steps)
+
+    with pytest.raises(DivergenceError) as exc:
+        run_resilient_loop(counted, {"x": np.zeros(1)}, 4,
+                           checkpoint_every=2, max_rollbacks=2,
+                           name="unit")
+    assert exc.value.leaves == ["x"]
+    # initial attempt + 2 rollback re-runs, all from step 0
+    assert calls == [0, 0, 0]
+
+
+def test_loop_done_flag_short_circuits():
+    def converge_at_3(state, step, n_steps):
+        x = float(np.asarray(state["x"])[0])
+        for i in range(n_steps):
+            x += 1
+            if x >= 3:
+                return {"x": np.array([x]),
+                        "done": np.array(1.0)}, True
+        return {"x": np.array([x]), "done": np.array(0.0)}, False
+
+    state, step = run_resilient_loop(
+        converge_at_3, {"x": np.zeros(1), "done": np.zeros(1)}, 10,
+        checkpoint_every=2)
+    assert state["x"][0] == 3.0
+    assert step < 10
+
+
+def test_loop_resume_of_done_state_skips(tmp_path):
+    d = str(tmp_path / "ck")
+
+    def instantly_done(state, step, n_steps):
+        return {"x": np.asarray(state["x"]) + 1,
+                "done": np.array(1.0)}, True
+
+    run_resilient_loop(instantly_done,
+                       {"x": np.zeros(1), "done": np.zeros(1)}, 10,
+                       checkpoint_dir=d, checkpoint_every=2)
+
+    def must_not_run(state, step, n_steps):  # pragma: no cover
+        raise AssertionError("resumed-done loop must not re-run")
+
+    state, _ = run_resilient_loop(
+        must_not_run, {"x": np.zeros(1), "done": np.zeros(1)}, 10,
+        checkpoint_dir=d, checkpoint_every=2)
+    assert state["x"][0] == 1.0
+
+
+def test_preempt_fires_only_after_save(tmp_path):
+    d = str(tmp_path / "ck")
+    with inject("preempt", at_step=2):
+        with pytest.raises(PreemptionError):
+            run_resilient_loop(_counting_chunk, {"x": np.zeros(1)}, 6,
+                               checkpoint_dir=d, checkpoint_every=2)
+    from brainiak_tpu.utils.checkpoint import CheckpointManager
+    step, state = CheckpointManager(d).restore()
+    assert step == 2 and np.asarray(state["x"])[0] == 2.0
+
+
+def test_replicate_identity_cached():
+    """The fetch_replicated fallback compiles once per mesh."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from brainiak_tpu.parallel.mesh import (_replicate_identity,
+                                            make_mesh)
+
+    mesh = make_mesh(("subject",), (8,))
+    fn = _replicate_identity(mesh)
+    assert _replicate_identity(mesh) is fn
+    x = jnp.arange(16.0).reshape(8, 2)
+    placed = __import__("jax").device_put(
+        x, NamedSharding(mesh, PartitionSpec("subject", None)))
+    out = fn(placed)
+    assert out.sharding.is_fully_replicated
+    assert np.allclose(np.asarray(out), np.asarray(x))

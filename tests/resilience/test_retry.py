@@ -1,0 +1,117 @@
+"""Unit tests for the exponential-backoff retry decorator."""
+
+import importlib
+
+import pytest
+
+from brainiak_tpu.resilience.retry import retry
+
+retry_mod = importlib.import_module("brainiak_tpu.resilience.retry")
+
+
+@pytest.fixture(autouse=True)
+def _no_sleep(monkeypatch):
+    """Record requested delays instead of sleeping."""
+    delays = []
+    monkeypatch.setattr(retry_mod, "_sleep", delays.append)
+    return delays
+
+
+def test_retry_succeeds_after_transient_failures(_no_sleep):
+    calls = {"n": 0}
+
+    @retry(retries=3, backoff=0.5, jitter=0.0)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert flaky() == "ok"
+    assert calls["n"] == 3
+    # exponential: 0.5, then 1.0
+    assert _no_sleep == [0.5, 1.0]
+
+
+def test_retry_exhausts_and_reraises(_no_sleep):
+    @retry(retries=2, backoff=0.0, jitter=0.0)
+    def always_fails():
+        raise OSError("still down")
+
+    with pytest.raises(OSError, match="still down"):
+        always_fails()
+
+
+def test_non_retriable_propagates_immediately(_no_sleep):
+    calls = {"n": 0}
+
+    @retry(retries=5, backoff=0.0)
+    def typed_failure():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        typed_failure()
+    assert calls["n"] == 1
+    assert _no_sleep == []
+
+
+def test_bare_decorator_form(_no_sleep):
+    @retry
+    def fine(x):
+        return x + 1
+
+    assert fine(1) == 2
+
+
+def test_inline_wrapper_form(_no_sleep):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("refused")
+        return 7
+
+    wrapped = retry(flaky, retries=1, backoff=0.0,
+                    retriable=(ConnectionError,))
+    assert wrapped() == 7
+
+
+def test_retry_if_predicate_gates_broad_types(_no_sleep):
+    calls = {"n": 0}
+
+    @retry(retries=3, backoff=0.0, retriable=(RuntimeError,),
+           retry_if=lambda e: "connect" in str(e))
+    def deterministic_failure():
+        calls["n"] += 1
+        raise RuntimeError("already initialized")
+
+    with pytest.raises(RuntimeError, match="already initialized"):
+        deterministic_failure()
+    assert calls["n"] == 1  # not retried: predicate said permanent
+
+    attempts = {"n": 0}
+
+    @retry(retries=3, backoff=0.0, retriable=(RuntimeError,),
+           retry_if=lambda e: "connect" in str(e))
+    def transient_failure():
+        attempts["n"] += 1
+        if attempts["n"] < 2:
+            raise RuntimeError("failed to connect to coordinator")
+        return "up"
+
+    assert transient_failure() == "up"
+    assert attempts["n"] == 2
+
+
+def test_jitter_scales_delay(_no_sleep):
+    @retry(retries=1, backoff=1.0, jitter=0.5)
+    def flaky():
+        if not _no_sleep:
+            raise OSError("once")
+        return True
+
+    assert flaky()
+    assert len(_no_sleep) == 1
+    assert 1.0 <= _no_sleep[0] <= 1.5

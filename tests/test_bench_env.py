@@ -1,0 +1,18 @@
+"""Bench env-override validation (ADVICE round 5: odd BENCH_WB_EPOCHS
+must not produce a label/epoch mismatch in VoxelSelector)."""
+
+import bench
+
+
+def test_even_epochs_env_rounds_up_odd(monkeypatch):
+    monkeypatch.setenv("BENCH_WB_EPOCHS", "7")
+    assert bench._even_epochs_env("BENCH_WB_EPOCHS", 32) == 8
+    monkeypatch.setenv("BENCH_WB_EPOCHS", "8")
+    assert bench._even_epochs_env("BENCH_WB_EPOCHS", 32) == 8
+    monkeypatch.delenv("BENCH_WB_EPOCHS")
+    assert bench._even_epochs_env("BENCH_WB_EPOCHS", 32) == 32
+
+
+def test_make_data_labels_match_even_epochs():
+    data, labels = bench.make_data(n_voxels=4, n_trs=6, n_epochs=8)
+    assert len(data) == len(labels) == 8

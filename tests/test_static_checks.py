@@ -1,9 +1,18 @@
 """Static-analysis gate, run with the suite (reference run-checks.sh)."""
 
+import importlib.util
 import subprocess
 import sys
 
 from tests.conftest import REPO_ROOT
+
+
+def _load_run_checks():
+    spec = importlib.util.spec_from_file_location(
+        "run_checks", f"{REPO_ROOT}/tools/run_checks.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def test_static_checks_clean():
@@ -11,3 +20,30 @@ def test_static_checks_clean():
         [sys.executable, f"{REPO_ROOT}/tools/run_checks.py"],
         capture_output=True, text=True)
     assert r.returncode == 0, f"static checks failed:\n{r.stdout}"
+
+
+def test_resilience_gate_passes_on_repo():
+    """Every public iterative fit accepts checkpoint_dir and runs
+    under the resilience guard (run_resilient_loop / delegation)."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_resilient_fits(findings)
+    assert findings == []
+
+
+def test_resilience_gate_catches_violations(tmp_path, monkeypatch):
+    """The gate flags a fit without checkpoint_dir and a module that
+    never touches the resilient-loop driver."""
+    rc = _load_run_checks()
+    bad = tmp_path / "bad_estimator.py"
+    bad.write_text(
+        "class Bad:\n"
+        "    def fit(self, X):\n"
+        "        return self\n")
+    monkeypatch.setattr(rc, "REPO", str(tmp_path))
+    monkeypatch.setattr(rc, "RESILIENT_FITS",
+                        {"bad_estimator.py": ("Bad",)})
+    findings = []
+    rc.check_resilient_fits(findings)
+    assert any("run_resilient_loop" in f for f in findings)
+    assert any("checkpoint_dir" in f for f in findings)

@@ -598,3 +598,17 @@ def test_gen_1d_gaussian_rfs():
     data = sim.generate_1d_rf_responses(rfs2, trials, 360, (0, 360),
                                         trial_noise=0.01)
     assert data.shape == (10, 3)
+
+
+def test_convolve_hrf_rejects_unknown_string_hrf_type():
+    """A typo'd hrf_type string must raise a clear ValueError instead
+    of coercing to a 0-d string array and failing in np.convolve."""
+    box = sim.generate_stimfunction(onsets=[2], event_durations=[2],
+                                    total_time=20)
+    with pytest.raises(ValueError, match="double-gamma"):
+        sim.convolve_hrf(stimfunction=box, tr_duration=2,
+                         hrf_type='double-gamma')
+    # the canonical spelling still works
+    out = sim.convolve_hrf(stimfunction=box, tr_duration=2,
+                           hrf_type='double_gamma')
+    assert out.shape[0] == 10

@@ -225,6 +225,74 @@ def check_doc_defaults(findings):
                                 f"{'/'.join(owners) or name} ({opts})")
 
 
+# Public iterative estimators required to honor the resilience
+# contract: fit() accepts checkpoint_dir, and the module either drives
+# its loop through resilience.run_resilient_loop (which applies the
+# non-finite guard) or delegates by forwarding checkpoint_dir= to
+# another estimator's fit (FastSRM -> reduced-space DetSRM).
+RESILIENT_FITS = {
+    "brainiak_tpu/funcalign/srm.py": ("SRM", "DetSRM"),
+    "brainiak_tpu/funcalign/rsrm.py": ("RSRM",),
+    "brainiak_tpu/funcalign/fastsrm.py": ("FastSRM",),
+    "brainiak_tpu/factoranalysis/tfa.py": ("TFA",),
+    "brainiak_tpu/factoranalysis/htfa.py": ("HTFA",),
+    "brainiak_tpu/reprsimil/brsa.py": ("BRSA",),
+    "brainiak_tpu/eventseg/event.py": ("EventSegment",),
+}
+
+
+def check_resilient_fits(findings):
+    """Static resilience gate: every public iterative ``fit`` must
+    accept ``checkpoint_dir`` and run its loop under the non-finite
+    guard (via ``run_resilient_loop``) or forward the contract to a
+    guarded estimator."""
+    for relpath, classes in sorted(RESILIENT_FITS.items()):
+        path = os.path.join(REPO, *relpath.split("/"))
+        try:
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except (OSError, SyntaxError):
+            findings.append(f"{path}: unparseable (resilience gate)")
+            continue
+        uses_driver = any(
+            (isinstance(n, ast.Name) and n.id == "run_resilient_loop")
+            or (isinstance(n, ast.Attribute)
+                and n.attr == "run_resilient_loop")
+            for n in ast.walk(tree))
+        delegates = any(
+            isinstance(n, ast.Call) and any(
+                kw.arg == "checkpoint_dir" for kw in n.keywords)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "fit"
+            for n in ast.walk(tree))
+        if not (uses_driver or delegates):
+            findings.append(
+                f"{path}: no run_resilient_loop use (or checkpointed "
+                "fit delegation); iterative fits must run under the "
+                "resilience guard")
+        class_fits = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef) \
+                            and sub.name == "fit":
+                        class_fits[node.name] = sub
+        for cls in classes:
+            fit = class_fits.get(cls)
+            if fit is None:
+                findings.append(
+                    f"{path}: class {cls} defines no fit() "
+                    "(resilience gate)")
+                continue
+            args = [a.arg for a in (fit.args.posonlyargs + fit.args.args
+                                    + fit.args.kwonlyargs)]
+            for required in ("checkpoint_dir", "checkpoint_every"):
+                if required not in args:
+                    findings.append(
+                        f"{path}:{fit.lineno}: {cls}.fit() does not "
+                        f"accept {required}= (resilience contract)")
+
+
 def run_external(findings):
     """Run ruff/flake8 + mypy when available (full CI environments)."""
     ran = []
@@ -255,6 +323,7 @@ def main(argv=None):
     findings = []
     ran = run_external(findings)
     check_doc_defaults(findings)
+    check_resilient_fits(findings)
     n = 0
     for path in python_sources():
         n += 1
