@@ -1,0 +1,23 @@
+"""jaxlint: AST-based TPU-correctness static analysis (JX001-JX006).
+
+Rule-plugin analyzer enforcing the TPU-readiness invariants the
+north-star depends on: no per-call retracing, no host-device syncs in
+hot loops, no float64 leaks, disciplined PRNG handling, no Python
+branching on traced values, and explicit static arguments.  Run it
+standalone (``python -m brainiak_tpu.analysis``) or as the jaxlint
+gate of ``python -m tools.run_checks --only=jaxlint``.
+"""
+
+from .baseline import Baseline, BaselineError  # noqa: F401
+from .config import JaxlintConfig, load_config  # noqa: F401
+from .core import (  # noqa: F401
+    FileContext,
+    FileRule,
+    Finding,
+    RepoRule,
+    analyze_file,
+    analyze_paths,
+    iter_python_files,
+    register,
+)
+from .rules import JAXLINT_RULES  # noqa: F401

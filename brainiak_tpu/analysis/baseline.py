@@ -1,0 +1,81 @@
+"""jaxlint baseline: grandfathered findings with justifications.
+
+A baseline entry suppresses exactly one finding identity
+``(rule, path, snippet)`` -- the snippet is the stripped source line,
+so entries survive unrelated line-number drift but die as soon as the
+flagged code changes.  Every entry MUST carry a non-empty ``reason``;
+a baseline without written justifications fails to load, so the file
+cannot silently become a blanket suppression list.
+"""
+
+import json
+import os
+
+__all__ = ["Baseline", "BaselineError"]
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (missing reason, bad schema...)."""
+
+
+class Baseline:
+    """Repo-level suppression list loaded from JSON."""
+
+    REQUIRED = ("rule", "path", "snippet", "reason")
+
+    def __init__(self, entries=(), path=None):
+        self.path = path
+        self.entries = list(entries)
+        self._index = {}
+        for i, entry in enumerate(self.entries):
+            for field in self.REQUIRED:
+                if not str(entry.get(field, "")).strip():
+                    raise BaselineError(
+                        f"baseline entry #{i} missing non-empty "
+                        f"'{field}' (every grandfathered finding "
+                        "needs a written justification): "
+                        f"{json.dumps(entry)}")
+            key = (entry["rule"], entry["path"],
+                   entry["snippet"].strip())
+            self._index[key] = entry
+
+    @classmethod
+    def load(cls, path):
+        if not os.path.exists(path):
+            return cls([], path=path)
+        with open(path, encoding="utf-8") as fh:
+            try:
+                data = json.load(fh)
+            except json.JSONDecodeError as exc:
+                raise BaselineError(
+                    f"{path}: not valid JSON ({exc})") from exc
+        if isinstance(data, dict):
+            entries = data.get("entries", [])
+        else:
+            raise BaselineError(
+                f"{path}: expected object with 'entries' list")
+        return cls(entries, path=path)
+
+    def filter(self, findings):
+        """Split findings into (kept, stale-baseline-entries)."""
+        used = set()
+        kept = []
+        for finding in findings:
+            key = (finding.code, finding.path,
+                   finding.snippet.strip())
+            if key in self._index:
+                used.add(key)
+            else:
+                kept.append(finding)
+        stale = [entry for key, entry in self._index.items()
+                 if key not in used]
+        return kept, stale
+
+    @staticmethod
+    def render(findings, reason="TODO: justify or fix"):
+        """Baseline JSON for ``findings`` (``--write-baseline``)."""
+        entries = [{"rule": f.code, "path": f.path,
+                    "snippet": f.snippet, "reason": reason}
+                   for f in findings]
+        return json.dumps({"version": 1, "entries": entries},
+                          indent=2) + "\n"

@@ -1,0 +1,135 @@
+"""jaxlint command line: ``python -m brainiak_tpu.analysis``.
+
+Exit status 0 when no finding survives pragma + baseline
+suppression, 1 otherwise, 2 for configuration errors.  ``--format
+json`` emits one machine-readable object (the same shape
+``tools/run_checks.py --format=json`` uses) for CI consumption.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from .baseline import Baseline, BaselineError
+from .config import load_config
+from .core import analyze_paths, iter_python_files, SKIP_DIRS
+from .rules import JAXLINT_RULES
+
+
+def _selected_rules(select):
+    by_code = {r.code: r for r in JAXLINT_RULES}
+    unknown = [c for c in select if c not in by_code]
+    if unknown:
+        raise SystemExit(
+            f"jaxlint: unknown rule code(s): {', '.join(unknown)}")
+    return [by_code[c] for c in select]
+
+
+def _filter_excluded(paths, repo_root, exclude):
+    """Drop files under any excluded repo-relative prefix."""
+    if not exclude:
+        for p in paths:
+            yield p
+        return
+    prefixes = tuple(e.rstrip("/") + "/" for e in exclude)
+    for p in paths:
+        rel = os.path.relpath(p, repo_root).replace(os.sep, "/")
+        if not (rel + "/").startswith(prefixes) \
+                and not rel.startswith(prefixes):
+            yield p
+
+
+def run(paths, repo_root, select, baseline_path=None, exclude=()):
+    """Programmatic entry; returns (findings, stale, n_files)."""
+    rules = _selected_rules(select)
+    baseline = (Baseline.load(baseline_path)
+                if baseline_path else None)
+    files = list(_filter_excluded(
+        iter_python_files(paths, SKIP_DIRS), repo_root, exclude))
+    return analyze_paths(files, repo_root, rules, baseline=baseline)
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="TPU-correctness static analysis for JAX code "
+                    "(rules JX001-JX006; see docs/static_analysis.md)")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to analyze (default: [tool.jaxlint] "
+             "include, else brainiak_tpu/)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument(
+        "--select",
+        help="comma-separated rule codes (default: config select)")
+    parser.add_argument(
+        "--baseline",
+        help="baseline JSON path (default: config baseline)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (report grandfathered findings)")
+    parser.add_argument(
+        "--write-baseline", metavar="PATH",
+        help="write current findings as a baseline template "
+             "(reasons set to TODO) and exit 0")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list rule codes and exit")
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in JAXLINT_RULES:
+            print(f"{rule.code}  {rule.name}: "
+                  f"{(rule.__doc__ or '').splitlines()[0]}")
+        return 0
+    config = load_config()
+    select = (tuple(c.strip() for c in args.select.split(","))
+              if args.select else config.select)
+    paths = args.paths or config.include_paths()
+    baseline_path = None
+    if not args.no_baseline and not args.write_baseline:
+        baseline_path = (
+            os.path.abspath(args.baseline) if args.baseline
+            else config.baseline_path())
+    try:
+        findings, stale, n = run(
+            paths, config.repo_root, select,
+            baseline_path=baseline_path, exclude=config.exclude)
+    except BaselineError as exc:
+        print(f"jaxlint: {exc}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        with open(args.write_baseline, "w",
+                  encoding="utf-8") as fh:
+            fh.write(Baseline.render(findings))
+        print(f"jaxlint: wrote {len(findings)} baseline entries "
+              f"to {args.write_baseline}")
+        return 0
+    if args.format == "json":
+        print(json.dumps({
+            "ok": not findings,
+            "files": n,
+            "rules": list(select),
+            "findings": [f.to_dict() for f in findings],
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for finding in findings:
+            print(finding)
+        for entry in stale:
+            print(f"warning: stale baseline entry "
+                  f"{entry['rule']} {entry['path']} "
+                  f"({entry['reason']}) matches nothing; delete it")
+        status = "OK" if not findings else \
+            f"{len(findings)} finding(s)"
+        print(f"jaxlint: {status} over {n} files "
+              f"({len(select)} rules)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":   # pragma: no cover
+    sys.exit(main())

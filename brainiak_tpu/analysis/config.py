@@ -1,0 +1,138 @@
+"""jaxlint configuration: the ``[tool.jaxlint]`` pyproject section.
+
+This build environment is Python 3.10 without :mod:`tomllib`, so a
+deliberately small TOML-subset reader handles the one section we own:
+string values, string lists (possibly multi-line), ints and booleans.
+When :mod:`tomllib` is importable it is used instead.
+"""
+
+import ast
+import os
+import re
+
+__all__ = ["JaxlintConfig", "load_config", "find_pyproject"]
+
+_DEFAULT_SELECT = ("JX001", "JX002", "JX003", "JX004", "JX005",
+                   "JX006")
+_DEFAULT_INCLUDE = ("brainiak_tpu",)
+_DEFAULT_EXCLUDE = ()
+
+
+class JaxlintConfig:
+    """Resolved analyzer configuration."""
+
+    def __init__(self, repo_root, select=_DEFAULT_SELECT,
+                 include=_DEFAULT_INCLUDE, exclude=_DEFAULT_EXCLUDE,
+                 baseline=None):
+        self.repo_root = repo_root
+        self.select = tuple(select)
+        self.include = tuple(include)
+        self.exclude = tuple(exclude)
+        self.baseline = baseline   # repo-relative path or None
+
+    def include_paths(self):
+        return [os.path.join(self.repo_root, p)
+                for p in self.include]
+
+    def baseline_path(self):
+        if not self.baseline:
+            return None
+        return os.path.join(self.repo_root, self.baseline)
+
+
+def find_pyproject(start):
+    """Nearest pyproject.toml at/above ``start``, else None."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        cand = os.path.join(cur, "pyproject.toml")
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def _section_lines(text, section):
+    """Raw lines of one ``[section]`` table, [] when absent."""
+    lines = []
+    in_section = False
+    for line in text.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("["):
+            in_section = stripped == f"[{section}]"
+            continue
+        if in_section:
+            lines.append(line)
+    return lines
+
+
+def _parse_section(lines):
+    """``key = value`` pairs from a TOML-subset table body."""
+    out = {}
+    buf = ""
+    key = None
+    for line in lines:
+        stripped = line.split("#", 1)[0].rstrip() \
+            if not line.lstrip().startswith("#") else ""
+        if not stripped.strip():
+            continue
+        if key is None:
+            m = re.match(r"\s*([A-Za-z0-9_-]+)\s*=\s*(.*)", stripped)
+            if not m:
+                continue
+            key, buf = m.group(1), m.group(2)
+        else:
+            buf += " " + stripped.strip()
+        if buf.count("[") > buf.count("]"):
+            continue    # multi-line array, keep accumulating
+        out[key] = _coerce(buf.strip())
+        key, buf = None, ""
+    return out
+
+
+def _coerce(raw):
+    if raw == "true":
+        return True
+    if raw == "false":
+        return False
+    try:
+        return ast.literal_eval(raw)
+    except (ValueError, SyntaxError):
+        return raw.strip("\"'")
+
+
+def _load_table(pyproject_path):
+    try:
+        import tomllib
+        with open(pyproject_path, "rb") as fh:
+            data = tomllib.load(fh)
+        return data.get("tool", {}).get("jaxlint", {})
+    except ImportError:
+        with open(pyproject_path, encoding="utf-8") as fh:
+            text = fh.read()
+        return _parse_section(_section_lines(text, "tool.jaxlint"))
+
+
+def load_config(repo_root=None, pyproject_path=None):
+    """Build a :class:`JaxlintConfig` from ``[tool.jaxlint]``.
+
+    Missing file or section yields the defaults (all JX rules over
+    ``brainiak_tpu/`` with no baseline).
+    """
+    if pyproject_path is None:
+        pyproject_path = find_pyproject(repo_root or os.getcwd())
+    if repo_root is None:
+        repo_root = (os.path.dirname(pyproject_path)
+                     if pyproject_path else os.getcwd())
+    table = {}
+    if pyproject_path and os.path.isfile(pyproject_path):
+        table = _load_table(pyproject_path)
+    return JaxlintConfig(
+        repo_root,
+        select=tuple(table.get("select", _DEFAULT_SELECT)),
+        include=tuple(table.get("include", _DEFAULT_INCLUDE)),
+        exclude=tuple(table.get("exclude", _DEFAULT_EXCLUDE)),
+        baseline=table.get("baseline"))

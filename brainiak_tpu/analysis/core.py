@@ -1,0 +1,353 @@
+"""jaxlint core: findings, per-file context, rule registry, engine.
+
+The analyzer is a rule-plugin system: each rule is a small class
+registered with :func:`register`; the engine parses every file ONCE
+into a :class:`FileContext` and hands the same context to every
+enabled rule, so adding a rule never adds a parse.  Suppression is
+layered:
+
+* line pragma  -- ``# jaxlint: disable=JX001[,JX002]`` (JX rules) or
+  the conventional ``# noqa`` (style gates, ``pragma = "noqa"``);
+* baseline     -- a repo-level JSON file of grandfathered findings,
+  each with a written justification (:mod:`.baseline`).
+
+Rules come in two kinds: :class:`FileRule` (runs once per parsed
+file) and :class:`RepoRule` (runs once per repo walk -- used by the
+``tools/run_checks.py`` gates that need cross-file state).
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+__all__ = [
+    "Finding", "FileContext", "FileRule", "RepoRule", "register",
+    "all_rules", "rules_for_gate", "analyze_file", "analyze_paths",
+    "iter_python_files", "SKIP_DIRS",
+]
+
+SKIP_DIRS = {
+    ".git", "__pycache__", ".claude", "build", "dist",
+    ".pytest_cache", "node_modules", ".venv", "venv", ".tox",
+    ".eggs", ".ruff_cache", ".mypy_cache",
+}
+
+_PRAGMA_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+# attributes of a traced array that are static at trace time, so
+# branching on them is legitimate Python control flow under jit
+_STATIC_ATTRS = {"ndim", "shape", "dtype", "size", "aval"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer finding, machine-readable."""
+
+    path: str          # repo-relative, POSIX separators
+    line: int
+    code: str          # e.g. "JX001"
+    message: str
+    snippet: str = ""  # stripped source line (baseline fingerprint)
+
+    def key(self):
+        """Line-number-free identity used by baseline matching."""
+        return (self.code, self.path, self.snippet)
+
+    def to_dict(self):
+        return {"path": self.path, "line": self.line,
+                "code": self.code, "message": self.message,
+                "snippet": self.snippet}
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}: {self.code} "
+                f"{self.message}")
+
+
+class FileContext:
+    """One parsed file: source, AST, parents, import aliases, jit map.
+
+    Shared across every rule so the file is read and parsed exactly
+    once per analyzer run.
+    """
+
+    def __init__(self, path, relpath, source):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = None
+        self.parse_error = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+            compile(source, path, "exec")
+        except SyntaxError as exc:
+            self.tree = None
+            self.parse_error = exc
+        self._parents = {}
+        self._decorator_nodes = set()
+        self.aliases = {}
+        self.jitted = {}   # FunctionDef -> set of static param names
+        if self.tree is not None:
+            self._index()
+
+    # -- indexing ----------------------------------------------------
+
+    def _index(self):
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                for dec in node.decorator_list:
+                    for sub in ast.walk(dec):
+                        self._decorator_nodes.add(id(sub))
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    canon = (alias.name if alias.asname
+                             else alias.name.split(".")[0])
+                    self.aliases[bound] = canon
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    self.aliases[bound] = (f"{mod}.{alias.name}"
+                                           if mod else alias.name)
+        self._collect_jitted()
+
+    def _collect_jitted(self):
+        defs = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+                statics = self._decorator_statics(node)
+                if statics is not None:
+                    self.jitted[node] = statics
+        # wrap-site pattern: ``f_jit = jax.jit(f, static_...)``
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and self.resolve(node.value.func) == "jax.jit"
+                    and node.value.args
+                    and isinstance(node.value.args[0], ast.Name)):
+                continue
+            fn = defs.get(node.value.args[0].id)
+            if fn is not None and fn not in self.jitted:
+                self.jitted[fn] = self._static_names(
+                    fn, node.value.keywords)
+
+    def _decorator_statics(self, fn):
+        """Static param names if ``fn`` is jit-decorated, else None."""
+        for dec in fn.decorator_list:
+            if self.resolve(dec) == "jax.jit":
+                return set()
+            if isinstance(dec, ast.Call):
+                target = self.resolve(dec.func)
+                if target == "jax.jit":
+                    return self._static_names(fn, dec.keywords)
+                if (target == "functools.partial" and dec.args
+                        and self.resolve(dec.args[0]) == "jax.jit"):
+                    return self._static_names(fn, dec.keywords)
+        return None
+
+    def _static_names(self, fn, keywords):
+        names = set()
+        pos = fn.args.posonlyargs + fn.args.args
+        for kw in keywords:
+            if kw.arg == "static_argnames":
+                for sub in ast.walk(kw.value):
+                    if (isinstance(sub, ast.Constant)
+                            and isinstance(sub.value, str)):
+                        names.add(sub.value)
+            elif kw.arg == "static_argnums":
+                for sub in ast.walk(kw.value):
+                    if (isinstance(sub, ast.Constant)
+                            and isinstance(sub.value, int)
+                            and 0 <= sub.value < len(pos)):
+                        names.add(pos[sub.value].arg)
+        return names
+
+    # -- queries -----------------------------------------------------
+
+    def parent(self, node):
+        return self._parents.get(node)
+
+    def ancestors(self, node):
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing(self, node, types):
+        for anc in self.ancestors(node):
+            if isinstance(anc, types):
+                return anc
+        return None
+
+    def in_decorator(self, node):
+        return id(node) in self._decorator_nodes
+
+    def dotted(self, node):
+        """``a.b.c`` parts of a Name/Attribute chain, else None."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        return list(reversed(parts))
+
+    def resolve(self, node):
+        """Canonical dotted name of an expression, alias-expanded.
+
+        ``np.random.normal`` -> ``numpy.random.normal`` under
+        ``import numpy as np``; unresolvable shapes return None.
+        """
+        parts = self.dotted(node)
+        if not parts:
+            return None
+        root = self.aliases.get(parts[0], parts[0])
+        return ".".join([root] + parts[1:])
+
+    def src_line(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule, node_or_line, message):
+        lineno = (node_or_line if isinstance(node_or_line, int)
+                  else node_or_line.lineno)
+        return Finding(self.relpath, lineno, rule.code, message,
+                       self.src_line(lineno))
+
+    def fn_params(self, fn):
+        return [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                + fn.args.kwonlyargs)]
+
+    # -- suppression -------------------------------------------------
+
+    def suppressed(self, finding, pragma):
+        line = self.src_line(finding.line)
+        if pragma == "noqa":
+            return "# noqa" in line
+        m = _PRAGMA_RE.search(line)
+        if not m:
+            return False
+        codes = {c.strip() for c in m.group(1).split(",")}
+        return finding.code in codes or "all" in codes
+
+
+class FileRule:
+    """Base class: one check over one parsed file."""
+
+    code = ""
+    name = ""
+    gate = "jaxlint"
+    pragma = "jaxlint"     # "jaxlint" or "noqa" line suppression
+    needs_tree = True
+
+    def check(self, ctx):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class RepoRule:
+    """Base class: one check over the whole repository."""
+
+    code = ""
+    name = ""
+    gate = "repo"
+    pragma = "noqa"
+
+    def check(self, repo_root):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """Class decorator adding a rule to the shared plugin registry."""
+    if cls.code in _REGISTRY:
+        raise ValueError(f"duplicate rule code {cls.code}")
+    _REGISTRY[cls.code] = cls
+    return cls
+
+
+def all_rules():
+    return dict(_REGISTRY)
+
+
+def rules_for_gate(gate):
+    return {c: r for c, r in _REGISTRY.items() if r.gate == gate}
+
+
+def iter_python_files(paths, skip_dirs=SKIP_DIRS):
+    """Yield .py files under ``paths`` (files pass through as-is)."""
+    for base in paths:
+        if os.path.isfile(base):
+            if base.endswith(".py"):
+                yield base
+            continue
+        for root, dirs, files in os.walk(base):
+            dirs[:] = sorted(d for d in dirs if d not in skip_dirs)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def analyze_file(path, repo_root, rules):
+    """Run ``rules`` (instances) over one file; returns findings.
+
+    Parse failures yield a single CHK001 syntax finding; tree-needing
+    rules are skipped for that file.
+    """
+    relpath = os.path.relpath(path, repo_root)
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    ctx = FileContext(path, relpath, source)
+    findings = []
+    if ctx.parse_error is not None:
+        exc = ctx.parse_error
+        findings.append(Finding(
+            ctx.relpath, exc.lineno or 1, "CHK001",
+            f"syntax error: {exc.msg}",
+            ctx.src_line(exc.lineno or 1)))
+    for rule in rules:
+        if rule.needs_tree and ctx.tree is None:
+            continue
+        for finding in rule.check(ctx):
+            if not ctx.suppressed(finding, rule.pragma):
+                findings.append(finding)
+    return findings
+
+
+def analyze_paths(paths, repo_root, rules, baseline=None):
+    """Analyze every file under ``paths``.
+
+    Returns ``(findings, stale_entries, n_files)``: findings that
+    survived pragma + baseline suppression, baseline entries that
+    matched nothing (candidates for deletion), and the file count.
+    """
+    instances = [r() if isinstance(r, type) else r for r in rules]
+    file_rules = [r for r in instances if isinstance(r, FileRule)]
+    findings = []
+    n = 0
+    for path in iter_python_files(paths):
+        n += 1
+        findings.extend(analyze_file(path, repo_root, file_rules))
+    for rule in instances:
+        if isinstance(rule, RepoRule):
+            findings.extend(rule.check(repo_root))
+    if baseline is not None:
+        findings, stale = baseline.filter(findings)
+    else:
+        stale = []
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings, stale, n

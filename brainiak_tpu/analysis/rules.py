@@ -1,0 +1,466 @@
+"""jaxlint rules JX001-JX006: TPU-readiness invariants.
+
+Each rule is a small plugin over the shared :class:`.core.FileContext`
+(one parse per file, N rules).  The rule set encodes the classic
+JAX/TPU hazards that silently destroy performance or correctness:
+uncached retracing, host-device synchronisation inside hot loops,
+float64 leaks, NumPy RNG / PRNG-key reuse under jit, Python control
+flow on traced values, and missing static-argument declarations.
+"""
+
+import ast
+import re
+
+from .core import _STATIC_ATTRS, FileRule, register
+
+# calls that force a host-device round trip
+_HOST_SYNC_CALLS = {
+    "numpy.asarray": "np.asarray",
+    "numpy.array": "np.array",
+    "jax.device_get": "jax.device_get",
+}
+_HOST_SYNC_METHODS = {"item", "block_until_ready"}
+
+# ``for``-loop headers that look like training/EM epochs; counting
+# loops (``for ... in range(...)``) additionally match chunked-fit
+# vocabulary (block/chunk/batch) -- those are the per-block dispatch
+# loops where a stray host sync serializes the device queue
+_EPOCH_RE = re.compile(
+    r"epoch|n_iter|max_iter|num_iter|iteration|n_steps|n_epochs",
+    re.IGNORECASE)
+_CHUNK_RE = re.compile(
+    r"epoch|itera|n_iter|max_iter|num_iter|n_steps|block|chunk"
+    r"|batch", re.IGNORECASE)
+
+# jax.random functions that legitimately consume a key many times
+_KEY_MGMT = {"split", "fold_in", "PRNGKey", "key", "key_data",
+             "wrap_key_data", "clone"}
+
+_CACHE_DECOS = {"functools.lru_cache", "functools.cache",
+                "lru_cache", "cache"}
+
+
+def _loop_ancestor(ctx, node):
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+            return anc
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return None
+    return None
+
+
+def _fn_ancestor(ctx, node):
+    return ctx.enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda))
+
+
+def _is_cached(ctx, fn):
+    """True when ``fn`` (or an enclosing def) is lru_cache-decorated."""
+    cur = fn
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in cur.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if ctx.resolve(target) in _CACHE_DECOS:
+                    return True
+        cur = _fn_ancestor(ctx, cur)
+    return False
+
+
+def _walk_skip_nested(body):
+    """Walk statements without descending into nested defs/lambdas."""
+    stack = list(body) if isinstance(body, list) else [body]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class JitPerCall(FileRule):
+    """JX001: ``jax.jit`` constructed where it retraces every call."""
+
+    code = "JX001"
+    name = "jit-per-call"
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.resolve(node.func) == "jax.jit"):
+                continue
+            if ctx.in_decorator(node):
+                continue
+            parent = ctx.parent(node)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                yield ctx.finding(
+                    self, node,
+                    "jax.jit(...)(...) wrapped and immediately "
+                    "called: the traced function is discarded and "
+                    "every call retraces; bind the jitted callable "
+                    "once (module scope or lru_cache)")
+                continue
+            loop = _loop_ancestor(ctx, node)
+            if loop is not None:
+                yield ctx.finding(
+                    self, node,
+                    "jax.jit constructed inside a loop: each "
+                    "iteration builds a fresh callable and retraces; "
+                    "hoist the jit out of the loop")
+                continue
+            fn = _fn_ancestor(ctx, node)
+            if fn is not None and not _is_cached(ctx, fn):
+                where = getattr(fn, "name", "<lambda>")
+                yield ctx.finding(
+                    self, node,
+                    f"jax.jit constructed inside function "
+                    f"'{where}': every call builds a fresh callable "
+                    "and retraces; hoist to module scope or cache "
+                    "the wrapper (functools.lru_cache)")
+
+
+@register
+class HostSyncInLoop(FileRule):
+    """JX002: host-device sync inside a hot loop body."""
+
+    code = "JX002"
+    name = "host-sync-in-loop"
+
+    def check(self, ctx):
+        local_defs = self._local_defs(ctx)
+        seen = set()
+        for scope, why in self._hot_scopes(ctx, local_defs):
+            body = (scope.body if not isinstance(scope, ast.Lambda)
+                    else [scope.body])
+            for node in _walk_skip_nested(body):
+                hit = self._host_sync(ctx, node)
+                if hit is None or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                yield ctx.finding(
+                    self, node,
+                    f"host-device sync `{hit}` inside {why}: forces "
+                    "a device round trip every iteration; move it "
+                    "out of the hot loop (or fetch once after)")
+
+    @staticmethod
+    def _local_defs(ctx):
+        defs = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+            elif (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Lambda)):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        defs.setdefault(tgt.id, node.value)
+        return defs
+
+    def _hot_scopes(self, ctx, local_defs):
+        def resolve_callee(arg):
+            if isinstance(arg, ast.Lambda):
+                return arg
+            if isinstance(arg, ast.Name):
+                return local_defs.get(arg.id)
+            return None
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                target = ctx.resolve(node.func) or ""
+                short = target.rsplit(".", 1)[-1]
+                callee_args = ()
+                if short == "run_resilient_loop" and node.args:
+                    callee_args = (node.args[0],)
+                    why = "the run_resilient_loop chunk body"
+                elif target == "jax.lax.scan" and node.args:
+                    callee_args = (node.args[0],)
+                    why = "a lax.scan body"
+                elif (target == "jax.lax.fori_loop"
+                        and len(node.args) >= 3):
+                    callee_args = (node.args[2],)
+                    why = "a lax.fori_loop body"
+                elif (target == "jax.lax.while_loop"
+                        and len(node.args) >= 2):
+                    callee_args = node.args[:2]
+                    why = "a lax.while_loop cond/body"
+                for arg in callee_args:
+                    callee = resolve_callee(arg)
+                    if callee is not None:
+                        yield callee, why
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                header = ast.dump(node.target) + ast.dump(node.iter)
+                counting = (isinstance(node.iter, ast.Call)
+                            and isinstance(node.iter.func, ast.Name)
+                            and node.iter.func.id == "range")
+                if counting and _CHUNK_RE.search(header):
+                    yield node, ("an epoch/chunk-style Python "
+                                 "for-loop")
+                elif not counting and _EPOCH_RE.search(header):
+                    yield node, "an epoch-style Python for-loop"
+
+    @staticmethod
+    def _host_sync(ctx, node):
+        if not isinstance(node, ast.Call):
+            return None
+        target = ctx.resolve(node.func)
+        if target in _HOST_SYNC_CALLS:
+            return _HOST_SYNC_CALLS[target]
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_METHODS):
+            return f".{node.func.attr}()"
+        if (isinstance(node.func, ast.Name)
+                and node.func.id == "float"
+                and node.func.id not in ctx.aliases
+                and len(node.args) == 1
+                and not isinstance(node.args[0], ast.Constant)):
+            return "float(...)"
+        return None
+
+
+@register
+class Float64Leak(FileRule):
+    """JX003: float64 literal in device code without x64 guard."""
+
+    code = "JX003"
+    name = "float64-leak"
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            hit = self._f64(ctx, node)
+            if hit and not self._guarded(ctx, node):
+                yield ctx.finding(
+                    self, node,
+                    f"{hit} in device code: TPUs run float32/bf16 "
+                    "and jax silently downcasts unless "
+                    "jax_enable_x64 is set; use float32 or guard "
+                    "with an explicit enable_x64 check")
+
+    def _f64(self, ctx, node):
+        in_jit = self._in_jitted(ctx, node)
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            target = ctx.resolve(node) or ""
+            if target == "jax.numpy.float64":
+                return "jnp.float64"
+            if target == "numpy.float64" and (
+                    in_jit or self._in_jax_call(ctx, node)):
+                return "np.float64"
+        if (isinstance(node, ast.Constant)
+                and node.value == "float64"):
+            call = ctx.enclosing(node, ast.Call)
+            if call is None:
+                return None
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "astype" and in_jit):
+                return ".astype('float64')"
+            target = ctx.resolve(call.func) or ""
+            if target.startswith(("jax.", "jax_")) or in_jit:
+                return "dtype='float64'"
+        return None
+
+    @staticmethod
+    def _in_jitted(ctx, node):
+        for anc in ctx.ancestors(node):
+            if anc in ctx.jitted:
+                return True
+        return False
+
+    @staticmethod
+    def _in_jax_call(ctx, node):
+        call = ctx.enclosing(node, ast.Call)
+        while call is not None:
+            target = ctx.resolve(call.func) or ""
+            if target.startswith("jax."):
+                return True
+            call = ctx.enclosing(call, ast.Call)
+        return False
+
+    @staticmethod
+    def _guarded(ctx, node):
+        if "enable_x64" in ctx.src_line(node.lineno):
+            return True
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.If, ast.IfExp)):
+                test = ast.dump(anc.test)
+                if "enable_x64" in test or "x64" in test:
+                    return True
+        return False
+
+
+@register
+class RngHazard(FileRule):
+    """JX004: NumPy RNG, or PRNG key reuse, in a jitted function."""
+
+    code = "JX004"
+    name = "rng-hazard"
+
+    def check(self, ctx):
+        for fn, _ in ctx.jitted.items():
+            yield from self._np_random(ctx, fn)
+            yield from self._key_reuse(ctx, fn)
+
+    def _np_random(self, ctx, fn):
+        for node in _walk_skip_nested(fn.body):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve(node.func) or ""
+            if target.startswith("numpy.random."):
+                yield ctx.finding(
+                    self, node,
+                    f"`{target}` inside jitted '{fn.name}': NumPy "
+                    "RNG runs at trace time on the host, so every "
+                    "execution replays the SAME draw; thread a "
+                    "jax.random key instead")
+
+    def _key_reuse(self, ctx, fn):
+        uses = {}      # key name -> [call nodes]
+        managed = set()    # keys handed to split/fold_in
+        stores = {}        # name -> number of rebindings
+        for node in _walk_skip_nested(fn.body):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name):
+                            stores[sub.id] = \
+                                stores.get(sub.id, 0) + 1
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve(node.func) or ""
+            if not target.startswith("jax.random."):
+                continue
+            short = target.rsplit(".", 1)[-1]
+            if not node.args or not isinstance(node.args[0],
+                                               ast.Name):
+                continue
+            key = node.args[0].id
+            if short in _KEY_MGMT:
+                managed.add(key)
+            else:
+                uses.setdefault(key, []).append(node)
+        for key, calls in sorted(uses.items()):
+            # a name bound at most once (a parameter, or a single
+            # PRNGKey/split result) that feeds >= 2 samplers without
+            # ever being split is the canonical reuse bug; names
+            # rebound between uses (key rotation) are exempt
+            if (len(calls) >= 2 and key not in managed
+                    and stores.get(key, 0) <= 1):
+                yield ctx.finding(
+                    self, calls[1],
+                    f"PRNG key `{key}` consumed by "
+                    f"{len(calls)} jax.random calls in "
+                    f"'{fn.name}' without a split: the draws are "
+                    "IDENTICAL, not independent; "
+                    "jax.random.split the key first")
+
+
+@register
+class TracedBranch(FileRule):
+    """JX005: Python ``if``/``while`` on a traced parameter."""
+
+    code = "JX005"
+    name = "traced-branch"
+
+    def check(self, ctx):
+        for fn, statics in ctx.jitted.items():
+            if isinstance(fn, ast.Lambda):
+                continue
+            params = set(ctx.fn_params(fn)) - statics - {"self"}
+            for node in _walk_skip_nested(fn.body):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                name = self._traced_name(ctx, node.test, params)
+                if name is None:
+                    continue
+                kind = ("if" if isinstance(node, ast.If)
+                        else "while")
+                yield ctx.finding(
+                    self, node,
+                    f"Python `{kind}` on traced parameter "
+                    f"`{name}` of jitted '{fn.name}': trace-time "
+                    "branching raises TracerBoolConversionError or "
+                    "bakes in one path; use lax.cond/lax.select, "
+                    "or declare the argument static")
+
+    @staticmethod
+    def _traced_name(ctx, test, params):
+        if (isinstance(test, ast.Compare)
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in test.ops)):
+            return None  # `x is None` checks are static
+        for node in ast.walk(test):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "isinstance"):
+                return None
+        for node in ast.walk(test):
+            if (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in params):
+                parent = ctx.parent(node)
+                if (isinstance(parent, ast.Attribute)
+                        and parent.attr in _STATIC_ATTRS):
+                    continue  # static metadata access
+                return node.id
+        return None
+
+
+@register
+class MissingStatic(FileRule):
+    """JX006: traced param used where a Python int is required."""
+
+    code = "JX006"
+    name = "missing-static"
+
+    def check(self, ctx):
+        for fn, statics in ctx.jitted.items():
+            if isinstance(fn, ast.Lambda):
+                continue
+            params = set(ctx.fn_params(fn)) - statics - {"self"}
+            for node in _walk_skip_nested(fn.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._int_sink(ctx, node)
+                if kind is None:
+                    continue
+                for arg in self._int_args(node):
+                    if (isinstance(arg, ast.Name)
+                            and arg.id in params):
+                        yield ctx.finding(
+                            self, node,
+                            f"traced parameter `{arg.id}` passed "
+                            f"to `{kind}` in jitted '{fn.name}': "
+                            "needs a concrete Python int at trace "
+                            "time; declare it in static_argnums/"
+                            "static_argnames")
+
+    @staticmethod
+    def _int_sink(ctx, node):
+        if (isinstance(node.func, ast.Name)
+                and node.func.id == "range"
+                and node.func.id not in ctx.aliases):
+            return "range"
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "reshape":
+            return "reshape"
+        if ctx.resolve(node.func) == "jax.numpy.reshape":
+            return "jnp.reshape"
+        return None
+
+    @staticmethod
+    def _int_args(node):
+        for arg in node.args:
+            if isinstance(arg, ast.Tuple):
+                yield from arg.elts
+            else:
+                yield arg
+
+
+JAXLINT_RULES = [JitPerCall, HostSyncInLoop, Float64Leak,
+                 RngHazard, TracedBranch, MissingStatic]

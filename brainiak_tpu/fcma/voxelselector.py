@@ -17,7 +17,7 @@ disappears because TPU chips are homogeneous.
 """
 
 import logging
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +50,29 @@ def _gram_and_shrink(corr, precision=None):
                          precision=resolve_precision(precision),
                          preferred_element_type=jnp.float32)
     return _shrink(kernels)
+
+
+@lru_cache(maxsize=None)
+def _sharded_gram_program(mesh, epochs_per_subj, interpret,
+                          precision):
+    """Mesh-sharded Pallas Gram program, built once per
+    (mesh, config).  GSPMD cannot partition a pallas_call, so the
+    Gram kernel runs per shard under shard_map; jit caches on
+    function identity, so constructing the shard_map closure inside
+    ``run()`` would rebuild (and retrace) it on every call.
+    """
+    from jax import shard_map
+    return jax.jit(shard_map(
+        partial(_block_gram_pallas,
+                epochs_per_subj=epochs_per_subj,
+                interpret=interpret,
+                precision=precision),
+        mesh=mesh,
+        in_specs=(PartitionSpec(None, None, DEFAULT_VOXEL_AXIS),
+                  PartitionSpec()),
+        out_specs=PartitionSpec(DEFAULT_VOXEL_AXIS, None, None),
+        # pallas_call's out_shape carries no vma info
+        check_vma=False))
 
 
 @partial(jax.jit, static_argnames=("epochs_per_subj", "interpret",
@@ -281,24 +304,14 @@ class VoxelSelector:
                 # VMEM tiling is independent of the block extent).
                 block = -(-self.num_voxels // n_shards) * n_shards
 
-        # mesh + Pallas: GSPMD cannot partition a pallas_call, so the
-        # Gram kernel runs per shard under shard_map.  Built ONCE here —
-        # block shapes are constant across iterations, so a fresh
-        # closure per block would recompile every iteration.
+        # mesh + Pallas: the cached shard_map program (block shapes
+        # are constant across iterations AND across run() calls, so
+        # the builder is lru_cached at module scope — jaxlint JX001)
         sharded_gram = None
         if self.mesh is not None and self.use_pallas:
-            from jax import shard_map
-            from jax.sharding import PartitionSpec as P
-            sharded_gram = jax.jit(shard_map(
-                partial(_block_gram_pallas,
-                        epochs_per_subj=self.epochs_per_subj,
-                        interpret=jax.default_backend() != 'tpu',
-                        precision=self.precision),
-                mesh=self.mesh,
-                in_specs=(P(None, None, DEFAULT_VOXEL_AXIS), P()),
-                out_specs=P(DEFAULT_VOXEL_AXIS, None, None),
-                # pallas_call's out_shape carries no vma info
-                check_vma=False))
+            sharded_gram = _sharded_gram_program(
+                self.mesh, self.epochs_per_subj,
+                jax.default_backend() != 'tpu', self.precision)
 
         block_accs = []
         for start in range(0, self.num_voxels, block):

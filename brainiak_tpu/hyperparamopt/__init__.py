@@ -1,3 +1,8 @@
 """Bayesian hyperparameter optimization (TPE-style)."""
 
-from .hpo import fmin, get_next_sample, get_sigma, gmm_1d_distribution  # noqa: F401,E501
+from .hpo import (
+    fmin,
+    get_next_sample,
+    get_sigma,
+    gmm_1d_distribution,
+)

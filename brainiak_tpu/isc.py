@@ -19,7 +19,7 @@ accidentally censor a genuine ISC of exactly 1.0.
 
 import logging
 import math
-from functools import partial
+from functools import lru_cache, partial
 from itertools import permutations, product
 
 import jax
@@ -155,6 +155,17 @@ def _shard_voxels(arr, mesh, axis):
     return place_on_mesh(arr, NamedSharding(mesh, PartitionSpec(*spec)))
 
 
+@lru_cache(maxsize=None)
+def _slab_program(mesh, chunk):
+    """Replicated row-slab fetch, cached per (mesh, chunk): jit
+    caches on function identity, so a fresh lambda per
+    ``_fetch_ring_matrix`` call would re-lower the broadcast on
+    every fetch (jaxlint JX001)."""
+    return jax.jit(
+        lambda a, i: jax.lax.dynamic_slice_in_dim(a, i, chunk, 0),
+        out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
 def _fetch_ring_matrix(m, mesh):
     """Host-fetch the ring path's row-sharded [V, V] matrix on every
     process WITHOUT ever replicating it on a device: the ring exists
@@ -172,9 +183,7 @@ def _fetch_ring_matrix(m, mesh):
             "row count {} not divisible by {} shards; trailing rows "
             "would be lost".format(m.shape[0], n_shards))
     chunk = m.shape[0] // n_shards
-    slab = jax.jit(
-        lambda a, i: jax.lax.dynamic_slice_in_dim(a, i, chunk, 0),
-        out_shardings=NamedSharding(mesh, PartitionSpec()))
+    slab = _slab_program(mesh, chunk)
     out = np.empty(m.shape, dtype=m.dtype)
     for i in range(n_shards):
         out[i * chunk:(i + 1) * chunk] = np.asarray(

@@ -34,6 +34,10 @@ def make_val_and_grad(loss_fn, *, jit=True):
     loss_fn : callable taking a flat parameter vector (plus optional
         fixed args) and returning a scalar.
     """
+    # one jit per bridge construction is intentional (jaxlint
+    # baseline): user loss closures are uncacheable without pinning
+    # their captured data for process lifetime, and a bridge is
+    # built once per fit then reused for every minimize iteration
     vg = jax.value_and_grad(loss_fn)
     if jit:
         vg = jax.jit(vg)

@@ -1,0 +1,345 @@
+"""Per-rule jaxlint fixtures: each rule fires on a known-bad snippet
+and stays silent on the known-good twin (ISSUE 2 acceptance)."""
+
+import textwrap
+
+from brainiak_tpu.analysis.core import analyze_file
+from brainiak_tpu.analysis.rules import (
+    Float64Leak,
+    HostSyncInLoop,
+    JitPerCall,
+    MissingStatic,
+    RngHazard,
+    TracedBranch,
+)
+
+
+def lint(tmp_path, src, rule_cls):
+    path = tmp_path / "snippet.py"
+    path.write_text(textwrap.dedent(src))
+    findings = analyze_file(str(path), str(tmp_path), [rule_cls()])
+    assert not any(f.code == "CHK001" for f in findings), findings
+    return findings
+
+
+# -- JX001 jit-per-call ----------------------------------------------
+
+def test_jx001_fires_on_jit_in_loop(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        def run(fns, x):
+            out = []
+            for fn in fns:
+                jfn = jax.jit(fn)
+                out.append(jfn(x))
+            return out
+        """, JitPerCall)
+    assert [f.code for f in findings] == ["JX001"]
+    assert "inside a loop" in findings[0].message
+
+
+def test_jx001_fires_on_immediately_invoked_jit(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        def g(x):
+            return x + 1
+        y = jax.jit(g)(3.0)
+        """, JitPerCall)
+    assert [f.code for f in findings] == ["JX001"]
+    assert "immediately" in findings[0].message
+
+
+def test_jx001_fires_on_jit_inside_function(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        def make(fn):
+            return jax.jit(fn)
+        """, JitPerCall)
+    assert [f.code for f in findings] == ["JX001"]
+    assert "'make'" in findings[0].message
+
+
+def test_jx001_silent_on_good_patterns(tmp_path):
+    findings = lint(tmp_path, """
+        import functools
+        import jax
+
+        @jax.jit
+        def decorated(x):
+            return x + 1
+
+        def g(x):
+            return x * 2
+
+        g_jit = jax.jit(g, static_argnames=("n",))
+
+        @functools.lru_cache(maxsize=None)
+        def cached_builder(n):
+            return jax.jit(lambda a: a + n)
+        """, JitPerCall)
+    assert findings == []
+
+
+# -- JX002 host-sync-in-loop -----------------------------------------
+
+def test_jx002_fires_in_epoch_loop(tmp_path):
+    findings = lint(tmp_path, """
+        import numpy as np
+        def fit(step, state, n_iter):
+            for epoch in range(n_iter):
+                state = step(state)
+                print(np.asarray(state).sum())
+            return state
+        """, HostSyncInLoop)
+    assert [f.code for f in findings] == ["JX002"]
+    assert "np.asarray" in findings[0].message
+
+
+def test_jx002_fires_in_scan_body(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        import numpy as np
+        def body(carry, x):
+            host = np.asarray(x)
+            return carry + host.sum(), x
+        def run(xs):
+            return jax.lax.scan(body, 0.0, xs)
+        """, HostSyncInLoop)
+    assert [f.code for f in findings] == ["JX002"]
+    assert "lax.scan" in findings[0].message
+
+
+def test_jx002_fires_in_resilient_chunk_body(tmp_path):
+    findings = lint(tmp_path, """
+        from brainiak_tpu.resilience import run_resilient_loop
+        def fit(step, init):
+            def run_chunk(state, i, n):
+                done = float(step(state))
+                return state, done > 0
+            return run_resilient_loop(run_chunk, init, 10)
+        """, HostSyncInLoop)
+    assert [f.code for f in findings] == ["JX002"]
+    assert "run_resilient_loop" in findings[0].message
+
+
+def test_jx002_fires_on_fori_loop_lambda(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        def run(xs):
+            return jax.lax.fori_loop(
+                0, 10, lambda i, c: c + xs.item(), 0.0)
+        """, HostSyncInLoop)
+    assert [f.code for f in findings] == ["JX002"]
+    assert ".item()" in findings[0].message
+
+
+def test_jx002_silent_on_host_side_code(tmp_path):
+    findings = lint(tmp_path, """
+        import numpy as np
+        def load(lines):
+            rows = []
+            for line in lines:
+                rows.append(float(line))
+            return np.asarray(rows)
+        def fit(step, state, n_iter):
+            for epoch in range(n_iter):
+                state = step(state)
+            return np.asarray(state)
+        """, HostSyncInLoop)
+    assert findings == []
+
+
+# -- JX003 float64-leak ----------------------------------------------
+
+def test_jx003_fires_on_jnp_float64(tmp_path):
+    findings = lint(tmp_path, """
+        import jax.numpy as jnp
+        ZEROS = jnp.zeros((4,), dtype=jnp.float64)
+        """, Float64Leak)
+    assert [f.code for f in findings] == ["JX003"]
+
+
+def test_jx003_fires_on_float64_string_in_jax_call(tmp_path):
+    findings = lint(tmp_path, """
+        import jax.numpy as jnp
+        ONES = jnp.ones((4,), dtype="float64")
+        """, Float64Leak)
+    assert [f.code for f in findings] == ["JX003"]
+
+
+def test_jx003_fires_on_astype_in_jit(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        @jax.jit
+        def f(x):
+            return x.astype("float64")
+        """, Float64Leak)
+    assert [f.code for f in findings] == ["JX003"]
+    assert ".astype" in findings[0].message
+
+
+def test_jx003_silent_when_guarded_or_host_side(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        import numpy as np
+        dtype = np.float64 if jax.config.jax_enable_x64 \\
+            else np.float32
+        HOST = np.zeros((4,), dtype=np.float64)
+        """, Float64Leak)
+    assert findings == []
+
+
+# -- JX004 rng-hazard ------------------------------------------------
+
+def test_jx004_fires_on_np_random_in_jit(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        import numpy as np
+        @jax.jit
+        def f(x):
+            return x + np.random.rand()
+        """, RngHazard)
+    assert [f.code for f in findings] == ["JX004"]
+    assert "numpy.random" in findings[0].message
+
+
+def test_jx004_fires_on_key_reuse(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        @jax.jit
+        def f(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            return a + b
+        """, RngHazard)
+    assert [f.code for f in findings] == ["JX004"]
+    assert "split" in findings[0].message
+
+
+def test_jx004_fires_on_key_created_inside_jit(tmp_path):
+    """The canonical form: a PRNGKey minted in the function and fed
+    to two samplers without a split."""
+    findings = lint(tmp_path, """
+        import jax
+        @jax.jit
+        def f(x):
+            key = jax.random.PRNGKey(0)
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            return x + a + b
+        """, RngHazard)
+    assert [f.code for f in findings] == ["JX004"]
+
+
+def test_jx004_silent_on_key_rotation(tmp_path):
+    """A name rebound between sampler calls is rotation, not reuse."""
+    findings = lint(tmp_path, """
+        import jax
+        @jax.jit
+        def f(keys):
+            k = keys[0]
+            a = jax.random.normal(k, (3,))
+            k = keys[1]
+            b = jax.random.uniform(k, (3,))
+            return a + b
+        """, RngHazard)
+    assert findings == []
+
+
+def test_jx004_silent_on_split_keys_and_host_rng(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        import numpy as np
+        @jax.jit
+        def f(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, (3,))
+            b = jax.random.uniform(k2, (3,))
+            return a + b
+        def host_init(seed):
+            return np.random.default_rng(seed).normal(size=3)
+        """, RngHazard)
+    assert findings == []
+
+
+# -- JX005 traced-branch ---------------------------------------------
+
+def test_jx005_fires_on_if_over_traced_param(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """, TracedBranch)
+    assert [f.code for f in findings] == ["JX005"]
+    assert "`x`" in findings[0].message
+
+
+def test_jx005_silent_on_static_and_metadata_branches(tmp_path):
+    findings = lint(tmp_path, """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("flag",))
+        def g(x, flag):
+            if flag:
+                return x
+            return -x
+
+        @jax.jit
+        def h(x, y=None):
+            if y is None:
+                y = x
+            if x.ndim == 2:
+                return x + y
+            return x - y
+
+        def plain(x):
+            if x > 0:
+                return x
+            return -x
+        """, TracedBranch)
+    assert findings == []
+
+
+# -- JX006 missing-static --------------------------------------------
+
+def test_jx006_fires_on_traced_reshape_arg(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        @jax.jit
+        def f(x, n):
+            return x.reshape(n, -1)
+        """, MissingStatic)
+    assert [f.code for f in findings] == ["JX006"]
+    assert "static_argnums" in findings[0].message
+
+
+def test_jx006_fires_on_traced_range_arg(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        @jax.jit
+        def f(x, steps):
+            for _ in range(steps):
+                x = x + 1
+            return x
+        """, MissingStatic)
+    assert [f.code for f in findings] == ["JX006"]
+
+
+def test_jx006_silent_with_static_declaration(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+
+        def _impl(x, n):
+            return x.reshape(n, -1)
+
+        _impl_jit = jax.jit(_impl, static_argnames=("n",))
+
+        @jax.jit
+        def g(x):
+            return x.reshape(x.shape[0], -1)
+        """, MissingStatic)
+    assert findings == []

@@ -1,0 +1,201 @@
+"""jaxlint suppression mechanics: line pragma, baseline, config, CLI."""
+
+import json
+import textwrap
+
+import pytest
+
+from brainiak_tpu.analysis import cli
+from brainiak_tpu.analysis.baseline import Baseline, BaselineError
+from brainiak_tpu.analysis.config import load_config
+from brainiak_tpu.analysis.core import analyze_file
+from brainiak_tpu.analysis.rules import JAXLINT_RULES, JitPerCall
+
+BAD = """
+import jax
+def make(fn):
+    return jax.jit(fn)
+"""
+
+
+def _write(tmp_path, src, name="mod.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(src))
+    return path
+
+
+def _lint(tmp_path, src, rules=(JitPerCall,), name="mod.py"):
+    path = _write(tmp_path, src, name)
+    return analyze_file(str(path), str(tmp_path),
+                        [r() for r in rules])
+
+
+# -- line pragma -----------------------------------------------------
+
+def test_pragma_suppresses_matching_code(tmp_path):
+    findings = _lint(tmp_path, """
+        import jax
+        def make(fn):
+            return jax.jit(fn)  # jaxlint: disable=JX001
+        """)
+    assert findings == []
+
+
+def test_pragma_with_code_list_and_all(tmp_path):
+    for tag in ("JX005,JX001", "all"):
+        findings = _lint(tmp_path, f"""
+            import jax
+            def make(fn):
+                return jax.jit(fn)  # jaxlint: disable={tag}
+            """)
+        assert findings == []
+
+
+def test_pragma_other_code_does_not_suppress(tmp_path):
+    findings = _lint(tmp_path, """
+        import jax
+        def make(fn):
+            return jax.jit(fn)  # jaxlint: disable=JX002
+        """)
+    assert [f.code for f in findings] == ["JX001"]
+
+
+def test_blanket_noqa_does_not_suppress_jaxlint(tmp_path):
+    """A bare ``# noqa`` must NOT silence TPU-correctness rules —
+    grandfathered findings go to the baseline with a justification."""
+    findings = _lint(tmp_path, """
+        import jax
+        def make(fn):
+            return jax.jit(fn)  # noqa
+        """)
+    assert [f.code for f in findings] == ["JX001"]
+
+
+def test_syntax_error_reported_as_chk001(tmp_path):
+    findings = _lint(tmp_path, "def broken(:\n    pass\n")
+    assert [f.code for f in findings] == ["CHK001"]
+
+
+# -- baseline --------------------------------------------------------
+
+def test_baseline_filters_matching_finding(tmp_path):
+    findings = _lint(tmp_path, BAD)
+    assert len(findings) == 1
+    baseline = Baseline([{
+        "rule": "JX001", "path": findings[0].path,
+        "snippet": findings[0].snippet,
+        "reason": "builder API: caller caches the result"}])
+    kept, stale = baseline.filter(findings)
+    assert kept == [] and stale == []
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    baseline = Baseline([{
+        "rule": "JX001", "path": "gone.py",
+        "snippet": "jax.jit(fn)", "reason": "was fixed"}])
+    kept, stale = baseline.filter(_lint(tmp_path, BAD))
+    assert len(kept) == 1
+    assert [e["path"] for e in stale] == ["gone.py"]
+
+
+def test_baseline_requires_written_justification():
+    with pytest.raises(BaselineError, match="reason"):
+        Baseline([{"rule": "JX001", "path": "a.py",
+                   "snippet": "jax.jit(fn)", "reason": "  "}])
+
+
+def test_baseline_load_rejects_bad_json(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text("not json")
+    with pytest.raises(BaselineError, match="JSON"):
+        Baseline.load(str(path))
+
+
+def test_baseline_load_missing_file_is_empty(tmp_path):
+    baseline = Baseline.load(str(tmp_path / "absent.json"))
+    assert baseline.entries == []
+
+
+# -- [tool.jaxlint] config -------------------------------------------
+
+def test_config_parses_tool_jaxlint_section(tmp_path):
+    pyproject = tmp_path / "pyproject.toml"
+    pyproject.write_text(textwrap.dedent("""
+        [project]
+        name = "x"
+
+        [tool.jaxlint]
+        select = [
+            "JX001",
+            "JX003",
+        ]
+        include = ["pkg"]
+        exclude = ["pkg/vendored"]
+        baseline = "tools/jaxlint_baseline.json"
+
+        [tool.other]
+        select = ["IGNORED"]
+        """))
+    config = load_config(str(tmp_path), str(pyproject))
+    assert config.select == ("JX001", "JX003")
+    assert config.include == ("pkg",)
+    assert config.exclude == ("pkg/vendored",)
+    assert config.baseline == "tools/jaxlint_baseline.json"
+    assert config.baseline_path().endswith(
+        "tools/jaxlint_baseline.json")
+
+
+def test_config_defaults_without_section(tmp_path):
+    pyproject = tmp_path / "pyproject.toml"
+    pyproject.write_text("[project]\nname = 'x'\n")
+    config = load_config(str(tmp_path), str(pyproject))
+    assert config.select == tuple(r.code for r in JAXLINT_RULES)
+    assert config.include == ("brainiak_tpu",)
+    assert config.baseline is None
+
+
+# -- CLI -------------------------------------------------------------
+
+def _cli_repo(tmp_path, monkeypatch):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    _write(pkg, BAD, "bad.py")
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.jaxlint]\nselect = ["JX001"]\n'
+        'include = ["pkg"]\n')
+    monkeypatch.chdir(tmp_path)
+    return pkg
+
+
+def test_cli_exit_one_and_json_on_findings(tmp_path, monkeypatch,
+                                           capsys):
+    _cli_repo(tmp_path, monkeypatch)
+    assert cli.main(["--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is False
+    assert [f["code"] for f in payload["findings"]] == ["JX001"]
+    assert payload["findings"][0]["path"] == "pkg/bad.py"
+
+
+def test_cli_write_then_enforce_baseline(tmp_path, monkeypatch,
+                                         capsys):
+    _cli_repo(tmp_path, monkeypatch)
+    assert cli.main(["--write-baseline", "bl.json"]) == 0
+    data = json.loads((tmp_path / "bl.json").read_text())
+    assert len(data["entries"]) == 1
+    assert cli.main(["--baseline", "bl.json"]) == 0
+    out = capsys.readouterr().out
+    assert "OK" in out
+
+
+def test_cli_exit_zero_on_clean_tree(tmp_path, monkeypatch, capsys):
+    pkg = _cli_repo(tmp_path, monkeypatch)
+    _write(pkg, "import jax\n\n\n@jax.jit\ndef f(x):\n"
+                "    return x\n", "bad.py")
+    assert cli.main([]) == 0
+
+
+def test_cli_rejects_unknown_rule(tmp_path, monkeypatch):
+    _cli_repo(tmp_path, monkeypatch)
+    with pytest.raises(SystemExit, match="JX999"):
+        cli.main(["--select", "JX999"])

@@ -2,7 +2,6 @@ import numpy as np
 import pytest
 
 from brainiak_tpu.reprsimil.brsa import BRSA, GBRSA
-from brainiak_tpu.utils.utils import gen_design  # noqa: F401
 
 
 def make_brsa_data(n_t=150, n_v=30, n_c=4, seed=0, snr_scale=1.0,

@@ -1,6 +1,14 @@
-"""Static-analysis gate, run with the suite (reference run-checks.sh)."""
+"""Static-analysis gates, run with the suite (reference run-checks.sh).
+
+The gate registry (tools/run_checks.py) shares one file walk between
+the hermetic stdlib checks and the jaxlint TPU-correctness analyzer;
+these tests enforce that the full gate — and the jaxlint gate alone —
+run clean on the live tree, and that the machinery catches seeded
+violations.
+"""
 
 import importlib.util
+import json
 import subprocess
 import sys
 
@@ -20,6 +28,76 @@ def test_static_checks_clean():
         [sys.executable, f"{REPO_ROOT}/tools/run_checks.py"],
         capture_output=True, text=True)
     assert r.returncode == 0, f"static checks failed:\n{r.stdout}"
+
+
+def test_run_checks_json_output():
+    """--format=json emits one machine-readable object for CI."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.run_checks",
+         "--format=json"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    payload = json.loads(r.stdout)
+    assert r.returncode == 0, r.stdout
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert set(payload["gates"]) == {
+        "external", "stdlib", "doc-defaults", "resilient-fits",
+        "jaxlint"}
+    assert payload["files"] > 100
+
+
+def test_jaxlint_gate_standalone():
+    """`python -m tools.run_checks --only=jaxlint` runs the analyzer
+    alone and exits clean on the live package (ISSUE 2 acceptance)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.run_checks",
+         "--only=jaxlint"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 0, r.stdout
+
+
+def test_jaxlint_clean_on_live_package():
+    """In-process: every JX finding on the tree is fixed or carries a
+    justified baseline entry, and no baseline entry is stale."""
+    from brainiak_tpu.analysis import cli as jaxlint_cli
+    from brainiak_tpu.analysis.config import load_config
+    config = load_config(
+        str(REPO_ROOT), f"{REPO_ROOT}/pyproject.toml")
+    findings, stale, n = jaxlint_cli.run(
+        config.include_paths(), str(REPO_ROOT), config.select,
+        baseline_path=config.baseline_path(),
+        exclude=config.exclude)
+    assert findings == [], [str(f) for f in findings]
+    assert stale == [], f"stale baseline entries: {stale}"
+    assert n > 50  # the walk actually covered the package
+
+
+def test_gate_registry_selection():
+    """run_gates honors --only and rejects unknown gates."""
+    import pytest
+    rc = _load_run_checks()
+    result = rc.run_gates(only=["resilient-fits"])
+    assert result["ok"] is True
+    assert result["files"] == 0  # no file walk needed
+    with pytest.raises(SystemExit, match="unknown gate"):
+        rc.run_gates(only=["nope"])
+
+
+def test_gate_rejects_unknown_select_code(monkeypatch):
+    """A typo in [tool.jaxlint] select must fail the gate loudly,
+    not silently disable the rule."""
+    import pytest
+    rc = _load_run_checks()
+    real = rc.load_config
+
+    def bad_config(*args, **kwargs):
+        config = real(*args, **kwargs)
+        config.select = ("JX001", "JX0099")
+        return config
+
+    monkeypatch.setattr(rc, "load_config", bad_config)
+    with pytest.raises(SystemExit, match="JX0099"):
+        rc.run_gates(only=["jaxlint"])
 
 
 def test_resilience_gate_passes_on_repo():
@@ -45,5 +123,35 @@ def test_resilience_gate_catches_violations(tmp_path, monkeypatch):
                         {"bad_estimator.py": ("Bad",)})
     findings = []
     rc.check_resilient_fits(findings)
-    assert any("run_resilient_loop" in f for f in findings)
-    assert any("checkpoint_dir" in f for f in findings)
+    assert any("run_resilient_loop" in f.message for f in findings)
+    assert any("checkpoint_dir" in f.message for f in findings)
+    assert all(f.code == "CHK102" for f in findings)
+
+
+def test_stdlib_gate_catches_seeded_violations(tmp_path):
+    """One walk, shared context: line-length and unused-import rules
+    both fire on a seeded file via the plugin registry."""
+    rc = _load_run_checks()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\n"
+        "X = '" + "x" * 90 + "'\n")
+    from brainiak_tpu.analysis.core import analyze_file
+    findings = analyze_file(
+        str(bad), str(tmp_path),
+        [rc.LineLength(), rc.UnusedImports()])
+    codes = sorted(f.code for f in findings)
+    assert codes == ["CHK002", "CHK003"]
+
+
+def test_stdlib_gate_honors_noqa(tmp_path):
+    rc = _load_run_checks()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os  # noqa\n"
+        "X = '" + "x" * 90 + "'  # noqa\n")
+    from brainiak_tpu.analysis.core import analyze_file
+    findings = analyze_file(
+        str(bad), str(tmp_path),
+        [rc.LineLength(), rc.UnusedImports()])
+    assert findings == []

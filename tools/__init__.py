@@ -1,0 +1,1 @@
+"""Repo tooling (``python -m tools.run_checks`` and friends)."""

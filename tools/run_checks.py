@@ -1,115 +1,131 @@
 #!/usr/bin/env python3
-"""CI-grade static analysis gate.
+"""CI-grade static analysis gate, rule-plugin edition.
 
-The analog of the reference's ``run-checks.sh:19-24`` (flake8 + mypy):
-runs ruff/flake8 and mypy when they are installed, and ALWAYS runs a
-hermetic stdlib fallback so the gate is enforced even in environments
-without the linters:
+The analog of the reference's ``run-checks.sh:19-24`` (flake8 + mypy),
+grown into a gate registry sharing ONE file walk and ONE output path
+with the jaxlint TPU-correctness analyzer
+(:mod:`brainiak_tpu.analysis`):
 
-1. byte-compilation of every Python source (syntax gate);
-2. AST-based unused-import detection (pyflakes F401 analog);
-3. the 79-column line limit (pycodestyle E501 analog).
+========== ===================================================
+gate       what it enforces
+========== ===================================================
+external   ruff/flake8 + mypy when installed (full CI hosts)
+stdlib     hermetic fallback: syntax (CHK001), 79-col lines
+           (CHK002), unused imports (CHK003)
+doc-defaults   docs/*.md ``name= (default X)`` claims match a
+           signature default (CHK101)
+resilient-fits every public iterative fit honors the
+           checkpoint_dir/run_resilient_loop contract (CHK102)
+jaxlint    TPU-readiness rules JX001-JX006 over the package,
+           with the [tool.jaxlint] baseline applied
+========== ===================================================
 
-``# noqa`` on a line suppresses findings for that line.  Exits non-zero
-on any finding; ``tests/test_static_checks.py`` wires this into the
-pytest suite so the gate runs with the tests.
+``# noqa`` suppresses stdlib/doc findings on a line; jaxlint uses
+``# jaxlint: disable=JX00N`` plus the justification baseline.  Run
+``python -m tools.run_checks --only=jaxlint`` for one gate,
+``--format=json`` for machine-readable output; exits non-zero on any
+finding.  ``tests/test_static_checks.py`` wires the full gate into
+the pytest suite.
 """
 
+import argparse
 import ast
+import json
 import os
+import re
 import shutil
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SKIP_DIRS = {".git", "__pycache__", ".claude", "build", "dist",
-             ".pytest_cache", "node_modules", ".venv", "venv", ".tox",
-             ".eggs", ".ruff_cache", ".mypy_cache"}
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from brainiak_tpu.analysis import (  # noqa: E402
+    Baseline, FileRule, Finding, JAXLINT_RULES, analyze_file,
+    iter_python_files, load_config)
+from brainiak_tpu.analysis.core import SKIP_DIRS  # noqa: E402,F401
+
 MAX_COLS = 79
+GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
+         "jaxlint")
 
 
 def python_sources():
-    for root, dirs, files in os.walk(REPO):
-        dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
-        for f in sorted(files):
-            if f.endswith(".py"):
-                yield os.path.join(root, f)
+    yield from iter_python_files([REPO])
 
 
-def _noqa_lines(source_lines):
-    return {i for i, line in enumerate(source_lines, 1)
-            if "# noqa" in line}
+def _rel(path):
+    return os.path.relpath(path, REPO).replace(os.sep, "/")
 
 
-def check_syntax(path, source, findings):
-    try:
-        compile(source, path, "exec")
-    except SyntaxError as exc:
-        findings.append(f"{path}:{exc.lineno}: syntax error: {exc.msg}")
+# -- stdlib gate (hermetic ruff/flake8 subset) ------------------------
+
+class LineLength(FileRule):
+    """CHK002: pycodestyle E501 analog (79 columns)."""
+
+    code = "CHK002"
+    name = "line-too-long"
+    gate = "stdlib"
+    pragma = "noqa"
+    needs_tree = False
+
+    def check(self, ctx):
+        for i, line in enumerate(ctx.lines, 1):
+            n = len(line.rstrip("\n"))
+            if n > MAX_COLS:
+                yield ctx.finding(
+                    self, i, f"line too long ({n} > {MAX_COLS})")
 
 
-def check_line_length(path, lines, noqa, findings):
-    for i, line in enumerate(lines, 1):
-        if i in noqa:
-            continue
-        n = len(line.rstrip("\n"))
-        if n > MAX_COLS:
-            findings.append(
-                f"{path}:{i}: line too long ({n} > {MAX_COLS})")
+class UnusedImports(FileRule):
+    """CHK003: pyflakes F401 analog."""
 
+    code = "CHK003"
+    name = "unused-import"
+    gate = "stdlib"
+    pragma = "noqa"
 
-class _ImportCollector(ast.NodeVisitor):
-    """Record imported bindings and every referenced identifier."""
-
-    def __init__(self):
-        self.imports = []     # (lineno, bound_name)
-        self.used = set()
-
-    def visit_Import(self, node):
-        for alias in node.names:
-            bound = alias.asname or alias.name.split(".")[0]
-            self.imports.append((node.lineno, bound))
-
-    def visit_ImportFrom(self, node):
-        for alias in node.names:
-            if alias.name == "*":
+    def check(self, ctx):
+        # __init__.py re-export lists are conventionally exempt
+        # (F401 in per-file-ignores of every major config).
+        if os.path.basename(ctx.path) == "__init__.py":
+            return
+        imports = []
+        used = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    imports.append((node.lineno, bound))
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    imports.append((node.lineno, bound))
+            elif isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load):
+                used.add(node.id)
+            elif isinstance(node, ast.Constant) and isinstance(
+                    node.value, str):
+                used.add(node.value)  # __all__ strings count as use
+        for lineno, name in imports:
+            if name.startswith("_"):
                 continue
-            bound = alias.asname or alias.name
-            self.imports.append((node.lineno, bound))
-
-    def visit_Name(self, node):
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
+            if name not in used:
+                yield ctx.finding(
+                    self, lineno, f"'{name}' imported but unused")
 
 
-def check_unused_imports(path, tree, noqa, findings):
-    # __init__.py re-export lists are conventionally exempt (F401 in
-    # per-file-ignores of every major config).
-    if os.path.basename(path) == "__init__.py":
-        return
-    col = _ImportCollector()
-    col.visit(tree)
-    # names referenced via __all__ strings count as used
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            col.used.add(node.value)
-    for lineno, name in col.imports:
-        if lineno in noqa or name.startswith("_"):
-            continue
-        if name not in col.used:
-            findings.append(
-                f"{path}:{lineno}: '{name}' imported but unused")
-
+# -- doc-defaults gate ------------------------------------------------
 
 def _code_defaults():
     """(global, by_owner): parameter name -> set of repr'd default
-    values across every function/method signature in the package, plus
-    the same map scoped per owning symbol — the function name, and for
-    methods also the enclosing class name (so docs can anchor a claim
-    to either ``fit`` or ``SRM``)."""
+    values across every function/method signature in the package,
+    plus the same map scoped per owning symbol — the function name,
+    and for methods also the enclosing class name (so docs can anchor
+    a claim to either ``fit`` or ``SRM``)."""
     defaults = {}
     by_owner = {}
 
@@ -131,105 +147,107 @@ def _code_defaults():
                 record(owners, arg.arg, repr(dflt.value))
 
     pkg = os.path.join(REPO, "brainiak_tpu")
-    for root, dirs, files in os.walk(pkg):
-        dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
-        for f in files:
-            if not f.endswith(".py"):
+    for path in iter_python_files([pkg]):
+        with open(path, encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
                 continue
-            path = os.path.join(root, f)
-            with open(path, encoding="utf-8") as fh:
-                try:
-                    tree = ast.parse(fh.read(), filename=path)
-                except SyntaxError:
-                    continue
-            for node in ast.walk(tree):
-                if isinstance(node, ast.ClassDef):
-                    for sub in node.body:
-                        if isinstance(sub, (ast.FunctionDef,
-                                            ast.AsyncFunctionDef)):
-                            visit_fn(sub, (node.name, sub.name))
-                elif isinstance(node, (ast.FunctionDef,
-                                       ast.AsyncFunctionDef)):
-                    visit_fn(node, (node.name,))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        visit_fn(sub, (node.name, sub.name))
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                visit_fn(node, (node.name,))
     return defaults, by_owner
 
 
+_DOC_DEFAULT_RE = re.compile(
+    r"`(?P<name>[A-Za-z_][A-Za-z0-9_]*)=?`\*{0,2}\s*"
+    r"\(\s*(?:`)?default(?:s to)?[\s:`]+(?P<value>[^)`\s,;]+)")
+_TOKEN_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
 def check_doc_defaults(findings):
-    """Docs-vs-code default drift gate: every ``**`name=`** (default X)``
-    claim in docs/*.md must match at least one signature default for a
-    parameter of that name somewhere in the package (the round-2
-    ``svm_iters`` 20-vs-10 drift is the motivating case)."""
-    import re
-    pattern = re.compile(
-        r"`(?P<name>[A-Za-z_][A-Za-z0-9_]*)=?`\*{0,2}\s*"
-        r"\(\s*(?:`)?default(?:s to)?[\s:`]+(?P<value>[^)`\s,;]+)")
+    """Docs-vs-code default drift gate (CHK101): every
+    ``**`name=`** (default X)`` claim in docs/*.md must match at
+    least one signature default for a parameter of that name (the
+    round-2 ``svm_iters`` 20-vs-10 drift is the motivating case)."""
     docs_dir = os.path.join(REPO, "docs")
     if not os.path.isdir(docs_dir):
         return
-    token_re = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
     defaults = by_owner = None
+    md_files = []
     for root, dirs, files in os.walk(docs_dir):
         dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
-        for f in sorted(files):
-            if not f.endswith(".md"):
-                continue
-            path = os.path.join(root, f)
-            heading = ""
-            in_fence = False
-            with open(path, encoding="utf-8") as fh:
-                for i, line in enumerate(fh, 1):
-                    if line.lstrip().startswith("```"):
-                        in_fence = not in_fence
-                    # markdown heading, not a comment inside a fenced
-                    # code example
-                    if not in_fence and re.match(r"^#{1,6} ", line):
-                        heading = line
-                    if "# noqa" in line:
+        md_files.extend(os.path.join(root, f)
+                        for f in sorted(files) if f.endswith(".md"))
+    for path in md_files:
+        heading = ""
+        in_fence = False
+        with open(path, encoding="utf-8") as fh:
+            for i, line in enumerate(fh, 1):
+                if line.lstrip().startswith("```"):
+                    in_fence = not in_fence
+                # markdown heading, not a comment inside a fenced
+                # code example
+                if not in_fence and re.match(r"^#{1,6} ", line):
+                    heading = line
+                if "# noqa" in line:
+                    continue
+                for m in _DOC_DEFAULT_RE.finditer(line):
+                    if defaults is None:
+                        defaults, by_owner = _code_defaults()
+                    name = m.group("name")
+                    doc_val = m.group("value").strip("'\"")
+                    code_vals = defaults.get(name)
+                    if not code_vals:
+                        continue  # not a signature param (knob alias)
+                    # Scope to the owning symbol when the line or the
+                    # nearest heading names one that defines this
+                    # parameter — a claim must not be "confirmed" by
+                    # an unrelated function's coincidentally matching
+                    # default.
+                    owners = [t for t in _TOKEN_RE.findall(
+                                  line + " " + heading)
+                              if t != name and name in
+                              by_owner.get(t, ())]
+                    if owners:
+                        code_vals = set().union(
+                            *(by_owner[o][name] for o in owners))
+                    elif len(code_vals) > 1:
+                        findings.append(Finding(
+                            _rel(path), i, "CHK101",
+                            f"documented default `{name}={doc_val}` "
+                            f"is ambiguous — {len(code_vals)} "
+                            "distinct signature defaults "
+                            f"({', '.join(sorted(code_vals))}) "
+                            "exist; name the owning function/class "
+                            "on the line or heading, or # noqa",
+                            line.strip()))
                         continue
-                    for m in pattern.finditer(line):
-                        if defaults is None:
-                            defaults, by_owner = _code_defaults()
-                        name = m.group("name")
-                        doc_val = m.group("value").strip("'\"")
-                        code_vals = defaults.get(name)
-                        if not code_vals:
-                            continue  # not a signature param (knob alias)
-                        # Scope to the owning symbol when the line or
-                        # the nearest heading names one that defines
-                        # this parameter — a claim must not be
-                        # "confirmed" by an unrelated function's
-                        # coincidentally matching default.
-                        owners = [t for t in token_re.findall(
-                                      line + " " + heading)
-                                  if t != name and name in
-                                  by_owner.get(t, ())]
-                        if owners:
-                            code_vals = set().union(
-                                *(by_owner[o][name] for o in owners))
-                        elif len(code_vals) > 1:
-                            findings.append(
-                                f"{path}:{i}: documented default "
-                                f"`{name}={doc_val}` is ambiguous — "
-                                f"{len(code_vals)} distinct signature "
-                                f"defaults ({', '.join(sorted(code_vals))})"
-                                " exist; name the owning function/class"
-                                " on the line or heading, or # noqa")
-                            continue
-                        normalized = {v.strip("'\"") for v in code_vals}
-                        if doc_val not in normalized:
-                            opts = ", ".join(sorted(code_vals))
-                            findings.append(
-                                f"{path}:{i}: documented default "
-                                f"`{name}={doc_val}` does not match "
-                                f"a signature default of "
-                                f"{'/'.join(owners) or name} ({opts})")
+                    normalized = {v.strip("'\"") for v in code_vals}
+                    if doc_val not in normalized:
+                        opts = ", ".join(sorted(code_vals))
+                        findings.append(Finding(
+                            _rel(path), i, "CHK101",
+                            f"documented default `{name}={doc_val}` "
+                            "does not match a signature default of "
+                            f"{'/'.join(owners) or name} ({opts})",
+                            line.strip()))
 
+
+# -- resilient-fits gate ----------------------------------------------
 
 # Public iterative estimators required to honor the resilience
-# contract: fit() accepts checkpoint_dir, and the module either drives
-# its loop through resilience.run_resilient_loop (which applies the
-# non-finite guard) or delegates by forwarding checkpoint_dir= to
-# another estimator's fit (FastSRM -> reduced-space DetSRM).
+# contract: fit() accepts checkpoint_dir, and the module either
+# drives its loop through resilience.run_resilient_loop (which
+# applies the non-finite guard) or delegates by forwarding
+# checkpoint_dir= to another estimator's fit (FastSRM ->
+# reduced-space DetSRM).
 RESILIENT_FITS = {
     "brainiak_tpu/funcalign/srm.py": ("SRM", "DetSRM"),
     "brainiak_tpu/funcalign/rsrm.py": ("RSRM",),
@@ -242,17 +260,19 @@ RESILIENT_FITS = {
 
 
 def check_resilient_fits(findings):
-    """Static resilience gate: every public iterative ``fit`` must
-    accept ``checkpoint_dir`` and run its loop under the non-finite
-    guard (via ``run_resilient_loop``) or forward the contract to a
-    guarded estimator."""
+    """Static resilience gate (CHK102): every public iterative
+    ``fit`` must accept ``checkpoint_dir`` and run its loop under the
+    non-finite guard (via ``run_resilient_loop``) or forward the
+    contract to a guarded estimator."""
     for relpath, classes in sorted(RESILIENT_FITS.items()):
         path = os.path.join(REPO, *relpath.split("/"))
         try:
             with open(path, encoding="utf-8") as fh:
                 tree = ast.parse(fh.read(), filename=path)
         except (OSError, SyntaxError):
-            findings.append(f"{path}: unparseable (resilience gate)")
+            findings.append(Finding(
+                relpath, 1, "CHK102",
+                "unparseable (resilience gate)"))
             continue
         uses_driver = any(
             (isinstance(n, ast.Name) and n.id == "run_resilient_loop")
@@ -266,10 +286,11 @@ def check_resilient_fits(findings):
             and n.func.attr == "fit"
             for n in ast.walk(tree))
         if not (uses_driver or delegates):
-            findings.append(
-                f"{path}: no run_resilient_loop use (or checkpointed "
-                "fit delegation); iterative fits must run under the "
-                "resilience guard")
+            findings.append(Finding(
+                relpath, 1, "CHK102",
+                "no run_resilient_loop use (or checkpointed fit "
+                "delegation); iterative fits must run under the "
+                "resilience guard"))
         class_fits = {}
         for node in ast.walk(tree):
             if isinstance(node, ast.ClassDef):
@@ -280,72 +301,183 @@ def check_resilient_fits(findings):
         for cls in classes:
             fit = class_fits.get(cls)
             if fit is None:
-                findings.append(
-                    f"{path}: class {cls} defines no fit() "
-                    "(resilience gate)")
+                findings.append(Finding(
+                    relpath, 1, "CHK102",
+                    f"class {cls} defines no fit() "
+                    "(resilience gate)"))
                 continue
-            args = [a.arg for a in (fit.args.posonlyargs + fit.args.args
+            args = [a.arg for a in (fit.args.posonlyargs
+                                    + fit.args.args
                                     + fit.args.kwonlyargs)]
             for required in ("checkpoint_dir", "checkpoint_every"):
                 if required not in args:
-                    findings.append(
-                        f"{path}:{fit.lineno}: {cls}.fit() does not "
-                        f"accept {required}= (resilience contract)")
+                    findings.append(Finding(
+                        relpath, fit.lineno, "CHK102",
+                        f"{cls}.fit() does not accept {required}= "
+                        "(resilience contract)"))
 
+
+# -- external gate ----------------------------------------------------
 
 def run_external(findings):
-    """Run ruff/flake8 + mypy when available (full CI environments)."""
+    """Run ruff/flake8 + mypy when available (full CI hosts).
+
+    Each failing tool contributes one EXT001 finding carrying its
+    output block."""
     ran = []
     if shutil.which("ruff"):
         ran.append("ruff")
         r = subprocess.run(["ruff", "check", REPO],
                            capture_output=True, text=True)
         if r.returncode:
-            findings.append(r.stdout.strip())
+            findings.append(Finding(
+                ".", 1, "EXT001", "ruff: " + r.stdout.strip()))
     elif shutil.which("flake8"):
         ran.append("flake8")
         r = subprocess.run(
             ["flake8", os.path.join(REPO, "brainiak_tpu")],
             capture_output=True, text=True)
         if r.returncode:
-            findings.append(r.stdout.strip())
+            findings.append(Finding(
+                ".", 1, "EXT001", "flake8: " + r.stdout.strip()))
     if shutil.which("mypy"):
         ran.append("mypy")
         r = subprocess.run(
             ["mypy", os.path.join(REPO, "brainiak_tpu")],
             capture_output=True, text=True)
         if r.returncode:
-            findings.append(r.stdout.strip())
+            findings.append(Finding(
+                ".", 1, "EXT001", "mypy: " + r.stdout.strip()))
     return ran
 
 
-def main(argv=None):
+# -- driver -----------------------------------------------------------
+
+def _jaxlint_scope(config):
+    """(include_abs_paths, exclude_prefixes) for the jaxlint gate."""
+    include = [os.path.abspath(p) for p in config.include_paths()]
+    prefixes = tuple(e.rstrip("/") + "/" for e in config.exclude)
+    return include, prefixes
+
+
+def _in_scope(path, include, prefixes):
+    ap = os.path.abspath(path)
+    if not any(ap == base or ap.startswith(base + os.sep)
+               for base in include):
+        return False
+    rel = _rel(path)
+    return not (rel + "/").startswith(prefixes) \
+        and not rel.startswith(prefixes)
+
+
+def run_gates(only=None):
+    """Run the selected gates; returns a result dict.
+
+    ``only``: iterable of gate names (default: all).  One file walk
+    feeds the stdlib and jaxlint file rules; repo-level gates run
+    after.
+    """
+    selected = set(only or GATES)
+    unknown = selected - set(GATES)
+    if unknown:
+        raise SystemExit(
+            f"run_checks: unknown gate(s): {', '.join(sorted(unknown))}"
+            f" (choose from {', '.join(GATES)})")
     findings = []
-    ran = run_external(findings)
-    check_doc_defaults(findings)
-    check_resilient_fits(findings)
+    stale = []
+    ran = []
+    if "external" in selected:
+        ran = run_external(findings)
+
+    config = load_config(REPO, os.path.join(REPO, "pyproject.toml"))
+    std_rules = ([LineLength(), UnusedImports()]
+                 if "stdlib" in selected else [])
+    jax_rules = []
+    baseline = None
+    if "jaxlint" in selected:
+        by_code = {r.code: r for r in JAXLINT_RULES}
+        bad = [c for c in config.select if c not in by_code]
+        if bad:
+            raise SystemExit(
+                "run_checks: unknown jaxlint rule code(s) in "
+                f"[tool.jaxlint] select: {', '.join(bad)} "
+                f"(known: {', '.join(sorted(by_code))})")
+        jax_rules = [by_code[c]() for c in config.select]
+        bl_path = config.baseline_path()
+        if bl_path:
+            baseline = Baseline.load(bl_path)
+    include, prefixes = _jaxlint_scope(config)
+
     n = 0
-    for path in python_sources():
-        n += 1
-        with open(path, encoding="utf-8") as f:
-            lines = f.readlines()
-        noqa = _noqa_lines(lines)
-        source = "".join(lines)
-        check_syntax(path, source, findings)
-        check_line_length(path, lines, noqa, findings)
-        try:
-            tree = ast.parse(source, filename=path)
-        except SyntaxError:
-            continue  # already reported by check_syntax
-        check_unused_imports(path, tree, noqa, findings)
-    label = "+".join(["stdlib"] + ran)
+    if std_rules or jax_rules:
+        for path in python_sources():
+            n += 1
+            rules = list(std_rules)
+            if jax_rules and _in_scope(path, include, prefixes):
+                rules += jax_rules
+            findings.extend(analyze_file(path, REPO, rules))
+
+    if "doc-defaults" in selected:
+        check_doc_defaults(findings)
+    if "resilient-fits" in selected:
+        check_resilient_fits(findings)
+
+    if baseline is not None:
+        findings, stale = baseline.filter(findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    label = "+".join(
+        (["stdlib"] if "stdlib" in selected else []) + ran
+        + [g for g in ("doc-defaults", "resilient-fits", "jaxlint")
+           if g in selected])
+    return {
+        "ok": not findings,
+        "label": label or "none",
+        "files": n,
+        "gates": sorted(selected),
+        "findings": findings,
+        "stale_baseline": stale,
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="run_checks",
+        description="repo static-analysis gates "
+                    "(see docs/static_analysis.md)")
+    parser.add_argument(
+        "--only", metavar="GATE[,GATE...]",
+        help=f"run a subset of gates ({', '.join(GATES)})")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--list", action="store_true",
+                        help="list gate names and exit")
+    args = parser.parse_args(argv)
+    if args.list:
+        for gate in GATES:
+            print(gate)
+        return 0
+    only = ([g.strip() for g in args.only.split(",")]
+            if args.only else None)
+    result = run_gates(only)
+    if args.format == "json":
+        payload = dict(result)
+        payload["findings"] = [f.to_dict()
+                               for f in result["findings"]]
+        print(json.dumps(payload, indent=2))
+        return 0 if result["ok"] else 1
+    findings = result["findings"]
+    for entry in result["stale_baseline"]:
+        print(f"warning: stale jaxlint baseline entry "
+              f"{entry['rule']} {entry['path']}; delete it")
     if findings:
-        print(f"run_checks [{label}]: {len(findings)} finding(s) "
-              f"over {n} files")
+        print(f"run_checks [{result['label']}]: "
+              f"{len(findings)} finding(s) over "
+              f"{result['files']} files")
         for item in findings:
             print(" ", item)
         return 1
-    print(f"run_checks [{label}]: OK ({n} files)")
+    print(f"run_checks [{result['label']}]: OK "
+          f"({result['files']} files)")
     return 0
 
 
