@@ -24,6 +24,15 @@ responsive chip the north-star whole-brain config is attempted first
 V=8192 mid config, then a reduced CPU fallback.  Each chip tier runs in
 its own subprocess under a timeout so a tunnel wedge mid-tier cannot
 hang the driver's bench invocation.
+
+Stage breakdown: every tier runs with :mod:`brainiak_tpu.obs` enabled
+on an in-memory sink — ``bench.data_gen`` / ``bench.warm`` (upload +
+compile) / ``bench.steady`` spans — and the JSON line carries the
+aggregate as ``"stages": {"data_gen_s", "warm_s", "steady_s"}``, so
+``BENCH_*.json`` attributes time instead of reporting one opaque
+number.  The record shape is validated by
+``brainiak_tpu.obs.validate_bench_record`` (tested in
+``tests/obs/test_bench_schema.py``; drift fails CI).
 """
 
 import json
@@ -31,6 +40,9 @@ import math
 import time
 
 import numpy as np
+
+from brainiak_tpu import obs
+from brainiak_tpu.obs.report import BENCH_STAGE_KEYS as STAGE_KEYS
 
 N_VOXELS = 8192
 N_TRS = 150
@@ -81,13 +93,16 @@ def make_data(n_voxels=N_VOXELS, n_trs=N_TRS, n_epochs=N_EPOCHS):
 def tpu_voxels_per_sec(n_voxels=N_VOXELS, unit=512, warm=True):
     from brainiak_tpu.fcma.voxelselector import VoxelSelector
 
-    data, labels = make_data(n_voxels)
-    vs = VoxelSelector(labels, EPOCHS_PER_SUBJ, NUM_FOLDS, data,
-                       voxel_unit=min(unit, n_voxels))
+    with obs.span("bench.data_gen"):
+        data, labels = make_data(n_voxels)
+        vs = VoxelSelector(labels, EPOCHS_PER_SUBJ, NUM_FOLDS, data,
+                           voxel_unit=min(unit, n_voxels))
     if warm:
-        vs.run('svm')  # warm compile caches
+        with obs.span("bench.warm"):
+            vs.run('svm')  # warm compile caches
     t0 = time.perf_counter()
-    results = vs.run('svm')
+    with obs.span("bench.steady"):
+        results = vs.run('svm')
     dt = time.perf_counter() - t0
     assert len(results) == n_voxels
     return n_voxels / dt
@@ -102,13 +117,16 @@ def whole_brain_voxels_per_sec(n_voxels=WB_VOXELS, selected=WB_SELECTED,
     runs) and compile; the timed call is compute-only."""
     from brainiak_tpu.fcma.voxelselector import VoxelSelector
 
-    data, labels = make_data(n_voxels, n_epochs=n_epochs)
-    sel = [m[:, :selected] for m in data]
-    vs = VoxelSelector(labels, EPOCHS_PER_SUBJ, NUM_FOLDS, sel,
-                       raw_data2=data, voxel_unit=selected)
-    vs.run('svm')
+    with obs.span("bench.data_gen"):
+        data, labels = make_data(n_voxels, n_epochs=n_epochs)
+        sel = [m[:, :selected] for m in data]
+        vs = VoxelSelector(labels, EPOCHS_PER_SUBJ, NUM_FOLDS, sel,
+                           raw_data2=data, voxel_unit=selected)
+    with obs.span("bench.warm"):
+        vs.run('svm')
     t0 = time.perf_counter()
-    results = vs.run('svm')
+    with obs.span("bench.steady"):
+        results = vs.run('svm')
     dt = time.perf_counter() - t0
     assert len(results) == selected
     return selected / dt
@@ -266,28 +284,91 @@ def _run_tier_subprocess(tier, timeout):
     return None
 
 
+def _stage_seconds(records):
+    """Aggregate ``bench.*`` span records into the per-stage
+    breakdown dict (missing stages report 0.0 so the emitted schema
+    is stable)."""
+    totals = {}
+    for rec in records:
+        if rec.get("kind") != "span":
+            continue
+        name = rec.get("name", "")
+        if not name.startswith("bench."):
+            continue
+        key = name.split(".", 1)[1] + "_s"
+        totals[key] = totals.get(key, 0.0) + float(rec["dur_s"])
+    return {key: round(totals.get(key, 0.0), 4)
+            for key in STAGE_KEYS}
+
+
+def measure_tier(tier):
+    """Run one tier with obs collecting on an in-memory sink; returns
+    ``{"voxels_per_sec": vps, "stages": {...}}`` (the child-process
+    JSON contract, also used in-process by the CPU fallback and the
+    bench schema test)."""
+    import os
+    import jax  # noqa: F401  (monitoring hook needs jax imported;
+    # plain import does not initialize a backend)
+    obs.install_compile_listener()
+    mem = obs.add_sink(obs.MemorySink())
+    try:
+        if tier == "wb":
+            vps = whole_brain_voxels_per_sec(
+                n_voxels=int(os.environ.get("BENCH_WB_VOXELS",
+                                            WB_VOXELS)),
+                selected=int(os.environ.get("BENCH_WB_SELECTED",
+                                            WB_SELECTED)),
+                n_epochs=_even_epochs_env("BENCH_WB_EPOCHS",
+                                          WB_EPOCHS))
+        elif tier == "mid":
+            vps = tpu_voxels_per_sec(
+                n_voxels=int(os.environ.get("BENCH_MID_VOXELS",
+                                            N_VOXELS)))
+        else:  # reduced CPU fallback
+            vps = tpu_voxels_per_sec(n_voxels=2048, unit=256)
+        # label with the PUBLISHED tier vocabulary (the bench JSON
+        # line's "tier" field), not the internal child-process name
+        obs.gauge("bench_voxels_per_sec", unit="voxels/sec").set(
+            vps, tier={"wb": "whole_brain",
+                       "mid": "mid_V8192"}.get(tier, tier))
+        stages = _stage_seconds(mem.records)
+    finally:
+        obs.remove_sink(mem)
+    return {"voxels_per_sec": vps, "stages": stages}
+
+
+def _result_record(tier, vps, cpu_vps, config=None, stages=None):
+    """The bench JSON line (schema:
+    ``brainiak_tpu.obs.validate_bench_record``)."""
+    metric = "fcma_voxel_selection_voxels_per_sec_chip"
+    if tier == "cpu_fallback":
+        metric += "_CPU_FALLBACK_tpu_unresponsive"
+    rec = {"metric": metric,
+           "value": round(vps, 2),
+           "unit": "voxels/sec",
+           "vs_baseline": round(vps / cpu_vps, 2),
+           "tier": tier}
+    if config:
+        rec["config"] = config
+    if stages:
+        rec["stages"] = stages
+    rec.update(_last_onchip())
+    return rec
+
+
 def _tier_main(tier):
     """Child-process entry: run one tier on the ambient (TPU) backend
-    and print its rate as a JSON line.  Env overrides exist so the
-    orchestration can be smoke-tested at toy sizes on CPU — set
-    ``BENCH_FORCE_CPU=1`` for that (the JAX_PLATFORMS env var alone
-    HANGS once the tunnel PJRT plugin is registered; the platform must
-    be pinned in-process before backend init, docs/performance.md
-    operational rule 4)."""
+    and print its rate (+ stage breakdown) as a JSON line.  Env
+    overrides exist so the orchestration can be smoke-tested at toy
+    sizes on CPU — set ``BENCH_FORCE_CPU=1`` for that (the
+    JAX_PLATFORMS env var alone HANGS once the tunnel PJRT plugin is
+    registered; the platform must be pinned in-process before backend
+    init, docs/performance.md operational rule 4)."""
     import os
     if os.environ.get("BENCH_FORCE_CPU"):
         import jax
         jax.config.update("jax_platforms", "cpu")
-    if tier == "wb":
-        vps = whole_brain_voxels_per_sec(
-            n_voxels=int(os.environ.get("BENCH_WB_VOXELS", WB_VOXELS)),
-            selected=int(os.environ.get("BENCH_WB_SELECTED",
-                                        WB_SELECTED)),
-            n_epochs=_even_epochs_env("BENCH_WB_EPOCHS", WB_EPOCHS))
-    else:
-        vps = tpu_voxels_per_sec(
-            n_voxels=int(os.environ.get("BENCH_MID_VOXELS", N_VOXELS)))
-    print(json.dumps({"voxels_per_sec": vps}))
+    print(json.dumps(measure_tier(tier)))
 
 
 def main():
@@ -325,39 +406,26 @@ def main():
         # and a probe runs before committing the next tier.
         out = _run_tier_subprocess("wb", timeout=1200)
         if out:
-            vps = out["voxels_per_sec"]
             cpu_vps = cpu_voxels_per_sec(n_voxels=wb_voxels, block=8,
                                          n_epochs=wb_epochs)
-            print(json.dumps({
-                "metric": "fcma_voxel_selection_voxels_per_sec_chip",
-                "value": round(vps, 2),
-                "unit": "voxels/sec",
-                "vs_baseline": round(vps / cpu_vps, 2),
-                "tier": "whole_brain",
-                "config": {"n_voxels": wb_voxels,
-                           "selected": wb_selected,
-                           "n_epochs": wb_epochs, "n_trs": N_TRS},
-                **_last_onchip(),
-            }))
+            print(json.dumps(_result_record(
+                "whole_brain", out["voxels_per_sec"], cpu_vps,
+                config={"n_voxels": wb_voxels,
+                        "selected": wb_selected,
+                        "n_epochs": wb_epochs, "n_trs": N_TRS},
+                stages=out.get("stages"))))
             return
         # the wb attempt may have wedged the tunnel — re-probe cheaply
         # before committing the mid tier to the chip
         if _device_responsive(timeout=90):
             out = _run_tier_subprocess("mid", timeout=420)
             if out:
-                vps = out["voxels_per_sec"]
                 cpu_vps = cpu_voxels_per_sec(n_voxels=mid_voxels)
-                print(json.dumps({
-                    "metric": "fcma_voxel_selection_voxels_per_sec"
-                              "_chip",
-                    "value": round(vps, 2),
-                    "unit": "voxels/sec",
-                    "vs_baseline": round(vps / cpu_vps, 2),
-                    "tier": "mid_V8192",
-                    "config": {"n_voxels": mid_voxels,
-                               "n_epochs": N_EPOCHS, "n_trs": N_TRS},
-                    **_last_onchip(),
-                }))
+                print(json.dumps(_result_record(
+                    "mid_V8192", out["voxels_per_sec"], cpu_vps,
+                    config={"n_voxels": mid_voxels,
+                            "n_epochs": N_EPOCHS, "n_trs": N_TRS},
+                    stages=out.get("stages"))))
                 return
 
     # fall back to CPU so the driver records a number instead of a
@@ -365,17 +433,11 @@ def main():
     # minutes on CPU)
     import jax
     jax.config.update("jax_platforms", "cpu")
-    vps = tpu_voxels_per_sec(n_voxels=2048, unit=256)
+    out = measure_tier("cpu_fallback")
     cpu_vps = cpu_voxels_per_sec(n_voxels=2048, block=32)
-    print(json.dumps({
-        "metric": "fcma_voxel_selection_voxels_per_sec_chip"
-                  "_CPU_FALLBACK_tpu_unresponsive",
-        "value": round(vps, 2),
-        "unit": "voxels/sec",
-        "vs_baseline": round(vps / cpu_vps, 2),
-        "tier": "cpu_fallback",
-        **_last_onchip(),
-    }))
+    print(json.dumps(_result_record(
+        "cpu_fallback", out["voxels_per_sec"], cpu_vps,
+        stages=out["stages"])))
 
 
 if __name__ == "__main__":

@@ -37,7 +37,17 @@ _KEY_MGMT = {"split", "fold_in", "PRNGKey", "key", "key_data",
              "wrap_key_data", "clone"}
 
 _CACHE_DECOS = {"functools.lru_cache", "functools.cache",
-                "lru_cache", "cache"}
+                "lru_cache", "cache",
+                # the obs retrace-counting lru_cache wrapper
+                # (brainiak_tpu.obs.runtime.counted_cache) — resolved
+                # under its common import spellings, incl. the
+                # package-level re-export (brainiak_tpu.obs.*); asname
+                # aliases canonicalize through ctx.resolve already
+                "counted_cache", "obs.runtime.counted_cache",
+                "brainiak_tpu.obs.runtime.counted_cache",
+                "obs.counted_cache", "brainiak_tpu.obs.counted_cache",
+                "obs_runtime.counted_cache",
+                "runtime.counted_cache"}
 
 
 def _loop_ancestor(ctx, node):

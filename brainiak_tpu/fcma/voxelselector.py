@@ -17,12 +17,14 @@ disappears because TPU chips are homogeneous.
 """
 
 import logging
-from functools import lru_cache, partial
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import runtime as obs_runtime
+from ..obs import spans as obs_spans
 from ..ops.correlation import resolve_precision
 from ..ops.fisherz import within_subject_normalization
 from ..ops.svm import svm_cv_accuracy
@@ -52,14 +54,15 @@ def _gram_and_shrink(corr, precision=None):
     return _shrink(kernels)
 
 
-@lru_cache(maxsize=None)
+@obs_runtime.counted_cache("fcma.sharded_gram")
 def _sharded_gram_program(mesh, epochs_per_subj, interpret,
                           precision):
     """Mesh-sharded Pallas Gram program, built once per
     (mesh, config).  GSPMD cannot partition a pallas_call, so the
     Gram kernel runs per shard under shard_map; jit caches on
     function identity, so constructing the shard_map closure inside
-    ``run()`` would rebuild (and retrace) it on every call.
+    ``run()`` would rebuild (and retrace) it on every call.  Cache
+    misses count as ``retrace_total{site=fcma.sharded_gram}``.
     """
     from jax import shard_map
     return jax.jit(shard_map(
@@ -284,7 +287,20 @@ class VoxelSelector:
             estimator runs host cross-validation per voxel (parity path —
             SVC(kernel='precomputed') gets the Gram matrices, anything else
             gets raw correlation vectors).
+
+        With :mod:`brainiak_tpu.obs` enabled the selection runs under a
+        ``fcma.voxel_selection`` span with one ``fcma.block`` span per
+        voxel block and a ``fcma.svm_cv`` span around the batched SMO
+        solve; disabled (default) the spans are no-ops and introduce no
+        host syncs — block dispatch stays fully asynchronous.
         """
+        clf_label = clf if isinstance(clf, str) else type(clf).__name__
+        with obs_spans.span("fcma.voxel_selection",
+                            attrs={"clf": clf_label,
+                                   "n_voxels": self.num_voxels}):
+            return self._run(clf)
+
+    def _run(self, clf):
         data1, data2 = self._stack()
         n_shards = 1
         if self.mesh is not None:
@@ -315,65 +331,77 @@ class VoxelSelector:
 
         block_accs = []
         for start in range(0, self.num_voxels, block):
-            cur = min(block, self.num_voxels - start)
-            pad_start = min(start, self.num_voxels - block) \
-                if self.num_voxels >= block else 0
-            offset = start - pad_start
-            blk = self._slice_block(data1, pad_start, block)
-            if self.use_pallas and on_device_svm:
-                # Gram-only fusion: the [block, E, V] tensor never
-                # round-trips through HBM
-                if sharded_gram is not None:
-                    kernels = sharded_gram(blk, data2)
-                else:
-                    kernels = _block_gram_pallas(
+            # per-chunk span: times ENQUEUE, not compute — no sync
+            # target on purpose, so observed runs keep the async block
+            # pipeline (the compute lands in fcma.svm_cv, whose fetch
+            # synchronizes); a no-op while obs is disabled
+            with obs_spans.span("fcma.block",
+                                attrs={"start": start}):
+                cur = min(block, self.num_voxels - start)
+                pad_start = min(start, self.num_voxels - block) \
+                    if self.num_voxels >= block else 0
+                offset = start - pad_start
+                blk = self._slice_block(data1, pad_start, block)
+                if self.use_pallas and on_device_svm:
+                    # Gram-only fusion: the [block, E, V] tensor never
+                    # round-trips through HBM
+                    if sharded_gram is not None:
+                        kernels = sharded_gram(blk, data2)
+                    else:
+                        kernels = _block_gram_pallas(
+                            blk, data2, self.epochs_per_subj,
+                            interpret=jax.default_backend() != 'tpu',
+                            precision=self.precision)
+                    corr = None
+                elif on_device_svm:
+                    kernels = _block_gram_xla(
+                        blk, data2, self.epochs_per_subj,
+                        precision=self.precision)
+                    corr = None
+                elif self.use_pallas and self.mesh is None:
+                    kernels, corr = _block_kernel_matrices_pallas(
                         blk, data2, self.epochs_per_subj,
                         interpret=jax.default_backend() != 'tpu',
                         precision=self.precision)
-                corr = None
-            elif on_device_svm:
-                kernels = _block_gram_xla(
-                    blk, data2, self.epochs_per_subj,
-                    precision=self.precision)
-                corr = None
-            elif self.use_pallas and self.mesh is None:
-                kernels, corr = _block_kernel_matrices_pallas(
-                    blk, data2, self.epochs_per_subj,
-                    interpret=jax.default_backend() != 'tpu',
-                    precision=self.precision)
-            else:
-                # host-CV path (and any mesh-sharded non-svm path: a
-                # sharded block cannot feed a plain-jitted pallas_call,
-                # so use the partitionable XLA program)
-                kernels, corr = _block_kernel_matrices(
-                    blk, data2, self.epochs_per_subj,
-                    precision=self.precision)
-            kernels = kernels[offset:offset + cur]
-            if corr is not None:
-                corr = corr[offset:offset + cur]
-            if on_device_svm:
-                # defer CV: collect the tiny [cur, E, E] Grams on device
-                # (blocks queue with no host sync) and solve ALL voxels'
-                # SVM duals in ONE batched SMO program after the loop —
-                # each SMO step is latency-bound, not FLOP-bound, so a
-                # 16x-larger problem batch costs nearly the same wall
-                # time as one block's
-                block_accs.append((start, cur, kernels))
-            else:
-                accs = self._host_cv(clf, np.asarray(kernels),
-                                     np.asarray(corr))
-                block_accs.append((start, cur, np.asarray(accs)))
+                else:
+                    # host-CV path (and any mesh-sharded non-svm path:
+                    # a sharded block cannot feed a plain-jitted
+                    # pallas_call, so use the partitionable XLA
+                    # program)
+                    kernels, corr = _block_kernel_matrices(
+                        blk, data2, self.epochs_per_subj,
+                        precision=self.precision)
+                kernels = kernels[offset:offset + cur]
+                if corr is not None:
+                    corr = corr[offset:offset + cur]
+                if on_device_svm:
+                    # defer CV: collect the tiny [cur, E, E] Grams on
+                    # device (blocks queue with no host sync) and solve
+                    # ALL voxels' SVM duals in ONE batched SMO program
+                    # after the loop — each SMO step is latency-bound,
+                    # not FLOP-bound, so a 16x-larger problem batch
+                    # costs nearly the same wall time as one block's
+                    block_accs.append((start, cur, kernels))
+                else:
+                    accs = self._host_cv(clf, np.asarray(kernels),
+                                         np.asarray(corr))
+                    block_accs.append((start, cur, np.asarray(accs)))
 
         results = []
         if block_accs and on_device_svm:
-            all_kernels = jnp.concatenate([k for _, _, k in block_accs])
-            # svm_cv_accuracy fetches replicated: in a multi-process
-            # run every process gets the full per-voxel scores (the
-            # analog of the reference's MPI score gather,
-            # voxelselector.py:208-238)
-            all_accs, gaps = svm_cv_accuracy(
-                all_kernels, self.labels, self.num_folds, C=self.svm_C,
-                n_iters=self.svm_iters, return_gap=True)
+            with obs_spans.span("fcma.svm_cv") as _svm_span:
+                all_kernels = jnp.concatenate(
+                    [k for _, _, k in block_accs])
+                # svm_cv_accuracy fetches replicated: in a
+                # multi-process run every process gets the full
+                # per-voxel scores (the analog of the reference's MPI
+                # score gather, voxelselector.py:208-238) — the fetch
+                # synchronizes, so the span needs no explicit sync
+                all_accs, gaps = svm_cv_accuracy(
+                    all_kernels, self.labels, self.num_folds,
+                    C=self.svm_C, n_iters=self.svm_iters,
+                    return_gap=True)
+                _svm_span.set("n_voxels", int(all_kernels.shape[0]))
             worst = float(np.max(gaps))
             if worst > 0.05:
                 # Not libsvm's 1e-3 optimizer tolerance: measured on a

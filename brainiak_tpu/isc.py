@@ -19,7 +19,7 @@ accidentally censor a genuine ISC of exactly 1.0.
 
 import logging
 import math
-from functools import lru_cache, partial
+from functools import partial
 from itertools import permutations, product
 
 import jax
@@ -28,6 +28,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 from scipy.spatial.distance import squareform
 
+from .obs import runtime as obs_runtime
+from .obs import spans as obs_spans
 from .parallel.mesh import (DEFAULT_VOXEL_AXIS, fetch_replicated,
                             place_on_mesh)
 from .utils.utils import _check_timeseries_input, p_from_null
@@ -155,12 +157,13 @@ def _shard_voxels(arr, mesh, axis):
     return place_on_mesh(arr, NamedSharding(mesh, PartitionSpec(*spec)))
 
 
-@lru_cache(maxsize=None)
+@obs_runtime.counted_cache("isc.slab")
 def _slab_program(mesh, chunk):
     """Replicated row-slab fetch, cached per (mesh, chunk): jit
     caches on function identity, so a fresh lambda per
     ``_fetch_ring_matrix`` call would re-lower the broadcast on
-    every fetch (jaxlint JX001)."""
+    every fetch (jaxlint JX001).  Cache misses count as
+    ``retrace_total{site=isc.slab}``."""
     return jax.jit(
         lambda a, i: jax.lax.dynamic_slice_in_dim(a, i, chunk, 0),
         out_shardings=NamedSharding(mesh, PartitionSpec()))
@@ -186,8 +189,13 @@ def _fetch_ring_matrix(m, mesh):
     slab = _slab_program(mesh, chunk)
     out = np.empty(m.shape, dtype=m.dtype)
     for i in range(n_shards):
-        out[i * chunk:(i + 1) * chunk] = np.asarray(
-            slab(m, jnp.asarray(i * chunk)))
+        # per-chunk span (no-op while obs is disabled); the
+        # np.asarray fetch below synchronizes, so the span needs no
+        # explicit sync target and adds none
+        with obs_spans.span("isc.ring_slab",
+                            attrs={"shard": i, "rows": chunk}):
+            out[i * chunk:(i + 1) * chunk] = np.asarray(
+                slab(m, jnp.asarray(i * chunk)))
     return out
 
 

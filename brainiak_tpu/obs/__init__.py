@@ -1,0 +1,104 @@
+"""brainiak_tpu.obs: structured tracing, metrics, and telemetry.
+
+The framework's observability layer (PR 3), closing the loop between
+PR 1's resilience events and PR 2's retrace lint:
+
+- :mod:`~brainiak_tpu.obs.spans` — hierarchical trace spans (context
+  manager + decorator) with async-dispatch-aware stop;
+- :mod:`~brainiak_tpu.obs.metrics` — typed counter/gauge/histogram
+  registry with labels (``fit_steps_total{estimator=SRM}``,
+  ``retrace_total{site=...}``, ``checkpoint_seconds``, ...);
+- :mod:`~brainiak_tpu.obs.runtime` — JAX-level collectors
+  (``counted_cache`` retrace hooks on the jitted-program builders,
+  device memory snapshots, mesh/topology capture);
+- :mod:`~brainiak_tpu.obs.sink` — schema-versioned record dispatch:
+  per-host JSON-lines files (env ``BRAINIAK_TPU_OBS_DIR``) and an
+  in-memory sink for tests;
+- :mod:`~brainiak_tpu.obs.report` — ``python -m brainiak_tpu.obs
+  report`` aggregates JSONL into per-stage/per-estimator summaries.
+
+Disabled by default: with no sink configured every instrumentation
+site is a no-op (no records, no ``block_until_ready`` host syncs).
+See docs/observability.md.
+
+The deprecated ``brainiak_tpu.utils.profiling`` names
+(:func:`stage_timer` / :func:`stage_times` /
+:func:`reset_stage_times` / :func:`device_trace`) are re-exported
+here by their new home.
+"""
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    collect,
+    counter,
+    default_registry,
+    gauge,
+    histogram,
+)
+from .report import validate_bench_record  # noqa: F401
+from .runtime import (  # noqa: F401
+    counted_cache,
+    device_memory_snapshot,
+    device_trace,
+    install_compile_listener,
+    topology_event,
+)
+from .sink import (  # noqa: F401
+    OBS_DIR_ENV,
+    SCHEMA_VERSION,
+    JsonlSink,
+    MemorySink,
+    add_sink,
+    emit,
+    enabled,
+    event,
+    make_record,
+    remove_sink,
+    validate_record,
+)
+from .spans import (  # noqa: F401
+    current_span,
+    reset_stage_times,
+    span,
+    stage_timer,
+    stage_times,
+    traced,
+)
+
+__all__ = [
+    "OBS_DIR_ENV",
+    "SCHEMA_VERSION",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "MemorySink",
+    "MetricsRegistry",
+    "add_sink",
+    "collect",
+    "counted_cache",
+    "counter",
+    "current_span",
+    "default_registry",
+    "device_memory_snapshot",
+    "device_trace",
+    "emit",
+    "enabled",
+    "event",
+    "gauge",
+    "histogram",
+    "install_compile_listener",
+    "make_record",
+    "remove_sink",
+    "reset_stage_times",
+    "span",
+    "stage_timer",
+    "stage_times",
+    "topology_event",
+    "traced",
+    "validate_bench_record",
+    "validate_record",
+]

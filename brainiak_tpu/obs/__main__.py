@@ -1,0 +1,8 @@
+"""``python -m brainiak_tpu.obs`` — the obs CLI (report command)."""
+
+import sys
+
+from .report import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,284 @@
+"""Aggregate obs JSONL traces into per-stage / per-estimator summaries.
+
+``python -m brainiak_tpu.obs report [PATH ...]`` reads one or more
+JSONL files (or directories of ``*.jsonl``; default: the directory in
+``BRAINIAK_TPU_OBS_DIR``), validates every record against the obs
+schema (:func:`brainiak_tpu.obs.sink.validate_record` — any violation
+fails the run, which is what the ``obs`` gate of
+``tools/run_checks.py`` relies on), and renders:
+
+- **spans** grouped by path (and ``estimator`` attr when present):
+  count / total / mean / max seconds;
+- **events** grouped by name: count;
+- **metrics** aggregated by (name, labels): counters sum their
+  increments, gauges keep the last set value, histograms summarize
+  count/sum/min/max/mean.
+
+``--format=json`` prints the same structure as one JSON document.
+This module imports neither jax nor numpy — reports run anywhere.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from .sink import OBS_DIR_ENV, validate_record
+
+__all__ = ["aggregate", "iter_jsonl_paths", "load_records", "main",
+           "render_text", "validate_bench_record"]
+
+#: Keys a bench.py result record must carry (satellite: BENCH_*.json
+#: drift fails CI instead of confusing the next round).
+BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline", "tier")
+BENCH_STAGE_KEYS = ("data_gen_s", "warm_s", "steady_s")
+
+
+def validate_bench_record(rec):
+    """Schema check for the bench JSON line; returns error strings.
+
+    Requires the headline keys (metric/value/unit/vs_baseline/tier)
+    and, when present, a ``stages`` dict holding the per-stage time
+    breakdown (data-gen / compile+warm / steady-state seconds).
+    """
+    errors = []
+    if not isinstance(rec, dict):
+        return ["bench record is not an object"]
+    for key in BENCH_REQUIRED:
+        if key not in rec:
+            errors.append(f"missing key {key!r}")
+    if "metric" in rec and not isinstance(rec["metric"], str):
+        errors.append("metric is not a string")
+    for key in ("value", "vs_baseline"):
+        if key in rec and (not isinstance(rec[key], (int, float))
+                           or isinstance(rec[key], bool)):
+            errors.append(f"{key} is not a number")
+    if "unit" in rec and not isinstance(rec["unit"], str):
+        errors.append("unit is not a string")
+    if "tier" in rec and not isinstance(rec["tier"], str):
+        errors.append("tier is not a string")
+    stages = rec.get("stages")
+    if stages is not None:
+        if not isinstance(stages, dict):
+            errors.append("stages is not an object")
+        else:
+            for key in BENCH_STAGE_KEYS:
+                val = stages.get(key)
+                if not isinstance(val, (int, float)) \
+                        or isinstance(val, bool):
+                    errors.append(
+                        f"stages.{key}={val!r} (expected a number)")
+    return errors
+
+
+def iter_jsonl_paths(paths):
+    """Expand files/directories into a sorted list of .jsonl files."""
+    out = []
+    for path in paths:
+        if os.path.isdir(path):
+            out.extend(sorted(
+                glob.glob(os.path.join(path, "*.jsonl"))))
+        else:
+            out.append(path)
+    return out
+
+
+def load_records(paths):
+    """Parse + validate records; returns ``(records, errors)`` where
+    errors are ``"file:line: problem"`` strings."""
+    records = []
+    errors = []
+    for path in iter_jsonl_paths(paths):
+        try:
+            fh = open(path, encoding="utf-8")
+        except OSError as exc:
+            errors.append(f"{path}: unreadable ({exc})")
+            continue
+        with fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as exc:
+                    errors.append(f"{path}:{lineno}: bad JSON ({exc})")
+                    continue
+                bad = validate_record(rec)
+                if bad:
+                    errors.append(
+                        f"{path}:{lineno}: {'; '.join(bad)}")
+                    continue
+                records.append(rec)
+    return records, errors
+
+
+def _labels_id(labels):
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+
+def aggregate(records):
+    """Summary dict over validated records (see module docstring)."""
+    spans = {}
+    events = {}
+    metrics = {}
+    for rec in records:
+        kind = rec["kind"]
+        if kind == "span":
+            attrs = rec.get("attrs") or {}
+            key = (rec["path"], str(attrs.get("estimator", "")))
+            cur = spans.setdefault(
+                key, {"path": key[0], "estimator": key[1] or None,
+                      "count": 0, "total_s": 0.0, "max_s": 0.0})
+            cur["count"] += 1
+            cur["total_s"] += float(rec["dur_s"])
+            cur["max_s"] = max(cur["max_s"], float(rec["dur_s"]))
+        elif kind == "event":
+            events[rec["name"]] = events.get(rec["name"], 0) + 1
+        else:  # metric
+            labels = rec.get("labels") or {}
+            key = (rec["name"], rec["mtype"], _labels_id(labels))
+            cur = metrics.get(key)
+            if cur is None:
+                cur = metrics[key] = {
+                    "name": rec["name"], "mtype": rec["mtype"],
+                    "labels": labels, "unit": rec.get("unit"),
+                    "count": 0, "sum": 0.0, "min": None,
+                    "max": None, "last": None, "_last_ts": None}
+            value = float(rec["value"])
+            cur["count"] += 1
+            cur["sum"] += value
+            cur["min"] = value if cur["min"] is None \
+                else min(cur["min"], value)
+            cur["max"] = value if cur["max"] is None \
+                else max(cur["max"], value)
+            # "last" is by record timestamp, not file-read order —
+            # multi-rank traces are read in filename order, which is
+            # unrelated to wall time
+            ts = float(rec["ts"])
+            if cur["_last_ts"] is None or ts >= cur["_last_ts"]:
+                cur["last"] = value
+                cur["_last_ts"] = ts
+    span_rows = []
+    for cur in spans.values():
+        cur["mean_s"] = cur["total_s"] / cur["count"]
+        span_rows.append(cur)
+    span_rows.sort(key=lambda r: -r["total_s"])
+    metric_rows = []
+    for cur in metrics.values():
+        del cur["_last_ts"]
+        if cur["mtype"] == "counter":
+            cur["value"] = cur["sum"]
+        elif cur["mtype"] == "gauge":
+            cur["value"] = cur["last"]
+        else:
+            cur["value"] = {"count": cur["count"], "sum": cur["sum"],
+                            "min": cur["min"], "max": cur["max"],
+                            "mean": cur["sum"] / cur["count"]}
+        metric_rows.append(cur)
+    metric_rows.sort(key=lambda r: (r["name"],
+                                    _labels_id(r["labels"])))
+    return {
+        "n_records": len(records),
+        "spans": span_rows,
+        "events": [{"name": name, "count": count}
+                   for name, count in sorted(events.items())],
+        "metrics": metric_rows,
+    }
+
+
+def _fmt_s(value):
+    return f"{value:9.4f}"
+
+
+def render_text(summary):
+    """Human-readable tables for the aggregate summary."""
+    lines = [f"records: {summary['n_records']}"]
+    if summary["spans"]:
+        lines.append("")
+        lines.append("spans (by path):")
+        lines.append(f"  {'count':>6} {'total_s':>9} {'mean_s':>9} "
+                     f"{'max_s':>9}  path")
+        for row in summary["spans"]:
+            label = row["path"]
+            if row["estimator"]:
+                label += f"  [{row['estimator']}]"
+            lines.append(
+                f"  {row['count']:>6} {_fmt_s(row['total_s'])} "
+                f"{_fmt_s(row['mean_s'])} {_fmt_s(row['max_s'])}  "
+                f"{label}")
+    if summary["events"]:
+        lines.append("")
+        lines.append("events:")
+        for row in summary["events"]:
+            lines.append(f"  {row['count']:>6}  {row['name']}")
+    if summary["metrics"]:
+        lines.append("")
+        lines.append("metrics:")
+        for row in summary["metrics"]:
+            label = row["name"]
+            if row["labels"]:
+                label += "{" + _labels_id(row["labels"]) + "}"
+            value = row["value"]
+            if isinstance(value, dict):
+                value = (f"count={value['count']} "
+                         f"sum={value['sum']:.4g} "
+                         f"mean={value['mean']:.4g} "
+                         f"min={value['min']:.4g} "
+                         f"max={value['max']:.4g}")
+            else:
+                value = f"{value:.6g}"
+            unit = f" {row['unit']}" if row["unit"] else ""
+            lines.append(f"  {label} = {value}{unit} "
+                         f"[{row['mtype']}]")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m brainiak_tpu.obs",
+        description="obs trace tools (docs/observability.md)")
+    sub = parser.add_subparsers(dest="command", required=True)
+    rep = sub.add_parser(
+        "report", help="aggregate JSONL traces into a summary")
+    rep.add_argument(
+        "paths", nargs="*", metavar="PATH",
+        help="JSONL files or directories of *.jsonl "
+             f"(default: ${OBS_DIR_ENV})")
+    rep.add_argument("--format", choices=("text", "json"),
+                     default="text")
+    args = parser.parse_args(argv)
+
+    paths = args.paths
+    if not paths:
+        env_dir = os.environ.get(OBS_DIR_ENV)
+        if not env_dir:
+            parser.error(
+                f"no PATH given and ${OBS_DIR_ENV} is not set")
+        paths = [env_dir]
+    files = iter_jsonl_paths(paths)
+    if not files:
+        print(f"obs report: no .jsonl files under {paths}",
+              file=sys.stderr)
+        return 1
+    # pass the expanded file list (not `paths`): one glob, and the
+    # emptiness check above cannot disagree with what gets loaded
+    records, errors = load_records(files)
+    for err in errors:
+        print(f"obs report: schema violation: {err}",
+              file=sys.stderr)
+    summary = aggregate(records)
+    if args.format == "json":
+        summary["schema_errors"] = errors
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render_text(summary))
+        if errors:
+            print(f"obs report: {len(errors)} schema violation(s)",
+                  file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - module smoke entry
+    sys.exit(main())

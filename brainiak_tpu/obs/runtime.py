@@ -1,0 +1,191 @@
+"""JAX-level collectors: retrace counting, device memory, topology.
+
+Nothing here imports jax at module scope, and nothing initializes a
+backend that the calling code has not already initialized — on a
+wedged TPU tunnel even backend init hangs, and telemetry must never
+be the first thing to touch the device (docs/performance.md
+operational rules).
+
+- :func:`counted_cache` — ``functools.lru_cache`` with a cache-miss
+  hook: wraps the repo's jitted-program *builders* (the lru-cached
+  functions that construct jit/shard_map programs per mesh/shape key)
+  so every cache miss — i.e. every fresh trace+compile of that
+  program — increments ``retrace_total{site=...}``.  jaxlint's JX001
+  recognizes it as a caching decorator.
+- :func:`device_memory_snapshot` — per-device ``memory_stats()``
+  gauges plus one ``device_memory`` event.
+- :func:`topology_event` — backend/process/device (and optionally
+  mesh axes) capture, emitted by ``parallel.mesh.make_mesh`` for
+  every mesh a run builds.
+- :func:`install_compile_listener` — best-effort ``jax.monitoring``
+  hook recording XLA compile durations into ``jax_compile_seconds``.
+- :func:`device_trace` — ``jax.profiler`` wrapper (TensorBoard
+  traces), moved here from ``utils.profiling``.
+"""
+
+import contextlib
+import functools
+import sys
+
+from . import metrics, sink
+
+__all__ = [
+    "counted_cache",
+    "device_memory_snapshot",
+    "device_trace",
+    "install_compile_listener",
+    "topology_event",
+]
+
+
+def counted_cache(site, maxsize=None):
+    """An ``lru_cache`` whose misses count as retraces.
+
+    Use on jitted-program builders: a miss means the builder ran,
+    which means a fresh trace + XLA compile for a new (mesh, shape,
+    config) key.  The count surfaces as ``retrace_total{site=...}``;
+    an unexpectedly growing site is the runtime confirmation of the
+    static retrace hazards jaxlint JX001 hunts for.
+
+    The wrapper keeps ``cache_info``/``cache_clear`` so call sites
+    and tests can inspect and reset it like a plain ``lru_cache``.
+    """
+
+    def decorate(fn):
+        cached = functools.lru_cache(maxsize=maxsize)(fn)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            # telemetry only: a racing concurrent miss may be
+            # attributed once; the lru_cache itself stays exact
+            misses = cached.cache_info().misses
+            out = cached(*args, **kwargs)
+            if cached.cache_info().misses > misses:
+                metrics.counter(
+                    "retrace_total",
+                    help="program-builder cache misses "
+                         "(fresh trace+compile)").inc(site=site)
+            return out
+
+        wrapper.cache_info = cached.cache_info
+        wrapper.cache_clear = cached.cache_clear
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    return decorate
+
+
+def _jax():
+    """The already-imported jax module, or None — never import it."""
+    return sys.modules.get("jax")
+
+
+def device_memory_snapshot(emit=True):
+    """Per-device memory stats as a list of dicts.
+
+    Sets ``device_bytes_in_use{device=...}`` gauges and (when ``emit``)
+    an aggregate ``device_memory`` event.  Returns ``[]`` when jax is
+    not imported or the backend exposes no ``memory_stats`` (CPU).
+    """
+    jax = _jax()
+    if jax is None:
+        return []
+    out = []
+    for dev in jax.devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        entry = {"device": dev.id, "platform": dev.platform}
+        for key in ("bytes_in_use", "peak_bytes_in_use",
+                    "bytes_limit"):
+            if key in stats:
+                entry[key] = int(stats[key])
+        out.append(entry)
+        if "bytes_in_use" in entry:
+            metrics.gauge(
+                "device_bytes_in_use", unit="bytes").set(
+                    entry["bytes_in_use"], device=str(dev.id))
+    if emit and out and sink.enabled():
+        sink.emit(sink.make_record(
+            "event", "device_memory", attrs={"devices": out}))
+    return out
+
+
+def topology_event(mesh=None):
+    """Emit a ``topology`` event (backend, processes, devices, mesh
+    axes) and return its attrs; None when obs is disabled or jax is
+    not imported."""
+    if not sink.enabled():
+        return None
+    jax = _jax()
+    if jax is None:
+        return None
+    try:
+        attrs = {"backend": jax.default_backend(),
+                 "process_index": int(jax.process_index()),
+                 "process_count": int(jax.process_count()),
+                 "device_count": int(jax.device_count()),
+                 "local_device_count":
+                     int(jax.local_device_count())}
+    except Exception:  # backend init failed mid-flight
+        return None
+    if mesh is not None:
+        attrs["mesh_axes"] = {str(name): int(size) for name, size
+                              in zip(mesh.axis_names,
+                                     mesh.devices.shape)}
+    sink.emit(sink.make_record("event", "topology", attrs=attrs))
+    return attrs
+
+
+_compile_listener_installed = False
+
+
+def install_compile_listener():
+    """Record XLA compile durations via ``jax.monitoring`` (if this
+    jax version exposes duration listeners).  Observations land in the
+    ``jax_compile_seconds`` histogram labeled by the monitoring event
+    name.  Returns True when installed (idempotent)."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return True
+    jax = _jax()
+    if jax is None:
+        return False
+    try:
+        from jax import monitoring
+        register = monitoring.register_event_duration_secs_listener
+    except (ImportError, AttributeError):
+        return False
+
+    def _listen(event, duration, **kwargs):
+        if "compil" not in event:
+            return
+        try:
+            metrics.histogram(
+                "jax_compile_seconds", unit="s").observe(
+                    float(duration), event=event)
+        except Exception:  # telemetry must never break compilation
+            pass
+
+    try:
+        register(_listen)
+    except Exception:
+        return False
+    _compile_listener_installed = True
+    return True
+
+
+@contextlib.contextmanager
+def device_trace(log_dir):
+    """Capture a jax.profiler trace (TensorBoard-viewable) around a
+    block of device work."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,397 @@
+"""Observability sinks: where span/event/metric records go.
+
+Records are flat JSON-serializable dicts with a versioned schema
+(:data:`SCHEMA_VERSION`, :func:`validate_record`).  Two sinks exist:
+
+- :class:`JsonlSink` — one JSON-lines file per host process
+  (``obs-<rank>.jsonl``), append-mode, flushed on every write batch
+  and closed atexit.  Activated automatically when the
+  ``BRAINIAK_TPU_OBS_DIR`` environment variable names a directory.
+- :class:`MemorySink` — an in-process record list for tests and for
+  :mod:`bench`'s stage breakdown.
+
+The module-level dispatch (:func:`emit`) fans a record out to every
+active sink.  **Disabled is the default**: with no sink registered and
+no ``BRAINIAK_TPU_OBS_DIR``, :func:`enabled` is False and every
+instrumentation site in the framework short-circuits to a no-op —
+in particular no ``block_until_ready`` host syncs are introduced in
+instrumented hot loops (acceptance-tested in
+``tests/obs/test_integration.py`` and linted by jaxlint JX002).
+"""
+
+import atexit
+import io
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "OBS_DIR_ENV",
+    "OBS_RANK_ENV",
+    "SCHEMA_VERSION",
+    "JsonlSink",
+    "MemorySink",
+    "add_sink",
+    "all_sinks",
+    "close_all",
+    "emit",
+    "enabled",
+    "event",
+    "make_record",
+    "process_rank",
+    "remove_sink",
+    "validate_record",
+]
+
+OBS_DIR_ENV = "BRAINIAK_TPU_OBS_DIR"
+OBS_RANK_ENV = "BRAINIAK_TPU_OBS_RANK"
+
+#: Version stamped into every record as ``"v"``.  Bump on any
+#: backwards-incompatible change to the keys below; the report CLI and
+#: the ``obs`` gate of ``tools/run_checks.py`` reject records whose
+#: version or shape they do not understand.
+SCHEMA_VERSION = 1
+
+KINDS = ("span", "event", "metric")
+METRIC_TYPES = ("counter", "gauge", "histogram")
+
+# backend-derived process rank, cached once resolvable (see
+# process_rank: a process's rank never changes after distributed init)
+_cached_rank = None
+
+_REQUIRED = {
+    "span": {"dur_s": (int, float), "path": str},
+    "event": {},
+    "metric": {"mtype": str, "value": (int, float)},
+}
+_OPTIONAL = {
+    "span": {"attrs": dict},
+    "event": {"attrs": dict},
+    "metric": {"labels": dict, "unit": str},
+}
+
+
+def validate_record(rec):
+    """Return a list of schema-violation strings (empty = valid).
+
+    Checked: the common envelope (``v``/``kind``/``ts``/``rank``/
+    ``name``), kind-specific required keys with their types, optional
+    keys with their types, and that no unknown keys are present.
+    """
+    errors = []
+    if not isinstance(rec, dict):
+        return ["record is not an object"]
+    v = rec.get("v")
+    if v != SCHEMA_VERSION:
+        errors.append(f"v={v!r} (expected {SCHEMA_VERSION})")
+    kind = rec.get("kind")
+    if kind not in KINDS:
+        errors.append(f"kind={kind!r} (expected one of {KINDS})")
+        return errors
+    if not isinstance(rec.get("ts"), (int, float)):
+        errors.append("ts missing or not a number")
+    if not isinstance(rec.get("rank"), int):
+        errors.append("rank missing or not an int")
+    if not isinstance(rec.get("name"), str) or not rec.get("name"):
+        errors.append("name missing or empty")
+    required = _REQUIRED[kind]
+    optional = _OPTIONAL[kind]
+    for key, typ in required.items():
+        val = rec.get(key)
+        if not isinstance(val, typ) or isinstance(val, bool):
+            errors.append(f"{kind}.{key}={val!r} (expected {typ})")
+    for key, typ in optional.items():
+        if key in rec and not isinstance(rec[key], typ):
+            errors.append(
+                f"{kind}.{key}={rec[key]!r} (expected {typ})")
+    if kind == "metric" and rec.get("mtype") not in METRIC_TYPES:
+        errors.append(f"metric.mtype={rec.get('mtype')!r} "
+                      f"(expected one of {METRIC_TYPES})")
+    known = {"v", "kind", "ts", "rank", "name"}
+    known.update(required)
+    known.update(optional)
+    unknown = sorted(set(rec) - known)
+    if unknown:
+        errors.append(f"unknown key(s): {', '.join(unknown)}")
+    return errors
+
+
+def process_rank():
+    """This process's rank for record attribution and sink filenames.
+
+    ``BRAINIAK_TPU_OBS_RANK`` wins; otherwise ``jax.process_index()``
+    — but ONLY when a jax backend is already initialized (checked via
+    the xla_bridge backend registry without touching it): obs never
+    imports jax and never initializes a backend, because on a wedged
+    TPU tunnel backend init hangs and telemetry must not be the
+    thing that first touches the device.  Records emitted before
+    distributed init therefore report rank 0; :class:`JsonlSink`
+    re-resolves its filename per write, so post-init records land in
+    the correct per-rank file.
+    """
+    global _cached_rank
+    env = os.environ.get(OBS_RANK_ENV)
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    if _cached_rank is not None:
+        # immutable after distributed init — skip the per-record
+        # probe cost on instrumented hot paths
+        return _cached_rank
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    # backend-initialized probe: jax.process_index() itself would
+    # INITIALIZE the backend (a blocking first device touch); the
+    # bridge registry is populated only after real initialization
+    bridge = sys.modules.get("jax._src.xla_bridge")
+    if bridge is None or not getattr(bridge, "_backends", None):
+        return 0
+    try:
+        _cached_rank = int(jax.process_index())
+    except Exception:  # backend unreachable mid-teardown
+        return 0
+    return _cached_rank
+
+
+def make_record(kind, name, **fields):
+    """Build a schema-v1 record envelope around ``fields``."""
+    rec = {"v": SCHEMA_VERSION, "kind": kind, "ts": time.time(),
+           "rank": process_rank(), "name": name}
+    rec.update({k: v for k, v in fields.items() if v is not None})
+    return rec
+
+
+class MemorySink:
+    """In-process sink: records accumulate in ``self.records``."""
+
+    def __init__(self):
+        self.records = []
+        self._lock = threading.Lock()
+
+    def write(self, record):
+        with self._lock:
+            self.records.append(record)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+    def clear(self):
+        with self._lock:
+            self.records.clear()
+
+
+class JsonlSink:
+    """Append records to ``<directory>/obs-<rank>.jsonl``.
+
+    One file per host process (rank-suffixed) so multi-process runs
+    never interleave writes; the report CLI aggregates the directory.
+    The file opens lazily, flushes after every record (a crash must
+    not lose the trace that explains it), and closes atexit through
+    :func:`close_all`.  The rank is re-resolved per write: records
+    emitted before ``jax.distributed`` initialization (when every
+    process still reports rank 0) go to ``obs-0.jsonl``, and once the
+    backend is up the sink reopens under the process's real rank —
+    so steady-state records never interleave across hosts.
+    """
+
+    def __init__(self, directory, rank=None):
+        self.directory = directory
+        self._rank = rank
+        self._fh = None
+        self._open_path = None
+        self._lock = threading.Lock()
+
+    @property
+    def path(self):
+        rank = self._rank if self._rank is not None else process_rank()
+        return os.path.join(self.directory, f"obs-{rank}.jsonl")
+
+    def _ensure_open(self):
+        path = self.path
+        if self._fh is None or self._open_path != path:
+            if self._fh is not None:
+                self._fh.close()
+            os.makedirs(self.directory, exist_ok=True)
+            self._fh = io.open(path, "a", encoding="utf-8")
+            self._open_path = path
+        return self._fh
+
+    def write(self, record):
+        with self._lock:
+            fh = self._ensure_open()
+            fh.write(json.dumps(record, default=_json_default) + "\n")
+            fh.flush()
+
+    def flush(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def _json_default(obj):
+    """Serialize numpy scalars/arrays that leak into span attrs."""
+    for attr in ("tolist", "item"):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:
+                continue
+    return repr(obj)
+
+
+# -- module-level dispatch --------------------------------------------
+
+_sinks = []
+_env_sink = None
+_env_dir_seen = None
+_env_broken = False  # env sink disabled after a write failure
+_lock = threading.Lock()
+
+
+def add_sink(sink):
+    """Register ``sink`` to receive every emitted record; returns it."""
+    with _lock:
+        _sinks.append(sink)
+    return sink
+
+
+def remove_sink(sink):
+    """Unregister (and close) a sink added with :func:`add_sink`."""
+    with _lock:
+        if sink in _sinks:
+            _sinks.remove(sink)
+    sink.close()
+
+
+def _configure_from_env():
+    """Keep the env-var-driven JSONL sink in step with the current
+    value of ``BRAINIAK_TPU_OBS_DIR`` (tests monkeypatch it)."""
+    global _env_sink, _env_dir_seen, _env_broken
+    directory = os.environ.get(OBS_DIR_ENV) or None
+    if directory == _env_dir_seen:
+        return
+    with _lock:
+        if directory == _env_dir_seen:
+            return
+        if _env_sink is not None:
+            _env_sink.close()
+        _env_sink = JsonlSink(directory) if directory else None
+        _env_dir_seen = directory
+        _env_broken = False  # a NEW dir gets a fresh chance
+
+
+def enabled():
+    """True when at least one sink is (or will be) active.
+
+    This is the gate every instrumentation site checks first; it costs
+    one list check plus one environ lookup, and instrumented code paths
+    do no timing, no attribute building, and — critically — no
+    ``block_until_ready`` when it returns False.
+
+    An env-configured sink that was disabled by a write failure turns
+    this False again, so instrumentation stops paying for records
+    nobody can receive; pointing the env var at a DIFFERENT directory
+    re-enables (it gets a fresh sink).
+    """
+    if _sinks:
+        return True
+    directory = os.environ.get(OBS_DIR_ENV)
+    if not directory:
+        return False
+    return not _env_broken or directory != _env_dir_seen
+
+
+def all_sinks():
+    """The currently-active sinks (explicit + env-configured)."""
+    _configure_from_env()
+    with _lock:
+        sinks = list(_sinks)
+        if _env_sink is not None:
+            sinks.append(_env_sink)
+    return sinks
+
+
+def emit(record):
+    """Dispatch ``record`` to every active sink; returns the record.
+
+    Telemetry must never break the instrumented application: a sink
+    whose write raises (unwritable ``BRAINIAK_TPU_OBS_DIR``, disk
+    full) is logged once and DISABLED for the rest of the process
+    instead of propagating into the fit/retry/fetch call that
+    happened to emit the record.
+    """
+    for sink in all_sinks():
+        try:
+            sink.write(record)
+        except Exception as exc:
+            logger.warning(
+                "obs sink %s failed (%s: %s); disabling it",
+                type(sink).__name__, type(exc).__name__, exc)
+            _disable_sink(sink)
+    return record
+
+
+def _disable_sink(sink):
+    global _env_sink, _env_broken
+    with _lock:
+        if sink in _sinks:
+            _sinks.remove(sink)
+        if sink is _env_sink:
+            # keep _env_dir_seen so the broken dir is not re-created
+            # on the next emit, and mark it broken so enabled()
+            # reverts to False (instrumentation stops paying)
+            _env_sink = None
+            _env_broken = True
+    try:
+        sink.close()
+    except Exception:
+        pass
+
+
+def event(name, **attrs):
+    """Emit an ``event`` record (no-op while obs is disabled).
+
+    The one-liner instrumentation sites use: attribute values must be
+    JSON-serializable (numpy scalars are coerced)."""
+    if not enabled():
+        return None
+    return emit(make_record("event", name, attrs=attrs or None))
+
+
+def close_all():
+    """Flush and close every sink (registered atexit)."""
+    global _env_sink, _env_dir_seen, _env_broken
+    with _lock:
+        sinks = list(_sinks)
+        if _env_sink is not None:
+            sinks.append(_env_sink)
+        _env_sink = None
+        _env_dir_seen = None
+        _env_broken = False
+        del _sinks[:]
+    for sink in sinks:
+        try:
+            sink.close()
+        except Exception:  # never let telemetry mask an exit path
+            pass
+
+
+atexit.register(close_all)

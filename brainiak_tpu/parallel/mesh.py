@@ -7,7 +7,6 @@ per-voxel arrays accordingly.  Collectives are inserted by XLA (GSPMD)
 rather than called explicitly.
 """
 
-import functools
 import logging
 from typing import Optional, Sequence
 
@@ -15,6 +14,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..obs import metrics as obs_metrics
+from ..obs import runtime as obs_runtime
 from ..resilience.retry import retry
 
 logger = logging.getLogger(__name__)
@@ -72,14 +73,15 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                 process_id=process_id)
 
 
-@functools.lru_cache(maxsize=None)
+@obs_runtime.counted_cache("parallel.replicate_identity")
 def _replicate_identity(mesh: Mesh):
     """Mesh-keyed cached jitted identity with replicated out_shardings —
     the collective-reshard fallback for :func:`fetch_replicated`.
 
     Caching per mesh matters: jit caches key on function identity, so a
     fresh ``jax.jit(lambda a: a, ...)`` per call would recompile (and
-    re-lower the all-gather) on every fetch.
+    re-lower the all-gather) on every fetch.  A cache miss counts as a
+    ``retrace_total{site=parallel.replicate_identity}`` increment.
     """
     return jax.jit(lambda a: a,
                    out_shardings=NamedSharding(mesh, PartitionSpec()))
@@ -98,14 +100,21 @@ def fetch_replicated(x, mesh: Optional[Mesh] = None):
     small (per-voxel scalars, factor parameters), so replication is
     cheap relative to the compute that produced them.
 
-    Backend dependency: the fast path relies on ``jax.device_put``
-    supporting CROSS-PROCESS resharding (moving shards between
-    processes outside a jitted computation).  That capability landed in
-    jax 0.4.x for TPU/ICI and is still backend-dependent — plugin PJRT
-    backends (and some GPU transports) reject it.  On those backends
-    this falls back to a mesh-keyed cached jitted identity whose
-    replicated ``out_shardings`` makes XLA itself insert the
-    all-gather, which every SPMD backend supports.
+    Backend dependency (JAX-version-sensitive): the fast path relies
+    on ``jax.device_put`` supporting CROSS-PROCESS resharding (moving
+    shards between processes outside a jitted computation).  That
+    capability landed in jax 0.4.x for TPU/ICI, remains
+    backend-dependent in the 0.4-0.6 line — plugin PJRT backends (and
+    some GPU transports) reject it with ``NotImplementedError`` /
+    ``ValueError`` — and its error TYPE has shifted across jax
+    releases (``RuntimeError`` on some), which is why all three are
+    caught below.  On those backends this falls back to a mesh-keyed
+    cached jitted identity whose replicated ``out_shardings`` makes
+    XLA itself insert the all-gather, which every SPMD backend
+    supports.  Each engagement of the fallback increments the obs
+    counter ``fetch_replicated_fallback_total{reason=<ExcType>}`` so a
+    fleet quietly running the slower path is visible in telemetry
+    (ADVICE round 5).
     """
     if mesh is None and isinstance(x, jax.Array) \
             and not x.is_fully_addressable:
@@ -125,6 +134,11 @@ def fetch_replicated(x, mesh: Optional[Mesh] = None):
             "cross-process device_put reshard failed (%s: %s); falling "
             "back to the jitted-identity all-gather",
             type(exc).__name__, exc)
+        obs_metrics.counter(
+            "fetch_replicated_fallback_total",
+            help="cross-process device_put reshards that fell back "
+                 "to the jitted-identity all-gather").inc(
+                reason=type(exc).__name__)
         rep = _replicate_identity(mesh)(x)
     return np.asarray(rep)
 
@@ -157,7 +171,11 @@ def make_mesh(axis_names: Sequence[str], axis_sizes: Sequence[int],
     if total > n:
         raise ValueError(f"Mesh of {sizes} needs {total} devices, have {n}")
     mesh_devices = np.asarray(devices[:total]).reshape(sizes)
-    return Mesh(mesh_devices, tuple(axis_names))
+    mesh = Mesh(mesh_devices, tuple(axis_names))
+    # topology capture (no-op while obs is disabled): every mesh a run
+    # builds lands in the trace with its axis map and backend
+    obs_runtime.topology_event(mesh)
+    return mesh
 
 
 def subject_voxel_mesh(n_subject_shards: int = -1,

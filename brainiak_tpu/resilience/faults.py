@@ -49,6 +49,8 @@ from contextlib import contextmanager
 
 import numpy as np
 
+from ..obs import sink as obs_sink
+
 logger = logging.getLogger(__name__)
 
 __all__ = [
@@ -153,6 +155,7 @@ def preempt_point(step, site="fit"):
     fault = _match("preempt")
     if fault is not None and step >= fault.at_step:
         fault.fired += 1
+        obs_sink.event("fault", kind="preempt", site=site, step=step)
         raise PreemptionError(
             f"injected preemption in {site} at step {step}")
 
@@ -178,6 +181,8 @@ def corrupt_state(state, step, site="fit"):
     fault.fired += 1
     logger.info("injecting NaN into leaf %r of %s at step %d", name,
                 site, step)
+    obs_sink.event("fault", kind="nan", site=site, step=step,
+                   leaf=name)
     poisoned = np.array(np.asarray(state[name]), dtype=float, copy=True)
     poisoned.reshape(-1)[0] = np.nan
     out = dict(state)
@@ -195,5 +200,7 @@ def io_point(path="", site="io"):
     fault.seen += 1
     if fault.seen > fault.at_step:
         fault.fired += 1
+        obs_sink.event("fault", kind="io_error", site=site,
+                       path=str(path))
         raise InjectedIOError(
             f"injected io_error in {site} for {path!r}")

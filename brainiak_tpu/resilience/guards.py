@@ -17,7 +17,12 @@ chunk.  :func:`run_resilient_loop` drives that shape uniformly:
 - **fault hooks** — :mod:`brainiak_tpu.resilience.faults` injection
   points (``nan`` corruption before the guard, ``preempt`` after each
   checkpoint save) so CI exercises both recovery paths without real
-  preemption.
+  preemption;
+- **telemetry** — with :mod:`brainiak_tpu.obs` enabled every chunk
+  runs under a ``fit_chunk`` span and the loop emits
+  ``resume``/``rollback``/``checkpoint``/``divergence_abort`` events
+  plus ``fit_steps_total``/``rollback_total``/``checkpoint_seconds``
+  metrics, all labeled with the loop ``name`` (disabled: no-ops).
 
 The guard granularity is the chunk (``checkpoint_every`` iterations for
 fused on-device loops, which cannot host-inspect intermediate
@@ -26,10 +31,14 @@ per outer iteration inside their chunk callbacks.
 """
 
 import logging
+import time
 
 import numpy as np
 
 from . import faults
+from ..obs import metrics as obs_metrics
+from ..obs import sink as obs_sink
+from ..obs import spans as obs_spans
 
 logger = logging.getLogger(__name__)
 
@@ -260,6 +269,10 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
             step = saved_step
             logger.info("%s: resumed from checkpoint at iteration %d",
                         name, step)
+            obs_sink.event("resume", estimator=name, step=step)
+            obs_metrics.counter(
+                "resume_total",
+                help="checkpoint resumes").inc(estimator=name)
 
     done = bool(np.asarray(state.get("done", False)).reshape(-1)[0]) \
         if isinstance(state, dict) and "done" in state else False
@@ -270,7 +283,14 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
         try:
             # run_chunk may itself raise DivergenceError from a
             # per-iteration check_state; it gets the same rollback.
-            new_state, done = run_chunk(state, step, n_steps)
+            # The span is a no-op while obs is disabled (and never
+            # introduces a device sync either way: run_chunk returns
+            # host-checkpointable state by contract).
+            with obs_spans.span(
+                    "fit_chunk",
+                    attrs={"estimator": name, "step": step,
+                           "n_steps": n_steps}):
+                new_state, done = run_chunk(state, step, n_steps)
             new_state = faults.corrupt_state(new_state, step + n_steps,
                                              site=name)
             check_state(new_state, iteration=step + n_steps, where=name,
@@ -280,11 +300,21 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
             if rollbacks > max_rollbacks:
                 logger.error("%s: %s; %d consecutive rollbacks "
                              "exhausted", name, exc, max_rollbacks)
+                obs_sink.event("divergence_abort", estimator=name,
+                               step=last_good[0],
+                               leaves=list(exc.leaves))
                 raise
             logger.warning(
                 "%s: %s; rolling back to iteration %d "
                 "(rollback %d/%d)", name, exc, last_good[0], rollbacks,
                 max_rollbacks)
+            obs_sink.event("rollback", estimator=name,
+                           from_step=step + n_steps,
+                           to_step=last_good[0],
+                           leaves=list(exc.leaves), attempt=rollbacks)
+            obs_metrics.counter(
+                "rollback_total",
+                help="non-finite-guard rollbacks").inc(estimator=name)
             step, state = last_good
             done = False
             continue
@@ -292,11 +322,23 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
         step += n_steps
         state = new_state
         last_good = (step, state)
+        obs_metrics.counter(
+            "fit_steps_total",
+            help="iterations advanced by guarded fit loops").inc(
+                n_steps, estimator=name)
         if mngr is not None:
             to_save = {k: np.asarray(v) for k, v in state.items()}
             if fingerprint is not None:
                 to_save["fingerprint"] = np.asarray(fingerprint,
                                                     dtype=float)
+            t_save = time.perf_counter()
             mngr.save(step, to_save)
+            dt_save = time.perf_counter() - t_save
+            obs_metrics.histogram(
+                "checkpoint_seconds", unit="s",
+                help="checkpoint save wall time").observe(
+                    dt_save, estimator=name)
+            obs_sink.event("checkpoint", estimator=name, step=step,
+                           seconds=dt_save)
         faults.preempt_point(step, site=name)
     return state, step

@@ -11,12 +11,20 @@ original exception once the budget is exhausted.
 Wired into :func:`brainiak_tpu.parallel.mesh.initialize_distributed`
 (coordinator connect), :func:`brainiak_tpu.nifti.load` (and through it
 ``io.load_images*``), and ``CheckpointManager.save``/``restore``.
+
+With :mod:`brainiak_tpu.obs` enabled, each retry emits a ``retry``
+event and a ``retry_total{site=...}`` increment, and exhausting the
+budget emits ``retry_exhausted`` — so transient-fault churn is visible
+in the trace instead of only in scrollback logs.
 """
 
 import functools
 import logging
 import random
 import time
+
+from ..obs import metrics as obs_metrics
+from ..obs import sink as obs_sink
 
 logger = logging.getLogger(__name__)
 
@@ -72,6 +80,10 @@ def retry(fn=None, *, retries=3, backoff=0.5, jitter=0.1,
                             "retry[%s]: giving up after %d attempts "
                             "(%s: %s)", label, attempt + 1,
                             type(exc).__name__, exc)
+                        obs_sink.event(
+                            "retry_exhausted", site=label,
+                            attempts=attempt + 1,
+                            error=type(exc).__name__)
                         raise
                     delay = backoff * (2.0 ** attempt)
                     if jitter:
@@ -80,6 +92,13 @@ def retry(fn=None, *, retries=3, backoff=0.5, jitter=0.1,
                         "retry[%s]: attempt %d/%d failed (%s: %s); "
                         "retrying in %.2fs", label, attempt + 1,
                         retries + 1, type(exc).__name__, exc, delay)
+                    obs_sink.event(
+                        "retry", site=label, attempt=attempt + 1,
+                        error=type(exc).__name__, delay_s=delay)
+                    obs_metrics.counter(
+                        "retry_total",
+                        help="transient-failure retries").inc(
+                            site=label)
                     if delay > 0:
                         _sleep(delay)
             raise AssertionError("unreachable")  # pragma: no cover

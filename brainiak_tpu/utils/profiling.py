@@ -1,71 +1,23 @@
-"""Structured timing and device profiling hooks.
+"""Deprecated shim over :mod:`brainiak_tpu.obs` (PR 3).
 
-The reference's observability is ad-hoc ``time.time()`` pairs around
-pipeline stages logged at DEBUG (SURVEY.md §5.1, e.g.
-fcma/voxelselector.py:299-328).  Here the same intent is a reusable
-context manager with an inspectable registry, plus a wrapper around
-``jax.profiler`` traces for device-level analysis (the TPU-native
-replacement for wall-clock-only timing).
+The 71-line ad-hoc timing registry that used to live here grew into
+the ``brainiak_tpu/obs/`` subsystem: hierarchical spans
+(:func:`brainiak_tpu.obs.span`), a typed metric registry, JSONL sinks,
+and the ``python -m brainiak_tpu.obs report`` CLI — see
+docs/observability.md.
+
+These names keep working exactly as before (``stage_timer`` always
+records into the thread-safe in-process registry and always honors
+``sync``, no sink required) but new code should import from
+``brainiak_tpu.obs`` directly.
 """
 
-import contextlib
-import logging
-import time
-from collections import defaultdict
-
-logger = logging.getLogger(__name__)
+from ..obs.runtime import device_trace  # noqa: F401
+from ..obs.spans import (  # noqa: F401
+    reset_stage_times,
+    stage_timer,
+    stage_times,
+)
 
 __all__ = ["stage_timer", "stage_times", "reset_stage_times",
            "device_trace"]
-
-_times = defaultdict(list)
-
-
-@contextlib.contextmanager
-def stage_timer(name, sync=None):
-    """Time a pipeline stage; ``sync`` may be an array (or pytree) to
-    block on before stopping the clock (remember: dispatch is async).
-
-    Results accumulate in a process-wide registry readable with
-    :func:`stage_times`.
-    """
-    t0 = time.perf_counter()
-    holder = {}
-    try:
-        yield holder
-    finally:
-        target = holder.get("sync", sync)
-        if target is not None:
-            try:
-                import jax
-            except ImportError:
-                jax = None
-            if jax is not None:
-                # computation errors surfaced here must propagate — a
-                # swallowed failure would record a bogus (unsynced) time
-                jax.block_until_ready(target)
-        dt = time.perf_counter() - t0
-        _times[name].append(dt)
-        logger.debug("stage %s took %.3fs", name, dt)
-
-
-def stage_times():
-    """Mapping of stage name -> list of durations (seconds)."""
-    return {k: list(v) for k, v in _times.items()}
-
-
-def reset_stage_times():
-    _times.clear()
-
-
-@contextlib.contextmanager
-def device_trace(log_dir):
-    """Capture a jax.profiler trace (TensorBoard-viewable) around a block
-    of device work."""
-    import jax
-
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()

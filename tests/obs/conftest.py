@@ -1,0 +1,17 @@
+"""Obs test isolation: every test starts with no sinks, no env dir,
+and a clean default metric registry."""
+
+import pytest
+
+from brainiak_tpu.obs import metrics, sink
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    monkeypatch.delenv(sink.OBS_DIR_ENV, raising=False)
+    monkeypatch.delenv(sink.OBS_RANK_ENV, raising=False)
+    sink.close_all()
+    metrics.reset()
+    yield
+    sink.close_all()
+    metrics.reset()

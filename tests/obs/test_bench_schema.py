@@ -1,0 +1,47 @@
+"""Bench record schema (satellite: BENCH_*.json drift fails CI).
+
+Runs the tiny bench tier in-process on CPU and validates the emitted
+record against the obs bench schema — metric/value/unit/vs_baseline/
+tier keys plus the per-stage time breakdown."""
+
+import bench
+
+from brainiak_tpu import obs
+
+
+def test_tiny_tier_record_matches_obs_schema(monkeypatch):
+    monkeypatch.setenv("BENCH_MID_VOXELS", "64")
+    out = bench.measure_tier("mid")
+    assert out["voxels_per_sec"] > 0
+    stages = out["stages"]
+    assert set(bench.STAGE_KEYS) <= set(stages)
+    assert all(stages[k] >= 0 for k in bench.STAGE_KEYS)
+    # warm (upload+compile) and steady (compute) both actually ran
+    assert stages["warm_s"] > 0 and stages["steady_s"] > 0
+
+    rec = bench._result_record(
+        "mid_V8192", out["voxels_per_sec"], cpu_vps=100.0,
+        config={"n_voxels": 64, "n_epochs": bench.N_EPOCHS,
+                "n_trs": bench.N_TRS},
+        stages=stages)
+    assert obs.validate_bench_record(rec) == []
+    assert rec["unit"] == "voxels/sec"
+    assert rec["tier"] == "mid_V8192"
+
+
+def test_cpu_fallback_record_matches_obs_schema():
+    rec = bench._result_record(
+        "cpu_fallback", 100.0, cpu_vps=50.0,
+        stages={"data_gen_s": 0.1, "warm_s": 0.2, "steady_s": 0.3})
+    assert obs.validate_bench_record(rec) == []
+    assert rec["metric"].endswith("_CPU_FALLBACK_tpu_unresponsive")
+    assert rec["vs_baseline"] == 2.0
+
+
+def test_stage_seconds_fills_missing_stages():
+    recs = [{"kind": "span", "name": "bench.steady", "dur_s": 1.5},
+            {"kind": "span", "name": "bench.steady", "dur_s": 0.5},
+            {"kind": "metric", "name": "bench.warm", "value": 9}]
+    stages = bench._stage_seconds(recs)
+    assert stages == {"data_gen_s": 0.0, "warm_s": 0.0,
+                      "steady_s": 2.0}

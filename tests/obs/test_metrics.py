@@ -1,0 +1,93 @@
+"""Metric registry: types, labels, records, conflicts, threads."""
+
+import threading
+
+import pytest
+
+from brainiak_tpu import obs
+from brainiak_tpu.obs import metrics, sink as obs_sink
+
+
+def test_counter_accumulates_by_labelset():
+    c = obs.counter("fit_steps_total")
+    c.inc(5, estimator="SRM")
+    c.inc(3, estimator="SRM")
+    c.inc(2, estimator="TFA")
+    assert c.value(estimator="SRM") == 8
+    assert c.value(estimator="TFA") == 2
+    assert c.value(estimator="HTFA") == 0.0
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_and_histogram():
+    g = obs.gauge("g", unit="bytes")
+    g.set(5)
+    g.set(7)
+    assert g.value() == 7
+    h = obs.histogram("h", unit="s")
+    for v in (0.1, 0.3, 0.2):
+        h.observe(v)
+    summary = h.summary()
+    assert summary["count"] == 3
+    assert summary["min"] == pytest.approx(0.1)
+    assert summary["max"] == pytest.approx(0.3)
+    assert summary["sum"] == pytest.approx(0.6)
+
+
+def test_type_conflict_raises():
+    obs.counter("conflicted")
+    with pytest.raises(ValueError):
+        obs.gauge("conflicted")
+
+
+def test_get_or_create_returns_same_object():
+    assert obs.counter("same") is obs.counter("same")
+
+
+def test_collect_shape():
+    obs.counter("a_total").inc(2, site="x")
+    obs.gauge("b").set(1.5)
+    obs.histogram("c_seconds", unit="s").observe(0.5)
+    samples = obs.collect()
+    by_name = {s["name"]: s for s in samples}
+    assert by_name["a_total"]["value"] == 2
+    assert by_name["a_total"]["labels"] == {"site": "x"}
+    assert by_name["b"]["value"] == 1.5
+    assert by_name["c_seconds"]["value"]["count"] == 1
+
+
+def test_updates_emit_records_only_when_enabled():
+    obs.counter("quiet_total").inc()  # disabled: in-memory only
+    mem = obs_sink.add_sink(obs.MemorySink())
+    obs.counter("loud_total").inc(2, estimator="SRM")
+    obs.histogram("loud_seconds", unit="s").observe(0.25)
+    recs = [r for r in mem.records if r["kind"] == "metric"]
+    assert [r["name"] for r in recs] == ["loud_total",
+                                         "loud_seconds"]
+    assert recs[0]["value"] == 2.0
+    assert recs[0]["labels"] == {"estimator": "SRM"}
+    assert recs[1]["unit"] == "s"
+    for rec in recs:
+        assert obs.validate_record(rec) == []
+
+
+def test_counter_thread_safe():
+    c = obs.counter("threaded_total")
+
+    def work():
+        for _ in range(1000):
+            c.inc(site="x")
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(site="x") == 4000
+
+
+def test_registry_reset_isolates():
+    obs.counter("ephemeral_total").inc()
+    metrics.reset()
+    assert obs.collect() == []

@@ -1,0 +1,124 @@
+"""Report aggregation + CLI (`python -m brainiak_tpu.obs report`)."""
+
+import json
+import os
+
+import pytest
+
+from brainiak_tpu import obs
+from brainiak_tpu.obs import report, sink as obs_sink
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "..", "..",
+                       "tools", "obs_fixture.jsonl")
+
+
+def _write_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.OBS_DIR_ENV, str(tmp_path))
+    with obs.span("fit", attrs={"estimator": "SRM.fit"}):
+        with obs.span("fit_chunk",
+                      attrs={"estimator": "SRM.fit", "step": 0}):
+            pass
+    obs_sink.event("checkpoint", estimator="SRM.fit", step=5)
+    obs.counter("fit_steps_total").inc(5, estimator="SRM.fit")
+    obs.counter("fit_steps_total").inc(3, estimator="SRM.fit")
+    obs.gauge("g").set(2.0)
+    obs.histogram("h", unit="s").observe(0.5)
+    obs.histogram("h", unit="s").observe(1.5)
+    obs_sink.close_all()
+    monkeypatch.delenv(obs.OBS_DIR_ENV)
+
+
+def test_aggregate_semantics(tmp_path, monkeypatch):
+    _write_trace(tmp_path, monkeypatch)
+    records, errors = report.load_records([str(tmp_path)])
+    assert errors == []
+    summary = report.aggregate(records)
+    spans = {(r["path"], r["estimator"]): r
+             for r in summary["spans"]}
+    assert spans[("fit", "SRM.fit")]["count"] == 1
+    assert spans[("fit/fit_chunk", "SRM.fit")]["count"] == 1
+    assert summary["events"] == [{"name": "checkpoint", "count": 1}]
+    mets = {m["name"]: m for m in summary["metrics"]}
+    assert mets["fit_steps_total"]["value"] == 8  # counter: sum
+    assert mets["g"]["value"] == 2.0              # gauge: last
+    hist = mets["h"]["value"]                     # histogram: stats
+    assert hist == {"count": 2, "sum": 2.0, "min": 0.5,
+                    "max": 1.5, "mean": 1.0}
+    text = report.render_text(summary)
+    assert "fit/fit_chunk" in text and "fit_steps_total" in text
+
+
+def test_cli_text_and_json(tmp_path, monkeypatch, capsys):
+    _write_trace(tmp_path, monkeypatch)
+    assert report.main(["report", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "spans (by path):" in out
+    assert report.main(["report", str(tmp_path),
+                        "--format=json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["schema_errors"] == []
+    assert summary["n_records"] > 0
+
+
+def test_cli_defaults_to_env_dir(tmp_path, monkeypatch, capsys):
+    _write_trace(tmp_path, monkeypatch)
+    monkeypatch.setenv(obs.OBS_DIR_ENV, str(tmp_path))
+    assert report.main(["report", "--format=json"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_fails_on_schema_violation(tmp_path, capsys):
+    bad = tmp_path / "obs-0.jsonl"
+    bad.write_text('{"v": 1, "kind": "span", "name": "x"}\n'
+                   "not json at all\n")
+    assert report.main(["report", str(tmp_path),
+                        "--format=json"]) == 1
+    summary = json.loads(capsys.readouterr().out)
+    assert len(summary["schema_errors"]) == 2
+
+
+def test_cli_errors_without_paths_or_env(monkeypatch):
+    monkeypatch.delenv(obs.OBS_DIR_ENV, raising=False)
+    with pytest.raises(SystemExit):
+        report.main(["report"])
+
+
+def test_committed_fixture_is_schema_clean():
+    records, errors = report.load_records([FIXTURE])
+    assert errors == []
+    assert len(records) >= 10
+    summary = report.aggregate(records)
+    assert summary["spans"] and summary["events"] \
+        and summary["metrics"]
+
+
+def test_gauge_last_is_by_timestamp_not_file_order(tmp_path):
+    # rank files read in lexical order (obs-10 before obs-2); the
+    # chronologically newest set must still win
+    def rec(ts, value, rank):
+        return {"v": 1, "kind": "metric", "ts": ts, "rank": rank,
+                "name": "g", "mtype": "gauge", "value": value}
+
+    (tmp_path / "obs-10.jsonl").write_text(
+        json.dumps(rec(200.0, 42.0, 10)) + "\n")
+    (tmp_path / "obs-2.jsonl").write_text(
+        json.dumps(rec(100.0, 7.0, 2)) + "\n")
+    records, errors = report.load_records([str(tmp_path)])
+    assert errors == []
+    (row,) = report.aggregate(records)["metrics"]
+    assert row["value"] == 42.0
+
+
+def test_validate_bench_record():
+    good = {"metric": "m", "value": 1.0, "unit": "voxels/sec",
+            "vs_baseline": 2.0, "tier": "mid_V8192",
+            "stages": {"data_gen_s": 0.1, "warm_s": 0.2,
+                       "steady_s": 0.3}}
+    assert obs.validate_bench_record(good) == []
+    assert obs.validate_bench_record({}) != []
+    bad = dict(good, stages={"data_gen_s": 0.1})
+    assert any("warm_s" in e
+               for e in obs.validate_bench_record(bad))
+    bad = dict(good, value="fast")
+    assert any("value" in e
+               for e in obs.validate_bench_record(bad))

@@ -1,0 +1,157 @@
+"""Sink mechanics: env activation, JSONL files, schema validation."""
+
+import json
+import os
+import threading
+
+from brainiak_tpu import obs
+from brainiak_tpu.obs import sink as obs_sink
+
+
+def test_disabled_by_default():
+    assert not obs.enabled()
+    assert obs_sink.all_sinks() == []
+
+
+def test_env_dir_enables_and_writes_rank_file(tmp_path,
+                                              monkeypatch):
+    d = str(tmp_path / "trace")
+    monkeypatch.setenv(obs.OBS_DIR_ENV, d)
+    assert obs.enabled()
+    obs.emit(obs.make_record("event", "hello", attrs={"a": 1}))
+    obs_sink.close_all()
+    path = os.path.join(d, "obs-0.jsonl")
+    assert os.path.exists(path)
+    (rec,) = [json.loads(line) for line in open(path)]
+    assert rec["name"] == "hello"
+    assert rec["kind"] == "event"
+    assert rec["v"] == obs.SCHEMA_VERSION
+    assert obs.validate_record(rec) == []
+
+
+def test_rank_env_override(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs_sink.OBS_RANK_ENV, "3")
+    monkeypatch.setenv(obs.OBS_DIR_ENV, str(tmp_path))
+    obs.emit(obs.make_record("event", "x"))
+    obs_sink.close_all()
+    assert os.path.exists(str(tmp_path / "obs-3.jsonl"))
+
+
+def test_event_helper_noop_when_disabled(tmp_path, monkeypatch):
+    assert obs_sink.event("nothing") is None
+    monkeypatch.setenv(obs.OBS_DIR_ENV, str(tmp_path))
+    rec = obs_sink.event("something", k="v")
+    assert rec["attrs"] == {"k": "v"}
+
+
+def test_memory_sink_add_remove():
+    mem = obs_sink.add_sink(obs.MemorySink())
+    assert obs.enabled()
+    obs_sink.event("ping")
+    obs_sink.remove_sink(mem)
+    assert not obs.enabled()
+    assert [r["name"] for r in mem.records] == ["ping"]
+
+
+def test_numpy_attrs_serialize(tmp_path, monkeypatch):
+    import numpy as np
+
+    monkeypatch.setenv(obs.OBS_DIR_ENV, str(tmp_path))
+    obs_sink.event("np", value=np.float32(1.5),
+                   arr=np.arange(3))
+    obs_sink.close_all()
+    (rec,) = [json.loads(line)
+              for line in open(str(tmp_path / "obs-0.jsonl"))]
+    assert rec["attrs"]["value"] == 1.5
+    assert rec["attrs"]["arr"] == [0, 1, 2]
+
+
+def test_validate_record_rejects_bad_shapes():
+    assert obs.validate_record([]) != []
+    assert obs.validate_record({"v": 99}) != []
+    good = obs.make_record("span", "s", path="s", dur_s=0.1)
+    assert obs.validate_record(good) == []
+    bad = dict(good)
+    bad["dur_s"] = "fast"
+    assert any("dur_s" in e for e in obs.validate_record(bad))
+    bad = dict(good)
+    bad["extra"] = 1
+    assert any("unknown" in e for e in obs.validate_record(bad))
+    bad = obs.make_record("metric", "m", mtype="timer", value=1.0)
+    assert any("mtype" in e for e in obs.validate_record(bad))
+
+
+def test_unwritable_dir_disables_sink_without_raising(tmp_path,
+                                                      monkeypatch,
+                                                      caplog):
+    # point the obs dir at a path whose parent is a FILE: makedirs
+    # fails on first write; the instrumented caller must not see it
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    monkeypatch.setenv(obs.OBS_DIR_ENV, str(blocker / "trace"))
+    import logging
+    assert obs.enabled()
+    with caplog.at_level(logging.WARNING,
+                         logger="brainiak_tpu.obs.sink"):
+        obs_sink.event("survives")       # must not raise
+        obs_sink.event("also survives")  # sink already disabled
+    assert "disabling" in caplog.text
+    # the broken env sink turns enabled() back off: hot loops stop
+    # paying for records nobody can receive
+    assert not obs.enabled()
+    # a DIFFERENT dir gets a fresh chance
+    monkeypatch.setenv(obs.OBS_DIR_ENV, str(tmp_path / "ok"))
+    assert obs.enabled()
+    obs_sink.event("works now")
+    obs_sink.close_all()
+    assert (tmp_path / "ok" / "obs-0.jsonl").exists()
+
+
+def test_rank_resolution_never_initializes_backend(monkeypatch):
+    # simulate "jax imported, backend not initialized": the rank
+    # probe must fall back to 0 without calling process_index (which
+    # would initialize — and on a wedged tunnel, hang — the backend)
+    import sys as _sys
+    bridge = _sys.modules.get("jax._src.xla_bridge")
+    if bridge is not None:
+        monkeypatch.setattr(bridge, "_backends", {}, raising=False)
+    import jax
+
+    def boom():
+        raise AssertionError("process_index would init the backend")
+
+    monkeypatch.setattr(jax, "process_index", boom)
+    assert obs_sink.process_rank() == 0
+
+
+def test_jsonl_sink_reopens_when_rank_changes(tmp_path,
+                                              monkeypatch):
+    sink = obs.JsonlSink(str(tmp_path))
+    monkeypatch.setenv(obs_sink.OBS_RANK_ENV, "0")
+    sink.write(obs.make_record("event", "early"))
+    monkeypatch.setenv(obs_sink.OBS_RANK_ENV, "2")
+    sink.write(obs.make_record("event", "late"))
+    sink.close()
+    early = open(str(tmp_path / "obs-0.jsonl")).read()
+    late = open(str(tmp_path / "obs-2.jsonl")).read()
+    assert "early" in early and "late" in late
+
+
+def test_jsonl_sink_concurrent_writes(tmp_path):
+    sink = obs.JsonlSink(str(tmp_path), rank=0)
+
+    def work(tag):
+        for i in range(100):
+            sink.write(obs.make_record("event", f"{tag}-{i}"))
+
+    threads = [threading.Thread(target=work, args=(f"t{j}",))
+               for j in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sink.close()
+    lines = open(str(tmp_path / "obs-0.jsonl")).read().splitlines()
+    assert len(lines) == 400
+    for line in lines:  # no interleaved/torn writes
+        assert obs.validate_record(json.loads(line)) == []

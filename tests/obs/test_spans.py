@@ -1,0 +1,187 @@
+"""Span semantics: nesting/paths, disabled no-ops, sync handling,
+decorator form, legacy stage_timer aliases, thread safety."""
+
+import threading
+
+import numpy as np
+
+from brainiak_tpu import obs
+from brainiak_tpu.obs import sink as obs_sink
+
+
+def _mem():
+    return obs_sink.add_sink(obs.MemorySink())
+
+
+def _spans(mem):
+    return [r for r in mem.records if r["kind"] == "span"]
+
+
+def test_span_disabled_is_noop_and_emits_nothing():
+    assert not obs.enabled()
+    with obs.span("outer") as frame:
+        # the null frame accepts attrs AND the documented late-sync
+        # assignment without effect (and without raising)
+        frame.set("k", 1)
+        frame.sync = [1, 2, 3]
+        assert frame.sync is None  # discarded, not pinned
+        assert obs.current_span() == ""
+    # nothing to assert against a sink: there is none — enabled()
+    # stays false and no record was buffered anywhere
+    assert not obs.enabled()
+
+
+def test_span_nesting_paths_and_attrs():
+    mem = _mem()
+    with obs.span("outer", attrs={"estimator": "SRM"}):
+        assert obs.current_span() == "outer"
+        with obs.span("inner") as frame:
+            frame.set("step", 3)
+            assert obs.current_span() == "outer/inner"
+    recs = _spans(mem)
+    assert [r["path"] for r in recs] == ["outer/inner", "outer"]
+    assert recs[0]["attrs"] == {"step": 3}
+    assert recs[1]["attrs"] == {"estimator": "SRM"}
+    for rec in recs:
+        assert obs.validate_record(rec) == []
+        assert rec["dur_s"] >= 0
+
+
+def test_span_sync_blocks_on_device_result():
+    import jax.numpy as jnp
+
+    mem = _mem()
+    x = jnp.ones((16, 16))
+    with obs.span("matmul", sync=x @ x):
+        pass
+    with obs.span("late") as frame:
+        frame.sync = x + 1
+    assert len(_spans(mem)) == 2
+
+
+def test_failing_sync_propagates_but_stack_stays_clean(monkeypatch):
+    """A sync target whose computation failed re-raises out of the
+    span, but the thread-local stack must be unwound — a caller that
+    catches and continues (the resilient-loop rollback path) must
+    not see corrupted paths on later spans."""
+    from brainiak_tpu.obs import spans
+
+    mem = _mem()
+
+    def boom(target):
+        raise FloatingPointError("async computation failed")
+
+    monkeypatch.setattr(spans, "_block_until_ready", boom)
+    try:
+        with obs.span("doomed", sync=object()):
+            pass
+    except FloatingPointError:
+        pass
+    assert obs.current_span() == ""
+    monkeypatch.undo()
+    with obs.span("after"):
+        pass
+    recs = _spans(mem)
+    # the doomed span recorded nothing (its time would be bogus);
+    # the next span has an uncorrupted root path
+    assert [r["path"] for r in recs] == ["after"]
+
+
+def test_span_exception_still_recorded():
+    mem = _mem()
+    try:
+        with obs.span("boom"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    recs = _spans(mem)
+    assert len(recs) == 1 and recs[0]["name"] == "boom"
+    # the stack unwound — no leaked active span
+    assert obs.current_span() == ""
+
+
+def test_traced_decorator_forms():
+    mem = _mem()
+
+    @obs.traced
+    def bare():
+        return 1
+
+    @obs.traced("labeled", sync_result=True)
+    def labeled():
+        import jax.numpy as jnp
+        return jnp.zeros(3)
+
+    assert bare() == 1
+    np.testing.assert_array_equal(np.asarray(labeled()), 0.0)
+    names = [r["name"] for r in _spans(mem)]
+    assert "bare" in names[0]  # qualified name of the function
+    assert names[1] == "labeled"
+
+
+def test_stage_timer_records_without_sink():
+    obs.reset_stage_times()
+    with obs.stage_timer("stage_a"):
+        pass
+    with obs.stage_timer("stage_a"):
+        pass
+    times = obs.stage_times()
+    assert len(times["stage_a"]) == 2
+    obs.reset_stage_times()
+    assert obs.stage_times() == {}
+
+
+def test_stage_timer_emits_span_when_enabled():
+    mem = _mem()
+    obs.reset_stage_times()
+    with obs.span("outer"):
+        with obs.stage_timer("legacy"):
+            pass
+    paths = [r["path"] for r in _spans(mem)]
+    assert "outer/legacy" in paths
+    assert "legacy" in obs.stage_times()
+    obs.reset_stage_times()
+
+
+def test_profiling_shim_reexports():
+    from brainiak_tpu.utils import profiling
+
+    assert profiling.stage_timer is obs.stage_timer
+    assert profiling.stage_times is obs.stage_times
+    assert profiling.reset_stage_times is obs.reset_stage_times
+
+
+def test_stage_registry_thread_safe():
+    obs.reset_stage_times()
+
+    def work():
+        for _ in range(200):
+            with obs.stage_timer("shared"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(obs.stage_times()["shared"]) == 800
+    obs.reset_stage_times()
+
+
+def test_span_stacks_are_thread_local():
+    mem = _mem()
+    seen = {}
+
+    def work(tag):
+        with obs.span(tag):
+            seen[tag] = obs.current_span()
+
+    threads = [threading.Thread(target=work, args=(f"t{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # no cross-thread nesting: every span is its own root
+    assert seen == {f"t{i}": f"t{i}" for i in range(4)}
+    assert all(r["path"] == r["name"] for r in _spans(mem))

@@ -42,7 +42,7 @@ def test_run_checks_json_output():
     assert payload["findings"] == []
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
-        "jaxlint"}
+        "jaxlint", "obs"}
     assert payload["files"] > 100
 
 
@@ -142,6 +142,36 @@ def test_stdlib_gate_catches_seeded_violations(tmp_path):
         [rc.LineLength(), rc.UnusedImports()])
     codes = sorted(f.code for f in findings)
     assert codes == ["CHK002", "CHK003"]
+
+
+def test_obs_gate_passes_on_repo():
+    """The committed fixture trace renders clean through the report
+    CLI smoke-run (ISSUE 3 satellite: obs gate)."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_obs(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_obs_gate_catches_schema_violations(tmp_path, monkeypatch):
+    """A drifted/corrupt trace fixture fails the gate with OBS001."""
+    rc = _load_run_checks()
+    bad = tmp_path / "obs_fixture.jsonl"
+    bad.write_text('{"v": 99, "kind": "span", "name": "x"}\n')
+    monkeypatch.setattr(rc, "OBS_FIXTURE", str(bad))
+    findings = []
+    rc.check_obs(findings)
+    assert findings and all(f.code == "OBS001" for f in findings)
+    assert any("schema violation" in f.message for f in findings)
+
+
+def test_obs_gate_catches_missing_fixture(tmp_path, monkeypatch):
+    rc = _load_run_checks()
+    monkeypatch.setattr(rc, "OBS_FIXTURE",
+                        str(tmp_path / "nope.jsonl"))
+    findings = []
+    rc.check_obs(findings)
+    assert [f.code for f in findings] == ["OBS001"]
 
 
 def test_stdlib_gate_honors_noqa(tmp_path):

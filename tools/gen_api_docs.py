@@ -47,6 +47,22 @@ def public_names(mod):
     return sorted(names)
 
 
+_SET_REPR_RE = re.compile(r"\{('[^'{}]*'(?:, '[^'{}]*')+)\}")
+
+
+def _sort_set_reprs(text):
+    """Sort the elements of string-set reprs: set iteration order is
+    hash-randomized per process, so an unsorted repr (e.g. a
+    ``skip_dirs={...}`` default) churns on every regeneration.
+
+    Elements are re-extracted as quoted units (not split on ', '),
+    so a string member that itself contains a comma survives."""
+    return _SET_REPR_RE.sub(
+        lambda m: "{" + ", ".join(
+            sorted(re.findall(r"'[^'{}]*'", m.group(1)))) + "}",
+        text)
+
+
 def fmt_signature(name, obj):
     try:
         sig = str(inspect.signature(obj))
@@ -54,9 +70,9 @@ def fmt_signature(name, obj):
         sig = "(...)"
     # default-value reprs embed run-specific id() addresses (functions,
     # bound methods, object instances); strip them so regeneration is
-    # deterministic
+    # deterministic — and sort set-literal reprs for the same reason
     sig = re.sub(r"<([^<>]*?) at 0x[\da-f]+>", r"<\1>", sig)
-    return f"{name}{sig}"
+    return f"{name}{_sort_set_reprs(sig)}"
 
 
 def fmt_doc(obj, indent=""):
@@ -94,7 +110,7 @@ def emit_member(lines, name, obj):
         lines.append(fmt_doc(obj))
     else:
         lines.append(f"### `{name}`\n")
-        lines.append(f"Constant: `{obj!r}`\n")
+        lines.append(f"Constant: `{_sort_set_reprs(repr(obj))}`\n")
 
 
 def emit_module(lines, modname):

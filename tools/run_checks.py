@@ -18,6 +18,9 @@ resilient-fits every public iterative fit honors the
            checkpoint_dir/run_resilient_loop contract (CHK102)
 jaxlint    TPU-readiness rules JX001-JX006 over the package,
            with the [tool.jaxlint] baseline applied
+obs        smoke-runs ``python -m brainiak_tpu.obs report
+           --format=json`` on tools/obs_fixture.jsonl and
+           fails on schema violations (OBS001)
 ========== ===================================================
 
 ``# noqa`` suppresses stdlib/doc findings on a line; jaxlint uses
@@ -48,7 +51,7 @@ from brainiak_tpu.analysis.core import SKIP_DIRS  # noqa: E402,F401
 
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
-         "jaxlint")
+         "jaxlint", "obs")
 
 
 def python_sources():
@@ -317,6 +320,60 @@ def check_resilient_fits(findings):
                         "(resilience contract)"))
 
 
+# -- obs gate ---------------------------------------------------------
+
+OBS_FIXTURE = os.path.join(REPO, "tools", "obs_fixture.jsonl")
+
+
+def check_obs(findings):
+    """Obs telemetry gate (OBS001): smoke-run the report CLI
+    (``python -m brainiak_tpu.obs report --format=json``) on the
+    fixture JSONL.  Fails when the CLI errors, emits schema
+    violations, or its summary is not the JSON shape downstream
+    tooling parses — so a schema drift in
+    :mod:`brainiak_tpu.obs.sink` breaks CI instead of silently
+    corrupting the next round's traces."""
+    rel = _rel(OBS_FIXTURE)
+    if not os.path.exists(OBS_FIXTURE):
+        findings.append(Finding(
+            rel, 1, "OBS001", "obs fixture JSONL missing"))
+        return
+    proc = subprocess.run(
+        [sys.executable, "-m", "brainiak_tpu.obs", "report",
+         "--format=json", OBS_FIXTURE],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    # rc=1 with parseable output means schema violations: the CLI
+    # still prints its JSON summary, so report them one Finding per
+    # violation rather than a generic stderr tail
+    try:
+        summary = json.loads(proc.stdout)
+    except ValueError:
+        summary = None
+    if summary is None:
+        tail = (proc.stderr or proc.stdout or "").strip()
+        tail = "; ".join(tail.splitlines()[-3:])
+        findings.append(Finding(
+            rel, 1, "OBS001",
+            f"obs report CLI failed (rc={proc.returncode}): "
+            f"{tail or 'no JSON summary'}"))
+        return
+    for key in ("n_records", "spans", "events", "metrics",
+                "schema_errors"):
+        if key not in summary:
+            findings.append(Finding(
+                rel, 1, "OBS001",
+                f"obs report summary missing key {key!r}"))
+    for err in summary.get("schema_errors", []):
+        findings.append(Finding(
+            rel, 1, "OBS001", f"schema violation: {err}"))
+    if proc.returncode != 0 and not summary.get("schema_errors"):
+        findings.append(Finding(
+            rel, 1, "OBS001",
+            f"obs report CLI exited rc={proc.returncode} with no "
+            "reported schema errors"))
+
+
 # -- external gate ----------------------------------------------------
 
 def run_external(findings):
@@ -421,13 +478,16 @@ def run_gates(only=None):
         check_doc_defaults(findings)
     if "resilient-fits" in selected:
         check_resilient_fits(findings)
+    if "obs" in selected:
+        check_obs(findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     label = "+".join(
         (["stdlib"] if "stdlib" in selected else []) + ran
-        + [g for g in ("doc-defaults", "resilient-fits", "jaxlint")
+        + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
+                       "obs")
            if g in selected])
     return {
         "ok": not findings,
