@@ -42,6 +42,7 @@ import time
 import numpy as np
 
 from brainiak_tpu import obs
+from brainiak_tpu.obs.report import BENCH_SCHEMA_VERSION
 from brainiak_tpu.obs.report import BENCH_STAGE_KEYS as STAGE_KEYS
 
 N_VOXELS = 8192
@@ -337,17 +338,37 @@ def measure_tier(tier):
     return {"voxels_per_sec": vps, "stages": stages}
 
 
+def _git_commit():
+    """Short commit hash of the tree this bench ran from, or None
+    (regress.py pins a record to the code that produced it)."""
+    import os
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=here,
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    commit = out.stdout.strip()
+    return commit if out.returncode == 0 and commit else None
+
+
 def _result_record(tier, vps, cpu_vps, config=None, stages=None):
     """The bench JSON line (schema:
     ``brainiak_tpu.obs.validate_bench_record``)."""
     metric = "fcma_voxel_selection_voxels_per_sec_chip"
     if tier == "cpu_fallback":
         metric += "_CPU_FALLBACK_tpu_unresponsive"
-    rec = {"metric": metric,
+    rec = {"schema_version": BENCH_SCHEMA_VERSION,
+           "metric": metric,
            "value": round(vps, 2),
            "unit": "voxels/sec",
            "vs_baseline": round(vps / cpu_vps, 2),
            "tier": tier}
+    commit = _git_commit()
+    if commit:
+        rec["git_commit"] = commit
     if config:
         rec["config"] = config
     if stages:

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
+from ..obs import profile as obs_profile
 from ..ops.optimize import minimize_bounded
 from ..ops.rbf import rbf_factors
 from ..parallel.mesh import DEFAULT_SUBJECT_AXIS, place_on_mesh
@@ -92,6 +93,12 @@ def _batched_subject_step(data, R, vmask, tmask, centers, widths, lower,
         in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0))(
             data, R, vmask, tmask, centers, widths, lower, upper,
             beta, data_sigma, sample_scaling)
+
+
+# cost attribution for the all-subjects inner-step program
+_batched_subject_step = obs_profile.profile_program(
+    _batched_subject_step, "htfa.subject_step", span="fit_chunk",
+    estimator="HTFA.fit")
 
 
 class HTFA(TFA):

@@ -28,6 +28,7 @@ from scipy.spatial import distance
 from sklearn.base import BaseEstimator
 from sklearn.cluster import KMeans
 
+from ..obs import profile as obs_profile
 from ..ops.optimize import minimize_bounded
 from ..ops.rbf import rbf_factors
 from ..resilience.guards import (array_digest, check_state,
@@ -98,6 +99,13 @@ def _fit_centers_widths(init, lower, upper, R, X, W, data_sigma,
 
     return minimize_bounded(objective, init, lower, upper,
                             max_iters=max_iters)
+
+
+# cost attribution for the per-iteration NLLS program (schema-v2
+# `cost` records while profiling is active)
+_fit_centers_widths = obs_profile.profile_program(
+    _fit_centers_widths, "tfa.fit_centers_widths", span="fit_chunk",
+    estimator="TFA.fit")
 
 
 class TFA(BaseEstimator):

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import profile as obs_profile
 from ..obs import runtime as obs_runtime
 from ..obs import spans as obs_spans
 from ..ops.correlation import resolve_precision
@@ -62,10 +63,13 @@ def _sharded_gram_program(mesh, epochs_per_subj, interpret,
     Gram kernel runs per shard under shard_map; jit caches on
     function identity, so constructing the shard_map closure inside
     ``run()`` would rebuild (and retrace) it on every call.  Cache
-    misses count as ``retrace_total{site=fcma.sharded_gram}``.
+    misses count as ``retrace_total{site=fcma.sharded_gram}``; with
+    cost profiling active (BRAINIAK_TPU_OBS_PROFILE) the program's
+    first run per shape captures a ``cost`` record under the same
+    site, joined to ``fcma.block`` span durations by the report CLI.
     """
     from jax import shard_map
-    return jax.jit(shard_map(
+    return obs_profile.profile_program(jax.jit(shard_map(
         partial(_block_gram_pallas,
                 epochs_per_subj=epochs_per_subj,
                 interpret=interpret,
@@ -75,7 +79,7 @@ def _sharded_gram_program(mesh, epochs_per_subj, interpret,
                   PartitionSpec()),
         out_specs=PartitionSpec(DEFAULT_VOXEL_AXIS, None, None),
         # pallas_call's out_shape carries no vma info
-        check_vma=False))
+        check_vma=False)), "fcma.sharded_gram", span="fcma.block")
 
 
 @partial(jax.jit, static_argnames=("epochs_per_subj", "interpret",
@@ -133,6 +137,14 @@ def _block_gram_xla(blk, data2, epochs_per_subj, precision=None):
                       preferred_element_type=jnp.float32)
     corr = within_subject_normalization(corr, epochs_per_subj)
     return _gram_and_shrink(corr, precision)
+
+
+# cost attribution for the unsharded Gram program (the sharded
+# variant is profiled inside its builder above); under an ambient
+# trace (_block_gram_pallas's VMEM-overflow fallback) the wrapper
+# bypasses straight to the jitted function
+_block_gram_xla = obs_profile.profile_program(
+    _block_gram_xla, "fcma.block_gram", span="fcma.block")
 
 
 @partial(jax.jit, static_argnames=("epochs_per_subj", "precision"))

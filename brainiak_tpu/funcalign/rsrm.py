@@ -21,6 +21,7 @@ from sklearn.base import BaseEstimator, TransformerMixin
 from sklearn.exceptions import NotFittedError
 from sklearn.utils import assert_all_finite
 
+from ..obs import profile as obs_profile
 from ..resilience.guards import (array_digest, check_state,
                                  make_device_carry_chunk,
                                  run_resilient_loop)
@@ -58,6 +59,12 @@ def _rsrm_chunk(x, w, s, r, gamma, n_steps):
     return jax.lax.fori_loop(0, n_steps, body, (w, s, r))
 
 
+# cost attribution: host-called by the checkpointed fit path; inside
+# the one-shot _fit_rsrm program the wrapper sees tracers and bypasses
+_rsrm_chunk = obs_profile.profile_program(
+    _rsrm_chunk, "rsrm.chunk", span="fit_chunk", estimator="RSRM.fit")
+
+
 @jax.jit
 def _rsrm_objective(x, w, s, r, gamma):
     return 0.5 * jnp.sum(
@@ -74,6 +81,10 @@ def _fit_rsrm(x, voxel_counts, key, gamma, features, n_iter):
     r = _shared_response(x, s, w, n_subjects)
     w, s, r = _rsrm_chunk(x, w, s, r, gamma, n_steps=n_iter)
     return w, s, r, _rsrm_objective(x, w, s, r, gamma)
+
+
+# cost attribution for the one-shot (non-checkpointed) fit program
+_fit_rsrm = obs_profile.profile_program(_fit_rsrm, "rsrm.fit")
 
 
 @partial(jax.jit, static_argnames=("n_iter",))

@@ -34,6 +34,7 @@ from sklearn.base import BaseEstimator, TransformerMixin
 from sklearn.exceptions import NotFittedError
 from sklearn.utils import assert_all_finite
 
+from ..obs import profile as obs_profile
 from ..parallel.mesh import (DEFAULT_SUBJECT_AXIS, fetch_replicated,
                              place_on_mesh)
 from ..resilience.guards import (array_digest, check_state,
@@ -240,6 +241,14 @@ def _em_chunk(x, trace_xtx, voxel_counts, w, rho2, sigma_s, shared,
                              (w, rho2, sigma_s, shared))
 
 
+# cost attribution (schema-v2 `cost` records when profiling is on):
+# the checkpointed fit path calls this program from the host, so the
+# wrapper sees concrete arrays there; inside the one-shot
+# _fit_prob_srm program it sees tracers and bypasses
+_em_chunk = obs_profile.profile_program(
+    _em_chunk, "srm.em_chunk", span="fit_chunk", estimator="SRM.fit")
+
+
 def _final_log_likelihood(x, w, rho2, sigma_s, trace_xtx, voxel_counts):
     """Marginal log-likelihood at the current EM state (shared by the
     plain and checkpointed fit paths)."""
@@ -267,8 +276,9 @@ def _fit_prob_srm(x, trace_xtx, voxel_counts, key, features, n_iter):
     return w, rho2, sigma_s, shared, ll
 
 
-_fit_prob_srm_jit = jax.jit(_fit_prob_srm,
-                            static_argnames=("features", "n_iter"))
+_fit_prob_srm_jit = obs_profile.profile_program(
+    jax.jit(_fit_prob_srm, static_argnames=("features", "n_iter")),
+    "srm.fit_prob")
 
 
 
@@ -287,6 +297,11 @@ def _det_chunk(x, w, shared, n_steps):
     return jax.lax.fori_loop(0, n_steps, body, (w, shared))
 
 
+_det_chunk = obs_profile.profile_program(
+    _det_chunk, "srm.det_chunk", span="fit_chunk",
+    estimator="DetSRM.fit")
+
+
 @jax.jit
 def _det_objective(x, w, shared):
     return jnp.sum(
@@ -303,8 +318,9 @@ def _fit_det_srm(x, voxel_counts, key, features, n_iter):
     return w, shared, _det_objective(x, w, shared)
 
 
-_fit_det_srm_jit = jax.jit(_fit_det_srm,
-                           static_argnames=("features", "n_iter"))
+_fit_det_srm_jit = obs_profile.profile_program(
+    jax.jit(_fit_det_srm, static_argnames=("features", "n_iter")),
+    "srm.fit_det")
 
 
 def _stack_and_pad(X, dtype, demean=True):

@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 from scipy.spatial.distance import squareform
 
+from .obs import profile as obs_profile
 from .obs import runtime as obs_runtime
 from .obs import spans as obs_spans
 from .parallel.mesh import (DEFAULT_VOXEL_AXIS, fetch_replicated,
@@ -163,10 +164,13 @@ def _slab_program(mesh, chunk):
     caches on function identity, so a fresh lambda per
     ``_fetch_ring_matrix`` call would re-lower the broadcast on
     every fetch (jaxlint JX001).  Cache misses count as
-    ``retrace_total{site=isc.slab}``."""
-    return jax.jit(
+    ``retrace_total{site=isc.slab}``; under cost profiling the
+    program's first run captures a ``cost`` record joined to
+    ``isc.ring_slab`` span durations."""
+    return obs_profile.profile_program(jax.jit(
         lambda a, i: jax.lax.dynamic_slice_in_dim(a, i, chunk, 0),
-        out_shardings=NamedSharding(mesh, PartitionSpec()))
+        out_shardings=NamedSharding(mesh, PartitionSpec())),
+        "isc.slab", span="isc.ring_slab")
 
 
 def _fetch_ring_matrix(m, mesh):

@@ -15,7 +15,21 @@ PR 1's resilience events and PR 2's retrace lint:
   per-host JSON-lines files (env ``BRAINIAK_TPU_OBS_DIR``) and an
   in-memory sink for tests;
 - :mod:`~brainiak_tpu.obs.report` — ``python -m brainiak_tpu.obs
-  report`` aggregates JSONL into per-stage/per-estimator summaries.
+  report`` aggregates JSONL into per-stage/per-estimator summaries
+  (``--top N`` lists the slowest spans; cost rows carry roofline
+  ratios);
+- :mod:`~brainiak_tpu.obs.profile` (PR 4) — XLA cost attribution:
+  ``profile_program`` captures FLOPs/bytes/compile-time ``cost``
+  records (schema v2) for the framework's jitted programs, activated
+  by ``BRAINIAK_TPU_OBS_PROFILE``; ``memory_watermark`` snapshots
+  HBM/host peaks around fit chunks;
+- :mod:`~brainiak_tpu.obs.export` (PR 4) — ``python -m
+  brainiak_tpu.obs export`` renders per-rank JSONL sinks into one
+  Chrome-trace/Perfetto timeline with topology-anchored clock-skew
+  merge;
+- :mod:`~brainiak_tpu.obs.regress` (PR 4) — ``python -m
+  brainiak_tpu.obs regress`` gates fresh bench numbers against the
+  tier-separated BENCH_* history.
 
 Disabled by default: with no sink configured every instrumentation
 site is a no-op (no records, no ``block_until_ready`` host syncs).
@@ -38,6 +52,13 @@ from .metrics import (  # noqa: F401
     gauge,
     histogram,
 )
+from .profile import (  # noqa: F401
+    PROFILE_ENV,
+    memory_watermark,
+    profile_level,
+    profile_program,
+    profiling,
+)
 from .report import validate_bench_record  # noqa: F401
 from .runtime import (  # noqa: F401
     counted_cache,
@@ -48,6 +69,7 @@ from .runtime import (  # noqa: F401
 )
 from .sink import (  # noqa: F401
     OBS_DIR_ENV,
+    OBS_MAX_MB_ENV,
     SCHEMA_VERSION,
     JsonlSink,
     MemorySink,
@@ -70,6 +92,8 @@ from .spans import (  # noqa: F401
 
 __all__ = [
     "OBS_DIR_ENV",
+    "OBS_MAX_MB_ENV",
+    "PROFILE_ENV",
     "SCHEMA_VERSION",
     "Counter",
     "Gauge",
@@ -92,6 +116,10 @@ __all__ = [
     "histogram",
     "install_compile_listener",
     "make_record",
+    "memory_watermark",
+    "profile_level",
+    "profile_program",
+    "profiling",
     "remove_sink",
     "reset_stage_times",
     "span",

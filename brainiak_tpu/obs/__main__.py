@@ -1,8 +1,28 @@
-"""``python -m brainiak_tpu.obs`` — the obs CLI (report command)."""
+"""``python -m brainiak_tpu.obs`` — the obs CLI.
+
+Subcommands: ``report`` (aggregate summaries,
+:mod:`~brainiak_tpu.obs.report`), ``export`` (Chrome-trace timeline,
+:mod:`~brainiak_tpu.obs.export`), ``regress`` (bench regression
+gate, :mod:`~brainiak_tpu.obs.regress`).
+"""
 
 import sys
 
-from .report import main
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    command = argv[0] if argv else None
+    if command == "export":
+        from .export import main as sub
+        return sub(argv[1:])
+    if command == "regress":
+        from .regress import main as sub
+        return sub(argv[1:])
+    # report.main owns the legacy parser (including the error message
+    # for an unknown/missing subcommand)
+    from .report import main as sub
+    return sub(argv)
+
 
 if __name__ == "__main__":
     sys.exit(main())

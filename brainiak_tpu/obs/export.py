@@ -1,0 +1,236 @@
+"""Export obs JSONL traces to a Chrome-trace (Perfetto) timeline.
+
+``python -m brainiak_tpu.obs export --format=chrome-trace PATH ...``
+converts one or more per-rank JSONL sinks (files or directories of
+``*.jsonl``) into a single JSON document loadable by
+``chrome://tracing`` and https://ui.perfetto.dev:
+
+- each **rank** becomes a process lane (``pid`` = rank, named
+  ``rank N`` via metadata events);
+- **span** records become complete duration events (``ph="X"``);
+  spans from one thread nest by containment, reconstructing the span
+  tree visually (records carry no thread id, so concurrent same-rank
+  threads share a lane);
+- **event** and **cost** records become instant events (``ph="i"``,
+  process-scoped) carrying their attrs;
+- **metric** records become counter tracks (``ph="C"``): counters
+  plot their running sum, gauges and histogram observations plot the
+  raw value.
+
+Cross-rank clock skew: per-rank wall clocks need not agree (the
+JSONL ``ts`` is host ``time.time()``).  The merge anchors on each
+rank's first ``topology`` event — emitted by ``make_mesh`` on every
+process of a collective mesh build, i.e. at (close to) the same true
+instant — and shifts each rank so those anchors coincide with the
+reference rank's.  Ranks without a topology event are passed through
+unshifted.  Timestamps are exported relative to the earliest
+adjusted event, in microseconds (the Chrome trace unit).
+
+This module imports neither jax nor numpy — exports run anywhere.
+"""
+
+import argparse
+import json
+import sys
+
+from .report import iter_jsonl_paths, load_records
+
+__all__ = ["chrome_trace", "main", "rank_offsets",
+           "validate_chrome_trace"]
+
+#: ``ph`` values the exporter emits; :func:`validate_chrome_trace`
+#: accepts exactly these.
+_PHASES = ("X", "i", "C", "M")
+
+
+def rank_offsets(records):
+    """Per-rank clock offsets (seconds to SUBTRACT from ``ts``).
+
+    The reference is the lowest rank that has a ``topology`` event;
+    every other anchored rank is shifted so its first topology event
+    lands at the reference's instant.  ``{}`` when fewer than two
+    ranks are anchored (nothing to reconcile).
+    """
+    anchors = {}
+    for rec in records:
+        if rec["kind"] == "event" and rec["name"] == "topology":
+            anchors.setdefault(rec["rank"], float(rec["ts"]))
+    if len(anchors) < 2:
+        return {}
+    ref_rank = min(anchors)
+    ref_ts = anchors[ref_rank]
+    return {rank: ts - ref_ts for rank, ts in anchors.items()}
+
+
+def _counter_value(state, rec):
+    """The value a metric record plots: running per-(rank,name,labels)
+    sum for counters, the raw sample otherwise."""
+    value = float(rec["value"])
+    if rec.get("mtype") != "counter":
+        return value
+    key = (rec["rank"], rec["name"],
+           tuple(sorted((rec.get("labels") or {}).items())))
+    state[key] = state.get(key, 0.0) + value
+    return state[key]
+
+
+def _metric_name(rec):
+    labels = rec.get("labels") or {}
+    if not labels:
+        return rec["name"]
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{rec['name']}{{{inner}}}"
+
+
+def chrome_trace(records):
+    """Build the Chrome-trace document for validated obs records."""
+    offsets = rank_offsets(records)
+
+    def adjusted(rec):
+        return float(rec["ts"]) - offsets.get(rec["rank"], 0.0)
+
+    # earliest adjusted instant (span records' ts is their END time)
+    t0 = None
+    for rec in records:
+        start = adjusted(rec)
+        if rec["kind"] == "span":
+            start -= float(rec["dur_s"])
+        t0 = start if t0 is None else min(t0, start)
+    t0 = t0 or 0.0
+
+    def us(seconds):
+        return round((seconds - t0) * 1e6, 3)
+
+    events = []
+    ranks = sorted({rec["rank"] for rec in records})
+    for rank in ranks:
+        events.append({"ph": "M", "name": "process_name",
+                       "pid": rank, "tid": 0,
+                       "args": {"name": f"rank {rank}"}})
+        events.append({"ph": "M", "name": "process_sort_index",
+                       "pid": rank, "tid": 0,
+                       "args": {"sort_index": rank}})
+    counter_state = {}
+    for rec in records:
+        kind = rec["kind"]
+        end = adjusted(rec)
+        if kind == "span":
+            dur = float(rec["dur_s"])
+            events.append({
+                "ph": "X", "name": rec["name"], "cat": "span",
+                "ts": us(end - dur), "dur": round(dur * 1e6, 3),
+                "pid": rec["rank"], "tid": 0,
+                "args": dict(rec.get("attrs") or {},
+                             path=rec["path"]),
+            })
+        elif kind == "metric":
+            events.append({
+                "ph": "C", "name": _metric_name(rec),
+                "ts": us(end), "pid": rec["rank"], "tid": 0,
+                "args": {"value": _counter_value(counter_state, rec)},
+            })
+        else:  # event / cost
+            args = dict(rec.get("attrs") or {})
+            if kind == "cost":
+                args.update({k: rec[k] for k in
+                             ("site", "level", "flops",
+                              "bytes_accessed", "compile_s")
+                             if k in rec})
+            events.append({
+                "ph": "i", "name": rec["name"], "cat": kind,
+                "s": "p", "ts": us(end), "pid": rec["rank"],
+                "tid": 0, "args": args,
+            })
+    # stable viewer ordering: X events must be opened in start order
+    # for nesting; metadata first
+    events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "generator": "brainiak_tpu.obs.export",
+            "clock_offsets_s": {str(r): round(off, 6)
+                                for r, off in offsets.items()},
+        },
+    }
+
+
+def validate_chrome_trace(doc):
+    """Return schema-violation strings for a Chrome-trace document
+    (empty = valid).  Checks the keys the Chrome/Perfetto loaders
+    require: a ``traceEvents`` list whose entries carry ``ph``/
+    ``name``/``pid`` (+ ``ts`` for non-metadata, ``dur`` for complete
+    events), with numeric non-negative timestamps."""
+    errors = []
+    if not isinstance(doc, dict):
+        return ["document is not an object"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _PHASES:
+            errors.append(f"{where}: ph={ph!r} (expected one of "
+                          f"{_PHASES})")
+            continue
+        for key in ("name", "pid"):
+            if key not in ev:
+                errors.append(f"{where}: missing {key!r}")
+        if ph != "M":
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) \
+                    or isinstance(ts, bool) or ts < 0:
+                errors.append(
+                    f"{where}: ts={ts!r} (expected a number >= 0)")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) \
+                    or isinstance(dur, bool) or dur < 0:
+                errors.append(
+                    f"{where}: dur={dur!r} (expected a number >= 0)")
+    return errors
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m brainiak_tpu.obs export",
+        description="export obs JSONL traces to a viewer timeline "
+                    "(docs/observability.md)")
+    parser.add_argument(
+        "paths", nargs="+", metavar="PATH",
+        help="JSONL files or directories of *.jsonl")
+    parser.add_argument("--format", choices=("chrome-trace",),
+                        default="chrome-trace")
+    parser.add_argument(
+        "-o", "--output", metavar="FILE", default=None,
+        help="write the trace JSON here (default: stdout)")
+    args = parser.parse_args(argv)
+
+    files = iter_jsonl_paths(args.paths)
+    if not files:
+        print(f"obs export: no .jsonl files under {args.paths}",
+              file=sys.stderr)
+        return 1
+    records, errors = load_records(files)
+    for err in errors:
+        print(f"obs export: schema violation: {err}",
+              file=sys.stderr)
+    if not records:
+        print("obs export: no valid records to export",
+              file=sys.stderr)
+        return 1
+    doc = chrome_trace(records)
+    payload = json.dumps(doc, indent=2)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(payload + "\n")
+        print(f"obs export: wrote {len(doc['traceEvents'])} events "
+              f"({len(records)} records) to {args.output}",
+              file=sys.stderr)
+    else:
+        print(payload)
+    return 1 if errors else 0

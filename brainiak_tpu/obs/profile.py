@@ -1,0 +1,452 @@
+"""XLA cost profiling: FLOPs/bytes attribution for jitted programs.
+
+PR 3 recorded *when* programs run (spans) and *how often* they
+recompile (``retrace_total``); this module records *what they cost*.
+:func:`profile_program` wraps a jitted program (or the program a
+counted builder returns) so that — while profiling is active — the
+first call per abstract input signature captures a schema-v2 ``cost``
+record: XLA cost-analysis FLOPs / bytes-accessed / transcendentals,
+the HLO module size, and (at the ``compiled`` level) the measured
+compile wall time plus the executable's memory analysis.
+
+Two levels, because the honest compile wall time is not free:
+
+- ``lowered`` (default when profiling is on) — ``fn.lower(*args)``
+  only: one extra trace, **no** extra XLA compile.  Cost analysis
+  comes from the lowered (pre-optimization) HLO, which is exact for
+  FLOPs of the written program.
+- ``compiled`` — additionally ``lowered.compile()`` under a timer:
+  post-optimization cost analysis, ``compile_s``, and memory
+  analysis.  JAX's ahead-of-time compile does NOT warm the jit
+  dispatch cache, so this level pays one extra compile per program
+  signature; use it for dedicated profiling runs, not steady-state
+  telemetry.
+
+Activation: the ``BRAINIAK_TPU_OBS_PROFILE`` env var (``1``/
+``lowered`` or ``compiled``) or the :func:`profiling` context
+manager; records are only emitted while an obs sink is active.  Off
+(the default), every wrapped program adds one attribute check per
+call and nothing else.  Under an ambient trace (a wrapped program
+called from inside another jitted function) the wrapper always
+bypasses straight to the wrapped callable — tracers never reach
+``lower``.
+
+The graceful-degradation contract: a backend without
+``cost_analysis()`` (or a program whose lowering fails) still yields
+a ``cost`` record, with an ``unavailable`` marker naming what was
+missing — downstream tooling sees the site exists rather than
+silently losing it.
+
+:func:`memory_watermark` is the companion runtime snapshot:
+HBM high-water marks (``device.memory_stats``) and host peak RSS,
+emitted as delta gauges around each ``fit_chunk`` in
+:func:`brainiak_tpu.resilience.guards.run_resilient_loop`.
+"""
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+import time
+
+from . import metrics, sink
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "PEAK_FLOPS_ENV",
+    "PROFILE_ENV",
+    "ProfiledProgram",
+    "memory_watermark",
+    "profile_level",
+    "profile_program",
+    "profiling",
+]
+
+PROFILE_ENV = "BRAINIAK_TPU_OBS_PROFILE"
+PEAK_FLOPS_ENV = "BRAINIAK_TPU_PEAK_FLOPS"
+
+#: Nominal peak FLOP/s per platform for roofline ratios, matching the
+#: ceilings ``benchmarks/tpu_mfu.py`` reports against (fp32 HIGHEST
+#: dots run ~6 passes of the bf16 MXU).  Override with
+#: ``BRAINIAK_TPU_PEAK_FLOPS`` (a float); unknown platforms get no
+#: peak and the report simply omits the ratio.
+PLATFORM_PEAK_FLOPS = {
+    "tpu": 197e12 / 6.0,
+}
+
+_LEVELS = ("lowered", "compiled")
+
+# module-level override (profiling() context / tests); None defers to
+# the environment variable
+_level_override = None
+
+
+def profile_level():
+    """Active profiling level: ``None`` (off), ``"lowered"``, or
+    ``"compiled"``.  The :func:`profiling` override wins over the
+    ``BRAINIAK_TPU_OBS_PROFILE`` environment variable (``0``/empty
+    off, ``1`` = lowered)."""
+    if _level_override is not None:
+        return _level_override if _level_override in _LEVELS else None
+    raw = os.environ.get(PROFILE_ENV, "").strip().lower()
+    if raw in ("", "0", "off", "false"):
+        return None
+    if raw in ("compiled", "2"):
+        return "compiled"
+    return "lowered"
+
+
+@contextlib.contextmanager
+def profiling(level="lowered"):
+    """Force cost profiling on (``"lowered"``/``"compiled"``) or off
+    (``None``) for a block, regardless of the environment."""
+    global _level_override
+    if level is not None and level not in _LEVELS:
+        raise ValueError(
+            f"profiling level must be one of {_LEVELS} or None, "
+            f"got {level!r}")
+    prev = _level_override
+    _level_override = level if level is not None else "off"
+    try:
+        yield
+    finally:
+        _level_override = prev
+
+
+def _jax():
+    """The already-imported jax module, or None — never import it
+    (telemetry must not be the first thing to touch a wedged
+    backend)."""
+    return sys.modules.get("jax")
+
+
+def _abstract_key(args, kwargs):
+    """Hashable (treedef, leaf signatures) key for a call, or None
+    when the call must not be profiled (tracer leaves — we are under
+    an ambient trace — or unhashable static leaves).
+
+    Scalar leaves: Python floats are keyed by TYPE, matching jit's
+    weak-type cache (floats here are dynamic hyperparameters — RSRM's
+    ``gamma`` — and keying them by value would pay one extra
+    ``lower()`` trace plus a duplicate cost record per sweep point).
+    Ints / bools / strings are keyed by VALUE: in this codebase they
+    are static arguments (``n_steps``, ``features``, ``K``,
+    ``weight_method``) that select a different program with different
+    FLOPs; dynamic scalar ints (the ISC slab start index) arrive as
+    jax arrays and take the shape/dtype path.
+    """
+    jax = _jax()
+    if jax is None:
+        return None
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    parts = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.core.Tracer):
+            return None
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(("a", tuple(shape), str(dtype)))
+        elif isinstance(leaf, float):
+            parts.append(("f", type(leaf).__name__))
+        else:
+            try:
+                hash(leaf)
+            except TypeError:
+                return None
+            parts.append(("s", leaf))
+    return (str(treedef), tuple(parts))
+
+
+def _cost_analysis_dict(stage):
+    """The cost-analysis mapping of a Lowered/Compiled stage, or None.
+    Handles both API generations (dict vs. one-element list)."""
+    try:
+        ca = stage.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return ca if isinstance(ca, dict) else None
+
+
+def _peak_flops(backend):
+    env = os.environ.get(PEAK_FLOPS_ENV)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    return PLATFORM_PEAK_FLOPS.get(backend)
+
+
+def _nonneg(value):
+    """Cost-analysis value as a float field, or None (XLA reports -1
+    for quantities it cannot attribute)."""
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return None
+    return value if value >= 0.0 else None
+
+
+class ProfiledProgram:
+    """Callable proxy adding one-shot cost capture to a jitted program.
+
+    Transparent by construction: every call is forwarded to the
+    wrapped program unchanged (the ahead-of-time stages are used only
+    for *analysis*, never for execution), so wrapping cannot alter
+    numerics, sharding, or dispatch behavior.  Profiling state is
+    per-proxy; builders cached with
+    :func:`~brainiak_tpu.obs.runtime.counted_cache` therefore profile
+    once per (mesh/config key, input signature).
+    """
+
+    def __init__(self, fn, site, span=None, estimator=None):
+        self._fn = fn
+        self.site = site
+        self.span_hint = span
+        self.estimator_hint = estimator
+        self._seen = set()
+        self._lock = threading.Lock()
+        self.__wrapped__ = fn
+        self.__name__ = getattr(fn, "__name__", site)
+
+    def __repr__(self):
+        return f"ProfiledProgram({self.site!r}, {self._fn!r})"
+
+    def __call__(self, *args, **kwargs):
+        level = profile_level()
+        if level is not None and sink.enabled():
+            try:
+                self._maybe_profile(level, args, kwargs)
+            except Exception:  # pragma: no cover - belt and braces
+                logger.exception(
+                    "cost profile of %s failed; continuing unprofiled",
+                    self.site)
+        return self._fn(*args, **kwargs)
+
+    # expose the lru_cache surface when wrapping a cached builder's
+    # program is composed the other way around (builder-level wrap)
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_fn"], name)
+
+    #: Distinct signatures profiled per program before capture stops
+    #: — a bound on ``_seen`` growth (and on extra lowers) in
+    #: long-lived sweep processes; real programs see a handful.
+    MAX_SIGNATURES = 512
+
+    def _maybe_profile(self, level, args, kwargs):
+        key = _abstract_key(args, kwargs)
+        if key is None:
+            return
+        with self._lock:
+            if (level, key) in self._seen:
+                return
+            if len(self._seen) >= self.MAX_SIGNATURES:
+                return
+            # mark before the (slow) capture: a concurrent caller
+            # must not profile the same signature twice
+            self._seen.add((level, key))
+        self._capture(level, args, kwargs)
+
+    def _capture(self, level, args, kwargs):
+        jax = _jax()
+        backend = None
+        if jax is not None:
+            try:
+                backend = jax.default_backend()
+            except Exception:
+                backend = None
+        fields = {"site": self.site, "level": level,
+                  "backend": backend, "span": self.span_hint,
+                  "estimator": self.estimator_hint}
+        lower = getattr(self._fn, "lower", None)
+        lowered = None
+        if lower is None:
+            fields["unavailable"] = "not-lowerable"
+        else:
+            try:
+                lowered = lower(*args, **kwargs)
+            except Exception as exc:
+                logger.debug("lowering %s for cost profile failed: %s",
+                             self.site, exc)
+                fields["unavailable"] = (
+                    f"lower-failed:{type(exc).__name__}")
+        compiled = None
+        if lowered is not None:
+            try:
+                text = lowered.as_text()
+                fields["hlo_bytes"] = len(text)
+                fields["hlo_lines"] = text.count("\n") + 1
+            except Exception:
+                pass
+            if level == "compiled":
+                t0 = time.perf_counter()
+                try:
+                    compiled = lowered.compile()
+                    fields["compile_s"] = time.perf_counter() - t0
+                except Exception as exc:
+                    logger.debug(
+                        "AOT compile of %s for cost profile "
+                        "failed: %s", self.site, exc)
+                    fields["unavailable"] = (
+                        f"compile-failed:{type(exc).__name__}")
+            # post-optimization numbers when available, else the
+            # lowered estimate — marked, so a record that SAYS
+            # compiled never silently carries pre-optimization FLOPs
+            ca = _cost_analysis_dict(compiled) if compiled is not None \
+                else None
+            if ca is None:
+                if level == "compiled":
+                    fields.setdefault("unavailable",
+                                      "compiled-cost-analysis")
+                ca = _cost_analysis_dict(lowered)
+            if ca is None:
+                fields.setdefault("unavailable", "cost_analysis")
+            else:
+                fields["flops"] = _nonneg(ca.get("flops"))
+                fields["bytes_accessed"] = _nonneg(
+                    ca.get("bytes accessed"))
+                fields["transcendentals"] = _nonneg(
+                    ca.get("transcendentals"))
+            if compiled is not None:
+                mem = self._memory_fields(compiled)
+                if mem:
+                    fields["attrs"] = mem
+        peak = _peak_flops(backend)
+        if peak:
+            fields["peak_flops"] = peak
+        sink.emit(sink.make_record("cost", self.site, **{
+            k: v for k, v in fields.items() if v is not None}))
+        metrics.counter(
+            "cost_profile_total",
+            help="cost records captured per site").inc(site=self.site)
+
+    @staticmethod
+    def _memory_fields(compiled):
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:
+            return None
+        out = {}
+        for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                     "temp_size_in_bytes",
+                     "generated_code_size_in_bytes"):
+            val = getattr(mem, attr, None)
+            if isinstance(val, int):
+                out[attr.replace("_size_in_bytes", "_bytes")] = val
+        return out or None
+
+
+def profile_program(fn, site, span=None, estimator=None):
+    """Wrap a jitted program in a :class:`ProfiledProgram`.
+
+    Parameters
+    ----------
+    fn : callable
+        A ``jax.jit``-ed callable (anything with ``.lower``); plain
+        callables are tolerated and record ``unavailable``.
+    site : str
+        Attribution label, conventionally matching the builder's
+        ``counted_cache`` site (``"fcma.sharded_gram"``) so retrace
+        counts and cost records join on one key.
+    span : str, optional
+        Name of the span whose durations measure this program's
+        execution (``"fcma.block"``); the report CLI joins cost and
+        span records through it to compute achieved throughput.
+    estimator : str, optional
+        ``estimator`` span attribute to additionally require in that
+        join, for programs that run under the shared ``fit_chunk``
+        span (``"SRM.fit"``).
+    """
+    return ProfiledProgram(fn, site, span=span, estimator=estimator)
+
+
+# -- memory watermarks ------------------------------------------------
+
+def _device_peaks():
+    """Max over local devices of (peak_bytes_in_use, bytes_in_use), or
+    (None, None) when the backend exposes no memory stats (CPU) or is
+    not yet initialized (``sink.backend_initialized``):
+    ``jax.local_devices()`` would INITIALIZE the backend — a blocking
+    first device touch on a wedged TPU tunnel — and a watermark read
+    must never be the thing that first touches the device (a
+    checkpointed fit can resume to completion without any device
+    call)."""
+    if not sink.backend_initialized():
+        return None, None
+    jax = _jax()
+    peak = in_use = None
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return None, None
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        if "peak_bytes_in_use" in stats:
+            val = int(stats["peak_bytes_in_use"])
+            peak = val if peak is None else max(peak, val)
+        if "bytes_in_use" in stats:
+            val = int(stats["bytes_in_use"])
+            in_use = val if in_use is None else max(in_use, val)
+    return peak, in_use
+
+
+def _host_rss_bytes():
+    try:
+        import resource
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except Exception:  # pragma: no cover - non-unix
+        return None
+    # linux reports kilobytes (macOS bytes; both monotonic peaks)
+    return int(rss) * (1 if sys.platform == "darwin" else 1024)
+
+
+def memory_watermark(estimator=None, before=None):
+    """Snapshot HBM / host-memory high-water marks.
+
+    With no arguments, returns ``{"hbm_peak", "hbm_in_use",
+    "host_rss"}`` (entries None where the backend has no stats) —
+    cheap enough to take before every fit chunk.  With ``estimator``
+    and a ``before`` snapshot, additionally sets the delta gauges:
+
+    - ``hbm_peak_bytes{estimator=}`` — growth of the device
+      high-water mark across the chunk (the first chunk of a fit is
+      where the working set peaks; later chunks read ~0);
+    - ``hbm_bytes_in_use{estimator=}`` — absolute live bytes after
+      the chunk;
+    - ``host_peak_rss_bytes{estimator=}`` — absolute host peak RSS.
+
+    Never initializes a backend and never raises: on CPU (no
+    ``memory_stats``) only the host gauge is set.
+    """
+    peak, in_use = _device_peaks()
+    snap = {"hbm_peak": peak, "hbm_in_use": in_use,
+            "host_rss": _host_rss_bytes()}
+    if estimator is None:
+        return snap
+    if peak is not None:
+        prev = (before or {}).get("hbm_peak") or 0
+        metrics.gauge(
+            "hbm_peak_bytes", unit="bytes",
+            help="device high-water-mark growth per fit chunk").set(
+                max(peak - prev, 0), estimator=estimator)
+    if in_use is not None:
+        metrics.gauge(
+            "hbm_bytes_in_use", unit="bytes",
+            help="live device bytes after a fit chunk").set(
+                in_use, estimator=estimator)
+    if snap["host_rss"] is not None:
+        metrics.gauge(
+            "host_peak_rss_bytes", unit="bytes",
+            help="host peak RSS").set(
+                snap["host_rss"], estimator=estimator)
+    return snap

@@ -1,0 +1,325 @@
+"""Noise-aware bench regression gating over the BENCH_* trajectory.
+
+``python -m brainiak_tpu.obs regress --history PATH [...]`` reads the
+repo's accumulated bench records (``BENCH_r*.json`` round files, the
+committed ``tools/bench_fixture/`` history, directories, JSONL — any
+mix), separates them into **tiers**, and decides whether the newest
+record of each tier is a regression against that tier's own history:
+
+- **tier separation** — a ``cpu_fallback`` record is never compared
+  against an on-chip baseline (the r05 record is ~10x below the last
+  on-chip rate for reasons that have nothing to do with the code);
+  the tier comes from the record's ``tier`` field, falling back to
+  the legacy metric-name marker (``_CPU_FALLBACK_``) for pre-tier
+  history;
+- **noise awareness** — the baseline is the *median* of the tier's
+  history, so one outlier round cannot poison the verdict, and the
+  pass bar is a *relative* threshold (default: the fresh value must
+  reach ``0.7 x`` median for higher-is-better metrics);
+- **min history** — with fewer than ``--min-history`` (default 2)
+  prior records a tier is reported ``insufficient_history`` and does
+  not gate; a brand-new tier must not fail CI on its first record.
+
+The fresh sample is ``--fresh FILE`` (or ``-`` for stdin, i.e. piped
+straight from ``python bench.py``); without it, the newest history
+record of each tier gates against the records before it — the mode
+the ``regress`` gate of ``tools/run_checks.py`` runs on the committed
+fixture.  The verdict is machine-readable (``--format=json``) and the
+exit status is the gate: 0 pass, 1 regression (the offending metric
+is named in the message), 2 no usable records.
+
+Record trust: every candidate must pass
+:func:`brainiak_tpu.obs.report.validate_bench_record` (which checks
+the v2 ``schema_version``/``git_commit`` provenance stamps when
+present); invalid records are skipped and reported, never compared.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from .report import validate_bench_record
+
+__all__ = ["DEFAULT_MIN_HISTORY", "DEFAULT_THRESHOLD", "evaluate",
+           "load_bench_records", "main", "tier_of"]
+
+DEFAULT_THRESHOLD = 0.7
+DEFAULT_MIN_HISTORY = 2
+
+#: Legacy marker bench.py appended to the metric name before the
+#: ``tier`` field existed (rounds r01-r04).
+_LEGACY_CPU_MARKER = "_CPU_FALLBACK_tpu_unresponsive"
+
+
+def tier_of(rec):
+    """The comparison tier of a bench record (``tier`` field, legacy
+    metric-name marker, else ``"unknown"``)."""
+    tier = rec.get("tier")
+    if isinstance(tier, str) and tier:
+        return tier
+    if _LEGACY_CPU_MARKER.strip("_") in str(rec.get("metric", "")):
+        return "cpu_fallback"
+    return "unknown"
+
+
+def _base_metric(rec):
+    """Metric family with the legacy tier marker stripped, so one
+    tier's records group together across the schema generations."""
+    return str(rec.get("metric", "")).replace(_LEGACY_CPU_MARKER, "")
+
+
+def _normalize_legacy(rec):
+    """Backfill the ``tier`` field on pre-tier rounds (r01-r04 carry
+    the tier only as a metric-name marker) so the validator accepts
+    the repo's real history; records with neither stay invalid."""
+    if "tier" not in rec and \
+            _LEGACY_CPU_MARKER in str(rec.get("metric", "")):
+        rec = dict(rec, tier="cpu_fallback")
+    return rec
+
+
+def _candidate_docs(doc):
+    """Bench-record candidates inside one parsed JSON document: the
+    document itself, a round-file wrapper's ``parsed`` payload, or a
+    list of either."""
+    if isinstance(doc, list):
+        for item in doc:
+            yield from _candidate_docs(item)
+    elif isinstance(doc, dict):
+        if "parsed" in doc and isinstance(doc["parsed"], dict):
+            yield doc["parsed"]
+        else:
+            yield doc
+
+
+def _expand(paths):
+    out = []
+    for path in paths:
+        if os.path.isdir(path):
+            out.extend(sorted(
+                p for p in glob.glob(os.path.join(path, "*"))
+                if p.endswith((".json", ".jsonl"))))
+        else:
+            out.append(path)
+    return out
+
+
+def _parse_text(text, label, order_start=0):
+    """Validated bench records out of one blob of JSON text (single
+    document, JSONL, or concatenated lines) — the one code path both
+    files and ``--fresh -`` stdin go through, legacy-tier backfill
+    included.  Returns ``(records, skipped)``."""
+    records = []
+    skipped = []
+    order = order_start
+    docs = []
+    try:
+        docs.append(json.loads(text))
+    except ValueError:
+        # JSONL / concatenated documents: one per non-empty line
+        for lineno, line in enumerate(text.splitlines(), 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                docs.append(json.loads(line))
+            except ValueError:
+                skipped.append(f"{label}:{lineno}: bad JSON")
+    for doc in docs:
+        for cand in _candidate_docs(doc):
+            cand = _normalize_legacy(cand)
+            bad = validate_bench_record(cand)
+            if bad:
+                skipped.append(f"{label}: {'; '.join(bad)}")
+                continue
+            rec = dict(cand)
+            rec["source"] = label
+            rec["order"] = order
+            order += 1
+            records.append(rec)
+    return records, skipped
+
+
+def load_bench_records(paths):
+    """Parse + validate bench records from files/directories.
+
+    Returns ``(records, skipped)``: records are
+    ``{"source", "order", **bench record}`` dicts in chronological
+    order (file name order, then line order — round files sort by
+    name), skipped are ``"source: reason"`` strings for anything that
+    failed :func:`validate_bench_record`.
+    """
+    records = []
+    skipped = []
+    for path in _expand(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError as exc:
+            skipped.append(f"{path}: unreadable ({exc})")
+            continue
+        recs, skips = _parse_text(text, os.path.basename(path),
+                                  order_start=len(records))
+        records.extend(recs)
+        skipped.extend(skips)
+    return records, skipped
+
+
+def evaluate(history, fresh=None, threshold=DEFAULT_THRESHOLD,
+             min_history=DEFAULT_MIN_HISTORY):
+    """Regression checks per (metric family, tier) group.
+
+    ``history``/``fresh`` are record lists from
+    :func:`load_bench_records`; with ``fresh=None`` each group's
+    chronologically newest history record is the sample under test.
+    Returns ``{"verdict": "pass"|"fail"|"skip", "checks": [...]}``
+    where each check carries the group's key, values, ratio, and a
+    ``status`` of ``ok`` / ``regression`` / ``insufficient_history``.
+    Higher values are better (the bench metrics are throughputs).
+    """
+    groups = {}
+    for rec in history:
+        groups.setdefault((_base_metric(rec), tier_of(rec)),
+                          []).append(rec)
+    fresh_by_group = {}
+    if fresh:
+        for rec in fresh:
+            fresh_by_group.setdefault(
+                (_base_metric(rec), tier_of(rec)), []).append(rec)
+    # an explicit fresh run gates ONLY the tiers it produced (a
+    # cpu_fallback round must not re-litigate the whole_brain
+    # history); self-gating mode covers every tier in the history
+    keys = sorted(fresh_by_group) if fresh \
+        else sorted(groups)
+    checks = []
+    for key in keys:
+        metric, tier = key
+        past = sorted(groups.get(key, []),
+                      key=lambda r: r["order"])
+        if key in fresh_by_group:
+            sample = fresh_by_group[key][-1]
+        elif past:
+            sample = past.pop()  # newest history record gates
+        else:
+            continue
+        check = {"metric": metric, "tier": tier,
+                 "value": float(sample["value"]),
+                 "unit": sample.get("unit"),
+                 "source": sample.get("source"),
+                 "n_history": len(past),
+                 "threshold": threshold}
+        if len(past) < min_history:
+            check["status"] = "insufficient_history"
+        else:
+            values = sorted(float(r["value"]) for r in past)
+            mid = len(values) // 2
+            baseline = values[mid] if len(values) % 2 \
+                else 0.5 * (values[mid - 1] + values[mid])
+            ratio = float(sample["value"]) / baseline if baseline \
+                else float("inf")
+            check["baseline_median"] = baseline
+            check["ratio"] = ratio
+            check["status"] = ("regression" if ratio < threshold
+                               else "ok")
+        checks.append(check)
+    if not checks:
+        verdict = "skip"
+    elif any(c["status"] == "regression" for c in checks):
+        verdict = "fail"
+    else:
+        verdict = "pass"
+    return {"verdict": verdict, "checks": checks}
+
+
+def _render_text(result, skipped):
+    lines = []
+    for check in result["checks"]:
+        status = check["status"]
+        head = (f"{check['metric']} [tier {check['tier']}] "
+                f"value={check['value']:.6g}")
+        if status == "insufficient_history":
+            lines.append(
+                f"SKIP {head} ({check['n_history']} prior record(s); "
+                "not enough history to gate)")
+            continue
+        detail = (f"{check['ratio']:.2f}x of median baseline "
+                  f"{check['baseline_median']:.6g} over "
+                  f"{check['n_history']} record(s), threshold "
+                  f"{check['threshold']:.2f}")
+        if status == "regression":
+            lines.append(f"FAIL {head}: regression — {detail}")
+        else:
+            lines.append(f"OK   {head}: {detail}")
+    for note in skipped:
+        lines.append(f"note: skipped {note}")
+    lines.append(f"verdict: {result['verdict']}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m brainiak_tpu.obs regress",
+        description="bench regression gate over BENCH_* history "
+                    "(docs/observability.md)")
+    parser.add_argument(
+        "--history", nargs="+", required=True, metavar="PATH",
+        help="bench history: files, directories, round wrappers, "
+             "JSONL")
+    parser.add_argument(
+        "--fresh", metavar="FILE",
+        help="record under test (a bench.py JSON line; '-' = stdin); "
+             "default: the newest history record per tier")
+    parser.add_argument("--threshold", type=float,
+                        default=DEFAULT_THRESHOLD,
+                        help="min fresh/baseline ratio "
+                             "(default %(default)s)")
+    parser.add_argument("--min-history", type=int,
+                        default=DEFAULT_MIN_HISTORY,
+                        help="prior records required before gating "
+                             "(default %(default)s)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    args = parser.parse_args(argv)
+    if not 0.0 < args.threshold <= 1.0:
+        parser.error("--threshold must be in (0, 1]")
+
+    history, skipped = load_bench_records(args.history)
+    fresh = None
+    if args.fresh:
+        if args.fresh == "-":
+            fresh, extra = _parse_text(sys.stdin.read(), "stdin",
+                                       order_start=10 ** 9)
+        else:
+            fresh, extra = load_bench_records([args.fresh])
+        skipped.extend(extra)
+        if not fresh:
+            print("obs regress: no valid fresh record",
+                  file=sys.stderr)
+            return 2
+    if not history and not fresh:
+        print("obs regress: no usable bench records",
+              file=sys.stderr)
+        return 2
+
+    result = evaluate(history, fresh, threshold=args.threshold,
+                      min_history=args.min_history)
+    if args.format == "json":
+        result["skipped"] = skipped
+        print(json.dumps(result, indent=2))
+    else:
+        print(_render_text(result, skipped))
+    if result["verdict"] == "fail":
+        bad = [c for c in result["checks"]
+               if c["status"] == "regression"]
+        print("obs regress: regression in "
+              + ", ".join(f"{c['metric']} [tier {c['tier']}]"
+                          for c in bad),
+              file=sys.stderr)
+        return 1
+    return 0 if result["verdict"] in ("pass", "skip") else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - module smoke entry
+    sys.exit(main())

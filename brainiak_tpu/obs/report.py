@@ -12,8 +12,16 @@ fails the run, which is what the ``obs`` gate of
 - **events** grouped by name: count;
 - **metrics** aggregated by (name, labels): counters sum their
   increments, gauges keep the last set value, histograms summarize
-  count/sum/min/max/mean.
+  count/sum/min/max/mean;
+- **cost** profiles (schema v2, :mod:`brainiak_tpu.obs.profile`): one
+  row per captured program signature, joined to the span durations
+  named by the record's ``span``/``estimator`` hints to derive
+  achieved FLOP/s and — when the record carries a platform peak — the
+  roofline ratio (achieved / peak; 1.0 would be a compute-bound
+  program running at the hardware ceiling).
 
+``--top N`` additionally lists the N slowest individual spans per
+estimator, so a trace is triageable without exporting to a viewer.
 ``--format=json`` prints the same structure as one JSON document.
 This module imports neither jax nor numpy — reports run anywhere.
 """
@@ -27,12 +35,19 @@ import sys
 from .sink import OBS_DIR_ENV, validate_record
 
 __all__ = ["aggregate", "iter_jsonl_paths", "load_records", "main",
-           "render_text", "validate_bench_record"]
+           "render_text", "top_spans", "validate_bench_record"]
 
 #: Keys a bench.py result record must carry (satellite: BENCH_*.json
 #: drift fails CI instead of confusing the next round).
 BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline", "tier")
 BENCH_STAGE_KEYS = ("data_gen_s", "warm_s", "steady_s")
+
+#: Version ``bench.py`` stamps into its JSON line as
+#: ``schema_version`` (v2 added the stamp itself plus ``git_commit``,
+#: so ``regress.py`` can pin a record to the code that produced it).
+#: Absent on pre-v2 history; when present it must be an int no newer
+#: than this.
+BENCH_SCHEMA_VERSION = 2
 
 
 def validate_bench_record(rec):
@@ -40,7 +55,10 @@ def validate_bench_record(rec):
 
     Requires the headline keys (metric/value/unit/vs_baseline/tier)
     and, when present, a ``stages`` dict holding the per-stage time
-    breakdown (data-gen / compile+warm / steady-state seconds).
+    breakdown (data-gen / compile+warm / steady-state seconds), an
+    int ``schema_version`` (<= :data:`BENCH_SCHEMA_VERSION`) and a
+    string ``git_commit`` — the provenance stamps ``regress.py``
+    trusts.
     """
     errors = []
     if not isinstance(rec, dict):
@@ -58,6 +76,19 @@ def validate_bench_record(rec):
         errors.append("unit is not a string")
     if "tier" in rec and not isinstance(rec["tier"], str):
         errors.append("tier is not a string")
+    sv = rec.get("schema_version")
+    if sv is not None:
+        if not isinstance(sv, int) or isinstance(sv, bool):
+            errors.append(f"schema_version={sv!r} (expected an int)")
+        elif sv > BENCH_SCHEMA_VERSION:
+            errors.append(
+                f"schema_version={sv} is newer than supported "
+                f"({BENCH_SCHEMA_VERSION})")
+    commit = rec.get("git_commit")
+    if commit is not None and (not isinstance(commit, str)
+                               or not commit):
+        errors.append(f"git_commit={commit!r} (expected a non-empty "
+                      "string)")
     stages = rec.get("stages")
     if stages is not None:
         if not isinstance(stages, dict):
@@ -118,14 +149,102 @@ def _labels_id(labels):
     return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
 
 
+_COST_KEYS = ("site", "level", "backend", "flops", "bytes_accessed",
+              "transcendentals", "compile_s", "hlo_bytes",
+              "hlo_lines", "peak_flops", "span", "estimator",
+              "unavailable")
+
+
+def _roofline(cost_rows, span_rows):
+    """Join cost rows to span aggregates through their ``span`` /
+    ``estimator`` hints, deriving achieved FLOP/s and the roofline
+    ratio in place.
+
+    The joined span's count approximates executions of the profiled
+    program (the span may include slicing/host overhead, so the
+    achieved number is a floor); rows without a hint, a match, or a
+    FLOPs figure simply stay unannotated.  When SEVERAL cost rows of
+    one site share a join target (a checkpointed fit compiles both a
+    full and a remainder chunk program, and their executions cannot
+    be apportioned between the shared ``fit_chunk`` spans), the whole
+    group stays unannotated too — charging each program's FLOPs to
+    every span would overstate throughput and break the floor
+    semantics documented in docs/performance.md.
+    """
+    joins = {}
+    for row in cost_rows:
+        if row.get("span") and row.get("flops"):
+            key = (row["site"], row["span"], row.get("estimator"))
+            joins[key] = joins.get(key, 0) + 1
+    for row in cost_rows:
+        hint = row.get("span")
+        flops = row.get("flops")
+        if not hint or not flops:
+            continue
+        if joins[(row["site"], hint, row.get("estimator"))] > 1:
+            continue
+        count = 0
+        total_s = 0.0
+        for srow in span_rows:
+            if srow["path"].split("/")[-1] != hint:
+                continue
+            if row.get("estimator") and \
+                    srow["estimator"] != row["estimator"]:
+                continue
+            count += srow["count"]
+            total_s += srow["total_s"]
+        if not count or total_s <= 0.0:
+            continue
+        achieved = flops * count / total_s
+        row["achieved_flops_per_s"] = achieved
+        peak = row.get("peak_flops")
+        if peak:
+            row["roofline_ratio"] = achieved / peak
+
+
+def top_spans(records, n):
+    """The ``n`` slowest individual span records per estimator.
+
+    Returns ``[{"estimator", "spans": [{path, dur_s, ts, rank}]}]``
+    sorted by each group's slowest span descending; spans without an
+    ``estimator`` attr group under ``None``.
+    """
+    groups = {}
+    for rec in records:
+        if rec["kind"] != "span":
+            continue
+        attrs = rec.get("attrs") or {}
+        est = attrs.get("estimator")
+        groups.setdefault(
+            str(est) if est is not None else None, []).append(rec)
+    out = []
+    for est, recs in groups.items():
+        recs.sort(key=lambda r: -float(r["dur_s"]))
+        out.append({
+            "estimator": est,
+            "spans": [{"path": r["path"],
+                       "dur_s": float(r["dur_s"]),
+                       "ts": float(r["ts"]),
+                       "rank": int(r["rank"])}
+                      for r in recs[:n]],
+        })
+    out.sort(key=lambda g: -g["spans"][0]["dur_s"])
+    return out
+
+
 def aggregate(records):
     """Summary dict over validated records (see module docstring)."""
     spans = {}
     events = {}
     metrics = {}
+    costs = []
     for rec in records:
         kind = rec["kind"]
-        if kind == "span":
+        if kind == "cost":
+            row = {k: rec[k] for k in _COST_KEYS if k in rec}
+            row["rank"] = rec["rank"]
+            costs.append(row)
+        elif kind == "span":
             attrs = rec.get("attrs") or {}
             key = (rec["path"], str(attrs.get("estimator", "")))
             cur = spans.setdefault(
@@ -179,12 +298,15 @@ def aggregate(records):
         metric_rows.append(cur)
     metric_rows.sort(key=lambda r: (r["name"],
                                     _labels_id(r["labels"])))
+    costs.sort(key=lambda r: (r["site"], r.get("level") or ""))
+    _roofline(costs, span_rows)
     return {
         "n_records": len(records),
         "spans": span_rows,
         "events": [{"name": name, "count": count}
                    for name, count in sorted(events.items())],
         "metrics": metric_rows,
+        "cost": costs,
     }
 
 
@@ -192,9 +314,23 @@ def _fmt_s(value):
     return f"{value:9.4f}"
 
 
+def _fmt_quantity(value):
+    return "-" if value is None else f"{value:.4g}"
+
+
 def render_text(summary):
     """Human-readable tables for the aggregate summary."""
     lines = [f"records: {summary['n_records']}"]
+    if summary.get("top_spans"):
+        lines.append("")
+        lines.append(f"slowest spans (top {summary['top_n']} per "
+                     "estimator):")
+        for group in summary["top_spans"]:
+            label = group["estimator"] or "(no estimator)"
+            lines.append(f"  {label}:")
+            for row in group["spans"]:
+                lines.append(f"    {_fmt_s(row['dur_s'])}s  "
+                             f"rank {row['rank']}  {row['path']}")
     if summary["spans"]:
         lines.append("")
         lines.append("spans (by path):")
@@ -213,6 +349,26 @@ def render_text(summary):
         lines.append("events:")
         for row in summary["events"]:
             lines.append(f"  {row['count']:>6}  {row['name']}")
+    if summary.get("cost"):
+        lines.append("")
+        lines.append("cost profiles:")
+        for row in summary["cost"]:
+            parts = [f"flops={_fmt_quantity(row.get('flops'))}",
+                     f"bytes={_fmt_quantity(row.get('bytes_accessed'))}"]
+            if row.get("compile_s") is not None:
+                parts.append(f"compile_s={row['compile_s']:.3f}")
+            if row.get("achieved_flops_per_s") is not None:
+                parts.append(
+                    "achieved="
+                    f"{row['achieved_flops_per_s'] / 1e9:.3g} GFLOP/s")
+            if row.get("roofline_ratio") is not None:
+                parts.append(
+                    f"roofline={row['roofline_ratio']:.2%}")
+            if row.get("unavailable"):
+                parts.append(f"unavailable={row['unavailable']}")
+            lines.append(f"  {row['site']} "
+                         f"[{row.get('level') or '?'}] "
+                         + " ".join(parts))
     if summary["metrics"]:
         lines.append("")
         lines.append("metrics:")
@@ -238,7 +394,9 @@ def render_text(summary):
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m brainiak_tpu.obs",
-        description="obs trace tools (docs/observability.md)")
+        description="obs trace tools (docs/observability.md); the "
+                    "export and regress subcommands live in "
+                    "brainiak_tpu.obs.export / .regress")
     sub = parser.add_subparsers(dest="command", required=True)
     rep = sub.add_parser(
         "report", help="aggregate JSONL traces into a summary")
@@ -248,6 +406,9 @@ def main(argv=None):
              f"(default: ${OBS_DIR_ENV})")
     rep.add_argument("--format", choices=("text", "json"),
                      default="text")
+    rep.add_argument(
+        "--top", type=int, default=0, metavar="N",
+        help="also list the N slowest individual spans per estimator")
     args = parser.parse_args(argv)
 
     paths = args.paths
@@ -269,6 +430,9 @@ def main(argv=None):
         print(f"obs report: schema violation: {err}",
               file=sys.stderr)
     summary = aggregate(records)
+    if args.top > 0:
+        summary["top_n"] = args.top
+        summary["top_spans"] = top_spans(records, args.top)
     if args.format == "json":
         summary["schema_errors"] = errors
         print(json.dumps(summary, indent=2))

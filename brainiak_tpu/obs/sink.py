@@ -31,13 +31,16 @@ import time
 logger = logging.getLogger(__name__)
 
 __all__ = [
+    "ACCEPTED_VERSIONS",
     "OBS_DIR_ENV",
+    "OBS_MAX_MB_ENV",
     "OBS_RANK_ENV",
     "SCHEMA_VERSION",
     "JsonlSink",
     "MemorySink",
     "add_sink",
     "all_sinks",
+    "backend_initialized",
     "close_all",
     "emit",
     "enabled",
@@ -54,25 +57,41 @@ OBS_RANK_ENV = "BRAINIAK_TPU_OBS_RANK"
 #: Version stamped into every record as ``"v"``.  Bump on any
 #: backwards-incompatible change to the keys below; the report CLI and
 #: the ``obs`` gate of ``tools/run_checks.py`` reject records whose
-#: version or shape they do not understand.
-SCHEMA_VERSION = 1
+#: version or shape they do not understand.  v2 (PR 4) added the
+#: ``cost`` kind (XLA cost-analysis attribution, see
+#: :mod:`brainiak_tpu.obs.profile`); v1 records remain valid, so
+#: pre-existing traces keep loading.
+SCHEMA_VERSION = 2
+ACCEPTED_VERSIONS = (1, 2)
 
-KINDS = ("span", "event", "metric")
+KINDS = ("span", "event", "metric", "cost")
 METRIC_TYPES = ("counter", "gauge", "histogram")
+
+OBS_MAX_MB_ENV = "BRAINIAK_TPU_OBS_MAX_MB"
 
 # backend-derived process rank, cached once resolvable (see
 # process_rank: a process's rank never changes after distributed init)
 _cached_rank = None
 
+_NUM = (int, float)
 _REQUIRED = {
-    "span": {"dur_s": (int, float), "path": str},
+    "span": {"dur_s": _NUM, "path": str},
     "event": {},
-    "metric": {"mtype": str, "value": (int, float)},
+    "metric": {"mtype": str, "value": _NUM},
+    "cost": {"site": str},
 }
 _OPTIONAL = {
     "span": {"attrs": dict},
     "event": {"attrs": dict},
     "metric": {"labels": dict, "unit": str},
+    # cost: FLOPs/bytes may be absent (backend without cost_analysis
+    # reports `unavailable` instead); span/estimator are join hints
+    # for the report CLI's roofline computation
+    "cost": {"flops": _NUM, "bytes_accessed": _NUM,
+             "transcendentals": _NUM, "compile_s": _NUM,
+             "hlo_bytes": int, "hlo_lines": int, "peak_flops": _NUM,
+             "level": str, "backend": str, "span": str,
+             "estimator": str, "unavailable": str, "attrs": dict},
 }
 
 
@@ -87,12 +106,14 @@ def validate_record(rec):
     if not isinstance(rec, dict):
         return ["record is not an object"]
     v = rec.get("v")
-    if v != SCHEMA_VERSION:
-        errors.append(f"v={v!r} (expected {SCHEMA_VERSION})")
+    if v not in ACCEPTED_VERSIONS:
+        errors.append(f"v={v!r} (expected one of {ACCEPTED_VERSIONS})")
     kind = rec.get("kind")
     if kind not in KINDS:
         errors.append(f"kind={kind!r} (expected one of {KINDS})")
         return errors
+    if kind == "cost" and isinstance(v, int) and v < 2:
+        errors.append("cost records require schema v>=2")
     if not isinstance(rec.get("ts"), (int, float)):
         errors.append("ts missing or not a number")
     if not isinstance(rec.get("rank"), int):
@@ -145,15 +166,12 @@ def process_rank():
         # immutable after distributed init — skip the per-record
         # probe cost on instrumented hot paths
         return _cached_rank
+    # jax.process_index() itself would INITIALIZE the backend (a
+    # blocking first device touch); the bridge registry is populated
+    # only after real initialization
+    if not backend_initialized():
+        return 0
     jax = sys.modules.get("jax")
-    if jax is None:
-        return 0
-    # backend-initialized probe: jax.process_index() itself would
-    # INITIALIZE the backend (a blocking first device touch); the
-    # bridge registry is populated only after real initialization
-    bridge = sys.modules.get("jax._src.xla_bridge")
-    if bridge is None or not getattr(bridge, "_backends", None):
-        return 0
     try:
         _cached_rank = int(jax.process_index())
     except Exception:  # backend unreachable mid-teardown
@@ -161,8 +179,23 @@ def process_rank():
     return _cached_rank
 
 
+def backend_initialized():
+    """True when a jax backend is already initialized, checked via
+    the xla_bridge registry WITHOUT touching it — the load-bearing
+    "telemetry must never be the first device touch" probe shared by
+    :func:`process_rank` and
+    :func:`brainiak_tpu.obs.profile.memory_watermark` (on a wedged
+    TPU tunnel, backend init blocks)."""
+    if sys.modules.get("jax") is None:
+        return False
+    bridge = sys.modules.get("jax._src.xla_bridge")
+    return bool(bridge is not None
+                and getattr(bridge, "_backends", None))
+
+
 def make_record(kind, name, **fields):
-    """Build a schema-v1 record envelope around ``fields``."""
+    """Build a :data:`SCHEMA_VERSION` record envelope around
+    ``fields``."""
     rec = {"v": SCHEMA_VERSION, "kind": kind, "ts": time.time(),
            "rank": process_rank(), "name": name}
     rec.update({k: v for k, v in fields.items() if v is not None})
@@ -203,14 +236,34 @@ class JsonlSink:
     process still reports rank 0) go to ``obs-0.jsonl``, and once the
     backend is up the sink reopens under the process's real rank —
     so steady-state records never interleave across hosts.
+
+    ``max_mb`` (default: the ``BRAINIAK_TPU_OBS_MAX_MB`` env var)
+    caps the bytes this sink will write across all its rank files: a
+    multi-day fit with per-chunk spans must not fill the disk.  On
+    reaching the cap the sink writes ONE ``obs_truncated`` event (so
+    the trace records its own incompleteness) and silently drops
+    every later record; the in-process metric registry keeps
+    aggregating regardless.
     """
 
-    def __init__(self, directory, rank=None):
+    def __init__(self, directory, rank=None, max_mb=None):
         self.directory = directory
         self._rank = rank
         self._fh = None
         self._open_path = None
         self._lock = threading.Lock()
+        if max_mb is None:
+            env = os.environ.get(OBS_MAX_MB_ENV)
+            try:
+                max_mb = float(env) if env else None
+            except ValueError:
+                logger.warning("ignoring non-numeric %s=%r",
+                               OBS_MAX_MB_ENV, env)
+                max_mb = None
+        self.max_bytes = None if not max_mb or max_mb <= 0 \
+            else int(max_mb * 1024 * 1024)
+        self._written = 0
+        self._truncated = False
 
     @property
     def path(self):
@@ -229,9 +282,26 @@ class JsonlSink:
 
     def write(self, record):
         with self._lock:
+            if self._truncated:
+                return
+            line = json.dumps(record, default=_json_default) + "\n"
+            if self.max_bytes is not None \
+                    and self._written + len(line) > self.max_bytes:
+                self._truncated = True
+                line = json.dumps(make_record(
+                    "event", "obs_truncated",
+                    attrs={"limit_mb":
+                           self.max_bytes / (1024 * 1024),
+                           "written_bytes": self._written}),
+                    default=_json_default) + "\n"
+                logger.warning(
+                    "obs sink reached %s cap (%.1f MB); dropping "
+                    "further records", OBS_MAX_MB_ENV,
+                    self.max_bytes / (1024 * 1024))
             fh = self._ensure_open()
-            fh.write(json.dumps(record, default=_json_default) + "\n")
+            fh.write(line)
             fh.flush()
+            self._written += len(line)
 
     def flush(self):
         with self._lock:

@@ -22,7 +22,10 @@ chunk.  :func:`run_resilient_loop` drives that shape uniformly:
   runs under a ``fit_chunk`` span and the loop emits
   ``resume``/``rollback``/``checkpoint``/``divergence_abort`` events
   plus ``fit_steps_total``/``rollback_total``/``checkpoint_seconds``
-  metrics, all labeled with the loop ``name`` (disabled: no-ops).
+  metrics and per-chunk memory watermarks
+  (``hbm_peak_bytes``/``hbm_bytes_in_use``/``host_peak_rss_bytes``
+  via :func:`brainiak_tpu.obs.profile.memory_watermark`), all
+  labeled with the loop ``name`` (disabled: no-ops).
 
 The guard granularity is the chunk (``checkpoint_every`` iterations for
 fused on-device loops, which cannot host-inspect intermediate
@@ -37,6 +40,7 @@ import numpy as np
 
 from . import faults
 from ..obs import metrics as obs_metrics
+from ..obs import profile as obs_profile
 from ..obs import sink as obs_sink
 from ..obs import spans as obs_spans
 
@@ -285,12 +289,21 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
             # per-iteration check_state; it gets the same rollback.
             # The span is a no-op while obs is disabled (and never
             # introduces a device sync either way: run_chunk returns
-            # host-checkpointable state by contract).
+            # host-checkpointable state by contract).  Memory
+            # watermarks bracket the chunk: the delta of the device
+            # high-water mark across the chunk becomes
+            # hbm_peak_bytes{estimator=} (never a backend init, never
+            # a sync — memory_stats is a host-side counter read).
+            watermark = obs_profile.memory_watermark() \
+                if obs_sink.enabled() else None
             with obs_spans.span(
                     "fit_chunk",
                     attrs={"estimator": name, "step": step,
                            "n_steps": n_steps}):
                 new_state, done = run_chunk(state, step, n_steps)
+            if watermark is not None:
+                obs_profile.memory_watermark(estimator=name,
+                                             before=watermark)
             new_state = faults.corrupt_state(new_state, step + n_steps,
                                              site=name)
             check_state(new_state, iteration=step + n_steps, where=name,

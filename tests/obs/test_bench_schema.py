@@ -45,3 +45,30 @@ def test_stage_seconds_fills_missing_stages():
     stages = bench._stage_seconds(recs)
     assert stages == {"data_gen_s": 0.0, "warm_s": 0.0,
                       "steady_s": 2.0}
+
+
+# -- PR 4: provenance stamps (schema_version + git commit) ------------
+
+def test_result_record_carries_provenance_stamps():
+    from brainiak_tpu.obs.report import BENCH_SCHEMA_VERSION
+
+    rec = bench._result_record(
+        "cpu_fallback", 100.0, cpu_vps=50.0,
+        stages={"data_gen_s": 0.1, "warm_s": 0.2, "steady_s": 0.3})
+    assert rec["schema_version"] == BENCH_SCHEMA_VERSION
+    # this test runs inside the repo's git checkout
+    assert rec["git_commit"] == bench._git_commit()
+    assert obs.validate_bench_record(rec) == []
+
+
+def test_validator_rejects_bad_stamps():
+    base = bench._result_record("cpu_fallback", 100.0, cpu_vps=50.0)
+    bad_version = dict(base, schema_version="two")
+    assert any("schema_version" in e
+               for e in obs.validate_bench_record(bad_version))
+    futuristic = dict(base, schema_version=99)
+    assert any("newer than supported" in e
+               for e in obs.validate_bench_record(futuristic))
+    bad_commit = dict(base, git_commit="")
+    assert any("git_commit" in e
+               for e in obs.validate_bench_record(bad_commit))

@@ -1,0 +1,159 @@
+"""Chrome-trace export: cross-rank merge, schema, CLI.
+
+Acceptance (ISSUE 4): two synthetic rank sinks with skewed clocks
+must export to one monotonic timeline, and the exported chrome-trace
+JSON must validate (required ``ph``/``ts``/``pid`` keys).
+"""
+
+import json
+
+import pytest
+
+from brainiak_tpu.obs import export, sink as obs_sink
+from brainiak_tpu.obs.report import load_records
+
+#: rank 1's wall clock runs 100 s ahead of rank 0's; both ranks emit
+#: their topology event at the same TRUE instant (the collective
+#: make_mesh), which is the merge anchor.
+SKEW = 100.0
+BASE = 1753900000.0
+
+
+def _rec(kind, name, ts, rank, **fields):
+    rec = {"v": obs_sink.SCHEMA_VERSION, "kind": kind, "ts": ts,
+           "rank": rank, "name": name}
+    rec.update(fields)
+    assert obs_sink.validate_record(rec) == []
+    return rec
+
+
+def _two_rank_trace():
+    r0 = [
+        _rec("event", "topology", BASE + 1.0, 0,
+             attrs={"backend": "cpu", "process_count": 2}),
+        _rec("span", "fit", BASE + 5.0, 0, path="fit", dur_s=3.5),
+        _rec("span", "fit_chunk", BASE + 3.0, 0,
+             path="fit/fit_chunk", dur_s=1.0,
+             attrs={"estimator": "SRM.fit"}),
+        _rec("metric", "fit_steps_total", BASE + 3.1, 0,
+             mtype="counter", value=5.0),
+        _rec("metric", "fit_steps_total", BASE + 4.1, 0,
+             mtype="counter", value=3.0),
+    ]
+    r1 = [
+        _rec("event", "topology", BASE + 1.0 + SKEW, 1,
+             attrs={"backend": "cpu", "process_count": 2}),
+        _rec("span", "fit", BASE + 5.2 + SKEW, 1, path="fit",
+             dur_s=3.6),
+        _rec("cost", "isc.slab", BASE + 2.0 + SKEW, 1,
+             site="isc.slab", flops=100.0),
+    ]
+    return r0, r1
+
+
+def _write_sinks(tmp_path, r0, r1):
+    for rank, recs in ((0, r0), (1, r1)):
+        path = tmp_path / f"obs-{rank}.jsonl"
+        path.write_text(
+            "".join(json.dumps(r) + "\n" for r in recs))
+    return str(tmp_path)
+
+
+def test_rank_offsets_anchor_on_topology():
+    r0, r1 = _two_rank_trace()
+    offsets = export.rank_offsets(r0 + r1)
+    assert offsets[0] == 0.0
+    assert offsets[1] == pytest.approx(SKEW)
+
+
+def test_skewed_ranks_merge_to_one_monotonic_timeline(tmp_path):
+    r0, r1 = _two_rank_trace()
+    records, errors = load_records(
+        [_write_sinks(tmp_path, r0, r1)])
+    assert errors == []
+    doc = export.chrome_trace(records)
+    assert export.validate_chrome_trace(doc) == []
+    timed = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    # monotonic export order, starting at 0
+    ts = [e["ts"] for e in timed]
+    assert ts == sorted(ts)
+    assert min(ts) == 0.0
+    # WITHOUT the merge, rank 1's events sit ~100 s away; with it the
+    # two ranks' anchored topology instants coincide and every event
+    # lands inside the ~9 s true extent of the trace
+    assert max(ts) < 15e6
+    # the two "fit" span lanes overlap in merged time (they truly ran
+    # concurrently), proving rank 1 was shifted back
+    fits = {e["pid"]: e for e in timed
+            if e["ph"] == "X" and e["name"] == "fit"}
+    s0, e0 = fits[0]["ts"], fits[0]["ts"] + fits[0]["dur"]
+    s1, e1 = fits[1]["ts"], fits[1]["ts"] + fits[1]["dur"]
+    assert s0 < e1 and s1 < e0
+
+
+def test_span_nesting_and_counter_running_sum(tmp_path):
+    r0, r1 = _two_rank_trace()
+    doc = export.chrome_trace(r0 + r1)
+    by_name = {}
+    for ev in doc["traceEvents"]:
+        by_name.setdefault(ev["name"], []).append(ev)
+    chunk = by_name["fit_chunk"][0]
+    fit = [e for e in by_name["fit"] if e["pid"] == 0][0]
+    # the chunk nests inside its parent span on the same lane
+    assert fit["ts"] <= chunk["ts"]
+    assert chunk["ts"] + chunk["dur"] <= fit["ts"] + fit["dur"]
+    assert chunk["args"]["path"] == "fit/fit_chunk"
+    # counters plot their running sum (5 then 8), not the increments
+    counters = sorted(by_name["fit_steps_total"],
+                      key=lambda e: e["ts"])
+    assert [c["args"]["value"] for c in counters] == [5.0, 8.0]
+    # cost records ride along as instant events with their fields
+    (cost,) = by_name["isc.slab"]
+    assert cost["ph"] == "i"
+    assert cost["args"]["flops"] == 100.0
+
+
+def test_ranks_without_anchor_pass_through():
+    recs = [_rec("span", "s", BASE + 1.0, 0, path="s", dur_s=0.5)]
+    assert export.rank_offsets(recs) == {}
+    doc = export.chrome_trace(recs)
+    assert export.validate_chrome_trace(doc) == []
+
+
+def test_validate_chrome_trace_catches_violations():
+    assert export.validate_chrome_trace([]) \
+        == ["document is not an object"]
+    assert export.validate_chrome_trace({}) \
+        == ["traceEvents missing or not a list"]
+    bad = {"traceEvents": [
+        {"ph": "Q", "name": "x", "pid": 0, "ts": 1},
+        {"ph": "X", "pid": 0, "ts": 1, "dur": 1},
+        {"ph": "X", "name": "x", "pid": 0, "ts": -5, "dur": 1},
+        {"ph": "X", "name": "x", "pid": 0, "ts": 1},
+    ]}
+    errors = export.validate_chrome_trace(bad)
+    assert len(errors) == 4
+    assert any("ph=" in e for e in errors)
+    assert any("missing 'name'" in e for e in errors)
+    assert any("ts=-5" in e for e in errors)
+    assert any("dur=None" in e for e in errors)
+
+
+def test_cli_writes_loadable_file(tmp_path, capsys):
+    r0, r1 = _two_rank_trace()
+    trace_subdir = tmp_path / "t"
+    trace_subdir.mkdir()
+    trace_dir = _write_sinks(trace_subdir, r0, r1)
+    out = tmp_path / "trace.json"
+    assert export.main([trace_dir, "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert export.validate_chrome_trace(doc) == []
+    assert doc["otherData"]["clock_offsets_s"]["1"] \
+        == pytest.approx(SKEW)
+
+
+def test_cli_rejects_empty_and_schema_violations(tmp_path, capsys):
+    assert export.main([str(tmp_path)]) == 1
+    bad = tmp_path / "obs-0.jsonl"
+    bad.write_text('{"v": 99, "kind": "span"}\n')
+    assert export.main([str(bad)]) == 1

@@ -1,0 +1,271 @@
+"""Cost profiling: schema-v2 ``cost`` records for the jitted sites.
+
+Acceptance (ISSUE 4): cost records are captured for at least 3
+distinct jitted sites — FCMA gram, ISC slab, and a funcalign fit
+program — under the in-memory sink, with FLOPs/bytes populated when
+the backend provides ``cost_analysis()`` and a graceful
+``unavailable`` marker when it does not.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from brainiak_tpu import obs
+from brainiak_tpu.obs import profile as obs_profile
+
+
+@pytest.fixture
+def mem():
+    sink = obs.add_sink(obs.MemorySink())
+    yield sink
+    obs.remove_sink(sink)
+
+
+def _costs(mem, site=None):
+    return [r for r in mem.records if r["kind"] == "cost"
+            and (site is None or r["site"] == site)]
+
+
+def test_profile_off_by_default(mem):
+    prog = obs_profile.profile_program(
+        jax.jit(lambda x: x * 2), "t.prog")
+    prog(jnp.ones(4))
+    assert _costs(mem) == []
+
+
+def test_lowered_level_captures_cost_fields(mem):
+    prog = obs_profile.profile_program(
+        jax.jit(lambda a, b: a @ b), "t.matmul")
+    with obs_profile.profiling("lowered"):
+        prog(jnp.ones((16, 16)), jnp.ones((16, 16)))
+    (rec,) = _costs(mem, "t.matmul")
+    assert obs.validate_record(rec) == []
+    assert rec["v"] == 2
+    assert rec["level"] == "lowered"
+    # XLA:CPU provides cost_analysis: 2*16^3 FLOPs for the matmul
+    assert rec["flops"] == pytest.approx(2 * 16 ** 3, rel=0.5)
+    assert rec["bytes_accessed"] > 0
+    assert rec["hlo_bytes"] > 0
+    assert "compile_s" not in rec  # lowered level never compiles
+
+
+def test_compiled_level_times_the_compile(mem):
+    prog = obs_profile.profile_program(
+        jax.jit(lambda a: jnp.tanh(a).sum()), "t.compiled")
+    with obs_profile.profiling("compiled"):
+        prog(jnp.ones((8, 8)))
+    (rec,) = _costs(mem, "t.compiled")
+    assert rec["level"] == "compiled"
+    assert rec["compile_s"] > 0
+    # memory analysis rides along as attrs
+    assert rec["attrs"]["argument_bytes"] > 0
+
+
+def test_one_record_per_signature(mem):
+    prog = obs_profile.profile_program(
+        jax.jit(lambda x: x + 1), "t.dedup")
+    with obs_profile.profiling("lowered"):
+        prog(jnp.ones(4))
+        prog(jnp.ones(4))          # same shape: no second record
+        prog(jnp.ones(8))          # new shape: second record
+    assert len(_costs(mem, "t.dedup")) == 2
+
+
+def test_tracer_args_bypass(mem):
+    inner = obs_profile.profile_program(
+        jax.jit(lambda x: x * 3), "t.inner")
+
+    @jax.jit
+    def outer(x):
+        return inner(x) + 1
+
+    with obs_profile.profiling("lowered"):
+        out = outer(jnp.ones(4))
+    np.testing.assert_allclose(np.asarray(out), 4.0)
+    assert _costs(mem, "t.inner") == []  # never lowered under trace
+
+
+def test_unavailable_marker_when_backend_lacks_cost_analysis(
+        mem, monkeypatch):
+    jitted = jax.jit(lambda x: x - 1)
+    prog = obs_profile.profile_program(jitted, "t.nocost")
+
+    real_lower = jitted.lower
+
+    class _NoCost:
+        def __init__(self, lowered):
+            self._lowered = lowered
+
+        def as_text(self):
+            return self._lowered.as_text()
+
+        def cost_analysis(self):
+            raise NotImplementedError("backend has no cost model")
+
+    monkeypatch.setattr(
+        prog, "_fn",
+        type("F", (), {
+            "lower": staticmethod(
+                lambda *a, **k: _NoCost(real_lower(*a, **k))),
+            "__call__": staticmethod(jitted),
+        })())
+    with obs_profile.profiling("lowered"):
+        prog(jnp.ones(4))
+    (rec,) = _costs(mem, "t.nocost")
+    assert rec["unavailable"] == "cost_analysis"
+    assert "flops" not in rec
+    assert obs.validate_record(rec) == []
+
+
+def test_not_lowerable_callable_marks_unavailable(mem):
+    prog = obs_profile.profile_program(lambda x: x, "t.plain")
+    with obs_profile.profiling("lowered"):
+        prog(np.ones(4))
+    (rec,) = _costs(mem, "t.plain")
+    assert rec["unavailable"] == "not-lowerable"
+
+
+def test_env_var_levels(monkeypatch):
+    monkeypatch.delenv(obs_profile.PROFILE_ENV, raising=False)
+    assert obs_profile.profile_level() is None
+    monkeypatch.setenv(obs_profile.PROFILE_ENV, "1")
+    assert obs_profile.profile_level() == "lowered"
+    monkeypatch.setenv(obs_profile.PROFILE_ENV, "compiled")
+    assert obs_profile.profile_level() == "compiled"
+    monkeypatch.setenv(obs_profile.PROFILE_ENV, "0")
+    assert obs_profile.profile_level() is None
+    with obs_profile.profiling("compiled"):
+        assert obs_profile.profile_level() == "compiled"
+    with obs_profile.profiling(None):
+        monkeypatch.setenv(obs_profile.PROFILE_ENV, "1")
+        assert obs_profile.profile_level() is None
+
+
+# -- the three acceptance sites ---------------------------------------
+
+def test_fcma_gram_site_captured(mem):
+    from brainiak_tpu.fcma.voxelselector import VoxelSelector
+
+    rng = np.random.RandomState(0)
+    data = [rng.randn(10, 32).astype(np.float32) for _ in range(4)]
+    vs = VoxelSelector([0, 1, 0, 1], 2, 2, data, voxel_unit=16,
+                       use_pallas=False)
+    with obs_profile.profiling("lowered"):
+        results = vs.run('svm')
+    assert len(results) == 32
+    (rec,) = _costs(mem, "fcma.block_gram")
+    assert rec["flops"] > 0
+    assert rec["span"] == "fcma.block"
+
+
+def test_isc_slab_site_captured(mem):
+    from brainiak_tpu.isc import _slab_program
+    from brainiak_tpu.parallel.mesh import DEFAULT_VOXEL_AXIS, \
+        make_mesh
+
+    mesh = make_mesh((DEFAULT_VOXEL_AXIS,), (-1,))
+    prog = _slab_program(mesh, 4)
+    with obs_profile.profiling("lowered"):
+        out = prog(jnp.arange(64.0).reshape(8, 8), jnp.asarray(0))
+    assert out.shape == (4, 8)
+    (rec,) = _costs(mem, "isc.slab")
+    assert rec["span"] == "isc.ring_slab"
+    assert rec["bytes_accessed"] > 0
+
+
+def test_funcalign_fit_site_captured(mem):
+    from brainiak_tpu.funcalign.srm import SRM
+
+    rng = np.random.RandomState(1)
+    X = [rng.randn(30, 20).astype(np.float32) for _ in range(3)]
+    with obs_profile.profiling("lowered"):
+        SRM(n_iter=2, features=4).fit(X)
+    (rec,) = _costs(mem, "srm.fit_prob")
+    assert rec["flops"] > 0
+    assert rec["backend"] == "cpu"
+
+
+# -- memory watermarks ------------------------------------------------
+
+def test_memory_watermark_sets_host_gauge(mem):
+    snap = obs_profile.memory_watermark()
+    assert snap["host_rss"] > 0
+    obs_profile.memory_watermark(estimator="T.fit", before=snap)
+    gauges = [r for r in mem.records
+              if r["kind"] == "metric"
+              and r["name"] == "host_peak_rss_bytes"]
+    assert gauges and gauges[0]["labels"] == {"estimator": "T.fit"}
+    # CPU backend exposes no memory_stats: no HBM gauge, no crash
+    assert not any(r["name"] == "hbm_peak_bytes"
+                   for r in mem.records if r["kind"] == "metric")
+
+
+def test_resilient_loop_emits_watermarks(mem):
+    from brainiak_tpu.resilience.guards import run_resilient_loop
+
+    def chunk(state, step, n):
+        return {"x": state["x"] + n}, False
+
+    run_resilient_loop(chunk, {"x": np.zeros(2)}, 4,
+                       checkpoint_every=2, name="WM.fit")
+    names = {r["name"] for r in mem.records if r["kind"] == "metric"}
+    assert "host_peak_rss_bytes" in names
+
+
+def test_float_scalar_args_share_one_signature(mem):
+    """Dynamic float hyperparameters must not retrigger capture per
+    value (jit keys weak scalars by dtype); static-style ints still
+    select distinct programs (code-review fix)."""
+    prog = obs_profile.profile_program(
+        jax.jit(lambda x, g: x * g), "t.scalar")
+    with obs_profile.profiling("lowered"):
+        prog(jnp.ones(4), 0.5)
+        prog(jnp.ones(4), 0.7)   # same signature: floats key by type
+    assert len(_costs(mem, "t.scalar")) == 1
+
+    chunk = obs_profile.profile_program(
+        jax.jit(lambda x, n: x * n, static_argnames=("n",)),
+        "t.static")
+    with obs_profile.profiling("lowered"):
+        chunk(jnp.ones(4), n=2)
+        chunk(jnp.ones(4), n=3)  # different static: new program
+    assert len(_costs(mem, "t.static")) == 2
+
+
+def test_memory_watermark_never_first_device_touch(mem,
+                                                   monkeypatch):
+    """With jax imported but no backend initialized, the watermark
+    must not call local_devices() (the blocking first device touch
+    on a wedged tunnel) — code-review fix."""
+    import sys as _sys
+    monkeypatch.setitem(_sys.modules, "jax._src.xla_bridge",
+                        type("B", (), {"_backends": {}})())
+
+    def boom():
+        raise AssertionError("local_devices would init the backend")
+
+    monkeypatch.setattr(jax, "local_devices", boom)
+    snap = obs_profile.memory_watermark()
+    assert snap["hbm_peak"] is None
+
+
+def test_compiled_fallback_to_lowered_cost_is_marked(mem,
+                                                     monkeypatch):
+    """A record that says level=compiled must never silently carry
+    pre-optimization numbers (code-review fix)."""
+    monkeypatch.setattr(
+        obs_profile, "_cost_analysis_dict",
+        lambda stage: None if hasattr(stage, "__call__")
+        else {"flops": 1.0})
+    # compiled objects are callable, Lowered is not — the lambda
+    # above fails the compiled stage and answers for the lowered one
+    prog = obs_profile.profile_program(
+        jax.jit(lambda x: x + 2), "t.fallback")
+    with obs_profile.profiling("compiled"):
+        prog(jnp.ones(4))
+    (rec,) = _costs(mem, "t.fallback")
+    assert rec["level"] == "compiled"
+    assert rec["unavailable"] == "compiled-cost-analysis"
+    assert rec["flops"] == 1.0  # the lowered estimate, marked as such

@@ -1,0 +1,177 @@
+"""Bench regression detector: tiers, thresholds, history policy.
+
+Acceptance (ISSUE 4): the gate passes on the committed fixture
+history and fails (non-zero exit, named metric) when the newest
+record is degraded 2x; tier separation is proven by a test where a
+``cpu_fallback`` record is NOT flagged against an on-chip baseline.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from brainiak_tpu.obs import regress
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "..", "..",
+                           "tools", "bench_fixture")
+
+ONCHIP = {"metric": "fcma_voxel_selection_voxels_per_sec_chip",
+          "unit": "voxels/sec", "vs_baseline": 300.0,
+          "tier": "whole_brain"}
+LEGACY_CPU = {"metric": "fcma_voxel_selection_voxels_per_sec_chip"
+                        "_CPU_FALLBACK_tpu_unresponsive",
+              "unit": "voxels/sec", "vs_baseline": 10.0}
+
+
+def _rec(base, value, order, **extra):
+    rec = dict(base, value=value, order=order, source=f"r{order}")
+    rec.update(extra)
+    return rec
+
+
+def test_tier_inference():
+    assert regress.tier_of({"tier": "whole_brain"}) == "whole_brain"
+    assert regress.tier_of(dict(LEGACY_CPU)) == "cpu_fallback"
+    assert regress.tier_of({"metric": "x"}) == "unknown"
+
+
+def test_fixture_history_passes_and_gates():
+    records, skipped = regress.load_bench_records([FIXTURE_DIR])
+    assert len(records) == 5          # the real r01-r05 trajectory
+    assert skipped == []
+    # legacy rounds (no tier field) were normalized, not dropped
+    assert all(regress.tier_of(r) == "cpu_fallback"
+               for r in records)
+    result = regress.evaluate(records)
+    assert result["verdict"] == "pass"
+    (check,) = result["checks"]
+    assert check["status"] == "ok"
+    assert check["n_history"] == 4
+
+
+def test_two_x_degradation_fails_with_named_metric(tmp_path,
+                                                   capsys):
+    for name in os.listdir(FIXTURE_DIR):
+        shutil.copy(os.path.join(FIXTURE_DIR, name), str(tmp_path))
+    with open(os.path.join(FIXTURE_DIR, "r05.json")) as fh:
+        degraded = json.load(fh)
+    degraded["value"] = degraded["value"] / 2.0
+    (tmp_path / "r06.json").write_text(json.dumps(degraded))
+    rc = regress.main(["--history", str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "regression" in captured.err
+    assert "fcma_voxel_selection_voxels_per_sec_chip" in captured.err
+
+
+def test_cpu_fallback_never_compared_to_onchip_baseline():
+    # an on-chip history an order of magnitude above the fresh
+    # cpu_fallback number: tier separation must keep them apart
+    history = [_rec(ONCHIP, 10000.0 + i, i) for i in range(4)]
+    fresh = [_rec(LEGACY_CPU, 1000.0, 99, tier="cpu_fallback")]
+    result = regress.evaluate(history, fresh)
+    # the cpu_fallback sample has NO cpu history: insufficient, not
+    # a regression — and the on-chip tier is not re-gated at all
+    (check,) = result["checks"]
+    assert check["tier"] == "cpu_fallback"
+    assert check["status"] == "insufficient_history"
+    assert result["verdict"] == "pass"
+
+
+def test_median_baseline_resists_outlier_round():
+    values = [1000.0, 1010.0, 990.0, 5000.0]  # one outlier round
+    history = [_rec(ONCHIP, v, i) for i, v in enumerate(values)]
+    fresh = [_rec(ONCHIP, 950.0, 99)]
+    result = regress.evaluate(history, fresh)
+    (check,) = result["checks"]
+    assert check["status"] == "ok"
+    assert check["baseline_median"] == 1005.0
+
+
+def test_threshold_is_configurable():
+    history = [_rec(ONCHIP, 1000.0, i) for i in range(3)]
+    fresh = [_rec(ONCHIP, 800.0, 99)]
+    assert regress.evaluate(history, fresh)["verdict"] == "pass"
+    strict = regress.evaluate(history, fresh, threshold=0.9)
+    assert strict["verdict"] == "fail"
+    (check,) = strict["checks"]
+    assert check["ratio"] == pytest.approx(0.8)
+
+
+def test_min_history_gate():
+    history = [_rec(ONCHIP, 1000.0, 0)]
+    fresh = [_rec(ONCHIP, 100.0, 99)]
+    result = regress.evaluate(history, fresh)
+    assert result["checks"][0]["status"] == "insufficient_history"
+    assert result["verdict"] == "pass"
+    gated = regress.evaluate(history, fresh, min_history=1)
+    assert gated["verdict"] == "fail"
+
+
+def test_loader_understands_wrappers_and_jsonl(tmp_path):
+    # a round wrapper (the BENCH_r* shape), a bare record, and JSONL
+    (tmp_path / "a.json").write_text(json.dumps(
+        {"n": 1, "cmd": "python bench.py", "rc": 0,
+         "parsed": dict(ONCHIP, value=1.0)}))
+    (tmp_path / "b.json").write_text(json.dumps(
+        dict(ONCHIP, value=2.0)))
+    (tmp_path / "c.jsonl").write_text(
+        json.dumps(dict(ONCHIP, value=3.0)) + "\n"
+        + json.dumps({"not": "a bench record"}) + "\n")
+    records, skipped = regress.load_bench_records([str(tmp_path)])
+    assert [r["value"] for r in records] == [1.0, 2.0, 3.0]
+    assert len(skipped) == 1 and "c.jsonl" in skipped[0]
+
+
+def test_schema_version_trust(tmp_path):
+    futuristic = dict(ONCHIP, value=1.0, schema_version=99)
+    (tmp_path / "f.json").write_text(json.dumps(futuristic))
+    records, skipped = regress.load_bench_records([str(tmp_path)])
+    assert records == []
+    assert "schema_version=99" in skipped[0]
+
+
+def test_cli_fresh_mode_and_exit_codes(tmp_path, capsys):
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    for i in range(3):
+        (hist / f"r{i}.json").write_text(
+            json.dumps(dict(ONCHIP, value=1000.0 + i)))
+    good = tmp_path / "fresh.json"
+    good.write_text(json.dumps(dict(ONCHIP, value=980.0)))
+    assert regress.main(["--history", str(hist),
+                         "--fresh", str(good)]) == 0
+    capsys.readouterr()
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(dict(ONCHIP, value=400.0)))
+    assert regress.main(["--history", str(hist), "--fresh",
+                         str(bad), "--format=json"]) == 1
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["verdict"] == "fail"
+    (check,) = verdict["checks"]
+    assert check["status"] == "regression"
+    assert check["metric"] == ONCHIP["metric"]
+    # no usable records at all
+    assert regress.main(["--history", str(tmp_path / "none")]) == 2
+
+
+def test_stdin_fresh_normalizes_legacy_records(tmp_path,
+                                               monkeypatch, capsys):
+    """A pre-tier bench line piped via --fresh - must get the same
+    legacy tier backfill the file path applies (code-review fix)."""
+    import io
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    for i in range(3):
+        (hist / f"r{i}.json").write_text(json.dumps(
+            _rec(LEGACY_CPU, 1000.0 + i, i, tier="cpu_fallback")))
+    legacy_line = json.dumps(dict(LEGACY_CPU, value=990.0))
+    monkeypatch.setattr("sys.stdin", io.StringIO(legacy_line))
+    assert regress.main(["--history", str(hist), "--fresh", "-",
+                         "--format=json"]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    (check,) = verdict["checks"]
+    assert check["tier"] == "cpu_fallback"
+    assert check["status"] == "ok"

@@ -122,3 +122,104 @@ def test_validate_bench_record():
     bad = dict(good, value="fast")
     assert any("value" in e
                for e in obs.validate_bench_record(bad))
+
+
+# -- PR 4: cost rows, roofline join, --top ----------------------------
+
+def _cost_rec(**fields):
+    rec = {"v": 2, "kind": "cost", "ts": 1.0, "rank": 0,
+           "name": fields.get("site", "s")}
+    rec.update(fields)
+    assert obs_sink.validate_record(rec) == []
+    return rec
+
+
+def _span_rec(path, dur_s, ts=1.0, estimator=None):
+    attrs = {"estimator": estimator} if estimator else None
+    rec = {"v": 1, "kind": "span", "ts": ts, "rank": 0,
+           "name": path.split("/")[-1], "path": path,
+           "dur_s": dur_s}
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+def test_cost_rows_join_spans_for_roofline():
+    records = [
+        _cost_rec(site="fcma.sharded_gram", flops=2e9,
+                  span="fcma.block", peak_flops=2e12),
+        _span_rec("fcma.voxel_selection/fcma.block", 0.5),
+        _span_rec("fcma.voxel_selection/fcma.block", 0.5),
+    ]
+    summary = report.aggregate(records)
+    (row,) = summary["cost"]
+    # 2 executions x 2e9 FLOPs / 1.0 s = 4e9 FLOP/s
+    assert row["achieved_flops_per_s"] == pytest.approx(4e9)
+    assert row["roofline_ratio"] == pytest.approx(4e9 / 2e12)
+    text = report.render_text(summary)
+    assert "cost profiles:" in text and "roofline" in text
+
+
+def test_cost_estimator_hint_restricts_the_join():
+    records = [
+        _cost_rec(site="srm.em_chunk", flops=1e6,
+                  span="fit_chunk", estimator="SRM.fit"),
+        _span_rec("fit/fit_chunk", 1.0, estimator="SRM.fit"),
+        _span_rec("fit/fit_chunk", 9.0, estimator="TFA.fit"),
+    ]
+    (row,) = report.aggregate(records)["cost"]
+    # only the SRM.fit second counts: 1e6 FLOPs / 1.0 s
+    assert row["achieved_flops_per_s"] == pytest.approx(1e6)
+
+
+def test_cost_unavailable_row_stays_unannotated():
+    records = [_cost_rec(site="x", unavailable="cost_analysis",
+                         span="fit_chunk"),
+               _span_rec("fit/fit_chunk", 1.0)]
+    (row,) = report.aggregate(records)["cost"]
+    assert "achieved_flops_per_s" not in row
+    assert "unavailable=cost_analysis" in \
+        report.render_text(report.aggregate(records))
+
+
+def test_top_spans_per_estimator():
+    records = [
+        _span_rec("fit/fit_chunk", 0.1, ts=1.0, estimator="SRM.fit"),
+        _span_rec("fit/fit_chunk", 0.9, ts=2.0, estimator="SRM.fit"),
+        _span_rec("fit/fit_chunk", 0.5, ts=3.0, estimator="SRM.fit"),
+        _span_rec("fcma.block", 0.3, ts=4.0),
+    ]
+    groups = report.top_spans(records, 2)
+    assert [g["estimator"] for g in groups] == ["SRM.fit", None]
+    assert [s["dur_s"] for s in groups[0]["spans"]] == [0.9, 0.5]
+    assert [s["dur_s"] for s in groups[1]["spans"]] == [0.3]
+
+
+def test_cli_top_flag(tmp_path, monkeypatch, capsys):
+    _write_trace(tmp_path, monkeypatch)
+    assert report.main(["report", str(tmp_path), "--top", "3",
+                        "--format=json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["top_n"] == 3
+    ests = {g["estimator"] for g in summary["top_spans"]}
+    assert "SRM.fit" in ests
+    assert report.main(["report", str(tmp_path), "--top", "1"]) == 0
+    assert "slowest spans" in capsys.readouterr().out
+
+
+def test_roofline_skips_ambiguous_multi_signature_sites():
+    """Two programs of one site sharing fit_chunk spans (full +
+    remainder chunk) cannot be apportioned — neither row may claim
+    the joined throughput (code-review fix)."""
+    records = [
+        _cost_rec(site="srm.em_chunk", flops=10e6,
+                  span="fit_chunk", estimator="SRM.fit"),
+        _cost_rec(site="srm.em_chunk", flops=5e6,
+                  span="fit_chunk", estimator="SRM.fit"),
+        _span_rec("fit/fit_chunk", 1.0, estimator="SRM.fit"),
+        _span_rec("fit/fit_chunk", 1.0, estimator="SRM.fit"),
+        _span_rec("fit/fit_chunk", 0.5, estimator="SRM.fit"),
+    ]
+    rows = report.aggregate(records)["cost"]
+    assert len(rows) == 2
+    assert all("achieved_flops_per_s" not in r for r in rows)

@@ -155,3 +155,69 @@ def test_jsonl_sink_concurrent_writes(tmp_path):
     assert len(lines) == 400
     for line in lines:  # no interleaved/torn writes
         assert obs.validate_record(json.loads(line)) == []
+
+
+# -- PR 4: schema v2 (cost kind) and the disk-usage cap ---------------
+
+def test_v1_records_still_validate():
+    rec = {"v": 1, "kind": "span", "ts": 1.0, "rank": 0,
+           "name": "s", "path": "s", "dur_s": 0.1}
+    assert obs.validate_record(rec) == []
+
+
+def test_cost_records_require_v2():
+    rec = {"v": 2, "kind": "cost", "ts": 1.0, "rank": 0,
+           "name": "site", "site": "site", "flops": 1.0,
+           "unavailable": "x"}
+    assert obs.validate_record(rec) == []
+    rec["v"] = 1
+    assert any("require schema v>=2" in e
+               for e in obs.validate_record(rec))
+    rec["v"] = 3
+    assert any("v=3" in e for e in obs.validate_record(rec))
+
+
+def test_cost_record_unknown_key_rejected():
+    rec = {"v": 2, "kind": "cost", "ts": 1.0, "rank": 0,
+           "name": "s", "site": "s", "flopz": 1.0}
+    assert any("unknown key" in e for e in obs.validate_record(rec))
+
+
+def test_max_mb_cap_truncates_with_one_marker(tmp_path,
+                                              monkeypatch):
+    monkeypatch.setenv(obs_sink.OBS_MAX_MB_ENV, "0.001")  # ~1 KB
+    sink = obs.JsonlSink(str(tmp_path), rank=0)
+    for i in range(100):
+        sink.write(obs.make_record("event", f"e{i}",
+                                   attrs={"pad": "x" * 64}))
+    sink.close()
+    lines = open(str(tmp_path / "obs-0.jsonl")).read().splitlines()
+    # far fewer than 100 lines made it; the LAST one is the marker
+    assert len(lines) < 50
+    last = json.loads(lines[-1])
+    assert last["name"] == "obs_truncated"
+    assert abs(last["attrs"]["limit_mb"] - 0.001) < 1e-5
+    assert all(json.loads(line)["name"] != "obs_truncated"
+               for line in lines[:-1])
+    # the cap bounds the file size (marker included)
+    assert os.path.getsize(str(tmp_path / "obs-0.jsonl")) \
+        < 2 * 1024
+
+
+def test_max_mb_env_activated_sink_truncates(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.OBS_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(obs_sink.OBS_MAX_MB_ENV, "0.0005")
+    for i in range(50):
+        obs_sink.event("spam", pad="y" * 64)
+    obs_sink.close_all()
+    lines = open(str(tmp_path / "obs-0.jsonl")).read().splitlines()
+    assert json.loads(lines[-1])["name"] == "obs_truncated"
+    assert len(lines) < 50
+
+
+def test_bad_max_mb_env_is_ignored(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs_sink.OBS_MAX_MB_ENV, "lots")
+    sink = obs.JsonlSink(str(tmp_path), rank=0)
+    assert sink.max_bytes is None
+    sink.write(obs.make_record("event", "ok"))
+    sink.close()

@@ -42,7 +42,7 @@ def test_run_checks_json_output():
     assert payload["findings"] == []
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
-        "jaxlint", "obs"}
+        "jaxlint", "obs", "regress"}
     assert payload["files"] > 100
 
 
@@ -174,6 +174,49 @@ def test_obs_gate_catches_missing_fixture(tmp_path, monkeypatch):
     assert [f.code for f in findings] == ["OBS001"]
 
 
+def test_regress_gate_passes_on_committed_fixture():
+    """The committed bench_fixture history (the repo's real BENCH_r*
+    trajectory) gates clean (ISSUE 4 acceptance)."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_regress(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_regress_gate_fails_on_injected_2x_slowdown(tmp_path,
+                                                    monkeypatch):
+    """Degrading the fixture's newest record 2x flips the gate to a
+    REG001 finding that names the metric (ISSUE 4 acceptance)."""
+    import os
+    import shutil
+    rc = _load_run_checks()
+    fixture = tmp_path / "bench_fixture"
+    fixture.mkdir()
+    for name in os.listdir(rc.BENCH_FIXTURE_DIR):
+        shutil.copy(os.path.join(rc.BENCH_FIXTURE_DIR, name),
+                    str(fixture))
+    with open(str(fixture / "r05.json")) as fh:
+        rec = json.load(fh)
+    rec["value"] = rec["value"] / 2.0
+    (fixture / "r06.json").write_text(json.dumps(rec))
+    monkeypatch.setattr(rc, "BENCH_FIXTURE_DIR", str(fixture))
+    findings = []
+    rc.check_regress(findings)
+    assert findings and all(f.code == "REG001" for f in findings)
+    assert any("fcma_voxel_selection_voxels_per_sec_chip"
+               in f.message for f in findings)
+
+
+def test_regress_gate_catches_missing_fixture(tmp_path,
+                                              monkeypatch):
+    rc = _load_run_checks()
+    monkeypatch.setattr(rc, "BENCH_FIXTURE_DIR",
+                        str(tmp_path / "nope"))
+    findings = []
+    rc.check_regress(findings)
+    assert [f.code for f in findings] == ["REG001"]
+
+
 def test_stdlib_gate_honors_noqa(tmp_path):
     rc = _load_run_checks()
     bad = tmp_path / "bad.py"
@@ -185,3 +228,24 @@ def test_stdlib_gate_honors_noqa(tmp_path):
         str(bad), str(tmp_path),
         [rc.LineLength(), rc.UnusedImports()])
     assert findings == []
+
+
+def test_regress_gate_fails_when_fixture_cannot_gate(tmp_path,
+                                                     monkeypatch):
+    """A gutted fixture (every tier below min-history) must fail the
+    gate instead of passing forever with zero coverage."""
+    import os
+    import shutil
+    rc = _load_run_checks()
+    fixture = tmp_path / "bench_fixture"
+    fixture.mkdir()
+    # keep only two records: newest becomes the sample, one prior
+    # record is below the min-history bar
+    for name in ("r01.json", "r02.json"):
+        shutil.copy(os.path.join(rc.BENCH_FIXTURE_DIR, name),
+                    str(fixture))
+    monkeypatch.setattr(rc, "BENCH_FIXTURE_DIR", str(fixture))
+    findings = []
+    rc.check_regress(findings)
+    assert [f.code for f in findings] == ["REG001"]
+    assert "no gating" in findings[0].message

@@ -21,6 +21,10 @@ jaxlint    TPU-readiness rules JX001-JX006 over the package,
 obs        smoke-runs ``python -m brainiak_tpu.obs report
            --format=json`` on tools/obs_fixture.jsonl and
            fails on schema violations (OBS001)
+regress    runs ``python -m brainiak_tpu.obs regress`` on the
+           committed tools/bench_fixture/ history and fails on
+           a regression verdict (REG001) — the bench gate runs
+           fixture-driven in CI, no TPU required
 ========== ===================================================
 
 ``# noqa`` suppresses stdlib/doc findings on a line; jaxlint uses
@@ -51,7 +55,7 @@ from brainiak_tpu.analysis.core import SKIP_DIRS  # noqa: E402,F401
 
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
-         "jaxlint", "obs")
+         "jaxlint", "obs", "regress")
 
 
 def python_sources():
@@ -374,6 +378,68 @@ def check_obs(findings):
             "reported schema errors"))
 
 
+# -- regress gate -----------------------------------------------------
+
+BENCH_FIXTURE_DIR = os.path.join(REPO, "tools", "bench_fixture")
+
+
+def check_regress(findings):
+    """Bench regression gate (REG001): run the regression detector
+    (``python -m brainiak_tpu.obs regress``) over the committed
+    fixture history in self-gating mode (each tier's newest record
+    vs. the records before it).  The fixture pins the detector's
+    behavior on the repo's real BENCH_r* trajectory; a code change
+    that flips its verdict — or breaks the CLI — fails CI without
+    needing TPU hardware or a live bench run."""
+    rel = _rel(BENCH_FIXTURE_DIR)
+    if not os.path.isdir(BENCH_FIXTURE_DIR):
+        findings.append(Finding(
+            rel, 1, "REG001", "bench fixture directory missing"))
+        return
+    proc = subprocess.run(
+        [sys.executable, "-m", "brainiak_tpu.obs", "regress",
+         "--history", BENCH_FIXTURE_DIR, "--format=json"],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    try:
+        verdict = json.loads(proc.stdout)
+    except ValueError:
+        verdict = None
+    if verdict is None:
+        tail = (proc.stderr or proc.stdout or "").strip()
+        tail = "; ".join(tail.splitlines()[-3:])
+        findings.append(Finding(
+            rel, 1, "REG001",
+            f"obs regress CLI failed (rc={proc.returncode}): "
+            f"{tail or 'no JSON verdict'}"))
+        return
+    for check in verdict.get("checks", []):
+        if check.get("status") == "regression":
+            findings.append(Finding(
+                rel, 1, "REG001",
+                f"regression: {check.get('metric')} "
+                f"[tier {check.get('tier')}] at "
+                f"{check.get('ratio', 0):.2f}x of baseline "
+                f"{check.get('baseline_median')}"))
+    if verdict.get("verdict") not in ("pass", "skip") \
+            and not any(c.get("status") == "regression"
+                        for c in verdict.get("checks", [])):
+        findings.append(Finding(
+            rel, 1, "REG001",
+            f"obs regress verdict {verdict.get('verdict')!r} with "
+            "no named regression"))
+    # a fixture that cannot gate must fail loudly rather than
+    # silently passing forever — that covers both zero checks
+    # (verdict "skip") and a gutted history where every tier reports
+    # insufficient_history (verdict "pass" with nothing gated)
+    if not any(c.get("status") in ("ok", "regression")
+               for c in verdict.get("checks", [])):
+        findings.append(Finding(
+            rel, 1, "REG001",
+            "fixture history produced no gating regression checks "
+            "(all skipped or insufficient history)"))
+
+
 # -- external gate ----------------------------------------------------
 
 def run_external(findings):
@@ -480,6 +546,8 @@ def run_gates(only=None):
         check_resilient_fits(findings)
     if "obs" in selected:
         check_obs(findings)
+    if "regress" in selected:
+        check_regress(findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
@@ -487,7 +555,7 @@ def run_gates(only=None):
     label = "+".join(
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
-                       "obs")
+                       "obs", "regress")
            if g in selected])
     return {
         "ok": not findings,
