@@ -61,6 +61,16 @@ NUM_FOLDS = 4
 WB_VOXELS = 65536
 WB_SELECTED = 1024
 WB_EPOCHS = 32
+SERVE_REQUESTS = 256  # serve-tier workload (BENCH_SERVE_REQUESTS overrides)
+
+
+def _serve_n_requests():
+    """The serve tier's request count: one reader for the env
+    override so the measured workload and the stamped
+    ``config.n_requests`` cannot drift apart."""
+    import os
+    return int(os.environ.get("BENCH_SERVE_REQUESTS",
+                              SERVE_REQUESTS))
 
 
 def _even_epochs_env(name, default):
@@ -167,6 +177,51 @@ def cpu_voxels_per_sec(n_voxels=N_VOXELS, block=64, n_epochs=N_EPOCHS):
         model_selection.cross_val_score(clf, k, y=labels, cv=skf, n_jobs=1)
     dt = time.perf_counter() - t0
     return block / dt
+
+
+def serve_tier_metrics(n_requests=SERVE_REQUESTS, seed=0):
+    """The ``serve`` tier: batched SRM-transform serving throughput
+    through ``brainiak_tpu.serve`` (requests/s, latency percentiles,
+    padding waste) against a tiny model fitted in-process, with
+    ``vs_baseline`` the unbatched per-request host-BLAS loop.  The
+    engine run goes through a save/load round trip so the measured
+    path is the production one (artifact -> engine), and the obs
+    spans around the phases feed the ``stages`` breakdown."""
+    import io as _io
+
+    from brainiak_tpu import serve
+    from brainiak_tpu.serve.__main__ import (build_demo_model,
+                                             build_mixed_requests,
+                                             measure,
+                                             naive_requests_per_sec,
+                                             summary_to_out)
+
+    with obs.span("bench.data_gen"):
+        model = build_demo_model(n_subjects=4, voxels=256,
+                                 samples=64, features=16, n_iter=3,
+                                 seed=seed)
+        buf = _io.BytesIO(serve.save_model_bytes(model))
+        model = serve.load_model(buf)
+        requests = build_mixed_requests(model, n_requests,
+                                        seed=seed)
+    with obs.span("bench.warm"):
+        measure(model, requests, warm=False)  # compile pass
+    with obs.span("bench.steady"):
+        summary = measure(model, requests, warm=False)
+    return summary_to_out(
+        summary,
+        baseline_rps=naive_requests_per_sec(model, requests))
+
+
+def _serve_result_record(out, n_requests):
+    """The serve tier's bench JSON line — delegated to the shared
+    builder in ``brainiak_tpu.serve.__main__`` so the CLI ``bench``
+    subcommand and this tier cannot drift (``obs regress`` gates the
+    serve tier separately from the FCMA tiers)."""
+    from brainiak_tpu.serve.__main__ import bench_record
+
+    return bench_record(out, n_requests,
+                        stages=out.get("stages"))
 
 
 def _ts_key(ts):
@@ -313,6 +368,17 @@ def measure_tier(tier):
     obs.install_compile_listener()
     mem = obs.add_sink(obs.MemorySink())
     try:
+        if tier == "serve":
+            out = serve_tier_metrics(n_requests=_serve_n_requests())
+            # the record's tier is split by backend (an on-chip
+            # serve rate must never share a regress baseline with
+            # a CPU-fallback one — same rule as the fcma tiers)
+            out["backend"] = jax.default_backend()
+            obs.gauge("bench_serve_requests_per_sec",
+                      unit="requests/sec").set(
+                          out["requests_per_sec"], tier="serve")
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "wb":
             vps = whole_brain_voxels_per_sec(
                 n_voxels=int(os.environ.get("BENCH_WB_VOXELS",
@@ -341,17 +407,8 @@ def measure_tier(tier):
 def _git_commit():
     """Short commit hash of the tree this bench ran from, or None
     (regress.py pins a record to the code that produced it)."""
-    import os
-    import subprocess
-    here = os.path.dirname(os.path.abspath(__file__))
-    try:
-        out = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"], cwd=here,
-            capture_output=True, text=True, timeout=10)
-    except (OSError, subprocess.TimeoutExpired):
-        return None
-    commit = out.stdout.strip()
-    return commit if out.returncode == 0 and commit else None
+    from brainiak_tpu.obs.report import git_commit_stamp
+    return git_commit_stamp()
 
 
 def _result_record(tier, vps, cpu_vps, config=None, stages=None):
@@ -393,6 +450,35 @@ def _tier_main(tier):
 
 
 def main():
+    """One bench invocation prints one JSON line per tier: the FCMA
+    fit-path record (whole-brain / mid / cpu_fallback) and the serve
+    tier record — ``obs regress`` gates each tier against its own
+    history."""
+    responsive = _fcma_main()
+    _serve_main(responsive)
+
+
+def _serve_main(responsive):
+    """Serve tier: subprocess first (one chip process at a time, a
+    wedge must not hang the driver), in-process CPU fallback
+    otherwise.  ``responsive`` is _fcma_main's probe verdict, which
+    may predate a tier subprocess that wedged the tunnel afterwards
+    (same stale-verdict hazard the wb→mid handoff guards against) —
+    re-probe cheaply before committing 420 s to the chip; a False
+    verdict is trusted as-is, skipping straight to the CPU fallback."""
+    n_requests = _serve_n_requests()
+    if responsive:
+        responsive = _device_responsive(timeout=90)
+    out = _run_tier_subprocess("serve", timeout=420) \
+        if responsive else None
+    if out is None:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        out = measure_tier("serve")
+    print(json.dumps(_serve_result_record(out, n_requests)))
+
+
+def _fcma_main():
     # Probe BEFORE any in-process jax backend touch: on a wedged TPU
     # tunnel even backend initialization (jax.default_backend()) hangs.
     # The tunnel sometimes un-wedges after an idle period, so a failed
@@ -435,10 +521,12 @@ def main():
                         "selected": wb_selected,
                         "n_epochs": wb_epochs, "n_trs": N_TRS},
                 stages=out.get("stages"))))
-            return
-        # the wb attempt may have wedged the tunnel — re-probe cheaply
-        # before committing the mid tier to the chip
-        if _device_responsive(timeout=90):
+            return responsive
+        # the wb attempt may have wedged the tunnel — re-probe
+        # cheaply before committing the mid tier to the chip, and
+        # keep the FRESHER verdict (the serve tier reads it too)
+        responsive = _device_responsive(timeout=90)
+        if responsive:
             out = _run_tier_subprocess("mid", timeout=420)
             if out:
                 cpu_vps = cpu_voxels_per_sec(n_voxels=mid_voxels)
@@ -447,7 +535,7 @@ def main():
                     config={"n_voxels": mid_voxels,
                             "n_epochs": N_EPOCHS, "n_trs": N_TRS},
                     stages=out.get("stages"))))
-                return
+                return responsive
 
     # fall back to CPU so the driver records a number instead of a
     # hung process (reduced size: the full problem takes tens of
@@ -459,6 +547,7 @@ def main():
     print(json.dumps(_result_record(
         "cpu_fallback", out["voxels_per_sec"], cpu_vps,
         stages=out["stages"])))
+    return responsive
 
 
 if __name__ == "__main__":

@@ -47,7 +47,17 @@ _CACHE_DECOS = {"functools.lru_cache", "functools.cache",
                 "brainiak_tpu.obs.runtime.counted_cache",
                 "obs.counted_cache", "brainiak_tpu.obs.counted_cache",
                 "obs_runtime.counted_cache",
-                "runtime.counted_cache"}
+                "runtime.counted_cache",
+                # the serve bucket-program cache (a counted_cache
+                # under serve's site convention,
+                # brainiak_tpu.serve.engine.program_cache): jit
+                # construction inside a builder it decorates is
+                # cached by definition
+                "program_cache", "engine.program_cache",
+                "serve.engine.program_cache",
+                "brainiak_tpu.serve.engine.program_cache",
+                "serve.program_cache",
+                "brainiak_tpu.serve.program_cache"}
 
 
 def _loop_ancestor(ctx, node):

@@ -219,11 +219,24 @@ class Classifier(BaseEstimator):
             data = feats
         self.test_data_ = data
 
+    def _require_test_data(self, method):
+        """X=None is only valid when fit() precomputed test
+        similarity vectors (num_training_samples with a precomputed
+        SVM kernel); otherwise sklearn would fail opaquely deep in
+        ``clf.{method}`` on the None."""
+        if getattr(self, "test_data_", None) is None:
+            raise ValueError(
+                f"{method}(X=None) requires test data prepared "
+                "during fit (pass num_training_samples with a "
+                "precomputed-kernel SVM), or pass X explicitly")
+
     def predict(self, X=None):
         """Predict labels; X=None reuses test data prepared during fit
         (reference classifier.py:507-570)."""
         if X is not None:
             self._prepare_test_data(X)
+        else:
+            self._require_test_data("predict")
         return self.clf.predict(self.test_data_)
 
     def _is_equal_to_test_raw_data(self, X):
@@ -240,6 +253,8 @@ class Classifier(BaseEstimator):
         """Decision values (reference classifier.py:597-650)."""
         if X is not None and not self._is_equal_to_test_raw_data(X):
             self._prepare_test_data(X)
+        elif X is None:
+            self._require_test_data("decision_function")
         return self.clf.decision_function(self.test_data_)
 
     def score(self, X, y, sample_weight=None):

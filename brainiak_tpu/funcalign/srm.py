@@ -539,7 +539,13 @@ class SRM(_SRMBase):
         return w, rho2, sigma_s, shared, ll
 
     def save(self, file):
-        """Persist the fitted model as .npz (srm.py:451-481)."""
+        """Persist the fitted model as .npz (srm.py:451-481).
+
+        Kept for reference-format compatibility; new code should
+        prefer :func:`brainiak_tpu.serve.save_model`, whose
+        versioned artifact schema covers every servable estimator
+        and stays pickle-free even for mixed voxel counts (this
+        format needs ``allow_pickle`` for the ragged path)."""
         if not hasattr(self, 'w_'):
             raise NotFittedError("The model fit has not been run yet.")
         if len({w.shape for w in self.w_}) == 1:
@@ -569,7 +575,9 @@ def load(file):
     """Load a fitted SRM saved by :meth:`SRM.save` (srm.py:110-142).
 
     Also reads the reference's npz format (pinned by its
-    tests/funcalign/sr_v0_4.npz golden file)."""
+    tests/funcalign/sr_v0_4.npz golden file).  For the uniform
+    versioned artifact registry (every servable estimator, retry-
+    wired reads) use :func:`brainiak_tpu.serve.load_model`."""
     loaded = np.load(file, allow_pickle=True)
     features, n_iter, rand_seed = (int(v) for v in loaded['kwargs'])
     srm = SRM(n_iter=n_iter, features=features, rand_seed=rand_seed)

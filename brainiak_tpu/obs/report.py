@@ -34,8 +34,9 @@ import sys
 
 from .sink import OBS_DIR_ENV, validate_record
 
-__all__ = ["aggregate", "iter_jsonl_paths", "load_records", "main",
-           "render_text", "top_spans", "validate_bench_record"]
+__all__ = ["aggregate", "git_commit_stamp", "iter_jsonl_paths",
+           "load_records", "main", "render_text", "top_spans",
+           "validate_bench_record"]
 
 #: Keys a bench.py result record must carry (satellite: BENCH_*.json
 #: drift fails CI instead of confusing the next round).
@@ -101,6 +102,26 @@ def validate_bench_record(rec):
                     errors.append(
                         f"stages.{key}={val!r} (expected a number)")
     return errors
+
+
+def git_commit_stamp(path=None):
+    """Short commit hash of the checkout containing ``path``
+    (default: this package's own checkout, so it works from any
+    cwd), or None — the provenance stamp bench records carry so
+    :mod:`~brainiak_tpu.obs.regress` can pin a record to the code
+    that produced it.  Shared by ``bench.py`` and the serve CLI
+    (one implementation, consistently-stamped records)."""
+    import subprocess
+    if path is None:
+        path = os.path.dirname(os.path.abspath(__file__))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=path,
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    commit = out.stdout.strip()
+    return commit if out.returncode == 0 and commit else None
 
 
 def iter_jsonl_paths(paths):

@@ -1,0 +1,66 @@
+"""brainiak_tpu.serve: persisted models + batched inference.
+
+The framework's fifth subsystem (after resilience, jaxlint, obs, and
+perf attribution): everything before it targeted the *fit* path; this
+is the *deployment* path the ROADMAP's heavy-traffic north star
+needs.  Three layers:
+
+- :mod:`~brainiak_tpu.serve.artifacts` — one versioned npz artifact
+  schema (``save_model``/``load_model``) with adapters for SRM,
+  DetSRM, RSRM, EventSegment, IEM (1-D/2-D), and the FCMA
+  classifier; loads retry transient I/O faults via
+  :func:`brainiak_tpu.resilience.retry`;
+- :mod:`~brainiak_tpu.serve.batching` +
+  :mod:`~brainiak_tpu.serve.engine` — an in-process engine that pads
+  heterogeneous requests into power-of-two shape buckets, runs one
+  jitted program per (model, bucket) through a retrace-counted
+  program cache, donates batch buffers, enforces
+  max-wait/max-batch flushes and per-request deadlines, and
+  isolates poison requests into structured error records;
+- :mod:`~brainiak_tpu.serve.__main__` — ``python -m
+  brainiak_tpu.serve run|bench``: the offline batch driver and the
+  serving micro-benchmark, both emitting obs spans/metrics so
+  ``obs report``/``export``/``regress`` work on serving rounds.
+
+See docs/serving.md.
+"""
+
+from .artifacts import (  # noqa: F401
+    ADAPTERS,
+    SCHEMA_VERSION,
+    detect_kind,
+    load_model,
+    save_model,
+    save_model_bytes,
+)
+from .batching import (  # noqa: F401
+    BucketPolicy,
+    Request,
+    ServeResult,
+    bucket_length,
+    load_requests,
+    pad_axis,
+    save_requests,
+)
+from .engine import (  # noqa: F401
+    InferenceEngine,
+    program_cache,
+)
+
+__all__ = [
+    "ADAPTERS",
+    "SCHEMA_VERSION",
+    "BucketPolicy",
+    "InferenceEngine",
+    "Request",
+    "ServeResult",
+    "bucket_length",
+    "detect_kind",
+    "load_model",
+    "load_requests",
+    "pad_axis",
+    "program_cache",
+    "save_model",
+    "save_model_bytes",
+    "save_requests",
+]

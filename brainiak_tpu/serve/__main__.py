@@ -1,0 +1,342 @@
+"""``python -m brainiak_tpu.serve`` — the serving CLI.
+
+Two subcommands:
+
+- ``run --model M.npz --requests R.npz [--out OUT.npz]`` — offline
+  batch driver: load a persisted model
+  (:func:`brainiak_tpu.serve.load_model`), read a request file
+  (:func:`brainiak_tpu.serve.load_requests`), drive the
+  :class:`~brainiak_tpu.serve.InferenceEngine` to completion, and
+  print one JSON summary (requests, errors, buckets, retraces,
+  padding waste, latency percentiles).  Exit status 0 means every
+  request produced a result; 1 means at least one structured error
+  record; 2 means the driver itself failed.
+- ``bench [--model M.npz] [--n-requests N]`` — serving
+  micro-benchmark: mixed-TR synthetic requests against the model (a
+  tiny deterministic SRM is fitted in-process when no artifact is
+  given), one warm pass (compiles) + one timed steady pass, printed
+  as a bench-schema JSON line (``metric``/``value``/``unit``/
+  ``vs_baseline``/``tier="serve"``) that
+  ``python -m brainiak_tpu.obs regress`` can gate.
+
+Run with ``BRAINIAK_TPU_OBS_DIR`` set to capture ``serve.request``/
+``serve.batch`` spans and serve metrics for ``obs report``/
+``export``.
+
+``BENCH_FORCE_CPU=1`` pins the CPU platform in-process before any
+backend init — the same knob bench.py's tier children honor, because
+the ``JAX_PLATFORMS`` env var alone can hang once a wedged tunnel
+PJRT plugin is registered (docs/performance.md operational rule 4).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from .artifacts import load_model, save_model
+from .batching import BucketPolicy, Request, load_requests
+from .engine import InferenceEngine
+
+__all__ = ["bench_record", "build_demo_model",
+           "build_mixed_requests", "main", "measure",
+           "naive_requests_per_sec", "summary_to_out"]
+
+
+def _policy(args):
+    return BucketPolicy(max_batch=args.max_batch,
+                        max_wait_s=args.max_wait,
+                        min_bucket=args.min_bucket)
+
+
+def _write_results(path, records):
+    """Persist per-request outcomes as one npz: ``result.<i>`` (or
+    ``result.<i>.<j>`` for tuple results), ``error.<i>`` +
+    ``message.<i>`` for failures, ``id.<i>`` always.  Returns the
+    path actually written (np.savez_compressed appends ".npz" to
+    extensionless paths, same normalization as ``save_model``)."""
+    if not path.endswith(".npz"):
+        path += ".npz"
+    out = {"n": np.asarray(len(records))}
+    for i, rec in enumerate(records):
+        out[f"id.{i}"] = np.asarray(rec.request_id)
+        if not rec.ok:
+            out[f"error.{i}"] = np.asarray(rec.error)
+            out[f"message.{i}"] = np.asarray(rec.message or "")
+            continue
+        if isinstance(rec.result, tuple):
+            out[f"result.{i}.parts"] = np.asarray(len(rec.result))
+            for j, part in enumerate(rec.result):
+                out[f"result.{i}.{j}"] = np.asarray(part)
+        else:
+            out[f"result.{i}"] = np.asarray(rec.result)
+    np.savez_compressed(path, **out)
+    return path
+
+
+def _run(args):
+    model = load_model(args.model)
+    requests = load_requests(args.requests)
+    engine = InferenceEngine(model, policy=_policy(args))
+    t0 = time.perf_counter()
+    records = engine.run(requests)
+    wall = time.perf_counter() - t0
+    summary = engine.summary()
+    summary["wall_s"] = round(wall, 6)
+    summary["requests_per_sec"] = (
+        round(len(requests) / wall, 3) if wall > 0 else None)
+    if args.out:
+        summary["out"] = _write_results(args.out, records)
+    if args.format == "json":
+        print(json.dumps(summary, indent=2))
+    else:
+        print(f"serve run: {summary['n_ok']}/"
+              f"{summary['n_requests']} ok, "
+              f"{summary['n_errors']} error(s), "
+              f"{summary['n_batches']} batch(es) over "
+              f"{len(summary['buckets'])} bucket(s), "
+              f"retraces={summary['retrace_total']:.0f}, "
+              f"padding waste="
+              f"{summary['padding_waste']:.1%}")
+        for code, count in sorted(
+                summary["errors_by_code"].items()):
+            print(f"  {count:>4}  {code}")
+    return 0 if summary["n_errors"] == 0 else 1
+
+
+def build_demo_model(n_subjects=4, voxels=48, samples=40,
+                     features=8, n_iter=5, seed=0, ragged=True):
+    """A small fitted SRM for benches/fixtures: deterministic
+    synthetic ``X_i = W_i S + noise`` data, mixed voxel counts when
+    ``ragged``."""
+    from ..funcalign.srm import SRM
+
+    rng = np.random.RandomState(seed)
+    shared = rng.randn(features, samples)
+    data = []
+    for i in range(n_subjects):
+        v = voxels + (i if ragged else 0)
+        q, _ = np.linalg.qr(rng.randn(v, features))
+        data.append(q @ shared + 0.1 * rng.randn(v, samples))
+    model = SRM(n_iter=n_iter, features=features, rand_seed=seed)
+    model.fit(data)
+    return model
+
+
+def build_mixed_requests(model, n_requests, seed=0,
+                         tr_choices=(24, 40, 100, 150)):
+    """Mixed-shape transform requests against a fitted SRM-family
+    model: TR lengths drawn from ``tr_choices`` (several buckets),
+    subjects round-robin."""
+    rng = np.random.RandomState(seed)
+    counts = [w.shape[0] for w in model.w_]
+    out = []
+    for i in range(n_requests):
+        subject = i % len(counts)
+        trs = int(tr_choices[i % len(tr_choices)])
+        x = rng.randn(counts[subject], trs).astype(np.float32)
+        out.append(Request(request_id=f"r{i}", x=x,
+                           subject=subject))
+    return out
+
+
+def measure(model, requests, policy=None, warm=True):
+    """Requests/s + latency percentiles for one engine drive.
+
+    ``warm=True`` runs a first (untimed) engine over the same
+    requests so the timed pass measures steady-state dispatch, not
+    compiles — the program cache is module-level, so the warm
+    engine's programs are reused.
+    """
+    if warm:
+        InferenceEngine(model, policy=policy).run(
+            [Request(request_id=f"w{i}", x=r.x, subject=r.subject,
+                     deadline_s=r.deadline_s)
+             for i, r in enumerate(requests)])
+    engine = InferenceEngine(model, policy=policy)
+    for req in requests:  # fresh queue-time stamps for this drive
+        req.submitted = None
+    t0 = time.perf_counter()
+    records = engine.run(requests)
+    wall = time.perf_counter() - t0
+    summary = engine.summary()
+    summary["wall_s"] = wall
+    summary["requests_per_sec"] = len(requests) / wall \
+        if wall > 0 else float("inf")
+    summary["n_results"] = len(records)
+    return summary
+
+
+def naive_requests_per_sec(model, requests):
+    """The unbatched reference path: one host-BLAS ``W_iᵀ x`` per
+    request, no bucketing, no reuse — the ``vs_baseline``
+    denominator for the serve bench."""
+    w = [np.asarray(wi) for wi in model.w_]
+    t0 = time.perf_counter()
+    for req in requests:
+        w[req.subject].T @ np.asarray(req.x)
+    wall = time.perf_counter() - t0
+    return len(requests) / wall if wall > 0 else float("inf")
+
+
+def summary_to_out(summary, baseline_rps=None, backend=None):
+    """Project an engine :meth:`~InferenceEngine.summary` onto the
+    measurement dict :func:`bench_record` consumes — the ONE place
+    the summary→record key mapping lives (used by this CLI's
+    ``bench`` subcommand and by ``bench.py``'s serve tier)."""
+    out = {
+        "requests_per_sec": summary["requests_per_sec"],
+        "p50_latency_s": summary["p50_latency_s"],
+        "p99_latency_s": summary["p99_latency_s"],
+        "padding_waste": summary["padding_waste"],
+        "n_buckets": len(summary["buckets"]),
+        "retrace_total": summary["retrace_total"],
+    }
+    if baseline_rps is not None:
+        out["baseline_rps"] = baseline_rps
+    if backend is not None:
+        out["backend"] = backend
+    return out
+
+
+def bench_record(out, n_requests, kind="srm", max_batch=None,
+                 stages=None):
+    """The serve bench-schema JSON record, shared by this CLI's
+    ``bench`` subcommand and ``bench.py``'s serve tier so the two
+    cannot drift.  ``out`` carries ``requests_per_sec`` /
+    ``baseline_rps`` / latency percentiles / ``padding_waste`` /
+    ``n_buckets`` / ``retrace_total`` and optionally ``backend``;
+    the record carries the PR-4 provenance stamps
+    (``schema_version``, ``git_commit``) regress.py trusts.
+
+    Tier separation mirrors the FCMA tiers: a run whose backend is
+    not a TPU is stamped ``tier="serve_cpu_fallback"`` so ``obs
+    regress`` never compares a host-fallback rate against an
+    on-chip serve baseline (and vice versa).
+    """
+    from ..obs.report import BENCH_SCHEMA_VERSION
+
+    rps = float(out["requests_per_sec"])
+    baseline = float(out.get("baseline_rps") or 0.0)
+    vs = round(rps / baseline, 3) \
+        if baseline > 0 and np.isfinite(baseline) else 0.0
+    config = {
+        "n_requests": n_requests,
+        "n_buckets": out["n_buckets"],
+        "retrace_total": out["retrace_total"],
+        "padding_waste_pct":
+            round(100.0 * out["padding_waste"], 2),
+    }
+    for key in ("p50_latency_s", "p99_latency_s"):
+        # None when no request produced a latency (empty drive)
+        if out.get(key) is not None:
+            config[key] = round(out[key], 6)
+    if max_batch is not None:
+        config["max_batch"] = max_batch
+    backend = out.get("backend")
+    tier = "serve" if backend == "tpu" else "serve_cpu_fallback"
+    if backend:
+        config["backend"] = backend
+    rec = {"schema_version": BENCH_SCHEMA_VERSION,
+           "metric": f"serve_{kind}_transform_requests_per_sec",
+           "value": round(rps, 2),
+           "unit": "requests/sec",
+           "vs_baseline": vs,
+           "tier": tier,
+           "config": config}
+    from ..obs.report import git_commit_stamp
+    commit = git_commit_stamp()
+    if commit:
+        rec["git_commit"] = commit
+    if stages:
+        rec["stages"] = stages
+    return rec
+
+
+def _bench(args):
+    if args.model:
+        model = load_model(args.model)
+        # the synthetic workload generator drives SRM-family
+        # transform (per-subject w_); other kinds load and serve
+        # fine via `run`, but bench has no request generator for
+        # them — fail as a driver error (rc=2), not a traceback
+        if not hasattr(model, "w_"):
+            raise ValueError(
+                "bench generates SRM-family transform requests; "
+                f"model artifact is kind {type(model).__name__!r} "
+                "— use `run` with a request file instead")
+    else:
+        model = build_demo_model()
+        if args.save_model:
+            save_model(model, args.save_model)
+    requests = build_mixed_requests(model, args.n_requests,
+                                    seed=args.seed)
+    policy = _policy(args)
+    summary = measure(model, requests, policy=policy)
+    import jax
+
+    out = summary_to_out(
+        summary,
+        baseline_rps=naive_requests_per_sec(model, requests),
+        backend=jax.default_backend())
+    print(json.dumps(bench_record(
+        out, args.n_requests, kind=summary["kind"],
+        max_batch=args.max_batch)))
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m brainiak_tpu.serve",
+        description="persisted-model batch serving "
+                    "(docs/serving.md)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    run_p = sub.add_parser(
+        "run", help="drive a request file through the engine")
+    run_p.add_argument("--model", required=True,
+                       help="model artifact (save_model npz)")
+    run_p.add_argument("--requests", required=True,
+                       help="request file (save_requests npz)")
+    run_p.add_argument("--out", help="write per-request results npz")
+    run_p.add_argument("--format", choices=("text", "json"),
+                       default="text")
+
+    bench_p = sub.add_parser(
+        "bench", help="serving throughput micro-benchmark")
+    bench_p.add_argument("--model",
+                         help="model artifact (default: fit a tiny "
+                              "demo SRM in-process)")
+    bench_p.add_argument("--save-model",
+                         help="persist the demo model artifact here")
+    bench_p.add_argument("--n-requests", type=int, default=256)
+    bench_p.add_argument("--seed", type=int, default=0)
+
+    for p in (run_p, bench_p):
+        p.add_argument("--max-batch", type=int, default=64)
+        p.add_argument("--max-wait", type=float, default=0.05)
+        p.add_argument("--min-bucket", type=int, default=16)
+
+    args = parser.parse_args(argv)
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if args.command == "run":
+        return _run(args)
+    return _bench(args)
+
+
+if __name__ == "__main__":
+    import zipfile
+
+    try:
+        sys.exit(main())
+    except (OSError, ValueError, zipfile.BadZipFile) as exc:
+        # rc=2 is the driver-failure contract: a missing/corrupt
+        # artifact (a truncated npz raises BadZipFile, not OSError)
+        # must not read as "ran with per-request errors" (rc=1)
+        print(f"serve: {exc}", file=sys.stderr)
+        sys.exit(2)

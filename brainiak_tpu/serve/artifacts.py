@@ -1,0 +1,501 @@
+"""Persisted-model registry: one save/load surface for every servable
+estimator.
+
+The fit path persists *training state* (``resilience`` checkpoints);
+this module persists *fitted models* — the deployment artifact a
+serving process loads.  One uniform, versioned npz schema replaces the
+per-estimator ad-hoc formats (``funcalign.srm.SRM.save`` /
+``funcalign.srm.load`` being the only one that existed):
+
+- every artifact carries ``serve_kind`` (the adapter that wrote it)
+  and ``serve_schema_version`` (:data:`SCHEMA_VERSION`);
+- all payload arrays are plain numpy arrays — the file loads with
+  ``allow_pickle=False``.  Ragged per-subject lists (mixed voxel
+  counts) are stored under indexed keys (``w_.0``, ``w_.1``, ...)
+  with an ``w_.n`` count, never as object arrays, so the
+  pickle-disabled load the reference's ``srm.load`` promises actually
+  holds for EVERY artifact;
+- the one exception is the FCMA :class:`~brainiak_tpu.fcma.Classifier`
+  adapter, whose wrapped sklearn estimator has no array-only form: it
+  is embedded as a pickle byte payload inside a uint8 array, opted
+  into explicitly at load time (``np.load`` itself still runs with
+  pickle disabled — only the clearly-labeled ``clf_pickle`` bytes go
+  through ``pickle.loads``).  Load FCMA artifacts only from trusted
+  stores.
+
+Loading is wired through :func:`brainiak_tpu.resilience.retry`: a
+shared-filesystem read that races a preemption retries with backoff
+instead of killing the serving process.
+
+Round-trip fidelity is bit-exact: adapters store the fitted arrays
+verbatim (no re-quantization, no recompute on load), so
+``load_model(save_model(m, f)).transform(X)`` equals
+``m.transform(X)`` to the last bit — acceptance-tested per adapter in
+``tests/serve/test_artifacts.py``.
+"""
+
+import io
+import logging
+import os
+import pickle
+
+import numpy as np
+
+from ..resilience.retry import retry
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ADAPTERS",
+    "KIND_KEY",
+    "SCHEMA_VERSION",
+    "VERSION_KEY",
+    "detect_kind",
+    "load_model",
+    "save_model",
+    "save_model_bytes",
+]
+
+#: Bump on any backwards-incompatible change to an adapter's key set.
+#: Loaders reject artifacts stamped with a NEWER version than they
+#: understand (an old server must not half-read a new artifact).
+SCHEMA_VERSION = 1
+
+KIND_KEY = "serve_kind"
+VERSION_KEY = "serve_schema_version"
+
+
+# -- npz packing helpers ----------------------------------------------
+
+def _put_list(out, key, arrays):
+    """Store a list of (possibly ragged) arrays under indexed keys."""
+    out[f"{key}.n"] = np.asarray(len(arrays))
+    for i, arr in enumerate(arrays):
+        out[f"{key}.{i}"] = np.asarray(arr)
+
+
+def _get_list(z, key):
+    n = int(z[f"{key}.n"])
+    return [np.asarray(z[f"{key}.{i}"]) for i in range(n)]
+
+
+def _put_scalar(out, key, value):
+    out[key] = np.asarray(value)
+
+
+def _scalar(z, key):
+    """A 0-d npz entry back as a Python scalar (or str)."""
+    val = np.asarray(z[key])
+    if val.dtype.kind in "US":
+        return str(val)
+    return val.item()
+
+
+def _maybe(out, key, value):
+    """Store ``value`` unless it is None (optional keys are absent)."""
+    if value is not None:
+        out[key] = np.asarray(value)
+
+
+# -- adapter protocol -------------------------------------------------
+
+class ModelAdapter:
+    """One estimator's mapping to/from the artifact schema.
+
+    Subclasses set ``kind`` (the schema tag) and implement
+    ``model_class`` (resolved lazily — importing an adapter must not
+    import every estimator), ``pack(model) -> {key: array}`` and
+    ``unpack(arrays) -> model``.
+    """
+
+    kind = None
+
+    def model_class(self):
+        raise NotImplementedError
+
+    def matches(self, model):
+        # exact-type match: DetSRM must not be claimed by the SRM
+        # adapter (and vice versa) through a shared base class
+        return type(model) is self.model_class()
+
+    def pack(self, model):
+        raise NotImplementedError
+
+    def unpack(self, arrays):
+        raise NotImplementedError
+
+    @staticmethod
+    def _fitted(model, *attrs):
+        missing = [a for a in attrs if not hasattr(model, a)]
+        if missing:
+            raise ValueError(
+                f"model is not fitted: missing {', '.join(missing)}")
+
+
+class SRMAdapter(ModelAdapter):
+    """Probabilistic SRM — subsumes the ad-hoc ``SRM.save``/``load``
+    pair (and unlike it, stays pickle-free for mixed voxel counts)."""
+
+    kind = "srm"
+
+    def model_class(self):
+        from ..funcalign.srm import SRM
+        return SRM
+
+    def pack(self, model):
+        self._fitted(model, "w_", "s_", "sigma_s_", "mu_", "rho2_")
+        out = {}
+        _put_list(out, "w_", model.w_)
+        _put_list(out, "mu_", model.mu_)
+        out["s_"] = np.asarray(model.s_)
+        out["sigma_s_"] = np.asarray(model.sigma_s_)
+        out["rho2_"] = np.asarray(model.rho2_)
+        _maybe(out, "logprob_", getattr(model, "logprob_", None))
+        _put_scalar(out, "features", model.features)
+        _put_scalar(out, "n_iter", model.n_iter)
+        _put_scalar(out, "rand_seed", model.rand_seed)
+        return out
+
+    def unpack(self, z):
+        model = self.model_class()(
+            n_iter=_scalar(z, "n_iter"),
+            features=_scalar(z, "features"),
+            rand_seed=_scalar(z, "rand_seed"))
+        model.w_ = _get_list(z, "w_")
+        model.mu_ = _get_list(z, "mu_")
+        model.s_ = np.asarray(z["s_"])
+        model.sigma_s_ = np.asarray(z["sigma_s_"])
+        model.rho2_ = np.asarray(z["rho2_"])
+        if "logprob_" in z:
+            model.logprob_ = _scalar(z, "logprob_")
+        return model
+
+
+class DetSRMAdapter(ModelAdapter):
+    kind = "detsrm"
+
+    def model_class(self):
+        from ..funcalign.srm import DetSRM
+        return DetSRM
+
+    def pack(self, model):
+        self._fitted(model, "w_", "s_")
+        out = {}
+        _put_list(out, "w_", model.w_)
+        out["s_"] = np.asarray(model.s_)
+        _maybe(out, "objective_", getattr(model, "objective_", None))
+        _put_scalar(out, "features", model.features)
+        _put_scalar(out, "n_iter", model.n_iter)
+        _put_scalar(out, "rand_seed", model.rand_seed)
+        return out
+
+    def unpack(self, z):
+        model = self.model_class()(
+            n_iter=_scalar(z, "n_iter"),
+            features=_scalar(z, "features"),
+            rand_seed=_scalar(z, "rand_seed"))
+        model.w_ = _get_list(z, "w_")
+        model.s_ = np.asarray(z["s_"])
+        if "objective_" in z:
+            model.objective_ = _scalar(z, "objective_")
+        return model
+
+
+class RSRMAdapter(ModelAdapter):
+    kind = "rsrm"
+
+    def model_class(self):
+        from ..funcalign.rsrm import RSRM
+        return RSRM
+
+    def pack(self, model):
+        self._fitted(model, "w_", "r_", "s_")
+        out = {}
+        _put_list(out, "w_", model.w_)
+        _put_list(out, "s_", model.s_)
+        out["r_"] = np.asarray(model.r_)
+        _maybe(out, "objective_", getattr(model, "objective_", None))
+        _put_scalar(out, "features", model.features)
+        _put_scalar(out, "gamma", model.gamma)
+        _put_scalar(out, "n_iter", model.n_iter)
+        _put_scalar(out, "rand_seed", model.rand_seed)
+        return out
+
+    def unpack(self, z):
+        model = self.model_class()(
+            n_iter=_scalar(z, "n_iter"),
+            features=_scalar(z, "features"),
+            gamma=_scalar(z, "gamma"),
+            rand_seed=_scalar(z, "rand_seed"))
+        model.w_ = _get_list(z, "w_")
+        model.s_ = _get_list(z, "s_")
+        model.r_ = np.asarray(z["r_"])
+        if "objective_" in z:
+            model.objective_ = _scalar(z, "objective_")
+        return model
+
+
+class EventSegmentAdapter(ModelAdapter):
+    """Event patterns + variance — the ``find_events``/``predict``
+    surface.  ``step_var`` (a callable) is not persisted: inference on
+    held-out scans uses the annealed ``event_var_`` the fit landed
+    on, exactly as :meth:`EventSegment.find_events` does."""
+
+    kind = "eventseg"
+
+    def model_class(self):
+        from ..eventseg.event import EventSegment
+        return EventSegment
+
+    def pack(self, model):
+        self._fitted(model, "event_pat_", "event_var_")
+        out = {
+            "event_pat_": np.asarray(model.event_pat_),
+            "event_var_": np.asarray(model.event_var_),
+            "event_chains": np.asarray(model.event_chains),
+        }
+        _put_scalar(out, "n_events", model.n_events)
+        _maybe(out, "ll_", getattr(model, "ll_", None))
+        return out
+
+    def unpack(self, z):
+        model = self.model_class()(
+            n_events=_scalar(z, "n_events"),
+            event_chains=np.asarray(z["event_chains"]))
+        model.event_pat_ = np.asarray(z["event_pat_"])
+        var = np.asarray(z["event_var_"])
+        # a scalar variance round-trips as the Python float the fit
+        # stored (find_events broadcasts either form identically)
+        model.event_var_ = var.item() if var.ndim == 0 else var
+        if "ll_" in z:
+            model.ll_ = np.asarray(z["ll_"])
+        model.classes_ = np.arange(model.n_events)
+        return model
+
+
+class IEM1DAdapter(ModelAdapter):
+    kind = "iem1d"
+
+    def model_class(self):
+        from ..reconstruct.iem import InvertedEncoding1D
+        return InvertedEncoding1D
+
+    def pack(self, model):
+        self._fitted(model, "W_", "channels_", "channel_centers_")
+        out = {
+            "W_": np.asarray(model.W_),
+            "channels_": np.asarray(model.channels_),
+            "channel_centers_": np.asarray(model.channel_centers_),
+        }
+        _put_scalar(out, "n_channels", model.n_channels)
+        _put_scalar(out, "channel_exp", model.channel_exp)
+        _put_scalar(out, "stimulus_mode", model.stimulus_mode)
+        _put_scalar(out, "range_start", model.range_start)
+        _put_scalar(out, "range_stop", model.range_stop)
+        _put_scalar(out, "channel_density", model.channel_density)
+        _put_scalar(out, "stim_res", model.stim_res)
+        return out
+
+    def unpack(self, z):
+        model = self.model_class()(
+            n_channels=_scalar(z, "n_channels"),
+            channel_exp=_scalar(z, "channel_exp"),
+            stimulus_mode=_scalar(z, "stimulus_mode"),
+            range_start=_scalar(z, "range_start"),
+            range_stop=_scalar(z, "range_stop"),
+            channel_density=_scalar(z, "channel_density"),
+            stimulus_resolution=_scalar(z, "stim_res"))
+        model.W_ = np.asarray(z["W_"])
+        model.channels_ = np.asarray(z["channels_"])
+        model.channel_centers_ = np.asarray(z["channel_centers_"])
+        return model
+
+
+class IEM2DAdapter(ModelAdapter):
+    kind = "iem2d"
+
+    def model_class(self):
+        from ..reconstruct.iem import InvertedEncoding2D
+        return InvertedEncoding2D
+
+    def pack(self, model):
+        self._fitted(model, "W_")
+        if model.channels is None:
+            raise ValueError("model has no channel basis defined")
+        out = {
+            "W_": np.asarray(model.W_),
+            "channels": np.asarray(model.channels),
+            "stim_fov": np.asarray(model.stim_fov),
+            "stim_resolution": np.asarray(
+                [len(model.stim_pixels[0]), len(model.stim_pixels[1])]),
+            "channel_limits": np.asarray(model.channel_limits),
+        }
+        _put_scalar(out, "channel_exp", model.channel_exp)
+        _maybe(out, "stim_radius_px", model.stim_radius_px)
+        return out
+
+    def unpack(self, z):
+        fov = np.asarray(z["stim_fov"])
+        res = np.asarray(z["stim_resolution"])
+        limits = np.asarray(z["channel_limits"])
+        radius = _scalar(z, "stim_radius_px") \
+            if "stim_radius_px" in z else None
+        model = self.model_class()(
+            stim_xlim=list(fov[0]), stim_ylim=list(fov[1]),
+            stimulus_resolution=[int(res[0]), int(res[1])],
+            stim_radius=radius,
+            chan_xlim=list(limits[0]), chan_ylim=list(limits[1]),
+            channels=np.asarray(z["channels"]),
+            channel_exp=_scalar(z, "channel_exp"))
+        model.W_ = np.asarray(z["W_"])
+        return model
+
+
+class FCMAClassifierAdapter(ModelAdapter):
+    """FCMA correlation classifier.  The wrapped sklearn estimator is
+    stored as labeled pickle bytes (see the module docstring's trust
+    caveat); everything else is plain arrays."""
+
+    kind = "fcma"
+
+    def model_class(self):
+        from ..fcma.classifier import Classifier
+        return Classifier
+
+    def pack(self, model):
+        self._fitted(model, "num_voxels_", "num_features_",
+                     "num_samples_")
+        out = {
+            "clf_pickle": np.frombuffer(
+                pickle.dumps(model.clf), dtype=np.uint8),
+        }
+        _put_scalar(out, "num_processed_voxels",
+                    model.num_processed_voxels)
+        _put_scalar(out, "epochs_per_subj", model.epochs_per_subj)
+        _put_scalar(out, "use_pallas", bool(model.use_pallas))
+        _put_scalar(out, "num_digits_", model.num_digits_)
+        _put_scalar(out, "num_voxels_", model.num_voxels_)
+        _put_scalar(out, "num_features_", model.num_features_)
+        _put_scalar(out, "num_samples_", model.num_samples_)
+        _maybe(out, "training_data_",
+               getattr(model, "training_data_", None))
+        return out
+
+    def unpack(self, z):
+        clf = pickle.loads(np.asarray(z["clf_pickle"]).tobytes())
+        model = self.model_class()(
+            clf,
+            num_processed_voxels=_scalar(z, "num_processed_voxels"),
+            epochs_per_subj=_scalar(z, "epochs_per_subj"),
+            use_pallas=bool(_scalar(z, "use_pallas")))
+        model.num_digits_ = _scalar(z, "num_digits_")
+        model.num_voxels_ = _scalar(z, "num_voxels_")
+        model.num_features_ = _scalar(z, "num_features_")
+        model.num_samples_ = _scalar(z, "num_samples_")
+        model.training_data_ = (
+            np.asarray(z["training_data_"])
+            if "training_data_" in z else None)
+        model.test_raw_data_ = None
+        model.test_data_ = None
+        return model
+
+
+#: kind -> adapter instance, in dispatch order.
+ADAPTERS = {a.kind: a for a in (
+    SRMAdapter(), DetSRMAdapter(), RSRMAdapter(),
+    EventSegmentAdapter(), IEM1DAdapter(), IEM2DAdapter(),
+    FCMAClassifierAdapter())}
+
+
+def detect_kind(model):
+    """The artifact ``kind`` serving this model, or raise TypeError."""
+    for kind, adapter in ADAPTERS.items():
+        if adapter.matches(model):
+            return kind
+    raise TypeError(
+        f"no serve adapter registered for {type(model).__name__} "
+        f"(known kinds: {', '.join(ADAPTERS)})")
+
+
+def save_model(model, file):
+    """Persist a fitted model as a versioned npz artifact.
+
+    ``file`` is a path or file-like object; returns ``file``.  The
+    adapter is selected by the model's type (:func:`detect_kind`).
+    """
+    kind = detect_kind(model)
+    arrays = ADAPTERS[kind].pack(model)
+    for key in (KIND_KEY, VERSION_KEY):
+        if key in arrays:  # pragma: no cover - adapter authoring bug
+            raise ValueError(f"adapter {kind} may not write {key}")
+    arrays[KIND_KEY] = np.asarray(kind)
+    arrays[VERSION_KEY] = np.asarray(SCHEMA_VERSION)
+    if isinstance(file, (str, os.PathLike)):
+        # np.savez_compressed appends ".npz" to extensionless paths
+        # behind the caller's back; normalize up front so the
+        # returned path is the one actually written and
+        # load_model(save_model(m, f)) round-trips for any f
+        file = os.fspath(file)
+        if not file.endswith(".npz"):
+            file += ".npz"
+    np.savez_compressed(file, **arrays)
+    return file
+
+
+@retry(name="serve.load_model",
+       retry_if=lambda exc: not isinstance(
+           exc, (FileNotFoundError, IsADirectoryError,
+                 NotADirectoryError)))
+def _read_arrays(file):
+    """All npz entries materialized under the retry guard, so a
+    transient shared-filesystem fault on ANY member read retries the
+    whole load (NpzFile reads members lazily).  File-like inputs are
+    rewound at the top of every attempt — a retry after a partial
+    read must not resume mid-stream."""
+    seek = getattr(file, "seek", None)
+    if callable(seek):
+        try:
+            seek(0)
+        except (OSError, ValueError):
+            pass  # non-seekable stream: first attempt still works
+    with np.load(file, allow_pickle=False) as z:
+        return {key: np.asarray(z[key]) for key in z.files}
+
+
+def load_model(file):
+    """Load a model artifact written by :func:`save_model`.
+
+    The read retries transient ``OSError`` with exponential backoff
+    (:func:`brainiak_tpu.resilience.retry`); deterministic path
+    errors (missing file, directory-in-the-way) and schema
+    violations — missing kind, unknown kind, newer schema version —
+    raise immediately (retrying cannot fix a bad path or artifact).
+    """
+    arrays = _read_arrays(file)
+    if KIND_KEY not in arrays or VERSION_KEY not in arrays:
+        raise ValueError(
+            f"{file!r} is not a serve artifact (missing "
+            f"{KIND_KEY}/{VERSION_KEY}; wrote with save_model?)")
+    kind = str(arrays[KIND_KEY])
+    version = int(arrays[VERSION_KEY])
+    if version > SCHEMA_VERSION:
+        raise ValueError(
+            f"artifact schema v{version} is newer than this loader "
+            f"understands (v{SCHEMA_VERSION}); upgrade brainiak_tpu")
+    adapter = ADAPTERS.get(kind)
+    if adapter is None:
+        raise ValueError(
+            f"unknown artifact kind {kind!r} "
+            f"(known: {', '.join(ADAPTERS)})")
+    model = adapter.unpack(arrays)
+    logger.info("loaded %s artifact (schema v%d) from %r",
+                kind, version, file)
+    return model
+
+
+def save_model_bytes(model):
+    """The artifact as bytes (for object stores without a filesystem
+    path); :func:`load_model` accepts the ``io.BytesIO`` round-trip."""
+    buf = io.BytesIO()
+    save_model(model, buf)
+    return buf.getvalue()

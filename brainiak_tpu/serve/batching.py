@@ -1,0 +1,198 @@
+"""Shape-bucketed request batching: the policy layer of the engine.
+
+TPU inference economics in one sentence: XLA compiles one program per
+input *shape*, so serving heterogeneous requests (a 40-TR scan here,
+a 900-TR scan there) naively compiles per request — the batching
+layer instead rounds every dynamic extent UP to a power of two
+(:func:`bucket_length`), so an unbounded family of request shapes
+lands in a small, enumerable set of **buckets** and the compile count
+is bounded by the bucket count, not the request count (the engine's
+``retrace_total{site=serve.*}`` makes that bound observable).
+
+Padding is only used where it is *exact* for the model family being
+served (zero TR-columns of an SRM transform produce zero shared-
+response columns that are sliced off; see docs/serving.md for the
+per-kind table) — a kind whose math is not padding-invariant
+(EventSegment's forward–backward over time) buckets on the exact
+extent instead and batches only across requests.
+
+This module holds the data types (:class:`Request`,
+:class:`ServeResult`), the flush policy (:class:`BucketPolicy`), the
+padding helpers, and the request-file codec the offline CLI driver
+uses; the dispatch loop lives in :mod:`brainiak_tpu.serve.engine`.
+"""
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = [
+    "BucketPolicy",
+    "Request",
+    "ServeResult",
+    "bucket_length",
+    "load_requests",
+    "pad_axis",
+    "save_requests",
+]
+
+
+def bucket_length(n, floor=16):
+    """Smallest power of two >= ``max(n, floor)``.
+
+    The floor keeps tiny requests from fragmenting the program cache
+    into 1/2/4/8 buckets nobody benefits from (padding a 3-TR request
+    to 16 costs nothing next to a compile).
+    """
+    n = max(int(n), int(floor))
+    return 1 << (n - 1).bit_length()
+
+
+def pad_axis(x, axis, target):
+    """Zero-pad ``x`` along ``axis`` up to ``target`` (no-op when
+    already there)."""
+    x = np.asarray(x)
+    have = x.shape[axis]
+    if have == target:
+        return x
+    if have > target:  # pragma: no cover - caller bucketing bug
+        raise ValueError(f"axis {axis} is {have}, beyond {target}")
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, target - have)
+    return np.pad(x, widths)
+
+
+@dataclasses.dataclass
+class BucketPolicy:
+    """Flush policy knobs.
+
+    - ``max_batch``: a bucket flushes as soon as it holds this many
+      requests (rounded up to a power of two at dispatch, so keep it
+      a power of two to avoid an extra partial-batch program shape);
+    - ``max_wait_s``: a bucket flushes when its OLDEST request has
+      queued this long, full or not — the tail-latency bound;
+    - ``min_bucket``: floor passed to :func:`bucket_length` for the
+      padded data axis;
+    - ``min_batch_bucket``: floor for the padded batch axis (1 keeps
+      singleton flushes cheap while still power-of-two).
+    """
+
+    max_batch: int = 64
+    max_wait_s: float = 0.05
+    min_bucket: int = 16
+    min_batch_bucket: int = 1
+
+    def batch_bucket(self, n):
+        """Padded batch extent for ``n`` queued requests."""
+        return min(bucket_length(n, floor=self.min_batch_bucket),
+                   bucket_length(self.max_batch,
+                                 floor=self.min_batch_bucket))
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request.
+
+    ``x`` is the kind-specific payload (an array, or for the FCMA
+    classifier a 2-sequence of arrays); ``subject`` selects the
+    fitted per-subject map for the SRM family; ``deadline_s`` is a
+    per-request budget in seconds measured from submission — a
+    request still queued past it is failed at dispatch time with a
+    ``deadline_exceeded`` error record instead of consuming device
+    time.
+
+    ``submitted`` is stamped by the engine on first submit and never
+    overwritten, so a caller may pre-stamp it (network-ingress time)
+    for truer queue-time SLOs.  The flip side: RESUBMITTING a
+    Request object (e.g. retrying a ``deadline_exceeded``) keeps the
+    stale clock and fails again immediately — reset
+    ``submitted = None`` before resubmission.
+    """
+
+    request_id: str
+    x: Any
+    subject: Optional[int] = None
+    deadline_s: Optional[float] = None
+    submitted: Optional[float] = None
+
+    def expired(self, now=None):
+        if self.deadline_s is None or self.submitted is None:
+            return False
+        if now is None:
+            now = time.monotonic()
+        return (now - self.submitted) > self.deadline_s
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """The engine's answer for one request: a result or a structured
+    error, never silence.  ``error`` is a stable machine code
+    (``invalid_payload``, ``invalid_shape``, ``invalid_subject``,
+    ``non_finite_input``, ``deadline_exceeded``,
+    ``execution_failed``); ``message`` is the human detail.
+    ``seq`` is the engine's submission index — the ordering key, so
+    duplicate ``request_id`` values cannot misorder results."""
+
+    request_id: str
+    ok: bool
+    result: Any = None
+    error: Optional[str] = None
+    message: Optional[str] = None
+    bucket: Optional[tuple] = None
+    latency_s: Optional[float] = None
+    seq: Optional[int] = None
+
+
+# -- request-file codec (offline CLI driver) --------------------------
+
+def save_requests(file, payloads, subjects=None, deadlines=None,
+                  ids=None):
+    """Write a batch of requests as one npz.
+
+    ``payloads``: list of arrays (or 2-sequences of arrays for the
+    FCMA pair layout, stored as ``x.<i>.0`` / ``x.<i>.1``);
+    ``subjects`` / ``deadlines``: optional per-request sequences
+    (None entries are omitted); ``ids`` default to ``"r<i>"``.
+    Returns ``file``.
+    """
+    out = {"n": np.asarray(len(payloads))}
+    for i, payload in enumerate(payloads):
+        if isinstance(payload, (tuple, list)):
+            out[f"x.{i}.pair"] = np.asarray(len(payload))
+            for j, part in enumerate(payload):
+                out[f"x.{i}.{j}"] = np.asarray(part)
+        else:
+            out[f"x.{i}"] = np.asarray(payload)
+        if ids is not None:
+            out[f"id.{i}"] = np.asarray(str(ids[i]))
+        if subjects is not None and subjects[i] is not None:
+            out[f"subject.{i}"] = np.asarray(int(subjects[i]))
+        if deadlines is not None and deadlines[i] is not None:
+            out[f"deadline.{i}"] = np.asarray(float(deadlines[i]))
+    np.savez_compressed(file, **out)
+    return file
+
+
+def load_requests(file):
+    """Read a request npz back into a list of :class:`Request`."""
+    with np.load(file, allow_pickle=False) as z:
+        n = int(z["n"])
+        out = []
+        for i in range(n):
+            if f"x.{i}.pair" in z.files:
+                parts = int(z[f"x.{i}.pair"])
+                x = tuple(np.asarray(z[f"x.{i}.{j}"])
+                          for j in range(parts))
+            else:
+                x = np.asarray(z[f"x.{i}"])
+            rid = str(np.asarray(z[f"id.{i}"])) \
+                if f"id.{i}" in z.files else f"r{i}"
+            subject = int(z[f"subject.{i}"]) \
+                if f"subject.{i}" in z.files else None
+            deadline = float(z[f"deadline.{i}"]) \
+                if f"deadline.{i}" in z.files else None
+            out.append(Request(request_id=rid, x=x, subject=subject,
+                               deadline_s=deadline))
+    return out

@@ -1,0 +1,922 @@
+"""In-process batched inference engine over persisted models.
+
+The serving analog of the fit path's resilient loop: heterogeneous
+requests (mixed TR lengths, mixed batch sizes, mixed subjects) are
+padded into the power-of-two shape buckets of
+:mod:`brainiak_tpu.serve.batching` and each (model, bucket) runs ONE
+jitted program, built by a :func:`program_cache`-decorated builder so
+every fresh compile is counted in ``retrace_total{site=serve.*}`` —
+the acceptance bound is compiles <= distinct buckets, never compiles
+per request.  Input batch buffers are donated to XLA (they are
+assembled fresh per dispatch and never reused), so the padded batch
+does not double-buffer in HBM.
+
+Failure isolation: a *poison* request — wrong shape, non-finite
+payload, or one that makes the whole batch fail — produces a
+structured :class:`~brainiak_tpu.serve.batching.ServeResult` error
+record; validation rejects what it can before batching, and a batch
+whose dispatch raises falls back to per-request execution so the
+poison request alone fails.  Per-request deadlines are enforced at
+dispatch: a request still queued past its budget is failed without
+consuming device time.
+
+Telemetry (live only while :mod:`brainiak_tpu.obs` has a sink):
+``serve.batch`` spans around every dispatch, ``serve.request`` span
+records carrying per-request latency, ``serve_request_seconds`` /
+``serve_batch_seconds`` histograms, ``serve_queue_depth`` and
+``serve_padding_waste_ratio`` gauges, ``serve_requests_total``
+counters by outcome, and — with ``BRAINIAK_TPU_OBS_PROFILE`` on —
+schema-v2 ``cost`` records for every serve program via
+:func:`brainiak_tpu.obs.profile.profile_program`.
+"""
+
+import logging
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import profile as obs_profile
+from ..obs import sink as obs_sink
+from ..obs import spans as obs_spans
+from ..obs.runtime import counted_cache
+from ..ops.correlation import PRECISION
+from . import artifacts
+from .batching import (BucketPolicy, ServeResult, bucket_length,
+                       pad_axis)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["InferenceEngine", "program_cache"]
+
+
+def program_cache(site, maxsize=None):
+    """The serve program cache: a retrace-counting
+    :func:`~brainiak_tpu.obs.runtime.counted_cache` over the bucket
+    program builders, under serve's ``site`` naming convention
+    (``serve.<family>``).  jaxlint's JX001 recognizes it as a caching
+    decorator, so constructing ``jax.jit`` inside a builder it
+    decorates is clean by construction."""
+    return counted_cache(site, maxsize=maxsize)
+
+
+# -- bucket program builders ------------------------------------------
+#
+# One builder per program family; the lru key IS the bucket (every
+# extent that shapes the traced arrays, plus trace-time statics), so
+# counted_cache misses == distinct compiled programs.  The padded
+# batch buffer is donated in every family (argument 2 by convention,
+# except eventseg where it is argument 5): it is assembled fresh per
+# dispatch and never reused, so XLA may overwrite it in place instead
+# of double-buffering the padded batch in HBM.
+
+def _donate(*argnums):
+    """``donate_argnums`` for the batch buffer — skipped on CPU,
+    where XLA cannot use donations and jax warns per compile."""
+    return () if jax.default_backend() == "cpu" else argnums
+
+
+@program_cache("serve.srm")
+def _srm_program(n_subjects, v_pad, k, t_bucket, b_pad, dtype):
+    """SRM / DetSRM transform: ``s_i = W_iᵀ x_i`` over a padded
+    batch.  Zero voxel-padding is exact (zero rows of both W and x);
+    zero TR-padding yields zero output columns sliced off on host."""
+
+    @partial(jax.jit, donate_argnums=_donate(2))
+    def run(w_stack, subjects, x):
+        w = jnp.take(w_stack, subjects, axis=0)
+        return jnp.einsum('bvk,bvt->bkt', w, x, precision=PRECISION)
+
+    return obs_profile.profile_program(run, "serve.srm",
+                                       span="serve.batch")
+
+
+@program_cache("serve.rsrm")
+def _rsrm_program(n_subjects, v_pad, k, t_bucket, b_pad, gamma,
+                  n_iter, dtype):
+    """RSRM transform-new-data, vmapped over the padded batch (the
+    alternating shrinkage/projection loop of
+    :func:`brainiak_tpu.funcalign.rsrm._transform_new_data`); both
+    paddings are exact — every update is per-column and zero-padded
+    voxel rows stay zero."""
+    # estimator modules import lazily (once per bucket): building a
+    # serve artifact host must not pay for every estimator
+    from ..funcalign.rsrm import _transform_new_data
+
+    @partial(jax.jit, donate_argnums=_donate(2))
+    def run(w_stack, subjects, x):
+        w = jnp.take(w_stack, subjects, axis=0)
+        return jax.vmap(
+            lambda wi, xi: _transform_new_data(xi, wi, gamma,
+                                               n_iter))(w, x)
+
+    return obs_profile.profile_program(run, "serve.rsrm",
+                                       span="serve.batch")
+
+
+# eventseg's bucket space is request-controlled (the bucket is the
+# EXACT T), so unlike the pow2-bucketed kinds its program cache must
+# be explicitly bounded: LRU-evict beyond 64 (T, batch) shapes — see
+# the operational note in docs/serving.md
+_EVENTSEG_CACHE_PROGRAMS = 64
+
+
+@program_cache("serve.eventseg", maxsize=_EVENTSEG_CACHE_PROGRAMS)
+def _eventseg_program(n_vox, t_len, k, b_pad, dtype):
+    """Batched ``find_events``: observation log-probs + forward-
+    backward per request, vmapped.  The time axis is NOT padded (the
+    transition chain and the z-scoring are T-dependent); the bucket
+    is the exact T, batching only across requests."""
+    from ..eventseg.event import (_forward_backward_core,
+                                  _logprob_obs_core)
+
+    @partial(jax.jit, donate_argnums=_donate(5))
+    def run(mean_pat, var, log_p, log_p_start, log_p_end, x):
+        def one(xi):
+            lp = _logprob_obs_core(xi, mean_pat, var)
+            lp_ext = jnp.concatenate(
+                [lp, jnp.full((lp.shape[0], 1), -jnp.inf, lp.dtype)],
+                axis=1)
+            lg, ll = _forward_backward_core(lp_ext, log_p,
+                                            log_p_start, log_p_end)
+            return lg[:, :-1], ll
+
+        return jax.vmap(one)(x)
+
+    return obs_profile.profile_program(run, "serve.eventseg",
+                                       span="serve.batch")
+
+
+@program_cache("serve.iem")
+def _iem_program(t_bucket, n_vox, k_chan, density, b_pad, dtype):
+    """IEM1D predict: channel responses via the precomputed
+    pseudo-inverse, feature responses, argmax over the channel
+    domain.  Trials are independent, so zero trial-padding is exact
+    for the real rows."""
+
+    @partial(jax.jit, donate_argnums=_donate(2))
+    def run(pinv_w, channels, x):
+        resp = jnp.einsum('kv,btv->btk', pinv_w, x,
+                          precision=PRECISION)
+        feat = jnp.einsum('kd,btk->btd', channels, resp,
+                          precision=PRECISION)
+        return jnp.argmax(feat, axis=2)
+
+    return obs_profile.profile_program(run, "serve.iem",
+                                       span="serve.batch")
+
+
+# -- per-kind serve ops -----------------------------------------------
+
+class _ServeOp:
+    """Kind-specific half of the engine: payload validation, bucket
+    keying, batch assembly, and result slicing.
+
+    ``isolate_on_failure``: whether a failed batch may be retried
+    request-by-request.  True wherever requests are independent
+    (every jitted-program kind); an op whose batch members interact
+    (FCMA's batch-dependent normalization) sets it False, because a
+    singleton re-run would silently CHANGE the survivors' answers.
+    """
+
+    site = None
+    isolate_on_failure = True
+
+    def __init__(self, model, policy):
+        self.model = model
+        self.policy = policy
+
+    def validate(self, req):
+        """(error_code, message) for a rejectable payload, else
+        None."""
+        raise NotImplementedError
+
+    def bucket_key(self, req):
+        raise NotImplementedError
+
+    def real_elements(self, req):
+        x = req.x
+        if isinstance(x, (tuple, list)):
+            return int(sum(np.asarray(p).size for p in x))
+        return int(np.asarray(x).size)
+
+    def batch_extent(self, n):
+        return self.policy.batch_bucket(n)
+
+    def padded_elements(self, key, b_pad):
+        raise NotImplementedError
+
+    def dispatch(self, reqs, key, b_pad):
+        """Run one padded batch; returns per-request results (host
+        arrays)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def _check_finite(x):
+        arrs = x if isinstance(x, (tuple, list)) else (x,)
+        for arr in arrs:
+            if not np.all(np.isfinite(np.asarray(arr))):
+                return ("non_finite_input",
+                        "payload contains NaN/Inf")
+        return None
+
+
+class _SRMFamilyOp(_ServeOp):
+    """SRM and DetSRM ``transform``: per-subject shared-space
+    projection."""
+
+    site = "serve.srm"
+
+    def __init__(self, model, policy):
+        super().__init__(model, policy)
+        self.voxel_counts = [w.shape[0] for w in model.w_]
+        self.v_pad = max(self.voxel_counts)
+        self.k = model.w_[0].shape[1]
+        self.dtype = np.asarray(model.w_[0]).dtype
+        stack = np.zeros(
+            (len(model.w_), self.v_pad, self.k), dtype=self.dtype)
+        for i, w in enumerate(model.w_):
+            stack[i, :w.shape[0]] = w
+        self.w_stack = jnp.asarray(stack)
+
+    def validate(self, req):
+        if req.subject is None or not (
+                0 <= int(req.subject) < len(self.voxel_counts)):
+            return ("invalid_subject",
+                    f"subject must be in [0, "
+                    f"{len(self.voxel_counts)}), got {req.subject}")
+        x = np.asarray(req.x)
+        if x.ndim != 2:
+            return ("invalid_shape",
+                    f"expected [voxels, TRs], got ndim={x.ndim}")
+        want = self.voxel_counts[int(req.subject)]
+        if x.shape[0] != want:
+            return ("invalid_shape",
+                    f"subject {req.subject} has {want} voxels, "
+                    f"payload has {x.shape[0]}")
+        return self._check_finite(x)
+
+    def bucket_key(self, req):
+        return (bucket_length(np.asarray(req.x).shape[1],
+                              floor=self.policy.min_bucket),)
+
+    def padded_elements(self, key, b_pad):
+        return b_pad * self.v_pad * key[0]
+
+    def _assemble(self, reqs, t_b, b_pad):
+        """The padded batch buffer + subject indices shared by the
+        SRM-family programs."""
+        x = np.zeros((b_pad, self.v_pad, t_b), dtype=self.dtype)
+        subjects = np.zeros((b_pad,), dtype=np.int32)
+        for i, req in enumerate(reqs):
+            xi = np.asarray(req.x, dtype=self.dtype)
+            x[i, :xi.shape[0], :xi.shape[1]] = xi
+            subjects[i] = int(req.subject)
+        return x, subjects
+
+    def dispatch(self, reqs, key, b_pad):
+        t_b = key[0]
+        x, subjects = self._assemble(reqs, t_b, b_pad)
+        prog = _srm_program(len(self.voxel_counts), self.v_pad,
+                            self.k, t_b, b_pad, str(self.dtype))
+        out = np.asarray(prog(self.w_stack, jnp.asarray(subjects),
+                              jnp.asarray(x)))
+        return [np.array(out[i, :, :np.asarray(r.x).shape[1]])
+                for i, r in enumerate(reqs)]
+
+
+class _RSRMTransformOp(_SRMFamilyOp):
+    """RSRM ``transform``: (shared response, sparse individual
+    term) per request via the alternating shrinkage loop."""
+
+    site = "serve.rsrm"
+
+    def dispatch(self, reqs, key, b_pad):
+        t_b = key[0]
+        x, subjects = self._assemble(reqs, t_b, b_pad)
+        prog = _rsrm_program(
+            len(self.voxel_counts), self.v_pad, self.k, t_b, b_pad,
+            float(self.model.gamma), int(self.model.n_iter),
+            str(self.dtype))
+        r, s = prog(self.w_stack, jnp.asarray(subjects),
+                    jnp.asarray(x))
+        r = np.asarray(r)
+        s = np.asarray(s)
+        out = []
+        for i, req in enumerate(reqs):
+            v_i, t_i = np.asarray(req.x).shape
+            out.append((np.array(r[i, :, :t_i]),
+                        np.array(s[i, :v_i, :t_i])))
+        return out
+
+
+class _EventSegmentOp(_ServeOp):
+    """``find_events`` on held-out scans: returns
+    ``(segments [T, K], log-likelihood)`` per request."""
+
+    site = "serve.eventseg"
+
+    def __init__(self, model, policy):
+        super().__init__(model, policy)
+        self.n_vox, self.k = model.event_pat_.shape
+        var = model.event_var_
+        if not isinstance(var, np.ndarray):
+            var = var * np.ones(model.n_events)
+        self.var = jnp.asarray(np.asarray(var, dtype=float))
+        self.mean_pat = jnp.asarray(model.event_pat_)
+        self._transitions = {}
+
+    def validate(self, req):
+        x = np.asarray(req.x)
+        if x.ndim != 2 or x.shape[1] != self.n_vox:
+            return ("invalid_shape",
+                    f"expected [TRs, {self.n_vox}], got "
+                    f"{x.shape}")
+        if x.shape[0] < self.k:
+            return ("invalid_shape",
+                    f"need at least {self.k} TRs for {self.k} "
+                    "events")
+        return self._check_finite(x)
+
+    def bucket_key(self, req):
+        # exact T: the transition chain and z-scoring are
+        # T-dependent, so TR padding would change the answer
+        return (int(np.asarray(req.x).shape[0]),)
+
+    def padded_elements(self, key, b_pad):
+        return b_pad * self.n_vox * key[0]
+
+    def _transition_logs(self, t):
+        cached = self._transitions.get(t)
+        if cached is None:
+            log_p, log_start, log_end = \
+                self.model._build_transitions(t)
+            cached = (jnp.asarray(log_p), jnp.asarray(log_start),
+                      jnp.asarray(log_end))
+            # bounded like the program cache: T is request-
+            # controlled, and a long-lived server must not pin one
+            # transition triple per distinct scan length forever
+            if len(self._transitions) >= _EVENTSEG_CACHE_PROGRAMS:
+                self._transitions.pop(
+                    next(iter(self._transitions)))
+            self._transitions[t] = cached
+        return cached
+
+    def dispatch(self, reqs, key, b_pad):
+        t = key[0]
+        log_p, log_start, log_end = self._transition_logs(t)
+        x = np.empty((b_pad, self.n_vox, t), dtype=float)
+        for i, req in enumerate(reqs):
+            x[i] = np.asarray(req.x).T
+        # pad lanes with a COPY of the last real scan (all-zero
+        # padding would z-score to NaN; lanes are independent under
+        # vmap, and pad results are discarded)
+        for i in range(len(reqs), b_pad):
+            x[i] = x[len(reqs) - 1]
+        prog = _eventseg_program(self.n_vox, t, self.k, b_pad,
+                                 str(x.dtype))
+        lg, ll = prog(self.mean_pat, self.var, log_p, log_start,
+                      log_end, jnp.asarray(x))
+        lg = np.asarray(lg)
+        ll = np.asarray(ll)
+        return [(np.exp(lg[i]), float(ll[i]))
+                for i in range(len(reqs))]
+
+
+class _IEM1DOp(_ServeOp):
+    """``InvertedEncoding1D.predict``: decoded feature value per
+    trial."""
+
+    site = "serve.iem"
+
+    def __init__(self, model, policy):
+        super().__init__(model, policy)
+        self.n_vox = model.W_.shape[0]
+        self.dtype = np.asarray(model.W_).dtype
+        self.pinv_w = jnp.linalg.pinv(jnp.asarray(model.W_))
+        self.channels = jnp.asarray(
+            np.asarray(model.channels_, dtype=self.dtype))
+        self.k_chan = int(model.channels_.shape[0])
+        self.density = int(model.channels_.shape[1])
+        self.domain = np.asarray(model.channel_domain)
+
+    def validate(self, req):
+        x = np.asarray(req.x)
+        if x.ndim != 2 or x.shape[1] != self.n_vox:
+            return ("invalid_shape",
+                    f"expected [trials, {self.n_vox}], got "
+                    f"{x.shape}")
+        return self._check_finite(x)
+
+    def bucket_key(self, req):
+        return (bucket_length(np.asarray(req.x).shape[0],
+                              floor=self.policy.min_bucket),)
+
+    def padded_elements(self, key, b_pad):
+        return b_pad * key[0] * self.n_vox
+
+    def dispatch(self, reqs, key, b_pad):
+        t_b = key[0]
+        x = np.zeros((b_pad, t_b, self.n_vox), dtype=self.dtype)
+        for i, req in enumerate(reqs):
+            xi = np.asarray(req.x, dtype=self.dtype)
+            x[i, :xi.shape[0]] = xi
+        prog = _iem_program(t_b, self.n_vox, self.k_chan,
+                            self.density, b_pad, str(self.dtype))
+        idx = np.asarray(prog(self.pinv_w, self.channels,
+                              jnp.asarray(x)))
+        return [self.domain[idx[i, :np.asarray(r.x).shape[0]]]
+                for i, r in enumerate(reqs)]
+
+
+# (pair_voxels, TR bucket, flush size) combinations already traced by
+# the FCMA classifier's process-global jitted programs — mirrors
+# jax.jit's own cache lifetime, NOT any engine's (see dispatch below)
+_FCMA_SEEN_SHAPES = set()
+
+
+class _FCMAPredictOp(_ServeOp):
+    """FCMA classifier ``predict`` on (region1, region2) epoch
+    pairs.
+
+    Host-delegated: the classifier's own jitted feature/Gram
+    programs run the batch.  Only their TR extent is bounded by the
+    bucket — the batch extent is the TRUE flush size, because the
+    test-side normalization is computed over the dispatched batch
+    (exactly :meth:`Classifier.predict` semantics), which makes
+    results batch-composition-dependent by construction; the batch
+    is therefore never padded with dummy requests, and TR
+    zero-padding alone is exact (correlation sums over TRs).  The
+    flip side is a compile per distinct (TR bucket, flush size) —
+    dispatch counts each process-novel shape into
+    ``retrace_total{site=serve.fcma}`` so the engine summary and
+    SRV001 stay honest; online fcma workloads should pin
+    ``max_batch``/``max_wait`` for steady flush sizes.
+
+    ``isolate_on_failure`` is False for the same reason: re-running
+    a failed batch's survivors one by one would renormalize each
+    against a batch of 1 and silently change their predictions, so
+    a failed FCMA batch fails as a unit.
+    """
+
+    site = "serve.fcma"
+    isolate_on_failure = False
+
+    def __init__(self, model, policy):
+        super().__init__(model, policy)
+        if model._is_precomputed_svm() and \
+                getattr(model, "training_data_", None) is None:
+            raise ValueError(
+                "this FCMA artifact cannot serve predict: the SVM "
+                "kernel was precomputed portion-by-portion and the "
+                "training correlation features were not retained "
+                "(refit with num_processed_voxels >= num voxels)")
+        self.num_features = int(model.num_features_)
+        self.pair_voxels = sorted(
+            (int(model.num_voxels_),
+             self.num_features // int(model.num_voxels_)))
+
+    def validate(self, req):
+        x = req.x
+        if not isinstance(x, (tuple, list)) or len(x) != 2:
+            return ("invalid_shape",
+                    "payload must be a (region1, region2) pair")
+        x1, x2 = (np.asarray(p) for p in x)
+        if x1.ndim != 2 or x2.ndim != 2 \
+                or x1.shape[0] != x2.shape[0]:
+            return ("invalid_shape",
+                    "pair members must be [TRs, voxels] with equal "
+                    "TRs")
+        # per-region counts, order-insensitive (matching the
+        # _stack_pairs swap) — the product alone would accept a
+        # (1, v1*v2)-shaped pair whose correlation geometry has
+        # nothing to do with training
+        if sorted((x1.shape[1], x2.shape[1])) != self.pair_voxels:
+            return ("invalid_shape",
+                    f"pair voxel counts ({x1.shape[1]}, "
+                    f"{x2.shape[1]}) do not match the model's "
+                    f"{tuple(self.pair_voxels)}")
+        return self._check_finite(x)
+
+    def bucket_key(self, req):
+        return (bucket_length(np.asarray(req.x[0]).shape[0],
+                              floor=self.policy.min_bucket),)
+
+    def batch_extent(self, n):
+        return n  # normalization depends on the true batch size
+
+    def padded_elements(self, key, b_pad):
+        # both pair members padded to the TR bucket
+        return b_pad * key[0] * sum(self.pair_voxels)
+
+    def dispatch(self, reqs, key, b_pad):
+        t_b = key[0]
+        # the classifier's jitted programs key on (voxel geometry,
+        # flush size, TR bucket) — a novel combination means a fresh
+        # trace+compile that the program_cache counter cannot see
+        # (host delegation), so count it here.  The seen-set is
+        # module-level because jax.jit's cache is process-global: a
+        # fresh engine over already-compiled shapes must read 0, the
+        # same warm-cache contract as the program_cache sites.
+        shape = (tuple(self.pair_voxels), t_b, len(reqs))
+        if shape not in _FCMA_SEEN_SHAPES:
+            _FCMA_SEEN_SHAPES.add(shape)
+            obs_metrics.counter("retrace_total").inc(site=self.site)
+        # validate() accepts either region order, but _stack_pairs
+        # swaps whole stacks keyed on the first pair only — a batch
+        # mixing orders would np.stack ragged shapes and fail as a
+        # unit.  Canonicalize per pair (larger region first, the
+        # same order _stack_pairs settles on for a lone request).
+        pairs = []
+        for r in reqs:
+            x1, x2 = (np.asarray(p) for p in r.x)
+            if x1.shape[1] < x2.shape[1]:
+                x1, x2 = x2, x1
+            pairs.append((pad_axis(x1, 0, t_b),
+                          pad_axis(x2, 0, t_b)))
+        labels = np.asarray(self.model.predict(pairs))
+        return [labels[i] for i in range(len(reqs))]
+
+
+_KIND_OPS = {
+    "srm": _SRMFamilyOp,
+    "detsrm": _SRMFamilyOp,
+    "rsrm": _RSRMTransformOp,
+    "eventseg": _EventSegmentOp,
+    "iem1d": _IEM1DOp,
+    "fcma": _FCMAPredictOp,
+}
+
+
+class InferenceEngine:
+    """Shape-bucketed batched inference over one fitted model.
+
+    Parameters
+    ----------
+    model : a fitted estimator with a registered serve adapter
+        (:data:`brainiak_tpu.serve.artifacts.ADAPTERS`) and an
+        engine op (SRM/DetSRM/RSRM transform, EventSegment
+        find_events, InvertedEncoding1D predict, FCMA Classifier
+        predict).
+    kind : str, optional
+        Override adapter detection (useful for duck-typed models).
+    policy : :class:`~brainiak_tpu.serve.batching.BucketPolicy`
+
+    Usage: :meth:`submit` requests (full buckets flush
+    immediately), :meth:`poll` on a timer to enforce ``max_wait_s``,
+    or :meth:`run` for the offline drive-to-completion mode.  Every
+    submitted request yields exactly one
+    :class:`~brainiak_tpu.serve.batching.ServeResult`.
+
+    The engine is NOT thread-safe: drive ``submit``/``poll``/
+    ``drain`` from a single thread (an event loop that interleaves
+    them is the intended online shape).  A submit racing a
+    concurrent flush could append to a just-popped bucket queue and
+    the request would never dispatch — callers serving from
+    multiple threads must serialize engine calls externally.
+    """
+
+    def __init__(self, model, kind=None, policy=None):
+        self.kind = kind or artifacts.detect_kind(model)
+        if self.kind not in _KIND_OPS:
+            raise ValueError(
+                f"no serve engine op for kind {self.kind!r} "
+                f"(supported: {', '.join(sorted(_KIND_OPS))})")
+        self.policy = policy or BucketPolicy()
+        self.op = _KIND_OPS[self.kind](model, self.policy)
+        self._queues = {}   # bucket key -> [Request]
+        self._records = []
+        self._n_submitted = 0
+        self._stats = {"n_batches": 0, "real_elements": 0,
+                       "padded_elements": 0, "buckets": set(),
+                       "n_ok": 0, "errors_by_code": {}}
+        # summary() reports retraces as a delta from here, so a
+        # fresh engine over an already-warm program cache reads 0
+        # and a second model's compiles are not charged to it
+        self._retrace_base = obs_metrics.counter(
+            "retrace_total").value(site=self.op.site)
+
+    # -- submission ---------------------------------------------------
+    def submit(self, request):
+        """Enqueue one request; returns an error
+        :class:`ServeResult` for an immediately-rejected payload,
+        else None (the record arrives at flush).
+
+        An already-set ``request.submitted`` is honored (callers may
+        pre-stamp ingress time) — when resubmitting a previously
+        served Request, reset ``submitted = None`` first or its
+        deadline counts from the ORIGINAL enqueue.
+
+        The synchronous return is the ONLY delivery of a rejection:
+        it is counted in :meth:`summary` and the serve metrics but
+        never appears in :attr:`records`/:meth:`drain`, so an online
+        caller replying from both channels cannot double-respond."""
+        if request.submitted is None:
+            request.submitted = time.monotonic()
+        # submission index travels on the request and into its
+        # record: the ordering key must survive duplicate ids
+        request._seq_index = self._n_submitted
+        self._n_submitted += 1
+        try:
+            problem = self.op.validate(request)
+            key = None if problem else self.op.bucket_key(request)
+        except Exception as exc:
+            # a payload weird enough to crash validation itself
+            # (ragged nested lists, non-int subject) still owes the
+            # caller a structured record, not an engine crash
+            problem = ("invalid_payload",
+                       f"{type(exc).__name__}: {exc}")
+        if problem is not None:
+            code, message = problem
+            return self._record_error(request, code, message,
+                                      store=False)
+        queue = self._queues.setdefault(key, [])
+        queue.append(request)
+        self._gauge_depth()
+        if len(queue) >= self.policy.max_batch:
+            self._flush_bucket(key)
+        return None
+
+    def poll(self, now=None):
+        """Flush buckets whose oldest request has waited past
+        ``max_wait_s`` (call on the serving loop's timer)."""
+        if now is None:
+            now = time.monotonic()
+        for key in list(self._queues):
+            queue = self._queues.get(key)
+            if queue and (now - queue[0].submitted
+                          >= self.policy.max_wait_s):
+                self._flush_bucket(key)
+
+    def flush(self):
+        """Flush every queued bucket (offline drain)."""
+        for key in list(self._queues):
+            self._flush_bucket(key)
+
+    def run(self, requests):
+        """Submit + drain, returning one record per passed request
+        in submission order (the offline CLI path).  Exactly these
+        requests' records are returned — selected by submission
+        index, so work queued by EARLIER ``submit`` calls that this
+        call's flush happens to complete is not interleaved; it
+        stays in :attr:`records` for :meth:`drain`."""
+        seq0 = self._n_submitted
+        out = []
+        for req in requests:
+            rec = self.submit(req)
+            if rec is not None:    # sync rejection: only delivery
+                out.append(rec)
+        self.flush()
+        out.extend(r for r in self._records
+                   if r.seq is not None and r.seq >= seq0)
+        out.sort(key=lambda r: r.seq if r.seq is not None else 0)
+        return out
+
+    @property
+    def records(self):
+        """Completed records so far (submission-interleaved;
+        submit-time rejections are delivered only via ``submit``'s
+        return and never appear here).
+
+        Records accumulate until :meth:`drain` — a long-lived online
+        server must drain after each :meth:`poll`, or completed
+        results (full arrays) pile up without bound."""
+        return self._records
+
+    def drain(self):
+        """Pop and return the completed records (the online-mode
+        companion of :meth:`poll`): the engine drops its references
+        to the returned results, so steady-state serving memory is
+        the queued work, not the history."""
+        out = self._records
+        self._records = []
+        return out
+
+    # -- internals ----------------------------------------------------
+    def _gauge_depth(self):
+        depth = sum(len(q) for q in self._queues.values())
+        obs_metrics.gauge(
+            "serve_queue_depth",
+            help="requests queued awaiting a bucket flush").set(
+                depth, kind=self.kind)
+
+    def _record_error(self, request, code, message, latency=None,
+                      store=True):
+        if latency is None and request.submitted is not None:
+            latency = time.monotonic() - request.submitted
+        rec = ServeResult(
+            request_id=request.request_id, ok=False, error=code,
+            message=message, latency_s=latency,
+            seq=getattr(request, "_seq_index", None))
+        self._finish(request, rec, outcome=code, store=store)
+        return rec
+
+    def _finish(self, request, rec, outcome, store=True):
+        """Account one finished request.  ``store=False`` (submit-
+        time rejections) counts and instruments the record without
+        adding it to the :meth:`drain` stream — the caller already
+        holds it from ``submit``'s return."""
+        if store:
+            self._records.append(rec)
+        if rec.ok:
+            self._stats["n_ok"] += 1
+        counts = self._stats["errors_by_code"]
+        if not rec.ok:
+            counts[outcome] = counts.get(outcome, 0) + 1
+        obs_metrics.counter(
+            "serve_requests_total",
+            help="serve requests by outcome").inc(
+                kind=self.kind, outcome="ok" if rec.ok else outcome)
+        if rec.latency_s is not None:
+            obs_metrics.histogram(
+                "serve_request_seconds", unit="s").observe(
+                    rec.latency_s, kind=self.kind,
+                    outcome="ok" if rec.ok else "error")
+        if obs_sink.enabled() and rec.latency_s is not None:
+            obs_sink.emit(obs_sink.make_record(
+                "span", "serve.request", path="serve.request",
+                dur_s=rec.latency_s,
+                attrs={"kind": self.kind,
+                       "outcome": "ok" if rec.ok else outcome,
+                       "request_id": rec.request_id}))
+
+    def _flush_bucket(self, key):
+        queue = self._queues.pop(key, [])
+        if not queue:
+            return
+        now = time.monotonic()
+        ready = []
+        for req in queue:
+            if req.expired(now):
+                self._record_error(
+                    req, "deadline_exceeded",
+                    f"queued {now - req.submitted:.3f}s past the "
+                    f"{req.deadline_s:.3f}s deadline",
+                    latency=now - req.submitted)
+            else:
+                ready.append(req)
+        self._gauge_depth()
+        size = max(int(self.policy.max_batch), 1)
+        groups = [ready[i:i + size]
+                  for i in range(0, len(ready), size)]
+        for group in groups:
+            self._run_group(key, group)
+
+    def _dispatch_group(self, key, group, b_pad, isolated=False):
+        """One ``op.dispatch`` call with its full accounting —
+        batch/element/bucket stats, padding-waste gauge,
+        ``serve.batch`` span, ``serve_batch_seconds`` histogram —
+        shared by the normal path and the poison-recovery singleton
+        re-runs so the two can never drift apart.  Stats count
+        dispatch ATTEMPTS: a poison batch charges its elements once
+        as the failed batch and again across the isolation
+        singletons, which is the device work actually dispatched —
+        padding waste for a round that hit poison reflects the
+        recovery cost, not steady-state waste.  The span emits even
+        when dispatch raises; the histogram records successful
+        dispatches only."""
+        bucket = key + (b_pad,)
+        real = sum(self.op.real_elements(r) for r in group)
+        padded = self.op.padded_elements(key, b_pad)
+        self._stats["n_batches"] += 1
+        self._stats["real_elements"] += real
+        self._stats["padded_elements"] += padded
+        self._stats["buckets"].add(bucket)
+        if padded:
+            obs_metrics.gauge(
+                "serve_padding_waste_ratio",
+                help="fraction of batch elements that are "
+                     "padding").set(1.0 - real / padded,
+                                    kind=self.kind)
+        attrs = {"kind": self.kind, "bucket": str(bucket),
+                 "batch": len(group)}
+        if isolated:
+            attrs["isolated"] = True
+        t0 = time.perf_counter()
+        with obs_spans.span("serve.batch", attrs=attrs):
+            results = self.op.dispatch(group, key, b_pad)
+        obs_metrics.histogram(
+            "serve_batch_seconds", unit="s").observe(
+                time.perf_counter() - t0, kind=self.kind)
+        return results
+
+    def _run_group(self, key, group):
+        b_pad = self.op.batch_extent(len(group))
+        bucket = key + (b_pad,)
+        try:
+            results = self._dispatch_group(key, group, b_pad)
+        except Exception as exc:  # poison batch: isolate per request
+            obs_sink.event("serve_batch_failed", kind=self.kind,
+                           bucket=str(bucket),
+                           error=type(exc).__name__)
+            if not self.op.isolate_on_failure:
+                # batch members interact (FCMA normalization):
+                # singleton re-runs would change survivors' answers
+                logger.warning(
+                    "serve batch %s failed (%s: %s); %s batches "
+                    "fail as a unit", bucket, type(exc).__name__,
+                    exc, self.kind)
+                for req in group:
+                    self._record_error(
+                        req, "execution_failed",
+                        f"{type(exc).__name__}: {exc} (batch "
+                        "fails as a unit: results are batch-"
+                        "composition-dependent for this kind)")
+                return
+            logger.warning(
+                "serve batch %s failed (%s: %s); retrying "
+                "per-request to isolate the poison payload",
+                bucket, type(exc).__name__, exc)
+            self._run_isolated(key, group)
+            return
+        done = time.monotonic()
+        for req, result in zip(group, results):
+            rec = ServeResult(
+                request_id=req.request_id, ok=True, result=result,
+                bucket=bucket, latency_s=done - req.submitted,
+                seq=getattr(req, "_seq_index", None))
+            self._finish(req, rec, outcome="ok")
+
+    def _run_isolated(self, key, group):
+        """Per-request fallback after a batch-level failure: each
+        request runs in its own singleton batch so exactly the
+        poison one fails.  Re-dispatches honor the same deadline and
+        stats accounting as the normal path (the failed batch may
+        have burned a queued request's remaining budget)."""
+        # honor the policy's batch floor: a min_batch_bucket=4
+        # policy must not compile an out-of-policy b_pad=1 shape
+        # mid-failure-recovery
+        b_pad = self.op.batch_extent(1)
+        for req in group:
+            if req.expired():
+                waited = time.monotonic() - req.submitted
+                self._record_error(
+                    req, "deadline_exceeded",
+                    f"deadline passed during the failed batch "
+                    f"({waited:.3f}s > {req.deadline_s:.3f}s)",
+                    latency=waited)
+                continue
+            try:
+                result = self._dispatch_group(
+                    key, [req], b_pad, isolated=True)[0]
+            except Exception as exc:
+                self._record_error(
+                    req, "execution_failed",
+                    f"{type(exc).__name__}: {exc}")
+                continue
+            rec = ServeResult(
+                request_id=req.request_id, ok=True, result=result,
+                bucket=key + (b_pad,),
+                latency_s=time.monotonic() - req.submitted,
+                seq=getattr(req, "_seq_index", None))
+            self._finish(req, rec, outcome="ok")
+
+    # -- reporting ----------------------------------------------------
+    def summary(self):
+        """Aggregate serving stats for the CLI / bench drivers.
+
+        ``retrace_total`` is the growth of this site's compile
+        counter since THIS engine was constructed (the process-wide
+        counter keeps accumulating across engines); engines of the
+        same kind running concurrently may cross-attribute each
+        other's compiles.  Counts (``n_requests``/``n_ok``/
+        ``n_errors``/batch/bucket/padding stats) are running totals
+        that survive :meth:`drain` and include submit-time
+        rejections; only the latency percentiles are derived from
+        the undrained ok records."""
+        records = self._records
+        # served latencies only: instant validation rejections would
+        # otherwise drag p50/p99 toward zero whenever errors occur
+        latencies = sorted(r.latency_s for r in records
+                           if r.ok and r.latency_s is not None)
+
+        def pct(q):
+            if not latencies:
+                return None
+            idx = min(len(latencies) - 1,
+                      int(round(q * (len(latencies) - 1))))
+            return latencies[idx]
+
+        padded = self._stats["padded_elements"]
+        real = self._stats["real_elements"]
+        return {
+            "kind": self.kind,
+            "n_requests": self._n_submitted,
+            "n_ok": self._stats["n_ok"],
+            "n_errors": sum(
+                self._stats["errors_by_code"].values()),
+            "errors_by_code": dict(self._stats["errors_by_code"]),
+            "n_batches": self._stats["n_batches"],
+            "buckets": sorted(
+                str(b) for b in self._stats["buckets"]),
+            "retrace_total": obs_metrics.counter(
+                "retrace_total").value(site=self.op.site)
+            - self._retrace_base,
+            "padding_waste": (1.0 - real / padded) if padded
+            else 0.0,
+            "p50_latency_s": pct(0.50),
+            "p99_latency_s": pct(0.99),
+        }

@@ -9,11 +9,20 @@ docs/observability.md.
 These names keep working exactly as before (``stage_timer`` always
 records into the thread-safe in-process registry and always honors
 ``sync``, no sink required) but new code should import from
-``brainiak_tpu.obs`` directly.
+``brainiak_tpu.obs`` directly — importing this shim emits a
+``DeprecationWarning`` saying so.
 """
 
-from ..obs.runtime import device_trace  # noqa: F401
-from ..obs.spans import (  # noqa: F401
+import warnings
+
+warnings.warn(
+    "brainiak_tpu.utils.profiling is deprecated: import "
+    "stage_timer/stage_times/reset_stage_times/device_trace from "
+    "brainiak_tpu.obs instead (see docs/observability.md)",
+    DeprecationWarning, stacklevel=2)
+
+from ..obs.runtime import device_trace  # noqa: E402,F401
+from ..obs.spans import (  # noqa: E402,F401
     reset_stage_times,
     stage_timer,
     stage_times,

@@ -177,3 +177,23 @@ def test_classification_errors():
     with pytest.raises(ValueError):
         clf.fit(list(zip(fake_raw_data, fake_raw_data)), labels,
                 num_training_samples=8)
+
+
+def test_predict_without_prepared_test_data_raises():
+    """predict(X=None)/decision_function(X=None) without test data
+    prepared during fit raise a clear ValueError instead of sklearn
+    failing opaquely on None (PR 5 satellite)."""
+    import pytest
+
+    fake_raw_data = [create_epoch(i, 5) for i in range(8)]
+    labels = [0, 1] * 4
+    clf = Classifier(svm.SVC(kernel='precomputed', shrinking=False,
+                             C=1, gamma='auto'), epochs_per_subj=2)
+    clf.fit(list(zip(fake_raw_data, fake_raw_data)), labels)
+    with pytest.raises(ValueError, match="predict"):
+        clf.predict()
+    with pytest.raises(ValueError, match="decision_function"):
+        clf.decision_function()
+    # passing X explicitly still works after the rejected call
+    assert len(clf.predict(
+        list(zip(fake_raw_data[:4], fake_raw_data[:4])))) == 4

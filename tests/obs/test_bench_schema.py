@@ -72,3 +72,31 @@ def test_validator_rejects_bad_stamps():
     bad_commit = dict(base, git_commit="")
     assert any("git_commit" in e
                for e in obs.validate_bench_record(bad_commit))
+
+
+# -- PR 5: serve tier -------------------------------------------------
+
+def test_serve_tier_record_matches_obs_schema(monkeypatch):
+    """The serve tier (bench.py satellite): a tiny in-process run
+    emits a schema-valid bench record with tier="serve" and the
+    stage breakdown, so `obs regress` gates serving throughput
+    alongside fit throughput."""
+    monkeypatch.setenv("BENCH_SERVE_REQUESTS", "12")
+    out = bench.measure_tier("serve")
+    assert out["requests_per_sec"] > 0
+    assert out["baseline_rps"] > 0
+    assert 0.0 <= out["padding_waste"] < 1.0
+    assert out["retrace_total"] <= out["n_buckets"]
+    stages = out["stages"]
+    assert set(bench.STAGE_KEYS) <= set(stages)
+    assert stages["steady_s"] > 0
+
+    rec = bench._serve_result_record(out, n_requests=12)
+    assert obs.validate_bench_record(rec) == []
+    # in-process run on the CPU test backend -> the fallback tier
+    # (tier separation mirrors the fcma tiers)
+    assert rec["tier"] == "serve_cpu_fallback"
+    assert rec["config"]["backend"] == "cpu"
+    assert rec["unit"] == "requests/sec"
+    assert rec["metric"] == "serve_srm_transform_requests_per_sec"
+    assert rec["config"]["n_buckets"] == out["n_buckets"]

@@ -39,16 +39,24 @@ def test_tier_inference():
 
 def test_fixture_history_passes_and_gates():
     records, skipped = regress.load_bench_records([FIXTURE_DIR])
-    assert len(records) == 5          # the real r01-r05 trajectory
+    # the real r01-r05 fcma trajectory + the serve_r01-r03 tier
+    # (PR 5, measured host-side -> serve_cpu_fallback): two tiers
+    # gating independently from one directory
+    assert len(records) == 8
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
-    assert all(regress.tier_of(r) == "cpu_fallback"
-               for r in records)
+    tiers = {regress.tier_of(r) for r in records}
+    assert tiers == {"cpu_fallback", "serve_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
-    (check,) = result["checks"]
-    assert check["status"] == "ok"
-    assert check["n_history"] == 4
+    by_tier = {c["tier"]: c for c in result["checks"]}
+    assert set(by_tier) == {"cpu_fallback", "serve_cpu_fallback"}
+    assert by_tier["cpu_fallback"]["status"] == "ok"
+    assert by_tier["cpu_fallback"]["n_history"] == 4
+    assert by_tier["serve_cpu_fallback"]["status"] == "ok"
+    assert by_tier["serve_cpu_fallback"]["n_history"] == 2
+    assert by_tier["serve_cpu_fallback"]["metric"] == \
+        "serve_srm_transform_requests_per_sec"
 
 
 def test_two_x_degradation_fails_with_named_metric(tmp_path,

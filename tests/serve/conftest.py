@@ -1,0 +1,123 @@
+"""Serve test isolation: obs sinks/metrics reset around every test
+(the engine's retrace/latency metrics and the program caches are
+process-global), plus shared fitted-model fixtures — the estimator
+fits dominate this directory's runtime, so they are session-scoped.
+"""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.obs import metrics, sink
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    monkeypatch.delenv(sink.OBS_DIR_ENV, raising=False)
+    monkeypatch.delenv(sink.OBS_RANK_ENV, raising=False)
+    sink.close_all()
+    metrics.reset()
+    yield
+    sink.close_all()
+    metrics.reset()
+
+
+def make_srm_data(n_subjects=3, voxels=20, samples=30, features=4,
+                  seed=0, ragged=True):
+    rng = np.random.RandomState(seed)
+    shared = rng.randn(features, samples)
+    data = []
+    for i in range(n_subjects):
+        v = voxels + (i if ragged else 0)
+        q, _ = np.linalg.qr(rng.randn(v, features))
+        data.append(q @ shared + 0.1 * rng.randn(v, samples))
+    return data
+
+
+@pytest.fixture(scope="session")
+def srm_model():
+    from brainiak_tpu.funcalign.srm import SRM
+    model = SRM(n_iter=3, features=4, rand_seed=0)
+    model.fit(make_srm_data())
+    return model
+
+
+@pytest.fixture(scope="session")
+def detsrm_model():
+    from brainiak_tpu.funcalign.srm import DetSRM
+    model = DetSRM(n_iter=3, features=4, rand_seed=0)
+    model.fit(make_srm_data())
+    return model
+
+
+@pytest.fixture(scope="session")
+def rsrm_model():
+    from brainiak_tpu.funcalign.rsrm import RSRM
+    model = RSRM(n_iter=3, features=4, gamma=1.0, rand_seed=0)
+    model.fit(make_srm_data(ragged=False))
+    return model
+
+
+@pytest.fixture(scope="session")
+def eventseg_model():
+    from brainiak_tpu.eventseg.event import EventSegment
+    rng = np.random.RandomState(0)
+    # blocky event structure: [T, V] with 3 mean-shifted segments
+    means = rng.randn(3, 10)
+    data = np.vstack([means[i] + 0.2 * rng.randn(12, 10)
+                      for i in range(3)])
+    model = EventSegment(n_events=3, n_iter=30)
+    model.fit(data)
+    return model
+
+
+@pytest.fixture(scope="session")
+def iem1d_model():
+    from brainiak_tpu.reconstruct.iem import InvertedEncoding1D
+    rng = np.random.RandomState(0)
+    model = InvertedEncoding1D(n_channels=6, channel_exp=5)
+    feats = rng.uniform(0, 179, size=40)
+    channels, centers = model._define_channels()
+    model.channels_ = channels
+    design = model._define_trial_activations(feats)
+    voxels = 12
+    w_true = rng.randn(6, voxels)
+    X = design @ w_true + 0.05 * rng.randn(40, voxels)
+    model.fit(X, feats)
+    return model
+
+
+@pytest.fixture(scope="session")
+def fcma_models():
+    """(full-features LogisticRegression model, single-portion
+    precomputed-SVM model) plus held-out epoch pairs."""
+    import math
+
+    from scipy.stats.mstats import zscore
+    from sklearn import svm
+    from sklearn.linear_model import LogisticRegression
+
+    from brainiak_tpu.fcma.classifier import Classifier
+
+    rng = np.random.RandomState(42)
+
+    def epoch(idx, num_voxels=5, row=12):
+        mat = rng.rand(row, num_voxels).astype(np.float32)
+        if idx % 2 == 0:
+            mat = np.sort(mat, axis=0)
+        mat = np.nan_to_num(zscore(mat, axis=0, ddof=0))
+        return mat / math.sqrt(mat.shape[0])
+
+    epochs = [epoch(i) for i in range(20)]
+    labels = [0, 1] * 6
+    train = list(zip(epochs[:12], epochs[:12]))
+    test = list(zip(epochs[12:], epochs[12:]))
+
+    logit = Classifier(LogisticRegression(solver="liblinear"),
+                       epochs_per_subj=4)
+    logit.fit(train, labels)
+
+    precomp = Classifier(
+        svm.SVC(kernel="precomputed", shrinking=False, C=1,
+                gamma="auto"), epochs_per_subj=4)
+    precomp.fit(train, labels)
+    return logit, precomp, test

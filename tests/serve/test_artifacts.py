@@ -1,0 +1,239 @@
+"""Artifact round-trips: ``load_model(save_model(m))`` must be
+bit-identical on the inference surface for every adapter (ISSUE 5
+satellite), the schema must be enforced, and loads must retry
+transient I/O faults."""
+
+import io
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.serve import (detect_kind, load_model, save_model,
+                                save_model_bytes)
+
+
+def _roundtrip(model, tmp_path, name):
+    path = str(tmp_path / f"{name}.npz")
+    save_model(model, path)
+    return load_model(path)
+
+
+def _exact(a, b):
+    assert type(a) is type(b)
+    if isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            _exact(x, y)
+        return
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_srm_roundtrip_mixed_voxel_counts(srm_model, tmp_path):
+    """The mixed-voxel-count path: per-subject W's of different
+    shapes survive pickle-free (the ad-hoc SRM.save used object
+    arrays + allow_pickle here)."""
+    assert len({w.shape for w in srm_model.w_}) > 1
+    loaded = _roundtrip(srm_model, tmp_path, "srm")
+    X = [np.random.RandomState(1).randn(w.shape[0], 9)
+         for w in srm_model.w_]
+    _exact(srm_model.transform(X), loaded.transform(X))
+    for w0, w1 in zip(srm_model.w_, loaded.w_):
+        np.testing.assert_array_equal(w0, w1)
+    np.testing.assert_array_equal(srm_model.sigma_s_,
+                                  loaded.sigma_s_)
+    assert loaded.logprob_ == srm_model.logprob_
+    assert detect_kind(loaded) == "srm"
+
+
+def test_detsrm_roundtrip(detsrm_model, tmp_path):
+    loaded = _roundtrip(detsrm_model, tmp_path, "detsrm")
+    X = [np.random.RandomState(2).randn(w.shape[0], 7)
+         for w in detsrm_model.w_]
+    _exact(detsrm_model.transform(X), loaded.transform(X))
+    assert detect_kind(loaded) == "detsrm"
+
+
+def test_rsrm_roundtrip(rsrm_model, tmp_path):
+    loaded = _roundtrip(rsrm_model, tmp_path, "rsrm")
+    X = [np.asarray(np.random.RandomState(3).randn(w.shape[0], 8),
+                    dtype=rsrm_model.w_[0].dtype)
+         for w in rsrm_model.w_]
+    r0, s0 = rsrm_model.transform(X)
+    r1, s1 = loaded.transform(X)
+    _exact(r0, r1)
+    _exact(s0, s1)
+    assert loaded.gamma == rsrm_model.gamma
+
+
+def test_eventseg_roundtrip(eventseg_model, tmp_path):
+    loaded = _roundtrip(eventseg_model, tmp_path, "eventseg")
+    rng = np.random.RandomState(4)
+    test_data = rng.randn(20, eventseg_model.event_pat_.shape[0])
+    seg0, ll0 = eventseg_model.find_events(test_data)
+    seg1, ll1 = loaded.find_events(test_data)
+    np.testing.assert_array_equal(seg0, seg1)
+    assert ll0 == ll1
+    np.testing.assert_array_equal(eventseg_model.predict(test_data),
+                                  loaded.predict(test_data))
+    assert type(loaded.event_var_) is type(
+        eventseg_model.event_var_)
+
+
+def test_iem1d_roundtrip(iem1d_model, tmp_path):
+    loaded = _roundtrip(iem1d_model, tmp_path, "iem1d")
+    rng = np.random.RandomState(5)
+    X = rng.randn(15, iem1d_model.W_.shape[0])
+    np.testing.assert_array_equal(iem1d_model.predict(X),
+                                  loaded.predict(X))
+    np.testing.assert_array_equal(iem1d_model.channels_,
+                                  loaded.channels_)
+
+
+def test_iem2d_roundtrip(tmp_path):
+    from brainiak_tpu.reconstruct.iem import InvertedEncoding2D
+    rng = np.random.RandomState(6)
+    model = InvertedEncoding2D([-6, 6], [-6, 6], 21, stim_radius=2)
+    model.define_basis_functions_sqgrid(4)
+    centers = rng.uniform(-4, 4, size=(30, 2))
+    design = model._define_trial_activations(centers)
+    X = design @ rng.randn(model.n_channels, 10) \
+        + 0.05 * rng.randn(30, 10)
+    model.fit(X, centers)
+    loaded = _roundtrip(model, tmp_path, "iem2d")
+    X_test = rng.randn(8, 10)
+    np.testing.assert_array_equal(model.predict(X_test),
+                                  loaded.predict(X_test))
+
+
+@pytest.mark.parametrize("which", ["logit", "precomputed"])
+def test_fcma_roundtrip(fcma_models, tmp_path, which):
+    logit, precomp, test = fcma_models
+    model = logit if which == "logit" else precomp
+    loaded = _roundtrip(model, tmp_path, f"fcma_{which}")
+    np.testing.assert_array_equal(model.predict(test),
+                                  loaded.predict(test))
+    if which == "precomputed":
+        np.testing.assert_array_equal(model.training_data_,
+                                      loaded.training_data_)
+
+
+def test_bytes_roundtrip(srm_model):
+    blob = save_model_bytes(srm_model)
+    loaded = load_model(io.BytesIO(blob))
+    for w0, w1 in zip(srm_model.w_, loaded.w_):
+        np.testing.assert_array_equal(w0, w1)
+
+
+def test_unfitted_model_rejected(tmp_path):
+    from brainiak_tpu.funcalign.srm import SRM
+    with pytest.raises(ValueError, match="not fitted"):
+        save_model(SRM(), str(tmp_path / "x.npz"))
+
+
+def test_unknown_model_type_rejected(tmp_path):
+    with pytest.raises(TypeError, match="no serve adapter"):
+        save_model(object(), str(tmp_path / "x.npz"))
+
+
+def test_not_an_artifact_rejected(tmp_path):
+    path = str(tmp_path / "plain.npz")
+    np.savez(path, a=np.arange(3))
+    with pytest.raises(ValueError, match="not a serve artifact"):
+        load_model(path)
+
+
+def test_newer_schema_rejected(srm_model, tmp_path):
+    from brainiak_tpu.serve import artifacts
+    path = str(tmp_path / "new.npz")
+    save_model(srm_model, path)
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays[artifacts.VERSION_KEY] = np.asarray(
+        artifacts.SCHEMA_VERSION + 1)
+    np.savez(path, **arrays)
+    with pytest.raises(ValueError, match="newer"):
+        load_model(path)
+
+
+def test_load_retries_transient_oserror(srm_model, tmp_path,
+                                        monkeypatch):
+    """load_model is wired through resilience.retry: a transient
+    OSError on the npz read retries with backoff instead of
+    propagating (ISSUE 5 tentpole wiring)."""
+    import importlib
+    retry_mod = importlib.import_module(
+        "brainiak_tpu.resilience.retry")
+
+    path = str(tmp_path / "flaky.npz")
+    save_model(srm_model, path)
+    monkeypatch.setattr(retry_mod, "_sleep", lambda s: None)
+    real_load = np.load
+    calls = {"n": 0}
+
+    def flaky_load(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("shared filesystem hiccup")
+        return real_load(*args, **kwargs)
+
+    monkeypatch.setattr(np, "load", flaky_load)
+    loaded = load_model(path)
+    assert calls["n"] == 2
+    for w0, w1 in zip(srm_model.w_, loaded.w_):
+        np.testing.assert_array_equal(w0, w1)
+
+
+def test_load_retry_rewinds_file_like(srm_model, monkeypatch):
+    """A retry on a file-like input must rewind the stream: the
+    failed first attempt leaves the cursor mid-file, and resuming
+    there would corrupt the read instead of retrying it."""
+    import importlib
+
+    from brainiak_tpu.serve import save_model_bytes
+    retry_mod = importlib.import_module(
+        "brainiak_tpu.resilience.retry")
+    monkeypatch.setattr(retry_mod, "_sleep", lambda s: None)
+
+    buf = io.BytesIO(save_model_bytes(srm_model))
+    real_load = np.load
+    calls = {"n": 0}
+
+    def flaky_load(file, *args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            file.read(16)  # consume part of the stream, then fail
+            raise OSError("transient read fault")
+        return real_load(file, *args, **kwargs)
+
+    monkeypatch.setattr(np, "load", flaky_load)
+    loaded = load_model(buf)
+    assert calls["n"] == 2
+    for w0, w1 in zip(srm_model.w_, loaded.w_):
+        np.testing.assert_array_equal(w0, w1)
+
+
+def test_load_missing_path_fails_fast(tmp_path, monkeypatch):
+    """A mispointed --model path is deterministic, not transient:
+    load_model must raise FileNotFoundError on the first attempt
+    instead of burning the full retry/backoff schedule."""
+    import importlib
+    retry_mod = importlib.import_module(
+        "brainiak_tpu.resilience.retry")
+    sleeps = []
+    monkeypatch.setattr(retry_mod, "_sleep", sleeps.append)
+
+    with pytest.raises(FileNotFoundError):
+        load_model(str(tmp_path / "typo.npz"))
+    assert sleeps == []  # no retries scheduled
+
+
+def test_save_model_extensionless_path_roundtrips(srm_model,
+                                                  tmp_path):
+    """np.savez_compressed appends ".npz" to extensionless paths;
+    save_model must return the path actually written so the
+    documented load_model(save_model(m, f)) chain works for any f."""
+    written = save_model(srm_model, str(tmp_path / "m"))
+    assert written.endswith(".npz")
+    loaded = load_model(written)
+    for w0, w1 in zip(srm_model.w_, loaded.w_):
+        np.testing.assert_array_equal(w0, w1)

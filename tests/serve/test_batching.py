@@ -1,0 +1,63 @@
+"""Bucketing policy + request codec unit tests."""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.serve import (BucketPolicy, Request, bucket_length,
+                                load_requests, pad_axis,
+                                save_requests)
+
+
+def test_bucket_length_powers_of_two():
+    assert bucket_length(1) == 16      # floor
+    assert bucket_length(16) == 16
+    assert bucket_length(17) == 32
+    assert bucket_length(100) == 128
+    assert bucket_length(128) == 128
+    assert bucket_length(3, floor=1) == 4
+    assert bucket_length(1, floor=1) == 1
+
+
+def test_pad_axis():
+    x = np.arange(6.0).reshape(2, 3)
+    padded = pad_axis(x, 1, 8)
+    assert padded.shape == (2, 8)
+    np.testing.assert_array_equal(padded[:, :3], x)
+    assert not padded[:, 3:].any()
+    assert pad_axis(x, 0, 2) is not None  # no-op path
+    with pytest.raises(ValueError):
+        pad_axis(x, 1, 2)
+
+
+def test_policy_batch_bucket():
+    policy = BucketPolicy(max_batch=64)
+    assert policy.batch_bucket(1) == 1
+    assert policy.batch_bucket(3) == 4
+    assert policy.batch_bucket(64) == 64
+    # never beyond the max-batch power of two
+    assert policy.batch_bucket(70) == 64
+
+
+def test_request_deadline_expiry():
+    req = Request(request_id="r", x=np.zeros((2, 2)),
+                  deadline_s=0.5, submitted=100.0)
+    assert not req.expired(now=100.4)
+    assert req.expired(now=100.6)
+    # no deadline / not yet submitted: never expired
+    assert not Request(request_id="r", x=None).expired()
+
+
+def test_request_codec_roundtrip(tmp_path):
+    path = str(tmp_path / "reqs.npz")
+    payloads = [np.random.randn(4, 7), np.random.randn(4, 9),
+                (np.random.randn(5, 3), np.random.randn(5, 4))]
+    save_requests(path, payloads, subjects=[1, None, None],
+                  deadlines=[None, 0.25, None],
+                  ids=["a", "b", "c"])
+    back = load_requests(path)
+    assert [r.request_id for r in back] == ["a", "b", "c"]
+    np.testing.assert_array_equal(back[0].x, payloads[0])
+    assert back[0].subject == 1 and back[0].deadline_s is None
+    assert back[1].subject is None and back[1].deadline_s == 0.25
+    assert isinstance(back[2].x, tuple) and len(back[2].x) == 2
+    np.testing.assert_array_equal(back[2].x[1], payloads[2][1])

@@ -1,0 +1,96 @@
+"""``python -m brainiak_tpu.serve`` CLI: run + bench subcommands
+(the SRV001 gate's contract) and the offline results file."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from tests.conftest import REPO_ROOT
+
+SUMMARY_KEYS = ("n_requests", "n_ok", "n_errors", "buckets",
+                "retrace_total", "padding_waste",
+                "requests_per_sec")
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "brainiak_tpu.serve", *args],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def _fixture_paths(tmp_path, poison=False):
+    from brainiak_tpu.serve import save_model, save_requests
+    from brainiak_tpu.serve.__main__ import (build_demo_model,
+                                             build_mixed_requests)
+    model_path = str(tmp_path / "model.npz")
+    req_path = str(tmp_path / "requests.npz")
+    model = build_demo_model(n_subjects=3, voxels=10, samples=20,
+                             features=3, n_iter=2, seed=1)
+    save_model(model, model_path)
+    reqs = build_mixed_requests(model, 6, seed=1,
+                                tr_choices=(5, 9))
+    payloads = [r.x for r in reqs]
+    subjects = [r.subject for r in reqs]
+    if poison:
+        bad = np.full_like(payloads[0], np.nan)
+        payloads.append(bad)
+        subjects.append(0)
+    save_requests(req_path, payloads, subjects=subjects)
+    return model_path, req_path
+
+
+def test_cli_run_json_summary(tmp_path):
+    model_path, req_path = _fixture_paths(tmp_path)
+    out_path = str(tmp_path / "results.npz")
+    proc = _cli("run", "--model", model_path,
+                "--requests", req_path, "--out", out_path,
+                "--format=json")
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout)
+    for key in SUMMARY_KEYS:
+        assert key in summary, key
+    assert summary["n_errors"] == 0
+    assert summary["n_ok"] == summary["n_requests"] == 6
+    assert summary["retrace_total"] <= len(summary["buckets"])
+    with np.load(out_path) as z:
+        assert int(z["n"]) == 6
+        assert z["result.0"].ndim == 2
+
+
+def test_cli_run_poison_exits_nonzero(tmp_path):
+    model_path, req_path = _fixture_paths(tmp_path, poison=True)
+    proc = _cli("run", "--model", model_path,
+                "--requests", req_path, "--format=json")
+    assert proc.returncode == 1
+    summary = json.loads(proc.stdout)
+    assert summary["n_errors"] == 1
+    assert summary["errors_by_code"] == {"non_finite_input": 1}
+    # still one record per request
+    assert summary["n_ok"] + summary["n_errors"] == \
+        summary["n_requests"]
+
+
+def test_cli_bench_emits_valid_bench_record(tmp_path):
+    from brainiak_tpu.obs import validate_bench_record
+    proc = _cli("bench", "--n-requests", "12",
+                "--save-model", str(tmp_path / "demo.npz"))
+    assert proc.returncode == 0, proc.stderr
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert validate_bench_record(record) == []
+    # CPU test backend -> the cpu_fallback serve tier
+    assert record["tier"] == "serve_cpu_fallback"
+    assert record["unit"] == "requests/sec"
+    assert record["value"] > 0
+    assert (tmp_path / "demo.npz").exists()
+
+
+def test_cli_run_text_format(tmp_path):
+    model_path, req_path = _fixture_paths(tmp_path)
+    proc = _cli("run", "--model", model_path,
+                "--requests", req_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "6/6 ok" in proc.stdout

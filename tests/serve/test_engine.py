@@ -1,0 +1,440 @@
+"""InferenceEngine behavior: the ISSUE 5 acceptance integration
+(>= 64 mixed-shape requests against a reloaded SRM, bounded
+retraces, result-or-error for every request) plus per-kind parity
+with the estimators' own inference methods, poison isolation, flush
+policy, and telemetry."""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.obs import MemorySink, add_sink, metrics, \
+    remove_sink
+from brainiak_tpu.serve import (BucketPolicy, InferenceEngine,
+                                Request, load_model, save_model)
+from brainiak_tpu.serve.engine import (_eventseg_program,
+                                       _iem_program, _rsrm_program,
+                                       _srm_program)
+
+
+def _clear_program_caches():
+    for prog in (_srm_program, _rsrm_program, _eventseg_program,
+                 _iem_program):
+        prog.cache_clear()
+
+
+def _srm_requests(model, n, tr_choices=(10, 25, 40, 70), seed=0):
+    rng = np.random.RandomState(seed)
+    counts = [w.shape[0] for w in model.w_]
+    reqs = []
+    for i in range(n):
+        subject = i % len(counts)
+        trs = tr_choices[i % len(tr_choices)]
+        reqs.append(Request(
+            request_id=f"r{i}",
+            x=rng.randn(counts[subject], trs),
+            subject=subject))
+    return reqs
+
+
+def test_acceptance_mixed_requests_reloaded_srm(srm_model,
+                                                tmp_path):
+    """ISSUE 5 acceptance: >= 64 mixed-shape requests against a
+    save/load-round-tripped SRM complete with retraces <= distinct
+    buckets and a result or structured error for every request."""
+    path = str(tmp_path / "model.npz")
+    save_model(srm_model, path)
+    model = load_model(path)
+
+    good = _srm_requests(model, 64)
+    poison = [
+        Request(request_id="nan", subject=0,
+                x=np.full((model.w_[0].shape[0], 25), np.nan)),
+        Request(request_id="badshape", subject=1,
+                x=np.zeros((3, 25))),
+        Request(request_id="badsubj", subject=99,
+                x=np.zeros((model.w_[0].shape[0], 25))),
+        Request(request_id="late", subject=0,
+                x=np.zeros((model.w_[0].shape[0], 25)),
+                deadline_s=0.0),
+    ]
+    requests = good[:32] + poison + good[32:]
+
+    _clear_program_caches()
+    metrics.reset()
+    engine = InferenceEngine(
+        model, policy=BucketPolicy(max_batch=16))
+    records = engine.run(requests)
+
+    # every request answered, in submission order
+    assert len(records) == len(requests)
+    assert [r.request_id for r in records] == \
+        [r.request_id for r in requests]
+    by_id = {r.request_id: r for r in records}
+    assert by_id["nan"].error == "non_finite_input"
+    assert by_id["badshape"].error == "invalid_shape"
+    assert by_id["badsubj"].error == "invalid_subject"
+    assert by_id["late"].error == "deadline_exceeded"
+    assert all(by_id[r.request_id].ok for r in good)
+
+    # results match the estimator's own transform bit-for-bit in
+    # intent (allclose: the batched einsum may reassociate)
+    for req in good[:8]:
+        expected = model.w_[req.subject].T @ req.x
+        got = by_id[req.request_id].result
+        assert got.shape == expected.shape
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+    summary = engine.summary()
+    assert summary["n_requests"] == len(requests)
+    assert summary["n_ok"] == 64
+    assert summary["n_errors"] == 4
+    # the acceptance bound: compiles <= distinct dispatched buckets,
+    # i.e. no per-request recompiles
+    retraces = metrics.counter("retrace_total").value(
+        site="serve.srm")
+    assert 0 < retraces <= len(summary["buckets"])
+    assert summary["retrace_total"] == retraces
+    assert 0.0 <= summary["padding_waste"] < 1.0
+    assert summary["p99_latency_s"] >= summary["p50_latency_s"]
+
+
+def test_engine_requires_fitted_kind():
+    with pytest.raises(TypeError):
+        InferenceEngine(object())
+
+
+def test_detsrm_engine_matches_transform(detsrm_model):
+    engine = InferenceEngine(detsrm_model)
+    reqs = _srm_requests(detsrm_model, 6, seed=1)
+    records = engine.run(reqs)
+    assert engine.kind == "detsrm"
+    for req, rec in zip(reqs, records):
+        assert rec.ok
+        np.testing.assert_allclose(
+            rec.result, detsrm_model.w_[req.subject].T @ req.x,
+            atol=1e-5)
+
+
+def test_rsrm_engine_matches_transform(rsrm_model):
+    engine = InferenceEngine(rsrm_model)
+    rng = np.random.RandomState(2)
+    counts = [w.shape[0] for w in rsrm_model.w_]
+    reqs = [Request(request_id=f"r{i}",
+                    x=rng.randn(counts[i % len(counts)], 12),
+                    subject=i % len(counts))
+            for i in range(5)]
+    records = engine.run(reqs)
+    X = [None] * len(rsrm_model.w_)
+    for req, rec in zip(reqs, records):
+        assert rec.ok
+        r_got, s_got = rec.result
+        X = [None] * len(rsrm_model.w_)
+        X[req.subject] = req.x
+        r_exp, s_exp = rsrm_model.transform(X)
+        np.testing.assert_allclose(r_got, r_exp[req.subject],
+                                   atol=1e-4)
+        np.testing.assert_allclose(s_got, s_exp[req.subject],
+                                   atol=1e-4)
+
+
+def test_eventseg_engine_matches_find_events(eventseg_model):
+    engine = InferenceEngine(eventseg_model)
+    rng = np.random.RandomState(3)
+    n_vox = eventseg_model.event_pat_.shape[0]
+    # two T-groups -> two (exact-T) buckets, batched within a group
+    reqs = [Request(request_id=f"r{i}",
+                    x=rng.randn(20 if i % 2 else 28, n_vox))
+            for i in range(6)]
+    records = engine.run(reqs)
+    for req, rec in zip(reqs, records):
+        assert rec.ok
+        seg_got, ll_got = rec.result
+        seg_exp, ll_exp = eventseg_model.find_events(req.x)
+        np.testing.assert_allclose(seg_got, seg_exp, atol=1e-5)
+        assert abs(ll_got - ll_exp) < 1e-5 * max(1.0, abs(ll_exp))
+
+
+def test_iem_engine_matches_predict(iem1d_model):
+    engine = InferenceEngine(iem1d_model)
+    rng = np.random.RandomState(4)
+    n_vox = iem1d_model.W_.shape[0]
+    reqs = [Request(request_id=f"r{i}",
+                    x=rng.randn(5 + 3 * i, n_vox))
+            for i in range(4)]
+    records = engine.run(reqs)
+    for req, rec in zip(reqs, records):
+        assert rec.ok
+        np.testing.assert_array_equal(rec.result,
+                                      iem1d_model.predict(req.x))
+
+
+def test_fcma_engine_matches_predict(fcma_models):
+    logit, precomp, test_pairs = fcma_models
+    for model in (logit, precomp):
+        engine = InferenceEngine(model)
+        reqs = [Request(request_id=f"r{i}", x=pair)
+                for i, pair in enumerate(test_pairs)]
+        records = engine.run(reqs)
+        expected = model.predict(test_pairs)
+        got = np.asarray([r.result for r in records])
+        np.testing.assert_array_equal(got, expected)
+
+
+def test_fcma_portioned_artifact_refused(fcma_models):
+    """A precomputed-SVM model whose training features were
+    discarded (portion-by-portion Gram) cannot serve predict; the
+    engine refuses at construction with a clear error."""
+    _, precomp, _ = fcma_models
+    import copy
+    crippled = copy.copy(precomp)
+    crippled.training_data_ = None
+    with pytest.raises(ValueError, match="cannot serve"):
+        InferenceEngine(crippled, kind="fcma")
+
+
+def test_poison_batch_isolated(srm_model, monkeypatch):
+    """A batch whose dispatch raises falls back to per-request
+    execution: the poison request alone gets an execution_failed
+    record, its batchmates still get results."""
+    engine = InferenceEngine(srm_model)
+    op = engine.op
+    real_dispatch = op.dispatch
+
+    def sabotaged(reqs, key, b_pad):
+        if any(r.request_id == "posion-like" for r in reqs) \
+                and len(reqs) > 1:
+            raise RuntimeError("batch-level explosion")
+        if reqs[0].request_id == "posion-like" and len(reqs) == 1:
+            raise RuntimeError("still poisoned alone")
+        return real_dispatch(reqs, key, b_pad)
+
+    monkeypatch.setattr(op, "dispatch", sabotaged)
+    reqs = _srm_requests(srm_model, 4, tr_choices=(20,), seed=5)
+    reqs.insert(2, Request(
+        request_id="posion-like", subject=0,
+        x=np.zeros((srm_model.w_[0].shape[0], 20))))
+    mem = add_sink(MemorySink())
+    try:
+        records = engine.run(reqs)
+    finally:
+        remove_sink(mem)
+    by_id = {r.request_id: r for r in records}
+    assert by_id["posion-like"].error == "execution_failed"
+    assert "still poisoned" in by_id["posion-like"].message
+    assert sum(r.ok for r in records) == 4
+    # the singleton re-dispatches carry the same serve.batch
+    # span/histogram contract as the normal path: a poison-recovery
+    # trace must show its isolated batches, not a telemetry hole
+    isolated = [r for r in mem.records
+                if r["kind"] == "span" and r["name"] == "serve.batch"
+                and (r.get("attrs") or {}).get("isolated")]
+    # 4 survivors + the poison retry (its span emits on the way out)
+    assert len(isolated) == 5
+
+
+
+def test_flush_policy_max_batch_and_poll(srm_model):
+    """A bucket flushes as soon as max_batch accumulates; poll()
+    flushes an under-full bucket once its oldest request exceeds
+    max_wait_s."""
+    policy = BucketPolicy(max_batch=4, max_wait_s=10.0)
+    engine = InferenceEngine(srm_model, policy=policy)
+    reqs = _srm_requests(srm_model, 6, tr_choices=(20,), seed=6)
+    for req in reqs[:3]:
+        assert engine.submit(req) is None
+    assert len(engine.records) == 0      # under-full, still queued
+    engine.submit(reqs[3])
+    assert len(engine.records) == 4      # max_batch flushed
+    engine.submit(reqs[4])
+    engine.poll()                        # not yet past max_wait
+    assert len(engine.records) == 4
+    engine.poll(now=reqs[4].submitted + 11.0)
+    assert len(engine.records) == 5
+
+
+def test_engine_emits_serve_telemetry(srm_model):
+    """With an obs sink active, a drive emits serve.batch spans,
+    serve.request span records, and the serve metrics."""
+    mem = add_sink(MemorySink())
+    try:
+        engine = InferenceEngine(srm_model)
+        engine.run(_srm_requests(srm_model, 5, tr_choices=(20, 40),
+                                 seed=7))
+    finally:
+        remove_sink(mem)
+    names = {(r["kind"], r["name"]) for r in mem.records}
+    assert ("span", "serve.batch") in names
+    assert ("span", "serve.request") in names
+    metric_names = {r["name"] for r in mem.records
+                    if r["kind"] == "metric"}
+    assert {"serve_queue_depth", "serve_request_seconds",
+            "serve_batch_seconds",
+            "serve_padding_waste_ratio",
+            "serve_requests_total"} <= metric_names
+    # every record in the trace validates against the obs schema
+    from brainiak_tpu.obs import validate_record
+    for rec in mem.records:
+        assert validate_record(rec) == [], rec
+
+
+def test_drain_releases_records(srm_model):
+    """Online mode: drain() hands back completed records and drops
+    the engine's references, so a long-lived server's memory is the
+    queued work, not the history."""
+    engine = InferenceEngine(srm_model)
+    reqs = _srm_requests(srm_model, 3, tr_choices=(20,), seed=8)
+    engine.run(reqs)
+    drained = engine.drain()
+    assert [r.request_id for r in drained] == \
+        [r.request_id for r in reqs]
+    assert engine.records == []
+    assert engine.drain() == []
+    # the engine keeps serving after a drain
+    more = engine.run(_srm_requests(srm_model, 2,
+                                    tr_choices=(20,), seed=9))
+    assert len(more) == 2 and all(r.ok for r in more)
+
+
+def test_run_excludes_earlier_queued_submits(srm_model):
+    """run()'s flush may complete requests queued by earlier
+    submit() calls, but its return covers exactly the passed
+    requests — the earlier work stays in records for drain()."""
+    policy = BucketPolicy(max_batch=8, max_wait_s=60.0)
+    engine = InferenceEngine(srm_model, policy=policy)
+    early = _srm_requests(srm_model, 1, tr_choices=(20,), seed=20)[0]
+    early.request_id = "early"
+    assert engine.submit(early) is None   # under-full, queued
+    later = _srm_requests(srm_model, 2, tr_choices=(20,), seed=21)
+    records = engine.run(later)
+    assert [r.request_id for r in records] == \
+        [r.request_id for r in later]
+    # the earlier submit's record is delivered via drain, once
+    drained = engine.drain()
+    assert "early" in {r.request_id for r in drained}
+
+
+def test_submit_rejection_delivered_exactly_once(srm_model):
+    """A submit-time rejection is returned synchronously and must
+    NOT be re-delivered by drain(); it still counts in summary()."""
+    engine = InferenceEngine(srm_model)
+    rec = engine.submit(Request(request_id="bad", subject=0,
+                                x=np.zeros((3, 10))))
+    assert rec is not None and rec.error == "invalid_shape"
+    assert engine.records == []
+    assert engine.drain() == []
+    summ = engine.summary()
+    assert summ["n_requests"] == 1
+    assert summ["n_errors"] == 1
+    assert summ["errors_by_code"] == {"invalid_shape": 1}
+
+
+def test_fcma_poison_batch_fails_as_unit(fcma_models, monkeypatch):
+    """FCMA predictions are batch-composition-dependent, so a failed
+    batch must NOT fall back to singleton re-runs (that would
+    silently change the survivors' answers): the whole batch gets
+    execution_failed records."""
+    logit, _, test_pairs = fcma_models
+    engine = InferenceEngine(logit)
+
+    def boom(reqs, key, b_pad):
+        raise RuntimeError("clf exploded")
+
+    monkeypatch.setattr(engine.op, "dispatch", boom)
+    reqs = [Request(request_id=f"r{i}", x=pair)
+            for i, pair in enumerate(test_pairs[:4])]
+    records = engine.run(reqs)
+    assert len(records) == 4
+    assert all(not r.ok and r.error == "execution_failed"
+               for r in records)
+    assert "batch fails as a unit" in records[0].message
+
+
+def test_fcma_rejects_wrong_region_geometry(fcma_models):
+    """Per-region voxel counts are validated (order-insensitive),
+    not just their product: a (T,1)x(T,25) pair against a (5,5)
+    model has matching feature count but alien geometry."""
+    logit, _, test_pairs = fcma_models
+    engine = InferenceEngine(logit)
+    t = test_pairs[0][0].shape[0]
+    n_feat = logit.num_features_
+    rec = engine.run([Request(
+        request_id="alien",
+        x=(np.zeros((t, 1), np.float32),
+           np.zeros((t, n_feat), np.float32)))])[0]
+    assert not rec.ok and rec.error == "invalid_shape"
+    # swapped order of a VALID pair is accepted (mirrors
+    # _stack_pairs' orientation swap)
+    x1, x2 = test_pairs[0]
+    ok = engine.run([Request(request_id="swap", x=(x2, x1))])[0]
+    assert ok.ok
+
+
+def test_fcma_mixed_pair_order_in_one_batch():
+    """validate() accepts either region order, so one batch can mix
+    (small, large) and (large, small) pairs; dispatch canonicalizes
+    per pair (larger region first, like _stack_pairs on a lone
+    request) instead of letting np.stack fail the batch as a unit."""
+    import math
+
+    from scipy.stats.mstats import zscore
+    from sklearn.linear_model import LogisticRegression
+
+    from brainiak_tpu.fcma.classifier import Classifier
+
+    rng = np.random.RandomState(7)
+
+    def region(idx, num_voxels, rows=12):
+        mat = rng.rand(rows, num_voxels).astype(np.float32)
+        if idx % 2 == 0:
+            mat = np.sort(mat, axis=0)
+        mat = np.nan_to_num(zscore(mat, axis=0, ddof=0))
+        return mat / math.sqrt(mat.shape[0])
+
+    train = [(region(i, 7), region(i, 5)) for i in range(12)]
+    model = Classifier(LogisticRegression(solver="liblinear"),
+                       epochs_per_subj=4)
+    model.fit(train, [0, 1] * 6)
+
+    test = [(region(i, 7), region(i, 5)) for i in range(12, 18)]
+    reqs = [Request(request_id=f"r{i}",
+                    x=pair if i % 2 == 0 else (pair[1], pair[0]))
+            for i, pair in enumerate(test)]
+    records = InferenceEngine(model).run(reqs)
+    assert all(r.ok for r in records), \
+        [(r.request_id, r.error, r.message) for r in records]
+    np.testing.assert_array_equal(
+        np.asarray([r.result for r in records]),
+        model.predict(test))
+
+
+def test_malformed_payload_yields_invalid_payload_record(srm_model):
+    """A payload weird enough to crash validation itself (ragged
+    nested list, non-int subject) still yields exactly one
+    structured record instead of crashing the engine."""
+    engine = InferenceEngine(srm_model)
+    records = engine.run([
+        Request(request_id="ragged", x=[[1.0, 2.0], [3.0]],
+                subject=0),
+        Request(request_id="strsubj",
+                x=np.zeros((srm_model.w_[0].shape[0], 20)),
+                subject="zero"),
+    ])
+    assert [r.error for r in records] == \
+        ["invalid_payload"] * 2
+    assert engine.summary()["n_requests"] == 2
+    assert engine.summary()["n_errors"] == 2
+
+
+def test_duplicate_request_ids_keep_submission_order(srm_model):
+    """Results are ordered by the per-submission index, not the
+    user-supplied id, so duplicate ids cannot misorder records."""
+    v = srm_model.w_[0].shape[0]
+    reqs = [Request(request_id="dup", subject=0,
+                    x=np.full((v, 20), float(i)))
+            for i in range(3)]
+    records = InferenceEngine(srm_model).run(reqs)
+    assert [r.request_id for r in records] == ["dup"] * 3
+    assert [r.seq for r in records] == [0, 1, 2]
+    for i, rec in enumerate(records):
+        expected = srm_model.w_[0].T @ reqs[i].x
+        np.testing.assert_allclose(rec.result, expected, atol=1e-5)

@@ -148,7 +148,7 @@ def test_subject_voxel_mesh_and_shard_along():
 
 
 def test_device_trace_writes_profile(tmp_path):
-    from brainiak_tpu.utils.profiling import device_trace
+    from brainiak_tpu.obs import device_trace
 
     log_dir = str(tmp_path / "trace")
     with device_trace(log_dir):

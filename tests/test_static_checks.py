@@ -42,7 +42,7 @@ def test_run_checks_json_output():
     assert payload["findings"] == []
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
-        "jaxlint", "obs", "regress"}
+        "jaxlint", "obs", "regress", "serve"}
     assert payload["files"] > 100
 
 
@@ -249,3 +249,50 @@ def test_regress_gate_fails_when_fixture_cannot_gate(tmp_path,
     rc.check_regress(findings)
     assert [f.code for f in findings] == ["REG001"]
     assert "no gating" in findings[0].message
+
+
+def test_serve_gate_passes_on_committed_fixture():
+    """The serve gate (SRV001) smoke-runs the serving CLI on the
+    committed tools/serve_fixture model + requests and passes on the
+    live tree (ISSUE 5 satellite)."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_serve(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_serve_gate_catches_missing_fixture(tmp_path, monkeypatch):
+    rc = _load_run_checks()
+    monkeypatch.setattr(rc, "SERVE_FIXTURE_DIR",
+                        str(tmp_path / "nope"))
+    findings = []
+    rc.check_serve(findings)
+    assert [f.code for f in findings] == ["SRV001"]
+    assert "missing" in findings[0].message
+
+
+def test_serve_gate_catches_poison_fixture(tmp_path, monkeypatch):
+    """A fixture whose requests produce error records fails the
+    gate — the committed fixture must keep serving cleanly."""
+    import os
+    import shutil
+
+    import numpy as np
+
+    rc = _load_run_checks()
+    fixture = tmp_path / "serve_fixture"
+    fixture.mkdir()
+    shutil.copy(os.path.join(rc.SERVE_FIXTURE_DIR, "model.npz"),
+                str(fixture))
+    from brainiak_tpu.serve import load_requests, save_requests
+    reqs = load_requests(
+        os.path.join(rc.SERVE_FIXTURE_DIR, "requests.npz"))
+    payloads = [r.x for r in reqs]
+    payloads[0] = np.full_like(payloads[0], np.nan)  # poison
+    save_requests(str(fixture / "requests.npz"), payloads,
+                  subjects=[r.subject for r in reqs])
+    monkeypatch.setattr(rc, "SERVE_FIXTURE_DIR", str(fixture))
+    findings = []
+    rc.check_serve(findings)
+    assert findings and all(f.code == "SRV001" for f in findings)
+    assert any("error record" in f.message for f in findings)

@@ -2,12 +2,12 @@ import time
 
 import numpy as np
 
-from brainiak_tpu.utils.checkpoint import CheckpointManager
-from brainiak_tpu.utils.profiling import (
+from brainiak_tpu.obs import (
     reset_stage_times,
     stage_timer,
     stage_times,
 )
+from brainiak_tpu.utils.checkpoint import CheckpointManager
 
 
 def test_checkpoint_roundtrip(tmp_path):
@@ -95,3 +95,21 @@ def test_stage_timer_sync_target():
     assert all(t > 0 for t in times["stage_sync"])
     assert float(y[0, 0]) == 64.0
     reset_stage_times()
+
+
+def test_profiling_shim_warns_and_still_works():
+    """The utils.profiling shim emits a DeprecationWarning pointing
+    at brainiak_tpu.obs on import, and keeps re-exporting the legacy
+    names (PR 5 satellite)."""
+    import importlib
+    import sys
+
+    import pytest
+
+    sys.modules.pop("brainiak_tpu.utils.profiling", None)
+    with pytest.warns(DeprecationWarning, match="brainiak_tpu.obs"):
+        shim = importlib.import_module(
+            "brainiak_tpu.utils.profiling")
+    assert shim.stage_timer is stage_timer
+    assert shim.stage_times is stage_times
+    assert shim.reset_stage_times is reset_stage_times

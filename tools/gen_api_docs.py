@@ -63,16 +63,20 @@ def _sort_set_reprs(text):
         text)
 
 
+def _mask_addrs(text):
+    """Strip run-specific id() addresses from reprs (functions, bound
+    methods, object instances) so regeneration is deterministic."""
+    return re.sub(r"<([^<>]*?) at 0x[\da-f]+>", r"<\1>", text)
+
+
 def fmt_signature(name, obj):
     try:
         sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         sig = "(...)"
-    # default-value reprs embed run-specific id() addresses (functions,
-    # bound methods, object instances); strip them so regeneration is
-    # deterministic — and sort set-literal reprs for the same reason
-    sig = re.sub(r"<([^<>]*?) at 0x[\da-f]+>", r"<\1>", sig)
-    return f"{name}{_sort_set_reprs(sig)}"
+    # default-value reprs embed addresses; sort set-literal reprs
+    # too — both for deterministic regeneration
+    return f"{name}{_sort_set_reprs(_mask_addrs(sig))}"
 
 
 def fmt_doc(obj, indent=""):
@@ -110,7 +114,8 @@ def emit_member(lines, name, obj):
         lines.append(fmt_doc(obj))
     else:
         lines.append(f"### `{name}`\n")
-        lines.append(f"Constant: `{_sort_set_reprs(repr(obj))}`\n")
+        lines.append(
+            f"Constant: `{_sort_set_reprs(_mask_addrs(repr(obj)))}`\n")
 
 
 def emit_module(lines, modname):

@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Regenerate the committed serve-gate fixture (tools/serve_fixture/).
+
+The ``serve`` gate of ``tools/run_checks.py`` (SRV001) smoke-runs the
+serving CLI on a tiny committed model + request file; this script is
+how those artifacts were produced — deterministic (fixed seeds, CPU
+backend) so a regeneration diff means the artifact schema or the
+demo-model numerics changed, both of which SHOULD be a reviewed
+change.
+
+Run from the repo root:  python tools/gen_serve_fixture.py
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "tools", "serve_fixture")
+
+
+def main():
+    from brainiak_tpu.serve import save_model, save_requests
+    from brainiak_tpu.serve.__main__ import (build_demo_model,
+                                             build_mixed_requests)
+
+    os.makedirs(OUT, exist_ok=True)
+    # mixed voxel counts (ragged=True) so the gate also exercises the
+    # indexed-key list packing; tiny sizes keep CI fast
+    model = build_demo_model(n_subjects=3, voxels=12, samples=24,
+                             features=4, n_iter=3, seed=7)
+    save_model(model, os.path.join(OUT, "model.npz"))
+    requests = build_mixed_requests(model, 10, seed=7,
+                                    tr_choices=(6, 11, 18))
+    save_requests(
+        os.path.join(OUT, "requests.npz"),
+        [r.x for r in requests],
+        subjects=[r.subject for r in requests],
+        ids=[r.request_id for r in requests])
+    print(f"wrote {OUT}/model.npz and {OUT}/requests.npz")
+
+
+if __name__ == "__main__":
+    main()

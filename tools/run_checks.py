@@ -25,6 +25,10 @@ regress    runs ``python -m brainiak_tpu.obs regress`` on the
            committed tools/bench_fixture/ history and fails on
            a regression verdict (REG001) — the bench gate runs
            fixture-driven in CI, no TPU required
+serve      smoke-runs ``python -m brainiak_tpu.serve run`` on
+           the committed tools/serve_fixture/ model + request
+           files and fails on CLI errors, request-level error
+           records, or per-request recompiles (SRV001)
 ========== ===================================================
 
 ``# noqa`` suppresses stdlib/doc findings on a line; jaxlint uses
@@ -55,7 +59,7 @@ from brainiak_tpu.analysis.core import SKIP_DIRS  # noqa: E402,F401
 
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
-         "jaxlint", "obs", "regress")
+         "jaxlint", "obs", "regress", "serve")
 
 
 def python_sources():
@@ -440,6 +444,89 @@ def check_regress(findings):
             "(all skipped or insufficient history)"))
 
 
+# -- serve gate -------------------------------------------------------
+
+SERVE_FIXTURE_DIR = os.path.join(REPO, "tools", "serve_fixture")
+
+
+def check_serve(findings):
+    """Serving gate (SRV001): smoke-run the serve CLI
+    (``python -m brainiak_tpu.serve run --format=json``) on the
+    committed tiny model + request fixture
+    (``tools/gen_serve_fixture.py`` regenerates).  Fails when the
+    CLI errors, any request yields an error record, the summary
+    loses the keys downstream tooling parses, or the engine
+    recompiled more than once per bucket (the no-per-request-
+    recompiles contract)."""
+    rel = _rel(SERVE_FIXTURE_DIR)
+    model = os.path.join(SERVE_FIXTURE_DIR, "model.npz")
+    requests = os.path.join(SERVE_FIXTURE_DIR, "requests.npz")
+    for path in (model, requests):
+        if not os.path.exists(path):
+            findings.append(Finding(
+                rel, 1, "SRV001",
+                f"serve fixture missing: {_rel(path)}"))
+            return
+    # unlike the obs/regress gate children this one initializes a
+    # JAX backend; BENCH_FORCE_CPU makes the child pin the platform
+    # in-process before backend init (the JAX_PLATFORMS env var
+    # alone can hang on a wedged tunnel PJRT plugin,
+    # docs/performance.md rule 4) — the timeout stays as a backstop
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "brainiak_tpu.serve", "run",
+             "--model", model, "--requests", requests,
+             "--format=json"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     BENCH_FORCE_CPU="1"),
+            timeout=420)
+    except subprocess.TimeoutExpired:
+        findings.append(Finding(
+            rel, 1, "SRV001",
+            "serve CLI timed out after 420s (hung backend init?)"))
+        return
+    try:
+        summary = json.loads(proc.stdout)
+    except ValueError:
+        summary = None
+    # rc=1 with a parseable summary means request-level error
+    # records — report those as their own finding below; anything
+    # without a summary is a hard CLI failure
+    if summary is None or proc.returncode not in (0, 1):
+        tail = (proc.stderr or proc.stdout or "").strip()
+        tail = "; ".join(tail.splitlines()[-3:])
+        findings.append(Finding(
+            rel, 1, "SRV001",
+            f"serve CLI failed (rc={proc.returncode}): "
+            f"{tail or 'no JSON summary'}"))
+        return
+    for key in ("n_requests", "n_ok", "n_errors", "buckets",
+                "retrace_total", "padding_waste"):
+        if key not in summary:
+            findings.append(Finding(
+                rel, 1, "SRV001",
+                f"serve summary missing key {key!r}"))
+            return
+    if summary["n_errors"]:
+        findings.append(Finding(
+            rel, 1, "SRV001",
+            f"{summary['n_errors']} fixture request(s) produced "
+            f"error records: {summary.get('errors_by_code')}"))
+    if summary["n_ok"] + summary["n_errors"] != summary["n_requests"]:
+        findings.append(Finding(
+            rel, 1, "SRV001",
+            f"{summary['n_ok']} ok + {summary['n_errors']} error "
+            f"record(s) for {summary['n_requests']} fixture "
+            "requests: records were silently dropped"))
+    if summary["retrace_total"] > len(summary["buckets"]):
+        findings.append(Finding(
+            rel, 1, "SRV001",
+            f"engine compiled {summary['retrace_total']:.0f} "
+            f"programs for {len(summary['buckets'])} bucket(s): "
+            "per-request recompiles"))
+
+
 # -- external gate ----------------------------------------------------
 
 def run_external(findings):
@@ -548,6 +635,8 @@ def run_gates(only=None):
         check_obs(findings)
     if "regress" in selected:
         check_regress(findings)
+    if "serve" in selected:
+        check_serve(findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
@@ -555,7 +644,7 @@ def run_gates(only=None):
     label = "+".join(
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
-                       "obs", "regress")
+                       "obs", "regress", "serve")
            if g in selected])
     return {
         "ok": not findings,
