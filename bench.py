@@ -23,7 +23,13 @@ responsive chip the north-star whole-brain config is attempted first
 (V=65536 correlation width, E=32 — the BASELINE.json scale), then the
 V=8192 mid config, then a reduced CPU fallback.  Each chip tier runs in
 its own subprocess under a timeout so a tunnel wedge mid-tier cannot
-hang the driver's bench invocation.
+hang the driver's bench invocation.  Two further tiers print their own
+JSON lines after the FCMA record: ``serve`` (batched SRM-transform
+serving) and ``distla`` (pod-scale SUMMA-sharded Gram,
+``brainiak_tpu.ops.distla`` — voxels/s of a [T, V] -> [V, V]
+correlation with the voxel axis ring-sharded), each split into an
+on-chip and a ``*_cpu_fallback`` tier so ``obs regress`` never
+compares host rounds against on-chip baselines.
 
 Stage breakdown: every tier runs with :mod:`brainiak_tpu.obs` enabled
 on an in-memory sink — ``bench.data_gen`` / ``bench.warm`` (upload +
@@ -62,6 +68,14 @@ WB_VOXELS = 65536
 WB_SELECTED = 1024
 WB_EPOCHS = 32
 SERVE_REQUESTS = 256  # serve-tier workload (BENCH_SERVE_REQUESTS overrides)
+
+# distla tier (pod-scale SUMMA Gram, brainiak_tpu.ops.distla): the
+# on-chip workload is a [T, V] -> [V, V] sharded correlation at a
+# width whose replicated working set is already uncomfortable per
+# device; the CPU fallback runs a reduced width so the round still
+# records a number.  BENCH_DISTLA_VOXELS overrides either.
+DISTLA_VOXELS = 16384
+DISTLA_CPU_VOXELS = 2048
 
 
 def _serve_n_requests():
@@ -177,6 +191,91 @@ def cpu_voxels_per_sec(n_voxels=N_VOXELS, block=64, n_epochs=N_EPOCHS):
         model_selection.cross_val_score(clf, k, y=labels, cv=skf, n_jobs=1)
     dt = time.perf_counter() - t0
     return block / dt
+
+
+def _distla_n_voxels():
+    """The distla tier's Gram width: the env override, else a default
+    scaled to the ambient backend (the reduced CPU width keeps the
+    fallback round under a minute) — one reader so the measured
+    workload and the stamped ``config.n_voxels`` cannot drift."""
+    import os
+
+    import jax
+    default = DISTLA_VOXELS if jax.default_backend() == "tpu" \
+        else DISTLA_CPU_VOXELS
+    return int(os.environ.get("BENCH_DISTLA_VOXELS", default))
+
+
+def distla_tier_metrics(n_voxels, n_trs=N_TRS, seed=0):
+    """The ``distla`` tier: SUMMA-sharded whole-Gram throughput
+    (voxels/s of [T, V] -> [V, V] Pearson correlation) through
+    :func:`brainiak_tpu.ops.distla.summa_gram`, ring over every
+    device the backend exposes.  The warm call pays placement and
+    compile; the timed call is the steady-state ring."""
+    import jax
+
+    from brainiak_tpu.ops import distla
+    from brainiak_tpu.parallel import make_mesh, max_divisible_shards
+
+    with obs.span("bench.data_gen"):
+        rng = np.random.RandomState(seed)
+        data = rng.randn(n_trs, n_voxels).astype(np.float32)
+        n_shards = max_divisible_shards(n_voxels)
+        mesh = make_mesh(("voxel",), (n_shards,))
+    with obs.span("bench.warm"):
+        np.asarray(distla.summa_gram(data, mesh))
+    t0 = time.perf_counter()
+    with obs.span("bench.steady"):
+        out = np.asarray(distla.summa_gram(data, mesh))
+    dt = time.perf_counter() - t0
+    assert out.shape == (n_voxels, n_voxels)
+    return {"voxels_per_sec": n_voxels / dt,
+            "n_voxels": n_voxels, "n_trs": n_trs,
+            "n_shards": n_shards,
+            "backend": jax.default_backend()}
+
+
+def distla_cpu_voxels_per_sec(n_voxels, n_trs=N_TRS, seed=0):
+    """Reference-path Gram throughput on host BLAS at the SAME width
+    as the sharded run (z-score + ``z.T @ z``), for the distla
+    record's ``vs_baseline``."""
+    rng = np.random.RandomState(seed)
+    data = rng.randn(n_trs, n_voxels).astype(np.float32)
+    t0 = time.perf_counter()
+    z = (data - data.mean(0)) / (data.std(0) * math.sqrt(n_trs))
+    out = z.T @ z
+    dt = time.perf_counter() - t0
+    assert out.shape == (n_voxels, n_voxels)
+    return n_voxels / dt
+
+
+def _distla_result_record(out):
+    """The distla tier's bench JSON line (schema:
+    ``brainiak_tpu.obs.validate_bench_record``).  Tier separation
+    mirrors the FCMA/serve tiers: a run whose backend is not a TPU
+    is stamped ``tier="distla_cpu_fallback"`` so ``obs regress``
+    never compares a host round against an on-chip SUMMA baseline
+    (and ``obs regress --only distla`` gates both as one family)."""
+    vps = float(out["voxels_per_sec"])
+    baseline = distla_cpu_voxels_per_sec(out["n_voxels"],
+                                         n_trs=out["n_trs"])
+    tier = "distla" if out.get("backend") == "tpu" \
+        else "distla_cpu_fallback"
+    rec = {"schema_version": BENCH_SCHEMA_VERSION,
+           "metric": "distla_summa_gram_voxels_per_sec",
+           "value": round(vps, 2),
+           "unit": "voxels/sec",
+           "vs_baseline": round(vps / baseline, 2),
+           "tier": tier,
+           "config": {"n_voxels": out["n_voxels"],
+                      "n_trs": out["n_trs"],
+                      "n_shards": out["n_shards"]}}
+    commit = _git_commit()
+    if commit:
+        rec["git_commit"] = commit
+    if out.get("stages"):
+        rec["stages"] = out["stages"]
+    return rec
 
 
 def serve_tier_metrics(n_requests=SERVE_REQUESTS, seed=0):
@@ -368,6 +467,18 @@ def measure_tier(tier):
     obs.install_compile_listener()
     mem = obs.add_sink(obs.MemorySink())
     try:
+        if tier == "distla":
+            out = distla_tier_metrics(_distla_n_voxels())
+            # the record's tier is split by backend (an on-chip SUMMA
+            # rate must never share a regress baseline with a
+            # CPU-fallback one — same rule as the fcma/serve tiers)
+            obs.gauge("bench_distla_voxels_per_sec",
+                      unit="voxels/sec").set(
+                          out["voxels_per_sec"],
+                          tier="distla" if out["backend"] == "tpu"
+                          else "distla_cpu_fallback")
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "serve":
             out = serve_tier_metrics(n_requests=_serve_n_requests())
             # the record's tier is split by backend (an on-chip
@@ -456,6 +567,25 @@ def main():
     history."""
     responsive = _fcma_main()
     _serve_main(responsive)
+    _distla_main(responsive)
+
+
+def _distla_main(responsive):
+    """Distla tier: subprocess first (one chip process at a time, a
+    wedge must not hang the driver), in-process CPU fallback at the
+    reduced width otherwise.  ``responsive`` is the earlier tiers'
+    probe verdict; a prior subprocess may have wedged the tunnel
+    since, so a True verdict is re-probed cheaply before committing
+    the chip, while a False one is trusted as-is."""
+    if responsive:
+        responsive = _device_responsive(timeout=90)
+    out = _run_tier_subprocess("distla", timeout=420) \
+        if responsive else None
+    if out is None:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        out = measure_tier("distla")
+    print(json.dumps(_distla_result_record(out)))
 
 
 def _serve_main(responsive):

@@ -26,9 +26,11 @@ import numpy as np
 from ..obs import profile as obs_profile
 from ..obs import runtime as obs_runtime
 from ..obs import spans as obs_spans
+from ..ops import distla
 from ..ops.correlation import resolve_precision
 from ..ops.fisherz import within_subject_normalization
 from ..ops.svm import svm_cv_accuracy
+from ..parallel.compat import shard_map
 from ..parallel.mesh import DEFAULT_VOXEL_AXIS
 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -55,6 +57,12 @@ def _gram_and_shrink(corr, precision=None):
     return _shrink(kernels)
 
 
+# the distla path's Grams come back raw (the psum-contraction program
+# is FCMA-agnostic); one tiny jitted shrink applies the magnitude
+# scaling without an eager per-element dispatch chain
+_shrink_jit = jax.jit(_shrink)
+
+
 @obs_runtime.counted_cache("fcma.sharded_gram")
 def _sharded_gram_program(mesh, epochs_per_subj, interpret,
                           precision):
@@ -68,7 +76,6 @@ def _sharded_gram_program(mesh, epochs_per_subj, interpret,
     first run per shape captures a ``cost`` record under the same
     site, joined to ``fcma.block`` span durations by the report CLI.
     """
-    from jax import shard_map
     return obs_profile.profile_program(jax.jit(shard_map(
         partial(_block_gram_pallas,
                 epochs_per_subj=epochs_per_subj,
@@ -191,6 +198,20 @@ class VoxelSelector:
         raise ``svm_iters`` if that fires (or cross-check with
         ``ops.svm.svm_cv_accuracy(..., solver='ipm')``, the exact
         interior-point solver)
+    use_distla : 'auto' | True | False — the pod-scale sharded-Gram
+        path (:mod:`brainiak_tpu.ops.distla`): the "all voxels"
+        operand is SHARDED over the mesh's voxel axis instead of
+        replicated, each device contracts the block against its
+        resident shard, and one psum completes the per-voxel Grams.
+        'auto' engages it when replicating the stacked data2 would
+        exceed ``replicated_budget_bytes`` — the whole-brain regime
+        where the replicated path OOMs.  Requires ``mesh`` and the
+        on-device SVM: under 'auto' a host-CV ``run(clf)`` falls
+        back to the replicated layout for that call (with a
+        warning); an explicit ``True`` raises instead.
+    replicated_budget_bytes : per-device byte budget for replicating
+        data2 under ``use_distla='auto'`` (default:
+        :func:`brainiak_tpu.ops.distla.replicated_budget_bytes`).
     use_pallas : 'auto' (fused Pallas kernel on TPU) | True | False
     precision : 'highest' (fp32-equivalent, default) | 'high' (3-pass
         bf16 MXU, ~1e-3 correlation accuracy) | 'default', for the
@@ -204,7 +225,8 @@ class VoxelSelector:
     def __init__(self, labels, epochs_per_subj, num_folds, raw_data,
                  raw_data2=None, voxel_unit=256, mesh=None,
                  svm_C=1.0, svm_iters=10, process_num=None,
-                 master_rank=0, use_pallas='auto', precision='highest'):
+                 master_rank=0, use_pallas='auto', precision='highest',
+                 use_distla='auto', replicated_budget_bytes=None):
         self.labels = np.asarray(labels)
         self.epochs_per_subj = epochs_per_subj
         self.num_folds = num_folds
@@ -234,6 +256,26 @@ class VoxelSelector:
                              'element by element')
         if self.num_voxels == 0 or self.num_voxels2 == 0:
             raise ValueError('Zero processed voxels')
+        # distla (sharded-data2) path: decided at construction — the
+        # input sizes are fixed here, and _stack()'s placement must
+        # agree with the block-loop path in _run().  Whether the
+        # engagement was automatic matters at run() time: the path
+        # serves the on-device SVM only, and a budget-triggered auto
+        # decision must degrade to the replicated path for host CV
+        # instead of turning a previously-working call into an error.
+        self._distla_auto = use_distla == 'auto'
+        if use_distla == 'auto':
+            budget = distla.replicated_budget_bytes() \
+                if replicated_budget_bytes is None \
+                else int(replicated_budget_bytes)
+            data2_bytes = (len(raw_data) * raw_data[0].shape[0]
+                           * self.num_voxels2 * 4)
+            use_distla = mesh is not None and data2_bytes > budget
+        elif use_distla and mesh is None:
+            raise ValueError(
+                "use_distla=True requires a mesh with a voxel axis "
+                "(the sharded-Gram path shards data2 over it)")
+        self.use_distla = bool(use_distla)
 
     def _stack(self):
         # cache the device-resident stack across run() calls — re-staging
@@ -249,7 +291,11 @@ class VoxelSelector:
             elems = tuple(self.raw_data) + (
                 tuple(self.raw_data2) if self.raw_data2 is not None
                 else ())
-            return (self.raw_data, self.raw_data2, self.mesh) + elems
+            # use_distla participates: the auto path's host-CV
+            # fallback flips it per run() call, and the sharded vs
+            # replicated data2 placements must never be conflated
+            return (self.raw_data, self.raw_data2, self.mesh,
+                    self.use_distla) + elems
 
         key = _key()
         cached = getattr(self, "_stack_cache", None)
@@ -263,7 +309,23 @@ class VoxelSelector:
                                 dtype=jnp.float32)
         else:
             data2 = data1
-        if self.mesh is not None:
+        if self.mesh is not None and self.use_distla:
+            # distla path: data2 (the "all voxels" side) is SHARDED
+            # over the voxel axis — the replicated-budget escape
+            # hatch — zero-padded to the axis size (pad columns
+            # normalize to zero and contribute nothing to the Gram);
+            # blocks stay replicated and the contraction psums.
+            n_shards = self.mesh.shape.get(DEFAULT_VOXEL_AXIS, 1)
+            pad = (-data2.shape[2]) % n_shards
+            if pad:
+                data2 = jnp.pad(data2, ((0, 0), (0, 0), (0, pad)))
+            data1 = jax.device_put(
+                data1, NamedSharding(self.mesh, PartitionSpec()))
+            data2 = jax.device_put(
+                data2, NamedSharding(
+                    self.mesh,
+                    PartitionSpec(None, None, DEFAULT_VOXEL_AXIS)))
+        elif self.mesh is not None:
             # data2 (the "all voxels" side) is replicated; each block of
             # data1 is sharded over the voxel axis below.
             data1 = jax.device_put(
@@ -284,7 +346,9 @@ class VoxelSelector:
             blk = jnp.tile(data1, (1, 1, reps))[:, :, :block]
         else:
             blk = jax.lax.dynamic_slice_in_dim(data1, start, block, axis=2)
-        if self.mesh is not None:
+        if self.mesh is not None and not self.use_distla:
+            # distla mode keeps the block replicated: the parallelism
+            # is over data2's sharded voxel axis, not the block dim
             blk = jax.device_put(
                 blk, NamedSharding(self.mesh,
                                    PartitionSpec(None, None,
@@ -313,14 +377,37 @@ class VoxelSelector:
             return self._run(clf)
 
     def _run(self, clf):
+        on_device_svm = isinstance(clf, str) and clf == 'svm'
+        if self.use_distla and not on_device_svm:
+            if not self._distla_auto:
+                raise ValueError(
+                    "the distla sharded-Gram path only supports the "
+                    "on-device SVM (run('svm')); pass "
+                    "use_distla=False for host cross-validation")
+            # auto-engaged: the classifier is only known here.  Run
+            # this call on the replicated path (the pre-distla
+            # behavior — it may exceed the budget that triggered the
+            # engagement) and restore the sharded path afterwards.
+            logger.warning(
+                "use_distla='auto' engaged (replicating data2 "
+                "exceeds the budget) but host cross-validation "
+                "needs the replicated layout; falling back for "
+                "this run() call")
+            self.use_distla = False
+            try:
+                return self._run(clf)
+            finally:
+                self.use_distla = True
         data1, data2 = self._stack()
         n_shards = 1
         if self.mesh is not None:
             n_shards = self.mesh.shape.get(DEFAULT_VOXEL_AXIS, 1)
-        block = self.voxel_unit * n_shards
+        # distla mode parallelizes over data2's sharded voxel axis, so
+        # the block extent is NOT multiplied by the shard count
+        block = self.voxel_unit if self.use_distla \
+            else self.voxel_unit * n_shards
 
-        on_device_svm = isinstance(clf, str) and clf == 'svm'
-        if self.use_pallas and on_device_svm:
+        if self.use_pallas and on_device_svm and not self.use_distla:
             from ..ops.pallas_kernels import pick_tiles
             if pick_tiles(len(self.raw_data), self.raw_data[0].shape[0],
                           self.num_voxels, self.num_voxels2)[2]:
@@ -336,7 +423,8 @@ class VoxelSelector:
         # are constant across iterations AND across run() calls, so
         # the builder is lru_cached at module scope — jaxlint JX001)
         sharded_gram = None
-        if self.mesh is not None and self.use_pallas:
+        if self.mesh is not None and self.use_pallas \
+                and not self.use_distla:
             sharded_gram = _sharded_gram_program(
                 self.mesh, self.epochs_per_subj,
                 jax.default_backend() != 'tpu', self.precision)
@@ -354,7 +442,15 @@ class VoxelSelector:
                     if self.num_voxels >= block else 0
                 offset = start - pad_start
                 blk = self._slice_block(data1, pad_start, block)
-                if self.use_pallas and on_device_svm:
+                if self.use_distla and on_device_svm:
+                    # sharded-data2 contraction (ops.distla): each
+                    # device grams the block against its resident
+                    # voxel shard; psum completes the kernels
+                    kernels = _shrink_jit(distla.block_gram(
+                        blk, data2, self.mesh, self.epochs_per_subj,
+                        precision=self.precision))
+                    corr = None
+                elif self.use_pallas and on_device_svm:
                     # Gram-only fusion: the [block, E, V] tensor never
                     # round-trips through HBM
                     if sharded_gram is not None:

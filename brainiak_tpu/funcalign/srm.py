@@ -35,6 +35,7 @@ from sklearn.exceptions import NotFittedError
 from sklearn.utils import assert_all_finite
 
 from ..obs import profile as obs_profile
+from ..ops import distla
 from ..parallel.mesh import (DEFAULT_SUBJECT_AXIS, fetch_replicated,
                              place_on_mesh)
 from ..resilience.guards import (array_digest, check_state,
@@ -163,6 +164,22 @@ def _procrustes(a, perturbation=0.001):
     return u @ vt
 
 
+def _procrustes_batch(a, mesh, perturbation=0.001):
+    """Per-subject Procrustes W updates for a stacked [S, V, K] batch.
+
+    With a mesh, the batch is laid out along the mesh's subject axis
+    through :func:`brainiak_tpu.ops.distla.shard_vmap`, so each
+    device runs the eigh-based polar solve only for its resident
+    subjects (the sharded-batched E-step solve layout of ISSUE 6;
+    batched small eigh under plain GSPMD lowers to long sequential
+    loops on some backends).  Falls back to a plain ``vmap`` without
+    a mesh or when the subject count does not divide the axis —
+    per-subject numerics are identical either way."""
+    fn = partial(_procrustes, perturbation=perturbation)
+    return distla.shard_vmap(fn, mesh, DEFAULT_SUBJECT_AXIS,
+                             a.shape[0])(a)
+
+
 def _init_w(key, voxels_pad, n_subjects, features, voxel_counts):
     """Random orthonormal init per subject via QR, with rows beyond each
     subject's true voxel count zeroed (srm.py:53-107)."""
@@ -175,11 +192,14 @@ def _init_w(key, voxels_pad, n_subjects, features, voxel_counts):
     return jnp.where(row < voxel_counts[:, None, None], q, 0.0)
 
 
-def _em_iteration(x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples):
+def _em_iteration(x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples,
+                  mesh=None):
     """One probabilistic-SRM EM iteration on stacked data.
 
     Mirrors srm.py:536-620; the subject-summed quantities become reductions
-    over the (possibly mesh-sharded) leading axis.
+    over the (possibly mesh-sharded) leading axis, and with ``mesh`` the
+    per-subject polar solves of the W update run sharded-batched along
+    the subject axis (:func:`_procrustes_batch`).
     """
     features = sigma_s.shape[0]
     eye = jnp.eye(features, dtype=x.dtype)
@@ -200,7 +220,7 @@ def _em_iteration(x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples):
     trace_sigma_s = samples * jnp.trace(sigma_s)
 
     a = jnp.einsum('svt,kt->svk', x, shared)
-    w = jax.vmap(_procrustes)(a)
+    w = _procrustes_batch(a, mesh)
     rho2 = (trace_xtx - 2.0 * jnp.sum(w * a, axis=(1, 2)) + trace_sigma_s) \
         / (samples * voxel_counts)
     return w, rho2, sigma_s, shared, wt_invpsi_x, inv_sigma_s_rhos
@@ -224,17 +244,20 @@ def _srm_log_likelihood(sigma_s, rho2, voxel_counts, wt_invpsi_x,
     return ll
 
 
-@partial(jax.jit, static_argnames=("n_steps",))
+@partial(jax.jit, static_argnames=("n_steps", "mesh"))
 def _em_chunk(x, trace_xtx, voxel_counts, w, rho2, sigma_s, shared,
-              n_steps):
+              n_steps, mesh=None):
     """Run ``n_steps`` EM iterations from explicit state — the
-    checkpointable unit for preemption-safe fits."""
+    checkpointable unit for preemption-safe fits.  ``mesh`` (static;
+    hashable) routes the per-subject W solves through the
+    sharded-batched distla layout."""
     samples = x.shape[2]
 
     def body(_, carry):
         w, rho2, sigma_s, shared = carry
         w, rho2, sigma_s, shared, _, _ = _em_iteration(
-            x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples)
+            x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples,
+            mesh=mesh)
         return w, rho2, sigma_s, shared
 
     return jax.lax.fori_loop(0, n_steps, body,
@@ -249,19 +272,22 @@ _em_chunk = obs_profile.profile_program(
     _em_chunk, "srm.em_chunk", span="fit_chunk", estimator="SRM.fit")
 
 
-def _final_log_likelihood(x, w, rho2, sigma_s, trace_xtx, voxel_counts):
+def _final_log_likelihood(x, w, rho2, sigma_s, trace_xtx, voxel_counts,
+                          mesh=None):
     """Marginal log-likelihood at the current EM state (shared by the
     plain and checkpointed fit paths)."""
     samples = x.shape[2]
     trace_xt_invsigma2_x = jnp.sum(trace_xtx / rho2)
     _, _, _, _, wt_invpsi_x, inv_sigma_s_rhos = _em_iteration(
-        x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples)
+        x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples,
+        mesh=mesh)
     return _srm_log_likelihood(sigma_s, rho2, voxel_counts, wt_invpsi_x,
                                inv_sigma_s_rhos, trace_xt_invsigma2_x,
                                samples)
 
 
-def _fit_prob_srm(x, trace_xtx, voxel_counts, key, features, n_iter):
+def _fit_prob_srm(x, trace_xtx, voxel_counts, key, features, n_iter,
+                  mesh=None):
     """Full probabilistic-SRM EM fit as one XLA program."""
     n_subjects, voxels_pad, samples = x.shape
     w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
@@ -270,28 +296,31 @@ def _fit_prob_srm(x, trace_xtx, voxel_counts, key, features, n_iter):
     shared = jnp.zeros((features, samples), dtype=x.dtype)
     w, rho2, sigma_s, shared = _em_chunk(
         x, trace_xtx, voxel_counts, w, rho2, sigma_s, shared,
-        n_steps=n_iter)
+        n_steps=n_iter, mesh=mesh)
     ll = _final_log_likelihood(x, w, rho2, sigma_s, trace_xtx,
-                               voxel_counts)
+                               voxel_counts, mesh=mesh)
     return w, rho2, sigma_s, shared, ll
 
 
 _fit_prob_srm_jit = obs_profile.profile_program(
-    jax.jit(_fit_prob_srm, static_argnames=("features", "n_iter")),
+    jax.jit(_fit_prob_srm,
+            static_argnames=("features", "n_iter", "mesh")),
     "srm.fit_prob")
 
 
 
-@partial(jax.jit, static_argnames=("n_steps",))
-def _det_chunk(x, w, shared, n_steps):
+@partial(jax.jit, static_argnames=("n_steps", "mesh"))
+def _det_chunk(x, w, shared, n_steps, mesh=None):
     """``n_steps`` deterministic-SRM BCD iterations from explicit
-    state — the checkpointable unit for preemption-safe fits."""
+    state — the checkpointable unit for preemption-safe fits.
+    ``mesh`` (static) lays the per-subject W solves out along the
+    subject axis (:func:`_procrustes_batch`)."""
     n_subjects = x.shape[0]
 
     def body(_, carry):
         w, shared = carry
         a = jnp.einsum('svt,kt->svk', x, shared)
-        w = jax.vmap(_procrustes)(a)
+        w = _procrustes_batch(a, mesh)
         return w, jnp.einsum('svk,svt->kt', w, x) / n_subjects
 
     return jax.lax.fori_loop(0, n_steps, body, (w, shared))
@@ -308,18 +337,19 @@ def _det_objective(x, w, shared):
         jnp.square(x - jnp.einsum('svk,kt->svt', w, shared))) / 2.0
 
 
-def _fit_det_srm(x, voxel_counts, key, features, n_iter):
+def _fit_det_srm(x, voxel_counts, key, features, n_iter, mesh=None):
     """Deterministic SRM block-coordinate descent (srm.py:859-918):
     alternate Procrustes W updates with S = mean_i W_iᵀ X_i."""
     n_subjects, voxels_pad, samples = x.shape
     w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
     shared = jnp.einsum('svk,svt->kt', w, x) / n_subjects
-    w, shared = _det_chunk(x, w, shared, n_steps=n_iter)
+    w, shared = _det_chunk(x, w, shared, n_steps=n_iter, mesh=mesh)
     return w, shared, _det_objective(x, w, shared)
 
 
 _fit_det_srm_jit = obs_profile.profile_program(
-    jax.jit(_fit_det_srm, static_argnames=("features", "n_iter")),
+    jax.jit(_fit_det_srm,
+            static_argnames=("features", "n_iter", "mesh")),
     "srm.fit_det")
 
 
@@ -462,7 +492,8 @@ class SRM(_SRMBase):
             w, rho2, sigma_s, shared, ll = _fit_prob_srm_jit(
                 stacked, jnp.asarray(trace_xtx),
                 jnp.asarray(voxel_counts).astype(dtype), key,
-                features=self.features, n_iter=self.n_iter)
+                features=self.features, n_iter=self.n_iter,
+                mesh=self.mesh)
         else:
             w, rho2, sigma_s, shared, ll = self._fit_checkpointed(
                 stacked, trace_xtx, voxel_counts, key, dtype,
@@ -524,7 +555,7 @@ class SRM(_SRMBase):
 
         run_chunk, final_leaves = make_device_carry_chunk(
             lambda dev, n: _em_chunk(stacked, trace_j, counts_j, *dev,
-                                     n_steps=n),
+                                     n_steps=n, mesh=self.mesh),
             ("w", "rho2", "sigma_s", "shared"),
             fetch=lambda v: fetch_replicated(v, self.mesh),
             dtype=dtype)
@@ -535,7 +566,7 @@ class SRM(_SRMBase):
             fingerprint=fingerprint, template=template, name="SRM.fit")
         w, rho2, sigma_s, shared = final_leaves(state, step)
         ll = _final_log_likelihood(stacked, w, rho2, sigma_s, trace_j,
-                                   counts_j)
+                                   counts_j, mesh=self.mesh)
         return w, rho2, sigma_s, shared, ll
 
     def save(self, file):
@@ -617,7 +648,8 @@ class DetSRM(_SRMBase):
         if checkpoint_dir is None:
             w, shared, objective = _fit_det_srm_jit(
                 stacked, jnp.asarray(voxel_counts).astype(dtype), key,
-                features=self.features, n_iter=self.n_iter)
+                features=self.features, n_iter=self.n_iter,
+                mesh=self.mesh)
         else:
             w, shared, objective = self._fit_checkpointed(
                 stacked, voxel_counts, key, dtype, data_digest,
@@ -656,7 +688,8 @@ class DetSRM(_SRMBase):
                       "shared": fetch_replicated(shared0, self.mesh)}
 
         run_chunk, final_leaves = make_device_carry_chunk(
-            lambda dev, n: _det_chunk(stacked, *dev, n_steps=n),
+            lambda dev, n: _det_chunk(stacked, *dev, n_steps=n,
+                                      mesh=self.mesh),
             ("w", "shared"),
             fetch=lambda v: fetch_replicated(v, self.mesh),
             dtype=dtype)

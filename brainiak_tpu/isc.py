@@ -366,12 +366,14 @@ def isfc(data, targets=None, pairwise=False, summary_statistic=None,
     series (pairwise); optionally against a separate ``targets`` array.
 
     mesh : optional :class:`jax.sharding.Mesh` with a ``voxel`` axis — the
-        leave-one-out V×V matrices are then computed by
-        :func:`brainiak_tpu.ops.ring.ring_correlation` with the voxel axis
-        sharded around the ring (O(V/n) per-device memory), for voxel
-        counts too large to replicate per device.  Requires > 2 subjects,
-        leave-one-out mode, targets with the same voxel count as data, and
-        the post-NaN-threshold voxel count divisible by the mesh axis.
+        leave-one-out V×V matrices are then computed by the SUMMA ring
+        (:func:`brainiak_tpu.ops.distla.summa_gram`, the pod-scale
+        primitive :func:`brainiak_tpu.ops.ring.ring_correlation` is also
+        built on) with the voxel axis sharded around the ring (O(V/n)
+        per-device memory), for voxel counts too large to replicate per
+        device.  Requires > 2 subjects, leave-one-out mode, targets with
+        the same voxel count as data, and the post-NaN-threshold voxel
+        count divisible by the mesh axis.
     """
     data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
     targets, t_n_TRs, t_n_voxels, _, symmetric = (
@@ -398,7 +400,7 @@ def isfc(data, targets=None, pairwise=False, summary_statistic=None,
         isfcs = np.asarray(_isfc_pairwise_core(
             jnp.asarray(data), jnp.asarray(iu[0]), jnp.asarray(iu[1])))
     elif mesh is not None:
-        from .ops.ring import ring_correlation
+        from .ops.distla import summa_gram
         if data.shape[1] != targets.shape[1]:
             raise ValueError("mesh-sharded ISFC requires targets with the "
                              "same voxel count as data")
@@ -414,8 +416,13 @@ def isfc(data, targets=None, pairwise=False, summary_statistic=None,
         data_j = jnp.asarray(data)
         per_subj = []
         for s in range(n_subjects):
-            m = _fetch_ring_matrix(ring_correlation(
-                data_j[..., s], mesh, data_b=target_means[..., s]),
+            # the slab product itself is the distla SUMMA primitive:
+            # one nearest-neighbor ring over the voxel axis, row-
+            # sharded output that _fetch_ring_matrix assembles slab
+            # by slab without ever replicating [V, V] on a device
+            m = _fetch_ring_matrix(summa_gram(
+                data_j[..., s], mesh, data_b=target_means[..., s],
+                axis_names=(DEFAULT_VOXEL_AXIS,)),
                 mesh)
             per_subj.append((m + m.T) / 2 if symmetric else m)
         isfcs = np.stack(per_subj, axis=2)

@@ -24,9 +24,12 @@ The fresh sample is ``--fresh FILE`` (or ``-`` for stdin, i.e. piped
 straight from ``python bench.py``); without it, the newest history
 record of each tier gates against the records before it — the mode
 the ``regress`` gate of ``tools/run_checks.py`` runs on the committed
-fixture.  The verdict is machine-readable (``--format=json``) and the
-exit status is the gate: 0 pass, 1 regression (the offending metric
-is named in the message), 2 no usable records.
+fixture.  ``--only TIER[,TIER]`` restricts gating to the named tier
+families (``--only distla`` covers ``distla`` and
+``distla_cpu_fallback``).  The verdict is machine-readable
+(``--format=json``) and the exit status is the gate: 0 pass,
+1 regression (the offending metric is named in the message), 2 no
+usable records (or nothing in the ``--only`` selection).
 
 Record trust: every candidate must pass
 :func:`brainiak_tpu.obs.report.validate_bench_record` (which checks
@@ -43,7 +46,7 @@ import sys
 from .report import validate_bench_record
 
 __all__ = ["DEFAULT_MIN_HISTORY", "DEFAULT_THRESHOLD", "evaluate",
-           "load_bench_records", "main", "tier_of"]
+           "load_bench_records", "main", "tier_of", "tier_selected"]
 
 DEFAULT_THRESHOLD = 0.7
 DEFAULT_MIN_HISTORY = 2
@@ -167,25 +170,42 @@ def load_bench_records(paths):
     return records, skipped
 
 
+def tier_selected(tier, only):
+    """Whether ``tier`` is covered by an ``--only`` family selector:
+    exact match or a ``_``-separated extension, so ``distla`` selects
+    both ``distla`` and ``distla_cpu_fallback`` — one family, two
+    backends — without ever conflating unrelated tiers."""
+    if only is None:
+        return True
+    return any(tier == fam or tier.startswith(fam + "_")
+               for fam in only)
+
+
 def evaluate(history, fresh=None, threshold=DEFAULT_THRESHOLD,
-             min_history=DEFAULT_MIN_HISTORY):
+             min_history=DEFAULT_MIN_HISTORY, only=None):
     """Regression checks per (metric family, tier) group.
 
     ``history``/``fresh`` are record lists from
     :func:`load_bench_records`; with ``fresh=None`` each group's
     chronologically newest history record is the sample under test.
-    Returns ``{"verdict": "pass"|"fail"|"skip", "checks": [...]}``
-    where each check carries the group's key, values, ratio, and a
-    ``status`` of ``ok`` / ``regression`` / ``insufficient_history``.
-    Higher values are better (the bench metrics are throughputs).
+    ``only`` restricts gating to the named tier families
+    (:func:`tier_selected`).  Returns ``{"verdict": "pass"|"fail"|
+    "skip", "checks": [...]}`` where each check carries the group's
+    key, values, ratio, and a ``status`` of ``ok`` / ``regression`` /
+    ``insufficient_history``.  Higher values are better (the bench
+    metrics are throughputs).
     """
     groups = {}
     for rec in history:
+        if not tier_selected(tier_of(rec), only):
+            continue
         groups.setdefault((_base_metric(rec), tier_of(rec)),
                           []).append(rec)
     fresh_by_group = {}
     if fresh:
         for rec in fresh:
+            if not tier_selected(tier_of(rec), only):
+                continue
             fresh_by_group.setdefault(
                 (_base_metric(rec), tier_of(rec)), []).append(rec)
     # an explicit fresh run gates ONLY the tiers it produced (a
@@ -281,9 +301,16 @@ def main(argv=None):
                              "(default %(default)s)")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
+    parser.add_argument(
+        "--only", metavar="TIER[,TIER...]",
+        help="gate only these tier families (a family selects its "
+             "backend variants too: 'distla' covers distla and "
+             "distla_cpu_fallback)")
     args = parser.parse_args(argv)
     if not 0.0 < args.threshold <= 1.0:
         parser.error("--threshold must be in (0, 1]")
+    only = ([t.strip() for t in args.only.split(",") if t.strip()]
+            if args.only else None)
 
     history, skipped = load_bench_records(args.history)
     fresh = None
@@ -304,7 +331,11 @@ def main(argv=None):
         return 2
 
     result = evaluate(history, fresh, threshold=args.threshold,
-                      min_history=args.min_history)
+                      min_history=args.min_history, only=only)
+    if only and not result["checks"]:
+        print("obs regress: no records in tier(s) "
+              + ", ".join(only), file=sys.stderr)
+        return 2
     if args.format == "json":
         result["skipped"] = skipped
         print(json.dumps(result, indent=2))

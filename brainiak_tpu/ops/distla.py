@@ -1,0 +1,571 @@
+"""Pod-scale distributed linear algebra: SUMMA-sharded Grams and
+sharded batched solves.
+
+The scaling wall this layer removes (ROADMAP open item 2): the seed's
+FCMA Gram and ISC slab loops replicate the "all voxels" operand on
+every device and reduce on one chip, so a whole-brain (~50k-voxel)
+[V, V] correlation matrix is only reachable by subsampling — the same
+wall the reference package hit with MPI.  Following "Large Scale
+Distributed Linear Algebra With Tensor Processing Units"
+(https://arxiv.org/pdf/2112.09017), the answer is SUMMA-style panel
+matmul on the device mesh: every operand panel and every output block
+stays sharded, panels move between nearest neighbors over ICI
+(``lax.ppermute``), and per-device memory is O(V/n) for the inputs
+and O(V²/n) for the output.
+
+Three compute primitives, one decomposition family:
+
+- :func:`summa_gram` / :func:`summa_matmul` — the fused ring program
+  (the :mod:`brainiak_tpu.ops.ring` pattern generalized): both
+  operands column-sharded over one or more mesh axes (a 2-D
+  ``('subject', 'voxel')`` mesh flattens into one ring, so the whole
+  pod participates), output row-sharded, one ``lax.scan`` of
+  matmul+ppermute steps.
+- :func:`panel_gram` — the checkpointable variant: row panels are
+  driven from the host through
+  :func:`~brainiak_tpu.resilience.guards.run_resilient_loop`, so a
+  preemption mid-Gram resumes at the last completed panel instead of
+  recomputing hours of matmul.
+- :func:`block_gram` — the FCMA contraction: a small replicated voxel
+  block against the voxel-sharded "all voxels" operand, partial Grams
+  reduced with one ``psum`` — the SUMMA inner reduction, used when
+  replicating the full data exceeds :func:`replicated_budget_bytes`.
+
+Plus the sharded batched small-matrix helpers SRM-family E-steps need
+(https://arxiv.org/pdf/1608.04647): :func:`batched_eigh` and
+:func:`batched_cholesky_solve` lay the per-subject solves out along
+the mesh's subject axis via ``shard_map`` (:func:`shard_vmap`)
+instead of relying on GSPMD to partition a ``vmap``-ed
+decomposition.
+
+Telemetry: every program builder is a
+:func:`~brainiak_tpu.obs.runtime.counted_cache` under a ``distla.*``
+site and its program is wrapped by
+:func:`~brainiak_tpu.obs.profile.profile_program`, so retrace counts,
+cost records (FLOPs/bytes), and span durations join in ``obs
+report`` for achieved-FLOP/s per primitive.
+"""
+
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..obs import profile as obs_profile
+from ..obs import runtime as obs_runtime
+from ..obs import spans as obs_spans
+from ..parallel.compat import shard_map
+from ..parallel.mesh import (DEFAULT_SUBJECT_AXIS, DEFAULT_VOXEL_AXIS,
+                             place_on_mesh)
+from .correlation import resolve_precision
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "BUDGET_ENV",
+    "DEFAULT_REPLICATED_BUDGET",
+    "batched_cholesky_solve",
+    "batched_eigh",
+    "block_gram",
+    "gram",
+    "panel_gram",
+    "replicated_budget_bytes",
+    "selfcheck",
+    "shard_vmap",
+    "summa_gram",
+    "summa_matmul",
+]
+
+#: Env override for the per-device replicated-operand budget.
+BUDGET_ENV = "BRAINIAK_TPU_DISTLA_BUDGET_BYTES"
+
+#: Default per-device budget for REPLICATING an operand (bytes).
+#: Half a v5e chip's 16 GiB HBM: beyond this, callers should shard
+#: the operand and pay collectives instead of replication.
+DEFAULT_REPLICATED_BUDGET = 8 << 30
+
+
+def replicated_budget_bytes():
+    """The per-device byte budget above which an operand should be
+    sharded rather than replicated (``BRAINIAK_TPU_DISTLA_BUDGET_BYTES``
+    overrides the 8 GiB default)."""
+    env = os.environ.get(BUDGET_ENV)
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            logger.warning("ignoring unparseable %s=%r", BUDGET_ENV, env)
+    return DEFAULT_REPLICATED_BUDGET
+
+
+def _zscore_cols(data):
+    """Column z-score + 1/sqrt(T), zero for constant columns (matching
+    compute_correlation) and NaN for NaN-containing columns (so missing
+    data propagates instead of fabricating finite correlations), making
+    a plain dot of two normalized columns their Pearson r.  Zero-pad
+    columns come out zero (std 0), so padded Grams carry exact zeros in
+    the pad rows/columns."""
+    t = data.shape[0]
+    mean = data.mean(axis=0, keepdims=True)
+    std = data.std(axis=0, keepdims=True)
+    safe_std = jnp.where(std > 0, std, 1.0)
+    z = jnp.where(std > 0, (data - mean) / (safe_std * np.sqrt(t)), 0.0)
+    return jnp.where(jnp.isnan(std), jnp.nan, z)
+
+
+def _ring_axes(mesh, axis_names):
+    """Normalize the SUMMA ring axes: ``None`` means every axis of the
+    mesh (a 2-D ``('subject', 'voxel')`` mesh becomes one flattened
+    ring over the full device grid).  Returns (names tuple, the
+    ppermute axis argument, ring size)."""
+    names = tuple(mesh.axis_names) if axis_names is None \
+        else tuple(axis_names)
+    missing = [a for a in names if a not in mesh.shape]
+    if not names or missing:
+        raise ValueError(
+            f"ring axes {names} not all present in mesh axes "
+            f"{tuple(mesh.axis_names)}")
+    size = int(np.prod([mesh.shape[a] for a in names]))
+    axis = names if len(names) > 1 else names[0]
+    return names, axis, size
+
+
+@obs_runtime.counted_cache("distla.summa")
+def _summa_program(mesh, axis_names, precision):
+    """Build (once per mesh/axes/precision) the fused SUMMA ring
+    program: both operands column-sharded over the flattened ring,
+    panels rotated with nearest-neighbor ``ppermute``, output
+    row-sharded.  Cache misses count as
+    ``retrace_total{site=distla.summa}``; under cost profiling the
+    program's first run captures a ``cost`` record joined to
+    ``distla.gram`` span durations by the report CLI."""
+    names, axis, n_shards = _ring_axes(mesh, axis_names)
+    prec = resolve_precision(precision)
+
+    def summa_fn(z_local, zb_local):
+        # z_local stays resident; zb panels visit around the ring
+        my_idx = jax.lax.axis_index(axis)
+        block_cols = zb_local.shape[1]
+
+        def step(rotating, _):
+            # output block: rows (resident cols) x cols (the panel
+            # currently held)
+            block = jax.lax.dot_general(
+                z_local, rotating, (((0,), (0,)), ((), ())),
+                precision=prec,
+                preferred_element_type=z_local.dtype)
+            # hand the visiting panel to the next device on the ring
+            rotating = jax.lax.ppermute(
+                rotating, axis,
+                [(i, (i + 1) % n_shards) for i in range(n_shards)])
+            return rotating, block
+
+        _, blocks = jax.lax.scan(step, zb_local, None, length=n_shards)
+        # blocks[s] holds out[local, owner] where the owner of the
+        # panel seen at step s is (my_idx - s) mod n_shards
+        owners = (my_idx - jnp.arange(n_shards)) % n_shards
+        out = jnp.zeros((z_local.shape[1], n_shards, block_cols),
+                        dtype=z_local.dtype)
+        out = out.at[:, owners, :].set(
+            jnp.transpose(blocks, (1, 0, 2)))
+        return out.reshape(z_local.shape[1], n_shards * block_cols)
+
+    spec = PartitionSpec(None, axis)
+    return obs_profile.profile_program(jax.jit(shard_map(
+        summa_fn, mesh, in_specs=(spec, spec),
+        out_specs=PartitionSpec(axis, None))),
+        "distla.summa", span="distla.gram")
+
+
+def _pad_cols(arr, multiple):
+    """Zero-pad the last axis of a host array up to ``multiple``."""
+    pad = (-arr.shape[-1]) % multiple
+    if not pad:
+        return np.asarray(arr), 0
+    widths = [(0, 0)] * arr.ndim
+    widths[-1] = (0, pad)
+    return np.pad(np.asarray(arr), widths), pad
+
+
+def summa_matmul(a, mesh, b=None, axis_names=None, precision=None):
+    """``C = aᵀ @ b`` with both operands column-sharded around the
+    mesh ring — the raw SUMMA primitive.
+
+    a, b : [T, V] arrays (``b`` defaults to ``a``); the voxel axis is
+        zero-padded up to the ring size, so uneven panel splits are
+        handled (pad rows/cols of C are exact zeros and are sliced
+        off).
+    mesh : :class:`jax.sharding.Mesh`; ``axis_names`` selects the
+        ring axes (default: ALL mesh axes, flattened row-major — on
+        the standard ``('subject', 'voxel')`` mesh the whole device
+        grid forms one ring).
+    Returns C [V, V] (row-sharded over the ring when V divides it).
+    """
+    names, _, n_shards = _ring_axes(mesh, axis_names)
+    v = a.shape[1]
+    if b is not None and b.shape != a.shape:
+        raise ValueError(
+            f"operand shapes differ: {a.shape} vs {b.shape}")
+    a_p, pad = _pad_cols(a, n_shards)
+    spec = NamedSharding(
+        mesh, PartitionSpec(None, names if len(names) > 1 else names[0]))
+    za = place_on_mesh(a_p, spec)
+    zb = za if b is None else place_on_mesh(_pad_cols(b, n_shards)[0],
+                                            spec)
+    out = _summa_program(mesh, names, resolve_precision(precision))(
+        za, zb)
+    return out[:v, :v] if pad else out
+
+
+def summa_gram(data, mesh, data_b=None, axis_names=None,
+               precision=None):
+    """All-pairs Pearson correlation of the columns of ``data``
+    (against ``data_b`` when given) computed as a SUMMA ring over the
+    mesh — O(V/n) per-device input memory, O(V²/n) output, only
+    nearest-neighbor traffic.
+
+    Column z-scoring runs shard-local after placement (the full
+    [T, V] array is never resident on one device); NaN columns
+    propagate NaN rows/columns (see :func:`_zscore_cols`).  For data
+    small enough to replicate, prefer :func:`gram` which dispatches
+    on the budget.
+    """
+    names, _, n_shards = _ring_axes(mesh, axis_names)
+    v = data.shape[1]
+    if data_b is not None and data_b.shape != data.shape:
+        raise ValueError(
+            f"data_b shape {data_b.shape} != data shape {data.shape}")
+    with obs_spans.span("distla.gram",
+                        attrs={"n_voxels": int(v),
+                               "n_shards": int(n_shards),
+                               "kind": "summa"}):
+        spec = NamedSharding(
+            mesh,
+            PartitionSpec(None, names if len(names) > 1 else names[0]))
+        # shard FIRST, z-score after: z-scoring is columnwise, so it
+        # runs shard-local and the full array never lands on one chip
+        z = _zscore_cols(place_on_mesh(_pad_cols(data, n_shards)[0],
+                                       spec))
+        z_b = z if data_b is None else _zscore_cols(
+            place_on_mesh(_pad_cols(data_b, n_shards)[0], spec))
+        out = _summa_program(mesh, names, resolve_precision(precision))(
+            z, z_b)
+    return out[:v, :v] if v % n_shards else out
+
+
+def gram(data, mesh=None, data_b=None, axis_names=None, precision=None,
+         budget_bytes=None, force=None):
+    """Pearson Gram with budget-based dispatch.
+
+    Small problems run the replicated einsum (no collectives); when
+    the replicated working set — the [T, V] operands plus the [V, V]
+    output on every device — exceeds ``budget_bytes`` (default
+    :func:`replicated_budget_bytes`) and a mesh is available, the
+    SUMMA ring computes the same result with O(1/n) per-device
+    memory.  ``force='replicated'`` raises instead of silently
+    exceeding the budget; ``force='summa'`` always takes the ring.
+    """
+    if force not in (None, "replicated", "summa"):
+        raise ValueError(
+            f"force must be None, 'replicated' or 'summa'; got "
+            f"{force!r}")
+    # one contract on every branch: without this, a mismatched
+    # cross-Gram would silently matmul on the replicated path and
+    # start raising only once the data grew past the budget
+    if data_b is not None and data_b.shape != data.shape:
+        raise ValueError(
+            f"data_b shape {data_b.shape} != data shape {data.shape}")
+    v = data.shape[1]
+    # .dtype, never np.asarray: a device-resident operand must not be
+    # gathered to host just to read its itemsize on the very dispatch
+    # path that exists to avoid oversized transfers
+    dtype = getattr(data, "dtype", None)
+    itemsize = np.dtype(dtype).itemsize if dtype is not None \
+        else np.asarray(data).dtype.itemsize
+    need = (2 if data_b is not None else 1) * data.size * itemsize \
+        + v * v * itemsize
+    budget = replicated_budget_bytes() if budget_bytes is None \
+        else int(budget_bytes)
+    over = need > budget
+    if force == "replicated":
+        if over:
+            raise ValueError(
+                f"replicated Gram needs ~{need} bytes per device, "
+                f"over the {budget}-byte budget; use the SUMMA path "
+                "(pass a mesh) or raise the budget")
+        use_summa = False
+    else:
+        use_summa = force == "summa" or (over and mesh is not None)
+    if use_summa:
+        if mesh is None:
+            raise ValueError("the SUMMA path needs a mesh")
+        return summa_gram(data, mesh, data_b=data_b,
+                          axis_names=axis_names, precision=precision)
+    if over:
+        logger.warning(
+            "replicated Gram working set (~%d bytes) exceeds the "
+            "%d-byte budget and no mesh was given; computing "
+            "replicated anyway", need, budget)
+    with obs_spans.span("distla.gram",
+                        attrs={"n_voxels": int(v), "n_shards": 1,
+                               "kind": "replicated"}):
+        z = _zscore_cols(jnp.asarray(data))
+        z_b = z if data_b is None else _zscore_cols(jnp.asarray(data_b))
+        return jnp.matmul(z.T, z_b,
+                          precision=resolve_precision(precision),
+                          preferred_element_type=z.dtype)
+
+
+# -- checkpointable panel Gram ---------------------------------------
+
+@obs_runtime.counted_cache("distla.panel")
+def _panel_program(mesh, axis_name, precision):
+    """Row-panel product, cached per (mesh, axis, precision): a small
+    replicated z-scored panel against the column-sharded operand,
+    output gathered replicated (one all-gather of [panel, V/n]
+    partials).  Cache misses count as
+    ``retrace_total{site=distla.panel}``."""
+    prec = resolve_precision(precision)
+    return obs_profile.profile_program(jax.jit(
+        lambda zp, z: jnp.einsum('tp,tv->pv', zp, z, precision=prec,
+                                 preferred_element_type=zp.dtype),
+        out_shardings=NamedSharding(mesh, PartitionSpec())),
+        "distla.panel", span="distla.panel_chunk")
+
+
+def panel_gram(data, mesh, data_b=None, axis_name=DEFAULT_VOXEL_AXIS,
+               panel_size=None, checkpoint_dir=None,
+               checkpoint_every=1, precision=None,
+               name="distla.panel_gram"):
+    """Pearson Gram computed panel-by-panel under the resilient-loop
+    driver — the checkpointable SUMMA variant.
+
+    The column-sharded operand stays device-resident for the whole
+    loop; each step z-scores one host row panel, multiplies it
+    against the sharded operand, and lands the finished [panel, V]
+    rows in host state.  With ``checkpoint_dir`` the accumulated rows
+    are persisted every ``checkpoint_every`` panels and a preempted
+    run resumes at the last completed panel (the mid-Gram resume the
+    fused ring cannot offer).  Returns the full [V, V] host array.
+
+    panel_size : rows per step (default: one shard width,
+        ``V_padded / n_shards``).
+    """
+    from ..resilience.guards import array_digest, run_resilient_loop
+
+    n_shards = mesh.shape[axis_name]
+    data = np.asarray(data)
+    data_b = data if data_b is None else np.asarray(data_b)
+    if data_b.shape != data.shape:
+        raise ValueError(
+            f"data_b shape {data_b.shape} != data shape {data.shape}")
+    t, v = data.shape
+    padded, _ = _pad_cols(data_b, n_shards)
+    if panel_size is None:
+        panel_size = max(1, padded.shape[1] // n_shards)
+    n_panels = -(-v // panel_size)
+    dtype = data.dtype if data.dtype.kind == "f" else np.float32
+
+    z_b = _zscore_cols(place_on_mesh(
+        padded, NamedSharding(mesh, PartitionSpec(None, axis_name))))
+    program = _panel_program(mesh, axis_name,
+                             resolve_precision(precision))
+
+    fingerprint = None
+    if checkpoint_dir is not None:
+        # data_b participates: a resume against the same data but a
+        # different cross-correlation target must restart, not mix
+        # rows of corr(data, X) with rows of corr(data, Y)
+        fingerprint = np.array(
+            [array_digest(data), array_digest(data_b), float(t),
+             float(v), float(panel_size), float(n_shards)])
+
+    def run_chunk(state, step, n_steps):
+        # copy-on-write: run_resilient_loop keeps the previous state
+        # as the rollback target, so the accumulator must not be
+        # mutated in place.  Host syncs are the POINT of this loop
+        # (finished rows must land in host state to be
+        # checkpointable); the fused ring (summa_gram) is the
+        # no-sync path.
+        out = np.array(state["out"], copy=True)  # jaxlint: disable=JX002
+        for p in range(step, step + n_steps):
+            start = p * panel_size
+            stop = min(start + panel_size, v)
+            panel = np.zeros((t, panel_size), dtype=dtype)
+            panel[:, :stop - start] = data[:, start:stop]
+            with obs_spans.span("distla.panel_chunk",
+                                attrs={"panel": p,
+                                       "rows": stop - start}):
+                rows = np.asarray(  # jaxlint: disable=JX002
+                    program(_zscore_cols(jnp.asarray(panel)), z_b))
+            out[start:stop, :] = rows[:stop - start, :v]
+        return {"out": out}, False
+
+    # guard_skip: NaN rows are the documented propagation semantics
+    # for NaN voxels, not divergence — the driver is used here for
+    # checkpoint/resume, not the non-finite guard
+    state, _ = run_resilient_loop(
+        run_chunk, {"out": np.zeros((v, v), dtype=dtype)}, n_panels,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        fingerprint=fingerprint,
+        template={"out": np.zeros((v, v), dtype=dtype)},
+        name=name, guard_skip=("out",))
+    return state["out"]
+
+
+# -- FCMA block x all-voxel contraction ------------------------------
+
+@obs_runtime.counted_cache("distla.block_gram")
+def _block_gram_program(mesh, axis_name, epochs_per_subj, precision):
+    """FCMA per-voxel Gram with the "all voxels" operand SHARDED over
+    the mesh's voxel axis (the replicated-data-budget escape hatch):
+    each device correlates the small replicated block against its
+    resident voxel shard, normalizes locally (Fisher-z within-subject
+    normalization is voxel-local), accumulates a partial Gram, and
+    one ``psum`` completes the contraction — SUMMA's inner reduction.
+    Cache misses count as ``retrace_total{site=distla.block_gram}``.
+    """
+    from .fisherz import within_subject_normalization
+
+    prec = resolve_precision(precision)
+
+    def fn(blk, data2_local):
+        corr = jnp.einsum('etb,etv->bev', blk, data2_local,
+                          precision=prec,
+                          preferred_element_type=jnp.float32)
+        corr = within_subject_normalization(corr, epochs_per_subj)
+        part = jnp.einsum('bev,bfv->bef', corr, corr, precision=prec,
+                          preferred_element_type=jnp.float32)
+        return jax.lax.psum(part, axis_name)
+
+    return obs_profile.profile_program(jax.jit(shard_map(
+        fn, mesh,
+        in_specs=(PartitionSpec(),
+                  PartitionSpec(None, None, axis_name)),
+        out_specs=PartitionSpec())),
+        "distla.block_gram", span="fcma.block")
+
+
+def block_gram(blk, data2, mesh, epochs_per_subj,
+               axis_name=DEFAULT_VOXEL_AXIS, precision=None):
+    """Per-voxel SVM Gram of a replicated voxel block against
+    voxel-sharded epoch data (see :func:`_block_gram_program`).
+
+    blk : [E, T, B] replicated block; data2 : [E, T, V] sharded over
+    ``axis_name`` (V padded to the axis size; zero pad columns
+    normalize to zero and contribute nothing to the Gram).  Returns
+    kernels [B, E, E] replicated (unshrunk — FCMA's magnitude shrink
+    is applied by the caller).
+    """
+    return _block_gram_program(mesh, axis_name, int(epochs_per_subj),
+                               resolve_precision(precision))(blk, data2)
+
+
+# -- sharded batched small-matrix solves -----------------------------
+
+def shard_vmap(fn, mesh, axis_name, n_batch):
+    """``vmap(fn)`` with the leading batch axis laid out along the
+    mesh's ``axis_name`` via ``shard_map`` (each device runs the vmap
+    over its resident batch slice), falling back to a plain ``vmap``
+    when there is no mesh, the axis is absent or trivial, or the
+    batch does not divide it.  Composable inside jitted programs
+    (SRM's EM chunks call it per W-update)."""
+    mapped = jax.vmap(fn)
+    if mesh is None or axis_name not in getattr(mesh, "shape", {}) \
+            or mesh.shape[axis_name] <= 1 \
+            or n_batch % mesh.shape[axis_name]:
+        return mapped
+    return shard_map(mapped, mesh,
+                     in_specs=PartitionSpec(axis_name),
+                     out_specs=PartitionSpec(axis_name))
+
+
+def batched_eigh(mats, mesh=None, axis_name=DEFAULT_SUBJECT_AXIS):
+    """Eigendecomposition of a batch of symmetric matrices [S, K, K],
+    the batch sharded over the mesh's subject axis when possible —
+    the per-subject solve layout SRM's E-step W updates run on
+    (batched small eigh under plain GSPMD lowers to long sequential
+    loops on some backends).  Returns ``(eigenvalues [S, K],
+    eigenvectors [S, K, K])``."""
+    return shard_vmap(jnp.linalg.eigh, mesh, axis_name,
+                      mats.shape[0])(mats)
+
+
+def batched_cholesky_solve(mats, rhs, mesh=None,
+                           axis_name=DEFAULT_SUBJECT_AXIS):
+    """Solve ``mats[i] @ x[i] = rhs[i]`` for a batch of SPD matrices
+    [S, K, K] against [S, K, M] right-hand sides via per-subject
+    Cholesky, sharded over the mesh's subject axis when possible —
+    the per-subject covariance-solve layout for subject-parallel
+    estimators."""
+    def solve(a, b):
+        return jax.scipy.linalg.cho_solve(
+            jax.scipy.linalg.cho_factor(a), b)
+
+    return shard_vmap(solve, mesh, axis_name, mats.shape[0])(mats, rhs)
+
+
+# -- CI selfcheck (tools/run_checks.py `distla` gate) ----------------
+
+def selfcheck(out=None):
+    """Smoke the layer on a tiny fixture for the ``distla`` CI gate
+    (DLA001): SUMMA parity against a NumPy reference, sharded batched
+    solves parity, and retrace stability (repeat calls must not
+    rebuild programs — every ``distla.*`` site stays at one trace).
+    Prints a JSON verdict; returns 0 on pass, 1 on failure."""
+    import json
+    import sys
+
+    from ..obs import metrics as obs_metrics
+    from ..parallel.mesh import make_mesh, max_divisible_shards
+
+    stream = out or sys.stdout
+    rng = np.random.RandomState(0)
+    t, v = 16, 64
+    data = rng.randn(t, v).astype(np.float32)
+    z = (data - data.mean(0)) / (data.std(0) * np.sqrt(t))
+    dense = z.T @ z
+
+    n = max_divisible_shards(v)
+    mesh = make_mesh((DEFAULT_VOXEL_AXIS,), (n,))
+    errs = []
+    for _ in range(2):  # second call must hit every program cache
+        got = np.asarray(summa_gram(data, mesh))
+        errs.append(float(np.max(np.abs(got - dense))))
+        got_u = np.asarray(summa_gram(data[:, :v - n + 1], mesh))
+        errs.append(float(np.max(np.abs(
+            got_u - dense[:v - n + 1, :v - n + 1]))))
+        errs.append(float(np.max(np.abs(
+            panel_gram(data, mesh) - dense))))
+
+    s, k = 8, 5
+    base = rng.randn(s, k, k)
+    spd = base @ np.transpose(base, (0, 2, 1)) + 3 * np.eye(k)
+    rhs = rng.randn(s, k, 2)
+    smesh = make_mesh((DEFAULT_SUBJECT_AXIS,),
+                      (max_divisible_shards(s),))
+    solved = np.asarray(batched_cholesky_solve(
+        jnp.asarray(spd), jnp.asarray(rhs), mesh=smesh))
+    errs.append(float(np.max(np.abs(
+        solved - np.linalg.solve(spd, rhs)))))
+    w, q = batched_eigh(jnp.asarray(spd), mesh=smesh)
+    recon = np.asarray(
+        jnp.einsum('sik,sk,sjk->sij', q, w, q))
+    errs.append(float(np.max(np.abs(recon - spd))))
+
+    retrace = obs_metrics.counter("retrace_total")
+    sites = {site: retrace.value(site=site)
+             for site in ("distla.summa", "distla.panel",
+                          "distla.block_gram")
+             if retrace.value(site=site)}
+    tol = 5e-4
+    ok = max(errs) < tol and all(c <= 1.0 for c in sites.values()) \
+        and {"distla.summa", "distla.panel"} <= set(sites)
+    json.dump({"ok": bool(ok), "max_err": max(errs), "tol": tol,
+               "n_shards": int(n), "retraces": sites}, stream)
+    stream.write("\n")
+    return 0 if ok else 1

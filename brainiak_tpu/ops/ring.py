@@ -11,76 +11,22 @@ of the correlation matrix has been produced with only nearest-neighbor
 ICI traffic and O(V/n) memory per device, never materializing the full
 data anywhere.
 
+The ring program itself now lives in the pod-scale linear algebra layer
+(:mod:`brainiak_tpu.ops.distla`) as the general SUMMA primitive — this
+module is the stable single-axis entry point the ISC/ISFC slab loop and
+RSA callers use; :func:`brainiak_tpu.ops.distla.summa_gram` additionally
+rides multi-axis (2-D mesh) rings, uneven panel splits, and the
+checkpointable :func:`~brainiak_tpu.ops.distla.panel_gram` variant.
+
 For data that fits replicated, prefer the plain einsum
-(:func:`brainiak_tpu.ops.correlation.correlate_epochs`); the ring pays
+(:func:`brainiak_tpu.ops.correlation.correlate_epochs`) or the
+budget-dispatching :func:`brainiak_tpu.ops.distla.gram`; the ring pays
 communication to buy memory.
 """
 
-import functools
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec
-from jax import shard_map
-
-from ..parallel.mesh import place_on_mesh
-from .correlation import PRECISION
+from .distla import summa_gram
 
 __all__ = ["ring_correlation"]
-
-
-def _zscore_cols(data):
-    """Column z-score + 1/sqrt(T), zero for constant columns (matching
-    compute_correlation) and NaN for NaN-containing columns (so missing
-    data propagates instead of fabricating finite correlations), making a
-    plain dot of two normalized columns their Pearson r."""
-    t = data.shape[0]
-    mean = data.mean(axis=0, keepdims=True)
-    std = data.std(axis=0, keepdims=True)
-    safe_std = jnp.where(std > 0, std, 1.0)
-    z = jnp.where(std > 0, (data - mean) / (safe_std * np.sqrt(t)), 0.0)
-    return jnp.where(jnp.isnan(std), jnp.nan, z)
-
-
-@functools.lru_cache(maxsize=None)
-def _ring_program(mesh, axis_name):
-    """Build (once per mesh/axis) the jitted ring program; jit caching
-    keeps repeated calls — e.g. per-subject ISFC — from re-tracing."""
-    n_shards = mesh.shape[axis_name]
-
-    def ring_fn(z_local, zb_local):
-        # z_local stays resident; zb shards visit around the ring
-        my_idx = jax.lax.axis_index(axis_name)
-        block_cols = zb_local.shape[1]
-
-        def step(rotating, _):
-            # block of corr rows (local) x cols (the shard currently held)
-            block = jax.lax.dot_general(
-                z_local, rotating, (((0,), (0,)), ((), ())),
-                precision=PRECISION,
-                preferred_element_type=z_local.dtype)
-            # pass the visiting shard to the next device on the ring
-            rotating = jax.lax.ppermute(
-                rotating, axis_name,
-                [(i, (i + 1) % n_shards) for i in range(n_shards)])
-            return rotating, block
-
-        _, blocks = jax.lax.scan(step, zb_local, None, length=n_shards)
-        # blocks[s] holds corr[local, owner] where the owner of the shard
-        # seen at step s is (my_idx - s) mod n_shards; scatter into place
-        owners = (my_idx - jnp.arange(n_shards)) % n_shards
-        out = jnp.zeros((z_local.shape[1], n_shards, block_cols),
-                        dtype=z_local.dtype)
-        out = out.at[:, owners, :].set(
-            jnp.transpose(blocks, (1, 0, 2)))
-        return out.reshape(z_local.shape[1], n_shards * block_cols)
-
-    return jax.jit(shard_map(
-        ring_fn, mesh=mesh,
-        in_specs=(PartitionSpec(None, axis_name),
-                  PartitionSpec(None, axis_name)),
-        out_specs=PartitionSpec(axis_name, None)))
 
 
 def ring_correlation(data, mesh, data_b=None, axis_name="voxel"):
@@ -105,11 +51,5 @@ def ring_correlation(data, mesh, data_b=None, axis_name="voxel"):
     if data_b is not None:
         assert data_b.shape == data.shape, \
             "data_b must have the same shape as data"
-
-    # shard FIRST, z-score after: the full [T, V] array is never resident
-    # on one device (z-scoring is columnwise, so it runs shard-local)
-    spec = NamedSharding(mesh, PartitionSpec(None, axis_name))
-    z = _zscore_cols(place_on_mesh(data, spec))
-    z_b = z if data_b is None else _zscore_cols(
-        place_on_mesh(data_b, spec))
-    return _ring_program(mesh, axis_name)(z, z_b)
+    return summa_gram(data, mesh, data_b=data_b,
+                      axis_names=(axis_name,))

@@ -7,6 +7,7 @@ arrays on a :class:`jax.sharding.Mesh` and letting XLA's SPMD partitioner
 insert the collectives (psum/all_gather over ICI/DCN).
 """
 
+from .compat import shard_map  # noqa: F401
 from .mesh import (  # noqa: F401
     DEFAULT_SUBJECT_AXIS,
     DEFAULT_VOXEL_AXIS,

@@ -1,0 +1,54 @@
+"""JAX API compatibility shims for the parallel layer.
+
+One import point for APIs whose location or keyword spelling moved
+across the jax releases this framework supports.  Today that is
+``shard_map``:
+
+- modern jax exports it at top level (``jax.shard_map``) and spells
+  the replication-check flag ``check_vma``;
+- the 0.4.x line keeps it in ``jax.experimental.shard_map`` and
+  spells the same flag ``check_rep``.
+
+Every ``shard_map`` use in the package goes through
+:func:`shard_map` below (ISSUE 6 satellite) — the former scattered
+``from jax import shard_map`` sites raised ``ImportError`` outright
+on 0.4.x, which is exactly the class of environment drift a single
+shim can absorb.  Call sites use the modern keyword (``check_vma``);
+the shim translates for older jax.
+"""
+
+import inspect
+
+try:  # modern jax: top-level export
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # 0.4.x line: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+__all__ = ["shard_map"]
+
+#: Keyword the underlying implementation uses for the replication /
+#: varying-manual-axes check (``check_vma`` on modern jax,
+#: ``check_rep`` before the rename).
+_CHECK_KW = None
+for _name in ("check_vma", "check_rep"):
+    try:
+        if _name in inspect.signature(_shard_map_impl).parameters:
+            _CHECK_KW = _name
+            break
+    except (TypeError, ValueError):  # pragma: no cover - exotic impl
+        break
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """Version-portable ``shard_map``.
+
+    Parameters mirror ``jax.shard_map``; ``check_vma`` (the modern
+    spelling; ``None`` keeps the implementation default) is
+    translated to ``check_rep`` on jax versions that predate the
+    rename.  Positional layout is the one both generations accept.
+    """
+    kwargs = {}
+    if check_vma is not None and _CHECK_KW is not None:
+        kwargs[_CHECK_KW] = check_vma
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kwargs)

@@ -100,3 +100,29 @@ def test_serve_tier_record_matches_obs_schema(monkeypatch):
     assert rec["unit"] == "requests/sec"
     assert rec["metric"] == "serve_srm_transform_requests_per_sec"
     assert rec["config"]["n_buckets"] == out["n_buckets"]
+
+
+def test_distla_tier_record_matches_obs_schema(monkeypatch):
+    """The distla tier (ISSUE 6 satellite): a tiny in-process run
+    emits a schema-valid bench record with the backend-split tier,
+    so `obs regress --only distla` gates SUMMA-Gram throughput
+    alongside fit and serving throughput."""
+    monkeypatch.setenv("BENCH_DISTLA_VOXELS", "256")
+    out = bench.measure_tier("distla")
+    assert out["voxels_per_sec"] > 0
+    assert out["n_voxels"] == 256
+    assert out["n_shards"] >= 1
+    stages = out["stages"]
+    assert set(bench.STAGE_KEYS) <= set(stages)
+    assert stages["steady_s"] > 0
+
+    rec = bench._distla_result_record(out)
+    assert obs.validate_bench_record(rec) == []
+    # in-process run on the CPU test backend -> the fallback tier
+    # (tier separation mirrors the fcma/serve tiers)
+    assert rec["tier"] == "distla_cpu_fallback"
+    assert rec["unit"] == "voxels/sec"
+    assert rec["metric"] == "distla_summa_gram_voxels_per_sec"
+    assert rec["config"]["n_voxels"] == 256
+    assert rec["config"]["n_shards"] == out["n_shards"]
+    assert rec["vs_baseline"] > 0

@@ -40,23 +40,62 @@ def test_tier_inference():
 def test_fixture_history_passes_and_gates():
     records, skipped = regress.load_bench_records([FIXTURE_DIR])
     # the real r01-r05 fcma trajectory + the serve_r01-r03 tier
-    # (PR 5, measured host-side -> serve_cpu_fallback): two tiers
-    # gating independently from one directory
-    assert len(records) == 8
+    # (PR 5) + the distla_r01-r03 tier (ISSUE 6), both measured
+    # host-side -> *_cpu_fallback: three tiers gating independently
+    # from one directory
+    assert len(records) == 11
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
-    assert tiers == {"cpu_fallback", "serve_cpu_fallback"}
+    assert tiers == {"cpu_fallback", "serve_cpu_fallback",
+                     "distla_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
     by_tier = {c["tier"]: c for c in result["checks"]}
-    assert set(by_tier) == {"cpu_fallback", "serve_cpu_fallback"}
+    assert set(by_tier) == {"cpu_fallback", "serve_cpu_fallback",
+                            "distla_cpu_fallback"}
     assert by_tier["cpu_fallback"]["status"] == "ok"
     assert by_tier["cpu_fallback"]["n_history"] == 4
     assert by_tier["serve_cpu_fallback"]["status"] == "ok"
     assert by_tier["serve_cpu_fallback"]["n_history"] == 2
     assert by_tier["serve_cpu_fallback"]["metric"] == \
         "serve_srm_transform_requests_per_sec"
+    assert by_tier["distla_cpu_fallback"]["status"] == "ok"
+    assert by_tier["distla_cpu_fallback"]["n_history"] == 2
+    assert by_tier["distla_cpu_fallback"]["metric"] == \
+        "distla_summa_gram_voxels_per_sec"
+
+
+def test_only_selects_tier_family():
+    """--only gates just the named tier family — exact tier or its
+    ``_``-separated backend variants, never an unrelated tier that
+    happens to share a prefix string."""
+    assert regress.tier_selected("distla", ["distla"])
+    assert regress.tier_selected("distla_cpu_fallback", ["distla"])
+    assert not regress.tier_selected("distlaish", ["distla"])
+    assert not regress.tier_selected("serve_cpu_fallback", ["distla"])
+    assert regress.tier_selected("anything", None)
+
+    records, _ = regress.load_bench_records([FIXTURE_DIR])
+    result = regress.evaluate(records, only=["distla"])
+    assert result["verdict"] == "pass"
+    assert [c["tier"] for c in result["checks"]] == \
+        ["distla_cpu_fallback"]
+
+
+def test_cli_only_flag(capsys):
+    """``obs regress --only distla`` gates the distla family alone
+    (ISSUE 6 acceptance) and an empty selection exits 2, not a
+    silent pass."""
+    assert regress.main(["--history", FIXTURE_DIR,
+                         "--only", "distla",
+                         "--format=json"]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["verdict"] == "pass"
+    assert [c["tier"] for c in verdict["checks"]] == \
+        ["distla_cpu_fallback"]
+    assert regress.main(["--history", FIXTURE_DIR,
+                         "--only", "nope"]) == 2
 
 
 def test_two_x_degradation_fails_with_named_metric(tmp_path,

@@ -1,0 +1,353 @@
+"""Pod-scale distributed linear algebra (ops/distla) — ISSUE 6.
+
+Numerics parity of the SUMMA ring against the replicated einsum
+(:func:`brainiak_tpu.ops.correlation.correlate_epochs`) on the
+8-device CPU mesh for even and uneven panel splits and
+NaN-propagating columns; the checkpointable panel loop's mid-Gram
+preemption resume; the budget dispatcher (a Gram whose replicated
+working set exceeds the per-device budget completes via SUMMA
+panels); the sharded batched eigh/Cholesky helpers; the SRM
+fit-parity of the sharded-batched E-step solves; and the
+``distla.*`` cost-record/span join for achieved-FLOP/s.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from brainiak_tpu import obs
+from brainiak_tpu.ops import distla
+from brainiak_tpu.parallel import make_mesh
+from tests.conftest import mesh_atol
+
+
+def _dense_corr(data):
+    """NumPy reference Pearson Gram with the layer's z-score
+    semantics (constant columns -> 0, NaN columns -> NaN)."""
+    data = np.asarray(data, dtype=float)
+    t = data.shape[0]
+    mean = data.mean(axis=0, keepdims=True)
+    std = data.std(axis=0, keepdims=True)
+    with np.errstate(invalid="ignore"):
+        z = np.where(std > 0, (data - mean)
+                     / (np.where(std > 0, std, 1.0) * np.sqrt(t)), 0.0)
+    z = np.where(np.isnan(std), np.nan, z)
+    return z.T @ z
+
+
+def test_summa_gram_matches_replicated_einsum_even_split():
+    """[V, V] SUMMA Gram == the replicated correlate_epochs einsum
+    at an even panel split (64 voxels over the 8-way ring)."""
+    from brainiak_tpu.ops.correlation import (correlate_epochs,
+                                              normalize_for_correlation)
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(20, 64)
+    mesh = make_mesh(("voxel",), (8,))
+    got = np.asarray(distla.summa_gram(data, mesh))
+    z = np.asarray(normalize_for_correlation(jnp.asarray(data), 0))
+    dense = np.asarray(
+        correlate_epochs(z.T[None], z.T[None]))[:, 0, :]
+    assert got.shape == (64, 64)
+    assert np.allclose(got, dense, atol=max(mesh_atol(), 1e-5))
+
+
+def test_summa_gram_uneven_split_and_cross():
+    """Voxel counts that do NOT divide the ring are zero-padded and
+    sliced (uneven panel split), for both the Gram and the
+    cross-correlation (data_b) form."""
+    rng = np.random.RandomState(1)
+    data = rng.randn(16, 53)  # 53 % 8 != 0
+    other = rng.randn(16, 53)
+    mesh = make_mesh(("voxel",), (8,))
+    got = np.asarray(distla.summa_gram(data, mesh))
+    assert got.shape == (53, 53)
+    assert np.allclose(got, _dense_corr(data), atol=1e-8)
+
+    cross = np.asarray(distla.summa_gram(data, mesh, data_b=other))
+    t = data.shape[0]
+
+    def _z(d):
+        return (d - d.mean(0)) / (d.std(0) * np.sqrt(t))
+
+    assert np.allclose(cross, _z(data).T @ _z(other), atol=1e-8)
+    with pytest.raises(ValueError, match="shape"):
+        distla.summa_gram(data, mesh, data_b=other[:, :20])
+
+
+def test_summa_gram_two_dimensional_mesh_ring():
+    """A 2-D ('subject', 'voxel') mesh flattens into one SUMMA ring:
+    the full 8-device grid participates and the result matches the
+    single-axis ring and the dense reference."""
+    rng = np.random.RandomState(2)
+    data = rng.randn(12, 48)
+    mesh2d = make_mesh(("subject", "voxel"), (2, 4))
+    got = np.asarray(distla.summa_gram(data, mesh2d))
+    assert np.allclose(got, _dense_corr(data), atol=1e-8)
+    # explicit axis subset: ring over just the voxel axis of the 2-D
+    # mesh (4 shards) gives the same numbers
+    sub = np.asarray(distla.summa_gram(data, mesh2d,
+                                       axis_names=("voxel",)))
+    assert np.allclose(sub, got, atol=1e-8)
+    with pytest.raises(ValueError, match="ring axes"):
+        distla.summa_gram(data, mesh2d, axis_names=("nope",))
+
+
+def test_summa_gram_nan_columns_propagate():
+    """A NaN voxel column yields NaN across its row/column instead of
+    fabricated finite correlations; finite entries are untouched."""
+    rng = np.random.RandomState(3)
+    data = rng.randn(16, 32)
+    data[3, 5] = np.nan
+    mesh = make_mesh(("voxel",), (8,))
+    got = np.asarray(distla.summa_gram(data, mesh))
+    assert np.all(np.isnan(got[5, :])) and np.all(np.isnan(got[:, 5]))
+    keep = np.arange(32) != 5
+    dense = _dense_corr(data)
+    assert np.allclose(got[np.ix_(keep, keep)],
+                       dense[np.ix_(keep, keep)], atol=1e-8)
+
+
+def test_panel_gram_matches_and_checkpoints(tmp_path):
+    """The host-driven panel loop reproduces the fused ring and a
+    preemption mid-Gram resumes at the last completed panel (panels
+    already computed are NOT redone)."""
+    from brainiak_tpu.resilience.faults import PreemptionError, inject
+
+    rng = np.random.RandomState(4)
+    data = rng.randn(16, 64)
+    mesh = make_mesh(("voxel",), (8,))
+    dense = _dense_corr(data)
+
+    plain = distla.panel_gram(data, mesh)
+    assert np.allclose(plain, dense, atol=1e-8)
+
+    ckpt = str(tmp_path / "panels")
+    with inject("preempt", at_step=2) as fault:
+        with pytest.raises(PreemptionError):
+            distla.panel_gram(data, mesh, checkpoint_dir=ckpt,
+                              checkpoint_every=1)
+    assert fault.fired
+
+    mem = obs.add_sink(obs.MemorySink())
+    try:
+        resumed = distla.panel_gram(data, mesh, checkpoint_dir=ckpt,
+                                    checkpoint_every=1)
+    finally:
+        obs.remove_sink(mem)
+    assert np.allclose(resumed, dense, atol=1e-8)
+    chunks = [r for r in mem.records if r["kind"] == "span"
+              and r["name"] == "distla.panel_chunk"]
+    resumes = [r for r in mem.records if r["kind"] == "event"
+               and r["name"] == "resume"]
+    assert len(resumes) == 1
+    # 8 panels total, 2 completed before the preemption
+    assert len(chunks) == 6
+
+
+def test_panel_gram_fingerprint_covers_data_b(tmp_path):
+    """A resume against the same data but a DIFFERENT
+    cross-correlation target must refuse (fresh checkpoint_dir), not
+    mix rows of corr(data, X) with rows of corr(data, Y)."""
+    from brainiak_tpu.resilience.faults import PreemptionError, inject
+
+    rng = np.random.RandomState(11)
+    data = rng.randn(16, 64)
+    x = rng.randn(16, 64)
+    y = rng.randn(16, 64)
+    mesh = make_mesh(("voxel",), (8,))
+    ckpt = str(tmp_path / "cross")
+    with inject("preempt", at_step=2):
+        with pytest.raises(PreemptionError):
+            distla.panel_gram(data, mesh, data_b=x,
+                              checkpoint_dir=ckpt, checkpoint_every=1)
+    with pytest.raises(ValueError, match="different data"):
+        distla.panel_gram(data, mesh, data_b=y,
+                          checkpoint_dir=ckpt, checkpoint_every=1)
+
+
+def test_gram_rejects_mismatched_data_b_on_every_branch():
+    """The cross-Gram shape contract holds on the replicated branch
+    too — not only once the data grows past the budget."""
+    rng = np.random.RandomState(12)
+    data = rng.randn(16, 32)
+    with pytest.raises(ValueError, match="shape"):
+        distla.gram(data, data_b=rng.randn(16, 20))
+
+
+def test_gram_budget_dispatch_replicated_would_oom():
+    """A voxel count whose replicated working set exceeds the
+    per-device budget completes via SUMMA panels (the whole-brain
+    acceptance shape, scaled to the CPU mesh): forcing the
+    replicated einsum under the same budget refuses."""
+    rng = np.random.RandomState(5)
+    data = rng.randn(16, 128)
+    mesh = make_mesh(("voxel",), (8,))
+    budget = 64 << 10  # 64 KiB: the [V, V] output alone exceeds it
+    with pytest.raises(ValueError, match="budget"):
+        distla.gram(data, mesh=mesh, budget_bytes=budget,
+                    force="replicated")
+    out = np.asarray(distla.gram(data, mesh=mesh,
+                                 budget_bytes=budget))
+    assert np.allclose(out, _dense_corr(data), atol=1e-8)
+    # under-budget problems keep the replicated einsum (no mesh
+    # required) and agree with the ring
+    small = np.asarray(distla.gram(data))
+    assert np.allclose(small, out, atol=1e-8)
+    with pytest.raises(ValueError, match="force"):
+        distla.gram(data, force="both")
+
+
+def test_batched_solves_sharded_over_subject_axis():
+    """batched_eigh / batched_cholesky_solve lay the batch along the
+    mesh's subject axis and match the NumPy per-subject solves."""
+    rng = np.random.RandomState(6)
+    s, k = 8, 5
+    base = rng.randn(s, k, k)
+    spd = base @ np.transpose(base, (0, 2, 1)) + 3 * np.eye(k)
+    rhs = rng.randn(s, k, 2)
+    mesh = make_mesh(("subject",), (8,))
+
+    solved = np.asarray(distla.batched_cholesky_solve(
+        jnp.asarray(spd), jnp.asarray(rhs), mesh=mesh))
+    assert np.allclose(solved, np.linalg.solve(spd, rhs), atol=1e-8)
+
+    w, q = distla.batched_eigh(jnp.asarray(spd), mesh=mesh)
+    recon = np.asarray(jnp.einsum('sik,sk,sjk->sij', q, w, q))
+    assert np.allclose(recon, spd, atol=1e-8)
+
+    # non-divisible batch falls back to the plain vmap, same numbers
+    solved5 = np.asarray(distla.batched_cholesky_solve(
+        jnp.asarray(spd[:5]), jnp.asarray(rhs[:5]), mesh=mesh))
+    assert np.allclose(solved5, np.linalg.solve(spd[:5], rhs[:5]),
+                       atol=1e-8)
+
+
+def test_srm_fit_parity_sharded_solves():
+    """SRM/DetSRM with the subject-sharded E-step solves reproduce
+    the unsharded fit from the same seed (allclose factors)."""
+    from brainiak_tpu.funcalign.srm import SRM, DetSRM
+
+    rng = np.random.RandomState(7)
+    X = [rng.randn(30, 40).astype(np.float64) for _ in range(4)]
+    mesh = make_mesh(("subject",), (4,))
+    atol = mesh_atol()
+
+    plain = SRM(n_iter=5, features=3, rand_seed=0).fit(X)
+    sharded = SRM(n_iter=5, features=3, rand_seed=0, mesh=mesh).fit(X)
+    assert np.allclose(plain.s_, sharded.s_, atol=atol)
+    assert np.allclose(plain.sigma_s_, sharded.sigma_s_, atol=atol)
+    for w0, w1 in zip(plain.w_, sharded.w_):
+        assert np.allclose(w0, w1, atol=atol)
+
+    dplain = DetSRM(n_iter=5, features=3, rand_seed=0).fit(X)
+    dsharded = DetSRM(n_iter=5, features=3, rand_seed=0,
+                      mesh=mesh).fit(X)
+    assert np.allclose(dplain.s_, dsharded.s_, atol=atol)
+    for w0, w1 in zip(dplain.w_, dsharded.w_):
+        assert np.allclose(w0, w1, atol=atol)
+
+
+def test_fcma_distla_path_matches_replicated(seeded_rng):
+    """VoxelSelector's sharded-data2 (distla) path reproduces the
+    replicated XLA path, including an uneven voxel count that pads
+    data2 to the mesh axis."""
+    from brainiak_tpu.fcma.voxelselector import VoxelSelector
+
+    def epoch(cols):
+        mat = seeded_rng.rand(12, cols).astype(np.float32)
+        return (mat - mat.mean(0)) / (mat.std(0) * np.sqrt(12))
+
+    data = [epoch(21) for _ in range(8)]  # 21 % 8 != 0 -> padded
+    labels = [0, 1] * 4
+    plain = sorted(VoxelSelector(
+        labels, 4, 2, data, voxel_unit=7,
+        use_pallas=False, use_distla=False).run('svm'))
+    mesh = make_mesh(("voxel",), (8,))
+    vs = VoxelSelector(labels, 4, 2, data, voxel_unit=7, mesh=mesh,
+                       use_pallas=False, use_distla=True)
+    sharded = sorted(vs.run('svm'))
+    for (v0, a0), (v1, a1) in zip(plain, sharded):
+        assert v0 == v1
+        assert np.isclose(a0, a1, atol=1e-4)
+    # the EXPLICITLY-requested distla path serves the on-device SVM
+    # only
+    with pytest.raises(ValueError, match="on-device SVM"):
+        vs.run(object())
+    # explicit opt-in without a mesh is a loud error
+    with pytest.raises(ValueError, match="mesh"):
+        VoxelSelector(labels, 4, 2, data, use_distla=True)
+
+
+def test_fcma_distla_auto_falls_back_for_host_cv(seeded_rng, caplog):
+    """A budget-triggered AUTO engagement must not turn a host-CV
+    run() into an error: that call degrades to the replicated layout
+    (with a warning) and the sharded path is restored afterwards."""
+    import logging
+
+    from sklearn import svm
+
+    from brainiak_tpu.fcma.voxelselector import VoxelSelector
+
+    def epoch():
+        mat = seeded_rng.rand(12, 16).astype(np.float32)
+        return (mat - mat.mean(0)) / (mat.std(0) * np.sqrt(12))
+
+    data = [epoch() for _ in range(8)]
+    labels = [0, 1] * 4
+    mesh = make_mesh(("voxel",), (8,))
+    # a 1-byte budget auto-engages distla for any data
+    vs = VoxelSelector(labels, 4, 2, data, voxel_unit=4, mesh=mesh,
+                       use_pallas=False, replicated_budget_bytes=1)
+    assert vs.use_distla and vs._distla_auto
+    clf = svm.SVC(kernel='precomputed', shrinking=False, C=1)
+    with caplog.at_level(logging.WARNING,
+                         logger="brainiak_tpu.fcma.voxelselector"):
+        host = sorted(vs.run(clf))
+    assert any("falling back" in r.message for r in caplog.records)
+    assert vs.use_distla  # restored after the call
+    plain = sorted(VoxelSelector(
+        labels, 4, 2, data, voxel_unit=4, use_pallas=False,
+        use_distla=False).run(clf))
+    for (v0, a0), (v1, a1) in zip(plain, host):
+        assert v0 == v1
+        assert np.isclose(a0, a1, atol=1e-4)
+    # and the sharded on-device path still works on the same instance
+    sharded = sorted(vs.run('svm'))
+    assert [v for v, _ in sharded] == [v for v, _ in plain]
+
+
+def test_distla_cost_records_join_spans_for_flops():
+    """With profiling on, a distla run emits ``distla.*`` cost
+    records whose span hints join the recorded span durations in
+    ``obs report`` (achieved-FLOP/s populated), and repeat calls do
+    not rebuild the program (one retrace per site)."""
+    from brainiak_tpu.obs import metrics as obs_metrics
+    from brainiak_tpu.obs import profile as obs_profile
+    from brainiak_tpu.obs import report
+
+    rng = np.random.RandomState(8)
+    data = rng.randn(16, 64)
+    mesh = make_mesh(("voxel",), (8,))
+    distla._summa_program.cache_clear()
+    retrace = obs_metrics.counter("retrace_total")
+    before = retrace.value(site="distla.summa")
+
+    mem = obs.add_sink(obs.MemorySink())
+    try:
+        with obs_profile.profiling("lowered"):
+            for _ in range(2):
+                np.asarray(distla.summa_gram(data, mesh))
+    finally:
+        obs.remove_sink(mem)
+
+    assert retrace.value(site="distla.summa") - before == 1
+    costs = [r for r in mem.records if r["kind"] == "cost"
+             and r["site"] == "distla.summa"]
+    assert costs and costs[0]["span"] == "distla.gram"
+    assert costs[0].get("flops")
+    summary = report.aggregate(mem.records)
+    (row,) = [r for r in summary["cost"]
+              if r["site"] == "distla.summa"]
+    assert row["achieved_flops_per_s"] > 0

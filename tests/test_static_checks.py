@@ -42,7 +42,7 @@ def test_run_checks_json_output():
     assert payload["findings"] == []
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
-        "jaxlint", "obs", "regress", "serve"}
+        "jaxlint", "obs", "regress", "serve", "distla"}
     assert payload["files"] > 100
 
 
@@ -296,3 +296,48 @@ def test_serve_gate_catches_poison_fixture(tmp_path, monkeypatch):
     rc.check_serve(findings)
     assert findings and all(f.code == "SRV001" for f in findings)
     assert any("error record" in f.message for f in findings)
+
+
+def test_distla_gate_passes_on_live_package():
+    """The distla gate (DLA001) smoke-runs the pod-scale linear
+    algebra selfcheck on the 8-device CPU mesh and passes on the
+    live tree (ISSUE 6 satellite)."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_distla(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_distla_gate_classifies_failures(monkeypatch):
+    """A failing selfcheck verdict is reported as DLA001, with
+    retrace instability (program rebuilt on a repeat call) named
+    separately from numerics parity."""
+    rc = _load_run_checks()
+
+    def fake_child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    monkeypatch.setattr(rc, "_DISTLA_CHILD", fake_child(
+        {"ok": False, "max_err": 0.25, "tol": 5e-4, "n_shards": 8,
+         "retraces": {"distla.summa": 1.0}}))
+    findings = []
+    rc.check_distla(findings)
+    assert [f.code for f in findings] == ["DLA001"]
+    assert "parity" in findings[0].message
+
+    monkeypatch.setattr(rc, "_DISTLA_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 5e-4, "n_shards": 8,
+         "retraces": {"distla.summa": 3.0, "distla.panel": 1.0}}))
+    findings = []
+    rc.check_distla(findings)
+    assert [f.code for f in findings] == ["DLA001"]
+    assert "rebuilt" in findings[0].message
+    assert "distla.summa=3" in findings[0].message
+
+    monkeypatch.setattr(rc, "_DISTLA_CHILD", "raise SystemExit(3)")
+    findings = []
+    rc.check_distla(findings)
+    assert [f.code for f in findings] == ["DLA001"]
+    assert "rc=3" in findings[0].message

@@ -29,6 +29,12 @@ serve      smoke-runs ``python -m brainiak_tpu.serve run`` on
            the committed tools/serve_fixture/ model + request
            files and fails on CLI errors, request-level error
            records, or per-request recompiles (SRV001)
+distla     smoke-runs the pod-scale linear algebra selfcheck
+           (``brainiak_tpu.ops.distla.selfcheck``) on a tiny
+           fixture over an 8-device CPU mesh and fails on
+           parity error or program rebuilds — every
+           ``retrace_total{site=distla.*}`` must stay at 1
+           across repeat calls (DLA001)
 ========== ===================================================
 
 ``# noqa`` suppresses stdlib/doc findings on a line; jaxlint uses
@@ -59,7 +65,7 @@ from brainiak_tpu.analysis.core import SKIP_DIRS  # noqa: E402,F401
 
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
-         "jaxlint", "obs", "regress", "serve")
+         "jaxlint", "obs", "regress", "serve", "distla")
 
 
 def python_sources():
@@ -527,6 +533,79 @@ def check_serve(findings):
             "per-request recompiles"))
 
 
+# -- distla gate ------------------------------------------------------
+
+_DISTLA_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.ops.distla import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_distla(findings):
+    """Distla gate (DLA001): smoke-run the pod-scale linear algebra
+    selfcheck (``brainiak_tpu.ops.distla.selfcheck``) in a child with
+    an 8-device CPU mesh.  The selfcheck runs the SUMMA Gram (even
+    and uneven splits), the checkpointable panel Gram, and the
+    sharded batched solves twice each against NumPy references, then
+    reads the retrace counter: any ``retrace_total{site=distla.*}``
+    above 1 means a repeat call rebuilt its program (the
+    no-per-call-retrace contract, jaxlint JX001's runtime twin).
+    The platform is pinned in-process by the child code, not the
+    JAX_PLATFORMS env var alone (which can hang on a wedged tunnel
+    PJRT plugin, docs/performance.md rule 4) — the timeout stays as
+    a backstop."""
+    rel = _rel(os.path.join(REPO, "brainiak_tpu", "ops", "distla.py"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _DISTLA_CHILD],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=420)
+    except subprocess.TimeoutExpired:
+        findings.append(Finding(
+            rel, 1, "DLA001",
+            "distla selfcheck timed out after 420s (hung backend "
+            "init?)"))
+        return
+    try:
+        verdict = json.loads(proc.stdout)
+    except ValueError:
+        verdict = None
+    if verdict is None or proc.returncode not in (0, 1):
+        tail = (proc.stderr or proc.stdout or "").strip()
+        tail = "; ".join(tail.splitlines()[-3:])
+        findings.append(Finding(
+            rel, 1, "DLA001",
+            f"distla selfcheck failed (rc={proc.returncode}): "
+            f"{tail or 'no JSON verdict'}"))
+        return
+    if not verdict.get("ok"):
+        retraces = {site: count for site, count
+                    in verdict.get("retraces", {}).items()
+                    if count > 1}
+        if retraces:
+            findings.append(Finding(
+                rel, 1, "DLA001",
+                "distla programs rebuilt on repeat calls: "
+                + ", ".join(f"{site}={count:.0f}"
+                            for site, count in sorted(
+                                retraces.items()))))
+        else:
+            findings.append(Finding(
+                rel, 1, "DLA001",
+                f"distla parity failure: max_err="
+                f"{verdict.get('max_err')} over tol="
+                f"{verdict.get('tol')} "
+                f"(n_shards={verdict.get('n_shards')})"))
+
+
 # -- external gate ----------------------------------------------------
 
 def run_external(findings):
@@ -637,6 +716,8 @@ def run_gates(only=None):
         check_regress(findings)
     if "serve" in selected:
         check_serve(findings)
+    if "distla" in selected:
+        check_distla(findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
@@ -644,7 +725,7 @@ def run_gates(only=None):
     label = "+".join(
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
-                       "obs", "regress", "serve")
+                       "obs", "regress", "serve", "distla")
            if g in selected])
     return {
         "ok": not findings,
