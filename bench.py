@@ -23,11 +23,13 @@ responsive chip the north-star whole-brain config is attempted first
 (V=65536 correlation width, E=32 — the BASELINE.json scale), then the
 V=8192 mid config, then a reduced CPU fallback.  Each chip tier runs in
 its own subprocess under a timeout so a tunnel wedge mid-tier cannot
-hang the driver's bench invocation.  Two further tiers print their own
-JSON lines after the FCMA record: ``serve`` (batched SRM-transform
-serving) and ``distla`` (pod-scale SUMMA-sharded Gram,
+hang the driver's bench invocation.  Three further tiers print their
+own JSON lines after the FCMA record: ``serve`` (batched
+SRM-transform serving), ``distla`` (pod-scale SUMMA-sharded Gram,
 ``brainiak_tpu.ops.distla`` — voxels/s of a [T, V] -> [V, V]
-correlation with the voxel axis ring-sharded), each split into an
+correlation with the voxel axis ring-sharded), and ``encoding``
+(voxel-wise ridge CV throughput, ``brainiak_tpu.encoding`` —
+voxels×lambdas/s of a full RidgeEncoder fit), each split into an
 on-chip and a ``*_cpu_fallback`` tier so ``obs regress`` never
 compares host rounds against on-chip baselines.
 
@@ -76,6 +78,19 @@ SERVE_REQUESTS = 256  # serve-tier workload (BENCH_SERVE_REQUESTS overrides)
 # records a number.  BENCH_DISTLA_VOXELS overrides either.
 DISTLA_VOXELS = 16384
 DISTLA_CPU_VOXELS = 2048
+
+# encoding tier (voxel-wise ridge, brainiak_tpu.encoding): the
+# on-chip workload is the paper-scale CV sweep (V=8192 voxels,
+# F=512 features, 10 lambdas, 5 folds); the CPU fallback runs a
+# reduced problem so the round still records a number in under a
+# minute.  BENCH_ENCODING_VOXELS overrides the width on either.
+ENCODING_VOXELS = 8192
+ENCODING_FEATURES = 512
+ENCODING_CPU_VOXELS = 1024
+ENCODING_CPU_FEATURES = 64
+ENCODING_N_LAMBDAS = 10
+ENCODING_FOLDS = 5
+ENCODING_TRS = 200
 
 
 def _serve_n_requests():
@@ -270,6 +285,134 @@ def _distla_result_record(out):
            "config": {"n_voxels": out["n_voxels"],
                       "n_trs": out["n_trs"],
                       "n_shards": out["n_shards"]}}
+    commit = _git_commit()
+    if commit:
+        rec["git_commit"] = commit
+    if out.get("stages"):
+        rec["stages"] = out["stages"]
+    return rec
+
+
+def _encoding_shape():
+    """The encoding tier's problem size: the env override for the
+    voxel width, else backend-scaled defaults — one reader so the
+    measured workload and the stamped config cannot drift."""
+    import os
+
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    voxels = int(os.environ.get(
+        "BENCH_ENCODING_VOXELS",
+        ENCODING_VOXELS if on_tpu else ENCODING_CPU_VOXELS))
+    features = ENCODING_FEATURES if on_tpu else ENCODING_CPU_FEATURES
+    return voxels, features
+
+
+def _encoding_lambdas():
+    return np.logspace(0.0, 3.0, ENCODING_N_LAMBDAS)
+
+
+def encoding_tier_metrics(n_voxels, n_features, n_trs=ENCODING_TRS,
+                          seed=0):
+    """The ``encoding`` tier: voxel-wise ridge CV throughput
+    (voxels×lambdas/s of a full :class:`RidgeEncoder` fit — Gram,
+    batched fold eigendecompositions, the one-program lambda sweep,
+    per-voxel selection, refit) on synthetic ``Y = X W + noise``
+    data.  The warm fit pays the compiles; the timed fit is the
+    steady-state sweep."""
+    import jax
+
+    from brainiak_tpu.encoding import RidgeEncoder
+
+    lambdas = _encoding_lambdas()
+    with obs.span("bench.data_gen"):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(n_trs, n_features).astype(np.float32)
+        w = rng.randn(n_features, n_voxels).astype(np.float32)
+        y = (x @ w + 0.5 * rng.randn(n_trs, n_voxels)).astype(
+            np.float32)
+    with obs.span("bench.warm"):
+        RidgeEncoder(lambdas=lambdas,
+                     n_folds=ENCODING_FOLDS).fit(x, y)
+    t0 = time.perf_counter()
+    with obs.span("bench.steady"):
+        enc = RidgeEncoder(lambdas=lambdas,
+                           n_folds=ENCODING_FOLDS).fit(x, y)
+    dt = time.perf_counter() - t0
+    assert enc.W_.shape == (n_features, n_voxels)
+    return {"voxels_lambdas_per_sec": n_voxels * len(lambdas) / dt,
+            "n_voxels": n_voxels, "n_features": n_features,
+            "n_lambdas": len(lambdas), "n_folds": ENCODING_FOLDS,
+            "n_trs": n_trs, "backend": jax.default_backend()}
+
+
+def encoding_cpu_voxels_lambdas_per_sec(n_voxels, n_features,
+                                        n_trs=ENCODING_TRS, seed=0):
+    """Reference-path encoding throughput on host NumPy/BLAS at the
+    SAME problem size: the identical eigendecomposition CV sweep +
+    per-voxel refit, for the encoding record's ``vs_baseline``."""
+    lambdas = _encoding_lambdas()
+    k = ENCODING_FOLDS
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n_trs, n_features).astype(np.float32)
+    w = rng.randn(n_features, n_voxels).astype(np.float32)
+    y = (x @ w + 0.5 * rng.randn(n_trs, n_voxels)).astype(np.float32)
+    t0 = time.perf_counter()
+    xc = x - x.mean(0)
+    yc = y - y.mean(0)
+    g = xc.T @ xc
+    b = xc.T @ yc
+    t_f = n_trs // k
+    scores = np.zeros((len(lambdas), n_voxels), np.float32)
+    for fold in range(k):
+        sl = slice(fold * t_f, (fold + 1) * t_f)
+        xf, yf = xc[sl], yc[sl]
+        ev, q = np.linalg.eigh(g - xf.T @ xf)
+        ev = np.maximum(ev, 0.0)
+        a = q.T @ (b - xf.T @ yf)
+        p = xf @ q
+        yf_c = yf - yf.mean(0)
+        yf_ss = (yf_c * yf_c).sum(0)
+        for i, lam in enumerate(lambdas):
+            pred = p @ (a / (ev[:, None] + lam))
+            pc = pred - pred.mean(0)
+            den = np.sqrt((pc * pc).sum(0) * yf_ss)
+            scores[i] += np.where(
+                den > 0, (pc * yf_c).sum(0) / np.where(den > 0, den,
+                                                       1.0), 0.0)
+    sel = lambdas[np.argmax(scores, axis=0)]
+    ev, q = np.linalg.eigh(g)
+    ev = np.maximum(ev, 0.0)
+    a = q.T @ b
+    out = q @ (a / (ev[:, None] + sel[None, :]))
+    dt = time.perf_counter() - t0
+    assert out.shape == (n_features, n_voxels)
+    return n_voxels * len(lambdas) / dt
+
+
+def _encoding_result_record(out):
+    """The encoding tier's bench JSON line (schema:
+    ``brainiak_tpu.obs.validate_bench_record``).  Tier separation
+    mirrors the other tiers: a run whose backend is not a TPU is
+    stamped ``tier="encoding_cpu_fallback"`` so ``obs regress
+    --only encoding`` gates both backends as one family without
+    ever comparing them against each other."""
+    vls = float(out["voxels_lambdas_per_sec"])
+    baseline = encoding_cpu_voxels_lambdas_per_sec(
+        out["n_voxels"], out["n_features"], n_trs=out["n_trs"])
+    tier = "encoding" if out.get("backend") == "tpu" \
+        else "encoding_cpu_fallback"
+    rec = {"schema_version": BENCH_SCHEMA_VERSION,
+           "metric": "encoding_ridge_cv_voxels_lambdas_per_sec",
+           "value": round(vls, 2),
+           "unit": "voxels*lambdas/sec",
+           "vs_baseline": round(vls / baseline, 2),
+           "tier": tier,
+           "config": {"n_voxels": out["n_voxels"],
+                      "n_features": out["n_features"],
+                      "n_lambdas": out["n_lambdas"],
+                      "n_folds": out["n_folds"],
+                      "n_trs": out["n_trs"]}}
     commit = _git_commit()
     if commit:
         rec["git_commit"] = commit
@@ -479,6 +622,18 @@ def measure_tier(tier):
                           else "distla_cpu_fallback")
             out["stages"] = _stage_seconds(mem.records)
             return out
+        if tier == "encoding":
+            out = encoding_tier_metrics(*_encoding_shape())
+            # the record's tier is split by backend (an on-chip
+            # sweep rate must never share a regress baseline with a
+            # CPU-fallback one — same rule as the other tiers)
+            obs.gauge("bench_encoding_voxels_lambdas_per_sec",
+                      unit="voxels*lambdas/sec").set(
+                          out["voxels_lambdas_per_sec"],
+                          tier="encoding" if out["backend"] == "tpu"
+                          else "encoding_cpu_fallback")
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "serve":
             out = serve_tier_metrics(n_requests=_serve_n_requests())
             # the record's tier is split by backend (an on-chip
@@ -568,44 +723,44 @@ def main():
     responsive = _fcma_main()
     _serve_main(responsive)
     _distla_main(responsive)
+    _encoding_main(responsive)
+
+
+def _aux_tier_main(responsive, tier, record_fn, timeout=420):
+    """Shared auxiliary-tier driver (serve/distla/encoding):
+    subprocess first (one chip process at a time — a wedge must not
+    hang the driver), in-process CPU fallback otherwise.
+    ``responsive`` is an earlier tier's probe verdict; a prior
+    subprocess may have wedged the tunnel since, so a True verdict
+    is re-probed cheaply before committing the chip, while a False
+    one is trusted as-is (straight to the CPU fallback)."""
+    if responsive:
+        responsive = _device_responsive(timeout=90)
+    out = _run_tier_subprocess(tier, timeout=timeout) \
+        if responsive else None
+    if out is None:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        out = measure_tier(tier)
+    print(json.dumps(record_fn(out)))
+
+
+def _encoding_main(responsive):
+    """Encoding tier: voxel-wise ridge CV throughput."""
+    _aux_tier_main(responsive, "encoding", _encoding_result_record)
 
 
 def _distla_main(responsive):
-    """Distla tier: subprocess first (one chip process at a time, a
-    wedge must not hang the driver), in-process CPU fallback at the
-    reduced width otherwise.  ``responsive`` is the earlier tiers'
-    probe verdict; a prior subprocess may have wedged the tunnel
-    since, so a True verdict is re-probed cheaply before committing
-    the chip, while a False one is trusted as-is."""
-    if responsive:
-        responsive = _device_responsive(timeout=90)
-    out = _run_tier_subprocess("distla", timeout=420) \
-        if responsive else None
-    if out is None:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        out = measure_tier("distla")
-    print(json.dumps(_distla_result_record(out)))
+    """Distla tier: SUMMA-sharded Gram throughput."""
+    _aux_tier_main(responsive, "distla", _distla_result_record)
 
 
 def _serve_main(responsive):
-    """Serve tier: subprocess first (one chip process at a time, a
-    wedge must not hang the driver), in-process CPU fallback
-    otherwise.  ``responsive`` is _fcma_main's probe verdict, which
-    may predate a tier subprocess that wedged the tunnel afterwards
-    (same stale-verdict hazard the wb→mid handoff guards against) —
-    re-probe cheaply before committing 420 s to the chip; a False
-    verdict is trusted as-is, skipping straight to the CPU fallback."""
+    """Serve tier: batched SRM-transform serving throughput."""
     n_requests = _serve_n_requests()
-    if responsive:
-        responsive = _device_responsive(timeout=90)
-    out = _run_tier_subprocess("serve", timeout=420) \
-        if responsive else None
-    if out is None:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        out = measure_tier("serve")
-    print(json.dumps(_serve_result_record(out, n_requests)))
+    _aux_tier_main(
+        responsive, "serve",
+        lambda out: _serve_result_record(out, n_requests))
 
 
 def _fcma_main():

@@ -19,8 +19,9 @@ Layout
 - domain packages    ``fcma``, ``funcalign``, ``factoranalysis``,
                      ``eventseg``, ``searchlight``, ``isc``, ``reprsimil``,
                      ``matnormal``, ``reconstruct``, ``hyperparamopt``,
-                     ``utils`` — sklearn-style estimators and free functions
-                     matching the reference API surface.
+                     ``encoding``, ``utils`` — sklearn-style estimators and
+                     free functions matching (and extending) the reference
+                     API surface.
 """
 
 __version__ = "0.1.0"

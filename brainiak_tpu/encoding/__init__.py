@@ -1,0 +1,34 @@
+"""brainiak_tpu.encoding: massive voxel-wise encoding models.
+
+The framework's heavy-read workload tier (ROADMAP open item 5):
+batched per-voxel ridge regression with an on-device cross-validated
+lambda sweep (:class:`RidgeEncoder`) and its per-feature-band
+generalization (:class:`BandedRidgeEncoder`), built on the
+eigendecomposition solver of "Scaling up ridge regression for brain
+encoding in a massive individual fMRI dataset"
+(https://arxiv.org/pdf/2403.19421).
+
+The ``Xᵀ X`` Gram runs through :func:`brainiak_tpu.ops.distla.gram`
+(budget-dispatched replicated vs. SUMMA-sharded), the sweep is one
+jitted program per lambda/candidate block driven resiliently
+(``fit(..., checkpoint_dir=)`` resumes mid-sweep), and fitted models
+persist through :mod:`brainiak_tpu.serve.artifacts`
+(``serve_kind="ridge_encoding"``) for batched held-out-scan scoring
+in the serve engine.
+
+See docs/encoding.md.
+"""
+
+from .ridge import (  # noqa: F401
+    DEFAULT_LAMBDAS,
+    BandedRidgeEncoder,
+    RidgeEncoder,
+    selfcheck,
+)
+
+__all__ = [
+    "DEFAULT_LAMBDAS",
+    "BandedRidgeEncoder",
+    "RidgeEncoder",
+    "selfcheck",
+]

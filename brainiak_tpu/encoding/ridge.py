@@ -1,0 +1,751 @@
+"""Massive voxel-wise encoding models: batched ridge / banded ridge.
+
+The canonical heavy-read fMRI workload the reference package never
+had (ROADMAP open item 5): tens of thousands of independent per-voxel
+ridge regressions fit once against a stimulus/feature design, then
+scored against thousands of held-out scans — the massive-individual-
+dataset setting of "Scaling up ridge regression for brain encoding in
+a massive individual fMRI dataset"
+(https://arxiv.org/pdf/2403.19421).
+
+The solver is the eigendecomposition trick that makes a lambda sweep
+nearly free: with ``G = Xᵀ X = Q Λ Qᵀ`` computed ONCE (through
+:func:`brainiak_tpu.ops.distla.gram`, so the budget dispatcher picks
+the replicated einsum or the SUMMA-sharded ring automatically, the
+feature axis sharded over the mesh when over budget), every ridge
+solution is a diagonal rescale in the eigenbasis::
+
+    W(λ) = Q diag(1 / (Λ + λ)) Qᵀ Xᵀ Y
+
+K-fold cross-validation reuses the same algebra per fold: the train
+Gram of fold ``f`` is ``G - G_f`` (one small per-fold Gram each), so
+one batched ``eigh`` over the K train Grams prepares the whole sweep,
+and the sweep itself is a ``vmap`` over the lambda grid inside ONE
+jitted program — no host round-trip per lambda, no recompile per
+lambda (``retrace_total{site=encoding.*}`` counts one trace per
+distinct program, not per grid point).
+
+:class:`BandedRidgeEncoder` generalizes to per-feature-band lambdas
+via the scaling trick: solving ridge at ``λ = 1`` on the column-scaled
+design ``X·diag(s)`` with ``s = 1/sqrt(λ_band)`` is exactly banded
+ridge, so each candidate (one lambda per band) costs one scaled
+``eigh`` — batched over a candidate block in one program.
+
+Resilience: the sweep is driven block-by-block through
+:func:`~brainiak_tpu.resilience.guards.run_resilient_loop` — with
+``checkpoint_dir=`` the accumulated per-voxel CV scores persist every
+``checkpoint_every`` blocks and a preempted fit resumes at the last
+completed lambda/candidate block.  Blocks are equal-sized (the last
+one padded), so chunking never adds program shapes.
+
+Telemetry: every program builder is a
+:func:`~brainiak_tpu.obs.runtime.counted_cache` under an
+``encoding.*`` site and the programs are
+:func:`~brainiak_tpu.obs.profile.profile_program`-wrapped, so
+retraces, cost records and span durations join in ``obs report`` like
+every other estimator.
+
+Memory model of the sweep (see docs/encoding.md): the peak transient
+is the predicted held-out block ``[block, K, T/K, V]`` — bound it
+with ``lambda_block=`` (ridge) / ``candidate_block=`` (banded)
+instead of shrinking the grid.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import profile as obs_profile
+from ..obs import runtime as obs_runtime
+from ..obs import spans as obs_spans
+from ..ops import distla
+from ..ops.correlation import resolve_precision
+from ..resilience.guards import array_digest, run_resilient_loop
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "BandedRidgeEncoder",
+    "DEFAULT_LAMBDAS",
+    "RidgeEncoder",
+    "selfcheck",
+]
+
+#: Default lambda grid (log-spaced; sorted ascending, so per-voxel
+#: argmax ties resolve to the SMALLEST adequate lambda).
+DEFAULT_LAMBDAS = (0.01, 0.1, 1.0, 10.0, 100.0, 1000.0, 10000.0)
+
+
+def _fold_scores(pred, y):
+    """Per-voxel Pearson r between predictions and held-out data over
+    the time axis (axis -2); zero where either side is constant (a
+    huge lambda drives predictions to a constant — score it neutral,
+    never NaN)."""
+    pc = pred - pred.mean(axis=-2, keepdims=True)
+    yc = y - y.mean(axis=-2, keepdims=True)
+    cov = (pc * yc).sum(axis=-2)
+    den = jnp.sqrt((pc * pc).sum(axis=-2) * (yc * yc).sum(axis=-2))
+    return jnp.where(den > 0, cov / jnp.where(den > 0, den, 1.0), 0.0)
+
+
+# -- jitted program builders ------------------------------------------
+#
+# One builder per program family, lru-keyed on every extent that
+# shapes the traced arrays (plus trace-time statics), so counted_cache
+# misses == distinct compiled programs.  The acceptance contract:
+# a full fit compiles at most one program per family — the lambda
+# sweep is ONE program ranging over the grid, never one per lambda.
+
+def _fold_algebra(k, t_f, prec):
+    """The fold decomposition both prepare programs share: slice the
+    contiguous folds out of the (already device-resident) full
+    arrays — so X and Y each cross the host-device boundary exactly
+    once per fit — and subtract per-fold Grams/cross-products from
+    the totals (``G_train = G - G_f``)."""
+
+    def fn(x, y, g_total):
+        x_folds = x[:k * t_f].reshape(k, t_f, x.shape[1])
+        y_folds = y[:k * t_f].reshape(k, t_f, y.shape[1])
+        b_total = jnp.einsum('tf,tv->fv', x, y, precision=prec,
+                             preferred_element_type=x.dtype)
+        g_folds = jnp.einsum('ktf,ktg->kfg', x_folds, x_folds,
+                             precision=prec,
+                             preferred_element_type=x.dtype)
+        b_folds = jnp.einsum('ktf,ktv->kfv', x_folds, y_folds,
+                             precision=prec,
+                             preferred_element_type=x.dtype)
+        return (x_folds, y_folds, g_total[None] - g_folds,
+                b_total[None] - b_folds, b_total)
+
+    return fn
+
+
+@obs_runtime.counted_cache("encoding.prepare")
+def _prepare_program(t, k, t_f, f, v, precision):
+    """Ridge sweep preparation: the shared fold algebra plus the
+    batched train-Gram eigendecompositions and the eigenbasis
+    projections the lambda sweep consumes.  Cache misses count as
+    ``retrace_total{site=encoding.prepare}``.  Both the total ``t``
+    (the full-T x/y arrays are traced inputs) and the fold length
+    ``t_f`` key the cache — T values sharing a fold length still
+    compile distinct programs."""
+    prec = resolve_precision(precision)
+    algebra = _fold_algebra(k, t_f, prec)
+
+    def fn(x, y, g_total):
+        x_folds, y_folds, g_tr, b_tr, b_total = algebra(x, y,
+                                                        g_total)
+        evals, q = jnp.linalg.eigh(g_tr)
+        evals = jnp.maximum(evals, 0.0)  # f32 noise on a PSD matrix
+        a = jnp.einsum('kfg,kfv->kgv', q, b_tr, precision=prec,
+                       preferred_element_type=x.dtype)
+        p = jnp.einsum('ktf,kfg->ktg', x_folds, q, precision=prec,
+                       preferred_element_type=x.dtype)
+        return evals, a, p, y_folds, b_total
+
+    return obs_profile.profile_program(
+        jax.jit(fn), "encoding.prepare", span="encoding.fit")
+
+
+@obs_runtime.counted_cache("encoding.banded_prepare")
+def _banded_prepare_program(t, k, t_f, f, v, precision):
+    """Banded sweep preparation: the shared fold algebra only — the
+    eigendecomposition is per-candidate (scaled Gram), so it lives in
+    the sweep program instead."""
+    prec = resolve_precision(precision)
+    algebra = _fold_algebra(k, t_f, prec)
+    return obs_profile.profile_program(
+        jax.jit(algebra), "encoding.banded_prepare",
+        span="encoding.fit")
+
+
+@obs_runtime.counted_cache("encoding.sweep")
+def _sweep_program(k, t_f, f, v, block, precision):
+    """The ridge CV sweep: ONE jitted program scoring a whole lambda
+    block — ``vmap`` over lambdas of (diagonal rescale in the
+    eigenbasis, held-out prediction, per-voxel correlation), folds
+    reduced inside.  Cache misses count as
+    ``retrace_total{site=encoding.sweep}`` — one per block SHAPE,
+    never one per lambda."""
+    prec = resolve_precision(precision)
+
+    def fn(evals, a, p, y_folds, lambdas):
+        def one(lam):
+            w = a / (evals[..., None] + lam)
+            pred = jnp.einsum('ktf,kfv->ktv', p, w, precision=prec,
+                              preferred_element_type=p.dtype)
+            return _fold_scores(pred, y_folds).mean(axis=0)
+
+        return jax.vmap(one)(lambdas)
+
+    return obs_profile.profile_program(
+        jax.jit(fn), "encoding.sweep", span="encoding.sweep_chunk")
+
+
+@obs_runtime.counted_cache("encoding.banded_sweep")
+def _banded_sweep_program(k, t_f, f, v, block, precision):
+    """The banded CV sweep: per candidate (one per-feature scale row
+    ``s = 1/sqrt(λ_band)``), scale the train Grams, eigendecompose,
+    solve at λ=1, score held-out predictions — ``vmap`` over the
+    candidate block in one program."""
+    prec = resolve_precision(precision)
+
+    def fn(g_tr, b_tr, x_folds, y_folds, scales):
+        def one(s):
+            g_s = g_tr * s[None, :, None] * s[None, None, :]
+            evals, q = jnp.linalg.eigh(g_s)
+            evals = jnp.maximum(evals, 0.0)
+            a = jnp.einsum('kfg,kfv->kgv', q,
+                           b_tr * s[None, :, None], precision=prec,
+                           preferred_element_type=s.dtype)
+            p = jnp.einsum('ktf,kfg->ktg',
+                           x_folds * s[None, None, :], q,
+                           precision=prec,
+                           preferred_element_type=s.dtype)
+            pred = jnp.einsum('ktf,kfv->ktv', p,
+                              a / (evals[..., None] + 1.0),
+                              precision=prec,
+                              preferred_element_type=s.dtype)
+            return _fold_scores(pred, y_folds).mean(axis=0)
+
+        return jax.vmap(one)(scales)
+
+    return obs_profile.profile_program(
+        jax.jit(fn), "encoding.banded_sweep",
+        span="encoding.sweep_chunk")
+
+
+@obs_runtime.counted_cache("encoding.refit")
+def _refit_program(f, v, precision):
+    """Final full-data refit at the per-voxel selected lambdas: one
+    eigendecomposition of the total Gram, then a per-voxel diagonal
+    rescale — every voxel gets its own lambda in one program."""
+    prec = resolve_precision(precision)
+
+    def fn(g_total, b_total, lam_sel):
+        evals, q = jnp.linalg.eigh(g_total)
+        evals = jnp.maximum(evals, 0.0)
+        a = jnp.einsum('fg,fv->gv', q, b_total, precision=prec,
+                       preferred_element_type=b_total.dtype)
+        w = a / (evals[:, None] + lam_sel[None, :])
+        return jnp.einsum('fg,gv->fv', q, w, precision=prec,
+                          preferred_element_type=b_total.dtype)
+
+    return obs_profile.profile_program(
+        jax.jit(fn), "encoding.refit", span="encoding.fit")
+
+
+@obs_runtime.counted_cache("encoding.banded_refit")
+def _banded_refit_program(f, v, block, precision):
+    """Banded full-data refit for one candidate block: per candidate,
+    eigendecompose the scaled total Gram, solve at λ=1, map back to
+    the unscaled basis (``w = s ∘ w_s``), and keep only the voxel
+    columns whose CV selected this candidate (the one-hot mask);
+    summing over candidates assembles the mixed-candidate [F, V]
+    coefficient block-by-block."""
+    prec = resolve_precision(precision)
+
+    def fn(g_total, b_total, scales, mask):
+        def one(s, m):
+            g_s = g_total * s[:, None] * s[None, :]
+            evals, q = jnp.linalg.eigh(g_s)
+            evals = jnp.maximum(evals, 0.0)
+            a = jnp.einsum('fg,fv->gv', q, b_total * s[:, None],
+                           precision=prec,
+                           preferred_element_type=s.dtype)
+            w = jnp.einsum('fg,gv->fv', q,
+                           a / (evals[:, None] + 1.0),
+                           precision=prec,
+                           preferred_element_type=s.dtype)
+            return (s[:, None] * w) * m[None, :]
+
+        return jax.vmap(one)(scales, mask).sum(axis=0)
+
+    return obs_profile.profile_program(
+        jax.jit(fn), "encoding.banded_refit", span="encoding.fit")
+
+
+# -- estimators -------------------------------------------------------
+
+class RidgeEncoder:
+    """Voxel-wise ridge encoding model with an on-device CV lambda
+    sweep.
+
+    Fits ``V`` independent ridge regressions ``y_v ≈ X w_v`` sharing
+    one design ``X [T, F]``, selecting a per-voxel lambda from
+    ``lambdas`` by contiguous k-fold cross-validation (held-out
+    per-voxel Pearson r, averaged over folds; ties take the smallest
+    lambda), then refitting on all data at the selected lambdas.
+
+    Parameters
+    ----------
+    lambdas : sequence of positive floats, default DEFAULT_LAMBDAS
+        Candidate regularization grid (sorted ascending internally).
+    n_folds : int, default 5
+        Contiguous CV folds over the first ``K * (T // K)`` samples;
+        remainder rows stay in every training fold.
+    fit_intercept : bool, default True
+        Center ``X`` and ``Y`` (the usual ridge intercept handling);
+        predictions add the stored means back.
+    standardize : bool, default False
+        Additionally scale design columns to unit std before fitting
+        (zero-variance columns keep scale 1).
+    lambda_block : int, optional
+        Sweep the grid in equal blocks of this many lambdas (default:
+        the whole grid in one block).  Bounds the sweep's transient
+        memory and sets the checkpoint granularity.
+    mesh : jax.sharding.Mesh, optional
+        Passed to :func:`brainiak_tpu.ops.distla.gram`: the ``Xᵀ X``
+        Gram shards the feature axis over the mesh when the
+        replicated working set exceeds the distla budget.
+    precision : jax.lax.Precision, optional
+        Matmul precision (default: the ops-layer default, HIGHEST).
+
+    Attributes (after fit)
+    ----------------------
+    W_ : [F, V] per-voxel coefficients (standardized design space).
+    lambda_ : [V] the CV-selected lambda per voxel.
+    cv_scores_ : [L, V] mean held-out correlation per (lambda, voxel).
+    lambdas_ : [L] the ascending grid actually swept.
+    x_mean_, x_scale_, y_mean_ : the preprocessing parameters
+        ``predict`` applies (zeros/ones when disabled).
+    """
+
+    def __init__(self, lambdas=None, n_folds=5, fit_intercept=True,
+                 standardize=False, lambda_block=None, mesh=None,
+                 precision=None):
+        self.lambdas = tuple(DEFAULT_LAMBDAS if lambdas is None
+                             else lambdas)
+        self.n_folds = int(n_folds)
+        self.fit_intercept = bool(fit_intercept)
+        self.standardize = bool(standardize)
+        self.lambda_block = lambda_block
+        self.mesh = mesh
+        self.precision = precision
+
+    # -- shared plumbing ----------------------------------------------
+    def _validate_grid(self):
+        grid = np.asarray(self.lambdas, dtype=np.float32)
+        if grid.ndim != 1 or grid.size == 0:
+            raise ValueError("lambdas must be a non-empty 1-D grid")
+        if not np.all(np.isfinite(grid)) or np.any(grid <= 0):
+            raise ValueError(
+                "lambdas must be finite and positive "
+                f"(got {self.lambdas!r})")
+        return np.sort(grid)
+
+    def _prepare_data(self, X, Y):
+        x = np.asarray(X, dtype=np.float32)
+        y = np.asarray(Y, dtype=np.float32)
+        if x.ndim != 2 or y.ndim != 2 or x.shape[0] != y.shape[0]:
+            raise ValueError(
+                f"expected X [T, F] and Y [T, V] with matching T; "
+                f"got {x.shape} and {y.shape}")
+        if not (np.all(np.isfinite(x)) and np.all(np.isfinite(y))):
+            raise ValueError(
+                "X/Y contain NaN/Inf; encoding fits require finite "
+                "data (mask or impute missing voxels first)")
+        if self.n_folds < 2:
+            raise ValueError(
+                f"n_folds must be >= 2, got {self.n_folds}")
+        t_f = x.shape[0] // self.n_folds
+        if t_f < 2:
+            raise ValueError(
+                f"{x.shape[0]} samples cannot form {self.n_folds} "
+                "folds of >= 2 samples (correlation scoring needs "
+                "at least 2 held-out rows per fold)")
+        self.x_mean_ = (x.mean(axis=0) if self.fit_intercept
+                        else np.zeros(x.shape[1], np.float32))
+        self.y_mean_ = (y.mean(axis=0) if self.fit_intercept
+                        else np.zeros(y.shape[1], np.float32))
+        xs = x - self.x_mean_
+        if self.standardize:
+            scale = xs.std(axis=0)
+            self.x_scale_ = np.where(scale > 0, scale,
+                                     1.0).astype(np.float32)
+            xs = xs / self.x_scale_
+        else:
+            self.x_scale_ = np.ones(x.shape[1], np.float32)
+        yc = y - self.y_mean_
+        return xs, yc, t_f
+
+    def _gram(self, xs):
+        """``Xᵀ X`` through the distla budget dispatcher (replicated
+        einsum under the budget; SUMMA ring with the feature axis
+        mesh-sharded over it)."""
+        return distla.gram(xs, mesh=self.mesh,
+                           precision=self.precision,
+                           normalize=False)
+
+    def _sweep_blocks(self, program, fixed_args, grid, block, n_vox,
+                      checkpoint_dir, checkpoint_every, fingerprint,
+                      name):
+        """Drive ``program(*fixed_args, block_rows)`` over
+        equal-sized blocks of ``grid`` rows under the resilient-loop
+        driver, filling the host [n_grid, V] score matrix.  Blocks
+        are padded (repeating the last row) so every call shares one
+        program shape; with ``checkpoint_dir`` a preempted sweep
+        resumes at the last completed block.  ``block`` must already
+        be normalized (the caller built the program with it — the
+        padded rows must match its traced static shape)."""
+        n = grid.shape[0]
+        n_blocks = -(-n // block)
+
+        def run_chunk(state, step, n_steps):
+            # copy-on-write: the previous state is the rollback
+            # target.  Host syncs are the contract here — finished
+            # block scores must land in host state to be
+            # checkpointable (the sweep program itself is sync-free).
+            out = np.array(state["scores"],  # jaxlint: disable=JX002
+                           copy=True)
+            for b in range(step, step + n_steps):
+                start = b * block
+                stop = min(start + block, n)
+                rows = grid[start:start + block]
+                if rows.shape[0] < block:
+                    pad = np.repeat(rows[-1:],
+                                    block - rows.shape[0], axis=0)
+                    rows = np.concatenate([rows, pad], axis=0)
+                with obs_spans.span("encoding.sweep_chunk",
+                                    attrs={"block": b,
+                                           "rows": stop - start}):
+                    got = np.asarray(  # jaxlint: disable=JX002
+                        program(*fixed_args, jnp.asarray(rows)))
+                out[start:stop] = got[:stop - start]
+            return {"scores": out}, False
+
+        zeros = np.zeros((n, n_vox), dtype=np.float32)
+        state, _ = run_resilient_loop(
+            run_chunk, {"scores": zeros}, n_blocks,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            fingerprint=fingerprint,
+            template={"scores": np.zeros_like(zeros)}, name=name)
+        return state["scores"]
+
+    def _fingerprint(self, checkpoint_dir, xs, yc, grid, block):
+        if checkpoint_dir is None:
+            return None
+        # the grid AND the block size participate: resilient-loop
+        # steps are counted in blocks, so a resume against the same
+        # data but a different grid or block size must restart, not
+        # mix (or silently skip) score rows
+        return np.array(
+            [array_digest(xs), array_digest(yc), array_digest(grid),
+             float(self.n_folds), float(grid.shape[0]),
+             float(block)])
+
+    def _check_fitted(self):
+        if not hasattr(self, "W_"):
+            raise ValueError(
+                f"this {type(self).__name__} is not fitted yet; "
+                "call fit(X, Y) first")
+
+    # -- fit / predict ------------------------------------------------
+    def fit(self, X, Y, checkpoint_dir=None, checkpoint_every=1):
+        """Fit per-voxel ridge with CV lambda selection.
+
+        X : [T, F] design (stimulus/feature embedding per TR).
+        Y : [T, V] responses (voxels).
+        checkpoint_dir, checkpoint_every : persist the accumulated
+            CV scores every ``checkpoint_every`` lambda blocks and
+            resume a preempted sweep at the last completed block
+            (the resilient fit contract every estimator honors).
+        """
+        self.lambdas_ = self._validate_grid()
+        xs, yc, t_f = self._prepare_data(X, Y)
+        f = xs.shape[1]
+        v = yc.shape[1]
+        with obs_spans.span("encoding.fit",
+                            attrs={"estimator": "RidgeEncoder",
+                                   "n_voxels": int(v),
+                                   "n_features": int(f),
+                                   "n_lambdas":
+                                       int(self.lambdas_.size)}):
+            g_total = self._gram(xs)
+            prep = _prepare_program(
+                xs.shape[0], self.n_folds, t_f, f, v,
+                resolve_precision(self.precision))
+            # X and Y cross the host-device boundary ONCE: the fold
+            # tensors are sliced out of the full arrays inside the
+            # program, and the sweep consumes its device outputs
+            evals, a, p, y_folds_d, b_total = prep(
+                jnp.asarray(xs), jnp.asarray(yc), g_total)
+            n_lam = int(self.lambdas_.size)
+            block = n_lam if self.lambda_block is None \
+                else max(1, min(int(self.lambda_block), n_lam))
+            sweep = _sweep_program(
+                self.n_folds, t_f, f, v, block,
+                resolve_precision(self.precision))
+            scores = self._sweep_blocks(
+                sweep, (evals, a, p, y_folds_d),
+                self.lambdas_, block, v, checkpoint_dir,
+                checkpoint_every,
+                self._fingerprint(checkpoint_dir, xs, yc,
+                                  self.lambdas_, block),
+                name="encoding.fit")
+            self.cv_scores_ = scores
+            best = np.argmax(scores, axis=0)
+            self.lambda_ = self.lambdas_[best]
+            refit = _refit_program(
+                f, v, resolve_precision(self.precision))
+            self.W_ = np.asarray(refit(g_total, b_total,
+                                       jnp.asarray(self.lambda_)))
+        return self
+
+    def predict(self, X):
+        """Predicted responses [T, V] for a new design [T, F]."""
+        self._check_fitted()
+        x = np.asarray(X, dtype=np.float32)
+        if x.ndim != 2 or x.shape[1] != self.W_.shape[0]:
+            raise ValueError(
+                f"expected X [T, {self.W_.shape[0]}], got {x.shape}")
+        xs = (x - self.x_mean_) / self.x_scale_
+        return xs @ self.W_ + self.y_mean_
+
+    def score(self, X, Y):
+        """Per-voxel Pearson r [V] between ``predict(X)`` and ``Y``
+        — the serve engine's scoring semantics on host."""
+        pred = self.predict(X)
+        y = np.asarray(Y, dtype=np.float32)
+        if y.shape != pred.shape:
+            raise ValueError(
+                f"expected Y {pred.shape}, got {y.shape}")
+        pc = pred - pred.mean(axis=0)
+        yc = y - y.mean(axis=0)
+        den = np.sqrt((pc * pc).sum(axis=0) * (yc * yc).sum(axis=0))
+        cov = (pc * yc).sum(axis=0)
+        return np.where(den > 0, cov / np.where(den > 0, den, 1.0),
+                        0.0).astype(np.float32)
+
+
+class BandedRidgeEncoder(RidgeEncoder):
+    """Banded ridge: one lambda per feature *band* (feature grouping
+    — e.g. motion-energy vs. semantic embeddings), selected jointly
+    per voxel over a candidate grid.
+
+    Parameters (beyond :class:`RidgeEncoder`)
+    -----------------------------------------
+    bands : int array [F]
+        Band id (0..n_bands-1) of every design column.
+    candidates : [C, n_bands] array, optional
+        Per-band lambda rows to sweep.  Default: the full Cartesian
+        grid of ``lambdas`` over the bands — refused above
+        ``max_candidates`` (pass explicit candidates, e.g. a random
+        search, for many bands).
+    candidate_block : int, default 8
+        Candidates scored per program call (each costs one scaled
+        ``eigh`` per fold; the block bounds transient memory and
+        sets the checkpoint granularity).
+    max_candidates : int, default 4096
+        Cap on the default Cartesian grid.
+
+    After fit, ``lambda_`` is [V, n_bands] (the selected candidate
+    row per voxel) and ``cv_scores_`` is [C, V].
+    """
+
+    def __init__(self, bands, lambdas=None, candidates=None,
+                 n_folds=5, fit_intercept=True, standardize=False,
+                 candidate_block=8, mesh=None, precision=None,
+                 max_candidates=4096):
+        super().__init__(lambdas=lambdas, n_folds=n_folds,
+                         fit_intercept=fit_intercept,
+                         standardize=standardize, mesh=mesh,
+                         precision=precision)
+        self.bands = np.asarray(bands, dtype=np.int32)
+        self.candidates = candidates
+        self.candidate_block = int(candidate_block)
+        self.max_candidates = int(max_candidates)
+
+    def _candidate_grid(self):
+        if self.bands.ndim != 1 or np.any(self.bands < 0):
+            raise ValueError(
+                "bands must be a 1-D array of non-negative band ids")
+        n_bands = int(self.bands.max()) + 1
+        if not np.array_equal(np.unique(self.bands),
+                              np.arange(n_bands)):
+            # sparse ids would silently inflate the Cartesian grid
+            # (bands=[0, 5] -> a 6-band product of duplicates)
+            raise ValueError(
+                "bands ids must be dense 0..n_bands-1; got "
+                f"{sorted(set(self.bands.tolist()))}")
+        if self.candidates is not None:
+            cand = np.asarray(self.candidates, dtype=np.float32)
+            if cand.ndim != 2 or cand.shape[1] != n_bands:
+                raise ValueError(
+                    f"candidates must be [C, {n_bands}] for "
+                    f"{n_bands} bands; got {cand.shape}")
+            if not np.all(np.isfinite(cand)) or np.any(cand <= 0):
+                raise ValueError(
+                    "candidates must be finite and positive")
+            return cand
+        grid = self._validate_grid()
+        n = grid.size ** n_bands
+        if n > self.max_candidates:
+            raise ValueError(
+                f"the full {grid.size}^{n_bands} = {n} candidate "
+                f"grid exceeds max_candidates={self.max_candidates}"
+                "; pass an explicit candidates array")
+        mesh_axes = np.meshgrid(*([grid] * n_bands), indexing="ij")
+        return np.stack([m.ravel() for m in mesh_axes],
+                        axis=1).astype(np.float32)
+
+    def fit(self, X, Y, checkpoint_dir=None, checkpoint_every=1):
+        """Fit banded ridge with joint per-voxel candidate selection
+        (same resilient contract as :meth:`RidgeEncoder.fit`, chunked
+        over candidate blocks)."""
+        self.lambdas_ = self._validate_grid()
+        xs, yc, t_f = self._prepare_data(X, Y)
+        f = xs.shape[1]
+        v = yc.shape[1]
+        if self.bands.shape[0] != f:
+            raise ValueError(
+                f"bands has {self.bands.shape[0]} entries for "
+                f"{f} design columns")
+        cand = self._candidate_grid()
+        self.candidates_ = cand
+        scales = (1.0 / np.sqrt(cand[:, self.bands])).astype(
+            np.float32)
+        block = max(1, min(self.candidate_block, cand.shape[0]))
+        with obs_spans.span("encoding.fit",
+                            attrs={"estimator": "BandedRidgeEncoder",
+                                   "n_voxels": int(v),
+                                   "n_features": int(f),
+                                   "n_candidates":
+                                       int(cand.shape[0])}):
+            g_total = self._gram(xs)
+            prep = _banded_prepare_program(
+                xs.shape[0], self.n_folds, t_f, f, v,
+                resolve_precision(self.precision))
+            # one transfer per operand; folds slice out on device
+            x_folds_d, y_folds_d, g_tr, b_tr, b_total = prep(
+                jnp.asarray(xs), jnp.asarray(yc), g_total)
+            sweep = _banded_sweep_program(
+                self.n_folds, t_f, f, v, block,
+                resolve_precision(self.precision))
+            scores = self._sweep_blocks(
+                sweep, (g_tr, b_tr, x_folds_d, y_folds_d),
+                scales, block, v, checkpoint_dir, checkpoint_every,
+                self._fingerprint(checkpoint_dir, xs, yc, scales,
+                                  block),
+                name="encoding.fit")
+            self.cv_scores_ = scores
+            best = np.argmax(scores, axis=0)
+            self.lambda_ = cand[best]
+            self.W_ = self._banded_refit(
+                g_total, b_total, scales, best, block, f, v)
+        return self
+
+    def _banded_refit(self, g_total, b_total, scales, best, block,
+                      f, v):
+        """Assemble the mixed-candidate [F, V] coefficient block by
+        block: each program call refits one candidate block on all
+        data and masks in exactly the voxel columns that selected a
+        candidate of the block (blocks nobody selected are skipped
+        host-side — no device work for unused candidates)."""
+        refit = _banded_refit_program(
+            f, v, block, resolve_precision(self.precision))
+        w = np.zeros((f, v), dtype=np.float32)
+        n = scales.shape[0]
+        for start in range(0, n, block):
+            stop = min(start + block, n)
+            onehot = (best[None, :]
+                      == np.arange(start, stop)[:, None])
+            if not onehot.any():
+                continue
+            rows = scales[start:start + block]
+            if rows.shape[0] < block:
+                pad = block - rows.shape[0]
+                rows = np.concatenate(
+                    [rows, np.repeat(rows[-1:], pad, axis=0)],
+                    axis=0)
+                onehot = np.concatenate(
+                    [onehot, np.zeros((pad, v), dtype=bool)],
+                    axis=0)
+            # host accumulation is the point: each block's masked
+            # [F, V] contribution lands in the host coefficient
+            # (bounded memory for any candidate count)
+            w += np.asarray(refit(  # jaxlint: disable=JX002
+                jnp.asarray(g_total), b_total, jnp.asarray(rows),
+                jnp.asarray(onehot.astype(np.float32))))
+        return w
+
+
+# -- CI selfcheck (tools/run_checks.py `encoding` gate) ---------------
+
+def selfcheck(out=None):
+    """Smoke the encoding tier for the ``encoding`` CI gate (ENC001):
+    sklearn-``Ridge`` per-voxel prediction parity at the CV-selected
+    lambdas, the sharded raw-product Gram path on the CPU mesh, a
+    banded fit, and retrace stability (a repeat fit must not rebuild
+    any ``encoding.*`` program).  Prints a JSON verdict; returns 0 on
+    pass, 1 on failure."""
+    import json
+    import sys
+
+    from sklearn.linear_model import Ridge
+
+    from ..obs import metrics as obs_metrics
+    from ..parallel.mesh import (DEFAULT_VOXEL_AXIS, make_mesh,
+                                 max_divisible_shards)
+
+    stream = out or sys.stdout
+    rng = np.random.RandomState(0)
+    t, f, v = 48, 12, 32
+    x = rng.randn(t, f).astype(np.float32)
+    w0 = rng.randn(f, v).astype(np.float32)
+    y = (x @ w0 + 0.5 * rng.randn(t, v)).astype(np.float32)
+    lambdas = (1.0, 10.0, 100.0)
+
+    errs = []
+    # sharded raw-product Gram parity (the encoding Xᵀ X path over
+    # the CPU mesh ring)
+    mesh = make_mesh((DEFAULT_VOXEL_AXIS,),
+                     (max_divisible_shards(f),))
+    g_ring = np.asarray(distla.gram(x, mesh=mesh, force="summa",
+                                    normalize=False))
+    errs.append(float(np.max(np.abs(g_ring - x.T @ x)))
+                / max(1.0, float(np.max(np.abs(x.T @ x)))))
+
+    enc = None
+    for _ in range(2):  # second fit must hit every program cache
+        enc = RidgeEncoder(lambdas=lambdas, n_folds=3,
+                           mesh=mesh).fit(x, y)
+    pred = enc.predict(x)
+    sk = np.empty_like(pred)
+    for lam in np.unique(enc.lambda_):
+        cols = enc.lambda_ == lam
+        model = Ridge(alpha=float(lam)).fit(x, y[:, cols])
+        sk[:, cols] = model.predict(x).reshape(t, -1)
+    errs.append(float(np.max(np.abs(pred - sk)))
+                / max(1.0, float(np.max(np.abs(sk)))))
+
+    bands = np.repeat(np.arange(2), f // 2)
+    for _ in range(2):
+        banded = BandedRidgeEncoder(
+            bands, lambdas=(1.0, 100.0), n_folds=3,
+            candidate_block=2).fit(x, y)
+    r = banded.score(x, y)
+    ok_banded = bool(np.all(np.isfinite(r))) and r.shape == (v,)
+
+    retrace = obs_metrics.counter("retrace_total")
+    sites = {site: retrace.value(site=site)
+             for site in ("encoding.prepare", "encoding.sweep",
+                          "encoding.refit",
+                          "encoding.banded_prepare",
+                          "encoding.banded_sweep",
+                          "encoding.banded_refit")
+             if retrace.value(site=site)}
+    tol = 1e-3
+    sites_ok = {"encoding.prepare", "encoding.sweep",
+                "encoding.refit"} <= set(sites)
+    ok = (max(errs) < tol and ok_banded and sites_ok
+          and all(c <= 1.0 for c in sites.values()))
+    json.dump({"ok": bool(ok), "max_err": max(errs), "tol": tol,
+               "banded_finite": ok_banded,
+               "sites_present": sites_ok, "retraces": sites},
+              stream)
+    stream.write("\n")
+    return 0 if ok else 1

@@ -221,7 +221,7 @@ def summa_matmul(a, mesh, b=None, axis_names=None, precision=None):
 
 
 def summa_gram(data, mesh, data_b=None, axis_names=None,
-               precision=None):
+               precision=None, normalize=True):
     """All-pairs Pearson correlation of the columns of ``data``
     (against ``data_b`` when given) computed as a SUMMA ring over the
     mesh — O(V/n) per-device input memory, O(V²/n) output, only
@@ -229,15 +229,19 @@ def summa_gram(data, mesh, data_b=None, axis_names=None,
 
     Column z-scoring runs shard-local after placement (the full
     [T, V] array is never resident on one device); NaN columns
-    propagate NaN rows/columns (see :func:`_zscore_cols`).  For data
-    small enough to replicate, prefer :func:`gram` which dispatches
-    on the budget.
+    propagate NaN rows/columns (see :func:`_zscore_cols`).  With
+    ``normalize=False`` the z-scoring is skipped and the result is
+    the raw product ``dataᵀ @ data_b`` — the encoding tier's
+    ``Xᵀ X`` path (zero pad columns still contribute exact zeros,
+    so uneven splits stay exact).  For data small enough to
+    replicate, prefer :func:`gram` which dispatches on the budget.
     """
     names, _, n_shards = _ring_axes(mesh, axis_names)
     v = data.shape[1]
     if data_b is not None and data_b.shape != data.shape:
         raise ValueError(
             f"data_b shape {data_b.shape} != data shape {data.shape}")
+    norm = _zscore_cols if normalize else (lambda z: z)
     with obs_spans.span("distla.gram",
                         attrs={"n_voxels": int(v),
                                "n_shards": int(n_shards),
@@ -247,9 +251,8 @@ def summa_gram(data, mesh, data_b=None, axis_names=None,
             PartitionSpec(None, names if len(names) > 1 else names[0]))
         # shard FIRST, z-score after: z-scoring is columnwise, so it
         # runs shard-local and the full array never lands on one chip
-        z = _zscore_cols(place_on_mesh(_pad_cols(data, n_shards)[0],
-                                       spec))
-        z_b = z if data_b is None else _zscore_cols(
+        z = norm(place_on_mesh(_pad_cols(data, n_shards)[0], spec))
+        z_b = z if data_b is None else norm(
             place_on_mesh(_pad_cols(data_b, n_shards)[0], spec))
         out = _summa_program(mesh, names, resolve_precision(precision))(
             z, z_b)
@@ -257,7 +260,7 @@ def summa_gram(data, mesh, data_b=None, axis_names=None,
 
 
 def gram(data, mesh=None, data_b=None, axis_names=None, precision=None,
-         budget_bytes=None, force=None):
+         budget_bytes=None, force=None, normalize=True):
     """Pearson Gram with budget-based dispatch.
 
     Small problems run the replicated einsum (no collectives); when
@@ -267,6 +270,9 @@ def gram(data, mesh=None, data_b=None, axis_names=None, precision=None,
     SUMMA ring computes the same result with O(1/n) per-device
     memory.  ``force='replicated'`` raises instead of silently
     exceeding the budget; ``force='summa'`` always takes the ring.
+    ``normalize=False`` skips the column z-scoring on either path and
+    returns the raw ``dataᵀ @ data_b`` product — how the encoding
+    tier gets its ``Xᵀ X`` through the same dispatcher.
     """
     if force not in (None, "replicated", "summa"):
         raise ValueError(
@@ -303,17 +309,19 @@ def gram(data, mesh=None, data_b=None, axis_names=None, precision=None,
         if mesh is None:
             raise ValueError("the SUMMA path needs a mesh")
         return summa_gram(data, mesh, data_b=data_b,
-                          axis_names=axis_names, precision=precision)
+                          axis_names=axis_names, precision=precision,
+                          normalize=normalize)
     if over:
         logger.warning(
             "replicated Gram working set (~%d bytes) exceeds the "
             "%d-byte budget and no mesh was given; computing "
             "replicated anyway", need, budget)
+    norm = _zscore_cols if normalize else (lambda z: z)
     with obs_spans.span("distla.gram",
                         attrs={"n_voxels": int(v), "n_shards": 1,
                                "kind": "replicated"}):
-        z = _zscore_cols(jnp.asarray(data))
-        z_b = z if data_b is None else _zscore_cols(jnp.asarray(data_b))
+        z = norm(jnp.asarray(data))
+        z_b = z if data_b is None else norm(jnp.asarray(data_b))
         return jnp.matmul(z.T, z_b,
                           precision=resolve_precision(precision),
                           preferred_element_type=z.dtype)

@@ -14,7 +14,10 @@ Two subcommands:
 - ``bench [--model M.npz] [--n-requests N]`` — serving
   micro-benchmark: mixed-TR synthetic requests against the model (a
   tiny deterministic SRM is fitted in-process when no artifact is
-  given), one warm pass (compiles) + one timed steady pass, printed
+  given; generators exist for the :data:`BENCH_KINDS` — SRM-family
+  transform and ``ridge_encoding`` held-out-scan scoring — and any
+  other artifact kind is rejected rc=2 with the supported kinds
+  named), one warm pass (compiles) + one timed steady pass, printed
   as a bench-schema JSON line (``metric``/``value``/``unit``/
   ``vs_baseline``/``tier="serve"``) that
   ``python -m brainiak_tpu.obs regress`` can gate.
@@ -37,11 +40,12 @@ import time
 
 import numpy as np
 
-from .artifacts import load_model, save_model
+from .artifacts import detect_kind, load_model, save_model
 from .batching import BucketPolicy, Request, load_requests
 from .engine import InferenceEngine
 
-__all__ = ["bench_record", "build_demo_model",
+__all__ = ["BENCH_KINDS", "bench_record", "build_demo_model",
+           "build_encoding_model", "build_encoding_requests",
            "build_mixed_requests", "main", "measure",
            "naive_requests_per_sec", "summary_to_out"]
 
@@ -143,6 +147,50 @@ def build_mixed_requests(model, n_requests, seed=0,
     return out
 
 
+def build_encoding_model(voxels=64, features=16, samples=80,
+                         n_folds=4, seed=0):
+    """A small fitted :class:`~brainiak_tpu.encoding.RidgeEncoder`
+    for benches/fixtures: deterministic synthetic ``Y = X W + noise``
+    data, a 3-point lambda grid."""
+    from ..encoding import RidgeEncoder
+
+    rng = np.random.RandomState(seed)
+    x = rng.randn(samples, features).astype(np.float32)
+    w = rng.randn(features, voxels).astype(np.float32)
+    y = (x @ w + 0.5 * rng.randn(samples, voxels)).astype(np.float32)
+    return RidgeEncoder(lambdas=(1.0, 10.0, 100.0),
+                        n_folds=n_folds).fit(x, y)
+
+
+def build_encoding_requests(model, n_requests, seed=0,
+                            tr_choices=(24, 40, 100, 150)):
+    """Mixed-TR held-out-scan scoring requests against a fitted
+    encoding model: each payload is a ``(features, responses)`` pair
+    whose responses are the model's own predictions plus noise, TR
+    lengths drawn from ``tr_choices`` (several buckets)."""
+    rng = np.random.RandomState(seed)
+    f, v = model.W_.shape
+    out = []
+    for i in range(n_requests):
+        trs = int(tr_choices[i % len(tr_choices)])
+        feats = rng.randn(trs, f).astype(np.float32)
+        resp = (model.predict(feats)
+                + 0.5 * rng.randn(trs, v)).astype(np.float32)
+        out.append(Request(request_id=f"r{i}", x=(feats, resp)))
+    return out
+
+
+#: kind -> synthetic request generator for the ``bench`` subcommand
+#: (the model kinds bench can drive without a request file; every
+#: other kind serves fine through ``run``).
+BENCH_KINDS = {
+    "srm": build_mixed_requests,
+    "detsrm": build_mixed_requests,
+    "rsrm": build_mixed_requests,
+    "ridge_encoding": build_encoding_requests,
+}
+
+
 def measure(model, requests, policy=None, warm=True):
     """Requests/s + latency percentiles for one engine drive.
 
@@ -171,13 +219,22 @@ def measure(model, requests, policy=None, warm=True):
 
 
 def naive_requests_per_sec(model, requests):
-    """The unbatched reference path: one host-BLAS ``W_iᵀ x`` per
-    request, no bucketing, no reuse — the ``vs_baseline``
-    denominator for the serve bench."""
-    w = [np.asarray(wi) for wi in model.w_]
-    t0 = time.perf_counter()
-    for req in requests:
-        w[req.subject].T @ np.asarray(req.x)
+    """The unbatched reference path: one host-BLAS pass per request,
+    no bucketing, no reuse — the ``vs_baseline`` denominator for the
+    serve bench.  Dispatches on the model's artifact kind (the same
+    key :data:`BENCH_KINDS` uses): SRM-family models run ``W_iᵀ x``
+    per request; encoding models run predict + per-voxel correlation
+    (the same work the engine's scoring program batches)."""
+    kind = detect_kind(model)
+    if kind == "ridge_encoding":  # per-request host scoring
+        t0 = time.perf_counter()
+        for req in requests:
+            model.score(np.asarray(req.x[0]), np.asarray(req.x[1]))
+    else:  # SRM family: per-subject projection
+        w = [np.asarray(wi) for wi in model.w_]
+        t0 = time.perf_counter()
+        for req in requests:
+            w[req.subject].T @ np.asarray(req.x)
     wall = time.perf_counter() - t0
     return len(requests) / wall if wall > 0 else float("inf")
 
@@ -223,6 +280,9 @@ def bench_record(out, n_requests, kind="srm", max_batch=None,
     baseline = float(out.get("baseline_rps") or 0.0)
     vs = round(rps / baseline, 3) \
         if baseline > 0 and np.isfinite(baseline) else 0.0
+    # the encoding read path scores held-out scans; every other
+    # bench-able kind transforms
+    op = "score" if kind == "ridge_encoding" else "transform"
     config = {
         "n_requests": n_requests,
         "n_buckets": out["n_buckets"],
@@ -241,7 +301,7 @@ def bench_record(out, n_requests, kind="srm", max_batch=None,
     if backend:
         config["backend"] = backend
     rec = {"schema_version": BENCH_SCHEMA_VERSION,
-           "metric": f"serve_{kind}_transform_requests_per_sec",
+           "metric": f"serve_{kind}_{op}_requests_per_sec",
            "value": round(rps, 2),
            "unit": "requests/sec",
            "vs_baseline": vs,
@@ -259,21 +319,25 @@ def bench_record(out, n_requests, kind="srm", max_batch=None,
 def _bench(args):
     if args.model:
         model = load_model(args.model)
-        # the synthetic workload generator drives SRM-family
-        # transform (per-subject w_); other kinds load and serve
-        # fine via `run`, but bench has no request generator for
-        # them — fail as a driver error (rc=2), not a traceback
-        if not hasattr(model, "w_"):
+        # the synthetic workload generators cover the SRM-family
+        # transform kinds and encoding-model scoring; other kinds
+        # load and serve fine via `run`, but bench has no request
+        # generator for them — fail as a driver error (rc=2) that
+        # NAMES the supported kinds, not a traceback
+        kind = detect_kind(model)
+        if kind not in BENCH_KINDS:
             raise ValueError(
-                "bench generates SRM-family transform requests; "
-                f"model artifact is kind {type(model).__name__!r} "
-                "— use `run` with a request file instead")
+                "bench generates synthetic requests only for kinds "
+                f"{', '.join(sorted(BENCH_KINDS))}; model artifact "
+                f"is kind {kind!r} — use `run` with a request file "
+                "instead")
     else:
         model = build_demo_model()
+        kind = "srm"
         if args.save_model:
             save_model(model, args.save_model)
-    requests = build_mixed_requests(model, args.n_requests,
-                                    seed=args.seed)
+    requests = BENCH_KINDS[kind](model, args.n_requests,
+                                 seed=args.seed)
     policy = _policy(args)
     summary = measure(model, requests, policy=policy)
     import jax

@@ -351,6 +351,73 @@ class IEM2DAdapter(ModelAdapter):
         return model
 
 
+class RidgeEncodingAdapter(ModelAdapter):
+    """Voxel-wise encoding models
+    (:class:`brainiak_tpu.encoding.RidgeEncoder` and its banded
+    subclass, distinguished by the ``banded`` flag): the deployment
+    surface is the affine map ``predict`` applies — coefficients,
+    preprocessing parameters — plus the CV-selected per-voxel
+    lambdas as provenance.  ``cv_scores_`` (the full [L, V] sweep
+    matrix) is deliberately NOT persisted: it is fit diagnostics,
+    not a serving input, and can dominate the artifact size."""
+
+    kind = "ridge_encoding"
+
+    def model_class(self):
+        from ..encoding.ridge import RidgeEncoder
+        return RidgeEncoder
+
+    def _banded_class(self):
+        from ..encoding.ridge import BandedRidgeEncoder
+        return BandedRidgeEncoder
+
+    def matches(self, model):
+        return type(model) in (self.model_class(),
+                               self._banded_class())
+
+    def pack(self, model):
+        self._fitted(model, "W_", "lambda_", "x_mean_", "x_scale_",
+                     "y_mean_", "lambdas_")
+        banded = type(model) is self._banded_class()
+        out = {
+            "W_": np.asarray(model.W_),
+            "lambda_": np.asarray(model.lambda_),
+            "x_mean_": np.asarray(model.x_mean_),
+            "x_scale_": np.asarray(model.x_scale_),
+            "y_mean_": np.asarray(model.y_mean_),
+            "lambdas_": np.asarray(model.lambdas_),
+        }
+        _put_scalar(out, "banded", banded)
+        _put_scalar(out, "n_folds", model.n_folds)
+        _put_scalar(out, "fit_intercept", model.fit_intercept)
+        _put_scalar(out, "standardize", model.standardize)
+        if banded:
+            out["bands"] = np.asarray(model.bands)
+            out["candidates_"] = np.asarray(model.candidates_)
+        return out
+
+    def unpack(self, z):
+        lambdas = np.asarray(z["lambdas_"])
+        kwargs = dict(lambdas=tuple(float(x) for x in lambdas),
+                      n_folds=_scalar(z, "n_folds"),
+                      fit_intercept=bool(_scalar(z, "fit_intercept")),
+                      standardize=bool(_scalar(z, "standardize")))
+        if bool(_scalar(z, "banded")):
+            model = self._banded_class()(
+                bands=np.asarray(z["bands"]),
+                candidates=np.asarray(z["candidates_"]), **kwargs)
+            model.candidates_ = np.asarray(z["candidates_"])
+        else:
+            model = self.model_class()(**kwargs)
+        model.W_ = np.asarray(z["W_"])
+        model.lambda_ = np.asarray(z["lambda_"])
+        model.x_mean_ = np.asarray(z["x_mean_"])
+        model.x_scale_ = np.asarray(z["x_scale_"])
+        model.y_mean_ = np.asarray(z["y_mean_"])
+        model.lambdas_ = lambdas
+        return model
+
+
 class FCMAClassifierAdapter(ModelAdapter):
     """FCMA correlation classifier.  The wrapped sklearn estimator is
     stored as labeled pickle bytes (see the module docstring's trust
@@ -404,7 +471,7 @@ class FCMAClassifierAdapter(ModelAdapter):
 ADAPTERS = {a.kind: a for a in (
     SRMAdapter(), DetSRMAdapter(), RSRMAdapter(),
     EventSegmentAdapter(), IEM1DAdapter(), IEM2DAdapter(),
-    FCMAClassifierAdapter())}
+    RidgeEncodingAdapter(), FCMAClassifierAdapter())}
 
 
 def detect_kind(model):
@@ -479,9 +546,12 @@ def load_model(file):
     kind = str(arrays[KIND_KEY])
     version = int(arrays[VERSION_KEY])
     if version > SCHEMA_VERSION:
+        # checked BEFORE any adapter unpack: a future artifact must
+        # fail with this message, never a KeyError mid-decode
         raise ValueError(
-            f"artifact schema v{version} is newer than this loader "
-            f"understands (v{SCHEMA_VERSION}); upgrade brainiak_tpu")
+            f"unsupported schema version: artifact is v{version}, "
+            f"newer than this loader understands "
+            f"(v{SCHEMA_VERSION}); upgrade brainiak_tpu")
     adapter = ADAPTERS.get(kind)
     if adapter is None:
         raise ValueError(

@@ -150,6 +150,36 @@ def _eventseg_program(n_vox, t_len, k, b_pad, dtype):
                                        span="serve.batch")
 
 
+@program_cache("serve.encoding")
+def _encoding_program(n_feat, n_vox, t_bucket, b_pad, dtype):
+    """Batched encoding-model scoring: predict every scan from its
+    features through the fitted affine map, then per-voxel Pearson r
+    against the observed responses.  The TR axis is zero-padded to
+    the bucket and the pad rows are MASKED out of the correlation
+    moments before the reduction (``t_real`` carries each request's
+    true length), so padding is exact for the real rows."""
+
+    @partial(jax.jit, donate_argnums=_donate(2, 3))
+    def run(w, b, x, y, t_real):
+        pred = jnp.einsum('btf,fv->btv', x, w,
+                          precision=PRECISION) + b[None, None, :]
+        mask = (jnp.arange(x.shape[1])[None, :]
+                < t_real[:, None]).astype(x.dtype)
+        n = jnp.maximum(t_real, 1).astype(x.dtype)[:, None]
+        pm = jnp.einsum('btv,bt->bv', pred, mask) / n
+        ym = jnp.einsum('btv,bt->bv', y, mask) / n
+        pc = (pred - pm[:, None, :]) * mask[:, :, None]
+        yc = (y - ym[:, None, :]) * mask[:, :, None]
+        cov = jnp.einsum('btv,btv->bv', pc, yc)
+        den = jnp.sqrt(jnp.einsum('btv,btv->bv', pc, pc)
+                       * jnp.einsum('btv,btv->bv', yc, yc))
+        return jnp.where(den > 0,
+                         cov / jnp.where(den > 0, den, 1.0), 0.0)
+
+    return obs_profile.profile_program(run, "serve.encoding",
+                                       span="serve.batch")
+
+
 @program_cache("serve.iem")
 def _iem_program(t_bucket, n_vox, k_chan, density, b_pad, dtype):
     """IEM1D predict: channel responses via the precomputed
@@ -432,6 +462,81 @@ class _IEM1DOp(_ServeOp):
                 for i, r in enumerate(reqs)]
 
 
+class _RidgeEncodingOp(_ServeOp):
+    """Encoding-model scoring: a request is a ``(features [T, F],
+    responses [T, V])`` pair for one held-out scan; the result is the
+    per-voxel correlation [V] between the model's predicted and the
+    observed responses — the heavy read path of the encoding tier.
+
+    The fitted preprocessing (centering/standardization) is folded
+    into one affine map at engine construction, so the program is a
+    pure matmul + masked correlation; requests bucket on the TR
+    length and pad rows are masked before the per-voxel reduction
+    (padding-exact by construction)."""
+
+    site = "serve.encoding"
+
+    def __init__(self, model, policy):
+        super().__init__(model, policy)
+        self.n_features, self.n_vox = model.W_.shape
+        self.dtype = np.asarray(model.W_).dtype
+        w_eff = np.asarray(model.W_) \
+            / np.asarray(model.x_scale_)[:, None]
+        b_eff = np.asarray(model.y_mean_) \
+            - (np.asarray(model.x_mean_)
+               / np.asarray(model.x_scale_)) @ np.asarray(model.W_)
+        self.w = jnp.asarray(w_eff.astype(self.dtype))
+        self.b = jnp.asarray(b_eff.astype(self.dtype))
+
+    def validate(self, req):
+        x = req.x
+        if not isinstance(x, (tuple, list)) or len(x) != 2:
+            return ("invalid_shape",
+                    "payload must be a (features, responses) pair")
+        feats, resp = (np.asarray(p) for p in x)
+        if feats.ndim != 2 or feats.shape[1] != self.n_features:
+            return ("invalid_shape",
+                    f"expected features [TRs, {self.n_features}], "
+                    f"got {feats.shape}")
+        if resp.ndim != 2 or resp.shape[1] != self.n_vox \
+                or resp.shape[0] != feats.shape[0]:
+            return ("invalid_shape",
+                    f"expected responses [{feats.shape[0]}, "
+                    f"{self.n_vox}], got {resp.shape}")
+        if feats.shape[0] < 2:
+            return ("invalid_shape",
+                    "per-voxel correlation needs at least 2 TRs")
+        return self._check_finite(x)
+
+    def bucket_key(self, req):
+        return (bucket_length(np.asarray(req.x[0]).shape[0],
+                              floor=self.policy.min_bucket),)
+
+    def padded_elements(self, key, b_pad):
+        return b_pad * key[0] * (self.n_features + self.n_vox)
+
+    def dispatch(self, reqs, key, b_pad):
+        t_b = key[0]
+        x = np.zeros((b_pad, t_b, self.n_features),
+                     dtype=self.dtype)
+        y = np.zeros((b_pad, t_b, self.n_vox), dtype=self.dtype)
+        # pad lanes keep t_real=1 so the masked moments never
+        # divide by zero; their (all-zero) scores are discarded
+        t_real = np.ones((b_pad,), dtype=np.int32)
+        for i, req in enumerate(reqs):
+            feats = np.asarray(req.x[0], dtype=self.dtype)
+            resp = np.asarray(req.x[1], dtype=self.dtype)
+            x[i, :feats.shape[0]] = feats
+            y[i, :resp.shape[0]] = resp
+            t_real[i] = feats.shape[0]
+        prog = _encoding_program(self.n_features, self.n_vox, t_b,
+                                 b_pad, str(self.dtype))
+        scores = np.asarray(prog(self.w, self.b, jnp.asarray(x),
+                                 jnp.asarray(y),
+                                 jnp.asarray(t_real)))
+        return [np.array(scores[i]) for i in range(len(reqs))]
+
+
 # (pair_voxels, TR bucket, flush size) combinations already traced by
 # the FCMA classifier's process-global jitted programs — mirrors
 # jax.jit's own cache lifetime, NOT any engine's (see dispatch below)
@@ -547,6 +652,7 @@ _KIND_OPS = {
     "rsrm": _RSRMTransformOp,
     "eventseg": _EventSegmentOp,
     "iem1d": _IEM1DOp,
+    "ridge_encoding": _RidgeEncodingOp,
     "fcma": _FCMAPredictOp,
 }
 
@@ -559,8 +665,8 @@ class InferenceEngine:
     model : a fitted estimator with a registered serve adapter
         (:data:`brainiak_tpu.serve.artifacts.ADAPTERS`) and an
         engine op (SRM/DetSRM/RSRM transform, EventSegment
-        find_events, InvertedEncoding1D predict, FCMA Classifier
-        predict).
+        find_events, InvertedEncoding1D predict, RidgeEncoder
+        held-out-scan scoring, FCMA Classifier predict).
     kind : str, optional
         Override adapter detection (useful for duck-typed models).
     policy : :class:`~brainiak_tpu.serve.batching.BucketPolicy`

@@ -1,0 +1,259 @@
+"""Encoding tier: eigendecomposition ridge vs sklearn, the
+one-program lambda sweep (ISSUE 7 acceptance), banded grouping,
+and the resilient checkpoint/resume contract."""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.encoding import BandedRidgeEncoder, RidgeEncoder
+from brainiak_tpu.obs import metrics
+
+ENC_SITES = ("encoding.prepare", "encoding.sweep", "encoding.refit",
+             "encoding.banded_prepare", "encoding.banded_sweep",
+             "encoding.banded_refit")
+
+
+def _retraces():
+    c = metrics.counter("retrace_total")
+    return sum(c.value(site=s) for s in ENC_SITES)
+
+
+def _make_data(t, f, v, seed=0, noise=0.5):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(t, f).astype(np.float32)
+    w = (rng.randn(f, v) / np.sqrt(f)).astype(np.float32)
+    y = (x @ w + noise * rng.randn(t, v)).astype(np.float32)
+    return x, y
+
+
+def _sklearn_predictions(enc, x, y):
+    """Per-voxel sklearn Ridge predictions at the CV-selected
+    lambdas (voxels grouped by selected lambda — sklearn fits one
+    multi-output Ridge per group)."""
+    from sklearn.linear_model import Ridge
+
+    sk = np.empty((x.shape[0], y.shape[1]), dtype=np.float64)
+    for lam in np.unique(enc.lambda_):
+        cols = enc.lambda_ == lam
+        model = Ridge(alpha=float(lam),
+                      fit_intercept=enc.fit_intercept).fit(
+                          x, y[:, cols])
+        sk[:, cols] = model.predict(x).reshape(x.shape[0], -1)
+    return sk
+
+
+def test_acceptance_scale_matches_sklearn():
+    """ISSUE 7 acceptance: (T=200, V=8192, F=512, 10 lambdas, 5
+    folds) matches sklearn Ridge per-voxel predictions to rtol 1e-4
+    at the CV-selected lambdas, with the whole fit compiling at most
+    one program per family (the lambda sweep is ONE program, not one
+    per lambda)."""
+    x, y = _make_data(200, 512, 8192)
+    lambdas = np.logspace(1, 3, 10)
+    before = _retraces()
+    enc = RidgeEncoder(lambdas=lambdas, n_folds=5).fit(x, y)
+    compiles = _retraces() - before
+    # prepare + sweep + refit — NOT one per lambda
+    assert compiles <= 3, compiles
+    assert enc.W_.shape == (512, 8192)
+    assert enc.cv_scores_.shape == (10, 8192)
+    np.testing.assert_allclose(enc.predict(x),
+                               _sklearn_predictions(enc, x, y),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_small_parity_without_intercept():
+    x, y = _make_data(48, 12, 20, seed=1)
+    enc = RidgeEncoder(lambdas=(1.0, 10.0, 100.0), n_folds=3,
+                       fit_intercept=False).fit(x, y)
+    np.testing.assert_allclose(enc.predict(x),
+                               _sklearn_predictions(enc, x, y),
+                               rtol=1e-4, atol=1e-4)
+    assert np.all(enc.x_mean_ == 0) and np.all(enc.y_mean_ == 0)
+
+
+def test_standardize_stores_scale_and_roundtrips():
+    rng = np.random.RandomState(2)
+    x = (rng.randn(60, 8) * rng.gamma(2.0, 2.0, 8)).astype(
+        np.float32)
+    y = _make_data(60, 8, 10, seed=2)[1]
+    enc = RidgeEncoder(lambdas=(1.0, 10.0), n_folds=3,
+                       standardize=True).fit(x, y)
+    assert enc.x_scale_.shape == (8,)
+    assert not np.allclose(enc.x_scale_, 1.0)
+    # predictions correlate with the targets (the affine map applies
+    # the stored preprocessing consistently)
+    assert enc.score(x, y).mean() > 0.5
+
+
+def test_rank_deficient_design_is_stable():
+    """F > T (the whole-brain encoding regime): the Gram is rank
+    deficient and the clamped eigensolver must stay finite and match
+    sklearn."""
+    x, y = _make_data(30, 64, 12, seed=3)
+    enc = RidgeEncoder(lambdas=(10.0, 100.0), n_folds=3).fit(x, y)
+    assert np.all(np.isfinite(enc.W_))
+    np.testing.assert_allclose(enc.predict(x),
+                               _sklearn_predictions(enc, x, y),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_lambda_block_chunking_is_exact():
+    """Block-chunked sweeps (the checkpointable path) score
+    identically to the one-block sweep, including an uneven last
+    block."""
+    x, y = _make_data(40, 8, 12, seed=4)
+    lams = (0.5, 5.0, 50.0, 500.0, 5000.0)
+    ref = RidgeEncoder(lambdas=lams, n_folds=4).fit(x, y)
+    blocked = RidgeEncoder(lambdas=lams, n_folds=4,
+                           lambda_block=2).fit(x, y)
+    np.testing.assert_allclose(blocked.cv_scores_, ref.cv_scores_,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(blocked.lambda_, ref.lambda_)
+    # a repeat fit of already-seen shapes reuses every program
+    before = _retraces()
+    RidgeEncoder(lambdas=lams, n_folds=4, lambda_block=2).fit(x, y)
+    assert _retraces() - before == 0
+
+
+def test_checkpoint_preempt_resume_parity(tmp_path):
+    """The resilient fit contract: a preemption mid-sweep resumes at
+    the last completed lambda block and lands on the same scores and
+    coefficients as an uninterrupted fit."""
+    from brainiak_tpu.resilience import faults
+
+    x, y = _make_data(40, 8, 12, seed=5)
+    lams = (0.5, 5.0, 50.0, 500.0)
+    ref = RidgeEncoder(lambdas=lams, n_folds=4,
+                       lambda_block=1).fit(x, y)
+    ckpt = str(tmp_path / "ck")
+    with pytest.raises(BaseException):
+        with faults.inject("preempt", at_step=2):
+            RidgeEncoder(lambdas=lams, n_folds=4,
+                         lambda_block=1).fit(
+                             x, y, checkpoint_dir=ckpt)
+    enc = RidgeEncoder(lambdas=lams, n_folds=4,
+                       lambda_block=1).fit(x, y,
+                                           checkpoint_dir=ckpt)
+    np.testing.assert_allclose(enc.cv_scores_, ref.cv_scores_)
+    np.testing.assert_allclose(enc.W_, ref.W_)
+
+
+def test_checkpoint_rejects_different_grid_or_block(tmp_path):
+    """A checkpoint written for one lambda grid must not resume a
+    sweep over another (score rows would silently mix), and a
+    changed block size must restart too — resilient-loop steps are
+    counted in BLOCKS, so a resume at the old step count under a
+    bigger block would silently skip unswept rows."""
+    from brainiak_tpu.resilience import faults
+
+    x, y = _make_data(40, 8, 12, seed=6)
+    ckpt = str(tmp_path / "ck")
+    with pytest.raises(BaseException):
+        with faults.inject("preempt", at_step=1):
+            RidgeEncoder(lambdas=(0.5, 5.0, 50.0, 500.0), n_folds=4,
+                         lambda_block=1).fit(
+                             x, y, checkpoint_dir=ckpt)
+    with pytest.raises(ValueError, match="different data"):
+        RidgeEncoder(lambdas=(1.0, 10.0, 100.0, 1000.0), n_folds=4,
+                     lambda_block=1).fit(x, y, checkpoint_dir=ckpt)
+    with pytest.raises(ValueError, match="different data"):
+        RidgeEncoder(lambdas=(0.5, 5.0, 50.0, 500.0), n_folds=4,
+                     lambda_block=2).fit(x, y, checkpoint_dir=ckpt)
+
+
+def test_banded_single_band_matches_plain_ridge():
+    """With one band, banded ridge (scaling trick, per-candidate
+    eigh) must reproduce the plain eigendecomposition sweep."""
+    x, y = _make_data(48, 10, 14, seed=7)
+    lams = (1.0, 10.0, 100.0)
+    plain = RidgeEncoder(lambdas=lams, n_folds=3).fit(x, y)
+    banded = BandedRidgeEncoder(np.zeros(10, np.int32),
+                                lambdas=lams, n_folds=3,
+                                candidate_block=3).fit(x, y)
+    np.testing.assert_allclose(banded.cv_scores_, plain.cv_scores_,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(banded.W_, plain.W_, rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_array_equal(banded.lambda_[:, 0],
+                                  plain.lambda_)
+
+
+def test_banded_selects_per_band_lambdas():
+    """Two bands, the second pure noise: the banded CV should
+    regularize the noise band at least as hard as the signal band
+    for most voxels, and every selected row must be a candidate."""
+    rng = np.random.RandomState(8)
+    t, v = 80, 24
+    x_sig = rng.randn(t, 6).astype(np.float32)
+    x_noise = rng.randn(t, 6).astype(np.float32)
+    x = np.concatenate([x_sig, x_noise], axis=1)
+    w = (rng.randn(6, v) / np.sqrt(6)).astype(np.float32)
+    y = (x_sig @ w + 0.3 * rng.randn(t, v)).astype(np.float32)
+    bands = np.repeat(np.arange(2), 6)
+    enc = BandedRidgeEncoder(bands, lambdas=(0.1, 10.0, 1000.0),
+                             n_folds=4, candidate_block=4).fit(x, y)
+    assert enc.lambda_.shape == (v, 2)
+    cand_rows = {tuple(row) for row in enc.candidates_}
+    assert all(tuple(row) in cand_rows for row in enc.lambda_)
+    assert np.median(enc.lambda_[:, 1]) >= np.median(
+        enc.lambda_[:, 0])
+
+
+def test_banded_candidate_grid_validation():
+    with pytest.raises(ValueError, match="candidates"):
+        BandedRidgeEncoder(np.zeros(4, np.int32),
+                           candidates=np.ones((3, 2))).fit(
+            *_make_data(30, 4, 6))
+    with pytest.raises(ValueError, match="max_candidates"):
+        BandedRidgeEncoder(np.repeat(np.arange(4), 2),
+                           lambdas=tuple(float(i + 1)
+                                         for i in range(10)),
+                           max_candidates=100).fit(
+            *_make_data(30, 8, 6))
+    with pytest.raises(ValueError, match="bands"):
+        BandedRidgeEncoder(np.zeros(5, np.int32)).fit(
+            *_make_data(30, 4, 6))
+    # sparse band ids would silently inflate the Cartesian grid
+    with pytest.raises(ValueError, match="dense"):
+        BandedRidgeEncoder(np.array([0, 0, 5, 5]),
+                           lambdas=(1.0, 10.0)).fit(
+            *_make_data(30, 4, 6))
+
+
+def test_input_validation():
+    x, y = _make_data(30, 4, 6)
+    with pytest.raises(ValueError, match="finite"):
+        RidgeEncoder().fit(np.full_like(x, np.nan), y)
+    with pytest.raises(ValueError, match="matching T"):
+        RidgeEncoder().fit(x, y[:-1])
+    with pytest.raises(ValueError, match="lambdas"):
+        RidgeEncoder(lambdas=(1.0, -2.0)).fit(x, y)
+    with pytest.raises(ValueError, match="folds"):
+        RidgeEncoder(n_folds=40).fit(x, y)
+    with pytest.raises(ValueError, match="not fitted"):
+        RidgeEncoder().predict(x)
+    enc = RidgeEncoder(lambdas=(1.0,), n_folds=3).fit(x, y)
+    with pytest.raises(ValueError, match="expected X"):
+        enc.predict(x[:, :-1])
+
+
+def test_gram_goes_through_distla_mesh():
+    """With a mesh, the Xᵀ X Gram runs through the distla dispatcher
+    (SUMMA when forced over budget) and the fit still matches the
+    meshless one."""
+    from brainiak_tpu.ops import distla
+    from brainiak_tpu.parallel import make_mesh, max_divisible_shards
+
+    x, y = _make_data(40, 8, 12, seed=9)
+    n = max_divisible_shards(8)
+    mesh = make_mesh(("voxel",), (n,))
+    # raw-product parity on the ring itself
+    g = np.asarray(distla.gram(x, mesh=mesh, force="summa",
+                               normalize=False))
+    np.testing.assert_allclose(g, x.T @ x, rtol=1e-4, atol=1e-3)
+    ref = RidgeEncoder(lambdas=(1.0, 10.0), n_folds=4).fit(x, y)
+    enc = RidgeEncoder(lambdas=(1.0, 10.0), n_folds=4,
+                       mesh=mesh).fit(x, y)
+    np.testing.assert_allclose(enc.W_, ref.W_, rtol=1e-4,
+                               atol=1e-5)

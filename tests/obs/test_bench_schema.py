@@ -126,3 +126,29 @@ def test_distla_tier_record_matches_obs_schema(monkeypatch):
     assert rec["config"]["n_voxels"] == 256
     assert rec["config"]["n_shards"] == out["n_shards"]
     assert rec["vs_baseline"] > 0
+
+
+def test_encoding_tier_record_matches_obs_schema(monkeypatch):
+    """The encoding tier (ISSUE 7): a tiny in-process run emits a
+    schema-valid bench record with the backend-split tier, so
+    `obs regress --only encoding` gates ridge-CV throughput
+    alongside fit, serving, and SUMMA-Gram throughput."""
+    monkeypatch.setenv("BENCH_ENCODING_VOXELS", "128")
+    out = bench.measure_tier("encoding")
+    assert out["voxels_lambdas_per_sec"] > 0
+    assert out["n_voxels"] == 128
+    assert out["n_lambdas"] == bench.ENCODING_N_LAMBDAS
+    stages = out["stages"]
+    assert set(bench.STAGE_KEYS) <= set(stages)
+    assert stages["steady_s"] > 0
+
+    rec = bench._encoding_result_record(out)
+    assert obs.validate_bench_record(rec) == []
+    # in-process run on the CPU test backend -> the fallback tier
+    assert rec["tier"] == "encoding_cpu_fallback"
+    assert rec["unit"] == "voxels*lambdas/sec"
+    assert rec["metric"] == \
+        "encoding_ridge_cv_voxels_lambdas_per_sec"
+    assert rec["config"]["n_voxels"] == 128
+    assert rec["config"]["n_folds"] == bench.ENCODING_FOLDS
+    assert rec["vs_baseline"] > 0

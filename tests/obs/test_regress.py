@@ -40,20 +40,23 @@ def test_tier_inference():
 def test_fixture_history_passes_and_gates():
     records, skipped = regress.load_bench_records([FIXTURE_DIR])
     # the real r01-r05 fcma trajectory + the serve_r01-r03 tier
-    # (PR 5) + the distla_r01-r03 tier (ISSUE 6), both measured
-    # host-side -> *_cpu_fallback: three tiers gating independently
-    # from one directory
-    assert len(records) == 11
+    # (PR 5) + the distla_r01-r03 tier (ISSUE 6) + the
+    # encoding_r01-r03 tier (ISSUE 7), all measured host-side ->
+    # *_cpu_fallback: four tiers gating independently from one
+    # directory
+    assert len(records) == 14
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
     assert tiers == {"cpu_fallback", "serve_cpu_fallback",
-                     "distla_cpu_fallback"}
+                     "distla_cpu_fallback",
+                     "encoding_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
     by_tier = {c["tier"]: c for c in result["checks"]}
     assert set(by_tier) == {"cpu_fallback", "serve_cpu_fallback",
-                            "distla_cpu_fallback"}
+                            "distla_cpu_fallback",
+                            "encoding_cpu_fallback"}
     assert by_tier["cpu_fallback"]["status"] == "ok"
     assert by_tier["cpu_fallback"]["n_history"] == 4
     assert by_tier["serve_cpu_fallback"]["status"] == "ok"
@@ -64,6 +67,10 @@ def test_fixture_history_passes_and_gates():
     assert by_tier["distla_cpu_fallback"]["n_history"] == 2
     assert by_tier["distla_cpu_fallback"]["metric"] == \
         "distla_summa_gram_voxels_per_sec"
+    assert by_tier["encoding_cpu_fallback"]["status"] == "ok"
+    assert by_tier["encoding_cpu_fallback"]["n_history"] == 2
+    assert by_tier["encoding_cpu_fallback"]["metric"] == \
+        "encoding_ridge_cv_voxels_lambdas_per_sec"
 
 
 def test_only_selects_tier_family():

@@ -121,3 +121,29 @@ def fcma_models():
                 gamma="auto"), epochs_per_subj=4)
     precomp.fit(train, labels)
     return logit, precomp, test
+
+
+@pytest.fixture(scope="session")
+def encoding_model():
+    from brainiak_tpu.encoding import RidgeEncoder
+    rng = np.random.RandomState(0)
+    t, f, v = 60, 8, 16
+    x = rng.randn(t, f).astype(np.float32)
+    w = rng.randn(f, v).astype(np.float32)
+    y = (x @ w + 0.5 * rng.randn(t, v)).astype(np.float32)
+    return RidgeEncoder(lambdas=(1.0, 10.0, 100.0),
+                        n_folds=3).fit(x, y)
+
+
+@pytest.fixture(scope="session")
+def banded_encoding_model():
+    from brainiak_tpu.encoding import BandedRidgeEncoder
+    rng = np.random.RandomState(1)
+    t, f, v = 60, 8, 16
+    x = rng.randn(t, f).astype(np.float32)
+    w = rng.randn(f, v).astype(np.float32)
+    y = (x @ w + 0.5 * rng.randn(t, v)).astype(np.float32)
+    return BandedRidgeEncoder(np.repeat(np.arange(2), 4),
+                              lambdas=(1.0, 100.0), n_folds=3,
+                              candidate_block=2,
+                              standardize=True).fit(x, y)

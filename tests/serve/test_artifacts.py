@@ -237,3 +237,57 @@ def test_save_model_extensionless_path_roundtrips(srm_model,
     loaded = load_model(written)
     for w0, w1 in zip(srm_model.w_, loaded.w_):
         np.testing.assert_array_equal(w0, w1)
+
+
+def test_ridge_encoding_roundtrip(encoding_model, tmp_path):
+    """The encoding artifact round-trips bit-exact on the inference
+    surface (ISSUE 7 acceptance) with allow_pickle=False."""
+    loaded = _roundtrip(encoding_model, tmp_path, "enc")
+    assert detect_kind(loaded) == "ridge_encoding"
+    assert type(loaded) is type(encoding_model)
+    x = np.random.RandomState(5).randn(
+        20, encoding_model.W_.shape[0]).astype(np.float32)
+    _exact(encoding_model.predict(x), loaded.predict(x))
+    np.testing.assert_array_equal(loaded.W_, encoding_model.W_)
+    np.testing.assert_array_equal(loaded.lambda_,
+                                  encoding_model.lambda_)
+    np.testing.assert_array_equal(loaded.lambdas_,
+                                  encoding_model.lambdas_)
+    assert loaded.n_folds == encoding_model.n_folds
+
+
+def test_banded_ridge_encoding_roundtrip(banded_encoding_model,
+                                         tmp_path):
+    """The banded subclass shares the ridge_encoding kind (a
+    ``banded`` flag selects the class on load) and keeps its bands,
+    candidates and per-band selected lambdas."""
+    model = banded_encoding_model
+    loaded = _roundtrip(model, tmp_path, "banded_enc")
+    assert detect_kind(loaded) == "ridge_encoding"
+    assert type(loaded) is type(model)
+    x = np.random.RandomState(6).randn(
+        15, model.W_.shape[0]).astype(np.float32)
+    _exact(model.predict(x), loaded.predict(x))
+    np.testing.assert_array_equal(loaded.bands, model.bands)
+    np.testing.assert_array_equal(loaded.candidates_,
+                                  model.candidates_)
+    assert loaded.lambda_.shape == model.lambda_.shape
+    assert loaded.standardize is True
+
+
+def test_future_schema_rejected_before_decode(tmp_path):
+    """Registry-level version handling (ISSUE 7 satellite): an
+    artifact stamped with a FUTURE serve_schema_version must raise
+    the unsupported-schema-version error up front — never a
+    KeyError from an adapter decoding payload keys it does not
+    understand (this artifact has none at all)."""
+    from brainiak_tpu.serve import artifacts
+
+    path = str(tmp_path / "future.npz")
+    np.savez(path, **{
+        artifacts.KIND_KEY: np.asarray("ridge_encoding"),
+        artifacts.VERSION_KEY:
+            np.asarray(artifacts.SCHEMA_VERSION + 1)})
+    with pytest.raises(ValueError,
+                       match="unsupported schema version"):
+        load_model(path)

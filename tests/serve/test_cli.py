@@ -94,3 +94,44 @@ def test_cli_run_text_format(tmp_path):
                 "--requests", req_path)
     assert proc.returncode == 0, proc.stderr
     assert "6/6 ok" in proc.stdout
+
+
+def test_cli_bench_rejects_unsupported_kind_naming_kinds(tmp_path):
+    """`serve bench` with an artifact it has no request generator
+    for fails rc=2 with an error that ENUMERATES the supported
+    kinds (ISSUE 7 satellite), instead of a bare driver error."""
+    from brainiak_tpu.eventseg.event import EventSegment
+    from brainiak_tpu.serve import save_model
+
+    model = EventSegment(n_events=2)
+    model.event_pat_ = np.random.RandomState(0).randn(6, 2)
+    model.event_var_ = 1.0
+    path = str(tmp_path / "eventseg.npz")
+    save_model(model, path)
+    proc = _cli("bench", "--model", path, "--n-requests", "4")
+    assert proc.returncode == 2
+    for kind in ("srm", "detsrm", "rsrm", "ridge_encoding"):
+        assert kind in proc.stderr
+    assert "eventseg" in proc.stderr
+
+
+def test_cli_bench_encoding_artifact_emits_valid_record(tmp_path,
+                                                        capsys):
+    """`serve bench` covers the new encoding read path: a
+    ridge_encoding artifact drives the scoring generator and emits
+    a schema-valid bench record (in-process `main` call — the
+    subprocess surface is covered by the other CLI tests)."""
+    from brainiak_tpu.obs import validate_bench_record
+    from brainiak_tpu.serve import save_model
+    from brainiak_tpu.serve.__main__ import build_encoding_model, main
+
+    path = str(tmp_path / "enc.npz")
+    save_model(build_encoding_model(voxels=24, features=6,
+                                    samples=40), path)
+    assert main(["bench", "--model", path, "--n-requests", "8"]) == 0
+    record = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert validate_bench_record(record) == []
+    assert record["metric"] == \
+        "serve_ridge_encoding_score_requests_per_sec"
+    assert record["tier"] in ("serve", "serve_cpu_fallback")

@@ -438,3 +438,66 @@ def test_duplicate_request_ids_keep_submission_order(srm_model):
     for i, rec in enumerate(records):
         expected = srm_model.w_[0].T @ reqs[i].x
         np.testing.assert_allclose(rec.result, expected, atol=1e-5)
+
+
+def test_acceptance_mixed_scoring_reloaded_encoding(encoding_model):
+    """ISSUE 7 acceptance: 64 mixed-TR held-out-scan scoring
+    requests against a reloaded ``ridge_encoding`` artifact — every
+    request answered, retraces bounded by the bucket count, and
+    per-request per-voxel correlations matching the estimator's own
+    host scoring (TR padding masked before the reduction)."""
+    import io
+
+    from brainiak_tpu.serve import save_model_bytes
+
+    model = load_model(io.BytesIO(save_model_bytes(encoding_model)))
+    rng = np.random.RandomState(0)
+    f, v = model.W_.shape
+    reqs, host = [], []
+    for i in range(64):
+        trs = (18, 30, 50, 70)[i % 4]
+        x = rng.randn(trs, f).astype(np.float32)
+        y = (model.predict(x)
+             + rng.randn(trs, v)).astype(np.float32)
+        reqs.append(Request(request_id=f"r{i}", x=(x, y)))
+        host.append(model.score(x, y))
+    engine = InferenceEngine(model)
+    records = engine.run(reqs)
+    assert len(records) == 64 and all(r.ok for r in records)
+    summary = engine.summary()
+    assert summary["kind"] == "ridge_encoding"
+    assert summary["n_ok"] == 64
+    # the acceptance bound: compiles <= distinct dispatched buckets
+    assert summary["retrace_total"] <= len(summary["buckets"])
+    for rec, expect in zip(records, host):
+        np.testing.assert_allclose(rec.result, expect, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_encoding_engine_banded_and_validation(
+        banded_encoding_model):
+    """The banded subclass serves through the same op (its predict
+    surface is the same affine map), and malformed scoring payloads
+    produce structured error records, not crashes."""
+    model = banded_encoding_model
+    rng = np.random.RandomState(3)
+    f, v = model.W_.shape
+    x = rng.randn(20, f).astype(np.float32)
+    y = (model.predict(x) + rng.randn(20, v)).astype(np.float32)
+    engine = InferenceEngine(model)
+    ok = engine.run([Request(request_id="good", x=(x, y))])[0]
+    assert ok.ok
+    np.testing.assert_allclose(ok.result, model.score(x, y),
+                               rtol=1e-4, atol=1e-5)
+    bad = [
+        Request(request_id="notpair", x=x),
+        Request(request_id="badf", x=(x[:, :-1], y)),
+        Request(request_id="badv", x=(x, y[:, :-1])),
+        Request(request_id="short", x=(x[:1], y[:1])),
+        Request(request_id="nan",
+                x=(np.full_like(x, np.nan), y)),
+    ]
+    records = engine.run(bad)
+    assert [r.ok for r in records] == [False] * 5
+    assert {r.error for r in records} == {"invalid_shape",
+                                          "non_finite_input"}

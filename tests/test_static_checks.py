@@ -42,7 +42,7 @@ def test_run_checks_json_output():
     assert payload["findings"] == []
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
-        "jaxlint", "obs", "regress", "serve", "distla"}
+        "jaxlint", "obs", "regress", "serve", "distla", "encoding"}
     assert payload["files"] > 100
 
 
@@ -340,4 +340,63 @@ def test_distla_gate_classifies_failures(monkeypatch):
     findings = []
     rc.check_distla(findings)
     assert [f.code for f in findings] == ["DLA001"]
+    assert "rc=3" in findings[0].message
+
+
+def test_encoding_gate_classifies_failures(monkeypatch):
+    """A failing encoding selfcheck is reported as ENC001, with
+    retrace instability, a broken banded fit, and sklearn-parity
+    failure each named distinctly."""
+    rc = _load_run_checks()
+
+    def fake_child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    monkeypatch.setattr(rc, "_ENCODING_CHILD", fake_child(
+        {"ok": False, "max_err": 0.3, "tol": 1e-3,
+         "banded_finite": True, "retraces": {}}))
+    findings = []
+    rc.check_encoding(findings)
+    assert [f.code for f in findings] == ["ENC001"]
+    assert "sklearn-parity" in findings[0].message
+
+    monkeypatch.setattr(rc, "_ENCODING_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 1e-3,
+         "banded_finite": True,
+         "retraces": {"encoding.sweep": 4.0,
+                      "encoding.refit": 1.0}}))
+    findings = []
+    rc.check_encoding(findings)
+    assert [f.code for f in findings] == ["ENC001"]
+    assert "rebuilt" in findings[0].message
+    assert "encoding.sweep=4" in findings[0].message
+
+    monkeypatch.setattr(rc, "_ENCODING_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 1e-3,
+         "banded_finite": False, "retraces": {}}))
+    findings = []
+    rc.check_encoding(findings)
+    assert [f.code for f in findings] == ["ENC001"]
+    assert "non-finite" in findings[0].message
+
+    # parity fine, retraces stable, but an expected site never
+    # registered (builder no longer counted): named distinctly, not
+    # misreported as a parity failure
+    monkeypatch.setattr(rc, "_ENCODING_CHILD", fake_child(
+        {"ok": False, "max_err": 1e-05, "tol": 1e-3,
+         "banded_finite": True, "sites_present": False,
+         "retraces": {"encoding.sweep": 1.0}}))
+    findings = []
+    rc.check_encoding(findings)
+    assert [f.code for f in findings] == ["ENC001"]
+    assert "missing expected" in findings[0].message
+    assert "encoding.sweep" in findings[0].message
+
+    monkeypatch.setattr(rc, "_ENCODING_CHILD",
+                        "raise SystemExit(3)")
+    findings = []
+    rc.check_encoding(findings)
+    assert [f.code for f in findings] == ["ENC001"]
     assert "rc=3" in findings[0].message

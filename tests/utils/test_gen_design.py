@@ -1,7 +1,14 @@
+import os
+
 import numpy as np
 import pytest
 
 from brainiak_tpu.utils.utils import ReadDesign, gen_design
+
+# Committed AFNI 3dDeconvolve-style design fixture (186 TRs, 27
+# columns: 4 polynomial-drift + 6 orth/motion + 17 stimulus).
+DESIGN_1D = os.path.join(os.path.dirname(__file__),
+                         "example_design.1D")
 
 # Stimulus timing fixtures (FSL 3-column and equivalent AFNI married format).
 FSL_1 = "5.2 2.0 2.0\n40.0 1.5 4.0\n50.0 1.0 2.0\n"
@@ -99,8 +106,8 @@ def test_read_design_header_mismatch_warns(tmp_path):
     ReadDesign semantics)."""
     import warnings
 
-    ref = ReadDesign("/root/reference/tests/utils/example_design.1D")
-    text = open("/root/reference/tests/utils/example_design.1D").read()
+    ref = ReadDesign(DESIGN_1D)
+    text = open(DESIGN_1D).read()
     bad = text.replace(f'ni_type = "{ref.n_col}*double"',
                        f'ni_type = "{ref.n_col + 3}*double"')
     assert bad != text
@@ -114,8 +121,8 @@ def test_read_design_header_mismatch_warns(tmp_path):
 
 
 def test_read_design_afni_fixture():
-    # Real AFNI 3dDeconvolve output from the reference test data (read-only).
-    d = ReadDesign("/root/reference/tests/utils/example_design.1D")
+    # Committed AFNI 3dDeconvolve-style design fixture.
+    d = ReadDesign(DESIGN_1D)
     assert d.n_TR == 186
     assert d.n_col == 27
     assert d.n_basis == 4
@@ -123,6 +130,6 @@ def test_read_design_afni_fixture():
     assert d.design_task.shape[0] == 186
     assert d.reg_nuisance is not None
     # excluding nuisance terms
-    d2 = ReadDesign("/root/reference/tests/utils/example_design.1D",
+    d2 = ReadDesign(DESIGN_1D,
                     include_orth=False, include_pols=False)
     assert d2.reg_nuisance is None

@@ -35,6 +35,12 @@ distla     smoke-runs the pod-scale linear algebra selfcheck
            parity error or program rebuilds — every
            ``retrace_total{site=distla.*}`` must stay at 1
            across repeat calls (DLA001)
+encoding   smoke-runs the encoding-tier selfcheck
+           (``brainiak_tpu.encoding.selfcheck``) on the
+           8-device CPU mesh and fails on sklearn-Ridge parity
+           error, a broken banded fit, or program rebuilds —
+           every ``retrace_total{site=encoding.*}`` must stay
+           at 1 across repeat fits (ENC001)
 ========== ===================================================
 
 ``# noqa`` suppresses stdlib/doc findings on a line; jaxlint uses
@@ -65,7 +71,7 @@ from brainiak_tpu.analysis.core import SKIP_DIRS  # noqa: E402,F401
 
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
-         "jaxlint", "obs", "regress", "serve", "distla")
+         "jaxlint", "obs", "regress", "serve", "distla", "encoding")
 
 
 def python_sources():
@@ -266,6 +272,8 @@ def check_doc_defaults(findings):
 # checkpoint_dir= to another estimator's fit (FastSRM ->
 # reduced-space DetSRM).
 RESILIENT_FITS = {
+    "brainiak_tpu/encoding/ridge.py": ("RidgeEncoder",
+                                       "BandedRidgeEncoder"),
     "brainiak_tpu/funcalign/srm.py": ("SRM", "DetSRM"),
     "brainiak_tpu/funcalign/rsrm.py": ("RSRM",),
     "brainiak_tpu/funcalign/fastsrm.py": ("FastSRM",),
@@ -533,7 +541,64 @@ def check_serve(findings):
             "per-request recompiles"))
 
 
-# -- distla gate ------------------------------------------------------
+# -- selfcheck-child gates (distla, encoding) -------------------------
+#
+# Shared harness: run a module selfcheck in a child pinned to an
+# 8-device CPU mesh (platform pinned IN-PROCESS by the child code,
+# not the JAX_PLATFORMS env var alone, which can hang on a wedged
+# tunnel PJRT plugin — docs/performance.md rule 4; the timeout stays
+# as a backstop), parse its JSON verdict, and classify failures.
+
+def _run_selfcheck_gate(findings, child_src, code, rel, label,
+                        classify):
+    """One selfcheck-child gate run.  ``classify(verdict)`` maps a
+    failed (ok=false) verdict to a finding message; retrace
+    instability (a repeat call rebuilt a program — the
+    no-per-call-retrace contract, jaxlint JX001's runtime twin) is
+    classified here, identically for every gate."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", child_src],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=420)
+    except subprocess.TimeoutExpired:
+        findings.append(Finding(
+            rel, 1, code,
+            f"{label} selfcheck timed out after 420s (hung backend "
+            "init?)"))
+        return
+    try:
+        verdict = json.loads(proc.stdout)
+    except ValueError:
+        verdict = None
+    if verdict is None or proc.returncode not in (0, 1):
+        tail = (proc.stderr or proc.stdout or "").strip()
+        tail = "; ".join(tail.splitlines()[-3:])
+        findings.append(Finding(
+            rel, 1, code,
+            f"{label} selfcheck failed (rc={proc.returncode}): "
+            f"{tail or 'no JSON verdict'}"))
+        return
+    if verdict.get("ok"):
+        return
+    retraces = {site: count for site, count
+                in verdict.get("retraces", {}).items()
+                if count > 1}
+    if retraces:
+        findings.append(Finding(
+            rel, 1, code,
+            f"{label} programs rebuilt on repeat calls: "
+            + ", ".join(f"{site}={count:.0f}"
+                        for site, count in sorted(
+                            retraces.items()))))
+    else:
+        findings.append(Finding(rel, 1, code, classify(verdict)))
+
 
 _DISTLA_CHILD = """\
 import jax
@@ -546,64 +611,63 @@ sys.exit(selfcheck())
 
 def check_distla(findings):
     """Distla gate (DLA001): smoke-run the pod-scale linear algebra
-    selfcheck (``brainiak_tpu.ops.distla.selfcheck``) in a child with
-    an 8-device CPU mesh.  The selfcheck runs the SUMMA Gram (even
-    and uneven splits), the checkpointable panel Gram, and the
-    sharded batched solves twice each against NumPy references, then
-    reads the retrace counter: any ``retrace_total{site=distla.*}``
-    above 1 means a repeat call rebuilt its program (the
-    no-per-call-retrace contract, jaxlint JX001's runtime twin).
-    The platform is pinned in-process by the child code, not the
-    JAX_PLATFORMS env var alone (which can hang on a wedged tunnel
-    PJRT plugin, docs/performance.md rule 4) — the timeout stays as
-    a backstop."""
-    rel = _rel(os.path.join(REPO, "brainiak_tpu", "ops", "distla.py"))
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _DISTLA_CHILD],
-            capture_output=True, text=True, cwd=REPO, env=env,
-            timeout=420)
-    except subprocess.TimeoutExpired:
-        findings.append(Finding(
-            rel, 1, "DLA001",
-            "distla selfcheck timed out after 420s (hung backend "
-            "init?)"))
-        return
-    try:
-        verdict = json.loads(proc.stdout)
-    except ValueError:
-        verdict = None
-    if verdict is None or proc.returncode not in (0, 1):
-        tail = (proc.stderr or proc.stdout or "").strip()
-        tail = "; ".join(tail.splitlines()[-3:])
-        findings.append(Finding(
-            rel, 1, "DLA001",
-            f"distla selfcheck failed (rc={proc.returncode}): "
-            f"{tail or 'no JSON verdict'}"))
-        return
-    if not verdict.get("ok"):
-        retraces = {site: count for site, count
-                    in verdict.get("retraces", {}).items()
-                    if count > 1}
-        if retraces:
-            findings.append(Finding(
-                rel, 1, "DLA001",
-                "distla programs rebuilt on repeat calls: "
-                + ", ".join(f"{site}={count:.0f}"
-                            for site, count in sorted(
-                                retraces.items()))))
-        else:
-            findings.append(Finding(
-                rel, 1, "DLA001",
-                f"distla parity failure: max_err="
+    selfcheck (``brainiak_tpu.ops.distla.selfcheck``) on the
+    8-device CPU mesh: the SUMMA Gram (even and uneven splits), the
+    checkpointable panel Gram, and the sharded batched solves, twice
+    each against NumPy references, plus the retrace-stability
+    contract (``retrace_total{site=distla.*}`` stays at 1 across
+    repeat calls)."""
+
+    def classify(verdict):
+        return (f"distla parity failure: max_err="
                 f"{verdict.get('max_err')} over tol="
                 f"{verdict.get('tol')} "
-                f"(n_shards={verdict.get('n_shards')})"))
+                f"(n_shards={verdict.get('n_shards')})")
+
+    _run_selfcheck_gate(
+        findings, _DISTLA_CHILD, "DLA001",
+        _rel(os.path.join(REPO, "brainiak_tpu", "ops", "distla.py")),
+        "distla", classify)
+
+
+# -- encoding gate ----------------------------------------------------
+
+_ENCODING_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.encoding import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_encoding(findings):
+    """Encoding gate (ENC001): smoke-run the encoding-tier selfcheck
+    (``brainiak_tpu.encoding.selfcheck``) on the 8-device CPU mesh:
+    per-voxel prediction parity against sklearn Ridge at the
+    CV-selected lambdas, the sharded raw-product Gram over the mesh
+    ring, a banded fit, and the retrace-stability contract — a
+    repeat fit must not rebuild any program (the lambda sweep is ONE
+    jitted program, not one per lambda)."""
+
+    def classify(verdict):
+        if not verdict.get("banded_finite", True):
+            return "banded encoding fit produced non-finite scores"
+        if not verdict.get("sites_present", True):
+            return ("encoding selfcheck missing expected "
+                    "retrace sites (a program builder no longer "
+                    "routes through counted_cache?): saw "
+                    + (", ".join(sorted(verdict.get("retraces", {})))
+                       or "none"))
+        return (f"encoding sklearn-parity failure: max_err="
+                f"{verdict.get('max_err')} over tol="
+                f"{verdict.get('tol')}")
+
+    _run_selfcheck_gate(
+        findings, _ENCODING_CHILD, "ENC001",
+        _rel(os.path.join(REPO, "brainiak_tpu", "encoding",
+                          "ridge.py")),
+        "encoding", classify)
 
 
 # -- external gate ----------------------------------------------------
@@ -718,6 +782,8 @@ def run_gates(only=None):
         check_serve(findings)
     if "distla" in selected:
         check_distla(findings)
+    if "encoding" in selected:
+        check_encoding(findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
@@ -725,7 +791,8 @@ def run_gates(only=None):
     label = "+".join(
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
-                       "obs", "regress", "serve", "distla")
+                       "obs", "regress", "serve", "distla",
+                       "encoding")
            if g in selected])
     return {
         "ok": not findings,
