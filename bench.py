@@ -23,15 +23,20 @@ responsive chip the north-star whole-brain config is attempted first
 (V=65536 correlation width, E=32 — the BASELINE.json scale), then the
 V=8192 mid config, then a reduced CPU fallback.  Each chip tier runs in
 its own subprocess under a timeout so a tunnel wedge mid-tier cannot
-hang the driver's bench invocation.  Three further tiers print their
+hang the driver's bench invocation.  Four further tiers print their
 own JSON lines after the FCMA record: ``serve`` (batched
-SRM-transform serving), ``distla`` (pod-scale SUMMA-sharded Gram,
-``brainiak_tpu.ops.distla`` — voxels/s of a [T, V] -> [V, V]
-correlation with the voxel axis ring-sharded), and ``encoding``
-(voxel-wise ridge CV throughput, ``brainiak_tpu.encoding`` —
-voxels×lambdas/s of a full RidgeEncoder fit), each split into an
-on-chip and a ``*_cpu_fallback`` tier so ``obs regress`` never
-compares host rounds against on-chip baselines.
+SRM-transform serving), ``service`` (always-on continuous batching,
+``brainiak_tpu.serve.service`` — steady-state requests/s AND p99
+latency AND padding waste over two resident models, the latter two
+stamped ``direction="lower_is_better"`` so ``obs regress`` fails a
+doubled p99 the right way round), ``distla`` (pod-scale
+SUMMA-sharded Gram, ``brainiak_tpu.ops.distla`` — voxels/s of a
+[T, V] -> [V, V] correlation with the voxel axis ring-sharded), and
+``encoding`` (voxel-wise ridge CV throughput,
+``brainiak_tpu.encoding`` — voxels×lambdas/s of a full RidgeEncoder
+fit), each split into an on-chip and a ``*_cpu_fallback`` tier so
+``obs regress`` never compares host rounds against on-chip
+baselines.
 
 Stage breakdown: every tier runs with :mod:`brainiak_tpu.obs` enabled
 on an in-memory sink — ``bench.data_gen`` / ``bench.warm`` (upload +
@@ -70,6 +75,10 @@ WB_VOXELS = 65536
 WB_SELECTED = 1024
 WB_EPOCHS = 32
 SERVE_REQUESTS = 256  # serve-tier workload (BENCH_SERVE_REQUESTS overrides)
+# service tier (always-on continuous batching): mixed SRM-transform +
+# ridge_encoding-scoring requests against two resident models.
+# BENCH_SERVICE_REQUESTS overrides.
+SERVICE_REQUESTS = 128
 
 # distla tier (pod-scale SUMMA Gram, brainiak_tpu.ops.distla): the
 # on-chip workload is a [T, V] -> [V, V] sharded correlation at a
@@ -100,6 +109,15 @@ def _serve_n_requests():
     import os
     return int(os.environ.get("BENCH_SERVE_REQUESTS",
                               SERVE_REQUESTS))
+
+
+def _service_n_requests():
+    """The service tier's request count (``BENCH_SERVICE_REQUESTS``
+    overrides) — one reader, same no-drift rule as the other
+    tiers."""
+    import os
+    return int(os.environ.get("BENCH_SERVICE_REQUESTS",
+                              SERVICE_REQUESTS))
 
 
 def _even_epochs_env(name, default):
@@ -466,6 +484,127 @@ def _serve_result_record(out, n_requests):
                         stages=out.get("stages"))
 
 
+def service_tier_metrics(n_requests=SERVICE_REQUESTS, seed=0):
+    """The ``service`` tier: always-on continuous-batching serving
+    through :class:`brainiak_tpu.serve.ServeService` — two resident
+    models (an SRM transform tier and a ridge_encoding scoring
+    tier) under one residency, mixed-shape requests submitted in
+    staggered waves, results delivered by ticket.  The warm drive
+    pays the compiles; the timed drive is the steady-state loop.
+    ``vs_baseline`` is the unbatched per-request host loop over the
+    same mixed workload."""
+    import itertools
+
+    import jax
+
+    from brainiak_tpu.serve import BucketPolicy, ModelResidency
+    from brainiak_tpu.serve.__main__ import (build_demo_model,
+                                             build_encoding_model,
+                                             build_encoding_requests,
+                                             build_mixed_requests,
+                                             drive_service,
+                                             naive_requests_per_sec)
+
+    with obs.span("bench.data_gen"):
+        srm = build_demo_model(n_subjects=4, voxels=256,
+                               samples=64, features=16, n_iter=3,
+                               seed=seed)
+        enc = build_encoding_model(voxels=256, features=32,
+                                   samples=80, n_folds=4, seed=seed)
+        n_srm = n_requests // 2
+        n_enc = n_requests - n_srm
+        sreqs = build_mixed_requests(srm, n_srm, seed=seed)
+        ereqs = build_encoding_requests(enc, n_enc, seed=seed + 1)
+        for req in sreqs:
+            req.model = "srm"
+        for req in ereqs:
+            req.model = "enc"
+        requests = [req for pair in itertools.zip_longest(
+            sreqs, ereqs) for req in pair if req is not None]
+        policy = BucketPolicy(max_batch=32, max_wait_s=0.02)
+
+    def _drive():
+        residency = ModelResidency(budget_bytes=1 << 30,
+                                   policy=policy)
+        residency.register("srm", model=srm)
+        residency.register("enc", model=enc)
+        for req in requests:  # fresh queue-time stamps per drive
+            req.submitted = None
+        return drive_service(residency, requests,
+                             default_model="srm", waves=4)
+
+    with obs.span("bench.warm"):
+        _drive()
+    with obs.span("bench.steady"):
+        summary, _, wall = _drive()
+    if summary["n_errors"]:
+        # error records resolve in microseconds: rating them would
+        # report record "throughput" (and a zero p99) for a broken
+        # serving path, and the regress gate would stay green
+        raise RuntimeError(
+            f"service bench round produced {summary['n_errors']} "
+            f"error record(s) ({summary['errors_by_code']}); "
+            "refusing to emit a throughput number for a failing "
+            "serving path")
+    srm_rps = naive_requests_per_sec(srm, sreqs)
+    enc_rps = naive_requests_per_sec(enc, ereqs)
+    baseline = n_requests / (n_srm / srm_rps + n_enc / enc_rps)
+    return {"requests_per_sec": n_requests / wall,
+            "p50_latency_s": summary["p50_latency_s"],
+            "p99_latency_s": summary["p99_latency_s"],
+            "padding_waste": summary["padding_waste"],
+            "retrace_total": summary["retrace_total"],
+            "evictions": summary["residency"]["evictions"],
+            "n_requests": n_requests,
+            "baseline_rps": baseline,
+            "backend": jax.default_backend()}
+
+
+def _service_result_records(out, n_requests):
+    """The service tier's bench JSON lines — one record per gated
+    metric: steady-state requests/s (higher is better), p99 latency
+    and padding waste (both stamped ``direction="lower_is_better"``
+    so ``obs regress --only service`` fails a doubled p99 or a
+    padding blow-up the right way round).  Tier split mirrors the
+    other tiers (``service`` on TPU, ``service_cpu_fallback``
+    otherwise)."""
+    tier = "service" if out.get("backend") == "tpu" \
+        else "service_cpu_fallback"
+    config = {"n_requests": n_requests,
+              "n_models": 2,
+              "backend": out.get("backend"),
+              "evictions": out.get("evictions", 0),
+              "retrace_total": out.get("retrace_total", 0)}
+    commit = _git_commit()
+
+    def rec(metric, value, unit, vs=0.0, direction=None,
+            stages=None):
+        r = {"schema_version": BENCH_SCHEMA_VERSION,
+             "metric": metric, "value": round(float(value), 6),
+             "unit": unit, "vs_baseline": vs, "tier": tier,
+             "config": config}
+        if direction:
+            r["direction"] = direction
+        if commit:
+            r["git_commit"] = commit
+        if stages:
+            r["stages"] = stages
+        return r
+
+    rps = float(out["requests_per_sec"])
+    baseline = float(out.get("baseline_rps") or 0.0)
+    vs = round(rps / baseline, 3) if baseline > 0 else 0.0
+    return [
+        rec("service_mixed_requests_per_sec", rps, "requests/sec",
+            vs=vs, stages=out.get("stages")),
+        rec("service_p99_latency_seconds",
+            out["p99_latency_s"], "s",
+            direction="lower_is_better"),
+        rec("service_padding_waste_ratio", out["padding_waste"],
+            "ratio", direction="lower_is_better"),
+    ]
+
+
 def _ts_key(ts):
     """Chronological sort key for possibly-absent ISO timestamps with
     heterogeneous UTC offsets (lexicographic comparison is wrong across
@@ -645,6 +784,17 @@ def measure_tier(tier):
                           out["requests_per_sec"], tier="serve")
             out["stages"] = _stage_seconds(mem.records)
             return out
+        if tier == "service":
+            out = service_tier_metrics(
+                n_requests=_service_n_requests())
+            # tier split by backend, same rule as every other tier
+            svc_tier = "service" if out["backend"] == "tpu" \
+                else "service_cpu_fallback"
+            obs.gauge("bench_service_requests_per_sec",
+                      unit="requests/sec").set(
+                          out["requests_per_sec"], tier=svc_tier)
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "wb":
             vps = whole_brain_voxels_per_sec(
                 n_voxels=int(os.environ.get("BENCH_WB_VOXELS",
@@ -717,11 +867,13 @@ def _tier_main(tier):
 
 def main():
     """One bench invocation prints one JSON line per tier: the FCMA
-    fit-path record (whole-brain / mid / cpu_fallback) and the serve
-    tier record — ``obs regress`` gates each tier against its own
-    history."""
+    fit-path record (whole-brain / mid / cpu_fallback), the serve
+    tier record, the service tier's three records (requests/s, p99,
+    padding waste), and the distla/encoding records — ``obs
+    regress`` gates each tier against its own history."""
     responsive = _fcma_main()
     _serve_main(responsive)
+    _service_main(responsive)
     _distla_main(responsive)
     _encoding_main(responsive)
 
@@ -742,7 +894,11 @@ def _aux_tier_main(responsive, tier, record_fn, timeout=420):
         import jax
         jax.config.update("jax_platforms", "cpu")
         out = measure_tier(tier)
-    print(json.dumps(record_fn(out)))
+    recs = record_fn(out)
+    # multi-metric tiers (service) return one record per gated
+    # metric; each is its own bench JSON line
+    for rec in recs if isinstance(recs, list) else [recs]:
+        print(json.dumps(rec))
 
 
 def _encoding_main(responsive):
@@ -761,6 +917,22 @@ def _serve_main(responsive):
     _aux_tier_main(
         responsive, "serve",
         lambda out: _serve_result_record(out, n_requests))
+
+
+def _service_main(responsive):
+    """Service tier: continuous-batching steady state — three
+    records (requests/s, p99 latency, padding waste).  A failing
+    service round (error records -> the tier refuses to emit fake
+    numbers) must not abort the driver: the remaining tiers still
+    record their history."""
+    import sys
+    n_requests = _service_n_requests()
+    try:
+        _aux_tier_main(
+            responsive, "service",
+            lambda out: _service_result_records(out, n_requests))
+    except RuntimeError as exc:
+        print(f"tier service: {exc}", file=sys.stderr)
 
 
 def _fcma_main():

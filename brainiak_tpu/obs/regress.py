@@ -18,7 +18,14 @@ record of each tier is a regression against that tier's own history:
   reach ``0.7 x`` median for higher-is-better metrics);
 - **min history** — with fewer than ``--min-history`` (default 2)
   prior records a tier is reported ``insufficient_history`` and does
-  not gate; a brand-new tier must not fail CI on its first record.
+  not gate; a brand-new tier must not fail CI on its first record;
+- **per-metric direction** — throughput metrics are
+  higher-is-better (the default), but the service tier also gates
+  p99 latency and padding waste, where HIGHER is the regression: a
+  record carrying ``"direction": "lower_is_better"`` flips the
+  comparison (the fresh value must stay under ``median /
+  threshold``), so a doubled p99 fails CI the right way round
+  instead of reading as a 2x "improvement".
 
 The fresh sample is ``--fresh FILE`` (or ``-`` for stdin, i.e. piped
 straight from ``python bench.py``); without it, the newest history
@@ -191,9 +198,11 @@ def evaluate(history, fresh=None, threshold=DEFAULT_THRESHOLD,
     ``only`` restricts gating to the named tier families
     (:func:`tier_selected`).  Returns ``{"verdict": "pass"|"fail"|
     "skip", "checks": [...]}`` where each check carries the group's
-    key, values, ratio, and a ``status`` of ``ok`` / ``regression`` /
-    ``insufficient_history``.  Higher values are better (the bench
-    metrics are throughputs).
+    key, values, ratio, direction, and a ``status`` of ``ok`` /
+    ``regression`` / ``insufficient_history``.  Higher values are
+    better unless the sample record is stamped ``"direction":
+    "lower_is_better"`` (latency/padding metrics), in which case the
+    value must stay below ``baseline / threshold``.
     """
     groups = {}
     for rec in history:
@@ -224,11 +233,14 @@ def evaluate(history, fresh=None, threshold=DEFAULT_THRESHOLD,
             sample = past.pop()  # newest history record gates
         else:
             continue
+        direction = str(sample.get("direction")
+                        or "higher_is_better")
         check = {"metric": metric, "tier": tier,
                  "value": float(sample["value"]),
                  "unit": sample.get("unit"),
                  "source": sample.get("source"),
                  "n_history": len(past),
+                 "direction": direction,
                  "threshold": threshold}
         if len(past) < min_history:
             check["status"] = "insufficient_history"
@@ -237,12 +249,26 @@ def evaluate(history, fresh=None, threshold=DEFAULT_THRESHOLD,
             mid = len(values) // 2
             baseline = values[mid] if len(values) % 2 \
                 else 0.5 * (values[mid - 1] + values[mid])
-            ratio = float(sample["value"]) / baseline if baseline \
-                else float("inf")
+            # zero baseline: a zero fresh value matches it (ratio
+            # 1.0 passes either direction); any positive value is
+            # infinitely above — an improvement for higher-is-
+            # better, a regression for lower-is-better (a tier
+            # whose p99/padding history is legitimately 0.0 must
+            # not fail forever on staying at 0.0)
+            if baseline:
+                ratio = float(sample["value"]) / baseline
+            else:
+                ratio = float("inf") if float(sample["value"]) > 0 \
+                    else 1.0
             check["baseline_median"] = baseline
             check["ratio"] = ratio
-            check["status"] = ("regression" if ratio < threshold
-                               else "ok")
+            if direction == "lower_is_better":
+                # the mirrored bar: a latency/padding value may
+                # grow to baseline/threshold before it regresses
+                bad = ratio > 1.0 / threshold
+            else:
+                bad = ratio < threshold
+            check["status"] = "regression" if bad else "ok"
         checks.append(check)
     if not checks:
         verdict = "skip"
@@ -268,6 +294,8 @@ def _render_text(result, skipped):
                   f"{check['baseline_median']:.6g} over "
                   f"{check['n_history']} record(s), threshold "
                   f"{check['threshold']:.2f}")
+        if check.get("direction") == "lower_is_better":
+            detail += " (lower is better)"
         if status == "regression":
             lines.append(f"FAIL {head}: regression — {detail}")
         else:

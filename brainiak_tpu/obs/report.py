@@ -59,7 +59,9 @@ def validate_bench_record(rec):
     breakdown (data-gen / compile+warm / steady-state seconds), an
     int ``schema_version`` (<= :data:`BENCH_SCHEMA_VERSION`) and a
     string ``git_commit`` — the provenance stamps ``regress.py``
-    trusts.
+    trusts.  An optional ``direction`` must be ``higher_is_better``
+    or ``lower_is_better`` (how ``regress.py`` orients the gate for
+    latency/padding metrics).
     """
     errors = []
     if not isinstance(rec, dict):
@@ -90,6 +92,12 @@ def validate_bench_record(rec):
                                or not commit):
         errors.append(f"git_commit={commit!r} (expected a non-empty "
                       "string)")
+    direction = rec.get("direction")
+    if direction is not None and direction not in (
+            "higher_is_better", "lower_is_better"):
+        errors.append(
+            f"direction={direction!r} (expected higher_is_better "
+            "or lower_is_better)")
     stages = rec.get("stages")
     if stages is not None:
         if not isinstance(stages, dict):

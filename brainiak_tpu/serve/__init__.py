@@ -30,6 +30,8 @@ from .artifacts import (  # noqa: F401
     SCHEMA_VERSION,
     detect_kind,
     load_model,
+    model_digest,
+    model_nbytes,
     save_model,
     save_model_bytes,
 )
@@ -46,18 +48,38 @@ from .engine import (  # noqa: F401
     InferenceEngine,
     program_cache,
 )
+from .aot import (  # noqa: F401
+    AOTProgramCache,
+)
+from .residency import (  # noqa: F401
+    AdmissionError,
+    ModelResidency,
+)
+from .service import (  # noqa: F401
+    ServeService,
+    ServiceClosed,
+    ServiceTicket,
+)
 
 __all__ = [
     "ADAPTERS",
+    "AOTProgramCache",
+    "AdmissionError",
     "SCHEMA_VERSION",
     "BucketPolicy",
     "InferenceEngine",
+    "ModelResidency",
     "Request",
     "ServeResult",
+    "ServeService",
+    "ServiceClosed",
+    "ServiceTicket",
     "bucket_length",
     "detect_kind",
     "load_model",
     "load_requests",
+    "model_digest",
+    "model_nbytes",
     "pad_axis",
     "program_cache",
     "save_model",

@@ -1,6 +1,6 @@
 """``python -m brainiak_tpu.serve`` — the serving CLI.
 
-Two subcommands:
+Three subcommands:
 
 - ``run --model M.npz --requests R.npz [--out OUT.npz]`` — offline
   batch driver: load a persisted model
@@ -11,6 +11,15 @@ Two subcommands:
   padding waste, latency percentiles).  Exit status 0 means every
   request produced a result; 1 means at least one structured error
   record; 2 means the driver itself failed.
+- ``service --model [NAME=]M.npz ... --requests R.npz`` — the
+  always-on path (:class:`~brainiak_tpu.serve.ServeService`):
+  multiple resident models under an HBM budget, continuous batching
+  in staggered waves, optional ``--aot-cache DIR`` persisted
+  programs (a restart over a warm cache serves with zero serve
+  retraces), ``--duration``-bounded with a drain-or-fail
+  ``--drain``/``--no-drain`` shutdown; JSON summary carries
+  p50/p99, padding waste, evictions, and AOT hits/misses.  Same
+  0/1/2 exit contract as ``run``.
 - ``bench [--model M.npz] [--n-requests N]`` — serving
   micro-benchmark: mixed-TR synthetic requests against the model (a
   tiny deterministic SRM is fitted in-process when no artifact is
@@ -46,8 +55,8 @@ from .engine import InferenceEngine
 
 __all__ = ["BENCH_KINDS", "bench_record", "build_demo_model",
            "build_encoding_model", "build_encoding_requests",
-           "build_mixed_requests", "main", "measure",
-           "naive_requests_per_sec", "summary_to_out"]
+           "build_mixed_requests", "drive_service", "main",
+           "measure", "naive_requests_per_sec", "summary_to_out"]
 
 
 def _policy(args):
@@ -105,6 +114,127 @@ def _run(args):
               f"retraces={summary['retrace_total']:.0f}, "
               f"padding waste="
               f"{summary['padding_waste']:.1%}")
+        for code, count in sorted(
+                summary["errors_by_code"].items()):
+            print(f"  {count:>4}  {code}")
+    return 0 if summary["n_errors"] == 0 else 1
+
+
+def _parse_model_args(values):
+    """``[NAME=]PATH`` pairs from repeated ``--model`` flags; a bare
+    path names the model after its file stem."""
+    out = []
+    seen = set()
+    for value in values:
+        if "=" in value:
+            name, path = value.split("=", 1)
+        else:
+            path = value
+            name = os.path.splitext(os.path.basename(value))[0]
+        if not name or not path:
+            raise ValueError(
+                f"--model expects [NAME=]PATH, got {value!r}")
+        if name in seen:
+            raise ValueError(f"duplicate model name {name!r}")
+        seen.add(name)
+        out.append((name, path))
+    return out
+
+
+def drive_service(residency, requests, default_model, waves=4,
+                  wave_gap_s=None, duration_s=None, drain=True):
+    """Submit ``requests`` to a fresh
+    :class:`~brainiak_tpu.serve.ServeService` in ``waves`` staggered
+    waves (the late-joiner shape: later waves join buckets already
+    in flight), wait for the tickets, and shut down gracefully.
+
+    ``duration_s`` caps the drive's wall clock; on expiry the
+    service shuts down per ``drain`` (serve everything queued, or
+    fail it with ``shutdown`` records) — either way every ticket
+    resolves.  Returns ``(service summary, records, wall seconds)``
+    — shared by the ``service`` subcommand and bench.py's service
+    tier so the measured drive cannot drift between them."""
+    from .service import ServeService
+
+    policy = residency.policy
+    if wave_gap_s is None:
+        wave_gap_s = min(0.05, policy.max_wait_s / 2.0
+                         if policy is not None else 0.02)
+    waves = max(1, min(int(waves), len(requests) or 1))
+    per_wave = -(-len(requests) // waves)  # ceil
+    svc = ServeService(residency,
+                       default_model=default_model).start()
+    t0 = time.perf_counter()
+    deadline = (t0 + duration_s) if duration_s else None
+    try:
+        tickets = []
+        for w in range(waves):
+            # one atomic wave: deterministic bucket composition,
+            # so repeat drives (warm AOT cache) reuse shapes
+            tickets.extend(svc.submit_many(
+                requests[w * per_wave:(w + 1) * per_wave]))
+            if w + 1 < waves and wave_gap_s > 0:
+                gap = wave_gap_s
+                if deadline is not None:
+                    gap = min(gap,
+                              deadline - time.perf_counter())
+                if gap > 0:
+                    time.sleep(gap)
+        for ticket in tickets:
+            if deadline is None:
+                # backstop, not an SLO: a lost record is a driver
+                # bug and must surface as rc=2, not a hang
+                ticket.result(timeout=600.0)
+                continue
+            left = deadline - time.perf_counter()
+            try:
+                ticket.result(timeout=max(0.0, left))
+            except TimeoutError:
+                break  # duration expired: shutdown resolves rest
+    finally:
+        summary = svc.shutdown(drain=drain)
+    wall = time.perf_counter() - t0
+    return summary, [t.record for t in tickets], wall
+
+
+def _service(args):
+    from .aot import AOTProgramCache
+    from .residency import ModelResidency
+
+    models = _parse_model_args(args.model)
+    pinned = set(args.pin or [])
+    unknown = pinned - {name for name, _ in models}
+    if unknown:
+        raise ValueError(
+            f"--pin names no registered model: "
+            f"{', '.join(sorted(unknown))}")
+    aot = AOTProgramCache(args.aot_cache) if args.aot_cache else None
+    residency = ModelResidency(budget_bytes=args.budget_bytes,
+                               policy=_policy(args), aot=aot)
+    for name, path in models:
+        residency.register(name, source=path,
+                           pinned=name in pinned)
+    requests = load_requests(args.requests)
+    summary, _, wall = drive_service(
+        residency, requests, default_model=models[0][0],
+        waves=args.waves, duration_s=args.duration,
+        drain=args.drain)
+    summary["wall_s"] = round(wall, 6)
+    summary["requests_per_sec"] = (
+        round(len(requests) / wall, 3) if wall > 0 else None)
+    summary["drain"] = bool(args.drain)
+    if args.format == "json":
+        print(json.dumps(summary, indent=2))
+    else:
+        aot_stats = summary.get("aot") or {}
+        print(f"serve service: {summary['n_ok']}/"
+              f"{summary['n_submitted']} ok, "
+              f"{summary['n_errors']} error(s), "
+              f"{summary['residency']['n_resident']} resident "
+              f"model(s), {summary['residency']['evictions']} "
+              f"eviction(s), retraces="
+              f"{summary['retrace_total']:.0f}, aot hits="
+              f"{aot_stats.get('hits', 0)}")
         for code, count in sorted(
                 summary["errors_by_code"].items()):
             print(f"  {count:>4}  {code}")
@@ -360,7 +490,9 @@ def main(argv=None):
     sub = parser.add_subparsers(dest="command", required=True)
 
     run_p = sub.add_parser(
-        "run", help="drive a request file through the engine")
+        "run", help="drive a request file through the engine "
+                    "(one-shot; see `service` for the always-on "
+                    "multi-model loop)")
     run_p.add_argument("--model", required=True,
                        help="model artifact (save_model npz)")
     run_p.add_argument("--requests", required=True,
@@ -369,8 +501,50 @@ def main(argv=None):
     run_p.add_argument("--format", choices=("text", "json"),
                        default="text")
 
+    service_p = sub.add_parser(
+        "service",
+        help="always-on continuous-batching service: multiple "
+             "resident models, HBM budget, persisted AOT programs")
+    service_p.add_argument(
+        "--model", action="append", required=True,
+        metavar="[NAME=]PATH",
+        help="model artifact; repeatable (name defaults to the "
+             "file stem)")
+    service_p.add_argument("--requests", required=True,
+                           help="request file (save_requests npz; "
+                                "per-request model.<i> keys route)")
+    service_p.add_argument(
+        "--aot-cache", metavar="DIR",
+        help="persisted-program cache directory: a restarted "
+             "service over a warm cache serves its first request "
+             "without a compile stall")
+    service_p.add_argument(
+        "--budget-bytes", type=int,
+        help="residency byte budget (default: device HBM limit, "
+             "or BRAINIAK_TPU_SERVE_BUDGET_BYTES)")
+    service_p.add_argument(
+        "--pin", action="append", metavar="NAME",
+        help="never evict this model; repeatable")
+    service_p.add_argument(
+        "--duration", type=float, metavar="SECONDS",
+        help="wall-clock cap; on expiry pending work drains or "
+             "fails per --drain")
+    service_p.add_argument(
+        "--drain", action=argparse.BooleanOptionalAction,
+        default=True,
+        help="on shutdown, serve queued work to completion "
+             "(--no-drain fails it with `shutdown` records)")
+    service_p.add_argument(
+        "--waves", type=int, default=4,
+        help="stagger submissions into this many waves "
+             "(default %(default)s)")
+    service_p.add_argument("--format", choices=("text", "json"),
+                           default="json")
+
     bench_p = sub.add_parser(
-        "bench", help="serving throughput micro-benchmark")
+        "bench", help="serving throughput micro-benchmark "
+                      "(steady-state tiers live in the `service` "
+                      "bench of bench.py)")
     bench_p.add_argument("--model",
                          help="model artifact (default: fit a tiny "
                               "demo SRM in-process)")
@@ -379,7 +553,7 @@ def main(argv=None):
     bench_p.add_argument("--n-requests", type=int, default=256)
     bench_p.add_argument("--seed", type=int, default=0)
 
-    for p in (run_p, bench_p):
+    for p in (run_p, service_p, bench_p):
         p.add_argument("--max-batch", type=int, default=64)
         p.add_argument("--max-wait", type=float, default=0.05)
         p.add_argument("--min-bucket", type=int, default=16)
@@ -390,6 +564,8 @@ def main(argv=None):
         jax.config.update("jax_platforms", "cpu")
     if args.command == "run":
         return _run(args)
+    if args.command == "service":
+        return _service(args)
     return _bench(args)
 
 

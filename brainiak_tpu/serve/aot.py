@@ -1,0 +1,322 @@
+"""Zero cold-start: persisted AOT-compiled serve programs.
+
+A fresh serving process pays one trace + XLA compile per (model,
+bucket) program before its first answer — exactly the stall a
+restart or a preemption (the resilience subsystem's bread and
+butter) turns into user-visible cold-start latency.  This module
+removes it: every serve program the engine builds is exported with
+``jax.export`` and written to an on-disk cache, and the next process
+deserializes the persisted program instead of re-tracing, so its
+first request runs with ``retrace_total{site=serve.*} == 0``.
+
+Cache key schema (one file per program)::
+
+    sha256(artifact digest | site | bucket key | jax version
+           | platform)
+
+- **artifact digest** (:func:`~brainiak_tpu.serve.artifacts.
+  model_digest`) — programs can bake model-specific statics (RSRM's
+  ``gamma``/``n_iter`` ride in the bucket key, but the digest also
+  invalidates on refit, the conservative choice);
+- **site + bucket key** — the same key the
+  :func:`~brainiak_tpu.serve.engine.program_cache` builders use, so
+  AOT entries and jit programs are one-to-one;
+- **jax version + platform** — serialized programs are not portable
+  across either; a version bump or a CPU/TPU move simply misses and
+  falls back to jit (then re-populates).
+
+Invalidation is purely key-based: a stale entry is never *wrong*,
+only unreachable (its key no longer matches), so the cache needs no
+coherence protocol — prune old files at will.
+
+Two layers of persistence remove the stall end to end: the
+serialized export removes the Python trace + jax lowering, and —
+because deserialized programs are still XLA-compiled on first call —
+the cache also points jax's **persistent compilation cache**
+(``jax_compilation_cache_dir``) at ``<dir>/xla``, so the compiled
+executable itself is reused across processes.  The latter is a
+process-global jax config (it benefits every jitted program, which
+is the point for a serving process); set
+``BRAINIAK_TPU_SERVE_XLA_CACHE=0`` to leave jax's config untouched,
+and on jax builds without the knobs it degrades silently to
+export-only persistence.
+
+Fallback semantics: every miss is counted in
+``serve_aot_miss_total{reason=}`` (``unsupported`` — this jax has no
+usable export API; ``absent`` — no entry under the key;
+``deserialize_failed`` — unreadable/corrupt entry) and the engine
+falls back to the jit builder, so AOT failure can cost a compile
+stall but never an answer.  Hits count in
+``serve_aot_hit_total{site=}``.  Cache writes go through
+:func:`brainiak_tpu.resilience.retry` (transient shared-filesystem
+faults back off and retry) and are atomic (tmp + rename), and a
+write that still fails only emits an ``aot_store_failed`` event —
+persisting a program is an optimization, never a serving
+dependency.
+
+The ``jax.export`` import is guarded by a
+:mod:`brainiak_tpu.parallel.compat`-style version shim (top-level
+module on modern jax, ``jax.experimental.export`` on the
+transitional releases, absent before that — in which case every
+lookup misses with ``reason="unsupported"``).
+"""
+
+import hashlib
+import logging
+import os
+
+from ..obs import metrics as obs_metrics
+from ..obs import sink as obs_sink
+from ..resilience.retry import retry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["AOTProgramCache", "XLA_CACHE_ENV",
+           "export_available"]
+
+#: Set to ``0`` to keep AOTProgramCache from pointing jax's
+#: persistent compilation cache at its directory (a process-global
+#: config; see the module docstring).
+XLA_CACHE_ENV = "BRAINIAK_TPU_SERVE_XLA_CACHE"
+
+#: Bound on the in-memory table of deserialized programs (FIFO
+#: beyond it).  Request-controlled bucket spaces (eventseg's exact
+#: T) could otherwise grow it without limit in a long-lived
+#: service — the same hazard the engine's per-op memo cap guards;
+#: an evicted entry simply deserializes again from disk (a counted
+#: hit, no compile).
+MAX_RESIDENT_PROGRAMS = 256
+
+# -- version shim (parallel/compat.py style) --------------------------
+#
+# jax.export moved across the releases this framework supports:
+# modern jax exports it at top level, the transitional line kept it
+# in jax.experimental.export, and older releases have neither — the
+# cache then degrades to always-miss (reason="unsupported") and the
+# engine serves through plain jit, the same graceful fallback as a
+# corrupt entry.
+try:  # modern jax: top-level module
+    from jax import export as _export
+except ImportError:  # pragma: no cover - version-dependent
+    try:  # transitional releases
+        from jax.experimental import export as _export
+    except ImportError:
+        _export = None
+
+if _export is not None and not (hasattr(_export, "export")
+                                and hasattr(_export, "deserialize")):
+    _export = None  # pragma: no cover - exotic/partial API
+
+
+def export_available():
+    """Whether this jax exposes a usable ``export``/``deserialize``
+    pair (the shim above found one)."""
+    return _export is not None
+
+
+def _environment_tag():
+    """``jax version | platform`` — the environment half of the cache
+    key.  Serialized programs are portable across neither, so both
+    ride in the key and a mismatch is an ordinary ``absent`` miss."""
+    import jax
+
+    return f"{jax.__version__}|{jax.default_backend()}"
+
+
+@retry(name="serve.aot_store", retries=2, backoff=0.05)
+def _atomic_write(path, blob):
+    """One atomic cache-entry write (tmp + rename), retried on
+    transient ``OSError`` via :func:`brainiak_tpu.resilience.retry` —
+    a shared-filesystem hiccup backs off instead of losing the
+    entry."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        fh.write(blob)
+    os.replace(tmp, path)
+
+
+class AOTProgramCache:
+    """On-disk store of serialized serve programs + an in-process
+    table of the ones already deserialized.
+
+    One instance is shared by every engine of a serving process (the
+    :class:`~brainiak_tpu.serve.residency.ModelResidency` threads it
+    through), so :meth:`stats` is the process-wide hit/miss ledger
+    the service summary and the SRV002 gate read.
+
+    ``get`` returns a ready-to-call program (the deserialized export
+    re-wrapped in ``jax.jit`` so repeat dispatches do not re-stage
+    the StableHLO) or None; ``put`` exports + persists a jit program
+    and never raises — see the module docstring for the fallback
+    contract.
+    """
+
+    def __init__(self, directory, create=True):
+        self.directory = os.fspath(directory)
+        if create:
+            os.makedirs(self.directory, exist_ok=True)
+        self._programs = {}  # key -> deserialized jitted callable
+        self._hits = 0
+        self._misses = {}  # reason -> count
+        self._stores = 0
+        self.xla_cache_dir = None
+        if os.environ.get(XLA_CACHE_ENV, "1") != "0":
+            self.xla_cache_dir = self._enable_xla_cache()
+
+    def _enable_xla_cache(self):
+        """Best-effort: point jax's persistent compilation cache at
+        ``<dir>/xla`` so the XLA executables behind both the jit
+        builders and the deserialized exports survive restarts —
+        the serialized export alone removes trace+lowering, but the
+        first call would still re-compile the StableHLO.  Returns
+        the directory on success, None when this jax lacks the
+        knobs (export-only persistence still works)."""
+        xla_dir = os.path.join(self.directory, "xla")
+        try:
+            import jax
+
+            os.makedirs(xla_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", xla_dir)
+            # serve programs are small and compile fast; without
+            # zeroing the thresholds jax would skip caching them
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception as exc:  # pragma: no cover - jax version
+            logger.info(
+                "persistent XLA cache unavailable (%s: %s); "
+                "export-only persistence", type(exc).__name__, exc)
+            return None
+        try:
+            # a process that already compiled something initialized
+            # the (disabled) cache; re-init so the new dir takes.
+            # Private API — failure just means the dir applies only
+            # to processes configured before their first compile.
+            from jax._src import compilation_cache
+            compilation_cache.reset_cache()
+        except Exception:  # pragma: no cover - jax version
+            pass
+        return xla_dir
+
+    # -- keys ---------------------------------------------------------
+
+    def key_for(self, digest, site, args):
+        """The cache key for one (model, program family, bucket):
+        sha256 over artifact digest, builder site, the builder's
+        bucket-key arguments, and the jax-version/platform tag."""
+        h = hashlib.sha256()
+        for part in (digest, site, repr(tuple(args)),
+                     _environment_tag()):
+            h.update(str(part).encode())
+            h.update(b"|")
+        return h.hexdigest()
+
+    def _path(self, key, site):
+        # the site prefix is cosmetic (the key hash alone is the
+        # identity): it makes `ls` on the cache dir legible
+        fam = site.replace("/", "_").replace(".", "_")
+        return os.path.join(self.directory,
+                            f"{fam}-{key[:32]}.jaxprog")
+
+    # -- accounting ---------------------------------------------------
+
+    def _miss(self, site, reason):
+        self._misses[reason] = self._misses.get(reason, 0) + 1
+        obs_metrics.counter(
+            "serve_aot_miss_total",
+            help="AOT program-cache misses by reason").inc(
+                site=site, reason=reason)
+        return None
+
+    def stats(self):
+        """``{"hits", "misses": {reason: n}, "stores"}`` for this
+        process — the summary block the service CLI prints and the
+        SRV002 gate asserts on."""
+        return {"hits": self._hits,
+                "misses": dict(self._misses),
+                "stores": self._stores}
+
+    # -- lookup -------------------------------------------------------
+
+    def get(self, key, site):
+        """The persisted program under ``key``, or None (counted
+        miss).  A disk hit deserializes once per process; the engine
+        memoizes the returned callable per bucket, so each key is
+        looked up at most once per engine."""
+        cached = self._programs.get(key)
+        if cached is not None:
+            return cached
+        if not export_available():
+            return self._miss(site, "unsupported")
+        path = self._path(key, site)
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except FileNotFoundError:
+            return self._miss(site, "absent")
+        except OSError as exc:
+            logger.warning("aot cache read failed (%s): %s",
+                           path, exc)
+            return self._miss(site, "deserialize_failed")
+        try:
+            import jax
+
+            exported = _export.deserialize(blob)
+            # re-wrap in jit: .call re-stages the StableHLO per
+            # invocation otherwise.  The jit cache makes repeat
+            # dispatches of this bucket as cheap as the builder
+            # path — without ever running the builder (so
+            # retrace_total{site=serve.*} stays 0 on a warm cache).
+            # Built once per key: _programs memoizes the wrapper
+            # below, so this is not a per-call jit.
+            prog = jax.jit(exported.call)  # jaxlint: disable=JX001
+        except Exception as exc:
+            logger.warning(
+                "aot entry %s failed to deserialize (%s: %s); "
+                "falling back to jit", path,
+                type(exc).__name__, exc)
+            return self._miss(site, "deserialize_failed")
+        if len(self._programs) >= MAX_RESIDENT_PROGRAMS:
+            self._programs.pop(next(iter(self._programs)))
+        self._programs[key] = prog
+        self._hits += 1
+        obs_metrics.counter(
+            "serve_aot_hit_total",
+            help="AOT program-cache hits (compile stall "
+                 "avoided)").inc(site=site)
+        return prog
+
+    # -- store --------------------------------------------------------
+
+    def put(self, key, site, prog, example_args):
+        """Export ``prog`` (a jit program, possibly
+        :func:`~brainiak_tpu.obs.profile.profile_program`-wrapped)
+        for the shapes of ``example_args`` and persist it under
+        ``key``.  Never raises: export or write failure emits an
+        ``aot_store_failed`` event and the process simply stays on
+        the jit program it already has."""
+        if not export_available():
+            return False
+        path = self._path(key, site)
+        if os.path.exists(path):
+            return False  # already persisted (idempotent)
+        try:
+            import jax
+
+            fn = getattr(prog, "__wrapped__", prog)
+            specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for a in example_args]
+            blob = _export.export(fn)(*specs).serialize()
+            _atomic_write(path, blob)
+        except Exception as exc:
+            logger.warning(
+                "aot export of %s failed (%s: %s); serving "
+                "continues on jit", site, type(exc).__name__, exc)
+            obs_sink.event("aot_store_failed", site=site,
+                           error=type(exc).__name__)
+            return False
+        self._stores += 1
+        obs_sink.event("aot_store", site=site,
+                       bytes=len(blob))
+        return True

@@ -52,6 +52,8 @@ __all__ = [
     "VERSION_KEY",
     "detect_kind",
     "load_model",
+    "model_digest",
+    "model_nbytes",
     "save_model",
     "save_model_bytes",
 ]
@@ -569,3 +571,39 @@ def save_model_bytes(model):
     buf = io.BytesIO()
     save_model(model, buf)
     return buf.getvalue()
+
+
+def model_digest(model):
+    """Stable content hash of a fitted model's artifact surface.
+
+    sha256 over the adapter's packed arrays (key names, dtypes,
+    shapes, raw bytes) plus the kind and schema version — the same
+    surface :func:`save_model` persists, so a save/load round trip
+    (bit-exact by contract) keeps the digest, while any refit that
+    changes a fitted array changes it.  Used as the artifact half of
+    the :mod:`~brainiak_tpu.serve.aot` cache key.
+    """
+    import hashlib
+
+    kind = detect_kind(model)
+    arrays = ADAPTERS[kind].pack(model)
+    h = hashlib.sha256()
+    h.update(f"{kind}|{SCHEMA_VERSION}".encode())
+    for key in sorted(arrays):
+        arr = np.ascontiguousarray(np.asarray(arrays[key]))
+        h.update(f"|{key}|{arr.dtype}|{arr.shape}|".encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def model_nbytes(model):
+    """Byte size of a fitted model's artifact surface (sum of the
+    packed arrays' ``nbytes``) — the admission weight
+    :class:`~brainiak_tpu.serve.residency.ModelResidency` charges
+    against its HBM budget.  An estimate by construction: the engine
+    uploads (a padded stack of) these arrays to the device, so the
+    packed size tracks device residency without touching the
+    backend."""
+    kind = detect_kind(model)
+    arrays = ADAPTERS[kind].pack(model)
+    return int(sum(np.asarray(a).nbytes for a in arrays.values()))

@@ -109,6 +109,10 @@ class Request:
     Request object (e.g. retrying a ``deadline_exceeded``) keeps the
     stale clock and fails again immediately — reset
     ``submitted = None`` before resubmission.
+
+    ``model`` names the target model for multi-model serving
+    (:class:`~brainiak_tpu.serve.service.ServeService` routes on it;
+    the single-model engine ignores it).
     """
 
     request_id: str
@@ -116,6 +120,7 @@ class Request:
     subject: Optional[int] = None
     deadline_s: Optional[float] = None
     submitted: Optional[float] = None
+    model: Optional[str] = None
 
     def expired(self, now=None):
         if self.deadline_s is None or self.submitted is None:
@@ -148,14 +153,15 @@ class ServeResult:
 # -- request-file codec (offline CLI driver) --------------------------
 
 def save_requests(file, payloads, subjects=None, deadlines=None,
-                  ids=None):
+                  ids=None, models=None):
     """Write a batch of requests as one npz.
 
     ``payloads``: list of arrays (or 2-sequences of arrays for the
     FCMA pair layout, stored as ``x.<i>.0`` / ``x.<i>.1``);
-    ``subjects`` / ``deadlines``: optional per-request sequences
-    (None entries are omitted); ``ids`` default to ``"r<i>"``.
-    Returns ``file``.
+    ``subjects`` / ``deadlines`` / ``models``: optional per-request
+    sequences (None entries are omitted; ``models`` carries the
+    multi-model routing name the ``service`` CLI honors); ``ids``
+    default to ``"r<i>"``.  Returns ``file``.
     """
     out = {"n": np.asarray(len(payloads))}
     for i, payload in enumerate(payloads):
@@ -171,6 +177,8 @@ def save_requests(file, payloads, subjects=None, deadlines=None,
             out[f"subject.{i}"] = np.asarray(int(subjects[i]))
         if deadlines is not None and deadlines[i] is not None:
             out[f"deadline.{i}"] = np.asarray(float(deadlines[i]))
+        if models is not None and models[i] is not None:
+            out[f"model.{i}"] = np.asarray(str(models[i]))
     np.savez_compressed(file, **out)
     return file
 
@@ -193,6 +201,8 @@ def load_requests(file):
                 if f"subject.{i}" in z.files else None
             deadline = float(z[f"deadline.{i}"]) \
                 if f"deadline.{i}" in z.files else None
+            model = str(np.asarray(z[f"model.{i}"])) \
+                if f"model.{i}" in z.files else None
             out.append(Request(request_id=rid, x=x, subject=subject,
-                               deadline_s=deadline))
+                               deadline_s=deadline, model=model))
     return out

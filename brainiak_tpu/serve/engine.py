@@ -214,10 +214,48 @@ class _ServeOp:
 
     site = None
     isolate_on_failure = True
+    #: bound on the per-op program memo (None = unbounded); set by
+    #: ops whose bucket space is request-controlled (eventseg)
+    program_memo_max = None
 
     def __init__(self, model, policy):
         self.model = model
         self.policy = policy
+        # engine-level program memo + AOT wiring (filled in by the
+        # engine when an AOT cache is attached): one resolved
+        # callable per bucket key, so the AOT lookup happens at most
+        # once per (engine, bucket)
+        self._programs = {}
+        self.aot = None
+        self.digest = None
+
+    def run_program(self, builder, key_args, call_args):
+        """Resolve + run the jitted program for one bucket.
+
+        Resolution order: the per-op memo (already resolved this
+        engine) -> the AOT cache (a persisted program from a prior
+        process — no trace, no builder, so a warm cache serves with
+        ``retrace_total{site=serve.*} == 0``) -> the counted jit
+        builder (whose compile lands in ``retrace_total``), which is
+        then exported into the AOT cache for the next process.
+        ``call_args`` must be the exact dispatch arguments: their
+        shapes/dtypes are the export signature."""
+        prog = self._programs.get(key_args)
+        if prog is None:
+            if self.aot is not None:
+                key = self.aot.key_for(self.digest, self.site,
+                                       key_args)
+                prog = self.aot.get(key, self.site)
+                if prog is None:
+                    prog = builder(*key_args)
+                    self.aot.put(key, self.site, prog, call_args)
+            else:
+                prog = builder(*key_args)
+            if self.program_memo_max is not None and \
+                    len(self._programs) >= self.program_memo_max:
+                self._programs.pop(next(iter(self._programs)))
+            self._programs[key_args] = prog
+        return prog(*call_args)
 
     def validate(self, req):
         """(error_code, message) for a rejectable payload, else
@@ -310,10 +348,12 @@ class _SRMFamilyOp(_ServeOp):
     def dispatch(self, reqs, key, b_pad):
         t_b = key[0]
         x, subjects = self._assemble(reqs, t_b, b_pad)
-        prog = _srm_program(len(self.voxel_counts), self.v_pad,
-                            self.k, t_b, b_pad, str(self.dtype))
-        out = np.asarray(prog(self.w_stack, jnp.asarray(subjects),
-                              jnp.asarray(x)))
+        out = np.asarray(self.run_program(
+            _srm_program,
+            (len(self.voxel_counts), self.v_pad, self.k, t_b,
+             b_pad, str(self.dtype)),
+            (self.w_stack, jnp.asarray(subjects),
+             jnp.asarray(x))))
         return [np.array(out[i, :, :np.asarray(r.x).shape[1]])
                 for i, r in enumerate(reqs)]
 
@@ -327,12 +367,13 @@ class _RSRMTransformOp(_SRMFamilyOp):
     def dispatch(self, reqs, key, b_pad):
         t_b = key[0]
         x, subjects = self._assemble(reqs, t_b, b_pad)
-        prog = _rsrm_program(
-            len(self.voxel_counts), self.v_pad, self.k, t_b, b_pad,
-            float(self.model.gamma), int(self.model.n_iter),
-            str(self.dtype))
-        r, s = prog(self.w_stack, jnp.asarray(subjects),
-                    jnp.asarray(x))
+        r, s = self.run_program(
+            _rsrm_program,
+            (len(self.voxel_counts), self.v_pad, self.k, t_b,
+             b_pad, float(self.model.gamma),
+             int(self.model.n_iter), str(self.dtype)),
+            (self.w_stack, jnp.asarray(subjects),
+             jnp.asarray(x)))
         r = np.asarray(r)
         s = np.asarray(s)
         out = []
@@ -348,6 +389,9 @@ class _EventSegmentOp(_ServeOp):
     ``(segments [T, K], log-likelihood)`` per request."""
 
     site = "serve.eventseg"
+    # the bucket space is request-controlled (exact T), so the
+    # per-op program memo is bounded like the builder's lru
+    program_memo_max = _EVENTSEG_CACHE_PROGRAMS
 
     def __init__(self, model, policy):
         super().__init__(model, policy)
@@ -406,10 +450,11 @@ class _EventSegmentOp(_ServeOp):
         # vmap, and pad results are discarded)
         for i in range(len(reqs), b_pad):
             x[i] = x[len(reqs) - 1]
-        prog = _eventseg_program(self.n_vox, t, self.k, b_pad,
-                                 str(x.dtype))
-        lg, ll = prog(self.mean_pat, self.var, log_p, log_start,
-                      log_end, jnp.asarray(x))
+        lg, ll = self.run_program(
+            _eventseg_program,
+            (self.n_vox, t, self.k, b_pad, str(x.dtype)),
+            (self.mean_pat, self.var, log_p, log_start, log_end,
+             jnp.asarray(x)))
         lg = np.asarray(lg)
         ll = np.asarray(ll)
         return [(np.exp(lg[i]), float(ll[i]))
@@ -454,10 +499,11 @@ class _IEM1DOp(_ServeOp):
         for i, req in enumerate(reqs):
             xi = np.asarray(req.x, dtype=self.dtype)
             x[i, :xi.shape[0]] = xi
-        prog = _iem_program(t_b, self.n_vox, self.k_chan,
-                            self.density, b_pad, str(self.dtype))
-        idx = np.asarray(prog(self.pinv_w, self.channels,
-                              jnp.asarray(x)))
+        idx = np.asarray(self.run_program(
+            _iem_program,
+            (t_b, self.n_vox, self.k_chan, self.density, b_pad,
+             str(self.dtype)),
+            (self.pinv_w, self.channels, jnp.asarray(x))))
         return [self.domain[idx[i, :np.asarray(r.x).shape[0]]]
                 for i, r in enumerate(reqs)]
 
@@ -529,11 +575,12 @@ class _RidgeEncodingOp(_ServeOp):
             x[i, :feats.shape[0]] = feats
             y[i, :resp.shape[0]] = resp
             t_real[i] = feats.shape[0]
-        prog = _encoding_program(self.n_features, self.n_vox, t_b,
-                                 b_pad, str(self.dtype))
-        scores = np.asarray(prog(self.w, self.b, jnp.asarray(x),
-                                 jnp.asarray(y),
-                                 jnp.asarray(t_real)))
+        scores = np.asarray(self.run_program(
+            _encoding_program,
+            (self.n_features, self.n_vox, t_b, b_pad,
+             str(self.dtype)),
+            (self.w, self.b, jnp.asarray(x), jnp.asarray(y),
+             jnp.asarray(t_real))))
         return [np.array(scores[i]) for i in range(len(reqs))]
 
 
@@ -670,6 +717,16 @@ class InferenceEngine:
     kind : str, optional
         Override adapter detection (useful for duck-typed models).
     policy : :class:`~brainiak_tpu.serve.batching.BucketPolicy`
+    aot : :class:`~brainiak_tpu.serve.aot.AOTProgramCache` or str,
+        optional
+        Persisted-program cache (a path constructs one): bucket
+        programs are looked up there before the jit builders, so a
+        process restarted over a warm cache serves its first
+        request without a compile stall
+        (``retrace_total{site=serve.*} == 0``), and every program
+        this engine does build is exported for the next process.
+        The host-delegated ``fcma`` kind has no exportable serve
+        program and ignores the cache.
 
     Usage: :meth:`submit` requests (full buckets flush
     immediately), :meth:`poll` on a timer to enforce ``max_wait_s``,
@@ -685,7 +742,8 @@ class InferenceEngine:
     multiple threads must serialize engine calls externally.
     """
 
-    def __init__(self, model, kind=None, policy=None):
+    def __init__(self, model, kind=None, policy=None, aot=None,
+                 digest=None):
         self.kind = kind or artifacts.detect_kind(model)
         if self.kind not in _KIND_OPS:
             raise ValueError(
@@ -693,6 +751,16 @@ class InferenceEngine:
                 f"(supported: {', '.join(sorted(_KIND_OPS))})")
         self.policy = policy or BucketPolicy()
         self.op = _KIND_OPS[self.kind](model, self.policy)
+        if aot is not None and self.kind != "fcma":
+            from . import aot as aot_mod
+            if not isinstance(aot, aot_mod.AOTProgramCache):
+                aot = aot_mod.AOTProgramCache(aot)
+            self.op.aot = aot
+            # the caller (residency) may pass the precomputed
+            # artifact digest so evict/re-admit cycles do not
+            # re-hash a large model on the request hot path
+            self.op.digest = digest or artifacts.model_digest(model)
+        self.aot = self.op.aot
         self._queues = {}   # bucket key -> [Request]
         self._records = []
         self._n_submitted = 0
@@ -761,6 +829,24 @@ class InferenceEngine:
         """Flush every queued bucket (offline drain)."""
         for key in list(self._queues):
             self._flush_bucket(key)
+
+    def fail_pending(self, code="shutdown", message=None):
+        """Fail every still-queued request with a structured error
+        record (the non-draining half of a service
+        ``shutdown(drain=False)``): each gets exactly one
+        :class:`ServeResult` carrying ``code``, no device time is
+        consumed, and the records land in the normal
+        :meth:`drain` stream.  Returns the number failed."""
+        n = 0
+        if message is None:
+            message = ("request was still queued when the engine "
+                       "shut down")
+        for key in list(self._queues):
+            for req in self._queues.pop(key, []):
+                self._record_error(req, code, message)
+                n += 1
+        self._gauge_depth()
+        return n
 
     def run(self, requests):
         """Submit + drain, returning one record per passed request
@@ -936,7 +1022,7 @@ class InferenceEngine:
                 "serve batch %s failed (%s: %s); retrying "
                 "per-request to isolate the poison payload",
                 bucket, type(exc).__name__, exc)
-            self._run_isolated(key, group)
+            self._run_isolated(key, group, b_pad)
             return
         done = time.monotonic()
         for req, result in zip(group, results):
@@ -946,16 +1032,20 @@ class InferenceEngine:
                 seq=getattr(req, "_seq_index", None))
             self._finish(req, rec, outcome="ok")
 
-    def _run_isolated(self, key, group):
+    def _run_isolated(self, key, group, b_pad):
         """Per-request fallback after a batch-level failure: each
         request runs in its own singleton batch so exactly the
         poison one fails.  Re-dispatches honor the same deadline and
         stats accounting as the normal path (the failed batch may
-        have burned a queued request's remaining budget)."""
-        # honor the policy's batch floor: a min_batch_bucket=4
-        # policy must not compile an out-of-policy b_pad=1 shape
-        # mid-failure-recovery
-        b_pad = self.op.batch_extent(1)
+        have burned a queued request's remaining budget).
+
+        Singletons are re-padded to the FAILED dispatch's batch
+        extent, the smallest admissible bucket known to this flush —
+        never re-bucketed through the batch table — so poison
+        recovery adds **zero** new program shapes per kind
+        (``retrace_total{site=serve.*}`` stays bounded by the
+        bucket count; the old ``batch_extent(1)`` re-pad minted a
+        fresh singleton shape per poisoned data bucket)."""
         for req in group:
             if req.expired():
                 waited = time.monotonic() - req.submitted

@@ -1,0 +1,372 @@
+"""HBM-aware multi-model residency: many models, one byte budget.
+
+A production serving process answers for MANY fitted models (one
+encoding model per individual in the arXiv:2403.19421 setting), but
+HBM is finite: loading every artifact eagerly OOMs, and loading per
+request pays artifact I/O + upload on the hot path.
+:class:`ModelResidency` is the middle ground — a byte-weighted LRU
+of loaded (model, engine) pairs under an explicit budget:
+
+- **admission** — :meth:`acquire` loads a registered artifact on
+  first use and charges its packed byte size
+  (:func:`~brainiak_tpu.serve.artifacts.model_nbytes`) against the
+  budget, evicting least-recently-used unpinned residents until it
+  fits; a model that cannot fit even after evicting everything
+  evictable raises the **typed** :class:`AdmissionError` — the
+  refusal happens at admission time in Python, never as a device
+  OOM mid-batch;
+- **pinning** — ``register(..., pinned=True)`` exempts a model from
+  eviction (the always-hot tier); pinned bytes still count against
+  the budget, so over-pinning surfaces as ``AdmissionError`` at the
+  next admission, not as silent thrash;
+- **transparent re-admission** — eviction drops the resident entry
+  (the engine and its device arrays), but the registration (source
+  path / loader) stays, so the next :meth:`acquire` reloads and
+  re-admits without the caller noticing anything but latency (the
+  AOT cache of :mod:`~brainiak_tpu.serve.aot` keeps even that
+  reload compile-free).
+
+The default budget comes from the device — the smallest device's
+``bytes_limit`` from
+:func:`brainiak_tpu.obs.runtime.device_memory_snapshot` (the same
+stats the PR 4 memory-watermark gauges read), scaled by
+:data:`DEFAULT_BUDGET_FRACTION` to leave headroom for batch buffers
+— with the ``BRAINIAK_TPU_SERVE_BUDGET_BYTES`` env override winning
+and a conservative constant fallback on backends without memory
+stats (CPU).
+
+Telemetry: ``serve_resident_models`` / ``serve_resident_bytes``
+gauges track occupancy, ``serve_evictions_total{model=}`` counts
+victims, and every eviction emits an ``eviction`` event naming the
+victim, its bytes, and the admission that displaced it.
+"""
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Any, Optional
+
+from ..obs import metrics as obs_metrics
+from ..obs import sink as obs_sink
+from ..obs.runtime import device_memory_snapshot
+from . import artifacts
+from .engine import InferenceEngine
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "AdmissionError",
+    "BUDGET_ENV",
+    "DEFAULT_BUDGET_BYTES",
+    "DEFAULT_BUDGET_FRACTION",
+    "ModelResidency",
+    "ResidentModel",
+    "default_budget_bytes",
+]
+
+BUDGET_ENV = "BRAINIAK_TPU_SERVE_BUDGET_BYTES"
+
+#: Fallback budget on backends without ``memory_stats`` (CPU): big
+#: enough that single-host test serving never thrashes, small enough
+#: to be an honest stand-in for one accelerator's HBM.
+DEFAULT_BUDGET_BYTES = 8 << 30
+
+#: Fraction of the smallest device's ``bytes_limit`` granted to
+#: model residency; the rest is headroom for padded batch buffers
+#: and XLA scratch.
+DEFAULT_BUDGET_FRACTION = 0.8
+
+
+def default_budget_bytes():
+    """The residency byte budget: the ``BRAINIAK_TPU_SERVE_BUDGET_
+    BYTES`` env override, else :data:`DEFAULT_BUDGET_FRACTION` of
+    the smallest device's ``bytes_limit``
+    (:func:`~brainiak_tpu.obs.runtime.device_memory_snapshot`), else
+    :data:`DEFAULT_BUDGET_BYTES` when the backend exposes no memory
+    stats (CPU) or jax is not initialized."""
+    raw = os.environ.get(BUDGET_ENV)
+    if raw:
+        return int(raw)
+    limits = [d["bytes_limit"]
+              for d in device_memory_snapshot(emit=False)
+              if "bytes_limit" in d]
+    if limits:
+        return int(min(limits) * DEFAULT_BUDGET_FRACTION)
+    return DEFAULT_BUDGET_BYTES
+
+
+class AdmissionError(RuntimeError):
+    """A model could not be admitted under the byte budget — the
+    typed, pre-device refusal the serving layer returns instead of
+    an OOM.  Carries the sizing facts a capacity dashboard needs."""
+
+    def __init__(self, name, needed, budget, resident, pinned):
+        self.model = name
+        self.needed_bytes = int(needed)
+        self.budget_bytes = int(budget)
+        self.resident_bytes = int(resident)
+        self.pinned_bytes = int(pinned)
+        super().__init__(
+            f"cannot admit model {name!r}: needs "
+            f"{self.needed_bytes} bytes against a "
+            f"{self.budget_bytes}-byte budget with "
+            f"{self.pinned_bytes} bytes pinned "
+            f"({self.resident_bytes} resident) — raise the budget, "
+            "unpin a model, or shrink the artifact")
+
+
+@dataclasses.dataclass
+class _Registration:
+    """How to (re)load one named model: a filesystem source (path or
+    loader callable) or a held instance."""
+
+    name: str
+    source: Optional[Any] = None   # path or callable -> model
+    model: Optional[Any] = None    # held instance (host memory)
+    kind: Optional[str] = None
+    pinned: bool = False
+    admissions: int = 0            # lifetime admits (re-admits too)
+    nbytes: Optional[int] = None   # learned at first load
+    digest: Optional[str] = None   # learned at first AOT admit
+
+    def load(self):
+        if self.model is not None:
+            return self.model
+        if callable(self.source):
+            return self.source()
+        return artifacts.load_model(self.source)
+
+
+@dataclasses.dataclass
+class ResidentModel:
+    """One admitted model: the loaded estimator, its engine, and the
+    accounting the LRU runs on."""
+
+    name: str
+    model: Any
+    engine: InferenceEngine
+    nbytes: int
+    pinned: bool = False
+    last_used: float = 0.0
+    admissions: int = 1
+
+    def touch(self):
+        self.last_used = time.monotonic()
+
+
+class ModelResidency:
+    """Byte-weighted LRU of loaded models with pinning.
+
+    Parameters
+    ----------
+    budget_bytes : int, optional
+        Admission budget; default :func:`default_budget_bytes`.
+    policy : :class:`~brainiak_tpu.serve.batching.BucketPolicy`,
+        optional
+        Shared by every engine this residency constructs.
+    aot : :class:`~brainiak_tpu.serve.aot.AOTProgramCache` or str,
+        optional
+        Threaded into every engine, so evict/re-admit cycles and
+        process restarts stay compile-free.
+
+    Not thread-safe on its own: the
+    :class:`~brainiak_tpu.serve.service.ServeService` loop is the
+    single caller in the online shape (the same contract as the
+    engine).
+    """
+
+    def __init__(self, budget_bytes=None, policy=None, aot=None):
+        self.budget_bytes = int(budget_bytes
+                                if budget_bytes is not None
+                                else default_budget_bytes())
+        if self.budget_bytes <= 0:
+            raise ValueError(
+                f"budget_bytes must be positive, got "
+                f"{self.budget_bytes}")
+        self.policy = policy
+        if aot is not None:
+            from . import aot as aot_mod
+            if not isinstance(aot, aot_mod.AOTProgramCache):
+                aot = aot_mod.AOTProgramCache(aot)
+        self.aot = aot
+        self._registry = {}   # name -> _Registration
+        self._resident = {}   # name -> ResidentModel
+        self._n_evictions = 0
+        #: optional ``fn(name, records)`` called with the error
+        #: records of work stranded on an evicted engine — the
+        #: service loop installs its delivery path here so evicted
+        #: queues resolve their tickets instead of vanishing
+        self.on_evict_records = None
+        #: optional ``fn(entry)`` called for EVERY eviction with
+        #: the dying :class:`ResidentModel` (before it is dropped)
+        #: — the service accrues the engine's batch/padding stats
+        #: here so summary metrics survive residency churn
+        self.on_evict = None
+
+    # -- registration -------------------------------------------------
+
+    def register(self, name, source=None, model=None, kind=None,
+                 pinned=False):
+        """Register a named model without loading it.
+
+        Exactly one of ``source`` (artifact path, or a zero-arg
+        loader callable) and ``model`` (a fitted instance; host
+        memory is the caller's — eviction then only frees the
+        engine's device arrays) must be given.  ``pinned`` models
+        are never evicted.  Returns ``name``."""
+        if (source is None) == (model is None):
+            raise ValueError(
+                "register() takes exactly one of source= / model=")
+        if name in self._registry:
+            raise ValueError(f"model {name!r} already registered")
+        self._registry[name] = _Registration(
+            name=name, source=source, model=model, kind=kind,
+            pinned=bool(pinned))
+        return name
+
+    def names(self):
+        """Registered model names (resident or not)."""
+        return sorted(self._registry)
+
+    def resident_names(self):
+        return sorted(self._resident)
+
+    def entries(self):
+        """The live :class:`ResidentModel` entries, name-sorted."""
+        return [self._resident[name]
+                for name in self.resident_names()]
+
+    # -- the LRU ------------------------------------------------------
+
+    def acquire(self, name):
+        """The live :class:`ResidentModel` for ``name``, loading and
+        admitting it first if necessary (the transparent-re-admission
+        path).  Raises ``KeyError`` for an unregistered name and
+        :class:`AdmissionError` when it cannot fit."""
+        entry = self._resident.get(name)
+        if entry is None:
+            reg = self._registry.get(name)
+            if reg is None:
+                raise KeyError(
+                    f"model {name!r} is not registered "
+                    f"(known: {', '.join(self.names()) or 'none'})")
+            entry = self._admit(reg)
+        entry.touch()
+        return entry
+
+    def _admit(self, reg):
+        # a size learned on a PRIOR load makes an over-budget model
+        # refuse in O(1): a request stream aimed at an inadmissible
+        # artifact must not re-read it from disk on every route
+        if reg.nbytes is not None and \
+                reg.nbytes > self.budget_bytes:
+            raise AdmissionError(
+                reg.name, reg.nbytes, self.budget_bytes,
+                self.resident_bytes(), self.pinned_bytes())
+        model = reg.load()
+        nbytes = artifacts.model_nbytes(model)
+        reg.nbytes = nbytes
+        self._make_room(reg.name, nbytes)
+        # the artifact digest cannot change between admissions of
+        # the same registration (bit-exact load contract): hash
+        # once, not on every evict/re-admit cycle of a GB artifact
+        if self.aot is not None and reg.digest is None:
+            reg.digest = artifacts.model_digest(model)
+        engine = InferenceEngine(model, kind=reg.kind,
+                                 policy=self.policy, aot=self.aot,
+                                 digest=reg.digest)
+        reg.admissions += 1
+        entry = ResidentModel(
+            name=reg.name, model=model, engine=engine,
+            nbytes=nbytes, pinned=reg.pinned,
+            last_used=time.monotonic(),
+            admissions=reg.admissions)
+        self._resident[reg.name] = entry
+        self._gauge()
+        return entry
+
+    def _make_room(self, incoming, nbytes):
+        """Evict LRU unpinned residents until ``nbytes`` fits; the
+        typed refusal when even that is not enough."""
+        if nbytes > self.budget_bytes:
+            raise AdmissionError(
+                incoming, nbytes, self.budget_bytes,
+                self.resident_bytes(), self.pinned_bytes())
+        while self.resident_bytes() + nbytes > self.budget_bytes:
+            victims = sorted(
+                (e for e in self._resident.values()
+                 if not e.pinned and e.name != incoming),
+                key=lambda e: e.last_used)
+            if not victims:
+                raise AdmissionError(
+                    incoming, nbytes, self.budget_bytes,
+                    self.resident_bytes(), self.pinned_bytes())
+            self.evict(victims[0].name,
+                       reason=f"admission of {incoming!r}")
+
+    def evict(self, name, reason="manual"):
+        """Drop a resident model (engine + device arrays); the
+        registration survives so the next :meth:`acquire` re-admits.
+        Pinned models refuse with ``ValueError``.  Queued work on
+        the evicted engine is failed with ``evicted`` records and
+        returned (the service loop delivers them)."""
+        entry = self._resident.get(name)
+        if entry is None:
+            raise KeyError(f"model {name!r} is not resident")
+        if entry.pinned:
+            raise ValueError(f"model {name!r} is pinned")
+        entry.engine.fail_pending(
+            "evicted", "model was evicted while the request was "
+                       "queued; resubmit")
+        records = entry.engine.drain()
+        if records and self.on_evict_records is not None:
+            self.on_evict_records(name, records)
+        if self.on_evict is not None:
+            self.on_evict(entry)
+        del self._resident[name]
+        self._n_evictions += 1
+        obs_metrics.counter(
+            "serve_evictions_total",
+            help="models evicted from residency").inc(model=name)
+        obs_sink.event("eviction", model=name,
+                       nbytes=entry.nbytes, reason=reason,
+                       admissions=entry.admissions)
+        logger.info("evicted model %r (%d bytes, %s)", name,
+                    entry.nbytes, reason)
+        self._gauge()
+        return records
+
+    # -- accounting ---------------------------------------------------
+
+    def resident_bytes(self):
+        return sum(e.nbytes for e in self._resident.values())
+
+    def pinned_bytes(self):
+        return sum(e.nbytes for e in self._resident.values()
+                   if e.pinned)
+
+    def _gauge(self):
+        obs_metrics.gauge(
+            "serve_resident_models",
+            help="models currently resident").set(
+                len(self._resident))
+        obs_metrics.gauge(
+            "serve_resident_bytes", unit="bytes").set(
+                self.resident_bytes())
+
+    def stats(self):
+        """Occupancy + churn for the service summary."""
+        return {
+            "budget_bytes": self.budget_bytes,
+            "resident_bytes": self.resident_bytes(),
+            "pinned_bytes": self.pinned_bytes(),
+            "n_registered": len(self._registry),
+            "n_resident": len(self._resident),
+            "resident": self.resident_names(),
+            "evictions": self._n_evictions,
+            "admissions": {
+                name: r.admissions
+                for name, r in sorted(self._registry.items())
+                if r.admissions},
+        }

@@ -1,0 +1,484 @@
+"""Always-on serving: the continuous-batching service loop.
+
+PR 5's :class:`~brainiak_tpu.serve.engine.InferenceEngine` is a
+one-shot batch driver — callers flush a fixed request list and wait,
+one model per engine.  :class:`ServeService` is the long-lived layer
+production serving needs, with no new runtime dependencies (one
+``threading.Thread``):
+
+- **continuous batching** — :meth:`submit` enqueues into the
+  per-(model, bucket) queues and returns a :class:`ServiceTicket`
+  immediately; a request submitted while a bucket's batch is
+  in flight simply joins the bucket queue and rides the NEXT
+  dispatch of the same bucket — no flush-and-wait barrier.
+  Dispatch fires on max-batch (inside ``engine.submit``) or
+  max-wait (the loop's ``engine.poll`` timer), and deadlines keep
+  counting from the ORIGINAL enqueue: :meth:`submit` stamps
+  ``request.submitted`` with the same ``time.monotonic`` clock the
+  engine's dispatch-time deadline check reads;
+- **multi-model** — requests route by model name through a
+  :class:`~brainiak_tpu.serve.residency.ModelResidency`, so an
+  evicted model is transparently re-admitted on its next request
+  and an over-budget model fails with a typed
+  ``admission_refused`` record instead of an OOM;
+- **graceful shutdown** — :meth:`shutdown` with ``drain=True``
+  flushes every queue and delivers every result;
+  ``drain=False`` fails all queued work with a clear ``shutdown``
+  status.  Either way every submitted request resolves exactly one
+  ticket.
+
+Threading contract: the engines and the residency are single-caller
+by design, so ALL engine/residency access happens on the service
+thread; :meth:`submit` only appends to a locked ingress queue (safe
+from any thread), and :meth:`summary`/:meth:`shutdown` synchronize
+through the same lock.  Results are delivered by resolving tickets
+— ``ticket.result(timeout)`` blocks the caller, never the loop.
+
+Telemetry (live while an obs sink is active): ``serve.service.tick``
+spans around every loop tick that did work (ingress routed, batches
+flushed, records delivered), ``serve_service_ingress_depth`` /
+``serve_service_queue_depth{model=}`` gauges, and the engine-level
+``serve_request_seconds`` histograms / ``serve_padding_waste_ratio``
+gauges the bench tier's p50/p99 and padding-waste gates read.
+"""
+
+import collections
+import logging
+import threading
+import time
+
+from ..obs import metrics as obs_metrics
+from ..obs import sink as obs_sink
+from .batching import ServeResult
+from .residency import AdmissionError
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ServeService", "ServiceClosed", "ServiceTicket",
+           "serve_retrace_total"]
+
+
+def serve_retrace_total():
+    """Process-wide ``retrace_total{site=serve.*}`` sum — the
+    zero-cold-start headline the restart acceptance test and the
+    SRV002 gate assert on."""
+    total = 0.0
+    for labels, value in obs_metrics.counter(
+            "retrace_total").samples():
+        if str(labels.get("site", "")).startswith("serve."):
+            total += value
+    return total
+
+#: Cap on the retained ok-latency samples the service percentiles
+#: are computed from (drop-oldest beyond it) — a week-long process
+#: must not grow an unbounded float list.
+_LATENCY_WINDOW = 65536
+
+
+class ServiceClosed(RuntimeError):
+    """submit() after shutdown() — the service no longer accepts
+    work."""
+
+
+class ServiceTicket:
+    """One request's future: resolved with exactly one
+    :class:`~brainiak_tpu.serve.batching.ServeResult` (a result or a
+    structured error, never silence — the engine contract, extended
+    across threads)."""
+
+    __slots__ = ("request_id", "model", "record", "_event")
+
+    def __init__(self, request_id, model):
+        self.request_id = request_id
+        self.model = model
+        self.record = None
+        self._event = threading.Event()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """Block until the record arrives; raises ``TimeoutError``
+        if it does not within ``timeout`` seconds."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id!r} (model "
+                f"{self.model!r}) not served within {timeout}s")
+        return self.record
+
+    def _resolve(self, record):
+        self.record = record
+        self._event.set()
+
+
+class ServeService:
+    """The always-on serving loop over a
+    :class:`~brainiak_tpu.serve.residency.ModelResidency`.
+
+    Usage::
+
+        residency = ModelResidency(budget_bytes=..., aot=cache_dir)
+        residency.register("subj01", source="subj01.npz")
+        with ServeService(residency) as svc:
+            ticket = svc.submit(Request("r0", x, model="subj01"))
+            record = ticket.result(timeout=5.0)
+
+    ``tick_interval`` bounds how long the loop sleeps between
+    max-wait checks (default: half the bucket policy's
+    ``max_wait_s``, clipped to [5 ms, 50 ms]); submissions wake the
+    loop immediately, so idle ticks cost one condition wait.
+    """
+
+    def __init__(self, residency, tick_interval=None,
+                 default_model=None):
+        self.residency = residency
+        policy = residency.policy
+        max_wait = policy.max_wait_s if policy is not None else 0.05
+        self.tick_interval = (
+            tick_interval if tick_interval is not None
+            else min(0.05, max(0.005, max_wait / 2.0)))
+        self._default_model = default_model
+        self._cond = threading.Condition()
+        # serializes engine/residency access between the loop's
+        # ticks and caller-thread summary() reads
+        self._engine_lock = threading.Lock()
+        self._ingress = collections.deque()
+        self._pending = {}   # (model, engine seq) -> ticket
+        self._state = "idle"
+        self._drain_on_stop = True
+        self._thread = None
+        self._latencies = collections.deque(maxlen=_LATENCY_WINDOW)
+        self._n_submitted = 0
+        self._n_delivered = 0
+        self._n_ok = 0
+        self._errors_by_code = {}
+        self._n_ticks = 0
+        self._n_active_ticks = 0
+        # dispatched-element stats of engines that were evicted:
+        # summary()'s padding waste must cover the WHOLE drive,
+        # not just the engines that happen to be resident at read
+        # time (re-admission builds a fresh engine with zeroed
+        # stats)
+        self._retired_real = 0
+        self._retired_padded = 0
+        # deliver results stranded on an engine evicted mid-queue
+        residency.on_evict_records = self._deliver_many
+        residency.on_evict = self._accrue_evicted
+
+    def _accrue_evicted(self, entry):
+        stats = entry.engine._stats
+        self._retired_real += stats["real_elements"]
+        self._retired_padded += stats["padded_elements"]
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self):
+        """Start the service thread (idempotent); returns self."""
+        with self._cond:
+            if self._state == "running":
+                return self
+            if self._state not in ("idle",):
+                raise ServiceClosed(
+                    "service was shut down; build a new one")
+            self._state = "running"
+            self._thread = threading.Thread(
+                target=self._loop, name="serve-service",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(drain=exc_type is None)
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop the loop and resolve every outstanding ticket.
+
+        ``drain=True`` flushes all queues and serves the queued work
+        to completion first; ``drain=False`` fails everything still
+        queued with a ``shutdown`` error record.  Returns
+        :meth:`summary`.  ``timeout`` bounds the join; a loop that
+        does not finish in time is abandoned (daemon thread) after
+        a warning."""
+        with self._cond:
+            if self._state == "running":
+                self._drain_on_stop = bool(drain)
+                self._state = "stopping"
+                self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():  # pragma: no cover - timing
+                logger.warning(
+                    "service loop did not stop within %ss", timeout)
+        with self._cond:
+            self._state = "stopped"
+        return self.summary()
+
+    # -- submission (any thread) --------------------------------------
+
+    def submit(self, request, model=None):
+        """Enqueue one request; returns its :class:`ServiceTicket`.
+
+        The target model is ``model`` or ``request.model`` or the
+        service's default (a single registered model).  The deadline
+        clock starts HERE: ``request.submitted`` is stamped with
+        ``time.monotonic()`` on enqueue (unless the caller
+        pre-stamped ingress time), and the engine's dispatch-time
+        deadline check counts from that same stamp no matter how
+        many ticks the request waits through."""
+        name = model or request.model or self._default_model
+        if name is None:
+            names = self.residency.names()
+            if len(names) == 1:
+                name = names[0]
+            else:
+                raise ValueError(
+                    "request names no model and the service has "
+                    f"no default ({len(names)} registered)")
+        if request.submitted is None:
+            request.submitted = time.monotonic()
+        ticket = ServiceTicket(request.request_id, name)
+        with self._cond:
+            if self._state != "running":
+                raise ServiceClosed(
+                    f"service is {self._state}; submit() needs a "
+                    "running loop (start()/with-block)")
+            self._ingress.append((name, request, ticket))
+            depth = len(self._ingress)
+            self._n_submitted += 1
+            self._cond.notify_all()
+        obs_metrics.gauge(
+            "serve_service_ingress_depth",
+            help="requests accepted but not yet routed").set(depth)
+        return ticket
+
+    def submit_many(self, requests, model=None):
+        """Atomically enqueue a wave of requests (one lock take, one
+        loop wake-up): the whole wave is routed in a single tick, so
+        its bucket-queue composition — and therefore the padded
+        batch extents the flush compiles — is deterministic, not a
+        race between submission and the max-wait timer.  Returns the
+        tickets in order."""
+        now = time.monotonic()
+        staged = []
+        for request in requests:
+            name = (model or request.model or self._default_model)
+            if name is None:
+                names = self.residency.names()
+                if len(names) != 1:
+                    raise ValueError(
+                        "request names no model and the service "
+                        f"has no default ({len(names)} registered)")
+                name = names[0]
+            if request.submitted is None:
+                request.submitted = now
+            staged.append((name, request,
+                           ServiceTicket(request.request_id, name)))
+        with self._cond:
+            if self._state != "running":
+                raise ServiceClosed(
+                    f"service is {self._state}; submit_many() "
+                    "needs a running loop (start()/with-block)")
+            self._ingress.extend(staged)
+            depth = len(self._ingress)
+            self._n_submitted += len(staged)
+            self._cond.notify_all()
+        obs_metrics.gauge(
+            "serve_service_ingress_depth",
+            help="requests accepted but not yet routed").set(depth)
+        return [ticket for _, _, ticket in staged]
+
+    # -- the loop (service thread only) -------------------------------
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                if self._state == "running" and not self._ingress:
+                    self._cond.wait(self.tick_interval)
+                batch = list(self._ingress)
+                self._ingress.clear()
+                stopping = self._state != "running"
+            with self._engine_lock:
+                self._tick(batch)
+                if stopping:
+                    self._finish(
+                        batch_failed=not self._drain_on_stop)
+                    return
+
+    def _tick(self, batch):
+        self._n_ticks += 1
+        t0 = time.perf_counter()
+        n_records = 0
+        routed = 0
+        for name, request, ticket in batch:
+            routed += self._route(name, request, ticket)
+        for entry in self.residency.entries():
+            entry.engine.poll()
+            records = entry.engine.drain()
+            if records:
+                n_records += len(records)
+                self._deliver_many(entry.name, records)
+            obs_metrics.gauge(
+                "serve_service_queue_depth",
+                help="requests queued in a model's bucket "
+                     "queues").set(
+                    sum(len(q) for q in entry.engine._queues
+                        .values()), model=entry.name)
+        if batch or n_records:
+            # one span per tick that did work (routed ingress or
+            # delivered results), carrying the measured tick
+            # duration — idle ticks stay out of the trace
+            self._n_active_ticks += 1
+            if obs_sink.enabled():
+                obs_sink.emit(obs_sink.make_record(
+                    "span", "serve.service.tick",
+                    path="serve.service.tick",
+                    dur_s=time.perf_counter() - t0,
+                    attrs={"n_ingress": len(batch),
+                           "n_routed": routed,
+                           "n_delivered": n_records}))
+        if batch:
+            obs_metrics.gauge(
+                "serve_service_ingress_depth",
+                help="requests accepted but not yet "
+                     "routed").set(0)
+
+    def _route(self, name, request, ticket):
+        """One ingress request into its model's engine; failures
+        become typed error records on the ticket, never loop
+        crashes.  Returns 1 when the request reached a queue."""
+        try:
+            entry = self.residency.acquire(name)
+        except AdmissionError as exc:
+            self._fail(ticket, request, "admission_refused",
+                       str(exc))
+            return 0
+        except KeyError as exc:
+            self._fail(ticket, request, "unknown_model",
+                       str(exc.args[0] if exc.args else exc))
+            return 0
+        except Exception as exc:
+            self._fail(ticket, request, "model_load_failed",
+                       f"{type(exc).__name__}: {exc}")
+            return 0
+        rejection = entry.engine.submit(request)
+        if rejection is not None:
+            # submit-time rejection: the engine's sync return is
+            # the only delivery — resolve the ticket with it
+            self._account(rejection)
+            ticket._resolve(rejection)
+            return 0
+        self._pending[(name, request._seq_index)] = ticket
+        return 1
+
+    def _fail(self, ticket, request, code, message):
+        latency = None
+        if request.submitted is not None:
+            latency = time.monotonic() - request.submitted
+        rec = ServeResult(
+            request_id=request.request_id, ok=False, error=code,
+            message=message, latency_s=latency)
+        self._account(rec)
+        ticket._resolve(rec)
+
+    def _deliver_many(self, name, records):
+        for rec in records:
+            ticket = self._pending.pop((name, rec.seq), None)
+            self._account(rec)
+            if ticket is not None:
+                ticket._resolve(rec)
+            else:  # pragma: no cover - engine driven out of band
+                logger.warning(
+                    "record for %r seq %s has no waiting ticket",
+                    name, rec.seq)
+
+    def _account(self, rec):
+        self._n_delivered += 1
+        if rec.ok:
+            self._n_ok += 1
+            if rec.latency_s is not None:
+                self._latencies.append(rec.latency_s)
+        else:
+            code = rec.error or "error"
+            self._errors_by_code[code] = \
+                self._errors_by_code.get(code, 0) + 1
+
+    def _finish(self, batch_failed):
+        """Final phase after stop: drain or fail everything queued
+        so every ticket resolves."""
+        with self._cond:
+            leftovers = list(self._ingress)
+            self._ingress.clear()
+        if batch_failed:
+            for name, request, ticket in leftovers:
+                self._fail(ticket, request, "shutdown",
+                           "service shut down before the request "
+                           "was routed")
+            for entry in self.residency.entries():
+                entry.engine.fail_pending("shutdown")
+                self._deliver_many(entry.name,
+                                   entry.engine.drain())
+            return
+        for name, request, ticket in leftovers:
+            self._route(name, request, ticket)
+        for entry in self.residency.entries():
+            entry.engine.flush()
+            self._deliver_many(entry.name, entry.engine.drain())
+
+    # -- reporting ----------------------------------------------------
+
+    def summary(self):
+        """Service-level aggregate: delivery counts, latency
+        percentiles over the retained window, padding waste,
+        per-model engine summaries, residency occupancy and churn,
+        and the AOT hit/miss ledger when a cache is attached.
+
+        ``retrace_total`` is the process-wide
+        ``retrace_total{site=serve.*}`` sum — the acceptance
+        headline: on a warm AOT cache a restarted process serves
+        with this at 0."""
+        def pct(q):
+            if not latencies:
+                return None
+            idx = min(len(latencies) - 1,
+                      int(round(q * (len(latencies) - 1))))
+            return latencies[idx]
+
+        models = {}
+        with self._engine_lock:
+            # under the tick lock: the loop appends to _latencies
+            # while delivering, and sorting a mutating deque raises
+            latencies = sorted(self._latencies)
+            # evicted engines' dispatched elements accrued via
+            # on_evict + the currently-resident ones: padding
+            # waste covers the whole drive across residency churn
+            real = self._retired_real
+            padded = self._retired_padded
+            for entry in self.residency.entries():
+                models[entry.name] = entry.engine.summary()
+                stats = entry.engine._stats
+                real += stats["real_elements"]
+                padded += stats["padded_elements"]
+            residency = self.residency.stats()
+        out = {
+            "n_submitted": self._n_submitted,
+            "n_delivered": self._n_delivered,
+            "n_ok": self._n_ok,
+            "n_errors": sum(self._errors_by_code.values()),
+            "errors_by_code": dict(self._errors_by_code),
+            "p50_latency_s": pct(0.50),
+            "p99_latency_s": pct(0.99),
+            "padding_waste": (1.0 - real / padded) if padded
+            else 0.0,
+            "retrace_total": serve_retrace_total(),
+            "ticks": self._n_ticks,
+            "active_ticks": self._n_active_ticks,
+            "models": models,
+            "residency": residency,
+        }
+        if self.residency.aot is not None:
+            out["aot"] = self.residency.aot.stats()
+        return out

@@ -152,3 +152,35 @@ def test_encoding_tier_record_matches_obs_schema(monkeypatch):
     assert rec["config"]["n_voxels"] == 128
     assert rec["config"]["n_folds"] == bench.ENCODING_FOLDS
     assert rec["vs_baseline"] > 0
+
+
+# -- ISSUE 9: service tier --------------------------------------------
+
+def test_service_tier_records_match_obs_schema(monkeypatch):
+    """The service tier emits THREE schema-valid records per round —
+    steady-state requests/s plus p99 latency and padding waste, the
+    latter two stamped direction="lower_is_better" so `obs regress
+    --only service` gates them mirrored."""
+    monkeypatch.setenv("BENCH_SERVICE_REQUESTS", "16")
+    out = bench.measure_tier("service")
+    assert out["requests_per_sec"] > 0
+    assert out["p99_latency_s"] > 0
+    assert 0.0 <= out["padding_waste"] < 1.0
+    assert out["baseline_rps"] > 0
+    stages = out["stages"]
+    assert set(bench.STAGE_KEYS) <= set(stages)
+    assert stages["steady_s"] > 0
+
+    recs = bench._service_result_records(out, n_requests=16)
+    assert [r["metric"] for r in recs] == [
+        "service_mixed_requests_per_sec",
+        "service_p99_latency_seconds",
+        "service_padding_waste_ratio"]
+    for rec in recs:
+        assert obs.validate_bench_record(rec) == []
+        # in-process CPU test backend -> the fallback tier
+        assert rec["tier"] == "service_cpu_fallback"
+        assert rec["config"]["n_requests"] == 16
+    assert "direction" not in recs[0]
+    assert recs[1]["direction"] == "lower_is_better"
+    assert recs[2]["direction"] == "lower_is_better"

@@ -41,22 +41,34 @@ def test_fixture_history_passes_and_gates():
     records, skipped = regress.load_bench_records([FIXTURE_DIR])
     # the real r01-r05 fcma trajectory + the serve_r01-r03 tier
     # (PR 5) + the distla_r01-r03 tier (ISSUE 6) + the
-    # encoding_r01-r03 tier (ISSUE 7), all measured host-side ->
-    # *_cpu_fallback: four tiers gating independently from one
-    # directory
-    assert len(records) == 14
+    # encoding_r01-r03 tier (ISSUE 7) + the service_r01-r03 tier
+    # (ISSUE 9: 3 rounds x 3 metrics — requests/s, p99, padding),
+    # all measured host-side -> *_cpu_fallback: five tiers gating
+    # independently from one directory
+    assert len(records) == 23
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
     assert tiers == {"cpu_fallback", "serve_cpu_fallback",
+                     "service_cpu_fallback",
                      "distla_cpu_fallback",
                      "encoding_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
-    by_tier = {c["tier"]: c for c in result["checks"]}
+    by_tier = {c["tier"]: c for c in result["checks"]
+               if c["tier"] != "service_cpu_fallback"}
+    by_metric = {c["metric"]: c for c in result["checks"]
+                 if c["tier"] == "service_cpu_fallback"}
     assert set(by_tier) == {"cpu_fallback", "serve_cpu_fallback",
                             "distla_cpu_fallback",
                             "encoding_cpu_fallback"}
+    # the service tier gates three metrics, two of them flipped
+    assert set(by_metric) == {"service_mixed_requests_per_sec",
+                              "service_p99_latency_seconds",
+                              "service_padding_waste_ratio"}
+    assert by_metric["service_p99_latency_seconds"][
+        "direction"] == "lower_is_better"
+    assert all(c["status"] == "ok" for c in by_metric.values())
     assert by_tier["cpu_fallback"]["status"] == "ok"
     assert by_tier["cpu_fallback"]["n_history"] == 4
     assert by_tier["serve_cpu_fallback"]["status"] == "ok"
@@ -229,3 +241,87 @@ def test_stdin_fresh_normalizes_legacy_records(tmp_path,
     (check,) = verdict["checks"]
     assert check["tier"] == "cpu_fallback"
     assert check["status"] == "ok"
+
+
+# -- ISSUE 9: per-metric direction (lower_is_better) ------------------
+
+P99 = {"metric": "service_p99_latency_seconds", "unit": "s",
+       "vs_baseline": 0.0, "tier": "service_cpu_fallback",
+       "direction": "lower_is_better"}
+
+
+def test_lower_is_better_flips_the_bar():
+    """A latency metric gates mirrored: growth past baseline /
+    threshold is the regression, shrinkage never is."""
+    history = [_rec(P99, 0.050 + 0.001 * i, i) for i in range(3)]
+    # halved latency: a big IMPROVEMENT, must pass
+    good = [_rec(P99, 0.025, 99)]
+    assert regress.evaluate(history, good)["verdict"] == "pass"
+    # doubled latency: ratio 2.0 > 1/0.7 -> regression
+    bad = [_rec(P99, 0.102, 99)]
+    result = regress.evaluate(history, bad)
+    assert result["verdict"] == "fail"
+    (check,) = result["checks"]
+    assert check["status"] == "regression"
+    assert check["direction"] == "lower_is_better"
+    # the same doubled value on a higher-is-better metric passes
+    up = dict(P99)
+    del up["direction"]
+    history_up = [_rec(up, 0.050 + 0.001 * i, i) for i in range(3)]
+    assert regress.evaluate(
+        history_up, [_rec(up, 0.102, 99)])["verdict"] == "pass"
+
+
+def test_acceptance_doubled_fixture_p99_exits_1(tmp_path, capsys):
+    """ISSUE 9 acceptance: `obs regress --only service` passes on
+    the committed fixture rounds and demonstrably fails (exit 1)
+    when a fixture p99 is doubled."""
+    assert regress.main(["--history", FIXTURE_DIR,
+                         "--only", "service"]) == 0
+    capsys.readouterr()
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    for name in os.listdir(FIXTURE_DIR):
+        if name.startswith("service_"):
+            shutil.copy(os.path.join(FIXTURE_DIR, name),
+                        str(hist))
+    # double the newest round's p99 line in place
+    newest = hist / "service_r03.json"
+    lines = []
+    for line in newest.read_text().splitlines():
+        rec = json.loads(line)
+        if rec["metric"] == "service_p99_latency_seconds":
+            rec["value"] *= 2.0
+        lines.append(json.dumps(rec))
+    newest.write_text("\n".join(lines) + "\n")
+    rc = regress.main(["--history", str(hist),
+                       "--only", "service"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "service_p99_latency_seconds" in captured.err
+    assert "lower is better" in captured.out
+
+
+def test_zero_baseline_gates_by_direction():
+    """A tier whose history is legitimately 0.0 (e.g. padding waste
+    on a uniform workload) must not fail forever: staying at 0.0
+    passes either direction; growing off 0.0 regresses only
+    lower-is-better."""
+    history = [_rec(P99, 0.0, i) for i in range(3)]
+    flat = regress.evaluate(history, [_rec(P99, 0.0, 99)])
+    assert flat["verdict"] == "pass"
+    assert flat["checks"][0]["ratio"] == 1.0
+    grown = regress.evaluate(history, [_rec(P99, 0.05, 99)])
+    assert grown["verdict"] == "fail"
+    up = {k: v for k, v in P99.items() if k != "direction"}
+    history_up = [_rec(up, 0.0, i) for i in range(3)]
+    assert regress.evaluate(
+        history_up, [_rec(up, 0.05, 99)])["verdict"] == "pass"
+
+
+def test_validator_rejects_unknown_direction():
+    from brainiak_tpu.obs.report import validate_bench_record
+    rec = dict(P99, value=0.05)
+    assert validate_bench_record(rec) == []
+    assert any("direction" in e for e in validate_bench_record(
+        dict(rec, direction="sideways")))

@@ -14,6 +14,10 @@ from brainiak_tpu.obs import metrics, sink
 def _clean_obs(monkeypatch):
     monkeypatch.delenv(sink.OBS_DIR_ENV, raising=False)
     monkeypatch.delenv(sink.OBS_RANK_ENV, raising=False)
+    # AOTProgramCache would otherwise point jax's PROCESS-GLOBAL
+    # persistent compilation cache at soon-deleted tmp dirs; the
+    # subprocess tests (CLI, SRV002 gate) cover that layer for real
+    monkeypatch.setenv("BRAINIAK_TPU_SERVE_XLA_CACHE", "0")
     sink.close_all()
     metrics.reset()
     yield

@@ -1,0 +1,210 @@
+"""AOT program persistence: the zero cold-start layer.
+
+Contract under test (ISSUE 9 tentpole part 3): programs the engine
+builds are exported + persisted; a fresh "process" (program caches
+cleared, metrics reset) over the warm cache serves bit-identically
+with ``retrace_total{site=serve.*} == 0``; every failure mode is a
+counted miss that falls back to jit, never a lost answer.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.obs import metrics
+from brainiak_tpu.serve import aot as aot_mod
+from brainiak_tpu.serve import engine as engine_mod
+from brainiak_tpu.serve.aot import AOTProgramCache
+from brainiak_tpu.serve.artifacts import model_digest
+from brainiak_tpu.serve.batching import Request
+from brainiak_tpu.serve.engine import InferenceEngine
+
+
+def _requests(model, n, seed=0, tr_choices=(6, 20)):
+    rng = np.random.RandomState(seed)
+    counts = [w.shape[0] for w in model.w_]
+    return [Request(request_id=f"r{i}",
+                    x=rng.randn(counts[i % len(counts)],
+                                tr_choices[i % len(tr_choices)])
+                    .astype(np.float32),
+                    subject=i % len(counts))
+            for i in range(n)]
+
+
+def _fresh_process():
+    """Simulate a restart: module-level jit builder caches cleared,
+    retrace counters reset (each engine's AOT lookups and the
+    process-global serve program caches start cold)."""
+    for builder in (engine_mod._srm_program,
+                    engine_mod._rsrm_program,
+                    engine_mod._eventseg_program,
+                    engine_mod._encoding_program,
+                    engine_mod._iem_program):
+        builder.cache_clear()
+    metrics.reset()
+
+
+def serve_retraces(site="serve.srm"):
+    return metrics.counter("retrace_total").value(site=site)
+
+
+def test_restart_zero_compile_and_bit_parity(srm_model, tmp_path):
+    """The tentpole acceptance (in-process form; the SRV002 gate
+    proves the true-subprocess version): a warm AOT cache serves a
+    fresh process's first requests with zero serve retraces and
+    bit-identical results."""
+    reqs = _requests(srm_model, 8)
+    cache = AOTProgramCache(tmp_path)
+    cold = InferenceEngine(srm_model, aot=cache)
+    cold_recs = cold.run(reqs)
+    assert all(r.ok for r in cold_recs)
+    assert cold.summary()["retrace_total"] > 0      # cold compiles
+    assert cache.stats()["stores"] == \
+        len(cold.summary()["buckets"])
+    assert sorted(glob.glob(os.path.join(tmp_path, "*.jaxprog")))
+
+    _fresh_process()
+    warm_cache = AOTProgramCache(tmp_path)
+    warm = InferenceEngine(srm_model, aot=warm_cache)
+    for req in reqs:
+        req.submitted = None
+    warm_recs = warm.run(reqs)
+    assert all(r.ok for r in warm_recs)
+    assert serve_retraces() == 0                     # no compiles
+    assert warm_cache.stats()["hits"] == \
+        len(warm.summary()["buckets"])
+    for a, b in zip(cold_recs, warm_recs):
+        np.testing.assert_array_equal(np.asarray(a.result),
+                                      np.asarray(b.result))
+
+
+def test_corrupt_entry_falls_back_to_jit(srm_model, tmp_path):
+    reqs = _requests(srm_model, 4, tr_choices=(6,))
+    cache = AOTProgramCache(tmp_path)
+    InferenceEngine(srm_model, aot=cache).run(reqs)
+    for path in glob.glob(os.path.join(tmp_path, "*.jaxprog")):
+        with open(path, "wb") as fh:
+            fh.write(b"not a serialized program")
+
+    _fresh_process()
+    cache2 = AOTProgramCache(tmp_path)
+    engine = InferenceEngine(srm_model, aot=cache2)
+    for req in reqs:
+        req.submitted = None
+    records = engine.run(reqs)
+    assert all(r.ok for r in records)                # served anyway
+    assert cache2.stats()["misses"] == {"deserialize_failed": 1}
+    assert metrics.counter("serve_aot_miss_total").value(
+        site="serve.srm", reason="deserialize_failed") == 1
+    assert serve_retraces() == 1                     # jit fallback
+
+
+def test_unsupported_jax_is_a_counted_miss(srm_model, tmp_path,
+                                           monkeypatch):
+    monkeypatch.setattr(aot_mod, "_export", None)
+    cache = AOTProgramCache(tmp_path)
+    engine = InferenceEngine(srm_model, aot=cache)
+    records = engine.run(_requests(srm_model, 2, tr_choices=(6,)))
+    assert all(r.ok for r in records)
+    assert cache.stats()["hits"] == 0
+    assert cache.stats()["misses"] == {"unsupported": 1}
+    assert not glob.glob(os.path.join(tmp_path, "*.jaxprog"))
+
+
+def test_key_covers_digest_args_and_environment(srm_model,
+                                                detsrm_model,
+                                                tmp_path):
+    cache = AOTProgramCache(tmp_path)
+    d1 = model_digest(srm_model)
+    d2 = model_digest(detsrm_model)
+    assert d1 != d2
+    base = cache.key_for(d1, "serve.srm", (3, 14, 4, 16, 4))
+    assert cache.key_for(d1, "serve.srm",
+                         (3, 14, 4, 16, 4)) == base
+    assert cache.key_for(d2, "serve.srm",
+                         (3, 14, 4, 16, 4)) != base
+    assert cache.key_for(d1, "serve.rsrm",
+                         (3, 14, 4, 16, 4)) != base
+    assert cache.key_for(d1, "serve.srm",
+                         (3, 14, 4, 32, 4)) != base
+
+
+def test_digest_survives_save_load_round_trip(srm_model, tmp_path):
+    from brainiak_tpu.serve import load_model, save_model
+    path = save_model(srm_model, str(tmp_path / "m.npz"))
+    assert model_digest(load_model(path)) == model_digest(srm_model)
+
+
+def test_store_failure_never_breaks_serving(srm_model, tmp_path,
+                                            monkeypatch):
+    def boom(path, blob):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(aot_mod, "_atomic_write", boom)
+    cache = AOTProgramCache(tmp_path)
+    engine = InferenceEngine(srm_model, aot=cache)
+    records = engine.run(_requests(srm_model, 2, tr_choices=(6,)))
+    assert all(r.ok for r in records)
+    assert cache.stats()["stores"] == 0
+
+
+def test_put_is_idempotent(srm_model, tmp_path):
+    reqs = _requests(srm_model, 2, tr_choices=(6,))
+    cache = AOTProgramCache(tmp_path)
+    InferenceEngine(srm_model, aot=cache).run(reqs)
+    files = sorted(glob.glob(os.path.join(tmp_path, "*.jaxprog")))
+    eng = InferenceEngine(srm_model, aot=cache)
+    for req in reqs:
+        req.submitted = None
+    eng.run(reqs)
+    assert sorted(glob.glob(
+        os.path.join(tmp_path, "*.jaxprog"))) == files
+
+
+def test_fcma_kind_bypasses_aot(fcma_models, tmp_path):
+    logit, _, _ = fcma_models
+    engine = InferenceEngine(logit, aot=AOTProgramCache(tmp_path))
+    assert engine.aot is None
+
+
+def test_xla_persistent_cache_opt_in(monkeypatch, srm_model,
+                                     tmp_path):
+    """With the env opt-out lifted, the cache points jax's
+    persistent compilation cache at <dir>/xla so even the XLA
+    executable survives a restart; the config is restored after."""
+    import glob as _glob
+
+    import jax
+
+    monkeypatch.setenv(aot_mod.XLA_CACHE_ENV, "1")
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        cache = AOTProgramCache(tmp_path)
+        assert cache.xla_cache_dir == str(tmp_path / "xla")
+        assert jax.config.jax_compilation_cache_dir == \
+            cache.xla_cache_dir
+        # a process-novel program (the odd shape + constant make
+        # the jit cache miss for sure): its XLA compile must land
+        # in the persistent cache directory
+        fn = jax.jit(lambda x: x * 3.14159 + 2.71828)
+        np.asarray(fn(np.arange(17.0, dtype=np.float32)))
+        assert _glob.glob(os.path.join(cache.xla_cache_dir, "*"))
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+    # and the opt-out leaves jax config untouched
+    monkeypatch.setenv(aot_mod.XLA_CACHE_ENV, "0")
+    assert AOTProgramCache(tmp_path / "b").xla_cache_dir is None
+
+
+@pytest.mark.parametrize("bad", ["", "0"])
+def test_env_tag_changes_key(monkeypatch, bad, srm_model, tmp_path):
+    """jax version/platform ride in the key: faking a different
+    version makes every prior entry unreachable (absent miss)."""
+    cache = AOTProgramCache(tmp_path)
+    digest = model_digest(srm_model)
+    key = cache.key_for(digest, "serve.srm", (1,))
+    monkeypatch.setattr(aot_mod, "_environment_tag",
+                        lambda: f"fake-{bad}|cpu")
+    assert cache.key_for(digest, "serve.srm", (1,)) != key

@@ -61,3 +61,17 @@ def test_request_codec_roundtrip(tmp_path):
     assert back[1].subject is None and back[1].deadline_s == 0.25
     assert isinstance(back[2].x, tuple) and len(back[2].x) == 2
     np.testing.assert_array_equal(back[2].x[1], payloads[2][1])
+    # no models= passed: the routing field stays unset
+    assert [r.model for r in back] == [None, None, None]
+
+
+def test_request_codec_carries_model_routing(tmp_path):
+    """ISSUE 9: per-request model names (the multi-model `service`
+    routing key) round-trip through the npz codec, None omitted."""
+    path = str(tmp_path / "reqs.npz")
+    payloads = [np.random.randn(4, 7), np.random.randn(4, 9)]
+    save_requests(path, payloads, ids=["a", "b"],
+                  models=["subj01", None])
+    back = load_requests(path)
+    assert back[0].model == "subj01"
+    assert back[1].model is None

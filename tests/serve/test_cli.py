@@ -1,5 +1,6 @@
-"""``python -m brainiak_tpu.serve`` CLI: run + bench subcommands
-(the SRV001 gate's contract) and the offline results file."""
+"""``python -m brainiak_tpu.serve`` CLI: run + service + bench
+subcommands (the SRV001/SRV002 gates' contracts) and the offline
+results file."""
 
 import json
 import os
@@ -113,6 +114,96 @@ def test_cli_bench_rejects_unsupported_kind_naming_kinds(tmp_path):
     for kind in ("srm", "detsrm", "rsrm", "ridge_encoding"):
         assert kind in proc.stderr
     assert "eventseg" in proc.stderr
+
+
+def _two_model_request_file(tmp_path):
+    """Two tiny artifacts + a request file whose model.<i> keys
+    route between them (second half unrouted -> default model)."""
+    from brainiak_tpu.serve import save_model, save_requests
+    from brainiak_tpu.serve.__main__ import (build_demo_model,
+                                             build_mixed_requests)
+    a = build_demo_model(n_subjects=2, voxels=10, samples=20,
+                         features=3, n_iter=2, seed=1)
+    b = build_demo_model(n_subjects=2, voxels=14, samples=20,
+                         features=3, n_iter=2, seed=2)
+    a_path = str(tmp_path / "a.npz")
+    b_path = str(tmp_path / "b.npz")
+    save_model(a, a_path)
+    save_model(b, b_path)
+    reqs = (build_mixed_requests(a, 4, seed=1, tr_choices=(5, 9))
+            + build_mixed_requests(b, 4, seed=2,
+                                   tr_choices=(5, 9)))
+    req_path = str(tmp_path / "requests.npz")
+    save_requests(req_path, [r.x for r in reqs],
+                  subjects=[r.subject for r in reqs],
+                  models=["a", "a", None, None, "b", "b", "b", "b"])
+    return a_path, b_path, req_path
+
+
+def test_cli_service_multi_model_summary(tmp_path):
+    """ISSUE 9 satellite: the `service` subcommand serves a routed
+    multi-model request file and prints the JSON summary with the
+    p50/p99 / padding / eviction / aot blocks."""
+    a_path, b_path, req_path = _two_model_request_file(tmp_path)
+    proc = _cli("service", "--model", f"a={a_path}",
+                "--model", f"b={b_path}",
+                "--requests", req_path, "--waves", "2")
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout)
+    assert summary["n_submitted"] == 8
+    assert summary["n_ok"] == 8 and summary["n_errors"] == 0
+    assert summary["p50_latency_s"] > 0
+    assert summary["p99_latency_s"] >= summary["p50_latency_s"]
+    assert 0.0 <= summary["padding_waste"] < 1.0
+    assert summary["residency"]["evictions"] == 0
+    assert set(summary["models"]) == {"a", "b"}
+    # both routed halves landed on their named model
+    assert summary["models"]["a"]["n_requests"] == 4
+    assert summary["models"]["b"]["n_requests"] == 4
+    assert "aot" not in summary   # no --aot-cache given
+    assert summary["requests_per_sec"] > 0
+
+
+def test_cli_service_aot_restart_zero_retraces(tmp_path):
+    """The SRV002 contract end to end: a second CLI process over
+    the same AOT cache reports aot hits and ZERO serve retraces."""
+    a_path, b_path, req_path = _two_model_request_file(tmp_path)
+    cache = str(tmp_path / "aot")
+    args = ("service", "--model", f"a={a_path}",
+            "--model", f"b={b_path}",
+            "--requests", req_path, "--aot-cache", cache,
+            "--waves", "1")
+    first = _cli(*args)
+    assert first.returncode == 0, first.stderr
+    cold = json.loads(first.stdout)
+    assert cold["aot"]["stores"] > 0
+    second = _cli(*args)
+    assert second.returncode == 0, second.stderr
+    warm = json.loads(second.stdout)
+    assert warm["n_errors"] == 0
+    assert warm["aot"]["hits"] > 0
+    assert warm["retrace_total"] == 0
+
+
+def test_cli_service_no_drain_and_text_format(tmp_path):
+    """--no-drain + --duration 0 fails queued work with `shutdown`
+    records (rc=1) and the text renderer reports them."""
+    a_path, _, req_path = _two_model_request_file(tmp_path)
+    proc = _cli("service", "--model", f"a={a_path}",
+                "--requests", req_path, "--no-drain",
+                "--duration", "0.001", "--max-wait", "30",
+                "--format=text")
+    assert proc.returncode == 1, proc.stderr
+    assert "shutdown" in proc.stdout
+
+
+def test_cli_service_bad_model_spec_is_driver_error(tmp_path):
+    a_path, _, req_path = _two_model_request_file(tmp_path)
+    proc = _cli("service", "--model", f"a={a_path}",
+                "--model", f"a={a_path}",
+                "--requests", req_path)
+    assert proc.returncode == 2
+    assert "duplicate model name" in proc.stderr
 
 
 def test_cli_bench_encoding_artifact_emits_valid_record(tmp_path,

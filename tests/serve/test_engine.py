@@ -232,6 +232,56 @@ def test_poison_batch_isolated(srm_model, monkeypatch):
     assert len(isolated) == 5
 
 
+def test_poison_isolation_adds_no_program_shapes(srm_model,
+                                                 monkeypatch):
+    """ISSUE 9 satellite: the singleton fallback re-pads to the
+    FAILED dispatch's batch extent (the smallest admissible bucket
+    this flush already resolved), so poison recovery mints ZERO new
+    program shapes — `retrace_total{site=serve.srm}` stays at the
+    distinct-bucket count instead of growing a fresh singleton
+    shape per poisoned bucket."""
+    engine = InferenceEngine(srm_model)
+    op = engine.op
+    real_dispatch = op.dispatch
+    calls = []
+
+    def sabotaged(reqs, key, b_pad):
+        calls.append((key, b_pad, len(reqs)))
+        if len(reqs) > 1:
+            raise RuntimeError("batch-level explosion")
+        return real_dispatch(reqs, key, b_pad)
+
+    monkeypatch.setattr(op, "dispatch", sabotaged)
+    reqs = _srm_requests(srm_model, 5, tr_choices=(20,), seed=5)
+    records = engine.run(reqs)
+    assert all(r.ok for r in records)
+    # every singleton re-ran at the failed batch's extent (8 for a
+    # 5-request flush), never a fresh b_pad=1 shape
+    failed_key, failed_b_pad, _ = calls[0]
+    assert failed_b_pad == 8
+    assert all(b == failed_b_pad for _, b, n in calls[1:])
+    assert {str(r.bucket) for r in records} \
+        == {str(failed_key + (failed_b_pad,))}
+    # at most one program shape for the whole poisoned round (0
+    # when an earlier test already compiled this bucket: builder
+    # caches are process-global)
+    assert engine.summary()["retrace_total"] <= 1
+
+
+def test_fail_pending_delivers_structured_records(srm_model):
+    """fail_pending (the no-drain shutdown path) fails every queued
+    request with the given status and empties the queues."""
+    policy = BucketPolicy(max_batch=64, max_wait_s=60.0)
+    engine = InferenceEngine(srm_model, policy=policy)
+    reqs = _srm_requests(srm_model, 3, tr_choices=(20,))
+    for req in reqs:
+        assert engine.submit(req) is None
+    assert engine.fail_pending("shutdown") == 3
+    records = engine.drain()
+    assert [r.error for r in records] == ["shutdown"] * 3
+    assert engine.fail_pending() == 0  # queues are empty now
+
+
 
 def test_flush_policy_max_batch_and_poll(srm_model):
     """A bucket flushes as soon as max_batch accumulates; poll()

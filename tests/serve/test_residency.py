@@ -1,0 +1,165 @@
+"""Multi-model residency: byte-weighted LRU, pinning, typed
+admission refusal, transparent re-admission (ISSUE 9 tentpole
+part 2)."""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.obs import metrics, sink
+from brainiak_tpu.serve import save_model
+from brainiak_tpu.serve.artifacts import model_nbytes
+from brainiak_tpu.serve.batching import Request
+from brainiak_tpu.serve.residency import (AdmissionError,
+                                          BUDGET_ENV,
+                                          DEFAULT_BUDGET_BYTES,
+                                          ModelResidency,
+                                          default_budget_bytes)
+
+
+@pytest.fixture
+def three_models(tmp_path):
+    """Three same-size SRM artifacts on disk + their byte size."""
+    from brainiak_tpu.serve.__main__ import build_demo_model
+    paths = {}
+    nbytes = None
+    for i, name in enumerate(("a", "b", "c")):
+        model = build_demo_model(n_subjects=2, voxels=10,
+                                 samples=16, features=3, n_iter=2,
+                                 seed=i, ragged=False)
+        paths[name] = save_model(model,
+                                 str(tmp_path / f"{name}.npz"))
+        nbytes = model_nbytes(model)
+    return paths, nbytes
+
+
+def test_lru_eviction_under_pressure(three_models):
+    """Admit N+1 models under a budget that fits two: the LEAST
+    recently used one is evicted, with the counter/event trail."""
+    paths, nbytes = three_models
+    mem = sink.add_sink(sink.MemorySink())
+    try:
+        res = ModelResidency(budget_bytes=2 * nbytes + 16)
+        for name, path in paths.items():
+            res.register(name, source=path)
+        res.acquire("a")
+        res.acquire("b")
+        res.acquire("a")          # b is now the LRU
+        res.acquire("c")          # must evict b, not a
+        assert res.resident_names() == ["a", "c"]
+        assert res.stats()["evictions"] == 1
+        assert metrics.counter("serve_evictions_total").value(
+            model="b") == 1
+        events = [r for r in mem.records
+                  if r.get("name") == "eviction"]
+        assert len(events) == 1
+        assert events[0]["attrs"]["model"] == "b"
+        assert "'c'" in events[0]["attrs"]["reason"]
+    finally:
+        sink.remove_sink(mem)
+
+
+def test_transparent_readmission(three_models):
+    paths, nbytes = three_models
+    res = ModelResidency(budget_bytes=nbytes + 16)
+    res.register("a", source=paths["a"])
+    res.register("b", source=paths["b"])
+    first = res.acquire("a")
+    res.acquire("b")              # evicts a
+    assert res.resident_names() == ["b"]
+    again = res.acquire("a")      # reloads from the registration
+    assert res.resident_names() == ["a"]
+    assert again is not first
+    assert again.admissions == 2
+    assert res.stats()["admissions"]["a"] == 2
+    # the re-admitted engine serves (same artifact, fresh load)
+    rng = np.random.RandomState(0)
+    x = rng.randn(10, 6).astype(np.float32)
+    rec = again.engine.run(
+        [Request(request_id="r", x=x, subject=0)])[0]
+    assert rec.ok
+
+
+def test_pinned_model_never_evicted(three_models):
+    paths, nbytes = three_models
+    res = ModelResidency(budget_bytes=nbytes + 16)
+    res.register("a", source=paths["a"], pinned=True)
+    res.register("b", source=paths["b"])
+    res.acquire("a")
+    with pytest.raises(AdmissionError) as excinfo:
+        res.acquire("b")
+    err = excinfo.value
+    assert err.model == "b"
+    assert err.needed_bytes == nbytes
+    assert err.budget_bytes == nbytes + 16
+    assert err.pinned_bytes == nbytes
+    assert res.resident_names() == ["a"]   # pinned survived
+    with pytest.raises(ValueError, match="pinned"):
+        res.evict("a")
+
+
+def test_oversized_model_is_typed_refusal(three_models):
+    paths, nbytes = three_models
+    res = ModelResidency(budget_bytes=nbytes // 2)
+    res.register("a", source=paths["a"])
+    with pytest.raises(AdmissionError):
+        res.acquire("a")
+    assert res.resident_names() == []
+    # the size was learned on the first load: repeat acquires must
+    # refuse WITHOUT re-reading the artifact from disk
+    res._registry["a"].source = str(paths["a"]) + ".gone"
+    with pytest.raises(AdmissionError):
+        res.acquire("a")
+
+
+def test_eviction_fails_queued_work_and_delivers(three_models):
+    """Requests queued on the victim fail with `evicted` records
+    routed through the on_evict_records hook, never dropped."""
+    paths, nbytes = three_models
+    res = ModelResidency(budget_bytes=nbytes + 16)
+    delivered = []
+    res.on_evict_records = \
+        lambda name, recs: delivered.append((name, recs))
+    res.register("a", source=paths["a"])
+    res.register("b", source=paths["b"])
+    entry = res.acquire("a")
+    rng = np.random.RandomState(0)
+    x = rng.randn(10, 6).astype(np.float32)
+    assert entry.engine.submit(
+        Request(request_id="q", x=x, subject=0)) is None
+    res.acquire("b")              # evicts a with work queued
+    assert len(delivered) == 1
+    name, records = delivered[0]
+    assert name == "a"
+    assert [r.error for r in records] == ["evicted"]
+
+
+def test_register_validation(three_models):
+    paths, _ = three_models
+    res = ModelResidency(budget_bytes=1 << 20)
+    res.register("a", source=paths["a"])
+    with pytest.raises(ValueError, match="already registered"):
+        res.register("a", source=paths["b"])
+    with pytest.raises(ValueError, match="exactly one"):
+        res.register("x")
+    with pytest.raises(KeyError):
+        res.acquire("nope")
+
+
+def test_budget_env_override(monkeypatch):
+    monkeypatch.setenv(BUDGET_ENV, "12345")
+    assert default_budget_bytes() == 12345
+    monkeypatch.delenv(BUDGET_ENV)
+    # CPU backend exposes no memory stats -> constant fallback
+    assert default_budget_bytes() == DEFAULT_BUDGET_BYTES
+
+
+def test_resident_gauges_track_occupancy(three_models):
+    paths, nbytes = three_models
+    res = ModelResidency(budget_bytes=4 * nbytes)
+    res.register("a", source=paths["a"])
+    res.register("b", source=paths["b"])
+    res.acquire("a")
+    res.acquire("b")
+    assert metrics.gauge("serve_resident_models").value() == 2
+    assert metrics.gauge("serve_resident_bytes").value() \
+        == 2 * nbytes

@@ -1,0 +1,276 @@
+"""The always-on service loop (ISSUE 9 tentpole part 1) + the PR's
+acceptance scenario: two resident models under a byte budget,
+staggered waves, eviction + transparent re-admission, and the
+restart-zero-compile contract over a warm AOT cache."""
+
+import time
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.obs import metrics
+from brainiak_tpu.serve import engine as engine_mod
+from brainiak_tpu.serve.aot import AOTProgramCache
+from brainiak_tpu.serve.artifacts import model_nbytes
+from brainiak_tpu.serve.batching import BucketPolicy, Request
+from brainiak_tpu.serve.residency import ModelResidency
+from brainiak_tpu.serve.service import (ServeService, ServiceClosed,
+                                        serve_retrace_total)
+
+
+def _srm_requests(model, n, seed=0, tr_choices=(6, 20),
+                  deadline_s=None, prefix="r"):
+    rng = np.random.RandomState(seed)
+    counts = [w.shape[0] for w in model.w_]
+    return [Request(request_id=f"{prefix}{i}",
+                    x=rng.randn(counts[i % len(counts)],
+                                tr_choices[i % len(tr_choices)])
+                    .astype(np.float32),
+                    subject=i % len(counts),
+                    deadline_s=deadline_s)
+            for i in range(n)]
+
+
+def _enc_requests(model, n, seed=0, tr_choices=(6, 20),
+                  prefix="e"):
+    rng = np.random.RandomState(seed)
+    f, v = model.W_.shape
+    out = []
+    for i in range(n):
+        trs = tr_choices[i % len(tr_choices)]
+        feats = rng.randn(trs, f).astype(np.float32)
+        resp = (model.predict(feats)
+                + 0.5 * rng.randn(trs, v)).astype(np.float32)
+        out.append(Request(request_id=f"{prefix}{i}",
+                           x=(feats, resp)))
+    return out
+
+
+def _residency(models, budget=1 << 30, policy=None, aot=None):
+    res = ModelResidency(
+        budget_bytes=budget,
+        policy=policy or BucketPolicy(max_batch=8,
+                                      max_wait_s=0.02),
+        aot=aot)
+    for name, model in models.items():
+        res.register(name, model=model)
+    return res
+
+
+def _fresh_process():
+    """Simulate a restart: module-level jit builder caches cleared,
+    metrics (retrace counters included) reset."""
+    for builder in (engine_mod._srm_program,
+                    engine_mod._rsrm_program,
+                    engine_mod._eventseg_program,
+                    engine_mod._encoding_program,
+                    engine_mod._iem_program):
+        builder.cache_clear()
+    metrics.reset()
+
+
+def test_single_model_roundtrip_with_parity(srm_model):
+    reqs = _srm_requests(srm_model, 6)
+    with ServeService(_residency({"m": srm_model})) as svc:
+        tickets = [svc.submit(r) for r in reqs]
+        records = [t.result(timeout=60) for t in tickets]
+    assert all(r.ok for r in records)
+    w = np.asarray(srm_model.w_[reqs[0].subject])
+    np.testing.assert_allclose(
+        np.asarray(records[0].result),
+        w.T @ np.asarray(reqs[0].x), atol=1e-5)
+
+
+def test_late_joiner_lands_in_next_batch_same_bucket(srm_model):
+    """A request submitted after its bucket already dispatched rides
+    the NEXT batch of the same bucket — never lost, deadline
+    honored."""
+    policy = BucketPolicy(max_batch=8, max_wait_s=0.05)
+    res = _residency({"m": srm_model}, policy=policy)
+    first, late = _srm_requests(srm_model, 2, tr_choices=(6,),
+                                deadline_s=30.0)
+    with ServeService(res) as svc:
+        t1 = svc.submit(first)
+        rec1 = t1.result(timeout=60)     # batch 1 dispatched
+        t2 = svc.submit(late)            # joins the same bucket
+        rec2 = t2.result(timeout=60)
+        engine = res.acquire("m").engine
+        summary = engine.summary()
+    assert rec1.ok and rec2.ok
+    assert rec2.latency_s <= 30.0        # deadline honored
+    assert summary["n_batches"] == 2     # two dispatches...
+    assert rec1.bucket == rec2.bucket    # ...of the SAME bucket
+
+
+def test_deadline_counts_from_original_enqueue(srm_model):
+    """A deadline shorter than max_wait expires while queued: the
+    dispatch-time check reads the service-stamped enqueue clock."""
+    policy = BucketPolicy(max_batch=64, max_wait_s=0.3)
+    res = _residency({"m": srm_model}, policy=policy)
+    req = _srm_requests(srm_model, 1, tr_choices=(6,),
+                        deadline_s=0.01)[0]
+    with ServeService(res) as svc:
+        record = svc.submit(req).result(timeout=60)
+    assert not record.ok
+    assert record.error == "deadline_exceeded"
+    assert record.latency_s >= 0.01
+
+
+def test_shutdown_drain_serves_queued_work(srm_model):
+    policy = BucketPolicy(max_batch=64, max_wait_s=60.0)
+    res = _residency({"m": srm_model}, policy=policy)
+    svc = ServeService(res).start()
+    tickets = [svc.submit(r)
+               for r in _srm_requests(srm_model, 5)]
+    time.sleep(0.05)          # routed, but max_wait never fires
+    svc.shutdown(drain=True)
+    records = [t.result(timeout=1) for t in tickets]
+    assert all(r.ok for r in records)
+
+
+def test_shutdown_no_drain_fails_queued_with_status(srm_model):
+    policy = BucketPolicy(max_batch=64, max_wait_s=60.0)
+    res = _residency({"m": srm_model}, policy=policy)
+    svc = ServeService(res).start()
+    tickets = [svc.submit(r)
+               for r in _srm_requests(srm_model, 5)]
+    time.sleep(0.05)
+    summary = svc.shutdown(drain=False)
+    records = [t.result(timeout=1) for t in tickets]
+    assert [r.error for r in records] == ["shutdown"] * 5
+    assert summary["errors_by_code"] == {"shutdown": 5}
+    with pytest.raises(ServiceClosed):
+        svc.submit(_srm_requests(srm_model, 1)[0])
+
+
+def test_unknown_model_is_typed_record(srm_model):
+    with ServeService(_residency({"m": srm_model})) as svc:
+        req = _srm_requests(srm_model, 1)[0]
+        req.model = "ghost"
+        record = svc.submit(req).result(timeout=60)
+    assert not record.ok
+    assert record.error == "unknown_model"
+
+
+def test_admission_refused_is_typed_record(srm_model,
+                                           encoding_model):
+    """An over-budget second model fails its requests with
+    admission_refused records — never an OOM, never a crash."""
+    budget = model_nbytes(srm_model) + 16
+    res = _residency({"big": srm_model}, budget=budget)
+    res.register("over", model=encoding_model, pinned=True)
+    # pin the resident one so the incoming pinned model cannot fit
+    res._registry["big"].pinned = True
+    with ServeService(res) as svc:
+        ok_rec = svc.submit(
+            _srm_requests(srm_model, 1)[0],
+            model="big").result(timeout=60)
+        req = _enc_requests(encoding_model, 1)[0]
+        bad_rec = svc.submit(req, model="over").result(timeout=60)
+    assert ok_rec.ok
+    assert not bad_rec.ok
+    assert bad_rec.error == "admission_refused"
+    assert "budget" in bad_rec.message
+
+
+def test_tick_spans_and_queue_gauges_emit(srm_model):
+    """A drive under an obs sink leaves serve.service.tick spans
+    (active ticks only, real durations) and the per-model queue
+    gauge behind."""
+    from brainiak_tpu.obs import sink
+    mem = sink.add_sink(sink.MemorySink())
+    try:
+        with ServeService(_residency({"m": srm_model})) as svc:
+            tickets = svc.submit_many(_srm_requests(srm_model, 4))
+            for ticket in tickets:
+                ticket.result(timeout=60)
+    finally:
+        sink.remove_sink(mem)
+    ticks = [r for r in mem.records
+             if r["kind"] == "span"
+             and r["name"] == "serve.service.tick"]
+    assert ticks
+    assert all(r["dur_s"] >= 0 for r in ticks)
+    assert sum((r.get("attrs") or {}).get("n_delivered", 0)
+               for r in ticks) == 4
+    assert metrics.gauge(
+        "serve_service_queue_depth").value(model="m") == 0
+
+
+def test_submit_many_is_deterministic_over_buckets(srm_model):
+    """Two identical atomic waves produce identical (bucket, batch)
+    shapes — the property the AOT restart contract rides on."""
+    def drive():
+        res = _residency({"m": srm_model})
+        with ServeService(res) as svc:
+            reqs = _srm_requests(srm_model, 7, tr_choices=(6, 20))
+            for req in reqs:
+                req.submitted = None
+            tickets = svc.submit_many(reqs)
+            records = [t.result(timeout=60) for t in tickets]
+        return sorted({str(r.bucket) for r in records})
+
+    assert drive() == drive()
+
+
+# -- the PR acceptance scenario ---------------------------------------
+
+def test_acceptance_two_models_waves_eviction_restart(
+        srm_model, encoding_model, tmp_path):
+    """ISSUE 9 acceptance: an SRM and a ridge_encoding model under a
+    byte budget that fits ONE of them answer 128 mixed-shape
+    requests in staggered model-alternating waves — zero lost
+    requests, retraces bounded by the distinct bucket count, at
+    least one eviction with transparent re-admission — and after a
+    (simulated) process restart against the same AOT cache, the
+    first requests serve with ``retrace_total{site=serve.*} == 0``.
+    The SRV002 gate proves the true-subprocess restart."""
+    budget = max(model_nbytes(srm_model),
+                 model_nbytes(encoding_model)) + 64
+    aot_dir = str(tmp_path / "aot")
+    models = {"srm": srm_model, "enc": encoding_model}
+
+    def drive(n_total, prefix):
+        res = _residency(models, budget=budget,
+                         aot=AOTProgramCache(aot_dir))
+        delivered = []   # (kind, record)
+        per_wave = 16
+        with ServeService(res) as svc:
+            waves = n_total // per_wave
+            for w in range(waves):
+                kind = "srm" if w % 2 == 0 else "enc"
+                build = (_srm_requests if kind == "srm"
+                         else _enc_requests)
+                reqs = build(models[kind], per_wave, seed=w,
+                             prefix=f"{prefix}{w}-")
+                tickets = svc.submit_many(reqs, model=kind)
+                delivered.extend(
+                    (kind, t.result(timeout=120))
+                    for t in tickets)
+            summary = svc.summary()
+        return delivered, summary, res
+
+    out, summary, res = drive(128, "cold")
+    records = [rec for _, rec in out]
+    # zero lost requests: every one of the 128 resolved ok
+    assert len(records) == 128
+    assert all(r.ok for r in records), \
+        {r.request_id: r.error for r in records if not r.ok}
+    # retraces bounded by the distinct per-kind bucket count
+    buckets = {(kind, str(rec.bucket)) for kind, rec in out}
+    assert 0 < serve_retrace_total() <= len(buckets)
+    # at least one eviction, and the evicted model was re-admitted
+    stats = summary["residency"]
+    assert stats["evictions"] >= 1
+    assert max(stats["admissions"].values()) >= 2
+    # padding waste covers the WHOLE drive: the evicted engines'
+    # dispatched elements were accrued, not lost with the engine
+    assert summary["padding_waste"] > 0
+
+    # restart: fresh caches/metrics, same AOT dir -> first requests
+    # serve without ANY serve compile
+    _fresh_process()
+    out2, summary2, _ = drive(32, "warm")
+    assert all(rec.ok for _, rec in out2)
+    assert serve_retrace_total() == 0
+    assert summary2["aot"]["hits"] > 0

@@ -42,7 +42,8 @@ def test_run_checks_json_output():
     assert payload["findings"] == []
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
-        "jaxlint", "obs", "regress", "serve", "distla", "encoding"}
+        "jaxlint", "obs", "regress", "serve", "service", "distla",
+        "encoding"}
     assert payload["files"] > 100
 
 
@@ -296,6 +297,28 @@ def test_serve_gate_catches_poison_fixture(tmp_path, monkeypatch):
     rc.check_serve(findings)
     assert findings and all(f.code == "SRV001" for f in findings)
     assert any("error record" in f.message for f in findings)
+
+
+def test_service_gate_passes_and_proves_restart_contract():
+    """The service gate (SRV002, ISSUE 9 satellite): two `service`
+    CLI runs over one temp AOT cache — the second must hit the
+    cache and compile nothing.  Passing on the live tree IS the
+    restart-zero-compile proof at true process granularity."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_service(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_service_gate_catches_missing_fixture(tmp_path,
+                                              monkeypatch):
+    rc = _load_run_checks()
+    monkeypatch.setattr(rc, "SERVE_FIXTURE_DIR",
+                        str(tmp_path / "nope"))
+    findings = []
+    rc.check_service(findings)
+    assert [f.code for f in findings] == ["SRV002"]
+    assert "missing" in findings[0].message
 
 
 def test_distla_gate_passes_on_live_package():

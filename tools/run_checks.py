@@ -29,6 +29,11 @@ serve      smoke-runs ``python -m brainiak_tpu.serve run`` on
            the committed tools/serve_fixture/ model + request
            files and fails on CLI errors, request-level error
            records, or per-request recompiles (SRV001)
+service    smoke-runs ``python -m brainiak_tpu.serve service``
+           TWICE on the committed serve fixture over one temp
+           AOT cache and fails unless the second run reports
+           aot hits > 0 and ZERO serve retraces — the
+           restart-without-compile-stall contract (SRV002)
 distla     smoke-runs the pod-scale linear algebra selfcheck
            (``brainiak_tpu.ops.distla.selfcheck``) on a tiny
            fixture over an 8-device CPU mesh and fails on
@@ -71,7 +76,8 @@ from brainiak_tpu.analysis.core import SKIP_DIRS  # noqa: E402,F401
 
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
-         "jaxlint", "obs", "regress", "serve", "distla", "encoding")
+         "jaxlint", "obs", "regress", "serve", "service", "distla",
+         "encoding")
 
 
 def python_sources():
@@ -541,6 +547,85 @@ def check_serve(findings):
             "per-request recompiles"))
 
 
+# -- service gate -----------------------------------------------------
+
+def _run_service_cli(aot_dir):
+    """One ``serve service`` child over the committed fixture with a
+    shared AOT cache; returns (rc, summary-or-None, stderr tail)."""
+    model = os.path.join(SERVE_FIXTURE_DIR, "model.npz")
+    requests = os.path.join(SERVE_FIXTURE_DIR, "requests.npz")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "brainiak_tpu.serve", "service",
+             "--model", f"fixture={model}", "--requests", requests,
+             "--aot-cache", aot_dir, "--waves", "1",
+             "--format=json"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     BENCH_FORCE_CPU="1"),
+            timeout=420)
+    except subprocess.TimeoutExpired:
+        return None, None, "timed out after 420s"
+    try:
+        summary = json.loads(proc.stdout)
+    except ValueError:
+        summary = None
+    tail = "; ".join((proc.stderr or proc.stdout or "")
+                     .strip().splitlines()[-3:])
+    return proc.returncode, summary, tail
+
+
+def check_service(findings):
+    """Always-on service gate (SRV002): run the ``service`` CLI
+    TWICE on the committed serve fixture over one fresh temp AOT
+    cache (``--waves 1`` — atomic submission, deterministic bucket
+    shapes).  The first run may compile (and must persist what it
+    compiled); the second run is the restart contract: every
+    request ok, ``aot.hits > 0``, and ``retrace_total`` (the
+    process-wide ``retrace_total{site=serve.*}``) exactly 0 — a
+    restarted service must serve without a compile stall."""
+    import tempfile
+
+    rel = _rel(SERVE_FIXTURE_DIR)
+    for name in ("model.npz", "requests.npz"):
+        if not os.path.exists(os.path.join(SERVE_FIXTURE_DIR,
+                                           name)):
+            findings.append(Finding(
+                rel, 1, "SRV002",
+                f"serve fixture missing: {rel}/{name}"))
+            return
+    with tempfile.TemporaryDirectory(prefix="srv002-aot-") as tmp:
+        for attempt in (1, 2):
+            rc, summary, tail = _run_service_cli(tmp)
+            if rc is None or summary is None or rc not in (0, 1):
+                findings.append(Finding(
+                    rel, 1, "SRV002",
+                    f"service CLI run {attempt} failed "
+                    f"(rc={rc}): {tail or 'no JSON summary'}"))
+                return
+            if summary.get("n_errors"):
+                findings.append(Finding(
+                    rel, 1, "SRV002",
+                    f"run {attempt}: {summary['n_errors']} "
+                    "request(s) produced error records: "
+                    f"{summary.get('errors_by_code')}"))
+                return
+    aot = summary.get("aot") or {}
+    if not aot.get("hits"):
+        findings.append(Finding(
+            rel, 1, "SRV002",
+            "second service run over the warm AOT cache reported "
+            f"no aot hits ({aot}): programs are not being "
+            "persisted or not being found"))
+    if summary.get("retrace_total", 1) != 0:
+        findings.append(Finding(
+            rel, 1, "SRV002",
+            "second service run compiled "
+            f"{summary.get('retrace_total'):.0f} serve program(s) "
+            "despite the warm AOT cache: the restart "
+            "zero-compile contract is broken"))
+
+
 # -- selfcheck-child gates (distla, encoding) -------------------------
 #
 # Shared harness: run a module selfcheck in a child pinned to an
@@ -780,6 +865,8 @@ def run_gates(only=None):
         check_regress(findings)
     if "serve" in selected:
         check_serve(findings)
+    if "service" in selected:
+        check_service(findings)
     if "distla" in selected:
         check_distla(findings)
     if "encoding" in selected:
@@ -791,8 +878,8 @@ def run_gates(only=None):
     label = "+".join(
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
-                       "obs", "regress", "serve", "distla",
-                       "encoding")
+                       "obs", "regress", "serve", "service",
+                       "distla", "encoding")
            if g in selected])
     return {
         "ok": not findings,
