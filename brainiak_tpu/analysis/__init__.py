@@ -1,11 +1,17 @@
-"""jaxlint: AST-based TPU-correctness static analysis (JX001-JX006).
+"""jaxlint: AST-based TPU-correctness static analysis.
 
 Rule-plugin analyzer enforcing the TPU-readiness invariants the
-north-star depends on: no per-call retracing, no host-device syncs in
-hot loops, no float64 leaks, disciplined PRNG handling, no Python
-branching on traced values, and explicit static arguments.  Run it
-standalone (``python -m brainiak_tpu.analysis``) or as the jaxlint
-gate of ``python -m tools.run_checks --only=jaxlint``.
+north-star depends on.  v1 file rules (JX001-JX006): no per-call
+retracing, no host-device syncs in hot loops, no float64 leaks,
+disciplined PRNG handling, no Python branching on traced values,
+and explicit static arguments.  v2 project rules run over a shared
+call-graph model (:mod:`.graph`/:mod:`.summaries`): interprocedural
+dataflow (JX010-JX012), mesh/collective axis checking
+(JX101-JX103), and the serve-loop lock-discipline race detector
+(JX201-JX205).  Run it standalone
+(``python -m brainiak_tpu.analysis``, ``--format sarif`` for CI
+annotation hosts) or through the ``jaxlint`` / ``jaxlint-deep``
+gates of ``python -m tools.run_checks``.
 """
 
 from .baseline import Baseline, BaselineError  # noqa: F401
@@ -14,6 +20,7 @@ from .core import (  # noqa: F401
     FileContext,
     FileRule,
     Finding,
+    ProjectRule,
     RepoRule,
     analyze_file,
     analyze_paths,
@@ -21,3 +28,5 @@ from .core import (  # noqa: F401
     register,
 )
 from .rules import JAXLINT_RULES  # noqa: F401
+from .cli import ALL_RULES, DEEP_RULES  # noqa: F401
+from .sarif import to_sarif  # noqa: F401

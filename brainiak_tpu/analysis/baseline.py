@@ -6,6 +6,12 @@ so entries survive unrelated line-number drift but die as soon as the
 flagged code changes.  Every entry MUST carry a non-empty ``reason``;
 a baseline without written justifications fails to load, so the file
 cannot silently become a blanket suppression list.
+
+Entries may live in the top-level ``entries`` list or grouped under
+named ``sections`` (``{"sections": {"tools-and-bench": [...]}}``) --
+sections are purely organizational (the tools/bench walk keeps its
+intentional bench-harness syncs in its own section) and are
+flattened into one suppression set at load time.
 """
 
 import json
@@ -50,7 +56,19 @@ class Baseline:
                 raise BaselineError(
                     f"{path}: not valid JSON ({exc})") from exc
         if isinstance(data, dict):
-            entries = data.get("entries", [])
+            entries = list(data.get("entries", []))
+            sections = data.get("sections", {})
+            if not isinstance(sections, dict):
+                raise BaselineError(
+                    f"{path}: 'sections' must map section names "
+                    "to entry lists")
+            for name in sorted(sections):
+                block = sections[name]
+                if not isinstance(block, list):
+                    raise BaselineError(
+                        f"{path}: section {name!r} must be a "
+                        "list of entries")
+                entries.extend(block)
         else:
             raise BaselineError(
                 f"{path}: expected object with 'entries' list")

@@ -3,7 +3,9 @@
 Exit status 0 when no finding survives pragma + baseline
 suppression, 1 otherwise, 2 for configuration errors.  ``--format
 json`` emits one machine-readable object (the same shape
-``tools/run_checks.py --format=json`` uses) for CI consumption.
+``tools/run_checks.py --format=json`` uses) for CI consumption;
+``--format sarif`` emits a SARIF 2.1.0 log CI hosts render as
+inline annotations.
 """
 
 import argparse
@@ -14,11 +16,22 @@ import sys
 from .baseline import Baseline, BaselineError
 from .config import load_config
 from .core import analyze_paths, iter_python_files, SKIP_DIRS
+from .interproc import INTERPROC_RULES
+from .lockrules import LOCK_RULES
+from .meshrules import MESH_RULES
 from .rules import JAXLINT_RULES
+from .sarif import to_sarif
+
+#: The project-wide (interprocedural / mesh / lock) rule families —
+#: everything beyond the per-file JX001-JX006 set.
+DEEP_RULES = INTERPROC_RULES + MESH_RULES + LOCK_RULES
+
+#: Every selectable rule, file and project alike.
+ALL_RULES = JAXLINT_RULES + DEEP_RULES
 
 
 def _selected_rules(select):
-    by_code = {r.code: r for r in JAXLINT_RULES}
+    by_code = {r.code: r for r in ALL_RULES}
     unknown = [c for c in select if c not in by_code]
     if unknown:
         raise SystemExit(
@@ -54,12 +67,16 @@ def build_parser():
     parser = argparse.ArgumentParser(
         prog="jaxlint",
         description="TPU-correctness static analysis for JAX code "
-                    "(rules JX001-JX006; see docs/static_analysis.md)")
+                    "(file rules JX001-JX006, interprocedural "
+                    "JX010-JX012, mesh/collective JX101-JX103, "
+                    "lock-discipline JX201-JX205; see "
+                    "docs/static_analysis.md)")
     parser.add_argument(
         "paths", nargs="*",
         help="files/dirs to analyze (default: [tool.jaxlint] "
              "include, else brainiak_tpu/)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format",
+                        choices=("text", "json", "sarif"),
                         default="text")
     parser.add_argument(
         "--select",
@@ -82,7 +99,7 @@ def build_parser():
 def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        for rule in JAXLINT_RULES:
+        for rule in ALL_RULES:
             print(f"{rule.code}  {rule.name}: "
                   f"{(rule.__doc__ or '').splitlines()[0]}")
         return 0
@@ -109,7 +126,13 @@ def main(argv=None):
         print(f"jaxlint: wrote {len(findings)} baseline entries "
               f"to {args.write_baseline}")
         return 0
-    if args.format == "json":
+    if args.format == "sarif":
+        rules_by_code = {r.code: r for r in ALL_RULES}
+        print(json.dumps(to_sarif(
+            findings,
+            {c: rules_by_code[c] for c in select
+             if c in rules_by_code}), indent=2))
+    elif args.format == "json":
         print(json.dumps({
             "ok": not findings,
             "files": n,

@@ -8,11 +8,18 @@ layered:
 
 * line pragma  -- ``# jaxlint: disable=JX001[,JX002]`` (JX rules) or
   the conventional ``# noqa`` (style gates, ``pragma = "noqa"``);
+  for jaxlint pragmas a multi-line simple statement is one logical
+  line (a pragma on any of its physical lines suppresses), and a
+  function/class header is one unit (decorator lines and the ``def``
+  line suppress each other's findings);
 * baseline     -- a repo-level JSON file of grandfathered findings,
   each with a written justification (:mod:`.baseline`).
 
-Rules come in two kinds: :class:`FileRule` (runs once per parsed
-file) and :class:`RepoRule` (runs once per repo walk -- used by the
+Rules come in three kinds: :class:`FileRule` (runs once per parsed
+file), :class:`ProjectRule` (runs once over the whole analyzed file
+set through the shared semantic model of :mod:`.graph` -- the
+interprocedural JX01x / mesh JX1xx / lock JX2xx families), and
+:class:`RepoRule` (runs once per repo walk -- used by the
 ``tools/run_checks.py`` gates that need cross-file state).
 """
 
@@ -22,9 +29,10 @@ import re
 from dataclasses import dataclass
 
 __all__ = [
-    "Finding", "FileContext", "FileRule", "RepoRule", "register",
-    "all_rules", "rules_for_gate", "analyze_file", "analyze_paths",
-    "iter_python_files", "SKIP_DIRS",
+    "Finding", "FileContext", "FileRule", "ProjectRule", "RepoRule",
+    "register", "all_rules", "rules_for_gate", "analyze_file",
+    "analyze_context", "analyze_paths", "build_context",
+    "run_project_rules", "iter_python_files", "SKIP_DIRS",
 ]
 
 SKIP_DIRS = {
@@ -75,6 +83,7 @@ class FileContext:
     def __init__(self, path, relpath, source):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
+        self.module = self._module_name(self.relpath)
         self.source = source
         self.lines = source.splitlines()
         self.tree = None
@@ -87,12 +96,43 @@ class FileContext:
             self.parse_error = exc
         self._parents = {}
         self._decorator_nodes = set()
+        self._pragma_extents = {}   # line -> tuple of sibling lines
         self.aliases = {}
         self.jitted = {}   # FunctionDef -> set of static param names
         if self.tree is not None:
             self._index()
 
     # -- indexing ----------------------------------------------------
+
+    @staticmethod
+    def _module_name(relpath):
+        """Dotted module name from the repo-relative path
+        (``brainiak_tpu/serve/aot.py`` -> ``brainiak_tpu.serve.aot``,
+        package ``__init__.py`` -> the package itself)."""
+        parts = relpath[:-3].split("/") if relpath.endswith(".py") \
+            else relpath.split("/")
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(p for p in parts if p) or "__main__"
+
+    def _package_parts(self):
+        parts = self.module.split(".")
+        if self.relpath.endswith("/__init__.py"):
+            return parts
+        return parts[:-1]
+
+    def _canonical_from(self, node):
+        """Absolute dotted module for an ``ImportFrom``, resolving
+        relative imports against this file's package."""
+        if not node.level:
+            return node.module or ""
+        base = self._package_parts()
+        base = base[:len(base) - (node.level - 1)] if node.level > 1 \
+            else base
+        parts = list(base)
+        if node.module:
+            parts.append(node.module)
+        return ".".join(parts)
 
     def _index(self):
         for node in ast.walk(self.tree):
@@ -104,6 +144,9 @@ class FileContext:
                 for dec in node.decorator_list:
                     for sub in ast.walk(dec):
                         self._decorator_nodes.add(id(sub))
+                self._index_header_extent(node)
+            elif self._is_simple_stmt(node):
+                self._index_stmt_extent(node)
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     bound = alias.asname or alias.name.split(".")[0]
@@ -111,7 +154,7 @@ class FileContext:
                              else alias.name.split(".")[0])
                     self.aliases[bound] = canon
             elif isinstance(node, ast.ImportFrom):
-                mod = node.module or ""
+                mod = self._canonical_from(node)
                 for alias in node.names:
                     if alias.name == "*":
                         continue
@@ -119,6 +162,38 @@ class FileContext:
                     self.aliases[bound] = (f"{mod}.{alias.name}"
                                            if mod else alias.name)
         self._collect_jitted()
+
+    @staticmethod
+    def _is_simple_stmt(node):
+        return isinstance(node, (
+            ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr,
+            ast.Return, ast.Raise, ast.Assert, ast.Delete,
+            ast.Import, ast.ImportFrom, ast.Global, ast.Nonlocal))
+
+    def _index_stmt_extent(self, node):
+        """A multi-line simple statement is ONE logical line for
+        jaxlint pragmas (flake8 noqa semantics)."""
+        end = getattr(node, "end_lineno", node.lineno)
+        if end <= node.lineno:
+            return
+        span = tuple(range(node.lineno, end + 1))
+        for line in span:
+            self._pragma_extents.setdefault(line, span)
+
+    def _index_header_extent(self, node):
+        """Decorator lines + the ``def``/``class`` header line form
+        one pragma unit: a pragma on the decorator line suppresses a
+        finding anchored to the def line and vice versa."""
+        first = min([node.lineno]
+                    + [d.lineno for d in node.decorator_list])
+        last = node.body[0].lineno - 1 if node.body else node.lineno
+        if last < node.lineno:
+            last = node.lineno
+        span = tuple(range(first, last + 1))
+        if len(span) <= 1:
+            return
+        for line in span:
+            self._pragma_extents[line] = span
 
     def _collect_jitted(self):
         defs = {}
@@ -234,14 +309,19 @@ class FileContext:
     # -- suppression -------------------------------------------------
 
     def suppressed(self, finding, pragma):
-        line = self.src_line(finding.line)
         if pragma == "noqa":
-            return "# noqa" in line
-        m = _PRAGMA_RE.search(line)
-        if not m:
-            return False
-        codes = {c.strip() for c in m.group(1).split(",")}
-        return finding.code in codes or "all" in codes
+            # style gates keep exact-line noqa semantics (E501 is a
+            # physical-line check; extending it would over-suppress)
+            return "# noqa" in self.src_line(finding.line)
+        for lineno in self._pragma_extents.get(finding.line,
+                                              (finding.line,)):
+            m = _PRAGMA_RE.search(self.src_line(lineno))
+            if not m:
+                continue
+            codes = {c.strip() for c in m.group(1).split(",")}
+            if finding.code in codes or "all" in codes:
+                return True
+        return False
 
 
 class FileRule:
@@ -254,6 +334,26 @@ class FileRule:
     needs_tree = True
 
     def check(self, ctx):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class ProjectRule:
+    """Base class: one check over the whole analyzed file set.
+
+    ``check`` receives a :class:`brainiak_tpu.analysis.graph.
+    ProjectContext` (module map, call graph, per-function summaries)
+    built once per run and shared by every project rule — the
+    project-wide analog of :class:`FileRule`'s one-parse contract.
+    Findings go through the same pragma + baseline suppression as
+    file-rule findings.
+    """
+
+    code = ""
+    name = ""
+    gate = "jaxlint-deep"
+    pragma = "jaxlint"
+
+    def check(self, project):  # pragma: no cover - interface
         raise NotImplementedError
 
 
@@ -302,16 +402,20 @@ def iter_python_files(paths, skip_dirs=SKIP_DIRS):
                     yield os.path.join(root, f)
 
 
-def analyze_file(path, repo_root, rules):
-    """Run ``rules`` (instances) over one file; returns findings.
+def build_context(path, repo_root):
+    """Read + parse one file into a shared :class:`FileContext`."""
+    relpath = os.path.relpath(path, repo_root)
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    return FileContext(path, relpath, source)
+
+
+def analyze_context(ctx, rules):
+    """Run file-rule instances over one built context.
 
     Parse failures yield a single CHK001 syntax finding; tree-needing
     rules are skipped for that file.
     """
-    relpath = os.path.relpath(path, repo_root)
-    with open(path, encoding="utf-8") as fh:
-        source = fh.read()
-    ctx = FileContext(path, relpath, source)
     findings = []
     if ctx.parse_error is not None:
         exc = ctx.parse_error
@@ -328,20 +432,52 @@ def analyze_file(path, repo_root, rules):
     return findings
 
 
+def analyze_file(path, repo_root, rules):
+    """Run ``rules`` (instances) over one file; returns findings."""
+    return analyze_context(build_context(path, repo_root), rules)
+
+
+def run_project_rules(contexts, rules):
+    """Run :class:`ProjectRule` instances over already-built
+    contexts (``{relpath: FileContext}``); pragma suppression is
+    applied through each finding's own file context."""
+    if not rules:
+        return []
+    from .graph import ProjectContext  # lazy: graph imports core
+    project = ProjectContext(contexts)
+    findings = []
+    for rule in rules:
+        for finding in rule.check(project):
+            ctx = contexts.get(finding.path)
+            if ctx is None or not ctx.suppressed(
+                    finding, rule.pragma):
+                findings.append(finding)
+    return findings
+
+
 def analyze_paths(paths, repo_root, rules, baseline=None):
     """Analyze every file under ``paths``.
 
-    Returns ``(findings, stale_entries, n_files)``: findings that
-    survived pragma + baseline suppression, baseline entries that
-    matched nothing (candidates for deletion), and the file count.
+    Files are parsed once into shared contexts; file rules run per
+    file, project rules run once over the full context set, repo
+    rules run last.  Returns ``(findings, stale_entries, n_files)``:
+    findings that survived pragma + baseline suppression, baseline
+    entries that matched nothing (candidates for deletion), and the
+    file count.
     """
     instances = [r() if isinstance(r, type) else r for r in rules]
     file_rules = [r for r in instances if isinstance(r, FileRule)]
+    project_rules = [r for r in instances
+                     if isinstance(r, ProjectRule)]
     findings = []
+    contexts = {}
     n = 0
     for path in iter_python_files(paths):
         n += 1
-        findings.extend(analyze_file(path, repo_root, file_rules))
+        ctx = build_context(path, repo_root)
+        contexts[ctx.relpath] = ctx
+        findings.extend(analyze_context(ctx, file_rules))
+    findings.extend(run_project_rules(contexts, project_rules))
     for rule in instances:
         if isinstance(rule, RepoRule):
             findings.extend(rule.check(repo_root))

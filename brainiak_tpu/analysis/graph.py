@@ -1,0 +1,353 @@
+"""Project-wide semantic model: modules, functions, call graph.
+
+PR 2's jaxlint sees one file at a time; the JX01x/JX1xx/JX2xx
+families need to see the program.  :class:`ProjectContext` is the
+shared model every :class:`~.core.ProjectRule` runs over — built
+once per analyzer run from the SAME :class:`~.core.FileContext`
+parses the file rules already used (no second parse):
+
+- a **module map** (dotted module name -> context, with relative
+  imports canonicalized against each file's package);
+- a **function index** covering nested defs and methods, with the
+  innermost-enclosing-function query rules anchor findings with;
+- **call resolution** from a call site to the project functions it
+  may invoke: local defs, module-level functions, ``self.`` methods,
+  alias-expanded cross-module dotted names, ``functools.partial``
+  unwrapping, and a unique-method-name fallback for attribute calls
+  whose receiver type is statically unknown;
+- a **string-constant table** so axis names like
+  ``DEFAULT_VOXEL_AXIS`` resolve to their literal values across
+  modules (the mesh rules verify against values, not spellings).
+
+Per-function dataflow summaries live in :mod:`.summaries`; rule
+families cache their derived models through :meth:`ProjectContext.
+cache` so e.g. the lock model is computed once for JX201-JX205.
+"""
+
+import ast
+
+__all__ = ["FunctionInfo", "ProjectContext", "body_nodes"]
+
+
+class FunctionInfo:
+    """One function/method definition in the project."""
+
+    __slots__ = ("qualname", "name", "node", "ctx", "module", "cls",
+                 "parent", "scope")
+
+    def __init__(self, qualname, name, node, ctx, cls, parent,
+                 scope):
+        self.qualname = qualname   # "module:Outer.inner"
+        self.name = name
+        self.node = node
+        self.ctx = ctx
+        self.module = ctx.module
+        self.cls = cls             # innermost class name or None
+        self.parent = parent       # enclosing FunctionInfo or None
+        self.scope = scope         # tuple of enclosing def/class names
+
+    @property
+    def relpath(self):
+        return self.ctx.relpath
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<fn {self.qualname}>"
+
+
+def body_nodes(info):
+    """Every AST node belonging to ``info``'s own body, in source
+    (pre-)order — consumers anchor findings to the FIRST offending
+    site, so ordering is part of the contract.  Nested
+    function/class bodies are excluded (they are separate
+    :class:`FunctionInfo` scopes); lambdas are treated as part of
+    the enclosing function."""
+    stack = list(reversed(info.node.body))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+class ProjectContext:
+    """The project model shared by every project rule in one run."""
+
+    def __init__(self, contexts):
+        # parse failures already produced CHK001; skip their trees
+        self.contexts = {rel: ctx for rel, ctx in contexts.items()
+                         if ctx.tree is not None}
+        self.modules = {}          # module name -> FileContext
+        self.functions = {}        # qualname -> FunctionInfo
+        self._top = {}             # (module, name) -> FunctionInfo
+        self._methods = {}         # (class, method) -> [FunctionInfo]
+        self._by_method_name = {}  # method name -> [FunctionInfo]
+        self._by_node = {}         # id(def node) -> FunctionInfo
+        self._locals = {}          # (id(parent node), name) -> info
+        self._constants = {}       # (module, NAME) -> str value
+        self._const_by_name = {}   # NAME -> set of str values
+        self._cache = {}
+        for ctx in self.contexts.values():
+            self.modules[ctx.module] = ctx
+        for ctx in self.contexts.values():
+            self._index_module(ctx)
+
+    def cache(self, key, builder):
+        """Memoize an expensive derived model (lock model, summaries,
+        mesh declarations) across the project rules of one run."""
+        if key not in self._cache:
+            self._cache[key] = builder(self)
+        return self._cache[key]
+
+    # -- indexing ----------------------------------------------------
+
+    def _index_module(self, ctx):
+        module = ctx.module
+        for stmt in ctx.tree.body:
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)):
+                name = stmt.targets[0].id
+                self._constants[(module, name)] = stmt.value.value
+                self._const_by_name.setdefault(name, set()).add(
+                    stmt.value.value)
+        self._walk_defs(ctx, ctx.tree, (), None, None)
+
+    def _walk_defs(self, ctx, node, scope, cls, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                inner = scope + (child.name,)
+                qual = f"{ctx.module}:{'.'.join(inner)}"
+                info = FunctionInfo(qual, child.name, child, ctx,
+                                    cls, parent, scope)
+                # first definition wins on (rare) duplicate names
+                self.functions.setdefault(qual, info)
+                self._by_node[id(child)] = info
+                if parent is None and cls is None:
+                    self._top.setdefault((ctx.module, child.name),
+                                         info)
+                if cls is not None and parent is None:
+                    self._methods.setdefault(
+                        (cls, child.name), []).append(info)
+                    self._by_method_name.setdefault(
+                        child.name, []).append(info)
+                if parent is not None:
+                    self._locals[(id(parent.node), child.name)] = \
+                        info
+                self._walk_defs(ctx, child, inner, cls, info)
+            elif isinstance(child, ast.ClassDef):
+                self._walk_defs(ctx, child, scope + (child.name,),
+                                child.name, None)
+            else:
+                self._walk_defs(ctx, child, scope, cls, parent)
+
+    # -- queries -----------------------------------------------------
+
+    def function_for_node(self, node):
+        """The :class:`FunctionInfo` whose def node is ``node``."""
+        return self._by_node.get(id(node))
+
+    def enclosing_function(self, ctx, node):
+        """Innermost indexed function containing ``node``."""
+        cur = node
+        while cur is not None:
+            info = self._by_node.get(id(cur))
+            if info is not None:
+                return info
+            cur = ctx.parent(cur)
+        return None
+
+    def iter_functions(self):
+        return self.functions.values()
+
+    def methods_named(self, name):
+        return self._by_method_name.get(name, [])
+
+    def module_function(self, module, name):
+        return self._top.get((module, name))
+
+    # -- call resolution ---------------------------------------------
+
+    def resolve_call(self, ctx, call, enclosing=None):
+        """Project functions a call site may invoke (possibly [])."""
+        return self.resolve_callable(ctx, call.func, enclosing)
+
+    def resolve_callable(self, ctx, node, enclosing=None, _depth=0):
+        """Project functions a callable *expression* denotes.
+
+        Handles bare names (local defs, module functions, imported
+        names), ``self.method``, dotted cross-module attributes, and
+        ``functools.partial(f, ...)`` unwrapping.  Attribute calls
+        on statically-unknown receivers resolve to nothing — a
+        deliberate precision choice: a unique-method-name guess
+        turns every ``d.get(...)`` into a call edge to whatever
+        class happens to define ``get``.
+        """
+        if _depth > 4:
+            return []
+        if isinstance(node, ast.Call):
+            target = ctx.resolve(node.func) or ""
+            if target.rsplit(".", 1)[-1] == "partial" and node.args:
+                return self.resolve_callable(
+                    ctx, node.args[0], enclosing, _depth + 1)
+            return []
+        if isinstance(node, ast.Name):
+            cur = enclosing
+            while cur is not None:
+                local = self._locals.get((id(cur.node), node.id))
+                if local is not None:
+                    return [local]
+                cur = cur.parent
+            top = self._top.get((ctx.module, node.id))
+            if top is not None:
+                return [top]
+            dotted = ctx.aliases.get(node.id)
+            if dotted:
+                return self._resolve_dotted(dotted, _depth)
+            return []
+        if isinstance(node, ast.Attribute):
+            if (isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and enclosing is not None
+                    and enclosing.cls is not None):
+                cands = self._methods.get(
+                    (enclosing.cls, node.attr), [])
+                same = [c for c in cands
+                        if c.module == enclosing.module]
+                return same or cands
+            dotted = ctx.resolve(node)
+            if dotted:
+                return self._resolve_dotted(dotted, _depth)
+            return []
+        return []
+
+    def _resolve_dotted(self, dotted, _depth=0):
+        if _depth > 4:
+            return []
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            module = ".".join(parts[:i])
+            ctx = self.modules.get(module)
+            if ctx is None:
+                continue
+            rest = parts[i:]
+            if len(rest) == 1:
+                info = self._top.get((module, rest[0]))
+                if info is not None:
+                    return [info]
+                # package re-export: chase the __init__ alias
+                target = ctx.aliases.get(rest[0])
+                if target and target != dotted:
+                    return self._resolve_dotted(target, _depth + 1)
+                return []
+            if len(rest) == 2:
+                cands = [f for f in self._methods.get(
+                             (rest[0], rest[1]), [])
+                         if f.module == module]
+                if cands:
+                    return cands
+                target = ctx.aliases.get(rest[0])
+                if target and target != dotted:
+                    return self._resolve_dotted(
+                        f"{target}.{rest[1]}", _depth + 1)
+            return []
+        return []
+
+    # -- constant / axis-name resolution -----------------------------
+
+    def param_default(self, fn_node, name):
+        """The default-value expression of parameter ``name``."""
+        args = fn_node.args
+        pos = args.posonlyargs + args.args
+        n_def = len(args.defaults)
+        for arg, dflt in zip(pos[len(pos) - n_def:], args.defaults):
+            if arg.arg == name:
+                return dflt
+        for arg, dflt in zip(args.kwonlyargs, args.kw_defaults):
+            if arg.arg == name and dflt is not None:
+                return dflt
+        return None
+
+    def literal_strings(self, ctx, node, enclosing=None, _depth=0):
+        """The set of literal strings an expression denotes, or None
+        when any part is statically unresolvable (rules then skip —
+        they flag only provable mismatches)."""
+        if node is None or _depth > 5:
+            return None
+        if isinstance(node, ast.Constant):
+            return ({node.value}
+                    if isinstance(node.value, str) else None)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for elt in node.elts:
+                sub = self.literal_strings(ctx, elt, enclosing,
+                                           _depth + 1)
+                if sub is None:
+                    return None
+                out |= sub
+            return out
+        if isinstance(node, ast.Name):
+            if enclosing is not None:
+                bound, resolved = self._local_binding(
+                    ctx, node.id, enclosing, _depth)
+                if bound:
+                    return resolved
+            const = self._constants.get((ctx.module, node.id))
+            if const is not None:
+                return {const}
+            dotted = ctx.aliases.get(node.id)
+            if dotted and "." in dotted:
+                mod, name = dotted.rsplit(".", 1)
+                const = self._constants.get((mod, name))
+                if const is not None:
+                    return {const}
+            vals = self._const_by_name.get(node.id)
+            if vals is not None and len(vals) == 1:
+                return set(vals)
+            return None
+        if isinstance(node, ast.Attribute):
+            dotted = ctx.resolve(node)
+            if dotted and "." in dotted:
+                mod, name = dotted.rsplit(".", 1)
+                const = self._constants.get((mod, name))
+                if const is not None:
+                    return {const}
+            return None
+        return None
+
+    def _local_binding(self, ctx, name, enclosing, _depth):
+        """Resolve a name bound inside a function: a parameter's
+        default (callers may override — still the declared intent
+        the rule verifies) or a single local literal assignment.
+        Returns ``(bound, values)``: a locally-bound name stops the
+        module-scope fallback even when its value is unresolvable (a
+        parameter must not be confused with a same-named module
+        constant it shadows)."""
+        fn = enclosing.node
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)}
+        if name in params:
+            return True, self.literal_strings(
+                ctx, self.param_default(fn, name), enclosing.parent,
+                _depth + 1)
+        assigns = []
+        for node in body_nodes(enclosing):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name) \
+                                and sub.id == name:
+                            assigns.append(
+                                node.value
+                                if isinstance(tgt, ast.Name)
+                                else None)
+        if len(assigns) == 1 and assigns[0] is not None:
+            return True, self.literal_strings(
+                ctx, assigns[0], enclosing, _depth + 1)
+        if assigns:
+            return True, None
+        return False, None

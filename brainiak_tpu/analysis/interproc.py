@@ -1,0 +1,243 @@
+"""Interprocedural dataflow rules JX010-JX012.
+
+The original JX001/JX002/JX004 see one function at a time; these
+three follow the :mod:`.graph` call graph, so a hazard hidden behind
+a helper — even one in another module — is reported at the call
+site where it bites:
+
+- **JX010** — a call inside a hot loop to a function that
+  (transitively, must-execute) performs a definite device sync, or
+  that directly performs an ambiguous host conversion;
+- **JX011** — a call inside any loop to a function that constructs
+  an uncached ``jax.jit`` per call (the JX001 hazard, observed from
+  the looping caller's side);
+- **JX012** — a PRNG key fed to two or more key-consuming calls
+  without a split, where consumption happens through helper
+  functions (the JX004 hazard across function boundaries).
+"""
+
+import ast
+
+from .core import ProjectRule, register
+from .rules import (
+    HostSyncInLoop,
+    _KEY_MGMT,
+    _walk_skip_nested,
+    iter_hot_scopes,
+)
+from .summaries import project_summaries
+
+__all__ = ["TransitiveHostSync", "TransitiveJitInLoop",
+           "CrossFunctionKeyReuse", "INTERPROC_RULES"]
+
+
+def _finding(rule, ctx, node, message):
+    return ctx.finding(rule, node, message)
+
+
+def _uses_jax(ctx):
+    """Whether a module imports jax (directly or via jax.numpy)."""
+    return any(canon == "jax" or canon.startswith("jax.")
+               for canon in ctx.aliases.values())
+
+
+@register
+class TransitiveHostSync(ProjectRule):
+    """JX010: hot-loop call to a helper that host-syncs."""
+
+    code = "JX010"
+    name = "transitive-host-sync"
+
+    def check(self, project):
+        summaries = project_summaries(project)
+        for ctx in project.contexts.values():
+            seen = set()
+            for body, why, scope in iter_hot_scopes(ctx):
+                direct_lines = {
+                    n.lineno for n in _walk_skip_nested(body)
+                    if HostSyncInLoop._host_sync(ctx, n)}
+                for node in _walk_skip_nested(body):
+                    if not isinstance(node, ast.Call) \
+                            or id(node) in seen \
+                            or node.lineno in direct_lines:
+                        continue
+                    enclosing = project.enclosing_function(ctx,
+                                                           node)
+                    targets = project.resolve_call(ctx, node,
+                                                   enclosing)
+                    if len(targets) != 1:
+                        continue
+                    target = targets[0]
+                    if enclosing is not None \
+                            and target.qualname == \
+                            enclosing.qualname:
+                        continue  # recursion, not a helper
+                    summary = summaries.get(target.qualname)
+                    if summary is None:
+                        continue
+                    if not _uses_jax(target.ctx):
+                        # a module that never imports jax has no
+                        # device arrays: its np.asarray/.item()
+                        # calls are host bookkeeping, not syncs
+                        continue
+                    hit = self._classify(summary)
+                    if hit is None:
+                        continue
+                    seen.add(id(node))
+                    yield _finding(
+                        self, ctx, node,
+                        f"call to '{target.name}' "
+                        f"({target.relpath}) inside {why} "
+                        f"host-syncs every iteration: {hit}; "
+                        "hoist the sync out of the hot loop or "
+                        "restructure the helper")
+
+    @staticmethod
+    def _classify(summary):
+        if summary.sync_witness is not None:
+            return summary.sync_witness
+        for node, label, cond in summary.host_convs:
+            if not cond:
+                return (f"{label} at {summary.info.relpath}:"
+                        f"{node.lineno}")
+        return None
+
+
+@register
+class TransitiveJitInLoop(ProjectRule):
+    """JX011: loop call to a builder that jits per call."""
+
+    code = "JX011"
+    name = "transitive-jit-in-loop"
+
+    def check(self, project):
+        summaries = project_summaries(project)
+        for summary in summaries.values():
+            ctx = summary.info.ctx
+            for node, targets, _cond in summary.calls:
+                if len(targets) != 1:
+                    continue
+                callee = summaries.get(targets[0].qualname)
+                if callee is None \
+                        or callee.builds_jit_line is None:
+                    continue
+                if not self._in_loop(ctx, node,
+                                     summary.info.node):
+                    continue
+                yield _finding(
+                    self, ctx, node,
+                    f"call to '{targets[0].name}' inside a loop: "
+                    "it constructs a fresh jax.jit per call "
+                    f"({targets[0].relpath}:"
+                    f"{callee.builds_jit_line}), so every "
+                    "iteration retraces; hoist the call or cache "
+                    "the builder (functools.lru_cache / "
+                    "counted_cache)")
+
+    @staticmethod
+    def _in_loop(ctx, node, fn_node):
+        cur = ctx.parent(node)
+        while cur is not None and cur is not fn_node:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While,
+                                ast.ListComp, ast.SetComp,
+                                ast.DictComp, ast.GeneratorExp)):
+                return True
+            if isinstance(cur, (ast.FunctionDef,
+                                ast.AsyncFunctionDef, ast.Lambda)):
+                return False
+            cur = ctx.parent(cur)
+        return False
+
+
+@register
+class CrossFunctionKeyReuse(ProjectRule):
+    """JX012: PRNG key reuse across function boundaries."""
+
+    code = "JX012"
+    name = "cross-function-key-reuse"
+
+    def check(self, project):
+        summaries = project_summaries(project)
+        for summary in summaries.values():
+            yield from self._check_fn(project, summaries, summary)
+
+    def _check_fn(self, project, summaries, summary):
+        ctx = summary.info.ctx
+        fn = summary.info.node
+        stores = {}
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name):
+                            stores[sub.id] = \
+                                stores.get(sub.id, 0) + 1
+        consumed = {}    # key name -> [(call node, via-helper name)]
+        managed = set()
+        for node, targets, _cond in summary.calls:
+            target = ctx.resolve(node.func) or ""
+            short = target.rsplit(".", 1)[-1]
+            if target.startswith("jax.random."):
+                if not node.args or not isinstance(node.args[0],
+                                                   ast.Name):
+                    continue
+                key = node.args[0].id
+                if short in _KEY_MGMT:
+                    managed.add(key)
+                else:
+                    consumed.setdefault(key, []).append(
+                        (node, None))
+                continue
+            if len(targets) != 1:
+                continue
+            callee = summaries.get(targets[0].qualname)
+            if callee is None or not callee.key_params:
+                continue
+            for key in self._keys_into(node, callee):
+                consumed.setdefault(key, []).append(
+                    (node, targets[0].name))
+        for key, calls in sorted(consumed.items()):
+            helpers = sorted({via for _, via in calls
+                              if via is not None})
+            if not helpers:
+                continue  # all-direct reuse is JX004's domain
+            if len(calls) < 2 or key in managed \
+                    or stores.get(key, 0) > 1:
+                continue
+            node = calls[1][0]
+            yield _finding(
+                self, ctx, node,
+                f"PRNG key `{key}` consumed by {len(calls)} "
+                f"calls in '{summary.info.name}' — including "
+                f"helper(s) {', '.join(helpers)} which sample "
+                "from it — without a split: the draws are "
+                "IDENTICAL, not independent; jax.random.split "
+                "the key first")
+
+    @staticmethod
+    def _keys_into(node, callee):
+        """Caller names passed into the callee's key-consuming
+        parameters at this call site."""
+        callee_pos = [a.arg for a in
+                      (callee.info.node.args.posonlyargs
+                       + callee.info.node.args.args)]
+        skip = 1 if callee_pos[:1] == ["self"] else 0
+        out = []
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Name) \
+                    and i + skip < len(callee_pos) \
+                    and callee_pos[i + skip] in callee.key_params:
+                out.append(arg.id)
+        for kw in node.keywords:
+            if isinstance(kw.value, ast.Name) \
+                    and kw.arg in callee.key_params:
+                out.append(kw.value.id)
+        return out
+
+
+INTERPROC_RULES = [TransitiveHostSync, TransitiveJitInLoop,
+                   CrossFunctionKeyReuse]

@@ -1,0 +1,688 @@
+"""Lock-discipline race detector JX201-JX205 for the serve loop.
+
+PR 8 introduced the repo's first real threading (the
+:class:`~brainiak_tpu.serve.service.ServeService` loop, ticket
+futures, eviction callbacks) with no tooling able to prove its lock
+discipline.  These rules implement the ``# guarded-by:`` convention:
+
+- annotate a mutable attribute where it is created::
+
+      self._pending = {}   # guarded-by: _engine_lock
+
+- annotate a helper whose callers must hold a lock (trusted like
+  clang's ``REQUIRES()`` — and *verified* at every statically
+  visible call site)::
+
+      def _deliver_many(self, name, records):  # requires-lock: _engine_lock
+
+The analyzer discovers every ``threading.Lock``/``RLock``/
+``Condition`` attribute, computes the lock set held at each
+statement — ``with self._lock:`` blocks plus the **entry lock set**
+propagated through the call graph (the intersection of the locks
+held at every statically visible call site; functions that escape as
+callbacks or thread targets start from the empty set) — and reports:
+
+- **JX201** — read/write of a ``guarded-by`` field without holding
+  its lock;
+- **JX202** — inconsistent lock-acquisition order (a cycle in the
+  acquired-while-holding graph; re-acquiring a non-reentrant
+  ``Lock`` is the one-lock case);
+- **JX203** — a blocking call (``.poll()``, ``.result()``,
+  ``.join()``, ``.wait()`` on foreign objects, file I/O,
+  ``time.sleep``) made while holding a lock;
+- **JX204** — a call site that does not hold a callee's declared
+  ``# requires-lock:``;
+- **JX205** — annotation hygiene: ``guarded-by``/``requires-lock``
+  naming a lock the class/module does not define.
+"""
+
+import ast
+import re
+
+from .core import ProjectRule, register
+from .graph import body_nodes
+from .summaries import project_summaries
+
+__all__ = ["UnguardedAttribute", "LockOrderInversion",
+           "BlockingCallUnderLock", "RequiresLockViolation",
+           "UnknownLockAnnotation", "LOCK_RULES"]
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+_REQUIRES_RE = re.compile(r"#\s*requires-lock:\s*([A-Za-z_][\w.]*)")
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "threading.Semaphore": "lock",
+    "threading.BoundedSemaphore": "lock",
+}
+
+#: Reentrant kinds: re-acquisition is legal, not a self-deadlock
+#: (``Condition()`` wraps an RLock by default).
+_REENTRANT = {"rlock", "condition"}
+
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep",
+    "subprocess.run": "subprocess.run",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+    "subprocess.Popen": "subprocess.Popen",
+    "os.system": "os.system",
+    "io.open": "io.open",
+    "numpy.load": "np.load",
+    "numpy.save": "np.save",
+    "numpy.savez": "np.savez",
+    "numpy.savez_compressed": "np.savez_compressed",
+}
+_BLOCKING_METHODS = {"result", "join", "wait", "wait_for", "poll"}
+
+
+def _fmt(lock):
+    module, cls, attr = lock
+    return f"{cls}.{attr}" if cls else attr
+
+
+def _fmt_set(locks):
+    return ", ".join(sorted(_fmt(lk) for lk in locks)) or "none"
+
+
+class LockModel:
+    """Everything JX201-JX205 share, built once per run."""
+
+    def __init__(self):
+        self.locks = {}           # (module, cls|None, attr) -> kind
+        self.guarded_attr = {}    # (module, cls, field) -> (lock, ln)
+        self.guarded_global = {}  # (module, name) -> (lock, ln)
+        self.requires = {}        # qualname -> set of lock ids
+        self.ann_errors = []      # (ctx, lineno, message)
+        self.entry = {}           # qualname -> frozenset of lock ids
+        self.node_locks = {}      # qualname -> {id(node): frozenset}
+        self.acquire_sites = {}   # qualname -> [(node, lock, held)]
+        self.acquires_trans = {}  # qualname -> set of lock ids
+        self.locked_modules = set()
+
+    def lock_for_name(self, module, cls, name):
+        """Resolve an annotation's lock name to a lock id."""
+        name = name[5:] if name.startswith("self.") else name
+        if "." in name:
+            owner, attr = name.rsplit(".", 1)
+            cands = [lk for lk in self.locks
+                     if lk[1] == owner and lk[2] == attr]
+            same = [lk for lk in cands if lk[0] == module]
+            if len(same) == 1:
+                return same[0]
+            if len(cands) == 1:
+                return cands[0]
+            return None
+        if cls is not None and (module, cls, name) in self.locks:
+            return (module, cls, name)
+        if (module, None, name) in self.locks:
+            return (module, None, name)
+        return None
+
+
+def _stmt_lines(node):
+    return range(node.lineno,
+                 getattr(node, "end_lineno", node.lineno) + 1)
+
+
+def _comment_on(ctx, node, regex):
+    for lineno in _stmt_lines(node):
+        m = regex.search(ctx.src_line(lineno))
+        if m:
+            return m.group(1), lineno
+    return None
+
+
+def _header_lines(node):
+    first = min([node.lineno]
+                + [d.lineno for d in node.decorator_list])
+    last = node.body[0].lineno - 1 if node.body else node.lineno
+    return range(first, max(last, node.lineno) + 1)
+
+
+def _scan_definitions(project, model):
+    for ctx in project.contexts.values():
+        module = ctx.module
+        for stmt in ctx.tree.body:
+            self_assign = (isinstance(stmt, ast.Assign)
+                           and len(stmt.targets) == 1
+                           and isinstance(stmt.targets[0],
+                                          ast.Name))
+            if not self_assign:
+                continue
+            name = stmt.targets[0].id
+            kind = _ctor_kind(ctx, stmt.value)
+            if kind:
+                model.locks[(module, None, name)] = kind
+            hit = _comment_on(ctx, stmt, _GUARDED_RE)
+            if hit:
+                model.guarded_global[(module, name)] = hit
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for sub in ast.walk(node):
+                target = None
+                if isinstance(sub, ast.Assign) and sub.targets:
+                    target = sub.targets[0]
+                elif isinstance(sub, (ast.AnnAssign,
+                                      ast.AugAssign)):
+                    target = sub.target
+                if not (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    continue
+                field = target.attr
+                value = getattr(sub, "value", None)
+                kind = _ctor_kind(ctx, value)
+                if kind:
+                    model.locks[(module, node.name, field)] = kind
+                hit = _comment_on(ctx, sub, _GUARDED_RE)
+                if hit:
+                    key = (module, node.name, field)
+                    model.guarded_attr.setdefault(key, hit)
+
+
+def _ctor_kind(ctx, value):
+    if not isinstance(value, ast.Call):
+        return None
+    return _LOCK_CTORS.get(ctx.resolve(value.func) or "")
+
+
+def _resolve_annotations(project, model):
+    """guarded-by/requires-lock names -> lock ids (JX205 on miss)."""
+    for key, (name, lineno) in list(model.guarded_attr.items()):
+        module, cls, field = key
+        lock = model.lock_for_name(module, cls, name)
+        if lock is None:
+            model.ann_errors.append((
+                project.modules.get(module), lineno,
+                f"guarded-by names unknown lock `{name}` for "
+                f"field `{field}` (class {cls} defines no such "
+                "threading.Lock/RLock/Condition attribute)"))
+            del model.guarded_attr[key]
+        else:
+            model.guarded_attr[key] = (lock, lineno)
+    for key, (name, lineno) in list(model.guarded_global.items()):
+        module, field = key
+        lock = model.lock_for_name(module, None, name)
+        if lock is None:
+            model.ann_errors.append((
+                project.modules.get(module), lineno,
+                f"guarded-by names unknown lock `{name}` for "
+                f"module global `{field}`"))
+            del model.guarded_global[key]
+        else:
+            model.guarded_global[key] = (lock, lineno)
+    for info in project.iter_functions():
+        found = set()
+        for lineno in _header_lines(info.node):
+            m = _REQUIRES_RE.search(info.ctx.src_line(lineno))
+            if not m:
+                continue
+            lock = model.lock_for_name(info.module, info.cls,
+                                       m.group(1))
+            if lock is None:
+                model.ann_errors.append((
+                    info.ctx, lineno,
+                    f"requires-lock names unknown lock "
+                    f"`{m.group(1)}` on '{info.name}'"))
+            else:
+                found.add(lock)
+        if found:
+            model.requires[info.qualname] = found
+
+
+def _with_locks(model, info, node):
+    """Lock ids acquired by one ``with`` item context expr."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self" and info.cls is not None):
+        key = (info.module, info.cls, node.attr)
+        if key in model.locks:
+            return key
+    if isinstance(node, ast.Name):
+        key = (info.module, None, node.id)
+        if key in model.locks:
+            return key
+    return None
+
+
+def _walk_locksets(model, info):
+    """Per-node held-set map + acquisition sites for one function."""
+    held_map = {}
+    sites = []
+
+    def walk(node, held):
+        held_map[id(node)] = held
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            # items acquire LEFT TO RIGHT: `with a, b:` holds a
+            # while acquiring b — the same order edge as nesting
+            inner = held
+            for item in node.items:
+                walk(item.context_expr, inner)
+                if item.optional_vars is not None:
+                    walk(item.optional_vars, inner)
+                lock = _with_locks(model, info,
+                                   item.context_expr)
+                if lock is not None:
+                    sites.append((item.context_expr, lock, inner))
+                    inner = frozenset(inner | {lock})
+            for stmt in node.body:
+                walk(stmt, inner)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            walk(child, held)
+
+    for stmt in info.node.body:
+        walk(stmt, frozenset())
+    return held_map, sites
+
+
+def _escaped_functions(project, summaries):
+    escaped = set()
+    for summary in summaries.values():
+        escaped |= summary.refs
+    for ctx in project.contexts.values():
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Name, ast.Attribute)):
+                    for info in project.resolve_callable(ctx,
+                                                         node):
+                        escaped.add(info.qualname)
+    return escaped
+
+
+def build_lock_model(project):
+    model = LockModel()
+    _scan_definitions(project, model)
+    model.locked_modules = {lk[0] for lk in model.locks}
+    _resolve_annotations(project, model)
+    summaries = project_summaries(project)
+    for info in project.iter_functions():
+        if info.module not in model.locked_modules:
+            continue
+        held_map, sites = _walk_locksets(model, info)
+        model.node_locks[info.qualname] = held_map
+        model.acquire_sites[info.qualname] = sites
+    # transitive acquired-locks (may-analysis, for order edges)
+    acquires = {q: {lk for _, lk, _ in s}
+                for q, s in model.acquire_sites.items()}
+    changed = True
+    rounds = 0
+    while changed and rounds < 12:
+        changed = False
+        rounds += 1
+        for qual, summary in summaries.items():
+            mine = acquires.setdefault(qual, set())
+            before = len(mine)
+            for _node, targets, _cond in summary.calls:
+                for target in targets:
+                    mine |= acquires.get(target.qualname, set())
+            if len(mine) != before:
+                changed = True
+    model.acquires_trans = acquires
+    _compute_entries(project, model, summaries)
+    return model
+
+
+def _compute_entries(project, model, summaries):
+    """Entry lock sets: intersection over statically visible call
+    sites, ∅ for escaped functions, plus trusted requires-lock."""
+    universe = frozenset(model.locks)
+    escaped = _escaped_functions(project, summaries)
+    call_sites = {}
+    for qual, summary in summaries.items():
+        for node, targets, _cond in summary.calls:
+            for target in targets:
+                call_sites.setdefault(target.qualname, []).append(
+                    (qual, node))
+    entry = {q: universe for q in summaries}
+
+    def lockset_at(caller, node):
+        base = entry.get(caller, frozenset())
+        withs = model.node_locks.get(caller, {}).get(
+            id(node), frozenset())
+        return base | withs
+
+    for _ in range(20):
+        changed = False
+        for qual in summaries:
+            if qual in escaped or qual not in call_sites:
+                base = frozenset()
+            else:
+                base = universe
+                for caller, node in call_sites[qual]:
+                    base &= lockset_at(caller, node)
+            eff = base | model.requires.get(qual, frozenset())
+            if eff != entry[qual]:
+                entry[qual] = frozenset(eff)
+                changed = True
+        if not changed:
+            break
+    model.entry = entry
+
+
+def lock_model(project):
+    return project.cache("lock_model", build_lock_model)
+
+
+def _held_at(model, qual, node):
+    return (model.entry.get(qual, frozenset())
+            | model.node_locks.get(qual, {}).get(id(node),
+                                                 frozenset()))
+
+
+def _access_kind(ctx, node):
+    """read vs write, seeing through subscript stores
+    (``self._pending[k] = v`` writes the container)."""
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return "write"
+    parent = ctx.parent(node)
+    if isinstance(parent, ast.Subscript) and isinstance(
+            parent.ctx, (ast.Store, ast.Del)):
+        return "write"
+    return "read"
+
+
+@register
+class UnguardedAttribute(ProjectRule):
+    """JX201: guarded-by field accessed without its lock."""
+
+    code = "JX201"
+    name = "unguarded-attribute"
+
+    def check(self, project):
+        model = lock_model(project)
+        if not model.guarded_attr and not model.guarded_global:
+            return
+        for info in project.iter_functions():
+            if info.module not in model.locked_modules:
+                continue
+            if info.name == "__init__":
+                continue  # construction precedes sharing
+            yield from self._check_attrs(model, info)
+            yield from self._check_globals(model, info)
+
+    def _check_attrs(self, model, info):
+        if info.cls is None:
+            return
+        ctx = info.ctx
+        seen = set()
+        for node in body_nodes(info):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                continue
+            key = (info.module, info.cls, node.attr)
+            hit = model.guarded_attr.get(key)
+            if hit is None:
+                continue
+            lock, _ = hit
+            held = _held_at(model, info.qualname, node)
+            if lock in held:
+                continue
+            mark = (node.lineno, node.attr)
+            if mark in seen:
+                continue
+            seen.add(mark)
+            access = _access_kind(ctx, node)
+            yield ctx.finding(
+                self, node,
+                f"{access} of `self.{node.attr}` (guarded-by "
+                f"{_fmt(lock)}) in '{info.name}' without holding "
+                f"it (held: {_fmt_set(held)}); wrap the access in "
+                f"`with self.{lock[2]}:` or annotate the method "
+                f"`# requires-lock: {lock[2]}`")
+
+    def _check_globals(self, model, info):
+        ctx = info.ctx
+        fields = {name for (mod, name) in model.guarded_global
+                  if mod == info.module}
+        if not fields:
+            return
+        fn = info.node
+        params = {a.arg for a in (fn.args.posonlyargs
+                                  + fn.args.args
+                                  + fn.args.kwonlyargs)}
+        globals_decl = set()
+        local_stores = set()
+        for node in body_nodes(info):
+            if isinstance(node, ast.Global):
+                globals_decl |= set(node.names)
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                local_stores.add(node.id)
+        seen = set()
+        for node in body_nodes(info):
+            if not (isinstance(node, ast.Name)
+                    and node.id in fields):
+                continue
+            name = node.id
+            if name in params or (name in local_stores
+                                  and name not in globals_decl):
+                continue  # shadowed local
+            lock, _ = model.guarded_global[(info.module, name)]
+            held = _held_at(model, info.qualname, node)
+            if lock in held:
+                continue
+            mark = (node.lineno, name)
+            if mark in seen:
+                continue
+            seen.add(mark)
+            access = _access_kind(ctx, node)
+            yield ctx.finding(
+                self, node,
+                f"{access} of module global `{name}` (guarded-by "
+                f"{_fmt(lock)}) in '{info.name}' without holding "
+                f"it (held: {_fmt_set(held)})")
+
+
+@register
+class LockOrderInversion(ProjectRule):
+    """JX202: cyclic acquired-while-holding order (deadlock)."""
+
+    code = "JX202"
+    name = "lock-order-inversion"
+
+    def check(self, project):
+        model = lock_model(project)
+        summaries = project_summaries(project)
+        edges = {}   # (A, B) -> (ctx, node)
+        for qual, sites in model.acquire_sites.items():
+            info = summaries[qual].info if qual in summaries \
+                else None
+            if info is None:
+                continue
+            entry = model.entry.get(qual, frozenset())
+            for node, lock, held_before in sites:
+                held = entry | held_before
+                if lock in held:
+                    kind = model.locks.get(lock, "lock")
+                    if kind not in _REENTRANT:
+                        yield info.ctx.finding(
+                            self, node,
+                            f"re-acquisition of non-reentrant "
+                            f"Lock {_fmt(lock)} while already "
+                            "holding it: self-deadlock; use an "
+                            "RLock or split the locked region")
+                    continue
+                for prior in held:
+                    edges.setdefault((prior, lock),
+                                     (info.ctx, node))
+        for qual, summary in summaries.items():
+            entry = model.entry.get(qual, frozenset())
+            node_locks = model.node_locks.get(qual, {})
+            for node, targets, _cond in summary.calls:
+                held = entry | node_locks.get(id(node),
+                                              frozenset())
+                if not held:
+                    continue
+                for target in targets:
+                    acq = model.acquires_trans.get(
+                        target.qualname, set())
+                    for lock in acq - held:
+                        for prior in held:
+                            edges.setdefault(
+                                (prior, lock),
+                                (summary.info.ctx, node))
+        yield from self._report_cycles(edges)
+
+    def _report_cycles(self, edges):
+        adj = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reaches(src, dst):
+            seen = set()
+            stack = [src]
+            while stack:
+                cur = stack.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                stack.extend(adj.get(cur, ()))
+            return False
+
+        reported = set()
+        for (a, b), (ctx, node) in sorted(
+                edges.items(),
+                key=lambda kv: (kv[1][0].relpath,
+                                kv[1][1].lineno)):
+            if not reaches(b, a):
+                continue
+            pair = frozenset((a, b))
+            if pair in reported:
+                continue
+            reported.add(pair)
+            yield ctx.finding(
+                self, node,
+                f"lock order inversion: {_fmt(b)} is acquired "
+                f"while holding {_fmt(a)} here, but elsewhere "
+                f"{_fmt(a)} is (transitively) acquired while "
+                f"holding {_fmt(b)} — a potential deadlock; pick "
+                "ONE acquisition order and enforce it")
+
+
+@register
+class BlockingCallUnderLock(ProjectRule):
+    """JX203: blocking call made while holding a lock."""
+
+    code = "JX203"
+    name = "blocking-call-under-lock"
+
+    def check(self, project):
+        model = lock_model(project)
+        summaries = project_summaries(project)
+        for qual, summary in summaries.items():
+            if summary.info.module not in model.locked_modules:
+                continue
+            ctx = summary.info.ctx
+            entry = model.entry.get(qual, frozenset())
+            node_locks = model.node_locks.get(qual, {})
+            for node, _targets, _cond in summary.calls:
+                held = entry | node_locks.get(id(node),
+                                              frozenset())
+                if not held:
+                    continue
+                label = self._blocking(model, summary.info, ctx,
+                                       node, held)
+                if label is None:
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    f"blocking call `{label}` while holding "
+                    f"{_fmt_set(held)}: every other thread "
+                    "contending for the lock stalls behind this "
+                    "I/O/wait; move it outside the locked region "
+                    "or document via the baseline why the lock "
+                    "must cover it")
+
+    @staticmethod
+    def _blocking(model, info, ctx, node, held):
+        target = ctx.resolve(node.func) or ""
+        if target in _BLOCKING_CALLS:
+            return _BLOCKING_CALLS[target]
+        if (isinstance(node.func, ast.Name)
+                and node.func.id == "open"
+                and node.func.id not in ctx.aliases):
+            return "open(...)"
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        method = node.func.attr
+        if method not in _BLOCKING_METHODS:
+            return None
+        receiver = node.func.value
+        if method in ("wait", "wait_for"):
+            lock = _with_locks(model, info, receiver)
+            if lock is not None and lock in held:
+                return None  # waiting the held condition: the idiom
+        if method == "join" and isinstance(
+                receiver, (ast.Constant, ast.JoinedStr)):
+            return None  # str.join, not thread join
+        return f".{method}()"
+
+
+@register
+class RequiresLockViolation(ProjectRule):
+    """JX204: call site missing a callee's requires-lock."""
+
+    code = "JX204"
+    name = "requires-lock-violation"
+
+    def check(self, project):
+        model = lock_model(project)
+        if not model.requires:
+            return
+        summaries = project_summaries(project)
+        for qual, summary in summaries.items():
+            ctx = summary.info.ctx
+            for node, targets, _cond in summary.calls:
+                if len(targets) != 1:
+                    continue
+                required = model.requires.get(
+                    targets[0].qualname)
+                if not required:
+                    continue
+                held = _held_at(model, qual, node)
+                missing = required - held
+                if not missing:
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    f"call to '{targets[0].name}' which declares "
+                    f"`# requires-lock: "
+                    f"{_fmt_set(missing)}` without holding it "
+                    f"(held: {_fmt_set(held)})")
+
+
+@register
+class UnknownLockAnnotation(ProjectRule):
+    """JX205: guarded-by/requires-lock names an unknown lock."""
+
+    code = "JX205"
+    name = "unknown-lock-annotation"
+
+    def check(self, project):
+        model = lock_model(project)
+        for ctx, lineno, message in model.ann_errors:
+            if ctx is None:
+                continue
+            yield ctx.finding(self, lineno, message)
+
+
+LOCK_RULES = [UnguardedAttribute, LockOrderInversion,
+              BlockingCallUnderLock, RequiresLockViolation,
+              UnknownLockAnnotation]

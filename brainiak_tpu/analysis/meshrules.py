@@ -1,0 +1,260 @@
+"""Mesh / collective axis rules JX101-JX103.
+
+A wrong axis name in a ``psum``/``ppermute`` is a silent-wrong-answer
+bug (the collective reduces over the wrong device group — or raises
+only at run time on a pod you do not have in CI).  These rules build
+one project-wide **axis model**:
+
+- **declared mesh axes** — string literals (and constants resolving
+  to strings, e.g. ``DEFAULT_VOXEL_AXIS``) in ``make_mesh(...)`` /
+  ``jax.make_mesh(...)`` / ``jax.sharding.Mesh(...)`` axis-name
+  arguments;
+- **spec axes** — axis names appearing in ``shard_map`` /
+  ``shard_vmap`` ``in_specs``/``out_specs``/``axis_names`` (and the
+  ``axis_name=`` kwarg of sharded helpers);
+- **shard-map scope** — the functions passed as ``shard_map`` bodies
+  plus everything they (transitively) call or reference, and inline
+  lambda bodies.
+
+Checks (all skip when the needed fact is statically unresolvable —
+they flag only provable mismatches):
+
+- **JX101** — a collective whose resolved axis name is not a
+  declared mesh/spec axis anywhere in the project;
+- **JX102** — a collective issued outside any shard-map scope (it
+  would raise ``NameError: unbound axis`` at trace time, or worse,
+  silently run unpartitioned under eager evaluation);
+- **JX103** — a ``PartitionSpec`` axis literal no mesh declares.
+"""
+
+import ast
+
+from .core import ProjectRule, register
+from .summaries import project_summaries
+
+__all__ = ["UndeclaredCollectiveAxis", "CollectiveOutsideShardMap",
+           "UndeclaredPartitionAxis", "MESH_RULES"]
+
+_MESH_CALLS = {"make_mesh", "subject_voxel_mesh"}
+_SHARD_CALLS = {"shard_map", "shard_vmap"}
+
+
+class AxisModel:
+    """Project-wide mesh/axis facts shared by JX101-JX103."""
+
+    def __init__(self):
+        self.mesh_axes = set()
+        self.spec_axes = set()
+        self.spec_sites = []     # (ctx, node, axis string)
+        self.scope = set()       # qualnames inside shard-map scope
+        self.inline_bodies = set()   # id() of lambda body nodes
+
+
+def _collect_axis_strings(project, ctx, node, enclosing):
+    """Every axis-name string statically visible in an expression:
+    plain literals, resolvable constants, and the arguments of
+    ``PartitionSpec(...)`` calls.  Partial results are fine here —
+    this feeds the DECLARED set, where missing an unresolvable name
+    only makes the checks more conservative."""
+    out = set()
+    if node is None:
+        return out
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) \
+                and isinstance(sub.value, str):
+            out.add(sub.value)
+        elif isinstance(sub, (ast.Name, ast.Attribute)):
+            vals = project.literal_strings(ctx, sub, enclosing)
+            if vals:
+                out |= vals
+    return out
+
+
+def build_axis_model(project):
+    summaries = project_summaries(project)
+    model = AxisModel()
+    seeds = set()
+    for ctx in project.contexts.values():
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve(node.func) or ""
+            short = target.rsplit(".", 1)[-1]
+            enclosing = project.enclosing_function(ctx, node)
+            if short in _MESH_CALLS or target == "jax.make_mesh":
+                arg = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        arg = kw.value
+                if arg is None and node.args:
+                    arg = (node.args[1]
+                           if target == "jax.make_mesh"
+                           and len(node.args) > 1
+                           else node.args[0])
+                vals = project.literal_strings(ctx, arg, enclosing)
+                if vals is None:
+                    vals = _collect_axis_strings(project, ctx, arg,
+                                                 enclosing)
+                model.mesh_axes |= vals
+            elif short == "Mesh" and (
+                    target in ("Mesh", "jax.sharding.Mesh")
+                    or target.endswith(".sharding.Mesh")):
+                arg = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        arg = kw.value
+                if arg is None and len(node.args) > 1:
+                    arg = node.args[1]
+                model.mesh_axes |= _collect_axis_strings(
+                    project, ctx, arg, enclosing)
+            elif short in _SHARD_CALLS:
+                self_args = list(node.args)
+                for kw in node.keywords:
+                    if kw.arg in ("in_specs", "out_specs",
+                                  "axis_names", "axis_name"):
+                        model.spec_axes |= _collect_axis_strings(
+                            project, ctx, kw.value, enclosing)
+                # positional layout: shard_map(f, mesh, in_specs,
+                # out_specs) / shard_vmap(f, mesh, axis_name, n)
+                for arg in self_args[2:4]:
+                    model.spec_axes |= _collect_axis_strings(
+                        project, ctx, arg, enclosing)
+                if self_args:
+                    body = self_args[0]
+                    if isinstance(body, ast.Lambda):
+                        for sub in ast.walk(body):
+                            model.inline_bodies.add(id(sub))
+                    else:
+                        for info in project.resolve_callable(
+                                ctx, body, enclosing):
+                            seeds.add(info.qualname)
+            elif short == "PartitionSpec":
+                for arg in list(node.args) + [
+                        kw.value for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Constant) \
+                                and isinstance(sub.value, str):
+                            model.spec_sites.append(
+                                (ctx, sub, sub.value))
+                            model.spec_axes.add(sub.value)
+    # transitive shard-map scope over calls AND bare references
+    # (bodies are often handed to lax.scan / partial, not called)
+    work = list(seeds)
+    model.scope = set(seeds)
+    while work:
+        qual = work.pop()
+        summary = summaries.get(qual)
+        if summary is None:
+            continue
+        nexts = {t.qualname for _, targets, _ in summary.calls
+                 for t in targets}
+        nexts |= summary.refs
+        for item in nexts:
+            if item not in model.scope:
+                model.scope.add(item)
+                work.append(item)
+    return model
+
+
+def axis_model(project):
+    return project.cache("axis_model", build_axis_model)
+
+
+def _in_shard_scope(project, model, ctx, node):
+    info = project.enclosing_function(ctx, node)
+    while info is not None:
+        if info.qualname in model.scope:
+            return True
+        info = info.parent
+    cur = node
+    while cur is not None:
+        if id(cur) in model.inline_bodies:
+            return True
+        cur = ctx.parent(cur)
+    return False
+
+
+@register
+class UndeclaredCollectiveAxis(ProjectRule):
+    """JX101: collective over an axis no mesh/spec declares."""
+
+    code = "JX101"
+    name = "undeclared-collective-axis"
+
+    def check(self, project):
+        model = axis_model(project)
+        declared = model.mesh_axes | model.spec_axes
+        if not declared:
+            return  # nothing declared anywhere: cannot verify
+        summaries = project_summaries(project)
+        for summary in summaries.values():
+            ctx = summary.info.ctx
+            for node, op, axis_node in summary.collectives:
+                vals = project.literal_strings(
+                    ctx, axis_node, summary.info)
+                if not vals:
+                    continue  # statically unresolvable: skip
+                missing = sorted(v for v in vals
+                                 if v not in declared)
+                if missing:
+                    yield ctx.finding(
+                        self, node,
+                        f"jax.lax.{op} over axis "
+                        f"{', '.join(repr(m) for m in missing)}: "
+                        "no mesh or shard_map spec in the project "
+                        "declares that axis (declared: "
+                        f"{', '.join(sorted(declared))}) — a wrong "
+                        "axis name reduces over the wrong device "
+                        "group")
+
+
+@register
+class CollectiveOutsideShardMap(ProjectRule):
+    """JX102: collective outside any shard_map/shard_vmap scope."""
+
+    code = "JX102"
+    name = "collective-outside-shard-map"
+
+    def check(self, project):
+        model = axis_model(project)
+        summaries = project_summaries(project)
+        for summary in summaries.values():
+            ctx = summary.info.ctx
+            for node, op, _axis in summary.collectives:
+                if _in_shard_scope(project, model, ctx, node):
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    f"jax.lax.{op} outside any shard_map/"
+                    "shard_vmap scope: named-axis collectives "
+                    "need an enclosing manual-sharding region or "
+                    "they fail at trace time (unbound axis); "
+                    "wrap the computation in shard_map or route "
+                    "it through ops.distla")
+
+
+@register
+class UndeclaredPartitionAxis(ProjectRule):
+    """JX103: PartitionSpec axis literal no mesh declares."""
+
+    code = "JX103"
+    name = "undeclared-partition-axis"
+
+    def check(self, project):
+        model = axis_model(project)
+        if not model.mesh_axes:
+            return  # no statically-visible mesh: cannot verify
+        for ctx, node, value in model.spec_sites:
+            if value in model.mesh_axes:
+                continue
+            yield ctx.finding(
+                self, node,
+                f"PartitionSpec axis {value!r}: no mesh in the "
+                "project declares that axis (meshes declare: "
+                f"{', '.join(sorted(model.mesh_axes))}) — "
+                "placement over an undeclared axis raises at "
+                "device_put time on the pod, not in CPU tests")
+
+
+MESH_RULES = [UndeclaredCollectiveAxis, CollectiveOutsideShardMap,
+              UndeclaredPartitionAxis]

@@ -142,6 +142,106 @@ class JitPerCall(FileRule):
                     "the wrapper (functools.lru_cache)")
 
 
+def _local_defs(ctx):
+    defs = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+        elif (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Lambda)):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    defs.setdefault(tgt.id, node.value)
+    return defs
+
+
+def _epochish(header, counting):
+    """Whether a loop header reads as an epoch/chunk hot loop."""
+    if counting:
+        return bool(_CHUNK_RE.search(header))
+    return bool(_EPOCH_RE.search(header))
+
+
+def iter_hot_scopes(ctx, local_defs=None):
+    """Yield ``(walk_nodes, why, scope_node)`` for every hot scope
+    in a file: callees handed to ``run_resilient_loop`` /
+    ``lax.scan`` / ``lax.fori_loop`` / ``lax.while_loop``, Python
+    ``for``-loops and ``while``-loops whose headers name epochs or
+    chunks, and comprehensions whose generators do (the former JX002
+    blind spot).  Shared by JX002 and the interprocedural JX010.
+    """
+    if local_defs is None:
+        local_defs = _local_defs(ctx)
+
+    def resolve_callee(arg):
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            return local_defs.get(arg.id)
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            target = ctx.resolve(node.func) or ""
+            short = target.rsplit(".", 1)[-1]
+            callee_args = ()
+            if short == "run_resilient_loop" and node.args:
+                callee_args = (node.args[0],)
+                why = "the run_resilient_loop chunk body"
+            elif target == "jax.lax.scan" and node.args:
+                callee_args = (node.args[0],)
+                why = "a lax.scan body"
+            elif (target == "jax.lax.fori_loop"
+                    and len(node.args) >= 3):
+                callee_args = (node.args[2],)
+                why = "a lax.fori_loop body"
+            elif (target == "jax.lax.while_loop"
+                    and len(node.args) >= 2):
+                callee_args = node.args[:2]
+                why = "a lax.while_loop cond/body"
+            for arg in callee_args:
+                callee = resolve_callee(arg)
+                if callee is not None:
+                    body = (callee.body
+                            if not isinstance(callee, ast.Lambda)
+                            else [callee.body])
+                    yield body, why, callee
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            header = ast.dump(node.target) + ast.dump(node.iter)
+            counting = (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range")
+            if _epochish(header, counting):
+                why = ("an epoch/chunk-style Python for-loop"
+                       if counting
+                       else "an epoch-style Python for-loop")
+                yield node.body, why, node
+        elif isinstance(node, ast.While):
+            if _EPOCH_RE.search(ast.dump(node.test)):
+                yield node.body, "an epoch-style while-loop", node
+        elif isinstance(node, (ast.ListComp, ast.SetComp,
+                               ast.DictComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                header = ast.dump(gen.target) + ast.dump(gen.iter)
+                counting = (isinstance(gen.iter, ast.Call)
+                            and isinstance(gen.iter.func, ast.Name)
+                            and gen.iter.func.id == "range")
+                if not _epochish(header, counting):
+                    continue
+                parts = ([node.key, node.value]
+                         if isinstance(node, ast.DictComp)
+                         else [node.elt])
+                parts += [i for g in node.generators
+                          for i in g.ifs]
+                # the first generator's iterable evaluates once;
+                # later generators re-evaluate per outer element
+                parts += [g.iter for g in node.generators[1:]]
+                yield (parts,
+                       "an epoch/chunk-style comprehension", node)
+                break
+
+
 @register
 class HostSyncInLoop(FileRule):
     """JX002: host-device sync inside a hot loop body."""
@@ -150,11 +250,9 @@ class HostSyncInLoop(FileRule):
     name = "host-sync-in-loop"
 
     def check(self, ctx):
-        local_defs = self._local_defs(ctx)
+        local_defs = _local_defs(ctx)
         seen = set()
-        for scope, why in self._hot_scopes(ctx, local_defs):
-            body = (scope.body if not isinstance(scope, ast.Lambda)
-                    else [scope.body])
+        for body, why, _scope in iter_hot_scopes(ctx, local_defs):
             for node in _walk_skip_nested(body):
                 hit = self._host_sync(ctx, node)
                 if hit is None or id(node) in seen:
@@ -168,59 +266,7 @@ class HostSyncInLoop(FileRule):
 
     @staticmethod
     def _local_defs(ctx):
-        defs = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef,
-                                 ast.AsyncFunctionDef)):
-                defs.setdefault(node.name, node)
-            elif (isinstance(node, ast.Assign)
-                    and isinstance(node.value, ast.Lambda)):
-                for tgt in node.targets:
-                    if isinstance(tgt, ast.Name):
-                        defs.setdefault(tgt.id, node.value)
-        return defs
-
-    def _hot_scopes(self, ctx, local_defs):
-        def resolve_callee(arg):
-            if isinstance(arg, ast.Lambda):
-                return arg
-            if isinstance(arg, ast.Name):
-                return local_defs.get(arg.id)
-            return None
-
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Call):
-                target = ctx.resolve(node.func) or ""
-                short = target.rsplit(".", 1)[-1]
-                callee_args = ()
-                if short == "run_resilient_loop" and node.args:
-                    callee_args = (node.args[0],)
-                    why = "the run_resilient_loop chunk body"
-                elif target == "jax.lax.scan" and node.args:
-                    callee_args = (node.args[0],)
-                    why = "a lax.scan body"
-                elif (target == "jax.lax.fori_loop"
-                        and len(node.args) >= 3):
-                    callee_args = (node.args[2],)
-                    why = "a lax.fori_loop body"
-                elif (target == "jax.lax.while_loop"
-                        and len(node.args) >= 2):
-                    callee_args = node.args[:2]
-                    why = "a lax.while_loop cond/body"
-                for arg in callee_args:
-                    callee = resolve_callee(arg)
-                    if callee is not None:
-                        yield callee, why
-            elif isinstance(node, (ast.For, ast.AsyncFor)):
-                header = ast.dump(node.target) + ast.dump(node.iter)
-                counting = (isinstance(node.iter, ast.Call)
-                            and isinstance(node.iter.func, ast.Name)
-                            and node.iter.func.id == "range")
-                if counting and _CHUNK_RE.search(header):
-                    yield node, ("an epoch/chunk-style Python "
-                                 "for-loop")
-                elif not counting and _EPOCH_RE.search(header):
-                    yield node, "an epoch-style Python for-loop"
+        return _local_defs(ctx)
 
     @staticmethod
     def _host_sync(ctx, node):

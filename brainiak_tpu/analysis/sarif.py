@@ -1,0 +1,83 @@
+"""SARIF 2.1.0 output for jaxlint and the gate registry.
+
+SARIF (Static Analysis Results Interchange Format) is what CI hosts
+(GitHub code scanning, Azure, Gitea) ingest to render findings as
+inline PR annotations.  :func:`to_sarif` maps the analyzer's
+findings to one minimal-but-valid ``sarif-2.1.0`` log: a single run,
+one ``tool.driver`` rule entry per distinct rule code, one result
+per finding with a physical location (repo-relative URI +
+1-based ``startLine``).
+"""
+
+__all__ = ["SARIF_SCHEMA", "SARIF_VERSION", "to_sarif"]
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+#: Codes that describe CI/gate plumbing failures rather than code
+#: defects map to SARIF level "error"; lint findings are "warning".
+_ERROR_PREFIXES = ("CHK0", "OBS", "REG", "SRV", "DLA", "ENC",
+                   "EXT")
+
+
+def _level(code):
+    return ("error" if code.startswith(_ERROR_PREFIXES)
+            else "warning")
+
+
+def _rule_entry(code, rule_cls):
+    entry = {"id": code, "name": code}
+    if rule_cls is not None:
+        doc = (rule_cls.__doc__ or "").strip().splitlines()
+        entry["name"] = getattr(rule_cls, "name", "") or code
+        if doc:
+            entry["shortDescription"] = {"text": doc[0]}
+    return entry
+
+
+def to_sarif(findings, rules_by_code=None, tool_name="jaxlint",
+             tool_version="2.0"):
+    """One SARIF log dict for ``findings``.
+
+    ``rules_by_code`` maps rule codes to rule classes (for
+    descriptions); codes present only in findings still get a
+    minimal rule entry, so the log is self-contained for any gate.
+    """
+    rules_by_code = dict(rules_by_code or {})
+    codes = sorted({f.code for f in findings}
+                   | set(rules_by_code))
+    driver = {
+        "name": tool_name,
+        "informationUri": ("https://github.com/brainiak/brainiak"
+                           "/blob/master/docs/static_analysis.md"),
+        "version": tool_version,
+        "rules": [_rule_entry(code, rules_by_code.get(code))
+                  for code in codes],
+    }
+    results = []
+    for finding in findings:
+        results.append({
+            "ruleId": finding.code,
+            "level": _level(finding.code),
+            "message": {"text": finding.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": finding.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(finding.line, 1)},
+                },
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": driver},
+            "columnKind": "utf16CodeUnits",
+            "originalUriBaseIds": {"SRCROOT": {"uri": "./"}},
+            "results": results,
+        }],
+    }

@@ -1,0 +1,283 @@
+"""Per-function dataflow summaries over the project call graph.
+
+Each project function gets one :class:`FunctionSummary`: the calls it
+makes (with their resolved project targets), the functions it merely
+*references* (escape analysis for the lock rules), its host-sync
+behavior, whether it constructs an uncached ``jax.jit`` per call,
+which parameters it consumes as PRNG keys, and the collectives it
+issues.  The interprocedural rules (:mod:`.interproc`,
+:mod:`.meshrules`, :mod:`.lockrules`) are thin queries over these.
+
+Transitive host-sync propagation is a **must-execute** analysis: a
+sync only enters a function's summary when it executes on every call
+(not nested under ``if``/``try``/``while``/``except``), and only
+propagates through unconditional, unambiguously-resolved call sites.
+That keeps JX010 actionable — ``obs`` spans whose
+``block_until_ready`` is gated behind ``if enabled()`` do not taint
+every instrumented caller.  Definite device syncs
+(``.block_until_ready``/``.item()``/``jax.device_get``) propagate to
+any depth; ambiguous host conversions (``np.asarray``/``np.array``/
+``float``) count only one call away from the hot loop, where they
+are still clearly attributable.
+"""
+
+import ast
+
+from .graph import body_nodes
+from .rules import _KEY_MGMT
+
+__all__ = ["FunctionSummary", "build_summaries", "project_summaries"]
+
+#: Device syncs that force the host to wait for the device queue no
+#: matter what the operand is.
+DEFINITE_SYNC_CALLS = {
+    "jax.device_get": "jax.device_get",
+    "jax.block_until_ready": "jax.block_until_ready",
+}
+DEFINITE_SYNC_METHODS = {"item", "block_until_ready"}
+
+#: Host conversions that sync IF the operand lives on device — only
+#: propagated one level (see module docstring).
+HOST_CONV_CALLS = {
+    "numpy.asarray": "np.asarray",
+    "numpy.array": "np.array",
+}
+
+#: ``jax.lax`` collectives that take a mesh-axis name.
+COLLECTIVE_OPS = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "psum_scatter", "axis_index",
+}
+
+_CONDITIONAL = (ast.If, ast.IfExp, ast.While, ast.ExceptHandler,
+                ast.Assert, ast.comprehension)
+
+
+class FunctionSummary:
+    """Everything the project rules need to know about one
+    function without re-walking its body."""
+
+    __slots__ = ("info", "calls", "refs", "definite_syncs",
+                 "host_convs", "sync_witness", "builds_jit_line",
+                 "key_params", "collectives")
+
+    def __init__(self, info):
+        self.info = info
+        #: [(call node, (FunctionInfo, ...), conditional)]
+        self.calls = []
+        #: qualnames referenced without being called (escapes)
+        self.refs = set()
+        #: [(node, label, conditional)] — definite device syncs
+        self.definite_syncs = []
+        #: [(node, label, conditional)] — ambiguous host conversions
+        self.host_convs = []
+        #: human-readable witness chain once a definite sync is
+        #: reachable must-execute (None until proven)
+        self.sync_witness = None
+        #: line of an uncached ``jax.jit`` construction, else None
+        self.builds_jit_line = None
+        #: parameter names this function consumes as PRNG keys
+        self.key_params = set()
+        #: [(node, op short name, axis expression or None)]
+        self.collectives = []
+
+
+def _conditional_nodes(fn_node):
+    """ids of nodes that may not execute on a given call (nested
+    anywhere under a conditional construct) — the must-execute
+    filter.  Conservative in the under-reporting direction: ``if``
+    tests and ``try`` bodies count as conditional too."""
+    out = set()
+    stack = [(n, False) for n in fn_node.body]
+    while stack:
+        node, cond = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if cond:
+            out.add(id(node))
+        here = cond or isinstance(node, _CONDITIONAL) \
+            or isinstance(node, ast.Try)
+        for child in ast.iter_child_nodes(node):
+            stack.append((child, here))
+    return out
+
+
+def _direct_sync(ctx, node):
+    """(label, definite) when ``node`` is a host-sync call."""
+    if not isinstance(node, ast.Call):
+        return None
+    target = ctx.resolve(node.func)
+    if target in DEFINITE_SYNC_CALLS:
+        return DEFINITE_SYNC_CALLS[target], True
+    if (isinstance(node.func, ast.Attribute)
+            and node.func.attr in DEFINITE_SYNC_METHODS):
+        return f".{node.func.attr}()", True
+    if target in HOST_CONV_CALLS:
+        return HOST_CONV_CALLS[target], False
+    if (isinstance(node.func, ast.Name)
+            and node.func.id == "float"
+            and node.func.id not in ctx.aliases
+            and len(node.args) == 1
+            and not isinstance(node.args[0], ast.Constant)):
+        return "float(...)", False
+    return None
+
+
+def _is_cached_builder(info):
+    """True when the function (or an enclosing def) carries a known
+    program-cache decorator — its jit construction is memoized."""
+    from .rules import _is_cached
+    return _is_cached(info.ctx, info.node)
+
+
+def _collect(project, info):
+    summary = FunctionSummary(info)
+    ctx = info.ctx
+    conditional = _conditional_nodes(info.node)
+    params = [a.arg for a in (info.node.args.posonlyargs
+                              + info.node.args.args
+                              + info.node.args.kwonlyargs)]
+    call_func_ids = set()
+    for node in body_nodes(info):
+        if isinstance(node, ast.Call):
+            call_func_ids.add(id(node.func))
+            cond = id(node) in conditional
+            hit = _direct_sync(ctx, node)
+            if hit is not None:
+                label, definite = hit
+                bucket = (summary.definite_syncs if definite
+                          else summary.host_convs)
+                bucket.append((node, label, cond))
+            target = ctx.resolve(node.func) or ""
+            short = target.rsplit(".", 1)[-1]
+            if (target.startswith("jax.lax.")
+                    and short in COLLECTIVE_OPS):
+                summary.collectives.append(
+                    (node, short, _axis_arg(node, short)))
+            if target == "jax.jit" \
+                    and not ctx.in_decorator(node) \
+                    and summary.builds_jit_line is None \
+                    and not _is_cached_builder(info):
+                summary.builds_jit_line = node.lineno
+            if (target.startswith("jax.random.")
+                    and short not in _KEY_MGMT
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params):
+                summary.key_params.add(node.args[0].id)
+            targets = tuple(project.resolve_call(ctx, node, info))
+            summary.calls.append((node, targets, cond))
+    # escape analysis: project functions referenced outside a direct
+    # call position (callbacks, thread targets, functools wrappers)
+    for node in body_nodes(info):
+        if id(node) in call_func_ids:
+            continue
+        if isinstance(node, (ast.Name, ast.Attribute)) \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            parent = ctx.parent(node)
+            if isinstance(parent, ast.Attribute):
+                continue  # inner part of a dotted chain
+            for target in project.resolve_callable(ctx, node, info):
+                summary.refs.add(target.qualname)
+    return summary
+
+
+def _axis_arg(node, op):
+    """The axis-name argument expression of a collective call."""
+    for kw in node.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    pos = 0 if op == "axis_index" else 1
+    if len(node.args) > pos:
+        return node.args[pos]
+    return None
+
+
+def _propagate_key_params(summaries, by_qual):
+    """A parameter forwarded (positionally or by name) to a callee's
+    key-consuming parameter is itself key-consuming."""
+    changed = True
+    rounds = 0
+    while changed and rounds < 8:
+        changed = False
+        rounds += 1
+        for summary in summaries:
+            fn = summary.info.node
+            params = [a.arg for a in (fn.args.posonlyargs
+                                      + fn.args.args)]
+            for node, targets, _ in summary.calls:
+                if len(targets) != 1:
+                    continue
+                callee = by_qual.get(targets[0].qualname)
+                if callee is None or not callee.key_params:
+                    continue
+                callee_pos = [
+                    a.arg for a in
+                    (callee.info.node.args.posonlyargs
+                     + callee.info.node.args.args)]
+                skip = 1 if callee_pos[:1] == ["self"] else 0
+                for i, arg in enumerate(node.args):
+                    if not isinstance(arg, ast.Name) \
+                            or arg.id not in params:
+                        continue
+                    if i + skip < len(callee_pos) and \
+                            callee_pos[i + skip] in \
+                            callee.key_params:
+                        if arg.id not in summary.key_params:
+                            summary.key_params.add(arg.id)
+                            changed = True
+                for kw in node.keywords:
+                    if isinstance(kw.value, ast.Name) \
+                            and kw.value.id in params \
+                            and kw.arg in callee.key_params \
+                            and kw.value.id not in \
+                            summary.key_params:
+                        summary.key_params.add(kw.value.id)
+                        changed = True
+
+
+def _propagate_syncs(summaries, by_qual):
+    """Must-execute transitive closure of definite device syncs."""
+    for summary in summaries:
+        for node, label, cond in summary.definite_syncs:
+            if not cond:
+                summary.sync_witness = (
+                    f"{label} at {summary.info.relpath}:"
+                    f"{node.lineno}")
+                break
+    changed = True
+    rounds = 0
+    while changed and rounds < 12:
+        changed = False
+        rounds += 1
+        for summary in summaries:
+            if summary.sync_witness is not None:
+                continue
+            for node, targets, cond in summary.calls:
+                if cond or len(targets) != 1:
+                    continue
+                callee = by_qual.get(targets[0].qualname)
+                if callee is None or callee.sync_witness is None:
+                    continue
+                summary.sync_witness = (
+                    f"{callee.info.name} -> "
+                    f"{callee.sync_witness}")
+                changed = True
+                break
+
+
+def build_summaries(project):
+    """``{qualname: FunctionSummary}`` for every project function."""
+    by_qual = {}
+    for info in project.iter_functions():
+        by_qual[info.qualname] = _collect(project, info)
+    summaries = list(by_qual.values())
+    _propagate_key_params(summaries, by_qual)
+    _propagate_syncs(summaries, by_qual)
+    return by_qual
+
+
+def project_summaries(project):
+    """The per-run memoized summary table."""
+    return project.cache("summaries", build_summaries)

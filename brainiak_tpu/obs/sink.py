@@ -206,8 +206,8 @@ class MemorySink:
     """In-process sink: records accumulate in ``self.records``."""
 
     def __init__(self):
-        self.records = []
         self._lock = threading.Lock()
+        self.records = []   # guarded-by: _lock
 
     def write(self, record):
         with self._lock:
@@ -249,9 +249,9 @@ class JsonlSink:
     def __init__(self, directory, rank=None, max_mb=None):
         self.directory = directory
         self._rank = rank
-        self._fh = None
-        self._open_path = None
         self._lock = threading.Lock()
+        self._fh = None          # guarded-by: _lock
+        self._open_path = None   # guarded-by: _lock
         if max_mb is None:
             env = os.environ.get(OBS_MAX_MB_ENV)
             try:
@@ -262,8 +262,8 @@ class JsonlSink:
                 max_mb = None
         self.max_bytes = None if not max_mb or max_mb <= 0 \
             else int(max_mb * 1024 * 1024)
-        self._written = 0
-        self._truncated = False
+        self._written = 0        # guarded-by: _lock
+        self._truncated = False  # guarded-by: _lock
 
     @property
     def path(self):
@@ -329,10 +329,11 @@ def _json_default(obj):
 
 # -- module-level dispatch --------------------------------------------
 
-_sinks = []
-_env_sink = None
-_env_dir_seen = None
-_env_broken = False  # env sink disabled after a write failure
+_sinks = []          # guarded-by: _lock
+_env_sink = None     # guarded-by: _lock
+_env_dir_seen = None  # guarded-by: _lock
+# env sink disabled after a write failure
+_env_broken = False  # guarded-by: _lock
 _lock = threading.Lock()
 
 

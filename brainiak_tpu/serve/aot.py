@@ -64,6 +64,7 @@ lookup misses with ``reason="unsupported"``).
 import hashlib
 import logging
 import os
+import threading
 
 from ..obs import metrics as obs_metrics
 from ..obs import sink as obs_sink
@@ -155,10 +156,16 @@ class AOTProgramCache:
         self.directory = os.fspath(directory)
         if create:
             os.makedirs(self.directory, exist_ok=True)
-        self._programs = {}  # key -> deserialized jitted callable
-        self._hits = 0
-        self._misses = {}  # reason -> count
-        self._stores = 0
+        # one cache is shared by every engine of the process; the
+        # ledger lock covers only the in-memory tables — disk reads
+        # and deserialization run outside it (a racing double
+        # deserialize is benign, a blocked service tick is not)
+        self._lock = threading.Lock()
+        # key -> deserialized jitted callable
+        self._programs = {}   # guarded-by: _lock
+        self._hits = 0        # guarded-by: _lock
+        self._misses = {}     # guarded-by: _lock
+        self._stores = 0      # guarded-by: _lock
         self.xla_cache_dir = None
         if os.environ.get(XLA_CACHE_ENV, "1") != "0":
             self.xla_cache_dir = self._enable_xla_cache()
@@ -222,7 +229,9 @@ class AOTProgramCache:
     # -- accounting ---------------------------------------------------
 
     def _miss(self, site, reason):
-        self._misses[reason] = self._misses.get(reason, 0) + 1
+        with self._lock:
+            self._misses[reason] = \
+                self._misses.get(reason, 0) + 1
         obs_metrics.counter(
             "serve_aot_miss_total",
             help="AOT program-cache misses by reason").inc(
@@ -233,9 +242,10 @@ class AOTProgramCache:
         """``{"hits", "misses": {reason: n}, "stores"}`` for this
         process — the summary block the service CLI prints and the
         SRV002 gate asserts on."""
-        return {"hits": self._hits,
-                "misses": dict(self._misses),
-                "stores": self._stores}
+        with self._lock:
+            return {"hits": self._hits,
+                    "misses": dict(self._misses),
+                    "stores": self._stores}
 
     # -- lookup -------------------------------------------------------
 
@@ -244,7 +254,8 @@ class AOTProgramCache:
         miss).  A disk hit deserializes once per process; the engine
         memoizes the returned callable per bucket, so each key is
         looked up at most once per engine."""
-        cached = self._programs.get(key)
+        with self._lock:
+            cached = self._programs.get(key)
         if cached is not None:
             return cached
         if not export_available():
@@ -277,10 +288,11 @@ class AOTProgramCache:
                 "falling back to jit", path,
                 type(exc).__name__, exc)
             return self._miss(site, "deserialize_failed")
-        if len(self._programs) >= MAX_RESIDENT_PROGRAMS:
-            self._programs.pop(next(iter(self._programs)))
-        self._programs[key] = prog
-        self._hits += 1
+        with self._lock:
+            if len(self._programs) >= MAX_RESIDENT_PROGRAMS:
+                self._programs.pop(next(iter(self._programs)))
+            self._programs[key] = prog
+            self._hits += 1
         obs_metrics.counter(
             "serve_aot_hit_total",
             help="AOT program-cache hits (compile stall "
@@ -316,7 +328,8 @@ class AOTProgramCache:
             obs_sink.event("aot_store_failed", site=site,
                            error=type(exc).__name__)
             return False
-        self._stores += 1
+        with self._lock:
+            self._stores += 1
         obs_sink.event("aot_store", site=site,
                        bytes=len(blob))
         return True

@@ -44,6 +44,7 @@ victim, its bytes, and the admission that displaced it.
 import dataclasses
 import logging
 import os
+import threading
 import time
 from typing import Any, Optional
 
@@ -170,10 +171,14 @@ class ModelResidency:
         Threaded into every engine, so evict/re-admit cycles and
         process restarts stay compile-free.
 
-    Not thread-safe on its own: the
-    :class:`~brainiak_tpu.serve.service.ServeService` loop is the
-    single caller in the online shape (the same contract as the
-    engine).
+    The registry/LRU bookkeeping is guarded by one reentrant lock
+    (``register()`` is legal from any thread while the service loop
+    runs), but the ENGINES this residency hands out remain
+    single-caller: only the
+    :class:`~brainiak_tpu.serve.service.ServeService` loop may
+    drive them (the same contract as the engine).  The lock is
+    reentrant because admission evicts: ``acquire -> _make_room ->
+    evict`` re-enters.
     """
 
     def __init__(self, budget_bytes=None, policy=None, aot=None):
@@ -190,9 +195,10 @@ class ModelResidency:
             if not isinstance(aot, aot_mod.AOTProgramCache):
                 aot = aot_mod.AOTProgramCache(aot)
         self.aot = aot
-        self._registry = {}   # name -> _Registration
-        self._resident = {}   # name -> ResidentModel
-        self._n_evictions = 0
+        self._lock = threading.RLock()
+        self._registry = {}    # guarded-by: _lock
+        self._resident = {}    # guarded-by: _lock
+        self._n_evictions = 0  # guarded-by: _lock
         #: optional ``fn(name, records)`` called with the error
         #: records of work stranded on an evicted engine — the
         #: service loop installs its delivery path here so evicted
@@ -218,24 +224,29 @@ class ModelResidency:
         if (source is None) == (model is None):
             raise ValueError(
                 "register() takes exactly one of source= / model=")
-        if name in self._registry:
-            raise ValueError(f"model {name!r} already registered")
-        self._registry[name] = _Registration(
-            name=name, source=source, model=model, kind=kind,
-            pinned=bool(pinned))
+        with self._lock:
+            if name in self._registry:
+                raise ValueError(
+                    f"model {name!r} already registered")
+            self._registry[name] = _Registration(
+                name=name, source=source, model=model, kind=kind,
+                pinned=bool(pinned))
         return name
 
     def names(self):
         """Registered model names (resident or not)."""
-        return sorted(self._registry)
+        with self._lock:
+            return sorted(self._registry)
 
     def resident_names(self):
-        return sorted(self._resident)
+        with self._lock:
+            return sorted(self._resident)
 
     def entries(self):
         """The live :class:`ResidentModel` entries, name-sorted."""
-        return [self._resident[name]
-                for name in self.resident_names()]
+        with self._lock:
+            return [self._resident[name]
+                    for name in sorted(self._resident)]
 
     # -- the LRU ------------------------------------------------------
 
@@ -244,35 +255,49 @@ class ModelResidency:
         admitting it first if necessary (the transparent-re-admission
         path).  Raises ``KeyError`` for an unregistered name and
         :class:`AdmissionError` when it cannot fit."""
-        entry = self._resident.get(name)
-        if entry is None:
+        with self._lock:
+            entry = self._resident.get(name)
+            if entry is not None:
+                entry.touch()
+                return entry
             reg = self._registry.get(name)
             if reg is None:
                 raise KeyError(
                     f"model {name!r} is not registered "
-                    f"(known: {', '.join(self.names()) or 'none'})")
-            entry = self._admit(reg)
-        entry.touch()
-        return entry
-
-    def _admit(self, reg):
-        # a size learned on a PRIOR load makes an over-budget model
-        # refuse in O(1): a request stream aimed at an inadmissible
-        # artifact must not re-read it from disk on every route
-        if reg.nbytes is not None and \
-                reg.nbytes > self.budget_bytes:
-            raise AdmissionError(
-                reg.name, reg.nbytes, self.budget_bytes,
-                self.resident_bytes(), self.pinned_bytes())
+                    f"(known: "
+                    f"{', '.join(sorted(self._registry)) or 'none'})")
+            # a size learned on a PRIOR load makes an over-budget
+            # model refuse in O(1): a request stream aimed at an
+            # inadmissible artifact must not re-read it from disk
+            # on every route
+            if reg.nbytes is not None and \
+                    reg.nbytes > self.budget_bytes:
+                raise AdmissionError(
+                    reg.name, reg.nbytes, self.budget_bytes,
+                    self.resident_bytes(), self.pinned_bytes())
+        # artifact I/O and digest hashing run OUTSIDE the lock: a
+        # multi-GB load must not block register()/stats() callers
+        # (same principle as the aot ledger lock); a racing double
+        # load is benign — the re-check below keeps one winner
         model = reg.load()
         nbytes = artifacts.model_nbytes(model)
-        reg.nbytes = nbytes
+        # the digest cannot change between admissions of the same
+        # registration (bit-exact load contract): hash once, not on
+        # every evict/re-admit cycle of a GB artifact
+        digest = reg.digest
+        if self.aot is not None and digest is None:
+            digest = artifacts.model_digest(model)
+        with self._lock:
+            entry = self._resident.get(name)
+            if entry is None:
+                reg.nbytes = nbytes
+                reg.digest = digest
+                entry = self._admit(reg, model, nbytes)
+            entry.touch()
+            return entry
+
+    def _admit(self, reg, model, nbytes):  # requires-lock: _lock
         self._make_room(reg.name, nbytes)
-        # the artifact digest cannot change between admissions of
-        # the same registration (bit-exact load contract): hash
-        # once, not on every evict/re-admit cycle of a GB artifact
-        if self.aot is not None and reg.digest is None:
-            reg.digest = artifacts.model_digest(model)
         engine = InferenceEngine(model, kind=reg.kind,
                                  policy=self.policy, aot=self.aot,
                                  digest=reg.digest)
@@ -286,7 +311,7 @@ class ModelResidency:
         self._gauge()
         return entry
 
-    def _make_room(self, incoming, nbytes):
+    def _make_room(self, incoming, nbytes):  # requires-lock: _lock
         """Evict LRU unpinned residents until ``nbytes`` fits; the
         typed refusal when even that is not enough."""
         if nbytes > self.budget_bytes:
@@ -311,21 +336,25 @@ class ModelResidency:
         Pinned models refuse with ``ValueError``.  Queued work on
         the evicted engine is failed with ``evicted`` records and
         returned (the service loop delivers them)."""
-        entry = self._resident.get(name)
-        if entry is None:
-            raise KeyError(f"model {name!r} is not resident")
-        if entry.pinned:
-            raise ValueError(f"model {name!r} is pinned")
-        entry.engine.fail_pending(
-            "evicted", "model was evicted while the request was "
-                       "queued; resubmit")
-        records = entry.engine.drain()
-        if records and self.on_evict_records is not None:
-            self.on_evict_records(name, records)
-        if self.on_evict is not None:
-            self.on_evict(entry)
-        del self._resident[name]
-        self._n_evictions += 1
+        with self._lock:
+            entry = self._resident.get(name)
+            if entry is None:
+                raise KeyError(f"model {name!r} is not resident")
+            if entry.pinned:
+                raise ValueError(f"model {name!r} is pinned")
+            entry.engine.fail_pending(
+                "evicted", "model was evicted while the request "
+                           "was queued; resubmit")
+            records = entry.engine.drain()
+            if records and self.on_evict_records is not None:
+                self.on_evict_records(name, records)
+            if self.on_evict is not None:
+                self.on_evict(entry)
+            del self._resident[name]
+            self._n_evictions += 1
+            self._gauge()
+        # telemetry outside the lock: sink writes are file I/O and
+        # must not serialize admission on a slow disk
         obs_metrics.counter(
             "serve_evictions_total",
             help="models evicted from residency").inc(model=name)
@@ -334,19 +363,20 @@ class ModelResidency:
                        admissions=entry.admissions)
         logger.info("evicted model %r (%d bytes, %s)", name,
                     entry.nbytes, reason)
-        self._gauge()
         return records
 
     # -- accounting ---------------------------------------------------
 
     def resident_bytes(self):
-        return sum(e.nbytes for e in self._resident.values())
+        with self._lock:
+            return sum(e.nbytes for e in self._resident.values())
 
     def pinned_bytes(self):
-        return sum(e.nbytes for e in self._resident.values()
-                   if e.pinned)
+        with self._lock:
+            return sum(e.nbytes for e in self._resident.values()
+                       if e.pinned)
 
-    def _gauge(self):
+    def _gauge(self):  # requires-lock: _lock
         obs_metrics.gauge(
             "serve_resident_models",
             help="models currently resident").set(
@@ -357,16 +387,17 @@ class ModelResidency:
 
     def stats(self):
         """Occupancy + churn for the service summary."""
-        return {
-            "budget_bytes": self.budget_bytes,
-            "resident_bytes": self.resident_bytes(),
-            "pinned_bytes": self.pinned_bytes(),
-            "n_registered": len(self._registry),
-            "n_resident": len(self._resident),
-            "resident": self.resident_names(),
-            "evictions": self._n_evictions,
-            "admissions": {
-                name: r.admissions
-                for name, r in sorted(self._registry.items())
-                if r.admissions},
-        }
+        with self._lock:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "resident_bytes": self.resident_bytes(),
+                "pinned_bytes": self.pinned_bytes(),
+                "n_registered": len(self._registry),
+                "n_resident": len(self._resident),
+                "resident": self.resident_names(),
+                "evictions": self._n_evictions,
+                "admissions": {
+                    name: r.admissions
+                    for name, r in sorted(self._registry.items())
+                    if r.admissions},
+            }

@@ -142,30 +142,34 @@ class ServeService:
         # serializes engine/residency access between the loop's
         # ticks and caller-thread summary() reads
         self._engine_lock = threading.Lock()
-        self._ingress = collections.deque()
-        self._pending = {}   # (model, engine seq) -> ticket
-        self._state = "idle"
-        self._drain_on_stop = True
-        self._thread = None
-        self._latencies = collections.deque(maxlen=_LATENCY_WINDOW)
-        self._n_submitted = 0
-        self._n_delivered = 0
-        self._n_ok = 0
-        self._errors_by_code = {}
-        self._n_ticks = 0
-        self._n_active_ticks = 0
+        self._ingress = collections.deque()  # guarded-by: _cond
+        self._state = "idle"                 # guarded-by: _cond
+        self._drain_on_stop = True           # guarded-by: _cond
+        self._thread = None                  # guarded-by: _cond
+        self._n_submitted = 0                # guarded-by: _cond
+        # (model, engine seq) -> ticket
+        self._pending = {}           # guarded-by: _engine_lock
+        self._latencies = collections.deque(
+            maxlen=_LATENCY_WINDOW)  # guarded-by: _engine_lock
+        self._n_delivered = 0        # guarded-by: _engine_lock
+        self._n_ok = 0               # guarded-by: _engine_lock
+        self._errors_by_code = {}    # guarded-by: _engine_lock
+        self._n_ticks = 0            # guarded-by: _engine_lock
+        self._n_active_ticks = 0     # guarded-by: _engine_lock
         # dispatched-element stats of engines that were evicted:
         # summary()'s padding waste must cover the WHOLE drive,
         # not just the engines that happen to be resident at read
         # time (re-admission builds a fresh engine with zeroed
         # stats)
-        self._retired_real = 0
-        self._retired_padded = 0
+        self._retired_real = 0       # guarded-by: _engine_lock
+        self._retired_padded = 0     # guarded-by: _engine_lock
         # deliver results stranded on an engine evicted mid-queue
+        # (the residency only runs on the service thread inside the
+        # engine-lock tick, so these callbacks inherit the lock)
         residency.on_evict_records = self._deliver_many
         residency.on_evict = self._accrue_evicted
 
-    def _accrue_evicted(self, entry):
+    def _accrue_evicted(self, entry):  # requires-lock: _engine_lock
         stats = entry.engine._stats
         self._retired_real += stats["real_elements"]
         self._retired_padded += stats["padded_elements"]
@@ -301,14 +305,16 @@ class ServeService:
                 batch = list(self._ingress)
                 self._ingress.clear()
                 stopping = self._state != "running"
+                # read under _cond (its guard): the engine-lock
+                # region below must not touch _cond-guarded state
+                drain = self._drain_on_stop
             with self._engine_lock:
                 self._tick(batch)
                 if stopping:
-                    self._finish(
-                        batch_failed=not self._drain_on_stop)
+                    self._finish(batch_failed=not drain)
                     return
 
-    def _tick(self, batch):
+    def _tick(self, batch):  # requires-lock: _engine_lock
         self._n_ticks += 1
         t0 = time.perf_counter()
         n_records = 0
@@ -346,7 +352,8 @@ class ServeService:
                 help="requests accepted but not yet "
                      "routed").set(0)
 
-    def _route(self, name, request, ticket):
+    def _route(self, name, request,
+               ticket):  # requires-lock: _engine_lock
         """One ingress request into its model's engine; failures
         become typed error records on the ticket, never loop
         crashes.  Returns 1 when the request reached a queue."""
@@ -374,7 +381,8 @@ class ServeService:
         self._pending[(name, request._seq_index)] = ticket
         return 1
 
-    def _fail(self, ticket, request, code, message):
+    def _fail(self, ticket, request, code,
+              message):  # requires-lock: _engine_lock
         latency = None
         if request.submitted is not None:
             latency = time.monotonic() - request.submitted
@@ -384,7 +392,8 @@ class ServeService:
         self._account(rec)
         ticket._resolve(rec)
 
-    def _deliver_many(self, name, records):
+    def _deliver_many(self, name,
+                      records):  # requires-lock: _engine_lock
         for rec in records:
             ticket = self._pending.pop((name, rec.seq), None)
             self._account(rec)
@@ -395,7 +404,7 @@ class ServeService:
                     "record for %r seq %s has no waiting ticket",
                     name, rec.seq)
 
-    def _account(self, rec):
+    def _account(self, rec):  # requires-lock: _engine_lock
         self._n_delivered += 1
         if rec.ok:
             self._n_ok += 1
@@ -406,7 +415,7 @@ class ServeService:
             self._errors_by_code[code] = \
                 self._errors_by_code.get(code, 0) + 1
 
-    def _finish(self, batch_failed):
+    def _finish(self, batch_failed):  # requires-lock: _engine_lock
         """Final phase after stop: drain or fail everything queued
         so every ticket resolves."""
         with self._cond:
@@ -448,6 +457,10 @@ class ServeService:
             return latencies[idx]
 
         models = {}
+        with self._cond:
+            # under its own guard: submit() increments on caller
+            # threads while the engine lock is NOT held
+            n_submitted = self._n_submitted
         with self._engine_lock:
             # under the tick lock: the loop appends to _latencies
             # while delivering, and sorting a mutating deque raises
@@ -463,19 +476,24 @@ class ServeService:
                 real += stats["real_elements"]
                 padded += stats["padded_elements"]
             residency = self.residency.stats()
+            n_delivered = self._n_delivered
+            n_ok = self._n_ok
+            errors_by_code = dict(self._errors_by_code)
+            ticks = self._n_ticks
+            active_ticks = self._n_active_ticks
         out = {
-            "n_submitted": self._n_submitted,
-            "n_delivered": self._n_delivered,
-            "n_ok": self._n_ok,
-            "n_errors": sum(self._errors_by_code.values()),
-            "errors_by_code": dict(self._errors_by_code),
+            "n_submitted": n_submitted,
+            "n_delivered": n_delivered,
+            "n_ok": n_ok,
+            "n_errors": sum(errors_by_code.values()),
+            "errors_by_code": errors_by_code,
             "p50_latency_s": pct(0.50),
             "p99_latency_s": pct(0.99),
             "padding_waste": (1.0 - real / padded) if padded
             else 0.0,
             "retrace_total": serve_retrace_total(),
-            "ticks": self._n_ticks,
-            "active_ticks": self._n_active_ticks,
+            "ticks": ticks,
+            "active_ticks": active_ticks,
             "models": models,
             "residency": residency,
         }

@@ -1,0 +1,666 @@
+"""jaxlint v2 project-rule fixtures (ISSUE 10 acceptance).
+
+Each new rule family is proven on a seeded-bug fixture and its
+known-good twin: a cross-module host-sync in a hot loop (JX010), a
+``psum`` over an undeclared axis name (JX101), and an unguarded
+write to a ``guarded-by`` field in a ``ServeService``-shaped class
+(JX201), plus the satellite rules around them.
+"""
+
+import json
+import textwrap
+
+from brainiak_tpu.analysis.core import analyze_paths
+from brainiak_tpu.analysis.interproc import (
+    CrossFunctionKeyReuse,
+    TransitiveHostSync,
+    TransitiveJitInLoop,
+)
+from brainiak_tpu.analysis.lockrules import (
+    BlockingCallUnderLock,
+    LockOrderInversion,
+    RequiresLockViolation,
+    UnguardedAttribute,
+    UnknownLockAnnotation,
+)
+from brainiak_tpu.analysis.meshrules import (
+    CollectiveOutsideShardMap,
+    UndeclaredCollectiveAxis,
+    UndeclaredPartitionAxis,
+)
+from brainiak_tpu.analysis.sarif import to_sarif
+
+
+def deep_lint(tmp_path, files, rules):
+    for name, src in files.items():
+        path = tmp_path / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    findings, _stale, _n = analyze_paths(
+        [str(tmp_path)], str(tmp_path), rules)
+    assert not any(f.code == "CHK001" for f in findings), findings
+    return findings
+
+
+# -- JX010 transitive host sync --------------------------------------
+
+HELPERS = """
+    import jax
+    import numpy as np
+
+
+    def fetch_scalar(x):
+        return float(np.asarray(x).sum())
+
+
+    def definite(x):
+        return x.block_until_ready()
+
+
+    def guarded(x, debug=False):
+        if debug:
+            return x.block_until_ready()
+        return x
+"""
+
+
+def test_jx010_cross_module_sync_in_hot_loop(tmp_path):
+    """ISSUE 10 acceptance: a helper in ANOTHER module that syncs
+    is flagged at its call site inside the hot loop."""
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/helpers.py": HELPERS,
+        "pkg/train.py": """
+            from .helpers import definite
+
+
+            def fit(step, state, n_iter):
+                for epoch in range(n_iter):
+                    state = step(state)
+                    definite(state)
+                return state
+        """,
+    }, [TransitiveHostSync])
+    assert [f.code for f in findings] == ["JX010"]
+    assert findings[0].path == "pkg/train.py"
+    assert "definite" in findings[0].message
+    assert "block_until_ready" in findings[0].message
+
+
+def test_jx010_host_conv_one_level_and_while_loop(tmp_path):
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/helpers.py": HELPERS,
+        "pkg/train.py": """
+            from .helpers import fetch_scalar
+
+
+            def fit(step, state, n_iter):
+                while n_iter > 0:
+                    state = step(state)
+                    fetch_scalar(state)
+                    n_iter -= 1
+                return state
+        """,
+    }, [TransitiveHostSync])
+    assert [f.code for f in findings] == ["JX010"]
+    assert "while-loop" in findings[0].message
+
+
+def test_jx010_silent_on_conditional_sync_and_cold_code(tmp_path):
+    """Must-execute analysis: a sync behind a debug flag does not
+    taint the helper, and calls outside hot loops never fire."""
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/helpers.py": HELPERS,
+        "pkg/train.py": """
+            from .helpers import definite, guarded
+
+
+            def fit(step, state, n_iter):
+                for epoch in range(n_iter):
+                    state = guarded(step(state))
+                return definite(state)
+        """,
+    }, [TransitiveHostSync])
+    assert findings == []
+
+
+def test_jx010_silent_in_jax_free_module(tmp_path):
+    """np.asarray in a module that never imports jax is host
+    bookkeeping, not a device sync."""
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/hostmath.py": """
+            import numpy as np
+
+
+            def norm(x):
+                return float(np.asarray(x).sum())
+        """,
+        "pkg/train.py": """
+            from .hostmath import norm
+
+
+            def fit(step, state, n_iter):
+                for epoch in range(n_iter):
+                    state = step(state)
+                    norm([1.0])
+                return state
+        """,
+    }, [TransitiveHostSync])
+    assert findings == []
+
+
+# -- JX011 transitive jit-in-loop ------------------------------------
+
+def test_jx011_loop_call_to_jit_builder(tmp_path):
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/builders.py": """
+            import jax
+
+
+            def build(fn):
+                return jax.jit(fn)
+        """,
+        "pkg/drive.py": """
+            from .builders import build
+
+
+            def run(fns, x):
+                out = []
+                for fn in fns:
+                    out.append(build(fn)(x))
+                return out
+        """,
+    }, [TransitiveJitInLoop])
+    assert [f.code for f in findings] == ["JX011"]
+    assert findings[0].path == "pkg/drive.py"
+    assert "build" in findings[0].message
+
+
+def test_jx011_silent_on_cached_builder_and_loopless(tmp_path):
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/builders.py": """
+            import functools
+
+            import jax
+
+
+            @functools.lru_cache(maxsize=None)
+            def cached(n):
+                return jax.jit(lambda a: a + n)
+
+
+            def build(fn):
+                return jax.jit(fn)
+        """,
+        "pkg/drive.py": """
+            from .builders import build, cached
+
+
+            def run(fns, x):
+                prog = build(lambda a: a)
+                return [cached(i)(x) for i in range(3)]
+        """,
+    }, [TransitiveJitInLoop])
+    assert findings == []
+
+
+# -- JX012 cross-function key reuse ----------------------------------
+
+def test_jx012_key_reuse_through_helper(tmp_path):
+    findings = deep_lint(tmp_path, {
+        "mod.py": """
+            import jax
+
+
+            def sample(key, shape):
+                return jax.random.normal(key, shape)
+
+
+            def model(key):
+                a = sample(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """,
+    }, [CrossFunctionKeyReuse])
+    assert [f.code for f in findings] == ["JX012"]
+    assert "sample" in findings[0].message
+
+
+def test_jx012_silent_after_split(tmp_path):
+    findings = deep_lint(tmp_path, {
+        "mod.py": """
+            import jax
+
+
+            def sample(key, shape):
+                return jax.random.normal(key, shape)
+
+
+            def model(key):
+                k1, k2 = jax.random.split(key)
+                a = sample(k1, (3,))
+                b = jax.random.uniform(k2, (3,))
+                return a + b
+        """,
+    }, [CrossFunctionKeyReuse])
+    assert findings == []
+
+
+# -- JX101/JX102/JX103 mesh + collectives ----------------------------
+
+MESHMOD = """
+    import jax
+    from jax.sharding import Mesh, PartitionSpec
+
+    from .compat import shard_map
+
+    AXIS = "voxel"
+
+
+    def build(devs):
+        return Mesh(devs, ("voxel",))
+"""
+
+COMPAT = """
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+"""
+
+
+def test_jx101_psum_over_undeclared_axis(tmp_path):
+    """ISSUE 10 acceptance: a psum over a misspelled axis name is
+    reported with the right rule id."""
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/compat.py": COMPAT,
+        "pkg/meshes.py": MESHMOD,
+        "pkg/ops.py": """
+            import jax
+            from jax.sharding import PartitionSpec
+
+            from .compat import shard_map
+
+
+            def body(x):
+                return jax.lax.psum(x, "voxle")
+
+
+            def run(x, mesh):
+                return shard_map(
+                    body, mesh,
+                    in_specs=PartitionSpec("voxel"),
+                    out_specs=PartitionSpec())(x)
+        """,
+    }, [UndeclaredCollectiveAxis])
+    assert [f.code for f in findings] == ["JX101"]
+    assert "'voxle'" in findings[0].message
+    assert "voxel" in findings[0].message
+
+
+def test_jx101_resolves_constants_and_defaults(tmp_path):
+    """Axis names resolving through module constants and parameter
+    defaults verify clean; unresolvable ones are skipped."""
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/compat.py": COMPAT,
+        "pkg/meshes.py": MESHMOD,
+        "pkg/ops.py": """
+            import jax
+
+            from .compat import shard_map
+            from .meshes import AXIS
+
+
+            def body(x, axis_name=AXIS):
+                opaque = x.aval.named_shape
+                jax.lax.ppermute(x, opaque, [(0, 1)])
+                return jax.lax.psum(x, axis_name)
+
+
+            def run(x, mesh):
+                return shard_map(body, mesh, in_specs=None,
+                                 out_specs=None)(x)
+        """,
+    }, [UndeclaredCollectiveAxis])
+    assert findings == []
+
+
+def test_jx102_collective_outside_shard_map(tmp_path):
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/compat.py": COMPAT,
+        "pkg/meshes.py": MESHMOD,
+        "pkg/loose.py": """
+            import jax
+
+
+            def reduce_all(x):
+                return jax.lax.psum(x, "voxel")
+        """,
+    }, [CollectiveOutsideShardMap])
+    assert [f.code for f in findings] == ["JX102"]
+    assert findings[0].path == "pkg/loose.py"
+
+
+def test_jx102_scope_follows_references(tmp_path):
+    """A body handed to shard_map, and the nested step function it
+    references through lax.scan, are both in scope."""
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/compat.py": COMPAT,
+        "pkg/meshes.py": MESHMOD,
+        "pkg/ring.py": """
+            import jax
+
+            from .compat import shard_map
+
+
+            def body(z):
+                def step(rotating, _):
+                    rotating = jax.lax.ppermute(
+                        rotating, "voxel", [(0, 1)])
+                    return rotating, rotating
+                _, out = jax.lax.scan(step, z, None, length=2)
+                return out
+
+
+            def run(x, mesh):
+                return shard_map(body, mesh, in_specs=None,
+                                 out_specs=None)(x)
+        """,
+    }, [CollectiveOutsideShardMap])
+    assert findings == []
+
+
+def test_jx103_partition_spec_axis_no_mesh_declares(tmp_path):
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/compat.py": COMPAT,
+        "pkg/meshes.py": MESHMOD,
+        "pkg/place.py": """
+            from jax.sharding import PartitionSpec
+
+
+            GOOD = PartitionSpec(None, "voxel")
+            BAD = PartitionSpec("voxl", None)
+        """,
+    }, [UndeclaredPartitionAxis])
+    assert [f.code for f in findings] == ["JX103"]
+    assert "'voxl'" in findings[0].message
+
+
+# -- JX201-JX205 lock discipline -------------------------------------
+
+SERVICE = """
+    import collections
+    import threading
+
+
+    class ServeService:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._engine_lock = threading.Lock()
+            self._ingress = collections.deque()  # guarded-by: _cond
+            self._pending = {}   # guarded-by: _engine_lock
+
+        def submit(self, seq, ticket):
+            with self._cond:
+                self._ingress.append((seq, ticket))
+            self._pending[seq] = ticket
+
+        def _tick(self):  # requires-lock: _engine_lock
+            self._pending.clear()
+
+        def _loop(self):
+            with self._engine_lock:
+                self._tick()
+"""
+
+
+def test_jx201_unguarded_write_in_serve_shaped_class(tmp_path):
+    """ISSUE 10 acceptance: the unguarded ``_pending`` write in a
+    ServeService-shaped fixture is reported as JX201; the
+    requires-lock helper and the locked ingress write are not."""
+    findings = deep_lint(tmp_path, {"service.py": SERVICE},
+                         [UnguardedAttribute])
+    assert [f.code for f in findings] == ["JX201"]
+    assert "_pending" in findings[0].message
+    assert "write" in findings[0].message
+    assert "ServeService._engine_lock" in findings[0].message
+
+
+def test_jx201_entry_lockset_propagates_through_callers(tmp_path):
+    """A helper only ever called under the lock inherits it — no
+    annotation needed (call-site intersection)."""
+    findings = deep_lint(tmp_path, {"mod.py": """
+        import threading
+
+
+        class Sink:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._buf = []   # guarded-by: _lock
+
+            def write(self, rec):
+                with self._lock:
+                    self._push(rec)
+
+            def _push(self, rec):
+                self._buf.append(rec)
+    """}, [UnguardedAttribute])
+    assert findings == []
+
+
+def test_jx201_escaped_callback_loses_lockset(tmp_path):
+    """A method handed out as a callback can be entered from
+    anywhere: its guarded accesses need requires-lock or a with."""
+    findings = deep_lint(tmp_path, {"mod.py": """
+        import threading
+
+
+        class Svc:
+            def __init__(self, residency):
+                self._lock = threading.Lock()
+                self._buf = []   # guarded-by: _lock
+                residency.on_evict = self._deliver
+
+            def _deliver(self, rec):
+                self._buf.append(rec)
+    """}, [UnguardedAttribute])
+    assert [f.code for f in findings] == ["JX201"]
+    assert "_buf" in findings[0].message
+
+
+def test_jx202_lock_order_inversion(tmp_path):
+    findings = deep_lint(tmp_path, {"mod.py": """
+        import threading
+
+
+        class TwoLocks:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """}, [LockOrderInversion])
+    assert [f.code for f in findings] == ["JX202"]
+    assert "inversion" in findings[0].message
+
+
+def test_jx202_multi_item_with_counts_as_nesting(tmp_path):
+    """`with self._a, self._b:` acquires left-to-right — the same
+    order edge as nested with-blocks (review fix: the common
+    single-statement spelling was a blind spot)."""
+    findings = deep_lint(tmp_path, {"mod.py": """
+        import threading
+
+
+        class TwoLocks:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a, self._b:
+                    pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """}, [LockOrderInversion])
+    assert [f.code for f in findings] == ["JX202"]
+
+
+def test_jx202_self_deadlock_on_plain_lock_only(tmp_path):
+    """Re-acquiring a Lock is a self-deadlock; an RLock is not."""
+    findings = deep_lint(tmp_path, {"mod.py": """
+        import threading
+
+
+        class Re:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rlock = threading.RLock()
+
+            def bad(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+
+            def fine(self):
+                with self._rlock:
+                    with self._rlock:
+                        pass
+    """}, [LockOrderInversion])
+    assert [f.code for f in findings] == ["JX202"]
+    assert "re-acquisition" in findings[0].message
+
+
+def test_jx203_blocking_call_under_lock(tmp_path):
+    findings = deep_lint(tmp_path, {"mod.py": """
+        import threading
+        import time
+
+
+        class Busy:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition()
+
+            def slow(self, engine):
+                with self._lock:
+                    engine.poll()
+                    time.sleep(0.1)
+
+            def idiom(self):
+                with self._cond:
+                    self._cond.wait(0.1)
+
+            def strings(self, parts):
+                with self._lock:
+                    return "; ".join(parts)
+    """}, [BlockingCallUnderLock])
+    codes = [f.code for f in findings]
+    assert codes == ["JX203", "JX203"]
+    labels = " ".join(f.message for f in findings)
+    assert ".poll()" in labels and "time.sleep" in labels
+    # waiting the held condition and str.join are NOT blocking
+
+
+def test_jx204_requires_lock_checked_at_call_sites(tmp_path):
+    findings = deep_lint(tmp_path, {"mod.py": """
+        import threading
+
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def helper(self):  # requires-lock: _lock
+                pass
+
+            def good(self):
+                with self._lock:
+                    self.helper()
+
+            def bad(self):
+                self.helper()
+    """}, [RequiresLockViolation])
+    assert [f.code for f in findings] == ["JX204"]
+    assert "helper" in findings[0].message
+
+
+def test_jx205_unknown_lock_annotation(tmp_path):
+    findings = deep_lint(tmp_path, {"mod.py": """
+        import threading
+
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []   # guarded-by: _nope
+    """}, [UnknownLockAnnotation])
+    assert [f.code for f in findings] == ["JX205"]
+    assert "_nope" in findings[0].message
+
+
+# -- SARIF envelope ---------------------------------------------------
+
+def test_sarif_envelope_from_findings(tmp_path):
+    findings = deep_lint(tmp_path, {"service.py": SERVICE},
+                         [UnguardedAttribute])
+    from brainiak_tpu.analysis.lockrules import LOCK_RULES
+    log = to_sarif(findings, {r.code: r for r in LOCK_RULES})
+    blob = json.dumps(log)   # must be JSON-serializable
+    assert json.loads(blob) == log
+    assert log["version"] == "2.1.0"
+    assert log["$schema"].endswith("sarif-schema-2.1.0.json")
+    run = log["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "jaxlint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert "JX201" in rule_ids
+    by_id = {r["id"]: r for r in driver["rules"]}
+    assert by_id["JX201"]["shortDescription"]["text"]
+    result = run["results"][0]
+    assert result["ruleId"] == "JX201"
+    assert result["level"] == "warning"
+    assert result["message"]["text"]
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "service.py"
+    assert loc["region"]["startLine"] == findings[0].line
+
+
+def test_sarif_cli_output(tmp_path, monkeypatch, capsys):
+    from brainiak_tpu.analysis import cli
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import jax\n\n\ndef make(fn):\n    return jax.jit(fn)\n")
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.jaxlint]\nselect = ["JX001"]\ninclude = ["pkg"]\n')
+    monkeypatch.chdir(tmp_path)
+    assert cli.main(["--format", "sarif"]) == 1
+    log = json.loads(capsys.readouterr().out)
+    assert log["version"] == "2.1.0"
+    results = log["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == ["JX001"]
+    uri = results[0]["locations"][0]["physicalLocation"][
+        "artifactLocation"]["uri"]
+    assert uri == "pkg/bad.py"

@@ -133,6 +133,50 @@ def test_jx002_fires_on_fori_loop_lambda(tmp_path):
     assert ".item()" in findings[0].message
 
 
+def test_jx002_fires_in_epoch_while_loop(tmp_path):
+    """Former blind spot (ISSUE 10 satellite): host syncs inside
+    ``while`` loops with epoch-style conditions were not visited."""
+    findings = lint(tmp_path, """
+        import numpy as np
+        def fit(step, state, n_iter):
+            epoch = 0
+            while epoch < n_iter:
+                state = step(state)
+                print(np.asarray(state).sum())
+                epoch += 1
+            return state
+        """, HostSyncInLoop)
+    assert [f.code for f in findings] == ["JX002"]
+    assert "while-loop" in findings[0].message
+
+
+def test_jx002_fires_in_epoch_comprehension(tmp_path):
+    """Former blind spot (ISSUE 10 satellite): comprehension bodies
+    whose generators read as epoch/chunk loops were not visited."""
+    findings = lint(tmp_path, """
+        import numpy as np
+        def fit(step, xs, n_steps):
+            return [np.asarray(step(xs, s))
+                    for s in range(n_steps)]
+        """, HostSyncInLoop)
+    assert [f.code for f in findings] == ["JX002"]
+    assert "comprehension" in findings[0].message
+
+
+def test_jx002_silent_on_non_epoch_while_and_comprehension(
+        tmp_path):
+    findings = lint(tmp_path, """
+        import numpy as np
+        def drain(queue):
+            while queue:
+                item = queue.pop()
+                print(np.asarray(item))
+        def collect(rows):
+            return [np.asarray(r) for r in rows]
+        """, HostSyncInLoop)
+    assert findings == []
+
+
 def test_jx002_silent_on_host_side_code(tmp_path):
     findings = lint(tmp_path, """
         import numpy as np

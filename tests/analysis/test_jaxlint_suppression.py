@@ -76,6 +76,87 @@ def test_syntax_error_reported_as_chk001(tmp_path):
     assert [f.code for f in findings] == ["CHK001"]
 
 
+# -- pragma placement on multi-line statements and decorated defs ----
+#
+# Previously unspecified (ISSUE 10 satellite); the spec is:
+# * a multi-line SIMPLE statement is one logical line — a pragma on
+#   any of its physical lines suppresses a finding anchored to any
+#   other (flake8 noqa semantics);
+# * a function/class header (decorators + def line) is one unit —
+#   a pragma on the decorator line suppresses a def-line finding
+#   and vice versa;
+# * a pragma on an unrelated BODY line does not leak upward.
+
+def test_pragma_on_last_line_of_multiline_statement(tmp_path):
+    findings = _lint(tmp_path, """
+        import jax
+        def make(fn):
+            return jax.jit(
+                fn)  # jaxlint: disable=JX001
+        """)
+    assert findings == []
+
+
+def test_pragma_on_first_line_of_multiline_statement(tmp_path):
+    findings = _lint(tmp_path, """
+        import jax
+        def make(fn):  # noqa will not work here
+            out = jax.jit(  # jaxlint: disable=JX001
+                fn)
+            return out
+        """)
+    assert findings == []
+
+
+def _lock_fixture(deco_comment="", def_comment=""):
+    return f"""
+        import threading
+
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            @property{deco_comment}
+            def thing(self):  # requires-lock: _nope{def_comment}
+                return 1
+        """
+
+
+def test_pragma_on_decorator_line_suppresses_def_finding(tmp_path):
+    from brainiak_tpu.analysis.lockrules import (
+        UnknownLockAnnotation)
+    from brainiak_tpu.analysis.core import analyze_paths
+    path = _write(tmp_path, _lock_fixture(
+        deco_comment="  # jaxlint: disable=JX205"))
+    findings, _, _ = analyze_paths(
+        [str(path)], str(tmp_path), [UnknownLockAnnotation])
+    assert findings == []
+
+
+def test_pragma_on_def_line_suppresses_decorator_finding(tmp_path):
+    from brainiak_tpu.analysis.lockrules import (
+        UnknownLockAnnotation)
+    from brainiak_tpu.analysis.core import analyze_paths
+    path = _write(tmp_path, _lock_fixture(
+        def_comment="  # jaxlint: disable=JX205"))
+    findings, _, _ = analyze_paths(
+        [str(path)], str(tmp_path), [UnknownLockAnnotation])
+    assert findings == []
+
+
+def test_pragma_on_body_line_does_not_leak_to_header(tmp_path):
+    from brainiak_tpu.analysis.lockrules import (
+        UnknownLockAnnotation)
+    from brainiak_tpu.analysis.core import analyze_paths
+    src = _lock_fixture().replace(
+        "return 1", "return 1  # jaxlint: disable=JX205")
+    path = _write(tmp_path, src)
+    findings, _, _ = analyze_paths(
+        [str(path)], str(tmp_path), [UnknownLockAnnotation])
+    assert [f.code for f in findings] == ["JX205"]
+
+
 # -- baseline --------------------------------------------------------
 
 def test_baseline_filters_matching_finding(tmp_path):
@@ -114,6 +195,35 @@ def test_baseline_load_rejects_bad_json(tmp_path):
 def test_baseline_load_missing_file_is_empty(tmp_path):
     baseline = Baseline.load(str(tmp_path / "absent.json"))
     assert baseline.entries == []
+
+
+def test_baseline_sections_flatten_and_require_reasons(tmp_path):
+    """Entries may be grouped under named sections (the tools/bench
+    walk keeps its justifications in its own section); sections are
+    organizational only and flatten into one suppression set."""
+    path = tmp_path / "bl.json"
+    path.write_text(json.dumps({
+        "version": 1,
+        "entries": [],
+        "sections": {"tools-and-bench": [
+            {"rule": "JX001", "path": "mod.py",
+             "snippet": "jax.jit(fn)",
+             "reason": "bench harness builds one program per rep "
+                       "on purpose"}]},
+    }))
+    baseline = Baseline.load(str(path))
+    assert len(baseline.entries) == 1
+    kept, stale = baseline.filter(_lint(tmp_path, BAD))
+    assert len(kept) == 1   # different path: entry is unused
+    assert len(stale) == 1
+    bad = tmp_path / "bad_bl.json"
+    bad.write_text(json.dumps({
+        "version": 1,
+        "sections": {"x": [{"rule": "JX001", "path": "a.py",
+                            "snippet": "s", "reason": " "}]},
+    }))
+    with pytest.raises(BaselineError, match="reason"):
+        Baseline.load(str(bad))
 
 
 # -- [tool.jaxlint] config -------------------------------------------

@@ -31,7 +31,9 @@ def test_static_checks_clean():
 
 
 def test_run_checks_json_output():
-    """--format=json emits one machine-readable object for CI."""
+    """--format=json emits one machine-readable object for CI,
+    including per-gate wall time (ISSUE 10 satellite: gate-runtime
+    creep must be visible as the registry grows)."""
     r = subprocess.run(
         [sys.executable, "-m", "tools.run_checks",
          "--format=json"],
@@ -42,9 +44,17 @@ def test_run_checks_json_output():
     assert payload["findings"] == []
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
-        "jaxlint", "obs", "regress", "serve", "service", "distla",
-        "encoding"}
+        "jaxlint", "jaxlint-deep", "obs", "regress", "serve",
+        "service", "distla", "encoding"}
     assert payload["files"] > 100
+    seconds = payload["gate_seconds"]
+    assert set(seconds) == set(payload["gates"])
+    assert all(isinstance(s, (int, float)) and s >= 0.0
+               for s in seconds.values()), seconds
+    # the analyzer gates (file rules + project-wide deep analysis)
+    # must stay fast enough to run on every test invocation
+    assert seconds["jaxlint"] + seconds["jaxlint-deep"] < 10.0, \
+        seconds
 
 
 def test_jaxlint_gate_standalone():
@@ -58,12 +68,16 @@ def test_jaxlint_gate_standalone():
 
 
 def test_jaxlint_clean_on_live_package():
-    """In-process: every JX finding on the tree is fixed or carries a
+    """In-process: every JX finding on the tree — file rules AND
+    the project-wide deep families — is fixed or carries a
     justified baseline entry, and no baseline entry is stale."""
     from brainiak_tpu.analysis import cli as jaxlint_cli
     from brainiak_tpu.analysis.config import load_config
     config = load_config(
         str(REPO_ROOT), f"{REPO_ROOT}/pyproject.toml")
+    deep = {r.code for r in jaxlint_cli.DEEP_RULES}
+    assert deep & set(config.select), \
+        "pyproject must select the deep rule families"
     findings, stale, n = jaxlint_cli.run(
         config.include_paths(), str(REPO_ROOT), config.select,
         baseline_path=config.baseline_path(),
@@ -71,6 +85,18 @@ def test_jaxlint_clean_on_live_package():
     assert findings == [], [str(f) for f in findings]
     assert stale == [], f"stale baseline entries: {stale}"
     assert n > 50  # the walk actually covered the package
+
+
+def test_jaxlint_deep_gate_standalone():
+    """The jaxlint-deep gate runs the project rules alone over the
+    configured scope and exits clean on the live tree (every
+    JX010/JX1xx/JX2xx finding fixed or justified)."""
+    rc = _load_run_checks()
+    result = rc.run_gates(only=["jaxlint-deep"])
+    assert result["ok"] is True, \
+        [str(f) for f in result["findings"]]
+    assert result["files"] > 50
+    assert "jaxlint-deep" in result["gate_seconds"]
 
 
 def test_gate_registry_selection():

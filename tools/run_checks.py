@@ -16,8 +16,17 @@ doc-defaults   docs/*.md ``name= (default X)`` claims match a
            signature default (CHK101)
 resilient-fits every public iterative fit honors the
            checkpoint_dir/run_resilient_loop contract (CHK102)
-jaxlint    TPU-readiness rules JX001-JX006 over the package,
-           with the [tool.jaxlint] baseline applied
+jaxlint    TPU-readiness file rules JX001-JX006 over the
+           configured scope, with the [tool.jaxlint] baseline
+           applied
+jaxlint-deep project-wide semantic analysis over the same scope:
+           interprocedural dataflow (JX010-JX012 — transitive
+           host syncs in hot loops, jit-per-call through the
+           call graph, cross-function PRNG key reuse),
+           mesh/collective axis checking (JX101-JX103), and the
+           guarded-by lock-discipline race detector for the
+           serve loop (JX201-JX205); same baseline, own section
+           conventions (see docs/static_analysis.md)
 obs        smoke-runs ``python -m brainiak_tpu.obs report
            --format=json`` on tools/obs_fixture.jsonl and
            fails on schema violations (OBS001)
@@ -51,9 +60,12 @@ encoding   smoke-runs the encoding-tier selfcheck
 ``# noqa`` suppresses stdlib/doc findings on a line; jaxlint uses
 ``# jaxlint: disable=JX00N`` plus the justification baseline.  Run
 ``python -m tools.run_checks --only=jaxlint`` for one gate,
-``--format=json`` for machine-readable output; exits non-zero on any
-finding.  ``tests/test_static_checks.py`` wires the full gate into
-the pytest suite.
+``--format=json`` for machine-readable output (including per-gate
+wall time in ``gate_seconds``, so gate-runtime creep is visible as
+the registry grows), ``--format=sarif`` for CI hosts that render
+findings as inline annotations; exits non-zero on any finding.
+``tests/test_static_checks.py`` wires the full gate into the pytest
+suite.
 """
 
 import argparse
@@ -64,20 +76,24 @@ import re
 import shutil
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 from brainiak_tpu.analysis import (  # noqa: E402
-    Baseline, FileRule, Finding, JAXLINT_RULES, analyze_file,
-    iter_python_files, load_config)
-from brainiak_tpu.analysis.core import SKIP_DIRS  # noqa: E402,F401
+    Baseline, FileRule, Finding, JAXLINT_RULES,
+    iter_python_files, load_config, to_sarif)
+from brainiak_tpu.analysis.cli import (  # noqa: E402
+    ALL_RULES, DEEP_RULES)
+from brainiak_tpu.analysis.core import (  # noqa: E402,F401
+    SKIP_DIRS, build_context, run_project_rules)
 
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
-         "jaxlint", "obs", "regress", "serve", "service", "distla",
-         "encoding")
+         "jaxlint", "jaxlint-deep", "obs", "regress", "serve",
+         "service", "distla", "encoding")
 
 
 def python_sources():
@@ -808,12 +824,25 @@ def _in_scope(path, include, prefixes):
         and not rel.startswith(prefixes)
 
 
+def _apply_rules(ctx, rules, findings):
+    """File-rule application over one built context (the CHK001
+    syntax finding is emitted once by the walk, not per group)."""
+    for rule in rules:
+        if rule.needs_tree and ctx.tree is None:
+            continue
+        for finding in rule.check(ctx):
+            if not ctx.suppressed(finding, rule.pragma):
+                findings.append(finding)
+
+
 def run_gates(only=None):
     """Run the selected gates; returns a result dict.
 
     ``only``: iterable of gate names (default: all).  One file walk
-    feeds the stdlib and jaxlint file rules; repo-level gates run
-    after.
+    (and one parse per file) feeds the stdlib file rules, the
+    jaxlint file rules, and the jaxlint-deep project analysis;
+    repo-level gates run after.  Every gate's wall time is recorded
+    in ``gate_seconds``.
     """
     selected = set(only or GATES)
     unknown = selected - set(GATES)
@@ -824,68 +853,112 @@ def run_gates(only=None):
     findings = []
     stale = []
     ran = []
+    gate_seconds = {gate: 0.0 for gate in sorted(selected)}
+
+    def timed(gate, fn, *args):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        gate_seconds[gate] += time.perf_counter() - t0
+        return out
+
     if "external" in selected:
-        ran = run_external(findings)
+        ran = timed("external", run_external, findings)
 
     config = load_config(REPO, os.path.join(REPO, "pyproject.toml"))
-    std_rules = ([LineLength(), UnusedImports()]
-                 if "stdlib" in selected else [])
-    jax_rules = []
-    baseline = None
-    if "jaxlint" in selected:
-        by_code = {r.code: r for r in JAXLINT_RULES}
-        bad = [c for c in config.select if c not in by_code]
+    known = {r.code: r for r in ALL_RULES}
+    deep_codes = {r.code for r in DEEP_RULES}
+    if "jaxlint" in selected or "jaxlint-deep" in selected:
+        bad = [c for c in config.select if c not in known]
         if bad:
             raise SystemExit(
                 "run_checks: unknown jaxlint rule code(s) in "
                 f"[tool.jaxlint] select: {', '.join(bad)} "
-                f"(known: {', '.join(sorted(by_code))})")
-        jax_rules = [by_code[c]() for c in config.select]
+                f"(known: {', '.join(sorted(known))})")
+    std_rules = ([LineLength(), UnusedImports()]
+                 if "stdlib" in selected else [])
+    jax_rules = []
+    deep_rules = []
+    baseline = None
+    if "jaxlint" in selected:
+        jax_rules = [known[c]() for c in config.select
+                     if c not in deep_codes]
+    if "jaxlint-deep" in selected:
+        deep_rules = [known[c]() for c in config.select
+                      if c in deep_codes]
+    if jax_rules or deep_rules:
         bl_path = config.baseline_path()
         if bl_path:
             baseline = Baseline.load(bl_path)
     include, prefixes = _jaxlint_scope(config)
 
     n = 0
-    if std_rules or jax_rules:
+    contexts = {}
+    if std_rules or jax_rules or deep_rules:
+        parse_gate = "stdlib" if std_rules else "jaxlint" \
+            if jax_rules else "jaxlint-deep"
         for path in python_sources():
+            in_scope = _in_scope(path, include, prefixes)
+            if not (std_rules or (in_scope
+                                  and (jax_rules or deep_rules))):
+                continue
             n += 1
-            rules = list(std_rules)
-            if jax_rules and _in_scope(path, include, prefixes):
-                rules += jax_rules
-            findings.extend(analyze_file(path, REPO, rules))
+            ctx = timed(parse_gate, build_context, path, REPO)
+            if ctx.parse_error is not None:
+                exc = ctx.parse_error
+                findings.append(Finding(
+                    ctx.relpath, exc.lineno or 1, "CHK001",
+                    f"syntax error: {exc.msg}",
+                    ctx.src_line(exc.lineno or 1)))
+            if std_rules:
+                timed("stdlib", _apply_rules, ctx, std_rules,
+                      findings)
+            if in_scope:
+                if jax_rules:
+                    timed("jaxlint", _apply_rules, ctx, jax_rules,
+                          findings)
+                if deep_rules:
+                    contexts[ctx.relpath] = ctx
+    if deep_rules:
+        findings.extend(timed("jaxlint-deep", run_project_rules,
+                              contexts, deep_rules))
 
     if "doc-defaults" in selected:
-        check_doc_defaults(findings)
+        timed("doc-defaults", check_doc_defaults, findings)
     if "resilient-fits" in selected:
-        check_resilient_fits(findings)
+        timed("resilient-fits", check_resilient_fits, findings)
     if "obs" in selected:
-        check_obs(findings)
+        timed("obs", check_obs, findings)
     if "regress" in selected:
-        check_regress(findings)
+        timed("regress", check_regress, findings)
     if "serve" in selected:
-        check_serve(findings)
+        timed("serve", check_serve, findings)
     if "service" in selected:
-        check_service(findings)
+        timed("service", check_service, findings)
     if "distla" in selected:
-        check_distla(findings)
+        timed("distla", check_distla, findings)
     if "encoding" in selected:
-        check_encoding(findings)
+        timed("encoding", check_encoding, findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
+        if not {"jaxlint", "jaxlint-deep"} <= selected:
+            # a partial rule run cannot judge staleness: entries
+            # for the unselected family would all look unmatched
+            stale = []
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     label = "+".join(
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
-                       "obs", "regress", "serve", "service",
-                       "distla", "encoding")
+                       "jaxlint-deep", "obs", "regress", "serve",
+                       "service", "distla", "encoding")
            if g in selected])
     return {
         "ok": not findings,
         "label": label or "none",
         "files": n,
         "gates": sorted(selected),
+        "gate_seconds": {g: round(s, 3)
+                         for g, s in gate_seconds.items()},
         "findings": findings,
         "stale_baseline": stale,
     }
@@ -899,7 +972,8 @@ def main(argv=None):
     parser.add_argument(
         "--only", metavar="GATE[,GATE...]",
         help=f"run a subset of gates ({', '.join(GATES)})")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format",
+                        choices=("text", "json", "sarif"),
                         default="text")
     parser.add_argument("--list", action="store_true",
                         help="list gate names and exit")
@@ -911,6 +985,12 @@ def main(argv=None):
     only = ([g.strip() for g in args.only.split(",")]
             if args.only else None)
     result = run_gates(only)
+    if args.format == "sarif":
+        rules_by_code = {r.code: r for r in ALL_RULES}
+        print(json.dumps(to_sarif(
+            result["findings"], rules_by_code,
+            tool_name="run_checks"), indent=2))
+        return 0 if result["ok"] else 1
     if args.format == "json":
         payload = dict(result)
         payload["findings"] = [f.to_dict()
