@@ -23,7 +23,7 @@ responsive chip the north-star whole-brain config is attempted first
 (V=65536 correlation width, E=32 — the BASELINE.json scale), then the
 V=8192 mid config, then a reduced CPU fallback.  Each chip tier runs in
 its own subprocess under a timeout so a tunnel wedge mid-tier cannot
-hang the driver's bench invocation.  Four further tiers print their
+hang the driver's bench invocation.  Five further tiers print their
 own JSON lines after the FCMA record: ``serve`` (batched
 SRM-transform serving), ``service`` (always-on continuous batching,
 ``brainiak_tpu.serve.service`` — steady-state requests/s AND p99
@@ -34,9 +34,12 @@ SUMMA-sharded Gram, ``brainiak_tpu.ops.distla`` — voxels/s of a
 [T, V] -> [V, V] correlation with the voxel axis ring-sharded), and
 ``encoding`` (voxel-wise ridge CV throughput,
 ``brainiak_tpu.encoding`` — voxels×lambdas/s of a full RidgeEncoder
-fit), each split into an on-chip and a ``*_cpu_fallback`` tier so
-``obs regress`` never compares host rounds against on-chip
-baselines.
+fit), and ``kernels`` (the roofline-guided fused kernels —
+single-scan HMM forward-backward TRs/s and fused SUMMA ring step
+GB/s, each record's ``vs_baseline`` being the measured fusion win
+over the unfused reference on the same backend), each split into an
+on-chip and a ``*_cpu_fallback`` tier so ``obs regress`` never
+compares host rounds against on-chip baselines.
 
 Stage breakdown: every tier runs with :mod:`brainiak_tpu.obs` enabled
 on an in-memory sink — ``bench.data_gen`` / ``bench.warm`` (upload +
@@ -87,6 +90,18 @@ SERVICE_REQUESTS = 128
 # records a number.  BENCH_DISTLA_VOXELS overrides either.
 DISTLA_VOXELS = 16384
 DISTLA_CPU_VOXELS = 2048
+
+# kernels tier (roofline-guided fused kernels): fused-vs-unfused
+# throughput of the single-scan HMM forward-backward (TRs/s) and the
+# fused SUMMA ring step (GB/s of Gram bytes produced+consumed) — the
+# vs_baseline of each record IS the fusion win, measured on the same
+# backend in the same process.  BENCH_KERNELS_TRS /
+# BENCH_KERNELS_VOXELS override the workload sizes.
+KERNELS_FB_TRS = 512
+KERNELS_FB_EVENTS = 32
+KERNELS_FB_REPS = 25
+KERNELS_RING_VOXELS = 8192
+KERNELS_RING_CPU_VOXELS = 2048
 
 # encoding tier (voxel-wise ridge, brainiak_tpu.encoding): the
 # on-chip workload is the paper-scale CV sweep (V=8192 voxels,
@@ -309,6 +324,147 @@ def _distla_result_record(out):
     if out.get("stages"):
         rec["stages"] = out["stages"]
     return rec
+
+
+def _kernels_shape():
+    """The kernels tier's workload sizes (env overrides, else
+    backend-scaled defaults) — one reader so the measured workload
+    and the stamped config cannot drift."""
+    import os
+
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    n_trs = int(os.environ.get("BENCH_KERNELS_TRS", KERNELS_FB_TRS))
+    voxels = int(os.environ.get(
+        "BENCH_KERNELS_VOXELS",
+        KERNELS_RING_VOXELS if on_tpu else KERNELS_RING_CPU_VOXELS))
+    return n_trs, voxels
+
+
+def kernels_tier_metrics(n_trs, ring_voxels, n_events=KERNELS_FB_EVENTS,
+                         reps=KERNELS_FB_REPS, seed=0):
+    """The ``kernels`` tier: fused-vs-unfused throughput of two of
+    the PR's fused sites, on whatever backend is ambient.
+
+    - eventseg forward-backward TRs/s: the single-scan fused program
+      (betas never round-trip HBM) vs the two-scan reference, same
+      [T, K] workload, ``reps`` timed dispatches each (every result
+      fetched — fetching synchronizes on this platform).
+    - SUMMA ring step GB/s: the fused rotate-multiply-accumulate
+      ring program vs the unfused stack/transpose/scatter
+      formulation, timed as the DEVICE DISPATCH ALONE — operands are
+      pre-placed and pre-normalized on the mesh, and a scalar fetch
+      synchronizes — so the gated metric tracks the kernel, not the
+      host round-trip the two modes share.  Bytes = the [V, V]
+      output plus both [T, V] operands at fp32.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from brainiak_tpu.eventseg import event as ev
+    from brainiak_tpu.ops import distla
+    from brainiak_tpu.ops.correlation import resolve_precision
+    from brainiak_tpu.parallel import make_mesh, max_divisible_shards
+    from brainiak_tpu.parallel.mesh import place_on_mesh
+
+    with obs.span("bench.data_gen"):
+        rng = np.random.RandomState(seed)
+        es = ev.EventSegment(n_events)
+        log_P, log_p_start, log_p_end = es._build_transitions(n_trs)
+        lp = np.hstack([rng.randn(n_trs, n_events),
+                        np.full((n_trs, 1), -np.inf)])
+        fb_args = (jnp.asarray(lp), jnp.asarray(log_P),
+                   jnp.asarray(log_p_start), jnp.asarray(log_p_end))
+        ring_data = rng.randn(N_TRS, ring_voxels).astype(np.float32)
+        n_shards = max_divisible_shards(ring_voxels)
+        mesh = make_mesh(("voxel",), (n_shards,))
+        # place + z-score ONCE; both ring modes time the same
+        # device-resident operands
+        padded, _ = distla._pad_cols(ring_data, n_shards)
+        z = distla._zscore_cols(place_on_mesh(
+            padded, NamedSharding(mesh,
+                                  PartitionSpec(None, "voxel"))))
+        auto_mode = distla._ring_step_for(N_TRS, padded.shape[1],
+                                          n_shards)
+
+    def ring_program(mode):
+        return distla._summa_program(
+            mesh, ("voxel",), resolve_precision(None),
+            ring_step=mode)
+
+    def time_fb(program):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            np.asarray(program(*fb_args)[0])
+        return n_trs * reps / (time.perf_counter() - t0)
+
+    def time_ring(mode):
+        program = ring_program(mode)
+        t0 = time.perf_counter()
+        out = program(z, z)
+        sync = float(out[0, 0])  # scalar fetch syncs the dispatch
+        dt = time.perf_counter() - t0
+        assert np.isfinite(sync)
+        gbytes = 4.0 * (ring_voxels * ring_voxels
+                        + 2 * N_TRS * ring_voxels) / 1e9
+        return gbytes / dt
+
+    with obs.span("bench.warm"):  # upload + compile, per program
+        for program in (ev._fb_program(), ev._fb_reference_program()):
+            np.asarray(program(*fb_args)[0])
+        for mode in (auto_mode, "unfused"):
+            float(ring_program(mode)(z, z)[0, 0])
+    with obs.span("bench.steady"):
+        fb_fused = time_fb(ev._fb_program())
+        fb_ref = time_fb(ev._fb_reference_program())
+        ring_fused = time_ring(auto_mode)
+        ring_unfused = time_ring("unfused")
+    return {"fb_trs_per_sec": fb_fused,
+            "fb_reference_trs_per_sec": fb_ref,
+            "ring_gb_per_sec": ring_fused,
+            "ring_unfused_gb_per_sec": ring_unfused,
+            "n_trs": n_trs, "n_events": n_events, "reps": reps,
+            "ring_voxels": ring_voxels, "n_shards": n_shards,
+            "backend": jax.default_backend()}
+
+
+def _kernels_result_records(out):
+    """The kernels tier's bench JSON lines — one record per fused
+    site, where ``vs_baseline`` is the measured fusion win
+    (fused rate / unfused-reference rate on the same backend).
+    Tier split mirrors the other tiers (``kernels`` on TPU,
+    ``kernels_cpu_fallback`` otherwise) so ``obs regress --only
+    kernels`` never compares host rounds against on-chip ones."""
+    tier = "kernels" if out.get("backend") == "tpu" \
+        else "kernels_cpu_fallback"
+    commit = _git_commit()
+
+    def rec(metric, value, baseline, unit, config):
+        r = {"schema_version": BENCH_SCHEMA_VERSION,
+             "metric": metric, "value": round(float(value), 3),
+             "unit": unit,
+             "vs_baseline": round(float(value) / baseline, 3)
+             if baseline else 0.0,
+             "tier": tier, "config": config}
+        if commit:
+            r["git_commit"] = commit
+        if out.get("stages"):
+            r["stages"] = out["stages"]
+        return r
+
+    return [
+        rec("kernels_eventseg_fb_trs_per_sec",
+            out["fb_trs_per_sec"], out["fb_reference_trs_per_sec"],
+            "TRs/sec",
+            {"n_trs": out["n_trs"], "n_events": out["n_events"],
+             "reps": out["reps"]}),
+        rec("kernels_summa_ring_gb_per_sec",
+            out["ring_gb_per_sec"], out["ring_unfused_gb_per_sec"],
+            "GB/sec",
+            {"n_voxels": out["ring_voxels"], "n_trs": N_TRS,
+             "n_shards": out["n_shards"]}),
+    ]
 
 
 def _encoding_shape():
@@ -761,6 +917,16 @@ def measure_tier(tier):
                           else "distla_cpu_fallback")
             out["stages"] = _stage_seconds(mem.records)
             return out
+        if tier == "kernels":
+            out = kernels_tier_metrics(*_kernels_shape())
+            # tier split by backend, same rule as every other tier
+            obs.gauge("bench_kernels_fb_trs_per_sec",
+                      unit="TRs/sec").set(
+                          out["fb_trs_per_sec"],
+                          tier="kernels" if out["backend"] == "tpu"
+                          else "kernels_cpu_fallback")
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "encoding":
             out = encoding_tier_metrics(*_encoding_shape())
             # the record's tier is split by backend (an on-chip
@@ -876,6 +1042,7 @@ def main():
     _service_main(responsive)
     _distla_main(responsive)
     _encoding_main(responsive)
+    _kernels_main(responsive)
 
 
 def _aux_tier_main(responsive, tier, record_fn, timeout=420):
@@ -904,6 +1071,13 @@ def _aux_tier_main(responsive, tier, record_fn, timeout=420):
 def _encoding_main(responsive):
     """Encoding tier: voxel-wise ridge CV throughput."""
     _aux_tier_main(responsive, "encoding", _encoding_result_record)
+
+
+def _kernels_main(responsive):
+    """Kernels tier: fused-vs-unfused throughput — two records
+    (eventseg forward-backward TRs/s, SUMMA ring step GB/s), each
+    with the measured fusion win as ``vs_baseline``."""
+    _aux_tier_main(responsive, "kernels", _kernels_result_records)
 
 
 def _distla_main(responsive):

@@ -31,7 +31,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 from ..obs import profile as obs_profile
 from ..ops.optimize import minimize_bounded
-from ..ops.rbf import rbf_factors
+from ..ops.rbf import (rbf_factors, rbf_residual_sum,
+                       rbf_weight_products)
 from ..parallel.mesh import DEFAULT_SUBJECT_AXIS, place_on_mesh
 from ..resilience.guards import (array_digest, check_state,
                                  run_resilient_loop)
@@ -67,17 +68,22 @@ def _batched_subject_step(data, R, vmask, tmask, centers, widths, lower,
             beta_s, sigma_s, scaling_s):
         mask2d = vmask_s[:, None] * tmask_s[None, :]
         x_m = data_s * mask2d
-        F = rbf_factors(R_s, c_s, w_s[:, None]) * vmask_s[:, None]
+        # MTTKRP-style fused contractions (ops.rbf): the masked
+        # factor matrix is reconstructed chunk-by-chunk inside the
+        # FᵀF/FᵀX products and the residual reduction, never
+        # materializing [V, K] per subject per L-BFGS iteration
+        g, b = rbf_weight_products(R_s, c_s, w_s, x_m,
+                                   vmask=vmask_s)
         W = jnp.linalg.solve(
-            F.T @ F + beta_s * jnp.eye(K, dtype=F.dtype), F.T @ x_m)
+            g + beta_s * jnp.eye(K, dtype=g.dtype), b)
         init = jnp.concatenate([c_s.ravel(), w_s])
 
         def objective(params):
             cc = params[:K * n_dim].reshape(K, n_dim)
             ww = params[K * n_dim:]
-            Fc = rbf_factors(R_s, cc, ww[:, None]) * vmask_s[:, None]
-            recon = sigma_s * (x_m - Fc @ W) * mask2d
-            total = _rho_sum(recon ** 2, nlss_loss)
+            total = rbf_residual_sum(R_s, cc, ww, x_m, W, sigma_s,
+                                     vmask=vmask_s, tmask=tmask_s,
+                                     nlss_loss=nlss_loss)
             diff = cc - tmpl_centers
             maha = jnp.einsum('kd,kde,ke->k', diff, tmpl_cov_inv, diff)
             total = total + _rho_sum(scaling_s * maha, nlss_loss)

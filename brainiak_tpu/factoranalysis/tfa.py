@@ -30,7 +30,8 @@ from sklearn.cluster import KMeans
 
 from ..obs import profile as obs_profile
 from ..ops.optimize import minimize_bounded
-from ..ops.rbf import rbf_factors
+from ..ops.rbf import (rbf_factors, rbf_residual_sum,
+                       rbf_weight_products)
 from ..resilience.guards import (array_digest, check_state,
                                  pack_rng_state, run_resilient_loop,
                                  unpack_rng_state)
@@ -44,11 +45,25 @@ __all__ = ["TFA"]
 @partial(jax.jit, static_argnames=("weight_method",))
 def _solve_weights(data, F, weight_method="rr"):
     """W = (FᵀF + beta·I)⁻¹ Fᵀ X (ridge, beta = var(data)) or OLS
-    (reference tfa.py:569-598)."""
+    (reference tfa.py:569-598), from a materialized factor matrix."""
     k = F.shape[1]
     beta = jnp.var(data) if weight_method == "rr" else 0.0
     return jnp.linalg.solve(F.T @ F + beta * jnp.eye(k, dtype=F.dtype),
                             F.T @ data)
+
+
+@partial(jax.jit, static_argnames=("weight_method",))
+def _solve_weights_fused(data, R, centers, widths,
+                         weight_method="rr"):
+    """The same ridge/OLS weight solve with ``FᵀF``/``FᵀX``
+    accumulated by the MTTKRP-style chunked contraction
+    (:func:`~brainiak_tpu.ops.rbf.rbf_weight_products`) — the factor
+    matrix is reconstructed tile-by-tile fused with the products and
+    never materializes at ``[V, K]``."""
+    k = centers.shape[0]
+    beta = jnp.var(data) if weight_method == "rr" else 0.0
+    g, b = rbf_weight_products(R, centers, widths, data)
+    return jnp.linalg.solve(g + beta * jnp.eye(k, dtype=g.dtype), b)
 
 
 def _rho_sum(sq, nlss_loss):
@@ -80,14 +95,18 @@ def _fit_centers_widths(init, lower, upper, R, X, W, data_sigma,
 
     Objective 0.5·Σ rho(r_i²) matching the reference residual stack
     (tfa.py:652-736): data term sigma·(X − F·W), plus per-factor center
-    Mahalanobis and width penalties when a template is present."""
+    Mahalanobis and width penalties when a template is present.  The
+    data term runs the MTTKRP-style fused reconstruction
+    (:func:`~brainiak_tpu.ops.rbf.rbf_residual_sum`): factor tiles are
+    rebuilt chunk-by-chunk inside the reduction, so no ``[V, K]``
+    factor matrix or ``[V, T]`` residual materializes per L-BFGS
+    iteration."""
 
     def objective(params):
         centers = params[:K * n_dim].reshape(K, n_dim)
         widths = params[K * n_dim:]
-        F = rbf_factors(R, centers, widths)
-        recon = data_sigma * (X - F @ W)
-        total = _rho_sum(recon ** 2, nlss_loss)
+        total = rbf_residual_sum(R, centers, widths, X, W,
+                                 data_sigma, nlss_loss=nlss_loss)
         if has_template:
             diff = centers - tmpl_centers
             maha = jnp.einsum('kd,kde,ke->k', diff, tmpl_cov_inv, diff)
@@ -352,10 +371,12 @@ class TFA(BaseEstimator):
         curr_R = R[feature_indices].copy()
         centers = self.get_centers(self.local_prior)
         widths = self.get_widths(self.local_prior)
-        F = np.asarray(rbf_factors(jnp.asarray(curr_R),
-                                   jnp.asarray(centers),
-                                   jnp.asarray(widths)))
-        W = self.get_weights(curr_data, F)
+        # fused MTTKRP weight solve: FᵀF/FᵀX accumulate chunk-wise,
+        # the [V, K] factor matrix never materializes
+        W = np.asarray(_solve_weights_fused(
+            jnp.asarray(curr_data), jnp.asarray(curr_R),
+            jnp.asarray(centers), jnp.asarray(widths),
+            self.weight_method))
         self.local_posterior_, self.total_cost = \
             self._estimate_centers_widths(
                 curr_R, curr_data, W, centers, widths, template_centers,

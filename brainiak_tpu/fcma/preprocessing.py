@@ -5,6 +5,13 @@ reference reads on rank 0 and broadcasts epoch-by-epoch over MPI
 (preprocessing.py:210-229); in the single-controller JAX model every process
 prepares host arrays directly and sharding happens when estimators place
 data on a mesh, so the ``comm`` parameter disappears.
+
+Epoch normalization runs on device
+(:mod:`brainiak_tpu.ops.kernels.epoch_norm`: one jitted z-score
+dispatch per distinct epoch shape, Pallas-tiled on TPU), retiring the
+per-epoch host C++ ``native/epoch_norm`` round-trip that used to sit
+on this ingest path; the NumPy fallback keeps toolchain-less hosts
+working.
 """
 
 import logging
@@ -14,7 +21,7 @@ import numpy as np
 from scipy.stats import zscore
 
 from ..image import mask_images, multimask_images
-from ..native import epoch_zscore
+from ..ops.kernels.epoch_norm import normalize_epochs
 
 logger = logging.getLogger(__name__)
 
@@ -67,11 +74,12 @@ def _separate_epochs(activity_data, epoch_list):
                 r = np.sum(sub_epoch[eid, :])
                 if r > 0:
                     mat = activity_data[sid][:, sub_epoch[eid, :] == 1]
-                    mat = np.ascontiguousarray(mat.T, dtype=np.float32)
-                    # native OpenMP kernel (NumPy fallback inside)
-                    raw_data.append(epoch_zscore(mat))
+                    raw_data.append(np.ascontiguousarray(
+                        mat.T, dtype=np.float32))
                     labels.append(cond)
-    return raw_data, labels
+    # one device dispatch per distinct epoch shape (NumPy fallback
+    # for tiny batches / forced-host operation)
+    return normalize_epochs(raw_data), labels
 
 
 def prepare_fcma_data(images, conditions, mask1, mask2=None,

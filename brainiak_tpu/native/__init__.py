@@ -1,11 +1,18 @@
-"""Native (C++/OpenMP) host-runtime kernels with ctypes bindings.
+"""DEPRECATED native (C++/OpenMP) host-runtime kernels.
 
-The device compute path is JAX/XLA/Pallas; this package covers host-side
-hot loops (data ingest normalization) the way the reference uses
-C++/OpenMP and Cython for its host kernels.  The shared library is
-compiled on demand with the system g++ and cached next to the sources;
-every entry point has a NumPy fallback, so the framework works without a
-toolchain.
+The FCMA ingest path no longer calls these: epoch normalization runs
+on device via :mod:`brainiak_tpu.ops.kernels.epoch_norm` (one jitted
+dispatch per distinct epoch shape, Pallas-tiled on TPU, NumPy
+fallback kept), which retired the last native-extension dependency
+on a hot path.  This package remains as a shim for out-of-tree
+callers — importing it emits a ``DeprecationWarning`` (the same
+retirement protocol ``utils/profiling`` followed in PR 5) — and will
+be removed once downstream code has migrated.
+
+The original behavior is preserved: the shared library is compiled
+on demand with the system g++ and cached next to the sources, and
+every entry point has a NumPy fallback, so the shim works without a
+toolchain too.
 """
 
 import ctypes
@@ -13,8 +20,17 @@ import logging
 import os
 import subprocess
 import sysconfig
+import warnings
 
 import numpy as np
+
+warnings.warn(
+    "brainiak_tpu.native is deprecated: the FCMA ingest path now "
+    "normalizes epochs on device via "
+    "brainiak_tpu.ops.kernels.epoch_norm (normalize_epochs / "
+    "epoch_zscore), which keeps a NumPy fallback for hosts without "
+    "an accelerator; this C++/ctypes shim will be removed",
+    DeprecationWarning, stacklevel=2)
 
 logger = logging.getLogger(__name__)
 

@@ -263,6 +263,27 @@ class ProfiledProgram:
         fields = {"site": self.site, "level": level,
                   "backend": backend, "span": self.span_hint,
                   "estimator": self.estimator_hint}
+        try:
+            self._analyze(level, args, kwargs, fields)
+        except Exception as exc:
+            # a lowering stage that raises outside the per-step
+            # guards (Pallas/Mosaic backends have done this) must
+            # degrade to a marked record, not lose the site
+            logger.debug("cost analysis of %s failed: %s",
+                         self.site, exc)
+            fields.setdefault(
+                "unavailable",
+                f"profile-failed:{type(exc).__name__}")
+        peak = _peak_flops(backend)
+        if peak:
+            fields["peak_flops"] = peak
+        sink.emit(sink.make_record("cost", self.site, **{
+            k: v for k, v in fields.items() if v is not None}))
+        metrics.counter(
+            "cost_profile_total",
+            help="cost records captured per site").inc(site=self.site)
+
+    def _analyze(self, level, args, kwargs, fields):
         lower = getattr(self._fn, "lower", None)
         lowered = None
         if lower is None:
@@ -312,18 +333,18 @@ class ProfiledProgram:
                     ca.get("bytes accessed"))
                 fields["transcendentals"] = _nonneg(
                     ca.get("transcendentals"))
+                if fields["flops"] is None \
+                        and fields["bytes_accessed"] is None:
+                    # Pallas/Mosaic-lowered programs surface a cost
+                    # dict with nothing attributable in it; mark the
+                    # record so the report renders the site with
+                    # span-only timing instead of dropping it
+                    fields.setdefault("unavailable",
+                                      "cost-analysis-empty")
             if compiled is not None:
                 mem = self._memory_fields(compiled)
                 if mem:
                     fields["attrs"] = mem
-        peak = _peak_flops(backend)
-        if peak:
-            fields["peak_flops"] = peak
-        sink.emit(sink.make_record("cost", self.site, **{
-            k: v for k, v in fields.items() if v is not None}))
-        metrics.counter(
-            "cost_profile_total",
-            help="cost records captured per site").inc(site=self.site)
 
     @staticmethod
     def _memory_fields(compiled):

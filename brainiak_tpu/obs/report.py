@@ -200,15 +200,17 @@ def _roofline(cost_rows, span_rows):
     every span would overstate throughput and break the floor
     semantics documented in docs/performance.md.
     """
+    # group on every hinted row (not just those with FLOPs): a
+    # degraded row sharing the join target still makes the span
+    # totals unapportionable, for timing and throughput alike
     joins = {}
     for row in cost_rows:
-        if row.get("span") and row.get("flops"):
+        if row.get("span"):
             key = (row["site"], row["span"], row.get("estimator"))
             joins[key] = joins.get(key, 0) + 1
     for row in cost_rows:
         hint = row.get("span")
-        flops = row.get("flops")
-        if not hint or not flops:
+        if not hint:
             continue
         if joins[(row["site"], hint, row.get("estimator"))] > 1:
             continue
@@ -223,6 +225,15 @@ def _roofline(cost_rows, span_rows):
             count += srow["count"]
             total_s += srow["total_s"]
         if not count or total_s <= 0.0:
+            continue
+        # span-only timing is attached even without a FLOPs figure:
+        # a Pallas-lowered program degrades to an ``unavailable``
+        # cost record, and its site must still render with measured
+        # wall time rather than dropping out of the section
+        row["span_count"] = count
+        row["span_total_s"] = total_s
+        flops = row.get("flops")
+        if not flops:
             continue
         achieved = flops * count / total_s
         row["achieved_flops_per_s"] = achieved
@@ -395,6 +406,12 @@ def render_text(summary):
                     f"roofline={row['roofline_ratio']:.2%}")
             if row.get("unavailable"):
                 parts.append(f"unavailable={row['unavailable']}")
+                if row.get("span_total_s") is not None:
+                    # span-only timing for sites whose cost analysis
+                    # degraded (Pallas-lowered programs)
+                    parts.append(
+                        f"span={row['span_total_s']:.4f}s"
+                        f"/{row['span_count']}x")
             lines.append(f"  {row['site']} "
                          f"[{row.get('level') or '?'}] "
                          + " ".join(parts))

@@ -134,14 +134,25 @@ def _ring_axes(mesh, axis_names):
 
 
 @obs_runtime.counted_cache("distla.summa")
-def _summa_program(mesh, axis_names, precision):
-    """Build (once per mesh/axes/precision) the fused SUMMA ring
+def _summa_program(mesh, axis_names, precision, ring_step="fused"):
+    """Build (once per mesh/axes/precision/step-mode) the SUMMA ring
     program: both operands column-sharded over the flattened ring,
     panels rotated with nearest-neighbor ``ppermute``, output
     row-sharded.  Cache misses count as
     ``retrace_total{site=distla.summa}``; under cost profiling the
     program's first run captures a ``cost`` record joined to
-    ``distla.gram`` span durations by the report CLI."""
+    ``distla.gram`` span durations by the report CLI.
+
+    ``ring_step`` selects the per-rotation implementation (see
+    :mod:`brainiak_tpu.ops.kernels.ring`): ``"fused"`` /
+    ``"pallas"`` land each panel product directly in its final
+    output slice on the scan-carried buffer (one HBM write per
+    element of C); ``"unfused"`` is the original three-stage
+    stack → transpose → scatter formulation, kept as the measured
+    reference for the ``kernels`` bench tier and parity tests.
+    """
+    from .kernels import ring as kring
+
     names, axis, n_shards = _ring_axes(mesh, axis_names)
     prec = resolve_precision(precision)
 
@@ -149,35 +160,75 @@ def _summa_program(mesh, axis_names, precision):
         # z_local stays resident; zb panels visit around the ring
         my_idx = jax.lax.axis_index(axis)
         block_cols = zb_local.shape[1]
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
-        def step(rotating, _):
-            # output block: rows (resident cols) x cols (the panel
-            # currently held)
-            block = jax.lax.dot_general(
-                z_local, rotating, (((0,), (0,)), ((), ())),
-                precision=prec,
-                preferred_element_type=z_local.dtype)
-            # hand the visiting panel to the next device on the ring
-            rotating = jax.lax.ppermute(
-                rotating, axis,
-                [(i, (i + 1) % n_shards) for i in range(n_shards)])
-            return rotating, block
+        if ring_step == "unfused":
+            def step(rotating, _):
+                # output block: rows (resident cols) x cols (the
+                # panel currently held)
+                block = jax.lax.dot_general(
+                    z_local, rotating, (((0,), (0,)), ((), ())),
+                    precision=prec,
+                    preferred_element_type=z_local.dtype)
+                # hand the visiting panel to the next device
+                rotating = jax.lax.ppermute(rotating, axis, perm)
+                return rotating, block
 
-        _, blocks = jax.lax.scan(step, zb_local, None, length=n_shards)
-        # blocks[s] holds out[local, owner] where the owner of the
-        # panel seen at step s is (my_idx - s) mod n_shards
-        owners = (my_idx - jnp.arange(n_shards)) % n_shards
-        out = jnp.zeros((z_local.shape[1], n_shards, block_cols),
-                        dtype=z_local.dtype)
-        out = out.at[:, owners, :].set(
-            jnp.transpose(blocks, (1, 0, 2)))
-        return out.reshape(z_local.shape[1], n_shards * block_cols)
+            _, blocks = jax.lax.scan(step, zb_local, None,
+                                     length=n_shards)
+            # blocks[s] holds out[local, owner] where the owner of
+            # the panel seen at step s is (my_idx - s) mod n_shards
+            owners = (my_idx - jnp.arange(n_shards)) % n_shards
+            out = jnp.zeros((z_local.shape[1], n_shards, block_cols),
+                            dtype=z_local.dtype)
+            out = out.at[:, owners, :].set(
+                jnp.transpose(blocks, (1, 0, 2)))
+            return out.reshape(z_local.shape[1],
+                               n_shards * block_cols)
+
+        def fused_step(carry, s):
+            rotating, out = carry
+            owner = (my_idx - s) % n_shards
+            if ring_step == "pallas":
+                out = kring.ring_mma(out, z_local, rotating, owner,
+                                     n_shards=n_shards,
+                                     precision=prec)
+            else:
+                out = kring.mma_update(out, z_local, rotating,
+                                       owner * block_cols, prec)
+            rotating = jax.lax.ppermute(rotating, axis, perm)
+            return (rotating, out), None
+
+        out0 = jnp.zeros((z_local.shape[1], n_shards * block_cols),
+                         dtype=z_local.dtype)
+        (_, out), _ = jax.lax.scan(
+            fused_step, (zb_local, out0),
+            jnp.arange(n_shards, dtype=jnp.int32))
+        return out
 
     spec = PartitionSpec(None, axis)
     return obs_profile.profile_program(jax.jit(shard_map(
         summa_fn, mesh, in_specs=(spec, spec),
         out_specs=PartitionSpec(axis, None))),
         "distla.summa", span="distla.gram")
+
+
+def _ring_step_for(n_trs, padded_v, n_shards, ring_step=None):
+    """The ring-step mode for one problem extent: the caller's
+    explicit choice (validated — a typo must not silently run a
+    different kernel AND mint a spurious builder-cache key), else
+    :func:`ops.kernels.ring.ring_step_mode` (Pallas on TPU when the
+    per-device tiles fit, jit-fused XLA everywhere else)."""
+    from .kernels import ring as kring
+
+    if ring_step is not None:
+        if ring_step not in kring._MODES:
+            raise ValueError(
+                f"ring_step must be one of {kring._MODES}; got "
+                f"{ring_step!r}")
+        return ring_step
+    local = padded_v // n_shards
+    return kring.ring_step_mode(n_trs, local, local)
 
 
 def _pad_cols(arr, multiple):
@@ -190,7 +241,8 @@ def _pad_cols(arr, multiple):
     return np.pad(np.asarray(arr), widths), pad
 
 
-def summa_matmul(a, mesh, b=None, axis_names=None, precision=None):
+def summa_matmul(a, mesh, b=None, axis_names=None, precision=None,
+                 ring_step=None):
     """``C = aᵀ @ b`` with both operands column-sharded around the
     mesh ring — the raw SUMMA primitive.
 
@@ -202,6 +254,9 @@ def summa_matmul(a, mesh, b=None, axis_names=None, precision=None):
         ring axes (default: ALL mesh axes, flattened row-major — on
         the standard ``('subject', 'voxel')`` mesh the whole device
         grid forms one ring).
+    ring_step : per-rotation implementation override
+        (``"pallas"``/``"fused"``/``"unfused"``; default: auto —
+        see :func:`_ring_step_for`).
     Returns C [V, V] (row-sharded over the ring when V divides it).
     """
     names, _, n_shards = _ring_axes(mesh, axis_names)
@@ -215,13 +270,15 @@ def summa_matmul(a, mesh, b=None, axis_names=None, precision=None):
     za = place_on_mesh(a_p, spec)
     zb = za if b is None else place_on_mesh(_pad_cols(b, n_shards)[0],
                                             spec)
-    out = _summa_program(mesh, names, resolve_precision(precision))(
-        za, zb)
+    mode = _ring_step_for(a.shape[0], a_p.shape[1], n_shards,
+                          ring_step)
+    out = _summa_program(mesh, names, resolve_precision(precision),
+                         ring_step=mode)(za, zb)
     return out[:v, :v] if pad else out
 
 
 def summa_gram(data, mesh, data_b=None, axis_names=None,
-               precision=None, normalize=True):
+               precision=None, normalize=True, ring_step=None):
     """All-pairs Pearson correlation of the columns of ``data``
     (against ``data_b`` when given) computed as a SUMMA ring over the
     mesh — O(V/n) per-device input memory, O(V²/n) output, only
@@ -235,6 +292,10 @@ def summa_gram(data, mesh, data_b=None, axis_names=None,
     ``Xᵀ X`` path (zero pad columns still contribute exact zeros,
     so uneven splits stay exact).  For data small enough to
     replicate, prefer :func:`gram` which dispatches on the budget.
+    ``ring_step`` overrides the per-rotation implementation
+    (``"pallas"``/``"fused"``/``"unfused"``; default auto — the
+    fused rotate-multiply-accumulate step, see
+    :mod:`brainiak_tpu.ops.kernels.ring`).
     """
     names, _, n_shards = _ring_axes(mesh, axis_names)
     v = data.shape[1]
@@ -251,11 +312,14 @@ def summa_gram(data, mesh, data_b=None, axis_names=None,
             PartitionSpec(None, names if len(names) > 1 else names[0]))
         # shard FIRST, z-score after: z-scoring is columnwise, so it
         # runs shard-local and the full array never lands on one chip
-        z = norm(place_on_mesh(_pad_cols(data, n_shards)[0], spec))
+        padded = _pad_cols(data, n_shards)[0]
+        z = norm(place_on_mesh(padded, spec))
         z_b = z if data_b is None else norm(
             place_on_mesh(_pad_cols(data_b, n_shards)[0], spec))
-        out = _summa_program(mesh, names, resolve_precision(precision))(
-            z, z_b)
+        mode = _ring_step_for(data.shape[0], padded.shape[1],
+                              n_shards, ring_step)
+        out = _summa_program(mesh, names, resolve_precision(precision),
+                             ring_step=mode)(z, z_b)
     return out[:v, :v] if v % n_shards else out
 
 

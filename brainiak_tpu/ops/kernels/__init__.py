@@ -1,0 +1,61 @@
+"""Roofline-guided fused kernels for the attributed memory-bound sites.
+
+PR 4 built the attribution (``obs report`` joins XLA cost records with
+span durations into achieved-FLOP/s and roofline ratios per site);
+this package spends those numbers.  Each module fuses one hot site
+the cost records showed to be HBM-bound, following the loop-reorder /
+fusion playbook of the sparse-MTTKRP formulation
+(https://arxiv.org/pdf/1708.08976) and the communication-avoiding
+batch discipline of DrJAX (https://arxiv.org/pdf/2403.07128):
+
+- :mod:`.ring` — the fused rotate-multiply-accumulate SUMMA ring
+  step: each panel product lands directly in its final output slice
+  (Pallas with dynamic block placement on TPU, one jit-fused
+  ``dynamic_update_slice`` per step elsewhere) instead of the
+  stack → transpose → scatter relayout of the unfused ring.
+- :mod:`.epoch_norm` — the device-side FCMA ingest epoch z-score
+  that retires the host C++ ``native/epoch_norm`` round-trip (the
+  last native-extension dependency on a hot path).
+- :mod:`.selfcheck` — the KRN001 CI gate body: fused-vs-reference
+  parity (single-scan HMM forward-backward, fused ring step,
+  MTTKRP factor reconstruction, epoch norm) plus the
+  retrace-stability contract on every fused site.
+
+The single-scan HMM forward-backward lives with its estimator
+(:mod:`brainiak_tpu.eventseg.event`) and the MTTKRP-style factor
+contractions in :mod:`brainiak_tpu.ops.rbf`; this package holds the
+kernels that are not tied to one estimator.  The FCMA
+correlation+Fisher-z fusion that seeded the pattern stays in
+:mod:`brainiak_tpu.ops.pallas_kernels`.
+"""
+
+from .epoch_norm import epoch_zscore, normalize_epochs
+from .selfcheck import selfcheck
+
+__all__ = [
+    "epoch_zscore",
+    "mma_update",
+    "normalize_epochs",
+    "pick_ring_tiles",
+    "ring_mma",
+    "ring_step_mode",
+    "selfcheck",
+]
+
+#: ring.py exports, resolved lazily (PEP 562): ring.py imports
+#: jax + pallas at module scope, and the FCMA ingest path imports
+#: this package — eager re-export would pull the whole jax/pallas
+#: stack into host-only ingest consumers at import time.
+_RING_EXPORTS = ("mma_update", "pick_ring_tiles", "ring_mma",
+                 "ring_step_mode")
+
+
+def __getattr__(name):
+    if name in _RING_EXPORTS or name == "ring":
+        import importlib
+        # importlib, not `from . import ring`: the from-import form
+        # re-enters this __getattr__ through _handle_fromlist
+        ring = importlib.import_module(".ring", __name__)
+        return ring if name == "ring" else getattr(ring, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,236 @@
+"""Device-side FCMA ingest epoch normalization.
+
+Retires the host C++/OpenMP ``native/epoch_norm`` round-trip (the
+last native-extension dependency on a hot path): the per-epoch
+column z-score + ``1/sqrt(T)`` scaling that makes correlation a
+plain matmul now runs as one jitted device program per distinct
+epoch shape — :func:`normalize_epochs` groups a subject's epochs by
+shape and normalizes each group in ONE dispatch, instead of one
+ctypes call per epoch.
+
+Numerics match the native kernel (and its NumPy fallback) exactly:
+population standard deviation, zero output for zero-variance
+columns, and non-finite results mapped to zero
+(``nan_to_num`` semantics — NaN inputs normalize to zero rather
+than poisoning the epoch).
+
+On TPU the z-score runs as a Pallas kernel over voxel tiles (the
+:mod:`~brainiak_tpu.ops.pallas_kernels` VMEM-budget discipline)
+when the extents tile; everywhere else it is plain fused XLA.  The
+NumPy path is kept as the fallback for forced-host operation
+(``BRAINIAK_TPU_EPOCH_NORM=numpy``), tiny batches where dispatch
+overhead dominates, and hosts where the device path fails —
+toolchain-less hosts keep working, now without needing g++ either.
+"""
+
+import logging
+import math
+import os
+
+import numpy as np
+
+from ...obs import profile as obs_profile
+from ...obs import runtime as obs_runtime
+from ...obs import spans as obs_spans
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["EPOCH_NORM_ENV", "epoch_zscore", "normalize_epochs"]
+
+#: Env override: ``numpy`` forces the host fallback, ``device``
+#: forces the device path even for tiny batches.
+EPOCH_NORM_ENV = "BRAINIAK_TPU_EPOCH_NORM"
+
+#: Below this many elements per batch the host path wins (one jit
+#: dispatch costs more than the BLAS-free normalization of a small
+#: epoch group).
+_MIN_DEVICE_ELEMS = 1 << 16
+
+def _vmem_budget_floats():
+    """The shared VMEM budget (``pallas_kernels``'s constant, so a
+    budget retune lands everywhere at once) — imported lazily: this
+    module must not pull jax/pallas in at import time (ingest code
+    imports it before any device work)."""
+    from ..pallas_kernels import _VMEM_BUDGET_FLOATS
+    return _VMEM_BUDGET_FLOATS
+
+
+def _numpy_epoch_zscore(mat):
+    """Host-fallback column z-score (population) + ``1/sqrt(rows)``
+    of one ``[rows, cols]`` epoch; zero-variance columns become
+    zero.  Bit-compatible with the retired native kernel's own NumPy
+    fallback."""
+    rows = mat.shape[0]
+    mean = mat.mean(axis=0)
+    std = mat.std(axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = (mat - mean) / (std * np.sqrt(rows))
+    return np.nan_to_num(out, nan=0.0, posinf=0.0,
+                         neginf=0.0).astype(mat.dtype, copy=False)
+
+
+def _pick_tile_v(n_trs, n_vox):
+    """Voxel tile width for the Pallas path, or 0 when the extents
+    do not tile under the VMEM budget (callers fall back to XLA)."""
+
+    budget = _vmem_budget_floats()
+
+    def used(tv):
+        return 5 * n_trs * tv
+
+    tile_v = min(512, n_vox)
+    while tile_v > 128 and (used(tile_v) > budget
+                            or n_vox % tile_v):
+        tile_v //= 2
+    # tile_v % 128: the lane (last) dimension must stay aligned or
+    # Mosaic rejects the block — same contract as ring.py's
+    # n_block % 128 guard
+    if tile_v >= 128 and tile_v % 128 == 0 and n_vox % tile_v == 0 \
+            and n_trs % 8 == 0 and used(tile_v) <= budget:
+        return tile_v
+    return 0
+
+
+def _zscore_block(x):
+    """Shared normalization body: z-score over the (row) time axis
+    of one ``[..., T, V]`` block, non-finite results zeroed.
+
+    Constant columns are detected EXACTLY (max == min) rather than
+    through a zero-variance test: XLA lowers the mean's division to
+    a multiply-by-reciprocal, so a constant column's residual can be
+    ±1 ulp instead of 0 and would otherwise normalize to ±1/sqrt(T)
+    — the NumPy/native contract is that such columns come out
+    zero."""
+    import jax.numpy as jnp
+    t = x.shape[-2]
+    mean = jnp.mean(x, axis=-2, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=-2, keepdims=True)
+    out = (x - mean) / (jnp.sqrt(var) * math.sqrt(t))
+    constant = jnp.max(x, axis=-2, keepdims=True) == \
+        jnp.min(x, axis=-2, keepdims=True)
+    return jnp.where(constant | ~jnp.isfinite(out), 0.0, out)
+
+
+def _zscore_kernel(x_ref, out_ref):
+    out_ref[...] = _zscore_block(x_ref[...])
+
+
+def _pallas_batch_zscore(batch, tile_v, interpret):
+    import jax
+    from jax.experimental import pallas as pl
+
+    n, t, v = batch.shape
+    return pl.pallas_call(
+        _zscore_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, t, v), batch.dtype),
+        grid_spec=pl.GridSpec(
+            grid=(n, v // tile_v),
+            in_specs=[pl.BlockSpec((1, t, tile_v),
+                                   lambda i, j: (i, 0, j))],
+            out_specs=pl.BlockSpec((1, t, tile_v),
+                                   lambda i, j: (i, 0, j)),
+        ),
+        interpret=interpret,
+    )(batch)
+
+
+@obs_runtime.counted_cache("fcma.epoch_norm")
+def _epoch_norm_program(use_pallas, interpret=False):
+    """Build (once per mode) the jitted batched epoch z-score
+    program for ``[N, T, V]`` stacks.  Cache misses count as
+    ``retrace_total{site=fcma.epoch_norm}``; under cost profiling
+    the program captures a ``cost`` record joined to the
+    ``fcma.epoch_norm`` span."""
+    import jax
+
+    def fn(batch):
+        if use_pallas:
+            tile_v = _pick_tile_v(batch.shape[1], batch.shape[2])
+            if tile_v:
+                return _pallas_batch_zscore(batch, tile_v, interpret)
+        return _zscore_block(batch)
+
+    return obs_profile.profile_program(
+        jax.jit(fn), "fcma.epoch_norm", span="fcma.epoch_norm")
+
+
+def _mode():
+    return os.environ.get(EPOCH_NORM_ENV, "").strip().lower()
+
+
+def _use_pallas():
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def epoch_zscore(mat, interpret=False):
+    """Column z-score (population) + ``1/sqrt(rows)`` scaling of one
+    ``[rows, cols]`` epoch; zero-variance columns become zero.
+
+    Returns a NEW array (the retired native kernel normalized in
+    place; no caller relied on the aliasing).  Small epochs and
+    ``BRAINIAK_TPU_EPOCH_NORM=numpy`` take the host path.
+    """
+    return normalize_epochs([mat], interpret=interpret)[0]
+
+
+def normalize_epochs(mats, interpret=False):
+    """Normalize a list of ``[rows, cols]`` epochs, grouped by shape
+    so each distinct shape costs ONE device dispatch (FCMA datasets
+    are usually uniform-length, so the whole ingest is one program
+    on one stacked batch).  Order is preserved; dtype is preserved.
+
+    The host fallback runs per epoch when forced
+    (``BRAINIAK_TPU_EPOCH_NORM=numpy``), when the batch is too small
+    to amortize a dispatch, or when the device path fails.
+    """
+    mats = list(mats)
+    if not mats:
+        return []
+    mode = _mode()
+    out = [None] * len(mats)
+    groups = {}
+    for i, mat in enumerate(mats):
+        groups.setdefault(np.shape(mat), []).append(i)
+    for shape, idxs in groups.items():
+        # size from the shape alone — the stacked copy is only built
+        # once a group is committed to the device path
+        group_elems = len(idxs) * int(np.prod(shape))
+        if mode == "numpy" or (mode != "device"
+                               and group_elems < _MIN_DEVICE_ELEMS):
+            for i in idxs:
+                out[i] = _numpy_epoch_zscore(np.asarray(mats[i]))
+            continue
+        try:
+            import jax.numpy as jnp
+            batch = np.stack([np.asarray(mats[i]) for i in idxs])
+            dev = jnp.asarray(batch)
+            if dev.dtype != batch.dtype:
+                # the backend would silently downcast (float64 in,
+                # x64 off): the dtype-preservation contract wins —
+                # take the exact host path for this group
+                for i in idxs:
+                    out[i] = _numpy_epoch_zscore(np.asarray(mats[i]))
+                continue
+            program = _epoch_norm_program(_use_pallas(),
+                                          interpret=interpret)
+            with obs_spans.span("fcma.epoch_norm",
+                                attrs={"n_epochs": len(idxs),
+                                       "n_trs": int(shape[0]),
+                                       "n_voxels": int(shape[1])}):
+                # the fetch is the point: ingest hands host arrays
+                # to downstream estimator constructors
+                res = np.asarray(  # jaxlint: disable=JX002
+                    program(dev))
+        except Exception as exc:  # device path unusable -> host
+            logger.info("device epoch norm unavailable (%s); using "
+                        "NumPy fallback", exc)
+            for i in idxs:
+                out[i] = _numpy_epoch_zscore(np.asarray(mats[i]))
+            continue
+        for j, i in enumerate(idxs):
+            out[i] = res[j]
+    return out

@@ -1,0 +1,178 @@
+"""Fused rotate-multiply-accumulate step for the SUMMA ring.
+
+The unfused ring step in :mod:`brainiak_tpu.ops.distla` is three
+HBM-bound stages per rotation: the panel matmul writes its block to
+the scan's stacked output, the post-scan transpose re-lays the whole
+``[n_shards, V/n, B]`` stack out again, and the owner scatter copies
+it a third time into the final ``[V/n, V]`` buffer.  The cost records
+(`obs report`, site ``distla.summa``) put the site well under the
+roofline with bytes-accessed dominated by exactly those relayouts.
+
+Fused form: the output buffer is carried through the scan and each
+step's panel product lands **directly** in its final column slice —
+one write per element of C, no stack, no transpose, no scatter.  Two
+implementations, selected by :func:`ring_step_mode`:
+
+- ``"pallas"`` (TPU, when the working set fits the VMEM budget): a
+  Pallas kernel tiles the local panel product on the MXU and uses a
+  scalar-prefetched owner index to place each output tile at its
+  dynamic column block (``PrefetchScalarGridSpec`` — the index map
+  reads the owner before the kernel body runs, so the DMA writes the
+  final location).  The carried output aliases the kernel output
+  (``input_output_aliases``), so untouched blocks are never copied.
+- ``"fused"`` (everywhere else, and the TPU fallback): one
+  ``lax.dynamic_update_slice`` per step on the donated scan carry —
+  XLA fuses the dot into the in-place update.
+
+``"unfused"`` requests the original three-stage formulation; it is
+kept as the measured reference for the ``kernels`` bench tier and
+the parity tests, never auto-selected.  ``BRAINIAK_TPU_RING_STEP``
+overrides the mode for experiments.
+
+VMEM discipline follows :mod:`brainiak_tpu.ops.pallas_kernels`: tile
+sizes are derived from a float budget under the 16 MB scoped-VMEM
+limit, and callers fall back to the XLA path when the extents cannot
+tile (:func:`pick_ring_tiles` returns ``fits=False``).
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["RING_STEP_ENV", "mma_update", "pick_ring_tiles",
+           "ring_mma", "ring_step_mode"]
+
+#: Env override for the ring-step implementation
+#: (``pallas`` / ``fused`` / ``unfused``).
+RING_STEP_ENV = "BRAINIAK_TPU_RING_STEP"
+
+#: VMEM budget per program, in floats — shared with the FCMA
+#: kernels (double-buffered I/O tiles under the 16 MB scoped-VMEM
+#: limit) so a budget retune lands everywhere at once.
+from ..pallas_kernels import _VMEM_BUDGET_FLOATS  # noqa: E402
+
+_MODES = ("pallas", "fused", "unfused")
+
+
+def pick_ring_tiles(n_trs, n_local, n_block):
+    """Choose ``(tile_r, fits)`` for the Pallas ring step.
+
+    Each program holds the rotating panel ``[T, B]``, one resident
+    column tile ``[T, tile_r]``, and one output tile
+    ``[tile_r, B]`` (double-buffered I/O).  ``fits`` is False when
+    even the smallest Mosaic-alignable tile exceeds the budget or
+    the extents cannot tile (callers take the XLA path then):
+    ``tile_r`` must divide ``n_local`` and — as the last axis of the
+    resident-operand block — stay a multiple of 128.
+    """
+
+    def used(tr):
+        return 2 * n_trs * (n_block + tr) + 2 * tr * n_block
+
+    tile_r = min(512, n_local)
+    while tile_r > 128 and (used(tile_r) > _VMEM_BUDGET_FLOATS
+                            or n_local % tile_r):
+        tile_r //= 2
+    fits = (tile_r >= 128 and n_local % tile_r == 0
+            and n_block % 128 == 0 and n_trs % 8 == 0
+            and used(tile_r) <= _VMEM_BUDGET_FLOATS)
+    return tile_r, fits
+
+
+def ring_step_mode(n_trs, n_local, n_block, backend=None):
+    """The ring-step implementation for one (T, V/n, B) extent:
+    ``"pallas"`` on TPU when :func:`pick_ring_tiles` fits, else
+    ``"fused"``.  ``BRAINIAK_TPU_RING_STEP`` overrides (unknown
+    values are ignored)."""
+    env = os.environ.get(RING_STEP_ENV, "").strip().lower()
+    if env in _MODES:
+        return env
+    if backend is None:
+        try:
+            backend = jax.default_backend()
+        except Exception:  # pragma: no cover - backend init failure
+            backend = "cpu"
+    if backend == "tpu" and pick_ring_tiles(n_trs, n_local,
+                                            n_block)[1]:
+        return "pallas"
+    return "fused"
+
+
+def _mma_kernel(owner_ref, z_ref, rot_ref, out_in_ref, out_ref, *,
+                precision):
+    """One ``[tile_r, B]`` output tile: resident-columns x rotating
+    panel on the MXU, written straight to its owner column block
+    (the index maps already placed this tile; nothing else moves)."""
+    del owner_ref, out_in_ref  # consumed by the index maps / aliasing
+    out_ref[...] = jax.lax.dot_general(
+        z_ref[...], rot_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=out_ref.dtype, precision=precision)
+
+
+def ring_mma(out, z_local, rotating, owner, *, n_shards, tile_r=None,
+             precision=None, interpret=False):
+    """Fused multiply-place for one ring step (Pallas).
+
+    out : [V_local, n_shards * B] carried output buffer
+    z_local : [T, V_local] resident columns
+    rotating : [T, B] the panel currently held
+    owner : traced int32 — which column *block* of ``out`` this panel
+        owns (the scalar-prefetch argument the output index map
+        reads).
+
+    Returns ``out`` with block ``owner`` overwritten by
+    ``z_localᵀ @ rotating``; every other block is aliased through
+    untouched.
+    """
+    n_trs, n_local = z_local.shape
+    n_block = rotating.shape[1]
+    if tile_r is None:
+        tile_r, fits = pick_ring_tiles(n_trs, n_local, n_block)
+        if not fits and not interpret:
+            raise ValueError(
+                f"ring extents (T={n_trs}, V/n={n_local}, B={n_block})"
+                " do not tile for the Pallas ring step; use the "
+                "'fused' XLA mode")
+        tile_r = min(tile_r, n_local)
+    assert n_local % tile_r == 0, \
+        "V_local must be a multiple of tile_r"
+    if precision is None:
+        precision = jax.lax.Precision.HIGHEST
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_local // tile_r,),
+        in_specs=[
+            pl.BlockSpec((n_trs, tile_r), lambda i, o: (0, i)),
+            pl.BlockSpec((n_trs, n_block), lambda i, o: (0, 0)),
+            pl.BlockSpec((tile_r, n_block), lambda i, o: (i, o[0])),
+        ],
+        out_specs=pl.BlockSpec((tile_r, n_block),
+                               lambda i, o: (i, o[0])),
+    )
+    return pl.pallas_call(
+        functools.partial(_mma_kernel, precision=precision),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_local, n_shards * n_block), out.dtype),
+        grid_spec=grid_spec,
+        input_output_aliases={3: 0},
+        interpret=interpret,
+    )(jnp.asarray(owner, jnp.int32).reshape(1), z_local, rotating,
+      out)
+
+
+def mma_update(out, z_local, rotating, col_start, precision=None):
+    """Fused multiply-place for one ring step (XLA fallback): the
+    panel product written in place at its final column offset on the
+    donated scan carry — XLA fuses the dot into the update, so each
+    element of C is written exactly once."""
+    block = jax.lax.dot_general(
+        z_local, rotating, (((0,), (0,)), ((), ())),
+        precision=precision, preferred_element_type=out.dtype)
+    # both indices pinned to one dtype: the literal 0 would otherwise
+    # weak-type to int64 under x64 while the traced offset is int32
+    return jax.lax.dynamic_update_slice(
+        out, block, (jnp.int32(0), jnp.asarray(col_start, jnp.int32)))

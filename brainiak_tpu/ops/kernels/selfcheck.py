@@ -1,0 +1,177 @@
+"""CI selfcheck for the fused kernels (``kernels`` gate, KRN001).
+
+Parity of every fused path against its unfused reference, plus the
+retrace-stability contract, on a tiny fixture over the 8-device CPU
+mesh the gate child pins:
+
+- single-scan HMM forward-backward vs the two-scan reference
+  (including the masked-log edge case where an event column is
+  entirely ``-inf`` — the -inf/NaN masks must agree exactly);
+- fused rotate-multiply-accumulate SUMMA ring step vs the unfused
+  three-stage formulation and a NumPy dense Gram (even and uneven
+  splits, NaN-column propagation);
+- MTTKRP-style factor reconstruction (matmul-decomposed
+  :func:`~brainiak_tpu.ops.rbf.rbf_factors`, chunked
+  ``FᵀF``/``FᵀX`` products) vs the naive broadcast einsum;
+- device-side epoch z-score vs the NumPy fallback.
+
+Everything runs TWICE; the second pass must add zero program-builder
+cache misses on any fused site (``retrace_total{site=...}`` stays
+flat), which the verdict reports as ``retraces[site] == 1``.
+Prints a JSON verdict; returns 0 on pass, 1 on failure.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+__all__ = ["selfcheck"]
+
+#: Fused sites whose builder caches must be stable across the two
+#: passes.
+_SITES = ("eventseg.forward_backward", "distla.summa",
+          "fcma.epoch_norm")
+
+
+def _fb_diff(a, b):
+    """Max abs difference of two log-domain arrays where mutual
+    ``-inf``/NaN entries count as equal, plus a mask-mismatch flag
+    (a fused path must not invent or lose zero-probability
+    states)."""
+    a, b = np.asarray(a), np.asarray(b)
+    mismatch = bool(np.any(np.isneginf(a) != np.isneginf(b))
+                    or np.any(np.isnan(a) != np.isnan(b)))
+    same = np.isneginf(a) & np.isneginf(b)
+    with np.errstate(invalid="ignore"):
+        d = np.abs(a - b)
+    d[same | np.isnan(a) | np.isnan(b)] = 0.0
+    return float(np.max(d)) if d.size else 0.0, mismatch
+
+
+def _run_once(mesh, errs, flags):
+    import jax.numpy as jnp
+
+    from ...eventseg import event as ev
+    from .. import distla, rbf
+    from . import epoch_norm, ring
+
+    rng = np.random.RandomState(0)
+
+    # -- single-scan HMM forward-backward vs two-scan reference ----
+    t, k = 48, 6
+    es = ev.EventSegment(k)
+    log_P, log_p_start, log_p_end = es._build_transitions(t)
+    lp = np.hstack([rng.randn(t, k), np.full((t, 1), -np.inf)])
+    args = (jnp.asarray(log_P), jnp.asarray(log_p_start),
+            jnp.asarray(log_p_end))
+    for case in (lp, np.where(np.arange(k + 1) == 2, -np.inf, lp)):
+        g1, l1 = ev._fb_program()(jnp.asarray(case), *args)
+        g2, l2 = ev._fb_reference_program()(jnp.asarray(case), *args)
+        d, mism = _fb_diff(g1, g2)
+        errs.append(d)
+        flags.append(("fb_mask", mism))
+        ld, lmism = _fb_diff(np.asarray([l1]), np.asarray([l2]))
+        errs.append(ld)
+        flags.append(("fb_ll_mask", lmism))
+
+    # -- fused SUMMA ring step -------------------------------------
+    t2, v = 16, 64
+    n = mesh.devices.size
+    data = rng.randn(t2, v).astype(np.float32)
+    z = (data - data.mean(0)) / (data.std(0) * np.sqrt(t2))
+    dense = z.T @ z
+    fused = np.asarray(distla.summa_gram(data, mesh,
+                                         ring_step="fused"))
+    unfused = np.asarray(distla.summa_gram(data, mesh,
+                                           ring_step="unfused"))
+    errs.append(float(np.max(np.abs(fused - dense))))
+    errs.append(float(np.max(np.abs(fused - unfused))))
+    got_u = np.asarray(distla.summa_gram(data[:, :v - n + 1], mesh,
+                                         ring_step="fused"))
+    errs.append(float(np.max(np.abs(
+        got_u - dense[:v - n + 1, :v - n + 1]))))
+    nan_data = data.copy()
+    nan_data[:, 3] = np.nan
+    got_n = np.asarray(distla.summa_gram(nan_data, mesh,
+                                         ring_step="fused"))
+    flags.append(("ring_nan",
+                  not (np.all(np.isnan(got_n[3]))
+                       and np.all(np.isnan(got_n[:, 3]))
+                       and np.isnan(got_n).sum() == 2 * v - 1)))
+    # the Pallas step body itself, interpreter-mode, vs the XLA step
+    out0 = jnp.zeros((8, 4 * 16), jnp.float32)
+    zl = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+    rot = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    via_pallas = np.asarray(ring.ring_mma(
+        out0, zl, rot, 2, n_shards=4, tile_r=8, interpret=True))
+    via_xla = np.asarray(ring.mma_update(out0, zl, rot, 2 * 16))
+    errs.append(float(np.max(np.abs(via_pallas - via_xla))))
+
+    # -- MTTKRP factor reconstruction ------------------------------
+    vv, kk, dd, tt = 300, 4, 3, 20
+    R = rng.randn(vv, dd)
+    C = rng.randn(kk, dd)
+    W = np.abs(rng.rand(kk, 1)) + 1.0
+    X = rng.randn(vv, tt)
+    naive = np.exp(
+        -np.einsum('vkd->vk',
+                   (R[:, None, :] - C[None]) ** 2) / W.T)
+    got_f = np.asarray(rbf.rbf_factors(
+        jnp.asarray(R), jnp.asarray(C), jnp.asarray(W)))
+    errs.append(float(np.max(np.abs(got_f - naive))))
+    g, b = rbf.rbf_weight_products(
+        jnp.asarray(R), jnp.asarray(C), jnp.asarray(W),
+        jnp.asarray(X), chunk=128)
+    errs.append(float(np.max(np.abs(np.asarray(g)
+                                    - naive.T @ naive))))
+    errs.append(float(np.max(np.abs(np.asarray(b) - naive.T @ X))))
+
+    # -- device epoch norm vs NumPy fallback -----------------------
+    mats = [rng.randn(30, 25).astype(np.float32) for _ in range(3)]
+    mats[1][:, 4] = 1.5  # constant column -> exact zeros
+    import os
+    prev = os.environ.get(epoch_norm.EPOCH_NORM_ENV)
+    os.environ[epoch_norm.EPOCH_NORM_ENV] = "device"
+    try:
+        dev = epoch_norm.normalize_epochs(mats)
+    finally:
+        if prev is None:
+            os.environ.pop(epoch_norm.EPOCH_NORM_ENV, None)
+        else:
+            os.environ[epoch_norm.EPOCH_NORM_ENV] = prev
+    for mat, got in zip(mats, dev):
+        ref = epoch_norm._numpy_epoch_zscore(mat)
+        errs.append(float(np.max(np.abs(got - ref))))
+
+
+def selfcheck(out=None):
+    """Run the fused-kernel parity suite twice and print the KRN001
+    JSON verdict (``ok``/``max_err``/``tol``/``retraces``/
+    ``n_shards``); returns 0 on pass, 1 on failure."""
+    from ...obs import metrics as obs_metrics
+    from ...parallel.mesh import (DEFAULT_VOXEL_AXIS, make_mesh,
+                                  max_divisible_shards)
+
+    stream = out or sys.stdout
+    n = max_divisible_shards(64)
+    mesh = make_mesh((DEFAULT_VOXEL_AXIS,), (n,))
+    errs, flags = [], []
+    _run_once(mesh, errs, flags)
+    retrace = obs_metrics.counter("retrace_total")
+    before = {site: retrace.value(site=site) for site in _SITES}
+    _run_once(mesh, errs, flags)
+    # 1 = stable (the second pass rebuilt nothing); >1 = the excess
+    # builder misses the repeat pass added
+    retraces = {site: 1.0 + retrace.value(site=site) - before[site]
+                for site in _SITES}
+    bad_flags = sorted({name for name, bad in flags if bad})
+    tol = 5e-4
+    ok = (max(errs) < tol and not bad_flags
+          and all(count <= 1.0 for count in retraces.values())
+          and all(before[site] > 0 for site in _SITES))
+    json.dump({"ok": bool(ok), "max_err": max(errs), "tol": tol,
+               "n_shards": int(n), "mask_mismatch": bad_flags,
+               "retraces": retraces}, stream)
+    stream.write("\n")
+    return 0 if ok else 1

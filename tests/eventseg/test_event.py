@@ -234,3 +234,77 @@ def test_fused_fit_matches_host_loop():
     assert np.allclose(fused.event_pat_, host.event_pat_, rtol=1e-6)
     assert np.isclose(fused.event_var_, host.event_var_)
     assert np.allclose(fused.segments_[0], host.segments_[0], atol=1e-6)
+
+
+def _fb_args(t, k, seed=0):
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    es = EventSegment(k)
+    log_P, log_p_start, log_p_end = es._build_transitions(t)
+    lp = np.hstack([rng.randn(t, k), np.full((t, 1), -np.inf)])
+    return (jnp.asarray(lp), jnp.asarray(log_P),
+            jnp.asarray(log_p_start), jnp.asarray(log_p_end))
+
+
+def _fb_compare(args):
+    """(max diff treating mutual -inf/NaN as equal, mask mismatch)"""
+    from brainiak_tpu.eventseg import event as ev
+    g1, l1 = ev._fb_program()(*args)
+    g2, l2 = ev._fb_reference_program()(*args)
+    a, b = np.asarray(g1), np.asarray(g2)
+    mismatch = (np.any(np.isneginf(a) != np.isneginf(b))
+                or np.any(np.isnan(a) != np.isnan(b)))
+    same = np.isneginf(a) & np.isneginf(b)
+    with np.errstate(invalid="ignore"):
+        d = np.abs(a - b)
+    d[same | np.isnan(a)] = 0.0
+    ll_ok = (float(l1) == float(l2)
+             or np.isclose(float(l1), float(l2), rtol=1e-10))
+    return float(np.max(d)), bool(mismatch), ll_ok
+
+
+def test_fused_forward_backward_matches_two_scan_reference():
+    """ISSUE 11 tentpole: the single-scan fused forward-backward
+    (betas never materialized) reproduces the two-scan reference —
+    gammas, lls, and -inf masks — across shapes."""
+    for t, k in [(40, 5), (7, 2), (200, 16)]:
+        d, mismatch, ll_ok = _fb_compare(_fb_args(t, k))
+        assert d < 1e-9 and not mismatch and ll_ok, (t, k)
+
+
+def test_fused_forward_backward_masked_log_edges():
+    """Masked-log edge cases: an event column entirely -inf (an
+    impossible state) and a huge-negative spike row yield identical
+    gammas / NaN masks / lls on both paths."""
+    import jax.numpy as jnp
+    args = _fb_args(30, 4)
+    lp = np.asarray(args[0])
+    cases = [
+        np.where(np.arange(5) == 1, -np.inf, lp),   # impossible event
+        np.vstack([lp[:3], np.full((1, 5), -1e30), lp[4:]]),
+    ]
+    for case in cases:
+        d, mismatch, ll_ok = _fb_compare(
+            (jnp.asarray(case),) + args[1:])
+        assert d < 1e-9 and not mismatch and ll_ok
+
+
+def test_fused_sites_retrace_at_most_once():
+    """Repeat fused fits/find_events add no program-builder cache
+    misses (retrace_total{site=eventseg.*} <= 1 — ISSUE 11
+    acceptance)."""
+    from brainiak_tpu.obs import metrics as obs_metrics
+
+    rng = np.random.RandomState(0)
+    d = rng.rand(25, 6)
+    es = EventSegment(3, n_iter=5).fit(d)
+    es.find_events(d)
+    retrace = obs_metrics.counter("retrace_total")
+    before = {site: retrace.value(site=site)
+              for site in ("eventseg.forward_backward",
+                           "eventseg.fit_chunk")}
+    assert before["eventseg.fit_chunk"] >= 1
+    EventSegment(3, n_iter=5).fit(d)
+    es.find_events(d)
+    for site, count in before.items():
+        assert retrace.value(site=site) == count, site

@@ -122,3 +122,27 @@ def test_map_offset_and_packing():
     widths = np.array([[1.0], [2.0], [3.0]])
     tfa.set_widths(est, widths)
     assert np.allclose(tfa.get_widths(est), widths)
+
+
+def test_fused_weight_solve_matches_materialized_factor_solve():
+    """ISSUE 11: the MTTKRP-style fused weight solve (chunked
+    FᵀF/FᵀX, no materialized F) reproduces the reference solve
+    through a materialized factor matrix, for both weight
+    methods."""
+    import jax.numpy as jnp
+
+    from brainiak_tpu.factoranalysis.tfa import (_solve_weights,
+                                                 _solve_weights_fused)
+    from brainiak_tpu.ops.rbf import rbf_factors
+
+    X, R, centers, widths = make_rbf_data()
+    for method in ("rr", "ols"):
+        F = np.asarray(rbf_factors(jnp.asarray(R),
+                                   jnp.asarray(centers),
+                                   jnp.asarray(widths)))
+        ref = np.asarray(_solve_weights(jnp.asarray(X),
+                                        jnp.asarray(F), method))
+        got = np.asarray(_solve_weights_fused(
+            jnp.asarray(X), jnp.asarray(R), jnp.asarray(centers),
+            jnp.asarray(widths), method))
+        assert np.allclose(got, ref, atol=1e-6), method

@@ -184,3 +184,32 @@ def test_service_tier_records_match_obs_schema(monkeypatch):
     assert "direction" not in recs[0]
     assert recs[1]["direction"] == "lower_is_better"
     assert recs[2]["direction"] == "lower_is_better"
+
+
+def test_kernels_tier_records_match_obs_schema(monkeypatch):
+    """The kernels tier (ISSUE 11): a tiny in-process run emits TWO
+    schema-valid bench records (fused forward-backward TRs/s, fused
+    ring step GB/s) whose ``vs_baseline`` is the measured
+    fused-vs-unfused ratio, with the backend-split tier, so
+    ``obs regress --only kernels`` gates the fused kernels
+    alongside the other tiers."""
+    monkeypatch.setenv("BENCH_KERNELS_TRS", "64")
+    monkeypatch.setenv("BENCH_KERNELS_VOXELS", "256")
+    out = bench.measure_tier("kernels")
+    assert out["fb_trs_per_sec"] > 0
+    assert out["fb_reference_trs_per_sec"] > 0
+    assert out["ring_gb_per_sec"] > 0
+    stages = out["stages"]
+    assert set(bench.STAGE_KEYS) <= set(stages)
+    assert stages["steady_s"] > 0
+
+    recs = bench._kernels_result_records(out)
+    assert [r["metric"] for r in recs] == [
+        "kernels_eventseg_fb_trs_per_sec",
+        "kernels_summa_ring_gb_per_sec"]
+    for rec in recs:
+        assert obs.validate_bench_record(rec) == []
+        assert rec["tier"] == "kernels_cpu_fallback"
+        assert rec["vs_baseline"] > 0
+    assert recs[0]["config"]["n_trs"] == 64
+    assert recs[1]["config"]["n_voxels"] == 256

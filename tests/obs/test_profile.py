@@ -269,3 +269,98 @@ def test_compiled_fallback_to_lowered_cost_is_marked(mem,
     assert rec["level"] == "compiled"
     assert rec["unavailable"] == "compiled-cost-analysis"
     assert rec["flops"] == 1.0  # the lowered estimate, marked as such
+
+
+def test_empty_cost_analysis_degrades_to_marker(mem):
+    """A program whose cost analysis yields nothing attributable
+    (Pallas/Mosaic-lowered programs do this) still emits a cost
+    record, marked ``cost-analysis-empty`` (ISSUE 11 satellite)."""
+
+    class EmptyCostLowered:
+        def as_text(self):
+            return "module {}"
+
+        def cost_analysis(self):
+            return {"utilization": 1.0}  # nothing attributable
+
+    class Prog:
+        def lower(self, *a, **k):
+            return EmptyCostLowered()
+
+        def __call__(self, x):
+            return x
+
+    prog = obs_profile.profile_program(Prog(), "t.pallas",
+                                       span="t.span")
+    with obs_profile.profiling("lowered"):
+        prog(jnp.ones(3))
+    (rec,) = _costs(mem, "t.pallas")
+    assert rec["unavailable"] == "cost-analysis-empty"
+    assert "flops" not in rec
+    assert rec["span"] == "t.span"
+
+
+def test_analysis_stage_raise_degrades_not_raises(mem):
+    """A lowering stage that raises outside the per-step guards
+    degrades to a marked record instead of losing the site."""
+
+    class Prog:
+        @property
+        def lower(self):
+            # raises on ATTRIBUTE ACCESS — outside every per-step
+            # guard (getattr's default only swallows AttributeError)
+            raise RuntimeError("mosaic said no")
+
+        def __call__(self, x):
+            return x
+
+    prog = obs_profile.profile_program(Prog(), "t.explode")
+    with obs_profile.profiling("lowered"):
+        prog(jnp.ones(3))
+    (rec,) = _costs(mem, "t.explode")
+    assert rec["unavailable"].startswith("profile-failed:")
+
+
+def test_report_renders_span_only_timing_for_unavailable_site():
+    """obs report's cost-profiles section attaches span-only timing
+    to a degraded (unavailable) cost row instead of dropping it."""
+    from brainiak_tpu.obs import report
+
+    records = [
+        {"v": 1, "kind": "span", "ts": 1.0, "rank": 0,
+         "name": "distla.gram", "path": "distla.gram",
+         "dur_s": 0.25},
+        {"v": 2, "kind": "cost", "ts": 1.1, "rank": 0,
+         "name": "distla.summa", "site": "distla.summa",
+         "level": "lowered", "span": "distla.gram",
+         "unavailable": "cost-analysis-empty"},
+    ]
+    summary = report.aggregate(records)
+    (row,) = summary["cost"]
+    assert row["span_total_s"] == 0.25
+    assert row["span_count"] == 1
+    assert "achieved_flops_per_s" not in row
+    text = report.render_text(summary)
+    assert "span=0.2500s/1x" in text
+    assert "unavailable=cost-analysis-empty" in text
+
+
+def test_span_timing_not_attached_to_ambiguous_join_groups():
+    """Review fix: several cost rows of one site sharing a join
+    target (full + remainder chunk programs) stay unannotated —
+    for span-only timing exactly as for FLOP/s — because the shared
+    span total cannot be apportioned between them."""
+    from brainiak_tpu.obs import report
+
+    span = {"v": 1, "kind": "span", "ts": 1.0, "rank": 0,
+            "name": "fit_chunk", "path": "fit_chunk", "dur_s": 0.5,
+            "attrs": {"estimator": "X.fit"}}
+    cost = {"v": 2, "kind": "cost", "ts": 1.1, "rank": 0,
+            "name": "x.chunk", "site": "x.chunk",
+            "level": "lowered", "span": "fit_chunk",
+            "estimator": "X.fit",
+            "unavailable": "cost-analysis-empty"}
+    summary = report.aggregate([span, cost, dict(cost, ts=1.2)])
+    for row in summary["cost"]:
+        assert "span_total_s" not in row
+        assert "achieved_flops_per_s" not in row

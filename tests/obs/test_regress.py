@@ -42,30 +42,37 @@ def test_fixture_history_passes_and_gates():
     # the real r01-r05 fcma trajectory + the serve_r01-r03 tier
     # (PR 5) + the distla_r01-r03 tier (ISSUE 6) + the
     # encoding_r01-r03 tier (ISSUE 7) + the service_r01-r03 tier
-    # (ISSUE 9: 3 rounds x 3 metrics — requests/s, p99, padding),
-    # all measured host-side -> *_cpu_fallback: five tiers gating
-    # independently from one directory
-    assert len(records) == 23
+    # (ISSUE 9: 3 rounds x 3 metrics — requests/s, p99, padding)
+    # + the kernels_r01-r03 tier (ISSUE 11: 3 rounds x 2 metrics —
+    # fused forward-backward TRs/s, fused ring GB/s), all measured
+    # host-side -> *_cpu_fallback: six tiers gating independently
+    # from one directory
+    assert len(records) == 29
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
     assert tiers == {"cpu_fallback", "serve_cpu_fallback",
                      "service_cpu_fallback",
                      "distla_cpu_fallback",
-                     "encoding_cpu_fallback"}
+                     "encoding_cpu_fallback",
+                     "kernels_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
+    multi = ("service_cpu_fallback", "kernels_cpu_fallback")
     by_tier = {c["tier"]: c for c in result["checks"]
-               if c["tier"] != "service_cpu_fallback"}
+               if c["tier"] not in multi}
     by_metric = {c["metric"]: c for c in result["checks"]
-                 if c["tier"] == "service_cpu_fallback"}
+                 if c["tier"] in multi}
     assert set(by_tier) == {"cpu_fallback", "serve_cpu_fallback",
                             "distla_cpu_fallback",
                             "encoding_cpu_fallback"}
-    # the service tier gates three metrics, two of them flipped
+    # the service tier gates three metrics (two flipped) and the
+    # kernels tier gates two fused sites
     assert set(by_metric) == {"service_mixed_requests_per_sec",
                               "service_p99_latency_seconds",
-                              "service_padding_waste_ratio"}
+                              "service_padding_waste_ratio",
+                              "kernels_eventseg_fb_trs_per_sec",
+                              "kernels_summa_ring_gb_per_sec"}
     assert by_metric["service_p99_latency_seconds"][
         "direction"] == "lower_is_better"
     assert all(c["status"] == "ok" for c in by_metric.values())
@@ -325,3 +332,38 @@ def test_validator_rejects_unknown_direction():
     assert validate_bench_record(rec) == []
     assert any("direction" in e for e in validate_bench_record(
         dict(rec, direction="sideways")))
+
+
+def test_only_kernels_gates_committed_fixture():
+    """ISSUE 11 acceptance: `obs regress --only kernels` passes on
+    the committed kernels fixture rounds (both fused-site metrics
+    gated, cpu_fallback tier)."""
+    records, _ = regress.load_bench_records([FIXTURE_DIR])
+    result = regress.evaluate(records, only=["kernels"])
+    assert result["verdict"] == "pass"
+    assert sorted(c["metric"] for c in result["checks"]) == [
+        "kernels_eventseg_fb_trs_per_sec",
+        "kernels_summa_ring_gb_per_sec"]
+    assert all(c["status"] == "ok" for c in result["checks"])
+
+
+def test_kernels_two_x_degradation_exits_one(tmp_path, capsys):
+    """ISSUE 11 acceptance: a synthetic 2x degradation of the
+    newest kernels round exits 1 with the metric named."""
+    for name in os.listdir(FIXTURE_DIR):
+        if name.startswith("kernels_"):
+            shutil.copy(os.path.join(FIXTURE_DIR, name),
+                        str(tmp_path))
+    lines = []
+    with open(os.path.join(FIXTURE_DIR, "kernels_r03.json")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            rec["value"] = rec["value"] / 2.0
+            lines.append(json.dumps(rec))
+    (tmp_path / "kernels_r04.json").write_text("\n".join(lines))
+    rc = regress.main(["--history", str(tmp_path),
+                       "--only", "kernels"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "regression" in captured.err
+    assert "kernels_" in captured.err

@@ -1,0 +1,380 @@
+"""Fused-kernel parity (ISSUE 11): the fused rotate-multiply-
+accumulate SUMMA ring step, the device-side epoch norm, and the
+MTTKRP-style RBF factor contractions, each against its unfused
+reference on the CPU/interpreter backends."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from brainiak_tpu.obs import metrics as obs_metrics
+from brainiak_tpu.ops import distla, rbf
+from brainiak_tpu.ops.kernels import epoch_norm as en
+from brainiak_tpu.ops.kernels import ring
+from brainiak_tpu.parallel import make_mesh
+
+
+# -- fused ring step --------------------------------------------------
+
+def test_ring_mma_pallas_matches_xla_update():
+    """The Pallas step body (interpreter mode) and the XLA
+    dynamic-update-slice step place identical blocks and leave every
+    other block untouched."""
+    rng = np.random.RandomState(0)
+    t, vl, b, shards = 16, 32, 8, 4
+    z = jnp.asarray(rng.randn(t, vl).astype(np.float32))
+    rot = jnp.asarray(rng.randn(t, b).astype(np.float32))
+    out0 = jnp.asarray(np.full((vl, shards * b), -1.0, np.float32))
+    got = np.asarray(ring.ring_mma(out0, z, rot, 2, n_shards=shards,
+                                   tile_r=16, interpret=True))
+    ref = np.asarray(ring.mma_update(out0, z, rot, 2 * b))
+    assert np.allclose(got, ref, atol=1e-5)
+    # untouched blocks alias straight through
+    assert np.allclose(got[:, :2 * b], -1.0)
+    assert np.allclose(got[:, 3 * b:], -1.0)
+
+
+def test_ring_mma_under_scan_with_traced_owner():
+    """The Pallas step composes under lax.scan with a traced owner
+    index (the real SUMMA use) — all blocks land correctly."""
+    rng = np.random.RandomState(1)
+    t, vl, b, shards = 8, 16, 8, 4
+    z = jnp.asarray(rng.randn(t, vl).astype(np.float32))
+    rot = jnp.asarray(rng.randn(t, b).astype(np.float32))
+
+    def step(out, s):
+        return ring.ring_mma(out, z, rot, s, n_shards=shards,
+                             tile_r=8, interpret=True), None
+
+    out, _ = jax.lax.scan(step, jnp.zeros((vl, shards * b),
+                                          jnp.float32),
+                          jnp.arange(shards, dtype=jnp.int32))
+    ref = np.asarray(z).T @ np.asarray(rot)
+    assert np.allclose(np.asarray(out), np.tile(ref, (1, shards)),
+                       atol=1e-5)
+
+
+def test_summa_gram_fused_matches_unfused_and_dense():
+    """The fused ring step reproduces the unfused three-stage
+    formulation (and the dense Gram) bit-for-tolerance, on even and
+    uneven splits."""
+    rng = np.random.RandomState(2)
+    t, v = 20, 64
+    data = rng.randn(t, v).astype(np.float32)
+    z = (data - data.mean(0)) / (data.std(0) * np.sqrt(t))
+    dense = z.T @ z
+    mesh = make_mesh(("voxel",), (8,))
+    for cols in (v, v - 7):
+        fused = np.asarray(distla.summa_gram(
+            data[:, :cols], mesh, ring_step="fused"))
+        unfused = np.asarray(distla.summa_gram(
+            data[:, :cols], mesh, ring_step="unfused"))
+        assert np.allclose(fused, dense[:cols, :cols], atol=5e-4)
+        assert np.allclose(fused, unfused, atol=1e-6)
+
+
+def test_summa_gram_fused_nan_columns_propagate():
+    """NaN voxels propagate whole NaN rows/columns through the fused
+    step, exactly like the unfused reference."""
+    rng = np.random.RandomState(3)
+    data = rng.randn(16, 32).astype(np.float32)
+    data[:, 5] = np.nan
+    mesh = make_mesh(("voxel",), (8,))
+    got = np.asarray(distla.summa_gram(data, mesh,
+                                       ring_step="fused"))
+    assert np.all(np.isnan(got[5]))
+    assert np.all(np.isnan(got[:, 5]))
+    assert np.isnan(got).sum() == 2 * 32 - 1
+
+
+def test_ring_step_mode_selection(monkeypatch):
+    """Auto mode: Pallas only on TPU with tileable extents; the env
+    override wins; unfused is never auto-selected."""
+    assert ring.ring_step_mode(150, 1024, 1024,
+                               backend="cpu") == "fused"
+    assert ring.ring_step_mode(152, 1024, 1024,
+                               backend="tpu") == "pallas"
+    # non-tileable extents fall back to the XLA fused step
+    assert ring.ring_step_mode(152, 100, 100,
+                               backend="tpu") == "fused"
+    monkeypatch.setenv(ring.RING_STEP_ENV, "unfused")
+    assert ring.ring_step_mode(152, 1024, 1024,
+                               backend="tpu") == "unfused"
+
+
+def test_pick_ring_tiles_respects_budget():
+    tile_r, fits = ring.pick_ring_tiles(152, 4096, 1024)
+    assert fits and 4096 % tile_r == 0
+    used = 2 * 152 * (1024 + tile_r) + 2 * tile_r * 1024
+    assert used <= ring._VMEM_BUDGET_FLOATS
+    # an epoch x TR extent too large for any tile reports not-fits
+    assert not ring.pick_ring_tiles(200_000, 4096, 4096)[1]
+
+
+# -- device epoch norm ------------------------------------------------
+
+def _np_ref(mat):
+    rows = mat.shape[0]
+    mean = mat.mean(axis=0)
+    std = mat.std(axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = (mat - mean) / (std * np.sqrt(rows))
+    return np.nan_to_num(out, nan=0.0, posinf=0.0, neginf=0.0)
+
+
+def test_epoch_zscore_device_matches_numpy(monkeypatch):
+    monkeypatch.setenv(en.EPOCH_NORM_ENV, "device")
+    rng = np.random.RandomState(0)
+    mat = rng.randn(50, 37).astype(np.float32)
+    mat[:, 5] = 2.5  # constant column -> exact zeros
+    mat[3, 7] = np.nan  # NaN input -> zeroed column, not poison
+    got = en.epoch_zscore(mat)
+    assert np.allclose(got, _np_ref(mat), atol=1e-5)
+    assert np.all(got[:, 5] == 0.0)
+    assert np.all(np.isfinite(got))
+
+
+def test_normalize_epochs_groups_shapes_and_preserves_order(
+        monkeypatch):
+    """Mixed epoch lengths batch by shape (one dispatch per group)
+    and the output order matches the input order."""
+    monkeypatch.setenv(en.EPOCH_NORM_ENV, "device")
+    rng = np.random.RandomState(1)
+    mats = [rng.randn(12, 9).astype(np.float32),
+            rng.randn(20, 9).astype(np.float32),
+            rng.randn(12, 9).astype(np.float32)]
+    out = en.normalize_epochs(mats)
+    for mat, got in zip(mats, out):
+        assert got.shape == mat.shape
+        assert np.allclose(got, _np_ref(mat), atol=1e-5)
+
+
+def test_normalize_epochs_numpy_fallback_forced(monkeypatch):
+    monkeypatch.setenv(en.EPOCH_NORM_ENV, "numpy")
+    rng = np.random.RandomState(2)
+    mats = [rng.randn(10, 6).astype(np.float32)]
+    out = en.normalize_epochs(mats)
+    assert np.allclose(out[0], _np_ref(mats[0]), atol=1e-6)
+
+
+def test_epoch_norm_pallas_tile_path_matches(monkeypatch):
+    """The Pallas voxel-tile kernel (interpreter mode) matches the
+    fused-XLA program on a tile-aligned batch."""
+    rng = np.random.RandomState(3)
+    batch = rng.randn(2, 16, 256).astype(np.float32)
+    got = np.asarray(en._pallas_batch_zscore(
+        jnp.asarray(batch), tile_v=128, interpret=True))
+    ref = np.stack([_np_ref(batch[i]) for i in range(2)])
+    assert np.allclose(got, ref, atol=1e-5)
+
+
+def test_preprocessing_epoch_separation_still_normalizes():
+    """The ingest path (_separate_epochs) keeps its output contract
+    through the device-side normalization."""
+    from brainiak_tpu.fcma.preprocessing import _separate_epochs
+
+    rng = np.random.RandomState(2)
+    activity = [rng.randn(10, 30).astype(np.float32)]
+    epochs = np.zeros((1, 2, 30))
+    epochs[0, 0, 3:9] = 1
+    epochs[0, 1, 15:23] = 1
+    raw, labels = _separate_epochs(activity, [epochs])
+    assert len(raw) == 2 and labels == [0, 0]
+    assert raw[0].shape == (6, 10)
+    assert np.allclose(raw[0].std(axis=0) * np.sqrt(6), 1.0,
+                       atol=1e-5)
+
+
+def test_fcma_preprocessing_no_native_import():
+    """Acceptance: the FCMA ingest path no longer imports
+    brainiak_tpu.native."""
+    import ast
+    import inspect
+
+    from brainiak_tpu.fcma import preprocessing
+
+    tree = ast.parse(inspect.getsource(preprocessing))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            assert "native" not in (node.module or "")
+        if isinstance(node, ast.Import):
+            assert all("native" not in a.name for a in node.names)
+
+
+def test_native_shim_emits_deprecation_warning():
+    import importlib
+    import sys
+    import warnings
+
+    sys.modules.pop("brainiak_tpu.native", None)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        importlib.import_module("brainiak_tpu.native")
+    assert any(issubclass(w.category, DeprecationWarning)
+               and "epoch_norm" in str(w.message) for w in caught)
+
+
+# -- MTTKRP factor reconstruction -------------------------------------
+
+def test_rbf_factors_matches_naive_broadcast():
+    rng = np.random.RandomState(0)
+    R = rng.randn(200, 3)
+    C = rng.randn(7, 3)
+    W = np.abs(rng.rand(7, 1)) + 0.5
+    naive = np.exp(-((R[:, None, :] - C[None]) ** 2).sum(-1) / W.T)
+    got = np.asarray(rbf.rbf_factors(jnp.asarray(R), jnp.asarray(C),
+                                     jnp.asarray(W)))
+    assert np.allclose(got, naive, atol=1e-5)
+
+
+def test_rbf_weight_products_match_materialized_einsum():
+    rng = np.random.RandomState(1)
+    R = rng.randn(300, 3)
+    C = rng.randn(5, 3)
+    W = np.abs(rng.rand(5)) + 1.0
+    X = rng.randn(300, 40)
+    F = np.exp(-((R[:, None, :] - C[None]) ** 2).sum(-1) / W[None])
+    g, b = rbf.rbf_weight_products(jnp.asarray(R), jnp.asarray(C),
+                                   jnp.asarray(W), jnp.asarray(X),
+                                   chunk=128)
+    assert np.allclose(np.asarray(g), np.einsum('vk,vl->kl', F, F),
+                       atol=1e-4)
+    assert np.allclose(np.asarray(b), np.einsum('vk,vt->kt', F, X),
+                       atol=1e-4)
+
+
+@pytest.mark.parametrize("loss", ["linear", "soft_l1"])
+def test_rbf_residual_sum_matches_naive(loss):
+    rng = np.random.RandomState(2)
+    R = rng.randn(250, 3)
+    C = rng.randn(4, 3)
+    W = np.abs(rng.rand(4)) + 1.0
+    X = rng.randn(250, 30)
+    Wt = rng.randn(4, 30)
+    sigma = 0.7
+    F = np.exp(-((R[:, None, :] - C[None]) ** 2).sum(-1) / W[None])
+    sq = (sigma * (X - F @ Wt)) ** 2
+    ref = np.sum(2.0 * (np.sqrt(1.0 + sq) - 1.0)) \
+        if loss == "soft_l1" else np.sum(sq)
+    got = float(rbf.rbf_residual_sum(
+        jnp.asarray(R), jnp.asarray(C), jnp.asarray(W),
+        jnp.asarray(X), jnp.asarray(Wt), sigma, nlss_loss=loss,
+        chunk=64))
+    assert np.isclose(got, ref, rtol=1e-5)
+
+
+def test_rbf_residual_sum_masks_match_htfa_convention():
+    """vmask/tmask zero pad voxels and TRs exactly as the
+    materialized masked residual did."""
+    rng = np.random.RandomState(3)
+    R = rng.randn(100, 3)
+    C = rng.randn(3, 3)
+    W = np.abs(rng.rand(3)) + 1.0
+    X = rng.randn(100, 20)
+    Wt = rng.randn(3, 20)
+    vm = (rng.rand(100) > 0.4).astype(float)
+    tm = (rng.rand(20) > 0.3).astype(float)
+    F = np.exp(-((R[:, None, :] - C[None]) ** 2).sum(-1) / W[None])
+    Fm = F * vm[:, None]
+    xm = X * vm[:, None] * tm[None, :]
+    ref = np.sum(((0.5 * (xm - Fm @ Wt))
+                  * (vm[:, None] * tm[None, :])) ** 2)
+    got = float(rbf.rbf_residual_sum(
+        jnp.asarray(R), jnp.asarray(C), jnp.asarray(W),
+        jnp.asarray(xm), jnp.asarray(Wt), 0.5,
+        vmask=jnp.asarray(vm), tmask=jnp.asarray(tm), chunk=32))
+    assert np.isclose(got, ref, rtol=1e-5)
+
+
+# -- retrace stability ------------------------------------------------
+
+def test_fused_sites_do_not_retrace_on_repeat_calls():
+    """Repeat calls at one configuration add zero program-builder
+    cache misses on the fused sites (retrace_total{site=...} <= 1
+    per fused site — ISSUE 11 acceptance)."""
+    rng = np.random.RandomState(4)
+    mesh = make_mesh(("voxel",), (8,))
+    data = rng.randn(16, 32).astype(np.float32)
+    mats = [rng.randn(64, 1024).astype(np.float32)]
+    retrace = obs_metrics.counter("retrace_total")
+
+    import os
+    os.environ[en.EPOCH_NORM_ENV] = "device"
+    try:
+        for _ in range(2):
+            distla.summa_gram(data, mesh, ring_step="fused")
+            en.normalize_epochs(mats)
+    finally:
+        os.environ.pop(en.EPOCH_NORM_ENV, None)
+    before = {site: retrace.value(site=site)
+              for site in ("distla.summa", "fcma.epoch_norm")}
+    distla.summa_gram(data, mesh, ring_step="fused")
+    en.normalize_epochs([rng.randn(64, 1024).astype(np.float32)])
+    for site, count in before.items():
+        assert retrace.value(site=site) == count, site
+
+
+def test_rbf_factors_accurate_at_offset_coordinates():
+    """Review fix: real scanner coordinates (~200 mm offsets) must
+    not lose accuracy to ||R||² − 2R·c cancellation — operands are
+    centered before the matmul decomposition, and factors never
+    exceed 1 (sq clamped at 0)."""
+    rng = np.random.RandomState(0)
+    R = (rng.randn(400, 3) * 5 + 200.0).astype(np.float32)
+    C = (rng.randn(4, 3) * 5 + 200.0).astype(np.float32)
+    W = (np.abs(rng.rand(4)) + 1.0).astype(np.float32)
+    ref = np.exp(-((R[:, None, :].astype(np.float64)
+                    - C[None].astype(np.float64)) ** 2).sum(-1)
+                 / W[None])
+    got = np.asarray(rbf.rbf_factors(
+        jnp.asarray(R), jnp.asarray(C), jnp.asarray(W)))
+    assert np.max(np.abs(got - ref)) < 5e-6
+    assert got.max() <= 1.0
+
+
+def test_epoch_norm_tile_picker_keeps_lane_alignment():
+    """Review fix: the Pallas voxel tile must keep the lane (last)
+    dimension 128-aligned or Mosaic rejects the block — unaligned
+    widths fall back to the fused-XLA path instead."""
+    assert en._pick_tile_v(16, 320) == 0    # 320 % 128 != 0
+    assert en._pick_tile_v(16, 768) == 256  # halves to aligned
+    assert en._pick_tile_v(16, 512) == 512
+    assert en._pick_tile_v(7, 512) == 0     # sublane-unaligned T
+
+
+def test_normalize_epochs_preserves_float64_dtype(monkeypatch):
+    """Review fix: float64 epochs above the device threshold must
+    not be silently downcast — when the backend would narrow the
+    dtype, the group takes the exact host path instead."""
+    monkeypatch.setenv(en.EPOCH_NORM_ENV, "device")
+    x64 = jax.config.jax_enable_x64
+    rng = np.random.RandomState(5)
+    mats = [rng.randn(300, 300)]  # float64, > _MIN_DEVICE_ELEMS
+    out = en.normalize_epochs(mats)
+    assert out[0].dtype == np.float64
+    tol = 1e-12 if not x64 else 1e-8  # host path is exact
+    assert np.allclose(out[0], _np_ref(mats[0]), atol=tol)
+
+
+def test_summa_gram_rejects_unknown_ring_step():
+    """Review fix: a typo'd ring_step override raises instead of
+    silently running a different kernel."""
+    rng = np.random.RandomState(6)
+    mesh = make_mesh(("voxel",), (8,))
+    data = rng.randn(8, 16).astype(np.float32)
+    with pytest.raises(ValueError, match="ring_step"):
+        distla.summa_gram(data, mesh, ring_step="Pallas")
+
+
+def test_vmem_budget_shared_across_kernel_modules():
+    """Review fix: one budget constant — the ring and epoch-norm
+    tile pickers read pallas_kernels' value, so a retune lands
+    everywhere."""
+    from brainiak_tpu.ops import pallas_kernels
+
+    assert ring._VMEM_BUDGET_FLOATS \
+        == pallas_kernels._VMEM_BUDGET_FLOATS
+    assert en._vmem_budget_floats() \
+        == pallas_kernels._VMEM_BUDGET_FLOATS

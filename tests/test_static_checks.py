@@ -45,7 +45,7 @@ def test_run_checks_json_output():
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
         "jaxlint", "jaxlint-deep", "obs", "regress", "serve",
-        "service", "distla", "encoding"}
+        "service", "distla", "encoding", "kernels"}
     assert payload["files"] > 100
     seconds = payload["gate_seconds"]
     assert set(seconds) == set(payload["gates"])
@@ -448,4 +448,61 @@ def test_encoding_gate_classifies_failures(monkeypatch):
     findings = []
     rc.check_encoding(findings)
     assert [f.code for f in findings] == ["ENC001"]
+    assert "rc=3" in findings[0].message
+
+
+def test_kernels_gate_passes_on_live_package():
+    """The kernels gate (KRN001, ISSUE 11 satellite) smoke-runs the
+    fused-kernels parity selfcheck on the 8-device CPU mesh and
+    passes on the live tree."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_kernels(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_kernels_gate_classifies_failures(monkeypatch):
+    """A failing fused-kernels selfcheck is reported as KRN001, with
+    retrace instability, a -inf/NaN mask mismatch, and numerics
+    parity each named distinctly."""
+    rc = _load_run_checks()
+
+    def fake_child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    monkeypatch.setattr(rc, "_KERNELS_CHILD", fake_child(
+        {"ok": False, "max_err": 0.2, "tol": 5e-4, "n_shards": 8,
+         "mask_mismatch": [], "retraces": {}}))
+    findings = []
+    rc.check_kernels(findings)
+    assert [f.code for f in findings] == ["KRN001"]
+    assert "parity" in findings[0].message
+
+    monkeypatch.setattr(rc, "_KERNELS_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 5e-4, "n_shards": 8,
+         "mask_mismatch": ["fb_mask"],
+         "retraces": {"eventseg.forward_backward": 1.0}}))
+    findings = []
+    rc.check_kernels(findings)
+    assert [f.code for f in findings] == ["KRN001"]
+    assert "mask" in findings[0].message
+    assert "fb_mask" in findings[0].message
+
+    monkeypatch.setattr(rc, "_KERNELS_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 5e-4, "n_shards": 8,
+         "mask_mismatch": [],
+         "retraces": {"distla.summa": 2.0,
+                      "fcma.epoch_norm": 1.0}}))
+    findings = []
+    rc.check_kernels(findings)
+    assert [f.code for f in findings] == ["KRN001"]
+    assert "rebuilt" in findings[0].message
+    assert "distla.summa=2" in findings[0].message
+
+    monkeypatch.setattr(rc, "_KERNELS_CHILD", "raise SystemExit(3)")
+    findings = []
+    rc.check_kernels(findings)
+    assert [f.code for f in findings] == ["KRN001"]
     assert "rc=3" in findings[0].message

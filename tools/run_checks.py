@@ -55,6 +55,13 @@ encoding   smoke-runs the encoding-tier selfcheck
            error, a broken banded fit, or program rebuilds —
            every ``retrace_total{site=encoding.*}`` must stay
            at 1 across repeat fits (ENC001)
+kernels    smoke-runs the fused-kernels selfcheck
+           (``brainiak_tpu.ops.kernels.selfcheck``) on the
+           8-device CPU mesh and fails on fused-vs-reference
+           parity error (single-scan HMM forward-backward,
+           fused SUMMA ring step, MTTKRP factor reconstruction,
+           device epoch norm), a -inf/NaN mask mismatch, or
+           program rebuilds across the repeat pass (KRN001)
 ========== ===================================================
 
 ``# noqa`` suppresses stdlib/doc findings on a line; jaxlint uses
@@ -93,7 +100,7 @@ from brainiak_tpu.analysis.core import (  # noqa: E402,F401
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
          "jaxlint", "jaxlint-deep", "obs", "regress", "serve",
-         "service", "distla", "encoding")
+         "service", "distla", "encoding", "kernels")
 
 
 def python_sources():
@@ -771,6 +778,46 @@ def check_encoding(findings):
         "encoding", classify)
 
 
+# -- kernels gate -----------------------------------------------------
+
+_KERNELS_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.ops.kernels import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_kernels(findings):
+    """Fused-kernels gate (KRN001): smoke-run the fused-kernel
+    parity selfcheck (``brainiak_tpu.ops.kernels.selfcheck``) on the
+    8-device CPU mesh: single-scan HMM forward-backward vs the
+    two-scan reference (incl. the masked-log edge cases), the fused
+    rotate-multiply-accumulate SUMMA ring step vs the unfused
+    formulation and a NumPy dense Gram (even/uneven splits, NaN
+    propagation), MTTKRP factor reconstruction vs the naive
+    broadcast einsum, and the device epoch norm vs its NumPy
+    fallback — everything twice, with the retrace-stability contract
+    (the repeat pass must rebuild no fused-site program)."""
+
+    def classify(verdict):
+        if verdict.get("mask_mismatch"):
+            return ("fused kernels changed -inf/NaN masks vs the "
+                    "references: "
+                    + ", ".join(verdict["mask_mismatch"]))
+        return (f"fused-kernel parity failure: max_err="
+                f"{verdict.get('max_err')} over tol="
+                f"{verdict.get('tol')} "
+                f"(n_shards={verdict.get('n_shards')})")
+
+    _run_selfcheck_gate(
+        findings, _KERNELS_CHILD, "KRN001",
+        _rel(os.path.join(REPO, "brainiak_tpu", "ops", "kernels",
+                          "selfcheck.py")),
+        "kernels", classify)
+
+
 # -- external gate ----------------------------------------------------
 
 def run_external(findings):
@@ -938,6 +985,8 @@ def run_gates(only=None):
         timed("distla", check_distla, findings)
     if "encoding" in selected:
         timed("encoding", check_encoding, findings)
+    if "kernels" in selected:
+        timed("kernels", check_kernels, findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
@@ -950,7 +999,7 @@ def run_gates(only=None):
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
                        "jaxlint-deep", "obs", "regress", "serve",
-                       "service", "distla", "encoding")
+                       "service", "distla", "encoding", "kernels")
            if g in selected])
     return {
         "ok": not findings,
