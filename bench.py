@@ -679,20 +679,53 @@ def service_tier_metrics(n_requests=SERVICE_REQUESTS, seed=0):
             sreqs, ereqs) for req in pair if req is not None]
         policy = BucketPolicy(max_batch=32, max_wait_s=0.02)
 
-    def _drive():
+    def _drive(**kwargs):
         residency = ModelResidency(budget_bytes=1 << 30,
                                    policy=policy)
         residency.register("srm", model=srm)
         residency.register("enc", model=enc)
-        for req in requests:  # fresh queue-time stamps per drive
+        for req in requests:  # fresh stamps/traces per drive
             req.submitted = None
+            req.trace_id = None
+            req.parent_id = None
         return drive_service(residency, requests,
-                             default_model="srm", waves=4)
+                             default_model="srm", waves=4,
+                             **kwargs)
 
     with obs.span("bench.warm"):
         _drive()
     with obs.span("bench.steady"):
         summary, _, wall = _drive()
+    # telemetry overhead: the SAME steady drive with obs fully
+    # suspended (no sinks, no tracing — the disabled fast path) vs
+    # the full live plane (sink + request tracing + SLO burn
+    # tracking + /metrics exposition live on an ephemeral port).
+    # Three reps per lane, min wall each: max-wait-vs-max-batch
+    # flush timing makes partial-batch extents (and therefore a
+    # stray compile) drive-dependent, and one 0.5 s compile would
+    # swamp a 0.1 s steady wall — the min is the steady-state
+    # estimate.  The ratio gates telemetry cost from day one
+    # (lower_is_better in obs regress).
+    from brainiak_tpu.obs import sink as obs_sink
+    from brainiak_tpu.obs.http import TelemetryServer
+    from brainiak_tpu.obs.slo import Objective
+    walls_off = []
+    with obs_sink.suspended():
+        for _ in range(3):
+            walls_off.append(_drive()[2])
+    # the exposition server runs for the whole on-lane but is
+    # started/stopped OUTSIDE the timed drives: drive_service's
+    # wall includes shutdown, and charging the listener's stop
+    # (poll interval + thread join) to telemetry overhead would be
+    # phantom cost the off-lane never pays
+    with TelemetryServer(port=0):
+        walls_on = [
+            _drive(slos=[Objective.latency(
+                "bench_p99", quantile=0.99, threshold_s=30.0)])[2]
+            for _ in range(3)]
+    wall_off = min(walls_off)
+    obs_overhead = (min(walls_on) / wall_off) if wall_off > 0 \
+        else 0.0
     if summary["n_errors"]:
         # error records resolve in microseconds: rating them would
         # report record "throughput" (and a zero p99) for a broken
@@ -711,6 +744,7 @@ def service_tier_metrics(n_requests=SERVICE_REQUESTS, seed=0):
             "padding_waste": summary["padding_waste"],
             "retrace_total": summary["retrace_total"],
             "evictions": summary["residency"]["evictions"],
+            "obs_overhead_ratio": obs_overhead,
             "n_requests": n_requests,
             "baseline_rps": baseline,
             "backend": jax.default_backend()}
@@ -721,8 +755,12 @@ def _service_result_records(out, n_requests):
     metric: steady-state requests/s (higher is better), p99 latency
     and padding waste (both stamped ``direction="lower_is_better"``
     so ``obs regress --only service`` fails a doubled p99 or a
-    padding blow-up the right way round).  Tier split mirrors the
-    other tiers (``service`` on TPU, ``service_cpu_fallback``
+    padding blow-up the right way round), and the telemetry
+    overhead ratio (steady-state wall with full tracing + SLO +
+    /metrics exposition live vs obs suspended — also
+    ``lower_is_better``, so a telemetry change that taxes the
+    serving hot path fails CI from day one).  Tier split mirrors
+    the other tiers (``service`` on TPU, ``service_cpu_fallback``
     otherwise)."""
     tier = "service" if out.get("backend") == "tpu" \
         else "service_cpu_fallback"
@@ -758,6 +796,9 @@ def _service_result_records(out, n_requests):
             direction="lower_is_better"),
         rec("service_padding_waste_ratio", out["padding_waste"],
             "ratio", direction="lower_is_better"),
+        rec("service_obs_overhead_ratio",
+            out.get("obs_overhead_ratio", 0.0), "ratio",
+            direction="lower_is_better"),
     ]
 
 

@@ -29,7 +29,21 @@ PR 1's resilience events and PR 2's retrace lint:
   merge;
 - :mod:`~brainiak_tpu.obs.regress` (PR 4) — ``python -m
   brainiak_tpu.obs regress`` gates fresh bench numbers against the
-  tier-separated BENCH_* history.
+  tier-separated BENCH_* history;
+- :mod:`~brainiak_tpu.obs.trace` (PR 12) — request-scoped tracing:
+  one trace id per serve request, span chains with parentage across
+  threads and processes (npz-codec propagation), rendered as Chrome
+  flows by ``obs export``;
+- :mod:`~brainiak_tpu.obs.sketch` (PR 12) — mergeable
+  bounded-relative-error quantile sketches (DDSketch-style): O(1)
+  observe/memory, exact ``merge()`` so replica percentiles pool;
+- :mod:`~brainiak_tpu.obs.http` (PR 12) — opt-in live exposition
+  (``/metrics`` Prometheus text, ``/healthz``, ``/readyz``) on a
+  stdlib daemon thread (``BRAINIAK_TPU_OBS_HTTP_PORT`` / ``serve
+  service --http-port``);
+- :mod:`~brainiak_tpu.obs.slo` (PR 12) — declarative objectives
+  with multi-window burn-rate tracking: ``slo_violation`` events,
+  error-budget gauges on the exposition endpoint.
 
 Disabled by default: with no sink configured every instrumentation
 site is a no-op (no records, no ``block_until_ready`` host syncs).
@@ -59,7 +73,19 @@ from .profile import (  # noqa: F401
     profile_program,
     profiling,
 )
+from .http import (  # noqa: F401
+    HTTP_PORT_ENV,
+    TelemetryServer,
+    parse_prometheus_text,
+    render_prometheus,
+)
 from .report import validate_bench_record  # noqa: F401
+from .sketch import QuantileSketch  # noqa: F401
+from .slo import (  # noqa: F401
+    BurnRule,
+    Objective,
+    SLOTracker,
+)
 from .runtime import (  # noqa: F401
     counted_cache,
     device_memory_snapshot,
@@ -79,7 +105,14 @@ from .sink import (  # noqa: F401
     event,
     make_record,
     remove_sink,
+    suspended,
     validate_record,
+)
+from .trace import (  # noqa: F401
+    new_span_id,
+    new_trace_id,
+    trace_chains,
+    trace_is_connected,
 )
 from .spans import (  # noqa: F401
     current_span,
@@ -91,16 +124,22 @@ from .spans import (  # noqa: F401
 )
 
 __all__ = [
+    "HTTP_PORT_ENV",
     "OBS_DIR_ENV",
     "OBS_MAX_MB_ENV",
     "PROFILE_ENV",
     "SCHEMA_VERSION",
+    "BurnRule",
     "Counter",
     "Gauge",
     "Histogram",
     "JsonlSink",
     "MemorySink",
     "MetricsRegistry",
+    "Objective",
+    "QuantileSketch",
+    "SLOTracker",
+    "TelemetryServer",
     "add_sink",
     "collect",
     "counted_cache",
@@ -117,15 +156,22 @@ __all__ = [
     "install_compile_listener",
     "make_record",
     "memory_watermark",
+    "new_span_id",
+    "new_trace_id",
+    "parse_prometheus_text",
     "profile_level",
     "profile_program",
     "profiling",
     "remove_sink",
+    "render_prometheus",
     "reset_stage_times",
     "span",
     "stage_timer",
     "stage_times",
+    "suspended",
     "topology_event",
+    "trace_chains",
+    "trace_is_connected",
     "traced",
     "validate_bench_record",
     "validate_record",

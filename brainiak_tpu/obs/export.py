@@ -15,7 +15,15 @@ converts one or more per-rank JSONL sinks (files or directories of
   process-scoped) carrying their attrs;
 - **metric** records become counter tracks (``ph="C"``): counters
   plot their running sum, gauges and histogram observations plot the
-  raw value.
+  raw value;
+- **traced** spans (schema v3 ``trace_id``/``span_id``/``parent_id``
+  from :mod:`brainiak_tpu.obs.trace`) additionally become Chrome
+  flow events (``ph="s"/"t"/"f"``, one flow per trace id): each
+  request's submit→enqueue→dispatch→deliver chain renders as arrows
+  across span slices — and across *rank lanes*, because the flow
+  timestamps go through the same clock-skew merge, so a request
+  submitted by one process and served by another draws as one
+  connected flow.
 
 Cross-rank clock skew: per-rank wall clocks need not agree (the
 JSONL ``ts`` is host ``time.time()``).  The merge anchors on each
@@ -39,8 +47,9 @@ __all__ = ["chrome_trace", "main", "rank_offsets",
            "validate_chrome_trace"]
 
 #: ``ph`` values the exporter emits; :func:`validate_chrome_trace`
-#: accepts exactly these.
-_PHASES = ("X", "i", "C", "M")
+#: accepts exactly these ("s"/"t"/"f" are the flow-event phases
+#: traced requests render as).
+_PHASES = ("X", "i", "C", "M", "s", "t", "f")
 
 
 def rank_offsets(records):
@@ -111,17 +120,30 @@ def chrome_trace(records):
                        "pid": rank, "tid": 0,
                        "args": {"sort_index": rank}})
     counter_state = {}
+    flows = {}  # trace_id -> [(start_s, rank, span name)]
     for rec in records:
         kind = rec["kind"]
         end = adjusted(rec)
         if kind == "span":
             dur = float(rec["dur_s"])
+            args = dict(rec.get("attrs") or {}, path=rec["path"])
+            for key in ("trace_id", "span_id", "parent_id"):
+                if rec.get(key):
+                    args[key] = rec[key]
+            if rec.get("trace_id"):
+                # causal order is END time (a delivery span STARTS
+                # near submit — its latency covers the whole
+                # chain); the flow timestamp sits just inside the
+                # slice's end so the viewer binds the arrow to the
+                # right slice AND the steps stay monotone in time
+                flows.setdefault(rec["trace_id"], []).append(
+                    (end, end - dur * 1e-3, rec["rank"],
+                     rec["name"]))
             events.append({
                 "ph": "X", "name": rec["name"], "cat": "span",
                 "ts": us(end - dur), "dur": round(dur * 1e6, 3),
                 "pid": rec["rank"], "tid": 0,
-                "args": dict(rec.get("attrs") or {},
-                             path=rec["path"]),
+                "args": args,
             })
         elif kind == "metric":
             events.append({
@@ -141,6 +163,31 @@ def chrome_trace(records):
                 "s": "p", "ts": us(end), "pid": rec["rank"],
                 "tid": 0, "args": args,
             })
+    # traced requests: one flow per trace id, stepping through its
+    # spans in start order — the viewer draws arrows between the
+    # slices the flow timestamps land in, across rank lanes
+    for trace_id, steps in flows.items():
+        if len(steps) < 2:  # no arrow to draw
+            continue
+        steps.sort()
+        prev_ts = None
+        for i, (end, inside, rank, name) in enumerate(steps):
+            ph = "s" if i == 0 else (
+                "f" if i == len(steps) - 1 else "t")
+            # keep the step sequence strictly monotone even when
+            # two chain spans END microseconds apart (delivery is
+            # recorded right after dispatch): any instant inside
+            # the slice binds, and a later span's slice always
+            # covers its predecessor's end
+            ts = inside if prev_ts is None \
+                else min(end, max(inside, prev_ts + 1e-6))
+            prev_ts = ts
+            ev = {"ph": ph, "id": trace_id, "name": "trace",
+                  "cat": "trace", "ts": us(ts), "pid": rank,
+                  "tid": 0, "args": {"step": name}}
+            if ph == "f":
+                ev["bp"] = "e"  # bind to the enclosing slice
+            events.append(ev)
     # stable viewer ordering: X events must be opened in start order
     # for nesting; metadata first
     events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0)))
@@ -192,6 +239,10 @@ def validate_chrome_trace(doc):
                     or isinstance(dur, bool) or dur < 0:
                 errors.append(
                     f"{where}: dur={dur!r} (expected a number >= 0)")
+        if ph in ("s", "t", "f") and not ev.get("id"):
+            errors.append(
+                f"{where}: flow event missing its 'id' (the trace "
+                "id binding the arrow's endpoints)")
     return errors
 
 

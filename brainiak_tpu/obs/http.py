@@ -1,0 +1,394 @@
+"""Live telemetry exposition: ``/metrics``, ``/healthz``, ``/readyz``.
+
+The PR 3/4 obs stack is post-hoc (JSONL read after the run); an
+always-on service needs its state scrapeable **while it runs** — a
+router places requests by live queue depth, an operator watches SLO
+burn on a dashboard, an orchestrator gates traffic on readiness.
+:class:`TelemetryServer` is that plane with zero new dependencies:
+one ``http.server`` daemon thread serving
+
+- ``/metrics`` — the in-process registry
+  (:func:`brainiak_tpu.obs.metrics.collect`) in Prometheus text
+  exposition format (version 0.0.4): counters and gauges verbatim,
+  histograms as summaries with real ``quantile=""`` series from
+  their mergeable sketches plus ``_sum``/``_count``;
+- ``/healthz`` — liveness: a 200 means the process (and this daemon
+  thread) is alive;
+- ``/readyz`` — readiness: delegates to an injectable callback
+  (:class:`~brainiak_tpu.serve.service.ServeService` wires its
+  residency + AOT warm state here) and answers 200 or 503 with a
+  JSON detail body either way.
+
+Opt-in: nothing listens unless a port is given — programmatically,
+via ``serve service --http-port``, or through the
+``BRAINIAK_TPU_OBS_HTTP_PORT`` environment variable
+(:func:`maybe_start_from_env`).  Port 0 binds an ephemeral port
+(read it back from :attr:`TelemetryServer.port` — the CI gate and
+the tests do).  The handler threads only *read* (the registry and
+the readiness callback synchronize internally), so exposition never
+blocks the serving loop.
+
+:func:`parse_prometheus_text` is the minimal in-repo parser the
+OBS002 gate and the tests validate the exposition with — no
+prometheus client library needed.
+"""
+
+import json
+import logging
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import metrics as obs_metrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "HTTP_HOST_ENV",
+    "HTTP_PORT_ENV",
+    "TelemetryServer",
+    "maybe_start_from_env",
+    "parse_prometheus_text",
+    "render_prometheus",
+]
+
+HTTP_PORT_ENV = "BRAINIAK_TPU_OBS_HTTP_PORT"
+HTTP_HOST_ENV = "BRAINIAK_TPU_OBS_HTTP_HOST"
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>\S+)\s*$")
+_LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"')
+
+
+def _escape_help(text):
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _escape_label(value):
+    return (str(value).replace("\\", r"\\")
+            .replace('"', r'\"').replace("\n", r"\n"))
+
+
+_UNESCAPE_RE = re.compile(r"\\(.)")
+
+
+def _unescape_label(value):
+    # single left-to-right scan: sequential str.replace would
+    # mis-read the tail of an escaped backslash ('\\\\n' is
+    # backslash + literal n, not backslash + newline)
+    return _UNESCAPE_RE.sub(
+        lambda m: "\n" if m.group(1) == "n" else m.group(1), value)
+
+
+def _label_str(labels):
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(value):
+    value = float(value)
+    if value != value:
+        return "NaN"
+    if value in (float("inf"), float("-inf")):
+        return "+Inf" if value > 0 else "-Inf"
+    return repr(value)
+
+
+def render_prometheus(samples=None):
+    """Prometheus text exposition (format 0.0.4) for registry
+    samples (default: the live default registry).
+
+    Counters/gauges render one line per label set; histograms render
+    as the ``summary`` type — their sketch quantiles
+    (:data:`~brainiak_tpu.obs.metrics.HISTOGRAM_QUANTILES`) as
+    ``name{quantile="0.99"}`` series plus ``name_sum`` /
+    ``name_count`` — because the sketch gives real bounded-error
+    percentiles, not pre-binned bucket counts.
+    """
+    if samples is None:
+        samples = obs_metrics.collect()
+    by_name = {}
+    for sample in samples:
+        by_name.setdefault(sample["name"], []).append(sample)
+    lines = []
+    for name in sorted(by_name):
+        group = by_name[name]
+        if not _NAME_RE.match(name):
+            logger.warning("skipping non-prometheus metric name %r",
+                           name)
+            continue
+        mtype = group[0]["mtype"]
+        help_text = group[0].get("help") or ""
+        if help_text:
+            lines.append(f"# HELP {name} {_escape_help(help_text)}")
+        lines.append(
+            f"# TYPE {name} "
+            f"{'summary' if mtype == 'histogram' else mtype}")
+        for sample in group:
+            labels = sample["labels"]
+            value = sample["value"]
+            if mtype != "histogram":
+                lines.append(f"{name}{_label_str(labels)} "
+                             f"{_fmt_value(value)}")
+                continue
+            for q in obs_metrics.HISTOGRAM_QUANTILES:
+                quant = value.get(f"p{int(q * 100)}")
+                if quant is None:
+                    continue
+                qlabels = dict(labels, quantile=f"{q:g}")
+                lines.append(f"{name}{_label_str(qlabels)} "
+                             f"{_fmt_value(quant)}")
+            lines.append(f"{name}_sum{_label_str(labels)} "
+                         f"{_fmt_value(value['sum'])}")
+            lines.append(f"{name}_count{_label_str(labels)} "
+                         f"{_fmt_value(value['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus_text(text):
+    """Minimal Prometheus text-format parser: returns
+    ``(families, errors)`` where ``families`` maps each metric
+    family name to ``{"type": str, "help": str, "samples":
+    [(sample_name, labels_dict, float_value)]}`` and ``errors`` is a
+    list of ``"line N: problem"`` strings (empty = the document is
+    well-formed).  This is the in-repo validator the OBS002 CI gate
+    scrapes ``/metrics`` through — samples must parse, carry float
+    values, and belong to a declared family (``_sum``/``_count``
+    suffixes fold into their summary family)."""
+    families = {}
+    errors = []
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) != 4:
+                errors.append(f"line {lineno}: malformed TYPE line")
+                continue
+            _, _, name, mtype = parts
+            if mtype not in ("counter", "gauge", "summary",
+                            "histogram", "untyped"):
+                errors.append(
+                    f"line {lineno}: unknown metric type {mtype!r}")
+                continue
+            families.setdefault(
+                name, {"type": mtype, "help": "", "samples": []})[
+                    "type"] = mtype
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 3:
+                errors.append(f"line {lineno}: malformed HELP line")
+                continue
+            name = parts[2]
+            families.setdefault(
+                name, {"type": "untyped", "help": "",
+                       "samples": []})["help"] = \
+                parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("#"):
+            continue  # plain comment
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {lineno}: unparseable sample "
+                          f"{line!r}")
+            continue
+        name = m.group("name")
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            errors.append(
+                f"line {lineno}: non-numeric value "
+                f"{m.group('value')!r}")
+            continue
+        labels = {lm.group("key"): _unescape_label(lm.group("value"))
+                  for lm in _LABEL_RE.finditer(
+                      m.group("labels") or "")}
+        family = name
+        for suffix in ("_sum", "_count", "_bucket"):
+            if name.endswith(suffix) \
+                    and name[:-len(suffix)] in families:
+                family = name[:-len(suffix)]
+                break
+        if family not in families:
+            errors.append(
+                f"line {lineno}: sample {name!r} has no TYPE/HELP "
+                "family declaration")
+            continue
+        families[family]["samples"].append((name, labels, value))
+    for name, fam in families.items():
+        if not fam["samples"]:
+            errors.append(f"family {name!r} declared but has no "
+                          "samples")
+    return families, errors
+
+
+class TelemetryServer:
+    """The opt-in exposition daemon (see module docstring).
+
+    Parameters
+    ----------
+    port : int
+        TCP port; 0 binds an ephemeral port (read
+        :attr:`port` after :meth:`start`).
+    host : str
+        Bind address.  Default ``127.0.0.1`` — the endpoint is
+        unauthenticated, so wide exposure is an explicit choice:
+        pass ``host=""`` (or set ``BRAINIAK_TPU_OBS_HTTP_HOST=""``
+        for the env-driven path) to bind all interfaces for a real
+        scraper.
+    readiness : callable, optional
+        Zero-arg callable returning ``(ok, detail_dict)``; drives
+        ``/readyz`` (200/503 + JSON detail).  Without one,
+        ``/readyz`` mirrors liveness.
+    registry : :class:`~brainiak_tpu.obs.metrics.MetricsRegistry`,
+        optional
+        Metrics source (default: the process default registry).
+    """
+
+    def __init__(self, port=0, host="127.0.0.1", readiness=None,
+                 registry=None):
+        self.requested_port = int(port)
+        self.host = host
+        self.readiness = readiness
+        self.registry = registry
+        self._httpd = None   # guarded-by: _lock
+        self._thread = None  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    @property
+    def port(self):
+        """The actually-bound port (None before :meth:`start`)."""
+        with self._lock:
+            return self._httpd.server_address[1] \
+                if self._httpd is not None else None
+
+    def start(self):
+        """Bind and serve on a daemon thread (idempotent); returns
+        self."""
+        with self._lock:
+            if self._httpd is not None:
+                return self
+            server = self
+
+            class Handler(BaseHTTPRequestHandler):
+                def do_GET(self):  # noqa: N802 (stdlib API name)
+                    server._handle(self)
+
+                def log_message(self, fmt, *args):
+                    logger.debug("obs http: " + fmt, *args)
+
+            self._httpd = ThreadingHTTPServer(
+                (self.host, self.requested_port), Handler)
+            self._httpd.daemon_threads = True
+            httpd = self._httpd
+            self._thread = threading.Thread(
+                # stdlib serve_forever polls its shutdown flag at
+                # 0.5 s by default — a service shutdown would stall
+                # on it (and a bench drive would charge it to
+                # telemetry overhead); 20 ms keeps stop() prompt
+                target=lambda: httpd.serve_forever(
+                    poll_interval=0.02),
+                name="obs-http", daemon=True)
+            self._thread.start()
+        logger.info("obs http exposition on port %s", self.port)
+        return self
+
+    def stop(self):
+        """Shut the listener down (idempotent)."""
+        with self._lock:
+            httpd, thread = self._httpd, self._thread
+            self._httpd = None
+            self._thread = None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+
+    # -- request handling (http handler threads) ----------------------
+
+    def _handle(self, handler):
+        path = handler.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                registry = self.registry
+                samples = registry.collect() if registry is not None \
+                    else obs_metrics.collect()
+                self._respond(
+                    handler, 200, render_prometheus(samples),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                self._respond(handler, 200, "ok\n", "text/plain")
+            elif path == "/readyz":
+                self._ready(handler)
+            else:
+                self._respond(handler, 404,
+                              f"unknown path {path!r}; endpoints: "
+                              "/metrics /healthz /readyz\n",
+                              "text/plain")
+        except Exception:  # exposition must never kill the server
+            logger.exception("obs http handler failed for %s", path)
+            try:
+                self._respond(handler, 500, "internal error\n",
+                              "text/plain")
+            except Exception:
+                pass
+
+    def _ready(self, handler):
+        if self.readiness is None:
+            self._respond(handler, 200,
+                          json.dumps({"ready": True}) + "\n",
+                          "application/json")
+            return
+        ok, detail = self.readiness()
+        body = json.dumps(dict({"ready": bool(ok)}, **(detail or {})),
+                          indent=2, sort_keys=True) + "\n"
+        self._respond(handler, 200 if ok else 503, body,
+                      "application/json")
+
+    @staticmethod
+    def _respond(handler, status, body, content_type):
+        payload = body.encode("utf-8")
+        handler.send_response(status)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(payload)))
+        handler.end_headers()
+        handler.wfile.write(payload)
+
+
+def maybe_start_from_env(readiness=None):
+    """Start a :class:`TelemetryServer` when
+    ``BRAINIAK_TPU_OBS_HTTP_PORT`` names a port; returns the started
+    server or None (unset/invalid = the default: no listener).
+    ``BRAINIAK_TPU_OBS_HTTP_HOST`` overrides the bind address
+    (default loopback; empty string = all interfaces)."""
+    raw = os.environ.get(HTTP_PORT_ENV)
+    if raw is None or raw == "":
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", HTTP_PORT_ENV,
+                       raw)
+        return None
+    if port < 0:
+        return None
+    host = os.environ.get(HTTP_HOST_ENV, "127.0.0.1")
+    return TelemetryServer(port=port, host=host,
+                           readiness=readiness).start()

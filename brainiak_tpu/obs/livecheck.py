@@ -1,0 +1,125 @@
+"""OBS002 selfcheck: the live telemetry plane, end to end.
+
+The ``obs-live`` gate of ``tools/run_checks.py`` runs
+:func:`selfcheck` in a CPU-pinned child process: drive a tiny
+in-process :class:`~brainiak_tpu.serve.service.ServeService` (demo
+SRM, a handful of mixed-shape requests) with SLO tracking attached
+and the exposition endpoint on an **ephemeral** port, then scrape
+``/metrics`` + ``/healthz`` + ``/readyz`` over real HTTP and verify:
+
+- the scrape parses with the minimal in-repo Prometheus parser
+  (:func:`brainiak_tpu.obs.http.parse_prometheus_text`) with zero
+  errors;
+- the required ``serve_*`` and ``slo_*`` families are present
+  (:data:`REQUIRED_SERIES`);
+- the scraped ``serve_requests_total{outcome="ok"}`` agrees with
+  the service summary's ``n_ok`` (the exposition and the JSON
+  summary must tell one story);
+- ``/healthz`` answers 200 and ``/readyz`` reports ready with a
+  resident model.
+
+Prints one JSON verdict line; exit 0 on success, 1 with the verdict
+naming what failed — the gate classifies from the verdict, not from
+a traceback.
+"""
+
+import json
+import urllib.request
+
+__all__ = ["REQUIRED_SERIES", "selfcheck"]
+
+#: Metric families a healthy live scrape must expose (the series the
+#: ROADMAP item 3 router and the SLO dashboards read).
+REQUIRED_SERIES = (
+    "serve_requests_total",
+    "serve_request_seconds",
+    "serve_queue_depth",
+    "serve_service_ingress_depth",
+    "slo_burn_rate",
+    "slo_error_budget_remaining",
+)
+
+
+def _get(port, path, timeout=10.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}",
+            timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+def selfcheck(n_requests=12):
+    """Run the live-plane check (see module docstring); returns the
+    process exit code."""
+    from ..serve import BucketPolicy, ModelResidency
+    from ..serve.__main__ import (build_demo_model,
+                                  build_mixed_requests)
+    from ..serve.service import ServeService
+    from . import sink as obs_sink
+    from .http import parse_prometheus_text
+    from .slo import Objective
+
+    verdict = {"ok": False, "missing": [], "parse_errors": [],
+               "n_requested": n_requests}
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        model = build_demo_model(n_subjects=2, voxels=24,
+                                 samples=20, features=4, n_iter=2)
+        requests = build_mixed_requests(model, n_requests)
+        residency = ModelResidency(
+            budget_bytes=1 << 30,
+            policy=BucketPolicy(max_batch=8, max_wait_s=0.01))
+        residency.register("demo", model=model)
+        svc = ServeService(
+            residency, default_model="demo", http_port=0,
+            slos=[Objective.latency("p99_latency", quantile=0.99,
+                                    threshold_s=30.0),
+                  Objective.error_rate("availability",
+                                       max_error_rate=0.01)],
+        ).start()
+        try:
+            tickets = svc.submit_many(requests)
+            for ticket in tickets:
+                ticket.result(timeout=120.0)
+            port = svc.summary().get("http_port")
+            verdict["http_port"] = port
+            status, text = _get(port, "/metrics")
+            verdict["metrics_status"] = status
+            families, errors = parse_prometheus_text(text)
+            verdict["parse_errors"] = errors
+            verdict["n_families"] = len(families)
+            verdict["missing"] = [name for name in REQUIRED_SERIES
+                                  if name not in families]
+            # the exposition and the JSON summary must agree on
+            # requests served
+            scraped_ok = sum(
+                value for fam in ("serve_requests_total",)
+                for name, labels, value in
+                families.get(fam, {"samples": []})["samples"]
+                if labels.get("outcome") == "ok")
+            health_status, health_body = _get(port, "/healthz")
+            verdict["healthz_ok"] = (
+                health_status == 200
+                and health_body.strip() == "ok")
+            ready_status, ready_body = _get(port, "/readyz")
+            verdict["readyz_status"] = ready_status
+            verdict["readyz_ready"] = bool(
+                json.loads(ready_body).get("ready"))
+        finally:
+            summary = svc.shutdown()
+        verdict["n_ok"] = summary["n_ok"]
+        verdict["scraped_ok"] = scraped_ok
+        verdict["counts_agree"] = \
+            int(scraped_ok) == summary["n_ok"] == n_requests
+        verdict["ok"] = bool(
+            verdict["metrics_status"] == 200
+            and not verdict["parse_errors"]
+            and not verdict["missing"]
+            and verdict["healthz_ok"]
+            and verdict["readyz_ready"]
+            and verdict["counts_agree"])
+    except Exception as exc:  # the gate wants a verdict, not a trace
+        verdict["error"] = f"{type(exc).__name__}: {exc}"
+    finally:
+        obs_sink.remove_sink(mem)
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1

@@ -16,8 +16,10 @@ time histograms (``checkpoint_seconds``).
 import threading
 
 from . import sink
+from .sketch import QuantileSketch
 
 __all__ = [
+    "HISTOGRAM_QUANTILES",
     "Counter",
     "Gauge",
     "Histogram",
@@ -29,6 +31,10 @@ __all__ = [
     "histogram",
     "reset",
 ]
+
+#: Quantiles histograms surface in :func:`collect` summaries and the
+#: ``/metrics`` exposition (:mod:`brainiak_tpu.obs.http`).
+HISTOGRAM_QUANTILES = (0.5, 0.9, 0.99)
 
 
 def _label_key(labels):
@@ -107,10 +113,24 @@ class Gauge(_Metric):
 
 
 class Histogram(_Metric):
-    """Streaming summary (count/sum/min/max) per label set; emitted
-    records carry each observation."""
+    """Streaming summary (count/sum/min/max + sketch quantiles) per
+    label set; emitted records carry each observation.
+
+    Every label set is additionally backed by a mergeable
+    :class:`~brainiak_tpu.obs.sketch.QuantileSketch`, so
+    :meth:`summary`/:func:`collect` report real p50/p90/p99 values
+    (:data:`HISTOGRAM_QUANTILES`, bounded relative error) instead of
+    only the min/max envelope, and :meth:`sketch` hands a **copy**
+    out for cross-replica merging."""
 
     mtype = "histogram"
+
+    def __init__(self, name, help="", unit=None):
+        super().__init__(name, help=help, unit=unit)
+        # accessed under the base _Metric._lock, like _values (the
+        # lock-rule annotation lives with locks declared in the
+        # same class; the base holds this one)
+        self._sketches = {}
 
     def observe(self, value, **labels):
         value = float(value)
@@ -120,17 +140,54 @@ class Histogram(_Metric):
             if cur is None:
                 self._values[key] = {"count": 1, "sum": value,
                                      "min": value, "max": value}
+                self._sketches[key] = QuantileSketch()
             else:
                 cur["count"] += 1
                 cur["sum"] += value
                 cur["min"] = min(cur["min"], value)
                 cur["max"] = max(cur["max"], value)
+            self._sketches[key].observe(value)
         self._emit(value, labels)
 
     def summary(self, **labels):
         with self._lock:
             cur = self._values.get(_label_key(labels))
-            return dict(cur) if cur else None
+            if not cur:
+                return None
+            out = dict(cur)
+            out.update(self._quantile_fields(_label_key(labels)))
+            return out
+
+    def _quantile_fields(self, key):
+        # callers hold the base _Metric._lock
+        sk = self._sketches.get(key)
+        if sk is None:
+            return {}
+        return {f"p{int(q * 100)}": sk.quantile(q)
+                for q in HISTOGRAM_QUANTILES}
+
+    def quantile(self, q, **labels):
+        """The ``q``-quantile of one label set's observations (None
+        before the first observation)."""
+        with self._lock:
+            sk = self._sketches.get(_label_key(labels))
+            return sk.quantile(q) if sk is not None else None
+
+    def sketch(self, **labels):
+        """A **copy** of one label set's sketch (None before the
+        first observation) — safe to merge/serialize without racing
+        :meth:`observe`."""
+        with self._lock:
+            sk = self._sketches.get(_label_key(labels))
+            return QuantileSketch.from_dict(sk.to_dict()) \
+                if sk is not None else None
+
+    def samples(self):
+        """[(labels dict, summary dict incl. sketch quantiles)]."""
+        with self._lock:
+            return [(dict(key),
+                     dict(value, **self._quantile_fields(key)))
+                    for key, value in self._values.items()]
 
 
 class MetricsRegistry:
@@ -174,6 +231,7 @@ class MetricsRegistry:
                 out.append({"name": metric.name,
                             "mtype": metric.mtype,
                             "unit": metric.unit,
+                            "help": metric.help,
                             "labels": labels,
                             "value": value})
         out.sort(key=lambda s: (s["name"], sorted(s["labels"].items())))

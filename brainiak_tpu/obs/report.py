@@ -278,8 +278,18 @@ def aggregate(records):
     events = {}
     metrics = {}
     costs = []
+    dropped = 0
     for rec in records:
         kind = rec["kind"]
+        if kind == "event" and rec["name"] == "obs_dropped":
+            # the truncated sink's close-time drop count: surface it
+            # as a headline so a capped trace reads as incomplete,
+            # not quiet (summed across rank files)
+            attrs = rec.get("attrs") or {}
+            try:
+                dropped += int(attrs.get("dropped_total", 0))
+            except (TypeError, ValueError):
+                pass
         if kind == "cost":
             row = {k: rec[k] for k in _COST_KEYS if k in rec}
             row["rank"] = rec["rank"]
@@ -342,6 +352,7 @@ def aggregate(records):
     _roofline(costs, span_rows)
     return {
         "n_records": len(records),
+        "dropped_records": dropped,
         "spans": span_rows,
         "events": [{"name": name, "count": count}
                    for name, count in sorted(events.items())],
@@ -361,6 +372,11 @@ def _fmt_quantity(value):
 def render_text(summary):
     """Human-readable tables for the aggregate summary."""
     lines = [f"records: {summary['n_records']}"]
+    if summary.get("dropped_records"):
+        lines.append(
+            f"WARNING: {summary['dropped_records']} record(s) "
+            "dropped after the BRAINIAK_TPU_OBS_MAX_MB cap — this "
+            "trace is incomplete")
     if summary.get("top_spans"):
         lines.append("")
         lines.append(f"slowest spans (top {summary['top_n']} per "

@@ -20,6 +20,7 @@ instrumented hot loops (acceptance-tested in
 """
 
 import atexit
+import contextlib
 import io
 import json
 import logging
@@ -48,6 +49,7 @@ __all__ = [
     "make_record",
     "process_rank",
     "remove_sink",
+    "suspended",
     "validate_record",
 ]
 
@@ -59,10 +61,12 @@ OBS_RANK_ENV = "BRAINIAK_TPU_OBS_RANK"
 #: the ``obs`` gate of ``tools/run_checks.py`` reject records whose
 #: version or shape they do not understand.  v2 (PR 4) added the
 #: ``cost`` kind (XLA cost-analysis attribution, see
-#: :mod:`brainiak_tpu.obs.profile`); v1 records remain valid, so
-#: pre-existing traces keep loading.
-SCHEMA_VERSION = 2
-ACCEPTED_VERSIONS = (1, 2)
+#: :mod:`brainiak_tpu.obs.profile`); v3 (PR 12) added the optional
+#: request-tracing fields ``trace_id``/``span_id``/``parent_id`` on
+#: span and event records (:mod:`brainiak_tpu.obs.trace`).  v1/v2
+#: records remain valid, so pre-existing traces keep loading.
+SCHEMA_VERSION = 3
+ACCEPTED_VERSIONS = (1, 2, 3)
 
 KINDS = ("span", "event", "metric", "cost")
 METRIC_TYPES = ("counter", "gauge", "histogram")
@@ -81,8 +85,14 @@ _REQUIRED = {
     "cost": {"site": str},
 }
 _OPTIONAL = {
-    "span": {"attrs": dict},
-    "event": {"attrs": dict},
+    # trace_id/span_id/parent_id (schema v3): request-scoped tracing
+    # (obs.trace) — a span/event may belong to one request's
+    # end-to-end trace, with parent_id naming the causally-preceding
+    # span so the export CLI reconstructs per-request flows
+    "span": {"attrs": dict, "trace_id": str, "span_id": str,
+             "parent_id": str},
+    "event": {"attrs": dict, "trace_id": str, "span_id": str,
+              "parent_id": str},
     "metric": {"labels": dict, "unit": str},
     # cost: FLOPs/bytes may be absent (backend without cost_analysis
     # reports `unavailable` instead); span/estimator are join hints
@@ -241,9 +251,13 @@ class JsonlSink:
     caps the bytes this sink will write across all its rank files: a
     multi-day fit with per-chunk spans must not fill the disk.  On
     reaching the cap the sink writes ONE ``obs_truncated`` event (so
-    the trace records its own incompleteness) and silently drops
-    every later record; the in-process metric registry keeps
-    aggregating regardless.
+    the trace records its own incompleteness) and drops every later
+    record — but keeps COUNTING them: :meth:`close` stamps one final
+    ``obs_dropped`` event carrying ``dropped_total`` (the one record
+    allowed past the cap), so ``obs report`` can state exactly how
+    incomplete a truncated trace is instead of implying the run went
+    quiet.  The in-process metric registry keeps aggregating
+    regardless.
     """
 
     def __init__(self, directory, rank=None, max_mb=None):
@@ -264,6 +278,15 @@ class JsonlSink:
             else int(max_mb * 1024 * 1024)
         self._written = 0        # guarded-by: _lock
         self._truncated = False  # guarded-by: _lock
+        self._dropped = 0        # guarded-by: _lock
+        self._drop_stamped = False  # guarded-by: _lock
+
+    @property
+    def dropped_total(self):
+        """Records dropped after the ``max_mb`` cap hit (0 while the
+        sink is under the cap)."""
+        with self._lock:
+            return self._dropped
 
     @property
     def path(self):
@@ -283,11 +306,15 @@ class JsonlSink:
     def write(self, record):
         with self._lock:
             if self._truncated:
+                self._dropped += 1
                 return
             line = json.dumps(record, default=_json_default) + "\n"
             if self.max_bytes is not None \
                     and self._written + len(line) > self.max_bytes:
                 self._truncated = True
+                # the record whose write tripped the cap is dropped
+                # too (the marker takes its slot)
+                self._dropped += 1
                 line = json.dumps(make_record(
                     "event", "obs_truncated",
                     attrs={"limit_mb":
@@ -310,6 +337,23 @@ class JsonlSink:
 
     def close(self):
         with self._lock:
+            # a truncated sink owes the trace its own drop count:
+            # ONE final event past the cap (stamped once even across
+            # repeated close() calls), so `obs report` renders
+            # dropped_total instead of implying the run went quiet
+            if self._truncated and self._dropped \
+                    and not self._drop_stamped:
+                self._drop_stamped = True
+                line = json.dumps(make_record(
+                    "event", "obs_dropped",
+                    attrs={"dropped_total": self._dropped}),
+                    default=_json_default) + "\n"
+                try:
+                    fh = self._ensure_open()
+                    fh.write(line)
+                    fh.flush()
+                except OSError:  # disk full is how we got here
+                    pass
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
@@ -334,7 +378,28 @@ _env_sink = None     # guarded-by: _lock
 _env_dir_seen = None  # guarded-by: _lock
 # env sink disabled after a write failure
 _env_broken = False  # guarded-by: _lock
+# nesting depth of active suspended() blocks (see below)
+_suspend_depth = 0   # guarded-by: _lock
 _lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def suspended():
+    """Temporarily force :func:`enabled` False (nests; thread-wide).
+
+    The obs-off reference lane for overhead measurement: the bench's
+    ``service_obs_overhead_ratio`` drives the same workload once
+    with telemetry live and once under this block, without tearing
+    down (and thereby closing) the registered sinks.  Instrumented
+    sites see plain disabled behavior — no records, no syncs."""
+    global _suspend_depth
+    with _lock:
+        _suspend_depth += 1
+    try:
+        yield
+    finally:
+        with _lock:
+            _suspend_depth -= 1
 
 
 def add_sink(sink):
@@ -380,8 +445,11 @@ def enabled():
     An env-configured sink that was disabled by a write failure turns
     this False again, so instrumentation stops paying for records
     nobody can receive; pointing the env var at a DIFFERENT directory
-    re-enables (it gets a fresh sink).
+    re-enables (it gets a fresh sink).  An active :func:`suspended`
+    block wins over everything.
     """
+    if _suspend_depth:
+        return False
     if _sinks:
         return True
     directory = os.environ.get(OBS_DIR_ENV)
@@ -391,9 +459,12 @@ def enabled():
 
 
 def all_sinks():
-    """The currently-active sinks (explicit + env-configured)."""
+    """The currently-active sinks (explicit + env-configured);
+    empty under an active :func:`suspended` block."""
     _configure_from_env()
     with _lock:
+        if _suspend_depth:
+            return []
         sinks = list(_sinks)
         if _env_sink is not None:
             sinks.append(_env_sink)

@@ -1,0 +1,323 @@
+"""Declarative SLOs with multi-window burn-rate tracking.
+
+The serving tiers promise latencies (ROADMAP item 4's per-TR budget
+is a hard one); promises need an evaluator that runs *while the
+service does*, not a post-hoc log query.  This module is the
+standard SRE construction (error budgets + multi-window burn rates,
+Beyer et al., "The Site Reliability Workbook" ch. 5) on top of the
+obs primitives:
+
+- an :class:`Objective` declares what fraction of requests must be
+  *good* (``target``, e.g. 0.999) and what good means — delivered
+  ok, and (for latency objectives) within ``latency_threshold_s``.
+  "p99 under 500 ms" is declared as
+  ``Objective.latency("p99", quantile=0.99, threshold_s=0.5)``:
+  99% of requests must finish inside the threshold, the
+  budget-burn formulation of a quantile target;
+- a **burn rate** is budget consumption speed: observed bad
+  fraction / allowed bad fraction over a window.  Burn 1.0 spends
+  exactly the budget over the budget window; burn 14.4 exhausts a
+  30-day budget in ~2 days;
+- a :class:`BurnRule` pairs a long and a short window with a factor
+  (defaults: the workbook's 1h/5m @ 14.4 and 6h/30m @ 6).  A
+  violation fires only when **both** windows burn past the factor —
+  the long window provides significance, the short window confirms
+  the problem is still live (so a recovered blip stops alerting
+  immediately);
+- an :class:`SLOTracker` ingests per-request outcomes (O(1), into
+  time-sliced counters), evaluates the rules, emits
+  ``slo_violation`` events to the sink on each transition into
+  violation, and keeps ``slo_burn_rate{slo=,window=}`` /
+  ``slo_error_budget_remaining{slo=}`` gauges fresh in the metric
+  registry — which is exactly what ``/metrics``
+  (:mod:`brainiak_tpu.obs.http`) exposes.
+
+:class:`~brainiak_tpu.serve.service.ServeService` accepts
+``slos=[...]`` and feeds every delivered record through its tracker
+on the service thread; the tracker carries its own lock, so
+dashboards may also evaluate it directly.
+"""
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from . import metrics as obs_metrics
+from . import sink as obs_sink
+
+__all__ = ["DEFAULT_BURN_RULES", "BurnRule", "Objective",
+           "SLOTracker"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnRule:
+    """One multi-window burn-rate alert rule: fire when the error
+    budget burns at ``factor``x or faster over BOTH the long and the
+    short window."""
+
+    long_s: float
+    short_s: float
+    factor: float
+
+    def label(self):
+        return f"{self.long_s:g}s/{self.short_s:g}s"
+
+
+#: The SRE-workbook default pairing (scaled to a 30-day budget):
+#: page-worthy fast burn (1h/5m at 14.4x) and slow burn (6h/30m at
+#: 6x).
+DEFAULT_BURN_RULES = (
+    BurnRule(long_s=3600.0, short_s=300.0, factor=14.4),
+    BurnRule(long_s=21600.0, short_s=1800.0, factor=6.0),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """One service-level objective: ``target`` fraction of requests
+    must be good.  A request is *bad* when its record is an error,
+    or — with ``latency_threshold_s`` set — when it was served
+    slower than the threshold."""
+
+    name: str
+    target: float = 0.999
+    latency_threshold_s: Optional[float] = None
+    description: str = ""
+
+    def __post_init__(self):
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(
+                f"objective {self.name!r}: target must be in "
+                f"(0, 1), got {self.target}")
+
+    @classmethod
+    def latency(cls, name, quantile=0.99, threshold_s=1.0,
+                description=""):
+        """A latency quantile target — "p<quantile> must stay under
+        ``threshold_s``" — expressed in budget form: ``quantile`` of
+        requests must finish inside the threshold."""
+        return cls(name=name, target=float(quantile),
+                   latency_threshold_s=float(threshold_s),
+                   description=description
+                   or f"p{quantile * 100:g} latency <= "
+                      f"{threshold_s}s")
+
+    @classmethod
+    def error_rate(cls, name, max_error_rate=0.001,
+                   description=""):
+        """An availability target: at most ``max_error_rate`` of
+        requests may fail."""
+        return cls(name=name, target=1.0 - float(max_error_rate),
+                   description=description
+                   or f"error rate <= {max_error_rate:g}")
+
+    def is_bad(self, ok, latency_s):
+        if not ok:
+            return True
+        return (self.latency_threshold_s is not None
+                and latency_s is not None
+                and latency_s > self.latency_threshold_s)
+
+    def budget(self):
+        """Allowed bad fraction (the error budget's rate form)."""
+        return 1.0 - self.target
+
+
+class _WindowCounts:
+    """Time-sliced good/bad counters for one objective: O(1) ingest
+    into the current slice, windowed sums by summing the few dozen
+    live slices.  Slice width is sized from the shortest rule
+    window, memory is bounded by the longest."""
+
+    __slots__ = ("slice_s", "max_age_s", "slices")
+
+    def __init__(self, slice_s, max_age_s):
+        self.slice_s = float(slice_s)
+        self.max_age_s = float(max_age_s)
+        self.slices = []  # [[slice_start, good, bad], ...] ascending
+
+    def add(self, now, good, bad):
+        start = now - (now % self.slice_s)
+        if self.slices and self.slices[-1][0] == start:
+            self.slices[-1][1] += good
+            self.slices[-1][2] += bad
+        else:
+            self.slices.append([start, good, bad])
+            self.prune(now)
+
+    def prune(self, now):
+        cutoff = now - self.max_age_s - self.slice_s
+        while self.slices and self.slices[0][0] < cutoff:
+            self.slices.pop(0)
+
+    def window(self, now, window_s):
+        """(good, bad) over the trailing ``window_s``."""
+        cutoff = now - window_s
+        good = bad = 0
+        for start, g, b in reversed(self.slices):
+            if start + self.slice_s <= cutoff:
+                break
+            good += g
+            bad += b
+        return good, bad
+
+
+class SLOTracker:
+    """Ingest request outcomes, evaluate burn rules, surface budget
+    state (see module docstring).
+
+    Parameters
+    ----------
+    objectives : iterable of :class:`Objective`
+    burn_rules : iterable of :class:`BurnRule`
+        Default :data:`DEFAULT_BURN_RULES`; tests pass short windows
+        with a fake ``clock``.
+    clock : callable
+        Monotonic time source (default ``time.monotonic``).
+    min_window_count : int
+        A window with fewer total events than this is never judged
+        (early traffic must not page at the first error).
+    gauge_interval_s : float
+        Minimum spacing between ``slo_*`` gauge refreshes: the
+        service evaluates every working tick (milliseconds apart),
+        and each gauge set also writes a sink record while obs is
+        enabled — violation *detection* stays per-evaluate, the
+        gauge/record fan-out is throttled to this cadence (and
+        always refreshed on a violation transition).
+    """
+
+    def __init__(self, objectives, burn_rules=DEFAULT_BURN_RULES,
+                 clock=time.monotonic, min_window_count=10,
+                 gauge_interval_s=1.0):
+        self.objectives = list(objectives)
+        if not self.objectives:
+            raise ValueError("SLOTracker needs >= 1 objective")
+        names = [o.name for o in self.objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"duplicate objective names: {sorted(names)}")
+        self.burn_rules = tuple(burn_rules)
+        if not self.burn_rules:
+            raise ValueError("SLOTracker needs >= 1 burn rule")
+        self.clock = clock
+        self.min_window_count = int(min_window_count)
+        shortest = min(r.short_s for r in self.burn_rules)
+        longest = max(r.long_s for r in self.burn_rules)
+        self._lock = threading.Lock()
+        self._counts = {
+            o.name: _WindowCounts(max(shortest / 10.0, 1e-6),
+                                  longest)
+            for o in self.objectives}  # guarded-by: _lock
+        # rule-keyed set of currently-violating (objective, rule)
+        # pairs: violations emit on the transition INTO violation
+        self._active = set()       # guarded-by: _lock
+        self._n_violations = 0     # guarded-by: _lock
+        self.gauge_interval_s = float(gauge_interval_s)
+        self._last_gauge = None    # guarded-by: _lock
+
+    # -- ingest -------------------------------------------------------
+
+    def record(self, ok, latency_s=None, n=1):
+        """Account ``n`` requests with one outcome (O(1) per
+        objective)."""
+        now = self.clock()
+        with self._lock:
+            for objective in self.objectives:
+                bad = objective.is_bad(bool(ok), latency_s)
+                self._counts[objective.name].add(
+                    now, 0 if bad else n, n if bad else 0)
+
+    # -- evaluation ---------------------------------------------------
+
+    def evaluate(self, now=None):
+        """Evaluate every objective against every burn rule; update
+        the ``slo_*`` gauges; emit one ``slo_violation`` event per
+        (objective, rule) transition into violation.  Returns the
+        per-objective state dict (also served by
+        ``ServeService.summary()[\"slo\"]``)."""
+        if now is None:
+            now = self.clock()
+        out = {}
+        events = []
+        with self._lock:
+            for objective in self.objectives:
+                counts = self._counts[objective.name]
+                counts.prune(now)
+                budget = objective.budget()
+                state = {"target": objective.target,
+                         "description": objective.description,
+                         "windows": {}, "violating": False}
+                longest = max(r.long_s for r in self.burn_rules)
+                for rule in self.burn_rules:
+                    burns = {}
+                    judged = True
+                    for window_s in (rule.long_s, rule.short_s):
+                        good, bad = counts.window(now, window_s)
+                        total = good + bad
+                        ratio = (bad / total) if total else 0.0
+                        burn = ratio / budget
+                        burns[window_s] = burn
+                        state["windows"][f"{window_s:g}s"] = {
+                            "total": total, "bad": bad,
+                            "bad_ratio": ratio, "burn_rate": burn}
+                        if total < self.min_window_count:
+                            judged = False
+                    violating = judged and all(
+                        b >= rule.factor for b in burns.values())
+                    key = (objective.name, rule)
+                    if violating:
+                        state["violating"] = True
+                        if key not in self._active:
+                            self._active.add(key)
+                            self._n_violations += 1
+                            events.append((objective, rule, burns))
+                    else:
+                        self._active.discard(key)
+                # budget remaining over the longest configured
+                # window: 1 - consumed fraction, floored at 0
+                good, bad = counts.window(now, longest)
+                total = good + bad
+                ratio = (bad / total) if total else 0.0
+                state["error_budget_remaining"] = max(
+                    0.0, 1.0 - ratio / budget)
+                state["n_requests"] = total
+                out[objective.name] = state
+            n_violations = self._n_violations
+            refresh_gauges = (
+                events
+                or self._last_gauge is None
+                or now - self._last_gauge >= self.gauge_interval_s)
+            if refresh_gauges:
+                self._last_gauge = now
+        # telemetry outside the lock (sink writes are file I/O)
+        for name, state in (out.items() if refresh_gauges else ()):
+            obs_metrics.gauge(
+                "slo_error_budget_remaining",
+                help="fraction of the error budget left over the "
+                     "longest burn window").set(
+                    state["error_budget_remaining"], slo=name)
+            for window, wstate in state["windows"].items():
+                obs_metrics.gauge(
+                    "slo_burn_rate",
+                    help="error-budget burn rate (1.0 = spending "
+                         "exactly the budget)").set(
+                        wstate["burn_rate"], slo=name,
+                        window=window)
+        for objective, rule, burns in events:
+            obs_metrics.counter(
+                "slo_violations_total",
+                help="burn-rule violations (transitions into "
+                     "violation)").inc(slo=objective.name)
+            obs_sink.event(
+                "slo_violation", slo=objective.name,
+                target=objective.target,
+                rule=rule.label(), factor=rule.factor,
+                burn_rates={f"{w:g}s": round(b, 4)
+                            for w, b in burns.items()})
+        return {"objectives": out, "n_violations": n_violations}
+
+    def summary(self):
+        """:meth:`evaluate` at the current clock — the service
+        summary hook."""
+        return self.evaluate()

@@ -1,0 +1,180 @@
+"""Request-scoped tracing: one end-to-end trace per serve request.
+
+The PR 3/4 span tree answers "where does *time* go"; serving needs
+the orthogonal question — "where did *this request* go" — across
+threads (the submit happens on a caller thread, dispatch on the
+service loop) and across processes (a router process submits, a
+replica process serves).  A **trace** is the unit of that question:
+
+- a ``trace_id`` (16 hex chars) minted once per request at
+  :meth:`~brainiak_tpu.serve.service.ServeService.submit` /
+  ``submit_many`` — or pre-assigned by an upstream process and
+  carried in through the npz request codec (:func:`inject_npz` /
+  :func:`extract_npz`), so multi-process replicas join the
+  *submitter's* trace instead of starting their own;
+- a chain of spans, each carrying its own ``span_id`` (8 hex chars)
+  and the ``parent_id`` of the causally-preceding span:
+  ``serve.submit`` (service ingress) → ``serve.enqueue`` (bucket
+  queue) → ``serve.dispatch`` (the batch that carried it, one span
+  per member request) → ``serve.request`` (delivery, the record the
+  engine already emitted — now parented).
+
+The trace fields ride the existing span records (sink schema v3,
+optional keys — v1/v2 traces still load), so every downstream tool
+works unchanged and ``obs export --format=chrome-trace``
+additionally renders each trace as a Chrome flow (arrows across
+rank lanes, using the existing topology-anchored clock-skew merge).
+
+Discipline: tracing is live exactly when obs is
+(:func:`~brainiak_tpu.obs.sink.enabled`); disabled, no ids are
+minted, no records emitted, and no host syncs added — the
+instrumented serve loop keeps the PR 3 zero-overhead contract
+(acceptance-tested in ``tests/obs/test_trace.py``).
+"""
+
+import os
+import time
+
+from . import sink
+
+__all__ = [
+    "NPZ_PARENT_KEY",
+    "NPZ_TRACE_KEY",
+    "extract_npz",
+    "inject_npz",
+    "new_span_id",
+    "new_trace_id",
+    "start_trace",
+    "trace_chains",
+    "trace_is_connected",
+    "traced_span",
+]
+
+#: npz codec key patterns for per-request trace propagation
+#: (``save_requests``/``load_requests`` in
+#: :mod:`brainiak_tpu.serve.batching` read/write these).
+NPZ_TRACE_KEY = "trace.{i}"
+NPZ_PARENT_KEY = "trace_parent.{i}"
+
+
+def new_trace_id():
+    """A fresh 16-hex-char trace id (random, collision-safe across
+    processes — no coordination needed between replicas)."""
+    return os.urandom(8).hex()
+
+
+def new_span_id():
+    """A fresh 8-hex-char span id."""
+    return os.urandom(4).hex()
+
+
+def start_trace(request):
+    """Ensure ``request`` carries a ``trace_id``; returns it.
+
+    A pre-assigned id (an upstream submitter's, via the npz codec)
+    is honored — that is what stitches multi-process replicas into
+    one trace.  While obs is disabled no id is minted (zero
+    overhead) and None is returned, but a pre-assigned id still
+    travels on the request untouched."""
+    if getattr(request, "trace_id", None):
+        return request.trace_id
+    if not sink.enabled():
+        return None
+    request.trace_id = new_trace_id()
+    return request.trace_id
+
+
+def traced_span(name, dur_s, request, path=None, attrs=None):
+    """Emit one span record in ``request``'s trace and ADVANCE the
+    chain: the new span's parent is the request's current
+    ``parent_id`` and the request's ``parent_id`` becomes the new
+    span's id, so the next stage parents correctly without knowing
+    what came before.  No-op (returns None) while obs is disabled
+    or the request is untraced."""
+    if not sink.enabled():
+        return None
+    trace_id = getattr(request, "trace_id", None)
+    if not trace_id:
+        return None
+    span_id = new_span_id()
+    sink.emit(sink.make_record(
+        "span", name, path=path or name, dur_s=float(dur_s),
+        trace_id=trace_id, span_id=span_id,
+        parent_id=getattr(request, "parent_id", None),
+        attrs=attrs or None))
+    request.parent_id = span_id
+    return span_id
+
+
+class stage_clock:
+    """Tiny monotonic stopwatch for the traced serve stages (the
+    stages are host-side bookkeeping — enqueue, batch assembly —
+    so no device sync is involved; device-synced timing stays the
+    job of :func:`brainiak_tpu.obs.spans.span`)."""
+
+    __slots__ = ("t0",)
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+
+    def elapsed(self):
+        return time.perf_counter() - self.t0
+
+
+# -- npz request-codec propagation ------------------------------------
+
+def inject_npz(store, index, trace_id, parent_id=None):
+    """Stamp one request's trace context into a request-npz dict
+    (the ``save_requests`` store).  None ids are omitted — the codec
+    stays byte-identical for untraced requests."""
+    import numpy as np
+    if trace_id:
+        store[NPZ_TRACE_KEY.format(i=index)] = \
+            np.asarray(str(trace_id))
+    if parent_id:
+        store[NPZ_PARENT_KEY.format(i=index)] = \
+            np.asarray(str(parent_id))
+    return store
+
+
+def extract_npz(z, index):
+    """``(trace_id, parent_id)`` for one request of a loaded npz
+    (None, None when the request was saved untraced)."""
+    import numpy as np
+    tkey = NPZ_TRACE_KEY.format(i=index)
+    pkey = NPZ_PARENT_KEY.format(i=index)
+    trace_id = str(np.asarray(z[tkey])) if tkey in z.files else None
+    parent_id = str(np.asarray(z[pkey])) if pkey in z.files else None
+    return trace_id, parent_id
+
+
+# -- trace reconstruction (export CLI + tests) ------------------------
+
+def trace_chains(records):
+    """Group span/event records by ``trace_id``, each group sorted
+    by record timestamp: ``{trace_id: [record, ...]}``.  Records
+    without a trace id are ignored."""
+    chains = {}
+    for rec in records:
+        tid = rec.get("trace_id")
+        if tid:
+            chains.setdefault(tid, []).append(rec)
+    for recs in chains.values():
+        recs.sort(key=lambda r: float(r["ts"]))
+    return chains
+
+
+def trace_is_connected(records):
+    """True when one trace's records form a single connected
+    parent-chain: every span's ``parent_id`` is either another
+    member's ``span_id`` or the (single) external root handed in by
+    an upstream process.  The acceptance predicate for "one
+    connected trace per request"."""
+    ids = {rec.get("span_id") for rec in records
+           if rec.get("span_id")}
+    n_roots = 0
+    for rec in records:
+        parent = rec.get("parent_id")
+        if parent is None or parent not in ids:
+            n_roots += 1
+    return n_roots == 1

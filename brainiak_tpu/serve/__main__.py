@@ -142,7 +142,8 @@ def _parse_model_args(values):
 
 
 def drive_service(residency, requests, default_model, waves=4,
-                  wave_gap_s=None, duration_s=None, drain=True):
+                  wave_gap_s=None, duration_s=None, drain=True,
+                  http_port=None, slos=None):
     """Submit ``requests`` to a fresh
     :class:`~brainiak_tpu.serve.ServeService` in ``waves`` staggered
     waves (the late-joiner shape: later waves join buckets already
@@ -151,7 +152,11 @@ def drive_service(residency, requests, default_model, waves=4,
     ``duration_s`` caps the drive's wall clock; on expiry the
     service shuts down per ``drain`` (serve everything queued, or
     fail it with ``shutdown`` records) — either way every ticket
-    resolves.  Returns ``(service summary, records, wall seconds)``
+    resolves.  ``http_port`` opts into the live
+    ``/metrics``/``/healthz``/``/readyz`` exposition for the
+    drive's lifetime (0 = ephemeral; the summary carries the bound
+    port); ``slos`` declares objectives for burn-rate tracking.
+    Returns ``(service summary, records, wall seconds)``
     — shared by the ``service`` subcommand and bench.py's service
     tier so the measured drive cannot drift between them."""
     from .service import ServeService
@@ -162,8 +167,8 @@ def drive_service(residency, requests, default_model, waves=4,
                          if policy is not None else 0.02)
     waves = max(1, min(int(waves), len(requests) or 1))
     per_wave = -(-len(requests) // waves)  # ceil
-    svc = ServeService(residency,
-                       default_model=default_model).start()
+    svc = ServeService(residency, default_model=default_model,
+                       http_port=http_port, slos=slos).start()
     t0 = time.perf_counter()
     deadline = (t0 + duration_s) if duration_s else None
     try:
@@ -218,7 +223,7 @@ def _service(args):
     summary, _, wall = drive_service(
         residency, requests, default_model=models[0][0],
         waves=args.waves, duration_s=args.duration,
-        drain=args.drain)
+        drain=args.drain, http_port=args.http_port)
     summary["wall_s"] = round(wall, 6)
     summary["requests_per_sec"] = (
         round(len(requests) / wall, 3) if wall > 0 else None)
@@ -538,6 +543,13 @@ def main(argv=None):
         "--waves", type=int, default=4,
         help="stagger submissions into this many waves "
              "(default %(default)s)")
+    service_p.add_argument(
+        "--http-port", type=int, metavar="PORT",
+        help="serve live /metrics (Prometheus text), /healthz and "
+             "/readyz on this port for the run's lifetime (0 = "
+             "ephemeral, reported as http_port in the summary; "
+             "default: the BRAINIAK_TPU_OBS_HTTP_PORT env var, "
+             "else no listener)")
     service_p.add_argument("--format", choices=("text", "json"),
                            default="json")
 

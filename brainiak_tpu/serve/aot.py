@@ -247,6 +247,21 @@ class AOTProgramCache:
                     "misses": dict(self._misses),
                     "stores": self._stores}
 
+    def warm(self):
+        """True when this cache can satisfy restarts without a
+        compile stall: persisted ``.jaxprog`` entries exist on disk
+        (a prior process stored programs) or this process already
+        hit/stored some.  The AOT half of the service's ``/readyz``
+        readiness signal."""
+        with self._lock:
+            if self._hits or self._stores or self._programs:
+                return True
+        try:
+            return any(name.endswith(".jaxprog")
+                       for name in os.listdir(self.directory))
+        except OSError:
+            return False
+
     # -- lookup -------------------------------------------------------
 
     def get(self, key, site):

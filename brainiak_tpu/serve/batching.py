@@ -113,6 +113,13 @@ class Request:
     ``model`` names the target model for multi-model serving
     (:class:`~brainiak_tpu.serve.service.ServeService` routes on it;
     the single-model engine ignores it).
+
+    ``trace_id``/``parent_id`` carry the request's end-to-end trace
+    (:mod:`brainiak_tpu.obs.trace`): minted at service submit when
+    obs is live, or pre-assigned by an upstream submitter (and
+    carried through the npz codec) so multi-process replicas join
+    one trace.  ``parent_id`` always names the most recent span in
+    the request's causal chain — each instrumented stage advances it.
     """
 
     request_id: str
@@ -121,6 +128,8 @@ class Request:
     deadline_s: Optional[float] = None
     submitted: Optional[float] = None
     model: Optional[str] = None
+    trace_id: Optional[str] = None
+    parent_id: Optional[str] = None
 
     def expired(self, now=None):
         if self.deadline_s is None or self.submitted is None:
@@ -153,7 +162,7 @@ class ServeResult:
 # -- request-file codec (offline CLI driver) --------------------------
 
 def save_requests(file, payloads, subjects=None, deadlines=None,
-                  ids=None, models=None):
+                  ids=None, models=None, traces=None):
     """Write a batch of requests as one npz.
 
     ``payloads``: list of arrays (or 2-sequences of arrays for the
@@ -161,8 +170,14 @@ def save_requests(file, payloads, subjects=None, deadlines=None,
     ``subjects`` / ``deadlines`` / ``models``: optional per-request
     sequences (None entries are omitted; ``models`` carries the
     multi-model routing name the ``service`` CLI honors); ``ids``
-    default to ``"r<i>"``.  Returns ``file``.
+    default to ``"r<i>"``; ``traces``: optional per-request
+    ``(trace_id, parent_id)`` pairs (or bare trace-id strings) —
+    the cross-process propagation path of
+    :mod:`brainiak_tpu.obs.trace`, so a replica process serving this
+    file continues the submitter's trace.  Returns ``file``.
     """
+    from ..obs import trace as obs_trace
+
     out = {"n": np.asarray(len(payloads))}
     for i, payload in enumerate(payloads):
         if isinstance(payload, (tuple, list)):
@@ -179,12 +194,21 @@ def save_requests(file, payloads, subjects=None, deadlines=None,
             out[f"deadline.{i}"] = np.asarray(float(deadlines[i]))
         if models is not None and models[i] is not None:
             out[f"model.{i}"] = np.asarray(str(models[i]))
+        if traces is not None and traces[i] is not None:
+            entry = traces[i]
+            if isinstance(entry, str):
+                entry = (entry, None)
+            obs_trace.inject_npz(out, i, entry[0], entry[1])
     np.savez_compressed(file, **out)
     return file
 
 
 def load_requests(file):
-    """Read a request npz back into a list of :class:`Request`."""
+    """Read a request npz back into a list of :class:`Request`
+    (trace context, when present, rides back onto the Request — a
+    served request then continues the submitter's trace)."""
+    from ..obs import trace as obs_trace
+
     with np.load(file, allow_pickle=False) as z:
         n = int(z["n"])
         out = []
@@ -203,6 +227,9 @@ def load_requests(file):
                 if f"deadline.{i}" in z.files else None
             model = str(np.asarray(z[f"model.{i}"])) \
                 if f"model.{i}" in z.files else None
+            trace_id, parent_id = obs_trace.extract_npz(z, i)
             out.append(Request(request_id=rid, x=x, subject=subject,
-                               deadline_s=deadline, model=model))
+                               deadline_s=deadline, model=model,
+                               trace_id=trace_id,
+                               parent_id=parent_id))
     return out

@@ -42,6 +42,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
 from ..obs import sink as obs_sink
 from ..obs import spans as obs_spans
+from ..obs import trace as obs_trace
 from ..obs.runtime import counted_cache
 from ..ops.correlation import PRECISION
 from . import artifacts
@@ -790,6 +791,7 @@ class InferenceEngine:
         caller replying from both channels cannot double-respond."""
         if request.submitted is None:
             request.submitted = time.monotonic()
+        clock = obs_trace.stage_clock()
         # submission index travels on the request and into its
         # record: the ordering key must survive duplicate ids
         request._seq_index = self._n_submitted
@@ -809,6 +811,12 @@ class InferenceEngine:
                                       store=False)
         queue = self._queues.setdefault(key, [])
         queue.append(request)
+        # trace stage 2: the request joined a bucket queue (no-op
+        # untraced/disabled; timing is host bookkeeping, no sync)
+        obs_trace.traced_span(
+            "serve.enqueue", clock.elapsed(), request,
+            attrs={"kind": self.kind, "bucket": str(key),
+                   "queue_depth": len(queue)})
         self._gauge_depth()
         if len(queue) >= self.policy.max_batch:
             self._flush_bucket(key)
@@ -928,12 +936,24 @@ class InferenceEngine:
                     rec.latency_s, kind=self.kind,
                     outcome="ok" if rec.ok else "error")
         if obs_sink.enabled() and rec.latency_s is not None:
-            obs_sink.emit(obs_sink.make_record(
-                "span", "serve.request", path="serve.request",
-                dur_s=rec.latency_s,
-                attrs={"kind": self.kind,
-                       "outcome": "ok" if rec.ok else outcome,
-                       "request_id": rec.request_id}))
+            # trace stage 4 (delivery): the per-request latency span
+            # closes the request's trace chain — traced_span threads
+            # trace_id/span_id/parent_id and is a plain span when
+            # the request is untraced
+            if getattr(request, "trace_id", None):
+                obs_trace.traced_span(
+                    "serve.request", rec.latency_s, request,
+                    path="serve.request",
+                    attrs={"kind": self.kind,
+                           "outcome": "ok" if rec.ok else outcome,
+                           "request_id": rec.request_id})
+            else:
+                obs_sink.emit(obs_sink.make_record(
+                    "span", "serve.request", path="serve.request",
+                    dur_s=rec.latency_s,
+                    attrs={"kind": self.kind,
+                           "outcome": "ok" if rec.ok else outcome,
+                           "request_id": rec.request_id}))
 
     def _flush_bucket(self, key):
         queue = self._queues.pop(key, [])
@@ -990,9 +1010,19 @@ class InferenceEngine:
         t0 = time.perf_counter()
         with obs_spans.span("serve.batch", attrs=attrs):
             results = self.op.dispatch(group, key, b_pad)
+        dispatch_s = time.perf_counter() - t0
         obs_metrics.histogram(
             "serve_batch_seconds", unit="s").observe(
-                time.perf_counter() - t0, kind=self.kind)
+                dispatch_s, kind=self.kind)
+        if obs_sink.enabled():
+            # trace stage 3: one serve.dispatch span per member
+            # request (a batch spans many traces, so the shared
+            # serve.batch span above cannot parent them), carrying
+            # the program-resolution bucket the request rode in
+            for req in group:
+                obs_trace.traced_span(
+                    "serve.dispatch", dispatch_s, req,
+                    attrs=dict(attrs, site=self.op.site))
         return results
 
     def _run_group(self, key, group):

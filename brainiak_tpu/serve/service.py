@@ -49,6 +49,8 @@ import time
 
 from ..obs import metrics as obs_metrics
 from ..obs import sink as obs_sink
+from ..obs import trace as obs_trace
+from ..obs.sketch import QuantileSketch
 from .batching import ServeResult
 from .residency import AdmissionError
 
@@ -69,10 +71,6 @@ def serve_retrace_total():
             total += value
     return total
 
-#: Cap on the retained ok-latency samples the service percentiles
-#: are computed from (drop-oldest beyond it) — a week-long process
-#: must not grow an unbounded float list.
-_LATENCY_WINDOW = 65536
 
 
 class ServiceClosed(RuntimeError):
@@ -127,10 +125,25 @@ class ServeService:
     max-wait checks (default: half the bucket policy's
     ``max_wait_s``, clipped to [5 ms, 50 ms]); submissions wake the
     loop immediately, so idle ticks cost one condition wait.
+
+    ``slos`` declares service-level objectives
+    (:class:`~brainiak_tpu.obs.slo.Objective` list, or a
+    pre-configured :class:`~brainiak_tpu.obs.slo.SLOTracker`):
+    every delivered record feeds the tracker and every working tick
+    re-evaluates its multi-window burn rates — violations emit
+    ``slo_violation`` events, budget gauges land in ``/metrics``.
+
+    ``http_port`` opts into the live exposition endpoint
+    (:class:`~brainiak_tpu.obs.http.TelemetryServer`: ``/metrics``,
+    ``/healthz``, ``/readyz``); 0 binds an ephemeral port (read
+    ``summary()["http_port"]``), None falls back to the
+    ``BRAINIAK_TPU_OBS_HTTP_PORT`` env var (unset = no listener).
+    ``/readyz`` derives from :meth:`readiness` — model residency
+    plus AOT warm state.
     """
 
     def __init__(self, residency, tick_interval=None,
-                 default_model=None):
+                 default_model=None, slos=None, http_port=None):
         self.residency = residency
         policy = residency.policy
         max_wait = policy.max_wait_s if policy is not None else 0.05
@@ -149,8 +162,13 @@ class ServeService:
         self._n_submitted = 0                # guarded-by: _cond
         # (model, engine seq) -> ticket
         self._pending = {}           # guarded-by: _engine_lock
-        self._latencies = collections.deque(
-            maxlen=_LATENCY_WINDOW)  # guarded-by: _engine_lock
+        # ok-latency distribution: a mergeable log-bucketed sketch
+        # (O(1) memory for a week-long process, O(1) observe, O(1)
+        # quantiles under the tick lock — the PR 8 sorted deque paid
+        # an O(n log n) sort per summary() call there, and its raw
+        # samples could not be pooled across replicas)
+        self._latency_sketch = \
+            QuantileSketch()         # guarded-by: _engine_lock
         self._n_delivered = 0        # guarded-by: _engine_lock
         self._n_ok = 0               # guarded-by: _engine_lock
         self._errors_by_code = {}    # guarded-by: _engine_lock
@@ -168,6 +186,18 @@ class ServeService:
         # engine-lock tick, so these callbacks inherit the lock)
         residency.on_evict_records = self._deliver_many
         residency.on_evict = self._accrue_evicted
+        # SLO tracking: the tracker carries its OWN lock; the
+        # service only ever calls it engine-lock-held (record on
+        # delivery, evaluate per working tick), and the tracker
+        # never calls back — no inversion (JX202-clean)
+        if slos is None:
+            self._slo = None
+        else:
+            from ..obs.slo import SLOTracker
+            self._slo = slos if isinstance(slos, SLOTracker) \
+                else SLOTracker(slos)
+        self._http_port = http_port
+        self._http = None  # guarded-by: _cond
 
     def _accrue_evicted(self, entry):  # requires-lock: _engine_lock
         stats = entry.engine._stats
@@ -177,7 +207,12 @@ class ServeService:
     # -- lifecycle ----------------------------------------------------
 
     def start(self):
-        """Start the service thread (idempotent); returns self."""
+        """Start the service thread (idempotent) and — when a port
+        was opted into (``http_port=`` or the
+        ``BRAINIAK_TPU_OBS_HTTP_PORT`` env var) — the live
+        exposition endpoint; returns self."""
+        from ..obs import http as obs_http
+
         with self._cond:
             if self._state == "running":
                 return self
@@ -189,6 +224,14 @@ class ServeService:
                 target=self._loop, name="serve-service",
                 daemon=True)
             self._thread.start()
+            if self._http is None:
+                if self._http_port is not None:
+                    self._http = obs_http.TelemetryServer(
+                        port=self._http_port,
+                        readiness=self.readiness).start()
+                else:
+                    self._http = obs_http.maybe_start_from_env(
+                        readiness=self.readiness)
         return self
 
     def __enter__(self):
@@ -219,7 +262,16 @@ class ServeService:
                     "service loop did not stop within %ss", timeout)
         with self._cond:
             self._state = "stopped"
-        return self.summary()
+            http = self._http
+            self._http = None
+        # the summary below must still report the bound port, and
+        # the exposition must answer scrapes for the whole serving
+        # lifetime — stop the listener only after the state flip
+        summary = self.summary()
+        if http is not None:
+            summary["http_port"] = http.port
+            http.stop()
+        return summary
 
     # -- submission (any thread) --------------------------------------
 
@@ -244,6 +296,15 @@ class ServeService:
                     f"no default ({len(names)} registered)")
         if request.submitted is None:
             request.submitted = time.monotonic()
+        clock = obs_trace.stage_clock()
+        # trace root: mint (or adopt an injected) trace id and emit
+        # the serve.submit span BEFORE the request becomes visible
+        # to the loop — the loop's serve.enqueue span reads and
+        # advances request.parent_id, so publishing first would
+        # race the chain (no-op, no records while obs is disabled)
+        obs_trace.start_trace(request)
+        obs_trace.traced_span("serve.submit", clock.elapsed(),
+                              request, attrs={"model": name})
         ticket = ServiceTicket(request.request_id, name)
         with self._cond:
             if self._state != "running":
@@ -267,6 +328,7 @@ class ServeService:
         race between submission and the max-wait timer.  Returns the
         tickets in order."""
         now = time.monotonic()
+        clock = obs_trace.stage_clock()
         staged = []
         for request in requests:
             name = (model or request.model or self._default_model)
@@ -279,8 +341,19 @@ class ServeService:
                 name = names[0]
             if request.submitted is None:
                 request.submitted = now
+            obs_trace.start_trace(request)
             staged.append((name, request,
                            ServiceTicket(request.request_id, name)))
+        # submit spans BEFORE publishing the wave: the loop's
+        # serve.enqueue advances request.parent_id, so emitting
+        # after the ingress extend would race the chain
+        if obs_sink.enabled():
+            wave_s = clock.elapsed()
+            for name, request, _ in staged:
+                obs_trace.traced_span("serve.submit", wave_s,
+                                      request,
+                                      attrs={"model": name,
+                                             "wave": len(staged)})
         with self._cond:
             if self._state != "running":
                 raise ServiceClosed(
@@ -351,6 +424,11 @@ class ServeService:
                 "serve_service_ingress_depth",
                 help="requests accepted but not yet "
                      "routed").set(0)
+        if self._slo is not None and (batch or n_records):
+            # burn rates re-evaluated on every working tick: cheap
+            # (a few dozen slice sums) and keeps the slo_* gauges
+            # the exposition serves at most one tick stale
+            self._slo.evaluate()
 
     def _route(self, name, request,
                ticket):  # requires-lock: _engine_lock
@@ -409,11 +487,13 @@ class ServeService:
         if rec.ok:
             self._n_ok += 1
             if rec.latency_s is not None:
-                self._latencies.append(rec.latency_s)
+                self._latency_sketch.observe(rec.latency_s)
         else:
             code = rec.error or "error"
             self._errors_by_code[code] = \
                 self._errors_by_code.get(code, 0) + 1
+        if self._slo is not None:
+            self._slo.record(rec.ok, latency_s=rec.latency_s)
 
     def _finish(self, batch_failed):  # requires-lock: _engine_lock
         """Final phase after stop: drain or fail everything queued
@@ -439,6 +519,47 @@ class ServeService:
 
     # -- reporting ----------------------------------------------------
 
+    def readiness(self):
+        """``(ready, detail)`` for the ``/readyz`` endpoint.
+
+        Ready means "traffic served now meets the zero-cold-start
+        contract": the loop is running, at least one model is
+        registered, and either a model is already resident or the
+        attached AOT cache is warm (persisted programs / hits — a
+        restarted replica over a warm cache serves its first
+        request without a compile stall, PR 8's SRV002 contract).
+        The detail dict carries the facts either way, so an
+        orchestrator can see WHY a replica is not ready."""
+        with self._cond:
+            state = self._state
+        res = self.residency.stats()
+        aot = self.residency.aot
+        aot_stats = aot.stats() if aot is not None else None
+        aot_warm = aot is not None and aot.warm()
+        ready = (state == "running"
+                 and res["n_registered"] > 0
+                 and (res["n_resident"] > 0 or aot_warm))
+        detail = {
+            "state": state,
+            "n_registered": res["n_registered"],
+            "n_resident": res["n_resident"],
+            "resident": res["resident"],
+            "aot_warm": aot_warm,
+        }
+        if aot_stats is not None:
+            detail["aot"] = aot_stats
+        return ready, detail
+
+    def latency_sketch(self):
+        """A **copy** of this replica's ok-latency
+        :class:`~brainiak_tpu.obs.sketch.QuantileSketch` — the
+        summary a router merges (``a.merge(b)``) to compute pooled
+        cross-replica percentiles with the single-sketch error
+        bound; ``to_dict()`` is its JSON wire format."""
+        with self._engine_lock:
+            return QuantileSketch.from_dict(
+                self._latency_sketch.to_dict())
+
     def summary(self):
         """Service-level aggregate: delivery counts, latency
         percentiles over the retained window, padding waste,
@@ -448,23 +569,27 @@ class ServeService:
         ``retrace_total`` is the process-wide
         ``retrace_total{site=serve.*}`` sum — the acceptance
         headline: on a warm AOT cache a restarted process serves
-        with this at 0."""
-        def pct(q):
-            if not latencies:
-                return None
-            idx = min(len(latencies) - 1,
-                      int(round(q * (len(latencies) - 1))))
-            return latencies[idx]
+        with this at 0.
 
+        ``p50_latency_s``/``p99_latency_s`` come from the mergeable
+        latency sketch (documented relative error:
+        ``sketch.DEFAULT_RELATIVE_ACCURACY``) — an O(1) read under
+        the tick lock instead of the old per-call deque sort.
+        They summarize the service's LIFETIME distribution (the
+        sketch is O(1)-memory and never reset; the old deque kept
+        the most recent 64k samples) — recency-sensitive alerting
+        is the SLO tracker's job (``slos=``), whose burn windows
+        are time-bounded by construction."""
         models = {}
         with self._cond:
             # under its own guard: submit() increments on caller
             # threads while the engine lock is NOT held
             n_submitted = self._n_submitted
         with self._engine_lock:
-            # under the tick lock: the loop appends to _latencies
-            # while delivering, and sorting a mutating deque raises
-            latencies = sorted(self._latencies)
+            # under the tick lock: the loop observes into the
+            # sketch while delivering
+            p50 = self._latency_sketch.quantile(0.50)
+            p99 = self._latency_sketch.quantile(0.99)
             # evicted engines' dispatched elements accrued via
             # on_evict + the currently-resident ones: padding
             # waste covers the whole drive across residency churn
@@ -487,8 +612,8 @@ class ServeService:
             "n_ok": n_ok,
             "n_errors": sum(errors_by_code.values()),
             "errors_by_code": errors_by_code,
-            "p50_latency_s": pct(0.50),
-            "p99_latency_s": pct(0.99),
+            "p50_latency_s": p50,
+            "p99_latency_s": p99,
             "padding_waste": (1.0 - real / padded) if padded
             else 0.0,
             "retrace_total": serve_retrace_total(),
@@ -499,4 +624,10 @@ class ServeService:
         }
         if self.residency.aot is not None:
             out["aot"] = self.residency.aot.stats()
+        if self._slo is not None:
+            out["slo"] = self._slo.evaluate()
+        with self._cond:
+            http = self._http
+        if http is not None:
+            out["http_port"] = http.port
         return out

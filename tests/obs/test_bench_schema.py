@@ -157,16 +157,22 @@ def test_encoding_tier_record_matches_obs_schema(monkeypatch):
 # -- ISSUE 9: service tier --------------------------------------------
 
 def test_service_tier_records_match_obs_schema(monkeypatch):
-    """The service tier emits THREE schema-valid records per round —
-    steady-state requests/s plus p99 latency and padding waste, the
-    latter two stamped direction="lower_is_better" so `obs regress
-    --only service` gates them mirrored."""
+    """The service tier emits FOUR schema-valid records per round —
+    steady-state requests/s plus p99 latency, padding waste, and
+    (ISSUE 12) the telemetry overhead ratio (full tracing + SLO +
+    exposition vs obs suspended), the latter three stamped
+    direction="lower_is_better" so `obs regress --only service`
+    gates them mirrored."""
     monkeypatch.setenv("BENCH_SERVICE_REQUESTS", "16")
     out = bench.measure_tier("service")
     assert out["requests_per_sec"] > 0
     assert out["p99_latency_s"] > 0
     assert 0.0 <= out["padding_waste"] < 1.0
     assert out["baseline_rps"] > 0
+    # the overhead lane ran: a real positive ratio (obs-on work
+    # can only add time, but timer jitter at toy sizes keeps this
+    # a sanity bound, not >= 1.0)
+    assert out["obs_overhead_ratio"] > 0
     stages = out["stages"]
     assert set(bench.STAGE_KEYS) <= set(stages)
     assert stages["steady_s"] > 0
@@ -175,7 +181,8 @@ def test_service_tier_records_match_obs_schema(monkeypatch):
     assert [r["metric"] for r in recs] == [
         "service_mixed_requests_per_sec",
         "service_p99_latency_seconds",
-        "service_padding_waste_ratio"]
+        "service_padding_waste_ratio",
+        "service_obs_overhead_ratio"]
     for rec in recs:
         assert obs.validate_bench_record(rec) == []
         # in-process CPU test backend -> the fallback tier
@@ -184,6 +191,8 @@ def test_service_tier_records_match_obs_schema(monkeypatch):
     assert "direction" not in recs[0]
     assert recs[1]["direction"] == "lower_is_better"
     assert recs[2]["direction"] == "lower_is_better"
+    assert recs[3]["direction"] == "lower_is_better"
+    assert recs[3]["value"] > 0
 
 
 def test_kernels_tier_records_match_obs_schema(monkeypatch):

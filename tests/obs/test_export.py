@@ -157,3 +157,54 @@ def test_cli_rejects_empty_and_schema_violations(tmp_path, capsys):
     bad = tmp_path / "obs-0.jsonl"
     bad.write_text('{"v": 99, "kind": "span"}\n')
     assert export.main([str(bad)]) == 1
+
+
+# -- ISSUE 12: traced-request flow rendering --------------------------
+
+def test_traced_spans_render_flow_events():
+    """Schema-v3 traced spans become s/t/f flow events binding the
+    request's chain; untraced spans draw no flows; a single-span
+    trace draws none (no arrow to draw)."""
+    t0 = BASE
+    recs = [
+        _rec("span", "serve.submit", t0 + 0.1, 0,
+             path="serve.submit", dur_s=0.01, trace_id="t" * 16,
+             span_id="aaaa0001"),
+        _rec("span", "serve.dispatch", t0 + 0.3, 1,
+             path="serve.dispatch", dur_s=0.05,
+             trace_id="t" * 16, span_id="aaaa0002",
+             parent_id="aaaa0001"),
+        _rec("span", "serve.request", t0 + 0.4, 1,
+             path="serve.request", dur_s=0.3, trace_id="t" * 16,
+             span_id="aaaa0003", parent_id="aaaa0002"),
+        _rec("span", "lonely", t0 + 0.5, 0, path="lonely",
+             dur_s=0.01, trace_id="u" * 16, span_id="bbbb0001"),
+        _rec("span", "untraced", t0 + 0.6, 0, path="untraced",
+             dur_s=0.01),
+    ]
+    doc = export.chrome_trace(recs)
+    assert export.validate_chrome_trace(doc) == []
+    flows = [e for e in doc["traceEvents"]
+             if e["ph"] in ("s", "t", "f")]
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert all(e["id"] == "t" * 16 for e in flows)
+    assert flows[-1]["bp"] == "e"
+    # flow steps name the span they bind to, in causal order, and
+    # land in the pid lane of the rank that emitted the span
+    assert [e["args"]["step"] for e in flows] == \
+        ["serve.submit", "serve.dispatch", "serve.request"]
+    assert [e["pid"] for e in flows] == [0, 1, 1]
+    # traced X slices carry the ids for the viewer's args pane
+    traced = [e for e in doc["traceEvents"]
+              if e["ph"] == "X" and "trace_id" in e["args"]]
+    assert len(traced) == 4
+    dispatch = next(e for e in traced
+                    if e["name"] == "serve.dispatch")
+    assert dispatch["args"]["parent_id"] == "aaaa0001"
+
+
+def test_validator_rejects_flow_event_without_id():
+    doc = {"traceEvents": [
+        {"ph": "s", "name": "trace", "pid": 0, "ts": 1.0}]}
+    errors = export.validate_chrome_trace(doc)
+    assert any("flow event" in e and "id" in e for e in errors)

@@ -1,0 +1,164 @@
+"""Live exposition endpoint (ISSUE 12 tentpole part 3).
+
+The stdlib daemon-thread server: /metrics renders the registry as
+valid Prometheus text (validated by the same minimal in-repo parser
+the OBS002 gate uses), /healthz answers liveness, /readyz delegates
+to the injected readiness callback with a JSON detail body."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from brainiak_tpu.obs import metrics
+from brainiak_tpu.obs.http import (TelemetryServer,
+                                   maybe_start_from_env,
+                                   parse_prometheus_text,
+                                   render_prometheus)
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}",
+                timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8"), \
+                resp.headers.get("Content-Type", "")
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode("utf-8"), \
+            exc.headers.get("Content-Type", "")
+
+
+@pytest.fixture
+def server():
+    srv = TelemetryServer(port=0, host="127.0.0.1").start()
+    yield srv
+    srv.stop()
+
+
+def _seed_metrics():
+    metrics.counter("serve_requests_total",
+                    help="by outcome").inc(5, kind="srm",
+                                           outcome="ok")
+    metrics.gauge("serve_queue_depth").set(3, kind="srm")
+    hist = metrics.histogram("serve_request_seconds", unit="s")
+    for v in (0.01, 0.02, 0.03, 0.5):
+        hist.observe(v, kind="srm")
+
+
+def test_render_parses_clean_and_carries_quantiles():
+    _seed_metrics()
+    text = render_prometheus()
+    families, errors = parse_prometheus_text(text)
+    assert errors == []
+    assert families["serve_requests_total"]["type"] == "counter"
+    assert families["serve_queue_depth"]["type"] == "gauge"
+    summary = families["serve_request_seconds"]
+    assert summary["type"] == "summary"
+    quantiles = {labels["quantile"]: value
+                 for name, labels, value in summary["samples"]
+                 if "quantile" in labels}
+    assert set(quantiles) == {"0.5", "0.9", "0.99"}
+    assert quantiles["0.99"] == pytest.approx(0.5, rel=0.02)
+    names = {name for name, _, _ in summary["samples"]}
+    assert {"serve_request_seconds_sum",
+            "serve_request_seconds_count"} <= names
+
+
+def test_label_escaping_round_trips():
+    # the backslash-n value is the order-sensitive case: escaped as
+    # \\n it must come back as backslash + literal n, NOT newline
+    # (sequential str.replace unescaping got this wrong)
+    for value in ('a"b\\c', "tail\\n", "nl\nmid", "\\\\double"):
+        metrics.reset()
+        metrics.gauge("weird_gauge").set(1.0, path=value)
+        families, errors = parse_prometheus_text(
+            render_prometheus())
+        assert errors == []
+        (_, labels, _), = families["weird_gauge"]["samples"]
+        assert labels["path"] == value, (value, labels)
+
+
+def test_parser_flags_malformations():
+    _, errors = parse_prometheus_text(
+        "# TYPE broken widget\n"
+        "orphan_series 1.0\n"
+        "# TYPE declared counter\n"
+        "declared not-a-number\n")
+    assert any("unknown metric type" in e for e in errors)
+    assert any("no TYPE/HELP family" in e for e in errors)
+    assert any("non-numeric" in e for e in errors)
+    assert any("declared but has no samples" in e for e in errors)
+
+
+def test_metrics_endpoint_live_scrape(server):
+    _seed_metrics()
+    status, body, ctype = _get(server.port, "/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    families, errors = parse_prometheus_text(body)
+    assert errors == []
+    assert "serve_requests_total" in families
+
+
+def test_healthz(server):
+    status, body, _ = _get(server.port, "/healthz")
+    assert status == 200
+    assert body.strip() == "ok"
+
+
+def test_unknown_path_404(server):
+    status, body, _ = _get(server.port, "/nope")
+    assert status == 404
+    assert "/metrics" in body
+
+
+def test_readyz_reflects_callback():
+    state = {"ok": False}
+    srv = TelemetryServer(
+        port=0, host="127.0.0.1",
+        readiness=lambda: (state["ok"], {"detail": "warming"}))
+    with srv:
+        status, body, ctype = _get(srv.port, "/readyz")
+        assert status == 503
+        payload = json.loads(body)
+        assert payload["ready"] is False
+        assert payload["detail"] == "warming"
+        assert ctype.startswith("application/json")
+        state["ok"] = True
+        status, body, _ = _get(srv.port, "/readyz")
+        assert status == 200
+        assert json.loads(body)["ready"] is True
+    # without a callback readiness mirrors liveness
+    with TelemetryServer(port=0, host="127.0.0.1") as bare:
+        status, body, _ = _get(bare.port, "/readyz")
+        assert status == 200
+
+
+def test_start_stop_idempotent_and_ephemeral_port():
+    srv = TelemetryServer(port=0, host="127.0.0.1")
+    assert srv.port is None
+    srv.start()
+    port = srv.port
+    assert port and port > 0
+    assert srv.start() is srv          # idempotent
+    assert srv.port == port
+    srv.stop()
+    srv.stop()                         # idempotent
+    assert srv.port is None
+
+
+def test_maybe_start_from_env(monkeypatch):
+    monkeypatch.delenv("BRAINIAK_TPU_OBS_HTTP_PORT", raising=False)
+    assert maybe_start_from_env() is None
+    monkeypatch.setenv("BRAINIAK_TPU_OBS_HTTP_PORT", "not-a-port")
+    assert maybe_start_from_env() is None
+    monkeypatch.setenv("BRAINIAK_TPU_OBS_HTTP_PORT", "0")
+    srv = maybe_start_from_env()
+    try:
+        assert srv is not None and srv.port > 0
+        status, _, _ = _get(srv.port, "/healthz")
+        assert status == 200
+    finally:
+        srv.stop()

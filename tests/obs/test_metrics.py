@@ -91,3 +91,48 @@ def test_registry_reset_isolates():
     obs.counter("ephemeral_total").inc()
     metrics.reset()
     assert obs.collect() == []
+
+
+# -- ISSUE 12: sketch-backed histogram quantiles ----------------------
+
+def test_histogram_quantiles_from_sketch():
+    h = obs.histogram("q_seconds", unit="s")
+    for i in range(1, 101):
+        h.observe(i / 100.0, kind="x")
+    summary = h.summary(kind="x")
+    assert summary["count"] == 100
+    # real quantiles, within the sketch's 1% relative error
+    # (nearest-rank: p50 of 0.01..1.00 is the 0-based index
+    # round(0.5 * 99) = 50 -> 0.51)
+    assert summary["p50"] == pytest.approx(0.51, rel=0.02)
+    assert summary["p90"] == pytest.approx(0.90, rel=0.02)
+    assert summary["p99"] == pytest.approx(0.99, rel=0.02)
+    assert h.quantile(0.99, kind="x") == summary["p99"]
+    assert h.quantile(0.5, kind="nope") is None
+    # collect() carries the quantile fields for the exposition
+    (sample,) = [s for s in obs.collect()
+                 if s["name"] == "q_seconds"]
+    assert sample["value"]["p99"] == summary["p99"]
+
+
+def test_histogram_sketch_copy_is_mergeable():
+    h = obs.histogram("m_seconds", unit="s")
+    for v in (0.1, 0.2, 0.3):
+        h.observe(v, shard="a")
+    for v in (1.0, 2.0):
+        h.observe(v, shard="b")
+    a = h.sketch(shard="a")
+    b = h.sketch(shard="b")
+    assert h.sketch(shard="zzz") is None
+    a.merge(b)
+    assert a.count == 5
+    # the copy is detached: merging did not corrupt the live metric
+    assert h.summary(shard="a")["count"] == 3
+    assert a.quantile(1.0) == pytest.approx(2.0, rel=0.02)
+
+
+def test_collect_carries_help_text():
+    obs.counter("helped_total", help="the help line").inc()
+    (sample,) = [s for s in obs.collect()
+                 if s["name"] == "helped_total"]
+    assert sample["help"] == "the help line"

@@ -42,7 +42,7 @@ def test_lowered_level_captures_cost_fields(mem):
         prog(jnp.ones((16, 16)), jnp.ones((16, 16)))
     (rec,) = _costs(mem, "t.matmul")
     assert obs.validate_record(rec) == []
-    assert rec["v"] == 2
+    assert rec["v"] == obs.SCHEMA_VERSION
     assert rec["level"] == "lowered"
     # XLA:CPU provides cost_analysis: 2*16^3 FLOPs for the matmul
     assert rec["flops"] == pytest.approx(2 * 16 ** 3, rel=0.5)

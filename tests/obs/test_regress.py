@@ -42,12 +42,13 @@ def test_fixture_history_passes_and_gates():
     # the real r01-r05 fcma trajectory + the serve_r01-r03 tier
     # (PR 5) + the distla_r01-r03 tier (ISSUE 6) + the
     # encoding_r01-r03 tier (ISSUE 7) + the service_r01-r03 tier
-    # (ISSUE 9: 3 rounds x 3 metrics — requests/s, p99, padding)
-    # + the kernels_r01-r03 tier (ISSUE 11: 3 rounds x 2 metrics —
-    # fused forward-backward TRs/s, fused ring GB/s), all measured
-    # host-side -> *_cpu_fallback: six tiers gating independently
-    # from one directory
-    assert len(records) == 29
+    # (ISSUE 9, refreshed by ISSUE 12: 3 rounds x 4 metrics —
+    # requests/s, p99, padding, obs overhead) + the kernels_r01-r03
+    # tier (ISSUE 11: 3 rounds x 2 metrics — fused forward-backward
+    # TRs/s, fused ring GB/s), all measured host-side ->
+    # *_cpu_fallback: six tiers gating independently from one
+    # directory
+    assert len(records) == 32
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
@@ -66,13 +67,17 @@ def test_fixture_history_passes_and_gates():
     assert set(by_tier) == {"cpu_fallback", "serve_cpu_fallback",
                             "distla_cpu_fallback",
                             "encoding_cpu_fallback"}
-    # the service tier gates three metrics (two flipped) and the
-    # kernels tier gates two fused sites
+    # the service tier gates four metrics (three flipped, incl. the
+    # ISSUE 12 telemetry-overhead ratio) and the kernels tier gates
+    # two fused sites
     assert set(by_metric) == {"service_mixed_requests_per_sec",
                               "service_p99_latency_seconds",
                               "service_padding_waste_ratio",
+                              "service_obs_overhead_ratio",
                               "kernels_eventseg_fb_trs_per_sec",
                               "kernels_summa_ring_gb_per_sec"}
+    assert by_metric["service_obs_overhead_ratio"][
+        "direction"] == "lower_is_better"
     assert by_metric["service_p99_latency_seconds"][
         "direction"] == "lower_is_better"
     assert all(c["status"] == "ok" for c in by_metric.values())

@@ -173,8 +173,10 @@ def test_cost_records_require_v2():
     rec["v"] = 1
     assert any("require schema v>=2" in e
                for e in obs.validate_record(rec))
-    rec["v"] = 3
-    assert any("v=3" in e for e in obs.validate_record(rec))
+    rec["v"] = 3  # v3 (trace fields) accepts cost records too
+    assert obs.validate_record(rec) == []
+    rec["v"] = 4  # future versions still rejected
+    assert any("v=4" in e for e in obs.validate_record(rec))
 
 
 def test_cost_record_unknown_key_rejected():
@@ -192,14 +194,24 @@ def test_max_mb_cap_truncates_with_one_marker(tmp_path,
                                    attrs={"pad": "x" * 64}))
     sink.close()
     lines = open(str(tmp_path / "obs-0.jsonl")).read().splitlines()
-    # far fewer than 100 lines made it; the LAST one is the marker
+    # far fewer than 100 lines made it; the truncation marker comes
+    # last-but-one, the close-time drop count last (ISSUE 12
+    # satellite: dropped records are counted, not silent)
     assert len(lines) < 50
-    last = json.loads(lines[-1])
-    assert last["name"] == "obs_truncated"
-    assert abs(last["attrs"]["limit_mb"] - 0.001) < 1e-5
+    marker = json.loads(lines[-2])
+    assert marker["name"] == "obs_truncated"
+    assert abs(marker["attrs"]["limit_mb"] - 0.001) < 1e-5
+    dropped = json.loads(lines[-1])
+    assert dropped["name"] == "obs_dropped"
+    # every record past the cap was counted: written events plus
+    # dropped count account for all 100 writes (the marker and the
+    # stamp are the sink's own two lines)
+    n_written_events = len(lines) - 2
+    assert dropped["attrs"]["dropped_total"] == \
+        100 - n_written_events
     assert all(json.loads(line)["name"] != "obs_truncated"
-               for line in lines[:-1])
-    # the cap bounds the file size (marker included)
+               for line in lines[:-2])
+    # the cap bounds the file size (markers included)
     assert os.path.getsize(str(tmp_path / "obs-0.jsonl")) \
         < 2 * 1024
 
@@ -211,7 +223,8 @@ def test_max_mb_env_activated_sink_truncates(tmp_path, monkeypatch):
         obs_sink.event("spam", pad="y" * 64)
     obs_sink.close_all()
     lines = open(str(tmp_path / "obs-0.jsonl")).read().splitlines()
-    assert json.loads(lines[-1])["name"] == "obs_truncated"
+    assert json.loads(lines[-2])["name"] == "obs_truncated"
+    assert json.loads(lines[-1])["name"] == "obs_dropped"
     assert len(lines) < 50
 
 
@@ -221,3 +234,82 @@ def test_bad_max_mb_env_is_ignored(tmp_path, monkeypatch):
     assert sink.max_bytes is None
     sink.write(obs.make_record("event", "ok"))
     sink.close()
+
+
+def test_truncate_close_round_trip_renders_in_report(tmp_path,
+                                                     monkeypatch):
+    """ISSUE 12 satellite acceptance: cap -> drop -> close stamps
+    dropped_total; the report CLI surfaces it as the incompleteness
+    headline, and the record round-trips the schema."""
+    from brainiak_tpu.obs.report import (aggregate, load_records,
+                                         render_text)
+    monkeypatch.setenv(obs_sink.OBS_MAX_MB_ENV, "0.0005")
+    sink = obs.JsonlSink(str(tmp_path), rank=0)
+    for i in range(80):
+        sink.write(obs.make_record("event", f"e{i}",
+                                   attrs={"pad": "z" * 48}))
+    assert sink.dropped_total > 0
+    n_dropped = sink.dropped_total
+    sink.close()
+    # repeated close() must not stamp twice
+    sink.close()
+    records, errors = load_records(
+        [str(tmp_path / "obs-0.jsonl")])
+    assert errors == []  # the stamp validates against the schema
+    assert sum(1 for r in records
+               if r["name"] == "obs_dropped") == 1
+    summary = aggregate(records)
+    assert summary["dropped_records"] == n_dropped
+    text = render_text(summary)
+    assert "incomplete" in text and str(n_dropped) in text
+    # written + dropped account for every write
+    n_events = sum(1 for r in records
+                   if r["kind"] == "event"
+                   and r["name"].startswith("e"))
+    assert n_events + n_dropped == 80
+
+
+def test_dropped_total_zero_below_cap(tmp_path):
+    sink = obs.JsonlSink(str(tmp_path), rank=0, max_mb=10)
+    sink.write(obs.make_record("event", "fine"))
+    assert sink.dropped_total == 0
+    sink.close()
+    lines = open(str(tmp_path / "obs-0.jsonl")).read().splitlines()
+    # no markers on a healthy close
+    assert [json.loads(line)["name"] for line in lines] == ["fine"]
+
+
+def test_suspended_disables_and_restores(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.OBS_DIR_ENV, str(tmp_path))
+    assert obs.enabled()
+    with obs_sink.suspended():
+        assert not obs.enabled()
+        assert obs_sink.all_sinks() == []
+        obs_sink.event("invisible")
+        with obs_sink.suspended():  # nests
+            assert not obs.enabled()
+        assert not obs.enabled()
+    assert obs.enabled()
+    obs_sink.event("visible")
+    obs_sink.close_all()
+    lines = open(str(tmp_path / "obs-0.jsonl")).read().splitlines()
+    assert [json.loads(line)["name"] for line in lines] == \
+        ["visible"]
+
+
+def test_trace_fields_validate_as_v3():
+    rec = obs.make_record("span", "serve.submit",
+                          path="serve.submit", dur_s=0.001,
+                          trace_id="a" * 16, span_id="b" * 8,
+                          parent_id="c" * 8)
+    assert rec["v"] == 3
+    assert obs.validate_record(rec) == []
+    # wrong types are rejected
+    bad = dict(rec, trace_id=123)
+    assert obs.validate_record(bad)
+    # v1 spans without trace fields still validate (back-compat)
+    old = dict(rec)
+    old["v"] = 1
+    for key in ("trace_id", "span_id", "parent_id"):
+        old.pop(key)
+    assert obs.validate_record(old) == []

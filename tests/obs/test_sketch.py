@@ -1,0 +1,178 @@
+"""Mergeable quantile sketches (ISSUE 12 tentpole part 2).
+
+The guarantees the serving plane rides on: bounded relative error
+against exact rank statistics, EXACT merge (pooled replica
+quantiles keep the single-sketch bound — the acceptance criterion),
+bounded memory, and a JSON round-trip for cross-process travel."""
+
+import json
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.obs.sketch import (DEFAULT_RELATIVE_ACCURACY,
+                                     QuantileSketch)
+
+
+def _exact(values, q):
+    """Nearest-rank percentile, the sketch's documented convention
+    (and the serve summary's historical one)."""
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1,
+              int(round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def test_relative_error_bound_lognormal():
+    rng = np.random.RandomState(0)
+    values = rng.lognormal(mean=-3.0, sigma=1.5, size=20000)
+    sketch = QuantileSketch()
+    for v in values:
+        sketch.observe(float(v))
+    for q in (0.01, 0.1, 0.5, 0.9, 0.99, 0.999):
+        true = _exact(values, q)
+        est = sketch.quantile(q)
+        assert abs(est - true) <= \
+            DEFAULT_RELATIVE_ACCURACY * true + 1e-12, (q, est, true)
+
+
+def test_relative_error_bound_across_scales():
+    """The log-bucket bound holds from microseconds to hours with no
+    prior scale hint."""
+    rng = np.random.RandomState(1)
+    values = np.concatenate([
+        rng.uniform(1e-6, 1e-5, 500),
+        rng.uniform(0.01, 0.1, 500),
+        rng.uniform(100.0, 5000.0, 500)])
+    rng.shuffle(values)
+    sketch = QuantileSketch()
+    for v in values:
+        sketch.observe(float(v))
+    for q in (0.1, 0.5, 0.9):
+        true = _exact(values, q)
+        assert abs(sketch.quantile(q) - true) <= \
+            DEFAULT_RELATIVE_ACCURACY * true + 1e-15
+
+
+def test_merge_is_exact():
+    """merge() is bucket-wise addition: indistinguishable from
+    observing both streams into one sketch."""
+    rng = np.random.RandomState(2)
+    a_vals = rng.exponential(0.05, 5000)
+    b_vals = rng.exponential(0.5, 3000)  # a slower replica
+    pooled = QuantileSketch()
+    a = QuantileSketch()
+    b = QuantileSketch()
+    for v in a_vals:
+        a.observe(float(v))
+        pooled.observe(float(v))
+    for v in b_vals:
+        b.observe(float(v))
+        pooled.observe(float(v))
+    a.merge(b)
+    assert a.count == pooled.count == 8000
+    assert a.sum == pytest.approx(pooled.sum)
+    for q in (0.05, 0.5, 0.95, 0.99):
+        assert a.quantile(q) == pooled.quantile(q)
+
+
+def test_merged_pooled_p99_within_documented_bound():
+    """The ISSUE 12 acceptance: two replica sketches, merged,
+    reproduce the pooled p99 within the documented relative-error
+    bound."""
+    rng = np.random.RandomState(3)
+    rep1 = rng.lognormal(-2.5, 1.0, 4000)
+    rep2 = rng.lognormal(-1.5, 0.7, 6000)
+    s1 = QuantileSketch()
+    s2 = QuantileSketch()
+    for v in rep1:
+        s1.observe(float(v))
+    for v in rep2:
+        s2.observe(float(v))
+    merged = QuantileSketch.from_dict(s1.to_dict()).merge(s2)
+    true_p99 = _exact(np.concatenate([rep1, rep2]), 0.99)
+    assert abs(merged.quantile(0.99) - true_p99) <= \
+        DEFAULT_RELATIVE_ACCURACY * true_p99
+
+
+def test_merge_rejects_mismatched_accuracy_and_type():
+    a = QuantileSketch(relative_accuracy=0.01)
+    b = QuantileSketch(relative_accuracy=0.05)
+    with pytest.raises(ValueError, match="relative"):
+        a.merge(b)
+    with pytest.raises(TypeError):
+        a.merge([1, 2, 3])
+
+
+def test_json_round_trip():
+    sketch = QuantileSketch()
+    for v in (0.0, 1e-6, 0.25, 0.25, 7.5, -3.0):
+        sketch.observe(v)
+    wire = json.loads(json.dumps(sketch.to_dict()))
+    back = QuantileSketch.from_dict(wire)
+    assert back.count == sketch.count
+    assert back.sum == pytest.approx(sketch.sum)
+    assert back.min == sketch.min
+    assert back.max == sketch.max
+    for q in (0.0, 0.25, 0.5, 0.99, 1.0):
+        assert back.quantile(q) == sketch.quantile(q)
+
+
+def test_zero_negative_and_edge_quantiles():
+    sketch = QuantileSketch()
+    for v in (-2.0, -1.0, 0.0, 0.0, 1.0, 2.0):
+        sketch.observe(v)
+    assert sketch.quantile(0.0) == pytest.approx(-2.0, rel=0.02)
+    assert sketch.quantile(0.5) == 0.0
+    assert sketch.quantile(1.0) == pytest.approx(2.0, rel=0.02)
+    assert sketch.min == -2.0
+    assert sketch.max == 2.0
+
+
+def test_empty_and_invalid_inputs():
+    sketch = QuantileSketch()
+    assert sketch.quantile(0.5) is None
+    assert sketch.quantiles((0.5, 0.99)) == [None, None]
+    with pytest.raises(ValueError):
+        sketch.observe(float("nan"))
+    with pytest.raises(ValueError):
+        sketch.observe(float("inf"))
+    with pytest.raises(ValueError):
+        sketch.quantile(1.5)
+    with pytest.raises(ValueError):
+        QuantileSketch(relative_accuracy=0.0)
+    with pytest.raises(ValueError):
+        QuantileSketch(max_buckets=1)
+
+
+def test_memory_bound_collapses_low_buckets():
+    """max_buckets bounds the store; quantiles ABOVE the collapse
+    boundary keep their error bound (the tail is the product — the
+    collapsed low end degrades toward the boundary, by design)."""
+    rng = np.random.RandomState(4)
+    values = rng.uniform(1.0, 100.0, 30000)
+    sketch = QuantileSketch(max_buckets=64)
+    for v in values:
+        sketch.observe(float(v))
+    assert len(sketch._buckets) <= 64
+    boundary = sketch._bucket_value(min(sketch._buckets))
+    for q in (0.8, 0.9, 0.99):
+        true = _exact(values, q)
+        assert true > boundary  # the tail stayed un-collapsed
+        assert abs(sketch.quantile(q) - true) <= \
+            DEFAULT_RELATIVE_ACCURACY * true
+    # the collapsed low end reports at most the boundary region —
+    # bounded memory, degraded-but-sane low quantiles
+    assert sketch.quantile(0.01) <= boundary * (1.02)
+
+
+def test_observe_is_o1_state():
+    """count/sum/min/max track exactly regardless of bucketing."""
+    sketch = QuantileSketch()
+    values = [0.003, 0.5, 0.0021, 12.0, 0.5]
+    for v in values:
+        sketch.observe(v)
+    assert sketch.count == 5
+    assert sketch.sum == pytest.approx(sum(values))
+    assert sketch.min == 0.0021
+    assert sketch.max == 12.0

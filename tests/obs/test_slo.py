@@ -1,0 +1,181 @@
+"""SLO burn-rate tracking (ISSUE 12 tentpole part 4).
+
+Deterministic fake-clock coverage: burn-rate math, the multi-window
+AND rule, violation events on transitions (with de-dup while a
+violation persists), budget gauges, and the min-count guard."""
+
+import pytest
+
+from brainiak_tpu.obs import metrics
+from brainiak_tpu.obs import sink as obs_sink
+from brainiak_tpu.obs.slo import (DEFAULT_BURN_RULES, BurnRule,
+                                  Objective, SLOTracker)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+def _tracker(objective, rule=BurnRule(long_s=60.0, short_s=10.0,
+                                      factor=2.0),
+             min_window_count=10):
+    clock = FakeClock()
+    return SLOTracker([objective], burn_rules=(rule,), clock=clock,
+                      min_window_count=min_window_count), clock
+
+
+def test_objective_declarations():
+    lat = Objective.latency("p99", quantile=0.99, threshold_s=0.5)
+    assert lat.target == 0.99
+    assert lat.is_bad(True, 0.6) and not lat.is_bad(True, 0.4)
+    assert lat.is_bad(False, 0.1)  # an error is always bad
+    err = Objective.error_rate("avail", max_error_rate=0.001)
+    assert err.target == pytest.approx(0.999)
+    assert not err.is_bad(True, 99.0)  # no latency threshold
+    with pytest.raises(ValueError, match="target"):
+        Objective("bad", target=1.0)
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOTracker([err, err])
+    with pytest.raises(ValueError, match="objective"):
+        SLOTracker([])
+    with pytest.raises(ValueError, match="burn rule"):
+        SLOTracker([err], burn_rules=())
+
+
+def test_healthy_traffic_full_budget_no_violation():
+    tracker, clock = _tracker(
+        Objective.error_rate("avail", max_error_rate=0.01))
+    for _ in range(200):
+        tracker.record(True, latency_s=0.01)
+        clock.advance(0.1)
+    out = tracker.evaluate()
+    state = out["objectives"]["avail"]
+    assert not state["violating"]
+    assert state["error_budget_remaining"] == pytest.approx(1.0)
+    assert out["n_violations"] == 0
+    for wstate in state["windows"].values():
+        assert wstate["burn_rate"] == 0.0
+
+
+def test_burn_rate_math():
+    """5% bad against a 1% budget burns at exactly 5.0."""
+    tracker, clock = _tracker(
+        Objective.error_rate("avail", max_error_rate=0.01),
+        rule=BurnRule(long_s=60.0, short_s=10.0, factor=100.0))
+    for i in range(100):
+        tracker.record(i % 20 != 0)  # 5% errors
+        clock.advance(0.05)
+    state = tracker.evaluate()["objectives"]["avail"]
+    for wstate in state["windows"].values():
+        assert wstate["burn_rate"] == pytest.approx(5.0)
+    assert state["error_budget_remaining"] == 0.0
+    assert not state["violating"]  # factor 100 not reached
+
+
+def test_multi_window_and_rule():
+    """A past burst that has left the SHORT window no longer
+    violates (long still burning, short recovered) — the workbook
+    property that alerts stop once the problem stops."""
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    tracker, clock = _tracker(
+        Objective.error_rate("avail", max_error_rate=0.01))
+    # burst: everything fails for 5 s
+    for _ in range(50):
+        tracker.record(False)
+        clock.advance(0.1)
+    out = tracker.evaluate()
+    assert out["objectives"]["avail"]["violating"]
+    assert out["n_violations"] == 1
+    events = [r for r in mem.records
+              if r["kind"] == "event" and r["name"] == "slo_violation"]
+    assert len(events) == 1
+    assert events[0]["attrs"]["slo"] == "avail"
+    assert obs_sink.validate_record(events[0]) == []
+    # still violating on re-evaluate: NO duplicate event
+    tracker.evaluate()
+    assert len([r for r in mem.records
+                if r["name"] == "slo_violation"]) == 1
+    # 15 s of clean traffic: the short window recovers, long still
+    # holds the burst -> no longer violating (AND rule)
+    for _ in range(150):
+        tracker.record(True, latency_s=0.01)
+        clock.advance(0.1)
+    out = tracker.evaluate()
+    state = out["objectives"]["avail"]
+    assert state["windows"]["60s"]["burn_rate"] > 2.0
+    assert state["windows"]["10s"]["burn_rate"] == 0.0
+    assert not state["violating"]
+    # a SECOND burst is a new transition: a second event fires
+    for _ in range(50):
+        tracker.record(False)
+        clock.advance(0.1)
+    tracker.evaluate()
+    assert len([r for r in mem.records
+                if r["name"] == "slo_violation"]) == 2
+    assert metrics.counter("slo_violations_total").value(
+        slo="avail") == 2
+
+
+def test_latency_objective_burns_on_slow_ok_requests():
+    tracker, clock = _tracker(
+        Objective.latency("p99", quantile=0.99, threshold_s=0.1))
+    for _ in range(100):
+        tracker.record(True, latency_s=0.5)  # ok but slow = bad
+        clock.advance(0.05)
+    state = tracker.evaluate()["objectives"]["p99"]
+    assert state["violating"]
+    assert state["error_budget_remaining"] == 0.0
+
+
+def test_min_window_count_guard():
+    """Two requests, one failed, must not page at the first error."""
+    tracker, clock = _tracker(
+        Objective.error_rate("avail", max_error_rate=0.01),
+        min_window_count=10)
+    tracker.record(True)
+    tracker.record(False)
+    state = tracker.evaluate()["objectives"]["avail"]
+    assert not state["violating"]
+
+
+def test_gauges_land_in_registry_for_exposition():
+    tracker, clock = _tracker(
+        Objective.error_rate("avail", max_error_rate=0.01))
+    for _ in range(20):
+        tracker.record(True)
+        clock.advance(0.1)
+    tracker.evaluate()
+    assert metrics.gauge("slo_error_budget_remaining").value(
+        slo="avail") == pytest.approx(1.0)
+    assert metrics.gauge("slo_burn_rate").value(
+        slo="avail", window="60s") == 0.0
+    assert metrics.gauge("slo_burn_rate").value(
+        slo="avail", window="10s") == 0.0
+
+
+def test_old_slices_are_pruned():
+    tracker, clock = _tracker(
+        Objective.error_rate("avail", max_error_rate=0.01))
+    for _ in range(100):
+        tracker.record(False)
+        clock.advance(1.0)
+    clock.advance(3600.0)  # far past the longest window
+    state = tracker.evaluate()["objectives"]["avail"]
+    assert state["n_requests"] == 0
+    assert state["error_budget_remaining"] == pytest.approx(1.0)
+    counts = tracker._counts["avail"]
+    assert len(counts.slices) == 0
+
+
+def test_default_burn_rules_are_workbook_shaped():
+    (fast, slow) = DEFAULT_BURN_RULES
+    assert fast.factor > slow.factor
+    assert fast.long_s < slow.long_s
+    assert fast.short_s < fast.long_s

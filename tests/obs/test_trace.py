@@ -1,0 +1,118 @@
+"""Request-scoped tracing (ISSUE 12 tentpole part 1).
+
+Unit coverage for :mod:`brainiak_tpu.obs.trace`: id minting, chain
+advancement, npz inject/extract, connectivity reconstruction, the
+obs-disabled zero-overhead contract, and schema-v3 record validity.
+The end-to-end in-process service chain lives in
+``tests/serve/test_telemetry.py``; the cross-process CLI continuity
+acceptance in ``tests/serve/test_trace_continuity.py``."""
+
+import numpy as np
+
+from brainiak_tpu.obs import sink as obs_sink
+from brainiak_tpu.obs import trace as obs_trace
+from brainiak_tpu.serve.batching import (Request, load_requests,
+                                         save_requests)
+
+
+def _req(**kwargs):
+    return Request(request_id="r0", x=np.zeros((4, 4)), **kwargs)
+
+
+def test_ids_are_fresh_and_well_formed():
+    tids = {obs_trace.new_trace_id() for _ in range(64)}
+    sids = {obs_trace.new_span_id() for _ in range(64)}
+    assert len(tids) == 64 and len(sids) == 64
+    assert all(len(t) == 16 and int(t, 16) >= 0 for t in tids)
+    assert all(len(s) == 8 and int(s, 16) >= 0 for s in sids)
+
+
+def test_start_trace_disabled_mints_nothing():
+    req = _req()
+    assert obs_trace.start_trace(req) is None
+    assert req.trace_id is None
+    # a pre-assigned id survives untouched even while disabled
+    req2 = _req(trace_id="deadbeefdeadbeef")
+    assert obs_trace.start_trace(req2) == "deadbeefdeadbeef"
+
+
+def test_traced_span_advances_chain_and_validates():
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    req = _req(parent_id="11112222")  # an upstream process's span
+    tid = obs_trace.start_trace(req)
+    assert tid is not None
+    s1 = obs_trace.traced_span("stage.one", 0.01, req,
+                               attrs={"k": 1})
+    s2 = obs_trace.traced_span("stage.two", 0.02, req)
+    assert req.parent_id == s2 != s1
+    recs = mem.records
+    assert [r["name"] for r in recs] == ["stage.one", "stage.two"]
+    assert recs[0]["parent_id"] == "11112222"
+    assert recs[1]["parent_id"] == s1
+    assert all(r["trace_id"] == tid for r in recs)
+    assert all(obs_sink.validate_record(r) == [] for r in recs)
+    assert all(r["v"] == obs_sink.SCHEMA_VERSION for r in recs)
+
+
+def test_traced_span_noop_disabled_or_untraced():
+    # disabled: nothing emitted even for a traced request
+    req = _req(trace_id="deadbeefdeadbeef")
+    assert obs_trace.traced_span("s", 0.0, req) is None
+    # enabled but untraced request: still a no-op
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    req2 = _req()
+    assert obs_trace.traced_span("s", 0.0, req2) is None
+    assert mem.records == []
+
+
+def test_npz_inject_extract_round_trip(tmp_path):
+    path = str(tmp_path / "reqs.npz")
+    tid, pid = obs_trace.new_trace_id(), obs_trace.new_span_id()
+    save_requests(path, [np.zeros((4, 4)), np.ones((2, 2))],
+                  ids=["a", "b"],
+                  traces=[(tid, pid), None])
+    back = load_requests(path)
+    assert back[0].trace_id == tid
+    assert back[0].parent_id == pid
+    assert back[1].trace_id is None and back[1].parent_id is None
+
+
+def test_npz_bare_trace_id_string():
+    """A bare string in traces= means (trace_id, no parent)."""
+    store = {}
+    obs_trace.inject_npz(store, 0, "feedfacefeedface")
+    assert "trace.0" in store and "trace_parent.0" not in store
+
+
+def test_trace_chains_and_connectivity():
+    recs = [
+        {"kind": "span", "ts": 2.0, "trace_id": "t1",
+         "span_id": "b", "parent_id": "a", "name": "mid"},
+        {"kind": "span", "ts": 1.0, "trace_id": "t1",
+         "span_id": "a", "parent_id": None, "name": "root"},
+        {"kind": "span", "ts": 3.0, "trace_id": "t1",
+         "span_id": "c", "parent_id": "b", "name": "leaf"},
+        {"kind": "span", "ts": 1.5, "trace_id": "t2",
+         "span_id": "x", "parent_id": None, "name": "root"},
+        {"kind": "span", "ts": 9.0, "name": "untraced",
+         "dur_s": 0.0},
+    ]
+    chains = obs_trace.trace_chains(recs)
+    assert set(chains) == {"t1", "t2"}
+    assert [r["name"] for r in chains["t1"]] == \
+        ["root", "mid", "leaf"]
+    assert obs_trace.trace_is_connected(chains["t1"])
+    assert obs_trace.trace_is_connected(chains["t2"])
+    # two roots = NOT one connected trace
+    broken = chains["t1"] + chains["t2"]
+    assert not obs_trace.trace_is_connected(broken)
+    # an orphan parent that is not a member counts as a root: one
+    # external root is fine (cross-process continuation) ...
+    ext = [{"kind": "span", "ts": 1.0, "trace_id": "t3",
+            "span_id": "m", "parent_id": "upstream", "name": "n"}]
+    assert obs_trace.trace_is_connected(ext)
+    # ... two distinct orphan parents are a broken chain
+    ext.append({"kind": "span", "ts": 2.0, "trace_id": "t3",
+                "span_id": "n", "parent_id": "elsewhere",
+                "name": "n2"})
+    assert not obs_trace.trace_is_connected(ext)

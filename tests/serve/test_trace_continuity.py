@@ -1,0 +1,108 @@
+"""Cross-process trace continuity (ISSUE 12 satellite).
+
+The multi-process replica story: a submitter process mints trace
+ids and writes them into the npz request codec; a `serve service`
+CLI replica (true subprocess) serves the file with an obs sink
+live; the exported chrome-trace then contains ONE connected trace
+per request — rooted at the submitter's span, spanning
+submit→enqueue→dispatch→deliver inside the replica — and the flow
+events bind each trace across the timeline."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from brainiak_tpu.obs import trace as obs_trace
+from brainiak_tpu.obs.export import (chrome_trace,
+                                     validate_chrome_trace)
+from brainiak_tpu.obs.report import load_records
+from tests.conftest import REPO_ROOT
+
+N_REQUESTS = 5
+
+
+@pytest.fixture(scope="module")
+def traced_run(tmp_path_factory):
+    """One traced `serve service` subprocess run over codec-injected
+    trace ids; returns (injected traces, obs records, client spans).
+    """
+    from brainiak_tpu.serve import save_model, save_requests
+    from brainiak_tpu.serve.__main__ import (build_demo_model,
+                                             build_mixed_requests)
+
+    tmp = tmp_path_factory.mktemp("trace-continuity")
+    obs_dir = str(tmp / "obs")
+    model_path = str(tmp / "model.npz")
+    req_path = str(tmp / "requests.npz")
+    model = build_demo_model(n_subjects=2, voxels=10, samples=20,
+                             features=3, n_iter=2, seed=1)
+    save_model(model, model_path)
+    reqs = build_mixed_requests(model, N_REQUESTS, seed=1,
+                                tr_choices=(5, 9))
+    # the submitter process: one client span per request, its id
+    # carried as the request's parent through the codec
+    traces = [(obs_trace.new_trace_id(), obs_trace.new_span_id())
+              for _ in reqs]
+    save_requests(req_path, [r.x for r in reqs],
+                  subjects=[r.subject for r in reqs],
+                  ids=[r.request_id for r in reqs],
+                  traces=traces)
+    proc = subprocess.run(
+        [sys.executable, "-m", "brainiak_tpu.serve", "service",
+         "--model", f"m={model_path}", "--requests", req_path,
+         "--waves", "1", "--format=json"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 BENCH_FORCE_CPU="1",
+                 BRAINIAK_TPU_OBS_DIR=obs_dir),
+        timeout=420)
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout)
+    assert summary["n_ok"] == N_REQUESTS
+    files = [os.path.join(obs_dir, f)
+             for f in sorted(os.listdir(obs_dir))
+             if f.endswith(".jsonl")]
+    records, errors = load_records(files)
+    assert errors == []
+    return traces, records
+
+
+def test_one_connected_trace_per_request(traced_run):
+    traces, records = traced_run
+    chains = obs_trace.trace_chains(records)
+    assert set(chains) == {tid for tid, _ in traces}
+    for tid, client_span in traces:
+        recs = chains[tid]
+        names = [r["name"] for r in recs]
+        # the full replica-side chain, in causal order
+        assert names == ["serve.submit", "serve.enqueue",
+                         "serve.dispatch", "serve.request"], names
+        # rooted at the SUBMITTER's span: cross-process continuity
+        assert recs[0]["parent_id"] == client_span
+        assert obs_trace.trace_is_connected(recs)
+        for parent, child in zip(recs, recs[1:]):
+            assert child["parent_id"] == parent["span_id"]
+
+
+def test_export_renders_request_flows(traced_run):
+    traces, records = traced_run
+    doc = chrome_trace(records)
+    assert validate_chrome_trace(doc) == []
+    flows = [e for e in doc["traceEvents"]
+             if e["ph"] in ("s", "t", "f")]
+    by_id = {}
+    for ev in flows:
+        by_id.setdefault(ev["id"], []).append(ev["ph"])
+    assert set(by_id) == {tid for tid, _ in traces}
+    for phases in by_id.values():
+        # one start, one finish, steps between (4 spans = 2 steps)
+        assert phases[0] == "s" and phases[-1] == "f"
+        assert phases.count("t") == len(phases) - 2
+    # traced X slices carry their ids for the viewer
+    traced_slices = [e for e in doc["traceEvents"]
+                     if e["ph"] == "X"
+                     and e["args"].get("trace_id")]
+    assert len(traced_slices) == 4 * N_REQUESTS

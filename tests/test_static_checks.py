@@ -44,8 +44,8 @@ def test_run_checks_json_output():
     assert payload["findings"] == []
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
-        "jaxlint", "jaxlint-deep", "obs", "regress", "serve",
-        "service", "distla", "encoding", "kernels"}
+        "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
+        "serve", "service", "distla", "encoding", "kernels"}
     assert payload["files"] > 100
     seconds = payload["gate_seconds"]
     assert set(seconds) == set(payload["gates"])
@@ -506,3 +506,77 @@ def test_kernels_gate_classifies_failures(monkeypatch):
     rc.check_kernels(findings)
     assert [f.code for f in findings] == ["KRN001"]
     assert "rc=3" in findings[0].message
+
+
+# -- ISSUE 12: the obs-live gate (OBS002) -----------------------------
+
+def test_obs_live_gate_passes_on_live_package():
+    """The obs-live gate (OBS002): a child ServeService drive with
+    SLO tracking + HTTP exposition, scraped and validated over real
+    HTTP.  Passing on the live tree IS the live-telemetry
+    acceptance at process granularity."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_obs_live(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_obs_live_gate_classifies_failures(monkeypatch):
+    """A failing verdict is reported as OBS002 with the failure
+    mode named: parse errors, missing series, summary/scrape
+    disagreement, and hard child crashes each classify
+    distinctly."""
+    rc = _load_run_checks()
+
+    def fake_child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    base = {"ok": False, "metrics_status": 200, "parse_errors": [],
+            "missing": [], "healthz_ok": True,
+            "readyz_ready": True, "counts_agree": True,
+            "n_requested": 12, "n_ok": 12, "scraped_ok": 12.0}
+
+    monkeypatch.setattr(rc, "_OBS_LIVE_CHILD", fake_child(
+        dict(base, parse_errors=["line 3: unparseable sample"])))
+    findings = []
+    rc.check_obs_live(findings)
+    assert [f.code for f in findings] == ["OBS002"]
+    assert "not valid Prometheus text" in findings[0].message
+
+    monkeypatch.setattr(rc, "_OBS_LIVE_CHILD", fake_child(
+        dict(base, missing=["slo_burn_rate"])))
+    findings = []
+    rc.check_obs_live(findings)
+    assert [f.code for f in findings] == ["OBS002"]
+    assert "missing required series" in findings[0].message
+    assert "slo_burn_rate" in findings[0].message
+
+    monkeypatch.setattr(rc, "_OBS_LIVE_CHILD", fake_child(
+        dict(base, counts_agree=False, scraped_ok=7.0)))
+    findings = []
+    rc.check_obs_live(findings)
+    assert [f.code for f in findings] == ["OBS002"]
+    assert "disagrees" in findings[0].message
+
+    monkeypatch.setattr(rc, "_OBS_LIVE_CHILD", fake_child(
+        dict(base, error="RuntimeError: boom")))
+    findings = []
+    rc.check_obs_live(findings)
+    assert [f.code for f in findings] == ["OBS002"]
+    assert "boom" in findings[0].message
+
+    monkeypatch.setattr(rc, "_OBS_LIVE_CHILD",
+                        "raise SystemExit(3)")
+    findings = []
+    rc.check_obs_live(findings)
+    assert [f.code for f in findings] == ["OBS002"]
+    assert "rc=3" in findings[0].message
+
+    monkeypatch.setattr(rc, "_OBS_LIVE_CHILD", fake_child(
+        dict(base, readyz_ready=False)))
+    findings = []
+    rc.check_obs_live(findings)
+    assert [f.code for f in findings] == ["OBS002"]
+    assert "readyz_ready=False" in findings[0].message

@@ -30,6 +30,12 @@ jaxlint-deep project-wide semantic analysis over the same scope:
 obs        smoke-runs ``python -m brainiak_tpu.obs report
            --format=json`` on tools/obs_fixture.jsonl and
            fails on schema violations (OBS001)
+obs-live   live telemetry plane (OBS002): a child process drives
+           a tiny ServeService with SLO tracking and the HTTP
+           exposition on an ephemeral port, scrapes /metrics +
+           /healthz + /readyz, validates the Prometheus text with
+           the in-repo parser, and requires the serve_*/slo_*
+           series present and in agreement with the JSON summary
 regress    runs ``python -m brainiak_tpu.obs regress`` on the
            committed tools/bench_fixture/ history and fails on
            a regression verdict (REG001) — the bench gate runs
@@ -99,8 +105,8 @@ from brainiak_tpu.analysis.core import (  # noqa: E402,F401
 
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
-         "jaxlint", "jaxlint-deep", "obs", "regress", "serve",
-         "service", "distla", "encoding", "kernels")
+         "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
+         "serve", "service", "distla", "encoding", "kernels")
 
 
 def python_sources():
@@ -423,6 +429,88 @@ def check_obs(findings):
             rel, 1, "OBS001",
             f"obs report CLI exited rc={proc.returncode} with no "
             "reported schema errors"))
+
+
+# -- obs-live gate ----------------------------------------------------
+
+_OBS_LIVE_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.obs.livecheck import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_obs_live(findings):
+    """Live telemetry gate (OBS002): run
+    :func:`brainiak_tpu.obs.livecheck.selfcheck` in a CPU-pinned
+    child — a real ``ServeService`` drive with SLO tracking and the
+    HTTP exposition on an ephemeral port, scraped over real HTTP.
+    Fails when the scrape does not parse as Prometheus text (the
+    minimal in-repo parser), a required ``serve_*``/``slo_*`` series
+    is missing, the scraped ok-count disagrees with the JSON
+    summary, or health/readiness misreport."""
+    rel = _rel(os.path.join(REPO, "brainiak_tpu", "obs",
+                            "livecheck.py"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _OBS_LIVE_CHILD],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     BENCH_FORCE_CPU="1"),
+            timeout=420)
+    except subprocess.TimeoutExpired:
+        findings.append(Finding(
+            rel, 1, "OBS002",
+            "obs-live selfcheck timed out after 420s (hung "
+            "backend init?)"))
+        return
+    try:
+        verdict = json.loads(proc.stdout)
+    except ValueError:
+        verdict = None
+    if verdict is None or proc.returncode not in (0, 1):
+        tail = (proc.stderr or proc.stdout or "").strip()
+        tail = "; ".join(tail.splitlines()[-3:])
+        findings.append(Finding(
+            rel, 1, "OBS002",
+            f"obs-live selfcheck failed (rc={proc.returncode}): "
+            f"{tail or 'no JSON verdict'}"))
+        return
+    if verdict.get("ok"):
+        return
+    if verdict.get("error"):
+        findings.append(Finding(
+            rel, 1, "OBS002",
+            f"obs-live drive crashed: {verdict['error']}"))
+        return
+    if verdict.get("parse_errors"):
+        for err in verdict["parse_errors"][:5]:
+            findings.append(Finding(
+                rel, 1, "OBS002",
+                f"/metrics is not valid Prometheus text: {err}"))
+        return
+    if verdict.get("missing"):
+        findings.append(Finding(
+            rel, 1, "OBS002",
+            "/metrics scrape is missing required series: "
+            + ", ".join(verdict["missing"])))
+        return
+    if not verdict.get("counts_agree", True):
+        findings.append(Finding(
+            rel, 1, "OBS002",
+            f"scraped serve_requests_total ok-count "
+            f"({verdict.get('scraped_ok')}) disagrees with the "
+            f"service summary n_ok ({verdict.get('n_ok')}) for "
+            f"{verdict.get('n_requested')} requests"))
+        return
+    findings.append(Finding(
+        rel, 1, "OBS002",
+        "obs-live selfcheck failed: "
+        f"healthz_ok={verdict.get('healthz_ok')} "
+        f"readyz_ready={verdict.get('readyz_ready')} "
+        f"metrics_status={verdict.get('metrics_status')}"))
 
 
 # -- regress gate -----------------------------------------------------
@@ -975,6 +1063,8 @@ def run_gates(only=None):
         timed("resilient-fits", check_resilient_fits, findings)
     if "obs" in selected:
         timed("obs", check_obs, findings)
+    if "obs-live" in selected:
+        timed("obs-live", check_obs_live, findings)
     if "regress" in selected:
         timed("regress", check_regress, findings)
     if "serve" in selected:
@@ -998,8 +1088,9 @@ def run_gates(only=None):
     label = "+".join(
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
-                       "jaxlint-deep", "obs", "regress", "serve",
-                       "service", "distla", "encoding", "kernels")
+                       "jaxlint-deep", "obs", "obs-live", "regress",
+                       "serve", "service", "distla", "encoding",
+                       "kernels")
            if g in selected])
     return {
         "ok": not findings,
