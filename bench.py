@@ -23,7 +23,7 @@ responsive chip the north-star whole-brain config is attempted first
 (V=65536 correlation width, E=32 — the BASELINE.json scale), then the
 V=8192 mid config, then a reduced CPU fallback.  Each chip tier runs in
 its own subprocess under a timeout so a tunnel wedge mid-tier cannot
-hang the driver's bench invocation.  Five further tiers print their
+hang the driver's bench invocation.  Six further tiers print their
 own JSON lines after the FCMA record: ``serve`` (batched
 SRM-transform serving), ``service`` (always-on continuous batching,
 ``brainiak_tpu.serve.service`` — steady-state requests/s AND p99
@@ -34,11 +34,15 @@ SUMMA-sharded Gram, ``brainiak_tpu.ops.distla`` — voxels/s of a
 [T, V] -> [V, V] correlation with the voxel axis ring-sharded), and
 ``encoding`` (voxel-wise ridge CV throughput,
 ``brainiak_tpu.encoding`` — voxels×lambdas/s of a full RidgeEncoder
-fit), and ``kernels`` (the roofline-guided fused kernels —
+fit), ``kernels`` (the roofline-guided fused kernels —
 single-scan HMM forward-backward TRs/s and fused SUMMA ring step
 GB/s, each record's ``vs_baseline`` being the measured fusion win
-over the unfused reference on the same backend), each split into an
-on-chip and a ``*_cpu_fallback`` tier so ``obs regress`` never
+over the unfused reference on the same backend), and ``streaming``
+(out-of-core subject-sharded SRM over an on-disk SubjectStore,
+``brainiak_tpu.data`` — streamed subjects/s AND the prefetch stall
+ratio, the latter ``direction="lower_is_better"`` so a collapsed
+disk/compute overlap fails CI the right way round), each split into
+an on-chip and a ``*_cpu_fallback`` tier so ``obs regress`` never
 compares host rounds against on-chip baselines.
 
 Stage breakdown: every tier runs with :mod:`brainiak_tpu.obs` enabled
@@ -115,6 +119,24 @@ ENCODING_CPU_FEATURES = 64
 ENCODING_N_LAMBDAS = 10
 ENCODING_FOLDS = 5
 ENCODING_TRS = 200
+
+# streaming tier (out-of-core subject-sharded SRM, brainiak_tpu.data):
+# a streamed SRM fit over an on-disk SubjectStore at a working set
+# deliberately larger than the per-shard budget the streamed path
+# holds live (the stack the in-memory path would allocate is the
+# stamped config.stack_bytes); subjects/s of the shard rounds plus
+# the prefetch STALL ratio (consumer time blocked on the buffer /
+# steady wall — 0 means disk+H2D fully overlapped compute; gated
+# lower_is_better).  BENCH_STREAMING_SUBJECTS overrides either
+# backend's subject count.
+STREAMING_SUBJECTS = 64
+STREAMING_CPU_SUBJECTS = 24
+STREAMING_VOXELS = 4096
+STREAMING_CPU_VOXELS = 1024
+STREAMING_TRS = 150
+STREAMING_CPU_TRS = 80
+STREAMING_FEATURES = 8
+STREAMING_ITERS = 2
 
 
 def _serve_n_requests():
@@ -324,6 +346,139 @@ def _distla_result_record(out):
     if out.get("stages"):
         rec["stages"] = out["stages"]
     return rec
+
+
+def _streaming_shape():
+    """The streaming tier's workload: env override for the subject
+    count, backend-scaled defaults for the rest (the reduced CPU
+    sizes keep the fallback round under a minute) — one reader so
+    the measured workload and the stamped config cannot drift."""
+    import os
+
+    import jax
+    tpu = jax.default_backend() == "tpu"
+    n_subjects = int(os.environ.get(
+        "BENCH_STREAMING_SUBJECTS",
+        STREAMING_SUBJECTS if tpu else STREAMING_CPU_SUBJECTS))
+    if tpu:
+        return n_subjects, STREAMING_VOXELS, STREAMING_TRS
+    return n_subjects, STREAMING_CPU_VOXELS, STREAMING_CPU_TRS
+
+
+def streaming_tier_metrics(n_subjects, n_voxels, n_trs, seed=0):
+    """The ``streaming`` tier: out-of-core SRM fit throughput over a
+    real on-disk :class:`~brainiak_tpu.data.store.SubjectStore`
+    (``brainiak_tpu.data``) — subjects/s of the streamed shard
+    rounds (``n_subjects × n_iter / steady wall``), never holding
+    the stacked ``[S, V, T]`` tensor.  The second gated metric is
+    the prefetch stall ratio: consumer seconds blocked on the
+    double buffer over the steady wall (0 = the background loader
+    fully overlapped disk + H2D with compute).  The in-memory
+    stacked fit of the SAME data at the SAME iteration schedule is
+    the ``vs_baseline`` comparator."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+
+    from brainiak_tpu.data import write_store
+    from brainiak_tpu.funcalign.srm import SRM
+
+    shard = max(2, n_subjects // 8)
+    with obs.span("bench.data_gen"):
+        rng = np.random.RandomState(seed)
+        shared = rng.randn(STREAMING_FEATURES, n_trs)
+        subjects = []
+        for _ in range(n_subjects):
+            w = np.linalg.qr(
+                rng.randn(n_voxels, STREAMING_FEATURES))[0]
+            subjects.append(
+                (w @ shared
+                 + 0.1 * rng.randn(n_voxels, n_trs)).astype(
+                     np.float32))
+        tmp = tempfile.mkdtemp(prefix="bench_streaming_")
+        store = write_store(os.path.join(tmp, "store"), subjects,
+                            dtype=np.float32)
+    # register with the SAME unit/help the prefetcher uses: the
+    # get-or-create registry keeps the first registration, and this
+    # call site can run before any ShardPrefetcher exists
+    stall_counter = obs.counter(
+        "data_prefetch_stall_seconds_total", unit="s",
+        help="consumer time spent waiting on the prefetch buffer")
+    try:
+        with obs.span("bench.warm"):
+            SRM(n_iter=1, features=STREAMING_FEATURES,
+                shard_subjects=shard).fit(store)
+        stall0 = float(stall_counter.value() or 0.0)
+        t0 = time.perf_counter()
+        with obs.span("bench.steady"):
+            SRM(n_iter=STREAMING_ITERS, features=STREAMING_FEATURES,
+                shard_subjects=shard).fit(store)
+        dt = time.perf_counter() - t0
+        stall = float(stall_counter.value() or 0.0) - stall0
+        # warm the stacked program first (n_iter is a static arg, so
+        # the warm fit must use the measured schedule) — the streamed
+        # side was warmed above, and a cold XLA compile in the
+        # baseline would flatter the streamed rate
+        SRM(n_iter=STREAMING_ITERS,
+            features=STREAMING_FEATURES).fit(subjects)
+        t1 = time.perf_counter()
+        SRM(n_iter=STREAMING_ITERS,
+            features=STREAMING_FEATURES).fit(subjects)
+        baseline_dt = time.perf_counter() - t1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    visits = n_subjects * STREAMING_ITERS
+    return {"subjects_per_sec": visits / dt,
+            "inmem_subjects_per_sec": visits / baseline_dt,
+            "stall_ratio": stall / dt,
+            "n_subjects": n_subjects, "n_voxels": n_voxels,
+            "n_trs": n_trs, "shard_subjects": shard,
+            "stack_bytes": store.stack_nbytes,
+            "backend": jax.default_backend()}
+
+
+def _streaming_result_records(out):
+    """The streaming tier's bench JSON lines — TWO records per
+    round: streamed subjects/s (``vs_baseline`` = streamed rate over
+    the in-memory stacked fit's rate on the same data) and the
+    prefetch stall ratio, stamped ``direction="lower_is_better"`` so
+    ``obs regress`` fails a collapsed overlap the right way round.
+    Tier split mirrors every other tier (``streaming`` on TPU,
+    ``streaming_cpu_fallback`` otherwise)."""
+    tier = "streaming" if out.get("backend") == "tpu" \
+        else "streaming_cpu_fallback"
+    config = {"n_subjects": out["n_subjects"],
+              "n_voxels": out["n_voxels"],
+              "n_trs": out["n_trs"],
+              "shard_subjects": out["shard_subjects"],
+              "stack_bytes": out["stack_bytes"]}
+    commit = _git_commit()
+
+    def rec(metric, value, unit, vs, direction=None, stages=None):
+        r = {"schema_version": BENCH_SCHEMA_VERSION,
+             "metric": metric, "value": round(value, 4),
+             "unit": unit, "vs_baseline": round(vs, 3),
+             "tier": tier, "config": config}
+        if direction:
+            r["direction"] = direction
+        if commit:
+            r["git_commit"] = commit
+        if stages:
+            r["stages"] = stages
+        return r
+
+    return [
+        rec("streaming_srm_subjects_per_sec",
+            float(out["subjects_per_sec"]), "subjects/sec",
+            float(out["subjects_per_sec"])
+            / max(float(out["inmem_subjects_per_sec"]), 1e-9),
+            stages=out.get("stages")),
+        rec("streaming_prefetch_stall_ratio",
+            float(out["stall_ratio"]), "ratio", 0.0,
+            direction="lower_is_better"),
+    ]
 
 
 def _kernels_shape():
@@ -968,6 +1123,16 @@ def measure_tier(tier):
                           else "kernels_cpu_fallback")
             out["stages"] = _stage_seconds(mem.records)
             return out
+        if tier == "streaming":
+            out = streaming_tier_metrics(*_streaming_shape())
+            # tier split by backend, same rule as every other tier
+            obs.gauge("bench_streaming_subjects_per_sec",
+                      unit="subjects/sec").set(
+                          out["subjects_per_sec"],
+                          tier="streaming" if out["backend"] == "tpu"
+                          else "streaming_cpu_fallback")
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "encoding":
             out = encoding_tier_metrics(*_encoding_shape())
             # the record's tier is split by backend (an on-chip
@@ -1084,6 +1249,7 @@ def main():
     _distla_main(responsive)
     _encoding_main(responsive)
     _kernels_main(responsive)
+    _streaming_main(responsive)
 
 
 def _aux_tier_main(responsive, tier, record_fn, timeout=420):
@@ -1124,6 +1290,12 @@ def _kernels_main(responsive):
 def _distla_main(responsive):
     """Distla tier: SUMMA-sharded Gram throughput."""
     _aux_tier_main(responsive, "distla", _distla_result_record)
+
+
+def _streaming_main(responsive):
+    """Streaming tier: out-of-core subject-sharded SRM — two
+    records (streamed subjects/s, prefetch stall ratio)."""
+    _aux_tier_main(responsive, "streaming", _streaming_result_records)
 
 
 def _serve_main(responsive):

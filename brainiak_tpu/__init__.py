@@ -16,6 +16,10 @@ Layout
 - ``parallel``       device-mesh / sharding / collective helpers — the analog
                      of the reference's mpi4py layer.
 - ``io`` / ``image`` host-side data plane (NIfTI, masking, condition specs).
+- ``data``           out-of-core streaming data plane: on-disk per-subject
+                     stores, the double-buffered host-to-device shard
+                     prefetcher, and streamed/minibatch SRM fits that never
+                     materialize the [subjects, V, T] stack.
 - domain packages    ``fcma``, ``funcalign``, ``factoranalysis``,
                      ``eventseg``, ``searchlight``, ``isc``, ``reprsimil``,
                      ``matnormal``, ``reconstruct``, ``hyperparamopt``,

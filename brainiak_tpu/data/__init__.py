@@ -1,0 +1,72 @@
+"""brainiak_tpu.data: the out-of-core streaming data plane.
+
+Every funcalign/factoranalysis fit used to materialize the full
+``[subjects, T, V]`` tensor on the host before anything ran, so "fit
+the whole dataset" was an OOM at thousand-subject scale — the exact
+setting of "Enabling Factor Analysis on Thousand-Subject Neuroimaging
+Datasets" (arXiv:1608.04647).  This package is the missing data
+plane (ROADMAP open item 1), following DrJAX's map-over-a-placed-axis
+discipline (arXiv:2403.07128):
+
+- :mod:`~brainiak_tpu.data.store` — :class:`SubjectStore`, a
+  manifest-described directory of per-subject arrays on disk
+  (``.npy`` memmap, ``.npz``, or NIfTI through the in-repo codec),
+  with per-subject content digests so resilient-loop fingerprints no
+  longer need the stacked tensor; :func:`write_store` converts
+  in-memory subject lists so existing call sites migrate trivially.
+- :mod:`~brainiak_tpu.data.prefetch` — :class:`ShardPrefetcher`, a
+  double-buffered background-thread loader that overlaps the disk
+  read + host-to-device copy of subject shard *s+1* with compute on
+  shard *s*, placing each batch directly onto the mesh's
+  ``'subject'`` axis (the layout ``ops.distla.shard_vmap`` expects);
+  instrumented with ``data_prefetch_seconds`` /
+  ``data_h2d_bytes_total`` / ``data_buffer_occupancy``.
+- :mod:`~brainiak_tpu.data.streaming_fit` — SRM/DetSRM outer loops
+  restructured as map-reduce over subject shards (per-shard E-step
+  feeding streaming sufficient-statistic reductions; peak memory
+  O(shard · V·T + K²), never the full stack), plus
+  :class:`IncrementalSRM`, the minibatch variant whose memory is
+  O(K) in subjects.  ``SRM.fit``/``DetSRM.fit``/``HTFA.fit`` route
+  here automatically when handed a :class:`SubjectStore`.
+
+See docs/streaming_data.md for the store layout, the pipeline
+diagram, and the memory-model table.
+"""
+
+from .prefetch import (  # noqa: F401
+    DATA_BUDGET_ENV,
+    DEFAULT_HOST_BUDGET,
+    ShardBatch,
+    ShardPrefetcher,
+    host_budget_bytes,
+    subject_shards,
+)
+from .store import (  # noqa: F401
+    STORE_FORMATS,
+    SubjectRef,
+    SubjectStore,
+    open_store,
+    write_store,
+)
+from .streaming_fit import (  # noqa: F401
+    IncrementalSRM,
+    stream_fit_detsrm,
+    stream_fit_srm,
+)
+
+__all__ = [
+    "DATA_BUDGET_ENV",
+    "DEFAULT_HOST_BUDGET",
+    "STORE_FORMATS",
+    "IncrementalSRM",
+    "ShardBatch",
+    "ShardPrefetcher",
+    "SubjectRef",
+    "SubjectStore",
+    "host_budget_bytes",
+    "open_store",
+    "stream_fit_detsrm",
+    "stream_fit_srm",
+    "subject_shards",
+    "write_store",
+]

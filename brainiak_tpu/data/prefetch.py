@@ -1,0 +1,371 @@
+"""Double-buffered async host-to-device subject-shard pipeline.
+
+:class:`ShardPrefetcher` walks a :class:`~brainiak_tpu.data.store.
+SubjectStore` shard by shard on a background thread: while the
+consumer computes on shard *s*, the loader reads shard *s+1* from
+disk, stacks/pads it, and (in device mode) starts its
+``jax.device_put`` onto the mesh's ``'subject'`` axis — the layout
+:func:`brainiak_tpu.ops.distla.shard_vmap` expects — so the H2D copy
+overlaps compute instead of serializing with it.  The buffer is a
+bounded queue (``depth``, default 2 = classic double buffering):
+when the consumer falls behind, the loader blocks instead of racing
+ahead of the host budget.
+
+Failure contract: an exception in the loader thread (a bad subject
+file, an injected ``io_error`` past its retry budget) is captured
+and re-raised — the original exception — from the consumer's next
+``__next__``; the fit fails loudly, never hangs.
+
+Telemetry (no-ops while obs is disabled, and the pipeline performs
+**zero** device syncs in that state): per-shard
+``data_prefetch_seconds`` histograms and ``data.prefetch_shard``
+spans from the loader thread, ``data_h2d_bytes_total`` for bytes
+placed, ``data_buffer_occupancy`` for queue depth, and stall
+accounting (``data_prefetch_stall_seconds_total``) on the consumer
+side so the overlap ratio is measurable (the ``streaming`` bench
+tier gates it).
+"""
+
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import sink as obs_sink
+from ..obs import spans as obs_spans
+from ..parallel.mesh import DEFAULT_SUBJECT_AXIS
+
+__all__ = ["DATA_BUDGET_ENV", "DEFAULT_HOST_BUDGET", "ShardBatch",
+           "ShardPrefetcher", "host_budget_bytes", "subject_shards"]
+
+#: Env override for the streaming host working-set budget (bytes).
+DATA_BUDGET_ENV = "BRAINIAK_TPU_DATA_BUDGET_BYTES"
+
+#: Default host budget for the streamed working set: the stacked
+#: tensor a shard pass may hold live at once (shard batch plus the
+#: double buffer), NOT the dataset size.  1 GiB keeps thousand-
+#: subject stores streamable on modest hosts.
+DEFAULT_HOST_BUDGET = 1 << 30
+
+
+def host_budget_bytes():
+    """The per-process byte budget for the streamed working set
+    (``BRAINIAK_TPU_DATA_BUDGET_BYTES`` overrides the 1 GiB
+    default).  The streamed fits size their default subject shard so
+    ``depth + 1`` in-flight shard batches fit inside it."""
+    env = os.environ.get(DATA_BUDGET_ENV)
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            pass
+    return DEFAULT_HOST_BUDGET
+
+
+def subject_shards(n_subjects, shard_size):
+    """Split ``range(n_subjects)`` into contiguous ``(lo, hi)``
+    shards of at most ``shard_size`` subjects (the last may be
+    short; the prefetcher zero-pads it back to ``shard_size`` lanes
+    so every shard batch has ONE program shape)."""
+    shard_size = int(shard_size)
+    if shard_size < 1:
+        raise ValueError(f"shard_size must be >= 1, got {shard_size}")
+    return [(lo, min(lo + shard_size, n_subjects))
+            for lo in range(0, int(n_subjects), shard_size)]
+
+
+class ShardBatch:
+    """One prefetched subject shard.
+
+    Attributes
+    ----------
+    index : int
+        Position of this shard in the pass (0-based).
+    lo, hi : int
+        Subject range ``[lo, hi)`` this shard covers; ``hi - lo`` may
+        be smaller than the lane count (the pad lanes have
+        ``mask == 0``).
+    x : array or None
+        Stacked ``[lanes, v_pad, samples]`` batch (device-placed in
+        device mode), demeaned when requested.  ``None`` in raw mode.
+    counts, mask, trace_xtx : float arrays ``[lanes]``
+        Per-lane voxel counts, real-subject mask, and raw-data
+        sum-of-squares (computed BEFORE demeaning, matching
+        ``_stack_and_pad``; zeros in raw mode, whose consumers
+        never read it).
+    means : list of arrays or None
+        Per-real-subject voxel means (``want_means=True`` only).
+    subjects : list of arrays or None
+        Raw ragged per-subject host arrays (raw mode only —
+        HTFA's host-side subsampling path).
+    """
+
+    __slots__ = ("index", "lo", "hi", "x", "counts", "mask",
+                 "trace_xtx", "means", "subjects")
+
+    def __init__(self, index, lo, hi, x=None, counts=None, mask=None,
+                 trace_xtx=None, means=None, subjects=None):
+        self.index = index
+        self.lo = lo
+        self.hi = hi
+        self.x = x
+        self.counts = counts
+        self.mask = mask
+        self.trace_xtx = trace_xtx
+        self.means = means
+        self.subjects = subjects
+
+
+class _End:
+    """Queue sentinel: normal exhaustion or a captured loader error."""
+
+    __slots__ = ()
+
+
+_DONE = _End()
+
+
+class ShardPrefetcher:
+    """Iterate a store's subject shards with background loading (see
+    module docstring).  Use as an iterator or context manager::
+
+        with ShardPrefetcher(store, shards, dtype=dt) as pf:
+            for batch in pf:
+                ...  # compute on batch while the next one loads
+
+    Parameters
+    ----------
+    store : :class:`~brainiak_tpu.data.store.SubjectStore`
+    shards : list of (lo, hi) subject ranges (:func:`subject_shards`)
+    dtype : numpy dtype the batch is cast to (the fit dtype)
+    lanes : lane count every batch is padded to (default: the widest
+        shard) — one program shape across the whole pass
+    pad_voxels : voxel padding (default: ``store.v_max``)
+    demean : subtract each subject's voxel mean (probabilistic SRM's
+        convention; ``trace_xtx`` stays raw either way)
+    mesh, axis_name : place each batch sharded over the mesh axis
+        (``lanes`` must divide the axis size)
+    to_device : place batches on device (False: host numpy batches)
+    raw : yield ragged host subject lists instead of stacked batches
+        (HTFA's subsampling path; implies host placement)
+    want_means : collect per-subject voxel means
+    depth : buffered shards (2 = double buffering)
+    verify : forward to :meth:`SubjectStore.read` (digest check)
+    """
+
+    def __init__(self, store, shards, *, dtype=np.float32, lanes=None,
+                 pad_voxels=None, demean=False, mesh=None,
+                 axis_name=DEFAULT_SUBJECT_AXIS, to_device=True,
+                 raw=False, want_means=False, depth=2, verify=False):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.store = store
+        self.shards = list(shards)
+        self.dtype = np.dtype(dtype)
+        self.lanes = int(lanes) if lanes is not None else (
+            max((hi - lo for lo, hi in self.shards), default=0))
+        self.pad_voxels = int(pad_voxels) if pad_voxels is not None \
+            else store.v_max
+        self.demean = bool(demean)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.to_device = bool(to_device) and not raw
+        self.raw = bool(raw)
+        self.want_means = bool(want_means)
+        self.verify = bool(verify)
+        if mesh is not None and self.to_device:
+            axis = mesh.shape.get(axis_name, 1)
+            if self.lanes % axis:
+                raise ValueError(
+                    f"shard lane count {self.lanes} is not a "
+                    f"multiple of the mesh '{axis_name}' axis "
+                    f"({axis}); pad the shard size up to a multiple")
+        self._queue = queue.Queue(maxsize=depth)
+        self._lock = threading.Lock()
+        self._error = None        # guarded-by: _lock
+        self._stop = False        # guarded-by: _lock
+        self._stall_s = 0.0       # guarded-by: _lock
+        self._bytes_placed = 0    # guarded-by: _lock
+        self._consumed = 0        # consumer thread only
+        self._thread = threading.Thread(
+            target=self._run, name="data-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- loader thread ----------------------------------------------------
+    def _should_stop(self):  # requires-lock: _lock
+        return self._stop
+
+    def _put(self, item):
+        """Bounded put that aborts promptly when the consumer closed
+        (close() drains the queue, so the timeout loop re-checks the
+        stop flag instead of blocking forever on a full buffer)."""
+        while True:
+            with self._lock:
+                if self._should_stop():
+                    return False
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+
+    def _run(self):
+        occupancy = obs_metrics.gauge(
+            "data_buffer_occupancy",
+            help="prefetched subject shards currently buffered")
+        try:
+            for index, (lo, hi) in enumerate(self.shards):
+                with self._lock:
+                    if self._should_stop():
+                        return
+                t0 = time.perf_counter()
+                with obs_spans.span(
+                        "data.prefetch_shard",
+                        attrs={"shard": index, "lo": lo, "hi": hi}):
+                    batch = self._load(index, lo, hi)
+                    if self.to_device and batch.x is not None:
+                        batch.x = self._place(batch.x)
+                        nbytes = batch.x.size \
+                            * self.dtype.itemsize
+                        with self._lock:
+                            self._bytes_placed += nbytes
+                        obs_metrics.counter(
+                            "data_h2d_bytes_total", unit="bytes",
+                            help="subject-shard bytes placed on "
+                                 "device by the prefetcher").inc(
+                                nbytes)
+                        if obs_sink.enabled():
+                            # charge the H2D copy to THIS span (the
+                            # whole point of prefetching is that this
+                            # wait runs on the loader thread, not the
+                            # consumer); obs disabled → no sync, the
+                            # copy completes asynchronously under the
+                            # consumer's first use
+                            import jax
+
+                            jax.block_until_ready(batch.x)
+                obs_metrics.histogram(
+                    "data_prefetch_seconds", unit="s",
+                    help="disk read + stack + device placement per "
+                         "prefetched shard").observe(
+                        time.perf_counter() - t0)
+                if not self._put(batch):
+                    return
+                occupancy.set(self._queue.qsize())
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            with self._lock:
+                self._error = exc
+        finally:
+            self._put(_DONE)
+
+    def _load(self, index, lo, hi):
+        reads = [self.store.read(i, verify=self.verify)
+                 for i in range(lo, hi)]
+        counts = np.zeros(self.lanes, dtype=self.dtype)
+        mask = np.zeros(self.lanes, dtype=self.dtype)
+        trace = np.zeros(self.lanes, dtype=self.dtype)
+        means = [] if self.want_means else None
+        subjects = [] if self.raw else None
+        x = None if self.raw else np.zeros(
+            (self.lanes, self.pad_voxels, self.store.samples),
+            dtype=self.dtype)
+        for lane, arr in enumerate(reads):
+            d = np.asarray(arr, dtype=self.dtype)
+            counts[lane] = d.shape[0]
+            mask[lane] = 1.0
+            if self.raw:
+                # raw consumers (HTFA subsampling, IncrementalSRM)
+                # never read trace_xtx — skip the O(V*T) reduction
+                subjects.append(d)
+                continue
+            # raw-data sum of squares, matching _stack_and_pad: the
+            # reference's trace is of the data BEFORE demeaning
+            trace[lane] = np.sum(d ** 2)
+            if self.want_means or self.demean:
+                m = d.mean(axis=1)
+                if self.want_means:
+                    means.append(m)
+                if self.demean:
+                    d = d - m[:, None]
+            x[lane, :d.shape[0]] = d
+        return ShardBatch(index, lo, hi, x=x, counts=counts,
+                          mask=mask, trace_xtx=trace, means=means,
+                          subjects=subjects)
+
+    def _place(self, x):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.mesh import place_on_mesh
+
+        if self.mesh is not None \
+                and self.axis_name in self.mesh.shape:
+            spec = PartitionSpec(self.axis_name, None, None)
+            return place_on_mesh(
+                x, NamedSharding(self.mesh, spec))
+        return jax.device_put(x)
+
+    # -- consumer side ----------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        item = self._queue.get()
+        stall = time.perf_counter() - t0
+        with self._lock:
+            self._stall_s += stall
+            err = self._error
+        obs_metrics.counter(
+            "data_prefetch_stall_seconds_total", unit="s",
+            help="consumer time spent waiting on the prefetch "
+                 "buffer").inc(stall)
+        obs_metrics.gauge(
+            "data_buffer_occupancy",
+            help="prefetched subject shards currently buffered").set(
+                self._queue.qsize())
+        if isinstance(item, _End):
+            self._thread.join(timeout=10.0)
+            if err is not None:
+                raise err
+            raise StopIteration
+        self._consumed += 1
+        return item
+
+    def close(self):
+        """Stop the loader and release the buffer (safe to call
+        multiple times; also runs on context exit).  A consumer that
+        abandons a pass mid-way (an exception in its compute) must
+        not leave the loader blocked on a full queue."""
+        with self._lock:
+            self._stop = True
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # -- stats ------------------------------------------------------------
+    @property
+    def stall_seconds(self):
+        """Consumer seconds spent blocked on the buffer this pass
+        (≈0 when prefetch fully overlaps compute)."""
+        with self._lock:
+            return self._stall_s
+
+    @property
+    def bytes_placed(self):
+        """Bytes of shard batches placed on device this pass."""
+        with self._lock:
+            return self._bytes_placed

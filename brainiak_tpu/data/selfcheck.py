@@ -1,0 +1,116 @@
+"""CI selfcheck for the streaming data plane (DAT001 gate).
+
+Run as a subprocess child by ``tools/run_checks.py`` on the 8-device
+CPU mesh: proves (1) streamed-vs-in-memory SRM parity over a real
+on-disk :class:`~brainiak_tpu.data.store.SubjectStore`, (2)
+resume-at-shard-round — an injected preemption mid-stream, then a
+resumed fit that matches the uninterrupted one, and (3) retrace
+stability: a REPEAT streamed fit (second full set of shard rounds in
+the same process) must not rebuild any ``data.*``/``srm.*`` program
+— every counted site stays at <= 1 trace.
+"""
+
+import numpy as np
+
+__all__ = ["selfcheck"]
+
+
+def selfcheck(out=None):
+    """Prints a JSON verdict; returns 0 on pass, 1 on failure."""
+    import json
+    import os
+    import sys
+    import tempfile
+
+    from ..funcalign.srm import SRM, DetSRM
+    from ..obs import metrics as obs_metrics
+    from ..parallel.mesh import DEFAULT_SUBJECT_AXIS, make_mesh
+    from ..resilience import faults
+    from .store import write_store
+
+    stream = out or sys.stdout
+    rng = np.random.RandomState(0)
+    # 10 subjects over shards of 4: the final shard is SHORT (2 real
+    # + 2 masked pad lanes), so the zero-pad reduction path runs
+    # under the mesh.  One mesh for every fit below — each counted
+    # builder must be constructed exactly once process-wide.
+    n_subjects, samples, features = 10, 30, 3
+    shared = rng.randn(features, samples)
+    subjects = []
+    for i in range(n_subjects):
+        v = 20 + i  # ragged: the zero-pad path must stay exact
+        q, _ = np.linalg.qr(rng.randn(v, features))
+        subjects.append((q @ shared
+                         + 0.1 * rng.randn(v, samples)).astype(
+                             np.float32))
+
+    mesh = make_mesh((DEFAULT_SUBJECT_AXIS,), (4,))
+    errs = []
+    resume_ok = True
+    with tempfile.TemporaryDirectory() as tmp:
+        store = write_store(os.path.join(tmp, "store"), subjects)
+
+        # (1) streamed vs in-memory parity over mesh-sharded shards
+        inmem = SRM(n_iter=4, features=features).fit(subjects)
+        streamed = SRM(n_iter=4, features=features, mesh=mesh,
+                       shard_subjects=4).fit(store)
+        errs.append(max(
+            float(np.max(np.abs(a - b)))
+            for a, b in zip(inmem.w_, streamed.w_)))
+        errs.append(float(np.max(np.abs(inmem.s_ - streamed.s_))))
+        errs.append(float(np.max(np.abs(inmem.rho2_
+                                        - streamed.rho2_))))
+
+        det_in = DetSRM(n_iter=4, features=features).fit(subjects)
+        det_st = DetSRM(n_iter=4, features=features, mesh=mesh,
+                        shard_subjects=4).fit(store)
+        errs.append(max(
+            float(np.max(np.abs(a - b)))
+            for a, b in zip(det_in.w_, det_st.w_)))
+        errs.append(float(np.max(np.abs(det_in.s_ - det_st.s_))))
+
+        # (2) resume at the last completed shard round after an
+        # injected preemption
+        ckpt = os.path.join(tmp, "ckpt")
+        try:
+            with faults.inject("preempt", at_step=2):
+                SRM(n_iter=4, features=features, mesh=mesh,
+                    shard_subjects=4).fit(
+                        store, checkpoint_dir=ckpt,
+                        checkpoint_every=2)
+            resume_ok = False  # the fault must fire
+        except faults.PreemptionError:
+            pass
+        resumed = SRM(n_iter=4, features=features, mesh=mesh,
+                      shard_subjects=4).fit(
+                          store, checkpoint_dir=ckpt,
+                          checkpoint_every=2)
+        resume_err = max(
+            float(np.max(np.abs(a - b)))
+            for a, b in zip(streamed.w_, resumed.w_))
+        errs.append(resume_err)
+        if resume_err > 1e-5:
+            resume_ok = False
+
+        # (3) repeat shard rounds: a second full streamed fit must
+        # hit every program cache (counted below)
+        SRM(n_iter=2, features=features, mesh=mesh,
+            shard_subjects=4).fit(store)
+
+    retrace = obs_metrics.counter("retrace_total")
+    sites = {}
+    for labels, value in retrace.samples():
+        site = labels.get("site", "")
+        if site.startswith(("data.", "srm.stream",
+                            "srm.incremental")):
+            sites[site] = value
+    tol = 5e-4
+    ok = max(errs) < tol and resume_ok \
+        and all(c <= 1.0 for c in sites.values()) \
+        and {"srm.stream_init", "srm.stream_prob_shard",
+             "srm.stream_det_shard"} <= set(sites)
+    json.dump({"ok": bool(ok), "max_err": max(errs), "tol": tol,
+               "resume_ok": bool(resume_ok), "retraces": sites},
+              stream)
+    stream.write("\n")
+    return 0 if ok else 1

@@ -1,0 +1,785 @@
+"""Streamed SRM/DetSRM fits: map-reduce over subject shards.
+
+The stacked fits (:mod:`brainiak_tpu.funcalign.srm`) hold the full
+``[subjects, V, T]`` tensor resident.  Mathematically, though, each
+EM/BCD iteration touches the data only through per-subject terms and
+two kinds of small reductions:
+
+- probabilistic SRM: the shared-response statistic
+  ``Σ_i W_iᵀ X_i / ρ_i²`` ([K, T]) plus per-subject scalars
+  (``ρ_i²``, ``tr X_iᵀX_i``);
+- deterministic SRM: ``Σ_i W_iᵀ X_i`` ([K, T]).
+
+So the outer loops restructure as a **map over subject shards**
+(per-shard Procrustes W updates — :func:`~brainiak_tpu.funcalign.
+srm._procrustes_batch`, sharded over the mesh subject axis) feeding
+**streaming sufficient-statistic reductions**, with one key
+observation: the W update of iteration *t+1* needs only the shared
+response of iteration *t*, so W is never persisted — it is
+recomputed inside each pass while that shard's data is resident.
+Peak memory is O(shard · V·T + K·T + K² + S), never
+O(subjects · V·T).  One fit costs ``n_iter + 2`` passes over the
+store (an init pass for ``W₀ᵀX`` accumulation, one pass per
+iteration, and an output pass that materializes the per-subject maps
+of the final iteration).
+
+Checkpoint/resume rides :func:`~brainiak_tpu.resilience.guards.
+run_resilient_loop` with the [K,T]-sized statistics as the state —
+a preempted fit resumes at the last completed shard round (= one
+full pass over the shards), and the checkpoint fingerprint comes
+from the store's per-subject digests
+(:meth:`~brainiak_tpu.data.store.SubjectStore.fingerprint`), so it
+never needs the stacked tensor either.
+
+:class:`IncrementalSRM` is the minibatch variant whose state is
+O(K·T) regardless of subject count: it keeps only the running
+shared response (online averaging over minibatch block updates) and
+computes per-subject bases on demand.
+"""
+
+import logging
+from functools import partial
+
+import numpy as np
+
+from ..obs import runtime as obs_runtime
+from ..obs import spans as obs_spans
+from ..parallel.mesh import DEFAULT_SUBJECT_AXIS
+from ..resilience.guards import run_resilient_loop
+from .prefetch import ShardPrefetcher, host_budget_bytes, subject_shards
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["IncrementalSRM", "stream_fit_detsrm", "stream_fit_srm"]
+
+
+# -- jitted per-shard / global programs ------------------------------
+#
+# Builders are counted_cache'd under srm.stream_* sites: across
+# repeat shard rounds (and repeat fits in one process) every site
+# must stay at <= 1 retrace — the DAT001 gate's contract.  All shard
+# batches in a pass share ONE shape (the prefetcher pads the last
+# shard), so the jit caches inside never grow either.
+
+@obs_runtime.counted_cache("srm.stream_init")
+def _init_program(mesh):
+    """``Σ_lane W₀ᵀ X`` for one shard from per-subject PRNG keys —
+    shared by the probabilistic init (ρ²=1) and the deterministic
+    init (divide by S on the host)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..funcalign.srm import _init_w_from_keys
+
+    @partial(jax.jit, static_argnames=("features",))
+    def init_fn(keys, counts, x, mask, *, features):
+        w0 = _init_w_from_keys(keys, x.shape[1], features, counts)
+        w0 = w0 * mask[:, None, None]
+        return jnp.einsum('svk,svt->kt', w0, x)
+
+    return init_fn
+
+
+@obs_runtime.counted_cache("srm.stream_prob_shard")
+def _prob_shard_program(mesh):
+    """One probabilistic-EM shard step: per-lane Procrustes W update
+    (mesh-sharded over the subject axis when available), ρ² update,
+    and this shard's contribution to ``Σ W'ᵀX/ρ'²`` — the map side
+    of the round's map-reduce."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..funcalign.srm import _procrustes_batch
+
+    @jax.jit
+    def shard_fn(x, trace_xtx, counts, mask, shared, trace_sigma_s,
+                 samples):
+        a = jnp.einsum('svt,kt->svk', x, shared)
+        w = _procrustes_batch(a, mesh)
+        # pad lanes: counts=0 would divide by zero and their W is
+        # meaningless — mask them to inert values (ρ²=1, W=0) so
+        # the reductions below stay exact
+        safe_counts = jnp.where(mask > 0, counts, 1.0)
+        rho2 = (trace_xtx - 2.0 * jnp.sum(w * a, axis=(1, 2))
+                + trace_sigma_s) / (samples * safe_counts)
+        rho2 = jnp.where(mask > 0, rho2, 1.0)
+        wm = w * mask[:, None, None]
+        wt_part = jnp.einsum('svk,svt->kt',
+                             wm / rho2[:, None, None], x)
+        return w, rho2, wt_part
+
+    return shard_fn
+
+
+@obs_runtime.counted_cache("srm.stream_global")
+def _prob_global_program(mesh):
+    """The replicated top half of ``_em_iteration``: shared response
+    and Σ_s update from the reduced statistic — O(K²), the reduce
+    side of the round."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def global_fn(wt_invpsi_x, rho2, sigma_s, samples):
+        features = sigma_s.shape[0]
+        eye = jnp.eye(features, dtype=sigma_s.dtype)
+        rho0 = jnp.sum(1.0 / rho2)
+        chol = jax.scipy.linalg.cho_factor(sigma_s)
+        inv_sigma_s = jax.scipy.linalg.cho_solve(chol, eye)
+        sigma_s_rhos = inv_sigma_s + eye * rho0
+        chol_rhos = jax.scipy.linalg.cho_factor(sigma_s_rhos)
+        inv_sigma_s_rhos = jax.scipy.linalg.cho_solve(chol_rhos, eye)
+        shared = sigma_s @ (eye - rho0 * inv_sigma_s_rhos) \
+            @ wt_invpsi_x
+        sigma_s_new = inv_sigma_s_rhos + shared @ shared.T / samples
+        trace_sigma_s = samples * jnp.trace(sigma_s_new)
+        return shared, sigma_s_new, trace_sigma_s
+
+    return global_fn
+
+
+@obs_runtime.counted_cache("srm.stream_ll")
+def _ll_program(mesh):
+    """Marginal log-likelihood at the final EM state from the
+    streamed statistics (the streamed analog of
+    ``_final_log_likelihood``: the ``Σ WᵀX/ρ²`` it needs is exactly
+    the accumulator left by the final round)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..funcalign.srm import _srm_log_likelihood
+
+    @jax.jit
+    def ll_fn(sigma_s, rho2, counts, trace_xtx, wt_invpsi_x, samples):
+        features = sigma_s.shape[0]
+        eye = jnp.eye(features, dtype=sigma_s.dtype)
+        rho0 = jnp.sum(1.0 / rho2)
+        chol = jax.scipy.linalg.cho_factor(sigma_s)
+        sigma_s_rhos = jax.scipy.linalg.cho_solve(chol, eye) \
+            + eye * rho0
+        inv_sigma_s_rhos = jax.scipy.linalg.cho_solve(
+            jax.scipy.linalg.cho_factor(sigma_s_rhos), eye)
+        trace_xt_invsigma2_x = jnp.sum(trace_xtx / rho2)
+        return _srm_log_likelihood(
+            sigma_s, rho2, counts, wt_invpsi_x, inv_sigma_s_rhos,
+            trace_xt_invsigma2_x, samples)
+
+    return ll_fn
+
+
+@obs_runtime.counted_cache("srm.stream_det_shard")
+def _det_shard_program(mesh):
+    """One deterministic-BCD shard step: Procrustes W update and this
+    shard's ``Σ WᵀX`` contribution."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..funcalign.srm import _procrustes_batch
+
+    @jax.jit
+    def shard_fn(x, mask, shared):
+        a = jnp.einsum('svt,kt->svk', x, shared)
+        w = _procrustes_batch(a, mesh)
+        wm = w * mask[:, None, None]
+        return w, jnp.einsum('svk,svt->kt', wm, x)
+
+    return shard_fn
+
+
+# -- shard-size policy ------------------------------------------------
+
+def _resolve_lanes(store, shard_subjects, mesh, dtype, depth):
+    """Subjects per shard batch: the caller's choice, else the
+    largest shard whose ``depth + 1`` in-flight padded batches fit
+    the host budget (:func:`~brainiak_tpu.data.prefetch.
+    host_budget_bytes`) — the knob that makes a store bigger than
+    host memory stream instead of OOM.  Rounded up to the mesh
+    subject-axis size so placed batches divide it."""
+    per_subject = store.v_max * store.samples * np.dtype(dtype).itemsize
+    if shard_subjects is None:
+        budget = host_budget_bytes()
+        lanes = max(1, int(budget // (max(per_subject, 1)
+                                      * (depth + 1))))
+        lanes = min(lanes, store.n_subjects)
+    else:
+        lanes = int(shard_subjects)
+        if lanes < 1:
+            raise ValueError(
+                f"shard_subjects must be >= 1, got {lanes}")
+    if mesh is not None and DEFAULT_SUBJECT_AXIS in mesh.shape:
+        axis = mesh.shape[DEFAULT_SUBJECT_AXIS]
+        lanes = -(-lanes // axis) * axis
+    return lanes
+
+
+def _pad_lanes(arr, lanes):
+    """Pad a leading-axis host array up to ``lanes`` rows by
+    repeating row 0 (used for PRNG keys of pad lanes, whose outputs
+    are masked out)."""
+    arr = np.asarray(arr)
+    if arr.shape[0] == lanes:
+        return arr
+    reps = np.repeat(arr[:1], lanes - arr.shape[0], axis=0)
+    return np.concatenate([arr, reps], axis=0)
+
+
+def _validate_store(store, features):
+    if store.n_subjects <= 1:
+        raise ValueError(
+            "There are not enough subjects ({0:d}) to train the "
+            "model.".format(store.n_subjects))
+    if store.samples < features:
+        raise ValueError(
+            "There are not enough samples to train the model with "
+            "{0:d} features.".format(features))
+
+
+# -- probabilistic SRM ------------------------------------------------
+
+def stream_fit_srm(store, *, features, n_iter, rand_seed=0, mesh=None,
+                   shard_subjects=None, prefetch_depth=2,
+                   checkpoint_dir=None, checkpoint_every=5,
+                   name="SRM.fit_stream"):
+    """Probabilistic-SRM EM over a :class:`SubjectStore`, never
+    materializing the stacked tensor.
+
+    Returns ``(w_list, shared, sigma_s, mu_list, rho2, logprob)`` —
+    the attribute set ``SRM.fit`` publishes.  Numerics match the
+    stacked fit at the same iteration schedule up to floating-point
+    reduction order (the per-shard partial sums replace one big
+    einsum); the per-subject W trajectories are otherwise identical
+    because the init is key-exact (``_init_w_from_keys``) and each
+    round consumes exactly the statistics the stacked
+    ``_em_iteration`` does.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    _validate_store(store, features)
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    n_subjects, samples = store.n_subjects, store.samples
+    v_max = store.v_max
+    lanes = _resolve_lanes(store, shard_subjects, mesh, dtype,
+                           prefetch_depth)
+    shards = subject_shards(n_subjects, lanes)
+    samples_f = float(samples)
+    keys = np.asarray(jax.random.split(
+        jax.random.PRNGKey(rand_seed), n_subjects))
+
+    init_p = _init_program(mesh)
+    shard_p = _prob_shard_program(mesh)
+    global_p = _prob_global_program(mesh)
+
+    def prefetcher(want_means=False):
+        return ShardPrefetcher(
+            store, shards, dtype=dtype, lanes=lanes,
+            pad_voxels=v_max, demean=True, mesh=mesh,
+            depth=prefetch_depth, want_means=want_means)
+
+    def init_pass():
+        wt = jnp.zeros((features, samples), dtype=dtype)
+        with obs_spans.span("data.stream_pass",
+                            attrs={"estimator": name,
+                                   "stage": "init"}):
+            with prefetcher() as pf:
+                for batch in pf:
+                    kb = jnp.asarray(_pad_lanes(keys[batch.lo:batch.hi],
+                                                lanes))
+                    wt = wt + init_p(kb, jnp.asarray(batch.counts),
+                                     batch.x,
+                                     jnp.asarray(batch.mask),
+                                     features=features)
+        return wt
+
+    def round_pass(shared, trace_sigma_s, round_idx):
+        """One EM round's map-reduce: returns the NEXT iteration's
+        ``Σ WᵀX/ρ²`` statistic, the updated per-subject ρ², and the
+        final shard's W handles (unused except by the output pass,
+        which replays this with the final shared response)."""
+        wt_next = jnp.zeros((features, samples), dtype=dtype)
+        rho2_parts = []
+        with obs_spans.span("data.stream_pass",
+                            attrs={"estimator": name,
+                                   "round": round_idx}):
+            with prefetcher() as pf:
+                for batch in pf:
+                    _, rho2_s, wt_part = shard_p(
+                        batch.x, jnp.asarray(batch.trace_xtx),
+                        jnp.asarray(batch.counts),
+                        jnp.asarray(batch.mask), shared,
+                        trace_sigma_s, samples_f)
+                    wt_next = wt_next + wt_part
+                    rho2_parts.append((batch.lo, batch.hi, rho2_s))
+        rho2 = np.empty(n_subjects, dtype=dtype)
+        for lo, hi, part in rho2_parts:
+            # host landing of the per-subject scalars is the point:
+            # they are loop state the next round (and the checkpoint)
+            # needs on host  # jaxlint: disable=JX002
+            rho2[lo:hi] = np.asarray(part)[:hi - lo]
+        return wt_next, rho2
+
+    def run_chunk(state, step, n_steps):
+        # host round trips below are the chunked-fit checkpoint
+        # contract: the streamed statistics are [K,T]-sized loop
+        # state run_resilient_loop guards/persists (the per-shard
+        # [B,V,T] work stays on device inside round_pass)
+        wt = jnp.asarray(np.asarray(  # jaxlint: disable=JX002
+            state["wt_invpsi_x"], dtype=dtype))
+        sigma_s = jnp.asarray(np.asarray(  # jaxlint: disable=JX002
+            state["sigma_s"], dtype=dtype))
+        rho2 = np.asarray(  # jaxlint: disable=JX002
+            state["rho2"], dtype=dtype)
+        shared = state["shared"]
+        started = np.asarray(  # jaxlint: disable=JX002
+            state["initialized"]).reshape(-1)[0]
+        if not float(started):  # jaxlint: disable=JX002
+            wt = init_pass()
+            rho2 = np.ones(n_subjects, dtype=dtype)
+        for i in range(n_steps):
+            shared, sigma_s, trace_sigma_s = global_p(
+                wt, jnp.asarray(rho2), sigma_s, samples_f)
+            # the per-subject rho2 land on host once per ROUND (one
+            # [S] vector per pass over the store) — checkpoint state,
+            # not a per-dispatch sync
+            wt, rho2 = round_pass(  # jaxlint: disable=JX010
+                shared, trace_sigma_s, step + i)
+        return {
+            "wt_invpsi_x": np.asarray(wt),  # jaxlint: disable=JX002
+            "sigma_s": np.asarray(sigma_s),  # jaxlint: disable=JX002
+            "rho2": np.asarray(rho2),  # jaxlint: disable=JX002
+            "shared": np.asarray(shared),  # jaxlint: disable=JX002
+            "initialized": np.ones(1, dtype=dtype),
+        }, False
+
+    zeros = partial(np.zeros, dtype=dtype)
+    init_state = {
+        "wt_invpsi_x": zeros((features, samples)),
+        "sigma_s": np.eye(features, dtype=dtype),
+        "rho2": np.ones(n_subjects, dtype=dtype),
+        "shared": zeros((features, samples)),
+        "initialized": zeros(1),
+    }
+    fingerprint = None
+    template = None
+    if checkpoint_dir is not None:
+        fingerprint = np.concatenate([
+            store.fingerprint(),
+            [float(features), float(rand_seed), float(lanes),
+             float(np.dtype(dtype).itemsize)]])
+        template = {k: np.zeros_like(np.asarray(v))
+                    for k, v in init_state.items()}
+
+    state, _ = run_resilient_loop(
+        run_chunk, init_state, n_iter,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        fingerprint=fingerprint, template=template, name=name)
+
+    # -- output pass: materialize the final-iteration W per subject
+    # (recomputed from the final shared response — bit-identical to
+    # the last round's update), per-subject means, and the raw
+    # traces the log-likelihood needs.
+    shared = jnp.asarray(np.asarray(state["shared"], dtype=dtype))
+    sigma_s = np.asarray(state["sigma_s"], dtype=dtype)
+    trace_sigma_s = dtype(samples_f) * np.trace(sigma_s)
+    w_list = [None] * n_subjects
+    mu_list = [None] * n_subjects
+    trace_all = np.zeros(n_subjects, dtype=dtype)
+    counts = store.voxel_counts
+    with obs_spans.span("data.stream_pass",
+                        attrs={"estimator": name, "stage": "output"}):
+        with prefetcher(want_means=True) as pf:
+            for batch in pf:
+                w, _, _ = shard_p(
+                    batch.x, jnp.asarray(batch.trace_xtx),
+                    jnp.asarray(batch.counts),
+                    jnp.asarray(batch.mask), shared,
+                    jnp.asarray(trace_sigma_s), samples_f)
+                wn = np.asarray(w)  # jaxlint: disable=JX002
+                for j, subj in enumerate(range(batch.lo, batch.hi)):
+                    w_list[subj] = wn[j, :int(counts[subj])].copy()
+                    mu_list[subj] = batch.means[j]
+                trace_all[batch.lo:batch.hi] = \
+                    batch.trace_xtx[:batch.hi - batch.lo]
+
+    ll = _ll_program(mesh)(
+        jnp.asarray(sigma_s), jnp.asarray(state["rho2"], dtype=dtype),
+        jnp.asarray(counts.astype(dtype)), jnp.asarray(trace_all),
+        jnp.asarray(np.asarray(state["wt_invpsi_x"], dtype=dtype)),
+        samples_f)
+    return (w_list, np.asarray(state["shared"], dtype=dtype), sigma_s,
+            mu_list, np.asarray(state["rho2"], dtype=dtype),
+            float(ll))
+
+
+# -- deterministic SRM ------------------------------------------------
+
+def stream_fit_detsrm(store, *, features, n_iter, rand_seed=0,
+                      mesh=None, shard_subjects=None, prefetch_depth=2,
+                      checkpoint_dir=None, checkpoint_every=5,
+                      name="DetSRM.fit_stream"):
+    """Deterministic-SRM BCD over a :class:`SubjectStore` (see
+    :func:`stream_fit_srm`; the carried statistic here is just
+    ``S = Σ WᵀX / n``).  Returns ``(w_list, shared, objective)``.
+
+    The objective needs no extra pass: with ``S = Σ WᵀX / n`` by
+    construction, ``Σ‖X_i − W_i S‖² = Σ‖X_i‖² − n·‖S‖²`` (W has
+    orthonormal columns), both terms of which the final round
+    already produced.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    _validate_store(store, features)
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    n_subjects, samples = store.n_subjects, store.samples
+    v_max = store.v_max
+    lanes = _resolve_lanes(store, shard_subjects, mesh, dtype,
+                           prefetch_depth)
+    shards = subject_shards(n_subjects, lanes)
+    keys = np.asarray(jax.random.split(
+        jax.random.PRNGKey(rand_seed), n_subjects))
+
+    init_p = _init_program(mesh)
+    shard_p = _det_shard_program(mesh)
+
+    def prefetcher():
+        return ShardPrefetcher(
+            store, shards, dtype=dtype, lanes=lanes,
+            pad_voxels=v_max, demean=False, mesh=mesh,
+            depth=prefetch_depth)
+
+    def init_pass():
+        ssum = jnp.zeros((features, samples), dtype=dtype)
+        with obs_spans.span("data.stream_pass",
+                            attrs={"estimator": name,
+                                   "stage": "init"}):
+            with prefetcher() as pf:
+                for batch in pf:
+                    kb = jnp.asarray(_pad_lanes(keys[batch.lo:batch.hi],
+                                                lanes))
+                    ssum = ssum + init_p(
+                        kb, jnp.asarray(batch.counts), batch.x,
+                        jnp.asarray(batch.mask), features=features)
+        return ssum / n_subjects
+
+    def round_pass(shared, round_idx):
+        ssum = jnp.zeros((features, samples), dtype=dtype)
+        with obs_spans.span("data.stream_pass",
+                            attrs={"estimator": name,
+                                   "round": round_idx}):
+            with prefetcher() as pf:
+                for batch in pf:
+                    _, part = shard_p(batch.x,
+                                      jnp.asarray(batch.mask), shared)
+                    ssum = ssum + part
+        return ssum / n_subjects
+
+    def run_chunk(state, step, n_steps):
+        shared = jnp.asarray(np.asarray(state["shared"], dtype=dtype))
+        if not float(np.asarray(state["initialized"]).reshape(-1)[0]):
+            shared = init_pass()
+        prev = shared
+        for i in range(n_steps):
+            prev = shared
+            shared = round_pass(shared, step + i)
+        # host state is the checkpoint/guard contract
+        # jaxlint: disable=JX002
+        return {"shared": np.asarray(shared),
+                "prev_shared": np.asarray(prev),
+                "initialized": np.ones(1, dtype=dtype)}, False
+
+    init_state = {
+        "shared": np.zeros((features, samples), dtype=dtype),
+        "prev_shared": np.zeros((features, samples), dtype=dtype),
+        "initialized": np.zeros(1, dtype=dtype),
+    }
+    fingerprint = None
+    template = None
+    if checkpoint_dir is not None:
+        fingerprint = np.concatenate([
+            store.fingerprint(),
+            [float(features), float(rand_seed), float(lanes),
+             float(np.dtype(dtype).itemsize)]])
+        template = {k: np.zeros_like(v)
+                    for k, v in init_state.items()}
+
+    state, _ = run_resilient_loop(
+        run_chunk, init_state, n_iter,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        fingerprint=fingerprint, template=template, name=name)
+
+    # -- output pass: the final-iteration W comes from the shared
+    # response that ENTERED the final round (the stacked BCD body
+    # updates W before S), so replay the final round's map with
+    # ``prev_shared`` and collect W + the raw traces the objective
+    # needs.
+    prev_shared = jnp.asarray(np.asarray(state["prev_shared"],
+                                         dtype=dtype))
+    w_list = [None] * n_subjects
+    trace_total = 0.0
+    counts = store.voxel_counts
+    with obs_spans.span("data.stream_pass",
+                        attrs={"estimator": name, "stage": "output"}):
+        with prefetcher() as pf:
+            for batch in pf:
+                w, _ = shard_p(batch.x, jnp.asarray(batch.mask),
+                               prev_shared)
+                wn = np.asarray(w)  # jaxlint: disable=JX002
+                for j, subj in enumerate(range(batch.lo, batch.hi)):
+                    w_list[subj] = wn[j, :int(counts[subj])].copy()
+                trace_total += float(
+                    batch.trace_xtx[:batch.hi - batch.lo].sum())
+
+    shared_out = np.asarray(state["shared"], dtype=dtype)
+    objective = 0.5 * (trace_total
+                       - n_subjects * float(np.sum(shared_out ** 2)))
+    return w_list, shared_out, float(objective)
+
+
+# -- incremental / minibatch SRM --------------------------------------
+
+@obs_runtime.counted_cache("srm.incremental_step")
+def _incremental_program(mesh):
+    """Local BCD alternation for one minibatch against the running
+    shared response: ``inner_iter`` rounds of (W | S) block updates
+    confined to the batch — O(batch · V·K) working memory."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..funcalign.srm import _procrustes_batch
+
+    @partial(jax.jit, static_argnames=("inner_iter",))
+    def step_fn(x, mask, shared, *, inner_iter):
+        n_real = jnp.maximum(jnp.sum(mask), 1.0)
+
+        def body(_, s):
+            a = jnp.einsum('svt,kt->svk', x, s)
+            w = _procrustes_batch(a, mesh)
+            wm = w * mask[:, None, None]
+            return jnp.einsum('svk,svt->kt', wm, x) / n_real
+
+        return jax.lax.fori_loop(0, inner_iter, body, shared)
+
+    return step_fn
+
+
+class IncrementalSRM:
+    """Minibatch deterministic SRM whose memory is O(K) in subjects.
+
+    Where :func:`stream_fit_detsrm` keeps exact BCD semantics at the
+    cost of one pass per iteration, this variant trades exactness
+    for constant state: it holds only the running shared response
+    ``s_`` ([features, samples]) and folds each subject minibatch in
+    with online averaging —
+
+    ``s ← s + (b / n_seen) · (s_batch − s)``
+
+    where ``s_batch`` is ``inner_iter`` local BCD rounds of the
+    minibatch against the current ``s``.  Because every batch's W is
+    solved *against the current shared frame*, there is no rotation
+    ambiguity between batches (the first batch bootstraps the
+    frame).  Per-subject maps are not retained; compute them on
+    demand with :meth:`subject_basis` / :meth:`transform`.
+
+    ``fit`` accepts either a list of arrays or a
+    :class:`~brainiak_tpu.data.store.SubjectStore` (minibatches then
+    stream through the prefetcher); ``partial_fit`` ingests one
+    minibatch at a time for fully external loops.  With
+    ``checkpoint_dir`` the rounds run under
+    :func:`run_resilient_loop` and resume after preemption.
+    """
+
+    def __init__(self, n_iter=3, features=50, rand_seed=0,
+                 batch_subjects=8, inner_iter=3, mesh=None,
+                 prefetch_depth=2):
+        self.n_iter = n_iter
+        self.features = features
+        self.rand_seed = rand_seed
+        self.batch_subjects = int(batch_subjects)
+        self.inner_iter = int(inner_iter)
+        self.mesh = mesh
+        self.prefetch_depth = prefetch_depth
+        self.s_ = None
+        self.n_seen_ = 0
+        self._v_pad = 0
+
+    # -- internals --------------------------------------------------------
+    def _dtype(self):
+        import jax
+
+        return np.float64 if jax.config.jax_enable_x64 \
+            else np.float32
+
+    def _stack_batch(self, X, lanes=None):
+        dtype = self._dtype()
+        lanes = len(X) if lanes is None else lanes
+        v_max = max(max(d.shape[0] for d in X), self._v_pad)
+        x = np.zeros((lanes, v_max, X[0].shape[1]), dtype=dtype)
+        mask = np.zeros(lanes, dtype=dtype)
+        counts = np.zeros(lanes, dtype=dtype)
+        for i, d in enumerate(X):
+            x[i, :d.shape[0]] = np.asarray(d, dtype=dtype)
+            mask[i] = 1.0
+            counts[i] = d.shape[0]
+        return x, mask, counts, v_max
+
+    def _bootstrap(self, x, mask, counts, n_real):
+        """First minibatch defines the shared frame: start from the
+        key-exact W₀ init (same recipe as the full fits) and take
+        its mean projection as the seed shared response."""
+        import jax
+        import jax.numpy as jnp
+
+        keys = jnp.asarray(np.asarray(jax.random.split(
+            jax.random.PRNGKey(self.rand_seed), x.shape[0])))
+        ssum = _init_program(self.mesh)(
+            keys, jnp.asarray(counts), jnp.asarray(x),
+            jnp.asarray(mask), features=self.features)
+        return ssum / n_real
+
+    def partial_fit(self, X, lanes=None):
+        """Fold one minibatch (list of ``[voxels_i, samples]``
+        arrays) into the running shared response.  ``lanes`` pads
+        the batch to a fixed lane count (``fit`` pins it so a short
+        final minibatch reuses the same compiled shape)."""
+        import jax.numpy as jnp
+
+        if not X:
+            return self
+        x, mask, counts, v_pad = self._stack_batch(X, lanes=lanes)
+        self._v_pad = v_pad
+        if self.s_ is None:
+            shared = self._bootstrap(x, mask, counts, float(len(X)))
+        else:
+            if x.shape[2] != self.s_.shape[1]:
+                raise ValueError(
+                    f"batch has {x.shape[2]} samples; the running "
+                    f"shared response has {self.s_.shape[1]}")
+            shared = jnp.asarray(self.s_)
+        shared = _incremental_program(self.mesh)(
+            jnp.asarray(x), jnp.asarray(mask), shared,
+            inner_iter=self.inner_iter)
+        b = len(X)
+        self.n_seen_ += b
+        eta = b / float(self.n_seen_)
+        new = np.asarray(shared)
+        self.s_ = new if self.s_ is None or eta >= 1.0 \
+            else (1.0 - eta) * self.s_ + eta * new
+        return self
+
+    def fit(self, X, y=None, checkpoint_dir=None, checkpoint_every=1):
+        """Rounds of minibatch updates over a subject list or a
+        :class:`SubjectStore`.  Each round is one pass over all
+        minibatches; with ``checkpoint_dir`` the rounds checkpoint
+        and resume under the resilience guard."""
+        from .store import SubjectStore
+
+        is_store = isinstance(X, SubjectStore)
+        n = X.n_subjects if is_store else len(X)
+        if n <= 1:
+            raise ValueError(
+                "There are not enough subjects ({0:d}) to train "
+                "the model.".format(n))
+        dtype = self._dtype()
+        lanes = min(self.batch_subjects, n)
+        if self.mesh is not None \
+                and DEFAULT_SUBJECT_AXIS in self.mesh.shape:
+            axis = self.mesh.shape[DEFAULT_SUBJECT_AXIS]
+            lanes = -(-lanes // axis) * axis
+        shards = subject_shards(n, lanes)
+        # pin the padded voxel width up front (the store manifest —
+        # or one pass over the list shapes — knows it), so a ragged
+        # store with growing voxel counts compiles ONE batch shape
+        # instead of retracing per new widest subject
+        self._v_pad = max(
+            self._v_pad,
+            X.v_max if is_store else max(d.shape[0] for d in X))
+
+        def batches():
+            if is_store:
+                pf = ShardPrefetcher(
+                    X, shards, dtype=dtype, lanes=lanes, raw=True,
+                    depth=self.prefetch_depth)
+                with pf:
+                    for batch in pf:
+                        yield batch.subjects
+            else:
+                for lo, hi in shards:
+                    yield [np.asarray(d, dtype=dtype)
+                           for d in X[lo:hi]]
+
+        def run_chunk(state, step, n_steps):
+            self.s_ = None if not float(
+                np.asarray(state["initialized"]).reshape(-1)[0]) \
+                else np.asarray(state["shared"], dtype=dtype)
+            self.n_seen_ = int(
+                np.asarray(state["n_seen"]).reshape(-1)[0])
+            for i in range(n_steps):
+                with obs_spans.span(
+                        "data.stream_pass",
+                        attrs={"estimator": "IncrementalSRM.fit",
+                               "round": step + i}):
+                    for subj_batch in batches():
+                        # partial_fit lands the [K,T] running shared
+                        # response on host per minibatch — that IS
+                        # the O(K)-in-subjects state contract
+                        self.partial_fit(  # jaxlint: disable=JX010
+                            subj_batch, lanes=lanes)
+            return {"shared": np.asarray(self.s_),
+                    "n_seen": np.array([float(self.n_seen_)]),
+                    "initialized": np.ones(1, dtype=dtype)}, False
+
+        samples = X.samples if is_store else X[0].shape[1]
+        init_state = {
+            "shared": np.zeros((self.features, samples), dtype=dtype),
+            "n_seen": np.zeros(1),
+            "initialized": np.zeros(1, dtype=dtype),
+        }
+        fingerprint = None
+        template = None
+        if checkpoint_dir is not None:
+            if not is_store:
+                raise ValueError(
+                    "checkpoint_dir requires a SubjectStore input "
+                    "(per-subject digests make the resume "
+                    "fingerprint; wrap the list with write_store)")
+            fingerprint = np.concatenate([
+                X.fingerprint(),
+                [float(self.features), float(self.rand_seed),
+                 float(lanes), float(self.inner_iter)]])
+            template = {k: np.zeros_like(v)
+                        for k, v in init_state.items()}
+        state, _ = run_resilient_loop(
+            run_chunk, init_state, self.n_iter,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            fingerprint=fingerprint, template=template,
+            name="IncrementalSRM.fit")
+        self.s_ = np.asarray(state["shared"], dtype=dtype)
+        self.n_seen_ = int(np.asarray(state["n_seen"]).reshape(-1)[0])
+        return self
+
+    # -- on-demand subject maps ------------------------------------------
+    def subject_basis(self, x):
+        """Orthonormal ``[voxels, features]`` map for one subject's
+        data against the fitted shared response (computed on demand —
+        the O(K)-in-subjects contract means no ``w_`` list)."""
+        import jax.numpy as jnp
+
+        from ..funcalign.srm import _procrustes
+
+        if self.s_ is None:
+            raise RuntimeError(
+                "The model fit has not been run yet.")
+        a = jnp.asarray(np.asarray(x, dtype=self._dtype())) \
+            @ jnp.asarray(self.s_).T
+        return np.asarray(_procrustes(a))
+
+    def transform(self, X, y=None):
+        """Project each subject into shared space via its on-demand
+        basis: ``s_i = W_iᵀ X_i``."""
+        return [None if x is None
+                else self.subject_basis(x).T @ np.asarray(x)
+                for x in X]

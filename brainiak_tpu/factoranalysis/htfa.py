@@ -124,7 +124,8 @@ class HTFA(TFA):
                  jac='2-point', x_scale='jac', tr_solver=None,
                  weight_method='rr', upper_ratio=1.8, lower_ratio=0.02,
                  voxel_ratio=0.25, tr_ratio=0.1, max_voxel=5000,
-                 max_tr=500, verbose=False, lbfgs_iters=60, mesh=None):
+                 max_tr=500, verbose=False, lbfgs_iters=60, mesh=None,
+                 shard_subjects=None):
         self.K = K
         self.n_subj = n_subj
         self.max_global_iter = max_global_iter
@@ -145,6 +146,12 @@ class HTFA(TFA):
         self.verbose = verbose
         self.lbfgs_iters = lbfgs_iters
         self.mesh = mesh
+        #: subjects per streamed shard when ``fit`` is handed a
+        #: :class:`~brainiak_tpu.data.store.SubjectStore` (None: one
+        #: mesh-subject-axis width, else 8); ignored for in-memory
+        #: subject lists.
+        self.shard_subjects = shard_subjects
+        self._store = None
 
     # -- convergence over the global template -----------------------------
     def _converged(self):
@@ -242,30 +249,42 @@ class HTFA(TFA):
         return self
 
     # -- fitting ----------------------------------------------------------
-    def _prepare_subject_batch(self, data, R):
+    def _prepare_subject_batch(self, shapes, R):
         """Precompute per-subject subsample sizes, NLLS bounds, and the
         template-penalty scaling (reference htfa.py:697-713 clamps +
-        tfa.py:995-999), stacked along the subject axis for batching."""
+        tfa.py:995-999), stacked along the subject axis for batching.
+        Only ``shapes`` (per-subject ``(voxels, trs)``) is needed — a
+        :class:`SubjectStore` supplies them from its manifest without
+        touching the data."""
         self.sub_nvox = [min(self.max_voxel,
-                             int(self.voxel_ratio * d.shape[0]),
-                             d.shape[0]) for d in data]
+                             int(self.voxel_ratio * shp[0]),
+                             shp[0]) for shp in shapes]
         self.sub_ntr = [min(self.max_tr,
-                            int(self.tr_ratio * d.shape[1]),
-                            d.shape[1]) for d in data]
+                            int(self.tr_ratio * shp[1]),
+                            shp[1]) for shp in shapes]
         self.sub_scaling = np.array(
-            [0.5 * float(nv * nt) / float(d.shape[0] * d.shape[1])
-             for nv, nt, d in zip(self.sub_nvox, self.sub_ntr, data)])
+            [0.5 * float(nv * nt) / float(shp[0] * shp[1])
+             for nv, nt, shp in zip(self.sub_nvox, self.sub_ntr,
+                                    shapes)])
         bounds = [self.get_bounds(r) for r in R]
         self.sub_lower = np.stack([b[0] for b in bounds])
         self.sub_upper = np.stack([b[1] for b in bounds])
+        # global batch extents: every shard pads to these, so the
+        # batched subject-step program keeps ONE shape whether the
+        # subjects arrive all at once or shard by shard
+        self._vb = max(self.sub_nvox)
+        self._tb = max(self.sub_ntr)
 
-    def _gather_subsample_batch(self, data, R, rngs):
-        """Draw each subject's stochastic voxel/TR subsample and pad to
-        the common batch shape.  The ragged gather stays on host (the
-        inputs are per-subject NumPy arrays); only the padded batch
-        ships to device."""
-        S = len(data)
-        vb, tb = max(self.sub_nvox), max(self.sub_ntr)
+    def _gather_subsample_batch(self, data, R, rngs, indices):
+        """Draw the stochastic voxel/TR subsample for the subjects in
+        ``indices`` and pad to the GLOBAL batch shape.  ``data``/
+        ``R``/``rngs`` are index-aligned with ``indices`` (a shard's
+        slice); the per-subject draws depend only on that subject's
+        own RNG stream, so shard-wise processing reproduces the
+        all-subjects batch exactly.  The ragged gather stays on host;
+        only the padded batch ships to device."""
+        S = len(indices)
+        vb, tb = self._vb, self._tb
         n_dim = R[0].shape[1]
         bdata = np.zeros((S, vb, tb))
         bR = np.zeros((S, vb, n_dim))
@@ -273,21 +292,24 @@ class HTFA(TFA):
         tmask = np.zeros((S, tb))
         beta = np.zeros(S)
         sigma = np.zeros(S)
-        for s in range(S):
+        for pos, s in enumerate(indices):
             nv, nt = self.sub_nvox[s], self.sub_ntr[s]
-            feat = rngs[s].choice(data[s].shape[0], nv, replace=False)
-            samp = rngs[s].choice(data[s].shape[1], nt, replace=False)
-            curr = data[s][feat][:, samp]
-            bdata[s, :nv, :nt] = curr
-            bR[s, :nv] = R[s][feat]
-            vmask[s, :nv] = 1.0
-            tmask[s, :nt] = 1.0
-            beta[s] = np.var(curr) if self.weight_method == 'rr' else 0.0
-            sigma[s] = np.std(curr) / np.sqrt(2.0)
+            feat = rngs[pos].choice(data[pos].shape[0], nv,
+                                    replace=False)
+            samp = rngs[pos].choice(data[pos].shape[1], nt,
+                                    replace=False)
+            curr = data[pos][feat][:, samp]
+            bdata[pos, :nv, :nt] = curr
+            bR[pos, :nv] = R[pos][feat]
+            vmask[pos, :nv] = 1.0
+            tmask[pos, :nt] = 1.0
+            beta[pos] = np.var(curr) if self.weight_method == 'rr' \
+                else 0.0
+            sigma[pos] = np.std(curr) / np.sqrt(2.0)
         return bdata, bR, vmask, tmask, beta, sigma
 
     def _dispatch_batched_step(self, bdata, bR, vmask, tmask, centers,
-                               widths, beta, sigma, tmpl):
+                               widths, beta, sigma, tmpl, indices):
         """Run the batched inner step, sharding the subject axis over the
         mesh when one is set.
 
@@ -305,10 +327,16 @@ class HTFA(TFA):
         inert template values rather than copies of a real subject.
         Padded rows are discarded on fetch."""
         S = bdata.shape[0]
-        pad = 0
+        # target lane count: the streamed path pins it to the shard
+        # size so a SHORT final shard reuses the compiled program
+        # (one batch shape for the whole fit), and a mesh rounds it
+        # up to the subject-axis size either way
+        target = max(S, getattr(self, "_pad_lanes_to", 0) or 0)
         if self.mesh is not None and \
                 DEFAULT_SUBJECT_AXIS in self.mesh.shape:
-            pad = (-S) % self.mesh.shape[DEFAULT_SUBJECT_AXIS]
+            axis = self.mesh.shape[DEFAULT_SUBJECT_AXIS]
+            target = -(-target // axis) * axis
+        pad = target - S
 
         def prep(a, pad_mode):
             a = np.asarray(a)
@@ -326,12 +354,13 @@ class HTFA(TFA):
                 return place_on_mesh(a, NamedSharding(self.mesh, spec))
             return jnp.asarray(a)
 
+        idx = np.asarray(indices, dtype=int)
         modes = ("zero", "zero", "zero", "zero", "repeat", "repeat",
                  "repeat", "repeat", "one", "repeat", "zero")
         batch = [prep(a, m) for a, m in zip(
                  (bdata, bR, vmask, tmask, centers, widths,
-                  self.sub_lower, self.sub_upper, beta, sigma,
-                  self.sub_scaling), modes)]
+                  self.sub_lower[idx], self.sub_upper[idx], beta,
+                  sigma, self.sub_scaling[idx]), modes)]
         if self.mesh is not None:
             tmpl = [place_on_mesh(
                 np.asarray(t), NamedSharding(self.mesh, PartitionSpec()))
@@ -356,42 +385,43 @@ class HTFA(TFA):
         col = _match_centers(pc, qc)
         return np.concatenate([qc[col].ravel(), qw[col]])
 
-    def _fit_subjects(self, data, R, global_iter):
-        """All subjects' inner TFA fits for one global iteration.
-
-        Every inner iteration is ONE device dispatch over the batched
-        (mesh-sharded) subject axis; the per-subject Hungarian reorder
-        and convergence bookkeeping are tiny and stay on host.  The
-        returned [n_subj, prior_size] array is the analog of the
-        reference's posterior Gatherv (htfa.py:746-749); converged
-        subjects are frozen, matching the per-subject early stop of
-        TFA._fit_tfa."""
-        S = self.n_subj
+    def _template_terms(self):
+        """The replicated template-penalty terms every subject's inner
+        objective shares for one global iteration."""
         K, n_dim = self.K, self.n_dim
         tmpl_centers = self.get_centers(self.global_prior_)
         tmpl_widths = self.get_widths(self.global_prior_).reshape(-1)
         tmpl_tri = self.get_centers_mean_cov(self.global_prior_)
         tmpl_reci = (
             1.0 / self.get_widths_mean_var(self.global_prior_)).reshape(-1)
-
         tmpl_cov_inv = np.stack(
             [np.linalg.inv(_full_sym(tmpl_tri[k], n_dim))
              for k in range(K)])
-        tmpl = (tmpl_centers, tmpl_cov_inv, tmpl_widths, tmpl_reci)
+        return (tmpl_centers, tmpl_cov_inv, tmpl_widths, tmpl_reci)
 
+    def _fit_subject_shard(self, data, R, indices, global_iter, tmpl):
+        """Inner TFA fits for the subjects in ``indices`` (their raw
+        arrays in ``data``, index-aligned): the per-shard map step of
+        the streamed outer loop, also the whole batch when everything
+        is in memory.  Subsampling RNGs are seeded per subject from
+        the global iteration, so a subject's draw stream — and hence
+        its posterior trajectory — is identical whether it is fitted
+        in one all-subjects batch or inside a shard."""
+        K, n_dim = self.K, self.n_dim
+        B = len(indices)
         rngs = [np.random.RandomState(global_iter * self.max_local_iter)
-                for _ in range(S)]
-        prior = np.tile(self.global_prior_[:self.prior_size], (S, 1))
+                for _ in range(B)]
+        prior = np.tile(self.global_prior_[:self.prior_size], (B, 1))
         posterior = prior.copy()
-        converged = np.zeros(S, dtype=bool)
+        converged = np.zeros(B, dtype=bool)
         for n in range(self.max_local_iter):
             bdata, bR, vmask, tmask, beta, sigma = \
-                self._gather_subsample_batch(data, R, rngs)
-            centers = prior[:, :K * n_dim].reshape(S, K, n_dim)
+                self._gather_subsample_batch(data, R, rngs, indices)
+            centers = prior[:, :K * n_dim].reshape(B, K, n_dim)
             widths = prior[:, K * n_dim:]
             out, _ = self._dispatch_batched_step(
                 bdata, bR, vmask, tmask, centers, widths, beta, sigma,
-                tmpl)
+                tmpl, indices)
             for s in np.nonzero(~converged)[0]:
                 post_s = self._match_to_prior(prior[s], out[s])
                 posterior[s] = post_s
@@ -401,6 +431,40 @@ class HTFA(TFA):
                     prior[s] = post_s
             if converged.all():
                 break
+        return posterior
+
+    def _fit_subjects(self, data, R, global_iter):
+        """All subjects' inner TFA fits for one global iteration.
+
+        Every inner iteration is ONE device dispatch over the batched
+        (mesh-sharded) subject axis; the per-subject Hungarian reorder
+        and convergence bookkeeping are tiny and stay on host.  The
+        returned [n_subj, prior_size] array is the analog of the
+        reference's posterior Gatherv (htfa.py:746-749); converged
+        subjects are frozen, matching the per-subject early stop of
+        TFA._fit_tfa.
+
+        With a :class:`~brainiak_tpu.data.store.SubjectStore` input,
+        subjects stream through the shard prefetcher instead: while
+        one shard runs its inner L-BFGS rounds on device, the next
+        shard's raw arrays load from disk in the background — the
+        full subject list is never host-resident at once."""
+        tmpl = self._template_terms()
+        if self._store is None:
+            return self._fit_subject_shard(
+                data, R, list(range(self.n_subj)), global_iter, tmpl)
+
+        from ..data.prefetch import ShardPrefetcher, subject_shards
+
+        shards = subject_shards(self.n_subj, self._shard_size)
+        posterior = np.zeros((self.n_subj, self.prior_size))
+        with ShardPrefetcher(self._store, shards, raw=True,
+                             dtype=np.float64) as pf:
+            for batch in pf:
+                indices = list(range(batch.lo, batch.hi))
+                posterior[batch.lo:batch.hi] = self._fit_subject_shard(
+                    batch.subjects, [R[s] for s in indices], indices,
+                    global_iter, tmpl)
         return posterior
 
     def _fit_htfa(self, data, R, checkpoint_dir=None,
@@ -416,7 +480,11 @@ class HTFA(TFA):
         subsampling RNGs from the global iteration index, so a resumed
         fit reproduces the uninterrupted iterates exactly."""
         n_subj = len(R)
-        self._prepare_subject_batch(data, R)
+        shapes = [(int(c), int(self._store.samples))
+                  for c in self._store.voxel_counts] \
+            if self._store is not None \
+            else [d.shape for d in data]
+        self._prepare_subject_batch(shapes, R)
         self.local_posterior_ = np.zeros(n_subj * self.prior_size)
 
         # Template initialized from a random subject's coordinates
@@ -479,10 +547,16 @@ class HTFA(TFA):
                 self.global_prior_ = self.global_posterior_
             return pack(done), done
 
-        fingerprint = np.array(
-            [array_digest(*data),
-             float(sum(d.shape[0] for d in data)), float(n_subj),
-             float(self.K)])
+        if self._store is not None:
+            # the manifest's per-subject digests identify the data —
+            # fingerprinting never needs the subjects host-resident
+            fingerprint = np.concatenate(
+                [self._store.fingerprint(), [float(self.K)]])
+        else:
+            fingerprint = np.array(
+                [array_digest(*data),
+                 float(sum(d.shape[0] for d in data)), float(n_subj),
+                 float(self.K)])
         state, _ = run_resilient_loop(
             run_chunk, pack(False), self.max_global_iter,
             checkpoint_dir=checkpoint_dir,
@@ -495,8 +569,13 @@ class HTFA(TFA):
 
     def _update_weight(self, data, R):
         """Final per-subject factor + weight solves
-        (reference htfa.py:626-670)."""
+        (reference htfa.py:626-670).  Store-backed fits read one
+        subject at a time — the weight pass is O(one subject) in
+        host memory too."""
         weights = []
+        if self._store is not None:
+            data = (self._store.read(s)
+                    for s in range(self._store.n_subjects))
         for s, subj_data in enumerate(data):
             base = s * self.prior_size
             centers = self.local_posterior_[
@@ -513,6 +592,23 @@ class HTFA(TFA):
         return self
 
     def _check_input(self, X, R):
+        from ..data.store import SubjectStore
+
+        if isinstance(X, SubjectStore):
+            if not isinstance(R, list):
+                raise TypeError("Coordinates should be a list")
+            if X.n_subjects != len(R):
+                raise TypeError("Data and coordinates lists must "
+                                "have equal length")
+            for s, r in enumerate(R):
+                if not isinstance(r, np.ndarray) or r.ndim != 2:
+                    raise TypeError(
+                        "Each coordinate matrix should be a 2D array")
+                if int(X.voxel_counts[s]) != r.shape[0]:
+                    raise TypeError(
+                        "The numbers of voxels in data and "
+                        "coordinates differ")
+            return
         if not isinstance(X, list):
             raise TypeError("Input data should be a list")
         if not isinstance(R, list):
@@ -533,7 +629,13 @@ class HTFA(TFA):
     def fit(self, X, R, checkpoint_dir=None, checkpoint_every=5):
         """Fit HTFA (reference htfa.py:766-841).
 
-        X : list of [n_voxel, n_tr] per-subject data
+        X : list of [n_voxel, n_tr] per-subject data, or a
+            :class:`~brainiak_tpu.data.store.SubjectStore` — the
+            subjects then stream from disk shard by shard through
+            the prefetcher (disk reads of shard *s+1* overlap the
+            inner L-BFGS rounds of shard *s*) and the full subject
+            list is never host-resident at once (the
+            thousand-subject path; docs/streaming_data.md)
         R : list of [n_voxel, n_dim] per-subject coordinates
 
         With ``checkpoint_dir``, the global-template loop checkpoints
@@ -545,7 +647,26 @@ class HTFA(TFA):
         >>> htfa = HTFA(K=5, n_subj=len(X))
         >>> htfa.fit(X, R, checkpoint_dir="/ckpts/htfa1")  # resumable
         """
+        from ..data.store import SubjectStore
+
         self._check_input(X, R)
+        if isinstance(X, SubjectStore):
+            self._store = X
+            shard = self.shard_subjects
+            if shard is None:
+                shard = 8
+                if self.mesh is not None and \
+                        DEFAULT_SUBJECT_AXIS in self.mesh.shape:
+                    shard = self.mesh.shape[DEFAULT_SUBJECT_AXIS]
+            self._shard_size = int(shard)
+            # every shard batch pads to the full shard size, so the
+            # jitted inner step compiles ONE shape even when the
+            # final shard is short
+            self._pad_lanes_to = self._shard_size
+            X = None  # streamed: never hold the subject list
+        else:
+            self._store = None
+            self._pad_lanes_to = 0
         if self.weight_method not in ('rr', 'ols'):
             raise ValueError(
                 "only 'rr' and 'ols' are accepted as weight_method!")

@@ -35,13 +35,23 @@ __all__ = ["FastSRM"]
 def _safe_load(data):
     if isinstance(data, str):
         return np.load(data)
+    if hasattr(data, "load"):  # data.store.SubjectRef
+        return data.load()
     return np.asarray(data)
 
 
 def _canonicalize_imgs(imgs):
     """Accepts: array of paths [n_subjects, n_sessions]; list of arrays
-    (one session each); list of lists of arrays/paths.  Returns a list of
-    lists: imgs[subject][session] (reference fastsrm.py:383-447)."""
+    (one session each); list of lists of arrays/paths; or a
+    :class:`~brainiak_tpu.data.store.SubjectStore` (one session per
+    subject, ingested lazily through
+    :class:`~brainiak_tpu.data.store.SubjectRef` handles).  Returns a
+    list of lists: imgs[subject][session] (reference
+    fastsrm.py:383-447)."""
+    from ..data.store import SubjectStore
+
+    if isinstance(imgs, SubjectStore):
+        return [[imgs.ref(i)] for i in range(imgs.n_subjects)]
     if isinstance(imgs, np.ndarray) and imgs.dtype.kind in ("U", "S", "O") \
             and imgs.ndim == 2:
         return [[imgs[i, j] for j in range(imgs.shape[1])]
@@ -53,13 +63,38 @@ def _canonicalize_imgs(imgs):
             return [list(subj) for subj in imgs]
         return [[subj] for subj in imgs]
     raise ValueError("imgs must be a list of arrays, a list of lists of "
-                     "arrays, or a 2D array of paths")
+                     "arrays, a 2D array of paths, or a SubjectStore")
 
 
 def _shape_of(img):
     if isinstance(img, str):
         return np.load(img, mmap_mode="r").shape
+    if hasattr(img, "iter_voxel_chunks"):  # SubjectRef: manifest shape
+        return img.shape
     return np.asarray(img).shape
+
+
+#: Voxel rows per streamed ingestion slab (:func:`_iter_voxel_chunks`).
+REDUCE_CHUNK_VOXELS = 8192
+
+
+def _iter_voxel_chunks(img, chunk_voxels=None):
+    """Yield ``(start_row, block)`` voxel slabs of ``img`` without
+    loading it whole: ``.npy`` paths are served off a read-only
+    memmap (only the touched rows hit host memory),
+    :class:`SubjectRef` handles stream from their store, and
+    in-memory arrays are sliced in place — the ingestion primitive
+    behind the streamed atlas reduction."""
+    chunk = int(chunk_voxels or REDUCE_CHUNK_VOXELS)
+    if hasattr(img, "iter_voxel_chunks"):
+        yield from img.iter_voxel_chunks(chunk)
+        return
+    data = np.load(img, mmap_mode="r") if isinstance(img, str) \
+        else np.asarray(img)
+    for start in range(0, data.shape[0], chunk):
+        # memmap slab -> host copy; no device is involved here
+        block = np.asarray(data[start:start + chunk])  # jaxlint: disable=JX002
+        yield start, block
 
 
 def _check_imgs_consistency(imgs, atlas, n_components):
@@ -120,18 +155,55 @@ def _check_indexes(indexes, n_max, name):
                 f"(0..{n_max - 1})")
 
 
-def _reduce_one(data, atlas, inv_atlas):
+def _reduce_one(data, atlas, inv_atlas, chunk_voxels=None):
     """Project [n_voxels, n_timeframes] data to the reduced space;
-    returns [n_timeframes, n_supervoxels] (reference fastsrm.py:592-675)."""
-    data_t = data.T  # [T, V]
-    if inv_atlas is not None:
-        return np.asarray(jnp.asarray(data_t) @ jnp.asarray(inv_atlas))
-    if atlas is not None:
+    returns [n_timeframes, n_supervoxels] (reference
+    fastsrm.py:592-675).
+
+    Ingestion STREAMS for lazy inputs: ``data`` may be an array, an
+    ``.npy`` path, or a :class:`~brainiak_tpu.data.store.SubjectRef`.
+    Path/store-backed subjects accumulate voxel slab by voxel slab
+    (:func:`_iter_voxel_chunks`), so they are never fully
+    host-resident — the [T, n_supervoxels] output is the only
+    full-size allocation (float64 accumulators, cast back to the
+    input's result type, matching the eager formulation to
+    rounding).  In-memory arrays keep the original one-dispatch
+    device formulation — chunking an already-resident operand would
+    only trade the accelerator matmul for host BLAS.  An explicit
+    ``chunk_voxels`` forces the streamed path (tests pin the
+    chunked math against the eager one with it)."""
+    lazy = isinstance(data, str) or hasattr(data, "iter_voxel_chunks")
+    if inv_atlas is None and atlas is None:
+        return _safe_load(data).T
+    if not lazy and chunk_voxels is None:
+        data_t = np.asarray(data).T  # [T, V]
+        if inv_atlas is not None:
+            return np.asarray(jnp.asarray(data_t)
+                              @ jnp.asarray(inv_atlas))
         values = np.unique(atlas)
         values = values[values != 0]
         return np.stack([data_t[:, atlas == c].mean(axis=1)
                          for c in values], axis=1)
-    return data_t
+    n_voxels, n_frames = _shape_of(data)
+    if inv_atlas is not None:
+        inv_atlas = np.asarray(inv_atlas)
+        out = np.zeros((n_frames, inv_atlas.shape[1]))
+        for start, block in _iter_voxel_chunks(data, chunk_voxels):
+            out += block.T.astype(np.float64) \
+                @ inv_atlas[start:start + block.shape[0]]
+        return out.astype(np.result_type(block.dtype,
+                                         inv_atlas.dtype), copy=False)
+    atlas = np.asarray(atlas)
+    values = np.unique(atlas)
+    values = values[values != 0]
+    sums = np.zeros((n_frames, len(values)))
+    counts = np.array([np.count_nonzero(atlas == c) for c in values],
+                      dtype=np.float64)
+    for start, block in _iter_voxel_chunks(data, chunk_voxels):
+        onehot = (atlas[start:start + block.shape[0], None]
+                  == values[None, :]).astype(np.float64)
+        sums += block.T.astype(np.float64) @ onehot
+    return (sums / counts).astype(block.dtype, copy=False)
 
 
 class FastSRM(BaseEstimator, TransformerMixin):
@@ -202,11 +274,24 @@ class FastSRM(BaseEstimator, TransformerMixin):
 
     def _compute_basis(self, subject_sessions, shared_sessions):
         """Basis [n_components, n_voxels] from SVD of Σ_j S_jᵀ X_j
-        (reference fastsrm.py:857-952)."""
+        (reference fastsrm.py:857-952).  Path/store-backed sessions
+        accumulate the correlation voxel slab by voxel slab through
+        :func:`_iter_voxel_chunks` (the [K, V] accumulator is the
+        working set); in-memory arrays keep the one-dispatch device
+        matmul."""
         corr = None
         for img, shared in zip(subject_sessions, shared_sessions):
-            data = _safe_load(img)  # [V, T]
-            c = np.asarray(jnp.asarray(shared.T) @ jnp.asarray(data.T))
+            if isinstance(img, str) \
+                    or hasattr(img, "iter_voxel_chunks"):
+                n_voxels = _shape_of(img)[0]
+                c = np.zeros((shared.shape[1], n_voxels))
+                for start, block in _iter_voxel_chunks(img):
+                    c[:, start:start + block.shape[0]] = \
+                        (block @ shared).T  # block: [v, T]
+            else:
+                data = np.asarray(img)  # [V, T]
+                c = np.asarray(jnp.asarray(shared.T)
+                               @ jnp.asarray(data.T))
             corr = c if corr is None else corr + c
         basis = np.asarray(_procrustes(jnp.asarray(corr)))
         return basis
@@ -242,8 +327,11 @@ class FastSRM(BaseEstimator, TransformerMixin):
         atlas, inv_atlas = self._atlas_parts()
 
         def reduce_subject(i):
+            # hand _reduce_one the RAW entry (array, path, or
+            # SubjectRef): lazy inputs then reduce voxel-slab by
+            # voxel-slab off disk instead of loading eagerly
             return [self._maybe_spill(
-                _reduce_one(_safe_load(imgs[i][j]), atlas, inv_atlas),
+                _reduce_one(imgs[i][j], atlas, inv_atlas),
                 f"reduced_{i}_{j}") for j in range(n_sessions)]
 
         if self.n_jobs not in (None, 1):

@@ -180,16 +180,24 @@ def _procrustes_batch(a, mesh, perturbation=0.001):
                              a.shape[0])(a)
 
 
-def _init_w(key, voxels_pad, n_subjects, features, voxel_counts):
-    """Random orthonormal init per subject via QR, with rows beyond each
-    subject's true voxel count zeroed (srm.py:53-107)."""
-    keys = jax.random.split(key, n_subjects)
+def _init_w_from_keys(keys, voxels_pad, features, voxel_counts):
+    """Per-subject orthonormal init from EXPLICIT per-subject keys —
+    the body shared by the stacked init (:func:`_init_w`) and the
+    streamed per-shard init (``data.streaming_fit``), so a shard's
+    ``w0`` lanes are bit-identical to the stacked fit's."""
     rnd = jax.vmap(
         lambda k: jax.random.uniform(k, (voxels_pad, features)))(keys)
     row = jnp.arange(voxels_pad)[None, :, None]
     rnd = jnp.where(row < voxel_counts[:, None, None], rnd, 0.0)
     q, _ = jnp.linalg.qr(rnd)
     return jnp.where(row < voxel_counts[:, None, None], q, 0.0)
+
+
+def _init_w(key, voxels_pad, n_subjects, features, voxel_counts):
+    """Random orthonormal init per subject via QR, with rows beyond each
+    subject's true voxel count zeroed (srm.py:53-107)."""
+    keys = jax.random.split(key, n_subjects)
+    return _init_w_from_keys(keys, voxels_pad, features, voxel_counts)
 
 
 def _em_iteration(x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples,
@@ -377,13 +385,30 @@ def _stack_and_pad(X, dtype, demean=True):
     return stacked, voxel_counts, mu, trace_xtx
 
 
+def _as_subject_store(X):
+    """The streamed-fit dispatch test: a
+    :class:`~brainiak_tpu.data.store.SubjectStore` (or anything
+    duck-typing its read/metadata surface) routes ``fit`` through
+    the out-of-core data plane instead of :func:`_stack_and_pad`.
+    Imported lazily — the data plane depends on this module."""
+    from ..data.store import SubjectStore
+
+    return X if isinstance(X, SubjectStore) else None
+
+
 class _SRMBase(BaseEstimator, TransformerMixin):
 
-    def __init__(self, n_iter=10, features=50, rand_seed=0, mesh=None):
+    def __init__(self, n_iter=10, features=50, rand_seed=0, mesh=None,
+                 shard_subjects=None):
         self.n_iter = n_iter
         self.features = features
         self.rand_seed = rand_seed
         self.mesh = mesh
+        #: subjects per streamed shard batch when ``fit`` is handed a
+        #: :class:`~brainiak_tpu.data.store.SubjectStore` (None: auto
+        #: from the host budget — see ``data.prefetch``); ignored by
+        #: the in-memory path.
+        self.shard_subjects = shard_subjects
 
     # -- common checks ----------------------------------------------------
     def _validate(self, X):
@@ -473,8 +498,18 @@ class SRM(_SRMBase):
         >>> srm = SRM(n_iter=20, features=10)
         >>> srm.fit(data, checkpoint_dir="/ckpts/srm_run1")  # preempted
         >>> srm.fit(data, checkpoint_dir="/ckpts/srm_run1")  # resumes
+
+        ``X`` may also be a :class:`~brainiak_tpu.data.store.
+        SubjectStore`: the fit then streams subject shards from disk
+        (map-reduce EM, overlapped prefetch) and never materializes
+        the ``[subjects, V, T]`` stack — the thousand-subject path.
+        See docs/streaming_data.md.
         """
         logger.info('Starting Probabilistic SRM')
+        store = _as_subject_store(X)
+        if store is not None:
+            return self._fit_streamed(store, checkpoint_dir,
+                                      checkpoint_every)
         self._validate(X)
         dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
         stacked, voxel_counts, mu, trace_xtx = _stack_and_pad(X, dtype)
@@ -514,6 +549,32 @@ class SRM(_SRMBase):
         # non-finite guard on the fitted state (the checkpointed path
         # guards every chunk; the fused path is guarded here)
         check_state({"w": w, "rho2": self.rho2_, "sigma_s": self.sigma_s_,
+                     "shared": self.s_, "logprob": self.logprob_},
+                    iteration=self.n_iter, where="SRM.fit")
+        logger.info('Objective function %f', self.logprob_)
+        return self
+
+    def _fit_streamed(self, store, checkpoint_dir, checkpoint_every):
+        """Out-of-core fit over a :class:`SubjectStore`: subject
+        shards stream through the prefetcher, the EM loop runs as
+        map-reduce over them (``data.streaming_fit``), and the
+        checkpoint fingerprint comes from the store's per-subject
+        digests instead of a stacked-tensor digest."""
+        from ..data.streaming_fit import stream_fit_srm
+
+        w, shared, sigma_s, mu, rho2, ll = stream_fit_srm(
+            store, features=self.features, n_iter=self.n_iter,
+            rand_seed=self.rand_seed, mesh=self.mesh,
+            shard_subjects=self.shard_subjects,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every)
+        self.w_ = w
+        self.s_ = shared
+        self.sigma_s_ = sigma_s
+        self.mu_ = mu
+        self.rho2_ = rho2
+        self.logprob_ = float(ll)
+        check_state({"rho2": self.rho2_, "sigma_s": self.sigma_s_,
                      "shared": self.s_, "logprob": self.logprob_},
                     iteration=self.n_iter, where="SRM.fit")
         logger.info('Objective function %f', self.logprob_)
@@ -635,8 +696,17 @@ class DetSRM(_SRMBase):
         -------
         >>> det = DetSRM(n_iter=30, features=10)
         >>> det.fit(data, checkpoint_dir="/ckpts/det_run1")  # resumable
+
+        ``X`` may also be a :class:`~brainiak_tpu.data.store.
+        SubjectStore` — the fit streams subject shards from disk and
+        never materializes the stacked tensor (see
+        docs/streaming_data.md).
         """
         logger.info('Starting Deterministic SRM')
+        store = _as_subject_store(X)
+        if store is not None:
+            return self._fit_streamed(store, checkpoint_dir,
+                                      checkpoint_every)
         self._validate(X)
         dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
         stacked, voxel_counts, _, _ = _stack_and_pad(
@@ -660,6 +730,26 @@ class DetSRM(_SRMBase):
         self.s_ = fetch_replicated(shared, self.mesh)
         self.objective_ = float(objective)
         check_state({"w": w, "shared": self.s_,
+                     "objective": self.objective_},
+                    iteration=self.n_iter, where="DetSRM.fit")
+        logger.info('Objective function %f', self.objective_)
+        return self
+
+    def _fit_streamed(self, store, checkpoint_dir, checkpoint_every):
+        """Out-of-core BCD over a :class:`SubjectStore` (see
+        :meth:`SRM._fit_streamed`)."""
+        from ..data.streaming_fit import stream_fit_detsrm
+
+        w, shared, objective = stream_fit_detsrm(
+            store, features=self.features, n_iter=self.n_iter,
+            rand_seed=self.rand_seed, mesh=self.mesh,
+            shard_subjects=self.shard_subjects,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every)
+        self.w_ = w
+        self.s_ = shared
+        self.objective_ = float(objective)
+        check_state({"shared": self.s_,
                      "objective": self.objective_},
                     iteration=self.n_iter, where="DetSRM.fit")
         logger.info('Objective function %f', self.objective_)

@@ -1,0 +1,119 @@
+"""SubjectStore: layout round-trips, digests, lazy refs."""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.data import open_store, write_store
+from brainiak_tpu.data.store import STORE_FORMATS
+
+
+def make_subjects(n=4, voxels=20, samples=15, ragged=True, seed=0,
+                  dtype=np.float64):
+    rng = np.random.RandomState(seed)
+    return [rng.randn(voxels + (i if ragged else 0),
+                      samples).astype(dtype)
+            for i in range(n)]
+
+
+@pytest.mark.parametrize("fmt", STORE_FORMATS)
+def test_write_open_read_roundtrip(tmp_path, fmt):
+    subjects = make_subjects(dtype=np.float32)
+    store = write_store(str(tmp_path / "st"), subjects, fmt=fmt)
+    reopened = open_store(str(tmp_path / "st"))
+    assert reopened.n_subjects == 4
+    assert reopened.samples == 15
+    assert reopened.format == fmt
+    assert list(reopened.voxel_counts) == [20, 21, 22, 23]
+    for i, subj in enumerate(subjects):
+        got = reopened.read(i)
+        assert got.dtype == np.float32
+        np.testing.assert_array_equal(got, subj)
+
+
+def test_read_verify_catches_out_of_band_rewrite(tmp_path):
+    subjects = make_subjects(ragged=False)
+    store = write_store(str(tmp_path / "st"), subjects)
+    assert store.read(1, verify=True).shape == (20, 15)
+    # rewrite one subject file behind the manifest's back
+    np.save(store.path(1), subjects[1] + 5.0)
+    with pytest.raises(ValueError, match="digest"):
+        store.read(1, verify=True)
+    # unverified read still returns the (new) bytes
+    assert store.read(1).shape == (20, 15)
+
+
+def test_fingerprint_tracks_content_not_layout(tmp_path):
+    subjects = make_subjects()
+    a = write_store(str(tmp_path / "a"), subjects)
+    b = write_store(str(tmp_path / "b"), subjects)
+    np.testing.assert_allclose(a.fingerprint(), b.fingerprint())
+    c = write_store(str(tmp_path / "c"),
+                    [subjects[0] + 1e-3] + subjects[1:])
+    assert not np.allclose(a.fingerprint(), c.fingerprint())
+
+
+def test_open_store_errors(tmp_path):
+    with pytest.raises(FileNotFoundError, match="not a subject store"):
+        open_store(str(tmp_path / "missing"))
+
+
+def test_write_store_validation(tmp_path):
+    with pytest.raises(ValueError, match="format"):
+        write_store(str(tmp_path / "x"), [np.zeros((3, 4))],
+                    fmt="hdf5")
+    with pytest.raises(ValueError, match="empty"):
+        write_store(str(tmp_path / "x"), [])
+    with pytest.raises(ValueError, match="2-D"):
+        write_store(str(tmp_path / "x"), [np.zeros(3)])
+    with pytest.raises(ValueError, match="samples"):
+        write_store(str(tmp_path / "x"),
+                    [np.zeros((3, 4)), np.zeros((3, 5))])
+
+
+def test_read_shape_mismatch_refused(tmp_path):
+    store = write_store(str(tmp_path / "st"),
+                        make_subjects(ragged=False))
+    np.save(store.path(0), np.zeros((7, 15), dtype=np.float64))
+    with pytest.raises(ValueError, match="shape"):
+        store.read(0)
+
+
+def test_subject_ref_streams_voxel_chunks(tmp_path):
+    subjects = make_subjects(dtype=np.float32)
+    store = write_store(str(tmp_path / "st"), subjects)
+    ref = store.ref(2)
+    assert ref.shape == (22, 15)
+    np.testing.assert_array_equal(ref.load(), subjects[2])
+    seen = np.zeros_like(subjects[2])
+    for start, block in ref.iter_voxel_chunks(chunk_voxels=5):
+        assert block.shape[0] <= 5
+        seen[start:start + block.shape[0]] = block
+    np.testing.assert_array_equal(seen, subjects[2])
+
+
+def test_nbytes_accounting(tmp_path):
+    store = write_store(str(tmp_path / "st"),
+                        make_subjects(dtype=np.float32))
+    assert store.total_nbytes == sum(20 + i for i in range(4)) * 15 * 4
+    assert store.stack_nbytes == 4 * 23 * 15 * 4
+    assert store.stack_nbytes >= store.total_nbytes
+
+
+def test_store_dtype_cast_is_digested(tmp_path):
+    """float64 inputs stored as float32 must digest the CAST bytes,
+    so read-back verification agrees with the manifest."""
+    subjects = make_subjects(dtype=np.float64)
+    store = write_store(str(tmp_path / "st"), subjects,
+                        dtype=np.float32)
+    for i in range(store.n_subjects):
+        store.read(i, verify=True)
+
+
+def test_store_read_retries_transient_io(tmp_path):
+    from brainiak_tpu.resilience import faults
+
+    store = write_store(str(tmp_path / "st"), make_subjects())
+    with faults.inject("io_error", times=1) as fault:
+        got = store.read(0)
+    assert fault.fired == 1  # failed once, retried, succeeded
+    np.testing.assert_array_equal(got, store.read(0))

@@ -162,3 +162,67 @@ def test_htfa_verbose_logging(caplog):
              max_voxel=30, max_tr=20, verbose=True).fit(X, R)
     assert any("HTFA" in r.message or "global iter" in r.message
                for r in caplog.records)
+
+
+# -- ISSUE 13: SubjectStore streaming ---------------------------------
+
+def test_htfa_store_matches_in_memory(tmp_path):
+    """A SubjectStore-backed fit pulls subject shards through the
+    prefetcher (disk reads overlap the inner L-BFGS rounds) and
+    reproduces the in-memory fit: per-subject RNG streams are seeded
+    from the global iteration, so shard-wise processing draws the
+    same subsamples."""
+    from brainiak_tpu.data import write_store
+
+    X, R, _, _ = make_multi_subject(n_subj=4)
+    kw = dict(K=2, n_subj=4, max_global_iter=2, max_local_iter=2,
+              max_voxel=64, max_tr=20, lbfgs_iters=10)
+    np.random.seed(0)
+    inmem = HTFA(**kw).fit(X, R)
+    store = write_store(str(tmp_path / "st"), X, dtype=np.float64)
+    np.random.seed(0)  # the template-init subject draw must match
+    streamed = HTFA(**kw, shard_subjects=2).fit(store, R)
+    np.testing.assert_allclose(streamed.local_posterior_,
+                               inmem.local_posterior_, atol=1e-8)
+    np.testing.assert_allclose(streamed.global_posterior_,
+                               inmem.global_posterior_, atol=1e-8)
+    np.testing.assert_allclose(streamed.local_weights_,
+                               inmem.local_weights_, atol=1e-8)
+
+
+def test_htfa_store_checkpoint_resume(tmp_path):
+    """Store-backed HTFA keeps the resilient-loop resume contract,
+    with the fingerprint built from the store's manifest digests."""
+    from brainiak_tpu.data import write_store
+    from brainiak_tpu.resilience import faults
+
+    X, R, _, _ = make_multi_subject(n_subj=3)
+    store = write_store(str(tmp_path / "st"), X, dtype=np.float64)
+    kw = dict(K=2, n_subj=3, max_global_iter=2, max_local_iter=1,
+              threshold=1e-6, max_voxel=64, max_tr=20,
+              lbfgs_iters=10, shard_subjects=2)
+    np.random.seed(0)
+    full = HTFA(**kw).fit(store, R)
+    ck = str(tmp_path / "ck")
+    np.random.seed(0)
+    with pytest.raises(faults.PreemptionError):
+        with faults.inject("preempt", at_step=1):
+            HTFA(**kw).fit(store, R, checkpoint_dir=ck,
+                           checkpoint_every=1)
+    np.random.seed(1)  # resume restores the template: init draw moot
+    resumed = HTFA(**kw).fit(store, R, checkpoint_dir=ck,
+                             checkpoint_every=1)
+    np.testing.assert_allclose(resumed.global_posterior_,
+                               full.global_posterior_, atol=1e-8)
+
+
+def test_htfa_store_input_validation(tmp_path):
+    from brainiak_tpu.data import write_store
+
+    X, R, _, _ = make_multi_subject(n_subj=3)
+    store = write_store(str(tmp_path / "st"), X)
+    htfa = HTFA(K=2, n_subj=3)
+    with pytest.raises(TypeError, match="equal length"):
+        htfa.fit(store, R[:2])
+    with pytest.raises(TypeError, match="voxels"):
+        htfa.fit(store, [r[:-1] for r in R])

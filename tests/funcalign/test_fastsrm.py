@@ -157,3 +157,105 @@ def test_fastsrm_input_validation():
         model.transform(imgs[:2], subjects_indexes=[0, 1, 2])
     with pytest.raises(ValueError, match="out of range"):
         model.inverse_transform(rng.randn(K, T), subjects_indexes=[9])
+
+
+# -- ISSUE 13: SubjectStore ingestion ---------------------------------
+
+def test_fastsrm_store_matches_array_input(tmp_path):
+    """A SubjectStore routes each subject through SubjectRef handles
+    and the streamed voxel-chunked reduction, reproducing the eager
+    array-input fit exactly."""
+    from brainiak_tpu.data import write_store
+
+    imgs, _, _ = make_fastsrm_data(session_lengths=(30,))
+    flat = [subj[0] for subj in imgs]
+    store = write_store(str(tmp_path / "st"), flat,
+                        dtype=np.float64)
+    rng = np.random.RandomState(1)
+    atlas = rng.randint(0, 9, size=flat[0].shape[0])
+
+    eager = FastSRM(atlas=atlas, n_components=3, n_iter=10,
+                    seed=0).fit([[x] for x in flat])
+    streamed = FastSRM(atlas=atlas, n_components=3, n_iter=10,
+                       seed=0).fit(store)
+    for a, b in zip(eager.basis_list, streamed.basis_list):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-10)
+    out = streamed.transform(store)
+    assert np.asarray(out).shape == (3, 30)
+
+
+def test_reduce_one_streams_in_chunks():
+    """The streamed reductions (deterministic label means and
+    probabilistic pseudo-inverse projection) match the eager
+    formulations at any chunking."""
+    from brainiak_tpu.funcalign.fastsrm import _reduce_one
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(100, 20)
+    atlas = rng.randint(0, 6, size=100)
+    values = np.unique(atlas)
+    values = values[values != 0]
+    eager = np.stack([data.T[:, atlas == c].mean(axis=1)
+                      for c in values], axis=1)
+    for chunk in (7, 33, 1000):
+        np.testing.assert_allclose(
+            _reduce_one(data, atlas, None, chunk_voxels=chunk),
+            eager, atol=1e-12)
+
+    prob = rng.rand(5, 100)
+    inv = np.linalg.pinv(prob)
+    eager_p = data.T @ inv
+    for chunk in (7, 33, 1000):
+        np.testing.assert_allclose(
+            _reduce_one(data, None, inv, chunk_voxels=chunk),
+            eager_p, atol=1e-12)
+
+
+def test_reduce_one_memmap_path(tmp_path):
+    """.npy-path ingestion reduces off the memmap without an eager
+    full load (the finish-the-job satellite: shape probing already
+    used mmap; now the reduction itself does)."""
+    from brainiak_tpu.funcalign.fastsrm import _reduce_one
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(64, 12)
+    path = str(tmp_path / "subj.npy")
+    np.save(path, data)
+    atlas = rng.randint(0, 4, size=64)
+    values = np.unique(atlas)
+    values = values[values != 0]
+    eager = np.stack([data.T[:, atlas == c].mean(axis=1)
+                      for c in values], axis=1)
+    np.testing.assert_allclose(
+        _reduce_one(path, atlas, None, chunk_voxels=16), eager,
+        atol=1e-12)
+
+
+def test_store_fit_never_loads_a_subject_whole(tmp_path,
+                                               monkeypatch):
+    """Regression guard: the fit-path atlas reduction must go
+    through the voxel-chunked readers, never a full SubjectRef.load
+    (that was the whole point of the streamed ingestion)."""
+    from brainiak_tpu.data import write_store
+    from brainiak_tpu.data.store import SubjectRef
+    from brainiak_tpu.funcalign.fastsrm import _reduce_one
+
+    imgs, _, _ = make_fastsrm_data(session_lengths=(30,))
+    flat = [subj[0] for subj in imgs]
+    store = write_store(str(tmp_path / "st"), flat,
+                        dtype=np.float64)
+    rng = np.random.RandomState(1)
+    atlas = rng.randint(0, 9, size=flat[0].shape[0])
+
+    def no_full_loads(self):
+        raise AssertionError(
+            "streamed reduction loaded a subject whole")
+
+    monkeypatch.setattr(SubjectRef, "load", no_full_loads)
+    out = _reduce_one(store.ref(0), atlas, None)
+    values = np.unique(atlas)
+    values = values[values != 0]
+    eager = np.stack([flat[0].T[:, atlas == c].mean(axis=1)
+                      for c in values], axis=1)
+    np.testing.assert_allclose(out, eager, atol=1e-12)

@@ -222,3 +222,36 @@ def test_kernels_tier_records_match_obs_schema(monkeypatch):
         assert rec["vs_baseline"] > 0
     assert recs[0]["config"]["n_trs"] == 64
     assert recs[1]["config"]["n_voxels"] == 256
+
+
+# -- ISSUE 13: streaming tier -----------------------------------------
+
+def test_streaming_tier_records_match_obs_schema(monkeypatch):
+    """The streaming tier (ISSUE 13): a tiny in-process run emits
+    TWO schema-valid records — streamed subjects/s (vs_baseline =
+    ratio over the in-memory stacked fit) and the prefetch stall
+    ratio stamped direction="lower_is_better" — so `obs regress
+    --only streaming` gates the out-of-core data plane from day
+    one."""
+    monkeypatch.setenv("BENCH_STREAMING_SUBJECTS", "8")
+    out = bench.measure_tier("streaming")
+    assert out["subjects_per_sec"] > 0
+    assert out["n_subjects"] == 8
+    assert out["stack_bytes"] > 0
+    assert 0.0 <= out["stall_ratio"]
+    stages = out["stages"]
+    assert set(bench.STAGE_KEYS) <= set(stages)
+    assert stages["steady_s"] > 0
+
+    recs = bench._streaming_result_records(out)
+    assert [r["metric"] for r in recs] == [
+        "streaming_srm_subjects_per_sec",
+        "streaming_prefetch_stall_ratio"]
+    for rec in recs:
+        assert obs.validate_bench_record(rec) == []
+        # in-process run on the CPU test backend -> fallback tier
+        assert rec["tier"] == "streaming_cpu_fallback"
+        assert rec["config"]["n_subjects"] == 8
+        assert rec["config"]["stack_bytes"] == out["stack_bytes"]
+    assert recs[0]["vs_baseline"] > 0
+    assert recs[1]["direction"] == "lower_is_better"

@@ -45,10 +45,12 @@ def test_fixture_history_passes_and_gates():
     # (ISSUE 9, refreshed by ISSUE 12: 3 rounds x 4 metrics —
     # requests/s, p99, padding, obs overhead) + the kernels_r01-r03
     # tier (ISSUE 11: 3 rounds x 2 metrics — fused forward-backward
-    # TRs/s, fused ring GB/s), all measured host-side ->
-    # *_cpu_fallback: six tiers gating independently from one
+    # TRs/s, fused ring GB/s) + the streaming_r01-r03 tier
+    # (ISSUE 13: 3 rounds x 2 metrics — streamed subjects/s,
+    # prefetch stall ratio), all measured host-side ->
+    # *_cpu_fallback: seven tiers gating independently from one
     # directory
-    assert len(records) == 32
+    assert len(records) == 38
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
@@ -56,10 +58,12 @@ def test_fixture_history_passes_and_gates():
                      "service_cpu_fallback",
                      "distla_cpu_fallback",
                      "encoding_cpu_fallback",
-                     "kernels_cpu_fallback"}
+                     "kernels_cpu_fallback",
+                     "streaming_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
-    multi = ("service_cpu_fallback", "kernels_cpu_fallback")
+    multi = ("service_cpu_fallback", "kernels_cpu_fallback",
+             "streaming_cpu_fallback")
     by_tier = {c["tier"]: c for c in result["checks"]
                if c["tier"] not in multi}
     by_metric = {c["metric"]: c for c in result["checks"]
@@ -75,8 +79,13 @@ def test_fixture_history_passes_and_gates():
                               "service_padding_waste_ratio",
                               "service_obs_overhead_ratio",
                               "kernels_eventseg_fb_trs_per_sec",
-                              "kernels_summa_ring_gb_per_sec"}
+                              "kernels_summa_ring_gb_per_sec",
+                              "streaming_srm_subjects_per_sec",
+                              "streaming_prefetch_stall_ratio"}
     assert by_metric["service_obs_overhead_ratio"][
+        "direction"] == "lower_is_better"
+    # the ISSUE 13 streaming tier gates overlap the right way round
+    assert by_metric["streaming_prefetch_stall_ratio"][
         "direction"] == "lower_is_better"
     assert by_metric["service_p99_latency_seconds"][
         "direction"] == "lower_is_better"

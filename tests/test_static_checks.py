@@ -45,7 +45,8 @@ def test_run_checks_json_output():
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
         "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
-        "serve", "service", "distla", "encoding", "kernels"}
+        "serve", "service", "distla", "encoding", "kernels",
+        "data"}
     assert payload["files"] > 100
     seconds = payload["gate_seconds"]
     assert set(seconds) == set(payload["gates"])
@@ -505,6 +506,64 @@ def test_kernels_gate_classifies_failures(monkeypatch):
     findings = []
     rc.check_kernels(findings)
     assert [f.code for f in findings] == ["KRN001"]
+    assert "rc=3" in findings[0].message
+
+
+# -- ISSUE 13: the data gate (DAT001) ---------------------------------
+
+def test_data_gate_passes_on_live_package():
+    """The data gate (DAT001) smoke-runs the streaming-data-plane
+    selfcheck on the 8-device CPU mesh — streamed-vs-in-memory SRM
+    parity over a real on-disk store, resume-at-shard-round after an
+    injected preemption, retrace stability across repeat shard
+    rounds — and passes on the live tree (ISSUE 13 satellite)."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_data(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_data_gate_classifies_failures(monkeypatch):
+    """A failing data selfcheck is reported as DAT001, with retrace
+    instability, a broken resume, and streamed-parity failure each
+    named distinctly."""
+    rc = _load_run_checks()
+
+    def fake_child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    monkeypatch.setattr(rc, "_DATA_CHILD", fake_child(
+        {"ok": False, "max_err": 0.2, "tol": 5e-4,
+         "resume_ok": True, "retraces": {"srm.stream_init": 1.0}}))
+    findings = []
+    rc.check_data(findings)
+    assert [f.code for f in findings] == ["DAT001"]
+    assert "parity" in findings[0].message
+
+    monkeypatch.setattr(rc, "_DATA_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 5e-4,
+         "resume_ok": False, "retraces": {}}))
+    findings = []
+    rc.check_data(findings)
+    assert [f.code for f in findings] == ["DAT001"]
+    assert "resume" in findings[0].message
+
+    monkeypatch.setattr(rc, "_DATA_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 5e-4,
+         "resume_ok": True,
+         "retraces": {"srm.stream_prob_shard": 3.0}}))
+    findings = []
+    rc.check_data(findings)
+    assert [f.code for f in findings] == ["DAT001"]
+    assert "rebuilt" in findings[0].message
+    assert "srm.stream_prob_shard=3" in findings[0].message
+
+    monkeypatch.setattr(rc, "_DATA_CHILD", "raise SystemExit(3)")
+    findings = []
+    rc.check_data(findings)
+    assert [f.code for f in findings] == ["DAT001"]
     assert "rc=3" in findings[0].message
 
 

@@ -106,7 +106,7 @@ from brainiak_tpu.analysis.core import (  # noqa: E402,F401
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
          "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
-         "serve", "service", "distla", "encoding", "kernels")
+         "serve", "service", "distla", "encoding", "kernels", "data")
 
 
 def python_sources():
@@ -307,6 +307,7 @@ def check_doc_defaults(findings):
 # checkpoint_dir= to another estimator's fit (FastSRM ->
 # reduced-space DetSRM).
 RESILIENT_FITS = {
+    "brainiak_tpu/data/streaming_fit.py": ("IncrementalSRM",),
     "brainiak_tpu/encoding/ridge.py": ("RidgeEncoder",
                                        "BandedRidgeEncoder"),
     "brainiak_tpu/funcalign/srm.py": ("SRM", "DetSRM"),
@@ -906,6 +907,43 @@ def check_kernels(findings):
         "kernels", classify)
 
 
+# -- data gate --------------------------------------------------------
+
+_DATA_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.data.selfcheck import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_data(findings):
+    """Streaming-data-plane gate (DAT001): smoke-run the out-of-core
+    selfcheck (``brainiak_tpu.data.selfcheck``) on the 8-device CPU
+    mesh: streamed-vs-in-memory SRM/DetSRM parity over a real
+    on-disk SubjectStore (mesh-sharded shards, a short masked final
+    shard), resume-at-shard-round after an injected preemption, and
+    the retrace-stability contract — repeat shard rounds (and a
+    repeat fit) must keep every ``data.*``/``srm.*`` streamed
+    program at <= 1 trace."""
+
+    def classify(verdict):
+        if not verdict.get("resume_ok", True):
+            return ("streamed fit did not resume at the last "
+                    "completed shard round after the injected "
+                    "preemption (or the preempt fault never fired)")
+        return (f"streamed-vs-in-memory SRM parity failure: "
+                f"max_err={verdict.get('max_err')} over tol="
+                f"{verdict.get('tol')}")
+
+    _run_selfcheck_gate(
+        findings, _DATA_CHILD, "DAT001",
+        _rel(os.path.join(REPO, "brainiak_tpu", "data",
+                          "selfcheck.py")),
+        "data", classify)
+
+
 # -- external gate ----------------------------------------------------
 
 def run_external(findings):
@@ -1077,6 +1115,8 @@ def run_gates(only=None):
         timed("encoding", check_encoding, findings)
     if "kernels" in selected:
         timed("kernels", check_kernels, findings)
+    if "data" in selected:
+        timed("data", check_data, findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
@@ -1090,7 +1130,7 @@ def run_gates(only=None):
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
                        "jaxlint-deep", "obs", "obs-live", "regress",
                        "serve", "service", "distla", "encoding",
-                       "kernels")
+                       "kernels", "data")
            if g in selected])
     return {
         "ok": not findings,
