@@ -86,6 +86,13 @@ SERVE_REQUESTS = 256  # serve-tier workload (BENCH_SERVE_REQUESTS overrides)
 # ridge_encoding-scoring requests against two resident models.
 # BENCH_SERVICE_REQUESTS overrides.
 SERVICE_REQUESTS = 128
+# federation tier (pod-scale serving federation,
+# brainiak_tpu.serve.federation): heavy-tailed fmrisim traffic
+# routed across two warm replicas, then replayed at 2x measured
+# capacity against bounded admission control — gated on routed
+# requests/s, accepted-request p99 under overload (lower is
+# better), and the shed ratio.  BENCH_FEDERATION_REQUESTS overrides.
+FEDERATION_REQUESTS = 128
 
 # distla tier (pod-scale SUMMA Gram, brainiak_tpu.ops.distla): the
 # on-chip workload is a [T, V] -> [V, V] sharded correlation at a
@@ -155,6 +162,15 @@ def _service_n_requests():
     import os
     return int(os.environ.get("BENCH_SERVICE_REQUESTS",
                               SERVICE_REQUESTS))
+
+
+def _federation_n_requests():
+    """The federation tier's request count
+    (``BENCH_FEDERATION_REQUESTS`` overrides) — one reader, same
+    no-drift rule as the other tiers."""
+    import os
+    return int(os.environ.get("BENCH_FEDERATION_REQUESTS",
+                              FEDERATION_REQUESTS))
 
 
 def _even_epochs_env(name, default):
@@ -957,6 +973,169 @@ def _service_result_records(out, n_requests):
     ]
 
 
+def federation_tier_metrics(n_requests=FEDERATION_REQUESTS, seed=0):
+    """The ``federation`` tier: pod-scale serving federation
+    (:mod:`brainiak_tpu.serve.federation`) — heavy-tailed
+    fmrisim-driven SRM traffic routed across TWO warm in-process
+    replicas behind the residency/depth router, with
+    ``vs_baseline`` the same workload through ONE replica (the
+    federation win).  A second phase replays fresh traffic at 2x
+    the measured routed capacity against depth-bounded admission
+    control: the gated numbers are the ACCEPTED requests' p99 (the
+    bounded-queue promise — without shedding it would be the
+    backlog) and the shed ratio."""
+    import jax
+
+    from brainiak_tpu.serve import BucketPolicy, ModelResidency
+    from brainiak_tpu.serve.__main__ import build_demo_model
+    from brainiak_tpu.serve.federation import (AdmissionController,
+                                               LocalReplica,
+                                               Router,
+                                               TrafficGenerator,
+                                               replay)
+    from brainiak_tpu.serve.service import ServeService
+
+    with obs.span("bench.data_gen"):
+        model = build_demo_model(n_subjects=4, voxels=256,
+                                 samples=64, features=16, n_iter=3,
+                                 seed=seed)
+        gen = TrafficGenerator(model, model_name="m", seed=seed)
+        requests = gen.requests(n_requests)
+        policy = BucketPolicy(max_batch=32, max_wait_s=0.02)
+
+    def replicas(n, tag):
+        out = []
+        for i in range(n):
+            res = ModelResidency(budget_bytes=1 << 30,
+                                 policy=policy)
+            res.register("m", model=model)
+            out.append(LocalReplica(ServeService(
+                res, default_model="m",
+                name=f"{tag}{i + 1}").start()))
+        return out
+
+    def drive(reps, reqs, admission=None, schedule=None):
+        router = Router(reps, admission=admission)
+        try:
+            t0 = time.perf_counter()
+            if schedule is not None:
+                tickets = replay(schedule, router.submit_many)
+            else:
+                for req in reqs:  # fresh stamps/traces per drive
+                    req.submitted = None
+                    req.trace_id = None
+                    req.parent_id = None
+                tickets = router.submit_many(reqs)
+            records = [t.result(timeout=600.0) for t in tickets]
+            wall = time.perf_counter() - t0
+        finally:
+            for rep in reps:
+                rep.service.shutdown()
+        return router, records, wall
+
+    with obs.span("bench.warm"):  # compiles (program caches are
+        drive(replicas(1, "w"), requests)  # process-global)
+    with obs.span("bench.steady"):
+        _, records, single_wall = drive(replicas(1, "s"), requests)
+        if not all(r.ok for r in records):
+            raise RuntimeError(
+                "federation bench single-replica drive produced "
+                "error records; refusing to emit numbers")
+        router, records, wall = drive(replicas(2, "f"), requests)
+        if not all(r.ok for r in records):
+            raise RuntimeError(
+                "federation bench routed drive produced error "
+                "records; refusing to emit numbers")
+        routed_rps = n_requests / wall
+        single_rps = n_requests / single_wall
+        # overload: a fresh heavy-tailed mix arriving as one
+        # atomic burst of 2x the fleet's admission capacity
+        # (2 replicas x depth bound) — the router's in-flight-
+        # corrected placement admits exactly the bound per replica
+        # and sheds the deterministic rest, so the gated shed
+        # ratio is burst structure, not scheduler jitter; the
+        # accepted requests' p99 is then capped by bound/rate (the
+        # bounded-queue promise) instead of the backlog.  The
+        # wall-paced heavy-tailed replay (federation.replay) is
+        # soak coverage, exercised by the SRV003 selfcheck and the
+        # federation tests.
+        bound = max(8, n_requests // 4)
+        over_router, over_records, _ = drive(
+            replicas(2, "o"),
+            gen.requests(2 * bound * 2, prefix="o"),
+            admission=AdmissionController(max_depth=bound))
+    ok_latencies = sorted(r.latency_s for r in over_records
+                          if r.ok and r.latency_s is not None)
+    n_shed = sum(1 for r in over_records
+                 if r.error == "shed_overload")
+    n_failed = len(over_records) - n_shed \
+        - sum(1 for r in over_records if r.ok)
+    if n_failed or not ok_latencies:
+        raise RuntimeError(
+            f"federation overload drive produced {n_failed} "
+            "non-shed error record(s) "
+            f"({len(ok_latencies)} served); refusing to emit "
+            "numbers")
+    idx = min(len(ok_latencies) - 1,
+              int(round(0.99 * (len(ok_latencies) - 1))))
+    return {"routed_requests_per_sec": routed_rps,
+            "single_replica_rps": single_rps,
+            "overload_p99_s": ok_latencies[idx],
+            "shed_ratio": n_shed / len(over_records),
+            "shed_bound": bound,
+            "overload_burst": len(over_records),
+            "routed": router.summary()["routed"],
+            "n_requests": n_requests,
+            "n_replicas": 2,
+            "backend": jax.default_backend()}
+
+
+def _federation_result_records(out):
+    """The federation tier's bench JSON lines — three records:
+    routed requests/s across 2 replicas (``vs_baseline`` = the
+    federation win over one replica on the same workload),
+    accepted-request p99 under 2x-capacity overload and the shed
+    ratio (both ``direction="lower_is_better"`` so a melted queue
+    or an over-eager shedder fails CI the right way round).  Tier
+    split mirrors every other tier (``federation`` on TPU,
+    ``federation_cpu_fallback`` otherwise)."""
+    tier = "federation" if out.get("backend") == "tpu" \
+        else "federation_cpu_fallback"
+    config = {"n_requests": out["n_requests"],
+              "n_replicas": out["n_replicas"],
+              "backend": out.get("backend"),
+              "shed_bound": out["shed_bound"],
+              "overload_burst": out["overload_burst"]}
+    commit = _git_commit()
+
+    def rec(metric, value, unit, vs=0.0, direction=None,
+            stages=None):
+        r = {"schema_version": BENCH_SCHEMA_VERSION,
+             "metric": metric, "value": round(float(value), 6),
+             "unit": unit, "vs_baseline": vs, "tier": tier,
+             "config": config}
+        if direction:
+            r["direction"] = direction
+        if commit:
+            r["git_commit"] = commit
+        if stages:
+            r["stages"] = stages
+        return r
+
+    rps = float(out["routed_requests_per_sec"])
+    single = float(out.get("single_replica_rps") or 0.0)
+    vs = round(rps / single, 3) if single > 0 else 0.0
+    return [
+        rec("federation_routed_requests_per_sec", rps,
+            "requests/sec", vs=vs, stages=out.get("stages")),
+        rec("federation_overload_p99_seconds",
+            out["overload_p99_s"], "s",
+            direction="lower_is_better"),
+        rec("federation_shed_ratio", out["shed_ratio"], "ratio",
+            direction="lower_is_better"),
+    ]
+
+
 def _ts_key(ts):
     """Chronological sort key for possibly-absent ISO timestamps with
     heterogeneous UTC offsets (lexicographic comparison is wrong across
@@ -1167,6 +1346,18 @@ def measure_tier(tier):
                           out["requests_per_sec"], tier=svc_tier)
             out["stages"] = _stage_seconds(mem.records)
             return out
+        if tier == "federation":
+            out = federation_tier_metrics(
+                n_requests=_federation_n_requests())
+            # tier split by backend, same rule as every other tier
+            fed_tier = "federation" if out["backend"] == "tpu" \
+                else "federation_cpu_fallback"
+            obs.gauge("bench_federation_requests_per_sec",
+                      unit="requests/sec").set(
+                          out["routed_requests_per_sec"],
+                          tier=fed_tier)
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "wb":
             vps = whole_brain_voxels_per_sec(
                 n_voxels=int(os.environ.get("BENCH_WB_VOXELS",
@@ -1246,6 +1437,7 @@ def main():
     responsive = _fcma_main()
     _serve_main(responsive)
     _service_main(responsive)
+    _federation_main(responsive)
     _distla_main(responsive)
     _encoding_main(responsive)
     _kernels_main(responsive)
@@ -1285,6 +1477,19 @@ def _kernels_main(responsive):
     (eventseg forward-backward TRs/s, SUMMA ring step GB/s), each
     with the measured fusion win as ``vs_baseline``."""
     _aux_tier_main(responsive, "kernels", _kernels_result_records)
+
+
+def _federation_main(responsive):
+    """Federation tier: routed requests/s across 2 replicas, p99
+    under 2x-capacity overload, shed ratio.  Like the service
+    tier, a failing round (non-shed error records) refuses to emit
+    numbers without aborting the driver."""
+    import sys
+    try:
+        _aux_tier_main(responsive, "federation",
+                       _federation_result_records)
+    except RuntimeError as exc:
+        print(f"tier federation: {exc}", file=sys.stderr)
 
 
 def _distla_main(responsive):

@@ -202,6 +202,96 @@ def drive_service(residency, requests, default_model, waves=4,
     return summary, [t.record for t in tickets], wall
 
 
+def drive_federation(models, requests, n_replicas, policy=None,
+                     aot=None, budget_bytes=None, pinned=(),
+                     shed_queue_depth=None, waves=1, drain=True,
+                     http_port=None, timeout_s=600.0):
+    """Drive a request file through ``n_replicas`` in-process
+    replicas behind a :class:`~brainiak_tpu.serve.federation.
+    Router` — the ``service --replicas N`` path, shared with the
+    SRV003 gate and bench.py's federation tier.
+
+    Every replica registers the same models over its OWN residency
+    but ONE shared AOT cache, so replica 2..N admit warm (zero
+    serve retraces — the content-addressed keys make programs
+    shareable).  ``http_port`` starts the exposition on the first
+    replica only: the metric registry is process-global, so one
+    listener serves every replica's labeled series.  Returns
+    ``(summary, records, wall seconds)``; the summary merges
+    per-replica counts, pools latency percentiles through the
+    mergeable sketch, and carries the router's routed/shed ledger
+    under ``"federation"``."""
+    from ..obs.sketch import QuantileSketch
+    from .federation import AdmissionController, LocalReplica, Router
+    from .residency import ModelResidency
+    from .service import ServeService, serve_retrace_total
+
+    admission = None
+    if shed_queue_depth is not None:
+        admission = AdmissionController(max_depth=shed_queue_depth)
+    replicas = []
+    for i in range(int(n_replicas)):
+        residency = ModelResidency(budget_bytes=budget_bytes,
+                                   policy=policy, aot=aot)
+        for name, source in models:
+            residency.register(
+                name,
+                **({"source": source}
+                   if isinstance(source, (str, os.PathLike))
+                   else {"model": source}),
+                pinned=name in set(pinned))
+        svc = ServeService(
+            residency, default_model=models[0][0],
+            name=f"r{i + 1}",
+            http_port=http_port if i == 0 else None).start()
+        replicas.append(LocalReplica(svc))
+    router = Router(replicas, admission=admission)
+    waves = max(1, min(int(waves), len(requests) or 1))
+    per_wave = -(-len(requests) // waves)  # ceil
+    t0 = time.perf_counter()
+    try:
+        tickets = []
+        for w in range(waves):
+            tickets.extend(router.submit_many(
+                requests[w * per_wave:(w + 1) * per_wave]))
+        records = [t.result(timeout=timeout_s) for t in tickets]
+    finally:
+        summaries = [r.service.shutdown(drain=drain)
+                     for r in replicas]
+    wall = time.perf_counter() - t0
+    pooled = QuantileSketch()
+    for replica in replicas:
+        pooled.merge(replica.service.latency_sketch())
+    errors_by_code = {}
+    for s in summaries:
+        for code, count in s["errors_by_code"].items():
+            errors_by_code[code] = \
+                errors_by_code.get(code, 0) + count
+    route = router.summary()
+    summary = {
+        "n_submitted": sum(s["n_submitted"] for s in summaries),
+        "n_delivered": sum(s["n_delivered"] for s in summaries),
+        "n_ok": sum(s["n_ok"] for s in summaries),
+        "n_shed": route["n_shed"]
+        + sum(s["n_shed"] for s in summaries),
+        "n_errors": sum(errors_by_code.values()),
+        "errors_by_code": errors_by_code,
+        "p50_latency_s": pooled.quantile(0.50),
+        "p99_latency_s": pooled.quantile(0.99),
+        "retrace_total": serve_retrace_total(),
+        "federation": dict(
+            route,
+            replicas={s.get("replica", f"r{i + 1}"): s
+                      for i, s in enumerate(summaries)}),
+    }
+    port = summaries[0].get("http_port")
+    if port is not None:
+        summary["http_port"] = port
+    if aot is not None:
+        summary["aot"] = aot.stats()
+    return summary, records, wall
+
+
 def _service(args):
     from .aot import AOTProgramCache
     from .residency import ModelResidency
@@ -214,22 +304,48 @@ def _service(args):
             f"--pin names no registered model: "
             f"{', '.join(sorted(unknown))}")
     aot = AOTProgramCache(args.aot_cache) if args.aot_cache else None
-    residency = ModelResidency(budget_bytes=args.budget_bytes,
-                               policy=_policy(args), aot=aot)
-    for name, path in models:
-        residency.register(name, source=path,
-                           pinned=name in pinned)
     requests = load_requests(args.requests)
-    summary, _, wall = drive_service(
-        residency, requests, default_model=models[0][0],
-        waves=args.waves, duration_s=args.duration,
-        drain=args.drain, http_port=args.http_port)
+    if args.replicas > 1:
+        summary, _, wall = drive_federation(
+            models, requests, args.replicas,
+            policy=_policy(args), aot=aot,
+            budget_bytes=args.budget_bytes, pinned=pinned,
+            shed_queue_depth=args.shed_queue_depth,
+            waves=args.waves, drain=args.drain,
+            http_port=args.http_port)
+    else:
+        residency = ModelResidency(budget_bytes=args.budget_bytes,
+                                   policy=_policy(args), aot=aot)
+        if args.shed_queue_depth is not None:
+            raise ValueError(
+                "--shed-queue-depth requires --replicas >= 2 (the "
+                "router owns fleet-level admission; single-replica "
+                "shedding is the ServeService admission= API)")
+        for name, path in models:
+            residency.register(name, source=path,
+                               pinned=name in pinned)
+        summary, _, wall = drive_service(
+            residency, requests, default_model=models[0][0],
+            waves=args.waves, duration_s=args.duration,
+            drain=args.drain, http_port=args.http_port)
     summary["wall_s"] = round(wall, 6)
     summary["requests_per_sec"] = (
         round(len(requests) / wall, 3) if wall > 0 else None)
     summary["drain"] = bool(args.drain)
     if args.format == "json":
         print(json.dumps(summary, indent=2))
+    elif args.replicas > 1:
+        fed = summary["federation"]
+        print(f"serve federation: {summary['n_ok']}/"
+              f"{summary['n_submitted']} ok over "
+              f"{fed['n_replicas']} replica(s), "
+              f"{summary['n_shed']} shed, "
+              f"{summary['n_errors']} error(s), retraces="
+              f"{summary['retrace_total']:.0f}, routed="
+              f"{fed['routed']}")
+        for code, count in sorted(
+                summary["errors_by_code"].items()):
+            print(f"  {count:>4}  {code}")
     else:
         aot_stats = summary.get("aot") or {}
         print(f"serve service: {summary['n_ok']}/"
@@ -543,6 +659,18 @@ def main(argv=None):
         "--waves", type=int, default=4,
         help="stagger submissions into this many waves "
              "(default %(default)s)")
+    service_p.add_argument(
+        "--replicas", type=int, default=1, metavar="N",
+        help="run N warm replicas behind the federation router "
+             "(each its own ServeService + residency, one shared "
+             "AOT cache; requests place by residency + live queue "
+             "depth; --duration applies to single-replica mode "
+             "only)")
+    service_p.add_argument(
+        "--shed-queue-depth", type=int, metavar="DEPTH",
+        help="fleet-level admission control (needs --replicas>=2): "
+             "shed with retry_after once EVERY replica is at this "
+             "queue depth (default: unbounded ingress)")
     service_p.add_argument(
         "--http-port", type=int, metavar="PORT",
         help="serve live /metrics (Prometheus text), /healthz and "

@@ -49,11 +49,13 @@ __all__ = [
     "ADAPTERS",
     "KIND_KEY",
     "SCHEMA_VERSION",
+    "SHARDED_KINDS",
     "VERSION_KEY",
     "detect_kind",
     "load_model",
     "model_digest",
     "model_nbytes",
+    "model_shard_nbytes",
     "save_model",
     "save_model_bytes",
 ]
@@ -607,3 +609,62 @@ def model_nbytes(model):
     kind = detect_kind(model)
     arrays = ADAPTERS[kind].pack(model)
     return int(sum(np.asarray(a).nbytes for a in arrays.values()))
+
+
+# -- sharded layouts (serving federation) -----------------------------
+#
+# Which packed keys partition over the mesh when a model is served
+# SHARDED (brainiak_tpu/serve/federation): the voxel-dimensioned
+# weights split (the engine's sharded programs consume one voxel
+# shard per device), everything else — shared-space statistics,
+# per-feature preprocessing, scalars — replicates.  Adding a kind
+# here requires a matching sharded program in serve/engine.py.
+
+def _list_keys(prefix):
+    """Predicate for indexed ragged-list keys (``w_.0``, ``w_.1``,
+    ...; the ``.n`` count entry is bookkeeping, not payload)."""
+    return lambda key: key.startswith(prefix + ".") \
+        and not key.endswith(".n")
+
+
+_SHARDED_KEYS = {
+    # per-subject voxel maps shard over their voxel rows
+    "srm": _list_keys("w_"),
+    "detsrm": _list_keys("w_"),
+    # voxel-wise encoding surface shards over its voxel columns
+    "ridge_encoding": lambda key: key in ("W_", "y_mean_",
+                                          "lambda_"),
+}
+
+#: Artifact kinds the engine can serve sharded over a device mesh.
+SHARDED_KINDS = frozenset(_SHARDED_KEYS)
+
+
+def model_shard_nbytes(model, n_shards):
+    """The per-device byte layout of a model served sharded over
+    ``n_shards`` devices: ``(per_shard_bytes, replicated_bytes)``.
+
+    ``per_shard_bytes`` is the ceil-divided slice of the shardable
+    arrays (:data:`SHARDED_KINDS` — the voxel-dimensioned weights);
+    ``replicated_bytes`` is everything else, which every device
+    holds whole.  Each device is charged
+    ``per_shard_bytes + replicated_bytes`` by the per-device
+    residency accounting, so a model over one device's budget
+    admits exactly when its largest shard fits."""
+    kind = detect_kind(model)
+    shardable = _SHARDED_KEYS.get(kind)
+    if shardable is None:
+        raise ValueError(
+            f"kind {kind!r} has no sharded serve layout "
+            f"(shardable: {', '.join(sorted(SHARDED_KINDS))})")
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    arrays = ADAPTERS[kind].pack(model)
+    sharded = replicated = 0
+    for key, arr in arrays.items():
+        nbytes = int(np.asarray(arr).nbytes)
+        if shardable(key):
+            sharded += nbytes
+        else:
+            replicated += nbytes
+    return -(-sharded // int(n_shards)), replicated

@@ -145,9 +145,12 @@ class ServeResult:
     error, never silence.  ``error`` is a stable machine code
     (``invalid_payload``, ``invalid_shape``, ``invalid_subject``,
     ``non_finite_input``, ``deadline_exceeded``,
-    ``execution_failed``); ``message`` is the human detail.
-    ``seq`` is the engine's submission index — the ordering key, so
-    duplicate ``request_id`` values cannot misorder results."""
+    ``execution_failed``, ``shed_overload``); ``message`` is the
+    human detail.  ``seq`` is the engine's submission index — the
+    ordering key, so duplicate ``request_id`` values cannot misorder
+    results.  ``retry_after_s`` is set only on admission-control
+    shed records (``error == "shed_overload"``): the client-facing
+    backoff hint, stamped BEFORE the request ever touched a queue."""
 
     request_id: str
     ok: bool
@@ -157,6 +160,7 @@ class ServeResult:
     bucket: Optional[tuple] = None
     latency_s: Optional[float] = None
     seq: Optional[int] = None
+    retry_after_s: Optional[float] = None
 
 
 # -- request-file codec (offline CLI driver) --------------------------

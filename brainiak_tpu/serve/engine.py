@@ -37,6 +37,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
@@ -80,6 +81,23 @@ def _donate(*argnums):
     return () if jax.default_backend() == "cpu" else argnums
 
 
+def _mesh_axes(mesh):
+    """The sharding axes of a serve mesh: ALL mesh axes, flattened
+    (the :mod:`~brainiak_tpu.ops.distla` ring idiom — a 2-D
+    ``('subject', 'voxel')`` mesh shards serve weights over the
+    whole device grid).  Returns ``(axis-name tuple, n_shards)``;
+    the tuple is hashable, so it rides in program-cache keys."""
+    names = tuple(mesh.axis_names)
+    n = int(np.prod([mesh.shape[a] for a in names]))
+    return names, n
+
+
+def _axis_arg(axis_names):
+    """The PartitionSpec/psum axis argument for a flattened-ring
+    axis tuple (a 1-tuple collapses to its bare name)."""
+    return axis_names if len(axis_names) > 1 else axis_names[0]
+
+
 @program_cache("serve.srm")
 def _srm_program(n_subjects, v_pad, k, t_bucket, b_pad, dtype):
     """SRM / DetSRM transform: ``s_i = W_iᵀ x_i`` over a padded
@@ -92,6 +110,37 @@ def _srm_program(n_subjects, v_pad, k, t_bucket, b_pad, dtype):
         return jnp.einsum('bvk,bvt->bkt', w, x, precision=PRECISION)
 
     return obs_profile.profile_program(run, "serve.srm",
+                                       span="serve.batch")
+
+
+@program_cache("serve.srm_sharded")
+def _srm_sharded_program(mesh, axis_names, n_subjects, v_pad, k,
+                         t_bucket, b_pad, dtype):
+    """SRM / DetSRM transform with the voxel axis SHARDED over the
+    mesh (the serving half of the :mod:`~brainiak_tpu.ops.distla`
+    idiom): each device holds one voxel shard of the per-subject
+    maps AND of the padded batch, contracts locally, and one
+    ``psum`` over the flattened ring completes ``W_iᵀ x_i`` — so a
+    model bigger than one device's HBM still serves, bit-exact
+    (zero voxel padding contributes zero on every shard).  The
+    program closes over the mesh; it is excluded from AOT
+    persistence (not portable across device counts)."""
+    from ..parallel.compat import shard_map
+
+    axis = _axis_arg(axis_names)
+    spec = PartitionSpec(None, axis, None)
+
+    def run_local(w_stack, subjects, x):
+        w = jnp.take(w_stack, subjects, axis=0)
+        part = jnp.einsum('bvk,bvt->bkt', w, x,
+                          precision=PRECISION)
+        return jax.lax.psum(part, axis)
+
+    run = jax.jit(shard_map(
+        run_local, mesh,
+        in_specs=(spec, PartitionSpec(), spec),
+        out_specs=PartitionSpec()))
+    return obs_profile.profile_program(run, "serve.srm_sharded",
                                        span="serve.batch")
 
 
@@ -181,6 +230,48 @@ def _encoding_program(n_feat, n_vox, t_bucket, b_pad, dtype):
                                        span="serve.batch")
 
 
+@program_cache("serve.encoding_sharded")
+def _encoding_sharded_program(mesh, axis_names, n_feat, v_pad,
+                              t_bucket, b_pad, dtype):
+    """Encoding-model scoring with the voxel axis SHARDED over the
+    mesh: the affine map's columns, the observed responses, and the
+    per-voxel correlation reduction are all voxel-local, so each
+    device scores its own voxel shard with NO collective at all —
+    the output stays voxel-sharded and the host gathers it once.
+    Same masked-moment math as the replicated program (padding
+    exact for real rows); closes over the mesh, so AOT persistence
+    is skipped."""
+    from ..parallel.compat import shard_map
+
+    axis = _axis_arg(axis_names)
+
+    def run_local(w, b, x, y, t_real):
+        pred = jnp.einsum('btf,fv->btv', x, w,
+                          precision=PRECISION) + b[None, None, :]
+        mask = (jnp.arange(x.shape[1])[None, :]
+                < t_real[:, None]).astype(x.dtype)
+        n = jnp.maximum(t_real, 1).astype(x.dtype)[:, None]
+        pm = jnp.einsum('btv,bt->bv', pred, mask) / n
+        ym = jnp.einsum('btv,bt->bv', y, mask) / n
+        pc = (pred - pm[:, None, :]) * mask[:, :, None]
+        yc = (y - ym[:, None, :]) * mask[:, :, None]
+        cov = jnp.einsum('btv,btv->bv', pc, yc)
+        den = jnp.sqrt(jnp.einsum('btv,btv->bv', pc, pc)
+                       * jnp.einsum('btv,btv->bv', yc, yc))
+        return jnp.where(den > 0,
+                         cov / jnp.where(den > 0, den, 1.0), 0.0)
+
+    run = jax.jit(shard_map(
+        run_local, mesh,
+        in_specs=(PartitionSpec(None, axis), PartitionSpec(axis),
+                  PartitionSpec(),
+                  PartitionSpec(None, None, axis),
+                  PartitionSpec()),
+        out_specs=PartitionSpec(None, axis)))
+    return obs_profile.profile_program(
+        run, "serve.encoding_sharded", span="serve.batch")
+
+
 @program_cache("serve.iem")
 def _iem_program(t_bucket, n_vox, k_chan, density, b_pad, dtype):
     """IEM1D predict: channel responses via the precomputed
@@ -219,9 +310,17 @@ class _ServeOp:
     #: ops whose bucket space is request-controlled (eventseg)
     program_memo_max = None
 
-    def __init__(self, model, policy):
+    def __init__(self, model, policy, mesh=None, device=None):
         self.model = model
         self.policy = policy
+        #: device mesh for SHARDED weights (kinds that implement a
+        #: sharded program), else None — set by the engine from the
+        #: per-device residency's placement decision
+        self.mesh = mesh
+        #: explicit placement device for UNSHARDED weights (the
+        #: per-device residency's least-loaded pick), else None =
+        #: the backend default
+        self.device = device
         # engine-level program memo + AOT wiring (filled in by the
         # engine when an AOT cache is attached): one resolved
         # callable per bucket key, so the AOT lookup happens at most
@@ -229,6 +328,14 @@ class _ServeOp:
         self._programs = {}
         self.aot = None
         self.digest = None
+
+    def _place(self, arr):
+        """Host weights onto this op's assigned device (committed,
+        so dispatches execute there); backend default when the
+        residency did not pick one."""
+        if self.device is not None:
+            return jax.device_put(jnp.asarray(arr), self.device)
+        return jnp.asarray(arr)
 
     def run_program(self, builder, key_args, call_args):
         """Resolve + run the jitted program for one bucket.
@@ -299,17 +406,34 @@ class _SRMFamilyOp(_ServeOp):
 
     site = "serve.srm"
 
-    def __init__(self, model, policy):
-        super().__init__(model, policy)
+    def __init__(self, model, policy, mesh=None, device=None):
+        super().__init__(model, policy, mesh=mesh, device=device)
         self.voxel_counts = [w.shape[0] for w in model.w_]
         self.v_pad = max(self.voxel_counts)
         self.k = model.w_[0].shape[1]
         self.dtype = np.asarray(model.w_[0]).dtype
+        if mesh is not None:
+            # sharded serving: the padded voxel axis must divide
+            # the flattened mesh ring; zero pad rows are exact
+            # (zero W rows x zero x rows contribute zero to psum).
+            # The retrace site follows the program family actually
+            # compiled, so summaries attribute sharded compiles.
+            self.site = self.site + "_sharded"
+            self.axis_names, self.n_shards = _mesh_axes(mesh)
+            self.v_pad = -(-self.v_pad // self.n_shards) \
+                * self.n_shards
         stack = np.zeros(
             (len(model.w_), self.v_pad, self.k), dtype=self.dtype)
         for i, w in enumerate(model.w_):
             stack[i, :w.shape[0]] = w
-        self.w_stack = jnp.asarray(stack)
+        if mesh is not None:
+            from ..parallel.mesh import place_on_mesh
+            self.w_stack = place_on_mesh(
+                stack, NamedSharding(
+                    mesh, PartitionSpec(
+                        None, _axis_arg(self.axis_names), None)))
+        else:
+            self.w_stack = self._place(stack)
 
     def validate(self, req):
         if req.subject is None or not (
@@ -346,15 +470,33 @@ class _SRMFamilyOp(_ServeOp):
             subjects[i] = int(req.subject)
         return x, subjects
 
+    def _shard_batch(self, x):
+        """The padded batch buffer onto the mesh, voxel-sharded to
+        match the resident weight shards."""
+        from ..parallel.mesh import place_on_mesh
+        return place_on_mesh(
+            x, NamedSharding(
+                self.mesh, PartitionSpec(
+                    None, _axis_arg(self.axis_names), None)))
+
     def dispatch(self, reqs, key, b_pad):
         t_b = key[0]
         x, subjects = self._assemble(reqs, t_b, b_pad)
-        out = np.asarray(self.run_program(
-            _srm_program,
-            (len(self.voxel_counts), self.v_pad, self.k, t_b,
-             b_pad, str(self.dtype)),
-            (self.w_stack, jnp.asarray(subjects),
-             jnp.asarray(x))))
+        if self.mesh is not None:
+            out = np.asarray(self.run_program(
+                _srm_sharded_program,
+                (self.mesh, self.axis_names,
+                 len(self.voxel_counts), self.v_pad, self.k, t_b,
+                 b_pad, str(self.dtype)),
+                (self.w_stack, jnp.asarray(subjects),
+                 self._shard_batch(x))))
+        else:
+            out = np.asarray(self.run_program(
+                _srm_program,
+                (len(self.voxel_counts), self.v_pad, self.k, t_b,
+                 b_pad, str(self.dtype)),
+                (self.w_stack, jnp.asarray(subjects),
+                 jnp.asarray(x))))
         return [np.array(out[i, :, :np.asarray(r.x).shape[1]])
                 for i, r in enumerate(reqs)]
 
@@ -394,14 +536,14 @@ class _EventSegmentOp(_ServeOp):
     # per-op program memo is bounded like the builder's lru
     program_memo_max = _EVENTSEG_CACHE_PROGRAMS
 
-    def __init__(self, model, policy):
-        super().__init__(model, policy)
+    def __init__(self, model, policy, mesh=None, device=None):
+        super().__init__(model, policy, mesh=mesh, device=device)
         self.n_vox, self.k = model.event_pat_.shape
         var = model.event_var_
         if not isinstance(var, np.ndarray):
             var = var * np.ones(model.n_events)
-        self.var = jnp.asarray(np.asarray(var, dtype=float))
-        self.mean_pat = jnp.asarray(model.event_pat_)
+        self.var = self._place(np.asarray(var, dtype=float))
+        self.mean_pat = self._place(model.event_pat_)
         self._transitions = {}
 
     def validate(self, req):
@@ -468,12 +610,12 @@ class _IEM1DOp(_ServeOp):
 
     site = "serve.iem"
 
-    def __init__(self, model, policy):
-        super().__init__(model, policy)
+    def __init__(self, model, policy, mesh=None, device=None):
+        super().__init__(model, policy, mesh=mesh, device=device)
         self.n_vox = model.W_.shape[0]
         self.dtype = np.asarray(model.W_).dtype
-        self.pinv_w = jnp.linalg.pinv(jnp.asarray(model.W_))
-        self.channels = jnp.asarray(
+        self.pinv_w = jnp.linalg.pinv(self._place(model.W_))
+        self.channels = self._place(
             np.asarray(model.channels_, dtype=self.dtype))
         self.k_chan = int(model.channels_.shape[0])
         self.density = int(model.channels_.shape[1])
@@ -523,8 +665,8 @@ class _RidgeEncodingOp(_ServeOp):
 
     site = "serve.encoding"
 
-    def __init__(self, model, policy):
-        super().__init__(model, policy)
+    def __init__(self, model, policy, mesh=None, device=None):
+        super().__init__(model, policy, mesh=mesh, device=device)
         self.n_features, self.n_vox = model.W_.shape
         self.dtype = np.asarray(model.W_).dtype
         w_eff = np.asarray(model.W_) \
@@ -532,8 +674,31 @@ class _RidgeEncodingOp(_ServeOp):
         b_eff = np.asarray(model.y_mean_) \
             - (np.asarray(model.x_mean_)
                / np.asarray(model.x_scale_)) @ np.asarray(model.W_)
-        self.w = jnp.asarray(w_eff.astype(self.dtype))
-        self.b = jnp.asarray(b_eff.astype(self.dtype))
+        w_eff = w_eff.astype(self.dtype)
+        b_eff = b_eff.astype(self.dtype)
+        self.v_pad = self.n_vox
+        if mesh is not None:
+            # sharded serving: voxel columns padded to the mesh
+            # ring and partitioned; the scoring math is voxel-local
+            # (pad columns score 0 and are sliced off on host)
+            self.site = self.site + "_sharded"
+            self.axis_names, self.n_shards = _mesh_axes(mesh)
+            self.v_pad = -(-self.n_vox // self.n_shards) \
+                * self.n_shards
+            pad = self.v_pad - self.n_vox
+            if pad:
+                w_eff = np.pad(w_eff, ((0, 0), (0, pad)))
+                b_eff = np.pad(b_eff, ((0, pad),))
+            from ..parallel.mesh import place_on_mesh
+            axis = _axis_arg(self.axis_names)
+            self.w = place_on_mesh(
+                w_eff, NamedSharding(mesh,
+                                     PartitionSpec(None, axis)))
+            self.b = place_on_mesh(
+                b_eff, NamedSharding(mesh, PartitionSpec(axis)))
+        else:
+            self.w = self._place(w_eff)
+            self.b = self._place(b_eff)
 
     def validate(self, req):
         x = req.x
@@ -566,7 +731,7 @@ class _RidgeEncodingOp(_ServeOp):
         t_b = key[0]
         x = np.zeros((b_pad, t_b, self.n_features),
                      dtype=self.dtype)
-        y = np.zeros((b_pad, t_b, self.n_vox), dtype=self.dtype)
+        y = np.zeros((b_pad, t_b, self.v_pad), dtype=self.dtype)
         # pad lanes keep t_real=1 so the masked moments never
         # divide by zero; their (all-zero) scores are discarded
         t_real = np.ones((b_pad,), dtype=np.int32)
@@ -574,14 +739,27 @@ class _RidgeEncodingOp(_ServeOp):
             feats = np.asarray(req.x[0], dtype=self.dtype)
             resp = np.asarray(req.x[1], dtype=self.dtype)
             x[i, :feats.shape[0]] = feats
-            y[i, :resp.shape[0]] = resp
+            y[i, :resp.shape[0], :self.n_vox] = resp
             t_real[i] = feats.shape[0]
-        scores = np.asarray(self.run_program(
-            _encoding_program,
-            (self.n_features, self.n_vox, t_b, b_pad,
-             str(self.dtype)),
-            (self.w, self.b, jnp.asarray(x), jnp.asarray(y),
-             jnp.asarray(t_real))))
+        if self.mesh is not None:
+            from ..parallel.mesh import place_on_mesh
+            axis = _axis_arg(self.axis_names)
+            y_dev = place_on_mesh(
+                y, NamedSharding(self.mesh,
+                                 PartitionSpec(None, None, axis)))
+            scores = np.asarray(self.run_program(
+                _encoding_sharded_program,
+                (self.mesh, self.axis_names, self.n_features,
+                 self.v_pad, t_b, b_pad, str(self.dtype)),
+                (self.w, self.b, jnp.asarray(x), y_dev,
+                 jnp.asarray(t_real))))[:, :self.n_vox]
+        else:
+            scores = np.asarray(self.run_program(
+                _encoding_program,
+                (self.n_features, self.n_vox, t_b, b_pad,
+                 str(self.dtype)),
+                (self.w, self.b, jnp.asarray(x), jnp.asarray(y),
+                 jnp.asarray(t_real))))
         return [np.array(scores[i]) for i in range(len(reqs))]
 
 
@@ -618,8 +796,8 @@ class _FCMAPredictOp(_ServeOp):
     site = "serve.fcma"
     isolate_on_failure = False
 
-    def __init__(self, model, policy):
-        super().__init__(model, policy)
+    def __init__(self, model, policy, mesh=None, device=None):
+        super().__init__(model, policy, mesh=mesh, device=device)
         if model._is_precomputed_svm() and \
                 getattr(model, "training_data_", None) is None:
             raise ValueError(
@@ -727,7 +905,19 @@ class InferenceEngine:
         (``retrace_total{site=serve.*} == 0``), and every program
         this engine does build is exported for the next process.
         The host-delegated ``fcma`` kind has no exportable serve
-        program and ignores the cache.
+        program and ignores the cache, as do SHARDED engines (their
+        programs close over the mesh).
+    mesh : :class:`jax.sharding.Mesh`, optional
+        Serve this model SHARDED over the mesh (kinds in
+        :data:`brainiak_tpu.serve.artifacts.SHARDED_KINDS` only):
+        weights are partitioned over all mesh axes and dispatches
+        run the ``serve.*_sharded`` programs — a model over one
+        device's HBM still serves, answers bit-exact vs the
+        replicated path.
+    device : jax device, optional
+        Place this engine's (unsharded) weights on an explicit
+        device — the per-device residency's placement decision.
+        Mutually exclusive with ``mesh``.
 
     Usage: :meth:`submit` requests (full buckets flush
     immediately), :meth:`poll` on a timer to enforce ``max_wait_s``,
@@ -744,15 +934,28 @@ class InferenceEngine:
     """
 
     def __init__(self, model, kind=None, policy=None, aot=None,
-                 digest=None):
+                 digest=None, mesh=None, device=None):
         self.kind = kind or artifacts.detect_kind(model)
         if self.kind not in _KIND_OPS:
             raise ValueError(
                 f"no serve engine op for kind {self.kind!r} "
                 f"(supported: {', '.join(sorted(_KIND_OPS))})")
+        if mesh is not None and self.kind not in \
+                artifacts.SHARDED_KINDS:
+            raise ValueError(
+                f"kind {self.kind!r} has no sharded serve program "
+                f"(shardable: "
+                f"{', '.join(sorted(artifacts.SHARDED_KINDS))})")
+        if mesh is not None and device is not None:
+            raise ValueError(
+                "mesh= (sharded weights) and device= (single-"
+                "device placement) are mutually exclusive")
+        self.mesh = mesh
         self.policy = policy or BucketPolicy()
-        self.op = _KIND_OPS[self.kind](model, self.policy)
-        if aot is not None and self.kind != "fcma":
+        self.op = _KIND_OPS[self.kind](model, self.policy,
+                                       mesh=mesh, device=device)
+        if aot is not None and self.kind != "fcma" \
+                and mesh is None:
             from . import aot as aot_mod
             if not isinstance(aot, aot_mod.AOTProgramCache):
                 aot = aot_mod.AOTProgramCache(aot)

@@ -1,0 +1,54 @@
+"""brainiak_tpu.serve.federation: pod-scale serving federation.
+
+The next tier above one :class:`~brainiak_tpu.serve.service.
+ServeService` (ROADMAP open item 3, the arXiv:2403.19421
+massive-individual serving setting under DrJAX's observed-state
+placement discipline, arXiv:2403.07128) — three coupled pieces:
+
+- **sharded-model serving** — models over one device's HBM budget
+  partition over the mesh through the engine's ``serve.*_sharded``
+  programs and the residency's per-device accounting (both live in
+  :mod:`~brainiak_tpu.serve.engine` /
+  :mod:`~brainiak_tpu.serve.residency`; this package is where the
+  fleet-level pieces compose);
+- **multi-replica operation** — :class:`Router` +
+  :class:`LocalReplica` place requests over N replicas by model
+  residency and live queue depth (the PR 11 gauges;
+  :func:`scrape_replica_state` reads the same series off a remote
+  ``/metrics``), all replicas warm-starting from one shared
+  content-addressed AOT cache;
+- **load-shedding admission control** — :class:`AdmissionController`
+  bounds ingress with a typed reject-with-``retry_after`` fast path
+  before enqueue, browned out by the PR 11 SLO burn-rate tracker;
+  :class:`TrafficGenerator`/:func:`replay` soak it with
+  fmrisim-driven heavy-tailed request mixes.
+
+CI: the ``federation`` gate (SRV003 in ``tools/run_checks.py``)
+drives replica warm-start at true process granularity and runs
+:mod:`~brainiak_tpu.serve.federation.selfcheck` on the 8-device CPU
+mesh.  See docs/serving.md ("Pod-scale federation").
+"""
+
+from .admission import (  # noqa: F401
+    AdmissionController,
+    Shed,
+)
+from .router import (  # noqa: F401
+    LocalReplica,
+    Router,
+    scrape_replica_state,
+)
+from .traffic import (  # noqa: F401
+    TrafficGenerator,
+    replay,
+)
+
+__all__ = [
+    "AdmissionController",
+    "LocalReplica",
+    "Router",
+    "Shed",
+    "TrafficGenerator",
+    "replay",
+    "scrape_replica_state",
+]

@@ -1,0 +1,178 @@
+"""Load-shedding admission control: bounded ingress with a typed
+reject-with-``retry_after`` fast path.
+
+The PR 8 service accepts unboundedly: under overload the ingress
+queue grows without limit, every queued request eventually times out,
+and p99 melts for EVERYONE.  Admission control is the standard fix
+(reject early, reject cheaply): a request over the depth bound is
+refused BEFORE it touches a queue, with a machine-readable
+``retry_after_s`` hint so well-behaved clients back off — goodput
+stays near capacity and the accepted requests' p99 stays bounded by
+``depth bound / service rate`` instead of the backlog.
+
+:class:`AdmissionController` is the decision point, shared by the
+:class:`~brainiak_tpu.serve.service.ServeService` submit fast path
+(consulted before enqueue; a shed resolves the ticket immediately
+with a ``shed_overload`` :class:`~brainiak_tpu.serve.batching.
+ServeResult` — never an exception mid-batch) and the
+:class:`~brainiak_tpu.serve.federation.router.Router` (shed only
+when EVERY replica is over bound).  Two signals drive it:
+
+- **queue depth** — the ``serve_service_ingress_depth`` +
+  ``serve_service_queue_depth`` gauges the service publishes (the
+  PR 11 in-process registry; at most one tick stale by design);
+- **SLO burn rate** — with an attached
+  :class:`~brainiak_tpu.obs.slo.SLOTracker`, a live burn-rule
+  violation *brown-outs* the depth bound by ``brownout_factor``
+  (default 0.5): when the error budget is burning, the service
+  sheds earlier to recover, the multi-window rules un-fire, and the
+  bound relaxes back — a proportional controller with the SLO
+  machinery as its sensor.  The tracker poll is throttled
+  (``slo_poll_interval_s``) so the submit fast path never pays a
+  full burn evaluation per request.
+
+``retry_after_s`` grows with the overflow (clipped at 8x the base):
+the deeper past the bound the fleet is, the longer clients are told
+to stay away — the cheap stand-in for exponential client backoff.
+"""
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+__all__ = ["AdmissionController", "Shed"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Shed:
+    """One shed decision: the facts a shed record (and its client)
+    needs — how long to stay away, why, and the depth-vs-bound
+    evidence."""
+
+    retry_after_s: float
+    reason: str          # "queue_full" | "slo_burn"
+    depth: int
+    bound: int
+
+
+class AdmissionController:
+    """Depth-bounded, SLO-aware admission control (see module
+    docstring).
+
+    Parameters
+    ----------
+    max_depth : int
+        Ingress + queued depth at (or beyond) which requests shed.
+        Size it as ``target p99 x expected service rate``: the
+        bound IS the queueing-delay budget.
+    retry_after_s : float
+        Base client backoff hint; scaled up with the overflow
+        (clipped at 8x).
+    slo : :class:`~brainiak_tpu.obs.slo.SLOTracker`, optional
+        Burn-rate sensor: while any objective is violating, the
+        depth bound multiplies by ``brownout_factor`` so the
+        service sheds its way back inside the error budget.
+    brownout_factor : float
+        Bound multiplier under SLO violation (0 < f <= 1).
+    slo_poll_interval_s : float
+        Minimum spacing between tracker evaluations (the submit
+        fast path must not pay a burn evaluation per request).
+    clock : callable
+        Monotonic time source (tests inject a fake).
+    """
+
+    def __init__(self, max_depth=256, retry_after_s=0.05, slo=None,
+                 brownout_factor=0.5, slo_poll_interval_s=0.25,
+                 clock=time.monotonic):
+        if max_depth < 0:
+            raise ValueError(
+                f"max_depth must be >= 0, got {max_depth}")
+        if not 0.0 < brownout_factor <= 1.0:
+            raise ValueError(
+                f"brownout_factor must be in (0, 1], got "
+                f"{brownout_factor}")
+        self.max_depth = int(max_depth)
+        self.retry_after_s = float(retry_after_s)
+        self.slo = slo
+        self.brownout_factor = float(brownout_factor)
+        self.slo_poll_interval_s = float(slo_poll_interval_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._n_admitted = 0       # guarded-by: _lock
+        self._n_shed = 0           # guarded-by: _lock
+        self._shed_by_reason = {}  # guarded-by: _lock
+        self._last_poll = None     # guarded-by: _lock
+        self._violating = False    # guarded-by: _lock
+
+    # -- the decision (any thread) ------------------------------------
+
+    def depth_bound(self):
+        """The live depth bound: ``max_depth``, browned out while
+        the SLO tracker reports a burn-rule violation."""
+        if self.slo is None:
+            return self.max_depth
+        if self._poll_slo():
+            return max(1, int(self.max_depth
+                              * self.brownout_factor))
+        return self.max_depth
+
+    def evaluate(self, queued_depth) -> Optional[Shed]:
+        """None to admit a request at ``queued_depth``, else the
+        :class:`Shed` (O(1); the throttled SLO poll is the only
+        non-constant ingredient)."""
+        bound = self.depth_bound()
+        depth = int(queued_depth)
+        if depth < bound:
+            with self._lock:
+                self._n_admitted += 1
+            return None
+        reason = "slo_burn" if bound < self.max_depth \
+            else "queue_full"
+        overflow = depth - bound
+        retry = self.retry_after_s * min(
+            8.0, 1.0 + overflow / max(bound, 1))
+        with self._lock:
+            self._n_shed += 1
+            self._shed_by_reason[reason] = \
+                self._shed_by_reason.get(reason, 0) + 1
+        return Shed(retry_after_s=retry, reason=reason,
+                    depth=depth, bound=bound)
+
+    def _poll_slo(self):
+        """Current SLO-violating state, re-evaluated at most every
+        ``slo_poll_interval_s`` (the cached verdict serves the fast
+        path in between)."""
+        now = self.clock()
+        with self._lock:
+            fresh = (self._last_poll is None
+                     or now - self._last_poll
+                     >= self.slo_poll_interval_s)
+            if fresh:
+                self._last_poll = now
+        if fresh:
+            state = self.slo.evaluate()
+            violating = any(
+                obj.get("violating")
+                for obj in state.get("objectives", {}).values())
+            with self._lock:
+                self._violating = violating
+        with self._lock:
+            return self._violating
+
+    # -- reporting ----------------------------------------------------
+
+    def stats(self):
+        """Admission ledger for the service/router summaries."""
+        with self._lock:
+            return {
+                "max_depth": self.max_depth,
+                "depth_bound": None if self.slo is None
+                else (max(1, int(self.max_depth
+                                 * self.brownout_factor))
+                      if self._violating else self.max_depth),
+                "n_admitted": self._n_admitted,
+                "n_shed": self._n_shed,
+                "shed_by_reason": dict(self._shed_by_reason),
+                "retry_after_s": self.retry_after_s,
+            }

@@ -1,0 +1,284 @@
+"""Multi-replica request routing: place by residency, then by load.
+
+One :class:`~brainiak_tpu.serve.service.ServeService` is one thread
+in one process; the federation tier runs N of them — warm-started
+off one shared :class:`~brainiak_tpu.serve.aot.AOTProgramCache`
+(keys are content-addressed and platform-stamped, so replica 2..N
+serve with zero retraces) — behind this thin router.  Placement
+follows the DrJAX mapreduce discipline (arXiv:2403.07128): decide
+from *observed* state, never by reaching into a replica's internals:
+
+1. **residency first** — replicas where the target model is already
+   resident beat replicas that would have to (re)admit it: an
+   artifact load + upload on the hot path is the exact churn the
+   residency layer exists to avoid;
+2. **least load** — among those, the smallest live queue depth wins,
+   read from the ``serve_service_ingress_depth`` /
+   ``serve_service_queue_depth{replica=}`` gauges each replica
+   publishes (the PR 11 in-process registry for same-process
+   replicas; :func:`scrape_replica_state` reads the same series off
+   a remote replica's ``/metrics`` endpoint);
+3. **in-flight correction** — gauges update once per service tick,
+   so within one routed wave the router adds its own just-assigned
+   counts to each replica's depth estimate (otherwise a whole wave
+   herds onto whichever replica's gauge was read first).
+
+Admission control composes at both levels: a router-level
+:class:`~brainiak_tpu.serve.federation.admission.
+AdmissionController` sheds only when EVERY candidate replica is
+over bound (one hot replica is a placement problem, not an overload
+problem), resolving the ticket itself with the same typed
+``shed_overload`` + ``retry_after_s`` record the service-level path
+produces — every request still resolves exactly one ticket.
+"""
+
+import threading
+import urllib.request
+
+from ...obs import metrics as obs_metrics
+from ..batching import ServeResult
+from ..service import ServiceTicket
+
+__all__ = ["LocalReplica", "Router", "scrape_replica_state"]
+
+
+class LocalReplica:
+    """One same-process replica behind the router: a named
+    :class:`~brainiak_tpu.serve.service.ServeService` plus the
+    read-only placement accessors the router needs."""
+
+    def __init__(self, service, name=None):
+        self.service = service
+        self.name = name or service.name
+        if not self.name:
+            raise ValueError(
+                "replica needs a name (ServeService(name=...)): "
+                "unnamed replicas publish indistinguishable gauges")
+        if service.name and name and service.name != name:
+            raise ValueError(
+                f"replica name {name!r} contradicts the service's "
+                f"replica label {service.name!r}")
+
+    def queue_depth(self):
+        """Routed-but-undispatched depth from the replica's own
+        gauges (at most one service tick stale)."""
+        return self.service.queued_depth()
+
+    def resident_models(self):
+        return set(self.service.residency.resident_names())
+
+    def registered_models(self):
+        return set(self.service.residency.names())
+
+    def submit_many(self, requests):
+        return self.service.submit_many(requests)
+
+
+class Router:
+    """Residency- and depth-aware placement over N replicas (see
+    module docstring).
+
+    Parameters
+    ----------
+    replicas : sequence of :class:`LocalReplica` (or objects with
+        the same accessor surface)
+    admission : :class:`~brainiak_tpu.serve.federation.admission.
+        AdmissionController`, optional
+        Fleet-level load shedding: consulted with the MINIMUM
+        candidate depth, so the router sheds only when no replica
+        has room.
+    """
+
+    def __init__(self, replicas, admission=None):
+        self.replicas = list(replicas)
+        if not self.replicas:
+            raise ValueError("Router needs >= 1 replica")
+        names = [r.name for r in self.replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"duplicate replica names: {sorted(names)}")
+        self.admission = admission
+        self._lock = threading.Lock()
+        self._routed = {name: 0 for name in names}  # guarded-by: _lock
+        self._n_shed = 0                            # guarded-by: _lock
+        self._rr = 0                                # guarded-by: _lock
+
+    # -- placement ----------------------------------------------------
+
+    def _snapshot_models(self):
+        """One read of every replica's registered/resident model
+        sets (each is a residency-lock acquisition): taken once per
+        routed wave, like the depth snapshot — never per request."""
+        return ({r.name: r.registered_models()
+                 for r in self.replicas},
+                {r.name: r.resident_models()
+                 for r in self.replicas})
+
+    def place(self, model=None, depths=None, models=None):
+        """The replica one request for ``model`` should land on
+        (pure decision — no submission): resident-first, then least
+        depth, round-robin tie-break.  ``depths`` overrides the
+        live gauge reads and ``models`` the
+        ``(registered, resident)`` snapshot — the per-wave
+        estimates :meth:`submit_many` maintains."""
+        if depths is None:
+            depths = {r.name: r.queue_depth()
+                      for r in self.replicas}
+        registered_by, resident_by = (
+            models if models is not None
+            else self._snapshot_models())
+        candidates = self.replicas
+        if model is not None:
+            registered = [r for r in self.replicas
+                          if model in registered_by[r.name]]
+            candidates = registered or candidates
+        with self._lock:
+            rr = self._rr
+            self._rr += 1
+        order = {r.name: (i - rr) % len(candidates)
+                 for i, r in enumerate(candidates)}
+        if model is not None:
+            resident = {r.name: model in resident_by[r.name]
+                        for r in candidates}
+        else:
+            resident = {r.name: True for r in candidates}
+        return min(candidates,
+                   key=lambda r: (not resident[r.name],
+                                  depths.get(r.name, 0),
+                                  order[r.name]))
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, request, model=None):
+        """Route one request; returns its ticket (possibly already
+        resolved with a shed record)."""
+        return self.submit_many([request], model=model)[0]
+
+    def submit_many(self, requests, model=None):
+        """Route a wave: each request placed resident-first /
+        least-depth with in-flight correction, then ONE atomic
+        ``submit_many`` per replica (so each replica's bucket
+        composition stays deterministic — the property the shared
+        AOT warm-start rides on).  Returns one ticket per request
+        in input order; shed tickets are already resolved."""
+        requests = list(requests)
+        depths = {r.name: r.queue_depth() for r in self.replicas}
+        models = self._snapshot_models()
+        by_name = {r.name: r for r in self.replicas}
+        assigned = {r.name: [] for r in self.replicas}
+        slots = [None] * len(requests)   # (replica name, index) | rec
+        n_shed = 0
+        for i, request in enumerate(requests):
+            target = model or request.model
+            if self.admission is not None:
+                floor = min(depths.values())
+                shed = self.admission.evaluate(floor)
+                if shed is not None:
+                    slots[i] = self._shed_ticket(request, target,
+                                                 shed)
+                    n_shed += 1
+                    continue
+            replica = self.place(target, depths=depths,
+                                 models=models)
+            # in-flight correction: the gauge will not move until
+            # the replica's next tick, but this wave already did
+            depths[replica.name] = depths.get(replica.name, 0) + 1
+            slots[i] = (replica.name, len(assigned[replica.name]))
+            assigned[replica.name].append(request)
+        tickets_by_name = {
+            name: by_name[name].submit_many(reqs) if reqs else []
+            for name, reqs in assigned.items()}
+        with self._lock:
+            self._n_shed += n_shed
+            for name, reqs in assigned.items():
+                self._routed[name] += len(reqs)
+        out = []
+        for slot in slots:
+            if isinstance(slot, ServiceTicket):
+                out.append(slot)
+            else:
+                name, idx = slot
+                out.append(tickets_by_name[name][idx])
+        return out
+
+    def _shed_ticket(self, request, model, shed):
+        """Fleet-level shed: resolve a router-minted ticket with
+        the typed record (same schema as the service-level path)."""
+        ticket = ServiceTicket(request.request_id, model)
+        ticket._resolve(ServeResult(
+            request_id=request.request_id, ok=False,
+            error="shed_overload",
+            message=(f"router shed the request before placement "
+                     f"({shed.reason}: every replica at depth >= "
+                     f"{shed.bound}); retry after "
+                     f"{shed.retry_after_s:.3f}s"),
+            latency_s=0.0, retry_after_s=shed.retry_after_s))
+        obs_metrics.counter(
+            "serve_shed_total",
+            help="requests shed by admission control before "
+                 "enqueue").inc(reason=shed.reason,
+                                replica="router")
+        return ticket
+
+    # -- reporting ----------------------------------------------------
+
+    def summary(self):
+        """Routed/shed counts per replica for the federation
+        summaries and the SRV003 gate."""
+        with self._lock:
+            out = {"n_replicas": len(self.replicas),
+                   "routed": dict(self._routed),
+                   "n_shed": self._n_shed}
+        if self.admission is not None:
+            out["admission"] = self.admission.stats()
+        return out
+
+
+def scrape_replica_state(url, timeout=5.0):
+    """One remote replica's placement signals off its ``/metrics``
+    endpoint (:mod:`brainiak_tpu.obs.http`): the same
+    ``serve_service_*`` / ``serve_resident_*`` series the in-process
+    router reads from the registry, parsed with the in-repo
+    Prometheus parser.  Returns ``{"queue_depth", "ingress_depth",
+    "resident_bytes", "queue_by_model", "by_replica"}`` —
+    ``by_replica`` splits the depth per replica label when the
+    scraped process runs several.  This is the cross-process half of
+    the placement contract: a front-end partitioning request files
+    across ``serve service`` processes reads state here instead of
+    guessing."""
+    from ...obs.http import parse_prometheus_text
+
+    target = url if "://" in url else f"http://{url}"
+    with urllib.request.urlopen(
+            target.rstrip("/") + "/metrics",
+            timeout=timeout) as resp:
+        text = resp.read().decode("utf-8")
+    families, errors = parse_prometheus_text(text)
+    if errors:
+        raise ValueError(
+            f"{target}/metrics is not valid Prometheus text: "
+            f"{'; '.join(errors[:3])}")
+
+    def samples(name):
+        return families.get(name, {"samples": []})["samples"]
+
+    out = {"queue_depth": 0.0, "ingress_depth": 0.0,
+           "resident_bytes": 0.0, "queue_by_model": {},
+           "by_replica": {}}
+    for _, labels, value in samples("serve_service_ingress_depth"):
+        out["ingress_depth"] += value
+        rep = labels.get("replica", "")
+        out["by_replica"].setdefault(rep, 0.0)
+        out["by_replica"][rep] += value
+    for _, labels, value in samples("serve_service_queue_depth"):
+        out["queue_depth"] += value
+        model = labels.get("model", "")
+        out["queue_by_model"][model] = \
+            out["queue_by_model"].get(model, 0.0) + value
+        rep = labels.get("replica", "")
+        out["by_replica"].setdefault(rep, 0.0)
+        out["by_replica"][rep] += value
+    for _, labels, value in samples("serve_resident_bytes"):
+        out["resident_bytes"] += value
+    out["queue_depth"] += out["ingress_depth"]
+    return out

@@ -1,0 +1,157 @@
+"""fmrisim-driven synthetic serving traffic with heavy tails.
+
+A serving bench that replays uniform arrivals at a constant rate
+flatters every queueing policy: real request streams are **bursty**
+(heavy-tailed inter-arrivals — a scanner session ends and a batch
+of subjects uploads at once) and **mixed** (scan lengths spread over
+an order of magnitude, with a long tail of long scans).  This
+module builds that workload from the repo's own simulator:
+
+- **payloads** come from :mod:`brainiak_tpu.utils.fmrisim` — a
+  boxcar event train (``generate_stimfunction``) convolved with the
+  double-gamma HRF (``convolve_hrf``) drives per-voxel loadings
+  plus Gaussian noise, so each request is a plausible BOLD
+  ``[voxels, TRs]`` scan rather than white noise;
+- **scan lengths** draw from ``tr_choices`` with Zipf-ish weights
+  (mostly short scans, occasional long ones — several shape
+  buckets, like the real encoding read path);
+- **arrivals** are Pareto inter-arrival times (``alpha`` default
+  1.5: finite mean, heavy tail) rescaled so the MEAN rate matches
+  ``target_rps`` — the overload bench dials ``target_rps`` to 2x
+  measured capacity and the bursts do the rest.
+
+Everything is seeded and deterministic, so a bench round or CI gate
+replays the identical mix.
+"""
+
+import time
+
+import numpy as np
+
+from ..batching import Request
+
+__all__ = ["TrafficGenerator", "replay"]
+
+
+class TrafficGenerator:
+    """Synthetic request traffic against a fitted SRM-family model
+    (see module docstring).
+
+    Parameters
+    ----------
+    model : fitted SRM/DetSRM (``w_`` per-subject maps — the demo
+        and fixture serving workload)
+    model_name : str, optional
+        Stamped on every request's ``model`` field (multi-model
+        routing through the federation router).
+    tr_choices : tuple of int
+        Scan lengths in TRs, ascending; drawn with Zipf weights
+        (``P(choice i) ∝ 1/(i+1)``) so short scans dominate.
+    alpha : float
+        Pareto tail index for inter-arrival times (smaller =
+        burstier; must be > 1 so the mean exists).
+    tr_duration : float
+        Simulated TR length in seconds (drives the HRF kernel).
+    """
+
+    def __init__(self, model, model_name=None, seed=0,
+                 tr_choices=(16, 32, 64, 128), alpha=1.5,
+                 tr_duration=1.0):
+        if alpha <= 1.0:
+            raise ValueError(
+                f"alpha must be > 1 (finite-mean Pareto), got "
+                f"{alpha}")
+        self.model = model
+        self.model_name = model_name
+        self.voxel_counts = [w.shape[0] for w in model.w_]
+        self.tr_choices = tuple(int(t) for t in tr_choices)
+        self.alpha = float(alpha)
+        self.tr_duration = float(tr_duration)
+        self.rng = np.random.RandomState(seed)
+        weights = 1.0 / np.arange(1, len(self.tr_choices) + 1)
+        self._tr_weights = weights / weights.sum()
+
+    def _payload(self, subject, n_trs):
+        """One fmrisim-flavored scan: an event-driven BOLD course
+        broadcast through random per-voxel loadings + noise."""
+        from ...utils import fmrisim
+
+        total_time = n_trs * self.tr_duration
+        n_events = max(1, n_trs // 8)
+        onsets = np.sort(self.rng.uniform(
+            0.0, max(total_time - 2.0, 1.0), size=n_events))
+        stim = fmrisim.generate_stimfunction(
+            onsets.tolist(), [self.tr_duration], total_time,
+            temporal_resolution=10.0)
+        bold = fmrisim.convolve_hrf(
+            stim, self.tr_duration,
+            temporal_resolution=10.0)[:n_trs, 0]
+        v = self.voxel_counts[subject]
+        loadings = self.rng.randn(v, 1)
+        data = loadings * bold[None, :] \
+            + 0.5 * self.rng.randn(v, n_trs)
+        return data.astype(np.float32)
+
+    def requests(self, n, prefix="t", deadline_s=None):
+        """``n`` deterministic requests: heavy-tailed scan-length
+        mix, subjects round-robin, fmrisim payloads."""
+        out = []
+        for i in range(n):
+            subject = i % len(self.voxel_counts)
+            n_trs = int(self.rng.choice(self.tr_choices,
+                                        p=self._tr_weights))
+            out.append(Request(
+                request_id=f"{prefix}{i}",
+                x=self._payload(subject, n_trs),
+                subject=subject, model=self.model_name,
+                deadline_s=deadline_s))
+        return out
+
+    def schedule(self, n, target_rps, prefix="t", deadline_s=None):
+        """``[(arrival_offset_s, Request)]`` — Pareto inter-arrival
+        times rescaled so the mean rate over the schedule is
+        ``target_rps`` exactly (the tail stays heavy: individual
+        gaps spread over orders of magnitude)."""
+        if target_rps <= 0:
+            raise ValueError(
+                f"target_rps must be > 0, got {target_rps}")
+        gaps = self.rng.pareto(self.alpha, size=n) + 1.0
+        arrivals = np.cumsum(gaps)
+        arrivals *= n / (float(target_rps) * arrivals[-1])
+        reqs = self.requests(n, prefix=prefix,
+                             deadline_s=deadline_s)
+        return list(zip(arrivals.tolist(), reqs))
+
+
+def replay(schedule, submit_many, time_scale=1.0,
+           sleep=time.sleep, now=time.perf_counter):
+    """Drive a schedule against a submit surface (a
+    :class:`~brainiak_tpu.serve.federation.router.Router` or
+    :class:`~brainiak_tpu.serve.service.ServeService` bound
+    method): sleeps to each arrival offset (scaled by
+    ``time_scale``) and submits every request whose time has come
+    as one wave.  Returns the tickets in schedule order.  Requests
+    are stamped ``submitted=None`` first so a reused schedule gets
+    fresh deadline clocks."""
+    schedule = sorted(schedule, key=lambda pair: pair[0])
+    for _, request in schedule:
+        request.submitted = None
+    tickets = []
+    t0 = now()
+    i = 0
+    while i < len(schedule):
+        due = schedule[i][0] * time_scale
+        wait = due - (now() - t0)
+        if wait > 0:
+            sleep(wait)
+        elapsed = now() - t0
+        wave = []
+        while i < len(schedule) and \
+                schedule[i][0] * time_scale <= elapsed:
+            wave.append(schedule[i][1])
+            i += 1
+        if not wave:  # clock did not advance past the next arrival
+            wave.append(schedule[i][1])
+            i += 1
+        tickets.extend(submit_many(wave))
+    return tickets

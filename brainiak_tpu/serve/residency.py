@@ -1,20 +1,36 @@
-"""HBM-aware multi-model residency: many models, one byte budget.
+"""HBM-aware multi-model residency: many models, per-device budgets.
 
 A production serving process answers for MANY fitted models (one
 encoding model per individual in the arXiv:2403.19421 setting), but
 HBM is finite: loading every artifact eagerly OOMs, and loading per
 request pays artifact I/O + upload on the hot path.
 :class:`ModelResidency` is the middle ground — a byte-weighted LRU
-of loaded (model, engine) pairs under an explicit budget:
+of loaded (model, engine) pairs under an explicit **per-device**
+budget:
 
 - **admission** — :meth:`acquire` loads a registered artifact on
   first use and charges its packed byte size
   (:func:`~brainiak_tpu.serve.artifacts.model_nbytes`) against the
-  budget, evicting least-recently-used unpinned residents until it
-  fits; a model that cannot fit even after evicting everything
-  evictable raises the **typed** :class:`AdmissionError` — the
-  refusal happens at admission time in Python, never as a device
-  OOM mid-batch;
+  budget of the device(s) it lands on, evicting least-recently-used
+  unpinned residents of the constrained device until it fits; a
+  model that cannot fit even after evicting everything evictable
+  raises the **typed** :class:`AdmissionError` — the refusal
+  happens at admission time in Python, never as a device OOM
+  mid-batch;
+- **per-device accounting** (the federation tier of ROADMAP item
+  3) — the budget is PER DEVICE, not one global pool: an unsharded
+  model is placed on the least-loaded device and charges only that
+  device; a **sharded** model (see below) charges every mesh device
+  its per-shard slice (:func:`~brainiak_tpu.serve.artifacts.
+  model_shard_nbytes`), and eviction decisions name the device that
+  is actually over budget;
+- **sharded-model serving** — with a ``mesh=``, a model whose packed
+  bytes exceed one device's budget (or one registered with
+  ``sharded=True``) is served through the engine's device-sharded
+  programs (:mod:`~brainiak_tpu.serve.engine`, the
+  :mod:`~brainiak_tpu.ops.distla` idiom): weights partitioned over
+  the mesh axes, per-device residency charged per shard, answers
+  bit-identical to the unsharded path (zero padding is exact);
 - **pinning** — ``register(..., pinned=True)`` exempts a model from
   eviction (the always-hot tier); pinned bytes still count against
   the budget, so over-pinning surfaces as ``AdmissionError`` at the
@@ -36,9 +52,10 @@ and a conservative constant fallback on backends without memory
 stats (CPU).
 
 Telemetry: ``serve_resident_models`` / ``serve_resident_bytes``
-gauges track occupancy, ``serve_evictions_total{model=}`` counts
-victims, and every eviction emits an ``eviction`` event naming the
-victim, its bytes, and the admission that displaced it.
+gauges track occupancy (plus ``serve_resident_device_bytes{device=}``
+per mesh device), ``serve_evictions_total{model=}`` counts victims,
+and every eviction emits an ``eviction`` event naming the victim,
+its bytes, and the admission that displaced it.
 """
 
 import dataclasses
@@ -88,7 +105,14 @@ def default_budget_bytes():
     stats (CPU) or jax is not initialized."""
     raw = os.environ.get(BUDGET_ENV)
     if raw:
-        return int(raw)
+        try:
+            return int(raw)
+        except ValueError:
+            # a malformed override must name itself, not surface as
+            # a bare `int()` traceback deep inside admission
+            raise ValueError(
+                f"{BUDGET_ENV} must be an integer byte count, "
+                f"got {raw!r}") from None
     limits = [d["bytes_limit"]
               for d in device_memory_snapshot(emit=False)
               if "bytes_limit" in d]
@@ -97,24 +121,42 @@ def default_budget_bytes():
     return DEFAULT_BUDGET_BYTES
 
 
+def _device_label(dev):
+    """Stable string form of an accounting device slot (a jax
+    Device's repr, or the label verbatim)."""
+    return str(dev)
+
+
+def _is_jax_device(dev):
+    """A real backend device (an engine placement target) vs a
+    planning label — duck-typed so no jax import is needed."""
+    return hasattr(dev, "platform") and hasattr(dev, "id")
+
+
 class AdmissionError(RuntimeError):
     """A model could not be admitted under the byte budget — the
     typed, pre-device refusal the serving layer returns instead of
-    an OOM.  Carries the sizing facts a capacity dashboard needs."""
+    an OOM.  Carries the sizing facts a capacity dashboard needs;
+    ``device`` names the constrained mesh device when the refusal is
+    per-device (the federation accounting)."""
 
-    def __init__(self, name, needed, budget, resident, pinned):
+    def __init__(self, name, needed, budget, resident, pinned,
+                 device=None):
         self.model = name
         self.needed_bytes = int(needed)
         self.budget_bytes = int(budget)
         self.resident_bytes = int(resident)
         self.pinned_bytes = int(pinned)
+        self.device = device
+        where = f" on device {device}" if device is not None else ""
         super().__init__(
-            f"cannot admit model {name!r}: needs "
+            f"cannot admit model {name!r}{where}: needs "
             f"{self.needed_bytes} bytes against a "
-            f"{self.budget_bytes}-byte budget with "
+            f"{self.budget_bytes}-byte per-device budget with "
             f"{self.pinned_bytes} bytes pinned "
             f"({self.resident_bytes} resident) — raise the budget, "
-            "unpin a model, or shrink the artifact")
+            "unpin a model, shard it over a mesh, or shrink the "
+            "artifact")
 
 
 @dataclasses.dataclass
@@ -127,6 +169,10 @@ class _Registration:
     model: Optional[Any] = None    # held instance (host memory)
     kind: Optional[str] = None
     pinned: bool = False
+    #: None = decide at admission (shard iff the model exceeds one
+    #: device's budget, a mesh is attached, and the kind has a
+    #: sharded serve program); True/False force either way.
+    sharded: Optional[bool] = None
     admissions: int = 0            # lifetime admits (re-admits too)
     nbytes: Optional[int] = None   # learned at first load
     digest: Optional[str] = None   # learned at first AOT admit
@@ -142,7 +188,9 @@ class _Registration:
 @dataclasses.dataclass
 class ResidentModel:
     """One admitted model: the loaded estimator, its engine, and the
-    accounting the LRU runs on."""
+    accounting the LRU runs on.  ``device_nbytes`` maps each device
+    this entry occupies to the bytes it charges there — one entry
+    for an unsharded model, one per mesh device for a sharded one."""
 
     name: str
     model: Any
@@ -151,25 +199,47 @@ class ResidentModel:
     pinned: bool = False
     last_used: float = 0.0
     admissions: int = 1
+    sharded: bool = False
+    device_nbytes: dict = dataclasses.field(default_factory=dict)
 
     def touch(self):
         self.last_used = time.monotonic()
 
 
 class ModelResidency:
-    """Byte-weighted LRU of loaded models with pinning.
+    """Byte-weighted LRU of loaded models with pinning, accounted
+    per device.
 
     Parameters
     ----------
     budget_bytes : int, optional
-        Admission budget; default :func:`default_budget_bytes`.
+        Admission budget **per device**; default
+        :func:`default_budget_bytes` (itself derived from the
+        smallest device's HBM).  On a single-device backend this is
+        exactly the pre-federation global-pool behavior.
     policy : :class:`~brainiak_tpu.serve.batching.BucketPolicy`,
         optional
         Shared by every engine this residency constructs.
     aot : :class:`~brainiak_tpu.serve.aot.AOTProgramCache` or str,
         optional
         Threaded into every engine, so evict/re-admit cycles and
-        process restarts stay compile-free.
+        process restarts stay compile-free.  Engines serving a
+        SHARDED model bypass the cache (their programs close over
+        the mesh and are not portable across device counts).
+    mesh : :class:`jax.sharding.Mesh`, optional
+        Enables sharded-model serving: a model over one device's
+        budget whose kind has a sharded serve program
+        (:data:`~brainiak_tpu.serve.artifacts.SHARDED_KINDS`) is
+        partitioned over ALL mesh axes (the
+        :mod:`~brainiak_tpu.ops.distla` flattened-ring idiom) and
+        charged per device.
+    devices : sequence, optional
+        The accounting device slots (default: the mesh's devices,
+        else ``jax.devices()``, resolved lazily so an explicit
+        budget never initializes a backend at construction).  Any
+        hashable labels are accepted — capacity planning and tests
+        can model a fleet without touching the backend; engine
+        placement only happens for real ``jax.Device`` entries.
 
     The registry/LRU bookkeeping is guarded by one reentrant lock
     (``register()`` is legal from any thread while the service loop
@@ -181,7 +251,8 @@ class ModelResidency:
     evict`` re-enters.
     """
 
-    def __init__(self, budget_bytes=None, policy=None, aot=None):
+    def __init__(self, budget_bytes=None, policy=None, aot=None,
+                 mesh=None, devices=None):
         self.budget_bytes = int(budget_bytes
                                 if budget_bytes is not None
                                 else default_budget_bytes())
@@ -190,12 +261,15 @@ class ModelResidency:
                 f"budget_bytes must be positive, got "
                 f"{self.budget_bytes}")
         self.policy = policy
+        self.mesh = mesh
         if aot is not None:
             from . import aot as aot_mod
             if not isinstance(aot, aot_mod.AOTProgramCache):
                 aot = aot_mod.AOTProgramCache(aot)
         self.aot = aot
         self._lock = threading.RLock()
+        self._devices = (list(devices) if devices is not None
+                         else None)  # guarded-by: _lock
         self._registry = {}    # guarded-by: _lock
         self._resident = {}    # guarded-by: _lock
         self._n_evictions = 0  # guarded-by: _lock
@@ -213,30 +287,54 @@ class ModelResidency:
     # -- registration -------------------------------------------------
 
     def register(self, name, source=None, model=None, kind=None,
-                 pinned=False):
+                 pinned=False, sharded=None):
         """Register a named model without loading it.
 
         Exactly one of ``source`` (artifact path, or a zero-arg
         loader callable) and ``model`` (a fitted instance; host
         memory is the caller's — eviction then only frees the
         engine's device arrays) must be given.  ``pinned`` models
-        are never evicted.  Returns ``name``."""
+        are never evicted.  ``sharded`` forces mesh-sharded serving
+        (True), forbids it (False), or leaves the decision to
+        admission (None, the default: shard exactly when the model
+        exceeds one device's budget and the mesh + kind allow it).
+        Returns ``name``."""
         if (source is None) == (model is None):
             raise ValueError(
                 "register() takes exactly one of source= / model=")
+        if sharded and self.mesh is None:
+            raise ValueError(
+                f"model {name!r} registered sharded=True but the "
+                "residency has no mesh")
         with self._lock:
             if name in self._registry:
                 raise ValueError(
                     f"model {name!r} already registered")
             self._registry[name] = _Registration(
                 name=name, source=source, model=model, kind=kind,
-                pinned=bool(pinned))
+                pinned=bool(pinned),
+                sharded=None if sharded is None else bool(sharded))
         return name
 
     def names(self):
         """Registered model names (resident or not)."""
         with self._lock:
             return sorted(self._registry)
+
+    def devices(self):
+        """The accounting device slots, resolved lazily: explicit
+        ``devices=``, else the mesh's devices, else
+        ``jax.devices()`` (deferred so an explicitly-budgeted
+        residency never initializes a backend at construction)."""
+        with self._lock:
+            if self._devices is None:
+                if self.mesh is not None:
+                    self._devices = [d for d in
+                                     self.mesh.devices.flat]
+                else:
+                    import jax
+                    self._devices = list(jax.devices())
+            return list(self._devices)
 
     def resident_names(self):
         with self._lock:
@@ -269,9 +367,11 @@ class ModelResidency:
             # a size learned on a PRIOR load makes an over-budget
             # model refuse in O(1): a request stream aimed at an
             # inadmissible artifact must not re-read it from disk
-            # on every route
+            # on every route (a model the mesh could still shard is
+            # not refused here — the decision needs the layout)
             if reg.nbytes is not None and \
-                    reg.nbytes > self.budget_bytes:
+                    reg.nbytes > self.budget_bytes and \
+                    not self._may_shard(reg):
                 raise AdmissionError(
                     reg.name, reg.nbytes, self.budget_bytes,
                     self.resident_bytes(), self.pinned_bytes())
@@ -281,52 +381,133 @@ class ModelResidency:
         # load is benign — the re-check below keeps one winner
         model = reg.load()
         nbytes = artifacts.model_nbytes(model)
+        kind = reg.kind or artifacts.detect_kind(model)
+        sharded = reg.sharded
+        if sharded is None:
+            sharded = (self.mesh is not None
+                       and kind in artifacts.SHARDED_KINDS
+                       and nbytes > self.budget_bytes)
+        per_device = None
+        if sharded:
+            if kind not in artifacts.SHARDED_KINDS:
+                raise ValueError(
+                    f"model {name!r} (kind {kind!r}) has no "
+                    "sharded serve program (shardable: "
+                    f"{', '.join(sorted(artifacts.SHARDED_KINDS))})")
+            shard_bytes, replicated = artifacts.model_shard_nbytes(
+                model, int(self.mesh.devices.size))
+            per_device = shard_bytes + replicated
         # the digest cannot change between admissions of the same
         # registration (bit-exact load contract): hash once, not on
-        # every evict/re-admit cycle of a GB artifact
+        # every evict/re-admit cycle of a GB artifact.  Sharded
+        # engines bypass the AOT cache, so they skip the hash too.
         digest = reg.digest
-        if self.aot is not None and digest is None:
+        if self.aot is not None and digest is None and not sharded:
             digest = artifacts.model_digest(model)
         with self._lock:
             entry = self._resident.get(name)
             if entry is None:
                 reg.nbytes = nbytes
+                reg.kind = kind
                 reg.digest = digest
-                entry = self._admit(reg, model, nbytes)
+                entry = self._admit(reg, model, nbytes,
+                                    sharded=sharded,
+                                    per_device=per_device)
             entry.touch()
             return entry
 
-    def _admit(self, reg, model, nbytes):  # requires-lock: _lock
-        self._make_room(reg.name, nbytes)
-        engine = InferenceEngine(model, kind=reg.kind,
-                                 policy=self.policy, aot=self.aot,
-                                 digest=reg.digest)
+    def _may_shard(self, reg):  # requires-lock: _lock
+        """Whether an over-budget registration could still admit
+        through the sharded path (kind unknown = maybe)."""
+        if self.mesh is None or reg.sharded is False:
+            return False
+        return reg.kind is None or reg.kind in artifacts.SHARDED_KINDS
+
+    def _admit(self, reg, model, nbytes, sharded=False,
+               per_device=None):  # requires-lock: _lock
+        if sharded:
+            device_nbytes = {dev: int(per_device)
+                             for dev in self.devices()}
+        else:
+            dev = self._place_device(nbytes)
+            device_nbytes = {dev: int(nbytes)}
+        self._make_room(reg.name, device_nbytes)
+        device = None
+        if not sharded:
+            dev = next(iter(device_nbytes))
+            device = dev if _is_jax_device(dev) else None
+        engine = InferenceEngine(
+            model, kind=reg.kind, policy=self.policy,
+            # sharded programs close over the mesh (not portable
+            # across device counts) and are excluded from AOT
+            # persistence, same as the host-delegated fcma kind
+            aot=None if sharded else self.aot,
+            digest=reg.digest,
+            mesh=self.mesh if sharded else None,
+            device=device)
         reg.admissions += 1
         entry = ResidentModel(
             name=reg.name, model=model, engine=engine,
             nbytes=nbytes, pinned=reg.pinned,
             last_used=time.monotonic(),
-            admissions=reg.admissions)
+            admissions=reg.admissions, sharded=sharded,
+            device_nbytes=device_nbytes)
         self._resident[reg.name] = entry
         self._gauge()
         return entry
 
-    def _make_room(self, incoming, nbytes):  # requires-lock: _lock
-        """Evict LRU unpinned residents until ``nbytes`` fits; the
-        typed refusal when even that is not enough."""
-        if nbytes > self.budget_bytes:
-            raise AdmissionError(
-                incoming, nbytes, self.budget_bytes,
-                self.resident_bytes(), self.pinned_bytes())
-        while self.resident_bytes() + nbytes > self.budget_bytes:
+    def _place_device(self, nbytes):  # requires-lock: _lock
+        """Least-loaded device for an unsharded admission: prefer
+        a device where the model fits without evicting anyone,
+        else one where evicting unpinned residents CAN make room
+        (pinned bytes are immovable — placing on a pinned-full
+        device would refuse a model another device could admit),
+        else fall back least-loaded so ``_make_room`` raises the
+        typed refusal naming that device.  ``min`` is stable, so
+        ties resolve to the first device in slot order —
+        deterministic placement."""
+        occ = self._device_bytes_locked()
+        devs = self.devices()
+        free = [d for d in devs
+                if occ.get(d, 0) + nbytes <= self.budget_bytes]
+        evictable = [d for d in devs
+                     if self._pinned_device_bytes(d) + nbytes
+                     <= self.budget_bytes]
+        return min(free or evictable or devs,
+                   key=lambda d: occ.get(d, 0))
+
+    def _make_room(self, incoming,
+                   device_nbytes):  # requires-lock: _lock
+        """Evict LRU unpinned residents OF EACH over-budget device
+        until the incoming per-device charges fit; the typed refusal
+        when even that is not enough.  Eviction frees every device a
+        victim occupies, so evicting for one constrained device
+        never strands partial accounting on another."""
+        for dev, need in device_nbytes.items():
+            if need > self.budget_bytes:
+                raise AdmissionError(
+                    incoming, need, self.budget_bytes,
+                    self.resident_bytes(), self.pinned_bytes(),
+                    device=_device_label(dev))
+        while True:
+            occ = self._device_bytes_locked()
+            over = next(
+                (dev for dev, need in device_nbytes.items()
+                 if occ.get(dev, 0) + need > self.budget_bytes),
+                None)
+            if over is None:
+                return
             victims = sorted(
                 (e for e in self._resident.values()
-                 if not e.pinned and e.name != incoming),
+                 if not e.pinned and e.name != incoming
+                 and over in e.device_nbytes),
                 key=lambda e: e.last_used)
             if not victims:
                 raise AdmissionError(
-                    incoming, nbytes, self.budget_bytes,
-                    self.resident_bytes(), self.pinned_bytes())
+                    incoming, device_nbytes[over],
+                    self.budget_bytes, occ.get(over, 0),
+                    self._pinned_device_bytes(over),
+                    device=_device_label(over))
             self.evict(victims[0].name,
                        reason=f"admission of {incoming!r}")
 
@@ -376,6 +557,25 @@ class ModelResidency:
             return sum(e.nbytes for e in self._resident.values()
                        if e.pinned)
 
+    def device_bytes(self):
+        """``{device label: resident bytes}`` — the per-device
+        occupancy the router and capacity dashboards read."""
+        with self._lock:
+            occ = self._device_bytes_locked()
+            return {_device_label(dev): occ.get(dev, 0)
+                    for dev in self.devices()}
+
+    def _device_bytes_locked(self):  # requires-lock: _lock
+        occ = {}
+        for entry in self._resident.values():
+            for dev, nbytes in entry.device_nbytes.items():
+                occ[dev] = occ.get(dev, 0) + nbytes
+        return occ
+
+    def _pinned_device_bytes(self, dev):  # requires-lock: _lock
+        return sum(e.device_nbytes.get(dev, 0)
+                   for e in self._resident.values() if e.pinned)
+
     def _gauge(self):  # requires-lock: _lock
         obs_metrics.gauge(
             "serve_resident_models",
@@ -384,14 +584,34 @@ class ModelResidency:
         obs_metrics.gauge(
             "serve_resident_bytes", unit="bytes").set(
                 self.resident_bytes())
+        occ = self._device_bytes_locked()
+        # per-device occupancy only once accounting touched a
+        # device: an idle residency must not initialize a backend
+        # just to publish zeros
+        if occ or self._devices is not None:
+            gauge = obs_metrics.gauge(
+                "serve_resident_device_bytes", unit="bytes",
+                help="resident model bytes charged per device")
+            for dev in self.devices():
+                gauge.set(occ.get(dev, 0),
+                          device=_device_label(dev))
 
     def stats(self):
         """Occupancy + churn for the service summary."""
         with self._lock:
+            per_device = {}
+            if self._resident or self._devices is not None:
+                occ = self._device_bytes_locked()
+                per_device = {_device_label(dev): occ.get(dev, 0)
+                              for dev in self.devices()}
             return {
                 "budget_bytes": self.budget_bytes,
                 "resident_bytes": self.resident_bytes(),
                 "pinned_bytes": self.pinned_bytes(),
+                "per_device": per_device,
+                "sharded": sorted(
+                    e.name for e in self._resident.values()
+                    if e.sharded),
                 "n_registered": len(self._registry),
                 "n_resident": len(self._resident),
                 "resident": self.resident_names(),

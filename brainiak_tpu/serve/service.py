@@ -140,11 +140,33 @@ class ServeService:
     ``BRAINIAK_TPU_OBS_HTTP_PORT`` env var (unset = no listener).
     ``/readyz`` derives from :meth:`readiness` — model residency
     plus AOT warm state.
+
+    ``name`` labels this replica: every ``serve_service_*`` gauge
+    this instance publishes carries ``replica=<name>``, so N
+    replicas in one process (the federation tier) stay separable in
+    the registry — exactly the series the
+    :class:`~brainiak_tpu.serve.federation.Router` places by.
+    Unnamed services publish unlabeled series (the pre-federation
+    shape, and what single-replica dashboards scrape).
+
+    ``admission`` attaches load-shedding admission control
+    (:class:`~brainiak_tpu.serve.federation.AdmissionController`):
+    :meth:`submit`/:meth:`submit_many` consult it BEFORE enqueue,
+    and an over-bound request resolves its ticket immediately with
+    a typed ``shed_overload`` record carrying ``retry_after_s`` —
+    never an exception, never device time, and still exactly one
+    ticket per request.
     """
 
     def __init__(self, residency, tick_interval=None,
-                 default_model=None, slos=None, http_port=None):
+                 default_model=None, slos=None, http_port=None,
+                 name=None, admission=None):
         self.residency = residency
+        self.name = name
+        # replica label threaded onto every service-level gauge
+        # (empty for the unnamed single-replica shape)
+        self._labels = {"replica": name} if name else {}
+        self._admission = admission
         policy = residency.policy
         max_wait = policy.max_wait_s if policy is not None else 0.05
         self.tick_interval = (
@@ -160,6 +182,7 @@ class ServeService:
         self._drain_on_stop = True           # guarded-by: _cond
         self._thread = None                  # guarded-by: _cond
         self._n_submitted = 0                # guarded-by: _cond
+        self._n_shed = 0                     # guarded-by: _cond
         # (model, engine seq) -> ticket
         self._pending = {}           # guarded-by: _engine_lock
         # ok-latency distribution: a mergeable log-bucketed sketch
@@ -297,6 +320,14 @@ class ServeService:
         if request.submitted is None:
             request.submitted = time.monotonic()
         clock = obs_trace.stage_clock()
+        # admission reads the ENGINE-queue gauge this replica
+        # publishes (at most one tick stale, by design) BEFORE the
+        # lock: the shed fast path must not serialize on ingress
+        # contention.  Ingress depth is counted live under the lock
+        # below — adding the ingress gauge here would double-count
+        # it (submit itself keeps that gauge at len(_ingress))
+        queued = self._engine_queue_depth() \
+            if self._admission is not None else 0
         # trace root: mint (or adopt an injected) trace id and emit
         # the serve.submit span BEFORE the request becomes visible
         # to the loop — the loop's serve.enqueue span reads and
@@ -306,18 +337,28 @@ class ServeService:
         obs_trace.traced_span("serve.submit", clock.elapsed(),
                               request, attrs={"model": name})
         ticket = ServiceTicket(request.request_id, name)
+        shed = None
         with self._cond:
             if self._state != "running":
                 raise ServiceClosed(
                     f"service is {self._state}; submit() needs a "
                     "running loop (start()/with-block)")
-            self._ingress.append((name, request, ticket))
-            depth = len(self._ingress)
-            self._n_submitted += 1
-            self._cond.notify_all()
+            if self._admission is not None:
+                shed = self._admission.evaluate(
+                    len(self._ingress) + queued)
+            if shed is None:
+                self._ingress.append((name, request, ticket))
+                depth = len(self._ingress)
+                self._n_submitted += 1
+                self._cond.notify_all()
+            else:
+                self._n_shed += 1
+        if shed is not None:
+            return self._shed_ticket(request, ticket, shed)
         obs_metrics.gauge(
             "serve_service_ingress_depth",
-            help="requests accepted but not yet routed").set(depth)
+            help="requests accepted but not yet routed").set(
+                depth, **self._labels)
         return ticket
 
     def submit_many(self, requests, model=None):
@@ -354,19 +395,106 @@ class ServeService:
                                       request,
                                       attrs={"model": name,
                                              "wave": len(staged)})
+        # engine-queue gauge only: len(_ingress) is counted live
+        # under the lock (the ingress gauge would double-count it)
+        queued = self._engine_queue_depth() \
+            if self._admission is not None else 0
+        shed_out = []
         with self._cond:
             if self._state != "running":
                 raise ServiceClosed(
                     f"service is {self._state}; submit_many() "
                     "needs a running loop (start()/with-block)")
-            self._ingress.extend(staged)
+            if self._admission is None:
+                admitted = staged
+            else:
+                # per-request admission over the wave: each accept
+                # raises the depth the next decision sees, so a
+                # wave overflows the bound deterministically — the
+                # head admits, the tail sheds
+                admitted = []
+                for name, request, ticket in staged:
+                    shed = self._admission.evaluate(
+                        len(self._ingress) + queued
+                        + len(admitted))
+                    if shed is None:
+                        admitted.append((name, request, ticket))
+                    else:
+                        shed_out.append((request, ticket, shed))
+                self._n_shed += len(shed_out)
+            self._ingress.extend(admitted)
             depth = len(self._ingress)
-            self._n_submitted += len(staged)
+            self._n_submitted += len(admitted)
             self._cond.notify_all()
+        for request, ticket, shed in shed_out:
+            self._shed_ticket(request, ticket, shed)
         obs_metrics.gauge(
             "serve_service_ingress_depth",
-            help="requests accepted but not yet routed").set(depth)
+            help="requests accepted but not yet routed").set(
+                depth, **self._labels)
         return [ticket for _, _, ticket in staged]
+
+    def _shed_ticket(self, request, ticket, shed):
+        """Resolve one ticket with the typed pre-enqueue shed
+        record (the exactly-one-ticket invariant holds for sheds
+        too): ``shed_overload`` + ``retry_after_s``, never an
+        exception, never a queue slot, never device time."""
+        rec = ServeResult(
+            request_id=request.request_id, ok=False,
+            error="shed_overload",
+            message=(f"admission control shed the request before "
+                     f"enqueue ({shed.reason}: depth {shed.depth} "
+                     f">= bound {shed.bound}); retry after "
+                     f"{shed.retry_after_s:.3f}s"),
+            latency_s=0.0, retry_after_s=shed.retry_after_s)
+        ticket._resolve(rec)
+        obs_metrics.counter(
+            "serve_shed_total",
+            help="requests shed by admission control before "
+                 "enqueue").inc(reason=shed.reason, **self._labels)
+        obs_sink.event("shed", reason=shed.reason,
+                       depth=shed.depth, bound=shed.bound,
+                       retry_after_s=shed.retry_after_s,
+                       request_id=request.request_id,
+                       replica=self.name)
+        return ticket
+
+    def queued_depth(self):
+        """This replica's routed-but-undispatched load estimate:
+        the sum of the ``serve_service_ingress_depth`` and
+        ``serve_service_queue_depth`` gauges it publishes (a
+        registry read — no service locks, at most one tick stale).
+        The placement signal the federation router reads, per
+        ROADMAP item 3.  (The service's OWN admission path counts
+        ingress live instead — see :meth:`_engine_queue_depth`.)"""
+        return self._gauge_depth_sum(
+            ("serve_service_ingress_depth",
+             "serve_service_queue_depth"))
+
+    def _engine_queue_depth(self):
+        """Routed-into-engine depth alone (the
+        ``serve_service_queue_depth`` gauge): the admission fast
+        path adds the live ingress length under ``_cond``, so
+        including the ingress GAUGE here would count every queued
+        request twice and halve the effective bound."""
+        return self._gauge_depth_sum(("serve_service_queue_depth",))
+
+    def _gauge_depth_sum(self, metrics):
+        total = 0.0
+        for metric in metrics:
+            for labels, value in \
+                    obs_metrics.gauge(metric).samples():
+                if self._owns_labels(labels):
+                    total += value
+        return int(total)
+
+    def _owns_labels(self, labels):
+        """Whether a gauge sample belongs to this replica (named
+        replicas match their label; the unnamed service owns the
+        unlabeled series)."""
+        if self.name:
+            return labels.get("replica") == self.name
+        return "replica" not in labels
 
     # -- the loop (service thread only) -------------------------------
 
@@ -405,7 +533,8 @@ class ServeService:
                 help="requests queued in a model's bucket "
                      "queues").set(
                     sum(len(q) for q in entry.engine._queues
-                        .values()), model=entry.name)
+                        .values()), model=entry.name,
+                    **self._labels)
         if batch or n_records:
             # one span per tick that did work (routed ingress or
             # delivered results), carrying the measured tick
@@ -423,7 +552,7 @@ class ServeService:
             obs_metrics.gauge(
                 "serve_service_ingress_depth",
                 help="requests accepted but not yet "
-                     "routed").set(0)
+                     "routed").set(0, **self._labels)
         if self._slo is not None and (batch or n_records):
             # burn rates re-evaluated on every working tick: cheap
             # (a few dozen slice sums) and keeps the slo_* gauges
@@ -585,6 +714,7 @@ class ServeService:
             # under its own guard: submit() increments on caller
             # threads while the engine lock is NOT held
             n_submitted = self._n_submitted
+            n_shed = self._n_shed
         with self._engine_lock:
             # under the tick lock: the loop observes into the
             # sketch while delivering
@@ -610,6 +740,7 @@ class ServeService:
             "n_submitted": n_submitted,
             "n_delivered": n_delivered,
             "n_ok": n_ok,
+            "n_shed": n_shed,
             "n_errors": sum(errors_by_code.values()),
             "errors_by_code": errors_by_code,
             "p50_latency_s": p50,
@@ -622,8 +753,12 @@ class ServeService:
             "models": models,
             "residency": residency,
         }
+        if self.name:
+            out["replica"] = self.name
         if self.residency.aot is not None:
             out["aot"] = self.residency.aot.stats()
+        if self._admission is not None:
+            out["admission"] = self._admission.stats()
         if self._slo is not None:
             out["slo"] = self._slo.evaluate()
         with self._cond:

@@ -255,3 +255,45 @@ def test_streaming_tier_records_match_obs_schema(monkeypatch):
         assert rec["config"]["stack_bytes"] == out["stack_bytes"]
     assert recs[0]["vs_baseline"] > 0
     assert recs[1]["direction"] == "lower_is_better"
+
+
+# -- ISSUE 14: federation tier ----------------------------------------
+
+def test_federation_tier_records_match_obs_schema(monkeypatch):
+    """The federation tier (ISSUE 14): a tiny in-process run emits
+    THREE schema-valid records — routed requests/s across 2
+    replicas (vs_baseline = the win over one replica on the same
+    workload), accepted-request p99 under the 2x-capacity overload
+    burst and the shed ratio (both direction="lower_is_better") —
+    so `obs regress --only federation` gates the federation plane
+    from day one."""
+    monkeypatch.setenv("BENCH_FEDERATION_REQUESTS", "16")
+    out = bench.measure_tier("federation")
+    assert out["routed_requests_per_sec"] > 0
+    assert out["single_replica_rps"] > 0
+    assert out["overload_p99_s"] > 0
+    assert out["n_replicas"] == 2
+    # the atomic overload burst admits exactly the fleet bound and
+    # sheds the deterministic rest (2x fleet capacity -> ratio 0.5)
+    assert out["shed_ratio"] == 0.5
+    assert out["overload_burst"] == 4 * out["shed_bound"]
+    assert all(v > 0 for v in out["routed"].values())
+    stages = out["stages"]
+    assert set(bench.STAGE_KEYS) <= set(stages)
+    assert stages["steady_s"] > 0
+
+    recs = bench._federation_result_records(out)
+    assert [r["metric"] for r in recs] == [
+        "federation_routed_requests_per_sec",
+        "federation_overload_p99_seconds",
+        "federation_shed_ratio"]
+    for rec in recs:
+        assert obs.validate_bench_record(rec) == []
+        # in-process run on the CPU test backend -> fallback tier
+        assert rec["tier"] == "federation_cpu_fallback"
+        assert rec["config"]["n_requests"] == 16
+        assert rec["config"]["n_replicas"] == 2
+    assert recs[0]["vs_baseline"] > 0
+    assert "direction" not in recs[0]
+    assert recs[1]["direction"] == "lower_is_better"
+    assert recs[2]["direction"] == "lower_is_better"

@@ -47,10 +47,12 @@ def test_fixture_history_passes_and_gates():
     # tier (ISSUE 11: 3 rounds x 2 metrics — fused forward-backward
     # TRs/s, fused ring GB/s) + the streaming_r01-r03 tier
     # (ISSUE 13: 3 rounds x 2 metrics — streamed subjects/s,
-    # prefetch stall ratio), all measured host-side ->
-    # *_cpu_fallback: seven tiers gating independently from one
+    # prefetch stall ratio) + the federation_r01-r03 tier
+    # (ISSUE 14: 3 rounds x 3 metrics — routed requests/s, overload
+    # p99, shed ratio), all measured host-side ->
+    # *_cpu_fallback: eight tiers gating independently from one
     # directory
-    assert len(records) == 38
+    assert len(records) == 47
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
@@ -59,11 +61,12 @@ def test_fixture_history_passes_and_gates():
                      "distla_cpu_fallback",
                      "encoding_cpu_fallback",
                      "kernels_cpu_fallback",
-                     "streaming_cpu_fallback"}
+                     "streaming_cpu_fallback",
+                     "federation_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
     multi = ("service_cpu_fallback", "kernels_cpu_fallback",
-             "streaming_cpu_fallback")
+             "streaming_cpu_fallback", "federation_cpu_fallback")
     by_tier = {c["tier"]: c for c in result["checks"]
                if c["tier"] not in multi}
     by_metric = {c["metric"]: c for c in result["checks"]
@@ -81,13 +84,21 @@ def test_fixture_history_passes_and_gates():
                               "kernels_eventseg_fb_trs_per_sec",
                               "kernels_summa_ring_gb_per_sec",
                               "streaming_srm_subjects_per_sec",
-                              "streaming_prefetch_stall_ratio"}
+                              "streaming_prefetch_stall_ratio",
+                              "federation_routed_requests_per_sec",
+                              "federation_overload_p99_seconds",
+                              "federation_shed_ratio"}
     assert by_metric["service_obs_overhead_ratio"][
         "direction"] == "lower_is_better"
     # the ISSUE 13 streaming tier gates overlap the right way round
     assert by_metric["streaming_prefetch_stall_ratio"][
         "direction"] == "lower_is_better"
     assert by_metric["service_p99_latency_seconds"][
+        "direction"] == "lower_is_better"
+    # the ISSUE 14 federation tier gates overload behavior mirrored
+    assert by_metric["federation_overload_p99_seconds"][
+        "direction"] == "lower_is_better"
+    assert by_metric["federation_shed_ratio"][
         "direction"] == "lower_is_better"
     assert all(c["status"] == "ok" for c in by_metric.values())
     assert by_tier["cpu_fallback"]["status"] == "ok"

@@ -38,7 +38,11 @@ def test_lru_eviction_under_pressure(three_models):
     paths, nbytes = three_models
     mem = sink.add_sink(sink.MemorySink())
     try:
-        res = ModelResidency(budget_bytes=2 * nbytes + 16)
+        # one accounting slot: the per-device budget IS the old
+        # global pool on a single device (multi-device placement
+        # and eviction are covered in test_federation.py)
+        res = ModelResidency(budget_bytes=2 * nbytes + 16,
+                             devices=["hbm0"])
         for name, path in paths.items():
             res.register(name, source=path)
         res.acquire("a")
@@ -60,7 +64,8 @@ def test_lru_eviction_under_pressure(three_models):
 
 def test_transparent_readmission(three_models):
     paths, nbytes = three_models
-    res = ModelResidency(budget_bytes=nbytes + 16)
+    res = ModelResidency(budget_bytes=nbytes + 16,
+                         devices=["hbm0"])
     res.register("a", source=paths["a"])
     res.register("b", source=paths["b"])
     first = res.acquire("a")
@@ -81,7 +86,8 @@ def test_transparent_readmission(three_models):
 
 def test_pinned_model_never_evicted(three_models):
     paths, nbytes = three_models
-    res = ModelResidency(budget_bytes=nbytes + 16)
+    res = ModelResidency(budget_bytes=nbytes + 16,
+                         devices=["hbm0"])
     res.register("a", source=paths["a"], pinned=True)
     res.register("b", source=paths["b"])
     res.acquire("a")
@@ -115,7 +121,8 @@ def test_eviction_fails_queued_work_and_delivers(three_models):
     """Requests queued on the victim fail with `evicted` records
     routed through the on_evict_records hook, never dropped."""
     paths, nbytes = three_models
-    res = ModelResidency(budget_bytes=nbytes + 16)
+    res = ModelResidency(budget_bytes=nbytes + 16,
+                         devices=["hbm0"])
     delivered = []
     res.on_evict_records = \
         lambda name, recs: delivered.append((name, recs))

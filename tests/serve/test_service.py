@@ -47,11 +47,15 @@ def _enc_requests(model, n, seed=0, tr_choices=(6, 20),
 
 
 def _residency(models, budget=1 << 30, policy=None, aot=None):
+    # one accounting slot: the per-device budget IS the old global
+    # pool on one device, so the eviction/refusal scenarios here
+    # keep their meaning under the forced-8-device test env
+    # (multi-device placement is covered in test_federation.py)
     res = ModelResidency(
         budget_bytes=budget,
         policy=policy or BucketPolicy(max_batch=8,
                                       max_wait_s=0.02),
-        aot=aot)
+        aot=aot, devices=["hbm0"])
     for name, model in models.items():
         res.register(name, model=model)
     return res

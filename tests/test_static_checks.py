@@ -45,8 +45,8 @@ def test_run_checks_json_output():
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
         "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
-        "serve", "service", "distla", "encoding", "kernels",
-        "data"}
+        "serve", "service", "federation", "distla", "encoding",
+        "kernels", "data"}
     assert payload["files"] > 100
     seconds = payload["gate_seconds"]
     assert set(seconds) == set(payload["gates"])
@@ -346,6 +346,94 @@ def test_service_gate_catches_missing_fixture(tmp_path,
     rc.check_service(findings)
     assert [f.code for f in findings] == ["SRV002"]
     assert "missing" in findings[0].message
+
+
+def test_federation_gate_catches_missing_fixture(tmp_path,
+                                                 monkeypatch):
+    rc = _load_run_checks()
+    monkeypatch.setattr(rc, "SERVE_FIXTURE_DIR",
+                        str(tmp_path / "nope"))
+    findings = []
+    rc.check_federation(findings)
+    assert [f.code for f in findings] == ["SRV003"]
+    assert "missing" in findings[0].message
+
+
+def test_federation_gate_classifies_failures(monkeypatch):
+    """SRV003 (ISSUE 14 satellite): warm-fleet retraces, a starved
+    replica, lost tickets, missing sheds, per-device accounting,
+    and sharded parity each classify distinctly.  The CLI half is
+    stubbed with canned summaries so the classification paths run
+    without 4 service subprocesses."""
+    rc = _load_run_checks()
+
+    def cli_stub(summary):
+        return lambda aot_dir: (0, summary, "")
+
+    def child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    ok_verdict = {"ok": True}
+    warm = {"n_errors": 0, "retrace_total": 0.0,
+            "aot": {"hits": 3},
+            "federation": {"routed": {"r1": 5, "r2": 5}}}
+
+    # warm fleet that recompiled -> retrace finding
+    monkeypatch.setattr(rc, "_run_federation_cli", cli_stub(
+        dict(warm, retrace_total=2.0)))
+    monkeypatch.setattr(rc, "_FEDERATION_CHILD", child(ok_verdict))
+    findings = []
+    rc.check_federation(findings)
+    assert [f.code for f in findings] == ["SRV003"]
+    assert "zero serve retraces" in findings[0].message
+
+    # router starved one replica
+    monkeypatch.setattr(rc, "_run_federation_cli", cli_stub(
+        dict(warm, federation={"routed": {"r1": 10, "r2": 0}})))
+    findings = []
+    rc.check_federation(findings)
+    assert [f.code for f in findings] == ["SRV003"]
+    assert "both replicas" in findings[0].message
+
+    # selfcheck: lost tickets under overload
+    monkeypatch.setattr(rc, "_run_federation_cli", cli_stub(warm))
+    monkeypatch.setattr(rc, "_FEDERATION_CHILD", child(
+        {"ok": False, "all_resolved": False}))
+    findings = []
+    rc.check_federation(findings)
+    assert [f.code for f in findings] == ["SRV003"]
+    assert "exactly one ticket" in findings[0].message
+
+    # selfcheck: no sheds under overload
+    monkeypatch.setattr(rc, "_FEDERATION_CHILD", child(
+        {"ok": False, "all_resolved": True, "n_shed": 0,
+         "retry_after_ok": False}))
+    findings = []
+    rc.check_federation(findings)
+    assert [f.code for f in findings] == ["SRV003"]
+    assert "shed" in findings[0].message
+
+    # selfcheck: per-device accounting broke
+    monkeypatch.setattr(rc, "_FEDERATION_CHILD", child(
+        {"ok": False, "all_resolved": True, "n_shed": 4,
+         "retry_after_ok": True, "routed": {"r1": 8, "r2": 8},
+         "per_device_ok": False, "per_device": {"cpu0": 999}}))
+    findings = []
+    rc.check_federation(findings)
+    assert [f.code for f in findings] == ["SRV003"]
+    assert "per-device" in findings[0].message
+
+    # selfcheck: sharded parity failure (the default classification)
+    monkeypatch.setattr(rc, "_FEDERATION_CHILD", child(
+        {"ok": False, "all_resolved": True, "n_shed": 4,
+         "retry_after_ok": True, "per_device_ok": True,
+         "max_err": 0.5, "tol": 1e-4, "n_devices": 8}))
+    findings = []
+    rc.check_federation(findings)
+    assert [f.code for f in findings] == ["SRV003"]
+    assert "parity" in findings[0].message
 
 
 def test_distla_gate_passes_on_live_package():

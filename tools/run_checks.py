@@ -49,6 +49,15 @@ service    smoke-runs ``python -m brainiak_tpu.serve service``
            AOT cache and fails unless the second run reports
            aot hits > 0 and ZERO serve retraces — the
            restart-without-compile-stall contract (SRV002)
+federation serving federation gate (SRV003): two ``serve service
+           --replicas 2`` fleets over ONE temp AOT cache — the
+           second fleet must report aot hits > 0, zero serve
+           retraces, and the router must have routed the mixed
+           wave across BOTH replicas; then the federation
+           selfcheck child on the 8-device CPU mesh proves
+           sharded over-budget serving parity, per-device
+           residency accounting, and load shedding with
+           retry_after
 distla     smoke-runs the pod-scale linear algebra selfcheck
            (``brainiak_tpu.ops.distla.selfcheck``) on a tiny
            fixture over an 8-device CPU mesh and fails on
@@ -106,7 +115,8 @@ from brainiak_tpu.analysis.core import (  # noqa: E402,F401
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
          "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
-         "serve", "service", "distla", "encoding", "kernels", "data")
+         "serve", "service", "federation", "distla", "encoding",
+         "kernels", "data")
 
 
 def python_sources():
@@ -738,6 +748,137 @@ def check_service(findings):
             "zero-compile contract is broken"))
 
 
+# -- federation gate --------------------------------------------------
+
+def _run_federation_cli(aot_dir):
+    """One ``serve service --replicas 2`` fleet over the committed
+    fixture with a shared AOT cache; returns (rc, summary-or-None,
+    stderr tail)."""
+    model = os.path.join(SERVE_FIXTURE_DIR, "model.npz")
+    requests = os.path.join(SERVE_FIXTURE_DIR, "requests.npz")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "brainiak_tpu.serve", "service",
+             "--model", f"fixture={model}", "--requests", requests,
+             "--aot-cache", aot_dir, "--waves", "1",
+             "--replicas", "2", "--format=json"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     BENCH_FORCE_CPU="1"),
+            timeout=420)
+    except subprocess.TimeoutExpired:
+        return None, None, "timed out after 420s"
+    try:
+        summary = json.loads(proc.stdout)
+    except ValueError:
+        summary = None
+    tail = "; ".join((proc.stderr or proc.stdout or "")
+                     .strip().splitlines()[-3:])
+    return proc.returncode, summary, tail
+
+
+_FEDERATION_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.serve.federation.selfcheck import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_federation(findings):
+    """Serving-federation gate (SRV003), two halves.
+
+    Process granularity: run ``serve service --replicas 2`` TWICE
+    over one fresh temp AOT cache — fleet 1 may compile (and must
+    persist what it compiled); fleet 2 is the warm-fleet contract:
+    every request ok, ``aot.hits > 0``, ``retrace_total`` exactly
+    0, and the router routed the mixed wave across BOTH replicas
+    (every per-replica routed count > 0).
+
+    Mesh granularity: the federation selfcheck child on the
+    8-device CPU mesh — sharded over-budget serving parity vs the
+    host reference, per-device residency accounting, router
+    placement, and overload sheds carrying ``retry_after`` (every
+    shed request still resolving exactly one ticket)."""
+    import tempfile
+
+    rel = _rel(SERVE_FIXTURE_DIR)
+    for name in ("model.npz", "requests.npz"):
+        if not os.path.exists(os.path.join(SERVE_FIXTURE_DIR,
+                                           name)):
+            findings.append(Finding(
+                rel, 1, "SRV003",
+                f"serve fixture missing: {rel}/{name}"))
+            return
+    with tempfile.TemporaryDirectory(prefix="srv003-aot-") as tmp:
+        for attempt in (1, 2):
+            rc, summary, tail = _run_federation_cli(tmp)
+            if rc is None or summary is None or rc not in (0, 1):
+                findings.append(Finding(
+                    rel, 1, "SRV003",
+                    f"federation CLI run {attempt} failed "
+                    f"(rc={rc}): {tail or 'no JSON summary'}"))
+                return
+            if summary.get("n_errors"):
+                findings.append(Finding(
+                    rel, 1, "SRV003",
+                    f"run {attempt}: {summary['n_errors']} "
+                    "request(s) produced error records: "
+                    f"{summary.get('errors_by_code')}"))
+                return
+    routed = (summary.get("federation") or {}).get("routed") or {}
+    if len(routed) < 2 or not all(v > 0 for v in routed.values()):
+        findings.append(Finding(
+            rel, 1, "SRV003",
+            f"router did not spread the wave across both replicas "
+            f"(routed={routed})"))
+    aot = summary.get("aot") or {}
+    if not aot.get("hits"):
+        findings.append(Finding(
+            rel, 1, "SRV003",
+            "second replica fleet over the warm shared AOT cache "
+            f"reported no aot hits ({aot}): warm fleet start is "
+            "broken"))
+    if summary.get("retrace_total", 1) != 0:
+        findings.append(Finding(
+            rel, 1, "SRV003",
+            "second replica fleet compiled "
+            f"{summary.get('retrace_total'):.0f} serve program(s) "
+            "despite the warm shared AOT cache: replicas 2..N "
+            "must warm-start with zero serve retraces"))
+
+    def classify(verdict):
+        if not verdict.get("all_resolved", True):
+            return ("federation selfcheck lost tickets under "
+                    "overload: a shed request must still resolve "
+                    "exactly one ticket")
+        if verdict.get("n_shed", 0) == 0 \
+                or not verdict.get("retry_after_ok", True):
+            return ("overload produced no usable sheds "
+                    f"(n_shed={verdict.get('n_shed')}, "
+                    f"retry_after_ok="
+                    f"{verdict.get('retry_after_ok')}): the "
+                    "bounded-ingress shed path is broken")
+        routed = verdict.get("routed") or {}
+        if routed and not all(v > 0 for v in routed.values()):
+            return (f"router starved a replica (routed={routed})")
+        if not verdict.get("per_device_ok", True):
+            return ("per-device residency accounting did not "
+                    "charge every mesh device within budget: "
+                    f"{verdict.get('per_device')}")
+        return (f"sharded-serving parity failure: max_err="
+                f"{verdict.get('max_err')} over tol="
+                f"{verdict.get('tol')} "
+                f"(n_devices={verdict.get('n_devices')})")
+
+    _run_selfcheck_gate(
+        findings, _FEDERATION_CHILD, "SRV003",
+        _rel(os.path.join(REPO, "brainiak_tpu", "serve",
+                          "federation", "selfcheck.py")),
+        "federation", classify)
+
+
 # -- selfcheck-child gates (distla, encoding) -------------------------
 #
 # Shared harness: run a module selfcheck in a child pinned to an
@@ -1109,6 +1250,8 @@ def run_gates(only=None):
         timed("serve", check_serve, findings)
     if "service" in selected:
         timed("service", check_service, findings)
+    if "federation" in selected:
+        timed("federation", check_federation, findings)
     if "distla" in selected:
         timed("distla", check_distla, findings)
     if "encoding" in selected:
@@ -1129,8 +1272,8 @@ def run_gates(only=None):
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
                        "jaxlint-deep", "obs", "obs-live", "regress",
-                       "serve", "service", "distla", "encoding",
-                       "kernels", "data")
+                       "serve", "service", "federation", "distla",
+                       "encoding", "kernels", "data")
            if g in selected])
     return {
         "ok": not findings,
