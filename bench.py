@@ -23,7 +23,7 @@ responsive chip the north-star whole-brain config is attempted first
 (V=65536 correlation width, E=32 — the BASELINE.json scale), then the
 V=8192 mid config, then a reduced CPU fallback.  Each chip tier runs in
 its own subprocess under a timeout so a tunnel wedge mid-tier cannot
-hang the driver's bench invocation.  Six further tiers print their
+hang the driver's bench invocation.  Further tiers print their
 own JSON lines after the FCMA record: ``serve`` (batched
 SRM-transform serving), ``service`` (always-on continuous batching,
 ``brainiak_tpu.serve.service`` — steady-state requests/s AND p99
@@ -41,7 +41,12 @@ over the unfused reference on the same backend), and ``streaming``
 (out-of-core subject-sharded SRM over an on-disk SubjectStore,
 ``brainiak_tpu.data`` — streamed subjects/s AND the prefetch stall
 ratio, the latter ``direction="lower_is_better"`` so a collapsed
-disk/compute overlap fails CI the right way round), each split into
+disk/compute overlap fails CI the right way round), and
+``realtime`` (the closed-loop per-TR tier, ``brainiak_tpu.realtime``
+— a full seeded fmrisim scan through ``RealtimeSession`` with online
+ISC + incremental event segmentation + a warm low-latency
+ServeService hop; per-TR p99 latency AND deadline-miss ratio, BOTH
+``lower_is_better``: the first latency-bound tier), each split into
 an on-chip and a ``*_cpu_fallback`` tier so ``obs regress`` never
 compares host rounds against on-chip baselines.
 
@@ -138,6 +143,21 @@ ENCODING_TRS = 200
 # backend's subject count.
 STREAMING_SUBJECTS = 64
 STREAMING_CPU_SUBJECTS = 24
+
+# realtime tier (closed-loop per-TR streaming, brainiak_tpu.realtime):
+# a full simulated scan from the seeded fmrisim real-time source
+# driven through RealtimeSession — online z-scoring + OnlineISC +
+# incremental event segmentation + a warm low-latency ServeService
+# SRM-scoring hop per TR, against a hard 1 s TR budget.  The gated
+# numbers are the per-TR p99 latency and the deadline-miss ratio
+# (both lower_is_better: this tier is latency-bound, the first such
+# workload class — a throughput win that costs tail latency fails CI
+# the right way round).  BENCH_REALTIME_TRS overrides the scan
+# length.
+REALTIME_TRS = 200
+REALTIME_DEADLINE_S = 1.0
+REALTIME_EVENTS = 12
+REALTIME_REFS = 3
 STREAMING_VOXELS = 4096
 STREAMING_CPU_VOXELS = 1024
 STREAMING_TRS = 150
@@ -494,6 +514,126 @@ def _streaming_result_records(out):
         rec("streaming_prefetch_stall_ratio",
             float(out["stall_ratio"]), "ratio", 0.0,
             direction="lower_is_better"),
+    ]
+
+
+def _realtime_n_trs():
+    """The realtime tier's scan length (``BENCH_REALTIME_TRS``
+    overrides) — one reader, same no-drift rule as the other
+    tiers."""
+    import os
+    return int(os.environ.get("BENCH_REALTIME_TRS", REALTIME_TRS))
+
+
+def realtime_tier_metrics(n_trs, seed=0):
+    """The ``realtime`` tier: a full closed-loop scan off the seeded
+    fmrisim real-time source through
+    :class:`brainiak_tpu.realtime.RealtimeSession` — per TR: online
+    z-scoring, cumulative OnlineISC against a 3-subject reference
+    group, the forward-only incremental event segmentation, and a
+    warm SRM scoring hop through ``ServeService.submit(...,
+    low_latency=True)`` — under the hard ``REALTIME_DEADLINE_S``
+    per-TR budget.  A short warm scan pays every compile first, so
+    the measured scan is the steady state the deadline SLO is about
+    (and runs at zero retraces — asserted, not assumed)."""
+    import jax
+
+    from brainiak_tpu.realtime import (IncrementalEventSegment,
+                                       MemoryFeed, OnlineISC,
+                                       OnlineZScore,
+                                       RealtimeSession)
+    from brainiak_tpu.eventseg.event import EventSegment
+    from brainiak_tpu.serve import BucketPolicy, ModelResidency
+    from brainiak_tpu.serve.__main__ import build_demo_model
+    from brainiak_tpu.serve.service import ServeService
+    from brainiak_tpu.utils.fmrisim_real_time_generator import \
+        generate_stream
+
+    with obs.span("bench.data_gen"):
+        rng = np.random.RandomState(seed)
+        stream = generate_stream({"numTRs": n_trs}, rng=seed)
+        # mask-flattened [T, V] rows via the library's own ingest
+        # path (one flattening convention, not a bench re-implementation)
+        rows = MemoryFeed(stream).rows.astype(np.float32)
+        n_voxels = rows.shape[1]
+        refs = rng.randn(n_trs, n_voxels,
+                         REALTIME_REFS).astype(np.float32)
+        seg_model = EventSegment(n_events=REALTIME_EVENTS)
+        seg_model.set_event_patterns(
+            rng.randn(n_voxels, REALTIME_EVENTS))
+        srm = build_demo_model(n_subjects=2, voxels=n_voxels,
+                               samples=48, features=8, n_iter=2,
+                               seed=seed)
+        residency = ModelResidency(
+            budget_bytes=1 << 30,
+            policy=BucketPolicy(max_batch=16, max_wait_s=2.0))
+        residency.register("m", model=srm)
+
+    def run_scan(trs):
+        session = RealtimeSession(
+            MemoryFeed(rows[:trs]),
+            {"isc": OnlineISC(refs[:trs], dtype=np.float32),
+             "evseg": IncrementalEventSegment(
+                 seg_model, n_trs=trs, var=4.0,
+                 dtype=np.float32)},
+            preprocess=OnlineZScore(n_voxels, dtype=np.float32),
+            deadline_s=REALTIME_DEADLINE_S, service=service,
+            service_model="m", name="bench-realtime")
+        return session.run()
+
+    with ServeService(residency, default_model="m") as service:
+        with obs.span("bench.warm"):
+            # pays every compile; the event chain needs T > K-1
+            run_scan(min(n_trs, 2 * REALTIME_EVENTS))
+        with obs.span("bench.steady"):
+            summary = run_scan(n_trs)
+    retraces = summary["retraces"]
+    if any(count > 1.0 for count in retraces.values()):
+        raise RuntimeError(
+            "realtime bench scan rebuilt step programs "
+            f"({retraces}); refusing to emit a latency number for "
+            "a retracing loop")
+    return {"p99_latency_s": summary["p99_latency_s"],
+            "miss_ratio": summary["deadline_miss_ratio"],
+            "n_misses": summary["n_deadline_misses"],
+            "n_trs": summary["n_trs"],
+            "n_voxels": n_voxels,
+            "deadline_s": REALTIME_DEADLINE_S,
+            "backend": jax.default_backend()}
+
+
+def _realtime_result_records(out):
+    """The realtime tier's bench JSON lines — two records, BOTH
+    ``direction="lower_is_better"`` (the tier is latency-bound):
+    per-TR p99 latency and the deadline-miss ratio.  Tier split
+    mirrors every other tier (``realtime`` on TPU,
+    ``realtime_cpu_fallback`` otherwise)."""
+    tier = "realtime" if out.get("backend") == "tpu" \
+        else "realtime_cpu_fallback"
+    config = {"n_trs": out["n_trs"],
+              "n_voxels": out["n_voxels"],
+              "deadline_s": out["deadline_s"],
+              "n_refs": REALTIME_REFS,
+              "n_events": REALTIME_EVENTS,
+              "backend": out.get("backend")}
+    commit = _git_commit()
+
+    def rec(metric, value, unit, stages=None):
+        r = {"schema_version": BENCH_SCHEMA_VERSION,
+             "metric": metric, "value": round(float(value), 6),
+             "unit": unit, "vs_baseline": 0.0, "tier": tier,
+             "config": config, "direction": "lower_is_better"}
+        if commit:
+            r["git_commit"] = commit
+        if stages:
+            r["stages"] = stages
+        return r
+
+    return [
+        rec("realtime_tr_p99_latency_seconds",
+            out["p99_latency_s"], "s", stages=out.get("stages")),
+        rec("realtime_deadline_miss_ratio", out["miss_ratio"],
+            "ratio"),
     ]
 
 
@@ -1302,6 +1442,16 @@ def measure_tier(tier):
                           else "kernels_cpu_fallback")
             out["stages"] = _stage_seconds(mem.records)
             return out
+        if tier == "realtime":
+            out = realtime_tier_metrics(_realtime_n_trs())
+            # tier split by backend, same rule as every other tier
+            obs.gauge("bench_realtime_tr_p99_seconds",
+                      unit="s").set(
+                          out["p99_latency_s"],
+                          tier="realtime" if out["backend"] == "tpu"
+                          else "realtime_cpu_fallback")
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "streaming":
             out = streaming_tier_metrics(*_streaming_shape())
             # tier split by backend, same rule as every other tier
@@ -1442,6 +1592,7 @@ def main():
     _encoding_main(responsive)
     _kernels_main(responsive)
     _streaming_main(responsive)
+    _realtime_main(responsive)
 
 
 def _aux_tier_main(responsive, tier, record_fn, timeout=420):
@@ -1501,6 +1652,19 @@ def _streaming_main(responsive):
     """Streaming tier: out-of-core subject-sharded SRM — two
     records (streamed subjects/s, prefetch stall ratio)."""
     _aux_tier_main(responsive, "streaming", _streaming_result_records)
+
+
+def _realtime_main(responsive):
+    """Realtime tier: closed-loop per-TR scan — two records (per-TR
+    p99 latency, deadline-miss ratio; both lower-is-better).  A
+    retracing scan refuses to emit numbers without aborting the
+    driver (same rule as the service tier)."""
+    import sys
+    try:
+        _aux_tier_main(responsive, "realtime",
+                       _realtime_result_records)
+    except RuntimeError as exc:
+        print(f"tier realtime: {exc}", file=sys.stderr)
 
 
 def _serve_main(responsive):

@@ -1,4 +1,13 @@
 """Event segmentation (HMM with left-to-right event chains), TPU-native.
 
 Re-design of /root/reference/src/brainiak/eventseg/: the Python
-forward-backward loops become ``lax.scan`` programs."""
+forward-backward loops become ``lax.scan`` programs.
+
+:func:`~brainiak_tpu.eventseg.event.forward_step` is the exposed
+single-step forward recursion — the shared kernel of the batch scan
+and the per-TR streaming estimator
+(:class:`brainiak_tpu.realtime.IncrementalEventSegment`)."""
+
+from .event import EventSegment, forward_step
+
+__all__ = ["EventSegment", "forward_step"]

@@ -1,0 +1,42 @@
+"""Real-time closed-loop tier: per-TR streaming analysis.
+
+Every other workload in the framework is throughput-bound; this tier
+is **latency-bound** — a TR arrives every ~1–2 s and the subject must
+see feedback well inside that window (the neurofeedback scenario,
+ROADMAP item 4).  The pieces:
+
+- :mod:`~brainiak_tpu.realtime.ingest` — the TR-source protocol
+  (:class:`MemoryFeed`, :class:`DirectoryWatcher` over the fmrisim
+  real-time generator's stream, :class:`StoreReplay` off a
+  ``data/`` SubjectStore), with arrival-jitter metrics;
+- :mod:`~brainiak_tpu.realtime.online` — incremental estimators with
+  O(1)-per-TR state (:class:`OnlineZScore`, :class:`OnlineISC`,
+  :class:`IncrementalEventSegment`), each one cached jitted step
+  program (retraces <= 1 per scan, online == batch at every prefix);
+- :mod:`~brainiak_tpu.realtime.loop` — :class:`RealtimeSession`, the
+  deadline-driven closed-loop driver with checkpoint/resume and
+  optional warm :class:`~brainiak_tpu.serve.service.ServeService`
+  scoring through the ``low_latency=True`` submit path.
+
+Gated by RT001 (``tools/run_checks.py``: online-vs-batch parity,
+preempt/resume parity, retrace stability) and the ``realtime`` bench
+tier (per-TR p99 + deadline-miss ratio, both lower-is-better).  See
+docs/realtime.md.
+"""
+
+from .ingest import (DirectoryWatcher, MemoryFeed, StoreReplay,
+                     TRSample, TRSource)
+from .loop import RealtimeSession
+from .online import IncrementalEventSegment, OnlineISC, OnlineZScore
+
+__all__ = [
+    "DirectoryWatcher",
+    "IncrementalEventSegment",
+    "MemoryFeed",
+    "OnlineISC",
+    "OnlineZScore",
+    "RealtimeSession",
+    "StoreReplay",
+    "TRSample",
+    "TRSource",
+]

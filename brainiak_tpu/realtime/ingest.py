@@ -1,0 +1,290 @@
+"""Per-TR ingest: the TR-source protocol of the closed-loop tier.
+
+A **TR source** delivers one flattened ``[V]`` volume per tick of a
+scan, stamped with its host arrival time — the latency clock every
+downstream deadline measures from.  Three sources cover the
+closed-loop lifecycles:
+
+- :class:`MemoryFeed` — an in-memory ``[T, V]`` array (or an
+  iterable of volumes, e.g. the fmrisim generator's
+  :class:`~brainiak_tpu.utils.fmrisim_real_time_generator
+  .RealtimeStream` with a mask), optionally paced at one volume per
+  ``tr_s`` — the simulation/bench mode;
+- :class:`DirectoryWatcher` — polls a directory for the
+  ``rt_<TR>.npy`` files the fmrisim real-time generator CLI writes,
+  yielding each volume as it lands (half-written files are retried,
+  never surfaced) — the scanner-adjacent mode;
+- :class:`StoreReplay` — replays one subject of a
+  :class:`~brainiak_tpu.data.store.SubjectStore` column by column —
+  the archived-scan replay mode.
+
+Every source shares the instrumentation of :class:`TRSource`: a
+``realtime_trs_total{source=}`` counter, and **arrival jitter**
+(observed inter-arrival time minus the nominal TR period) into the
+``realtime_arrival_jitter_seconds`` histogram — the scanner-clock
+health signal a closed-loop operator watches next to the processing
+deadline.  All sources support :meth:`~TRSource.seek`, which is what
+lets a checkpointed :class:`~brainiak_tpu.realtime.RealtimeSession`
+resume mid-scan: the resumed loop seeks the source to the first
+unprocessed TR.
+"""
+
+import os
+import time
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..utils.utils import MonotonicPacer
+
+__all__ = ["DirectoryWatcher", "MemoryFeed", "StoreReplay",
+           "TRSample", "TRSource"]
+
+
+class TRSample:
+    """One ingested TR: the flattened ``[V]`` volume, its scan
+    index, and the host arrival stamp (``time.monotonic`` — the
+    deadline clock's zero for this TR)."""
+
+    __slots__ = ("index", "volume", "t_arrival")
+
+    def __init__(self, index, volume, t_arrival):
+        self.index = int(index)
+        self.volume = volume
+        self.t_arrival = float(t_arrival)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"TRSample(index={self.index}, "
+                f"volume[{self.volume.shape[0]}])")
+
+
+class TRSource:
+    """Base TR source: iteration protocol + arrival instrumentation.
+
+    Subclasses implement :meth:`_read` (volume for one index, or
+    None when the scan is over) and set ``n_trs`` (None for
+    unbounded live streams) and ``tr_s`` (the nominal TR period the
+    jitter metric is measured against; 0 disables jitter — an
+    unpaced replay has no scanner clock to be late against).
+    """
+
+    #: label stamped on this source's metrics
+    source_name = "source"
+
+    def __init__(self, tr_s=0.0, n_trs=None):
+        self.tr_s = float(tr_s)
+        self.n_trs = n_trs
+        self._pos = 0
+        self._last_arrival = None
+        self._pacer = MonotonicPacer(self.tr_s)
+
+    # -- the protocol -------------------------------------------------
+    def _read(self, index):
+        """Volume ``[V]`` for TR ``index``; None = end of scan.
+        Blocking (a live watcher waits for the file) is allowed —
+        the wait is the arrival time the sample stamps."""
+        raise NotImplementedError
+
+    def seek(self, index):
+        """Position the source so the next sample is TR ``index``
+        (the resume contract: a restored session seeks to its
+        checkpoint step).  Forgets the jitter baseline and the
+        pacing schedule — the gap across a preemption is downtime,
+        not scanner jitter."""
+        self._pos = int(index)
+        self._last_arrival = None
+        self._pacer.reset()
+        return self
+
+    def _pace(self):
+        """Hold replayed sources to the scanner period (the shared
+        :class:`~brainiak_tpu.utils.utils.MonotonicPacer` absolute
+        schedule — consumer time counts against the period, pacing
+        never drifts).  No-op for ``tr_s == 0``."""
+        self._pacer.wait()
+
+    def __len__(self):
+        if self.n_trs is None:
+            raise TypeError(f"{type(self).__name__} is unbounded")
+        return int(self.n_trs)
+
+    def next(self):
+        """The next :class:`TRSample`, or None at end of scan."""
+        volume = self._read(self._pos)
+        if volume is None:
+            return None
+        sample = TRSample(self._pos, volume, time.monotonic())
+        self._pos += 1
+        self._observe_arrival(sample)
+        return sample
+
+    def __iter__(self):
+        while True:
+            sample = self.next()
+            if sample is None:
+                return
+            yield sample
+
+    # -- instrumentation ----------------------------------------------
+    def _observe_arrival(self, sample):
+        obs_metrics.counter(
+            "realtime_trs_total",
+            help="TRs ingested by realtime sources").inc(
+                source=self.source_name)
+        last = self._last_arrival
+        self._last_arrival = sample.t_arrival
+        if last is None or self.tr_s <= 0.0:
+            return
+        # jitter = how late (positive) or early (negative) this TR
+        # arrived vs the nominal scanner period; the histogram keeps
+        # the magnitude (sketch-backed quantiles need positives) and
+        # the signed value rides the gauge
+        jitter = (sample.t_arrival - last) - self.tr_s
+        obs_metrics.gauge(
+            "realtime_arrival_jitter_last_seconds", unit="s",
+            help="signed arrival jitter of the latest TR "
+                 "(inter-arrival minus nominal TR)").set(
+                jitter, source=self.source_name)
+        obs_metrics.histogram(
+            "realtime_arrival_jitter_seconds", unit="s",
+            help="absolute arrival jitter per TR").observe(
+                abs(jitter), source=self.source_name)
+
+
+class MemoryFeed(TRSource):
+    """In-memory TR source over a ``[T, V]`` array.
+
+    ``data`` may be a ``[T, V]`` array, a list of ``[V]`` volumes,
+    or an fmrisim :class:`~brainiak_tpu.utils
+    .fmrisim_real_time_generator.RealtimeStream` together with
+    ``mask`` (volumes are flattened through ``mask > 0``).
+    ``tr_s > 0`` paces delivery at one volume per period (sleeping
+    in :meth:`_read`), simulating the scanner clock — and giving the
+    jitter metric something real to measure.
+    """
+
+    source_name = "memory"
+
+    def __init__(self, data, mask=None, tr_s=0.0):
+        if hasattr(data, "brain"):  # RealtimeStream
+            brain = np.asarray(data.brain)
+            if mask is None:
+                mask = np.asarray(data.mask)
+            flat = brain[mask > 0]          # [V, T]
+            rows = np.ascontiguousarray(flat.T)
+        else:
+            rows = np.asarray(data)
+            if rows.ndim != 2:
+                raise ValueError(
+                    "MemoryFeed expects [T, V] data (or a "
+                    f"RealtimeStream); got shape {rows.shape}")
+            if mask is not None:
+                rows = rows[:, np.asarray(mask).ravel() > 0]
+        self.rows = rows
+        super().__init__(tr_s=tr_s, n_trs=rows.shape[0])
+
+    def _read(self, index):
+        if index >= self.rows.shape[0]:
+            return None
+        self._pace()
+        return self.rows[index]
+
+
+class DirectoryWatcher(TRSource):
+    """Watch a directory for the fmrisim generator's ``rt_<TR>.npy``
+    stream, yielding each volume as it lands.
+
+    ``mask`` (array, or the directory's ``mask.npy`` — resolved
+    lazily at the first volume read, so a watcher started before
+    the producer wrote its metadata still picks the mask up)
+    flattens the 3-D volumes to ``[V]``.  A file that exists but
+    fails to load
+    (half-written by the producer) is retried until ``timeout_s``
+    (counted in ``realtime_ingest_retries_total``); timing out —
+    no file, no producer progress — ends the scan when ``n_trs`` is
+    None, or raises :class:`TimeoutError` for a bounded scan that
+    goes quiet mid-way.
+    """
+
+    source_name = "directory"
+
+    def __init__(self, path, mask=None, tr_s=0.0, n_trs=None,
+                 timeout_s=30.0, poll_s=0.02):
+        super().__init__(tr_s=tr_s, n_trs=n_trs)
+        self.path = str(path)
+        self.timeout_s = float(timeout_s)
+        self.poll_s = float(poll_s)
+        # mask=None defers resolution to the FIRST volume read: a
+        # watcher started before the producer finished simulating
+        # must not silently lock in "unmasked" — the generator
+        # writes mask.npy before any rt_*.npy, so once a volume
+        # exists the mask question is settled
+        self._mask_pending = mask is None
+        self.mask = None if mask is None \
+            else (np.asarray(mask) > 0)
+
+    def _resolve_mask(self):
+        if self._mask_pending:
+            mask_path = os.path.join(self.path, "mask.npy")
+            if os.path.exists(mask_path):
+                self.mask = np.load(mask_path) > 0
+            self._mask_pending = False
+
+    def _file_for(self, index):
+        return os.path.join(self.path, f"rt_{index:0>3}.npy")
+
+    def _read(self, index):
+        if self.n_trs is not None and index >= int(self.n_trs):
+            return None
+        deadline = time.monotonic() + self.timeout_s
+        path = self._file_for(index)
+        while True:
+            if os.path.exists(path):
+                try:
+                    vol = np.load(path, allow_pickle=False)
+                except (OSError, ValueError):
+                    # half-written by the producer: retry until the
+                    # write completes (numpy writes the header last
+                    # on some paths, so a partial file raises)
+                    obs_metrics.counter(
+                        "realtime_ingest_retries_total",
+                        help="half-written volume reads retried "
+                             "by the directory watcher").inc(
+                            source=self.source_name)
+                else:
+                    self._resolve_mask()
+                    if self.mask is not None:
+                        vol = np.asarray(vol)[self.mask]
+                    return np.asarray(vol).ravel()
+            if time.monotonic() >= deadline:
+                if self.n_trs is None:
+                    return None  # open-ended scan: quiet = over
+                raise TimeoutError(
+                    f"TR {index} ({path}) did not arrive within "
+                    f"{self.timeout_s}s (scan of {self.n_trs} TRs "
+                    "went quiet)")
+            time.sleep(self.poll_s)
+
+
+class StoreReplay(TRSource):
+    """Replay one subject of an on-disk
+    :class:`~brainiak_tpu.data.store.SubjectStore` TR by TR.
+
+    The subject's ``[V, T]`` array is memmap-friendly
+    (:meth:`SubjectStore.open`), so the replay reads one column per
+    tick rather than the whole scan.  ``tr_s > 0`` paces the replay
+    at the scanner period.
+    """
+
+    source_name = "store"
+
+    def __init__(self, store, subject=0, tr_s=0.0):
+        self._data = store.open(int(subject))  # [V, T]
+        super().__init__(tr_s=tr_s, n_trs=self._data.shape[1])
+
+    def _read(self, index):
+        if index >= self._data.shape[1]:
+            return None
+        self._pace()
+        # one column off the (possibly memmapped) subject
+        return np.asarray(self._data[:, index])

@@ -1,0 +1,446 @@
+"""The closed-loop driver: ingest → estimators → serve, per TR.
+
+:class:`RealtimeSession` pipelines one TR at a time from a
+:class:`~brainiak_tpu.realtime.ingest.TRSource` through an optional
+online preprocessor, a set of incremental estimators
+(:mod:`brainiak_tpu.realtime.online`), and optionally a warm
+classifier/SRM scoring hop through a running
+:class:`~brainiak_tpu.serve.service.ServeService` (submitted
+``low_latency=True`` so a singleton request dispatches on the next
+tick instead of waiting out the batch window), against a **hard
+per-TR deadline**:
+
+- every TR runs under a ``realtime.tr`` span; each stage's wall time
+  feeds a per-stage :class:`~brainiak_tpu.obs.sketch.QuantileSketch`
+  AND the ``realtime_stage_seconds{stage=}`` histogram, so ``/metrics``
+  serves live per-stage p50/p99;
+- a TR whose total latency (arrival → all outputs on host) exceeds
+  ``deadline_s`` emits a ``deadline_exceeded`` record naming the TR
+  and its stage breakdown and increments
+  ``realtime_deadline_miss_total`` — the closed-loop SLO is the miss
+  ratio plus the per-TR p99, both gated ``lower_is_better`` by the
+  ``realtime`` bench tier;
+- with ``checkpoint_dir`` the estimator states checkpoint every
+  ``checkpoint_every`` TRs through
+  :func:`~brainiak_tpu.resilience.guards.run_resilient_loop`; a
+  preempted session re-run with the same arguments **resumes
+  mid-scan**: the source seeks to the first unprocessed TR and the
+  resumed states match an uninterrupted scan (the RT001 resume-parity
+  gate).
+
+Steady-state contract: every estimator advances through ONE cached
+jitted step program, so a whole scan — any length — runs at
+``retrace_total{site=realtime.*} <= 1`` per estimator
+(:meth:`RealtimeSession.summary` reports the live counts).
+"""
+
+import time
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import sink as obs_sink
+from ..obs import spans as obs_spans
+from ..obs.sketch import QuantileSketch
+from ..resilience.guards import run_resilient_loop
+
+__all__ = ["RealtimeSession"]
+
+#: state-dict key separator between estimator name and leaf name
+_KEY_SEP = "."
+
+#: stage names owned by the session itself — estimator names must
+#: not shadow them (outputs, latency sketches, and checkpoint state
+#: are all keyed by stage name)
+_RESERVED_STAGES = frozenset({"preprocess", "serve", "total"})
+
+
+class RealtimeSession:
+    """Drive a closed-loop per-TR analysis over one scan.
+
+    Parameters
+    ----------
+    source : :class:`~brainiak_tpu.realtime.ingest.TRSource`
+        Per-TR volume source (in-memory feed, directory watcher over
+        the fmrisim generator's stream, or a SubjectStore replay).
+        Must support ``seek`` for checkpoint/resume.
+    estimators : dict of name -> online estimator
+        Incremental estimators (the :mod:`~brainiak_tpu.realtime
+        .online` protocol: ``init_state``/``step``).  Names label
+        stages, metrics, and checkpoint state leaves — so they must
+        not contain ``"."``.
+    preprocess : online estimator, optional
+        Runs before the estimators each TR; its first output (e.g.
+        :class:`~brainiak_tpu.realtime.online.OnlineZScore`'s ``z``)
+        replaces the volume the estimators see.  Stage name:
+        ``"preprocess"``.
+    deadline_s : float
+        Hard per-TR latency budget, measured from the sample's host
+        arrival stamp to all outputs fetched.  A miss never aborts
+        the scan — neurofeedback skips a frame, it does not stop the
+        scanner — it is *recorded* (``deadline_exceeded`` event +
+        miss counter) and the loop moves on.
+    service, service_model : optional
+        A started :class:`~brainiak_tpu.serve.service.ServeService`
+        plus the model name to score each TR against (stage
+        ``"serve"``; requests go ``low_latency=True`` with the TR's
+        remaining deadline budget as both the request deadline and
+        the ticket wait).  ``service_request`` customizes the
+        request: a callable ``(tr_index, volume) -> Request``;
+        the default sends ``volume[:, None]`` (one-TR scan) for
+        subject ``service_subject``.
+    name : str
+        Label for checkpoints, spans, and the resilient loop.
+    keep_outputs : int, optional
+        Retain only the most recent N per-TR output dicts (None —
+        the default — keeps the whole scan).  Set for long or
+        open-ended live sessions: the aggregates (``summary()``,
+        the metric histograms) are O(1) regardless, but the raw
+        per-TR outputs are ~the volume size each and would
+        otherwise grow without bound.
+    """
+
+    def __init__(self, source, estimators, preprocess=None,
+                 deadline_s=1.0, service=None, service_model=None,
+                 service_subject=0, service_request=None,
+                 name="realtime", keep_outputs=None):
+        for key in estimators:
+            if _KEY_SEP in key:
+                raise ValueError(
+                    f"estimator name {key!r} must not contain "
+                    f"{_KEY_SEP!r} (it separates checkpoint state "
+                    "leaves)")
+            if key in _RESERVED_STAGES:
+                raise ValueError(
+                    f"estimator name {key!r} is reserved (built-in "
+                    "stage names: "
+                    f"{', '.join(sorted(_RESERVED_STAGES))}) — it "
+                    "would collide with that stage's outputs, "
+                    "timings, and checkpoint state")
+        self.source = source
+        self.estimators = dict(estimators)
+        self.preprocess = preprocess
+        self.deadline_s = float(deadline_s)
+        self.service = service
+        self.service_model = service_model
+        self.service_subject = service_subject
+        self.service_request = service_request
+        self.name = name
+        if keep_outputs is not None and int(keep_outputs) < 1:
+            raise ValueError(
+                f"keep_outputs must be >= 1 or None, got "
+                f"{keep_outputs}")
+        self.keep_outputs = None if keep_outputs is None \
+            else int(keep_outputs)
+        self._outputs = {}       # tr -> output dict (re-runs overwrite)
+        self._sketches = {}      # stage -> QuantileSketch
+        self._n_processed = 0
+        self._n_misses = 0
+        self._source_pos = 0
+        self._slo_snapshot = None  # (step, counts, sketches)
+        # retrace reporting is a DELTA from construction (the
+        # InferenceEngine idiom): a later session in the same
+        # process must not be charged the programs an earlier one
+        # legitimately built
+        self._retrace_base = self._retrace_counts()
+        obs_metrics.gauge(
+            "realtime_deadline_budget_seconds", unit="s",
+            help="per-TR latency budget of the running "
+                 "session").set(self.deadline_s, session=self.name)
+        # pre-register the miss series at 0: a healthy scan must
+        # expose realtime_deadline_miss_total{session=} == 0 on
+        # /metrics (an absent series cannot be alerted on)
+        obs_metrics.counter(
+            "realtime_deadline_miss_total",
+            help="TRs whose processing exceeded the per-TR "
+                 "deadline").inc(0, session=self.name)
+
+    # -- state plumbing -----------------------------------------------
+    def _stages(self):
+        names = []
+        if self.preprocess is not None:
+            names.append("preprocess")
+        names.extend(self.estimators)
+        if self.service is not None:
+            names.append("serve")
+        return names
+
+    def _init_state(self):
+        state = {}
+        if self.preprocess is not None:
+            for leaf, value in self.preprocess.init_state().items():
+                state[f"preprocess{_KEY_SEP}{leaf}"] = value
+        for est_name, est in self.estimators.items():
+            for leaf, value in est.init_state().items():
+                state[f"{est_name}{_KEY_SEP}{leaf}"] = value
+        return state
+
+    @staticmethod
+    def _slice_state(state, prefix):
+        head = prefix + _KEY_SEP
+        return {key[len(head):]: value
+                for key, value in state.items()
+                if key.startswith(head)}
+
+    @staticmethod
+    def _merge_state(state, prefix, sub):
+        for leaf, value in sub.items():
+            state[f"{prefix}{_KEY_SEP}{leaf}"] = value
+
+    def _fingerprint(self, n_trs):
+        names = sorted(self.estimators)
+        base = [float(n_trs), float(len(names)),
+                float(sum((i + 1) * sum(map(ord, n))
+                          for i, n in enumerate(names))),
+                float(0 if self.preprocess is None else 1),
+                float(0 if self.service is None else 1)]
+        # per-estimator configuration digests (sorted by name):
+        # same shapes + names but DIFFERENT parameters (reference
+        # group, event patterns) must refuse a checkpoint, not
+        # silently mix runs.  An estimator without config_digest
+        # contributes 0 (checked by name/count only).
+        for name in names:
+            digest = getattr(self.estimators[name],
+                             "config_digest", None)
+            base.append(float(digest()) if callable(digest)
+                        else 0.0)
+        pre = getattr(self.preprocess, "config_digest", None)
+        base.append(float(pre()) if callable(pre) else 0.0)
+        return np.array(base)
+
+    # -- instrumentation ----------------------------------------------
+    def _restore_or_snapshot_slo(self, step):
+        """Chunk-entry SLO-accounting snapshot: a guard rollback
+        re-runs the chunk deterministically, and the replayed TRs
+        must not inflate the gated numbers (n_trs, miss ratio, the
+        latency percentiles).  The process-global ``realtime_*``
+        metric counters stay monotonic (Prometheus semantics — a
+        rollback shows up there as the extra work it really was);
+        only this session's summary() is de-duplicated."""
+        if self._slo_snapshot is not None \
+                and self._slo_snapshot[0] == step:
+            _, n_processed, n_misses, sketches = self._slo_snapshot
+            self._n_processed = n_processed
+            self._n_misses = n_misses
+            self._sketches = {
+                stage: QuantileSketch.from_dict(payload)
+                for stage, payload in sketches.items()}
+        self._slo_snapshot = (
+            step, self._n_processed, self._n_misses,
+            {stage: sketch.to_dict()
+             for stage, sketch in self._sketches.items()})
+
+    def _observe_stage(self, stage, seconds):
+        self._sketches.setdefault(stage, QuantileSketch()).observe(
+            max(seconds, 0.0))
+        obs_metrics.histogram(
+            "realtime_stage_seconds", unit="s",
+            help="per-TR wall time of each closed-loop "
+                 "stage").observe(max(seconds, 0.0), stage=stage,
+                                  session=self.name)
+
+    # -- the per-TR pipeline ------------------------------------------
+    def _process_tr(self, sample, state):
+        tr = sample.index
+        stage_s = {}
+        with obs_spans.span("realtime.tr",
+                            attrs={"tr": int(tr),
+                                   "session": self.name}) as frame:
+            out = {"tr": int(tr)}
+            volume = sample.volume
+            t0 = time.perf_counter()
+            if self.preprocess is not None:
+                sub = self._slice_state(state, "preprocess")
+                sub, pre_out = self.preprocess.step(sub, volume)
+                # first output is the transformed volume; fetch it
+                # (the fetch is the sync that makes the stage time
+                # real, not an async-dispatch enqueue time)
+                first = next(iter(pre_out.values()))
+                volume = np.asarray(first)
+                self._merge_state(state, "preprocess", sub)
+                stage_s["preprocess"] = time.perf_counter() - t0
+            for est_name, est in self.estimators.items():
+                t1 = time.perf_counter()
+                sub = self._slice_state(state, est_name)
+                sub, est_out = est.step(sub, volume)
+                out[est_name] = {key: np.asarray(value)
+                                 for key, value in est_out.items()}
+                self._merge_state(state, est_name, sub)
+                stage_s[est_name] = time.perf_counter() - t1
+            if self.service is not None:
+                stage_s["serve"] = self._serve_stage(
+                    sample, volume, out)
+            latency = time.monotonic() - sample.t_arrival
+            out["latency_s"] = latency
+            miss = latency > self.deadline_s
+            out["deadline_miss"] = miss
+            frame.set("latency_s", round(latency, 6))
+            frame.set("deadline_miss", miss)
+        for stage, seconds in stage_s.items():
+            self._observe_stage(stage, seconds)
+        self._observe_stage("total", latency)
+        obs_metrics.histogram(
+            "realtime_tr_latency_seconds", unit="s",
+            help="arrival-to-outputs latency per TR").observe(
+                latency, session=self.name)
+        if miss:
+            self._n_misses += 1
+            obs_metrics.counter(
+                "realtime_deadline_miss_total",
+                help="TRs whose processing exceeded the per-TR "
+                     "deadline").inc(session=self.name)
+            obs_sink.event(
+                "deadline_exceeded", session=self.name, tr=int(tr),
+                latency_s=round(latency, 6),
+                deadline_s=self.deadline_s,
+                stages={stage: round(seconds, 6)
+                        for stage, seconds in stage_s.items()})
+        self._n_processed += 1
+        self._outputs[tr] = out
+        if self.keep_outputs is not None:
+            while len(self._outputs) > self.keep_outputs:
+                self._outputs.pop(min(self._outputs))
+        return state
+
+    def _serve_stage(self, sample, volume, out):
+        from ..serve.batching import Request
+
+        t2 = time.perf_counter()
+        remaining = self.deadline_s - (time.monotonic()
+                                       - sample.t_arrival)
+        budget = max(remaining, 1e-3)
+        if self.service_request is not None:
+            request = self.service_request(sample.index, volume)
+        else:
+            request = Request(
+                request_id=f"{self.name}-tr{sample.index}",
+                x=np.asarray(volume)[:, None],
+                subject=self.service_subject,
+                model=self.service_model)
+        request.deadline_s = budget
+        ticket = self.service.submit(request,
+                                     model=self.service_model,
+                                     low_latency=True)
+        try:
+            record = ticket.result(timeout=budget)
+        except TimeoutError:
+            # the deadline accounting below records the miss; the
+            # abandoned ticket still resolves (exactly-one-record
+            # contract) — it is just too late to matter
+            out["serve"] = None
+            out["serve_timeout"] = True
+        else:
+            out["serve"] = record.result if record.ok else None
+            if not record.ok:
+                out["serve_error"] = record.error
+        return time.perf_counter() - t2
+
+    # -- driving ------------------------------------------------------
+    def run(self, n_trs=None, checkpoint_dir=None,
+            checkpoint_every=25):
+        """Process the scan; returns :meth:`summary`.
+
+        ``n_trs`` defaults to ``len(source)``; a source that ends
+        early simply ends the scan.  With ``checkpoint_dir`` the
+        estimator states are persisted every ``checkpoint_every``
+        TRs and a later call with the same arguments resumes at the
+        first unprocessed TR (the source is ``seek``-ed there) —
+        outputs before the resume point are not re-emitted, but the
+        resumed states (and every output after) match an
+        uninterrupted scan.
+        """
+        if n_trs is None:
+            n_trs = len(self.source)
+        n_trs = int(n_trs)
+        self._source_pos = None  # force the first seek
+
+        def run_chunk(state, step, n_steps):
+            # shallow-copy: _process_tr rebinds leaves on this dict,
+            # and the resilient loop's rollback snapshot must keep
+            # the chunk-entry state intact
+            state = dict(state)
+            # a guard rollback re-runs this chunk from the same
+            # step; restore the SLO accounting (TR/miss counts,
+            # latency sketches) to its chunk-entry snapshot so the
+            # replayed TRs are not double-counted in summary()
+            self._restore_or_snapshot_slo(step)
+            if self._source_pos != step:
+                self.source.seek(step)
+                self._source_pos = step
+            for _ in range(n_steps):
+                sample = self.source.next()
+                if sample is None:
+                    return state, True  # scan ended early
+                state = self._process_tr(sample, state)
+                self._source_pos = sample.index + 1
+            return state, False
+
+        state, _ = run_resilient_loop(
+            run_chunk, self._init_state(), n_trs,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            fingerprint=self._fingerprint(n_trs),
+            name=self.name, guard_nan_only=True)
+        self._final_state = state
+        return self.summary()
+
+    # -- reporting ----------------------------------------------------
+    @property
+    def outputs(self):
+        """Per-TR output dicts, in TR order (this process's TRs —
+        a resumed session holds the TRs after the resume point)."""
+        return [self._outputs[tr] for tr in sorted(self._outputs)]
+
+    def estimator_state(self, name):
+        """Final state leaves of one estimator after :meth:`run`
+        (host arrays)."""
+        return {leaf: np.asarray(value) for leaf, value
+                in self._slice_state(self._final_state,
+                                     name).items()}
+
+    @staticmethod
+    def _retrace_counts():
+        sites = {}
+        for labels, value in obs_metrics.counter(
+                "retrace_total").samples():
+            site = str(labels.get("site", ""))
+            if site.startswith("realtime."):
+                sites[site] = value
+        return sites
+
+    def retraces(self):
+        """``retrace_total{site=realtime.*}`` growth SINCE this
+        session was constructed — the steady-state zero-retrace
+        contract, readable mid-scan.  A delta, not the process
+        total: programs an earlier session in the same process
+        built (one per shape, by design) are not charged to this
+        one."""
+        return {site: value - self._retrace_base.get(site, 0.0)
+                for site, value in self._retrace_counts().items()}
+
+    def summary(self):
+        """Scan-level aggregate: TRs processed, per-stage and total
+        latency percentiles, deadline misses, and the realtime
+        retrace counts."""
+        stages = {}
+        for stage, sketch in self._sketches.items():
+            stages[stage] = {
+                "count": sketch.count,
+                "p50_s": sketch.quantile(0.50),
+                "p99_s": sketch.quantile(0.99),
+                "max_s": sketch.max,
+            }
+        return {
+            "session": self.name,
+            "n_trs": self._n_processed,
+            "n_deadline_misses": self._n_misses,
+            "deadline_miss_ratio": (
+                self._n_misses / self._n_processed
+                if self._n_processed else 0.0),
+            "deadline_s": self.deadline_s,
+            "stages": stages,
+            "p99_latency_s": (
+                self._sketches["total"].quantile(0.99)
+                if "total" in self._sketches else None),
+            "retraces": self.retraces(),
+        }

@@ -1,0 +1,505 @@
+"""Incremental per-TR estimators with O(1)-per-TR state.
+
+The closed-loop tier cannot re-run a batch estimator per TR — at TR
+``t`` that costs O(t) and the per-TR latency grows through the scan
+until the deadline breaks.  Every estimator here advances **constant
+state** by one jitted step per TR, and the step is built exactly once
+per (shape, config) through a ``counted_cache`` builder, so a whole
+scan runs at **retraces <= 1 per estimator** (the RT001 gate's
+runtime contract):
+
+- :class:`OnlineZScore` — per-voxel running z-scoring via Welford
+  moments (count, mean ``[V]``, M2 ``[V]``); at TR ``t`` emits the
+  volume standardized against the running prefix moments — exactly
+  ``(x_t - mean(X[:t+1])) / std(X[:t+1], ddof=1)``;
+- :class:`OnlineISC` — intersubject correlation of the live subject
+  against a reference group from rolling sufficient statistics
+  (sums, squares, cross-products): leave-one-out (vs the reference
+  mean time course — row 0 of :func:`brainiak_tpu.isc.isc` on the
+  stacked prefix) or pairwise (vs each reference subject),
+  cumulative and optionally windowed (ring buffer of the last ``W``
+  TRs, still O(V·R) work per TR);
+- :class:`IncrementalEventSegment` — forward-only HMM event
+  segmentation carrying ONLY the scaled log-alpha row ``[K+1]`` from
+  the fused batch scan's :func:`~brainiak_tpu.eventseg.event
+  .forward_step` (no backward pass, nothing O(T)); each TR emits the
+  current-event posterior given the data so far, equal to the batch
+  forward pass's scaled alpha at every prefix.
+
+Shared protocol (duck-typed; :class:`~brainiak_tpu.realtime
+.RealtimeSession` drives it): ``init_state() -> dict`` of named
+arrays (flat — checkpointable by
+:func:`~brainiak_tpu.resilience.guards.run_resilient_loop`),
+``step(state, volume) -> (state, outputs)`` with ``outputs`` a dict
+of device arrays, and ``state_nbytes`` for capacity planning (the
+state-size table in docs/realtime.md).
+"""
+
+import numpy as np
+
+from ..obs import profile as obs_profile
+from ..obs import runtime as obs_runtime
+
+__all__ = ["IncrementalEventSegment", "OnlineISC", "OnlineZScore"]
+
+
+def _canonical_dtype(dtype):
+    """The estimator state dtype: ``None`` means jax's canonical
+    float (float32, or float64 under ``jax_enable_x64`` — so parity
+    tests run at full precision and the TPU path stays fp32 without
+    a silent downcast)."""
+    import jax.numpy as jnp
+    if dtype is None:
+        return jnp.zeros(0).dtype
+    return jnp.asarray(np.zeros(0, dtype=dtype)).dtype
+
+
+# ---------------------------------------------------------------------------
+# online z-scoring (Welford moments)
+
+def _zscore_step_core(n, mean, m2, x):
+    import jax.numpy as jnp
+    n1 = n + 1.0
+    delta = x - mean
+    mean1 = mean + delta / n1
+    m21 = m2 + delta * (x - mean1)
+    var = m21 / jnp.maximum(n1 - 1.0, 1.0)
+    std = jnp.sqrt(var)
+    z = jnp.where(std > 0, (x - mean1) / std, 0.0)
+    return n1, mean1, m21, z
+
+
+@obs_runtime.counted_cache("realtime.zscore_step")
+def _zscore_program(v, dtype):
+    """The jitted Welford step for one (V, dtype) — built once per
+    scan shape; misses count as
+    ``retrace_total{site=realtime.zscore_step}``."""
+    import jax
+    del v, dtype  # cache key only: shapes specialize inside jit
+    return obs_profile.profile_program(
+        jax.jit(_zscore_step_core), "realtime.zscore_step",
+        span="realtime.tr")
+
+
+class OnlineZScore:
+    """Per-voxel running z-score: Welford moments in O(V) state.
+
+    At TR ``t`` the emitted volume equals the batch
+    ``(x_t - mean(X[:t+1])) / std(X[:t+1], ddof=1)`` (constant
+    voxels emit 0; the first TR emits 0 everywhere — a 1-sample
+    std is undefined).  The state is 2 ``[V]`` arrays + a scalar.
+    """
+
+    def __init__(self, n_voxels, dtype=None):
+        self.n_voxels = int(n_voxels)
+        self.dtype = _canonical_dtype(dtype)
+
+    def init_state(self):
+        v = self.n_voxels
+        return {"n": np.zeros((), dtype=np.float64),
+                "mean": np.zeros(v, dtype=self.dtype),
+                "m2": np.zeros(v, dtype=self.dtype)}
+
+    def config_digest(self):
+        """Configuration digest folded into the session checkpoint
+        fingerprint (resuming under a different configuration must
+        refuse, not silently mix)."""
+        return float(self.n_voxels)
+
+    @property
+    def state_nbytes(self):
+        return 8 + 2 * self.n_voxels * self.dtype.itemsize
+
+    def step(self, state, volume):
+        import jax.numpy as jnp
+        program = _zscore_program(self.n_voxels, str(self.dtype))
+        n, mean, m2, z = program(
+            jnp.asarray(np.asarray(state["n"]), dtype=self.dtype),
+            jnp.asarray(state["mean"], dtype=self.dtype),
+            jnp.asarray(state["m2"], dtype=self.dtype),
+            jnp.asarray(volume, dtype=self.dtype))
+        return ({"n": n, "mean": mean, "m2": m2},
+                {"z": z})
+
+
+# ---------------------------------------------------------------------------
+# online ISC (rolling sufficient statistics)
+
+def _pearson_from_sums(n, sx, sy, sxx, syy, sxy):
+    """Pearson r per (voxel, reference) from running sums.
+
+    sx/sxx: [V]; sy/syy/sxy: [V, R] -> [V, R].  Undefined
+    correlations (fewer than 2 samples, constant series) are NaN —
+    the same convention as the batch :func:`brainiak_tpu.isc.isc`.
+    """
+    import jax.numpy as jnp
+    num = n * sxy - sx[:, None] * sy
+    den_x = n * sxx - sx * sx
+    den_y = n * syy - sy * sy
+    den = jnp.sqrt(jnp.maximum(den_x[:, None], 0.0)
+                   * jnp.maximum(den_y, 0.0))
+    return jnp.where((den > 0) & (n > 1), num / den, jnp.nan)
+
+
+def _isc_step_cum_core(n, x0, y0, sx, sxx, sy, syy, sxy, x, y):
+    """Advance the cumulative sufficient statistics by one TR.
+
+    The sums are of SHIFTED samples ``x - x0`` / ``y - y0`` with the
+    first TR as the anchor: Pearson r is shift-invariant, and the
+    raw-moment formula ``n*sxx - sx*sx`` on unshifted fMRI
+    intensities (mean >> std) would cancel catastrophically in
+    float32 — the anchored moments keep the subtraction at the
+    signal's own scale.
+    """
+    import jax.numpy as jnp
+    first = n == 0
+    x01 = jnp.where(first, x, x0)
+    y01 = jnp.where(first, y, y0)
+    xs = x - x01
+    ys = y - y01
+    n1 = n + 1.0
+    sx1 = sx + xs
+    sxx1 = sxx + xs * xs
+    sy1 = sy + ys
+    syy1 = syy + ys * ys
+    sxy1 = sxy + xs[:, None] * ys
+    corr = _pearson_from_sums(n1, sx1, sy1, sxx1, syy1, sxy1)
+    return (n1, x01, y01, sx1, sxx1, sy1, syy1, sxy1), corr
+
+
+def _make_isc_step_core(window):
+    """Step core for one static window size (0 = cumulative only).
+
+    The windowed half keeps a ring buffer of the subject's last
+    ``window`` volumes (anchor-shifted, like every moment here —
+    see :func:`_isc_step_cum_core`); the reference rows leaving the
+    window are supplied by the host (the estimator holds the full
+    reference array), so the windowed sufficient statistics
+    subtract the outgoing (x, y) pair exactly.
+    """
+    import jax.numpy as jnp
+
+    if not window:
+        def core(n, x0, y0, sx, sxx, sy, syy, sxy, x, y):
+            state, corr = _isc_step_cum_core(
+                n, x0, y0, sx, sxx, sy, syy, sxy, x, y)
+            return state + (corr,)
+        return core
+
+    w = int(window)
+
+    def core(n, x0, y0, sx, sxx, sy, syy, sxy, xbuf,
+             wsx, wsxx, wsy, wsyy, wsxy, x, y, y_out, t):
+        (n1, x01, y01, sx1, sxx1, sy1, syy1, sxy1), corr = \
+            _isc_step_cum_core(n, x0, y0, sx, sxx, sy, syy, sxy,
+                               x, y)
+        xs = x - x01
+        ys = y - y01
+        slot = jnp.mod(t, w)
+        full = t >= w
+        x_out = jnp.where(full, xbuf[slot], 0.0)
+        yo = jnp.where(full, y_out - y01, 0.0)
+        wsx1 = wsx + xs - x_out
+        wsxx1 = wsxx + xs * xs - x_out * x_out
+        wsy1 = wsy + ys - yo
+        wsyy1 = wsyy + ys * ys - yo * yo
+        wsxy1 = wsxy + xs[:, None] * ys - x_out[:, None] * yo
+        xbuf1 = xbuf.at[slot].set(xs)
+        wn = jnp.minimum(n1, float(w))
+        wcorr = _pearson_from_sums(wn, wsx1, wsy1, wsxx1, wsyy1,
+                                   wsxy1)
+        return (n1, x01, y01, sx1, sxx1, sy1, syy1, sxy1, xbuf1,
+                wsx1, wsxx1, wsy1, wsyy1, wsxy1, corr, wcorr)
+
+    return core
+
+
+@obs_runtime.counted_cache("realtime.isc_step")
+def _isc_program(v, r, window, dtype):
+    """The jitted ISC sufficient-statistics step for one
+    (V, R, window, dtype) — built once per scan configuration."""
+    import jax
+    del v, r, dtype  # cache key only
+    return obs_profile.profile_program(
+        jax.jit(_make_isc_step_core(window)), "realtime.isc_step",
+        span="realtime.tr")
+
+
+class OnlineISC:
+    """Streaming intersubject correlation against a reference group.
+
+    Parameters
+    ----------
+    references : array
+        Reference group time courses, ``[T, V, R]`` (brainiak's
+        time-major convention) or ``[T, V]`` for a single reference.
+        Held in full by the estimator (the references are a fitted
+        artifact, not streaming state); the per-TR state is the
+        rolling sufficient statistics only.
+    pairwise : bool
+        False (default): leave-one-out — correlate the live subject
+        with the MEAN reference time course; at every prefix this
+        equals row 0 of the batch ``isc(stack([subject] + refs))``.
+        True: one correlation per reference subject — the
+        ``(0, j)`` rows of the batch pairwise ISC.
+    window : int
+        0 (default): cumulative only.  ``W > 0`` additionally
+        maintains a rolling window of the last ``W`` TRs
+        (``isc_windowed`` output) — the recency-sensitive signal a
+        neurofeedback display shows.
+
+    Per-TR outputs: ``isc`` (``[V]`` leave-one-out, ``[V, R]``
+    pairwise) and, with a window, ``isc_windowed``.
+    """
+
+    def __init__(self, references, pairwise=False, window=0,
+                 dtype=None):
+        import jax.numpy as jnp
+        refs = np.asarray(references, dtype=float)
+        if refs.ndim == 2:
+            refs = refs[:, :, None]
+        if refs.ndim != 3:
+            raise ValueError(
+                "references must be [T, V, R] or [T, V]; got shape "
+                f"{refs.shape}")
+        self.pairwise = bool(pairwise)
+        self.window = int(window or 0)
+        if self.window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
+        # leave-one-out reduces the references to their mean time
+        # course once, up front — the per-TR y row is then [V, 1]
+        self._y_rows = refs if self.pairwise \
+            else refs.mean(axis=2, keepdims=True)
+        self.n_trs, self.n_voxels, self.n_refs = self._y_rows.shape
+        self.dtype = _canonical_dtype(dtype)
+        self._y_dev = jnp.asarray(self._y_rows, dtype=self.dtype)
+
+    def init_state(self):
+        v, r = self.n_voxels, self.n_refs
+        dt = self.dtype
+        state = {"n": np.zeros((), dtype=np.float64),
+                 "x0": np.zeros(v, dtype=dt),
+                 "y0": np.zeros((v, r), dtype=dt),
+                 "sx": np.zeros(v, dtype=dt),
+                 "sxx": np.zeros(v, dtype=dt),
+                 "sy": np.zeros((v, r), dtype=dt),
+                 "syy": np.zeros((v, r), dtype=dt),
+                 "sxy": np.zeros((v, r), dtype=dt)}
+        if self.window:
+            state.update({
+                "xbuf": np.zeros((self.window, v), dtype=dt),
+                "wsx": np.zeros(v, dtype=dt),
+                "wsxx": np.zeros(v, dtype=dt),
+                "wsy": np.zeros((v, r), dtype=dt),
+                "wsyy": np.zeros((v, r), dtype=dt),
+                "wsxy": np.zeros((v, r), dtype=dt)})
+        return state
+
+    def config_digest(self):
+        """Content digest of the reference group + mode knobs: a
+        resumed session over DIFFERENT references (same shapes)
+        must refuse the checkpoint, not mix two groups' sufficient
+        statistics."""
+        from ..resilience.guards import array_digest
+        return (array_digest(self._y_rows)
+                + 7.0 * self.window
+                + (13.0 if self.pairwise else 0.0))
+
+    @property
+    def state_nbytes(self):
+        v, r, item = self.n_voxels, self.n_refs, self.dtype.itemsize
+        n = 8 + (3 * v + 4 * v * r) * item
+        if self.window:
+            n += (self.window * v + 2 * v + 3 * v * r) * item
+        return n
+
+    def _squeeze(self, corr):
+        return corr[:, 0] if not self.pairwise else corr
+
+    def step(self, state, volume):
+        import jax.numpy as jnp
+        program = _isc_program(self.n_voxels, self.n_refs,
+                               self.window, str(self.dtype))
+        t = int(np.asarray(state["n"]))
+        if t >= self.n_trs:
+            raise ValueError(
+                f"OnlineISC was built for {self.n_trs} reference "
+                f"TRs; TR {t} is past the end")
+        dt = self.dtype
+        x = jnp.asarray(volume, dtype=dt)
+        y = self._y_dev[t]
+        args = [jnp.asarray(np.asarray(state["n"]), dtype=dt)] + [
+            jnp.asarray(state[k], dtype=dt)
+            for k in ("x0", "y0", "sx", "sxx", "sy", "syy", "sxy")]
+        if not self.window:
+            out = program(*args, x, y)
+            n1, x0, y0, sx, sxx, sy, syy, sxy, corr = out
+            new_state = {"n": n1, "x0": x0, "y0": y0, "sx": sx,
+                         "sxx": sxx, "sy": sy, "syy": syy,
+                         "sxy": sxy}
+            return new_state, {"isc": self._squeeze(corr)}
+        args += [jnp.asarray(state[k], dtype=dt)
+                 for k in ("xbuf", "wsx", "wsxx", "wsy", "wsyy",
+                           "wsxy")]
+        y_out = self._y_dev[t - self.window] if t >= self.window \
+            else jnp.zeros_like(y)
+        out = program(*args, x, y, y_out,
+                      jnp.asarray(t, dtype=jnp.int32))
+        (n1, x0, y0, sx, sxx, sy, syy, sxy, xbuf, wsx, wsxx, wsy,
+         wsyy, wsxy, corr, wcorr) = out
+        new_state = {"n": n1, "x0": x0, "y0": y0, "sx": sx,
+                     "sxx": sxx, "sy": sy, "syy": syy, "sxy": sxy,
+                     "xbuf": xbuf, "wsx": wsx, "wsxx": wsxx,
+                     "wsy": wsy, "wsyy": wsyy, "wsxy": wsxy}
+        return new_state, {"isc": self._squeeze(corr),
+                           "isc_windowed": self._squeeze(wcorr)}
+
+
+# ---------------------------------------------------------------------------
+# incremental event segmentation (forward pass only)
+
+def _zscore_columns(mat):
+    """Column-wise spatial z-scoring, the exact normalization the
+    batch ``_logprob_obs_core`` applies to the event patterns."""
+    import jax.numpy as jnp
+    return (mat - jnp.mean(mat, axis=0)) \
+        / jnp.std(mat, axis=0, ddof=1)
+
+
+def _evseg_step_core(alpha, t, ll, x, mp_z, mp_sq, var, log_P,
+                     log_p_start):
+    import jax
+    import jax.numpy as jnp
+
+    from ..eventseg.event import forward_step
+
+    v = x.shape[0]
+    # per-TR spatial z-scoring: identical to the batch
+    # _logprob_obs_core, whose column-wise mean/std make every TR's
+    # observation row independent of the rest of the scan.  A
+    # constant volume (TR 0 of an online-z-scored stream is all
+    # zeros) z-scores to zeros instead of NaN: the posterior then
+    # follows the prior for that TR rather than poisoning the
+    # forward row for the rest of the scan.  The patterns' z-score
+    # (``mp_z``) and squared norms (``mp_sq``) are scan constants,
+    # precomputed once by the estimator — not re-derived per TR on
+    # the deadline-bound path.
+    x_std = jnp.std(x, ddof=1)
+    xz = jnp.where(x_std > 0, (x - jnp.mean(x)) / x_std, 0.0)
+    sq = jnp.sum(xz ** 2) - 2.0 * xz @ mp_z + mp_sq
+    lp = (-0.5 * v * jnp.log(2 * jnp.pi * var)
+          - 0.5 * sq / var) / v
+    lp_ext = jnp.concatenate(
+        [lp, jnp.full((1,), -jnp.inf, lp.dtype)])
+    stepped, step_scale = forward_step(alpha, lp_ext, log_P)
+    # TR 0 starts the chain from the start prior instead of a
+    # transition out of a previous row (one program for both cases:
+    # is_first is a traced predicate, never a retrace)
+    first = log_p_start + lp_ext
+    first_scale = jax.nn.logsumexp(first)
+    is_first = t == 0
+    new_alpha = jnp.where(is_first, first - first_scale, stepped)
+    scale = jnp.where(is_first, first_scale, step_scale)
+    return (new_alpha, t + 1, ll + scale,
+            jnp.exp(new_alpha))
+
+
+@obs_runtime.counted_cache("realtime.evseg_step")
+def _evseg_program(v, k, dtype):
+    """The jitted forward-only event-segmentation step for one
+    (V, K, dtype) — built once per scan configuration."""
+    import jax
+    del v, k, dtype  # cache key only
+    return obs_profile.profile_program(
+        jax.jit(_evseg_step_core), "realtime.evseg_step",
+        span="realtime.tr")
+
+
+class IncrementalEventSegment:
+    """Forward-only streaming event segmentation.
+
+    Wraps a fitted (or pattern-set)
+    :class:`~brainiak_tpu.eventseg.event.EventSegment`: per TR it
+    advances ONLY the scaled log-alpha row of the batch model's
+    fused forward scan (through the shared
+    :func:`~brainiak_tpu.eventseg.event.forward_step`) and emits the
+    current-event posterior given the data so far.  No backward
+    pass, no ``[T, K]`` arrays — O(K) state, O(V·K) work per TR.
+
+    ``n_trs`` fixes the expected scan length: the left-to-right
+    transition probability is ``(K-1)/T``, so the batch model's
+    transitions — and therefore prefix-parity with its forward pass
+    — are defined by the full scan length, not the prefix.
+
+    Per-TR outputs: ``log_alpha`` (``[K+1]`` scaled — equal to the
+    batch forward pass's row at this prefix), ``posterior``
+    (``exp(log_alpha)``; entry K is the past-the-last-event sink),
+    and the running forward log-evidence rides the state (``ll`` —
+    the batch log-likelihood without the end-state prior).
+    """
+
+    def __init__(self, model, n_trs, var=None, dtype=None):
+        import jax.numpy as jnp
+        if not hasattr(model, "event_pat_"):
+            raise ValueError(
+                "model has no event patterns; fit() it or call "
+                "set_event_patterns() first")
+        if var is None:
+            if not hasattr(model, "event_var_"):
+                raise ValueError(
+                    "var= is required when the model was not "
+                    "fit() (set_event_patterns sets no variance)")
+            var = model.event_var_
+        self.n_trs = int(n_trs)
+        self.n_events = int(model.n_events)
+        pat = np.asarray(model.event_pat_, dtype=float)
+        self.n_voxels = pat.shape[0]
+        var = np.broadcast_to(
+            np.asarray(var, dtype=float), (self.n_events,))
+        log_P, log_p_start, _ = model._build_transitions(self.n_trs)
+        self.dtype = _canonical_dtype(dtype)
+        dt = self.dtype
+        self._mean_pat = jnp.asarray(pat, dtype=dt)
+        # scan constants: z-scored patterns + their squared norms
+        # (the same jnp ops the batch path applies, so prefix
+        # parity is preserved bit-for-bit)
+        self._mp_z = _zscore_columns(self._mean_pat)
+        self._mp_sq = jnp.sum(self._mp_z ** 2, axis=0)
+        self._var = jnp.asarray(var, dtype=dt)
+        self._log_P = jnp.asarray(log_P, dtype=dt)
+        self._log_p_start = jnp.asarray(log_p_start, dtype=dt)
+
+    def init_state(self):
+        k = self.n_events
+        return {"alpha": np.zeros(k + 1, dtype=self.dtype),
+                "t": np.zeros((), dtype=np.int32),
+                "ll": np.zeros((), dtype=self.dtype)}
+
+    def config_digest(self):
+        """Content digest of the event patterns + variance + scan
+        length: resuming against a differently-parameterized model
+        must refuse the checkpoint."""
+        from ..resilience.guards import array_digest
+        return (array_digest(np.asarray(self._mean_pat),
+                             np.asarray(self._var))
+                + 7.0 * self.n_trs)
+
+    @property
+    def state_nbytes(self):
+        return (self.n_events + 1) * self.dtype.itemsize + 4 \
+            + self.dtype.itemsize
+
+    def step(self, state, volume):
+        import jax.numpy as jnp
+        program = _evseg_program(self.n_voxels, self.n_events,
+                                 str(self.dtype))
+        dt = self.dtype
+        alpha, t, ll, posterior = program(
+            jnp.asarray(np.asarray(state["alpha"]), dtype=dt),
+            jnp.asarray(np.asarray(state["t"]), dtype=jnp.int32),
+            jnp.asarray(np.asarray(state["ll"]), dtype=dt),
+            jnp.asarray(volume, dtype=dt),
+            self._mp_z, self._mp_sq, self._var, self._log_P,
+            self._log_p_start)
+        return ({"alpha": alpha, "t": t, "ll": ll},
+                {"log_alpha": alpha, "posterior": posterior})

@@ -1,0 +1,172 @@
+"""CI selfcheck for the realtime closed-loop tier (RT001 gate).
+
+Run as a subprocess child by ``tools/run_checks.py``; proves the
+tier's three contracts:
+
+1. **online == batch** — :class:`~brainiak_tpu.realtime.OnlineISC`'s
+   cumulative correlation matches :func:`brainiak_tpu.isc.isc` on the
+   stacked prefix at EVERY TR, and
+   :class:`~brainiak_tpu.realtime.IncrementalEventSegment`'s scaled
+   log-alpha matches the fused batch forward pass at every prefix
+   (both ~1e-6);
+2. **resume-mid-scan parity** — a session preempted by an injected
+   fault, then resumed from its checkpoint, ends with the same
+   estimator states as the uninterrupted scan;
+3. **retrace stability** — a full scan (including a REPEAT session in
+   the same process, and a warm low-latency ServeService scoring hop)
+   keeps every ``retrace_total{site=realtime.*}`` at <= 1.
+"""
+
+import numpy as np
+
+__all__ = ["selfcheck"]
+
+
+def selfcheck(out=None):
+    """Prints a JSON verdict; returns 0 on pass, 1 on failure."""
+    import json
+    import os
+    import sys
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from ..eventseg.event import (EventSegment, _forward_pass,
+                                  _logprob_obs_core)
+    from ..isc import isc
+    from ..obs import metrics as obs_metrics
+    from ..resilience import faults
+    from ..serve import ModelResidency
+    from ..serve.batching import BucketPolicy
+    from ..serve.service import ServeService
+    from ..serve.__main__ import build_demo_model
+    from . import (IncrementalEventSegment, MemoryFeed, OnlineISC,
+                   OnlineZScore, RealtimeSession)
+
+    import jax.numpy as jnp
+
+    stream = out or sys.stdout
+    rng = np.random.RandomState(0)
+    n_trs, n_voxels, n_refs, n_events = 48, 40, 3, 5
+    subj = rng.randn(n_trs, n_voxels)
+    refs = rng.randn(n_trs, n_voxels, n_refs)
+    pat = rng.randn(n_voxels, n_events)
+    var = 2.0
+
+    errs = []
+    resume_ok = True
+    serve_ok = True
+
+    # (1a) OnlineISC vs the batch isc() at every prefix
+    online = OnlineISC(refs)
+    state = online.init_state()
+    for t in range(n_trs):
+        state, out_t = online.step(state, subj[t])
+        if t >= 2:
+            stacked = np.concatenate(
+                [subj[:t + 1, :, None], refs[:t + 1]], axis=2)
+            batch = isc(stacked)  # [S, V]; row 0 = subj vs mean-refs
+            errs.append(float(np.nanmax(np.abs(
+                np.asarray(out_t["isc"]) - batch[0]))))
+
+    # (1b) incremental event segmentation vs the fused batch forward
+    # pass at every prefix (shared forward_step — RT001's core claim)
+    model = EventSegment(n_events=n_events)
+    model.set_event_patterns(pat)
+    log_P, log_p_start, _ = model._build_transitions(n_trs)
+    logprob = np.asarray(_logprob_obs_core(
+        jnp.asarray(subj.T), jnp.asarray(pat),
+        jnp.asarray(np.full(n_events, var))))
+    lp_ext = np.hstack([logprob, np.full((n_trs, 1), -np.inf)])
+    batch_alpha = np.asarray(_forward_pass(
+        jnp.asarray(lp_ext), jnp.asarray(log_P),
+        jnp.asarray(log_p_start))[0])
+    inc = IncrementalEventSegment(model, n_trs=n_trs, var=var)
+    state = inc.init_state()
+    for t in range(n_trs):
+        state, out_t = inc.step(state, subj[t])
+        row = np.asarray(out_t["log_alpha"])
+        ref_row = batch_alpha[t]
+        finite = np.isfinite(ref_row)
+        if not np.array_equal(np.isfinite(row), finite):
+            errs.append(float("inf"))
+        elif finite.any():
+            errs.append(float(np.max(np.abs(
+                row[finite] - ref_row[finite]))))
+
+    # (2 + 3) full closed-loop sessions: uninterrupted, preempted +
+    # resumed (state parity), and a repeat (retrace stability), each
+    # with online z-scoring and a warm low-latency ServeService hop
+    srm = build_demo_model(n_subjects=2, voxels=n_voxels,
+                           samples=32, features=4, n_iter=2, seed=0)
+    residency = ModelResidency(
+        budget_bytes=1 << 30,
+        policy=BucketPolicy(max_batch=16, max_wait_s=2.0))
+    residency.register("m", model=srm)
+
+    def run_session(service, checkpoint_dir=None):
+        session = RealtimeSession(
+            MemoryFeed(subj),
+            {"isc": OnlineISC(refs),
+             "evseg": IncrementalEventSegment(model, n_trs=n_trs,
+                                              var=var)},
+            preprocess=OnlineZScore(n_voxels), deadline_s=5.0,
+            service=service, service_model="m",
+            name="rt-selfcheck")
+        session.run(checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=8)
+        return session
+
+    with ServeService(residency, default_model="m") as service, \
+            tempfile.TemporaryDirectory() as tmp:
+        base = run_session(service)
+        if any(o.get("serve") is None for o in base.outputs):
+            serve_ok = False
+        ckpt = os.path.join(tmp, "ckpt")
+        try:
+            with faults.inject("preempt", at_step=16):
+                run_session(service, checkpoint_dir=ckpt)
+            resume_ok = False  # the fault must fire
+        except faults.PreemptionError:
+            pass
+        resumed = run_session(service, checkpoint_dir=ckpt)
+        if not resumed.outputs or resumed.outputs[0]["tr"] != 16:
+            resume_ok = False  # did not resume at the checkpoint
+        for est in ("isc", "evseg"):
+            a_state = base.estimator_state(est)
+            b_state = resumed.estimator_state(est)
+            for leaf in a_state:
+                a, b = a_state[leaf], b_state[leaf]
+                finite = np.isfinite(a)
+                if not np.array_equal(np.isfinite(b), finite):
+                    resume_ok = False
+                elif finite.any():
+                    err = float(np.max(np.abs(
+                        a[finite] - b[finite])))
+                    errs.append(err)
+                    if err > 1e-6:
+                        resume_ok = False
+        # repeat scan: every realtime.* program must already be built
+        repeat = run_session(service)
+
+    sites = repeat.retraces()
+    retrace = obs_metrics.counter("retrace_total")
+    for labels, value in retrace.samples():
+        if str(labels.get("site", "")).startswith("serve."):
+            sites[labels["site"]] = value
+
+    tol = 1e-6
+    expected = {"realtime.zscore_step", "realtime.isc_step",
+                "realtime.evseg_step"}
+    ok = (max(errs) < tol and resume_ok and serve_ok
+          and all(count <= 1.0 for count in sites.values())
+          and expected <= set(sites))
+    json.dump({"ok": bool(ok), "max_err": max(errs), "tol": tol,
+               "resume_ok": bool(resume_ok),
+               "serve_ok": bool(serve_ok),
+               "n_misses": int(base.summary()["n_deadline_misses"]),
+               "retraces": sites}, stream)
+    stream.write("\n")
+    return 0 if ok else 1

@@ -1025,6 +1025,26 @@ class InferenceEngine:
             self._flush_bucket(key)
         return None
 
+    def expedite(self, request):
+        """Flush the bucket holding ``request`` NOW, without waiting
+        out ``max_wait_s`` — the low-latency single-request path
+        (:meth:`ServeService.submit(..., low_latency=True)
+        <brainiak_tpu.serve.service.ServeService.submit>`).  A
+        closed-loop per-TR caller cannot afford the batch window: a
+        max-wait flush adds the full window to every singleton round
+        trip.  Returns True when a bucket was flushed (False: the
+        request already dispatched, e.g. its bucket hit max_batch at
+        submit).  Anything else queued in the same bucket rides the
+        expedited batch — no reordering, no starvation."""
+        try:
+            key = self.op.bucket_key(request)
+        except Exception:  # pragma: no cover - validated at submit
+            return False
+        if self._queues.get(key):
+            self._flush_bucket(key)
+            return True
+        return False
+
     def poll(self, now=None):
         """Flush buckets whose oldest request has waited past
         ``max_wait_s`` (call on the serving loop's timer)."""

@@ -298,7 +298,7 @@ class ServeService:
 
     # -- submission (any thread) --------------------------------------
 
-    def submit(self, request, model=None):
+    def submit(self, request, model=None, low_latency=False):
         """Enqueue one request; returns its :class:`ServiceTicket`.
 
         The target model is ``model`` or ``request.model`` or the
@@ -307,7 +307,18 @@ class ServeService:
         ``time.monotonic()`` on enqueue (unless the caller
         pre-stamped ingress time), and the engine's dispatch-time
         deadline check counts from that same stamp no matter how
-        many ticks the request waits through."""
+        many ticks the request waits through.
+
+        ``low_latency=True`` is the single-request fast path: the
+        loop (woken immediately by this submit) flushes the
+        request's bucket on the NEXT tick instead of waiting out the
+        batch window — the continuous-batching ``max_wait_s`` flush
+        otherwise adds a full wait-window to every singleton round
+        trip, which a closed-loop per-TR caller
+        (:mod:`brainiak_tpu.realtime`) cannot afford.  Requests
+        queued in the same bucket ride the expedited batch, so
+        mixing low-latency and batched traffic sacrifices batching
+        efficiency, never correctness."""
         name = model or request.model or self._default_model
         if name is None:
             names = self.residency.names()
@@ -319,6 +330,11 @@ class ServeService:
                     f"no default ({len(names)} registered)")
         if request.submitted is None:
             request.submitted = time.monotonic()
+        # rides the request into _route on the service thread (the
+        # ingress tuple shape stays (name, request, ticket)); set
+        # unconditionally so a RESUBMITTED request honors this
+        # call's choice, not a stale flag from an earlier submit
+        request._low_latency = bool(low_latency)
         clock = obs_trace.stage_clock()
         # admission reads the ENGINE-queue gauge this replica
         # publishes (at most one tick stale, by design) BEFORE the
@@ -382,6 +398,9 @@ class ServeService:
                 name = names[0]
             if request.submitted is None:
                 request.submitted = now
+            # waves are batched traffic: clear any stale fast-path
+            # flag a prior low-latency submit left on the request
+            request._low_latency = False
             obs_trace.start_trace(request)
             staged.append((name, request,
                            ServiceTicket(request.request_id, name)))
@@ -586,6 +605,11 @@ class ServeService:
             ticket._resolve(rejection)
             return 0
         self._pending[(name, request._seq_index)] = ticket
+        if getattr(request, "_low_latency", False):
+            # single-request fast path: dispatch the bucket in THIS
+            # tick (the same tick's drain below then delivers the
+            # record — a one-tick round trip instead of max_wait_s)
+            entry.engine.expedite(request)
         return 1
 
     def _fail(self, ticket, request, code,

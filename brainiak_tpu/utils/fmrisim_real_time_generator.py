@@ -7,6 +7,26 @@ as packaged files (ROIs, template, noise dict) are synthesized when not
 provided; DICOM output requires pydicom and raises a clear error when it is
 absent (it is an optional dependency there too).
 
+Two consumption modes share one simulation path (:func:`_simulate`):
+
+- :func:`generate_data` — the on-disk CLI path: mask/labels/ROI volumes
+  plus one ``rt_<TR>.npy`` (or ``.dcm``) per TR, optionally paced at one
+  volume per ``trDuration`` (``save_realtime``).  Under a fixed ``rng``
+  seed the written bytes are deterministic across runs.
+- :func:`generate_stream` — the in-memory mode: returns a
+  :class:`RealtimeStream` whose iterator yields one ``[x, y, z]`` volume
+  per TR with the mask/ROIs/labels as attributes, so a closed-loop
+  consumer (:mod:`brainiak_tpu.realtime`) never round-trips through
+  disk.
+
+Randomness: ``rng`` accepts a seed or a ``numpy.random.Generator`` and
+threads through every draw this module makes; because the underlying
+:mod:`fmrisim` synthesis routines draw from global NumPy state, a
+seeded call also pins that stream (from the generator) for the
+duration of the simulation, making the whole volume sequence
+reproducible.  ``rng=None`` keeps the legacy behavior (global state,
+non-deterministic).
+
 Run as ``python -m brainiak_tpu.utils.fmrisim_real_time_generator -o DIR``.
 """
 
@@ -22,7 +42,8 @@ from . import fmrisim as sim
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["generate_data", "default_settings"]
+__all__ = ["RealtimeStream", "default_settings", "generate_data",
+           "generate_stream"]
 
 default_settings = {
     'ROI_A_file': None,
@@ -40,6 +61,32 @@ default_settings = {
     'isi': 6,
     'burn_in': 6,
 }
+
+
+class _GlobalStateRNG:
+    """Legacy draw source for ``rng=None``: the module's own draws
+    come from process-global NumPy state exactly as they always did
+    (``np.random.randint``/``rand``), so a caller that seeded the
+    global stream keeps its pre-``rng=`` reproducibility."""
+
+    @staticmethod
+    def integers(low, high):
+        return np.random.randint(low, high)
+
+    @staticmethod
+    def random(shape):
+        return np.random.rand(*shape)
+
+
+def _resolve_rng(rng):
+    """``(generator, seeded)``: the draw source for this module's
+    own draws, plus whether the caller asked for determinism (a
+    seed or an explicit Generator) — in which case the global NumPy
+    stream the fmrisim internals read is pinned too (and restored
+    afterwards)."""
+    if rng is None:
+        return _GlobalStateRNG(), False
+    return np.random.default_rng(rng), True
 
 
 def _default_inputs(data_dict):
@@ -114,25 +161,37 @@ def _write_dicom(volume, out_file):
     ds.save_as(out_file, write_like_original=False)
 
 
-def generate_data(outputDir, user_settings):
-    """Generate and stream simulated realtime data to ``outputDir``
-    (reference fmrisim_real_time_generator.py:349-533).
+def _simulate(data_dict, rng):
+    """The simulation shared by the on-disk and in-memory modes:
+    synthesizes (or loads) the inputs, generates noise + evoked
+    signal, and returns the whole-scan arrays as a dict with keys
+    ``brain`` [x, y, z, T], ``mask``, ``roi_a``, ``roi_b`` (binary
+    uint8 volumes), ``labels`` [T*tr, 1], and ``dims``.
 
-    Writes mask.npy, labels.npy, and one rt_<TR>.npy (or .dcm) per TR.
+    ``rng`` is this module's draw stream (onset coin flips, the
+    multivariate pattern); when the caller seeded it, the global
+    NumPy stream the fmrisim internals use is pinned from it too, so
+    the full volume sequence is reproducible.
     """
-    data_dict = default_settings.copy()
-    data_dict.update(user_settings)
-    Path(outputDir).mkdir(parents=True, exist_ok=True)
+    rng, seeded = _resolve_rng(rng)
+    if not seeded:
+        return _simulate_body(data_dict, rng)
+    # fmrisim's synthesis (generate_noise et al.) draws from global
+    # NumPy state; pin it from the caller's generator so a seeded
+    # run is reproducible end to end — and restore the caller's
+    # global stream afterwards (the pin lasts only for the
+    # duration of the simulation)
+    saved_state = np.random.get_state()
+    np.random.seed(int(rng.integers(0, 2 ** 32)))
+    try:
+        return _simulate_body(data_dict, rng)
+    finally:
+        np.random.set_state(saved_state)
 
+
+def _simulate_body(data_dict, rng):
     roi_a, roi_b, template, noise_dict, dims = _default_inputs(data_dict)
     mask, template = sim.mask_brain(volume=template, mask_self=True)
-    np.save(os.path.join(outputDir, 'mask.npy'), mask.astype(np.uint8))
-    # the analysis side needs the ROI geometry (the reference ships its
-    # ROI volumes as package data next to the generated stream)
-    np.save(os.path.join(outputDir, 'roi_a.npy'),
-            (roi_a > 0).astype(np.uint8))
-    np.save(os.path.join(outputDir, 'roi_b.npy'),
-            (roi_b > 0).astype(np.uint8))
 
     noise_dict['matched'] = 0
     num_trs = data_dict['numTRs']
@@ -150,7 +209,7 @@ def generate_data(outputDir, user_settings):
     onsets_a, onsets_b = [], []
     curr_time = data_dict['burn_in']
     while curr_time < total_time - data_dict['event_duration']:
-        (onsets_a if np.random.randint(0, 2) == 1
+        (onsets_a if int(rng.integers(0, 2)) == 1
          else onsets_b).append(curr_time)
         curr_time += data_dict['event_duration'] + data_dict['isi']
 
@@ -161,8 +220,7 @@ def generate_data(outputDir, user_settings):
     stimfunc_b = sim.generate_stimfunction(
         onsets=onsets_b, event_durations=[data_dict['event_duration']],
         total_time=total_time, temporal_resolution=temporal_res)
-    np.save(os.path.join(outputDir, 'labels.npy'),
-            stimfunc_a + stimfunc_b * 2)
+    labels = stimfunc_a + stimfunc_b * 2
 
     def roi_signal(roi_vol, stimfunc, scale):
         """Evoked signal within an ROI scaled as percent signal change."""
@@ -170,7 +228,7 @@ def generate_data(outputDir, user_settings):
                               temporal_resolution=temporal_res)
         n_vox = int((roi_vol > 0).sum())
         if data_dict['multivariate_pattern']:
-            pattern = np.random.rand(1, n_vox)
+            pattern = rng.random((1, n_vox))
             sf = sf @ pattern
         sig_func = np.tile(sf, (1, n_vox)) if sf.shape[1] == 1 else sf
         noise_fn = noise[roi_vol > 0].T
@@ -187,7 +245,106 @@ def generate_data(outputDir, user_settings):
     else:
         signal_b = roi_signal(roi_a, stimfunc_b, scale * 0.5)
 
-    brain = noise + signal_a + signal_b
+    return {
+        'brain': noise + signal_a + signal_b,
+        'mask': mask.astype(np.uint8),
+        'roi_a': (roi_a > 0).astype(np.uint8),
+        'roi_b': (roi_b > 0).astype(np.uint8),
+        'labels': labels,
+        'dims': dims,
+    }
+
+
+class RealtimeStream:
+    """In-memory realtime scan: iterate for one ``[x, y, z]`` volume
+    per TR (no disk round-trip).
+
+    Attributes mirror the files :func:`generate_data` writes:
+    ``mask`` / ``roi_a`` / ``roi_b`` (binary uint8 volumes),
+    ``labels`` (per-stimulus-sample condition vector), ``n_trs``,
+    ``tr_duration_s``, plus the full ``brain`` [x, y, z, T] array
+    for batch-parity checks.  ``paced=True`` sleeps the iterator to
+    one volume per TR (the ``save_realtime`` analog); the default
+    yields as fast as the consumer pulls.
+    """
+
+    def __init__(self, sim_out, tr_duration_s, paced=False):
+        self.brain = sim_out['brain']
+        self.mask = sim_out['mask']
+        self.roi_a = sim_out['roi_a']
+        self.roi_b = sim_out['roi_b']
+        self.labels = sim_out['labels']
+        self.tr_duration_s = float(tr_duration_s)
+        self.paced = bool(paced)
+
+    @property
+    def n_trs(self):
+        return int(self.brain.shape[3])
+
+    def __len__(self):
+        return self.n_trs
+
+    def volume(self, tr):
+        """The ``[x, y, z]`` volume at ``tr`` (random access — what
+        lets a resumed closed-loop session seek mid-scan)."""
+        return self.brain[:, :, :, int(tr)]
+
+    def __iter__(self):
+        # the shared absolute-monotonic scheduler (also used by the
+        # realtime ingest replays): TR t is due at
+        # start + t*trDuration, so consumer processing time between
+        # pulls counts against the period and pacing never drifts —
+        # and a wall-clock step (NTP, DST) cannot stall or burst
+        # the simulated scanner
+        from .utils import MonotonicPacer
+
+        pacer = MonotonicPacer(self.tr_duration_s
+                               if self.paced else 0.0)
+        for tr in range(self.n_trs):
+            pacer.wait()
+            yield self.brain[:, :, :, tr]
+
+
+def generate_stream(user_settings=None, rng=None, paced=False):
+    """Simulate a realtime scan fully in memory; returns a
+    :class:`RealtimeStream` (the generator-function mode — no disk
+    round-trip, same volumes the on-disk path would write, before
+    the int16 save cast).
+
+    ``user_settings`` updates :data:`default_settings`; ``rng`` is a
+    seed or ``numpy.random.Generator`` (a seeded call is
+    reproducible end to end, see the module docstring).
+    """
+    data_dict = default_settings.copy()
+    data_dict.update(user_settings or {})
+    out = _simulate(data_dict, rng)
+    return RealtimeStream(out, data_dict['trDuration'], paced=paced)
+
+
+def generate_data(outputDir, user_settings, rng=None):
+    """Generate and stream simulated realtime data to ``outputDir``
+    (reference fmrisim_real_time_generator.py:349-533).
+
+    Writes mask.npy, labels.npy, and one rt_<TR>.npy (or .dcm) per TR.
+    ``rng`` (seed or ``numpy.random.Generator``): a fixed seed makes
+    the written bytes deterministic across runs; None keeps the
+    legacy global-state behavior.
+    """
+    data_dict = default_settings.copy()
+    data_dict.update(user_settings)
+    Path(outputDir).mkdir(parents=True, exist_ok=True)
+
+    out = _simulate(data_dict, rng)
+    np.save(os.path.join(outputDir, 'mask.npy'), out['mask'])
+    # the analysis side needs the ROI geometry (the reference ships its
+    # ROI volumes as package data next to the generated stream)
+    np.save(os.path.join(outputDir, 'roi_a.npy'), out['roi_a'])
+    np.save(os.path.join(outputDir, 'roi_b.npy'), out['roi_b'])
+    np.save(os.path.join(outputDir, 'labels.npy'), out['labels'])
+
+    num_trs = data_dict['numTRs']
+    tr_dur = data_dict['trDuration']
+    brain = out['brain']
     for tr in range(num_trs):
         start = time.time()
         vol = brain[:, :, :, tr]
@@ -218,6 +375,9 @@ def main():
     p.add_argument('--different-ROIs', '-r', action='store_true')
     p.add_argument('--save-dicom', action='store_true')
     p.add_argument('--save-realtime', action='store_true')
+    p.add_argument('--seed', default=None, type=int,
+                   help="seed the simulation (deterministic output "
+                        "bytes for a fixed seed)")
     args = p.parse_args()
     settings = {
         'ROI_A_file': args.ROI_A_file,
@@ -235,7 +395,7 @@ def main():
         'save_dicom': args.save_dicom,
         'save_realtime': args.save_realtime,
     }
-    generate_data(args.output_dir, settings)
+    generate_data(args.output_dir, settings, rng=args.seed)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ __all__ = [
     "from_sym_2_tri",
     "from_tri_2_sym",
     "gen_design",
+    "MonotonicPacer",
     "p_from_null",
     "phase_randomize",
     "ReadDesign",
@@ -34,6 +35,46 @@ __all__ = [
 ]
 
 logger = logging.getLogger(__name__)
+
+
+class MonotonicPacer:
+    """Absolute-monotonic period scheduler: tick ``t`` is due at
+    ``start + t * period_s``.
+
+    The shared pacing primitive of the real-time paths (the fmrisim
+    :class:`~brainiak_tpu.utils.fmrisim_real_time_generator
+    .RealtimeStream` iterator and the
+    :class:`brainiak_tpu.realtime.ingest.TRSource` replays):
+    consumer time between :meth:`wait` calls counts against the
+    period — pacing never drifts — and the monotonic clock is
+    immune to wall-clock steps (NTP, DST).  ``period_s <= 0``
+    disables pacing.  :meth:`reset` forgets the schedule (a resumed
+    replay restarts its clock; the gap was downtime, not lateness).
+    """
+
+    def __init__(self, period_s):
+        import time as _time  # late: keep this module numpy-light
+        self._time = _time
+        self.period_s = float(period_s)
+        self._next_due = None
+
+    def reset(self):
+        self._next_due = None
+        return self
+
+    def wait(self):
+        """Sleep until the next tick is due, then advance the
+        schedule.  Returns the seconds slept."""
+        if self.period_s <= 0.0:
+            return 0.0
+        now = self._time.monotonic()
+        if self._next_due is None:
+            self._next_due = now
+        delay = self._next_due - now
+        if delay > 0:
+            self._time.sleep(delay)
+        self._next_due += self.period_s
+        return max(delay, 0.0)
 
 
 def circ_dist(x, y):

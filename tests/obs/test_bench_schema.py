@@ -297,3 +297,36 @@ def test_federation_tier_records_match_obs_schema(monkeypatch):
     assert "direction" not in recs[0]
     assert recs[1]["direction"] == "lower_is_better"
     assert recs[2]["direction"] == "lower_is_better"
+
+
+# -- ISSUE 15: realtime tier ------------------------------------------
+
+def test_realtime_tier_records_match_obs_schema(monkeypatch):
+    """The realtime tier (ISSUE 15): a short in-process closed-loop
+    scan off the seeded fmrisim source emits TWO schema-valid
+    records — per-TR p99 latency and the deadline-miss ratio, BOTH
+    direction="lower_is_better" (the tier is latency-bound) — so
+    `obs regress --only realtime` gates the closed-loop SLO from
+    day one."""
+    monkeypatch.setenv("BENCH_REALTIME_TRS", "30")
+    out = bench.measure_tier("realtime")
+    assert out["n_trs"] == 30
+    assert out["p99_latency_s"] > 0
+    assert 0.0 <= out["miss_ratio"] <= 1.0
+    assert out["n_voxels"] > 0
+    stages = out["stages"]
+    assert set(bench.STAGE_KEYS) <= set(stages)
+    assert stages["steady_s"] > 0
+
+    recs = bench._realtime_result_records(out)
+    assert [r["metric"] for r in recs] == [
+        "realtime_tr_p99_latency_seconds",
+        "realtime_deadline_miss_ratio"]
+    for rec in recs:
+        assert obs.validate_bench_record(rec) == []
+        # in-process run on the CPU test backend -> fallback tier
+        assert rec["tier"] == "realtime_cpu_fallback"
+        assert rec["config"]["n_trs"] == 30
+        assert rec["config"]["deadline_s"] == \
+            bench.REALTIME_DEADLINE_S
+        assert rec["direction"] == "lower_is_better"

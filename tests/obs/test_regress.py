@@ -49,10 +49,12 @@ def test_fixture_history_passes_and_gates():
     # (ISSUE 13: 3 rounds x 2 metrics — streamed subjects/s,
     # prefetch stall ratio) + the federation_r01-r03 tier
     # (ISSUE 14: 3 rounds x 3 metrics — routed requests/s, overload
-    # p99, shed ratio), all measured host-side ->
-    # *_cpu_fallback: eight tiers gating independently from one
+    # p99, shed ratio) + the realtime_r01-r03 tier (ISSUE 15:
+    # 3 rounds x 2 metrics — per-TR p99 latency, deadline-miss
+    # ratio, both lower-is-better), all measured host-side ->
+    # *_cpu_fallback: nine tiers gating independently from one
     # directory
-    assert len(records) == 47
+    assert len(records) == 53
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
@@ -62,11 +64,13 @@ def test_fixture_history_passes_and_gates():
                      "encoding_cpu_fallback",
                      "kernels_cpu_fallback",
                      "streaming_cpu_fallback",
-                     "federation_cpu_fallback"}
+                     "federation_cpu_fallback",
+                     "realtime_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
     multi = ("service_cpu_fallback", "kernels_cpu_fallback",
-             "streaming_cpu_fallback", "federation_cpu_fallback")
+             "streaming_cpu_fallback", "federation_cpu_fallback",
+             "realtime_cpu_fallback")
     by_tier = {c["tier"]: c for c in result["checks"]
                if c["tier"] not in multi}
     by_metric = {c["metric"]: c for c in result["checks"]
@@ -87,7 +91,9 @@ def test_fixture_history_passes_and_gates():
                               "streaming_prefetch_stall_ratio",
                               "federation_routed_requests_per_sec",
                               "federation_overload_p99_seconds",
-                              "federation_shed_ratio"}
+                              "federation_shed_ratio",
+                              "realtime_tr_p99_latency_seconds",
+                              "realtime_deadline_miss_ratio"}
     assert by_metric["service_obs_overhead_ratio"][
         "direction"] == "lower_is_better"
     # the ISSUE 13 streaming tier gates overlap the right way round
@@ -97,6 +103,11 @@ def test_fixture_history_passes_and_gates():
         "direction"] == "lower_is_better"
     # the ISSUE 14 federation tier gates overload behavior mirrored
     assert by_metric["federation_overload_p99_seconds"][
+        "direction"] == "lower_is_better"
+    # the ISSUE 15 realtime tier gates the latency SLO, not a rate
+    assert by_metric["realtime_tr_p99_latency_seconds"][
+        "direction"] == "lower_is_better"
+    assert by_metric["realtime_deadline_miss_ratio"][
         "direction"] == "lower_is_better"
     assert by_metric["federation_shed_ratio"][
         "direction"] == "lower_is_better"

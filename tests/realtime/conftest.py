@@ -1,0 +1,16 @@
+"""Realtime test isolation: obs sinks/metrics reset around every
+test (the step-program retrace counters and the latency histograms
+are process-global)."""
+
+import pytest
+
+from brainiak_tpu.obs import metrics, sink
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    sink.close_all()
+    metrics.reset()
+    yield
+    sink.close_all()
+    metrics.reset()

@@ -1,0 +1,157 @@
+"""TR sources: delivery, seek/resume, arrival-jitter metrics, and
+the directory watcher's half-written-file tolerance (ISSUE 15)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.obs import metrics as obs_metrics
+from brainiak_tpu.realtime import (DirectoryWatcher, MemoryFeed,
+                                   StoreReplay)
+
+T, V = 10, 7
+
+
+@pytest.fixture
+def rows():
+    return np.random.RandomState(0).randn(T, V)
+
+
+def test_memory_feed_delivers_rows_with_indices(rows):
+    feed = MemoryFeed(rows)
+    assert len(feed) == T
+    samples = list(feed)
+    assert [s.index for s in samples] == list(range(T))
+    for s in samples:
+        assert np.array_equal(s.volume, rows[s.index])
+        assert s.t_arrival > 0
+
+
+def test_memory_feed_seek_and_mask(rows):
+    mask = np.zeros(V)
+    mask[:3] = 1
+    feed = MemoryFeed(rows, mask=mask)
+    feed.seek(7)
+    samples = list(feed)
+    assert [s.index for s in samples] == [7, 8, 9]
+    assert samples[0].volume.shape == (3,)
+    assert np.array_equal(samples[0].volume, rows[7, :3])
+
+
+def test_memory_feed_flattens_realtime_stream():
+    class FakeStream:  # duck-typed RealtimeStream
+        brain = np.arange(2 * 2 * 1 * 4, dtype=float).reshape(
+            2, 2, 1, 4)
+        mask = np.array([[[1], [0]], [[1], [1]]])
+
+    feed = MemoryFeed(FakeStream())
+    sample = feed.next()
+    assert sample.volume.shape == (3,)  # 3 in-mask voxels
+    assert len(feed) == 4
+
+
+def test_paced_feed_records_jitter(rows):
+    feed = MemoryFeed(rows[:4], tr_s=0.01)
+    list(feed)
+    hist = obs_metrics.histogram(
+        "realtime_arrival_jitter_seconds").summary(source="memory")
+    assert hist is not None and hist["count"] == 3  # T-1 intervals
+    assert obs_metrics.counter("realtime_trs_total").value(
+        source="memory") == 4.0
+
+
+def test_directory_watcher_reads_generator_layout(tmp_path, rows):
+    mask = np.ones(V)
+    mask[0] = 0
+    np.save(tmp_path / "mask.npy", mask)
+    for t in range(T):
+        np.save(tmp_path / f"rt_{t:0>3}.npy", rows[t])
+    watcher = DirectoryWatcher(tmp_path, n_trs=T, timeout_s=5.0)
+    samples = list(watcher)
+    assert len(samples) == T
+    assert samples[3].volume.shape == (V - 1,)
+    assert np.array_equal(samples[3].volume, rows[3, 1:])
+
+
+def test_directory_watcher_retries_half_written_file(tmp_path,
+                                                     rows):
+    np.save(tmp_path / "rt_000.npy", rows[0])
+    # a half-written volume: invalid npy bytes the producer will
+    # finish shortly after the watcher first sees the file
+    bad = tmp_path / "rt_001.npy"
+    bad.write_bytes(b"\x93NUMPY")
+
+    def finish_write():
+        time.sleep(0.15)
+        np.save(bad, rows[1])
+
+    writer = threading.Thread(target=finish_write)
+    writer.start()
+    try:
+        watcher = DirectoryWatcher(tmp_path, n_trs=2,
+                                   timeout_s=10.0)
+        samples = list(watcher)
+    finally:
+        writer.join()
+    assert len(samples) == 2
+    assert np.array_equal(samples[1].volume, rows[1])
+    assert obs_metrics.counter(
+        "realtime_ingest_retries_total").value(
+            source="directory") >= 1.0
+
+
+def test_directory_watcher_timeout_semantics(tmp_path, rows):
+    np.save(tmp_path / "rt_000.npy", rows[0])
+    # bounded scan that goes quiet mid-way: an error, not silence
+    watcher = DirectoryWatcher(tmp_path, n_trs=3, timeout_s=0.1,
+                               poll_s=0.01)
+    assert watcher.next().index == 0
+    with pytest.raises(TimeoutError, match="TR 1"):
+        watcher.next()
+    # open-ended scan: quiet means the scan is over
+    watcher = DirectoryWatcher(tmp_path, timeout_s=0.1,
+                               poll_s=0.01)
+    assert [s.index for s in watcher] == [0]
+
+
+def test_store_replay_and_seek(tmp_path, rows):
+    from brainiak_tpu.data import write_store
+
+    store = write_store(os.path.join(tmp_path, "store"),
+                        [rows.T, rows.T * 2])
+    replay = StoreReplay(store, subject=1)
+    assert len(replay) == T
+    samples = list(replay)
+    assert np.allclose(samples[4].volume, rows[4] * 2, atol=1e-6)
+    replay.seek(8)
+    assert [s.index for s in replay] == [8, 9]
+
+
+def test_directory_watcher_picks_up_late_mask(tmp_path, rows):
+    """A watcher started before the producer wrote its metadata
+    resolves mask.npy lazily at the first volume read (the
+    generator writes mask.npy before any rt_*.npy), instead of
+    silently locking in unmasked full volumes."""
+    watcher = DirectoryWatcher(tmp_path, n_trs=2, timeout_s=10.0,
+                               poll_s=0.01)  # empty dir so far
+    mask = np.zeros(V)
+    mask[:4] = 1
+
+    def produce():
+        time.sleep(0.1)
+        np.save(tmp_path / "mask.npy", mask)
+        for t in range(2):
+            np.save(tmp_path / f"rt_{t:0>3}.npy", rows[t])
+
+    producer = threading.Thread(target=produce)
+    producer.start()
+    try:
+        samples = list(watcher)
+    finally:
+        producer.join()
+    assert len(samples) == 2
+    assert samples[0].volume.shape == (4,)
+    assert np.array_equal(samples[1].volume, rows[1, :4])

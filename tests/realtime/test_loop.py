@@ -1,0 +1,238 @@
+"""RealtimeSession: the closed-loop driver — deadline accounting,
+checkpoint/resume mid-scan, and the warm low-latency serve hop
+(ISSUE 15 tentpole)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.eventseg.event import EventSegment
+from brainiak_tpu.obs import metrics as obs_metrics
+from brainiak_tpu.obs import sink as obs_sink
+from brainiak_tpu.realtime import (IncrementalEventSegment,
+                                   MemoryFeed, OnlineISC,
+                                   OnlineZScore, RealtimeSession)
+from brainiak_tpu.resilience import faults
+
+T, V, R, K = 20, 11, 2, 4
+
+
+@pytest.fixture
+def scan():
+    rng = np.random.RandomState(3)
+    return rng.randn(T, V), rng.randn(T, V, R)
+
+
+def _session(scan, deadline_s=30.0, **kwargs):
+    subj, refs = scan
+    model = EventSegment(n_events=K)
+    model.set_event_patterns(
+        np.random.RandomState(5).randn(V, K))
+    return RealtimeSession(
+        MemoryFeed(subj),
+        {"isc": OnlineISC(refs),
+         "evseg": IncrementalEventSegment(model, n_trs=T, var=2.0)},
+        preprocess=OnlineZScore(V), deadline_s=deadline_s,
+        name="rt-loop-test", **kwargs)
+
+
+def test_session_processes_whole_scan(scan):
+    session = _session(scan)
+    summary = session.run()
+    assert summary["n_trs"] == T
+    assert summary["n_deadline_misses"] == 0
+    assert summary["deadline_miss_ratio"] == 0.0
+    assert summary["p99_latency_s"] > 0
+    # one output per TR, with both estimators' results fetched
+    assert [o["tr"] for o in session.outputs] == list(range(T))
+    out = session.outputs[-1]
+    assert out["isc"]["isc"].shape == (V,)
+    assert out["evseg"]["posterior"].shape == (K + 1,)
+    assert not out["deadline_miss"]
+    # per-stage latency sketches cover every stage + the total
+    assert {"preprocess", "isc", "evseg", "total"} <= set(
+        summary["stages"])
+    assert all(count <= 1.0
+               for count in summary["retraces"].values())
+
+
+def test_deadline_misses_are_recorded_not_fatal(scan):
+    mem = obs_sink.MemorySink()
+    obs_sink.add_sink(mem)
+    try:
+        session = _session(scan, deadline_s=0.0)  # every TR misses
+        summary = session.run()
+    finally:
+        obs_sink.remove_sink(mem)
+    assert summary["n_trs"] == T  # the scan still completed
+    assert summary["n_deadline_misses"] == T
+    assert summary["deadline_miss_ratio"] == 1.0
+    assert obs_metrics.counter(
+        "realtime_deadline_miss_total").value(
+            session="rt-loop-test") == float(T)
+    events = [r for r in mem.records
+              if r.get("name") == "deadline_exceeded"]
+    assert len(events) == T
+    attrs = events[0]["attrs"]
+    assert attrs["deadline_s"] == 0.0
+    assert "preprocess" in attrs["stages"]
+
+
+def test_resume_mid_scan_matches_uninterrupted(scan, tmp_path):
+    base = _session(scan)
+    base.run()
+    ckpt = os.path.join(tmp_path, "ckpt")
+    with pytest.raises(faults.PreemptionError):
+        with faults.inject("preempt", at_step=10):
+            _session(scan).run(checkpoint_dir=ckpt,
+                               checkpoint_every=5)
+    resumed = _session(scan)
+    resumed.run(checkpoint_dir=ckpt, checkpoint_every=5)
+    # the resumed process holds only the TRs after the checkpoint
+    assert resumed.outputs[0]["tr"] == 10
+    assert resumed.outputs[-1]["tr"] == T - 1
+    for est in ("isc", "evseg"):
+        a_state = base.estimator_state(est)
+        b_state = resumed.estimator_state(est)
+        for leaf, a in a_state.items():
+            b = b_state[leaf]
+            finite = np.isfinite(a)
+            assert np.array_equal(np.isfinite(b), finite)
+            if finite.any():
+                assert np.max(np.abs(a[finite] - b[finite])) < 1e-10
+
+
+def test_resume_refuses_mismatched_configuration(scan, tmp_path):
+    subj, refs = scan
+    ckpt = os.path.join(tmp_path, "ckpt")
+    with pytest.raises(faults.PreemptionError):
+        with faults.inject("preempt", at_step=10):
+            _session(scan).run(checkpoint_dir=ckpt,
+                               checkpoint_every=5)
+    other = RealtimeSession(
+        MemoryFeed(subj), {"only_isc": OnlineISC(refs)},
+        name="rt-loop-test")
+    with pytest.raises(ValueError, match="different data"):
+        other.run(checkpoint_dir=ckpt, checkpoint_every=5)
+
+
+def test_estimator_names_cannot_collide_with_state_keys(scan):
+    subj, refs = scan
+    with pytest.raises(ValueError, match="must not contain"):
+        RealtimeSession(MemoryFeed(subj),
+                        {"a.b": OnlineISC(refs)})
+
+
+def test_session_scores_through_low_latency_service(scan):
+    from brainiak_tpu.serve import BucketPolicy, ModelResidency
+    from brainiak_tpu.serve.__main__ import build_demo_model
+    from brainiak_tpu.serve.service import ServeService
+
+    subj, _ = scan
+    srm = build_demo_model(n_subjects=2, voxels=V, samples=16,
+                           features=3, n_iter=2, seed=0)
+    residency = ModelResidency(
+        budget_bytes=1 << 30,
+        policy=BucketPolicy(max_batch=16, max_wait_s=5.0))
+    residency.register("m", model=srm)
+    with ServeService(residency, default_model="m") as service:
+        session = RealtimeSession(
+            MemoryFeed(subj), {"zs": OnlineZScore(V)},
+            deadline_s=30.0, service=service, service_model="m",
+            name="rt-serve-test")
+        summary = session.run()
+    assert summary["n_trs"] == T
+    # every TR got a scored result back (shared response [k, 1]),
+    # well inside a deadline far smaller than the 5 s batch window
+    # it would have waited without the low-latency path
+    for out in session.outputs:
+        assert out["serve"] is not None
+        assert out["serve"].shape == (3, 1)
+    assert "serve" in summary["stages"]
+    assert summary["n_deadline_misses"] == 0
+
+
+def test_guard_rollback_does_not_double_count_slo(scan, tmp_path):
+    """A NaN-guard rollback re-runs the chunk; the replayed TRs
+    must not inflate n_trs / miss ratio / the latency sketches
+    (the CI-gated SLO numbers)."""
+    session = _session(scan)
+    with faults.inject("nan", at_step=10):
+        summary = session.run(checkpoint_dir=str(tmp_path / "ck"),
+                              checkpoint_every=5)
+    assert obs_metrics.counter("rollback_total").value(
+        estimator="rt-loop-test") == 1.0
+    assert summary["n_trs"] == T
+    assert summary["stages"]["total"]["count"] == T
+    assert [o["tr"] for o in session.outputs] == list(range(T))
+    # and the replay converged to the same states as a clean run
+    clean = _session(scan)
+    clean.run()
+    for leaf, a in clean.estimator_state("isc").items():
+        b = session.estimator_state("isc")[leaf]
+        assert np.max(np.abs(a - b)) < 1e-10
+
+
+def test_retraces_are_per_session_deltas():
+    """A second session over the same shapes reuses every cached
+    step program: its retrace report is 0, not the process total
+    (the InferenceEngine delta idiom).  A fresh voxel count forces
+    the first session to build (the step caches are process-global
+    and may be warm from earlier tests)."""
+    v = V + 17  # unique shape -> guaranteed builds in session 1
+    rows = np.random.RandomState(9).randn(T, v)
+
+    def make():
+        return RealtimeSession(MemoryFeed(rows),
+                               {"zs": OnlineZScore(v)},
+                               name="rt-delta-test")
+
+    first = make()
+    first.run()
+    assert first.retraces().get("realtime.zscore_step") == 1.0
+    second = make()
+    summary = second.run()
+    assert summary["retraces"]["realtime.zscore_step"] == 0.0
+
+
+def test_resume_refuses_changed_estimator_config(scan, tmp_path):
+    """Same estimator names and shapes but DIFFERENT parameters (a
+    new reference group) must refuse the checkpoint — resuming
+    would silently mix two groups' sufficient statistics."""
+    subj, refs = scan
+    ckpt = os.path.join(tmp_path, "ckpt")
+    with pytest.raises(faults.PreemptionError):
+        with faults.inject("preempt", at_step=10):
+            RealtimeSession(
+                MemoryFeed(subj), {"isc": OnlineISC(refs)},
+                name="rt-loop-test").run(checkpoint_dir=ckpt,
+                                         checkpoint_every=5)
+    other_refs = refs + 1.0  # same shape, different content
+    session = RealtimeSession(
+        MemoryFeed(subj), {"isc": OnlineISC(other_refs)},
+        name="rt-loop-test")
+    with pytest.raises(ValueError, match="different data"):
+        session.run(checkpoint_dir=ckpt, checkpoint_every=5)
+
+
+def test_keep_outputs_bounds_retention(scan):
+    subj, refs = scan
+    with pytest.raises(ValueError, match="keep_outputs"):
+        RealtimeSession(MemoryFeed(subj),
+                        {"isc": OnlineISC(refs)}, keep_outputs=0)
+    session = RealtimeSession(MemoryFeed(subj),
+                              {"isc": OnlineISC(refs)},
+                              keep_outputs=5, name="rt-keep")
+    summary = session.run()
+    assert summary["n_trs"] == T  # aggregates cover the whole scan
+    assert [o["tr"] for o in session.outputs] == \
+        list(range(T - 5, T))  # raw outputs: only the last 5
+
+
+def test_reserved_stage_names_rejected(scan):
+    subj, refs = scan
+    for name in ("preprocess", "serve", "total"):
+        with pytest.raises(ValueError, match="reserved"):
+            RealtimeSession(MemoryFeed(subj),
+                            {name: OnlineISC(refs)})

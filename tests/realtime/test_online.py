@@ -1,0 +1,183 @@
+"""Online estimators: O(1)-per-TR state, online == batch at every
+prefix, retraces <= 1 per estimator (ISSUE 15 tentpole)."""
+
+import numpy as np
+import pytest
+from scipy import stats
+
+from brainiak_tpu.eventseg.event import EventSegment
+from brainiak_tpu.obs import metrics as obs_metrics
+from brainiak_tpu.realtime import (IncrementalEventSegment, OnlineISC,
+                                   OnlineZScore)
+
+T, V, R, K = 24, 13, 3, 4
+
+
+@pytest.fixture
+def scan():
+    rng = np.random.RandomState(7)
+    return rng.randn(T, V), rng.randn(T, V, R)
+
+
+def _drive(est, rows):
+    state = est.init_state()
+    outs = []
+    for t in range(rows.shape[0]):
+        state, out = est.step(state, rows[t])
+        outs.append({k: np.asarray(v) for k, v in out.items()})
+    return state, outs
+
+
+def test_online_zscore_matches_batch_prefix(scan):
+    subj, _ = scan
+    _, outs = _drive(OnlineZScore(V), subj)
+    assert np.allclose(outs[0]["z"], 0.0)  # 1-sample std undefined
+    for t in range(1, T):
+        ref = stats.zscore(subj[:t + 1], axis=0, ddof=1)[t]
+        assert np.max(np.abs(outs[t]["z"] - ref)) < 1e-9
+
+
+def test_online_isc_loo_matches_batch_isc(scan):
+    from brainiak_tpu.isc import isc
+    subj, refs = scan
+    _, outs = _drive(OnlineISC(refs), subj)
+    for t in range(2, T, 5):
+        stacked = np.concatenate(
+            [subj[:t + 1, :, None], refs[:t + 1]], axis=2)
+        batch = isc(stacked)  # [S, V]; row 0 = subj vs mean-refs
+        err = np.nanmax(np.abs(outs[t]["isc"] - batch[0]))
+        assert err < 1e-6, (t, err)
+
+
+def test_online_isc_pairwise_and_windowed(scan):
+    from brainiak_tpu.isc import isc
+    subj, refs = scan
+    window = 8
+    _, outs = _drive(OnlineISC(refs, pairwise=True, window=window),
+                     subj)
+    for t in range(3, T, 5):
+        stacked = np.concatenate(
+            [subj[:t + 1, :, None], refs[:t + 1]], axis=2)
+        # first R condensed rows are the (subject, ref_j) pairs
+        batch = isc(stacked, pairwise=True)
+        err = np.nanmax(np.abs(outs[t]["isc"].T - batch[:R]))
+        assert err < 1e-6, (t, err)
+        lo = max(0, t + 1 - window)
+        stacked_w = np.concatenate(
+            [subj[lo:t + 1, :, None], refs[lo:t + 1]], axis=2)
+        batch_w = isc(stacked_w, pairwise=True)
+        err_w = np.nanmax(np.abs(
+            outs[t]["isc_windowed"].T - batch_w[:R]))
+        assert err_w < 1e-6, (t, err_w)
+
+
+def test_online_isc_validates_input(scan):
+    subj, refs = scan
+    with pytest.raises(ValueError, match=r"\[T, V, R\]"):
+        OnlineISC(np.zeros(5))
+    with pytest.raises(ValueError, match="window"):
+        OnlineISC(refs, window=-1)
+    est = OnlineISC(refs)
+    state = est.init_state()
+    for t in range(T):
+        state, _ = est.step(state, subj[t])
+    with pytest.raises(ValueError, match="past the end"):
+        est.step(state, subj[0])
+
+
+def test_incremental_eventseg_matches_batch_forward(scan):
+    import jax.numpy as jnp
+
+    from brainiak_tpu.eventseg.event import (_forward_pass,
+                                             _logprob_obs_core)
+    subj, _ = scan
+    rng = np.random.RandomState(1)
+    pat = rng.randn(V, K)
+    model = EventSegment(n_events=K)
+    model.set_event_patterns(pat)
+    log_P, log_p_start, _ = model._build_transitions(T)
+    logprob = np.asarray(_logprob_obs_core(
+        jnp.asarray(subj.T), jnp.asarray(pat),
+        jnp.asarray(np.full(K, 2.0))))
+    lp_ext = np.hstack([logprob, np.full((T, 1), -np.inf)])
+    batch_alpha = np.asarray(_forward_pass(
+        jnp.asarray(lp_ext), jnp.asarray(log_P),
+        jnp.asarray(log_p_start))[0])
+
+    _, outs = _drive(IncrementalEventSegment(model, n_trs=T,
+                                             var=2.0), subj)
+    for t in range(T):
+        row, ref = outs[t]["log_alpha"], batch_alpha[t]
+        finite = np.isfinite(ref)
+        assert np.array_equal(np.isfinite(row), finite)
+        assert np.max(np.abs(row[finite] - ref[finite])) < 1e-8
+        # the emitted posterior is exp(scaled alpha): a probability
+        # row over the K events + the sink state
+        post = outs[t]["posterior"]
+        assert abs(post.sum() - 1.0) < 1e-8
+
+
+def test_incremental_eventseg_requires_patterns_and_var():
+    model = EventSegment(n_events=K)
+    with pytest.raises(ValueError, match="event patterns"):
+        IncrementalEventSegment(model, n_trs=T)
+    model.set_event_patterns(np.random.RandomState(0).randn(V, K))
+    with pytest.raises(ValueError, match="var="):
+        IncrementalEventSegment(model, n_trs=T)
+    # var from fit()-style attribute works too
+    model.event_var_ = 3.0
+    est = IncrementalEventSegment(model, n_trs=T)
+    assert est.n_events == K
+
+
+def test_estimators_report_state_size(scan):
+    _, refs = scan
+    assert OnlineZScore(V).state_nbytes > 0
+    small = OnlineISC(refs).state_nbytes
+    windowed = OnlineISC(refs, window=8).state_nbytes
+    assert windowed > small  # the ring buffer costs W x V
+
+
+def test_full_scan_retraces_at_most_one_per_estimator(scan):
+    subj, refs = scan
+    model = EventSegment(n_events=K)
+    model.set_event_patterns(np.random.RandomState(2).randn(V, K))
+    for est in (OnlineZScore(V), OnlineISC(refs, window=6),
+                IncrementalEventSegment(model, n_trs=T, var=2.0)):
+        _drive(est, subj)
+    sites = {}
+    for labels, value in obs_metrics.counter(
+            "retrace_total").samples():
+        if str(labels.get("site", "")).startswith("realtime."):
+            sites[labels["site"]] = value
+    assert all(count <= 1.0 for count in sites.values()), sites
+
+
+def test_online_isc_is_stable_on_raw_fp32_intensities():
+    """Raw fMRI intensities (mean >> std) in float32: the anchored
+    sufficient statistics must stay parity-close to the batch
+    isc(), where naive raw moments would cancel catastrophically
+    (the TPU-path configuration streams fp32)."""
+    from brainiak_tpu.isc import isc
+    rng = np.random.RandomState(11)
+    t_len = 60
+    subj = (1000.0 + 10.0 * rng.randn(t_len, V)).astype(np.float32)
+    refs = (1000.0 + 10.0 * rng.randn(t_len, V, R)).astype(
+        np.float32)
+    est = OnlineISC(refs, window=16, dtype=np.float32)
+    state = est.init_state()
+    for t in range(t_len):
+        state, out = est.step(state, subj[t])
+    stacked = np.concatenate(
+        [subj[:, :, None], refs], axis=2).astype(np.float64)
+    batch = isc(stacked)  # float64 reference
+    err = np.nanmax(np.abs(np.asarray(out["isc"],
+                                      dtype=np.float64) - batch[0]))
+    assert np.isfinite(np.asarray(out["isc"])).all()
+    assert err < 1e-3, err
+    # windowed half too: last-16-TR window vs the float64 batch
+    stacked_w = stacked[t_len - 16:]
+    batch_w = isc(stacked_w)
+    err_w = np.nanmax(np.abs(np.asarray(
+        out["isc_windowed"], dtype=np.float64) - batch_w[0]))
+    assert err_w < 1e-3, err_w

@@ -278,3 +278,79 @@ def test_acceptance_two_models_waves_eviction_restart(
     assert all(rec.ok for _, rec in out2)
     assert serve_retrace_total() == 0
     assert summary2["aot"]["hits"] > 0
+
+
+# -- ISSUE 15 satellite: the low-latency single-request fast path -----
+
+def test_low_latency_submit_skips_the_batch_window(srm_model):
+    """submit(low_latency=True) dispatches a singleton on the next
+    tick: the round trip completes in a fraction of a max_wait_s
+    deliberately set far beyond the test timeout (waiting out the
+    window would time the ticket out)."""
+    policy = BucketPolicy(max_batch=8, max_wait_s=30.0)
+    res = _residency({"m": srm_model}, policy=policy)
+    warm, measured = _srm_requests(srm_model, 2, tr_choices=(6,))
+    with ServeService(res) as svc:
+        svc.submit(warm, low_latency=True).result(timeout=60)
+        t0 = time.monotonic()
+        rec = svc.submit(measured,
+                         low_latency=True).result(timeout=5.0)
+        elapsed = time.monotonic() - t0
+        engine = res.acquire("m").engine
+        n_batches = engine.summary()["n_batches"]
+    assert rec.ok
+    assert elapsed < 5.0          # never waited out the 30 s window
+    assert n_batches == 2         # one dispatch per expedited submit
+    w = np.asarray(srm_model.w_[measured.subject])
+    np.testing.assert_allclose(np.asarray(rec.result),
+                               w.T @ np.asarray(measured.x),
+                               atol=1e-5)
+
+
+def test_low_latency_expedites_queued_bucket_mates(srm_model):
+    """Requests already queued in the same bucket ride the expedited
+    batch — the fast path never reorders or strands them."""
+    policy = BucketPolicy(max_batch=8, max_wait_s=30.0)
+    res = _residency({"m": srm_model}, policy=policy)
+    reqs = _srm_requests(srm_model, 3, tr_choices=(6,))
+    with ServeService(res) as svc:
+        svc.submit(reqs[0], low_latency=True).result(timeout=60)
+        slow = svc.submit(reqs[1])            # batched: would wait
+        fast = svc.submit(reqs[2], low_latency=True)
+        rec_fast = fast.result(timeout=5.0)
+        rec_slow = slow.result(timeout=5.0)   # rode the same flush
+    assert rec_fast.ok and rec_slow.ok
+    assert rec_slow.bucket == rec_fast.bucket
+
+
+def test_engine_expedite_flushes_the_request_bucket(srm_model):
+    """Engine-level: expedite() flushes exactly the bucket holding
+    the request, and reports False when nothing is queued."""
+    from brainiak_tpu.serve.engine import InferenceEngine
+
+    engine = InferenceEngine(
+        srm_model, policy=BucketPolicy(max_batch=8,
+                                       max_wait_s=30.0))
+    req = _srm_requests(srm_model, 1, tr_choices=(6,))[0]
+    assert engine.submit(req) is None
+    assert engine.expedite(req) is True
+    records = engine.drain()
+    assert len(records) == 1 and records[0].ok
+    assert engine.expedite(req) is False  # bucket already empty
+
+
+def test_low_latency_flag_is_not_sticky_across_resubmits(srm_model):
+    """A request submitted low_latency once and later resubmitted
+    as batched traffic (submit or submit_many) must not keep the
+    fast-path flag."""
+    res = _residency({"m": srm_model})
+    req = _srm_requests(srm_model, 1, tr_choices=(6,))[0]
+    with ServeService(res) as svc:
+        svc.submit(req, low_latency=True).result(timeout=60)
+        assert req._low_latency is True
+        req.submitted = None
+        svc.submit(req).result(timeout=60)
+        assert req._low_latency is False
+        req.submitted = None
+        svc.submit_many([req])[0].result(timeout=60)
+        assert req._low_latency is False

@@ -46,7 +46,7 @@ def test_run_checks_json_output():
         "external", "stdlib", "doc-defaults", "resilient-fits",
         "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
         "serve", "service", "federation", "distla", "encoding",
-        "kernels", "data"}
+        "kernels", "data", "realtime"}
     assert payload["files"] > 100
     seconds = payload["gate_seconds"]
     assert set(seconds) == set(payload["gates"])
@@ -653,6 +653,95 @@ def test_data_gate_classifies_failures(monkeypatch):
     rc.check_data(findings)
     assert [f.code for f in findings] == ["DAT001"]
     assert "rc=3" in findings[0].message
+
+
+# -- ISSUE 15: the realtime gate (RT001) ------------------------------
+
+def test_realtime_gate_passes_on_live_package():
+    """The realtime gate (RT001) smoke-runs the closed-loop tier
+    selfcheck — online-vs-batch parity at every prefix, resume-mid-
+    scan parity after an injected preemption, retrace stability
+    across repeat sessions with the warm low-latency serve hop —
+    and passes on the live tree (ISSUE 15)."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_realtime(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_realtime_gate_classifies_failures(monkeypatch):
+    """A failing realtime selfcheck is reported as RT001, with
+    retrace instability, a broken resume, a failed serve hop, and
+    online-vs-batch parity failure each named distinctly."""
+    rc = _load_run_checks()
+
+    def fake_child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    monkeypatch.setattr(rc, "_REALTIME_CHILD", fake_child(
+        {"ok": False, "max_err": 0.2, "tol": 1e-6,
+         "resume_ok": True, "serve_ok": True,
+         "retraces": {"realtime.isc_step": 1.0}}))
+    findings = []
+    rc.check_realtime(findings)
+    assert [f.code for f in findings] == ["RT001"]
+    assert "parity" in findings[0].message
+
+    monkeypatch.setattr(rc, "_REALTIME_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 1e-6,
+         "resume_ok": False, "serve_ok": True, "retraces": {}}))
+    findings = []
+    rc.check_realtime(findings)
+    assert [f.code for f in findings] == ["RT001"]
+    assert "resume" in findings[0].message
+
+    monkeypatch.setattr(rc, "_REALTIME_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 1e-6,
+         "resume_ok": True, "serve_ok": False, "retraces": {}}))
+    findings = []
+    rc.check_realtime(findings)
+    assert [f.code for f in findings] == ["RT001"]
+    assert "serve" in findings[0].message.lower()
+
+    monkeypatch.setattr(rc, "_REALTIME_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 1e-6,
+         "resume_ok": True, "serve_ok": True,
+         "retraces": {"realtime.evseg_step": 5.0}}))
+    findings = []
+    rc.check_realtime(findings)
+    assert [f.code for f in findings] == ["RT001"]
+    assert "rebuilt" in findings[0].message
+    assert "realtime.evseg_step=5" in findings[0].message
+
+    monkeypatch.setattr(rc, "_REALTIME_CHILD", "raise SystemExit(3)")
+    findings = []
+    rc.check_realtime(findings)
+    assert [f.code for f in findings] == ["RT001"]
+    assert "rc=3" in findings[0].message
+
+
+def test_resilient_fits_method_entries(tmp_path, monkeypatch):
+    """A RESILIENT_FITS entry may name the guarded method as
+    "Class.method" (the realtime session's run()); a module whose
+    named method lacks the contract is caught."""
+    rc = _load_run_checks()
+    bad = tmp_path / "loop.py"
+    bad.write_text(
+        "class RealtimeSession:\n"
+        "    def run(self, n_trs=None):\n"
+        "        pass\n")
+    monkeypatch.setattr(
+        rc, "RESILIENT_FITS",
+        {str(bad.relative_to(tmp_path)): ("RealtimeSession.run",)})
+    monkeypatch.setattr(rc, "REPO", str(tmp_path))
+    findings = []
+    rc.check_resilient_fits(findings)
+    messages = [f.message for f in findings]
+    assert any("RealtimeSession.run() does not accept "
+               "checkpoint_dir=" in m for m in messages), messages
+    assert any("run_resilient_loop" in m for m in messages)
 
 
 # -- ISSUE 12: the obs-live gate (OBS002) -----------------------------

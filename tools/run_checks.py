@@ -77,6 +77,14 @@ kernels    smoke-runs the fused-kernels selfcheck
            fused SUMMA ring step, MTTKRP factor reconstruction,
            device epoch norm), a -inf/NaN mask mismatch, or
            program rebuilds across the repeat pass (KRN001)
+realtime   smoke-runs the closed-loop tier selfcheck
+           (``brainiak_tpu.realtime.selfcheck``): online-vs-
+           batch parity (OnlineISC vs ``isc()``, incremental
+           event segmentation vs the fused batch forward pass,
+           at every prefix, ~1e-6), resume-mid-scan parity
+           after an injected preemption, and retrace stability
+           across repeat sessions incl. the warm low-latency
+           ServeService hop (RT001)
 ========== ===================================================
 
 ``# noqa`` suppresses stdlib/doc findings on a line; jaxlint uses
@@ -116,7 +124,7 @@ MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
          "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
          "serve", "service", "federation", "distla", "encoding",
-         "kernels", "data")
+         "kernels", "data", "realtime")
 
 
 def python_sources():
@@ -315,7 +323,9 @@ def check_doc_defaults(findings):
 # drives its loop through resilience.run_resilient_loop (which
 # applies the non-finite guard) or delegates by forwarding
 # checkpoint_dir= to another estimator's fit (FastSRM ->
-# reduced-space DetSRM).
+# reduced-space DetSRM).  An entry may name the guarded loop method
+# explicitly as "Class.method" for stateful drivers whose loop is
+# not a fit() (the realtime closed-loop session's run()).
 RESILIENT_FITS = {
     "brainiak_tpu/data/streaming_fit.py": ("IncrementalSRM",),
     "brainiak_tpu/encoding/ridge.py": ("RidgeEncoder",
@@ -327,6 +337,7 @@ RESILIENT_FITS = {
     "brainiak_tpu/factoranalysis/htfa.py": ("HTFA",),
     "brainiak_tpu/reprsimil/brsa.py": ("BRSA",),
     "brainiak_tpu/eventseg/event.py": ("EventSegment",),
+    "brainiak_tpu/realtime/loop.py": ("RealtimeSession.run",),
 }
 
 
@@ -362,19 +373,20 @@ def check_resilient_fits(findings):
                 "no run_resilient_loop use (or checkpointed fit "
                 "delegation); iterative fits must run under the "
                 "resilience guard"))
-        class_fits = {}
+        class_methods = {}
         for node in ast.walk(tree):
             if isinstance(node, ast.ClassDef):
                 for sub in node.body:
-                    if isinstance(sub, ast.FunctionDef) \
-                            and sub.name == "fit":
-                        class_fits[node.name] = sub
+                    if isinstance(sub, ast.FunctionDef):
+                        class_methods[(node.name, sub.name)] = sub
         for cls in classes:
-            fit = class_fits.get(cls)
+            cls, _, method = cls.partition(".")
+            method = method or "fit"
+            fit = class_methods.get((cls, method))
             if fit is None:
                 findings.append(Finding(
                     relpath, 1, "CHK102",
-                    f"class {cls} defines no fit() "
+                    f"class {cls} defines no {method}() "
                     "(resilience gate)"))
                 continue
             args = [a.arg for a in (fit.args.posonlyargs
@@ -384,8 +396,8 @@ def check_resilient_fits(findings):
                 if required not in args:
                     findings.append(Finding(
                         relpath, fit.lineno, "CHK102",
-                        f"{cls}.fit() does not accept {required}= "
-                        "(resilience contract)"))
+                        f"{cls}.{method}() does not accept "
+                        f"{required}= (resilience contract)"))
 
 
 # -- obs gate ---------------------------------------------------------
@@ -1085,6 +1097,46 @@ def check_data(findings):
         "data", classify)
 
 
+# -- realtime gate ----------------------------------------------------
+
+_REALTIME_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.realtime.selfcheck import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_realtime(findings):
+    """Closed-loop tier gate (RT001): smoke-run the realtime
+    selfcheck (``brainiak_tpu.realtime.selfcheck``): online-vs-batch
+    parity at every prefix (OnlineISC vs ``isc()``, incremental
+    event segmentation's scaled forward row vs the fused batch
+    forward pass), resume-mid-scan parity after an injected
+    preemption, and the retrace-stability contract — repeat sessions
+    (with a warm low-latency ServeService scoring hop) must keep
+    every ``realtime.*`` step program at <= 1 trace."""
+
+    def classify(verdict):
+        if not verdict.get("resume_ok", True):
+            return ("realtime session did not resume mid-scan with "
+                    "parity after the injected preemption (or the "
+                    "preempt fault never fired)")
+        if not verdict.get("serve_ok", True):
+            return ("realtime low-latency ServeService scoring hop "
+                    "returned error/empty records")
+        return (f"realtime online-vs-batch parity failure: "
+                f"max_err={verdict.get('max_err')} over tol="
+                f"{verdict.get('tol')}")
+
+    _run_selfcheck_gate(
+        findings, _REALTIME_CHILD, "RT001",
+        _rel(os.path.join(REPO, "brainiak_tpu", "realtime",
+                          "selfcheck.py")),
+        "realtime", classify)
+
+
 # -- external gate ----------------------------------------------------
 
 def run_external(findings):
@@ -1260,6 +1312,8 @@ def run_gates(only=None):
         timed("kernels", check_kernels, findings)
     if "data" in selected:
         timed("data", check_data, findings)
+    if "realtime" in selected:
+        timed("realtime", check_realtime, findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
@@ -1273,7 +1327,7 @@ def run_gates(only=None):
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
                        "jaxlint-deep", "obs", "obs-live", "regress",
                        "serve", "service", "federation", "distla",
-                       "encoding", "kernels", "data")
+                       "encoding", "kernels", "data", "realtime")
            if g in selected])
     return {
         "ok": not findings,
