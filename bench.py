@@ -99,6 +99,19 @@ SERVICE_REQUESTS = 128
 # better), and the shed ratio.  BENCH_FEDERATION_REQUESTS overrides.
 FEDERATION_REQUESTS = 128
 
+# elastic tier (fault-tolerant fleet, brainiak_tpu.serve.federation
+# .fleet): the deterministic chaos soak — heavy-tailed traffic
+# triples mid-run while a replica is stalled and killed under
+# injected faults; the supervisor fails its work over and scales
+# the fleet up off the shared AOT cache.  Gated on soak requests/s
+# (``vs_baseline`` = the same mix on a STATIC 2-replica fleet, no
+# faults — the price of surviving the chaos), post-failure p99
+# (lower is better), and the lost-ticket count (lower is better —
+# the committed fixtures hold it at ZERO, so any regression from
+# "every ticket resolves" fails ``obs regress --only elastic``
+# outright).  BENCH_ELASTIC_REQUESTS overrides.
+ELASTIC_REQUESTS = 96
+
 # distla tier (pod-scale SUMMA Gram, brainiak_tpu.ops.distla): the
 # on-chip workload is a [T, V] -> [V, V] sharded correlation at a
 # width whose replicated working set is already uncomfortable per
@@ -191,6 +204,15 @@ def _federation_n_requests():
     import os
     return int(os.environ.get("BENCH_FEDERATION_REQUESTS",
                               FEDERATION_REQUESTS))
+
+
+def _elastic_n_requests():
+    """The elastic tier's request count (``BENCH_ELASTIC_REQUESTS``
+    overrides) — one reader, same no-drift rule as the other
+    tiers."""
+    import os
+    return int(os.environ.get("BENCH_ELASTIC_REQUESTS",
+                              ELASTIC_REQUESTS))
 
 
 def _even_epochs_env(name, default):
@@ -1276,6 +1298,100 @@ def _federation_result_records(out):
     ]
 
 
+def elastic_tier_metrics(n_requests=ELASTIC_REQUESTS, seed=0):
+    """Elastic-fleet chaos soak throughput (ISSUE 16 satellite):
+    one :func:`~brainiak_tpu.serve.federation.fleet.chaos_soak`
+    (replica stalled, killed, failed over; traffic tripled;
+    fleet scaled up off the shared AOT cache), with the SAME
+    request mix on a static no-fault 2-replica fleet as the
+    baseline — ``vs_baseline`` is the survival tax.  A soak whose
+    non-shed/non-replica_lost error count is nonzero refuses to
+    emit numbers (same rule as the service/federation tiers);
+    unresolved tickets and replica_lost records are NOT refusals —
+    they are the gated lost-ticket metric itself."""
+    import jax
+
+    from brainiak_tpu.serve.federation.fleet import chaos_soak
+
+    with obs.span("bench.baseline"):
+        static = chaos_soak(n_requests=n_requests, seed=seed,
+                            chaos=False)
+    with obs.span("bench.soak"):
+        soak = chaos_soak(n_requests=n_requests, seed=seed,
+                          chaos=True)
+    for name, facts in (("static", static), ("soak", soak)):
+        other = {code: n for code, n in facts["by_code"].items()
+                 if code not in ("delivered", "shed_overload",
+                                 "replica_lost")}
+        if other:
+            raise RuntimeError(
+                f"elastic bench {name} round produced unexpected "
+                f"error records {other}; refusing to emit numbers")
+    lost = soak["n_unresolved"] + soak["n_replica_lost"]
+    return {"soak_requests_per_sec": soak["requests_per_sec"],
+            "static_requests_per_sec":
+                static["requests_per_sec"],
+            "post_failure_p99_s": soak.get("post_failure_p99_s",
+                                           0.0),
+            "lost_tickets": lost,
+            "n_unresolved": soak["n_unresolved"],
+            "n_replica_lost": soak["n_replica_lost"],
+            "n_shed": soak["n_shed"],
+            "failover": soak.get("failover"),
+            "scaled_replicas": soak.get("scaled_replicas", []),
+            "warm_retraces": soak.get("warm_retraces"),
+            "final_retraces": soak.get("final_retraces"),
+            "n_requests": soak["n_requests"],
+            "n_replicas": 2,
+            "backend": jax.default_backend()}
+
+
+def _elastic_result_records(out):
+    """The elastic tier's bench JSON lines — three records: soak
+    requests/s under chaos (``vs_baseline`` = soak rate over the
+    static-fleet rate on the same mix), post-failure p99
+    (``lower_is_better``: failover + scale-up must not melt the
+    tail), and the lost-ticket count (``lower_is_better`` with the
+    committed fixtures at ZERO: the first unresolved or
+    replica_lost ticket is an infinite-ratio regression).  Tier
+    split mirrors every other tier (``elastic`` on TPU,
+    ``elastic_cpu_fallback`` otherwise)."""
+    tier = "elastic" if out.get("backend") == "tpu" \
+        else "elastic_cpu_fallback"
+    config = {"n_requests": out["n_requests"],
+              "n_replicas": out["n_replicas"],
+              "backend": out.get("backend"),
+              "scaled_replicas": out.get("scaled_replicas")}
+    commit = _git_commit()
+
+    def rec(metric, value, unit, vs=0.0, direction=None,
+            stages=None):
+        r = {"schema_version": BENCH_SCHEMA_VERSION,
+             "metric": metric, "value": round(float(value), 6),
+             "unit": unit, "vs_baseline": vs, "tier": tier,
+             "config": config}
+        if direction:
+            r["direction"] = direction
+        if commit:
+            r["git_commit"] = commit
+        if stages:
+            r["stages"] = stages
+        return r
+
+    rps = float(out["soak_requests_per_sec"])
+    static = float(out.get("static_requests_per_sec") or 0.0)
+    vs = round(rps / static, 3) if static > 0 else 0.0
+    return [
+        rec("elastic_soak_requests_per_sec", rps, "requests/sec",
+            vs=vs, stages=out.get("stages")),
+        rec("elastic_post_failure_p99_seconds",
+            out["post_failure_p99_s"], "s",
+            direction="lower_is_better"),
+        rec("elastic_lost_tickets", out["lost_tickets"],
+            "requests", direction="lower_is_better"),
+    ]
+
+
 def _ts_key(ts):
     """Chronological sort key for possibly-absent ISO timestamps with
     heterogeneous UTC offsets (lexicographic comparison is wrong across
@@ -1508,6 +1624,18 @@ def measure_tier(tier):
                           tier=fed_tier)
             out["stages"] = _stage_seconds(mem.records)
             return out
+        if tier == "elastic":
+            out = elastic_tier_metrics(
+                n_requests=_elastic_n_requests())
+            # tier split by backend, same rule as every other tier
+            ela_tier = "elastic" if out["backend"] == "tpu" \
+                else "elastic_cpu_fallback"
+            obs.gauge("bench_elastic_requests_per_sec",
+                      unit="requests/sec").set(
+                          out["soak_requests_per_sec"],
+                          tier=ela_tier)
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "wb":
             vps = whole_brain_voxels_per_sec(
                 n_voxels=int(os.environ.get("BENCH_WB_VOXELS",
@@ -1588,6 +1716,7 @@ def main():
     _serve_main(responsive)
     _service_main(responsive)
     _federation_main(responsive)
+    _elastic_main(responsive)
     _distla_main(responsive)
     _encoding_main(responsive)
     _kernels_main(responsive)
@@ -1641,6 +1770,19 @@ def _federation_main(responsive):
                        _federation_result_records)
     except RuntimeError as exc:
         print(f"tier federation: {exc}", file=sys.stderr)
+
+
+def _elastic_main(responsive):
+    """Elastic tier: chaos-soak requests/s vs a static 2-replica
+    fleet, post-failure p99, lost-ticket count.  Like the
+    federation tier, a failing round (unexpected error records)
+    refuses to emit numbers without aborting the driver."""
+    import sys
+    try:
+        _aux_tier_main(responsive, "elastic",
+                       _elastic_result_records)
+    except RuntimeError as exc:
+        print(f"tier elastic: {exc}", file=sys.stderr)
 
 
 def _distla_main(responsive):

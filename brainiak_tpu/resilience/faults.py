@@ -37,6 +37,21 @@ Kinds
     from inside retry-wrapped I/O (NIfTI reads, checkpoint save or
     restore), exercising :func:`brainiak_tpu.resilience.retry.retry`.
     Here ``at_step`` counts I/O calls to let through first.
+``"replica_crash"``
+    :func:`crash_point` raises :class:`ReplicaCrashError` from inside
+    the serving loop (:class:`~brainiak_tpu.serve.service.
+    ServeService` calls it once per loop iteration, with ``step`` =
+    the iteration count) — the loop thread dies WITHOUT resolving its
+    queued tickets, which is exactly what a preempted replica host
+    looks like to the fleet.  The
+    :class:`~brainiak_tpu.serve.federation.fleet.FleetSupervisor`
+    failover path is the recovery under test.
+``"slow_replica"``
+    :func:`slow_point` returns a stall duration (``delay_s``, default
+    0.05 s, settable via ``leaf=``) the serving loop sleeps between
+    ticks while the fault is armed — a replica that is alive but not
+    making progress, the gray-failure half of replica death.  The
+    supervisor's ``degraded`` hysteresis is the consumer.
 
 Every fault fires ``times`` times (default 1) and is inert afterwards,
 so a retry or rollback that re-runs the failed operation succeeds —
@@ -57,15 +72,23 @@ __all__ = [
     "FAULT_ENV_VAR",
     "InjectedIOError",
     "PreemptionError",
+    "ReplicaCrashError",
     "corrupt_state",
+    "crash_point",
     "inject",
     "io_point",
     "preempt_point",
+    "slow_point",
 ]
 
 FAULT_ENV_VAR = "BRAINIAK_TPU_FAULT"
 
-KINDS = ("preempt", "nan", "io_error")
+KINDS = ("preempt", "nan", "io_error", "replica_crash",
+         "slow_replica")
+
+#: Stall per loop iteration while a ``slow_replica`` fault with no
+#: explicit ``leaf=`` duration is armed.
+DEFAULT_SLOW_REPLICA_S = 0.05
 
 
 class PreemptionError(RuntimeError):
@@ -76,8 +99,15 @@ class InjectedIOError(OSError):
     """Injected transient I/O failure (retriable)."""
 
 
+class ReplicaCrashError(RuntimeError):
+    """Injected replica death: the serving loop thread was 'killed'
+    mid-run, stranding its queued work (the federation failover
+    path's trigger)."""
+
+
 class _Fault:
-    def __init__(self, kind, at_step=0, times=1, leaf=None):
+    def __init__(self, kind, at_step=0, times=1, leaf=None,
+                 target=None):
         if kind not in KINDS:
             raise ValueError(
                 f"unknown fault kind {kind!r}; expected one of {KINDS}")
@@ -85,6 +115,7 @@ class _Fault:
         self.at_step = int(at_step)
         self.times = int(times)
         self.leaf = leaf
+        self.target = target  # replica name for the serve kinds
         self.fired = 0
         self.seen = 0  # io_error: calls observed so far
 
@@ -102,13 +133,17 @@ _env_spec_seen = None
 
 
 @contextmanager
-def inject(kind, at_step=0, times=1, leaf=None):
+def inject(kind, at_step=0, times=1, leaf=None, target=None):
     """Activate a fault for the dynamic extent of the ``with`` block.
 
     Yields the fault record; ``fault.fired`` afterwards tells a test
-    whether the fault actually triggered.
-    """
-    fault = _Fault(kind, at_step=at_step, times=times, leaf=leaf)
+    whether the fault actually triggered.  ``target`` scopes the
+    serve kinds (``replica_crash``/``slow_replica``) to one named
+    replica — the chaos soak kills a SPECIFIC replica while the
+    rest of the fleet keeps serving (None hits whichever loop
+    iterates first)."""
+    fault = _Fault(kind, at_step=at_step, times=times, leaf=leaf,
+                   target=target)
     _active.append(fault)
     try:
         yield fault
@@ -138,9 +173,10 @@ def _from_env():
     return _env_fault
 
 
-def _match(kind):
+def _match(kind, where=None):
     for fault in reversed(_active):
-        if fault.kind == kind and fault.fired < fault.times:
+        if fault.kind == kind and fault.fired < fault.times and \
+                fault.target in (None, where):
             return fault
     env = _from_env()
     if env is not None and env.kind == kind and env.fired < env.times:
@@ -188,6 +224,41 @@ def corrupt_state(state, step, site="fit"):
     out = dict(state)
     out[name] = poisoned
     return out
+
+
+def crash_point(step, site="serve", name=None):
+    """Hook called once per serving-loop iteration (lock-free — the
+    loop calls it BEFORE acquiring any lock, so an injected death
+    never strands a held lock); raises :class:`ReplicaCrashError`
+    when a ``"replica_crash"`` fault targeting ``name`` (or any
+    replica) has reached its trigger step."""
+    fault = _match("replica_crash", where=name)
+    if fault is not None and step >= fault.at_step:
+        fault.fired += 1
+        obs_sink.event("fault", kind="replica_crash", site=site,
+                       step=step, replica=name)
+        raise ReplicaCrashError(
+            f"injected replica crash in {site} at step {step}")
+
+
+def slow_point(step, site="serve", name=None):
+    """Hook called once per serving-loop iteration; returns the
+    seconds the loop should stall (0.0 when no ``"slow_replica"``
+    fault targeting ``name`` — or any replica — is armed or its
+    trigger step is not reached).  The fault's ``leaf=`` carries an
+    explicit stall duration; default
+    :data:`DEFAULT_SLOW_REPLICA_S`.  Unlike the raise-style kinds a
+    slow replica degrades EVERY iteration while armed, so each
+    returned stall consumes one of the fault's ``times``."""
+    fault = _match("slow_replica", where=name)
+    if fault is None or step < fault.at_step:
+        return 0.0
+    fault.fired += 1
+    delay = (float(fault.leaf) if fault.leaf is not None
+             else DEFAULT_SLOW_REPLICA_S)
+    obs_sink.event("fault", kind="slow_replica", site=site,
+                   step=step, delay_s=delay)
+    return delay
 
 
 def io_point(path="", site="io"):

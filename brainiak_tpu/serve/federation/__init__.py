@@ -21,17 +21,34 @@ placement discipline, arXiv:2403.07128) — three coupled pieces:
   bounds ingress with a typed reject-with-``retry_after`` fast path
   before enqueue, browned out by the PR 11 SLO burn-rate tracker;
   :class:`TrafficGenerator`/:func:`replay` soak it with
-  fmrisim-driven heavy-tailed request mixes.
+  fmrisim-driven heavy-tailed request mixes;
+- **elastic fault tolerance** — :class:`FleetSupervisor` health-
+  checks replicas with hysteresis, fails a dead replica's stranded
+  work over to survivors (exactly-one-ticket preserved; typed
+  ``replica_lost`` records, never silence), autoscales between
+  ``min_replicas``/``max_replicas`` off the ``/metrics`` signals
+  (joiners warm-start retrace-free from the shared AOT cache), and
+  reshards resident models with drain-and-handoff when the device
+  set changes.  :func:`chaos_soak` exercises all of it under
+  injected ``replica_crash``/``slow_replica`` faults
+  (:mod:`brainiak_tpu.resilience.faults`).
 
 CI: the ``federation`` gate (SRV003 in ``tools/run_checks.py``)
 drives replica warm-start at true process granularity and runs
 :mod:`~brainiak_tpu.serve.federation.selfcheck` on the 8-device CPU
-mesh.  See docs/serving.md ("Pod-scale federation").
+mesh; the ``fleet`` gate (SRV004) runs
+:mod:`~brainiak_tpu.serve.federation.fleet_selfcheck` — the chaos
+soak — on the same mesh.  See docs/serving.md ("Pod-scale
+federation", "Elastic fleet").
 """
 
 from .admission import (  # noqa: F401
     AdmissionController,
     Shed,
+)
+from .fleet import (  # noqa: F401
+    FleetSupervisor,
+    chaos_soak,
 )
 from .router import (  # noqa: F401
     LocalReplica,
@@ -45,10 +62,12 @@ from .traffic import (  # noqa: F401
 
 __all__ = [
     "AdmissionController",
+    "FleetSupervisor",
     "LocalReplica",
     "Router",
     "Shed",
     "TrafficGenerator",
+    "chaos_soak",
     "replay",
     "scrape_replica_state",
 ]

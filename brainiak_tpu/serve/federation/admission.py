@@ -117,6 +117,15 @@ class AdmissionController:
                               * self.brownout_factor))
         return self.max_depth
 
+    def burning(self):
+        """Whether the attached SLO tracker currently reports a
+        burn-rule violation (False without a tracker) — the browned-
+        out state, exposed for the fleet supervisor's scale-up
+        signal (same throttled poll as :meth:`depth_bound`)."""
+        if self.slo is None:
+            return False
+        return bool(self._poll_slo())
+
     def evaluate(self, queued_depth) -> Optional[Shed]:
         """None to admit a request at ``queued_depth``, else the
         :class:`Shed` (O(1); the throttled SLO poll is the only

@@ -1,0 +1,719 @@
+"""Elastic fault-tolerant fleet: supervised replicas, failover
+re-placement, autoscaling, live resharding.
+
+The PR 13 federation serves pod-scale traffic but is statically
+provisioned and fragile: ``--replicas N`` is fixed at launch, a
+replica that dies takes its queued work with it, and sharded
+residency assumes a device set that never changes.  None of that
+survives real TPU-pod operation, where preemption and load swings
+are the norm (arXiv:2112.09017).  :class:`FleetSupervisor` closes
+the loop from the signals the obs plane already exports:
+
+- **health checking with hysteresis** — every supervision round
+  probes each replica's loop-iteration heartbeat
+  (:meth:`~brainiak_tpu.serve.service.ServeService.heartbeat`, the
+  lock-free progress counter) and ``/readyz`` readiness, and walks
+  a ``healthy | degraded | dead`` state machine with
+  consecutive-probe thresholds (``degraded_after`` bad probes to
+  degrade, ``dead_after`` down probes to declare death,
+  ``healthy_after`` good probes to heal) — one missed beat never
+  kills a replica, and a flapping one never heals instantly;
+- **failover re-placement** — a replica declared dead is detached
+  from the :class:`~brainiak_tpu.serve.federation.router.Router`,
+  its accepted-but-undelivered work harvested
+  (:meth:`~brainiak_tpu.serve.service.ServeService.
+  unresolved_work`) and re-placed onto the survivors as one atomic
+  wave per survivor (:meth:`~brainiak_tpu.serve.federation.router.
+  Router.failover`): every caller-held ticket still resolves
+  exactly once — delivered by a survivor, shed by admission, or a
+  typed ``replica_lost`` record when past deadline or out of
+  survivors.  Never silence;
+- **autoscaling** — replica count floats between ``min_replicas``
+  and ``max_replicas`` on the signals already on ``/metrics``:
+  mean queue depth (``serve_queue_depth`` family), the shed-ratio
+  delta, and the SLO burn state
+  (:meth:`~brainiak_tpu.serve.federation.admission.
+  AdmissionController.burning`).  Scale-up builds replicas through
+  the caller's ``factory`` over the SHARED content-addressed AOT
+  cache, so a mid-run joiner serves at zero retraces (the SRV003
+  property, extended to scale-up and gated by SRV004); scale-down
+  detaches and drains through ``shutdown(drain=True)``;
+- **live resharding** — when the device set changes,
+  :meth:`FleetSupervisor.reshard_replica` runs drain-and-handoff:
+  detach from the router (traffic flows to the rest of the fleet),
+  wait out :meth:`~brainiak_tpu.serve.service.ServeService.
+  drained`, swap the residency layout under the engine lock
+  (per-shard charges recomputed via
+  :func:`~brainiak_tpu.serve.artifacts.model_shard_nbytes` over the
+  new device count), re-attach.  No request ever observes a
+  half-resharded model.
+
+All of it is exercised deterministically by :func:`chaos_soak` —
+fmrisim heavy-tailed traffic that triples mid-run while a targeted
+``replica_crash`` fault (:mod:`brainiak_tpu.resilience.faults`)
+kills a replica — the shared driver behind the SRV004 gate
+(``tools/run_checks.py``), the ``elastic`` bench tier, and the
+fleet tests.  See docs/serving.md ("Elastic fleet").
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from ...obs import metrics as obs_metrics
+from ...obs import sink as obs_sink
+from ...resilience import faults
+
+__all__ = ["FleetSupervisor", "chaos_soak"]
+
+#: Health states, in descending order of usefulness; the
+#: ``serve_replica_health`` gauge publishes their numeric rank.
+HEALTH_STATES = ("dead", "degraded", "healthy")
+
+
+class _ReplicaHealth:
+    """One replica's supervision ledger (owned by the supervisor's
+    poll lock): hysteresis counters + the last heartbeat reading."""
+
+    def __init__(self):
+        self.state = "healthy"
+        self.bad = 0          # consecutive slow/unready probes
+        self.down = 0         # consecutive dead-thread probes
+        self.good = 0         # consecutive clean probes
+        self.last_iters = None  # loop-iteration count at last probe
+
+
+class FleetSupervisor:
+    """Supervision, failover, autoscaling, and resharding over a
+    :class:`~brainiak_tpu.serve.federation.router.Router` (see
+    module docstring).
+
+    Parameters
+    ----------
+    router : :class:`~brainiak_tpu.serve.federation.router.Router`
+        The fleet under supervision; membership is edited through
+        its ``add_replica``/``remove_replica``.
+    factory : callable ``(name) -> LocalReplica``, optional
+        Builds a warm replica for scale-up (and for the
+        no-survivors failover path).  Share one AOT cache directory
+        across every replica the factory builds — that is what
+        makes mid-run scale-up retrace-free.  Without a factory the
+        fleet can shrink but never grow.
+    min_replicas, max_replicas : int
+        Autoscale bounds (scale-down never goes below
+        ``min_replicas``; scale-up never above ``max_replicas``).
+    degraded_after, dead_after, healthy_after : int
+        Hysteresis thresholds: consecutive slow/unready probes
+        before ``healthy -> degraded``, consecutive dead-thread
+        probes before ``-> dead``, and consecutive clean probes
+        before ``degraded -> healthy``.
+    scale_up_depth, scale_down_depth : float
+        Mean queued requests per replica beyond which the fleet
+        grows, and at-or-below which it is scale-down-eligible.
+    scale_down_after : int
+        Consecutive idle polls (depth at/under ``scale_down_depth``,
+        no sheds, no SLO burn) before one replica drains away —
+        scale-down is the slowest decision by design.
+    drain_timeout_s : float
+        Bound on graceful drains (gray-failure decommission,
+        scale-down, reshard handoff).
+    clock, sleep : callables
+        Time sources (tests inject fakes).
+
+    Threading: :meth:`poll` is the whole control loop, deterministic
+    and re-entrant-safe (one round at a time under the poll lock);
+    :meth:`start` merely drives it from a background thread.  The
+    supervisor holds NO lock while calling into router or services,
+    so a slow drain can never deadlock a probe.
+    """
+
+    def __init__(self, router, factory=None, min_replicas=1,
+                 max_replicas=4, degraded_after=2, dead_after=2,
+                 healthy_after=2, scale_up_depth=8.0,
+                 scale_down_depth=1.0, scale_down_after=3,
+                 drain_timeout_s=30.0, clock=time.monotonic,
+                 sleep=time.sleep):
+        if min_replicas < 0 or max_replicas < max(min_replicas, 1):
+            raise ValueError(
+                f"need 0 <= min_replicas <= max_replicas (>= 1), "
+                f"got {min_replicas}/{max_replicas}")
+        self.router = router
+        self.factory = factory
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.degraded_after = int(degraded_after)
+        self.dead_after = int(dead_after)
+        self.healthy_after = int(healthy_after)
+        self.scale_up_depth = float(scale_up_depth)
+        self.scale_down_depth = float(scale_down_depth)
+        self.scale_down_after = int(scale_down_after)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.clock = clock
+        self._sleep = sleep
+        # one supervision round at a time; all ledger state below
+        # is read/written inside poll() only
+        self._poll_lock = threading.Lock()
+        self._health = {}        # guarded-by: _poll_lock
+        self._dead = {}          # guarded-by: _poll_lock (replica)
+        self._last_shed = 0      # guarded-by: _poll_lock
+        self._idle_polls = 0     # guarded-by: _poll_lock
+        self._spawn_seq = 0      # guarded-by: _poll_lock
+        self._n_polls = 0        # guarded-by: _poll_lock
+        self._n_failovers = 0    # guarded-by: _poll_lock
+        self._scaled_up = []     # guarded-by: _poll_lock
+        self._scaled_down = []   # guarded-by: _poll_lock
+        # background driver bookkeeping
+        self._bg_lock = threading.Lock()
+        self._thread = None      # guarded-by: _bg_lock
+        self._stop = threading.Event()
+
+    # -- probing ------------------------------------------------------
+
+    def _probe(self, replica, health):
+        """One replica's instantaneous verdict: ``"ok"``, ``"slow"``
+        (alive but unready or not progressing past queued work), or
+        ``"down"`` (loop thread dead)."""
+        service = getattr(replica, "service", None)
+        if service is None:  # non-local replicas: depth-read probe
+            try:
+                replica.queue_depth()
+                return "ok"
+            except Exception:
+                return "down"
+        alive, iters, n_ingress = service.heartbeat()
+        if not alive:
+            return "down"
+        ready, _ = service.readiness()
+        # iters frozen between probes while work waits (gauge depth
+        # OR live ingress — a stalled loop never refreshes gauges)
+        stalled = (health.last_iters is not None
+                   and iters <= health.last_iters
+                   and (n_ingress > 0
+                        or replica.queue_depth() > 0))
+        health.last_iters = iters
+        return "ok" if ready and not stalled else "slow"
+
+    def _update_health(self, name, probe):
+        """Walk the hysteresis state machine for one probe verdict;
+        returns the (possibly new) state."""
+        health = self._health.setdefault(name, _ReplicaHealth())
+        if probe == "down":
+            health.down += 1
+            health.good = 0
+            if health.down >= self.dead_after:
+                health.state = "dead"
+            elif health.state == "healthy":
+                health.state = "degraded"
+        elif probe == "slow":
+            health.bad += 1
+            health.good = 0
+            health.down = 0
+            if health.state == "healthy" \
+                    and health.bad >= self.degraded_after:
+                health.state = "degraded"
+        else:
+            health.good += 1
+            health.bad = 0
+            health.down = 0
+            if health.state == "degraded" \
+                    and health.good >= self.healthy_after:
+                health.state = "healthy"
+        obs_metrics.gauge(
+            "serve_replica_health",
+            help="supervisor verdict per replica "
+                 "(2 healthy, 1 degraded, 0 dead)").set(
+            HEALTH_STATES.index(health.state), replica=name)
+        return health.state
+
+    # -- the control loop ---------------------------------------------
+
+    def poll(self):
+        """One supervision round: probe every routed replica, walk
+        health states, fail over the newly dead, then evaluate the
+        autoscale signals.  Deterministic — tests and the chaos soak
+        call this directly; :meth:`start` drives it on a timer.
+        Returns the round's actions
+        (``{"states", "failed_over", "scaled_up", "scaled_down"}``).
+        """
+        with self._poll_lock:
+            return self._poll_locked()
+
+    def _poll_locked(self):  # requires-lock: _poll_lock
+        self._n_polls += 1
+        actions = {"states": {}, "failed_over": [],
+                   "scaled_up": [], "scaled_down": []}
+        for replica in list(self.router.replicas):
+            health = self._health.setdefault(replica.name,
+                                             _ReplicaHealth())
+            state = self._update_health(
+                replica.name, self._probe(replica, health))
+            actions["states"][replica.name] = state
+            if state == "dead":
+                result = self._fail_over(replica)
+                actions["failed_over"].append(
+                    {"replica": replica.name, **result})
+        self._autoscale(actions)
+        return actions
+
+    def _fail_over(self, replica):  # requires-lock: _poll_lock
+        """Detach a dead replica and re-place its work (see module
+        docstring).  A replica whose thread still breathes (declared
+        dead by hysteresis — the gray-failure case) is decommissioned
+        through a bounded graceful drain instead: the drain delivers
+        everything, so there is nothing to re-place."""
+        name = replica.name
+        try:
+            self.router.remove_replica(name)
+        except KeyError:
+            pass  # already detached by an earlier round
+        self._dead[name] = replica
+        service = getattr(replica, "service", None)
+        work = []
+        if service is not None:
+            if service.alive():
+                service.shutdown(drain=True,
+                                 timeout=self.drain_timeout_s)
+            else:
+                work = service.unresolved_work()
+        # out of survivors: replace the dead replica BEFORE
+        # re-placement so the harvested work lands somewhere
+        # instead of resolving replica_lost wholesale
+        if not self.router.replicas and self.factory is not None \
+                and len(self.router.replicas) < self.max_replicas:
+            self._scale_up("failover replacement")
+        result = self.router.failover(work, source=name)
+        self._n_failovers += 1
+        obs_sink.event("replica_dead", replica=name,
+                       n_harvested=len(work), **result)
+        return result
+
+    def _autoscale(self, actions):  # requires-lock: _poll_lock
+        replicas = self.router.replicas
+        summary = self.router.summary()
+        shed_delta = summary["n_shed"] - self._last_shed
+        self._last_shed = summary["n_shed"]
+        admission = self.router.admission
+        burning = admission.burning() if admission is not None \
+            else False
+        depths = [r.queue_depth() for r in replicas]
+        mean_depth = (sum(depths) / len(depths)) if depths \
+            else float("inf")
+        pressed = (not replicas
+                   or mean_depth >= self.scale_up_depth
+                   or shed_delta > 0 or burning)
+        if pressed and self.factory is not None \
+                and len(replicas) < self.max_replicas:
+            reason = ("empty_fleet" if not replicas
+                      else "shed" if shed_delta > 0
+                      else "slo_burn" if burning else "queue_depth")
+            name = self._scale_up(reason)
+            actions["scaled_up"].append(name)
+            self._idle_polls = 0
+            return
+        idle = (replicas and mean_depth <= self.scale_down_depth
+                and shed_delta == 0 and not burning)
+        self._idle_polls = self._idle_polls + 1 if idle else 0
+        if self._idle_polls >= self.scale_down_after \
+                and len(replicas) > self.min_replicas:
+            name = self._scale_down()
+            actions["scaled_down"].append(name)
+            self._idle_polls = 0
+
+    def _scale_up(self, reason):  # requires-lock: _poll_lock
+        self._spawn_seq += 1
+        name = f"auto{self._spawn_seq}"
+        replica = self.factory(name)
+        self.router.add_replica(replica)
+        self._health[replica.name] = _ReplicaHealth()
+        self._scaled_up.append(replica.name)
+        obs_metrics.counter(
+            "serve_scale_events_total",
+            help="fleet size changes by the supervisor").inc(
+            direction="up", reason=reason)
+        obs_sink.event("scale_up", replica=replica.name,
+                       reason=reason,
+                       n_replicas=len(self.router.replicas))
+        return replica.name
+
+    def _scale_down(self):  # requires-lock: _poll_lock
+        """Drain one replica away: prefer the most recent
+        supervisor-spawned joiner (LIFO keeps the operator-provisioned
+        base fleet intact), else the router's last member."""
+        replicas = self.router.replicas
+        spawned = [n for n in self._scaled_up
+                   if any(r.name == n for r in replicas)]
+        name = spawned[-1] if spawned else replicas[-1].name
+        replica = self.router.remove_replica(name)
+        service = getattr(replica, "service", None)
+        if service is not None:
+            service.shutdown(drain=True,
+                             timeout=self.drain_timeout_s)
+        self._scaled_down.append(name)
+        obs_metrics.counter(
+            "serve_scale_events_total",
+            help="fleet size changes by the supervisor").inc(
+            direction="down", reason="idle")
+        obs_sink.event("scale_down", replica=name,
+                       n_replicas=len(self.router.replicas))
+        return name
+
+    # -- resharding ---------------------------------------------------
+
+    def reshard_replica(self, name, mesh=None, devices=None,
+                        drain_timeout_s=None, poll_interval_s=0.005):
+        """Drain-and-handoff reshard of one replica: detach from the
+        router (the rest of the fleet keeps taking traffic), wait
+        until the replica is fully drained, swap its residency
+        layout under the engine lock
+        (:meth:`~brainiak_tpu.serve.service.ServeService.reshard` —
+        per-shard charges recomputed over the new device count),
+        then re-attach.  No request ever observes a half-resharded
+        model: requests routed before the detach drain first, and
+        requests after the re-attach meet the new layout whole.
+        Returns the names of the re-laid-out models."""
+        timeout = (self.drain_timeout_s if drain_timeout_s is None
+                   else float(drain_timeout_s))
+        replica = self.router.remove_replica(name)
+        try:
+            service = getattr(replica, "service", None)
+            if service is None:
+                raise TypeError(
+                    f"replica {name!r} has no local service to "
+                    "reshard")
+            deadline = self.clock() + timeout
+            while not service.drained():
+                if self.clock() >= deadline:
+                    raise TimeoutError(
+                        f"replica {name!r} did not drain within "
+                        f"{timeout}s for resharding")
+                self._sleep(poll_interval_s)
+            dropped = service.reshard(mesh=mesh, devices=devices)
+        finally:
+            self.router.add_replica(replica)
+        obs_sink.event("reshard_handoff", replica=name,
+                       models=dropped)
+        return dropped
+
+    # -- background driver --------------------------------------------
+
+    def start(self, interval_s=0.05):
+        """Drive :meth:`poll` from a daemon thread every
+        ``interval_s`` seconds (idempotent); returns self.
+        Deterministic callers (tests, the chaos soak) skip this and
+        call :meth:`poll` themselves."""
+        with self._bg_lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+
+            def run():
+                while not self._stop.wait(interval_s):
+                    try:
+                        self.poll()
+                    except Exception:  # pragma: no cover - defensive
+                        # supervision must outlive one bad round
+                        import logging
+                        logging.getLogger(__name__).exception(
+                            "fleet supervision round failed")
+
+            self._thread = threading.Thread(
+                target=run, name="fleet-supervisor", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the background driver (no-op when not started)."""
+        with self._bg_lock:
+            self._stop.set()
+            thread = self._thread
+            self._thread = None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    # -- reporting ----------------------------------------------------
+
+    def states(self):
+        """``{replica name: health state}`` for every replica ever
+        supervised (dead ones included — their terminal state is
+        part of the fleet's story)."""
+        with self._poll_lock:
+            return {name: h.state
+                    for name, h in sorted(self._health.items())}
+
+    def summary(self):
+        """Supervision ledger + the router's own summary."""
+        with self._poll_lock:
+            out = {
+                "n_polls": self._n_polls,
+                "n_failovers": self._n_failovers,
+                "states": {name: h.state
+                           for name, h in
+                           sorted(self._health.items())},
+                "scaled_up": list(self._scaled_up),
+                "scaled_down": list(self._scaled_down),
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+            }
+        out["router"] = self.router.summary()
+        return out
+
+
+# -- the chaos soak ---------------------------------------------------
+
+
+def _await(predicate, what, timeout_s=30.0, interval_s=0.001):
+    """Spin until ``predicate()`` holds (bounded — the soak must
+    fail loudly, never hang CI)."""
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        if time.monotonic() > deadline:
+            raise RuntimeError(what)
+        time.sleep(interval_s)
+
+
+def chaos_soak(model=None, n_requests=96, seed=0, aot_dir=None,
+               base_rps=400.0, traffic_multiplier=3.0,
+               deadline_s=60.0, max_replicas=3, max_batch=8,
+               tr_choices=(16, 32), chaos=True, time_scale=1.0,
+               result_timeout_s=180.0):
+    """The deterministic chaos soak (SRV004 / ``elastic`` bench /
+    fleet-test shared driver): fmrisim heavy-tailed traffic against
+    a supervised 2-replica fleet; mid-run, replica ``r1`` is
+    degraded by a targeted ``slow_replica`` fault, killed by a
+    targeted ``replica_crash`` fault with a wave still queued, the
+    supervisor fails its work over to the survivor, and the traffic
+    then TRIPLES so the fleet scales up off the shared AOT cache.
+
+    Phases (all seeded):
+
+    1. **warm** — one wave per (TR bucket x power-of-two batch
+       extent) drives every serve program once, so the shared AOT
+       cache is fully populated; the ``retrace_total{site=serve.*}``
+       reading after this phase is the zero-retrace baseline;
+    2. **steady** — half the requests replayed at ``base_rps``
+       through the router, supervisor polling each wave;
+    3. **chaos** (``chaos=True``) — ``slow_replica`` degrades r1
+       (hysteresis walks healthy -> degraded), then
+       ``replica_crash`` kills it with a freshly-submitted hold
+       wave still in ingress; the next poll declares death and
+       fails over;
+    4. **surge** — the other half of the requests at
+       ``traffic_multiplier x base_rps``; polls scale the fleet up
+       to ``max_replicas``;
+    5. **settle** — every ticket resolved (bounded wait) and
+       classified: ``delivered`` / ``shed_overload`` /
+       ``replica_lost`` / other typed errors.  A ticket that never
+       resolves is a LOST ticket — the invariant violation the
+       SRV004 gate exists to catch.
+
+    ``chaos=False`` runs the same mix on a static 2-replica fleet
+    (no faults, no supervisor actions) — the bench baseline.
+
+    Returns a facts dict (counts, routed/summary ledgers, health
+    states, retrace readings, post-failure p99, wall seconds); the
+    callers assert on it.
+    """
+    from ..__main__ import build_demo_model
+    from ..batching import BucketPolicy, Request
+    from ..residency import ModelResidency
+    from ..service import ServeService, serve_retrace_total
+    from .admission import AdmissionController
+    from .router import LocalReplica, Router
+    from .traffic import TrafficGenerator, replay
+
+    if model is None:
+        model = build_demo_model(n_subjects=2, voxels=48,
+                                 samples=32, features=6, n_iter=2,
+                                 seed=seed)
+    policy = BucketPolicy(max_batch=max_batch, max_wait_s=0.01)
+
+    # every replica — including mid-run joiners — MUST share one
+    # AOT cache: that is the whole zero-retrace-on-scale-up story
+    owned_tmp = None
+    if aot_dir is None:
+        import tempfile
+        owned_tmp = tempfile.TemporaryDirectory(
+            prefix="chaos-soak-aot-")
+        aot_dir = owned_tmp.name
+
+    def factory(name):
+        residency = ModelResidency(budget_bytes=1 << 30,
+                                   policy=policy, aot=aot_dir)
+        residency.register("demo", model=model)
+        return LocalReplica(ServeService(
+            residency, default_model="demo", name=name).start())
+
+    r1, r2 = factory("r1"), factory("r2")
+    admission = AdmissionController(max_depth=64,
+                                    retry_after_s=0.02)
+    router = Router([r1, r2], admission=admission)
+    supervisor = FleetSupervisor(
+        router, factory=factory, min_replicas=1,
+        max_replicas=max_replicas, degraded_after=2, dead_after=1,
+        healthy_after=2, scale_up_depth=4.0, scale_down_depth=0.0,
+        scale_down_after=10 ** 9)  # soak never scales down mid-run
+
+    facts = {"chaos": bool(chaos), "n_requests": 0}
+    rng = np.random.RandomState(seed + 1)
+    tickets = []
+    t_start = time.perf_counter()
+    try:
+        # -- phase 1: warm every (tr bucket, batch extent) program
+        voxel_counts = [w.shape[0] for w in model.w_]
+        warm_id = 0
+        for n_trs in tr_choices:
+            extent = 1
+            while extent <= max_batch:
+                wave = []
+                for _ in range(extent):
+                    subject = warm_id % len(voxel_counts)
+                    wave.append(Request(
+                        request_id=f"warm{warm_id}",
+                        x=rng.randn(voxel_counts[subject],
+                                    n_trs).astype(np.float32),
+                        subject=subject, model="demo"))
+                    warm_id += 1
+                for ticket in r1.service.submit_many(wave):
+                    ticket.result(timeout=result_timeout_s)
+                extent *= 2
+        facts["warm_retraces"] = serve_retrace_total()
+
+        # -- phase 2: steady traffic at base_rps
+        gen = TrafficGenerator(model, model_name="demo", seed=seed,
+                               tr_choices=tr_choices)
+        n_steady = n_requests // 2
+        n_surge = n_requests - n_steady
+
+        def drive(schedule):
+            def submit(wave):
+                out = router.submit_many(wave)
+                if chaos:  # static baseline: no supervisor actions
+                    supervisor.poll()
+                return out
+            return replay(schedule, submit,
+                          time_scale=time_scale)
+
+        tickets += drive(gen.schedule(n_steady, base_rps,
+                                      prefix="s",
+                                      deadline_s=deadline_s))
+
+        # -- phase 3: degrade, then kill, r1 (chaos only)
+        if chaos:
+            # 3a: one long stall freezes r1's loop mid-iteration;
+            # work submitted during the stall sits in live ingress,
+            # so consecutive probes see frozen iters + queued work
+            # and the hysteresis walks healthy -> degraded
+            with faults.inject("slow_replica", times=1, leaf=1.5,
+                               target="r1") as stall:
+                _await(lambda: stall.fired >= 1,
+                       "slow_replica stall never began")
+                tickets += r1.service.submit_many(
+                    gen.requests(2, prefix="d",
+                                 deadline_s=deadline_s))
+                supervisor.poll()   # freezes last_iters reading
+                supervisor.poll()   # slow x1
+                supervisor.poll()   # slow x2 -> degraded
+            facts["degraded_seen"] = (
+                supervisor.states().get("r1") == "degraded")
+            # let r1 wake from the 3a stall and deliver the "d"
+            # wave before arming the kill — the crash must land in
+            # a FRESH iteration, after a fresh stall
+            _await(r1.service.drained,
+                   "r1 never recovered from the 3a stall")
+            # 3b: stall + crash in ONE iteration: the loop sleeps
+            # (slow fires first), the hold wave lands in ingress
+            # during the sleep, then crash_point fires BEFORE the
+            # ingress drain — guaranteed stranded work for the
+            # failover path, no race with delivery
+            with faults.inject("slow_replica", times=1, leaf=1.5,
+                               target="r1") as stall, \
+                    faults.inject("replica_crash",
+                                  target="r1") as crash:
+                _await(lambda: stall.fired >= 1,
+                       "pre-crash stall never began")
+                hold = gen.requests(8, prefix="h",
+                                    deadline_s=deadline_s)
+                tickets += r1.service.submit_many(hold)
+                _await(lambda: not r1.service.alive(),
+                       "injected crash did not kill r1")
+            facts["crash_fired"] = crash.fired
+            actions = supervisor.poll()
+            facts["failover"] = (
+                actions["failed_over"][0]
+                if actions["failed_over"] else None)
+
+        # -- phase 4: the surge (traffic triples)
+        tickets += drive(gen.schedule(
+            n_surge, base_rps * traffic_multiplier, prefix="x",
+            deadline_s=deadline_s))
+        if chaos:
+            supervisor.poll()
+
+        # drive every mid-run joiner directly: the zero-retrace-on-
+        # scale-up claim is only meaningful if the scaled-up
+        # replicas actually SERVE off the shared warm cache
+        scaled = {r.name: r for r in router.replicas
+                  if r.name.startswith("auto")}
+        scaled_ids = set()
+        for i, replica in enumerate(scaled.values()):
+            wave = gen.requests(4, prefix=f"a{i}",
+                                deadline_s=deadline_s)
+            scaled_ids.update(r.request_id for r in wave)
+            tickets += replica.submit_many(wave)
+        facts["scaled_replicas"] = sorted(scaled)
+
+        # -- phase 5: settle and classify every ticket
+        facts["n_requests"] = len(tickets)
+        unresolved = 0
+        by_code = {}
+        ok_latencies = []
+        post_failure = []
+        n_scaled_served = 0
+        for ticket in tickets:
+            try:
+                rec = ticket.result(timeout=result_timeout_s)
+            except TimeoutError:
+                unresolved += 1
+                continue
+            if rec.ok:
+                by_code["delivered"] = by_code.get(
+                    "delivered", 0) + 1
+                if ticket.request_id in scaled_ids:
+                    n_scaled_served += 1
+                if rec.latency_s is not None:
+                    ok_latencies.append(rec.latency_s)
+                    if ticket.request_id[0] in ("h", "x", "a"):
+                        post_failure.append(rec.latency_s)
+            else:
+                code = rec.error or "error"
+                by_code[code] = by_code.get(code, 0) + 1
+        facts["n_unresolved"] = unresolved
+        facts["n_scaled_up_served"] = n_scaled_served
+        facts["by_code"] = by_code
+        facts["n_delivered_ok"] = by_code.get("delivered", 0)
+        facts["n_shed"] = by_code.get("shed_overload", 0)
+        facts["n_replica_lost"] = by_code.get("replica_lost", 0)
+        if ok_latencies:
+            facts["p99_latency_s"] = float(np.percentile(
+                np.asarray(ok_latencies), 99))
+        if post_failure:
+            facts["post_failure_p99_s"] = float(np.percentile(
+                np.asarray(post_failure), 99))
+        facts["final_retraces"] = serve_retrace_total()
+        facts["states"] = supervisor.states()
+        facts["supervisor"] = supervisor.summary()
+        facts["wall_s"] = time.perf_counter() - t_start
+        if facts["wall_s"] > 0:
+            facts["requests_per_sec"] = (
+                facts["n_requests"] / facts["wall_s"])
+    finally:
+        supervisor.stop()
+        for replica in list(router.replicas):
+            try:
+                replica.service.shutdown(drain=True, timeout=30.0)
+            except Exception:  # pragma: no cover - teardown
+                pass
+        if owned_tmp is not None:
+            owned_tmp.cleanup()
+    return facts

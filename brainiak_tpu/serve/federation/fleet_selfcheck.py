@@ -1,0 +1,90 @@
+"""SRV004 selfcheck: the elastic fleet, end to end in one child.
+
+The ``fleet`` gate of ``tools/run_checks.py`` runs
+:func:`selfcheck` in a child pinned to the 8-device CPU mesh (the
+same harness as the federation/distla/encoding gates): one
+deterministic :func:`~brainiak_tpu.serve.federation.fleet.
+chaos_soak` — fmrisim heavy-tailed traffic that triples mid-run
+while replica ``r1`` is degraded by an injected ``slow_replica``
+fault and killed by an injected ``replica_crash`` fault with a wave
+still queued — and verifies, with one JSON verdict line:
+
+- **zero lost tickets** — EVERY submitted request resolves exactly
+  one ticket, as ``delivered``, ``shed_overload``, or a typed
+  ``replica_lost`` record (``n_unresolved == 0``: a ticket that
+  never resolves is the invariant violation this gate exists to
+  catch);
+- **failover routing** — the supervisor declared ``r1`` dead and
+  the router re-placed its stranded work onto survivors
+  (``failover.n_replaced > 0``, survivors routed);
+- **zero retraces on scale-up** — the surge scaled the fleet up
+  and the mid-run joiners SERVED requests off the shared AOT cache
+  without compiling a single new serve program
+  (``final_retraces == warm_retraces`` — the SRV003 warm-fleet
+  property, extended to mid-run scale-up).
+
+Exit 0 on success, 1 with the verdict naming what failed.
+"""
+
+import json
+
+__all__ = ["selfcheck"]
+
+
+def selfcheck(out=None):
+    """Run the elastic-fleet chaos soak (see module docstring);
+    returns the process exit code."""
+    import sys
+
+    from .fleet import chaos_soak
+
+    stream = out or sys.stdout
+    verdict = {"ok": False}
+    try:
+        facts = chaos_soak(n_requests=48, seed=0)
+        verdict["n_requests"] = facts["n_requests"]
+        verdict["n_unresolved"] = facts["n_unresolved"]
+        verdict["all_resolved"] = facts["n_unresolved"] == 0
+        verdict["by_code"] = facts["by_code"]
+        verdict["n_replica_lost"] = facts["n_replica_lost"]
+        verdict["degraded_seen"] = facts.get("degraded_seen",
+                                             False)
+        verdict["crash_fired"] = facts.get("crash_fired", 0)
+        failover = facts.get("failover") or {}
+        verdict["failover"] = failover
+        verdict["failover_ok"] = bool(
+            facts.get("crash_fired")
+            and failover.get("n_replaced", 0) > 0
+            and failover.get("n_lost", 0) == 0)
+        routed = facts["supervisor"]["router"]["routed"]
+        verdict["routed"] = routed
+        verdict["survivor_routed_ok"] = routed.get("r2", 0) > 0
+        verdict["scaled_replicas"] = facts.get("scaled_replicas",
+                                               [])
+        verdict["n_scaled_up_served"] = facts.get(
+            "n_scaled_up_served", 0)
+        verdict["scale_up_ok"] = bool(
+            verdict["scaled_replicas"]
+            and verdict["n_scaled_up_served"] > 0)
+        verdict["states"] = facts["states"]
+        # normalized like every selfcheck gate: 1.0 means "no
+        # program rebuilt after warmup"; anything above is counted
+        # retraces, classified by the shared gate harness
+        warm = facts.get("warm_retraces", 0.0)
+        final = facts.get("final_retraces", 0.0)
+        verdict["warm_retraces"] = warm
+        verdict["final_retraces"] = final
+        verdict["retraces"] = {
+            "serve.fleet": 1.0 + max(0.0, final - warm)}
+        verdict["ok"] = bool(
+            verdict["all_resolved"]
+            and verdict["failover_ok"]
+            and verdict["survivor_routed_ok"]
+            and verdict["degraded_seen"]
+            and verdict["scale_up_ok"]
+            and final <= warm)
+    except Exception as exc:  # noqa: BLE001 - verdict carries it
+        verdict["error"] = f"{type(exc).__name__}: {exc}"
+    json.dump(verdict, stream)
+    stream.write("\n")
+    return 0 if verdict["ok"] else 1

@@ -33,9 +33,12 @@ produces — every request still resolves exactly one ticket.
 """
 
 import threading
+import time
 import urllib.request
 
 from ...obs import metrics as obs_metrics
+from ...obs import sink as obs_sink
+from ...resilience.retry import retry
 from ..batching import ServeResult
 from ..service import ServiceTicket
 
@@ -90,7 +93,12 @@ class Router:
     """
 
     def __init__(self, replicas, admission=None):
-        self.replicas = list(replicas)
+        # the membership list is COPY-ON-WRITE: add_replica /
+        # remove_replica rebind it under _lock, and every wave
+        # snapshots the reference once (`_membership`) — an
+        # in-flight wave keeps routing over the membership it
+        # started with
+        self.replicas = list(replicas)  # guarded-by: _lock
         if not self.replicas:
             raise ValueError("Router needs >= 1 replica")
         names = [r.name for r in self.replicas]
@@ -101,35 +109,82 @@ class Router:
         self._lock = threading.Lock()
         self._routed = {name: 0 for name in names}  # guarded-by: _lock
         self._n_shed = 0                            # guarded-by: _lock
+        self._n_lost = 0                            # guarded-by: _lock
+        self._n_failed_over = 0                     # guarded-by: _lock
         self._rr = 0                                # guarded-by: _lock
+
+    # -- elastic membership -------------------------------------------
+
+    def add_replica(self, replica):
+        """Join a replica to the fleet (scale-up / failover
+        re-placement target): visible to the NEXT wave — waves
+        already in flight keep their membership snapshot.  Returns
+        the replica."""
+        with self._lock:
+            if any(r.name == replica.name for r in self.replicas):
+                raise ValueError(
+                    f"replica {replica.name!r} already routed")
+            self.replicas = self.replicas + [replica]
+            self._routed.setdefault(replica.name, 0)
+        return replica
+
+    def remove_replica(self, name):
+        """Detach a replica from the fleet (scale-down drain, or a
+        death declared by the supervisor): no NEW wave will place on
+        it.  Its routed history stays in :meth:`summary` (ledger
+        continuity).  Removing the last replica is legal — a fleet
+        can be all-dead; submission then raises until a replica
+        joins.  Returns the detached replica."""
+        with self._lock:
+            target = next((r for r in self.replicas
+                           if r.name == name), None)
+            if target is None:
+                raise KeyError(f"no replica named {name!r}")
+            self.replicas = [r for r in self.replicas
+                             if r.name != name]
+        return target
+
+    def _membership(self):
+        """One locked read of the copy-on-write membership list —
+        the per-wave snapshot: an in-flight wave keeps routing over
+        the list reference it grabbed here, and a concurrent
+        add/remove rebinds ``self.replicas`` for the NEXT wave."""
+        with self._lock:
+            return self.replicas
 
     # -- placement ----------------------------------------------------
 
-    def _snapshot_models(self):
+    def _snapshot_models(self, replicas=None):
         """One read of every replica's registered/resident model
         sets (each is a residency-lock acquisition): taken once per
         routed wave, like the depth snapshot — never per request."""
+        replicas = self._membership() if replicas is None else replicas
         return ({r.name: r.registered_models()
-                 for r in self.replicas},
+                 for r in replicas},
                 {r.name: r.resident_models()
-                 for r in self.replicas})
+                 for r in replicas})
 
-    def place(self, model=None, depths=None, models=None):
+    def place(self, model=None, depths=None, models=None,
+              replicas=None):
         """The replica one request for ``model`` should land on
         (pure decision — no submission): resident-first, then least
         depth, round-robin tie-break.  ``depths`` overrides the
-        live gauge reads and ``models`` the
-        ``(registered, resident)`` snapshot — the per-wave
-        estimates :meth:`submit_many` maintains."""
+        live gauge reads, ``models`` the
+        ``(registered, resident)`` snapshot, and ``replicas`` the
+        membership — the per-wave estimates :meth:`submit_many`
+        maintains."""
+        replicas = self._membership() if replicas is None else replicas
+        if not replicas:
+            raise RuntimeError(
+                "no replicas to place on (the fleet is empty)")
         if depths is None:
-            depths = {r.name: r.queue_depth()
-                      for r in self.replicas}
+            depths = {r.name: r.queue_depth() for r in replicas}
         registered_by, resident_by = (
             models if models is not None
-            else self._snapshot_models())
-        candidates = self.replicas
+            else self._snapshot_models(replicas))
+        candidates = replicas
         if model is not None:
-            registered = [r for r in self.replicas
+            registered = [r for r in replicas
                           if model in registered_by[r.name]]
             candidates = registered or candidates
         with self._lock:
@@ -162,10 +217,17 @@ class Router:
         AOT warm-start rides on).  Returns one ticket per request
         in input order; shed tickets are already resolved."""
         requests = list(requests)
-        depths = {r.name: r.queue_depth() for r in self.replicas}
-        models = self._snapshot_models()
-        by_name = {r.name: r for r in self.replicas}
-        assigned = {r.name: [] for r in self.replicas}
+        # ONE membership snapshot per wave (copy-on-write list): a
+        # concurrent add/remove affects the next wave, not this one
+        replicas = self._membership()
+        if not replicas:
+            raise RuntimeError(
+                "cannot route: the fleet has no replicas "
+                "(all removed/dead; scale up first)")
+        depths = {r.name: r.queue_depth() for r in replicas}
+        models = self._snapshot_models(replicas)
+        by_name = {r.name: r for r in replicas}
+        assigned = {r.name: [] for r in replicas}
         slots = [None] * len(requests)   # (replica name, index) | rec
         n_shed = 0
         for i, request in enumerate(requests):
@@ -179,7 +241,7 @@ class Router:
                     n_shed += 1
                     continue
             replica = self.place(target, depths=depths,
-                                 models=models)
+                                 models=models, replicas=replicas)
             # in-flight correction: the gauge will not move until
             # the replica's next tick, but this wave already did
             depths[replica.name] = depths.get(replica.name, 0) + 1
@@ -220,39 +282,150 @@ class Router:
                                 replica="router")
         return ticket
 
+    # -- failover -----------------------------------------------------
+
+    def failover(self, work, source=None, now=None):
+        """Re-place a dead replica's un-delivered work onto the
+        survivors.
+
+        ``work`` is the ``(model, request, ticket)`` triples
+        harvested from the dead replica
+        (:meth:`~brainiak_tpu.serve.service.ServeService.
+        unresolved_work`).  Requests already past their deadline —
+        and every request when no survivor remains — resolve their
+        (original, caller-held) tickets with typed ``replica_lost``
+        records: an accounted loss, never a silent one.  The rest
+        are re-submitted as ONE router wave (atomic ``submit_many``
+        per survivor, deterministic bucket composition), each fresh
+        ticket chained back to the original so the caller's wait
+        resolves when the survivor delivers — the
+        exactly-one-ticket-per-request invariant holds throughout
+        (a re-placed request that the admission controller sheds
+        resolves the original ticket with the shed record through
+        the same chain).  Deadlines keep counting from the ORIGINAL
+        enqueue: ``request.submitted`` is preserved across the
+        re-placement.
+
+        Returns ``{"n_replaced", "n_lost"}``."""
+        now = time.monotonic() if now is None else now
+        survivors = self._membership()
+        lost, replace = [], []
+        for name, request, ticket in work:
+            if ticket.done():
+                continue
+            if not survivors or request.expired(now):
+                lost.append((name, request, ticket))
+            else:
+                replace.append((name, request, ticket))
+        for name, request, ticket in lost:
+            reason = ("no_survivors" if not survivors
+                      else "deadline")
+            self._lost_ticket(request, name, ticket,
+                              source=source, reason=reason)
+        if replace:
+            for name, request, _ in replace:
+                # the harvest knows the resolved target model even
+                # when the request rode a service default — pin it
+                # so the re-placement wave routes identically
+                if request.model is None:
+                    request.model = name
+            fresh = self.submit_many(
+                [request for _, request, _ in replace])
+            for (_, _, ticket), new_ticket in zip(replace, fresh):
+                new_ticket._chain(ticket)
+        with self._lock:
+            self._n_lost += len(lost)
+            self._n_failed_over += len(replace)
+        obs_metrics.counter(
+            "serve_failover_total",
+            help="requests re-placed onto survivors after a "
+                 "replica death").inc(len(replace),
+                                      replica=source or "unknown")
+        obs_sink.event("failover", replica=source,
+                       n_replaced=len(replace), n_lost=len(lost))
+        return {"n_replaced": len(replace), "n_lost": len(lost)}
+
+    def _lost_ticket(self, request, model, ticket, source=None,
+                     reason="deadline"):
+        """Resolve one caller-held ticket with the typed
+        ``replica_lost`` record (same shape discipline as the shed
+        record: a structured loss, never an exception or silence)."""
+        latency = None
+        if request.submitted is not None:
+            latency = time.monotonic() - request.submitted
+        ticket._resolve(ServeResult(
+            request_id=request.request_id, ok=False,
+            error="replica_lost",
+            message=(f"replica {source or '<unknown>'} died before "
+                     f"serving the request and it was not "
+                     f"re-placed ({reason}); resubmit with a fresh "
+                     f"deadline"),
+            latency_s=latency))
+        obs_metrics.counter(
+            "serve_replica_lost_total",
+            help="requests lost with a replica death (past "
+                 "deadline or no survivors)").inc(
+                replica=source or "unknown", reason=reason)
+
     # -- reporting ----------------------------------------------------
 
     def summary(self):
-        """Routed/shed counts per replica for the federation
-        summaries and the SRV003 gate."""
+        """Routed/shed/failover counts per replica for the
+        federation summaries and the SRV003/SRV004 gates."""
         with self._lock:
             out = {"n_replicas": len(self.replicas),
                    "routed": dict(self._routed),
-                   "n_shed": self._n_shed}
+                   "n_shed": self._n_shed,
+                   "n_lost": self._n_lost,
+                   "n_failed_over": self._n_failed_over}
         if self.admission is not None:
             out["admission"] = self.admission.stats()
         return out
 
 
-def scrape_replica_state(url, timeout=5.0):
+def scrape_replica_state(url, timeout=2.0, retries=2,
+                         backoff=0.05):
     """One remote replica's placement signals off its ``/metrics``
     endpoint (:mod:`brainiak_tpu.obs.http`): the same
     ``serve_service_*`` / ``serve_resident_*`` series the in-process
     router reads from the registry, parsed with the in-repo
-    Prometheus parser.  Returns ``{"queue_depth", "ingress_depth",
-    "resident_bytes", "queue_by_model", "by_replica"}`` —
-    ``by_replica`` splits the depth per replica label when the
-    scraped process runs several.  This is the cross-process half of
-    the placement contract: a front-end partitioning request files
-    across ``serve service`` processes reads state here instead of
-    guessing."""
+    Prometheus parser.  Returns ``{"state", "queue_depth",
+    "ingress_depth", "resident_bytes", "queue_by_model",
+    "by_replica"}`` — ``by_replica`` splits the depth per replica
+    label when the scraped process runs several.  This is the
+    cross-process half of the placement contract: a front-end
+    partitioning request files across ``serve service`` processes
+    reads state here instead of guessing.
+
+    The fetch is wired through :func:`~brainiak_tpu.resilience.
+    retry.retry` with a bounded PER-ATTEMPT ``timeout``: a hung or
+    dead remote endpoint costs at most ``(retries + 1) * timeout``
+    plus backoff, then the call returns a typed
+    ``state="unreachable"`` dict (zeroed signals plus the final
+    error) instead of raising — so a supervisor probing the fleet
+    degrades the replica and moves on, never stalls.  Malformed
+    Prometheus text still raises ``ValueError``: that is a bug on
+    the replica, not a transient reachability failure."""
     from ...obs.http import parse_prometheus_text
 
     target = url if "://" in url else f"http://{url}"
-    with urllib.request.urlopen(
-            target.rstrip("/") + "/metrics",
-            timeout=timeout) as resp:
-        text = resp.read().decode("utf-8")
+
+    def fetch():
+        with urllib.request.urlopen(
+                target.rstrip("/") + "/metrics",
+                timeout=timeout) as resp:
+            return resp.read().decode("utf-8")
+
+    try:
+        text = retry(fetch, retries=retries, backoff=backoff,
+                     retriable=(OSError,),
+                     name="scrape_replica_state")()
+    except OSError as exc:
+        return {"state": "unreachable",
+                "error": f"{type(exc).__name__}: {exc}",
+                "queue_depth": 0.0, "ingress_depth": 0.0,
+                "resident_bytes": 0.0, "queue_by_model": {},
+                "by_replica": {}}
     families, errors = parse_prometheus_text(text)
     if errors:
         raise ValueError(
@@ -262,9 +435,9 @@ def scrape_replica_state(url, timeout=5.0):
     def samples(name):
         return families.get(name, {"samples": []})["samples"]
 
-    out = {"queue_depth": 0.0, "ingress_depth": 0.0,
-           "resident_bytes": 0.0, "queue_by_model": {},
-           "by_replica": {}}
+    out = {"state": "ok", "queue_depth": 0.0,
+           "ingress_depth": 0.0, "resident_bytes": 0.0,
+           "queue_by_model": {}, "by_replica": {}}
     for _, labels, value in samples("serve_service_ingress_depth"):
         out["ingress_depth"] += value
         rep = labels.get("replica", "")

@@ -546,6 +546,68 @@ class ModelResidency:
                     entry.nbytes, reason)
         return records
 
+    def reshard(self, mesh=None, devices=None):
+        """Swap the accounting device set (a fleet-elasticity event:
+        the mesh grew, shrank, or moved): EVERY resident entry is
+        dropped — pinned included, a pin protects against capacity
+        eviction, not against the devices changing under it — and
+        the new ``mesh``/``devices`` are installed, so the next
+        :meth:`acquire` re-admits each model with per-shard charges
+        computed over the NEW device count
+        (:func:`~brainiak_tpu.serve.artifacts.model_shard_nbytes`).
+
+        ``mesh=None`` keeps the current mesh; ``devices=None``
+        re-resolves the slots lazily (mesh devices, else
+        ``jax.devices()``).  Queued work on dropped engines is
+        failed with typed ``resharded`` records through the usual
+        eviction delivery hooks — never silently lost.  Returns the
+        names of the models that were re-laid-out."""
+        with self._lock:
+            dropped = sorted(self._resident)
+            for name in dropped:
+                entry = self._resident[name]
+                entry.engine.fail_pending(
+                    "resharded",
+                    "model was re-laid-out over a new device set "
+                    "while the request was queued; resubmit")
+                records = entry.engine.drain()
+                if records and self.on_evict_records is not None:
+                    self.on_evict_records(name, records)
+                if self.on_evict is not None:
+                    self.on_evict(entry)
+                del self._resident[name]
+                self._n_evictions += 1
+            # zero the OLD per-device occupancy series first: a
+            # shrunk device set must not leave stale bytes on
+            # /metrics (only when the slots were ever resolved)
+            old_devices = (list(self._devices)
+                           if self._devices is not None else [])
+            if old_devices:
+                gauge = obs_metrics.gauge(
+                    "serve_resident_device_bytes", unit="bytes",
+                    help="resident model bytes charged per device")
+                for dev in old_devices:
+                    gauge.set(0, device=_device_label(dev))
+            if mesh is not None:
+                self.mesh = mesh
+            self._devices = (list(devices)
+                             if devices is not None else None)
+            self._gauge()
+        # telemetry outside the lock (same discipline as evict)
+        for name in dropped:
+            obs_metrics.counter(
+                "serve_reshard_total",
+                help="models re-laid-out by a device-set "
+                     "change").inc(model=name)
+        # device count reported without resolving lazy slots (that
+        # could initialize a backend from a planning-only caller)
+        n_devices = (len(devices) if devices is not None
+                     else int(mesh.devices.size)
+                     if mesh is not None else None)
+        obs_sink.event("reshard", models=dropped,
+                       n_devices=n_devices)
+        return dropped
+
     # -- accounting ---------------------------------------------------
 
     def resident_bytes(self):

@@ -51,6 +51,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import sink as obs_sink
 from ..obs import trace as obs_trace
 from ..obs.sketch import QuantileSketch
+from ..resilience import faults
 from .batching import ServeResult
 from .residency import AdmissionError
 
@@ -84,13 +85,18 @@ class ServiceTicket:
     structured error, never silence — the engine contract, extended
     across threads)."""
 
-    __slots__ = ("request_id", "model", "record", "_event")
+    __slots__ = ("request_id", "model", "record", "_event",
+                 "_chained")
 
     def __init__(self, request_id, model):
         self.request_id = request_id
         self.model = model
         self.record = None
         self._event = threading.Event()
+        # tickets this one's record forwards to on resolution (the
+        # failover re-placement path: a survivor's fresh ticket
+        # chains to the ticket the original caller already holds)
+        self._chained = []
 
     def done(self):
         return self._event.is_set()
@@ -107,6 +113,21 @@ class ServiceTicket:
     def _resolve(self, record):
         self.record = record
         self._event.set()
+        for ticket in list(self._chained):
+            if not ticket._event.is_set():
+                ticket._resolve(record)
+
+    def _chain(self, target):
+        """Forward this ticket's eventual record to ``target`` too
+        (failover re-placement: the original caller keeps waiting on
+        ``target`` while a survivor serves through this ticket).
+        Safe against a concurrent :meth:`_resolve`: the append is
+        atomic, and whichever side observes the other's progress
+        performs the (idempotent) resolution — worst case ``target``
+        is resolved twice with the same record."""
+        self._chained.append(target)
+        if self._event.is_set() and not target._event.is_set():
+            target._resolve(self.record)
 
 
 class ServeService:
@@ -183,8 +204,16 @@ class ServeService:
         self._thread = None                  # guarded-by: _cond
         self._n_submitted = 0                # guarded-by: _cond
         self._n_shed = 0                     # guarded-by: _cond
+        # loop-iteration heartbeat: the fleet supervisor probes
+        # progress here WITHOUT touching the engine lock, so a
+        # wedged or fault-stalled tick can never block the probe
+        self._n_loop_iters = 0               # guarded-by: _cond
         # (model, engine seq) -> ticket
         self._pending = {}           # guarded-by: _engine_lock
+        # (model, engine seq) -> the un-delivered request itself,
+        # kept in lockstep with _pending: the failover harvest
+        # (unresolved_work) re-places these when the loop dies
+        self._pending_requests = {}  # guarded-by: _engine_lock
         # ok-latency distribution: a mergeable log-bucketed sketch
         # (O(1) memory for a week-long process, O(1) observe, O(1)
         # quantiles under the tick lock — the PR 8 sorted deque paid
@@ -295,6 +324,77 @@ class ServeService:
             summary["http_port"] = http.port
             http.stop()
         return summary
+
+    def unresolved_work(self):
+        """Harvest every accepted-but-undelivered request off a DEAD
+        loop: the ``(model, request, ticket)`` triples still waiting
+        in ingress or in the pending map, in submission order (routed
+        work first, by engine sequence, then unrouted ingress).
+
+        This is the failover source: the
+        :class:`~brainiak_tpu.serve.federation.fleet.FleetSupervisor`
+        re-places these onto surviving replicas, chaining each
+        survivor ticket back to the ticket the original caller holds
+        — so a replica crash costs latency, never silent loss.
+
+        Only legal once the loop thread is no longer running (crashed
+        or stopped): raises ``RuntimeError`` against a live loop,
+        whose engines are single-caller by contract.  The harvested
+        entries are removed, so a second call returns nothing."""
+        with self._cond:
+            thread = self._thread
+            if (self._state == "running" and thread is not None
+                    and thread.is_alive()):
+                raise RuntimeError(
+                    "unresolved_work() needs a dead service loop; "
+                    "this one is still running (shutdown() first, "
+                    "or let the supervisor declare it dead)")
+            if self._state == "running":
+                # crashed thread under a stale "running" state:
+                # close the door so late submit() callers get
+                # ServiceClosed instead of enqueueing into a void
+                self._state = "crashed"
+            leftovers = list(self._ingress)
+            self._ingress.clear()
+        out = []
+        with self._engine_lock:
+            for (name, seq), ticket in sorted(
+                    self._pending.items(),
+                    key=lambda item: item[0][1]):
+                request = self._pending_requests.get((name, seq))
+                if request is not None and not ticket.done():
+                    out.append((name, request, ticket))
+            self._pending.clear()
+            self._pending_requests.clear()
+        for name, request, ticket in leftovers:
+            if not ticket.done():
+                out.append((name, request, ticket))
+        return out
+
+    def reshard(self, mesh=None, devices=None):
+        """Re-lay-out the residency over a new device set (the
+        drain-and-handoff core): under the engine lock — so no
+        request can observe a half-resharded model — every resident
+        entry is dropped and the residency's mesh/device slots are
+        swapped; the next ``acquire`` re-admits with per-shard
+        charges computed over the NEW device count
+        (:func:`~brainiak_tpu.serve.artifacts.model_shard_nbytes`).
+
+        Requires a drained service (no pending tickets, empty
+        ingress): raises ``RuntimeError`` otherwise — the supervisor
+        removes the replica from the router and waits out
+        :meth:`drained` first.  Returns the names of the re-laid-out
+        models."""
+        with self._engine_lock:
+            with self._cond:
+                busy = bool(self._ingress)
+            if busy or self._pending:
+                raise RuntimeError(
+                    "reshard() needs a drained service: "
+                    f"{len(self._pending)} pending tickets, "
+                    f"ingress {'non-empty' if busy else 'empty'}")
+            return self.residency.reshard(mesh=mesh,
+                                          devices=devices)
 
     # -- submission (any thread) --------------------------------------
 
@@ -518,7 +618,35 @@ class ServeService:
     # -- the loop (service thread only) -------------------------------
 
     def _loop(self):
+        n_iters = 0
         while True:
+            n_iters += 1
+            with self._cond:
+                self._n_loop_iters = n_iters
+            # fault hooks run LOCK-FREE between iterations: an
+            # injected death can never strand a held lock, and an
+            # injected stall degrades tick progression (the
+            # supervisor's heartbeat signal) without wedging
+            # summary()/submit() callers
+            try:
+                stall = faults.slow_point(n_iters, site="serve.loop",
+                                          name=self.name)
+                if stall > 0:
+                    time.sleep(stall)
+                faults.crash_point(n_iters, site="serve.loop",
+                                   name=self.name)
+            except faults.ReplicaCrashError as exc:
+                # injected replica death: the loop dies WITHOUT
+                # resolving its queued tickets — the stranded work
+                # is exactly what the fleet failover path re-places
+                # (unresolved_work); state "crashed" makes further
+                # submit() raise ServiceClosed like a dead host
+                with self._cond:
+                    self._state = "crashed"
+                    self._cond.notify_all()
+                logger.warning("service loop %r died: %s",
+                               self.name or "<unnamed>", exc)
+                return
             with self._cond:
                 if self._state == "running" and not self._ingress:
                     self._cond.wait(self.tick_interval)
@@ -605,6 +733,7 @@ class ServeService:
             ticket._resolve(rejection)
             return 0
         self._pending[(name, request._seq_index)] = ticket
+        self._pending_requests[(name, request._seq_index)] = request
         if getattr(request, "_low_latency", False):
             # single-request fast path: dispatch the bucket in THIS
             # tick (the same tick's drain below then delivers the
@@ -627,6 +756,7 @@ class ServeService:
                       records):  # requires-lock: _engine_lock
         for rec in records:
             ticket = self._pending.pop((name, rec.seq), None)
+            self._pending_requests.pop((name, rec.seq), None)
             self._account(rec)
             if ticket is not None:
                 ticket._resolve(rec)
@@ -671,6 +801,40 @@ class ServeService:
             self._deliver_many(entry.name, entry.engine.drain())
 
     # -- reporting ----------------------------------------------------
+
+    def alive(self):
+        """Whether the loop thread is actually running — the
+        supervisor's hard liveness probe (``_state == "running"``
+        alone cannot see a crashed thread)."""
+        with self._cond:
+            return (self._state == "running"
+                    and self._thread is not None
+                    and self._thread.is_alive())
+
+    def heartbeat(self):
+        """``(alive, loop iterations, live ingress length)`` without
+        touching the engine lock: the supervisor's progress probe
+        stays responsive even while a tick is wedged or
+        fault-stalled.  A replica whose iteration count stops
+        advancing between probes while work is queued is degraded; a
+        dead thread is down.  The ingress length is the LIVE deque
+        (not the gauge, which a stalled loop never refreshes), so
+        the probe can see work a stuck replica is sitting on."""
+        with self._cond:
+            alive = (self._state == "running"
+                     and self._thread is not None
+                     and self._thread.is_alive())
+            return alive, self._n_loop_iters, len(self._ingress)
+
+    def drained(self):
+        """True when no accepted request is still in flight (empty
+        ingress AND no pending ticket) — the precondition
+        :meth:`reshard`'s drain-and-handoff waits on."""
+        with self._cond:
+            if self._ingress:
+                return False
+        with self._engine_lock:
+            return not self._pending
 
     def readiness(self):
         """``(ready, detail)`` for the ``/readyz`` endpoint.

@@ -51,10 +51,12 @@ def test_fixture_history_passes_and_gates():
     # (ISSUE 14: 3 rounds x 3 metrics — routed requests/s, overload
     # p99, shed ratio) + the realtime_r01-r03 tier (ISSUE 15:
     # 3 rounds x 2 metrics — per-TR p99 latency, deadline-miss
-    # ratio, both lower-is-better), all measured host-side ->
-    # *_cpu_fallback: nine tiers gating independently from one
-    # directory
-    assert len(records) == 53
+    # ratio, both lower-is-better) + the elastic_r01-r03 tier
+    # (ISSUE 16: 3 rounds x 3 metrics — chaos-soak requests/s,
+    # post-failure p99, lost-ticket count held at zero), all
+    # measured host-side -> *_cpu_fallback: ten tiers gating
+    # independently from one directory
+    assert len(records) == 62
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
@@ -65,12 +67,13 @@ def test_fixture_history_passes_and_gates():
                      "kernels_cpu_fallback",
                      "streaming_cpu_fallback",
                      "federation_cpu_fallback",
-                     "realtime_cpu_fallback"}
+                     "realtime_cpu_fallback",
+                     "elastic_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
     multi = ("service_cpu_fallback", "kernels_cpu_fallback",
              "streaming_cpu_fallback", "federation_cpu_fallback",
-             "realtime_cpu_fallback")
+             "realtime_cpu_fallback", "elastic_cpu_fallback")
     by_tier = {c["tier"]: c for c in result["checks"]
                if c["tier"] not in multi}
     by_metric = {c["metric"]: c for c in result["checks"]
@@ -93,7 +96,10 @@ def test_fixture_history_passes_and_gates():
                               "federation_overload_p99_seconds",
                               "federation_shed_ratio",
                               "realtime_tr_p99_latency_seconds",
-                              "realtime_deadline_miss_ratio"}
+                              "realtime_deadline_miss_ratio",
+                              "elastic_soak_requests_per_sec",
+                              "elastic_post_failure_p99_seconds",
+                              "elastic_lost_tickets"}
     assert by_metric["service_obs_overhead_ratio"][
         "direction"] == "lower_is_better"
     # the ISSUE 13 streaming tier gates overlap the right way round
@@ -110,6 +116,13 @@ def test_fixture_history_passes_and_gates():
     assert by_metric["realtime_deadline_miss_ratio"][
         "direction"] == "lower_is_better"
     assert by_metric["federation_shed_ratio"][
+        "direction"] == "lower_is_better"
+    # the ISSUE 16 elastic tier holds the lost-ticket count at
+    # ZERO: any growth is an infinite-ratio regression
+    assert by_metric["elastic_lost_tickets"][
+        "direction"] == "lower_is_better"
+    assert by_metric["elastic_lost_tickets"]["value"] == 0.0
+    assert by_metric["elastic_post_failure_p99_seconds"][
         "direction"] == "lower_is_better"
     assert all(c["status"] == "ok" for c in by_metric.values())
     assert by_tier["cpu_fallback"]["status"] == "ok"

@@ -5,6 +5,8 @@ generator.  The conftest forces 8 CPU devices, so the mesh paths
 run multi-device in-process; the SRV003 gate adds true-subprocess
 replica coverage."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -529,3 +531,95 @@ def test_replay_drives_service_to_completion(srm_model):
         records = [t.result(timeout=60) for t in tickets]
     assert len(records) == 16
     assert all(r.ok for r in records)
+
+
+# -- in-flight correction parity (ISSUE 16 satellite) -----------------
+
+def test_router_inflight_correction_drains_to_gauge_parity(
+        srm_model):
+    """The router's per-wave in-flight correction (depths bumped at
+    placement time, ahead of the gauges) is transient: once a wave
+    is delivered, the published depth gauges drain back to the true
+    value (zero) — and a shed wave at the admission bound drains
+    back the same way, because shed requests never touch a queue."""
+
+    def settled_depth(replica, want=0.0, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while replica.queue_depth() != want:
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.005)
+        return replica.queue_depth()
+
+    r1 = _replica("p1", {"m": srm_model})
+    r2 = _replica("p2", {"m": srm_model})
+    router = Router([r1, r2],
+                    admission=AdmissionController(
+                        max_depth=3, retry_after_s=0.01))
+    try:
+        # under the bound: all delivered, gauges back to zero
+        records = [t.result(timeout=60) for t in
+                   router.submit_many(
+                       _srm_requests(srm_model, 4), model="m")]
+        assert all(r.ok for r in records)
+        assert settled_depth(r1) == 0.0
+        assert settled_depth(r2) == 0.0
+
+        # a wave AT the admission bound: the tail sheds, every
+        # ticket resolves, and the gauges still drain to zero —
+        # the correction never leaks shed requests into the depth
+        records = [t.result(timeout=60) for t in
+                   router.submit_many(
+                       _srm_requests(srm_model, 16, prefix="s"),
+                       model="m")]
+        assert len(records) == 16
+        sheds = [r for r in records if r.error == "shed_overload"]
+        assert sheds and all(r.retry_after_s > 0 for r in sheds)
+        assert sum(1 for r in records if r.ok) == 16 - len(sheds)
+        assert settled_depth(r1) == 0.0
+        assert settled_depth(r2) == 0.0
+        # parity restored: the next wave's placement snapshot sees
+        # clean depths and routes instead of shedding
+        record = router.submit(
+            _srm_requests(srm_model, 1, prefix="z")[0],
+            model="m").result(timeout=60)
+        assert record.ok
+    finally:
+        r1.service.shutdown()
+        r2.service.shutdown()
+
+
+def test_admission_brownout_recovers_after_violation_clears(
+        srm_model):
+    """ISSUE 16 satellite: once the SLO violation clears, the
+    browned-out depth bound returns to max_depth on the next
+    throttled poll — brownout is a temporary regime, not a ratchet
+    (fake-clock harness, like the brownout test above)."""
+
+    class FakeTracker:
+        def __init__(self):
+            self.violating = False
+
+        def evaluate(self):
+            return {"objectives": {
+                "p99": {"violating": self.violating}}}
+
+    clock = [0.0]
+    tracker = FakeTracker()
+    ctrl = AdmissionController(max_depth=8, slo=tracker,
+                               brownout_factor=0.5,
+                               slo_poll_interval_s=1.0,
+                               clock=lambda: clock[0])
+    assert ctrl.depth_bound() == 8
+    assert ctrl.burning() is False
+    tracker.violating = True
+    clock[0] = 2.0
+    assert ctrl.depth_bound() == 4            # browned out
+    assert ctrl.burning() is True
+    assert ctrl.stats()["depth_bound"] == 4
+    tracker.violating = False
+    assert ctrl.depth_bound() == 4            # poll throttled
+    clock[0] = 4.0
+    assert ctrl.depth_bound() == 8            # recovered
+    assert ctrl.burning() is False
+    assert ctrl.stats()["depth_bound"] == 8

@@ -1,0 +1,376 @@
+"""Elastic fault-tolerant fleet (ISSUE 16 tentpole): supervisor
+health hysteresis, failover re-placement with the exactly-one-
+ticket invariant, typed ``replica_lost`` records, autoscaling, and
+drain-and-handoff resharding.  The SRV004 gate adds the full chaos
+soak at subprocess granularity; these tests pin each mechanism
+deterministically (fake replicas for the state machine, injected
+clocks for deadlines, targeted faults for real crashes)."""
+
+import time
+
+import pytest
+
+from brainiak_tpu.resilience import faults
+from brainiak_tpu.serve.batching import BucketPolicy, Request
+from brainiak_tpu.serve.federation import (FleetSupervisor,
+                                           LocalReplica, Router,
+                                           TrafficGenerator,
+                                           scrape_replica_state)
+from brainiak_tpu.serve.residency import ModelResidency
+from brainiak_tpu.serve.service import ServeService, ServiceTicket
+
+
+def _policy():
+    return BucketPolicy(max_batch=8, max_wait_s=0.01)
+
+
+def _replica(name, model, aot=None):
+    res = ModelResidency(budget_bytes=1 << 30, policy=_policy(),
+                         devices=["hbm0"], aot=aot)
+    res.register("m", model=model)
+    return LocalReplica(ServeService(
+        res, default_model="m", name=name).start())
+
+
+# -- fakes for the supervision state machine --------------------------
+
+
+class FakeService:
+    def __init__(self):
+        self.alive_flag = True
+        self.iters = 0
+        self.n_ingress = 0
+        self.ready = True
+        self.shutdowns = []
+        self.work = []
+
+    def heartbeat(self):
+        return self.alive_flag, self.iters, self.n_ingress
+
+    def readiness(self):
+        return self.ready, {}
+
+    def alive(self):
+        return self.alive_flag
+
+    def shutdown(self, drain=True, timeout=None):
+        self.shutdowns.append(drain)
+        self.alive_flag = False
+
+    def unresolved_work(self):
+        return list(self.work)
+
+
+class FakeReplica:
+    def __init__(self, name, depth=0):
+        self.name = name
+        self.depth = depth
+        self.service = FakeService()
+        self.submitted = []
+
+    def queue_depth(self):
+        return self.depth
+
+    def resident_models(self):
+        return {"m"}
+
+    def registered_models(self):
+        return {"m"}
+
+    def submit_many(self, requests):
+        self.submitted.extend(requests)
+        out = []
+        for request in requests:
+            ticket = ServiceTicket(request.request_id, "m")
+            out.append(ticket)
+        return out
+
+
+# -- health hysteresis ------------------------------------------------
+
+
+def test_supervisor_hysteresis_walks_states():
+    """healthy -> degraded needs degraded_after consecutive slow
+    probes; degraded -> healthy needs healthy_after good ones; a
+    single bad probe never flips anything (the hysteresis point)."""
+    replica = FakeReplica("r1")
+    sup = FleetSupervisor(Router([replica]), degraded_after=2,
+                          dead_after=2, healthy_after=2)
+
+    def tick(advance=True):
+        if advance:
+            replica.service.iters += 1
+        return sup.poll()["states"]["r1"]
+
+    assert tick() == "healthy"
+    # loop frozen with work queued: slow probes
+    replica.service.n_ingress = 3
+    assert tick(advance=False) == "healthy"   # slow x1: held
+    assert tick(advance=False) == "degraded"  # slow x2: degraded
+    # recovery: progress resumes, queue drains
+    replica.service.n_ingress = 0
+    assert tick() == "degraded"               # good x1: held
+    assert tick() == "healthy"                # good x2: healed
+    # a frozen loop with NO work queued is just idle, not slow
+    assert tick(advance=False) == "healthy"
+
+
+def test_supervisor_declares_death_and_fails_over():
+    """dead_after down-probes declare death: the replica leaves the
+    router, its unresolved work is harvested and re-placed on the
+    survivor, and the supervision ledger records the failover."""
+    r1, r2 = FakeReplica("r1"), FakeReplica("r2")
+    router = Router([r1, r2])
+    sup = FleetSupervisor(router, dead_after=2)
+    stranded = Request(request_id="q1", x=None, model="m")
+    ticket = ServiceTicket("q1", "m")
+    r1.service.work = [("m", stranded, ticket)]
+    r1.service.alive_flag = False
+
+    first = sup.poll()
+    assert first["states"]["r1"] == "degraded"  # down x1: held
+    assert not first["failed_over"]
+    second = sup.poll()
+    assert second["states"]["r1"] == "dead"
+    assert second["failed_over"] == [
+        {"replica": "r1", "n_replaced": 1, "n_lost": 0}]
+    assert [r.name for r in router.replicas] == ["r2"]
+    assert [r.request_id for r in r2.submitted] == ["q1"]
+    # re-placement chained the original ticket to the new one
+    assert not ticket.done()
+    summary = sup.summary()
+    assert summary["n_failovers"] == 1
+    assert summary["states"]["r1"] == "dead"
+
+
+# -- failover re-placement against real services ----------------------
+
+
+def test_crash_failover_resolves_every_ticket(srm_model, tmp_path):
+    """A targeted replica_crash strands a submitted wave in r1's
+    ingress (the loop dies mid-stall, before routing); the
+    supervisor declares death, the router re-places the wave on r2,
+    and EVERY original ticket resolves ok — zero lost tickets."""
+    aot = str(tmp_path / "aot")
+    r1 = _replica("r1", srm_model, aot=aot)
+    r2 = _replica("r2", srm_model, aot=aot)
+    router = Router([r1, r2])
+    sup = FleetSupervisor(router, dead_after=1)
+    gen = TrafficGenerator(srm_model, model_name="m", seed=0,
+                           tr_choices=(8, 16))
+    try:
+        with faults.inject("slow_replica", times=1, leaf=1.5,
+                           target="r1") as stall, \
+                faults.inject("replica_crash",
+                              target="r1") as crash:
+            deadline = time.monotonic() + 30.0
+            while stall.fired == 0:
+                assert time.monotonic() < deadline, "no stall"
+                time.sleep(0.001)
+            # lands in ingress during the stall; the crash fires
+            # in the SAME iteration, before the ingress drain
+            tickets = r1.service.submit_many(
+                gen.requests(6, deadline_s=60.0))
+            while r1.service.alive():
+                assert time.monotonic() < deadline, "no crash"
+                time.sleep(0.001)
+        assert crash.fired == 1
+        actions = sup.poll()
+        assert actions["failed_over"][0]["n_replaced"] == 6
+        assert actions["failed_over"][0]["n_lost"] == 0
+        records = [t.result(timeout=60) for t in tickets]
+    finally:
+        for replica in (r1, r2):
+            replica.service.shutdown(drain=False)
+    assert all(r.ok for r in records)
+    assert router.summary()["routed"]["r2"] >= 6
+    assert router.summary()["n_failed_over"] == 6
+
+
+def test_failover_past_deadline_resolves_replica_lost():
+    """Work already past its deadline is NOT re-placed: it resolves
+    as a typed replica_lost record (reason deadline), and with no
+    survivors at all everything resolves replica_lost — never
+    silence, never a surprise re-execution."""
+    survivor = FakeReplica("r2")
+    router = Router([survivor])
+    expired = Request(request_id="old", x=None, model="m",
+                      submitted=100.0, deadline_s=1.0)
+    fresh = Request(request_id="new", x=None, model="m",
+                    submitted=100.0, deadline_s=50.0)
+    t_old, t_new = ServiceTicket("old", "m"), ServiceTicket(
+        "new", "m")
+    out = router.failover([("r1", expired, t_old),
+                           ("r1", fresh, t_new)],
+                          source="r1", now=110.0)
+    assert out == {"n_replaced": 1, "n_lost": 1}
+    rec = t_old.result(timeout=1)
+    assert not rec.ok and rec.error == "replica_lost"
+    assert "r1" in rec.message
+    assert [r.request_id for r in survivor.submitted] == ["new"]
+
+    # no survivors left: everything is lost, typed, immediately
+    router.remove_replica("r2")
+    t2 = ServiceTicket("n2", "m")
+    out = router.failover(
+        [("r1", Request(request_id="n2", x=None, model="m"),
+          t2)], source="r1")
+    assert out == {"n_replaced": 0, "n_lost": 1}
+    assert t2.result(timeout=1).error == "replica_lost"
+
+
+# -- autoscaling ------------------------------------------------------
+
+
+def test_supervisor_scales_up_and_down():
+    """Queue pressure grows the fleet through the factory (bounded
+    by max_replicas); scale_down_after consecutive idle polls drain
+    the most recent joiner away (never below min_replicas)."""
+    base = FakeReplica("r1", depth=0)
+    router = Router([base])
+    spawned = []
+
+    def factory(name):
+        replica = FakeReplica(name)
+        spawned.append(replica)
+        return replica
+
+    sup = FleetSupervisor(router, factory=factory, min_replicas=1,
+                          max_replicas=2, scale_up_depth=4.0,
+                          scale_down_depth=1.0, scale_down_after=2)
+    base.depth = 10
+    first = sup.poll()
+    assert first["scaled_up"] == ["auto1"]
+    assert {r.name for r in router.replicas} == {"r1", "auto1"}
+    # at max_replicas: pressure no longer grows the fleet
+    assert sup.poll()["scaled_up"] == []
+
+    base.depth = 0
+    for replica in spawned:
+        replica.service.iters += 1
+    assert sup.poll()["scaled_down"] == []    # idle x1: held
+    for replica in spawned:
+        replica.service.iters += 1
+    down = sup.poll()["scaled_down"]
+    assert down == ["auto1"]                  # idle x2: drained
+    assert spawned[0].service.shutdowns == [True]
+    assert [r.name for r in router.replicas] == ["r1"]
+    # at min_replicas: idleness never empties the fleet
+    assert sup.poll()["scaled_down"] == []
+    assert sup.poll()["scaled_down"] == []
+    summary = sup.summary()
+    assert summary["scaled_up"] == ["auto1"]
+    assert summary["scaled_down"] == ["auto1"]
+
+
+def test_supervisor_scales_up_on_shed_and_burn():
+    """The other two /metrics signals: a shed-count delta since the
+    last poll, and a burning admission SLO, each trigger scale-up
+    even with shallow queues."""
+
+    class FakeAdmission:
+        def __init__(self):
+            self.burn = False
+
+        def burning(self):
+            return self.burn
+
+        def stats(self):
+            return {}
+
+    admission = FakeAdmission()
+    router = Router([FakeReplica("r1")], admission=admission)
+    sup = FleetSupervisor(router, factory=FakeReplica,
+                          max_replicas=3, scale_up_depth=1000.0)
+    assert sup.poll()["scaled_up"] == []
+    with router._lock:
+        router._n_shed += 5        # a shed wave landed
+    assert sup.poll()["scaled_up"] == ["auto1"]
+    assert sup.poll()["scaled_up"] == []      # delta consumed
+    admission.burn = True
+    assert sup.poll()["scaled_up"] == ["auto2"]
+
+
+# -- drain-and-handoff resharding -------------------------------------
+
+
+def test_reshard_replica_drain_and_handoff(srm_model):
+    """reshard_replica detaches the replica, waits out the drain,
+    re-lays residency out over the new device set, and re-attaches:
+    requests before AND after see a whole model, and the residency
+    charges the new device count afterwards."""
+    r1 = _replica("r1", srm_model)
+    router = Router([r1])
+    sup = FleetSupervisor(router)
+    gen = TrafficGenerator(srm_model, model_name="m", seed=1,
+                           tr_choices=(8,))
+    try:
+        before = [t.result(timeout=60) for t in
+                  router.submit_many(gen.requests(4))]
+        dropped = sup.reshard_replica(
+            "r1", devices=["hbm0", "hbm1"])
+        assert dropped == ["m"]
+        assert [r.name for r in router.replicas] == ["r1"]
+        after = [t.result(timeout=60) for t in
+                 router.submit_many(gen.requests(4, prefix="b"))]
+    finally:
+        r1.service.shutdown()
+    assert all(r.ok for r in before + after)
+    stats = r1.service.residency.stats()
+    assert set(stats["per_device"]) == {"hbm0", "hbm1"}
+
+
+def test_reshard_refuses_while_work_pending(srm_model):
+    """ServeService.reshard is drain-gated: with work still queued
+    it refuses (RuntimeError) instead of dropping a model out from
+    under a queued request."""
+    r1 = _replica("r1", srm_model)
+    try:
+        with faults.inject("slow_replica", times=1, leaf=1.0,
+                           target="r1") as stall:
+            deadline = time.monotonic() + 30.0
+            while stall.fired == 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            gen = TrafficGenerator(srm_model, model_name="m",
+                                   seed=2, tr_choices=(8,))
+            tickets = r1.service.submit_many(gen.requests(2))
+            with pytest.raises(RuntimeError, match="drain"):
+                r1.service.reshard(devices=["hbm0", "hbm1"])
+        records = [t.result(timeout=60) for t in tickets]
+    finally:
+        r1.service.shutdown()
+    assert all(r.ok for r in records)
+
+
+# -- the scrape's typed unreachable state -----------------------------
+
+
+def test_scrape_replica_state_unreachable():
+    """ISSUE 16 satellite: a dead endpoint exhausts the bounded
+    retries and comes back as a TYPED unreachable state (zeroed
+    placement signals), not an exception mid-supervision-round."""
+    state = scrape_replica_state("127.0.0.1:9", timeout=0.2,
+                                 retries=1, backoff=0.0)
+    assert state["state"] == "unreachable"
+    assert "error" in state
+    assert state["queue_depth"] == 0.0
+    assert state["by_replica"] == {}
+
+
+def test_scrape_replica_state_ok_has_state_field(srm_model):
+    """The live-scrape dict now carries state=ok so supervision
+    code can branch on one field for both outcomes."""
+    res = ModelResidency(budget_bytes=1 << 30, policy=_policy(),
+                         devices=["hbm0"])
+    res.register("m", model=srm_model)
+    with ServeService(res, default_model="m", name="rep1",
+                      http_port=0) as svc:
+        gen = TrafficGenerator(srm_model, model_name="m", seed=3,
+                               tr_choices=(8,))
+        for ticket in svc.submit_many(gen.requests(2)):
+            assert ticket.result(timeout=60).ok
+        port = svc.summary()["http_port"]
+        state = scrape_replica_state(f"127.0.0.1:{port}")
+    assert state["state"] == "ok"
+    assert "rep1" in state["by_replica"]

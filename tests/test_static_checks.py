@@ -45,8 +45,8 @@ def test_run_checks_json_output():
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
         "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
-        "serve", "service", "federation", "distla", "encoding",
-        "kernels", "data", "realtime"}
+        "serve", "service", "federation", "fleet", "distla",
+        "encoding", "kernels", "data", "realtime"}
     assert payload["files"] > 100
     seconds = payload["gate_seconds"]
     assert set(seconds) == set(payload["gates"])
@@ -434,6 +434,73 @@ def test_federation_gate_classifies_failures(monkeypatch):
     rc.check_federation(findings)
     assert [f.code for f in findings] == ["SRV003"]
     assert "parity" in findings[0].message
+
+
+def test_fleet_gate_classifies_failures(monkeypatch):
+    """SRV004 (ISSUE 16 satellite): a lost ticket, a missing
+    failover, a missed degraded verdict, a missing scale-up, and
+    scale-up retraces each classify distinctly.  The chaos-soak
+    child is stubbed with canned verdicts so the classification
+    paths run without a soak subprocess."""
+    rc = _load_run_checks()
+
+    def child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    # a request that never resolved: the invariant violation
+    monkeypatch.setattr(rc, "_FLEET_CHILD", child(
+        {"ok": False, "all_resolved": False, "n_unresolved": 3,
+         "by_code": {"delivered": 45}}))
+    findings = []
+    rc.check_fleet(findings)
+    assert [f.code for f in findings] == ["SRV004"]
+    assert "LOST 3 ticket" in findings[0].message
+    assert "exactly one ticket" in findings[0].message
+
+    # the killed replica's work was not re-placed
+    monkeypatch.setattr(rc, "_FLEET_CHILD", child(
+        {"ok": False, "all_resolved": True, "failover_ok": False,
+         "crash_fired": 1, "failover": {"n_replaced": 0},
+         "routed": {"r2": 0}}))
+    findings = []
+    rc.check_fleet(findings)
+    assert [f.code for f in findings] == ["SRV004"]
+    assert "did not fail over" in findings[0].message
+
+    # the stalled replica never went degraded
+    monkeypatch.setattr(rc, "_FLEET_CHILD", child(
+        {"ok": False, "all_resolved": True, "failover_ok": True,
+         "survivor_routed_ok": True, "degraded_seen": False,
+         "states": {"r1": "healthy"}}))
+    findings = []
+    rc.check_fleet(findings)
+    assert [f.code for f in findings] == ["SRV004"]
+    assert "degraded" in findings[0].message
+
+    # the surge never scaled the fleet up
+    monkeypatch.setattr(rc, "_FLEET_CHILD", child(
+        {"ok": False, "all_resolved": True, "failover_ok": True,
+         "survivor_routed_ok": True, "degraded_seen": True,
+         "scale_up_ok": False, "scaled_replicas": [],
+         "n_scaled_up_served": 0}))
+    findings = []
+    rc.check_fleet(findings)
+    assert [f.code for f in findings] == ["SRV004"]
+    assert "scale the fleet up" in findings[0].message
+
+    # a scaled-up replica compiled: classified by the shared
+    # retrace harness, identically to every selfcheck gate
+    monkeypatch.setattr(rc, "_FLEET_CHILD", child(
+        {"ok": False, "all_resolved": True, "failover_ok": True,
+         "survivor_routed_ok": True, "degraded_seen": True,
+         "scale_up_ok": True,
+         "retraces": {"serve.fleet": 3.0}}))
+    findings = []
+    rc.check_fleet(findings)
+    assert [f.code for f in findings] == ["SRV004"]
+    assert "rebuilt" in findings[0].message
 
 
 def test_distla_gate_passes_on_live_package():

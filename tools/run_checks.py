@@ -58,6 +58,14 @@ federation serving federation gate (SRV003): two ``serve service
            sharded over-budget serving parity, per-device
            residency accounting, and load shedding with
            retry_after
+fleet      elastic-fleet chaos gate (SRV004): the fleet
+           selfcheck child on the 8-device CPU mesh runs one
+           deterministic chaos soak — heavy-tailed traffic
+           triples mid-run while a replica is stalled and then
+           killed under injected faults — and fails on a lost
+           ticket (a request that never resolves), a missing
+           failover to survivors, or ANY serve retrace on the
+           mid-run scaled-up replicas over the shared AOT cache
 distla     smoke-runs the pod-scale linear algebra selfcheck
            (``brainiak_tpu.ops.distla.selfcheck``) on a tiny
            fixture over an 8-device CPU mesh and fails on
@@ -123,8 +131,8 @@ from brainiak_tpu.analysis.core import (  # noqa: E402,F401
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
          "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
-         "serve", "service", "federation", "distla", "encoding",
-         "kernels", "data", "realtime")
+         "serve", "service", "federation", "fleet", "distla",
+         "encoding", "kernels", "data", "realtime")
 
 
 def python_sources():
@@ -891,6 +899,75 @@ def check_federation(findings):
         "federation", classify)
 
 
+# -- elastic-fleet gate -----------------------------------------------
+
+_FLEET_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.serve.federation.fleet_selfcheck import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_fleet(findings):
+    """Elastic-fleet chaos gate (SRV004): the fleet selfcheck child
+    on the 8-device CPU mesh runs one deterministic chaos soak —
+    fmrisim heavy-tailed traffic triples mid-run while replica
+    ``r1`` is degraded by an injected ``slow_replica`` fault and
+    killed by an injected ``replica_crash`` fault with a wave still
+    queued in its ingress.  Verified, in failure-class order:
+
+    - **lost tickets** — every submitted request resolves exactly
+      one ticket (``delivered`` / ``shed_overload`` / typed
+      ``replica_lost``), never silence;
+    - **failover** — the supervisor declared the killed replica
+      dead and the router re-placed its stranded work onto
+      survivors, with the survivor actually routed;
+    - **scale-up retraces** — the surge grew the fleet and the
+      mid-run joiners served off the shared AOT cache with ZERO
+      new serve programs (classified generically by the selfcheck
+      harness, like every gate)."""
+
+    def classify(verdict):
+        if not verdict.get("all_resolved", True):
+            return (f"fleet chaos soak LOST "
+                    f"{verdict.get('n_unresolved')} ticket(s): a "
+                    "request on a killed replica must still "
+                    "resolve exactly one ticket (delivered, shed, "
+                    "or a typed replica_lost record) — silent "
+                    "loss is the invariant violation "
+                    f"(by_code={verdict.get('by_code')})")
+        if not verdict.get("failover_ok", True) \
+                or not verdict.get("survivor_routed_ok", True):
+            return ("replica death did not fail over to "
+                    "survivors: crash_fired="
+                    f"{verdict.get('crash_fired')}, failover="
+                    f"{verdict.get('failover')}, routed="
+                    f"{verdict.get('routed')}")
+        if not verdict.get("degraded_seen", True):
+            return ("the stalled replica was never marked "
+                    "degraded: the supervisor's slow-replica "
+                    "hysteresis is broken (states="
+                    f"{verdict.get('states')})")
+        if not verdict.get("scale_up_ok", True):
+            return ("the mid-run traffic surge did not scale the "
+                    "fleet up (or the joiners served nothing): "
+                    f"scaled={verdict.get('scaled_replicas')}, "
+                    f"n_scaled_up_served="
+                    f"{verdict.get('n_scaled_up_served')}")
+        return ("fleet chaos soak failed: "
+                f"warm_retraces={verdict.get('warm_retraces')}, "
+                f"final_retraces={verdict.get('final_retraces')}, "
+                f"by_code={verdict.get('by_code')}")
+
+    _run_selfcheck_gate(
+        findings, _FLEET_CHILD, "SRV004",
+        _rel(os.path.join(REPO, "brainiak_tpu", "serve",
+                          "federation", "fleet_selfcheck.py")),
+        "fleet", classify)
+
+
 # -- selfcheck-child gates (distla, encoding) -------------------------
 #
 # Shared harness: run a module selfcheck in a child pinned to an
@@ -1304,6 +1381,8 @@ def run_gates(only=None):
         timed("service", check_service, findings)
     if "federation" in selected:
         timed("federation", check_federation, findings)
+    if "fleet" in selected:
+        timed("fleet", check_fleet, findings)
     if "distla" in selected:
         timed("distla", check_distla, findings)
     if "encoding" in selected:
@@ -1326,8 +1405,9 @@ def run_gates(only=None):
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
                        "jaxlint-deep", "obs", "obs-live", "regress",
-                       "serve", "service", "federation", "distla",
-                       "encoding", "kernels", "data", "realtime")
+                       "serve", "service", "federation", "fleet",
+                       "distla", "encoding", "kernels", "data",
+                       "realtime")
            if g in selected])
     return {
         "ok": not findings,
