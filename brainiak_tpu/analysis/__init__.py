@@ -8,10 +8,15 @@ and explicit static arguments.  v2 project rules run over a shared
 call-graph model (:mod:`.graph`/:mod:`.summaries`): interprocedural
 dataflow (JX010-JX012), mesh/collective axis checking
 (JX101-JX103), and the serve-loop lock-discipline race detector
-(JX201-JX205).  Run it standalone
-(``python -m brainiak_tpu.analysis``, ``--format sarif`` for CI
-annotation hosts) or through the ``jaxlint`` / ``jaxlint-deep``
-gates of ``python -m tools.run_checks``.
+(JX201-JX205).  v3 (:mod:`.ir`, rules JP301-JP305) leaves the AST
+entirely: every registered jitted-program builder is traced at a
+canonical abstract signature and the rules run over the actual
+jaxpr/executable — dtype promotion, donation, host callbacks,
+collective axes, retrace surface.  Run it standalone
+(``python -m brainiak_tpu.analysis``, ``--ir`` for the traced
+tier, ``--format sarif`` for CI annotation hosts) or through the
+``jaxlint`` / ``jaxlint-deep`` / ``jaxlint-ir`` gates of
+``python -m tools.run_checks``.
 """
 
 from .baseline import Baseline, BaselineError  # noqa: F401
@@ -29,4 +34,5 @@ from .core import (  # noqa: F401
 )
 from .rules import JAXLINT_RULES  # noqa: F401
 from .cli import ALL_RULES, DEEP_RULES  # noqa: F401
+from .ir import IR_RULES, run_audit  # noqa: F401
 from .sarif import to_sarif  # noqa: F401

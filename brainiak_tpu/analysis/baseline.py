@@ -74,8 +74,13 @@ class Baseline:
                 f"{path}: expected object with 'entries' list")
         return cls(entries, path=path)
 
-    def filter(self, findings):
-        """Split findings into (kept, stale-baseline-entries)."""
+    def filter(self, findings, codes=None):
+        """Split findings into (kept, stale-baseline-entries).
+
+        ``codes`` names the rule codes the caller actually ran: only
+        entries for those rules can be judged stale (an entry for a
+        rule family this run never executed always looks unmatched —
+        e.g. the JP3xx traced-IR entries during an AST-only walk)."""
         used = set()
         kept = []
         for finding in findings:
@@ -86,7 +91,8 @@ class Baseline:
             else:
                 kept.append(finding)
         stale = [entry for key, entry in self._index.items()
-                 if key not in used]
+                 if key not in used
+                 and (codes is None or key[0] in codes)]
         return kept, stale
 
     @staticmethod

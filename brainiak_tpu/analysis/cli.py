@@ -93,17 +93,106 @@ def build_parser():
              "(reasons set to TODO) and exit 0")
     parser.add_argument("--list-rules", action="store_true",
                         help="list rule codes and exit")
+    parser.add_argument(
+        "--ir", action="store_true",
+        help="run the jaxlint-IR audit (JP301-JP305): trace every "
+             "registered jitted-program builder at its canonical "
+             "abstract signature and rule-check the actual IR; "
+             "requires jax (pins a forced multi-device CPU backend "
+             "when jax is not yet configured)")
     return parser
+
+
+def _setup_ir_env():
+    """Pin the audit backend BEFORE jax first imports: CPU, 8 forced
+    host devices (so collective programs trace against a real mesh).
+    A caller that already imported/configured jax wins."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def run_ir(paths, repo_root, select=None, baseline_path=None):
+    """Programmatic jaxlint-IR entry; returns an AuditReport."""
+    from . import ir
+    _setup_ir_env()
+    baseline = (Baseline.load(baseline_path)
+                if baseline_path else None)
+    return ir.run_audit(paths, repo_root, select=select,
+                        baseline=baseline)
+
+
+def _main_ir(args, config):
+    from . import ir
+
+    select = (tuple(c.strip() for c in args.select.split(","))
+              if args.select else ir.DEFAULT_SELECT)
+    by_code = {r.code: r for r in ir.IR_RULES}
+    unknown = [c for c in select if c not in by_code]
+    if unknown:
+        print(f"jaxlint: unknown IR rule code(s): "
+              f"{', '.join(unknown)}", file=sys.stderr)
+        return 2
+    paths = args.paths or config.include_paths()
+    baseline_path = None
+    if not args.no_baseline and not args.write_baseline:
+        baseline_path = (
+            os.path.abspath(args.baseline) if args.baseline
+            else config.baseline_path())
+    try:
+        report = run_ir(paths, config.repo_root, select=select,
+                        baseline_path=baseline_path)
+    except BaselineError as exc:
+        print(f"jaxlint: {exc}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            fh.write(Baseline.render(report.findings))
+        print(f"jaxlint: wrote {len(report.findings)} baseline "
+              f"entries to {args.write_baseline}")
+        return 0
+    if args.format == "sarif":
+        print(json.dumps(to_sarif(
+            report.findings,
+            {c: by_code[c] for c in select}), indent=2))
+    elif args.format == "json":
+        payload = report.to_dict()
+        payload["ok"] = not report.findings
+        print(json.dumps(payload, indent=2))
+    else:
+        for finding in report.findings:
+            print(finding)
+        for site, reason in sorted(report.skipped.items()):
+            print(f"skip: {site}: {reason}")
+        for entry in report.stale:
+            print(f"warning: stale baseline entry "
+                  f"{entry['rule']} {entry['path']} "
+                  f"({entry['reason']}) matches nothing; delete it")
+        status = "OK" if not report.findings else \
+            f"{len(report.findings)} finding(s)"
+        print(f"jaxlint-ir: {status}; traced "
+              f"{len(report.traced)}/{len(report.sites)} builder "
+              f"sites (coverage {report.coverage:.0%}) in "
+              f"{report.seconds:.1f}s")
+    return 1 if report.findings else 0
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        for rule in ALL_RULES:
+        from .ir import IR_RULES
+        for rule in (*ALL_RULES, *IR_RULES):
             print(f"{rule.code}  {rule.name}: "
                   f"{(rule.__doc__ or '').splitlines()[0]}")
         return 0
     config = load_config()
+    if args.ir:
+        return _main_ir(args, config)
     select = (tuple(c.strip() for c in args.select.split(","))
               if args.select else config.select)
     paths = args.paths or config.include_paths()

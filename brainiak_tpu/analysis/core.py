@@ -482,7 +482,8 @@ def analyze_paths(paths, repo_root, rules, baseline=None):
         if isinstance(rule, RepoRule):
             findings.extend(rule.check(repo_root))
     if baseline is not None:
-        findings, stale = baseline.filter(findings)
+        findings, stale = baseline.filter(
+            findings, codes={r.code for r in instances})
     else:
         stale = []
     findings.sort(key=lambda f: (f.path, f.line, f.code))

@@ -1,0 +1,28 @@
+"""jaxlint-IR: the traced-IR analysis tier (rules JP301-JP305).
+
+The third analysis tier.  Tier 1 (:mod:`..rules`) pattern-matches
+single files; tier 2 (:mod:`..interproc` and friends) reasons over
+the project call graph; this tier builds every registered
+jitted-program builder at a canonical abstract signature and runs
+rules over the **actual jaxpr/executable** — dtype promotion leaks
+(JP301), degenerate donation (JP302), host callbacks in hot programs
+(JP303), collective-axis validity against a real mesh (JP304), and
+retrace-surface hygiene of the builder cache keys (JP305).
+
+Entry points: :func:`run_audit` (programmatic),
+``python -m brainiak_tpu.analysis.cli --ir`` (CLI), and the
+``jaxlint-ir`` gate of ``tools/run_checks.py`` (CI).  Importing this
+package is jax-free; only :func:`run_audit` needs a working jax.
+"""
+
+from .audit import AuditReport, enumerate_static_sites, run_audit
+from .rules import DEFAULT_SELECT, IR_RULES, IRRule
+
+__all__ = [
+    "AuditReport",
+    "DEFAULT_SELECT",
+    "IRRule",
+    "IR_RULES",
+    "enumerate_static_sites",
+    "run_audit",
+]

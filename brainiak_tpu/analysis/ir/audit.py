@@ -1,0 +1,228 @@
+"""jaxlint-IR auditor: enumerate, trace, and rule-check every
+registered jitted-program builder.
+
+The enumeration is **mechanical**, not curated:
+
+1. the source tree is AST-scanned for ``counted_cache`` /
+   ``program_cache`` decorated builders (the same decorator
+   spellings jaxlint's JX001 recognizes) — this static census is the
+   coverage DENOMINATOR, robust to modules that fail to import;
+2. every census module is imported, which registers its builders in
+   :func:`~brainiak_tpu.obs.runtime.builder_registry`;
+3. each registered site's canonical-signature factory runs, and each
+   spec it yields is traced (:mod:`.trace`) under the audit
+   configuration (x64 on, forced multi-device CPU);
+4. the JP3xx rules run over each trace; findings anchor at the
+   builder's ``def`` line in its source file, where line pragmas and
+   the shared baseline apply.
+
+A site that cannot be audited is never silently dropped: it appears
+in the coverage report with a reason (module import failed, no
+canonical signature, factory failed, trace failed).  The coverage
+contract (the JPR001 gate enforces >= 90%) keeps the mechanical
+sweep honest — new builders must ship signatures or show up red.
+"""
+
+import ast
+import importlib
+import time
+from dataclasses import dataclass, field
+
+from ..core import Finding, build_context, iter_python_files
+from .rules import DEFAULT_SELECT, IR_RULES
+
+__all__ = ["AuditReport", "enumerate_static_sites", "run_audit"]
+
+
+@dataclass
+class AuditReport:
+    """One jaxlint-IR run: findings + the coverage ledger."""
+
+    findings: list = field(default_factory=list)
+    stale: list = field(default_factory=list)
+    #: static census: site -> {path, line, module, qualname}
+    sites: dict = field(default_factory=dict)
+    #: sites that produced auditable IR (>=1 jaxpr or axis-error)
+    traced: list = field(default_factory=list)
+    #: site -> reason for every census site NOT traced
+    skipped: dict = field(default_factory=dict)
+    seconds: float = 0.0
+    select: tuple = ()
+
+    @property
+    def coverage(self):
+        """Traced fraction of the static census (1.0 when empty)."""
+        return (len(self.traced) / len(self.sites)) if self.sites \
+            else 1.0
+
+    def to_dict(self):
+        return {
+            "sites": len(self.sites),
+            "traced": sorted(self.traced),
+            "skipped": [{"site": s, "reason": r}
+                        for s, r in sorted(self.skipped.items())],
+            "coverage": round(self.coverage, 4),
+            "seconds": round(self.seconds, 3),
+            "rules": list(self.select),
+            "findings": [f.to_dict() for f in self.findings],
+            "stale_baseline": list(self.stale),
+        }
+
+
+def enumerate_static_sites(paths, repo_root):
+    """AST census of cache-decorated builder sites under ``paths``.
+
+    Returns ``{site: {path, line, module, qualname}}`` — every
+    function decorated with a recognized caching decorator
+    (:data:`..rules._CACHE_DECOS`) whose first argument is a string
+    literal site name.  Site-less ``lru_cache`` uses are not program
+    builders and are excluded by construction.
+    """
+    from ..rules import _CACHE_DECOS
+
+    sites = {}
+    for path in iter_python_files(paths):
+        ctx = build_context(path, repo_root)
+        if ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if not (isinstance(dec, ast.Call) and dec.args):
+                    continue
+                if ctx.resolve(dec.func) not in _CACHE_DECOS:
+                    continue
+                first = dec.args[0]
+                if not (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)):
+                    continue
+                sites[first.value] = {
+                    "path": ctx.relpath,
+                    "line": node.lineno,
+                    "module": ctx.module,
+                    "qualname": node.name,
+                }
+    return sites
+
+
+def _import_census_modules(sites):
+    """Import every census module; returns {module: error-or-None}."""
+    status = {}
+    for mod in sorted({info["module"] for info in sites.values()}):
+        try:
+            importlib.import_module(mod)
+            status[mod] = None
+        except Exception as exc:
+            status[mod] = f"{type(exc).__name__}: {exc}"
+    return status
+
+
+def _first_reason(traces):
+    for t in traces:
+        if t.error:
+            return f"trace failed ({t.error_type}): {t.error}"
+    return "trace produced no IR"
+
+
+def run_audit(paths, repo_root, select=None, baseline=None):
+    """Run the full IR audit; returns an :class:`AuditReport`.
+
+    Requires jax importable; the caller pins the environment
+    (``JAX_PLATFORMS=cpu``, forced host device count) before this
+    runs — the CLI's ``--ir`` mode and the ``jaxlint-ir`` gate both
+    do.  64-bit mode is enabled for the duration of the audit (and
+    restored) so promotion leaks are visible rather than truncated.
+    """
+    import jax
+
+    from brainiak_tpu.obs.runtime import builder_registry
+
+    t0 = time.monotonic()
+    select = tuple(select) if select else DEFAULT_SELECT
+    rules = [r() for r in IR_RULES if r.code in select]
+    report = AuditReport(select=select)
+    report.sites = enumerate_static_sites(paths, repo_root)
+    import_status = _import_census_modules(report.sites)
+
+    contexts = {}
+
+    def ctx_for(info):
+        rel = info["path"]
+        if rel not in contexts:
+            import os
+            contexts[rel] = build_context(
+                os.path.join(repo_root, rel), repo_root)
+        return contexts[rel]
+
+    raw = []
+    x64_before = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        registry = builder_registry()
+        for site, info in sorted(report.sites.items()):
+            import_error = import_status.get(info["module"])
+            if import_error:
+                report.skipped[site] = (
+                    f"module import failed: {import_error}")
+                continue
+            record = registry.get(site)
+            if record is None:
+                report.skipped[site] = (
+                    "module imported but site never registered "
+                    "(decorator not executed?)")
+                continue
+            factory = record.get("signature")
+            if factory is None:
+                report.skipped[site] = (
+                    "no canonical signature registered "
+                    "(trace_signature missing)")
+                continue
+            try:
+                specs = list(factory())
+            except Exception as exc:
+                report.skipped[site] = (
+                    f"signature factory failed "
+                    f"({type(exc).__name__}): {exc}")
+                continue
+            if not specs:
+                report.skipped[site] = (
+                    "signature factory returned no specs")
+                continue
+            from .trace import trace_spec
+            traces = [trace_spec(record, spec) for spec in specs]
+            if not any(t.traced for t in traces):
+                report.skipped[site] = _first_reason(traces)
+                continue
+            report.traced.append(site)
+            for trace in traces:
+                for rule in rules:
+                    for message in rule.check(trace):
+                        raw.append((rule, info, message))
+    finally:
+        jax.config.update("jax_enable_x64", x64_before)
+
+    seen = set()
+    findings = []
+    for rule, info, message in raw:
+        ctx = ctx_for(info)
+        finding = Finding(info["path"], info["line"], rule.code,
+                          message, ctx.src_line(info["line"]))
+        ident = (finding.code, finding.path, finding.line, message)
+        if ident in seen:
+            continue  # multi-spec sites repeat spec-free findings
+        seen.add(ident)
+        if not ctx.suppressed(finding, rule.pragma):
+            findings.append(finding)
+
+    if baseline is not None:
+        findings, stale = baseline.filter(findings)
+        # the shared baseline also carries JX entries for the static
+        # gates; only entries for the rules THIS audit ran can be
+        # judged stale here
+        report.stale = [e for e in stale if e.get("rule") in select]
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    report.findings = findings
+    report.seconds = time.monotonic() - t0
+    return report

@@ -1,0 +1,171 @@
+"""jaxlint-IR rules JP301-JP305: checks over traced program IR.
+
+Where the JX rules pattern-match source, these rules read the
+:class:`~brainiak_tpu.analysis.ir.trace.SiteTrace` facts distilled
+from ``jax.make_jaxpr`` of each registered builder at its canonical
+abstract signature — what XLA would actually compile, not what the
+source looks like.  Each rule yields plain message strings; the
+auditor (:mod:`.audit`) anchors them as findings at the builder's
+``def`` line, where the normal ``# jaxlint: disable=JPxxx`` pragma
+and baseline machinery apply.
+
+This module must stay importable without jax (``tools/run_checks.py``
+imports the analysis package on hosts that never trace).
+"""
+
+from ..core import register
+
+__all__ = ["IRRule", "IR_RULES", "DEFAULT_SELECT"]
+
+
+class IRRule:
+    """Base class: one check over one traced builder spec."""
+
+    code = ""
+    name = ""
+    gate = "jaxlint-ir"
+    pragma = "jaxlint"
+
+    def check(self, trace):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def _spec_tag(trace):
+    return f" [{trace.label}]" if trace.label else ""
+
+
+@register
+class DtypePromotionLeak(IRRule):
+    """JP301: 64-bit values inside a program traced at <=32-bit
+    inputs."""
+
+    code = "JP301"
+    name = "ir-dtype-promotion-leak"
+
+    def check(self, trace):
+        if trace.jaxpr is None or not trace.wide_eqns:
+            return
+        if any(d in ("float64", "complex128")
+               for d in trace.input_dtypes):
+            return  # legitimately a 64-bit program
+        prim, dtype = trace.wide_eqns[0]
+        in_set = "/".join(sorted(set(trace.input_dtypes))) or "scalar"
+        yield (f"{trace.site}{_spec_tag(trace)}: {dtype} values "
+               f"appear in a program whose inputs are {in_set} "
+               f"(first widening primitive: {prim}) — a strongly "
+               "typed 64-bit constant (np.float64 scalar, "
+               "dtype-less np array) promotes the chain; on TPU "
+               "this silently truncates instead, so the fit runs "
+               "different math per backend")
+
+
+@register
+class DegenerateDonation(IRRule):
+    """JP302: donation declared or expected, but the executable
+    aliases nothing."""
+
+    code = "JP302"
+    name = "ir-degenerate-donation"
+
+    def check(self, trace):
+        if trace.jaxpr is None:
+            return
+        if trace.donated_declared:
+            if trace.aliased is False:
+                yield (f"{trace.site}{_spec_tag(trace)}: program "
+                       "declares donated arguments but the compiled "
+                       "executable's aliasing table is empty — XLA "
+                       "dropped the donation (unusable layout or "
+                       "backend), so the buffer double-buffers "
+                       "anyway and the caller must still not reuse "
+                       "it")
+        elif trace.donate_expected:
+            argnums = ",".join(str(i) for i in trace.donate_expected)
+            yield (f"{trace.site}{_spec_tag(trace)}: family expects "
+                   f"the batch buffer (argnums {argnums}) to be "
+                   "donated but the built program declares no "
+                   "donation — on HBM-bound serving paths the "
+                   "padded batch double-buffers")
+
+
+@register
+class HostCallbackInProgram(IRRule):
+    """JP303: host callback primitive inside a hot jitted program."""
+
+    code = "JP303"
+    name = "ir-host-callback"
+
+    def check(self, trace):
+        if trace.jaxpr is None:
+            return
+        for prim in sorted(set(trace.callback_prims)):
+            yield (f"{trace.site}{_spec_tag(trace)}: {prim} "
+                   "primitive inside the jitted program — every "
+                   "dispatch pays a host round-trip, serializing "
+                   "the device queue from inside the hottest path")
+
+
+@register
+class CollectiveAxisMismatch(IRRule):
+    """JP304: collective axes that don't resolve against the trace
+    mesh."""
+
+    code = "JP304"
+    name = "ir-collective-axis"
+
+    def check(self, trace):
+        if trace.axis_error:
+            yield (f"{trace.site}{_spec_tag(trace)}: trace failed "
+                   f"with '{trace.error}' — the program names a "
+                   "collective axis no enclosing mesh binds")
+            return
+        if trace.jaxpr is None:
+            return
+        mesh_axes = set(trace.mesh_axes)
+        for prim, axes in trace.collectives:
+            unknown = [a for a in axes if a not in mesh_axes]
+            if not unknown:
+                continue
+            if not mesh_axes:
+                yield (f"{trace.site}{_spec_tag(trace)}: {prim} "
+                       f"over axis {'/'.join(unknown)} but the "
+                       "canonical spec provides no trace mesh — "
+                       "the signature cannot validate the "
+                       "collective it contains")
+            else:
+                yield (f"{trace.site}{_spec_tag(trace)}: {prim} "
+                       f"over axis {'/'.join(unknown)}, not an axis "
+                       f"of the trace mesh "
+                       f"({', '.join(sorted(mesh_axes))}) — the "
+                       "program can only run under a differently "
+                       "named mesh than its own signature declares")
+
+
+@register
+class RetraceSurface(IRRule):
+    """JP305: array-valued or continuously-varying builder cache
+    keys."""
+
+    code = "JP305"
+    name = "ir-retrace-surface"
+
+    def check(self, trace):
+        for name in trace.array_keys:
+            yield (f"{trace.site}: builder cache key parameter "
+                   f"'{name}' is array/container-valued — unhashable "
+                   "or unbounded as an lru key; every distinct value "
+                   "mints a fresh compiled program")
+        for name in trace.float_keys:
+            yield (f"{trace.site}: builder cache key parameter "
+                   f"'{name}' carries a float — a continuously "
+                   "varying value makes the program cache unbounded "
+                   "(one compile per distinct float); declare it in "
+                   "float_keys_ok if it is a fixed per-model "
+                   "constant")
+
+
+IR_RULES = (DtypePromotionLeak, DegenerateDonation,
+            HostCallbackInProgram, CollectiveAxisMismatch,
+            RetraceSurface)
+
+DEFAULT_SELECT = tuple(r.code for r in IR_RULES)

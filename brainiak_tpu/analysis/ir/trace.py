@@ -1,0 +1,241 @@
+"""Abstract tracing of registered program builders (jaxlint-IR).
+
+The static tiers (:mod:`..rules`, :mod:`..interproc`) reason about
+source; this tier reasons about the **actual IR**: every
+:func:`~brainiak_tpu.obs.runtime.counted_cache` builder that attached
+a canonical-signature factory is built at its canonical key and traced
+with ``jax.make_jaxpr`` at abstract (``ShapeDtypeStruct``) arguments —
+no data, no device math, just the jaxprs XLA would compile.  One
+:class:`SiteTrace` per spec summarizes everything the JP3xx rules
+need as plain Python (dtype strings, primitive names, axis names,
+donation/aliasing booleans), so :mod:`.rules` never imports jax.
+
+Tracing conventions (the audit child pins these):
+
+* 64-bit mode ON — a hidden ``np.float64`` constant then shows up as
+  a genuine ``float64`` aval instead of being silently truncated
+  (JP301's whole signal; Python floats stay weakly typed, so
+  f32-input programs remain f32 unless something strongly promotes);
+* 8 forced CPU host devices — collective programs trace against a
+  real mesh, so axis names resolve (or demonstrably don't: JP304);
+* compilation happens only when donation is at stake (JP302) — the
+  aliasing table is a property of the *executable*, not the jaxpr.
+"""
+
+import warnings
+from dataclasses import dataclass
+
+__all__ = ["SiteTrace", "trace_spec"]
+
+#: jaxpr primitives that are cross-device collectives; their axis
+#: params must name axes of the mesh the spec traced against.
+_COLLECTIVE_PRIMS = {
+    "psum", "psum2", "pmax", "pmin", "pmean", "ppermute",
+    "pbroadcast", "all_gather", "all_to_all", "reduce_scatter",
+    "axis_index", "pgather", "pdot", "pswapaxes",
+}
+
+#: param keys that carry collective axis names.
+_AXIS_PARAM_KEYS = ("axes", "axis_name", "axis")
+
+#: dtypes whose appearance in a <=32-bit program is a promotion leak.
+_WIDE_DTYPES = {"float64", "complex128"}
+
+
+@dataclass
+class SiteTrace:
+    """One builder traced at one canonical spec — plain-Python facts.
+
+    ``jaxpr`` keeps the ClosedJaxpr for debugging, but every field a
+    rule reads is a string/tuple/bool so the rule layer stays
+    jax-free.
+    """
+
+    site: str
+    label: str
+    key: tuple
+    spec: dict
+    input_dtypes: tuple = ()          # flattened arg aval dtypes
+    jaxpr: object = None              # ClosedJaxpr, or None on error
+    error: str = None                 # trace failure (str(exc))
+    error_type: str = None
+    wide_eqns: tuple = ()             # (primitive, dtype) f64/c128 outs
+    callback_prims: tuple = ()        # callback primitives seen
+    collectives: tuple = ()           # (primitive, axis-name tuple)
+    mesh_axes: tuple = ()             # axes of the spec's trace mesh
+    donate_expected: tuple = ()       # spec["donate"] argnums
+    donated_declared: bool = False    # any donated_invars in the IR
+    aliased: bool = None              # executable aliasing non-empty
+    compile_error: str = None
+    float_keys: tuple = ()            # float-valued key params (JP305)
+    array_keys: tuple = ()            # unhashable-ish key params
+
+    @property
+    def axis_error(self):
+        """Trace failed on an unresolvable collective axis (JP304)."""
+        return bool(self.error) and "unbound axis name" in self.error
+
+    @property
+    def traced(self):
+        """Whether this spec produced auditable IR: a jaxpr, or the
+        one failure mode that IS a finding (unbound axis)."""
+        return self.jaxpr is not None or self.axis_error
+
+
+def _sub_jaxprs(params):
+    """Jaxprs nested in an eqn's params (pjit/scan/while/cond...)."""
+    stack = list(params.values())
+    while stack:
+        val = stack.pop()
+        if isinstance(val, (list, tuple)):
+            stack.extend(val)
+        elif hasattr(val, "jaxpr") and hasattr(val, "consts"):
+            yield val.jaxpr                       # ClosedJaxpr
+        elif hasattr(val, "eqns") and hasattr(val, "invars"):
+            yield val                             # raw Jaxpr
+
+
+def iter_eqns(jaxpr):
+    """Every eqn of ``jaxpr`` and its nested sub-jaxprs, once."""
+    stack, seen = [jaxpr], set()
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        for eqn in j.eqns:
+            yield eqn
+            stack.extend(_sub_jaxprs(eqn.params))
+
+
+def _axis_names(value):
+    """Flatten an axis param value into a tuple of name strings."""
+    if isinstance(value, str):
+        return (value,)
+    if isinstance(value, (list, tuple, frozenset, set)):
+        out = []
+        for v in value:
+            out.extend(_axis_names(v))
+        return tuple(out)
+    return ()
+
+
+def _key_surface(record, key):
+    """(float_keys, array_keys): cache-key params whose VALUES vary
+    continuously (floats) or are array-shaped — both mint unbounded
+    program-cache entries from what should be a finite bucket space.
+    Param names come from the builder's own signature; names the
+    site declared via ``float_keys_ok`` are intentional constants."""
+    import inspect
+
+    import numpy as np
+
+    try:
+        params = list(inspect.signature(record["fn"]).parameters)
+    except (TypeError, ValueError):   # builtins, odd callables
+        params = []
+    ok = set(record.get("float_keys_ok") or ())
+    float_keys, array_keys = [], []
+    for i, value in enumerate(tuple(key)):
+        name = params[i] if i < len(params) else f"arg{i}"
+        if isinstance(value, (np.ndarray, list, dict, set)):
+            array_keys.append(name)
+        elif isinstance(value, (float, np.floating)) \
+                and not isinstance(value, bool) and name not in ok:
+            float_keys.append(name)
+    return tuple(float_keys), tuple(array_keys)
+
+
+def _summarize(jaxpr_closed):
+    """(wide_eqns, callback_prims, collectives) from a ClosedJaxpr."""
+    wide, callbacks, collectives = [], [], []
+    for eqn in iter_eqns(jaxpr_closed.jaxpr):
+        prim = eqn.primitive.name
+        if "callback" in prim or prim in ("outside_call",
+                                          "host_callback_call"):
+            callbacks.append(prim)
+        if prim in _COLLECTIVE_PRIMS:
+            axes = []
+            for k in _AXIS_PARAM_KEYS:
+                if k in eqn.params:
+                    axes.extend(_axis_names(eqn.params[k]))
+            collectives.append((prim, tuple(axes)))
+        for var in eqn.outvars:
+            dt = str(getattr(getattr(var, "aval", None), "dtype", ""))
+            if dt in _WIDE_DTYPES:
+                wide.append((prim, dt))
+    return tuple(wide), tuple(callbacks), tuple(collectives)
+
+
+def _declared_donation(jaxpr_closed):
+    """Whether any nested pjit declares donated invars in the IR."""
+    for eqn in iter_eqns(jaxpr_closed.jaxpr):
+        donated = eqn.params.get("donated_invars")
+        if donated and any(donated):
+            return True
+    return False
+
+
+def _executable_aliases(prog, args, kwargs):
+    """Whether the compiled executable's input/output aliasing table
+    is non-empty — the ground truth donation either survived to
+    (``input_output_alias`` in the optimized HLO) or was dropped
+    from (CPU: XLA warns and strips it)."""
+    with warnings.catch_warnings():
+        # CPU's "Some donated buffers were not usable" is exactly the
+        # condition being measured, not a problem with measuring it
+        warnings.simplefilter("ignore")
+        compiled = prog.lower(*args, **kwargs).compile()
+    text = compiled.as_text() or ""
+    return "input_output_alias" in text
+
+
+def trace_spec(record, spec):
+    """Trace one builder at one canonical spec → :class:`SiteTrace`.
+
+    Never raises: build/trace failures land in ``error`` (the
+    coverage report's skip reasons and JP304's unbound-axis signal),
+    compile failures in ``compile_error``.
+    """
+    import jax
+
+    site = record["site"]
+    key = tuple(spec.get("key", ()))
+    kwargs = dict(spec.get("kwargs") or {})
+    args = tuple(spec.get("args", ()))
+    mesh = spec.get("mesh")
+    float_keys, array_keys = _key_surface(record, key)
+    trace = SiteTrace(
+        site=site,
+        label=str(spec.get("label") or ""),
+        key=key,
+        spec=spec,
+        mesh_axes=tuple(mesh.axis_names) if mesh is not None else (),
+        donate_expected=tuple(spec.get("donate") or ()),
+        float_keys=float_keys,
+        array_keys=array_keys,
+    )
+    trace.input_dtypes = tuple(
+        str(leaf.dtype) for leaf in jax.tree_util.tree_leaves(args)
+        if hasattr(leaf, "dtype"))
+    try:
+        prog = record["wrapper"](*key)
+        fn = (lambda *a: prog(*a, **kwargs)) if kwargs else prog
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as exc:
+        trace.error = str(exc)
+        trace.error_type = type(exc).__name__
+        return trace
+    trace.jaxpr = closed
+    trace.wide_eqns, trace.callback_prims, trace.collectives = \
+        _summarize(closed)
+    trace.donated_declared = _declared_donation(closed)
+    if trace.donate_expected or trace.donated_declared:
+        # aliasing is an executable property — compile, but only
+        # when donation is actually at stake (compiles dominate the
+        # audit's wall clock)
+        try:
+            trace.aliased = _executable_aliases(prog, args, kwargs)
+        except Exception as exc:
+            trace.compile_error = str(exc)
+    return trace

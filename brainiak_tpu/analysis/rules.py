@@ -57,7 +57,13 @@ _CACHE_DECOS = {"functools.lru_cache", "functools.cache",
                 "serve.engine.program_cache",
                 "brainiak_tpu.serve.engine.program_cache",
                 "serve.program_cache",
-                "brainiak_tpu.serve.program_cache"}
+                "brainiak_tpu.serve.program_cache",
+                # program_cache now LIVES in serve.batching (the
+                # cache key IS the bucket); engine re-exports it,
+                # so both module spellings stay recognized
+                "batching.program_cache",
+                "serve.batching.program_cache",
+                "brainiak_tpu.serve.batching.program_cache"}
 
 
 def _loop_ancestor(ctx, node):

@@ -18,7 +18,7 @@ SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
 #: Codes that describe CI/gate plumbing failures rather than code
 #: defects map to SARIF level "error"; lint findings are "warning".
 _ERROR_PREFIXES = ("CHK0", "OBS", "REG", "SRV", "DLA", "ENC",
-                   "EXT")
+                   "EXT", "JPR")
 
 
 def _level(code):

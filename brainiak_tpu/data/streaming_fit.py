@@ -73,11 +73,43 @@ def _init_program(mesh):
 
     @partial(jax.jit, static_argnames=("features",))
     def init_fn(keys, counts, x, mask, *, features):
-        w0 = _init_w_from_keys(keys, x.shape[1], features, counts)
+        w0 = _init_w_from_keys(keys, x.shape[1], features, counts,
+                               dtype=x.dtype)
         w0 = w0 * mask[:, None, None]
         return jnp.einsum('svk,svt->kt', w0, x)
 
     return init_fn
+
+
+def _stream_mesh():
+    """Canonical subject-axis trace mesh for the srm.stream_* sites."""
+    from ..parallel.mesh import DEFAULT_SUBJECT_AXIS, make_mesh
+    return make_mesh((DEFAULT_SUBJECT_AXIS,), (-1,))
+
+
+def _stream_extents(mesh):
+    """(S, V, T, K) canonical extents: S fills the subject axis so
+    sharded Procrustes batches divide it."""
+    from ..parallel.mesh import DEFAULT_SUBJECT_AXIS
+    return mesh.shape[DEFAULT_SUBJECT_AXIS], 4, 6, 2
+
+
+def _aval(*shape, dtype=None):
+    import jax
+    import jax.numpy as jnp
+    return jax.ShapeDtypeStruct(shape, dtype or jnp.float32)
+
+
+@obs_runtime.trace_signature("srm.stream_init")
+def _init_trace_signature():
+    import jax.numpy as jnp
+
+    mesh = _stream_mesh()
+    s, v, t, k = _stream_extents(mesh)
+    return [{"key": (mesh,),
+             "args": (_aval(s, 2, dtype=jnp.uint32), _aval(s),
+                      _aval(s, v, t), _aval(s)),
+             "kwargs": {"features": k}, "mesh": mesh}]
 
 
 @obs_runtime.counted_cache("srm.stream_prob_shard")
@@ -111,6 +143,16 @@ def _prob_shard_program(mesh):
     return shard_fn
 
 
+@obs_runtime.trace_signature("srm.stream_prob_shard")
+def _prob_shard_trace_signature():
+    mesh = _stream_mesh()
+    s, v, t, k = _stream_extents(mesh)
+    return [{"key": (mesh,),
+             "args": (_aval(s, v, t), _aval(s), _aval(s), _aval(s),
+                      _aval(k, t), _aval(), _aval()),
+             "mesh": mesh}]
+
+
 @obs_runtime.counted_cache("srm.stream_global")
 def _prob_global_program(mesh):
     """The replicated top half of ``_em_iteration``: shared response
@@ -136,6 +178,15 @@ def _prob_global_program(mesh):
         return shared, sigma_s_new, trace_sigma_s
 
     return global_fn
+
+
+@obs_runtime.trace_signature("srm.stream_global")
+def _prob_global_trace_signature():
+    mesh = _stream_mesh()
+    s, v, t, k = _stream_extents(mesh)
+    return [{"key": (mesh,),
+             "args": (_aval(k, t), _aval(s), _aval(k, k), _aval()),
+             "mesh": mesh}]
 
 
 @obs_runtime.counted_cache("srm.stream_ll")
@@ -167,6 +218,16 @@ def _ll_program(mesh):
     return ll_fn
 
 
+@obs_runtime.trace_signature("srm.stream_ll")
+def _ll_trace_signature():
+    mesh = _stream_mesh()
+    s, v, t, k = _stream_extents(mesh)
+    return [{"key": (mesh,),
+             "args": (_aval(k, k), _aval(s), _aval(s), _aval(s),
+                      _aval(k, t), _aval()),
+             "mesh": mesh}]
+
+
 @obs_runtime.counted_cache("srm.stream_det_shard")
 def _det_shard_program(mesh):
     """One deterministic-BCD shard step: Procrustes W update and this
@@ -184,6 +245,15 @@ def _det_shard_program(mesh):
         return w, jnp.einsum('svk,svt->kt', wm, x)
 
     return shard_fn
+
+
+@obs_runtime.trace_signature("srm.stream_det_shard")
+def _det_shard_trace_signature():
+    mesh = _stream_mesh()
+    s, v, t, k = _stream_extents(mesh)
+    return [{"key": (mesh,),
+             "args": (_aval(s, v, t), _aval(s), _aval(k, t)),
+             "mesh": mesh}]
 
 
 # -- shard-size policy ------------------------------------------------
@@ -563,6 +633,15 @@ def _incremental_program(mesh):
         return jax.lax.fori_loop(0, inner_iter, body, shared)
 
     return step_fn
+
+
+@obs_runtime.trace_signature("srm.incremental_step")
+def _incremental_trace_signature():
+    mesh = _stream_mesh()
+    s, v, t, k = _stream_extents(mesh)
+    return [{"key": (mesh,),
+             "args": (_aval(s, v, t), _aval(s), _aval(k, t)),
+             "kwargs": {"inner_iter": 2}, "mesh": mesh}]
 
 
 class IncrementalSRM:

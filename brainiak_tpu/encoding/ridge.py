@@ -149,6 +149,30 @@ def _prepare_program(t, k, t_f, f, v, precision):
         jax.jit(fn), "encoding.prepare", span="encoding.fit")
 
 
+# canonical trace extents shared by every encoding.* signature:
+# T=8 TRs in k=2 folds of t_f=4, F=3 features, V=5 voxels,
+# lambda/candidate blocks of 2
+_TRACE_T, _TRACE_K, _TRACE_TF, _TRACE_F, _TRACE_V, _TRACE_BLOCK = \
+    8, 2, 4, 3, 5, 2
+
+
+def _enc_aval(*shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _prepare_trace_specs():
+    t, f, v = _TRACE_T, _TRACE_F, _TRACE_V
+    return [{"key": (t, _TRACE_K, _TRACE_TF, f, v,
+                     resolve_precision(None)),
+             "args": (_enc_aval(t, f), _enc_aval(t, v),
+                      _enc_aval(f, f))}]
+
+
+@obs_runtime.trace_signature("encoding.prepare")
+def _prepare_trace_signature():
+    return _prepare_trace_specs()
+
+
 @obs_runtime.counted_cache("encoding.banded_prepare")
 def _banded_prepare_program(t, k, t_f, f, v, precision):
     """Banded sweep preparation: the shared fold algebra only — the
@@ -159,6 +183,11 @@ def _banded_prepare_program(t, k, t_f, f, v, precision):
     return obs_profile.profile_program(
         jax.jit(algebra), "encoding.banded_prepare",
         span="encoding.fit")
+
+
+@obs_runtime.trace_signature("encoding.banded_prepare")
+def _banded_prepare_trace_signature():
+    return _prepare_trace_specs()
 
 
 @obs_runtime.counted_cache("encoding.sweep")
@@ -182,6 +211,16 @@ def _sweep_program(k, t_f, f, v, block, precision):
 
     return obs_profile.profile_program(
         jax.jit(fn), "encoding.sweep", span="encoding.sweep_chunk")
+
+
+@obs_runtime.trace_signature("encoding.sweep")
+def _sweep_trace_signature():
+    k, t_f, f, v, block = (_TRACE_K, _TRACE_TF, _TRACE_F, _TRACE_V,
+                           _TRACE_BLOCK)
+    return [{"key": (k, t_f, f, v, block, resolve_precision(None)),
+             "args": (_enc_aval(k, f), _enc_aval(k, f, v),
+                      _enc_aval(k, t_f, f), _enc_aval(k, t_f, v),
+                      _enc_aval(block))}]
 
 
 @obs_runtime.counted_cache("encoding.banded_sweep")
@@ -217,6 +256,16 @@ def _banded_sweep_program(k, t_f, f, v, block, precision):
         span="encoding.sweep_chunk")
 
 
+@obs_runtime.trace_signature("encoding.banded_sweep")
+def _banded_sweep_trace_signature():
+    k, t_f, f, v, block = (_TRACE_K, _TRACE_TF, _TRACE_F, _TRACE_V,
+                           _TRACE_BLOCK)
+    return [{"key": (k, t_f, f, v, block, resolve_precision(None)),
+             "args": (_enc_aval(k, f, f), _enc_aval(k, f, v),
+                      _enc_aval(k, t_f, f), _enc_aval(k, t_f, v),
+                      _enc_aval(block, f))}]
+
+
 @obs_runtime.counted_cache("encoding.refit")
 def _refit_program(f, v, precision):
     """Final full-data refit at the per-voxel selected lambdas: one
@@ -235,6 +284,14 @@ def _refit_program(f, v, precision):
 
     return obs_profile.profile_program(
         jax.jit(fn), "encoding.refit", span="encoding.fit")
+
+
+@obs_runtime.trace_signature("encoding.refit")
+def _refit_trace_signature():
+    f, v = _TRACE_F, _TRACE_V
+    return [{"key": (f, v, resolve_precision(None)),
+             "args": (_enc_aval(f, f), _enc_aval(f, v),
+                      _enc_aval(v))}]
 
 
 @obs_runtime.counted_cache("encoding.banded_refit")
@@ -265,6 +322,14 @@ def _banded_refit_program(f, v, block, precision):
 
     return obs_profile.profile_program(
         jax.jit(fn), "encoding.banded_refit", span="encoding.fit")
+
+
+@obs_runtime.trace_signature("encoding.banded_refit")
+def _banded_refit_trace_signature():
+    f, v, block = _TRACE_F, _TRACE_V, _TRACE_BLOCK
+    return [{"key": (f, v, block, resolve_precision(None)),
+             "args": (_enc_aval(f, f), _enc_aval(f, v),
+                      _enc_aval(block, f), _enc_aval(block, v))}]
 
 
 # -- estimators -------------------------------------------------------

@@ -89,6 +89,19 @@ def _sharded_gram_program(mesh, epochs_per_subj, interpret,
         check_vma=False)), "fcma.sharded_gram", span="fcma.block")
 
 
+@obs_runtime.trace_signature("fcma.sharded_gram")
+def _sharded_gram_trace_signature():
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh((DEFAULT_VOXEL_AXIS,), (-1,))
+    e, t, v = 4, 5, 6
+    b = mesh.shape[DEFAULT_VOXEL_AXIS]
+    return [{"key": (mesh, 2, True, resolve_precision(None)),
+             "args": (jax.ShapeDtypeStruct((e, t, b), jnp.float32),
+                      jax.ShapeDtypeStruct((e, t, v), jnp.float32)),
+             "mesh": mesh}]
+
+
 @partial(jax.jit, static_argnames=("epochs_per_subj", "interpret",
                                    "precision"))
 def _block_gram_pallas(blk, data2, epochs_per_subj, interpret=False,

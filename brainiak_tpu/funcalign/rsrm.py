@@ -76,7 +76,8 @@ def _rsrm_objective(x, w, s, r, gamma):
 def _fit_rsrm(x, voxel_counts, key, gamma, features, n_iter):
     """Full RSRM BCD fit as one XLA program (reference rsrm.py:256-350)."""
     n_subjects, voxels_pad, trs = x.shape
-    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
+    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts,
+                dtype=x.dtype)
     s = jnp.zeros_like(x)
     r = _shared_response(x, s, w, n_subjects)
     w, s, r = _rsrm_chunk(x, w, s, r, gamma, n_steps=n_iter)
@@ -217,7 +218,7 @@ class RSRM(BaseEstimator, TransformerMixin):
             "r": np.zeros((self.features, trs), dtype=dtype),
         }
         w0 = _init_w(key, voxels_pad, n_subjects, self.features,
-                     counts_j)
+                     counts_j, dtype=dtype)
         s0 = jnp.zeros_like(stacked)
         r0 = _shared_response(stacked, s0, w0, n_subjects)
         init_state = {"w": np.asarray(w0), "s": np.asarray(s0),

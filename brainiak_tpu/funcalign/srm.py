@@ -180,24 +180,33 @@ def _procrustes_batch(a, mesh, perturbation=0.001):
                              a.shape[0])(a)
 
 
-def _init_w_from_keys(keys, voxels_pad, features, voxel_counts):
+def _init_w_from_keys(keys, voxels_pad, features, voxel_counts,
+                      dtype=jnp.float32):
     """Per-subject orthonormal init from EXPLICIT per-subject keys —
     the body shared by the stacked init (:func:`_init_w`) and the
     streamed per-shard init (``data.streaming_fit``), so a shard's
-    ``w0`` lanes are bit-identical to the stacked fit's."""
+    ``w0`` lanes are bit-identical to the stacked fit's.
+
+    ``dtype`` pins the draw to the data dtype: a dtype-less
+    ``random.uniform`` follows the x64 flag, and a float64 ``w0``
+    would promote every downstream contraction (jaxlint-IR JP301).
+    """
     rnd = jax.vmap(
-        lambda k: jax.random.uniform(k, (voxels_pad, features)))(keys)
+        lambda k: jax.random.uniform(k, (voxels_pad, features),
+                                     dtype=dtype))(keys)
     row = jnp.arange(voxels_pad)[None, :, None]
     rnd = jnp.where(row < voxel_counts[:, None, None], rnd, 0.0)
     q, _ = jnp.linalg.qr(rnd)
     return jnp.where(row < voxel_counts[:, None, None], q, 0.0)
 
 
-def _init_w(key, voxels_pad, n_subjects, features, voxel_counts):
+def _init_w(key, voxels_pad, n_subjects, features, voxel_counts,
+            dtype=jnp.float32):
     """Random orthonormal init per subject via QR, with rows beyond each
     subject's true voxel count zeroed (srm.py:53-107)."""
     keys = jax.random.split(key, n_subjects)
-    return _init_w_from_keys(keys, voxels_pad, features, voxel_counts)
+    return _init_w_from_keys(keys, voxels_pad, features, voxel_counts,
+                             dtype=dtype)
 
 
 def _em_iteration(x, w, rho2, sigma_s, trace_xtx, voxel_counts, samples,
@@ -298,7 +307,8 @@ def _fit_prob_srm(x, trace_xtx, voxel_counts, key, features, n_iter,
                   mesh=None):
     """Full probabilistic-SRM EM fit as one XLA program."""
     n_subjects, voxels_pad, samples = x.shape
-    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
+    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts,
+                dtype=x.dtype)
     rho2 = jnp.ones(n_subjects, dtype=x.dtype)
     sigma_s = jnp.eye(features, dtype=x.dtype)
     shared = jnp.zeros((features, samples), dtype=x.dtype)
@@ -349,7 +359,8 @@ def _fit_det_srm(x, voxel_counts, key, features, n_iter, mesh=None):
     """Deterministic SRM block-coordinate descent (srm.py:859-918):
     alternate Procrustes W updates with S = mean_i W_iᵀ X_i."""
     n_subjects, voxels_pad, samples = x.shape
-    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts)
+    w = _init_w(key, voxels_pad, n_subjects, features, voxel_counts,
+                dtype=x.dtype)
     shared = jnp.einsum('svk,svt->kt', w, x) / n_subjects
     w, shared = _det_chunk(x, w, shared, n_steps=n_iter, mesh=mesh)
     return w, shared, _det_objective(x, w, shared)
@@ -606,7 +617,7 @@ class SRM(_SRMBase):
             "shared": np.zeros((self.features, samples), dtype=dtype),
         }
         w0 = _init_w(key, voxels_pad, n_subjects, self.features,
-                     counts_j)
+                     counts_j, dtype=dtype)
         init_state = {
             "w": fetch_replicated(w0, self.mesh),
             "rho2": np.ones(n_subjects, dtype=dtype),
@@ -772,7 +783,7 @@ class DetSRM(_SRMBase):
             "shared": np.zeros((self.features, samples), dtype=dtype),
         }
         w0 = _init_w(key, voxels_pad, n_subjects, self.features,
-                     counts_j)
+                     counts_j, dtype=dtype)
         shared0 = jnp.einsum('svk,svt->kt', w0, stacked) / n_subjects
         init_state = {"w": fetch_replicated(w0, self.mesh),
                       "shared": fetch_replicated(shared0, self.mesh)}

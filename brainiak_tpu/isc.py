@@ -173,6 +173,24 @@ def _slab_program(mesh, chunk):
         "isc.slab", span="isc.ring_slab")
 
 
+@obs_runtime.trace_signature("isc.slab")
+def _slab_trace_signature():
+    """Canonical jaxlint-IR trace: one row-slab fetch on the
+    voxel-axis mesh over every trace device."""
+    from .parallel.mesh import make_mesh
+
+    mesh = make_mesh((DEFAULT_VOXEL_AXIS,), (-1,))
+    chunk = 2
+    v = mesh.shape[DEFAULT_VOXEL_AXIS] * chunk
+    f32 = jnp.float32
+    return [{
+        "key": (mesh, chunk),
+        "args": (jax.ShapeDtypeStruct((v, v), f32),
+                 jax.ShapeDtypeStruct((), jnp.int32)),
+        "mesh": mesh,
+    }]
+
+
 def _fetch_ring_matrix(m, mesh):
     """Host-fetch the ring path's row-sharded [V, V] matrix on every
     process WITHOUT ever replicating it on a device: the ring exists

@@ -11,7 +11,12 @@ operational rules).
   functions that construct jit/shard_map programs per mesh/shape key)
   so every cache miss — i.e. every fresh trace+compile of that
   program — increments ``retrace_total{site=...}``.  jaxlint's JX001
-  recognizes it as a caching decorator.
+  recognizes it as a caching decorator.  Every decorated builder
+  additionally registers itself in the process-global
+  :func:`builder_registry` — the enumeration surface the jaxlint-IR
+  auditor (:mod:`brainiak_tpu.analysis.ir`) traces at canonical
+  abstract signatures; :func:`trace_signature` attaches a site's
+  canonical-signature factory after the fact.
 - :func:`device_memory_snapshot` — per-device ``memory_stats()``
   gauges plus one ``device_memory`` event.
 - :func:`topology_event` — backend/process/device (and optionally
@@ -30,15 +35,64 @@ import sys
 from . import metrics, sink
 
 __all__ = [
+    "builder_registry",
     "counted_cache",
     "device_memory_snapshot",
     "device_trace",
     "install_compile_listener",
     "topology_event",
+    "trace_signature",
 ]
 
+#: Process-global registry of every ``counted_cache``-decorated
+#: program builder: ``site -> record``.  Each record holds the
+#: wrapper, the raw builder, its module/qualname, the lru bound, and
+#: (when the site attached one) the canonical-signature factory the
+#: IR auditor traces it with.  Plain dicts, no jax — registration
+#: must stay importable on a host that never touches a device.
+_BUILDER_REGISTRY = {}
 
-def counted_cache(site, maxsize=None):
+
+def builder_registry():
+    """Snapshot of the registered program-builder sites
+    (``{site: record}``); records are shared, the mapping is a
+    copy."""
+    return dict(_BUILDER_REGISTRY)
+
+
+def trace_signature(site, float_keys_ok=()):
+    """Attach a canonical-signature factory to a registered builder.
+
+    ``factory`` is a zero-argument callable returning a list of
+    trace specs — plain dicts with keys ``key`` (the positional
+    builder arguments), ``args`` (abstract arrays for calling the
+    built program), and optionally ``kwargs`` (static call kwargs),
+    ``mesh`` (the trace mesh, for collective-axis validation),
+    ``donate`` (argnums the family expects the executable to alias),
+    and ``label``.  The factory runs only inside the IR auditor's
+    trace child, so it may import jax and build meshes freely; the
+    decorated module stays jax-import-free at registration time.
+
+    ``float_keys_ok`` names builder parameters that legitimately
+    carry float values in the cache key (a per-model constant, not a
+    per-request value) — JP305 skips them.
+    """
+
+    def attach(factory):
+        record = _BUILDER_REGISTRY.get(site)
+        if record is None:  # decoration order bug: fail loudly
+            raise KeyError(f"trace_signature({site!r}): no "
+                           "counted_cache builder registered under "
+                           "that site")
+        record["signature"] = factory
+        record["float_keys_ok"] = tuple(float_keys_ok)
+        return factory
+
+    return attach
+
+
+def counted_cache(site, maxsize=None, signature=None,
+                  float_keys_ok=()):
     """An ``lru_cache`` whose misses count as retraces.
 
     Use on jitted-program builders: a miss means the builder ran,
@@ -48,7 +102,13 @@ def counted_cache(site, maxsize=None):
     static retrace hazards jaxlint JX001 hunts for.
 
     The wrapper keeps ``cache_info``/``cache_clear`` so call sites
-    and tests can inspect and reset it like a plain ``lru_cache``.
+    and tests can inspect and reset it like a plain ``lru_cache``,
+    and registers the builder in :func:`builder_registry` so the
+    jaxlint-IR auditor can enumerate every program family
+    mechanically.  ``signature`` (or a later
+    :func:`trace_signature`) attaches the canonical-signature
+    factory the auditor traces the site with; a site without one
+    shows up in the auditor's coverage report as skipped.
     """
 
     def decorate(fn):
@@ -70,6 +130,19 @@ def counted_cache(site, maxsize=None):
         wrapper.cache_info = cached.cache_info
         wrapper.cache_clear = cached.cache_clear
         wrapper.__wrapped__ = fn
+        # re-registration (module reload, test fixtures) replaces
+        # the record: latest decoration wins, matching lru behavior
+        _BUILDER_REGISTRY[site] = {
+            "site": site,
+            "wrapper": wrapper,
+            "fn": fn,
+            "module": getattr(fn, "__module__", None),
+            "qualname": getattr(fn, "__qualname__",
+                                getattr(fn, "__name__", site)),
+            "maxsize": maxsize,
+            "signature": signature,
+            "float_keys_ok": tuple(float_keys_ok),
+        }
         return wrapper
 
     return decorate

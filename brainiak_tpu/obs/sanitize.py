@@ -1,0 +1,136 @@
+"""Runtime sanitizer: the checkify lane of the jaxlint-IR tier.
+
+``BRAINIAK_TPU_SANITIZE=1`` routes the repo's two hot dispatch
+paths — :func:`~brainiak_tpu.resilience.guards.run_resilient_loop`
+chunk programs and the serve engine's bucket programs — through
+``jax.experimental.checkify`` with the NaN / division / out-of-bounds
+error sets.  A tripped check surfaces as one typed ``sanitizer`` obs
+event whose ``codes`` attribute cross-references the static JP3xx
+rule family (:mod:`brainiak_tpu.analysis.ir`) auditing the same
+program: the dynamic lane for what the IR pass proves statically.
+
+Off (the default), every caller takes its original call path
+untouched — zero extra syncs, zero extra records.  On, each checked
+call pays one ``err.get()`` host read; the mode is a debugging lane,
+not a serving configuration.
+
+Not every chunk callable is checkifiable: ``run_resilient_loop``
+accepts host-side chunk drivers (NumPy state juggling, checkpoint
+IO) that cannot trace.  The first failed trace marks the site
+unsanitizable (one ``sanitizer_skip`` event), and subsequent calls
+run unwrapped — the sanitizer instruments pure chunks and stays out
+of the way of impure ones.
+"""
+
+import os
+import threading
+
+from . import metrics, sink
+
+__all__ = ["call_checked", "enabled", "reset"]
+
+_ENV = "BRAINIAK_TPU_SANITIZE"
+
+#: checkify error-set names the sanitizer enables (resolved lazily:
+#: this module must import without jax).
+_ERROR_SETS = ("float_checks", "index_checks", "div_checks")
+
+#: What each dynamic check is the runtime half of: NaN/div trips are
+#: the numeric-discipline lane (JP301's dtype/promotion audit traces
+#: the same programs), OOB trips are the retrace/key-surface lane
+#: (JP305 audits the shapes those indices were traced at).
+_CHECK_CODES = ("JP301", "JP305")
+
+_lock = threading.Lock()
+_checked = {}        # id(fn) -> (fn, checked callable)
+_unsanitizable = {}  # site -> first failure reason
+
+
+def enabled():
+    """Whether the sanitizer lane is on (``BRAINIAK_TPU_SANITIZE=1``)."""
+    return os.environ.get(_ENV, "").strip() == "1"
+
+
+def reset():
+    """Drop memoized checked programs and skip markers (tests)."""
+    with _lock:
+        _checked.clear()
+        _unsanitizable.clear()
+
+
+def _errors():
+    from jax.experimental import checkify
+
+    sets = None
+    for name in _ERROR_SETS:
+        got = getattr(checkify, name, None)
+        if got is None:
+            continue
+        sets = got if sets is None else sets | got
+    return sets
+
+
+def _checked_for(fn, static_argnums):
+    """The memoized jitted-checkify wrapper for ``fn``."""
+    import jax
+    from jax.experimental import checkify
+
+    key = (id(fn), static_argnums)
+    with _lock:
+        hit = _checked.get(key)
+        if hit is not None and hit[0] is fn:
+            return hit[1]
+    # one jit per distinct (fn, static_argnums), memoized in
+    # _checked above for process lifetime
+    checked = jax.jit(  # jaxlint: disable=JX001
+        checkify.checkify(fn, errors=_errors()),
+        static_argnums=static_argnums)
+    with _lock:
+        _checked[key] = (fn, checked)
+    return checked
+
+
+def _emit(name, **attrs):
+    if sink.enabled():
+        sink.emit(sink.make_record("event", name, attrs=attrs))
+
+
+def call_checked(fn, args, site, scope, codes=_CHECK_CODES,
+                 static_argnums=()):
+    """Run ``fn(*args)`` under checkify; returns ``(error, out)``.
+
+    ``error`` is the checkify message string when a NaN / division /
+    out-of-bounds check tripped (also emitted as a typed
+    ``sanitizer`` obs event carrying ``site``, ``scope``, and the
+    cross-referenced static rule ``codes``), else None.
+    ``static_argnums`` marks positions that must stay concrete under
+    the trace (the resilient loop's ``step``/``n_steps``, which
+    chunk drivers use in Python control flow).  A function that
+    cannot trace (host-side chunk drivers) is marked unsanitizable
+    on first failure and runs unwrapped from then on, returning
+    ``(None, out)`` like the disabled path.
+    """
+    reason = _unsanitizable.get(site)
+    if reason is not None:
+        return None, fn(*args)
+    try:
+        err, out = _checked_for(fn, tuple(static_argnums))(*args)
+    except Exception as exc:
+        # tracing failed (host code in the chunk) — remember, note
+        # once, and fall back to the unwrapped call so the sanitizer
+        # never changes what runs
+        with _lock:
+            _unsanitizable[site] = str(exc)
+        _emit("sanitizer_skip", site=site, scope=scope,
+              reason=f"{type(exc).__name__}: {exc}")
+        return None, fn(*args)
+    message = err.get()  # the lane's one deliberate host sync
+    if message:
+        _emit("sanitizer", site=site, scope=scope, error=message,
+              codes=list(codes))
+        metrics.counter(
+            "sanitizer_errors_total",
+            help="checkify errors caught by the sanitizer "
+                 "lane").inc(site=site, scope=scope)
+        return message, out
+    return None, out

@@ -213,6 +213,23 @@ def _summa_program(mesh, axis_names, precision, ring_step="fused"):
         "distla.summa", span="distla.gram")
 
 
+@obs_runtime.trace_signature("distla.summa")
+def _summa_trace_signature():
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh((DEFAULT_SUBJECT_AXIS, DEFAULT_VOXEL_AXIS),
+                     (2, -1))
+    names = (DEFAULT_SUBJECT_AXIS, DEFAULT_VOXEL_AXIS)
+    ring = int(np.prod([mesh.shape[a] for a in names]))
+    t, v = 3, 2 * ring
+    args = (jax.ShapeDtypeStruct((t, v), jnp.float32),
+            jax.ShapeDtypeStruct((t, v), jnp.float32))
+    prec = resolve_precision(None)
+    return [{"key": (mesh, names, prec, step), "args": args,
+             "mesh": mesh, "label": f"ring_step={step}"}
+            for step in ("fused", "unfused")]
+
+
 def _ring_step_for(n_trs, padded_v, n_shards, ring_step=None):
     """The ring-step mode for one problem extent: the caller's
     explicit choice (validated — a typo must not silently run a
@@ -408,6 +425,19 @@ def _panel_program(mesh, axis_name, precision):
         "distla.panel", span="distla.panel_chunk")
 
 
+@obs_runtime.trace_signature("distla.panel")
+def _panel_trace_signature():
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh((DEFAULT_VOXEL_AXIS,), (-1,))
+    t, p, v = 4, 2, 2 * mesh.shape[DEFAULT_VOXEL_AXIS]
+    return [{"key": (mesh, DEFAULT_VOXEL_AXIS,
+                     resolve_precision(None)),
+             "args": (jax.ShapeDtypeStruct((t, p), jnp.float32),
+                      jax.ShapeDtypeStruct((t, v), jnp.float32)),
+             "mesh": mesh}]
+
+
 def panel_gram(data, mesh, data_b=None, axis_name=DEFAULT_VOXEL_AXIS,
                panel_size=None, checkpoint_dir=None,
                checkpoint_every=1, precision=None,
@@ -520,6 +550,20 @@ def _block_gram_program(mesh, axis_name, epochs_per_subj, precision):
                   PartitionSpec(None, None, axis_name)),
         out_specs=PartitionSpec())),
         "distla.block_gram", span="fcma.block")
+
+
+@obs_runtime.trace_signature("distla.block_gram")
+def _block_gram_trace_signature():
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh((DEFAULT_VOXEL_AXIS,), (-1,))
+    e, t, b = 4, 5, 3
+    v = 2 * mesh.shape[DEFAULT_VOXEL_AXIS]
+    return [{"key": (mesh, DEFAULT_VOXEL_AXIS, 2,
+                     resolve_precision(None)),
+             "args": (jax.ShapeDtypeStruct((e, t, b), jnp.float32),
+                      jax.ShapeDtypeStruct((e, t, v), jnp.float32)),
+             "mesh": mesh}]
 
 
 def block_gram(blk, data2, mesh, epochs_per_subj,

@@ -154,6 +154,15 @@ def _epoch_norm_program(use_pallas, interpret=False):
         jax.jit(fn), "fcma.epoch_norm", span="fcma.epoch_norm")
 
 
+@obs_runtime.trace_signature("fcma.epoch_norm")
+def _epoch_norm_trace_signature():
+    import jax
+    import jax.numpy as jnp
+
+    return [{"key": (False,),
+             "args": (jax.ShapeDtypeStruct((2, 5, 7), jnp.float32),)}]
+
+
 def _mode():
     return os.environ.get(EPOCH_NORM_ENV, "").strip().lower()
 

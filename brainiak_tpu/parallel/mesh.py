@@ -87,6 +87,17 @@ def _replicate_identity(mesh: Mesh):
                    out_shardings=NamedSharding(mesh, PartitionSpec()))
 
 
+@obs_runtime.trace_signature("parallel.replicate_identity")
+def _replicate_identity_trace_signature():
+    import jax.numpy as jnp
+
+    mesh = make_mesh((DEFAULT_VOXEL_AXIS,), (-1,))
+    v = 2 * mesh.shape[DEFAULT_VOXEL_AXIS]
+    return [{"key": (mesh,),
+             "args": (jax.ShapeDtypeStruct((v,), jnp.float32),),
+             "mesh": mesh}]
+
+
 def fetch_replicated(x, mesh: Optional[Mesh] = None):
     """Host-fetch a possibly mesh-sharded array as a full numpy array on
     EVERY process — the analog of the reference's MPI gather of results

@@ -81,6 +81,20 @@ def _zscore_program(v, dtype):
         span="realtime.tr")
 
 
+@obs_runtime.trace_signature("realtime.zscore_step")
+def _zscore_trace_signature():
+    import jax
+    import jax.numpy as jnp
+
+    v = 5
+
+    def a(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    return [{"key": (v, "float32"),
+             "args": (a(), a(v), a(v), a(v))}]
+
+
 class OnlineZScore:
     """Per-voxel running z-score: Welford moments in O(V) state.
 
@@ -223,6 +237,31 @@ def _isc_program(v, r, window, dtype):
     return obs_profile.profile_program(
         jax.jit(_make_isc_step_core(window)), "realtime.isc_step",
         span="realtime.tr")
+
+
+@obs_runtime.trace_signature("realtime.isc_step")
+def _isc_trace_signature():
+    import jax
+    import jax.numpy as jnp
+
+    v, r, w = 5, 2, 3
+
+    def a(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    cumulative = (a(), a(v), a(v, r), a(v), a(v), a(v, r), a(v, r),
+                  a(v, r))
+    windowed = (a(w, v), a(v), a(v), a(v, r), a(v, r), a(v, r))
+    return [
+        {"key": (v, r, 0, "float32"),
+         "args": cumulative + (a(v), a(v, r)),
+         "label": "cumulative"},
+        {"key": (v, r, w, "float32"),
+         "args": cumulative + windowed
+         + (a(v), a(v, r), a(v, r),
+            jax.ShapeDtypeStruct((), jnp.int32)),
+         "label": f"window={w}"},
+    ]
 
 
 class OnlineISC:
@@ -413,6 +452,22 @@ def _evseg_program(v, k, dtype):
     return obs_profile.profile_program(
         jax.jit(_evseg_step_core), "realtime.evseg_step",
         span="realtime.tr")
+
+
+@obs_runtime.trace_signature("realtime.evseg_step")
+def _evseg_trace_signature():
+    import jax
+    import jax.numpy as jnp
+
+    v, k = 5, 3
+
+    def a(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    return [{"key": (v, k, "float32"),
+             "args": (a(k + 1), jax.ShapeDtypeStruct((), jnp.int32),
+                      a(), a(v), a(v, k), a(k), a(k), a(k + 1, k + 1),
+                      a(k + 1))}]
 
 
 class IncrementalEventSegment:

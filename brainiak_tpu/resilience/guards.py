@@ -41,6 +41,7 @@ import numpy as np
 from . import faults
 from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
+from ..obs import sanitize as obs_sanitize
 from ..obs import sink as obs_sink
 from ..obs import spans as obs_spans
 
@@ -300,7 +301,25 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
                     "fit_chunk",
                     attrs={"estimator": name, "step": step,
                            "n_steps": n_steps}):
-                new_state, done = run_chunk(state, step, n_steps)
+                if obs_sanitize.enabled():
+                    # the checkify lane (BRAINIAK_TPU_SANITIZE=1):
+                    # a tripped NaN/div/OOB check inside a traceable
+                    # chunk emits a typed ``sanitizer`` event and
+                    # feeds the rollback machinery like any other
+                    # divergence; step/n_steps stay static so chunk
+                    # drivers may use them in Python control flow
+                    sanitizer_error, (new_state, done) = \
+                        obs_sanitize.call_checked(
+                            run_chunk, (state, step, n_steps),
+                            site=name, scope="resilient_loop",
+                            static_argnums=(1, 2))
+                    if sanitizer_error is not None:
+                        raise DivergenceError(
+                            ["sanitizer:" + sanitizer_error
+                             .splitlines()[0].strip()],
+                            iteration=step + n_steps, where=name)
+                else:
+                    new_state, done = run_chunk(state, step, n_steps)
             if watermark is not None:
                 obs_profile.memory_watermark(estimator=name,
                                              before=watermark)

@@ -28,6 +28,8 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ..obs.runtime import counted_cache
+
 __all__ = [
     "BucketPolicy",
     "Request",
@@ -35,8 +37,28 @@ __all__ = [
     "bucket_length",
     "load_requests",
     "pad_axis",
+    "program_cache",
     "save_requests",
 ]
+
+
+def program_cache(site, maxsize=None, signature=None,
+                  float_keys_ok=()):
+    """The serve program cache: a retrace-counting
+    :func:`~brainiak_tpu.obs.runtime.counted_cache` over the bucket
+    program builders, under serve's ``site`` naming convention
+    (``serve.<family>``).  jaxlint's JX001 recognizes it as a caching
+    decorator, so constructing ``jax.jit`` inside a builder it
+    decorates is clean by construction; like every
+    ``counted_cache``, each decorated builder self-registers for the
+    jaxlint-IR audit (``signature`` attaches the canonical trace
+    signature, see :func:`~brainiak_tpu.obs.runtime.trace_signature`).
+
+    It lives in the batching (policy) layer because the cache key IS
+    the bucket: every extent the batching layer pads to, plus
+    trace-time statics."""
+    return counted_cache(site, maxsize=maxsize, signature=signature,
+                         float_keys_ok=float_keys_ok)
 
 
 def bucket_length(n, floor=16):

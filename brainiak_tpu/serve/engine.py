@@ -41,28 +41,19 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
+from ..obs import runtime as obs_runtime
 from ..obs import sink as obs_sink
 from ..obs import spans as obs_spans
 from ..obs import trace as obs_trace
-from ..obs.runtime import counted_cache
+from ..obs import sanitize as obs_sanitize
 from ..ops.correlation import PRECISION
 from . import artifacts
 from .batching import (BucketPolicy, ServeResult, bucket_length,
-                       pad_axis)
+                       pad_axis, program_cache)
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["InferenceEngine", "program_cache"]
-
-
-def program_cache(site, maxsize=None):
-    """The serve program cache: a retrace-counting
-    :func:`~brainiak_tpu.obs.runtime.counted_cache` over the bucket
-    program builders, under serve's ``site`` naming convention
-    (``serve.<family>``).  jaxlint's JX001 recognizes it as a caching
-    decorator, so constructing ``jax.jit`` inside a builder it
-    decorates is clean by construction."""
-    return counted_cache(site, maxsize=maxsize)
 
 
 # -- bucket program builders ------------------------------------------
@@ -113,6 +104,37 @@ def _srm_program(n_subjects, v_pad, k, t_bucket, b_pad, dtype):
                                        span="serve.batch")
 
 
+# canonical trace extents for the serve.* signatures: S=2 subjects,
+# v_pad=8 (divides the 8-device trace ring), K=3 features, t_bucket=4,
+# b_pad=2 — small enough to trace in milliseconds, shaped like a real
+# bucket
+_TRACE_S, _TRACE_V, _TRACE_K, _TRACE_T, _TRACE_B = 2, 8, 3, 4, 2
+
+
+def _serve_aval(*shape, dtype=None):
+    return jax.ShapeDtypeStruct(shape, dtype or jnp.float32)
+
+
+def _serve_mesh():
+    from ..parallel.mesh import DEFAULT_VOXEL_AXIS, make_mesh
+    mesh = make_mesh((DEFAULT_VOXEL_AXIS,), (-1,))
+    return mesh, (DEFAULT_VOXEL_AXIS,)
+
+
+def _srm_call_avals():
+    s, v, k, t, b = (_TRACE_S, _TRACE_V, _TRACE_K, _TRACE_T,
+                     _TRACE_B)
+    return (_serve_aval(s, v, k), _serve_aval(b, dtype=jnp.int32),
+            _serve_aval(b, v, t))
+
+
+@obs_runtime.trace_signature("serve.srm")
+def _srm_trace_signature():
+    return [{"key": (_TRACE_S, _TRACE_V, _TRACE_K, _TRACE_T,
+                     _TRACE_B, "float32"),
+             "args": _srm_call_avals(), "donate": (2,)}]
+
+
 @program_cache("serve.srm_sharded")
 def _srm_sharded_program(mesh, axis_names, n_subjects, v_pad, k,
                          t_bucket, b_pad, dtype):
@@ -144,6 +166,14 @@ def _srm_sharded_program(mesh, axis_names, n_subjects, v_pad, k,
                                        span="serve.batch")
 
 
+@obs_runtime.trace_signature("serve.srm_sharded")
+def _srm_sharded_trace_signature():
+    mesh, names = _serve_mesh()
+    return [{"key": (mesh, names, _TRACE_S, _TRACE_V, _TRACE_K,
+                     _TRACE_T, _TRACE_B, "float32"),
+             "args": _srm_call_avals(), "mesh": mesh}]
+
+
 @program_cache("serve.rsrm")
 def _rsrm_program(n_subjects, v_pad, k, t_bucket, b_pad, gamma,
                   n_iter, dtype):
@@ -165,6 +195,13 @@ def _rsrm_program(n_subjects, v_pad, k, t_bucket, b_pad, gamma,
 
     return obs_profile.profile_program(run, "serve.rsrm",
                                        span="serve.batch")
+
+
+@obs_runtime.trace_signature("serve.rsrm", float_keys_ok=("gamma",))
+def _rsrm_trace_signature():
+    return [{"key": (_TRACE_S, _TRACE_V, _TRACE_K, _TRACE_T,
+                     _TRACE_B, 0.1, 3, "float32"),
+             "args": _srm_call_avals(), "donate": (2,)}]
 
 
 # eventseg's bucket space is request-controlled (the bucket is the
@@ -200,6 +237,16 @@ def _eventseg_program(n_vox, t_len, k, b_pad, dtype):
                                        span="serve.batch")
 
 
+@obs_runtime.trace_signature("serve.eventseg")
+def _eventseg_trace_signature():
+    v, t, k, b = 5, 6, _TRACE_K, _TRACE_B
+    return [{"key": (v, t, k, b, "float64"),
+             "args": (_serve_aval(v, k), _serve_aval(k),
+                      _serve_aval(k + 1, k + 1), _serve_aval(k + 1),
+                      _serve_aval(k + 1), _serve_aval(b, v, t)),
+             "donate": (5,)}]
+
+
 @program_cache("serve.encoding")
 def _encoding_program(n_feat, n_vox, t_bucket, b_pad, dtype):
     """Batched encoding-model scoring: predict every scan from its
@@ -228,6 +275,19 @@ def _encoding_program(n_feat, n_vox, t_bucket, b_pad, dtype):
 
     return obs_profile.profile_program(run, "serve.encoding",
                                        span="serve.batch")
+
+
+def _encoding_call_avals(v):
+    f, t, b = 3, _TRACE_T, _TRACE_B
+    return (_serve_aval(f, v), _serve_aval(v), _serve_aval(b, t, f),
+            _serve_aval(b, t, v), _serve_aval(b, dtype=jnp.int32))
+
+
+@obs_runtime.trace_signature("serve.encoding")
+def _encoding_trace_signature():
+    v = 5
+    return [{"key": (3, v, _TRACE_T, _TRACE_B, "float32"),
+             "args": _encoding_call_avals(v), "donate": (2, 3)}]
 
 
 @program_cache("serve.encoding_sharded")
@@ -272,6 +332,14 @@ def _encoding_sharded_program(mesh, axis_names, n_feat, v_pad,
         run, "serve.encoding_sharded", span="serve.batch")
 
 
+@obs_runtime.trace_signature("serve.encoding_sharded")
+def _encoding_sharded_trace_signature():
+    mesh, names = _serve_mesh()
+    return [{"key": (mesh, names, 3, _TRACE_V, _TRACE_T, _TRACE_B,
+                     "float32"),
+             "args": _encoding_call_avals(_TRACE_V), "mesh": mesh}]
+
+
 @program_cache("serve.iem")
 def _iem_program(t_bucket, n_vox, k_chan, density, b_pad, dtype):
     """IEM1D predict: channel responses via the precomputed
@@ -289,6 +357,16 @@ def _iem_program(t_bucket, n_vox, k_chan, density, b_pad, dtype):
 
     return obs_profile.profile_program(run, "serve.iem",
                                        span="serve.batch")
+
+
+@obs_runtime.trace_signature("serve.iem")
+def _iem_trace_signature():
+    v, k_chan, density, t, b = 5, 4, 6, _TRACE_T, _TRACE_B
+    return [{"key": (t, v, k_chan, density, b, "float32"),
+             "args": (_serve_aval(k_chan, v),
+                      _serve_aval(k_chan, density),
+                      _serve_aval(b, t, v)),
+             "donate": (2,)}]
 
 
 # -- per-kind serve ops -----------------------------------------------
@@ -363,6 +441,18 @@ class _ServeOp:
                     len(self._programs) >= self.program_memo_max:
                 self._programs.pop(next(iter(self._programs)))
             self._programs[key_args] = prog
+        if obs_sanitize.enabled():
+            # the checkify lane (BRAINIAK_TPU_SANITIZE=1): a tripped
+            # NaN/div/OOB check becomes a typed ``sanitizer`` obs
+            # event and fails the batch through the engine's normal
+            # execution_failed machinery (isolation retries still
+            # apply for independent-request kinds)
+            error, out = obs_sanitize.call_checked(
+                prog, call_args, site=self.site, scope="serve")
+            if error is not None:
+                raise RuntimeError(
+                    f"sanitizer: {self.site}: {error}")
+            return out
         return prog(*call_args)
 
     def validate(self, req):

@@ -209,6 +209,52 @@ def test_jx011_silent_on_cached_builder_and_loopless(tmp_path):
     assert findings == []
 
 
+def test_jx011_silent_on_program_cache_builder(tmp_path):
+    """ISSUE 17 regression: program_cache now lives in
+    serve.batching; a builder decorated under the new spellings is a
+    cached factory, so a loop calling it must stay clean while the
+    uncached twin in the same project still fires."""
+    findings = deep_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/builders.py": """
+            import jax
+
+            from brainiak_tpu.serve import batching
+            from brainiak_tpu.serve.batching import program_cache
+
+
+            @batching.program_cache("fixture.attr")
+            def attr_cached(n, b):
+                return jax.jit(lambda a: a + n)
+
+
+            @program_cache("fixture.bare")
+            def bare_cached(n, b):
+                return jax.jit(lambda a: a * n)
+
+
+            def uncached(n, b):
+                return jax.jit(lambda a: a - n)
+        """,
+        "pkg/drive.py": """
+            from .builders import attr_cached, bare_cached, uncached
+
+
+            def run(xs):
+                out = []
+                for x in xs:
+                    out.append(attr_cached(2, 8)(x))
+                    out.append(bare_cached(3, 8)(x))
+                    out.append(uncached(4, 8)(x))
+                return out
+        """,
+    }, [TransitiveJitInLoop])
+    assert [f.code for f in findings] == ["JX011"], \
+        [f.message for f in findings]
+    assert "uncached" in findings[0].message
+
+
+
 # -- JX012 cross-function key reuse ----------------------------------
 
 def test_jx012_key_reuse_through_helper(tmp_path):

@@ -1,0 +1,513 @@
+"""jaxlint-IR tier (JP301-JP305): per-rule fixtures over REAL traces.
+
+Each rule gets a positive seed (the acceptance fixtures from ISSUE
+17: an f32 builder with a hidden ``np.float64`` constant, a
+donated-but-unaliased serve-style batch program, a ``psum`` over a
+missing axis) and a negative twin, traced with the same
+:func:`~brainiak_tpu.analysis.ir.trace.trace_spec` machinery the
+audit child runs — plus end-to-end :func:`run_audit` coverage-report
+and suppression tests over a throwaway fixture tree.
+"""
+
+import functools
+import textwrap
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from brainiak_tpu.analysis.baseline import Baseline  # noqa: E402
+from brainiak_tpu.analysis.ir import (  # noqa: E402
+    DEFAULT_SELECT, IR_RULES, enumerate_static_sites, run_audit)
+from brainiak_tpu.analysis.ir.rules import (  # noqa: E402
+    CollectiveAxisMismatch, DegenerateDonation, DtypePromotionLeak,
+    HostCallbackInProgram, RetraceSurface)
+from brainiak_tpu.analysis.ir.trace import SiteTrace, trace_spec  # noqa: E402
+
+
+def _record(site, fn, float_keys_ok=()):
+    """A registry-shaped record without touching the global
+    registry (trace_spec only reads these keys)."""
+    return {"site": site,
+            "wrapper": functools.lru_cache(maxsize=None)(fn),
+            "fn": fn,
+            "float_keys_ok": tuple(float_keys_ok)}
+
+
+def _aval(*shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+@pytest.fixture
+def x64():
+    """The audit's 64-bit tracing mode (restored afterwards)."""
+    before = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", before)
+
+
+# -- JP301: dtype-promotion leak --------------------------------------
+
+
+def test_jp301_flags_hidden_float64_constant(x64):
+    """Acceptance seed: an f32 builder multiplying by a strongly
+    typed np.float64 scalar promotes the whole chain under x64."""
+
+    def build(n):
+        hidden = np.float64(1.5)
+
+        @jax.jit
+        def prog(x):
+            return x * hidden + jnp.sum(x)
+
+        return prog
+
+    trace = trace_spec(_record("irtest.leaky", build),
+                       {"key": (4,), "args": (_aval(4),)})
+    assert trace.jaxpr is not None
+    assert trace.input_dtypes == ("float32",)
+    assert trace.wide_eqns, "float64 must be visible in the IR"
+    msgs = list(DtypePromotionLeak().check(trace))
+    assert len(msgs) == 1
+    assert "float64" in msgs[0] and "float32" in msgs[0]
+
+
+def test_jp301_clean_on_weak_python_float(x64):
+    """A Python float is weakly typed: the same program stays f32
+    and must NOT be flagged."""
+
+    def build(n):
+        @jax.jit
+        def prog(x):
+            return x * 1.5 + jnp.sum(x)
+
+        return prog
+
+    trace = trace_spec(_record("irtest.weak", build),
+                       {"key": (4,), "args": (_aval(4),)})
+    assert trace.jaxpr is not None
+    assert trace.wide_eqns == ()
+    assert list(DtypePromotionLeak().check(trace)) == []
+
+
+def test_jp301_silent_on_legitimate_f64_program(x64):
+    """A program traced AT float64 inputs is legitimately 64-bit."""
+
+    def build(n):
+        @jax.jit
+        def prog(x):
+            return x * np.float64(1.5)
+
+        return prog
+
+    trace = trace_spec(
+        _record("irtest.f64", build),
+        {"key": (4,), "args": (_aval(4, dtype=jnp.float64),)})
+    assert trace.jaxpr is not None
+    assert list(DtypePromotionLeak().check(trace)) == []
+
+
+# -- JP302: degenerate donation ---------------------------------------
+
+
+def test_jp302_declared_but_unaliased():
+    """Acceptance seed: a donated batch program none of whose
+    outputs can reuse the donated buffer (shape mismatch) — XLA
+    strips the donation, the executable aliases nothing."""
+
+    def build(n):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def prog(x):
+            return jnp.sum(x)  # scalar out: (8,) donation unusable
+
+        return prog
+
+    trace = trace_spec(_record("irtest.donated", build),
+                       {"key": (8,), "args": (_aval(8),)})
+    assert trace.jaxpr is not None
+    assert trace.donated_declared is True
+    assert trace.aliased is False, \
+        "XLA must have dropped the unusable donation"
+    msgs = list(DegenerateDonation().check(trace))
+    assert len(msgs) == 1
+    assert "aliasing table is empty" in msgs[0]
+
+
+def test_jp302_usable_donation_stays_clean():
+    """The same declaration with a shape-matched output DOES alias
+    (even on CPU) and must not be flagged — the rule keys off the
+    executable's aliasing table, not the declaration."""
+
+    def build(n):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def prog(x):
+            return x + 1.0
+
+        return prog
+
+    trace = trace_spec(_record("irtest.aliased", build),
+                       {"key": (8,), "args": (_aval(8),)})
+    assert trace.donated_declared is True
+    assert trace.aliased is True
+    assert list(DegenerateDonation().check(trace)) == []
+
+
+def test_jp302_expected_but_not_declared():
+    """A family that expects donation (spec['donate']) but builds a
+    donation-free program is the other degenerate half."""
+
+    def build(n):
+        @jax.jit
+        def prog(x):
+            return x + 1.0
+
+        return prog
+
+    trace = trace_spec(
+        _record("irtest.nodonate", build),
+        {"key": (8,), "args": (_aval(8),), "donate": (0,)})
+    assert trace.donated_declared is False
+    assert trace.donate_expected == (0,)
+    msgs = list(DegenerateDonation().check(trace))
+    assert len(msgs) == 1
+    assert "argnums 0" in msgs[0]
+    assert "declares no donation" in msgs[0]
+
+
+def test_jp302_clean_without_donation_anywhere():
+    def build(n):
+        @jax.jit
+        def prog(x):
+            return x + 1.0
+
+        return prog
+
+    trace = trace_spec(_record("irtest.plain", build),
+                       {"key": (8,), "args": (_aval(8),)})
+    assert trace.aliased is None  # donation not at stake: no compile
+    assert list(DegenerateDonation().check(trace)) == []
+
+
+def test_jp302_clean_when_aliasing_survives():
+    """Synthetic: declared AND aliased (the TPU outcome) is the
+    healthy state."""
+    trace = SiteTrace(site="s", label="", key=(), spec={},
+                      jaxpr=object(), donated_declared=True,
+                      aliased=True)
+    assert list(DegenerateDonation().check(trace)) == []
+
+
+# -- JP303: host callback in a hot program ----------------------------
+
+
+def test_jp303_flags_debug_callback():
+    def build(n):
+        @jax.jit
+        def prog(x):
+            jax.debug.callback(lambda v: None, x)
+            return x * 2.0
+
+        return prog
+
+    trace = trace_spec(_record("irtest.cb", build),
+                       {"key": (4,), "args": (_aval(4),)})
+    assert trace.jaxpr is not None
+    assert trace.callback_prims
+    msgs = list(HostCallbackInProgram().check(trace))
+    assert len(msgs) == 1
+    assert "host round-trip" in msgs[0]
+
+
+def test_jp303_clean_without_callbacks():
+    def build(n):
+        @jax.jit
+        def prog(x):
+            return x * 2.0
+
+        return prog
+
+    trace = trace_spec(_record("irtest.nocb", build),
+                       {"key": (4,), "args": (_aval(4),)})
+    assert trace.callback_prims == ()
+    assert list(HostCallbackInProgram().check(trace)) == []
+
+
+# -- JP304: collective-axis validation --------------------------------
+
+
+def test_jp304_flags_psum_over_missing_axis():
+    """Acceptance seed: a psum over an axis no enclosing mesh binds
+    fails the trace with the unbound-axis signal — which IS the
+    finding, and still counts as audited coverage."""
+
+    def build(n):
+        @jax.jit
+        def prog(x):
+            return jax.lax.psum(x, "missing")
+
+        return prog
+
+    trace = trace_spec(_record("irtest.axis", build),
+                       {"key": (4,), "args": (_aval(4),)})
+    assert trace.jaxpr is None
+    assert trace.axis_error, trace.error
+    assert trace.traced  # an axis error is auditable IR evidence
+    msgs = list(CollectiveAxisMismatch().check(trace))
+    assert len(msgs) == 1
+    assert "collective axis" in msgs[0]
+
+
+def test_jp304_clean_psum_over_real_mesh_axis():
+    """The same collective under a shard_map over a real mesh axis
+    resolves and passes."""
+    from jax.sharding import Mesh, PartitionSpec
+    from jax.experimental.shard_map import shard_map
+
+    devs = np.array(jax.devices()[:1])
+    mesh = Mesh(devs, ("voxel",))
+
+    def build(n):
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=PartitionSpec("voxel"),
+            out_specs=PartitionSpec())
+        def prog(x):
+            return jax.lax.psum(jnp.sum(x), "voxel")
+
+        return prog
+
+    trace = trace_spec(_record("irtest.goodaxis", build),
+                       {"key": (4,), "args": (_aval(4),),
+                        "mesh": mesh})
+    assert trace.jaxpr is not None, trace.error
+    assert ("psum", ("voxel",)) in trace.collectives \
+        or any(p.startswith("psum") for p, _ in trace.collectives)
+    assert trace.mesh_axes == ("voxel",)
+    assert list(CollectiveAxisMismatch().check(trace)) == []
+
+
+def test_jp304_mesh_mismatch_and_missing_mesh_branches():
+    """Synthetic branch coverage: a collective over an axis the
+    trace mesh doesn't bind, and a spec that provides no mesh at
+    all for a collective program."""
+    mismatch = SiteTrace(site="s", label="", key=(), spec={},
+                         jaxpr=object(),
+                         collectives=(("psum", ("voxel",)),),
+                         mesh_axes=("subject",))
+    msgs = list(CollectiveAxisMismatch().check(mismatch))
+    assert len(msgs) == 1 and "not an axis" in msgs[0]
+
+    meshless = SiteTrace(site="s", label="", key=(), spec={},
+                         jaxpr=object(),
+                         collectives=(("psum", ("voxel",)),),
+                         mesh_axes=())
+    msgs = list(CollectiveAxisMismatch().check(meshless))
+    assert len(msgs) == 1 and "no trace mesh" in msgs[0]
+
+
+# -- JP305: retrace surface -------------------------------------------
+
+
+def test_jp305_flags_float_cache_key():
+    def build(gamma, n):
+        @jax.jit
+        def prog(x):
+            return x * gamma
+
+        return prog
+
+    trace = trace_spec(_record("irtest.floatkey", build),
+                       {"key": (0.5, 4), "args": (_aval(4),)})
+    assert trace.float_keys == ("gamma",)
+    msgs = list(RetraceSurface().check(trace))
+    assert len(msgs) == 1
+    assert "'gamma'" in msgs[0] and "float" in msgs[0]
+
+
+def test_jp305_float_keys_ok_declares_intent():
+    """A site that declared the float a fixed per-model constant
+    (float_keys_ok at registration) is NOT flagged."""
+
+    def build(gamma, n):
+        @jax.jit
+        def prog(x):
+            return x * gamma
+
+        return prog
+
+    trace = trace_spec(
+        _record("irtest.okkey", build, float_keys_ok=("gamma",)),
+        {"key": (0.5, 4), "args": (_aval(4),)})
+    assert trace.float_keys == ()
+    assert list(RetraceSurface().check(trace)) == []
+
+
+def test_jp305_flags_array_cache_key():
+    def build(weights, n):
+        @jax.jit
+        def prog(x):
+            return x + 1.0
+
+        return prog
+
+    trace = trace_spec(
+        _record("irtest.arrkey", build),
+        {"key": (np.ones(3), 4), "args": (_aval(4),)})
+    assert trace.array_keys == ("weights",)
+    msgs = list(RetraceSurface().check(trace))
+    assert len(msgs) == 1
+    assert "'weights'" in msgs[0]
+
+
+# -- end-to-end audit over a fixture tree -----------------------------
+
+_FIXTURE_MOD = textwrap.dedent('''\
+    """IR-audit fixture: one leaky, one pragma'd, one signature-less
+    builder."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from brainiak_tpu.obs import runtime as obs_runtime
+
+
+    @obs_runtime.counted_cache("{tag}.leaky")
+    def _leaky(n):
+        hidden = np.float64(1.5)
+
+        @jax.jit
+        def prog(x):
+            return x * hidden
+
+        return prog
+
+
+    @obs_runtime.trace_signature("{tag}.leaky")
+    def _leaky_sig():
+        return [{{"key": (4,),
+                 "args": (jax.ShapeDtypeStruct((4,), jnp.float32),)}}]
+
+
+    @obs_runtime.counted_cache("{tag}.hushed")  # jaxlint: disable=JP301
+    def _hushed(n):
+        hidden = np.float64(1.5)
+
+        @jax.jit
+        def prog(x):
+            return x * hidden
+
+        return prog
+
+
+    @obs_runtime.trace_signature("{tag}.hushed")
+    def _hushed_sig():
+        return [{{"key": (4,),
+                 "args": (jax.ShapeDtypeStruct((4,), jnp.float32),)}}]
+
+
+    @obs_runtime.counted_cache("{tag}.nosig")
+    def _nosig(n):
+        @jax.jit
+        def prog(x):
+            return x + 1
+
+        return prog
+''')
+
+
+def _write_fixture(tmp_path, monkeypatch, name, tag):
+    (tmp_path / f"{name}.py").write_text(
+        _FIXTURE_MOD.format(tag=tag))
+    monkeypatch.syspath_prepend(str(tmp_path))
+
+
+def test_run_audit_coverage_report(tmp_path, monkeypatch):
+    """The census is mechanical: every static site is traced or
+    carries a reason, coverage is the traced fraction, findings
+    anchor at the builder's def line, pragmas suppress."""
+    _write_fixture(tmp_path, monkeypatch, "ir_fix_cov", "ircov")
+    sites = enumerate_static_sites([str(tmp_path)], str(tmp_path))
+    assert set(sites) == {"ircov.leaky", "ircov.hushed",
+                          "ircov.nosig"}
+    report = run_audit([str(tmp_path)], str(tmp_path))
+    assert sorted(report.traced) == ["ircov.hushed", "ircov.leaky"]
+    assert report.skipped == {
+        "ircov.nosig": "no canonical signature registered "
+                       "(trace_signature missing)"}
+    assert report.coverage == pytest.approx(2 / 3)
+    # the leaky builder is flagged at its def line; the pragma'd
+    # twin (same IR) is suppressed
+    assert [f.code for f in report.findings] == ["JP301"]
+    finding = report.findings[0]
+    assert finding.path == "ir_fix_cov.py"
+    assert finding.snippet.startswith("def _leaky(")
+    payload = report.to_dict()
+    assert payload["sites"] == 3
+    assert payload["coverage"] == pytest.approx(0.6667, abs=1e-3)
+    assert payload["skipped"][0]["site"] == "ircov.nosig"
+    assert payload["rules"] == list(DEFAULT_SELECT)
+
+
+def test_run_audit_restores_x64(tmp_path, monkeypatch):
+    _write_fixture(tmp_path, monkeypatch, "ir_fix_x64", "irx64")
+    before = jax.config.jax_enable_x64
+    run_audit([str(tmp_path)], str(tmp_path))
+    assert jax.config.jax_enable_x64 == before
+
+
+def test_run_audit_reports_import_failure(tmp_path, monkeypatch):
+    """A census module that fails to import is skipped WITH the
+    import error as its reason — never silently dropped."""
+    (tmp_path / "ir_fix_broken.py").write_text(
+        "from brainiak_tpu.obs import runtime as obs_runtime\n"
+        "raise RuntimeError('deliberately broken')\n"
+        "\n"
+        "@obs_runtime.counted_cache('irbroken.site')\n"
+        "def _b(n):\n"
+        "    return None\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    report = run_audit([str(tmp_path)], str(tmp_path))
+    assert report.traced == []
+    assert "irbroken.site" in report.skipped
+    assert "deliberately broken" in report.skipped["irbroken.site"]
+    assert report.coverage == 0.0
+
+
+def test_run_audit_select_and_baseline_scoping(tmp_path, monkeypatch):
+    """--select narrows the rule set; baseline entries suppress with
+    justification and staleness is judged ONLY for selected JP
+    rules (the shared baseline's JX entries are out of scope)."""
+    _write_fixture(tmp_path, monkeypatch, "ir_fix_bl", "irbl")
+    report = run_audit([str(tmp_path)], str(tmp_path),
+                       select=("JP302",))
+    assert report.findings == []  # the leak is a JP301 story
+
+    bl = Baseline([
+        {"rule": "JP301", "path": "ir_fix_bl.py",
+         "snippet": "def _leaky(n):",
+         "reason": "fixture: grandfathered"},
+        {"rule": "JP301", "path": "gone.py",
+         "snippet": "def vanished():", "reason": "stale one"},
+        {"rule": "JX001", "path": "other.py",
+         "snippet": "x = jax.jit(f)", "reason": "not ours"},
+    ])
+    report = run_audit([str(tmp_path)], str(tmp_path), baseline=bl)
+    assert report.findings == []
+    assert [e["path"] for e in report.stale] == ["gone.py"]
+
+
+def test_ir_rules_registered_and_jax_free():
+    """The rule layer imports without jax (gate hosts) and every
+    JP3xx code is selectable from the CLI's --list surface."""
+    import importlib
+    import sys
+
+    assert tuple(r.code for r in IR_RULES) == DEFAULT_SELECT == (
+        "JP301", "JP302", "JP303", "JP304", "JP305")
+    mod = importlib.import_module("brainiak_tpu.analysis.ir.rules")
+    src = open(mod.__file__).read()
+    assert "import jax" not in src
+    assert "brainiak_tpu.analysis.ir.rules" in sys.modules

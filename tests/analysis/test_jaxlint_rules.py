@@ -387,3 +387,57 @@ def test_jx006_silent_with_static_declaration(tmp_path):
             return x.reshape(x.shape[0], -1)
         """, MissingStatic)
     assert findings == []
+
+# -- JX001 x program_cache registry (ISSUE 17 regression) ------------
+#
+# program_cache moved from serve.engine to serve.batching; jaxlint's
+# _CACHE_DECOS learned the new module spellings.  A builder decorated
+# under ANY of them is a cached factory — jit construction inside it
+# must stay silent, while the undecorated twin still fires.
+
+def test_jx001_silent_on_batching_program_cache_spellings(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+        from brainiak_tpu.serve import batching
+        from brainiak_tpu.serve.batching import program_cache
+        import brainiak_tpu.serve.batching as sb
+
+        @batching.program_cache("fixture.attr")
+        def _attr_builder(n, b):
+            return jax.jit(lambda x: x + n)
+
+        @program_cache("fixture.bare")
+        def _bare_builder(n, b):
+            return jax.jit(lambda x: x * n)
+
+        @sb.program_cache("fixture.asname")
+        def _asname_builder(n, b):
+            return jax.jit(lambda x: x - n)
+        """, JitPerCall)
+    assert findings == [], [f.message for f in findings]
+
+
+def test_jx001_silent_on_engine_reexport_spelling(tmp_path):
+    # engine re-exports program_cache for back-compat; the old
+    # spelling must keep working too
+    findings = lint(tmp_path, """
+        import jax
+        from brainiak_tpu.serve import engine
+
+        @engine.program_cache("fixture.legacy")
+        def _legacy_builder(n, b):
+            return jax.jit(lambda x: x + n)
+        """, JitPerCall)
+    assert findings == [], [f.message for f in findings]
+
+
+def test_jx001_still_fires_on_uncached_twin(tmp_path):
+    # control: the identical builder WITHOUT the cache decorator is
+    # the real hazard and must keep firing
+    findings = lint(tmp_path, """
+        import jax
+
+        def _uncached_builder(n, b):
+            return jax.jit(lambda x: x + n)
+        """, JitPerCall)
+    assert [f.code for f in findings] == ["JX001"]

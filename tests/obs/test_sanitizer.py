@@ -1,0 +1,149 @@
+"""Checkify sanitizer lane (BRAINIAK_TPU_SANITIZE=1): typed
+``sanitizer`` events cross-referencing the JP3xx static rules, the
+unsanitizable-chunk fallback, and the off-by-default zero-cost
+contract (ISSUE 17 acceptance)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from brainiak_tpu import obs  # noqa: E402
+from brainiak_tpu.obs import metrics, sanitize  # noqa: E402
+from brainiak_tpu.obs import sink as obs_sink  # noqa: E402
+from brainiak_tpu.resilience.guards import (  # noqa: E402
+    DivergenceError, run_resilient_loop)
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizer():
+    sanitize.reset()
+    yield
+    sanitize.reset()
+
+
+def _mem():
+    return obs_sink.add_sink(obs.MemorySink())
+
+
+def _events(mem, name):
+    return [r for r in mem.records
+            if r["kind"] == "event" and r["name"] == name]
+
+
+def _nan_chunk(state, step, n_steps):
+    # sqrt of a negative produces NaN INSIDE the program — the
+    # float_checks lane must catch it at the generating primitive
+    return {"x": jnp.sqrt(jnp.asarray(state["x"]) - 10.0)}, False
+
+
+def _host_chunk(state, step, n_steps):
+    # np.asarray on a tracer fails: the classic host-side chunk
+    # driver run_resilient_loop explicitly supports
+    return {"x": np.asarray(state["x"]) + n_steps}, False
+
+
+def test_call_checked_reports_nan_with_jp_codes():
+    mem = _mem()
+
+    @jax.jit
+    def prog(x):
+        return jnp.log(x)
+
+    error, out = sanitize.call_checked(
+        prog, (jnp.asarray([-1.0]),), site="t.site", scope="test")
+    assert error is not None and "nan" in error.lower()
+    events = _events(mem, "sanitizer")
+    assert len(events) == 1
+    attrs = events[0]["attrs"]
+    assert attrs["site"] == "t.site"
+    assert attrs["scope"] == "test"
+    assert attrs["codes"] == ["JP301", "JP305"]
+    assert metrics.counter("sanitizer_errors_total").value(
+        site="t.site", scope="test") == 1.0
+
+
+def test_call_checked_clean_program_passes_through():
+    mem = _mem()
+
+    @jax.jit
+    def prog(x):
+        return x * 2.0
+
+    error, out = sanitize.call_checked(
+        prog, (jnp.asarray([2.0]),), site="t.clean", scope="test")
+    assert error is None
+    np.testing.assert_allclose(np.asarray(out), [4.0])
+    assert _events(mem, "sanitizer") == []
+
+
+def test_resilient_loop_nan_chunk_becomes_typed_event(monkeypatch):
+    """Acceptance: an injected NaN inside a resilient-loop chunk
+    surfaces as a typed ``sanitizer`` event AND fails the fit
+    through the normal divergence machinery, with the sanitizer —
+    not the post-hoc state guard — naming the leaf."""
+    monkeypatch.setenv("BRAINIAK_TPU_SANITIZE", "1")
+    mem = _mem()
+    with pytest.raises(DivergenceError) as exc:
+        run_resilient_loop(_nan_chunk, {"x": np.zeros(3)}, 4,
+                           checkpoint_every=2, name="sanfit")
+    assert exc.value.leaves[0].startswith("sanitizer:")
+    events = _events(mem, "sanitizer")
+    assert events, "the trip must emit a typed sanitizer event"
+    attrs = events[0]["attrs"]
+    assert attrs["site"] == "sanfit"
+    assert attrs["scope"] == "resilient_loop"
+    assert "JP301" in attrs["codes"]
+    assert metrics.counter("sanitizer_errors_total").value(
+        site="sanfit", scope="resilient_loop") >= 1.0
+
+
+def test_resilient_loop_host_chunk_skips_once_and_completes(
+        monkeypatch):
+    """A host-side chunk driver cannot checkify-trace: ONE
+    sanitizer_skip event, then the loop runs it unwrapped to the
+    same result the lane-off path produces."""
+    monkeypatch.setenv("BRAINIAK_TPU_SANITIZE", "1")
+    mem = _mem()
+    state, step = run_resilient_loop(
+        _host_chunk, {"x": np.zeros(1)}, 6, checkpoint_every=2,
+        name="hostfit")
+    assert step == 6 and state["x"][0] == 6.0
+    skips = _events(mem, "sanitizer_skip")
+    assert len(skips) == 1
+    assert skips[0]["attrs"]["site"] == "hostfit"
+    assert _events(mem, "sanitizer") == []
+
+
+def test_sanitizer_off_is_zero_cost(monkeypatch):
+    """Acceptance: with the env var unset the lane adds NOTHING —
+    no checked-program builds, no events, no counter series."""
+    monkeypatch.delenv("BRAINIAK_TPU_SANITIZE", raising=False)
+    assert not sanitize.enabled()
+    mem = _mem()
+    state, step = run_resilient_loop(
+        _host_chunk, {"x": np.zeros(1)}, 4, checkpoint_every=2,
+        name="offfit")
+    assert step == 4
+    assert not sanitize._checked, \
+        "no checkify wrapper may be built while the lane is off"
+    assert _events(mem, "sanitizer") == []
+    assert _events(mem, "sanitizer_skip") == []
+    assert metrics.counter("sanitizer_errors_total").value(
+        site="offfit", scope="resilient_loop") == 0.0
+
+
+def test_sanitizer_events_silent_without_sink(monkeypatch):
+    """Even tripped checks emit no records when obs is disabled —
+    the error return path still works."""
+    monkeypatch.setenv("BRAINIAK_TPU_SANITIZE", "1")
+    assert not obs_sink.enabled()
+
+    @jax.jit
+    def prog(x):
+        return jnp.log(x)
+
+    error, _ = sanitize.call_checked(
+        prog, (jnp.asarray([-1.0]),), site="t.nosink", scope="test")
+    assert error is not None

@@ -1,0 +1,92 @@
+"""Serve-side checkify sanitizer lane: an injected NaN inside a
+bucket program (poisoned model weights — finite requests pass
+validation) becomes a typed ``sanitizer`` obs event and a structured
+per-request error; with the lane off the same dispatch runs
+untouched and emits nothing (ISSUE 17 acceptance)."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.obs import MemorySink, add_sink, metrics
+from brainiak_tpu.obs import sanitize
+from brainiak_tpu.serve import InferenceEngine, Request
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizer():
+    sanitize.reset()
+    yield
+    sanitize.reset()
+
+
+def _poisoned(srm_model):
+    """A deep copy whose subject-0 weights carry one NaN: every
+    finite subject-0 request then produces NaN INSIDE the transform
+    program, past request validation."""
+    model = copy.deepcopy(srm_model)
+    model.w_[0] = np.array(model.w_[0])
+    model.w_[0][0, 0] = np.nan
+    return model
+
+
+def _request(model, rid="r0", subject=0, trs=10):
+    rng = np.random.RandomState(3)
+    return Request(request_id=rid, subject=subject,
+                   x=rng.randn(model.w_[subject].shape[0], trs))
+
+
+def test_serve_program_nan_becomes_typed_event(srm_model,
+                                               monkeypatch):
+    monkeypatch.setenv("BRAINIAK_TPU_SANITIZE", "1")
+    mem = add_sink(MemorySink())
+    model = _poisoned(srm_model)
+    engine = InferenceEngine(model)
+    record, = engine.run([_request(model)])
+    assert not record.ok
+    assert record.error == "execution_failed"
+    assert "sanitizer" in (record.message or "")
+    events = [r for r in mem.records
+              if r["kind"] == "event" and r["name"] == "sanitizer"]
+    assert events, "serve trip must emit a typed sanitizer event"
+    attrs = events[0]["attrs"]
+    assert attrs["site"] == "serve.srm"
+    assert attrs["scope"] == "serve"
+    assert "JP301" in attrs["codes"]
+    assert metrics.counter("sanitizer_errors_total").value(
+        site="serve.srm", scope="serve") >= 1.0
+
+
+def test_serve_lane_off_runs_untouched(srm_model, monkeypatch):
+    monkeypatch.delenv("BRAINIAK_TPU_SANITIZE", raising=False)
+    mem = add_sink(MemorySink())
+    model = _poisoned(srm_model)
+    engine = InferenceEngine(model)
+    record, = engine.run([_request(model)])
+    # the NaN flows through silently: the lane is off, the engine's
+    # contract is untouched dispatch
+    assert record.ok
+    assert np.isnan(np.asarray(record.result)).any()
+    assert not sanitize._checked
+    assert [r for r in mem.records
+            if r["kind"] == "event"
+            and r["name"].startswith("sanitizer")] == []
+
+
+def test_serve_clean_requests_pass_under_sanitizer(srm_model,
+                                                   monkeypatch):
+    """The lane must not perturb healthy serving: same results,
+    no events."""
+    monkeypatch.setenv("BRAINIAK_TPU_SANITIZE", "1")
+    mem = add_sink(MemorySink())
+    engine = InferenceEngine(srm_model)
+    req = _request(srm_model, rid="ok0")
+    record, = engine.run([req])
+    assert record.ok, record.error
+    expected = srm_model.w_[0].T @ req.x
+    np.testing.assert_allclose(np.asarray(record.result), expected,
+                               atol=1e-5)
+    assert [r for r in mem.records
+            if r["kind"] == "event"
+            and r["name"] == "sanitizer"] == []

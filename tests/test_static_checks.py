@@ -44,9 +44,9 @@ def test_run_checks_json_output():
     assert payload["findings"] == []
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
-        "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
-        "serve", "service", "federation", "fleet", "distla",
-        "encoding", "kernels", "data", "realtime"}
+        "jaxlint", "jaxlint-deep", "jaxlint-ir", "obs", "obs-live",
+        "regress", "serve", "service", "federation", "fleet",
+        "distla", "encoding", "kernels", "data", "realtime"}
     assert payload["files"] > 100
     seconds = payload["gate_seconds"]
     assert set(seconds) == set(payload["gates"])
@@ -56,6 +56,12 @@ def test_run_checks_json_output():
     # must stay fast enough to run on every test invocation
     assert seconds["jaxlint"] + seconds["jaxlint-deep"] < 10.0, \
         seconds
+    # the combined analyzer walk — AST families plus the traced-IR
+    # audit child — must stay well under a minute (ISSUE 17
+    # acceptance: asserted via gate_seconds, not wall-clock guesses)
+    analyzer = (seconds["stdlib"] + seconds["jaxlint"]
+                + seconds["jaxlint-deep"] + seconds["jaxlint-ir"])
+    assert analyzer < 60.0, seconds
 
 
 def test_jaxlint_gate_standalone():
@@ -883,3 +889,165 @@ def test_obs_live_gate_classifies_failures(monkeypatch):
     rc.check_obs_live(findings)
     assert [f.code for f in findings] == ["OBS002"]
     assert "readyz_ready=False" in findings[0].message
+
+
+# -- jaxlint-ir gate --------------------------------------------------
+
+
+def test_jaxlint_ir_gate_standalone():
+    """`--only=jaxlint-ir` runs the traced-IR audit alone: the live
+    tree traces every registered builder at its canonical signature
+    with coverage >= 90%, and every JP3xx finding is fixed or
+    carries a justified baseline entry (ISSUE 17 acceptance)."""
+    rc = _load_run_checks()
+    result = rc.run_gates(only=["jaxlint-ir"])
+    assert result["ok"] is True, \
+        [str(f) for f in result["findings"]]
+    assert result["files"] == 0  # audit child owns the walk
+    assert result["label"] == "jaxlint-ir"
+    assert result["gate_seconds"]["jaxlint-ir"] > 0.0
+    assert result["stale_baseline"] == []
+
+
+def test_gate_list_includes_jaxlint_ir():
+    """`--list` advertises the IR gate between the AST analyzer
+    families and the runtime gates."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.run_checks", "--list"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 0
+    gates = r.stdout.split()
+    assert gates.index("jaxlint") < gates.index("jaxlint-deep") \
+        < gates.index("jaxlint-ir") < gates.index("obs")
+
+
+def _fake_ir_child(monkeypatch, rc, verdict=None, stdout=None,
+                   returncode=1, stderr="", timeout=False):
+    def runner(cmd, **kwargs):
+        assert "--ir" in cmd and "--format=json" in cmd
+        env = kwargs.get("env") or {}
+        assert env.get("JAX_PLATFORMS") == "cpu"
+        assert "xla_force_host_platform_device_count" \
+            in env.get("XLA_FLAGS", "")
+        if timeout:
+            raise rc.subprocess.TimeoutExpired(cmd, 420)
+        out = stdout if stdout is not None else json.dumps(verdict)
+        class Proc:
+            pass
+        proc = Proc()
+        proc.stdout = out
+        proc.stderr = stderr
+        proc.returncode = returncode
+        return proc
+    monkeypatch.setattr(rc.subprocess, "run", runner)
+
+
+def test_jaxlint_ir_gate_per_rule_classification(monkeypatch):
+    """Audit findings keep their OWN JP codes in gate output — a
+    dtype leak and a donation break stay distinguishable — and the
+    child's JP-scoped stale-baseline entries join the report."""
+    rc = _load_run_checks()
+    _fake_ir_child(monkeypatch, rc, verdict={
+        "coverage": 1.0,
+        "findings": [
+            {"path": "brainiak_tpu/a.py", "line": 3,
+             "code": "JP301", "message": "float64 values appear",
+             "snippet": "def build_a():"},
+            {"path": "brainiak_tpu/b.py", "line": 7,
+             "code": "JP302", "message": "declares no donation",
+             "snippet": "def build_b():"},
+        ],
+        "skipped": [],
+        "stale_baseline": [{"rule": "JP302", "path": "gone.py",
+                            "snippet": "x", "reason": "old"}],
+    })
+    findings, stale = [], []
+    rc.check_jaxlint_ir(findings, stale)
+    assert [f.code for f in findings] == ["JP301", "JP302"]
+    assert findings[0].path == "brainiak_tpu/a.py"
+    assert findings[0].line == 3
+    assert findings[1].snippet == "def build_b():"
+    assert stale == [{"rule": "JP302", "path": "gone.py",
+                      "snippet": "x", "reason": "old"}]
+
+
+def test_jaxlint_ir_gate_coverage_contract(monkeypatch):
+    """Builder coverage below 90% of the static census is a
+    gate-level JPR001 naming every skipped site's reason."""
+    rc = _load_run_checks()
+    _fake_ir_child(monkeypatch, rc, verdict={
+        "coverage": 0.5,
+        "findings": [],
+        "skipped": [
+            {"site": "serve.srm",
+             "reason": "signature factory failed: boom"},
+            {"site": "isc.slab",
+             "reason": "no canonical signature registered "
+                       "(trace_signature missing)"},
+        ],
+        "stale_baseline": [],
+    })
+    findings, stale = [], []
+    rc.check_jaxlint_ir(findings, stale)
+    assert [f.code for f in findings] == ["JPR001"]
+    msg = findings[0].message
+    assert "50%" in msg and "90%" in msg
+    assert "serve.srm" in msg and "isc.slab" in msg
+    assert "signature factory failed" in msg
+
+
+def test_jaxlint_ir_gate_child_failures(monkeypatch):
+    """A crashed child (bad rc / no JSON) and a hung child each
+    classify as gate-level JPR001, never as silence."""
+    rc = _load_run_checks()
+    _fake_ir_child(monkeypatch, rc, stdout="not json",
+                   returncode=2, stderr="Traceback: boom")
+    findings, stale = [], []
+    rc.check_jaxlint_ir(findings, stale)
+    assert [f.code for f in findings] == ["JPR001"]
+    assert "rc=2" in findings[0].message
+    assert "boom" in findings[0].message
+
+    _fake_ir_child(monkeypatch, rc, timeout=True)
+    findings, stale = [], []
+    rc.check_jaxlint_ir(findings, stale)
+    assert [f.code for f in findings] == ["JPR001"]
+    assert "timed out" in findings[0].message
+
+
+def test_run_checks_unified_sarif(monkeypatch, capsys):
+    """--format=sarif merges every analyzer family into ONE log:
+    JP3xx lint results stay level=warning, gate plumbing codes
+    (JPR/CHK0 prefixes) map to level=error, and the driver carries
+    rule metadata for the IR family."""
+    rc = _load_run_checks()
+
+    def fake_run_gates(only=None):
+        return {
+            "ok": False,
+            "label": "test",
+            "files": 2,
+            "gates": ["stdlib", "jaxlint", "jaxlint-deep",
+                      "jaxlint-ir"],
+            "gate_seconds": {},
+            "findings": [
+                rc.Finding("a.py", 1, "CHK002", "line too long"),
+                rc.Finding("b.py", 2, "JX001", "jit per call"),
+                rc.Finding("c.py", 3, "JP301", "float64 leak"),
+                rc.Finding("d.py", 4, "JPR001", "coverage 50%"),
+            ],
+            "stale_baseline": [],
+        }
+
+    monkeypatch.setattr(rc, "run_gates", fake_run_gates)
+    assert rc.main(["--format", "sarif"]) == 1
+    log = json.loads(capsys.readouterr().out)
+    assert log["version"] == "2.1.0"
+    run, = log["runs"]
+    levels = {r["ruleId"]: r["level"] for r in run["results"]}
+    assert levels == {"CHK002": "error", "JX001": "warning",
+                      "JP301": "warning", "JPR001": "error"}
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    # IR rules ship driver metadata alongside the AST families
+    assert {"JP301", "JP302", "JP303", "JP304", "JP305",
+            "JX001", "CHK002", "JPR001"} <= rule_ids

@@ -27,6 +27,15 @@ jaxlint-deep project-wide semantic analysis over the same scope:
            guarded-by lock-discipline race detector for the
            serve loop (JX201-JX205); same baseline, own section
            conventions (see docs/static_analysis.md)
+jaxlint-ir traced-IR audit (JPR001): a child pinned to an
+           8-device CPU backend traces every registered
+           jitted-program builder at its canonical abstract
+           signature and runs the JP301-JP305 rules over the
+           actual jaxpr/executable (dtype promotion, donation,
+           host callbacks, collective axes, retrace surface);
+           surviving findings keep their own JP codes, and the
+           gate itself fails on builder coverage below 90% of
+           the static census or a crashed/hung audit child
 obs        smoke-runs ``python -m brainiak_tpu.obs report
            --format=json`` on tools/obs_fixture.jsonl and
            fails on schema violations (OBS001)
@@ -130,9 +139,9 @@ from brainiak_tpu.analysis.core import (  # noqa: E402,F401
 
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
-         "jaxlint", "jaxlint-deep", "obs", "obs-live", "regress",
-         "serve", "service", "federation", "fleet", "distla",
-         "encoding", "kernels", "data", "realtime")
+         "jaxlint", "jaxlint-deep", "jaxlint-ir", "obs", "obs-live",
+         "regress", "serve", "service", "federation", "fleet",
+         "distla", "encoding", "kernels", "data", "realtime")
 
 
 def python_sources():
@@ -1250,6 +1259,81 @@ def run_external(findings):
 
 # -- driver -----------------------------------------------------------
 
+# -- jaxlint-ir gate --------------------------------------------------
+
+#: Minimum traced fraction of the static builder census the gate
+#: accepts; below this every skipped site's reason is surfaced.
+_IR_MIN_COVERAGE = 0.90
+
+
+def check_jaxlint_ir(findings, ir_stale):
+    """jaxlint-IR gate (JPR001): the traced-IR audit in a child.
+
+    Runs ``python -m brainiak_tpu.analysis.cli --ir --format=json``
+    pinned to an 8-device CPU backend (the audit traces collective
+    programs against a real mesh) and folds the verdict in:
+
+    * surviving JP3xx findings are re-emitted under their OWN rule
+      codes — a JP301 dtype leak and a JP302 donation break stay
+      distinguishable in gate output and SARIF;
+    * builder coverage below ``_IR_MIN_COVERAGE`` of the static
+      census, a crashed or hung child, or malformed JSON raise a
+      gate-level JPR001 with the skip reasons attached;
+    * the audit's stale-baseline entries (already scoped to the JP
+      rules it ran) are appended to ``ir_stale`` so jaxlint-ir
+      participates in the shared staleness report.
+    """
+    rel = _rel(os.path.join(REPO, "brainiak_tpu", "analysis", "ir",
+                            "audit.py"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    cmd = [sys.executable, "-m", "brainiak_tpu.analysis.cli",
+           "--ir", "--format=json"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              cwd=REPO, env=env, timeout=420)
+    except subprocess.TimeoutExpired:
+        findings.append(Finding(
+            rel, 1, "JPR001",
+            "jaxlint-ir audit timed out after 420s (hung backend "
+            "init?)"))
+        return
+    try:
+        verdict = json.loads(proc.stdout)
+    except ValueError:
+        verdict = None
+    if verdict is None or proc.returncode not in (0, 1):
+        tail = (proc.stderr or proc.stdout or "").strip()
+        tail = "; ".join(tail.splitlines()[-3:])
+        findings.append(Finding(
+            rel, 1, "JPR001",
+            f"jaxlint-ir audit failed (rc={proc.returncode}): "
+            f"{tail or 'no JSON verdict'}"))
+        return
+    for item in verdict.get("findings", []):
+        findings.append(Finding(
+            item["path"], item["line"], item["code"],
+            item["message"], item.get("snippet", "")))
+    coverage = verdict.get("coverage", 0.0)
+    if coverage < _IR_MIN_COVERAGE:
+        skipped = verdict.get("skipped", [])
+        detail = "; ".join(f"{s['site']}: {s['reason']}"
+                           for s in skipped[:5])
+        if len(skipped) > 5:
+            detail += f"; … {len(skipped) - 5} more"
+        findings.append(Finding(
+            rel, 1, "JPR001",
+            f"builder coverage {coverage:.0%} is below the "
+            f"{_IR_MIN_COVERAGE:.0%} contract — every builder "
+            f"needs a canonical trace signature or an explicit "
+            f"fix: {detail or 'no skip reasons reported'}"))
+    ir_stale.extend(verdict.get("stale_baseline", []))
+
+
 def _jaxlint_scope(config):
     """(include_abs_paths, exclude_prefixes) for the jaxlint gate."""
     include = [os.path.abspath(p) for p in config.include_paths()]
@@ -1365,6 +1449,10 @@ def run_gates(only=None):
         findings.extend(timed("jaxlint-deep", run_project_rules,
                               contexts, deep_rules))
 
+    ir_stale = []
+    if "jaxlint-ir" in selected:
+        timed("jaxlint-ir", check_jaxlint_ir, findings, ir_stale)
+
     if "doc-defaults" in selected:
         timed("doc-defaults", check_doc_defaults, findings)
     if "resilient-fits" in selected:
@@ -1396,18 +1484,25 @@ def run_gates(only=None):
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
+        # JP-rule entries are judged by the jaxlint-ir child (which
+        # applies the same baseline to the traced findings), never
+        # by the AST families — they always look unmatched here.
+        stale = [e for e in stale
+                 if not str(e.get("rule", "")).startswith("JP")]
         if not {"jaxlint", "jaxlint-deep"} <= selected:
             # a partial rule run cannot judge staleness: entries
             # for the unselected family would all look unmatched
             stale = []
+    if "jaxlint-ir" in selected:
+        stale = list(stale) + ir_stale
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     label = "+".join(
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
-                       "jaxlint-deep", "obs", "obs-live", "regress",
-                       "serve", "service", "federation", "fleet",
-                       "distla", "encoding", "kernels", "data",
-                       "realtime")
+                       "jaxlint-deep", "jaxlint-ir", "obs",
+                       "obs-live", "regress", "serve", "service",
+                       "federation", "fleet", "distla", "encoding",
+                       "kernels", "data", "realtime")
            if g in selected])
     return {
         "ok": not findings,
@@ -1443,7 +1538,9 @@ def main(argv=None):
             if args.only else None)
     result = run_gates(only)
     if args.format == "sarif":
-        rules_by_code = {r.code: r for r in ALL_RULES}
+        from brainiak_tpu.analysis import IR_RULES
+        rules_by_code = {r.code: r
+                         for r in (*ALL_RULES, *IR_RULES)}
         print(json.dumps(to_sarif(
             result["findings"], rules_by_code,
             tool_name="run_checks"), indent=2))
