@@ -178,6 +178,21 @@ STREAMING_CPU_TRS = 80
 STREAMING_FEATURES = 8
 STREAMING_ITERS = 2
 
+# stats tier (resampling-statistics engine, brainiak_tpu.stats): a
+# chunked NullEngine run of the sign-flip family over an ISC-scale
+# [subjects, voxels] input — surrogates/s of the vmapped one-program
+# path, with ``vs_baseline`` = the measured win over the pre-engine
+# host-loop formulation (one numpy surrogate + statistic per
+# resample, the legacy brainiak idiom), timed on the same backend in
+# the same process.  BENCH_STATS_RESAMPLES overrides either
+# backend's resample count.
+STATS_RESAMPLES = 2048
+STATS_CPU_RESAMPLES = 512
+STATS_SUBJECTS = 16
+STATS_VOXELS = 4096
+STATS_CPU_VOXELS = 1024
+STATS_BASELINE_RESAMPLES = 64
+
 
 def _serve_n_requests():
     """The serve tier's request count: one reader for the env
@@ -657,6 +672,100 @@ def _realtime_result_records(out):
         rec("realtime_deadline_miss_ratio", out["miss_ratio"],
             "ratio"),
     ]
+
+
+def _stats_shape():
+    """The stats tier's workload (env override, else backend-scaled
+    defaults) — one reader so the measured workload and the stamped
+    config cannot drift."""
+    import os
+
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    n_resamples = int(os.environ.get(
+        "BENCH_STATS_RESAMPLES",
+        STATS_RESAMPLES if on_tpu else STATS_CPU_RESAMPLES))
+    n_voxels = STATS_VOXELS if on_tpu else STATS_CPU_VOXELS
+    return n_resamples, n_voxels
+
+
+def stats_tier_metrics(n_resamples, n_voxels, seed=0):
+    """The ``stats`` tier: resampling-null throughput of the
+    :class:`brainiak_tpu.stats.engine.NullEngine` sign-flip family
+    over an ISC-scale ``[subjects, voxels]`` input, on whatever
+    backend is ambient.
+
+    A short warm run pays the (single) surrogate-program compile, so
+    the measured run is the steady chunked state.  The baseline is
+    the pre-engine host-loop formulation — one numpy sign-flip
+    surrogate + median statistic per resample, the legacy brainiak
+    ``permutation_isc`` inner loop — capped at
+    ``STATS_BASELINE_RESAMPLES`` iterations (the rate extrapolates;
+    a full host run at the engine's resample count would dominate
+    the bench round)."""
+    import jax
+
+    from brainiak_tpu.stats.engine import NullEngine
+
+    with obs.span("bench.data_gen"):
+        rng = np.random.RandomState(seed)
+        iscs = 0.2 + 0.1 * rng.randn(STATS_SUBJECTS, n_voxels)
+    engine = NullEngine()
+    run_kwargs = dict(statistic="median", side="two-sided",
+                      seed=seed)
+    with obs.span("bench.warm"):
+        engine.run(iscs, "sign_flip", 64, **run_kwargs)
+    with obs.span("bench.steady"):
+        t0 = time.perf_counter()
+        result = engine.run(iscs, "sign_flip", n_resamples,
+                            **run_kwargs)
+        rate = n_resamples / (time.perf_counter() - t0)
+    p = result.p_values()
+    assert np.all((p > 0.0) & (p <= 1.0))
+    with obs.span("bench.baseline"):
+        reps = min(n_resamples, STATS_BASELINE_RESAMPLES)
+        host_rng = np.random.RandomState(seed)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            signs = host_rng.choice((-1.0, 1.0),
+                                    size=(iscs.shape[0], 1))
+            np.median(signs * iscs, axis=0)
+        host_rate = reps / (time.perf_counter() - t0)
+    return {"surrogates_per_sec": rate,
+            "host_surrogates_per_sec": host_rate,
+            "n_resamples": n_resamples,
+            "n_subjects": STATS_SUBJECTS, "n_voxels": n_voxels,
+            "backend": jax.default_backend()}
+
+
+def _stats_result_record(out):
+    """The stats tier's bench JSON line: engine surrogates/s, with
+    ``vs_baseline`` = the measured win over the host-loop
+    formulation on the same backend.  Tier split mirrors every
+    other tier (``stats`` on TPU, ``stats_cpu_fallback`` otherwise)
+    so ``obs regress --only stats`` never compares host rounds
+    against on-chip ones."""
+    tier = "stats" if out.get("backend") == "tpu" \
+        else "stats_cpu_fallback"
+    host = out.get("host_surrogates_per_sec") or 0.0
+    rec = {"schema_version": BENCH_SCHEMA_VERSION,
+           "metric": "stats_surrogates_per_sec",
+           "value": round(float(out["surrogates_per_sec"]), 3),
+           "unit": "surrogates/sec",
+           "vs_baseline": round(out["surrogates_per_sec"] / host, 3)
+           if host else 0.0,
+           "tier": tier,
+           "config": {"n_resamples": out["n_resamples"],
+                      "n_subjects": out["n_subjects"],
+                      "n_voxels": out["n_voxels"],
+                      "family": "sign_flip",
+                      "backend": out.get("backend")}}
+    commit = _git_commit()
+    if commit:
+        rec["git_commit"] = commit
+    if out.get("stages"):
+        rec["stages"] = out["stages"]
+    return rec
 
 
 def _kernels_shape():
@@ -1568,6 +1677,16 @@ def measure_tier(tier):
                           else "realtime_cpu_fallback")
             out["stages"] = _stage_seconds(mem.records)
             return out
+        if tier == "stats":
+            out = stats_tier_metrics(*_stats_shape())
+            # tier split by backend, same rule as every other tier
+            obs.gauge("bench_stats_surrogates_per_sec",
+                      unit="surrogates/sec").set(
+                          out["surrogates_per_sec"],
+                          tier="stats" if out["backend"] == "tpu"
+                          else "stats_cpu_fallback")
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "streaming":
             out = streaming_tier_metrics(*_streaming_shape())
             # tier split by backend, same rule as every other tier
@@ -1722,6 +1841,7 @@ def main():
     _kernels_main(responsive)
     _streaming_main(responsive)
     _realtime_main(responsive)
+    _stats_main(responsive)
 
 
 def _aux_tier_main(responsive, tier, record_fn, timeout=420):
@@ -1794,6 +1914,12 @@ def _streaming_main(responsive):
     """Streaming tier: out-of-core subject-sharded SRM — two
     records (streamed subjects/s, prefetch stall ratio)."""
     _aux_tier_main(responsive, "streaming", _streaming_result_records)
+
+
+def _stats_main(responsive):
+    """Stats tier: resampling-null surrogates/s through the chunked
+    NullEngine, with the host-loop formulation as ``vs_baseline``."""
+    _aux_tier_main(responsive, "stats", _stats_result_record)
 
 
 def _realtime_main(responsive):

@@ -6,10 +6,15 @@ statistical semantics match the reference; the compute core is jitted JAX:
 - leave-one-out / pairwise ISC and ISFC are batched einsums instead of
   per-voxel / per-pair Python loops (reference isc.py:164-192, 310-349);
 - the resampling nulls (bootstrap, permutation, circular time-shift, phase
-  randomization) are ``lax.map`` over ``jax.random`` keys on device instead
-  of stateful RandomState chains (reference isc.py:739-787, 1200-1247,
-  1344-1398, 1500-1547).  Seeds therefore produce different (but
-  statistically equivalent) resamples than the reference.
+  randomization) route through the :mod:`brainiak_tpu.stats` engine
+  (:class:`~brainiak_tpu.stats.engine.NullEngine`): whole surrogate
+  families compiled as one vmapped program over ``jax.random`` keys
+  instead of stateful RandomState chains (reference isc.py:739-787,
+  1200-1247, 1344-1398, 1500-1547).  Seeds therefore produce different
+  (but statistically equivalent) resamples than the reference.  Pass
+  ``return_distribution=False`` to skip materializing the
+  ``[n_resamples, V]`` null and read p/CI from the engine's mergeable
+  accumulator instead (population-scale runs).
 
 Deviation noted: in the pairwise bootstrap the reference censors resampled
 same-subject pairs by testing ``isc == 1.0`` (isc.py:769); we censor by
@@ -18,9 +23,7 @@ accidentally censor a genuine ISC of exactly 1.0.
 """
 
 import logging
-import math
 from functools import partial
-from itertools import permutations, product
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +36,8 @@ from .obs import runtime as obs_runtime
 from .obs import spans as obs_spans
 from .parallel.mesh import (DEFAULT_VOXEL_AXIS, fetch_replicated,
                             place_on_mesh)
-from .utils.utils import _check_timeseries_input, p_from_null
+from .stats.pvalues import compute_summary_statistic, p_from_null
+from .utils.utils import _check_timeseries_input
 
 __all__ = [
     "bootstrap_isc",
@@ -90,20 +94,8 @@ def _check_isc_input(iscs, pairwise=False):
     return iscs, n_subjects, iscs.shape[1]
 
 
-def compute_summary_statistic(iscs, summary_statistic='mean', axis=None):
-    """'mean' (Fisher-z averaged) or 'median' of ISC values
-    (reference isc.py:483-527)."""
-    if summary_statistic not in ('mean', 'median'):
-        raise ValueError("Summary statistic must be 'mean' or 'median'")
-    if summary_statistic == 'mean':
-        return np.tanh(np.nanmean(np.arctanh(iscs), axis=axis))
-    return np.nanmedian(iscs, axis=axis)
-
-
-def _jnp_summary(iscs, summary_statistic, axis=0):
-    if summary_statistic == 'mean':
-        return jnp.tanh(jnp.nanmean(jnp.arctanh(iscs), axis=axis))
-    return jnp.nanmedian(iscs, axis=axis)
+# compute_summary_statistic's canonical home is stats.pvalues (imported
+# above and re-exported here for the long-standing isc surface).
 
 
 def squareform_isfc(isfcs, iscs=None):
@@ -488,160 +480,39 @@ def _resolve_seed(random_state):
     return int(random_state)
 
 
-# -- jitted null-distribution programs -----------------------------------
-# Each resampling loop is a MODULE-LEVEL jitted function (statics: the
-# summary statistic, batch size, and branch flags).  Defining the lax.map
-# inside the public functions as a closure re-traced and re-dispatched the
-# map chunks on every call: measured 0.96 s/call eager vs 0.069 s warm
-# jitted for a 200-resample bootstrap on a v5e.
+# -- null distributions ---------------------------------------------------
+# The resampling loops live in brainiak_tpu.stats: each family is ONE
+# counted_cache'd vmapped program (stats.surrogates) driven chunked /
+# resumable / mergeable by stats.engine.NullEngine.  The wrappers below
+# keep the long-standing *_isc signatures and, at matched seeds, return
+# bit-identical distributions to the pre-engine versions (same key
+# schedule: split once over all planned resamples).
 
 
-@partial(jax.jit, static_argnames=("stat", "batch"))
-def _boot_loo_map(iscs_j, keys, stat, batch):
-    n_subj = iscs_j.shape[0]
-
-    def one(key):
-        sample = jax.random.choice(key, n_subj, (n_subj,))
-        return _jnp_summary(iscs_j[sample], stat, axis=0)
-
-    return jax.lax.map(one, keys, batch_size=batch)
+def _null_engine(mesh, null_batch_size):
+    from .stats.engine import NullEngine
+    return NullEngine(mesh=mesh, null_batch_size=null_batch_size)
 
 
-@partial(jax.jit, static_argnames=("stat", "batch"))
-def _boot_pairwise_map(sq_j, keys, iu0, iu1, stat, batch):
-    n_subj = sq_j.shape[0]
-
-    def one(key):
-        sample = jnp.sort(jax.random.choice(key, n_subj, (n_subj,)))
-        resq = sq_j[sample][:, sample]
-        same = sample[:, None] == sample[None, :]
-        resq = jnp.where(same[..., None], jnp.nan, resq)
-        return _jnp_summary(resq[iu0, iu1], stat, axis=0)
-
-    return jax.lax.map(one, keys, batch_size=batch)
-
-
-@partial(jax.jit,
-         static_argnames=("stat", "batch", "sampled", "n_subjects"))
-def _perm_flip_loo_map(iscs_j, xs, stat, batch, sampled, n_subjects):
-    def apply_flips(flips):
-        return _jnp_summary(iscs_j * flips[:, None], stat, axis=0)
-
-    if sampled:
-        def one(key):
-            flips = jax.random.choice(key, jnp.array([-1.0, 1.0]),
-                                      (n_subjects,))
-            return apply_flips(flips)
-
-        return jax.lax.map(one, xs, batch_size=batch)
-    return jax.lax.map(apply_flips, xs, batch_size=batch)
-
-
-@partial(jax.jit,
-         static_argnames=("stat", "batch", "sampled", "n_subjects"))
-def _perm_flip_pairwise_map(iscs_j, xs, iu0, iu1, stat, batch, sampled,
-                            n_subjects):
-    def apply_flips(flips):
-        pairflip = flips[iu0] * flips[iu1]
-        return _jnp_summary(iscs_j * pairflip[:, None], stat, axis=0)
-
-    if sampled:
-        def one(key):
-            flips = jax.random.choice(key, jnp.array([-1.0, 1.0]),
-                                      (n_subjects,))
-            return apply_flips(flips)
-
-        return jax.lax.map(one, xs, batch_size=batch)
-    return jax.lax.map(apply_flips, xs, batch_size=batch)
-
-
-def _group_diff_stat(iscs_j, sel, labels_j, stat):
-    """summary(group0) - summary(group1) for per-row labels ``sel``
-    (rows labeled NaN are excluded from both summaries).  Single source
-    of the two-group statistic for BOTH the observed value and the
-    permutation nulls."""
-    s0 = _jnp_summary(
-        jnp.where((sel == labels_j[0])[:, None], iscs_j, jnp.nan),
-        stat, axis=0)
-    s1 = _jnp_summary(
-        jnp.where((sel == labels_j[1])[:, None], iscs_j, jnp.nan),
-        stat, axis=0)
-    return s0 - s1
-
-
-@partial(jax.jit, static_argnames=("stat", "batch", "sampled"))
-def _perm_group_loo_map(iscs_j, sel_j, labels_j, xs, stat, batch,
-                        sampled):
-    n_subjects = sel_j.shape[0]
-    if sampled:
-        def one(key):
-            return _group_diff_stat(
-                iscs_j, sel_j[jax.random.permutation(key, n_subjects)],
-                labels_j, stat)
-
-        return jax.lax.map(one, xs, batch_size=batch)
-    return jax.lax.map(
-        lambda perm: _group_diff_stat(iscs_j, sel_j[perm], labels_j,
-                                      stat),
-        xs, batch_size=batch)
-
-
-@partial(jax.jit, static_argnames=("stat", "batch", "sampled"))
-def _perm_group_pairwise_map(iscs_j, sq_labels_j, labels_j, iu0, iu1,
-                             xs, stat, batch, sampled):
-    def permute_stat(perm):
-        shuffled = sq_labels_j[perm][:, perm]
-        return _group_diff_stat(iscs_j, shuffled[iu0, iu1], labels_j,
-                                stat)
-
-    n_subjects = sq_labels_j.shape[0]
-    if sampled:
-        def one(key):
-            return permute_stat(jax.random.permutation(key, n_subjects))
-
-        return jax.lax.map(one, xs, batch_size=batch)
-    return jax.lax.map(permute_stat, xs, batch_size=batch)
-
-
-@partial(jax.jit, static_argnames=("stat", "batch", "pairwise"))
-def _timeshift_map(data_j, others, keys, iu0, iu1, stat, batch,
-                   pairwise):
-    n_trs, _, n_subjects = data_j.shape
-
-    def one_shift(key):
-        shifts = jax.random.choice(key, n_trs, (n_subjects,))
-        rolled = jax.vmap(
-            lambda s, shift: jnp.roll(s, shift, axis=0),
-            in_axes=(2, 0), out_axes=2)(data_j, shifts)
-        if pairwise:
-            corr = _isc_pairwise_core(rolled)
-            return _jnp_summary(corr[iu0, iu1, :], stat, axis=0)
-        return _jnp_summary(_columnwise_corr(rolled, others), stat,
-                            axis=0)
-
-    return jax.lax.map(one_shift, keys, batch_size=batch)
-
-
-@partial(jax.jit,
-         static_argnames=("stat", "batch", "pairwise", "voxelwise"))
-def _phaseshift_map(data_j, others, keys, iu0, iu1, stat, batch,
-                    pairwise, voxelwise):
-    from .ops.stats import phase_randomize as phase_randomize_jax
-
-    def one_shift(key):
-        shifted = phase_randomize_jax(key, data_j, voxelwise=voxelwise)
-        if pairwise:
-            corr = _isc_pairwise_core(shifted)
-            return _jnp_summary(corr[iu0, iu1, :], stat, axis=0)
-        return _jnp_summary(_columnwise_corr(shifted, others), stat,
-                            axis=0)
-
-    return jax.lax.map(one_shift, keys, batch_size=batch)
+def _reinsert_nan_p(observed, p, mask, n_voxels, n_samples):
+    """Accumulator-mode counterpart of _reinsert_nan_voxels: excluded
+    voxels get the legacy all-NaN-column p of ``1 / (n + 1)`` (every
+    NaN comparison counts as a non-exceedance)."""
+    if np.all(mask):
+        return observed, p
+    idx = np.where(mask)[0]
+    obs_full = np.full(observed.shape[:-1] + (n_voxels,), np.nan)
+    obs_full[..., idx] = observed
+    p_full = np.full(np.shape(p)[:-1] + (n_voxels,),
+                     1.0 / (n_samples + 1))
+    p_full[..., idx] = p
+    return obs_full, p_full
 
 
 def bootstrap_isc(iscs, pairwise=False, summary_statistic='median',
                   n_bootstraps=1000, ci_percentile=95, side='right',
-                  random_state=None, mesh=None, null_batch_size=64):
+                  random_state=None, mesh=None, null_batch_size=None,
+                  return_distribution=True):
     """Subject-wise bootstrap test for ISCs (reference isc.py:649-810).
 
     Resamples subjects with replacement, shifts the bootstrap distribution
@@ -651,7 +522,12 @@ def bootstrap_isc(iscs, pairwise=False, summary_statistic='median',
     mesh : optional Mesh with a ``'voxel'`` axis — shards the voxel
         dimension of the resampling program.
     null_batch_size : resamples evaluated per device dispatch (the
-        vmap-chunk size; bound it to keep single dispatches short).
+        vmap-chunk size); default
+        :func:`brainiak_tpu.stats.engine.default_null_batch`.
+    return_distribution : when False the ``[n_bootstraps, V]`` null is
+        never materialized — p and CI come from the engine's mergeable
+        accumulator (CI to sketch accuracy) and the returned
+        distribution is None.
     """
     iscs, n_subjects, n_voxels = _check_isc_input(iscs, pairwise=pairwise)
     if summary_statistic not in ('mean', 'median'):
@@ -660,33 +536,30 @@ def bootstrap_isc(iscs, pairwise=False, summary_statistic='median',
     observed = compute_summary_statistic(
         iscs, summary_statistic=summary_statistic, axis=0)
 
-    if pairwise:
-        # Rebuild the square matrix once; each bootstrap gathers rows/cols.
-        sq = np.stack([squareform(v, force='tomatrix') for v in iscs.T],
-                      axis=-1)  # [S, S, V]
-        for v in range(sq.shape[-1]):
-            np.fill_diagonal(sq[..., v], 1.0)
-        sq_j = _shard_voxels(sq, mesh, 2)
-    else:
-        iscs_j = _shard_voxels(iscs, mesh, 1)
-    keys = jax.random.split(
-        jax.random.PRNGKey(_resolve_seed(random_state)), n_bootstraps)
-    if pairwise:
-        iu = np.triu_indices(n_subjects, k=1)
-        distribution = fetch_replicated(_boot_pairwise_map(
-            sq_j, keys, jnp.asarray(iu[0]), jnp.asarray(iu[1]),
-            summary_statistic, null_batch_size), mesh)[:, :n_voxels]
-    else:
-        distribution = fetch_replicated(_boot_loo_map(
-            iscs_j, keys, summary_statistic,
-            null_batch_size), mesh)[:, :n_voxels]
+    engine = _null_engine(mesh, null_batch_size)
+    result = engine.run(
+        iscs, "subject_bootstrap", n_bootstraps,
+        statistic=summary_statistic, side=side,
+        seed=_resolve_seed(random_state), pairwise=pairwise,
+        observed=observed, center=observed,
+        return_distribution=return_distribution)
 
-    ci = (np.percentile(distribution, (100 - ci_percentile) / 2, axis=0),
-          np.percentile(distribution,
-                        ci_percentile + (100 - ci_percentile) / 2, axis=0))
-    shifted = distribution - observed
-    p = p_from_null(observed, shifted, side=side, exact=False, axis=0)
-    return observed, ci, p, distribution
+    if return_distribution:
+        distribution = result.distribution
+        ci = (np.percentile(distribution, (100 - ci_percentile) / 2,
+                            axis=0),
+              np.percentile(distribution,
+                            ci_percentile + (100 - ci_percentile) / 2,
+                            axis=0))
+        shifted = distribution - observed
+        p = p_from_null(observed, shifted, side=side, exact=False,
+                        axis=0)
+        return observed, ci, p, distribution
+    # accumulator mode: exceedance counts of (null - observed), i.e.
+    # exactly the Hall & Wilson shifted comparison, without the array
+    p = result.p_values(side=side, exact=False)
+    ci = result.ci(ci_percentile)
+    return observed, ci, p, None
 
 
 def _check_group_assignment(group_assignment, n_subjects):
@@ -703,14 +576,15 @@ def _check_group_assignment(group_assignment, n_subjects):
 def permutation_isc(iscs, group_assignment=None, pairwise=False,
                     summary_statistic='median', n_permutations=1000,
                     side='right', random_state=None, mesh=None,
-                    null_batch_size=64):
+                    null_batch_size=None, return_distribution=True):
     """Group-label permutation test for ISCs (reference isc.py:1057-1251).
 
     One group: sign-flipping (exact when 2**N <= n_permutations).  Two
     groups: group-assignment shuffling (exact when N! <= n_permutations).
     Returns (observed, p, distribution).
 
-    mesh / null_batch_size : see :func:`bootstrap_isc`.
+    mesh / null_batch_size / return_distribution : see
+    :func:`bootstrap_isc`.
     """
     iscs, n_subjects, n_voxels = _check_isc_input(iscs, pairwise=pairwise)
     if summary_statistic not in ('mean', 'median'):
@@ -724,141 +598,87 @@ def permutation_isc(iscs, group_assignment=None, pairwise=False,
         raise ValueError("This test is not valid for more than "
                          "2 groups! (got {0})".format(n_groups))
 
-    iscs_j = _shard_voxels(iscs, mesh, 1)
+    family = "sign_flip" if n_groups == 1 else "group_shuffle"
+    engine = _null_engine(mesh, null_batch_size)
+    result = engine.run(
+        iscs, family, n_permutations, statistic=summary_statistic,
+        side=side, seed=_resolve_seed(random_state), pairwise=pairwise,
+        group_assignment=group_assignment,
+        return_distribution=return_distribution)
 
-    if n_groups == 1:
-        observed = compute_summary_statistic(
-            iscs, summary_statistic=summary_statistic, axis=0)[np.newaxis, :]
-        exact = n_permutations >= 2 ** n_subjects
-
-        if exact:
-            n_permutations = 2 ** n_subjects
-            xs = jnp.asarray(list(product([-1.0, 1.0],
-                                          repeat=n_subjects)))
-        else:
-            xs = jax.random.split(
-                jax.random.PRNGKey(_resolve_seed(random_state)),
-                n_permutations)
-        if pairwise:
-            iu = np.triu_indices(n_subjects, k=1)
-            distribution = fetch_replicated(_perm_flip_pairwise_map(
-                iscs_j, xs, jnp.asarray(iu[0]), jnp.asarray(iu[1]),
-                summary_statistic, null_batch_size, not exact,
-                n_subjects), mesh)[:, :n_voxels]
-        else:
-            distribution = fetch_replicated(_perm_flip_loo_map(
-                iscs_j, xs, summary_statistic, null_batch_size,
-                not exact, n_subjects), mesh)[:, :n_voxels]
-    else:
-        group_selector = np.asarray(group_assignment)
-        labels_j = jnp.asarray(labels.astype(float))
-        exact = n_permutations >= math.factorial(n_subjects)
-        if exact:
-            n_permutations = math.factorial(n_subjects)
-            xs = jnp.asarray(list(permutations(np.arange(n_subjects))))
-        else:
-            xs = jax.random.split(
-                jax.random.PRNGKey(_resolve_seed(random_state)),
-                n_permutations)
-        if pairwise:
-            # Group label of each pair: valid only within-group;
-            # between-group pairs get NaN and are excluded from summaries.
-            sq_labels = np.full((n_subjects, n_subjects), np.nan)
-            for g in labels:
-                idx = np.where(group_selector == g)[0]
-                sq_labels[np.ix_(idx, idx)] = g
-            np.fill_diagonal(sq_labels, np.nan)
-            pair_labels = squareform(sq_labels, checks=False)
-
-            observed = fetch_replicated(_group_diff_stat(
-                iscs_j, jnp.asarray(pair_labels), labels_j,
-                summary_statistic), mesh)[:n_voxels]
-
-            iu = np.triu_indices(n_subjects, k=1)
-            distribution = fetch_replicated(_perm_group_pairwise_map(
-                iscs_j, jnp.asarray(sq_labels), labels_j,
-                jnp.asarray(iu[0]), jnp.asarray(iu[1]), xs,
-                summary_statistic, null_batch_size,
-                not exact), mesh)[:, :n_voxels]
-        else:
-            sel_j = jnp.asarray(group_selector)
-            observed = fetch_replicated(_group_diff_stat(
-                iscs_j, sel_j, labels_j, summary_statistic),
-                mesh)[:n_voxels]
-            distribution = fetch_replicated(_perm_group_loo_map(
-                iscs_j, sel_j, labels_j, xs, summary_statistic,
-                null_batch_size, not exact), mesh)[:, :n_voxels]
-
-    p = p_from_null(observed, distribution, side=side, exact=exact, axis=0)
-    return observed, p, distribution
+    observed = result.observed
+    if return_distribution:
+        distribution = result.distribution
+        p = p_from_null(observed, distribution, side=side,
+                        exact=result.exact, axis=0)
+        return observed, p, distribution
+    return observed, result.p_values(side=side), None
 
 
 def timeshift_isc(data, pairwise=False, summary_statistic='median',
                   n_shifts=1000, side='right', tolerate_nans=True,
-                  random_state=None, mesh=None, null_batch_size=16):
+                  random_state=None, mesh=None, null_batch_size=None,
+                  return_distribution=True):
     """Circular time-shift null for ISC (reference isc.py:1253-1410).
 
     Returns (observed, p, distribution).
-    mesh / null_batch_size : see :func:`bootstrap_isc`."""
+    mesh / null_batch_size / return_distribution : see
+    :func:`bootstrap_isc`."""
     data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
     data, mask = _threshold_nans(data, tolerate_nans)
-    n_kept = data.shape[1]
 
     observed = isc(data, pairwise=pairwise,
                    summary_statistic=summary_statistic,
                    tolerate_nans=tolerate_nans, mesh=mesh)
 
-    data_j = _shard_voxels(data, mesh, 1)
-    tol = bool(tolerate_nans)
+    engine = _null_engine(mesh, null_batch_size)
+    result = engine.run(
+        data, "circular_timeshift", n_shifts,
+        statistic=summary_statistic, side=side,
+        seed=_resolve_seed(random_state), pairwise=pairwise,
+        tolerate_nans=tolerate_nans, observed=observed,
+        return_distribution=return_distribution)
 
-    iu = np.triu_indices(n_subjects, k=1)
-    # loo: shift all subjects, correlate each against the UNSHIFTED
-    # others' mean.  The pairwise trace never reads ``others``; pass
-    # data_j as a free placeholder instead of computing dead LOO means.
-    others = data_j if pairwise else _loo_means_core(data_j, tol)
-    keys = jax.random.split(jax.random.PRNGKey(_resolve_seed(random_state)),
-                            n_shifts)
-    distribution = fetch_replicated(_timeshift_map(
-        data_j, others, keys, jnp.asarray(iu[0]), jnp.asarray(iu[1]),
-        summary_statistic, null_batch_size, bool(pairwise)),
-        mesh)[:, :n_kept]
-
-    observed, distribution = _reinsert_nan_voxels(
-        observed, distribution, mask, n_voxels)
-    p = p_from_null(observed, distribution, side=side, exact=False, axis=0)
-    return observed, p, distribution
+    if return_distribution:
+        observed, distribution = _reinsert_nan_voxels(
+            observed, result.distribution, mask, n_voxels)
+        p = p_from_null(observed, distribution, side=side, exact=False,
+                        axis=0)
+        return observed, p, distribution
+    observed, p = _reinsert_nan_p(
+        observed, result.p_values(side=side), mask, n_voxels, result.n)
+    return observed, p, None
 
 
 def phaseshift_isc(data, pairwise=False, summary_statistic='median',
                    n_shifts=1000, voxelwise=False, side='right',
                    tolerate_nans=True, random_state=None, mesh=None,
-                   null_batch_size=16):
+                   null_batch_size=None, return_distribution=True):
     """Phase-randomization null for ISC (reference isc.py:1410-1551).
 
     Returns (observed, p, distribution).
-    mesh / null_batch_size : see :func:`bootstrap_isc`."""
-    from .ops.stats import phase_randomize as phase_randomize_jax
-
+    mesh / null_batch_size / return_distribution : see
+    :func:`bootstrap_isc`."""
     data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
     data, mask = _threshold_nans(data, tolerate_nans)
-    n_kept = data.shape[1]
 
     observed = isc(data, pairwise=pairwise,
                    summary_statistic=summary_statistic,
                    tolerate_nans=tolerate_nans, mesh=mesh)
 
-    data_j = _shard_voxels(data, mesh, 1)
-    tol = bool(tolerate_nans)
-    iu = np.triu_indices(n_subjects, k=1)
-    others = data_j if pairwise else _loo_means_core(data_j, tol)
-    keys = jax.random.split(jax.random.PRNGKey(_resolve_seed(random_state)),
-                            n_shifts)
-    distribution = fetch_replicated(_phaseshift_map(
-        data_j, others, keys, jnp.asarray(iu[0]), jnp.asarray(iu[1]),
-        summary_statistic, null_batch_size, bool(pairwise),
-        bool(voxelwise)), mesh)[:, :n_kept]
+    engine = _null_engine(mesh, null_batch_size)
+    result = engine.run(
+        data, "phase_randomize", n_shifts, statistic=summary_statistic,
+        side=side, seed=_resolve_seed(random_state), pairwise=pairwise,
+        voxelwise=voxelwise, tolerate_nans=tolerate_nans,
+        observed=observed, return_distribution=return_distribution)
 
-    observed, distribution = _reinsert_nan_voxels(
-        observed, distribution, mask, n_voxels)
-    p = p_from_null(observed, distribution, side=side, exact=False, axis=0)
-    return observed, p, distribution
+    if return_distribution:
+        observed, distribution = _reinsert_nan_voxels(
+            observed, result.distribution, mask, n_voxels)
+        p = p_from_null(observed, distribution, side=side, exact=False,
+                        axis=0)
+        return observed, p, distribution
+    observed, p = _reinsert_nan_p(
+        observed, result.p_values(side=side), mask, n_voxels, result.n)
+    return observed, p, None

@@ -35,8 +35,11 @@ def phase_randomize(key, data, voxelwise=False):
     neg = n_TRs - pos
 
     shift_vox = n_voxels if voxelwise else 1
+    # dtype threaded from the input so an f32 program stays f32 even
+    # under x64 tracing (the uniform default would promote to f64)
     shifts = jax.random.uniform(
-        key, (n_pos, shift_vox, n_subjects)) * 2 * jnp.pi
+        key, (n_pos, shift_vox, n_subjects),
+        dtype=jnp.real(data).dtype) * 2 * jnp.pi
 
     f = jnp.fft.fft(data, axis=0)
     rot = jnp.exp(1j * shifts).astype(f.dtype)

@@ -471,11 +471,77 @@ class FCMAClassifierAdapter(ModelAdapter):
         return model
 
 
+class NullDistributionAdapter(ModelAdapter):
+    """Resampling-null summary (:class:`brainiak_tpu.stats.engine.
+    NullDistribution`) — the servable significance artifact.
+
+    Persists provenance (family, statistic, seed, side, exact), the
+    observed statistic, the FULL mergeable accumulator state (the
+    exact wire format of :meth:`NullAccumulator.to_state`, under
+    ``acc.``-prefixed keys), and the precomputed threshold table.
+    The materialized ``[n_total, V]`` distribution is deliberately
+    NOT persisted: the accumulator reproduces p-values bit-for-bit
+    from integer counts and its size is independent of
+    ``n_resamples`` — that O(K * V) bound is what makes population-
+    scale nulls a deployable artifact at all."""
+
+    kind = "null_distribution"
+
+    def model_class(self):
+        from ..stats.engine import NullDistribution
+        return NullDistribution
+
+    def pack(self, model):
+        self._fitted(model, "accumulator", "observed")
+        if model.accumulator is None:
+            raise ValueError("model is not fitted: accumulator is None")
+        out = {}
+        _put_scalar(out, "family", model.family)
+        _put_scalar(out, "statistic",
+                    "" if model.statistic is None
+                    else str(model.statistic))
+        # -1 encodes "no seed" (seeds are validated non-negative by
+        # the isc wrappers' _resolve_seed)
+        _put_scalar(out, "seed",
+                    -1 if model.seed is None else int(model.seed))
+        _put_scalar(out, "side", model.side)
+        _put_scalar(out, "exact", bool(model.exact))
+        out["observed"] = np.asarray(model.observed)
+        for key, arr in model.accumulator.to_state().items():
+            out[f"acc.{key}"] = np.asarray(arr)
+        keys = sorted(model.thresholds)
+        out["thr_keys"] = np.asarray(keys)
+        out["thr_values"] = np.asarray(
+            [float(model.thresholds[k]) for k in keys])
+        return out
+
+    def unpack(self, z):
+        from ..stats.accum import NullAccumulator
+        from ..stats.engine import NullDistribution
+        state = {key[len("acc."):]: np.asarray(z[key])
+                 for key in z if key.startswith("acc.")}
+        seed = int(_scalar(z, "seed"))
+        thresholds = {
+            str(k): float(v)
+            for k, v in zip(np.asarray(z["thr_keys"]).tolist(),
+                            np.asarray(z["thr_values"]).tolist())}
+        return NullDistribution(
+            family=_scalar(z, "family"),
+            statistic=_scalar(z, "statistic") or None,
+            seed=None if seed < 0 else seed,
+            side=_scalar(z, "side"),
+            exact=bool(_scalar(z, "exact")),
+            observed=np.asarray(z["observed"]),
+            accumulator=NullAccumulator.from_state(state),
+            thresholds=thresholds)
+
+
 #: kind -> adapter instance, in dispatch order.
 ADAPTERS = {a.kind: a for a in (
     SRMAdapter(), DetSRMAdapter(), RSRMAdapter(),
     EventSegmentAdapter(), IEM1DAdapter(), IEM2DAdapter(),
-    RidgeEncodingAdapter(), FCMAClassifierAdapter())}
+    RidgeEncodingAdapter(), FCMAClassifierAdapter(),
+    NullDistributionAdapter())}
 
 
 def detect_kind(model):

@@ -369,6 +369,49 @@ def _iem_trace_signature():
              "donate": (2,)}]
 
 
+@program_cache("serve.null_threshold")
+def _null_threshold_program(n_grid, n_vox, b_pad, mode, dtype):
+    """Served significance lookup against a persisted null artifact:
+    bucketed tail-count search.  ``grid`` is the ascending bucket-
+    representative axis (already side-transformed on host for the
+    artifact's ``mode``), ``tail[k, v]`` the per-voxel count of null
+    values in buckets ``>= k`` (with an appended all-zero row for
+    queries past the top bucket), so a batch of statistic maps
+    resolves to p-values with one searchsorted + gather — no null
+    array, no recompute, O(log K) per voxel.  p follows the
+    ``(count + 1) / (n + 1)`` convention; ``sig`` is the upper-tail
+    max-statistic FWER verdict (False wherever the artifact carries
+    no threshold, via NaN comparison)."""
+
+    @partial(jax.jit, donate_argnums=_donate(4))
+    def run(grid, tail, n_null, thr, x):
+        if mode == "left":
+            q = -x
+        elif mode == "two-sided":
+            q = jnp.abs(x)
+        else:
+            q = x
+        idx = jnp.searchsorted(grid, q, side="left")
+        counts = jnp.take_along_axis(tail, idx, axis=0)
+        p = (counts.astype(grid.dtype) + 1.0) / (n_null + 1.0)
+        sig = x >= thr
+        return p, sig
+
+    return obs_profile.profile_program(run, "serve.null_threshold",
+                                       span="serve.batch")
+
+
+@obs_runtime.trace_signature("serve.null_threshold")
+def _null_threshold_trace_signature():
+    k, v, b = 6, _TRACE_V, _TRACE_B
+    return [{"key": (k, v, b, "right", "float32"),
+             "args": (_serve_aval(k),
+                      _serve_aval(k + 1, v, dtype=jnp.int32),
+                      _serve_aval(), _serve_aval(),
+                      _serve_aval(b, v)),
+             "donate": (4,)}]
+
+
 # -- per-kind serve ops -----------------------------------------------
 
 class _ServeOp:
@@ -962,6 +1005,101 @@ class _FCMAPredictOp(_ServeOp):
         return [labels[i] for i in range(len(reqs))]
 
 
+class _NullThresholdOp(_ServeOp):
+    """Significance lookup against a ``null_distribution`` artifact:
+    a request is a statistic map ``[V]`` and the result is
+    ``(p [V], sig [V])`` — the bucketed-tail p-value at the
+    artifact's ``side`` and the upper-tail max-statistic FWER
+    verdict (``x >= thresholds['fwer_0.05']``; all-False when the
+    artifact carries no threshold).
+
+    The device tables are precomputed once from the accumulator's
+    ordered bucket histogram: p is accurate to the accumulator's
+    configured relative accuracy (the bucket width), with the exact
+    ``(count + 1) / (n + 1)`` convention on the bucketed counts.
+    Queries are vectorized over the batch lane, so a cohort of
+    subject maps screens in one dispatch."""
+
+    site = "serve.null_threshold"
+
+    def __init__(self, model, policy, mesh=None, device=None):
+        super().__init__(model, policy, mesh=mesh, device=device)
+        acc = model.accumulator
+        self.shape = tuple(acc.shape)
+        self.n_vox = int(np.prod(self.shape, dtype=np.int64)) or 1
+        self.dtype = np.asarray(model.observed).dtype
+        if self.dtype.kind != "f":
+            self.dtype = np.dtype(np.float64)
+        counts, values = acc._ordered_counts()
+        counts = counts.reshape(counts.shape[0], -1)
+        self.mode = model.side
+        if self.mode == "left":
+            # count(null <= x) == count(-null >= -x): negate + flip
+            grid = -values[::-1]
+            c = counts[::-1]
+        elif self.mode == "two-sided":
+            # magnitude axis: the near-zero bucket then |value|
+            # buckets, positive and negative halves folded together
+            k = (len(values) - 1) // 2
+            grid = np.concatenate([[0.0], values[k + 1:]])
+            c = np.concatenate(
+                [counts[k][None], counts[k + 1:] + counts[:k][::-1]],
+                axis=0)
+        else:
+            grid = values
+            c = counts
+        # tail[j] = count of buckets >= j, plus a zero row so a
+        # query past the top bucket gathers count 0 (p = 1/(n+1));
+        # int32 holds any realistic resample count per voxel
+        tail = np.concatenate(
+            [np.cumsum(c[::-1], axis=0)[::-1],
+             np.zeros((1, c.shape[1]), dtype=np.int64)], axis=0)
+        self.n_grid = len(grid)
+        self.grid = self._place(np.asarray(grid, dtype=self.dtype))
+        self.tail = self._place(tail.astype(np.int32))
+        self.n_null = self._place(
+            np.asarray(acc.n, dtype=self.dtype))
+        self.thr = self._place(np.asarray(
+            model.thresholds.get("fwer_0.05", float("nan")),
+            dtype=self.dtype))
+
+    def validate(self, req):
+        # accept any layout of the artifact's voxel extent: the
+        # observed map itself may carry a leading length-1 axis
+        # (the one-sample permutation convention) and dispatch
+        # flattens anyway
+        x = np.asarray(req.x)
+        if x.size != self.n_vox:
+            return ("invalid_shape",
+                    f"expected statistic map {self.shape}, got "
+                    f"{x.shape}")
+        return self._check_finite(x)
+
+    def bucket_key(self, req):
+        # every query has the artifact's fixed voxel extent; the
+        # only bucketed axis is the batch lane
+        return ()
+
+    def padded_elements(self, key, b_pad):
+        return b_pad * self.n_vox
+
+    def dispatch(self, reqs, key, b_pad):
+        x = np.zeros((b_pad, self.n_vox), dtype=self.dtype)
+        for i, req in enumerate(reqs):
+            x[i] = np.asarray(req.x, dtype=self.dtype).reshape(-1)
+        p, sig = self.run_program(
+            _null_threshold_program,
+            (self.n_grid, self.n_vox, b_pad, self.mode,
+             str(self.dtype)),
+            (self.grid, self.tail, self.n_null, self.thr,
+             jnp.asarray(x)))
+        p = np.asarray(p)
+        sig = np.asarray(sig)
+        return [(np.array(p[i]).reshape(self.shape),
+                 np.array(sig[i]).reshape(self.shape))
+                for i in range(len(reqs))]
+
+
 _KIND_OPS = {
     "srm": _SRMFamilyOp,
     "detsrm": _SRMFamilyOp,
@@ -970,6 +1108,7 @@ _KIND_OPS = {
     "iem1d": _IEM1DOp,
     "ridge_encoding": _RidgeEncodingOp,
     "fcma": _FCMAPredictOp,
+    "null_distribution": _NullThresholdOp,
 }
 
 

@@ -1,0 +1,55 @@
+"""Resampling-statistics engine: surrogate families, mergeable null
+accumulators, and the chunked/resumable/poolable :class:`NullEngine`.
+
+Submodules
+----------
+- :mod:`.pvalues`    p-value / summary-statistic conventions (NumPy-only)
+- :mod:`.surrogates` surrogate-family registry + vmapped programs
+- :mod:`.accum`      mergeable null accumulators (counts/moments/quantiles)
+- :mod:`.engine`     :class:`NullEngine` + :class:`NullDistribution`
+
+Attribute access is lazy (PEP 562): importing
+``brainiak_tpu.stats.pvalues`` alone never pulls in jax, so the host
+shims in ``utils.utils`` stay light.
+"""
+
+__all__ = [
+    "FAMILIES",
+    "NullAccumulator",
+    "NullDistribution",
+    "NullEngine",
+    "TRANSFORMS",
+    "compute_summary_statistic",
+    "default_null_batch",
+    "fdr_threshold",
+    "make_spec",
+    "p_from_null",
+    "stats_budget_bytes",
+]
+
+_EXPORTS = {
+    "FAMILIES": ".surrogates",
+    "TRANSFORMS": ".surrogates",
+    "make_spec": ".surrogates",
+    "NullAccumulator": ".accum",
+    "fdr_threshold": ".accum",
+    "NullDistribution": ".engine",
+    "NullEngine": ".engine",
+    "default_null_batch": ".engine",
+    "stats_budget_bytes": ".engine",
+    "compute_summary_statistic": ".pvalues",
+    "p_from_null": ".pvalues",
+}
+
+
+def __getattr__(name):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            "module {!r} has no attribute {!r}".format(__name__, name))
+    from importlib import import_module
+    return getattr(import_module(target, __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

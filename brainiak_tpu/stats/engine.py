@@ -1,0 +1,342 @@
+"""Population-scale resampling-statistics engine.
+
+:class:`NullEngine` drives thousands of null resamples per dispatch
+through the :mod:`.surrogates` family programs, folding each chunk
+into the mergeable :class:`~brainiak_tpu.stats.accum.NullAccumulator`
+instead of materializing the ``[n_resamples, V]`` null (unless the
+small-N ``return_distribution=True`` path asks for it).  The null
+axis is chunked whenever ``n_resamples * V`` exceeds the device
+budget (``BRAINIAK_TPU_STATS_BUDGET_BYTES``), the loop runs under
+:func:`~brainiak_tpu.resilience.guards.run_resilient_loop` so a
+preempted run resumes at the last completed null chunk
+(fingerprint = data digest + family + seed + grid), and every chunk
+emits a ``stats.chunk`` span plus ``stats_surrogates_total``.
+
+Disjoint-range pooling: two runs over disjoint ``index_range``s of
+the SAME (data, family, seed) slice the same key schedule, so their
+:class:`NullDistribution` results ``merge()`` to exactly the
+single-run verdict — across the serialized wire format.
+"""
+
+import logging
+import os
+import zlib
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from ..resilience.guards import array_digest, run_resilient_loop
+from .accum import NullAccumulator
+from .surrogates import FAMILIES, make_spec
+
+__all__ = [
+    "DEFAULT_BUDGET_BYTES",
+    "NullDistribution",
+    "NullEngine",
+    "default_null_batch",
+    "stats_budget_bytes",
+]
+
+logger = logging.getLogger(__name__)
+
+#: default per-run materialization budget (bytes) when
+#: ``BRAINIAK_TPU_STATS_BUDGET_BYTES`` is unset: 256 MiB.
+DEFAULT_BUDGET_BYTES = 1 << 28
+
+#: state leaves that are NaN-bearing by design (uncovered resample
+#: slots, NaN voxel columns) and must skip the non-finite guard.
+_NAN_LEAVES = ("observed", "center", "max_stat", "dist")
+
+
+def stats_budget_bytes():
+    """The configured null-materialization budget in bytes."""
+    return int(os.environ.get("BRAINIAK_TPU_STATS_BUDGET_BYTES",
+                              DEFAULT_BUDGET_BYTES))
+
+
+def default_null_batch(n_elements=None):
+    """The unified ``null_batch_size`` default, sized from the device
+    budget.
+
+    ``n_elements`` is the per-resample working-set element count (the
+    ISC stack's ``V`` for the ISC-resampling families, ``T * V * S``
+    for the shift families).  The batch is the largest power of two
+    whose f32 working set (``batch * n_elements * 4`` bytes) stays
+    within 1/8 of :func:`stats_budget_bytes`, clamped to [16, 64] —
+    reproducing the old per-function defaults (64 for cheap ISC
+    resamples, 16 for the heavy shift families) from one rule.
+    """
+    if n_elements is None:
+        return 64
+    budget = stats_budget_bytes()
+    per_resample = 4 * max(1, int(n_elements))
+    lanes = budget // (8 * per_resample)
+    if lanes >= 64:
+        return 64
+    if lanes <= 16:
+        return 16
+    return 1 << (int(lanes).bit_length() - 1)
+
+
+def _chunk_length(n_voxels, batch, budget):
+    """Resamples per chunk: the materialized per-chunk null block
+    ``[chunk, V]`` (one f32 device copy + one f64 host copy + integer
+    accumulator updates) is held to the budget, rounded down to a
+    whole number of ``batch``-size dispatch lanes."""
+    per_resample = 16 * max(1, int(n_voxels))
+    chunk = int(budget) // per_resample
+    chunk = (chunk // batch) * batch
+    return max(batch, chunk)
+
+
+class NullDistribution:
+    """The engine's result: observed statistic + mergeable null
+    summary, and the persistable ``serve_kind="null_distribution"``
+    artifact (:mod:`brainiak_tpu.serve.artifacts`).
+
+    ``distribution`` is the materialized ``[n_total, V]`` null (rows
+    outside the run's covered index range are NaN) when the run asked
+    for ``return_distribution=True``; ``None`` otherwise.
+    """
+
+    def __init__(self, family, statistic, seed, side, exact,
+                 observed, accumulator, distribution=None,
+                 thresholds=None):
+        self.family = family
+        self.statistic = statistic
+        self.seed = None if seed is None else int(seed)
+        self.side = side
+        self.exact = bool(exact)
+        self.observed = np.asarray(observed)
+        self.accumulator = accumulator
+        self.distribution = distribution
+        self.thresholds = dict(thresholds or {})
+
+    @property
+    def n_total(self):
+        return self.accumulator.n_total
+
+    @property
+    def n(self):
+        return self.accumulator.n
+
+    @property
+    def complete(self):
+        return self.accumulator.complete
+
+    def p_values(self, side=None, exact=None):
+        return self.accumulator.p_values(
+            side=self.side if side is None else side,
+            exact=self.exact if exact is None else exact)
+
+    def ci(self, ci_percentile=95):
+        return self.accumulator.ci(ci_percentile)
+
+    def fwer_threshold(self, alpha=0.05):
+        return self.accumulator.fwer_threshold(alpha)
+
+    def fdr_threshold(self, alpha=0.05):
+        return self.accumulator.fdr_threshold(
+            alpha, side=self.side, exact=self.exact)
+
+    def compute_thresholds(self, alphas=(0.05, 0.01)):
+        """Precompute FWER/FDR thresholds (stored on the artifact so
+        the served lookup never re-derives them)."""
+        for alpha in alphas:
+            self.thresholds["fwer_{:g}".format(alpha)] = \
+                self.fwer_threshold(alpha)
+            self.thresholds["fdr_{:g}".format(alpha)] = \
+                self.fdr_threshold(alpha)
+        return self.thresholds
+
+    def merge(self, other):
+        """Pool a disjoint-range run into this one, in place —
+        counts, histograms, and max-statistic slots merge exactly
+        (see :meth:`NullAccumulator.merge`)."""
+        if (self.family, self.statistic, self.seed, self.side,
+                self.exact) != (other.family, other.statistic,
+                                other.seed, other.side, other.exact):
+            raise ValueError("cannot merge null distributions from "
+                             "different runs")
+        before = self.accumulator.covered.astype(bool).copy()
+        self.accumulator.merge(other.accumulator)
+        if self.distribution is not None:
+            if other.distribution is None:
+                self.distribution = None
+            else:
+                rows = other.accumulator.covered.astype(bool) & ~before
+                self.distribution[rows] = other.distribution[rows]
+        return self
+
+
+class NullEngine:
+    """Chunked, resumable, poolable null-distribution runner.
+
+    Parameters
+    ----------
+    mesh : optional Mesh with a ``'voxel'`` axis — surrogate programs
+        run voxel-sharded (the ``_shard_voxels`` placement idiom).
+    null_batch_size : resamples per ``lax.map`` dispatch lane inside a
+        chunk; default :func:`default_null_batch`.
+    budget_bytes : override of ``BRAINIAK_TPU_STATS_BUDGET_BYTES``.
+    """
+
+    def __init__(self, mesh=None, null_batch_size=None,
+                 budget_bytes=None):
+        self.mesh = mesh
+        self.null_batch_size = null_batch_size
+        self.budget_bytes = (stats_budget_bytes()
+                             if budget_bytes is None
+                             else int(budget_bytes))
+
+    def run(self, data, family, n_resamples, statistic='median', *,
+            side='right', seed=0, pairwise=False,
+            group_assignment=None, voxelwise=False, tolerate_nans=True,
+            observed=None, center=None, index_range=None,
+            return_distribution=False, checkpoint_dir=None,
+            checkpoint_every=1, quantile_accuracy=None):
+        """Evaluate ``n_resamples`` nulls of ``family`` over ``data``.
+
+        ``observed`` defaults to the family's own observed statistic;
+        ``center`` (e.g. the observed value, for the Hall & Wilson
+        bootstrap shift) is subtracted from every null before
+        exceedance counting.  ``index_range=(lo, hi)`` restricts this
+        run to a slice of the global resample index space — the
+        pooling hook: disjoint-range results ``merge()`` exactly.
+        ``checkpoint_dir`` / ``checkpoint_every`` (in chunks) persist
+        the accumulator so a preempted run resumes at the last
+        completed null chunk.
+        """
+        if family not in FAMILIES:
+            raise ValueError(
+                "Unknown surrogate family {!r}; registered families: "
+                "{}".format(family, ", ".join(FAMILIES)))
+        spec = make_spec(
+            family, data, statistic=statistic,
+            n_resamples=n_resamples, seed=seed, pairwise=pairwise,
+            group_assignment=group_assignment, voxelwise=voxelwise,
+            tolerate_nans=tolerate_nans, mesh=self.mesh,
+            null_batch_size=self.null_batch_size)
+        n_total = spec.n_total
+        lo, hi = (0, n_total) if index_range is None else (
+            int(index_range[0]), int(index_range[1]))
+        if not 0 <= lo < hi <= n_total:
+            raise ValueError("index_range {} outside [0, {}]".format(
+                (lo, hi), n_total))
+
+        if observed is None:
+            observed = spec.compute_observed()
+        observed = np.asarray(observed)
+
+        batch = (self.null_batch_size
+                 if self.null_batch_size is not None
+                 else default_null_batch(spec.n_voxels))
+        chunk_len = _chunk_length(spec.n_voxels, batch,
+                                  self.budget_bytes)
+        # never pad past the requested range: one whole-range chunk
+        # (rounded up to full dispatch lanes) is the floor
+        chunk_len = min(chunk_len, -(-(hi - lo) // batch) * batch)
+        n_chunks = -(-(hi - lo) // chunk_len)
+        acc_kwargs = {}
+        if quantile_accuracy is not None:
+            acc_kwargs["quantile_accuracy"] = float(quantile_accuracy)
+
+        # Materialize the null at a dtype that stores the compiled
+        # program's values EXACTLY (f64 under x64, f32 on device):
+        # a lossy cast would let a tie round across ``observed`` and
+        # flip an exceedance count between the counted and the
+        # materialized p-map, and in exact enumeration would drop
+        # the identity resample's self-tie (the p >= 1/n guarantee).
+        dist_dtype = np.result_type(np.asarray(observed).dtype,
+                                    np.float32)
+
+        def fresh_state():
+            acc = NullAccumulator(observed, n_total, center=center,
+                                  shape=(spec.n_voxels,), **acc_kwargs)
+            state = acc.to_state()
+            if return_distribution:
+                state["dist"] = np.full(
+                    (n_total, spec.n_voxels), np.nan,
+                    dtype=dist_dtype)
+            return acc, state
+
+        acc0, init_state = fresh_state()
+        fingerprint = self._fingerprint(
+            data, spec, statistic, seed, lo, hi, chunk_len, batch)
+
+        carry = {}
+
+        def run_chunk(state, step, n_steps):
+            if carry.get("step") == step:
+                acc = carry["acc"]
+                dist = carry.get("dist")
+            else:
+                acc = NullAccumulator.from_state(state)
+                # host-to-host copy (state leaves are numpy, fresh or
+                # checkpoint-restored) so rollback keeps the prior
+                # chunk's rows; astype(copy=True) rather than
+                # np.array to keep the chunk body sync-free (JX002)
+                dist = (state["dist"].astype(dist_dtype, copy=True)
+                        if return_distribution else None)
+            for i in range(step, step + n_steps):
+                c_lo = lo + i * chunk_len
+                c_hi = min(c_lo + chunk_len, hi)
+                xs_chunk = spec.xs[c_lo:c_hi]
+                pad = chunk_len - (c_hi - c_lo)
+                if pad:
+                    # pad to the compiled chunk extent (one program
+                    # per family); pad rows are sliced off below
+                    xs_chunk = np.concatenate(
+                        [xs_chunk,
+                         np.repeat(xs_chunk[:1], pad, axis=0)])
+                with obs_spans.span(
+                        "stats.chunk",
+                        attrs={"family": family, "lo": c_lo,
+                               "hi": c_hi}):
+                    values = spec.run(xs_chunk)[:c_hi - c_lo]
+                acc.update(values, (c_lo, c_hi))
+                if dist is not None:
+                    dist[c_lo:c_hi] = values
+                obs_metrics.counter(
+                    "stats_surrogates_total",
+                    help="null surrogates evaluated").inc(
+                        c_hi - c_lo, family=family)
+            new_state = acc.to_state()
+            if dist is not None:
+                new_state["dist"] = dist
+            carry["step"] = step + n_steps
+            carry["acc"] = acc
+            carry["dist"] = dist
+            return new_state, False
+
+        state, _ = run_resilient_loop(
+            run_chunk, init_state, n_chunks,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            fingerprint=fingerprint, name="stats",
+            guard_skip=_NAN_LEAVES)
+
+        acc = NullAccumulator.from_state(state)
+        dist = (np.array(state["dist"], dtype=dist_dtype)
+                if return_distribution else None)
+        result = NullDistribution(
+            family, statistic, seed, side, spec.exact, observed, acc,
+            distribution=dist)
+        if result.complete:
+            result.compute_thresholds()
+        return result
+
+    @staticmethod
+    def _fingerprint(data, spec, statistic, seed, lo, hi, chunk_len,
+                     batch):
+        flat = np.nan_to_num(np.asarray(data, dtype=float))
+        return np.asarray([
+            array_digest(flat),
+            array_digest(np.asarray(spec.xs, dtype=float)),
+            float(zlib.crc32(spec.family.encode())),
+            float(zlib.crc32(str(statistic).encode())),
+            float(-1 if seed is None else int(seed)),
+            float(spec.n_total), float(lo), float(hi),
+            float(chunk_len), float(batch),
+        ], dtype=float)

@@ -1,0 +1,126 @@
+"""CI selfcheck for the resampling-statistics engine (STA001 gate).
+
+Run as a subprocess child by ``tools/run_checks.py`` on the 8-device
+CPU mesh: proves (1) count-vs-materialized parity — the accumulator's
+integer exceedance counts reproduce ``p_from_null`` on the
+materialized distribution bit-for-bit, (2) chunk invariance — a
+starved ``BRAINIAK_TPU_STATS_BUDGET_BYTES`` run (many small chunks)
+returns the bitwise-identical null to a one-chunk run, (3) pooling —
+two disjoint half-range runs, each round-tripped through a DIFFERENT
+wire format (JSON hex-floats / npz), ``merge()`` to EXACTLY the full
+run's verdicts, (4) resume — an injected preemption mid-run, then a
+resumed run that reproduces the uninterrupted p-map bitwise, and (5)
+retrace stability: all of the above reuses ONE compiled program per
+``stats.*`` builder key (every counted site stays at <= 1 trace).
+"""
+
+import numpy as np
+
+__all__ = ["selfcheck"]
+
+
+def selfcheck(out=None):
+    """Prints a JSON verdict; returns 0 on pass, 1 on failure."""
+    import json
+    import os
+    import sys
+    import tempfile
+
+    from ..obs import metrics as obs_metrics
+    from ..resilience import faults
+    from .accum import NullAccumulator
+    from .engine import NullEngine
+    from .pvalues import p_from_null
+
+    stream = out or sys.stdout
+    rng = np.random.RandomState(0)
+    # 8 subjects x 5 voxels of ISC-scale values; 64 resamples over a
+    # 16-lane batch so the starved-budget run below spans 4 chunks.
+    iscs = 0.2 + 0.1 * rng.randn(8, 5)
+    n_resamples, batch = 64, 16
+    run_kwargs = dict(statistic="median", side="two-sided", seed=3)
+
+    errs = []
+    merge_ok = True
+    resume_ok = True
+
+    # (1) count-vs-materialized parity: accumulator counts must
+    # reproduce p_from_null on the materialized null bit-for-bit
+    engine = NullEngine(null_batch_size=batch)
+    full = engine.run(iscs, "sign_flip", n_resamples,
+                      return_distribution=True, **run_kwargs)
+    p_ref = p_from_null(full.observed, full.distribution,
+                        side="two-sided", exact=full.exact, axis=0)
+    errs.append(float(np.max(np.abs(full.p_values() - p_ref))))
+
+    # (2) chunk invariance: a starved budget (chunk == one dispatch
+    # lane, 4 chunks here) must return the bitwise-identical null
+    starved = NullEngine(null_batch_size=batch, budget_bytes=1)
+    small = starved.run(iscs, "sign_flip", n_resamples,
+                        return_distribution=True, **run_kwargs)
+    chunk_exact = (
+        np.array_equal(small.distribution, full.distribution,
+                       equal_nan=True)
+        and np.array_equal(small.p_values(), full.p_values()))
+    errs.append(0.0 if chunk_exact else float(np.max(np.abs(
+        np.nan_to_num(small.distribution)
+        - np.nan_to_num(full.distribution)))))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # (3) pooling: disjoint half-ranges, each through a different
+        # wire format, merge to EXACTLY the full run
+        half = n_resamples // 2
+        lo_half = engine.run(iscs, "sign_flip", n_resamples,
+                             index_range=(0, half), **run_kwargs)
+        hi_half = engine.run(iscs, "sign_flip", n_resamples,
+                             index_range=(half, n_resamples),
+                             **run_kwargs)
+        acc_a = NullAccumulator.from_json(
+            lo_half.accumulator.to_json())
+        npz = os.path.join(tmp, "half.npz")
+        hi_half.accumulator.save(npz)
+        acc_b = NullAccumulator.load(npz)
+        merged = acc_a.merge(acc_b)
+        ref = full.accumulator
+        merge_ok = (
+            merged.complete
+            and np.array_equal(merged.p_values(side="two-sided"),
+                               ref.p_values(side="two-sided"))
+            and np.array_equal(merged.quantile(0.975),
+                               ref.quantile(0.975))
+            and merged.fwer_threshold() == ref.fwer_threshold())
+
+        # (4) resume at the last completed chunk after an injected
+        # preemption; the resumed p-map must be bitwise identical
+        ckpt = os.path.join(tmp, "ckpt")
+        try:
+            with faults.inject("preempt", at_step=2):
+                starved.run(iscs, "sign_flip", n_resamples,
+                            checkpoint_dir=ckpt, **run_kwargs)
+            resume_ok = False  # the fault must fire
+        except faults.PreemptionError:
+            pass
+        resumed = starved.run(iscs, "sign_flip", n_resamples,
+                              checkpoint_dir=ckpt, **run_kwargs)
+        if not np.array_equal(resumed.p_values(), full.p_values()):
+            resume_ok = False
+
+    # (5) retrace stability: one compiled program per builder key —
+    # every run above shares (stat, batch, sampled, n_subjects,
+    # pairwise), so each counted stats.* site must read <= 1
+    retrace = obs_metrics.counter("retrace_total")
+    sites = {}
+    for labels, value in retrace.samples():
+        site = labels.get("site", "")
+        if site.startswith("stats."):
+            sites[site] = value
+    tol = 0.0
+    ok = (max(errs) <= tol and merge_ok and resume_ok
+          and all(c <= 1.0 for c in sites.values())
+          and "stats.sign_flip" in sites)
+    json.dump({"ok": bool(ok), "max_err": max(errs), "tol": tol,
+               "merge_ok": bool(merge_ok),
+               "resume_ok": bool(resume_ok), "retraces": sites},
+              stream)
+    stream.write("\n")
+    return 0 if ok else 1

@@ -1,0 +1,622 @@
+"""Surrogate-family registry: whole null families as ONE vmapped program.
+
+Each family is a pure jittable transform ``(key, data) -> surrogate``
+(or index-resample) listed in :data:`TRANSFORMS`, and a compiled
+program that evaluates an entire chunk of surrogates in one dispatch
+(``lax.map`` over split PRNG keys — or over enumerated resamples for
+exact tests).  Program builders are ``counted_cache``'d under
+``stats.*`` sites with ``trace_signature`` factories, so the JPR001
+IR audit covers every family and ``retrace_total{site=stats.*}``
+stays <= 1 per family across repeat runs.
+
+Voxel sharding rides on input placement: the engine places inputs via
+the ``_shard_voxels`` idiom (``brainiak_tpu.isc``), and every program
+here is voxelwise-independent, so XLA partitions the whole map with
+no collectives.
+
+The statistic compositions are verbatim the pre-refactor ``isc.py``
+null maps (bit-for-bit parity at matched seeds is load-bearing: the
+four ``*_isc`` resampling entry points now route through these
+programs).
+"""
+
+import math
+from itertools import permutations, product
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import profile as obs_profile
+from ..obs import runtime as obs_runtime
+from ..ops.stats import phase_randomize as _phase_randomize_jax
+
+__all__ = [
+    "FAMILIES",
+    "TRANSFORMS",
+    "NullSpec",
+    "make_spec",
+    "sign_flip",
+    "group_shuffle",
+    "subject_bootstrap",
+    "circular_timeshift",
+    "phase_randomize",
+]
+
+#: the registered surrogate families, in registry order.
+FAMILIES = ("sign_flip", "group_shuffle", "subject_bootstrap",
+            "circular_timeshift", "phase_randomize")
+
+#: families whose input is an ISC matrix (vs a [T, V, S] time series).
+ISC_INPUT_FAMILIES = ("sign_flip", "group_shuffle", "subject_bootstrap")
+
+
+# ---------------------------------------------------------------------------
+# pure per-surrogate transforms (the registry's canonical forms)
+
+def sign_flip(key, iscs):
+    """Random per-subject sign flips applied to an [S, V] ISC stack."""
+    flips = jax.random.choice(
+        key, jnp.asarray([-1.0, 1.0], dtype=iscs.dtype),
+        (iscs.shape[0],))
+    return iscs * flips[:, None]
+
+
+def group_shuffle(key, selector):
+    """Random permutation of a per-subject group-label vector."""
+    return selector[jax.random.permutation(key, selector.shape[0])]
+
+
+def subject_bootstrap(key, n_subjects):
+    """With-replacement subject index resample (an index-resample
+    family: the gather happens inside the statistic program)."""
+    return jax.random.choice(key, n_subjects, (n_subjects,))
+
+
+def circular_timeshift(key, data):
+    """Independent circular time shift per subject of [T, V, S] data."""
+    n_trs, _, n_subjects = data.shape
+    shifts = jax.random.choice(key, n_trs, (n_subjects,))
+    return jax.vmap(
+        lambda s, shift: jnp.roll(s, shift, axis=0),
+        in_axes=(2, 0), out_axes=2)(data, shifts)
+
+
+def phase_randomize(key, data, voxelwise=False):
+    """Phase randomization preserving power spectra
+    (:func:`brainiak_tpu.ops.stats.phase_randomize`)."""
+    return _phase_randomize_jax(key, data, voxelwise=voxelwise)
+
+
+TRANSFORMS = {
+    "sign_flip": sign_flip,
+    "group_shuffle": group_shuffle,
+    "subject_bootstrap": subject_bootstrap,
+    "circular_timeshift": circular_timeshift,
+    "phase_randomize": phase_randomize,
+}
+
+
+# ---------------------------------------------------------------------------
+# shared statistic helpers (traced inside the family programs)
+
+def _nanmedian(x, axis=0):
+    """NaN-excluding median in the INPUT dtype.  ``jnp.nanmedian``
+    routes through ``nanquantile``, whose rank-interpolation
+    constants are float64 under x64 — a dtype-promotion leak in
+    every f32 surrogate program (JP301).  Sorting with NaNs pushed
+    to +inf and averaging the two middle ranks is the same
+    arithmetic as ``np.median``'s ``(a + b) / 2``."""
+    x = jnp.moveaxis(x, axis, 0)
+    valid = ~jnp.isnan(x)
+    count = jnp.sum(valid, axis=0, dtype=jnp.int32)
+    ordered = jnp.sort(jnp.where(valid, x, jnp.inf), axis=0)
+    hi_rank = count // 2
+    lo_rank = jnp.maximum(count - 1, 0) // 2
+    lo = jnp.take_along_axis(ordered, lo_rank[None], axis=0)[0]
+    hi = jnp.take_along_axis(ordered, hi_rank[None], axis=0)[0]
+    return jnp.where(count > 0, (lo + hi) / 2.0, jnp.nan)
+
+
+def _jnp_summary(iscs, summary_statistic, axis=0):
+    if summary_statistic == 'mean':
+        return jnp.tanh(jnp.nanmean(jnp.arctanh(iscs), axis=axis))
+    return _nanmedian(iscs, axis=axis)
+
+
+def _group_diff_stat(iscs_j, sel, labels_j, stat):
+    """summary(group0) - summary(group1) for per-row labels ``sel``
+    (rows labeled NaN are excluded from both summaries).  Single source
+    of the two-group statistic for BOTH the observed value and the
+    permutation nulls."""
+    s0 = _jnp_summary(
+        jnp.where((sel == labels_j[0])[:, None], iscs_j, jnp.nan),
+        stat, axis=0)
+    s1 = _jnp_summary(
+        jnp.where((sel == labels_j[1])[:, None], iscs_j, jnp.nan),
+        stat, axis=0)
+    return s0 - s1
+
+
+# ---------------------------------------------------------------------------
+# family program builders (one compiled vmapped program per family)
+
+@obs_runtime.counted_cache("stats.subject_bootstrap")
+def subject_bootstrap_program(stat, batch, pairwise):
+    """Subject-wise bootstrap null chunk: [n_keys] -> [n_keys, V]."""
+    if pairwise:
+        def run(sq_j, keys, iu0, iu1):
+            n_subj = sq_j.shape[0]
+
+            def one(key):
+                sample = jnp.sort(subject_bootstrap(key, n_subj))
+                resq = sq_j[sample][:, sample]
+                same = sample[:, None] == sample[None, :]
+                resq = jnp.where(same[..., None], jnp.nan, resq)
+                return _jnp_summary(resq[iu0, iu1], stat, axis=0)
+
+            return jax.lax.map(one, keys, batch_size=batch)
+    else:
+        def run(iscs_j, keys):
+            n_subj = iscs_j.shape[0]
+
+            def one(key):
+                sample = subject_bootstrap(key, n_subj)
+                return _jnp_summary(iscs_j[sample], stat, axis=0)
+
+            return jax.lax.map(one, keys, batch_size=batch)
+
+    return obs_profile.profile_program(
+        jax.jit(run), "stats.subject_bootstrap", span="stats.chunk")
+
+
+@obs_runtime.counted_cache("stats.sign_flip")
+def sign_flip_program(stat, batch, sampled, n_subjects, pairwise):
+    """One-group sign-flip permutation null chunk.  ``xs`` is split
+    keys when ``sampled`` else the enumerated [-1, 1]^S flip matrix."""
+    if pairwise:
+        def run(iscs_j, xs, iu0, iu1):
+            def apply_flips(flips):
+                pairflip = flips[iu0] * flips[iu1]
+                return _jnp_summary(iscs_j * pairflip[:, None], stat,
+                                    axis=0)
+
+            if sampled:
+                def one(key):
+                    flips = jax.random.choice(
+                        key,
+                        jnp.asarray([-1.0, 1.0], dtype=iscs_j.dtype),
+                        (n_subjects,))
+                    return apply_flips(flips)
+
+                return jax.lax.map(one, xs, batch_size=batch)
+            return jax.lax.map(apply_flips, xs, batch_size=batch)
+    else:
+        def run(iscs_j, xs):
+            def apply_flips(flips):
+                return _jnp_summary(iscs_j * flips[:, None], stat,
+                                    axis=0)
+
+            if sampled:
+                def one(key):
+                    flips = jax.random.choice(
+                        key,
+                        jnp.asarray([-1.0, 1.0], dtype=iscs_j.dtype),
+                        (n_subjects,))
+                    return apply_flips(flips)
+
+                return jax.lax.map(one, xs, batch_size=batch)
+            return jax.lax.map(apply_flips, xs, batch_size=batch)
+
+    return obs_profile.profile_program(
+        jax.jit(run), "stats.sign_flip", span="stats.chunk")
+
+
+@obs_runtime.counted_cache("stats.group_shuffle")
+def group_shuffle_program(stat, batch, sampled, pairwise):
+    """Two-group label-shuffle permutation null chunk.  ``xs`` is
+    split keys when ``sampled`` else enumerated permutations."""
+    if pairwise:
+        def run(iscs_j, sq_labels_j, labels_j, iu0, iu1, xs):
+            def permute_stat(perm):
+                shuffled = sq_labels_j[perm][:, perm]
+                return _group_diff_stat(iscs_j, shuffled[iu0, iu1],
+                                        labels_j, stat)
+
+            n_subjects = sq_labels_j.shape[0]
+            if sampled:
+                def one(key):
+                    return permute_stat(
+                        jax.random.permutation(key, n_subjects))
+
+                return jax.lax.map(one, xs, batch_size=batch)
+            return jax.lax.map(permute_stat, xs, batch_size=batch)
+    else:
+        def run(iscs_j, sel_j, labels_j, xs):
+            n_subjects = sel_j.shape[0]
+            if sampled:
+                def one(key):
+                    return _group_diff_stat(
+                        iscs_j, group_shuffle(key, sel_j), labels_j,
+                        stat)
+
+                return jax.lax.map(one, xs, batch_size=batch)
+            return jax.lax.map(
+                lambda perm: _group_diff_stat(iscs_j, sel_j[perm],
+                                              labels_j, stat),
+                xs, batch_size=batch)
+
+    return obs_profile.profile_program(
+        jax.jit(run), "stats.group_shuffle", span="stats.chunk")
+
+
+@obs_runtime.counted_cache("stats.circular_timeshift")
+def circular_timeshift_program(stat, batch, pairwise):
+    """Circular time-shift null chunk over [T, V, S] data.  ``others``
+    is the unshifted leave-one-out means (loo mode; unread in the
+    pairwise trace — callers pass the data as a free placeholder)."""
+    def run(data_j, others, keys, iu0, iu1):
+        from ..isc import _columnwise_corr, _isc_pairwise_core
+
+        def one_shift(key):
+            rolled = circular_timeshift(key, data_j)
+            if pairwise:
+                corr = _isc_pairwise_core(rolled)
+                return _jnp_summary(corr[iu0, iu1, :], stat, axis=0)
+            return _jnp_summary(_columnwise_corr(rolled, others), stat,
+                                axis=0)
+
+        return jax.lax.map(one_shift, keys, batch_size=batch)
+
+    return obs_profile.profile_program(
+        jax.jit(run), "stats.circular_timeshift", span="stats.chunk")
+
+
+@obs_runtime.counted_cache("stats.phase_randomize")
+def phase_randomize_program(stat, batch, pairwise, voxelwise):
+    """Phase-randomization null chunk over [T, V, S] data."""
+    def run(data_j, others, keys, iu0, iu1):
+        from ..isc import _columnwise_corr, _isc_pairwise_core
+
+        def one_shift(key):
+            shifted = phase_randomize(key, data_j,
+                                      voxelwise=voxelwise)
+            if pairwise:
+                corr = _isc_pairwise_core(shifted)
+                return _jnp_summary(corr[iu0, iu1, :], stat, axis=0)
+            return _jnp_summary(_columnwise_corr(shifted, others),
+                                stat, axis=0)
+
+        return jax.lax.map(one_shift, keys, batch_size=batch)
+
+    return obs_profile.profile_program(
+        jax.jit(run), "stats.phase_randomize", span="stats.chunk")
+
+
+# ---------------------------------------------------------------------------
+# canonical jaxlint-IR trace signatures (one spec per program branch)
+
+def _key_aval(n):
+    return jax.ShapeDtypeStruct((n, 2), jnp.uint32)
+
+
+def _iu_avals(n_pairs):
+    return (jax.ShapeDtypeStruct((n_pairs,), jnp.int32),
+            jax.ShapeDtypeStruct((n_pairs,), jnp.int32))
+
+
+@obs_runtime.trace_signature("stats.subject_bootstrap")
+def _subject_bootstrap_signature():
+    f32 = jnp.float32
+    iu0, iu1 = _iu_avals(3)
+    return [
+        {"key": ("median", 2, False), "label": "loo",
+         "args": (jax.ShapeDtypeStruct((3, 8), f32), _key_aval(4))},
+        {"key": ("mean", 2, True), "label": "pairwise",
+         "args": (jax.ShapeDtypeStruct((3, 3, 8), f32), _key_aval(4),
+                  iu0, iu1)},
+    ]
+
+
+@obs_runtime.trace_signature("stats.sign_flip")
+def _sign_flip_signature():
+    f32 = jnp.float32
+    iu0, iu1 = _iu_avals(3)
+    return [
+        {"key": ("median", 2, True, 3, False), "label": "loo-sampled",
+         "args": (jax.ShapeDtypeStruct((3, 8), f32), _key_aval(4))},
+        {"key": ("median", 2, False, 3, False), "label": "loo-exact",
+         "args": (jax.ShapeDtypeStruct((3, 8), f32),
+                  jax.ShapeDtypeStruct((8, 3), f32))},
+        {"key": ("mean", 2, True, 3, True), "label": "pairwise",
+         "args": (jax.ShapeDtypeStruct((3, 8), f32), _key_aval(4),
+                  iu0, iu1)},
+    ]
+
+
+@obs_runtime.trace_signature("stats.group_shuffle")
+def _group_shuffle_signature():
+    f32 = jnp.float32
+    iu0, iu1 = _iu_avals(6)
+    labels = jax.ShapeDtypeStruct((2,), f32)
+    return [
+        {"key": ("median", 2, True, False), "label": "loo-sampled",
+         "args": (jax.ShapeDtypeStruct((4, 8), f32),
+                  jax.ShapeDtypeStruct((4,), f32), labels,
+                  _key_aval(4))},
+        {"key": ("median", 2, False, False), "label": "loo-exact",
+         "args": (jax.ShapeDtypeStruct((4, 8), f32),
+                  jax.ShapeDtypeStruct((4,), f32), labels,
+                  jax.ShapeDtypeStruct((4, 4), jnp.int32))},
+        {"key": ("mean", 2, True, True), "label": "pairwise",
+         "args": (jax.ShapeDtypeStruct((6, 8), f32),
+                  jax.ShapeDtypeStruct((4, 4), f32), labels,
+                  iu0, iu1, _key_aval(4))},
+    ]
+
+
+@obs_runtime.trace_signature("stats.circular_timeshift")
+def _circular_timeshift_signature():
+    f32 = jnp.float32
+    iu0, iu1 = _iu_avals(3)
+    data = jax.ShapeDtypeStruct((6, 8, 3), f32)
+    return [
+        {"key": ("median", 2, False), "label": "loo",
+         "args": (data, data, _key_aval(4), iu0, iu1)},
+        {"key": ("mean", 2, True), "label": "pairwise",
+         "args": (data, data, _key_aval(4), iu0, iu1)},
+    ]
+
+
+@obs_runtime.trace_signature("stats.phase_randomize")
+def _phase_randomize_signature():
+    f32 = jnp.float32
+    iu0, iu1 = _iu_avals(3)
+    data = jax.ShapeDtypeStruct((6, 8, 3), f32)
+    return [
+        {"key": ("median", 2, False, False), "label": "loo",
+         "args": (data, data, _key_aval(4), iu0, iu1)},
+        {"key": ("mean", 2, True, True), "label": "pairwise-voxelwise",
+         "args": (data, data, _key_aval(4), iu0, iu1)},
+    ]
+
+
+# ---------------------------------------------------------------------------
+# family specs: everything the engine needs to drive one null run
+
+class NullSpec:
+    """One prepared resampling family: the full resample descriptor
+    array ``xs`` (split PRNG keys, or the enumerated resamples of an
+    exact test) plus a ``run(xs_chunk) -> [n, V] ndarray`` closure over
+    the device-placed inputs.  Slicing ``xs`` by global resample index
+    is what makes chunking, resume, and disjoint-range pooling all
+    yield the same per-index surrogate."""
+
+    def __init__(self, family, xs, run, n_voxels, n_total, exact,
+                 sampled, statistic, compute_observed):
+        self.family = family
+        self.xs = xs
+        self.run = run
+        self.n_voxels = n_voxels
+        self.n_total = n_total
+        self.exact = exact
+        self.sampled = sampled
+        self.statistic = statistic
+        self.compute_observed = compute_observed
+
+
+def _sampled_xs(seed, n_resamples):
+    """The canonical key schedule: split once over ALL planned
+    resamples, sliced per chunk — key i is a pure function of
+    (seed, i), independent of chunk boundaries."""
+    return np.asarray(jax.random.split(
+        jax.random.PRNGKey(int(seed)), int(n_resamples)))
+
+
+def make_spec(family, data, *, statistic='median', n_resamples=1000,
+              seed=0, pairwise=False, group_assignment=None,
+              voxelwise=False, tolerate_nans=True, mesh=None,
+              null_batch_size=None):
+    """Build the :class:`NullSpec` for one family over prepared data.
+
+    ``data`` is the family's input: an ISC stack (``[S, V]``
+    leave-one-out, or the condensed pairwise form) for the
+    ISC-resampling families, a ``[T, V, S]`` time-series stack for the
+    shift families.  Placement (voxel sharding over ``mesh``) happens
+    here, once, outside the chunk loop.
+    """
+    from .engine import default_null_batch
+
+    if family not in FAMILIES:
+        raise ValueError("Unknown surrogate family {!r}; registered "
+                         "families: {}".format(family,
+                                               ", ".join(FAMILIES)))
+    if statistic not in ('mean', 'median'):
+        raise ValueError("Summary statistic must be 'mean' or 'median'")
+
+    from ..isc import _check_isc_input, _loo_means_core, _shard_voxels
+    from ..parallel.mesh import fetch_replicated
+    from .pvalues import compute_summary_statistic
+
+    if family in ISC_INPUT_FAMILIES:
+        iscs, n_subjects, n_voxels = _check_isc_input(
+            np.asarray(data) if not isinstance(data, list) else data,
+            pairwise=pairwise)
+        iu = np.triu_indices(n_subjects, k=1)
+        iu0, iu1 = jnp.asarray(iu[0]), jnp.asarray(iu[1])
+
+        if family == "subject_bootstrap":
+            batch = (null_batch_size if null_batch_size is not None
+                     else default_null_batch(n_voxels))
+            if pairwise:
+                from scipy.spatial.distance import squareform
+                sq = np.stack([squareform(v, force='tomatrix')
+                               for v in iscs.T], axis=-1)  # [S, S, V]
+                for v in range(sq.shape[-1]):
+                    np.fill_diagonal(sq[..., v], 1.0)
+                sq_j = _shard_voxels(sq, mesh, 2)
+
+                def run(xs_chunk):
+                    program = subject_bootstrap_program(
+                        statistic, batch, True)
+                    return np.asarray(fetch_replicated(
+                        program(sq_j, jnp.asarray(xs_chunk), iu0,
+                                iu1), mesh))[:, :n_voxels]
+            else:
+                iscs_j = _shard_voxels(iscs, mesh, 1)
+
+                def run(xs_chunk):
+                    program = subject_bootstrap_program(
+                        statistic, batch, False)
+                    return np.asarray(fetch_replicated(
+                        program(iscs_j, jnp.asarray(xs_chunk)),
+                        mesh))[:, :n_voxels]
+
+            def compute_observed():
+                return compute_summary_statistic(
+                    iscs, summary_statistic=statistic, axis=0)
+
+            return NullSpec(family, _sampled_xs(seed, n_resamples),
+                            run, n_voxels, int(n_resamples), False,
+                            True, statistic, compute_observed)
+
+        if family == "sign_flip":
+            batch = (null_batch_size if null_batch_size is not None
+                     else default_null_batch(n_voxels))
+            exact = n_resamples >= 2 ** n_subjects
+            if exact:
+                n_total = 2 ** n_subjects
+                xs = np.asarray(list(product([-1.0, 1.0],
+                                             repeat=n_subjects)))
+            else:
+                n_total = int(n_resamples)
+                xs = _sampled_xs(seed, n_total)
+            iscs_j = _shard_voxels(iscs, mesh, 1)
+
+            if pairwise:
+                def run(xs_chunk):
+                    program = sign_flip_program(
+                        statistic, batch, not exact, n_subjects, True)
+                    return np.asarray(fetch_replicated(
+                        program(iscs_j, jnp.asarray(xs_chunk), iu0,
+                                iu1), mesh))[:, :n_voxels]
+            else:
+                def run(xs_chunk):
+                    program = sign_flip_program(
+                        statistic, batch, not exact, n_subjects,
+                        False)
+                    return np.asarray(fetch_replicated(
+                        program(iscs_j, jnp.asarray(xs_chunk)),
+                        mesh))[:, :n_voxels]
+
+            def compute_observed():
+                return compute_summary_statistic(
+                    iscs, summary_statistic=statistic,
+                    axis=0)[np.newaxis, :]
+
+            return NullSpec(family, xs, run, n_voxels, n_total, exact,
+                            not exact, statistic, compute_observed)
+
+        # group_shuffle
+        if group_assignment is None:
+            raise ValueError("group_shuffle requires group_assignment")
+        batch = (null_batch_size if null_batch_size is not None
+                 else default_null_batch(n_voxels))
+        group_selector = np.asarray(group_assignment)
+        labels = np.unique(group_selector)
+        if len(labels) != 2:
+            raise ValueError("group_shuffle requires exactly 2 groups "
+                             "(got {0})".format(len(labels)))
+        labels_j = jnp.asarray(labels.astype(float))
+        exact = n_resamples >= math.factorial(n_subjects)
+        if exact:
+            n_total = math.factorial(n_subjects)
+            xs = np.asarray(list(permutations(np.arange(n_subjects))))
+        else:
+            n_total = int(n_resamples)
+            xs = _sampled_xs(seed, n_total)
+        iscs_j = _shard_voxels(iscs, mesh, 1)
+
+        if pairwise:
+            from scipy.spatial.distance import squareform
+            sq_labels = np.full((n_subjects, n_subjects), np.nan)
+            for g in labels:
+                idx = np.where(group_selector == g)[0]
+                sq_labels[np.ix_(idx, idx)] = g
+            np.fill_diagonal(sq_labels, np.nan)
+            pair_labels = squareform(sq_labels, checks=False)
+            sq_labels_j = jnp.asarray(sq_labels)
+
+            def run(xs_chunk):
+                program = group_shuffle_program(statistic, batch,
+                                                not exact, True)
+                return np.asarray(fetch_replicated(
+                    program(iscs_j, sq_labels_j, labels_j, iu0, iu1,
+                            jnp.asarray(xs_chunk)),
+                    mesh))[:, :n_voxels]
+
+            def compute_observed():
+                return np.asarray(fetch_replicated(_group_diff_stat(
+                    iscs_j, jnp.asarray(pair_labels), labels_j,
+                    statistic), mesh))[:n_voxels]
+        else:
+            sel_j = jnp.asarray(group_selector)
+
+            def run(xs_chunk):
+                program = group_shuffle_program(statistic, batch,
+                                                not exact, False)
+                return np.asarray(fetch_replicated(
+                    program(iscs_j, sel_j, labels_j,
+                            jnp.asarray(xs_chunk)),
+                    mesh))[:, :n_voxels]
+
+            def compute_observed():
+                return np.asarray(fetch_replicated(_group_diff_stat(
+                    iscs_j, sel_j, labels_j, statistic),
+                    mesh))[:n_voxels]
+
+        return NullSpec(family, xs, run, n_voxels, n_total, exact,
+                        not exact, statistic, compute_observed)
+
+    # shift families: data is a prepared [T, V, S] stack
+    data = np.asarray(data)
+    if data.ndim != 3:
+        raise ValueError("shift families expect [TRs, voxels, "
+                         "subjects] data (got ndim={})".format(
+                             data.ndim))
+    n_trs, n_voxels, n_subjects = data.shape
+    batch = (null_batch_size if null_batch_size is not None
+             else default_null_batch(n_trs * n_voxels * n_subjects))
+    data_j = _shard_voxels(data, mesh, 1)
+    tol = bool(tolerate_nans)
+    iu = np.triu_indices(n_subjects, k=1)
+    iu0, iu1 = jnp.asarray(iu[0]), jnp.asarray(iu[1])
+    # loo: shift all subjects, correlate each against the UNSHIFTED
+    # others' mean.  The pairwise trace never reads ``others``; pass
+    # data_j as a free placeholder instead of computing dead LOO means.
+    others = data_j if pairwise else _loo_means_core(data_j, tol)
+
+    if family == "circular_timeshift":
+        def run(xs_chunk):
+            program = circular_timeshift_program(
+                statistic, batch, bool(pairwise))
+            return np.asarray(fetch_replicated(
+                program(data_j, others, jnp.asarray(xs_chunk), iu0,
+                        iu1), mesh))[:, :n_voxels]
+    else:
+        def run(xs_chunk):
+            program = phase_randomize_program(
+                statistic, batch, bool(pairwise), bool(voxelwise))
+            return np.asarray(fetch_replicated(
+                program(data_j, others, jnp.asarray(xs_chunk), iu0,
+                        iu1), mesh))[:, :n_voxels]
+
+    def compute_observed():
+        from ..isc import isc
+        return isc(data, pairwise=pairwise,
+                   summary_statistic=statistic,
+                   tolerate_nans=tolerate_nans, mesh=mesh)
+
+    return NullSpec(family, _sampled_xs(seed, n_resamples), run,
+                    n_voxels, int(n_resamples), False, True,
+                    statistic, compute_observed)

@@ -227,66 +227,49 @@ def array_correlation(x, y, axis=0):
 def phase_randomize(data, voxelwise=False, random_state=None):
     """Randomize the phase of time series, preserving the power spectrum.
 
+    .. deprecated::
+        This host-NumPy twin now delegates to the single jax
+        implementation, :func:`brainiak_tpu.ops.stats.phase_randomize`
+        (which also backs the ``"phase_randomize"`` surrogate family in
+        :mod:`brainiak_tpu.stats`).  ``random_state`` seeds a
+        ``jax.random`` key, so surrogates differ draw-for-draw from the
+        old RandomState chain while remaining distribution-identical
+        (uniform phases; power spectra preserved exactly).
+
     Same phase shift across voxels by default; per-voxel shifts when
     ``voxelwise=True``.  Accepts 2-D (TR × subject) or 3-D
     (TR × voxel × subject) input.  Reference contract:
-    utils/utils.py:720-801.  A jittable JAX counterpart lives in
-    :func:`brainiak_tpu.ops.stats.phase_randomize`.
+    utils/utils.py:720-801.
     """
+    warnings.warn(
+        "brainiak_tpu.utils.utils.phase_randomize is deprecated; use "
+        "brainiak_tpu.ops.stats.phase_randomize (explicit jax.random "
+        "key) or the 'phase_randomize' surrogate family in "
+        "brainiak_tpu.stats", DeprecationWarning, stacklevel=2)
+    import jax
+
+    from ..ops.stats import phase_randomize as _phase_randomize_jax
+
     data_ndim = np.ndim(data)
     data, n_TRs, n_voxels, n_subjects = _check_timeseries_input(data)
-
     if isinstance(random_state, np.random.RandomState):
-        prng = random_state
+        seed = int(random_state.randint(0, 2 ** 32 - 1))
+    elif random_state is None:
+        seed = int(np.random.randint(0, 2 ** 32 - 1))
     else:
-        prng = np.random.RandomState(random_state)
-
-    if n_TRs % 2 == 0:
-        pos_freq = np.arange(1, n_TRs // 2)
-        neg_freq = np.arange(n_TRs - 1, n_TRs // 2, -1)
-    else:
-        pos_freq = np.arange(1, (n_TRs - 1) // 2 + 1)
-        neg_freq = np.arange(n_TRs - 1, (n_TRs - 1) // 2, -1)
-
-    shift_voxels = n_voxels if voxelwise else 1
-    phase_shifts = prng.rand(len(pos_freq), shift_voxels, n_subjects) \
-        * 2 * np.pi
-
-    fft_data = np.fft.fft(data, axis=0)
-    fft_data[pos_freq, :, :] *= np.exp(1j * phase_shifts)
-    fft_data[neg_freq, :, :] *= np.exp(-1j * phase_shifts)
-    shifted_data = np.real(np.fft.ifft(fft_data, axis=0))
-
+        seed = int(random_state)
+    shifted_data = np.asarray(_phase_randomize_jax(
+        jax.random.PRNGKey(seed), data, voxelwise=voxelwise))
     if data_ndim == 2:
         shifted_data = shifted_data[:, 0, :]
     return shifted_data
 
 
-def p_from_null(observed, distribution, side='two-sided', exact=False,
-                axis=None):
-    """p-value of an observed statistic under a resampling null distribution.
-
-    Adjusts for the observed statistic unless ``exact`` (Phipson & Smyth
-    2010).  Reference contract: utils/utils.py:804-872.
-    """
-    if side not in ('two-sided', 'left', 'right'):
-        raise ValueError("The value for 'side' must be either "
-                         "'two-sided', 'left', or 'right', got {0}".
-                         format(side))
-    distribution = np.asarray(distribution)
-    n_samples = len(distribution)
-
-    if side == 'two-sided':
-        numerator = np.sum(np.abs(distribution) >= np.abs(observed),
-                           axis=axis)
-    elif side == 'left':
-        numerator = np.sum(distribution <= observed, axis=axis)
-    else:
-        numerator = np.sum(distribution >= observed, axis=axis)
-
-    if exact:
-        return numerator / n_samples
-    return (numerator + 1) / (n_samples + 1)
+# p_from_null's canonical home is brainiak_tpu.stats.pvalues (one
+# NumPy-only source for the exceedance-count -> p conventions shared
+# with the streaming NullAccumulator); re-exported here for the
+# long-standing utils surface.
+from ..stats.pvalues import p_from_null  # noqa: E402,F401
 
 
 class ReadDesign:

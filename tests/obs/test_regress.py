@@ -53,10 +53,12 @@ def test_fixture_history_passes_and_gates():
     # 3 rounds x 2 metrics — per-TR p99 latency, deadline-miss
     # ratio, both lower-is-better) + the elastic_r01-r03 tier
     # (ISSUE 16: 3 rounds x 3 metrics — chaos-soak requests/s,
-    # post-failure p99, lost-ticket count held at zero), all
-    # measured host-side -> *_cpu_fallback: ten tiers gating
-    # independently from one directory
-    assert len(records) == 62
+    # post-failure p99, lost-ticket count held at zero) + the
+    # stats_r01-r03 tier (ISSUE 18: 3 rounds x 1 metric — engine
+    # surrogates/s vs a host loop), all measured host-side ->
+    # *_cpu_fallback: eleven tiers gating independently from one
+    # directory
+    assert len(records) == 65
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
@@ -68,7 +70,8 @@ def test_fixture_history_passes_and_gates():
                      "streaming_cpu_fallback",
                      "federation_cpu_fallback",
                      "realtime_cpu_fallback",
-                     "elastic_cpu_fallback"}
+                     "elastic_cpu_fallback",
+                     "stats_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
     multi = ("service_cpu_fallback", "kernels_cpu_fallback",
@@ -80,7 +83,8 @@ def test_fixture_history_passes_and_gates():
                  if c["tier"] in multi}
     assert set(by_tier) == {"cpu_fallback", "serve_cpu_fallback",
                             "distla_cpu_fallback",
-                            "encoding_cpu_fallback"}
+                            "encoding_cpu_fallback",
+                            "stats_cpu_fallback"}
     # the service tier gates four metrics (three flipped, incl. the
     # ISSUE 12 telemetry-overhead ratio) and the kernels tier gates
     # two fused sites
@@ -139,6 +143,11 @@ def test_fixture_history_passes_and_gates():
     assert by_tier["encoding_cpu_fallback"]["n_history"] == 2
     assert by_tier["encoding_cpu_fallback"]["metric"] == \
         "encoding_ridge_cv_voxels_lambdas_per_sec"
+    # the ISSUE 18 stats tier gates the null-engine surrogate rate
+    assert by_tier["stats_cpu_fallback"]["status"] == "ok"
+    assert by_tier["stats_cpu_fallback"]["n_history"] == 2
+    assert by_tier["stats_cpu_fallback"]["metric"] == \
+        "stats_surrogates_per_sec"
 
 
 def test_only_selects_tier_family():

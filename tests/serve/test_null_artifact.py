@@ -1,0 +1,208 @@
+"""The ``null_distribution`` serve surface (ISSUE 18): a finished
+:class:`NullDistribution` persists through the pickle-free artifact
+schema bit-for-bit (counts, thresholds, seed/statistic edge cases),
+and ``InferenceEngine`` serves ``serve.null_threshold`` lookups —
+p-values from the accumulated tail tables plus FWER significance
+masks that match a host recompute of ``x >= threshold`` exactly."""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.serve import (InferenceEngine, Request, detect_kind,
+                                load_model, model_digest, save_model)
+from brainiak_tpu.stats import NullEngine
+
+
+def _null_run(side="right", seed=9, return_distribution=False):
+    rng = np.random.RandomState(4)
+    iscs = 0.2 + 0.1 * rng.randn(8, 6)
+    return NullEngine(null_batch_size=16).run(
+        iscs, "subject_bootstrap", 64, statistic="median", side=side,
+        seed=seed, return_distribution=return_distribution)
+
+
+def _roundtrip(model, tmp_path, name):
+    path = str(tmp_path / f"{name}.npz")
+    save_model(model, path)
+    return load_model(path)
+
+
+def test_null_distribution_roundtrip_bit_exact(tmp_path):
+    result = _null_run()
+    loaded = _roundtrip(result, tmp_path, "null")
+    assert detect_kind(loaded) == "null_distribution"
+    assert model_digest(loaded) == model_digest(result)
+    assert (loaded.family, loaded.statistic, loaded.seed,
+            loaded.side, loaded.exact) == (
+        result.family, result.statistic, result.seed,
+        result.side, result.exact)
+    np.testing.assert_array_equal(loaded.observed, result.observed)
+    assert loaded.thresholds == result.thresholds
+    for side in ("right", "left", "two-sided"):
+        np.testing.assert_array_equal(loaded.p_values(side=side),
+                                      result.p_values(side=side))
+    a, b = loaded.accumulator, result.accumulator
+    for key, arr in b.to_state().items():
+        np.testing.assert_array_equal(a.to_state()[key], arr,
+                                      err_msg=key)
+
+
+def test_null_distribution_roundtrip_none_seed_and_statistic(
+        tmp_path):
+    result = _null_run()
+    result.seed = None
+    result.statistic = None
+    loaded = _roundtrip(result, tmp_path, "null_none")
+    assert loaded.seed is None
+    assert loaded.statistic is None
+
+
+def test_null_distribution_artifact_drops_materialized_null(
+        tmp_path):
+    """The artifact is the SUMMARY: a materialized [N, V] null on
+    the in-memory object is not serialized (that is what the
+    accumulator replaces), and the loaded object still answers every
+    p/threshold query identically."""
+    result = _null_run(return_distribution=True)
+    assert result.distribution is not None
+    loaded = _roundtrip(result, tmp_path, "null_dist")
+    assert loaded.distribution is None
+    np.testing.assert_array_equal(loaded.p_values(),
+                                  result.p_values())
+
+
+def test_unfitted_null_distribution_refused():
+    from brainiak_tpu.stats.engine import NullDistribution
+    bare = NullDistribution("sign_flip", "median", 0, "right", False,
+                            np.zeros(3), None)
+    with pytest.raises(ValueError, match="not fitted"):
+        save_model(bare, "/dev/null")
+
+
+def _serve(result, queries, **engine_kwargs):
+    engine = InferenceEngine(result, **engine_kwargs)
+    reqs = [Request(request_id=f"q{i}", x=q)
+            for i, q in enumerate(queries)]
+    return engine, engine.run(reqs)
+
+
+def test_engine_serves_threshold_lookups_right_side():
+    result = _null_run(side="right")
+    thr = result.thresholds["fwer_0.05"]
+    v = result.observed.shape[0]
+    lo = np.full(v, -10.0)
+    hi = np.full(v, 10.0)
+    engine, records = _serve(result, [result.observed, lo, hi])
+    assert all(r.ok for r in records), [r.error for r in records]
+    n = result.n
+    for rec, q in zip(records, (result.observed, lo, hi)):
+        p, sig = rec.result
+        assert p.shape == sig.shape == (v,)
+        assert np.all((p > 0.0) & (p <= 1.0))
+        np.testing.assert_array_equal(sig, q >= thr)
+    p_lo = records[1].result[0]
+    p_hi = records[2].result[0]
+    np.testing.assert_array_equal(p_lo, np.full(v, 1.0))
+    np.testing.assert_array_equal(p_hi, np.full(v, 1.0 / (n + 1)))
+    # the served p is EXACTLY the bucketed-tail convention: a host
+    # recompute from the same ordered bucket histogram matches
+    # bitwise, and the exact count-based p differs by at most the
+    # mass of the single bucket the query lands in
+    counts, values = result.accumulator._ordered_counts()
+    counts = counts.reshape(len(values), -1)
+    tail = np.concatenate(
+        [np.cumsum(counts[::-1], axis=0)[::-1],
+         np.zeros((1, v), dtype=counts.dtype)], axis=0)
+    idx = np.searchsorted(values, result.observed, side="left")
+    want = (np.take_along_axis(tail, idx[None], axis=0)[0]
+            + 1.0) / (n + 1.0)
+    p_obs = records[0].result[0]
+    np.testing.assert_allclose(p_obs, want.astype(p_obs.dtype),
+                               rtol=1e-6)
+    bucket_bound = (counts.max() + 1.0) / (n + 1.0)
+    assert np.all(np.abs(p_obs - result.p_values()) <= bucket_bound)
+
+
+def test_engine_serves_left_and_two_sided_modes():
+    for side in ("left", "two-sided"):
+        result = _null_run(side=side)
+        v = result.observed.shape[0]
+        lo = np.full(v, -10.0)
+        hi = np.full(v, 10.0)
+        _, records = _serve(result, [lo, hi])
+        assert all(r.ok for r in records)
+        p_lo = records[0].result[0]
+        p_hi = records[1].result[0]
+        n = result.n
+        if side == "left":
+            # left tail: very negative is maximally significant
+            np.testing.assert_array_equal(p_lo,
+                                          np.full(v, 1.0 / (n + 1)))
+            np.testing.assert_array_equal(p_hi, np.full(v, 1.0))
+        else:
+            # two-sided: both extremes are maximally significant
+            np.testing.assert_array_equal(p_lo,
+                                          np.full(v, 1.0 / (n + 1)))
+            np.testing.assert_array_equal(p_hi,
+                                          np.full(v, 1.0 / (n + 1)))
+
+
+def test_engine_serves_reloaded_artifact_identically(tmp_path):
+    result = _null_run()
+    loaded = _roundtrip(result, tmp_path, "null_served")
+    rng = np.random.RandomState(5)
+    queries = [0.2 + 0.1 * rng.randn(6) for _ in range(4)]
+    _, recs_a = _serve(result, queries)
+    _, recs_b = _serve(loaded, queries)
+    for ra, rb in zip(recs_a, recs_b):
+        assert ra.ok and rb.ok
+        np.testing.assert_array_equal(ra.result[0], rb.result[0])
+        np.testing.assert_array_equal(ra.result[1], rb.result[1])
+
+
+def test_engine_rejects_bad_null_queries():
+    result = _null_run()
+    engine = InferenceEngine(result)
+    records = engine.run([
+        Request(request_id="badshape", x=np.zeros(5)),
+        Request(request_id="nonfinite",
+                x=np.full(6, np.nan)),
+        Request(request_id="good", x=np.zeros(6)),
+    ])
+    by_id = {r.request_id: r for r in records}
+    assert not by_id["badshape"].ok
+    assert not by_id["nonfinite"].ok
+    assert by_id["good"].ok
+
+
+def test_engine_serves_one_sample_observed_layout():
+    """A ``sign_flip`` result's own observed map carries a leading
+    length-1 axis (the one-sample permutation convention); serving
+    it verbatim must work — the op flattens any layout of the
+    artifact's voxel extent."""
+    rng = np.random.RandomState(4)
+    iscs = 0.2 + 0.1 * rng.randn(8, 6)
+    result = NullEngine(null_batch_size=16).run(
+        iscs, "sign_flip", 64, statistic="median", seed=9)
+    assert result.observed.shape == (1, 6)
+    engine = InferenceEngine(result)
+    records = engine.run([
+        Request(request_id="as-is", x=result.observed),
+        Request(request_id="flat", x=result.observed.reshape(-1)),
+    ])
+    assert all(r.ok for r in records), [r.error for r in records]
+    p0, sig0 = records[0].result
+    p1, sig1 = records[1].result
+    assert np.array_equal(p0, p1)
+    assert np.array_equal(sig0, sig1)
+
+
+def test_repeat_null_serving_reuses_one_program():
+    result = _null_run()
+    engine = InferenceEngine(result)
+    queries = [Request(request_id=f"q{i}", x=np.zeros(6))
+               for i in range(3)]
+    engine.run(queries)
+    first = engine.summary()["retrace_total"]
+    engine.run(queries)
+    assert engine.summary()["retrace_total"] == first

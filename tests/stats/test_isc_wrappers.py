@@ -1,0 +1,105 @@
+"""The four legacy ISC resampling wrappers now route through
+``NullEngine``; these tests pin what the rewiring must preserve:
+matched-seed determinism across calls, and the
+``return_distribution=False`` accumulator path returning the BITWISE
+same p-map as the materialized path (the null is counted, never
+stored)."""
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.isc import (bootstrap_isc, permutation_isc,
+                              phaseshift_isc, timeshift_isc)
+
+SEED = 42
+
+
+@pytest.fixture(scope="module")
+def iscs():
+    rng = np.random.RandomState(0)
+    return 0.2 + 0.1 * rng.randn(10, 6)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(1)
+    return rng.randn(30, 4, 8)
+
+
+def test_bootstrap_isc_matched_seed_and_counted_path(iscs):
+    obs, ci, p, dist = bootstrap_isc(
+        iscs, n_bootstraps=48, random_state=SEED)
+    obs2, ci2, p2, dist2 = bootstrap_isc(
+        iscs, n_bootstraps=48, random_state=SEED)
+    assert np.array_equal(obs, obs2)
+    assert np.array_equal(p, p2)
+    assert np.array_equal(dist, dist2, equal_nan=True)
+    assert dist.shape == (48, 6)
+
+    obs3, ci3, p3, dist3 = bootstrap_isc(
+        iscs, n_bootstraps=48, random_state=SEED,
+        return_distribution=False)
+    assert dist3 is None
+    assert np.array_equal(obs3, obs)
+    assert np.array_equal(p3, p)
+
+
+def test_permutation_isc_matched_seed_and_counted_path(iscs):
+    group = [0] * 4 + [1] * 6
+    obs, p, dist = permutation_isc(
+        iscs, group_assignment=group, n_permutations=48,
+        random_state=SEED)
+    obs2, p2, dist2 = permutation_isc(
+        iscs, group_assignment=group, n_permutations=48,
+        random_state=SEED)
+    assert np.array_equal(p, p2)
+    assert np.array_equal(dist, dist2, equal_nan=True)
+
+    obs3, p3, dist3 = permutation_isc(
+        iscs, group_assignment=group, n_permutations=48,
+        random_state=SEED, return_distribution=False)
+    assert dist3 is None
+    assert np.array_equal(np.asarray(obs3), np.asarray(obs))
+    assert np.array_equal(p3, p)
+
+
+def test_permutation_isc_one_sample_counted_path(iscs):
+    obs, p, dist = permutation_isc(
+        iscs, n_permutations=32, random_state=SEED)
+    obs3, p3, dist3 = permutation_isc(
+        iscs, n_permutations=32, random_state=SEED,
+        return_distribution=False)
+    assert dist3 is None
+    assert np.array_equal(p3, p)
+
+
+def test_timeshift_isc_matched_seed_and_counted_path(data):
+    obs, p, dist = timeshift_isc(
+        data, n_shifts=32, random_state=SEED)
+    obs2, p2, dist2 = timeshift_isc(
+        data, n_shifts=32, random_state=SEED)
+    assert np.array_equal(p, p2)
+    assert np.array_equal(dist, dist2, equal_nan=True)
+
+    obs3, p3, dist3 = timeshift_isc(
+        data, n_shifts=32, random_state=SEED,
+        return_distribution=False)
+    assert dist3 is None
+    assert np.array_equal(obs3, obs)
+    assert np.array_equal(p3, p)
+
+
+def test_phaseshift_isc_matched_seed_and_counted_path(data):
+    obs, p, dist = phaseshift_isc(
+        data, n_shifts=32, random_state=SEED)
+    obs2, p2, dist2 = phaseshift_isc(
+        data, n_shifts=32, random_state=SEED)
+    assert np.array_equal(p, p2)
+    assert np.array_equal(dist, dist2, equal_nan=True)
+
+    obs3, p3, dist3 = phaseshift_isc(
+        data, n_shifts=32, random_state=SEED,
+        return_distribution=False)
+    assert dist3 is None
+    assert np.array_equal(obs3, obs)
+    assert np.array_equal(p3, p)

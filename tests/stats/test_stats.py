@@ -1,0 +1,310 @@
+"""ISSUE 18: the resampling-statistics engine (brainiak_tpu.stats).
+
+Pins the subsystem's contracts: the ``+1`` p-value convention (a
+reference implementation, not a round-trip), accumulator counts
+reproducing ``p_from_null`` bit-for-bit, exact pooling of disjoint
+resample ranges through BOTH wire formats, chunk-size invariance,
+the population-scale chunked run + resume proof at a resample count
+whose materialized null cannot fit the configured budget, exact
+sign-flip enumeration against an itertools brute force, and the
+one-compile-per-family retrace contract.
+"""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.stats import (NullAccumulator, NullEngine,
+                                compute_summary_statistic,
+                                default_null_batch, p_from_null)
+from brainiak_tpu.stats.pvalues import exceedance_counts, p_from_counts
+
+
+def _p_reference(observed, distribution, side, exact):
+    """The original brainiak convention, re-derived from scratch:
+    exact tests divide raw counts by n; sampled tests add the
+    observed value to both numerator and denominator (the ``+1``)."""
+    observed = np.asarray(observed, dtype=np.float64)
+    distribution = np.asarray(distribution, dtype=np.float64)
+    n = distribution.shape[0]
+    if side == 'right':
+        numerator = np.sum(distribution >= observed, axis=0)
+    elif side == 'left':
+        numerator = np.sum(distribution <= observed, axis=0)
+    else:
+        numerator = np.sum(np.abs(distribution) >= np.abs(observed),
+                           axis=0)
+    if exact:
+        return numerator / n
+    return (numerator + 1) / (n + 1)
+
+
+def test_p_from_null_pins_plus_one_convention():
+    """p_from_null (now in stats.pvalues, the single source) matches
+    the reference convention bitwise for every side x exact mode."""
+    rng = np.random.RandomState(0)
+    observed = rng.randn(7)
+    distribution = rng.randn(100, 7)
+    for side in ('right', 'left', 'two-sided'):
+        for exact in (False, True):
+            got = p_from_null(observed, distribution, side=side,
+                              exact=exact, axis=0)
+            want = _p_reference(observed, distribution, side, exact)
+            assert np.array_equal(got, want), (side, exact)
+
+
+def test_pvalue_shims_share_one_implementation():
+    """The utils/isc re-export shims resolve to the stats.pvalues
+    objects — one convention, no copies to drift."""
+    import brainiak_tpu.isc as isc_mod
+    import brainiak_tpu.stats.pvalues as pvalues
+    import brainiak_tpu.utils.utils as utils_mod
+    assert utils_mod.p_from_null is pvalues.p_from_null
+    assert isc_mod.p_from_null is pvalues.p_from_null
+    assert (isc_mod.compute_summary_statistic
+            is pvalues.compute_summary_statistic)
+    assert (compute_summary_statistic
+            is pvalues.compute_summary_statistic)
+
+
+def test_p_from_counts_matches_exceedance_counts():
+    rng = np.random.RandomState(1)
+    observed = rng.randn(5)
+    distribution = rng.randn(64, 5)
+    ge, le, abs_ge = exceedance_counts(observed, distribution)
+    for side, numerator in (('right', ge), ('left', le),
+                            ('two-sided', abs_ge)):
+        for exact in (False, True):
+            assert np.array_equal(
+                p_from_counts(numerator, 64, exact=exact),
+                _p_reference(observed, distribution, side, exact))
+
+
+def test_accumulator_reproduces_p_from_null_bitwise():
+    """Integer exceedance counts folded chunk-by-chunk (including a
+    NaN column) reproduce p_from_null on the materialized null
+    bit-for-bit."""
+    rng = np.random.RandomState(2)
+    observed = rng.randn(6)
+    distribution = rng.randn(90, 6)
+    distribution[13:40, 2] = np.nan
+    acc = NullAccumulator(observed, 90, shape=(6,))
+    for lo, hi in ((0, 17), (17, 64), (64, 90)):
+        acc.update(distribution[lo:hi], (lo, hi))
+    assert acc.complete
+    for side in ('right', 'left', 'two-sided'):
+        for exact in (False, True):
+            assert np.array_equal(
+                acc.p_values(side=side, exact=exact),
+                p_from_null(observed, distribution, side=side,
+                            exact=exact, axis=0)), (side, exact)
+
+
+def test_accumulator_merge_exact_through_both_wire_formats(tmp_path):
+    """Two half-range accumulators, one round-tripped through JSON
+    hex-floats and one through npz, merge to EXACTLY the single-run
+    verdicts: p-values, quantiles, CI bounds, FWER/FDR thresholds,
+    moments."""
+    rng = np.random.RandomState(3)
+    observed = rng.randn(5)
+    distribution = rng.randn(120, 5)
+    full = NullAccumulator(observed, 120, shape=(5,))
+    full.update(distribution, (0, 120))
+
+    a = NullAccumulator(observed, 120, shape=(5,))
+    a.update(distribution[:50], (0, 50))
+    b = NullAccumulator(observed, 120, shape=(5,))
+    b.update(distribution[50:], (50, 120))
+    a = NullAccumulator.from_json(a.to_json())
+    path = os.path.join(str(tmp_path), "half_b.npz")
+    b.save(path)
+    b = NullAccumulator.load(path)
+
+    merged = a.merge(b)
+    assert merged.complete
+    for side in ('right', 'left', 'two-sided'):
+        assert np.array_equal(merged.p_values(side=side),
+                              full.p_values(side=side))
+    for q in (0.025, 0.5, 0.975):
+        assert np.array_equal(merged.quantile(q), full.quantile(q))
+    assert merged.fwer_threshold() == full.fwer_threshold()
+    assert merged.fdr_threshold() == full.fdr_threshold()
+    # Moments are float sums: pooling adds two partial sums where the
+    # full run sums 120 rows in one pass, so the last ulp can differ.
+    # The count-based verdicts above are the EXACT contract.
+    assert np.allclose(merged.mean(), full.mean(), rtol=1e-12)
+    assert np.allclose(merged.variance(), full.variance(), rtol=1e-12)
+
+
+def test_accumulator_rejects_overlap_and_config_mismatch():
+    rng = np.random.RandomState(4)
+    observed = rng.randn(3)
+    a = NullAccumulator(observed, 20, shape=(3,))
+    a.update(rng.randn(10, 3), (0, 10))
+    b = NullAccumulator(observed, 20, shape=(3,))
+    b.update(rng.randn(10, 3), (5, 15))
+    with pytest.raises(ValueError, match="overlap"):
+        a.merge(b)
+    c = NullAccumulator(observed, 21, shape=(3,))
+    with pytest.raises(ValueError, match="configurations"):
+        a.merge(c)
+    with pytest.raises(ValueError, match="already accumulated"):
+        a.update(rng.randn(5, 3), (5, 10))
+
+
+def test_engine_chunk_invariance_bitwise():
+    """A starved budget (one dispatch lane per chunk) returns the
+    bitwise-identical null and p-map to a one-chunk run — chunking
+    is an execution detail, never a statistical one."""
+    rng = np.random.RandomState(5)
+    iscs = 0.2 + 0.1 * rng.randn(10, 4)
+    kwargs = dict(statistic="median", side="two-sided", seed=11,
+                  return_distribution=True)
+    one = NullEngine(null_batch_size=16).run(
+        iscs, "subject_bootstrap", 48, **kwargs)
+    many = NullEngine(null_batch_size=16, budget_bytes=1).run(
+        iscs, "subject_bootstrap", 48, **kwargs)
+    assert np.array_equal(one.distribution, many.distribution,
+                          equal_nan=True)
+    assert np.array_equal(one.p_values(), many.p_values())
+
+
+def test_engine_population_scale_chunked_run_and_resume(tmp_path):
+    """The scale proof: 20,000 resamples under a 64 KiB budget — the
+    materialized [N, V] null (1.25 MiB at f64) cannot exist under
+    the budget, so the run MUST chunk (and does: ~40 chunks), and an
+    injected preemption mid-run resumes from the checkpoint to a
+    BIT-IDENTICAL p-map."""
+    from brainiak_tpu.resilience import faults
+
+    rng = np.random.RandomState(6)
+    iscs = 0.2 + 0.1 * rng.randn(12, 8)
+    n_resamples, budget = 20000, 64 * 1024
+    assert n_resamples * iscs.shape[1] * 8 > budget  # no [N, V] fits
+    kwargs = dict(statistic="median", side="right", seed=7)
+    engine = NullEngine(null_batch_size=64, budget_bytes=budget)
+    full = engine.run(iscs, "subject_bootstrap", n_resamples,
+                      **kwargs)
+    assert full.n == n_resamples
+
+    ckpt = os.path.join(str(tmp_path), "ckpt")
+    with pytest.raises(faults.PreemptionError):
+        with faults.inject("preempt", at_step=3):
+            engine.run(iscs, "subject_bootstrap", n_resamples,
+                       checkpoint_dir=ckpt, **kwargs)
+    resumed = engine.run(iscs, "subject_bootstrap", n_resamples,
+                         checkpoint_dir=ckpt, **kwargs)
+    assert np.array_equal(resumed.p_values(), full.p_values())
+    assert np.array_equal(resumed.observed, full.observed)
+    assert resumed.fwer_threshold() == full.fwer_threshold()
+
+
+def test_engine_disjoint_ranges_pool_exactly():
+    """The pooling proof: two engine runs over disjoint halves of
+    the resample index space (same seed — ONE key schedule sliced
+    per range) merge to EXACTLY the single full run, across the
+    NullDistribution merge surface."""
+    rng = np.random.RandomState(7)
+    iscs = 0.2 + 0.1 * rng.randn(9, 5)
+    kwargs = dict(statistic="median", side="two-sided", seed=13)
+    engine = NullEngine(null_batch_size=16)
+    full = engine.run(iscs, "subject_bootstrap", 64, **kwargs)
+    lo = engine.run(iscs, "subject_bootstrap", 64,
+                    index_range=(0, 32), **kwargs)
+    hi = engine.run(iscs, "subject_bootstrap", 64,
+                    index_range=(32, 64), **kwargs)
+    assert lo.n == 32 and hi.n == 32 and not lo.complete
+    pooled = lo.merge(hi)
+    assert pooled.complete
+    assert np.array_equal(pooled.p_values(), full.p_values())
+    assert np.array_equal(pooled.ci(95)[0], full.ci(95)[0])
+    assert pooled.fwer_threshold() == full.fwer_threshold()
+
+
+def test_exact_sign_flip_matches_itertools_brute_force():
+    """Exact sign-flip enumeration (n_resamples >= 2**n) carries the
+    same multiset of null statistics as an itertools product over
+    every sign pattern, and the exact-mode p-map (counts / n, no +1)
+    matches the reference convention bitwise."""
+    rng = np.random.RandomState(8)
+    iscs = 0.2 + 0.3 * rng.randn(4, 3)
+    engine = NullEngine(null_batch_size=16)
+    res = engine.run(iscs, "sign_flip", 16, statistic="median",
+                     side="two-sided", return_distribution=True)
+    assert res.exact and res.n == 16
+    brute = np.stack([
+        np.median(np.asarray(signs)[:, None] * iscs, axis=0)
+        for signs in itertools.product((1.0, -1.0), repeat=4)])
+    assert np.allclose(np.sort(res.distribution, axis=0),
+                       np.sort(brute, axis=0), atol=1e-6)
+    want = _p_reference(res.observed, brute, 'two-sided', True)
+    assert np.allclose(res.p_values(), want, atol=1e-12)
+
+
+def test_engine_runs_every_family():
+    """Each registered family completes end-to-end through the
+    chunked engine and yields a valid p-map."""
+    rng = np.random.RandomState(9)
+    iscs = 0.2 + 0.1 * rng.randn(8, 4)
+    data = rng.randn(24, 4, 6)
+    group = [0] * 3 + [1] * 5
+    engine = NullEngine(null_batch_size=16)
+    runs = {
+        "subject_bootstrap": (iscs, {}),
+        "sign_flip": (iscs, {}),
+        "group_shuffle": (iscs, {"group_assignment": group}),
+        "circular_timeshift": (data, {}),
+        "phase_randomize": (data, {}),
+    }
+    for family, (payload, extra) in runs.items():
+        res = engine.run(payload, family, 24, statistic="median",
+                         side="two-sided", seed=1, **extra)
+        p = res.p_values()
+        assert p.shape == (4,)
+        assert np.all((p > 0.0) & (p <= 1.0)), family
+        assert res.family == family
+
+
+def test_repeat_runs_never_retrace():
+    """The retrace contract: re-running a family at the same lane
+    width reuses the compiled program — retrace_total{stats.*} gains
+    nothing on the second pass."""
+    from brainiak_tpu.obs import metrics as obs_metrics
+
+    rng = np.random.RandomState(10)
+    iscs = 0.2 + 0.1 * rng.randn(8, 4)
+    engine = NullEngine(null_batch_size=16)
+    kwargs = dict(statistic="median", side="right", seed=2)
+    engine.run(iscs, "subject_bootstrap", 32, **kwargs)
+    engine.run(iscs, "sign_flip", 32, **kwargs)
+    counter = obs_metrics.counter("retrace_total")
+
+    def stats_counts():
+        return {labels.get("site"): value
+                for labels, value in counter.samples()
+                if labels.get("site", "").startswith("stats.")}
+
+    before = stats_counts()
+    engine.run(iscs, "subject_bootstrap", 64, **kwargs)
+    engine.run(iscs, "sign_flip", 64, **kwargs)
+    assert stats_counts() == before
+
+
+def test_default_null_batch_unified():
+    """The one shared default (satellite c): power-of-two lanes,
+    clamped to [16, 64], monotone in the voxel count."""
+    sizes = [default_null_batch(v)
+             for v in (1, 64, 1024, 1 << 20)]
+    for batch in sizes:
+        assert batch in (16, 32, 64)
+    assert sizes == sorted(sizes, reverse=True)
+
+
+def test_stats_budget_env_override(monkeypatch):
+    from brainiak_tpu.stats import stats_budget_bytes
+    monkeypatch.setenv("BRAINIAK_TPU_STATS_BUDGET_BYTES", "12345")
+    assert stats_budget_bytes() == 12345
+    monkeypatch.delenv("BRAINIAK_TPU_STATS_BUDGET_BYTES")
+    assert stats_budget_bytes() == (1 << 28)

@@ -46,7 +46,8 @@ def test_run_checks_json_output():
         "external", "stdlib", "doc-defaults", "resilient-fits",
         "jaxlint", "jaxlint-deep", "jaxlint-ir", "obs", "obs-live",
         "regress", "serve", "service", "federation", "fleet",
-        "distla", "encoding", "kernels", "data", "realtime"}
+        "distla", "encoding", "kernels", "data", "realtime",
+        "stats"}
     assert payload["files"] > 100
     seconds = payload["gate_seconds"]
     assert set(seconds) == set(payload["gates"])
@@ -792,6 +793,73 @@ def test_realtime_gate_classifies_failures(monkeypatch):
     findings = []
     rc.check_realtime(findings)
     assert [f.code for f in findings] == ["RT001"]
+    assert "rc=3" in findings[0].message
+
+
+# -- ISSUE 18: the stats gate (STA001) --------------------------------
+
+def test_stats_gate_passes_on_live_package():
+    """The stats gate (STA001) smoke-runs the resampling-statistics
+    selfcheck — accumulator-vs-materialized p-value parity, chunk
+    invariance under a starved budget, exact pooling through both
+    wire formats, resume after an injected preemption, retrace
+    stability — and passes on the live tree (ISSUE 18)."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_stats(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_stats_gate_classifies_failures(monkeypatch):
+    """A failing stats selfcheck is reported as STA001, with broken
+    pooling, a broken resume, retrace instability, and p-value
+    parity failure each named distinctly."""
+    rc = _load_run_checks()
+
+    def fake_child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    monkeypatch.setattr(rc, "_STATS_CHILD", fake_child(
+        {"ok": False, "max_err": 0.2, "tol": 0.0,
+         "merge_ok": True, "resume_ok": True,
+         "retraces": {"stats.sign_flip": 1.0}}))
+    findings = []
+    rc.check_stats(findings)
+    assert [f.code for f in findings] == ["STA001"]
+    assert "parity" in findings[0].message
+
+    monkeypatch.setattr(rc, "_STATS_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 0.0,
+         "merge_ok": False, "resume_ok": True, "retraces": {}}))
+    findings = []
+    rc.check_stats(findings)
+    assert [f.code for f in findings] == ["STA001"]
+    assert "merge" in findings[0].message
+
+    monkeypatch.setattr(rc, "_STATS_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 0.0,
+         "merge_ok": True, "resume_ok": False, "retraces": {}}))
+    findings = []
+    rc.check_stats(findings)
+    assert [f.code for f in findings] == ["STA001"]
+    assert "resume" in findings[0].message
+
+    monkeypatch.setattr(rc, "_STATS_CHILD", fake_child(
+        {"ok": False, "max_err": 0.0, "tol": 0.0,
+         "merge_ok": True, "resume_ok": True,
+         "retraces": {"stats.phase_randomize": 4.0}}))
+    findings = []
+    rc.check_stats(findings)
+    assert [f.code for f in findings] == ["STA001"]
+    assert "rebuilt" in findings[0].message
+    assert "stats.phase_randomize=4" in findings[0].message
+
+    monkeypatch.setattr(rc, "_STATS_CHILD", "raise SystemExit(3)")
+    findings = []
+    rc.check_stats(findings)
+    assert [f.code for f in findings] == ["STA001"]
     assert "rc=3" in findings[0].message
 
 

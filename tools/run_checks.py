@@ -141,7 +141,8 @@ MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
          "jaxlint", "jaxlint-deep", "jaxlint-ir", "obs", "obs-live",
          "regress", "serve", "service", "federation", "fleet",
-         "distla", "encoding", "kernels", "data", "realtime")
+         "distla", "encoding", "kernels", "data", "realtime",
+         "stats")
 
 
 def python_sources():
@@ -355,6 +356,7 @@ RESILIENT_FITS = {
     "brainiak_tpu/reprsimil/brsa.py": ("BRSA",),
     "brainiak_tpu/eventseg/event.py": ("EventSegment",),
     "brainiak_tpu/realtime/loop.py": ("RealtimeSession.run",),
+    "brainiak_tpu/stats/engine.py": ("NullEngine.run",),
 }
 
 
@@ -1223,6 +1225,51 @@ def check_realtime(findings):
         "realtime", classify)
 
 
+# -- stats gate -------------------------------------------------------
+
+_STATS_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.stats.selfcheck import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_stats(findings):
+    """Resampling-statistics gate (STA001): smoke-run the stats
+    selfcheck (``brainiak_tpu.stats.selfcheck``) on the 8-device CPU
+    mesh: accumulator-counts-vs-materialized-null p-value parity
+    (bit-for-bit), chunk invariance under a starved
+    ``BRAINIAK_TPU_STATS_BUDGET_BYTES``, exact pooling of disjoint
+    half-range runs round-tripped through BOTH wire formats
+    (JSON/npz), resume-at-chunk after an injected preemption, and
+    the retrace-stability contract — every counted ``stats.*``
+    surrogate program stays at <= 1 trace across all of the above."""
+
+    def classify(verdict):
+        if not verdict.get("merge_ok", True):
+            return ("pooled half-range null runs did not merge to "
+                    "EXACTLY the full-run verdicts (wire-format or "
+                    "accumulator merge drift)")
+        if not verdict.get("resume_ok", True):
+            return ("null run did not resume at the last completed "
+                    "chunk with a bit-identical p-map after the "
+                    "injected preemption (or the preempt fault "
+                    "never fired)")
+        return (f"null-engine p-value parity failure: max_err="
+                f"{verdict.get('max_err')} over tol="
+                f"{verdict.get('tol')} (accumulator counts vs "
+                "materialized distribution, or chunk-size "
+                "dependence)")
+
+    _run_selfcheck_gate(
+        findings, _STATS_CHILD, "STA001",
+        _rel(os.path.join(REPO, "brainiak_tpu", "stats",
+                          "selfcheck.py")),
+        "stats", classify)
+
+
 # -- external gate ----------------------------------------------------
 
 def run_external(findings):
@@ -1481,6 +1528,8 @@ def run_gates(only=None):
         timed("data", check_data, findings)
     if "realtime" in selected:
         timed("realtime", check_realtime, findings)
+    if "stats" in selected:
+        timed("stats", check_stats, findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
@@ -1502,7 +1551,7 @@ def run_gates(only=None):
                        "jaxlint-deep", "jaxlint-ir", "obs",
                        "obs-live", "regress", "serve", "service",
                        "federation", "fleet", "distla", "encoding",
-                       "kernels", "data", "realtime")
+                       "kernels", "data", "realtime", "stats")
            if g in selected])
     return {
         "ok": not findings,
