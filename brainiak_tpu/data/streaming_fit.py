@@ -443,7 +443,8 @@ def stream_fit_srm(store, *, features, n_iter, rand_seed=0, mesh=None,
         run_chunk, init_state, n_iter,
         checkpoint_dir=checkpoint_dir,
         checkpoint_every=checkpoint_every,
-        fingerprint=fingerprint, template=template, name=name)
+        fingerprint=fingerprint, template=template, name=name,
+        progress_objective="rho2", progress_direction="min")
 
     # -- output pass: materialize the final-iteration W per subject
     # (recomputed from the final shared response — bit-identical to

@@ -487,7 +487,8 @@ class RidgeEncoder:
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
             fingerprint=fingerprint,
-            template={"scores": np.zeros_like(zeros)}, name=name)
+            template={"scores": np.zeros_like(zeros)}, name=name,
+            progress_objective="scores", progress_direction="max")
         return state["scores"]
 
     def _fingerprint(self, checkpoint_dir, xs, yc, grid, block):

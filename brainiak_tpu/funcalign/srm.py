@@ -635,7 +635,8 @@ class SRM(_SRMBase):
             run_chunk, init_state, self.n_iter,
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
-            fingerprint=fingerprint, template=template, name="SRM.fit")
+            fingerprint=fingerprint, template=template, name="SRM.fit",
+            progress_objective="rho2", progress_direction="min")
         w, rho2, sigma_s, shared = final_leaves(state, step)
         ll = _final_log_likelihood(stacked, w, rho2, sigma_s, trace_j,
                                    counts_j, mesh=self.mesh)

@@ -43,7 +43,21 @@ PR 1's resilience events and PR 2's retrace lint:
   service --http-port``);
 - :mod:`~brainiak_tpu.obs.slo` (PR 12) — declarative objectives
   with multi-window burn-rate tracking: ``slo_violation`` events,
-  error-budget gauges on the exposition endpoint.
+  error-budget gauges on the exposition endpoint;
+- :mod:`~brainiak_tpu.obs.progress` (PR 19) — fit-progress and
+  convergence telemetry: every resilient fit owns a stable
+  ``fit_id`` (checkpoint-persisted across resumes), emits schema-v4
+  ``progress`` records per chunk (objective, delta, EWMA rate, ETA),
+  detects plateaus and fires ``divergence_precursor`` events before
+  the non-finite guard trips, and feeds the ``/jobs`` endpoint;
+- :mod:`~brainiak_tpu.obs.flight` (PR 19) — always-on bounded
+  flight-recorder ring of recent records; :func:`~flight.dump`
+  writes incident snapshots (auto-triggered on divergence aborts,
+  sanitizer trips, retry exhaustion, SLO violations, replica
+  deaths), rendered by ``python -m brainiak_tpu.obs postmortem``;
+- :mod:`~brainiak_tpu.obs.watch` (PR 19) — ``python -m
+  brainiak_tpu.obs watch`` live terminal view of active fits
+  (``--url`` scrapes ``/jobs``; ``--dir`` tails JSONL sinks).
 
 Disabled by default: with no sink configured every instrumentation
 site is a no-op (no records, no ``block_until_ready`` host syncs).
@@ -78,6 +92,17 @@ from .http import (  # noqa: F401
     TelemetryServer,
     parse_prometheus_text,
     render_prometheus,
+)
+from .flight import (  # noqa: F401
+    FLIGHT_DIR_ENV,
+    FLIGHT_RECORDS_ENV,
+)
+from .flight import dump as flight_dump  # noqa: F401
+from .flight import records as flight_records  # noqa: F401
+from .progress import (  # noqa: F401
+    FitProgress,
+    active_fits,
+    new_fit_id,
 )
 from .report import validate_bench_record  # noqa: F401
 from .sketch import QuantileSketch  # noqa: F401
@@ -124,6 +149,8 @@ from .spans import (  # noqa: F401
 )
 
 __all__ = [
+    "FLIGHT_DIR_ENV",
+    "FLIGHT_RECORDS_ENV",
     "HTTP_PORT_ENV",
     "OBS_DIR_ENV",
     "OBS_MAX_MB_ENV",
@@ -131,6 +158,7 @@ __all__ = [
     "SCHEMA_VERSION",
     "BurnRule",
     "Counter",
+    "FitProgress",
     "Gauge",
     "Histogram",
     "JsonlSink",
@@ -140,6 +168,7 @@ __all__ = [
     "QuantileSketch",
     "SLOTracker",
     "TelemetryServer",
+    "active_fits",
     "add_sink",
     "collect",
     "counted_cache",
@@ -151,11 +180,14 @@ __all__ = [
     "emit",
     "enabled",
     "event",
+    "flight_dump",
+    "flight_records",
     "gauge",
     "histogram",
     "install_compile_listener",
     "make_record",
     "memory_watermark",
+    "new_fit_id",
     "new_span_id",
     "new_trace_id",
     "parse_prometheus_text",

@@ -3,7 +3,10 @@
 Subcommands: ``report`` (aggregate summaries,
 :mod:`~brainiak_tpu.obs.report`), ``export`` (Chrome-trace timeline,
 :mod:`~brainiak_tpu.obs.export`), ``regress`` (bench regression
-gate, :mod:`~brainiak_tpu.obs.regress`).
+gate, :mod:`~brainiak_tpu.obs.regress`), ``postmortem`` (render a
+flight-recorder incident snapshot,
+:mod:`~brainiak_tpu.obs.postmortem`), ``watch`` (live fit-progress
+terminal view, :mod:`~brainiak_tpu.obs.watch`).
 """
 
 import sys
@@ -17,6 +20,12 @@ def main(argv=None):
         return sub(argv[1:])
     if command == "regress":
         from .regress import main as sub
+        return sub(argv[1:])
+    if command == "postmortem":
+        from .postmortem import main as sub
+        return sub(argv[1:])
+    if command == "watch":
+        from .watch import main as sub
         return sub(argv[1:])
     # report.main owns the legacy parser (including the error message
     # for an unknown/missing subcommand)

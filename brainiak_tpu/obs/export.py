@@ -16,6 +16,11 @@ converts one or more per-rank JSONL sinks (files or directories of
 - **metric** records become counter tracks (``ph="C"``): counters
   plot their running sum, gauges and histogram observations plot the
   raw value;
+- **progress** records (schema v4 fit telemetry from
+  :mod:`brainiak_tpu.obs.progress`) become two counter tracks per
+  fit in that rank's lane — the completion ratio and, when the fit
+  reports one, the objective trace — so a diverging fit's objective
+  blow-up lines up visually with its span/rollback timeline;
 - **traced** spans (schema v3 ``trace_id``/``span_id``/``parent_id``
   from :mod:`brainiak_tpu.obs.trace`) additionally become Chrome
   flow events (``ph="s"/"t"/"f"``, one flow per trace id): each
@@ -39,6 +44,7 @@ This module imports neither jax nor numpy — exports run anywhere.
 
 import argparse
 import json
+import math
 import sys
 
 from .report import iter_jsonl_paths, load_records
@@ -151,6 +157,27 @@ def chrome_trace(records):
                 "ts": us(end), "pid": rec["rank"], "tid": 0,
                 "args": {"value": _counter_value(counter_state, rec)},
             })
+        elif kind == "progress":
+            # one ratio track per fit (+ an objective track when the
+            # fit reports one), named so every chunk of a fit lands
+            # on the same counter in that rank's lane
+            fit = f"{rec['estimator']}:{rec['fit_id']}"
+            events.append({
+                "ph": "C", "name": f"fit_progress {fit}",
+                "ts": us(end), "pid": rec["rank"], "tid": 0,
+                "args": {"ratio": float(rec["ratio"])},
+            })
+            objective = rec.get("objective")
+            if objective is not None \
+                    and math.isfinite(float(objective)):
+                # a NaN/Inf objective has no plottable value (and
+                # would not round-trip as JSON) — the precursor
+                # event in the same lane marks the blow-up instant
+                events.append({
+                    "ph": "C", "name": f"fit_objective {fit}",
+                    "ts": us(end), "pid": rec["rank"], "tid": 0,
+                    "args": {"objective": float(objective)},
+                })
         else:  # event / cost
             args = dict(rec.get("attrs") or {})
             if kind == "cost":

@@ -1,0 +1,182 @@
+"""OBS003 selfcheck: the fit-progress telemetry plane, end to end.
+
+The ``obs-fit`` gate of ``tools/run_checks.py`` runs
+:func:`selfcheck` in a CPU-pinned child process, driving a toy
+chunked fit through :func:`~brainiak_tpu.resilience.guards.
+run_resilient_loop` twice:
+
+**Phase 1 — preemption parity.**  A checkpointed fit is preempted
+mid-flight (:func:`brainiak_tpu.resilience.faults.inject`) and
+rerun to completion.  The progress stream must show ONE ``fit_id``
+across both processes' worth of records, strictly monotone chunk
+indices spanning the resume point, and a cumulative
+``fit_wall_s`` that keeps growing across the resume (the
+wall-accounting carried in the checkpoint).
+
+**Phase 2 — divergence incident.**  A NaN fault poisons the
+objective leaf until the rollback budget exhausts.  The
+``divergence_precursor`` event must be timestamped no later than
+the first ``rollback`` (the tracker observes the chunk before the
+guard trips), the abort must auto-dump exactly one flight-recorder
+snapshot under ``$BRAINIAK_TPU_OBS_DIR/incidents`` whose manifest
+names the aborting fit, and ``python -m brainiak_tpu.obs
+postmortem`` must render that snapshot cleanly (exit 0, estimator
+named).
+
+Every record emitted along the way must validate against the
+current sink schema (v4).  Prints one JSON verdict line; exit 0 on
+success, 1 with the verdict naming what failed — the gate
+classifies from the verdict, not from a traceback.
+"""
+
+import contextlib
+import io
+import json
+import os
+import tempfile
+
+import numpy as np
+
+__all__ = ["selfcheck"]
+
+
+def _toy_chunk(state, step, n_steps):
+    """Deterministic toy fit: the objective leaf decays toward 0."""
+    # pure-numpy toy state; nothing here lives on a device
+    new = {k: np.array(v, copy=True)  # jaxlint: disable=JX002
+           for k, v in state.items()}
+    new["obj"] = (100.0  # jaxlint: disable=JX002
+                  / (1.0 + float(step + n_steps)) + 0.0 * new["obj"])
+    return new, False
+
+
+def _progress_records(mem, fit_id=None):
+    return [r for r in mem.records if r["kind"] == "progress"
+            and (fit_id is None or r["fit_id"] == fit_id)]
+
+
+def _event_ts(mem, name):
+    return [r["ts"] for r in mem.records
+            if r["kind"] == "event" and r["name"] == name]
+
+
+def selfcheck(n_iter=10, checkpoint_every=2):
+    """Run the fit-progress check (see module docstring); returns
+    the process exit code."""
+    from ..resilience import faults
+    from ..resilience.guards import DivergenceError, \
+        run_resilient_loop
+    from . import flight, postmortem, progress as obs_progress
+    from . import sink as obs_sink
+
+    verdict = {"ok": False, "n_iter": n_iter}
+    tmp = tempfile.mkdtemp(prefix="obs-fitcheck-")
+    # the incident auto-dump lands under $BRAINIAK_TPU_OBS_DIR; set
+    # it before any record is emitted so the env-driven JSONL sink
+    # and the flight recorder agree on the directory
+    os.environ[obs_sink.OBS_DIR_ENV] = tmp
+    os.environ["BRAINIAK_TPU_CHECKPOINT_NPZ"] = "1"
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        init = {"obj": np.full(4, 100.0)}
+        ckpt = os.path.join(tmp, "ckpt")
+
+        # -- phase 1: preempt mid-fit, resume, check id parity ----
+        try:
+            with faults.inject("preempt", at_step=4):
+                run_resilient_loop(
+                    _toy_chunk, init, n_iter,
+                    checkpoint_dir=ckpt,
+                    checkpoint_every=checkpoint_every,
+                    name="fitcheck", progress_objective="obj")
+            verdict["error"] = "preemption fault never fired"
+            raise SystemExit
+        except faults.PreemptionError:
+            pass
+        run_resilient_loop(
+            _toy_chunk, init, n_iter, checkpoint_dir=ckpt,
+            checkpoint_every=checkpoint_every, name="fitcheck",
+            progress_objective="obj")
+        recs = _progress_records(mem)
+        verdict["n_progress"] = len(recs)
+        fit_ids = {r["fit_id"] for r in recs}
+        verdict["fit_id_stable"] = len(fit_ids) == 1
+        chunks = [r["chunk"] for r in recs]
+        verdict["chunks"] = chunks
+        verdict["chunks_monotone"] = (
+            chunks == sorted(chunks) and len(set(chunks)) ==
+            len(chunks) and
+            len(chunks) == -(-n_iter // checkpoint_every))
+        walls = [r["fit_wall_s"] for r in recs]
+        verdict["wall_cumulative"] = all(
+            b > a for a, b in zip(walls, walls[1:]))
+
+        # -- phase 2: NaN divergence -> precursor, dump, postmortem
+        mem.records.clear()
+        obs_progress.clear_registry()
+        flight.clear()
+        aborted = False
+        try:
+            with faults.inject("nan", at_step=6, times=10,
+                               leaf="obj"):
+                run_resilient_loop(
+                    _toy_chunk, init, n_iter,
+                    checkpoint_every=checkpoint_every,
+                    max_rollbacks=1, name="fitcheck",
+                    progress_objective="obj")
+        except DivergenceError:
+            aborted = True
+        verdict["aborted"] = aborted
+        precursors = _event_ts(mem, "divergence_precursor")
+        rollbacks = _event_ts(mem, "rollback")
+        verdict["precursor_fired"] = bool(precursors)
+        verdict["precursor_before_guard"] = bool(
+            precursors and rollbacks
+            and precursors[0] <= rollbacks[0])
+        abort_fit = [r for r in mem.records
+                     if r["kind"] == "event"
+                     and r["name"] == "divergence_abort"]
+        fit_id = abort_fit[0].get("fit_id") if abort_fit else None
+        snapdir = os.path.join(tmp, "incidents")
+        snaps = sorted(os.listdir(snapdir)) \
+            if os.path.isdir(snapdir) else []
+        verdict["n_snapshots"] = len(snaps)
+        snapshot_ok = False
+        postmortem_ok = False
+        if len(snaps) == 1:
+            path = os.path.join(snapdir, snaps[0])
+            with open(os.path.join(path, "manifest.json"),
+                      encoding="utf-8") as fh:
+                manifest = json.load(fh)
+            snapshot_ok = (
+                manifest.get("trigger") == "divergence_abort"
+                and fit_id is not None
+                and manifest.get("fit_id") == fit_id)
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = postmortem.main([path])
+            postmortem_ok = rc == 0 and "fitcheck" in out.getvalue()
+            verdict["postmortem_rc"] = rc
+        verdict["snapshot_ok"] = snapshot_ok
+        verdict["postmortem_ok"] = postmortem_ok
+
+        # -- every record must be schema-clean --------------------
+        schema_errors = []
+        for rec in mem.records:
+            schema_errors.extend(obs_sink.validate_record(rec))
+        verdict["schema_errors"] = schema_errors[:5]
+
+        verdict["ok"] = bool(
+            verdict["fit_id_stable"] and verdict["chunks_monotone"]
+            and verdict["wall_cumulative"] and aborted
+            and verdict["precursor_before_guard"] and snapshot_ok
+            and postmortem_ok and not schema_errors)
+    except SystemExit:
+        pass
+    except Exception as exc:  # the gate wants a verdict, not a trace
+        verdict["error"] = f"{type(exc).__name__}: {exc}"
+    finally:
+        obs_sink.remove_sink(mem)
+        obs_sink.close_all()
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1

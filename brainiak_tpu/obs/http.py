@@ -17,7 +17,12 @@ one ``http.server`` daemon thread serving
 - ``/readyz`` — readiness: delegates to an injectable callback
   (:class:`~brainiak_tpu.serve.service.ServeService` wires its
   residency + AOT warm state here) and answers 200 or 503 with a
-  JSON detail body either way.
+  JSON detail body either way;
+- ``/jobs`` — the active-fit registry
+  (:func:`brainiak_tpu.obs.progress.active_fits`) as JSON: every
+  running (and recently finished) resilient fit with its progress
+  ratio, ETA, objective trend, and rollback count — the live view
+  ``python -m brainiak_tpu.obs watch`` polls.
 
 Opt-in: nothing listens unless a port is given — programmatically,
 via ``serve service --http-port``, or through the
@@ -337,10 +342,17 @@ class TelemetryServer:
                 self._respond(handler, 200, "ok\n", "text/plain")
             elif path == "/readyz":
                 self._ready(handler)
+            elif path == "/jobs":
+                from . import progress as obs_progress
+                body = json.dumps(
+                    {"fits": obs_progress.active_fits()},
+                    indent=2, sort_keys=True) + "\n"
+                self._respond(handler, 200, body,
+                              "application/json")
             else:
                 self._respond(handler, 404,
                               f"unknown path {path!r}; endpoints: "
-                              "/metrics /healthz /readyz\n",
+                              "/metrics /healthz /readyz /jobs\n",
                               "text/plain")
         except Exception:  # exposition must never kill the server
             logger.exception("obs http handler failed for %s", path)

@@ -1,0 +1,191 @@
+"""Render flight-recorder incident snapshots.
+
+``python -m brainiak_tpu.obs postmortem <snapshot>`` reads a snapshot
+written by :func:`brainiak_tpu.obs.flight.dump` — a directory holding
+``manifest.json`` + ``records.jsonl`` (either file also accepted
+directly) — and renders the incident for a human: the trigger and
+implicated fit/trace, the failing chunk and site from the manifest's
+last-known state, each fit's objective tail (the last few values
+before the lights went out), and a timeline of the final records in
+the ring.  Exit 0 on a rendered snapshot, 1 on an unreadable or
+malformed one.
+
+This module imports neither jax nor numpy — postmortems run anywhere
+(a laptop reading a snapshot scp'd off the pod).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+__all__ = ["load_snapshot", "main", "render"]
+
+#: Timeline rows rendered from the tail of the ring.
+TIMELINE_TAIL = 20
+
+#: Objective values shown per fit (the convergence tail).
+OBJECTIVE_TAIL = 5
+
+
+def load_snapshot(path):
+    """Read ``(manifest, records)`` from a snapshot directory (or
+    either of its files); raises ``ValueError`` on malformed input,
+    ``OSError`` on unreadable paths."""
+    if os.path.isdir(path):
+        manifest_path = os.path.join(path, "manifest.json")
+        records_path = os.path.join(path, "records.jsonl")
+    elif path.endswith("manifest.json"):
+        manifest_path = path
+        records_path = os.path.join(os.path.dirname(path),
+                                    "records.jsonl")
+    else:
+        records_path = path
+        manifest_path = os.path.join(os.path.dirname(path),
+                                     "manifest.json")
+    manifest = {}
+    if os.path.exists(manifest_path):
+        with open(manifest_path, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+        if not isinstance(manifest, dict):
+            raise ValueError(
+                f"{manifest_path}: manifest is not an object")
+    records = []
+    with open(records_path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError as exc:
+                raise ValueError(
+                    f"{records_path}:{lineno}: bad JSON ({exc})")
+    return manifest, records
+
+
+def _fmt_ts(ts, t0):
+    try:
+        return f"+{float(ts) - t0:8.3f}s"
+    except (TypeError, ValueError):
+        return " " * 10
+
+
+def _describe(rec):
+    kind = rec.get("kind")
+    name = rec.get("name", "?")
+    if kind == "progress":
+        parts = [f"chunk {rec.get('chunk')}",
+                 f"step {rec.get('step')}/{rec.get('n_iter', '?')}"]
+        if rec.get("objective") is not None:
+            parts.append(f"objective={rec['objective']:.6g}")
+        return f"progress  {rec.get('estimator')}: " \
+               + ", ".join(parts)
+    if kind == "span":
+        return f"span      {rec.get('path', name)} " \
+               f"({rec.get('dur_s', 0):.4f}s)"
+    if kind == "event":
+        attrs = rec.get("attrs") or {}
+        keys = ("estimator", "site", "step", "reason", "leaves",
+                "slo", "replica", "error", "status")
+        detail = ", ".join(f"{k}={attrs[k]}" for k in keys
+                           if k in attrs)
+        return f"event     {name}" + (f" [{detail}]" if detail
+                                      else "")
+    if kind == "metric":
+        return f"metric    {name} = {rec.get('value')}"
+    return f"{kind or '?':9s} {name}"
+
+
+def render(manifest, records):
+    """Human-readable postmortem text for a loaded snapshot."""
+    lines = ["flight-recorder postmortem"]
+    trigger = manifest.get("trigger", "unknown")
+    ts = manifest.get("ts")
+    when = time.strftime("%Y-%m-%d %H:%M:%S",
+                         time.localtime(ts)) if ts else "?"
+    lines.append(f"  trigger: {trigger}  at {when}")
+    if manifest.get("fit_id"):
+        lines.append(f"  fit_id: {manifest['fit_id']}")
+    if manifest.get("trace_id"):
+        lines.append(f"  trace_id: {manifest['trace_id']}")
+    state = manifest.get("state") or {}
+    for key in sorted(state):
+        lines.append(f"  {key}: {state[key]}")
+    lines.append(f"  ring: {len(records)} record(s)"
+                 + (f" (capacity {manifest['capacity']})"
+                    if manifest.get("capacity") else ""))
+
+    # per-fit objective tails + failing chunk, from the ring's
+    # progress stream (newest records win)
+    fits = {}
+    for rec in records:
+        if rec.get("kind") != "progress":
+            continue
+        cur = fits.setdefault(rec.get("fit_id"), {
+            "estimator": rec.get("estimator"),
+            "objectives": [], "last": rec})
+        cur["last"] = rec
+        if rec.get("objective") is not None:
+            cur["objectives"].append(
+                (rec.get("step"), rec["objective"]))
+    for fit_id, cur in fits.items():
+        last = cur["last"]
+        lines.append("")
+        marker = "  <-- implicated" \
+            if fit_id == manifest.get("fit_id") else ""
+        lines.append(f"fit {fit_id} [{cur['estimator']}]{marker}")
+        lines.append(
+            f"  last chunk: {last.get('chunk')}"
+            f" (step {last.get('step')}/{last.get('n_iter', '?')},"
+            f" rollbacks {last.get('rollbacks', 0)})")
+        tail = cur["objectives"][-OBJECTIVE_TAIL:]
+        if tail:
+            lines.append("  objective tail: " + ", ".join(
+                f"{v:.6g}@{s}" for s, v in tail))
+
+    events = [r for r in records if r.get("kind") == "event"
+              and r.get("name") in (
+                  "divergence_precursor", "rollback",
+                  "divergence_abort", "sanitizer", "fault",
+                  "retry_exhausted", "slo_violation",
+                  "replica_dead", "fit_finished")]
+    if events:
+        lines.append("")
+        lines.append("incident events:")
+        t0 = float(records[0].get("ts", 0.0)) if records else 0.0
+        for rec in events:
+            lines.append(f"  {_fmt_ts(rec.get('ts'), t0)}  "
+                         + _describe(rec))
+
+    lines.append("")
+    lines.append(f"timeline (last {TIMELINE_TAIL} records):")
+    t0 = float(records[0].get("ts", 0.0)) if records else 0.0
+    for rec in records[-TIMELINE_TAIL:]:
+        lines.append(f"  {_fmt_ts(rec.get('ts'), t0)}  "
+                     + _describe(rec))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m brainiak_tpu.obs postmortem",
+        description="render a flight-recorder incident snapshot "
+                    "(docs/observability.md)")
+    parser.add_argument(
+        "snapshot",
+        help="snapshot directory written by the flight recorder "
+             "(or its manifest.json / records.jsonl)")
+    args = parser.parse_args(argv)
+    try:
+        manifest, records = load_snapshot(args.snapshot)
+    except (OSError, ValueError) as exc:
+        print(f"obs postmortem: {exc}", file=sys.stderr)
+        return 1
+    print(render(manifest, records))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - module smoke entry
+    sys.exit(main())

@@ -18,7 +18,13 @@ fails the run, which is what the ``obs`` gate of
   named by the record's ``span``/``estimator`` hints to derive
   achieved FLOP/s and — when the record carries a platform peak — the
   roofline ratio (achieved / peak; 1.0 would be a compute-bound
-  program running at the hardware ceiling).
+  program running at the hardware ceiling);
+- **fits** (schema v4, :mod:`brainiak_tpu.obs.progress`): one row per
+  ``fit_id`` — estimator, chunks done, last step / iteration budget,
+  rollbacks, ETA at the last record, and a converged / diverged /
+  interrupted verdict (diverged when the trace carries that fit's
+  ``divergence_abort`` event; converged when its last record reached
+  the budget or a plateau).
 
 ``--top N`` additionally lists the N slowest individual spans per
 estimator, so a trace is triageable without exporting to a viewer.
@@ -278,9 +284,22 @@ def aggregate(records):
     events = {}
     metrics = {}
     costs = []
+    fits = {}
+    aborted = set()   # fit_ids with a divergence_abort event
+    precursor = set()  # fit_ids with a divergence_precursor event
+    finished = {}     # fit_id -> fit_finished status attr
     dropped = 0
     for rec in records:
         kind = rec["kind"]
+        if kind == "event" and rec.get("fit_id"):
+            if rec["name"] == "divergence_abort":
+                aborted.add(rec["fit_id"])
+            elif rec["name"] == "divergence_precursor":
+                precursor.add(rec["fit_id"])
+            elif rec["name"] == "fit_finished":
+                status = (rec.get("attrs") or {}).get("status")
+                if isinstance(status, str):
+                    finished[rec["fit_id"]] = status
         if kind == "event" and rec["name"] == "obs_dropped":
             # the truncated sink's close-time drop count: surface it
             # as a headline so a capped trace reads as incomplete,
@@ -305,6 +324,32 @@ def aggregate(records):
             cur["max_s"] = max(cur["max_s"], float(rec["dur_s"]))
         elif kind == "event":
             events[rec["name"]] = events.get(rec["name"], 0) + 1
+        elif kind == "progress":
+            cur = fits.setdefault(rec["fit_id"], {
+                "fit_id": rec["fit_id"],
+                "estimator": rec["estimator"],
+                "chunks": 0, "step": 0, "n_iter": None,
+                "ratio": 0.0, "rollbacks": 0, "objective": None,
+                "eta_s": None, "plateaued": False,
+                "_last_ts": None})
+            cur["chunks"] = max(cur["chunks"], int(rec["chunk"]))
+            try:
+                cur["rollbacks"] = max(cur["rollbacks"],
+                                       int(rec.get("rollbacks", 0)))
+            except (TypeError, ValueError):
+                pass
+            # fields "at the last record" follow the record
+            # timestamp, not file-read order (multi-rank traces)
+            ts = float(rec["ts"])
+            if cur["_last_ts"] is None or ts >= cur["_last_ts"]:
+                cur["_last_ts"] = ts
+                cur["step"] = int(rec["step"])
+                cur["ratio"] = float(rec["ratio"])
+                if rec.get("n_iter") is not None:
+                    cur["n_iter"] = int(rec["n_iter"])
+                cur["objective"] = rec.get("objective")
+                cur["eta_s"] = rec.get("eta_s")
+                cur["plateaued"] = bool(rec.get("plateaued", False))
         else:  # metric
             labels = rec.get("labels") or {}
             key = (rec["name"], rec["mtype"], _labels_id(labels))
@@ -350,6 +395,22 @@ def aggregate(records):
                                     _labels_id(r["labels"])))
     costs.sort(key=lambda r: (r["site"], r.get("level") or ""))
     _roofline(costs, span_rows)
+    fit_rows = []
+    for cur in fits.values():
+        del cur["_last_ts"]
+        if cur["fit_id"] in aborted \
+                or finished.get(cur["fit_id"]) == "diverged":
+            cur["verdict"] = "diverged"
+        elif cur["fit_id"] in finished:
+            cur["verdict"] = "converged"
+        elif cur["ratio"] >= 1.0 or cur["plateaued"]:
+            cur["verdict"] = "converged"
+        elif cur["fit_id"] in precursor:
+            cur["verdict"] = "diverging"
+        else:
+            cur["verdict"] = "interrupted"
+        fit_rows.append(cur)
+    fit_rows.sort(key=lambda r: (r["estimator"], r["fit_id"]))
     return {
         "n_records": len(records),
         "dropped_records": dropped,
@@ -358,6 +419,7 @@ def aggregate(records):
                    for name, count in sorted(events.items())],
         "metrics": metric_rows,
         "cost": costs,
+        "fits": fit_rows,
     }
 
 
@@ -405,6 +467,23 @@ def render_text(summary):
         lines.append("events:")
         for row in summary["events"]:
             lines.append(f"  {row['count']:>6}  {row['name']}")
+    if summary.get("fits"):
+        lines.append("")
+        lines.append("fits:")
+        for row in summary["fits"]:
+            budget = row["n_iter"] if row["n_iter"] is not None \
+                else "?"
+            parts = [f"chunks={row['chunks']}",
+                     f"step={row['step']}/{budget}",
+                     f"rollbacks={row['rollbacks']}"]
+            if row["objective"] is not None:
+                parts.append(
+                    f"objective={_fmt_quantity(row['objective'])}")
+            if row["eta_s"] is not None:
+                parts.append(f"eta={row['eta_s']:.1f}s")
+            lines.append(
+                f"  {row['fit_id']}  [{row['estimator']}] "
+                + " ".join(parts) + f"  -> {row['verdict']}")
     if summary.get("cost"):
         lines.append("")
         lines.append("cost profiles:")

@@ -132,5 +132,10 @@ def call_checked(fn, args, site, scope, codes=_CHECK_CODES,
             "sanitizer_errors_total",
             help="checkify errors caught by the sanitizer "
                  "lane").inc(site=site, scope=scope)
+        from . import flight
+        flight.dump("sanitizer",
+                    state={"site": site, "scope": scope,
+                           "error": message.splitlines()[0].strip(),
+                           "codes": list(codes)})
         return message, out
     return None, out

@@ -63,12 +63,16 @@ OBS_RANK_ENV = "BRAINIAK_TPU_OBS_RANK"
 #: ``cost`` kind (XLA cost-analysis attribution, see
 #: :mod:`brainiak_tpu.obs.profile`); v3 (PR 12) added the optional
 #: request-tracing fields ``trace_id``/``span_id``/``parent_id`` on
-#: span and event records (:mod:`brainiak_tpu.obs.trace`).  v1/v2
-#: records remain valid, so pre-existing traces keep loading.
-SCHEMA_VERSION = 3
-ACCEPTED_VERSIONS = (1, 2, 3)
+#: span and event records (:mod:`brainiak_tpu.obs.trace`).  v4
+#: (PR 19) added the ``progress`` kind (per-chunk fit progress /
+#: convergence telemetry, :mod:`brainiak_tpu.obs.progress`) and the
+#: optional ``fit_id`` field on span and event records so a fit's
+#: spans/events join its progress stream.  v1–v3 records remain
+#: valid, so pre-existing traces keep loading.
+SCHEMA_VERSION = 4
+ACCEPTED_VERSIONS = (1, 2, 3, 4)
 
-KINDS = ("span", "event", "metric", "cost")
+KINDS = ("span", "event", "metric", "cost", "progress")
 METRIC_TYPES = ("counter", "gauge", "histogram")
 
 OBS_MAX_MB_ENV = "BRAINIAK_TPU_OBS_MAX_MB"
@@ -83,16 +87,22 @@ _REQUIRED = {
     "event": {},
     "metric": {"mtype": str, "value": _NUM},
     "cost": {"site": str},
+    # progress (schema v4): one record per resilient-loop chunk
+    # (obs.progress) — the fit_id joins a fit's records across
+    # process restarts (it rides in the checkpoint)
+    "progress": {"fit_id": str, "estimator": str, "chunk": _NUM,
+                 "step": _NUM, "ratio": _NUM},
 }
 _OPTIONAL = {
     # trace_id/span_id/parent_id (schema v3): request-scoped tracing
     # (obs.trace) — a span/event may belong to one request's
     # end-to-end trace, with parent_id naming the causally-preceding
-    # span so the export CLI reconstructs per-request flows
+    # span so the export CLI reconstructs per-request flows;
+    # fit_id (schema v4): the owning fit's progress stream
     "span": {"attrs": dict, "trace_id": str, "span_id": str,
-             "parent_id": str},
+             "parent_id": str, "fit_id": str},
     "event": {"attrs": dict, "trace_id": str, "span_id": str,
-              "parent_id": str},
+              "parent_id": str, "fit_id": str},
     "metric": {"labels": dict, "unit": str},
     # cost: FLOPs/bytes may be absent (backend without cost_analysis
     # reports `unavailable` instead); span/estimator are join hints
@@ -102,6 +112,12 @@ _OPTIONAL = {
              "hlo_bytes": int, "hlo_lines": int, "peak_flops": _NUM,
              "level": str, "backend": str, "span": str,
              "estimator": str, "unavailable": str, "attrs": dict},
+    # objective / ETA telemetry may be absent: a fit without a
+    # progress_objective hint still reports chunk cadence and ratio
+    "progress": {"n_chunks": _NUM, "n_iter": _NUM, "epoch": _NUM,
+                 "objective": _NUM, "delta": _NUM, "rollbacks": _NUM,
+                 "chunk_s": _NUM, "fit_wall_s": _NUM, "rate": _NUM,
+                 "eta_s": _NUM, "plateaued": bool, "attrs": dict},
 }
 
 
@@ -124,6 +140,8 @@ def validate_record(rec):
         return errors
     if kind == "cost" and isinstance(v, int) and v < 2:
         errors.append("cost records require schema v>=2")
+    if kind == "progress" and isinstance(v, int) and v < 4:
+        errors.append("progress records require schema v>=4")
     if not isinstance(rec.get("ts"), (int, float)):
         errors.append("ts missing or not a number")
     if not isinstance(rec.get("rank"), int):
@@ -479,7 +497,13 @@ def emit(record):
     full) is logged once and DISABLED for the rest of the process
     instead of propagating into the fit/retry/fetch call that
     happened to emit the record.
+
+    Every emitted record is additionally mirrored into the
+    flight-recorder ring (:mod:`brainiak_tpu.obs.flight`) so an
+    incident snapshot carries the records that led up to it.
     """
+    from . import flight
+    flight.record(record)
     for sink in all_sinks():
         try:
             sink.write(record)
@@ -512,10 +536,14 @@ def event(name, **attrs):
     """Emit an ``event`` record (no-op while obs is disabled).
 
     The one-liner instrumentation sites use: attribute values must be
-    JSON-serializable (numpy scalars are coerced)."""
+    JSON-serializable (numpy scalars are coerced).  A ``fit_id``
+    attribute is promoted to the record's top-level schema-v4 field so
+    the event joins that fit's progress stream."""
     if not enabled():
         return None
-    return emit(make_record("event", name, attrs=attrs or None))
+    fit_id = attrs.pop("fit_id", None)
+    return emit(make_record("event", name, attrs=attrs or None,
+                            fit_id=fit_id))
 
 
 def close_all():

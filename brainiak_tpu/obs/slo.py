@@ -315,6 +315,17 @@ class SLOTracker:
                 rule=rule.label(), factor=rule.factor,
                 burn_rates={f"{w:g}s": round(b, 4)
                             for w, b in burns.items()})
+            # a violation TRANSITION is an incident: snapshot the
+            # flight ring so the requests that burned the budget are
+            # preserved (re-entering violation re-dumps; steady-state
+            # violation does not)
+            from . import flight
+            flight.dump(
+                "slo_violation",
+                state={"slo": objective.name, "rule": rule.label(),
+                       "factor": rule.factor,
+                       "burn_rates": {f"{w:g}s": round(b, 4)
+                                      for w, b in burns.items()}})
         return {"objectives": out, "n_violations": n_violations}
 
     def summary(self):

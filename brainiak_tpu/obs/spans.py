@@ -142,9 +142,13 @@ def span(name, sync=None, attrs=None):
         if target is not None:
             _block_until_ready(target)
         dt = time.perf_counter() - t0
+        # a fit_id attr is promoted to the top-level schema-v4 field
+        # (same contract as sink.event) so fit_chunk spans join their
+        # fit's progress stream
+        fit_id = frame.attrs.pop("fit_id", None)
         sink.emit(sink.make_record(
             "span", name, path=path, dur_s=dt,
-            attrs=frame.attrs or None))
+            attrs=frame.attrs or None, fit_id=fit_id))
 
 
 def _block_until_ready(target):

@@ -1,0 +1,211 @@
+"""Live terminal view of active fits and recent incidents.
+
+``python -m brainiak_tpu.obs watch [--url URL | --dir DIR]`` polls a
+fit-progress source and renders a table per refresh:
+
+- ``--url`` scrapes a :class:`~brainiak_tpu.obs.http.TelemetryServer`
+  ``/jobs`` endpoint (a live process's in-memory registry);
+- ``--dir`` tails the ``progress`` records of an obs JSONL directory
+  (default: ``$BRAINIAK_TPU_OBS_DIR``) — the cross-process view, and
+  the only one that works after the fit process exited;
+
+plus the ``incidents/`` snapshots under the watched directory (or
+``$BRAINIAK_TPU_OBS_DIR``), newest first.  ``--once`` renders a
+single frame and exits (tests and scripting); otherwise the view
+refreshes every ``--interval`` seconds until interrupted.
+
+This module imports neither jax nor numpy — a watch terminal must
+never be the process that first touches a wedged device.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+import urllib.request
+
+from .sink import OBS_DIR_ENV
+
+__all__ = ["fits_from_dir", "fits_from_url", "main", "render_frame"]
+
+BAR_WIDTH = 20
+
+
+def fits_from_url(url, timeout=5.0):
+    """Fit snapshots from a ``/jobs`` endpoint (``url`` may name the
+    server root or the ``/jobs`` path)."""
+    if not url.rstrip("/").endswith("/jobs"):
+        url = url.rstrip("/") + "/jobs"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        payload = json.load(resp)
+    return list(payload.get("fits", []))
+
+
+def fits_from_dir(directory):
+    """Fit snapshots reconstructed from the ``progress`` records of
+    every ``*.jsonl`` file under ``directory`` (last record per
+    fit_id wins, by record timestamp)."""
+    fits = {}
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "*.jsonl"))):
+        try:
+            fh = open(path, encoding="utf-8")
+        except OSError:
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") == "progress":
+                    cur = fits.get(rec.get("fit_id"))
+                    if cur is None or float(rec.get("ts", 0)) >= \
+                            float(cur.get("ts", 0)):
+                        fits[rec["fit_id"]] = rec
+                elif rec.get("kind") == "event" \
+                        and rec.get("name") == "fit_finished" \
+                        and rec.get("fit_id") in fits:
+                    status = (rec.get("attrs") or {}).get("status")
+                    if status:
+                        fits[rec["fit_id"]] = dict(
+                            fits[rec["fit_id"]], status=status)
+    return [fits[k] for k in sorted(fits)]
+
+
+def recent_incidents(directory, limit=5):
+    """The newest incident-snapshot manifests under
+    ``directory/incidents`` (or ``directory`` itself when it already
+    is the incidents dir), newest first."""
+    if not directory:
+        return []
+    roots = [os.path.join(directory, "incidents"), directory]
+    manifests = []
+    for root in roots:
+        manifests = sorted(
+            glob.glob(os.path.join(root, "*", "manifest.json")),
+            key=os.path.getmtime, reverse=True)
+        if manifests:
+            break
+    out = []
+    for path in manifests[:limit]:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        manifest["_path"] = os.path.dirname(path)
+        out.append(manifest)
+    return out
+
+
+def _bar(ratio):
+    try:
+        ratio = min(max(float(ratio), 0.0), 1.0)
+    except (TypeError, ValueError):
+        ratio = 0.0
+    full = int(round(ratio * BAR_WIDTH))
+    return "[" + "#" * full + "-" * (BAR_WIDTH - full) + "]"
+
+
+def _fmt_eta(eta):
+    if eta is None:
+        return "-"
+    eta = float(eta)
+    if eta >= 3600:
+        return f"{eta / 3600:.1f}h"
+    if eta >= 60:
+        return f"{eta / 60:.1f}m"
+    return f"{eta:.0f}s"
+
+
+def render_frame(fits, incidents=(), now=None):
+    """One text frame: the fit table plus recent incidents."""
+    now = time.time() if now is None else now
+    when = time.strftime("%H:%M:%S", time.localtime(now))
+    lines = [f"obs watch  {when}  ({len(fits)} fit(s))"]
+    if fits:
+        lines.append(
+            f"  {'fit_id':16s} {'estimator':20s} "
+            f"{'progress':{BAR_WIDTH + 2}s} {'step':>12s} "
+            f"{'objective':>12s} {'eta':>7s} {'rb':>3s}  status")
+    for fit in fits:
+        step = f"{fit.get('step', '?')}/{fit.get('n_iter', '?')}"
+        objective = fit.get("objective")
+        objective = "-" if objective is None else f"{objective:.5g}"
+        status = fit.get("status", "running")
+        lines.append(
+            f"  {str(fit.get('fit_id', '?')):16s} "
+            f"{str(fit.get('estimator', '?'))[:20]:20s} "
+            f"{_bar(fit.get('ratio'))} {step:>12s} "
+            f"{objective:>12s} {_fmt_eta(fit.get('eta_s')):>7s} "
+            f"{fit.get('rollbacks', 0):>3} "
+            f" {status}")
+    if not fits:
+        lines.append("  (no fits reported yet)")
+    if incidents:
+        lines.append("")
+        lines.append("recent incidents:")
+        for manifest in incidents:
+            ts = manifest.get("ts")
+            when = time.strftime("%H:%M:%S", time.localtime(ts)) \
+                if ts else "?"
+            fit_id = manifest.get("fit_id") or "-"
+            lines.append(
+                f"  {when}  {manifest.get('trigger', '?'):18s} "
+                f"fit={fit_id}  {manifest['_path']}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m brainiak_tpu.obs watch",
+        description="live terminal view of active fits "
+                    "(docs/observability.md)")
+    source = parser.add_mutually_exclusive_group()
+    source.add_argument(
+        "--url", help="TelemetryServer base URL (or its /jobs path)")
+    source.add_argument(
+        "--dir", dest="directory",
+        help=f"obs JSONL directory (default: ${OBS_DIR_ENV})")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh period in seconds")
+    parser.add_argument("--once", action="store_true",
+                        help="render one frame and exit")
+    args = parser.parse_args(argv)
+
+    directory = args.directory
+    if args.url is None and directory is None:
+        directory = os.environ.get(OBS_DIR_ENV)
+        if not directory:
+            parser.error(
+                f"give --url or --dir (or set ${OBS_DIR_ENV})")
+    while True:
+        try:
+            fits = fits_from_url(args.url) if args.url \
+                else fits_from_dir(directory)
+        except OSError as exc:
+            print(f"obs watch: source unreachable ({exc})",
+                  file=sys.stderr)
+            if args.once:
+                return 1
+            fits = []
+        incidents = recent_incidents(
+            directory or os.environ.get(OBS_DIR_ENV) or "")
+        print(render_frame(fits, incidents))
+        if args.once:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:  # pragma: no cover - interactive
+            return 0
+        print()
+
+
+if __name__ == "__main__":  # pragma: no cover - module smoke entry
+    sys.exit(main())

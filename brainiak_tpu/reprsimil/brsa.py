@@ -689,7 +689,8 @@ class BRSA(BaseEstimator, TransformerMixin):
             run_chunk, pack(X0, None, False), n_rounds,
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
-            fingerprint=fingerprint, name="BRSA.fit")
+            fingerprint=fingerprint, name="BRSA.fit",
+            progress_objective="res_loss", progress_direction="min")
         X0, result = unpack(state)
 
         self.U_ = result["U"]

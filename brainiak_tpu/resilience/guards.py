@@ -39,8 +39,10 @@ import time
 import numpy as np
 
 from . import faults
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
+from ..obs import progress as obs_progress
 from ..obs import sanitize as obs_sanitize
 from ..obs import sink as obs_sink
 from ..obs import spans as obs_spans
@@ -201,10 +203,36 @@ def _fingerprint_mismatch(saved, fingerprint):
     return not np.allclose(saved, fingerprint, rtol=1e-10, atol=0.0)
 
 
+#: Checkpoint bookkeeping leaves the loop owns (stripped from the
+#: state handed to ``run_chunk``): the data/config fingerprint, the
+#: fit_id (uint8[16] of its hex digits), and [cumulative wall
+#: seconds, cumulative chunk count] — the latter two so a resumed
+#: fit continues the same progress stream with honest rate/ETA
+#: accounting instead of restarting the clock from zero.
+_CKPT_META = ("fingerprint", "fit_id", "fit_wall")
+
+
+def _encode_fit_id(fit_id):
+    return np.frombuffer(fit_id.encode("ascii"),
+                         dtype=np.uint8).copy()
+
+
+def _decode_fit_id(leaf):
+    try:
+        raw = bytes(np.asarray(leaf).astype(np.uint8).tolist())
+        fit_id = raw.decode("ascii")
+        int(fit_id, 16)  # trace-id shaped or bust
+        return fit_id
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
 def run_resilient_loop(run_chunk, init_state, n_iter, *,
                        checkpoint_dir=None, checkpoint_every=5,
                        fingerprint=None, template=None, max_rollbacks=2,
-                       name="fit", guard_skip=(), guard_nan_only=False):
+                       name="fit", guard_skip=(), guard_nan_only=False,
+                       progress_objective=None,
+                       progress_direction="min"):
     """Drive an iterative fit resiliently; returns ``(state, step)``.
 
     Parameters
@@ -238,6 +266,21 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
         Label for logs and errors.
     guard_skip, guard_nan_only
         Forwarded to :func:`check_state`.
+    progress_objective : str or callable, optional
+        Objective hint for the fit-progress tracker
+        (:class:`brainiak_tpu.obs.progress.FitProgress`): a state
+        leaf name (reduced with ``np.mean``) or ``state -> float``.
+        Without it the fit still reports chunk cadence / ratio / ETA
+        but no objective-trend telemetry.
+    progress_direction : {"min", "max"}
+        Whether ``progress_objective`` should decrease or increase
+        as the fit converges (drives the divergence precursor).
+
+    Every run owns a stable ``fit_id`` (persisted in the checkpoint,
+    so a resume continues the same id) and emits one schema-v4
+    ``progress`` record per chunk; on divergence abort the flight
+    recorder dumps an incident snapshot
+    (:func:`brainiak_tpu.obs.flight.dump`).
     """
     from ..utils.checkpoint import CheckpointManager
 
@@ -249,13 +292,18 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
     mngr = None
     step = 0
     state = init_state
+    saved_fit_id = None
+    saved_wall, saved_chunks = 0.0, 0
     if checkpoint_dir is not None:
         mngr = CheckpointManager(checkpoint_dir)
         tpl = template
-        if tpl is not None and fingerprint is not None:
-            tpl = dict(tpl,
-                       fingerprint=np.zeros_like(
-                           np.asarray(fingerprint, dtype=float)))
+        if tpl is not None:
+            meta = {"fit_id": np.zeros(16, dtype=np.uint8),
+                    "fit_wall": np.zeros(2, dtype=float)}
+            if fingerprint is not None:
+                meta["fingerprint"] = np.zeros_like(
+                    np.asarray(fingerprint, dtype=float))
+            tpl = dict(tpl, **meta)
         saved_step, saved = mngr.restore(template=tpl)
         if saved is not None:
             if fingerprint is not None and _fingerprint_mismatch(
@@ -269,15 +317,29 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
                     "Checkpoint is at iteration {} but n_iter={}; use "
                     "a fresh checkpoint_dir or raise n_iter".format(
                         saved_step, n_iter))
+            if "fit_id" in saved:
+                saved_fit_id = _decode_fit_id(saved["fit_id"])
+            if "fit_wall" in saved:
+                wall = np.asarray(saved["fit_wall"],
+                                  dtype=float).reshape(-1)
+                if wall.size >= 2 and np.all(np.isfinite(wall[:2])):
+                    saved_wall = float(wall[0])
+                    saved_chunks = int(wall[1])
             state = {k: v for k, v in saved.items()
-                     if k != "fingerprint"}
+                     if k not in _CKPT_META}
             step = saved_step
             logger.info("%s: resumed from checkpoint at iteration %d",
                         name, step)
-            obs_sink.event("resume", estimator=name, step=step)
+            obs_sink.event("resume", estimator=name, step=step,
+                           fit_id=saved_fit_id)
             obs_metrics.counter(
                 "resume_total",
                 help="checkpoint resumes").inc(estimator=name)
+    progress = obs_progress.FitProgress(
+        name, n_iter, fit_id=saved_fit_id,
+        objective=progress_objective, direction=progress_direction,
+        n_chunks=-(-int(n_iter) // int(checkpoint_every)) or None,
+        wall0=saved_wall, chunks0=saved_chunks)
 
     done = bool(np.asarray(state.get("done", False)).reshape(-1)[0]) \
         if isinstance(state, dict) and "done" in state else False
@@ -297,10 +359,12 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
             # a sync — memory_stats is a host-side counter read).
             watermark = obs_profile.memory_watermark() \
                 if obs_sink.enabled() else None
+            t_chunk = time.perf_counter()
             with obs_spans.span(
                     "fit_chunk",
                     attrs={"estimator": name, "step": step,
-                           "n_steps": n_steps}):
+                           "n_steps": n_steps,
+                           "fit_id": progress.fit_id}):
                 if obs_sanitize.enabled():
                     # the checkify lane (BRAINIAK_TPU_SANITIZE=1):
                     # a tripped NaN/div/OOB check inside a traceable
@@ -325,16 +389,31 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
                                              before=watermark)
             new_state = faults.corrupt_state(new_state, step + n_steps,
                                              site=name)
+            # progress observes the PRE-guard state: a non-finite or
+            # trend-worsening objective fires the typed
+            # divergence_precursor event strictly before the guard
+            # below can trip (and before its rollback/abort events)
+            progress.observe(new_state, step + n_steps, n_steps,
+                             time.perf_counter() - t_chunk)
             check_state(new_state, iteration=step + n_steps, where=name,
                         skip=guard_skip, nan_only=guard_nan_only)
         except DivergenceError as exc:
             rollbacks += 1
+            progress.note_rollback()
             if rollbacks > max_rollbacks:
                 logger.error("%s: %s; %d consecutive rollbacks "
                              "exhausted", name, exc, max_rollbacks)
                 obs_sink.event("divergence_abort", estimator=name,
                                step=last_good[0],
-                               leaves=list(exc.leaves))
+                               leaves=list(exc.leaves),
+                               fit_id=progress.fit_id)
+                progress.finish("diverged")
+                obs_flight.dump(
+                    "divergence_abort", fit_id=progress.fit_id,
+                    state={"estimator": name, "step": last_good[0],
+                           "failed_step": step + n_steps,
+                           "leaves": list(exc.leaves),
+                           "rollbacks": progress.rollbacks})
                 raise
             logger.warning(
                 "%s: %s; rolling back to iteration %d "
@@ -343,7 +422,8 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
             obs_sink.event("rollback", estimator=name,
                            from_step=step + n_steps,
                            to_step=last_good[0],
-                           leaves=list(exc.leaves), attempt=rollbacks)
+                           leaves=list(exc.leaves), attempt=rollbacks,
+                           fit_id=progress.fit_id)
             obs_metrics.counter(
                 "rollback_total",
                 help="non-finite-guard rollbacks").inc(estimator=name)
@@ -363,6 +443,10 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
             if fingerprint is not None:
                 to_save["fingerprint"] = np.asarray(fingerprint,
                                                     dtype=float)
+            to_save["fit_id"] = _encode_fit_id(progress.fit_id)
+            # host-side floats, not device state: no sync happens
+            to_save["fit_wall"] = np.array(  # jaxlint: disable=JX002
+                [progress.fit_wall_s, progress.chunk], dtype=float)
             t_save = time.perf_counter()
             mngr.save(step, to_save)
             dt_save = time.perf_counter() - t_save
@@ -371,6 +455,7 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
                 help="checkpoint save wall time").observe(
                     dt_save, estimator=name)
             obs_sink.event("checkpoint", estimator=name, step=step,
-                           seconds=dt_save)
+                           seconds=dt_save, fit_id=progress.fit_id)
         faults.preempt_point(step, site=name)
+    progress.finish("converged" if done else "completed")
     return state, step

@@ -84,6 +84,13 @@ def retry(fn=None, *, retries=3, backoff=0.5, jitter=0.1,
                             "retry_exhausted", site=label,
                             attempts=attempt + 1,
                             error=type(exc).__name__)
+                        from ..obs import flight
+                        flight.dump(
+                            "retry_exhausted",
+                            state={"site": label,
+                                   "attempts": attempt + 1,
+                                   "error": f"{type(exc).__name__}: "
+                                            f"{exc}"})
                         raise
                     delay = backoff * (2.0 ** attempt)
                     if jitter:

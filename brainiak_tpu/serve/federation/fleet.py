@@ -286,6 +286,12 @@ class FleetSupervisor:
         self._n_failovers += 1
         obs_sink.event("replica_dead", replica=name,
                        n_harvested=len(work), **result)
+        # replica death is an incident: snapshot the flight ring so
+        # the probes/requests leading up to it survive the failover
+        from ...obs import flight
+        flight.dump("replica_death",
+                    state={"replica": name, "n_harvested": len(work),
+                           **result})
         return result
 
     def _autoscale(self, actions):  # requires-lock: _poll_lock

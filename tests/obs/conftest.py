@@ -1,17 +1,24 @@
 """Obs test isolation: every test starts with no sinks, no env dir,
-and a clean default metric registry."""
+a clean default metric registry, an empty flight-recorder ring, and
+an empty fit-progress registry."""
 
 import pytest
 
-from brainiak_tpu.obs import metrics, sink
+from brainiak_tpu.obs import flight, metrics, progress, sink
 
 
 @pytest.fixture(autouse=True)
 def _clean_obs(monkeypatch):
     monkeypatch.delenv(sink.OBS_DIR_ENV, raising=False)
     monkeypatch.delenv(sink.OBS_RANK_ENV, raising=False)
+    monkeypatch.delenv(flight.FLIGHT_DIR_ENV, raising=False)
+    monkeypatch.delenv(flight.FLIGHT_RECORDS_ENV, raising=False)
     sink.close_all()
     metrics.reset()
+    flight.clear()
+    progress.clear_registry()
     yield
     sink.close_all()
     metrics.reset()
+    flight.clear()
+    progress.clear_registry()

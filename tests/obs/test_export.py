@@ -203,6 +203,39 @@ def test_traced_spans_render_flow_events():
     assert dispatch["args"]["parent_id"] == "aaaa0001"
 
 
+def test_progress_records_render_counter_tracks():
+    """PR 19: each fit's progress becomes a ratio counter track (+
+    an objective track when reported) in that rank's lane; a
+    non-finite objective sample is skipped, not exported."""
+    fit = "f" * 16
+    recs = [
+        _rec("progress", "fit_progress", BASE + 1.0, 0,
+             fit_id=fit, estimator="SRM.fit", chunk=1, step=2,
+             n_iter=8, ratio=0.25, objective=10.0),
+        _rec("progress", "fit_progress", BASE + 2.0, 0,
+             fit_id=fit, estimator="SRM.fit", chunk=2, step=4,
+             n_iter=8, ratio=0.5, objective=float("nan")),
+        _rec("progress", "fit_progress", BASE + 3.0, 0,
+             fit_id=fit, estimator="SRM.fit", chunk=3, step=6,
+             n_iter=8, ratio=0.75),
+    ]
+    doc = export.chrome_trace(recs)
+    assert export.validate_chrome_trace(doc) == []
+    counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    track = f"SRM.fit:{fit}"
+    ratios = [e for e in counters
+              if e["name"] == f"fit_progress {track}"]
+    assert [e["args"]["ratio"] for e in ratios] == \
+        [0.25, 0.5, 0.75]
+    assert all(e["pid"] == 0 for e in ratios)
+    objectives = [e for e in counters
+                  if e["name"] == f"fit_objective {track}"]
+    # the NaN sample is dropped; the finite one survives
+    assert [e["args"]["objective"] for e in objectives] == [10.0]
+    # round-trips as strict JSON (no NaN tokens)
+    json.loads(json.dumps(doc, allow_nan=False))
+
+
 def test_validator_rejects_flow_event_without_id():
     doc = {"traceEvents": [
         {"ph": "s", "name": "trace", "pid": 0, "ts": 1.0}]}

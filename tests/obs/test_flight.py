@@ -1,0 +1,184 @@
+"""Flight-recorder ring + incident snapshots + postmortem CLI."""
+
+import json
+import os
+import threading
+
+from brainiak_tpu.obs import flight, postmortem
+from brainiak_tpu.obs import sink as obs_sink
+
+
+def _rec(i, kind="event", **fields):
+    rec = {"v": obs_sink.SCHEMA_VERSION, "kind": kind,
+           "name": f"r{i}", "ts": float(i), "rank": 0}
+    rec.update(fields)
+    return rec
+
+
+def test_ring_appends_and_snapshots():
+    for i in range(5):
+        flight.record(_rec(i))
+    recs = flight.records()
+    assert [r["name"] for r in recs] == [f"r{i}" for i in range(5)]
+    # snapshot is a copy: mutating it leaves the ring alone
+    recs.append(_rec(99))
+    assert len(flight.records()) == 5
+
+
+def test_ring_overwrites_oldest_at_capacity(monkeypatch):
+    monkeypatch.setenv(flight.FLIGHT_RECORDS_ENV, "8")
+    for i in range(20):
+        flight.record(_rec(i))
+    recs = flight.records()
+    assert len(recs) == 8
+    assert [r["name"] for r in recs] == \
+        [f"r{i}" for i in range(12, 20)]
+
+
+def test_ring_capacity_env_validation(monkeypatch):
+    monkeypatch.setenv(flight.FLIGHT_RECORDS_ENV, "not-a-number")
+    assert flight.capacity() == flight.DEFAULT_CAPACITY
+    monkeypatch.setenv(flight.FLIGHT_RECORDS_ENV, "0")
+    assert flight.capacity() == flight.DEFAULT_CAPACITY
+
+
+def test_concurrent_appends_never_lose_the_lock(monkeypatch):
+    monkeypatch.setenv(flight.FLIGHT_RECORDS_ENV, "64")
+    n_threads, per_thread = 8, 200
+
+    def spin(t):
+        for i in range(per_thread):
+            flight.record(_rec(i, thread=t))
+
+    threads = [threading.Thread(target=spin, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    recs = flight.records()
+    assert len(recs) == 64  # full ring, no corruption
+    assert all(isinstance(r, dict) and "name" in r for r in recs)
+
+
+def test_sink_emit_taps_the_ring(tmp_path, monkeypatch):
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        obs_sink.event("ping", x=1)
+    finally:
+        obs_sink.remove_sink(mem)
+    names = [r["name"] for r in flight.records()]
+    assert "ping" in names
+
+
+def test_dump_writes_snapshot_and_manifest(tmp_path):
+    for i in range(4):
+        flight.record(_rec(i, kind="progress", fit_id="f" * 16,
+                           estimator="SRM.fit", chunk=i + 1,
+                           step=2 * i, n_iter=8, ratio=i / 4,
+                           objective=10.0 - i))
+    path = flight.dump("divergence_abort", fit_id="f" * 16,
+                       state={"estimator": "SRM.fit", "step": 4},
+                       directory=str(tmp_path))
+    assert path and os.path.isdir(path)
+    with open(os.path.join(path, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["trigger"] == "divergence_abort"
+    assert manifest["fit_id"] == "f" * 16
+    assert manifest["n_records"] == 4
+    assert manifest["state"]["estimator"] == "SRM.fit"
+    with open(os.path.join(path, "records.jsonl")) as fh:
+        lines = [json.loads(l) for l in fh if l.strip()]
+    assert len(lines) == 4
+    assert lines[-1]["chunk"] == 4
+
+
+def test_dump_resolution_order(tmp_path, monkeypatch):
+    flight.record(_rec(0))
+    # no directory anywhere -> no snapshot, no crash
+    assert flight.dump("trigger") is None
+    # $BRAINIAK_TPU_OBS_DIR -> <dir>/incidents
+    monkeypatch.setenv(obs_sink.OBS_DIR_ENV, str(tmp_path))
+    path = flight.dump("trigger")
+    assert path.startswith(str(tmp_path / "incidents"))
+    # explicit flight dir wins over the obs dir
+    override = tmp_path / "elsewhere"
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(override))
+    path = flight.dump("trigger")
+    assert path.startswith(str(override))
+
+
+def test_dump_emits_flight_dump_event_when_enabled(tmp_path):
+    flight.record(_rec(0))
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        flight.dump("sanitizer", directory=str(tmp_path))
+    finally:
+        obs_sink.remove_sink(mem)
+    events = [r for r in mem.records
+              if r["name"] == "flight_dump"]
+    assert len(events) == 1
+    assert events[0]["attrs"]["trigger"] == "sanitizer"
+
+
+# -- postmortem CLI ---------------------------------------------------
+
+def _snapshot(tmp_path):
+    fit = "9" * 16
+    flight.record(_rec(0, kind="span", path="fit",
+                       dur_s=0.5, fit_id=fit))
+    for i in range(6):
+        flight.record(_rec(i + 1, kind="progress", fit_id=fit,
+                           estimator="SRM.fit", chunk=i + 1,
+                           step=2 * (i + 1), n_iter=20,
+                           ratio=(i + 1) / 10.0,
+                           objective=100.0 - 5 * i, rollbacks=0))
+    flight.record(_rec(7, fit_id=fit,
+                       name="divergence_precursor",
+                       attrs={"estimator": "SRM.fit",
+                              "reason": "non_finite_objective"}))
+    flight.record(_rec(8, fit_id=fit, name="divergence_abort",
+                       attrs={"estimator": "SRM.fit", "step": 10}))
+    return flight.dump("divergence_abort", fit_id=fit,
+                       state={"estimator": "SRM.fit",
+                              "failed_step": 12,
+                              "leaves": ["rho2"]},
+                       directory=str(tmp_path))
+
+
+def test_postmortem_renders_snapshot(tmp_path, capsys):
+    path = _snapshot(tmp_path)
+    assert postmortem.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "trigger: divergence_abort" in out
+    assert "SRM.fit" in out
+    assert "<-- implicated" in out
+    assert "failed_step: 12" in out
+    # the objective tail shows the last OBJECTIVE_TAIL values
+    assert "objective tail:" in out
+    assert "75@12" in out
+    assert "divergence_precursor" in out
+
+
+def test_postmortem_accepts_manifest_or_records_path(tmp_path):
+    path = _snapshot(tmp_path)
+    assert postmortem.main(
+        [os.path.join(path, "manifest.json")]) == 0
+    assert postmortem.main(
+        [os.path.join(path, "records.jsonl")]) == 0
+
+
+def test_postmortem_cli_errors_on_garbage(tmp_path, capsys):
+    assert postmortem.main([str(tmp_path / "nope")]) == 1
+    bad = tmp_path / "incident"
+    bad.mkdir()
+    (bad / "records.jsonl").write_text("{not json\n")
+    assert postmortem.main([str(bad)]) == 1
+    err = capsys.readouterr().err
+    assert "bad JSON" in err
+
+
+def test_postmortem_via_obs_main(tmp_path):
+    from brainiak_tpu.obs.__main__ import main as obs_main
+    path = _snapshot(tmp_path)
+    assert obs_main(["postmortem", path]) == 0

@@ -112,6 +112,31 @@ def test_unknown_path_404(server):
     status, body, _ = _get(server.port, "/nope")
     assert status == 404
     assert "/metrics" in body
+    assert "/jobs" in body
+
+
+def test_jobs_endpoint_serves_fit_registry(server):
+    from brainiak_tpu.obs.progress import FitProgress
+
+    status, body, ctype = _get(server.port, "/jobs")
+    assert status == 200
+    assert "json" in ctype
+    assert json.loads(body) == {"fits": []}
+
+    fp = FitProgress("SRM.fit", 10, n_chunks=5)
+    fp.observe({}, 4, 2, 0.25)
+    status, body, _ = _get(server.port, "/jobs")
+    assert status == 200
+    (fit,) = json.loads(body)["fits"]
+    assert fit["fit_id"] == fp.fit_id
+    assert fit["estimator"] == "SRM.fit"
+    assert fit["status"] == "running"
+    assert fit["step"] == 4 and fit["n_iter"] == 10
+    assert fit["ratio"] == pytest.approx(0.4)
+    fp.finish("completed")
+    status, body, _ = _get(server.port, "/jobs")
+    (fit,) = json.loads(body)["fits"]
+    assert fit["status"] == "completed"
 
 
 def test_readyz_reflects_callback():

@@ -27,6 +27,8 @@ def _srm_data(n_subjects=3, voxels=14, samples=20, features=3):
 def _load_trace(trace_dir):
     recs = []
     for name in sorted(os.listdir(trace_dir)):
+        if not name.endswith(".jsonl"):
+            continue  # e.g. the incidents/ snapshot directory
         with open(os.path.join(trace_dir, name)) as fh:
             recs.extend(json.loads(line) for line in fh)
     return recs
@@ -112,6 +114,103 @@ def test_disabled_fit_emits_nothing_and_never_syncs(
     # may interleave jax_compile_seconds metric records here
     assert [r["name"] for r in mem.records
             if r["kind"] == "span"] == ["synced"]
+
+
+def test_streamed_srm_incident_telemetry_end_to_end(
+        tmp_path, monkeypatch, capsys):
+    """PR 19 acceptance: a streamed SRM fit preempted and resumed
+    reports one fit_id with monotone chunk indices spanning the
+    resume; a second fit driven into NaN divergence fires the
+    precursor before the guard's rollback and auto-dumps a
+    flight-recorder snapshot whose postmortem names the estimator,
+    failing chunk, and objective tail."""
+    from brainiak_tpu.data import write_store
+    from brainiak_tpu.funcalign.srm import SRM
+    from brainiak_tpu.obs import flight, postmortem
+    from brainiak_tpu.resilience.guards import DivergenceError
+
+    trace_dir = str(tmp_path / "trace")
+    ckpt = str(tmp_path / "ckpt")
+    monkeypatch.setenv(obs.OBS_DIR_ENV, trace_dir)
+    store = write_store(str(tmp_path / "store"), _srm_data())
+    flight.clear()
+
+    # -- phase 1: preempt mid-fit, resume, finish -----------------
+    with inject("preempt", at_step=4) as fault:
+        with pytest.raises(PreemptionError):
+            SRM(n_iter=8, features=3, shard_subjects=2).fit(
+                store, checkpoint_dir=ckpt, checkpoint_every=2)
+    assert fault.fired == 1
+    SRM(n_iter=8, features=3, shard_subjects=2).fit(
+        store, checkpoint_dir=ckpt, checkpoint_every=2)
+
+    # -- phase 2: persistent NaN in the objective leaf -> abort ---
+    with inject("nan", at_step=4, times=10, leaf="rho2"):
+        with pytest.raises(DivergenceError):
+            SRM(n_iter=8, features=3, shard_subjects=2).fit(store)
+
+    obs_sink.close_all()
+    monkeypatch.delenv(obs.OBS_DIR_ENV)
+    records = _load_trace(os.path.join(trace_dir))
+    for rec in records:
+        assert obs.validate_record(rec) == []
+
+    progress = [r for r in records if r["kind"] == "progress"]
+    assert all(r["estimator"] == "SRM.fit_stream"
+               for r in progress)
+    by_fit = {}
+    for rec in progress:
+        by_fit.setdefault(rec["fit_id"], []).append(rec)
+    resumed_id = next(
+        fid for fid, recs in by_fit.items()
+        if recs[-1]["step"] == 8 and recs[-1]["ratio"] == 1.0)
+    chunks = [r["chunk"] for r in by_fit[resumed_id]]
+    # ONE fit_id spans pre- and post-resume: all 4 planned chunks
+    # observed, strictly monotone, despite two processes' worth of
+    # records (the preempted run contributed chunks 1-2)
+    assert chunks == [1, 2, 3, 4]
+    walls = [r["fit_wall_s"] for r in by_fit[resumed_id]]
+    assert all(b > a for a, b in zip(walls, walls[1:]))
+    resume_events = [r for r in records if r["kind"] == "event"
+                     and r["name"] == "resume"]
+    assert any(e["attrs"].get("step") == 4 for e in resume_events)
+    assert any(e.get("fit_id") == resumed_id
+               for e in resume_events)
+
+    # precursor strictly before the guard's rollback
+    precursor = [r for r in records if r["kind"] == "event"
+                 and r["name"] == "divergence_precursor"]
+    rollbacks = [r for r in records if r["kind"] == "event"
+                 and r["name"] == "rollback"]
+    aborts = [r for r in records if r["kind"] == "event"
+              and r["name"] == "divergence_abort"]
+    assert precursor and rollbacks and aborts
+    assert precursor[0]["attrs"]["reason"] == \
+        "non_finite_objective"
+    assert precursor[0]["ts"] <= rollbacks[0]["ts"]
+    diverged_id = aborts[0]["fit_id"]
+    assert diverged_id and diverged_id != resumed_id
+
+    # the abort auto-dumped one snapshot naming the diverged fit
+    incidents = os.path.join(trace_dir, "incidents")
+    (snap,) = sorted(os.listdir(incidents))
+    snap = os.path.join(incidents, snap)
+    with open(os.path.join(snap, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["trigger"] == "divergence_abort"
+    assert manifest["fit_id"] == diverged_id
+    assert manifest["state"]["estimator"] == "SRM.fit_stream"
+    assert "rho2" in manifest["state"]["leaves"]
+
+    # ... and the postmortem CLI renders it: estimator, failing
+    # chunk, objective tail
+    assert postmortem.main([snap]) == 0
+    out = capsys.readouterr().out
+    assert "trigger: divergence_abort" in out
+    assert "SRM.fit_stream" in out
+    assert "<-- implicated" in out
+    assert "last chunk:" in out
+    assert "objective tail:" in out
 
 
 def test_fcma_selection_trace(monkeypatch):

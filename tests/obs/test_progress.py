@@ -1,0 +1,239 @@
+"""FitProgress tracker: records, convergence telemetry, registry."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.obs import flight, progress
+from brainiak_tpu.obs import sink as obs_sink
+from brainiak_tpu.obs.progress import FitProgress
+
+
+def _observe_n(fp, n, objective=None, start=0, n_steps=2,
+               chunk_s=0.1):
+    recs = []
+    for i in range(n):
+        value = objective(i) if callable(objective) else objective
+        state = {} if value is None else \
+            {"obj": np.full(3, float(value))}
+        recs.append(fp.observe(state, start + (i + 1) * n_steps,
+                               n_steps, chunk_s))
+    return recs
+
+
+def test_new_fit_id_is_trace_shaped():
+    fid = progress.new_fit_id()
+    assert len(fid) == 16
+    int(fid, 16)  # hex or bust
+    assert fid != progress.new_fit_id()
+
+
+def test_progress_records_validate_and_carry_telemetry():
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        fp = FitProgress("SRM.fit", 10, objective="obj",
+                         n_chunks=5)
+        recs = _observe_n(fp, 5, objective=lambda i: 100.0 - i)
+    finally:
+        obs_sink.remove_sink(mem)
+    assert [r["chunk"] for r in recs] == [1, 2, 3, 4, 5]
+    for rec in recs:
+        assert obs_sink.validate_record(rec) == []
+        assert rec["v"] == obs_sink.SCHEMA_VERSION
+        assert rec["fit_id"] == fp.fit_id
+        assert rec["estimator"] == "SRM.fit"
+    assert recs[-1]["ratio"] == 1.0
+    assert recs[-1]["objective"] == 96.0
+    assert recs[1]["delta"] == -1.0
+    assert recs[-1]["rate"] > 0
+    assert recs[-1]["eta_s"] == 0.0
+    # the sink saw the same stream
+    assert [r for r in mem.records if r["kind"] == "progress"] \
+        == recs
+
+
+def test_disabled_obs_emits_no_sink_records():
+    """The zero-overhead lane: no sink -> no records anywhere but
+    the flight ring and the /jobs registry."""
+    assert not obs_sink.enabled()
+    fp = FitProgress("fit", 4, objective="obj")
+    _observe_n(fp, 2, objective=lambda i: 1.0)
+    fp.finish("completed")
+    # flight ring and registry still fed (the always-on lane)
+    kinds = {r["kind"] for r in flight.records()}
+    assert kinds == {"progress", "event"}
+    (snap,) = progress.active_fits()
+    assert snap["fit_id"] == fp.fit_id
+    assert snap["status"] == "completed"
+
+
+def test_enabled_obs_taps_flight_ring_exactly_once():
+    """sink.emit mirrors into the flight ring itself; the tracker
+    must not ALSO tap it directly, or incident snapshots carry
+    every progress record twice."""
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        fp = FitProgress("fit", 4, objective="obj")
+        _observe_n(fp, 2, objective=lambda i: 1.0)
+        fp.finish("completed")
+    finally:
+        obs_sink.remove_sink(mem)
+    chunks = [r["chunk"] for r in flight.records()
+              if r["kind"] == "progress"]
+    assert chunks == [1, 2]
+    finished = [r for r in flight.records()
+                if r["kind"] == "event"
+                and r["name"] == "fit_finished"]
+    assert len(finished) == 1
+
+
+def test_divergence_precursor_on_non_finite_objective():
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        fp = FitProgress("fit", 10, objective="obj")
+        _observe_n(fp, 2, objective=lambda i: 5.0)
+        fp.observe({"obj": np.array([1.0, np.nan, 2.0])}, 6, 2, 0.1)
+    finally:
+        obs_sink.remove_sink(mem)
+    events = [r for r in mem.records if r["kind"] == "event"
+              and r["name"] == "divergence_precursor"]
+    assert len(events) == 1
+    assert events[0]["fit_id"] == fp.fit_id
+    assert events[0]["attrs"]["reason"] == "non_finite_objective"
+    assert fp.precursor_fired
+    # the NaN objective is omitted, never serialized: every record
+    # in the stream stays strict JSON (no bare NaN tokens)
+    assert events[0]["attrs"]["objective"] is None
+    progress_recs = [r for r in mem.records
+                     if r["kind"] == "progress"]
+    assert progress_recs[-1].get("objective") is None
+    json.dumps(mem.records, allow_nan=False)
+
+
+def test_divergence_precursor_on_worsening_trend():
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        fp = FitProgress("fit", 20, objective="obj",
+                         direction="min")
+        # steadily worsening (growing) objective under "min"
+        _observe_n(fp, 6, objective=lambda i: 10.0 + 3.0 * i)
+    finally:
+        obs_sink.remove_sink(mem)
+    events = [r for r in mem.records if r["kind"] == "event"
+              and r["name"] == "divergence_precursor"]
+    assert len(events) == 1  # fires once, not per chunk
+    assert events[0]["attrs"]["reason"] == "worsening_trend"
+    assert events[0]["attrs"]["ewma_worsening"] > 0
+
+
+def test_improving_objective_fires_no_precursor():
+    fp = FitProgress("fit", 20, objective="obj", direction="min")
+    _observe_n(fp, 8, objective=lambda i: 10.0 - i)
+    assert not fp.precursor_fired
+    fp = FitProgress("fit", 20, objective="obj", direction="max")
+    _observe_n(fp, 8, objective=lambda i: 10.0 + i)
+    assert not fp.precursor_fired
+
+
+def test_plateau_detection():
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        fp = FitProgress("fit", 40, objective="obj")
+        _observe_n(fp, 2, objective=lambda i: 50.0 - 10 * i)
+        # then flat within PLATEAU_RTOL for PLATEAU_CHUNKS chunks
+        _observe_n(fp, progress.PLATEAU_CHUNKS,
+                   objective=lambda i: 40.0, start=4)
+    finally:
+        obs_sink.remove_sink(mem)
+    assert fp.plateaued
+    events = [r for r in mem.records if r["kind"] == "event"
+              and r["name"] == "plateau"]
+    assert len(events) == 1
+    last = [r for r in mem.records if r["kind"] == "progress"][-1]
+    assert last["plateaued"] is True
+
+
+def test_callable_objective_and_swallowed_errors():
+    calls = []
+
+    def objective(state):
+        calls.append(1)
+        if len(calls) > 1:
+            raise RuntimeError("flaky telemetry")
+        return 7.0
+
+    fp = FitProgress("fit", 4, objective=objective)
+    rec = fp.observe({}, 2, 2, 0.1)
+    assert rec["objective"] == 7.0
+    rec = fp.observe({}, 4, 2, 0.1)  # extractor raises -> None
+    assert rec.get("objective") is None
+    # missing leaf names are swallowed too
+    fp = FitProgress("fit", 4, objective="nope")
+    rec = fp.observe({"obj": np.ones(2)}, 2, 2, 0.1)
+    assert rec.get("objective") is None
+
+
+def test_eta_uses_ewma_rate():
+    fp = FitProgress("fit", 100, objective=None)
+    fp.observe({}, 10, 10, 1.0)   # 10 it/s
+    assert fp.eta_s == pytest.approx(9.0)
+    fp.observe({}, 20, 10, 1.0)
+    assert fp.rate == pytest.approx(10.0)
+    assert fp.eta_s == pytest.approx(8.0)
+
+
+def test_resume_carries_wall_and_chunks():
+    fp = FitProgress("fit", 10, fit_id="ab" * 8, wall0=3.0,
+                     chunks0=2)
+    rec = fp.observe({}, 6, 2, 0.5)
+    assert rec["fit_id"] == "ab" * 8
+    assert rec["chunk"] == 3
+    assert rec["fit_wall_s"] == pytest.approx(3.5)
+
+
+def test_registry_eviction_keeps_running_fits():
+    running = FitProgress("fit", 4)
+    running.observe({}, 2, 2, 0.1)
+    finished = []
+    for _ in range(progress._MAX_FINISHED + 5):
+        fp = FitProgress("fit", 2)
+        fp.observe({}, 2, 2, 0.1)
+        fp.finish("completed")
+        finished.append(fp.fit_id)
+    snaps = progress.active_fits()
+    ids = [s["fit_id"] for s in snaps]
+    assert running.fit_id in ids
+    assert len(ids) == progress._MAX_FINISHED + 1
+    # evicted oldest-first
+    assert finished[0] not in ids
+    assert finished[-1] in ids
+
+
+def test_direction_validated():
+    with pytest.raises(ValueError):
+        FitProgress("fit", 4, direction="sideways")
+
+
+def test_gauges_exposed_when_enabled():
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        fp = FitProgress("SRM.fit", 10)
+        fp.observe({}, 5, 5, 0.5)
+        rows = {(m["name"],
+                 tuple(sorted((m.get("labels") or {}).items())))
+                for m in mem.records if m["kind"] == "metric"}
+    finally:
+        obs_sink.remove_sink(mem)
+    labels = (("estimator", "SRM.fit"), ("fit_id", fp.fit_id))
+    assert ("fit_progress_ratio", labels) in rows
+    assert ("fit_eta_seconds", labels) in rows
+
+
+def test_objective_ring_is_bounded():
+    fp = FitProgress("fit", 10_000, objective="obj")
+    _observe_n(fp, progress.OBJECTIVE_RING + 20,
+               objective=lambda i: float(i) * -1.0)
+    assert len(fp.objectives) == progress.OBJECTIVE_RING
+    assert math.isfinite(fp.objectives[-1][1])

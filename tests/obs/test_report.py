@@ -207,6 +207,69 @@ def test_cli_top_flag(tmp_path, monkeypatch, capsys):
     assert "slowest spans" in capsys.readouterr().out
 
 
+def _progress_rec(fit_id, chunk, step, ts, **fields):
+    rec = {"v": 4, "kind": "progress", "ts": ts, "rank": 0,
+           "name": "fit_progress", "fit_id": fit_id,
+           "estimator": "SRM.fit", "chunk": chunk, "step": step,
+           "n_iter": 10, "ratio": step / 10.0}
+    rec.update(fields)
+    assert obs_sink.validate_record(rec) == []
+    return rec
+
+
+def _fit_event(name, fit_id, ts, **attrs):
+    return {"v": 4, "kind": "event", "ts": ts, "rank": 0,
+            "name": name, "fit_id": fit_id,
+            "attrs": attrs or None}
+
+
+def test_fits_section_verdicts():
+    """PR 19: per-fit report rows with a convergence verdict —
+    finished fits report their terminal status, an aborted fit is
+    diverged, a precursor without completion is diverging, and a
+    trailing-off fit is interrupted."""
+    done, diverged, diverging, cut = ("d" * 16, "e" * 16,
+                                      "f" * 16, "a" * 16)
+    records = [
+        _progress_rec(done, 1, 5, 1.0, objective=9.0),
+        _progress_rec(done, 2, 10, 2.0, objective=4.0,
+                      eta_s=0.0),
+        _fit_event("fit_finished", done, 2.1, status="converged"),
+        _progress_rec(diverged, 1, 5, 3.0, objective=2.0,
+                      rollbacks=2),
+        _fit_event("divergence_abort", diverged, 3.5,
+                   step=4, leaves=["rho2"]),
+        _progress_rec(diverging, 1, 5, 4.0, objective=50.0),
+        _fit_event("divergence_precursor", diverging, 4.5,
+                   reason="worsening_trend"),
+        _progress_rec(cut, 1, 5, 5.0),
+    ]
+    rows = {r["fit_id"]: r
+            for r in report.aggregate(records)["fits"]}
+    assert rows[done]["verdict"] == "converged"
+    assert rows[done]["chunks"] == 2
+    assert rows[done]["objective"] == 4.0
+    assert rows[diverged]["verdict"] == "diverged"
+    assert rows[diverged]["rollbacks"] == 2
+    assert rows[diverging]["verdict"] == "diverging"
+    assert rows[cut]["verdict"] == "interrupted"
+    text = report.render_text(report.aggregate(records))
+    assert "fits:" in text
+    assert "-> diverged" in text and "-> converged" in text
+
+
+def test_fits_last_fields_follow_timestamp_not_order():
+    fit = "9" * 16
+    records = [
+        _progress_rec(fit, 2, 8, 20.0, objective=1.5),
+        _progress_rec(fit, 1, 4, 10.0, objective=3.0),
+    ]
+    (row,) = report.aggregate(records)["fits"]
+    assert row["step"] == 8
+    assert row["objective"] == 1.5
+    assert row["chunks"] == 2
+
+
 def test_roofline_skips_ambiguous_multi_signature_sites():
     """Two programs of one site sharing fit_chunk spans (full +
     remainder chunk) cannot be apportioned — neither row may claim

@@ -175,8 +175,10 @@ def test_cost_records_require_v2():
                for e in obs.validate_record(rec))
     rec["v"] = 3  # v3 (trace fields) accepts cost records too
     assert obs.validate_record(rec) == []
-    rec["v"] = 4  # future versions still rejected
-    assert any("v=4" in e for e in obs.validate_record(rec))
+    rec["v"] = 4  # v4 (progress/fit_id) accepts cost records too
+    assert obs.validate_record(rec) == []
+    rec["v"] = 5  # future versions still rejected
+    assert any("v=5" in e for e in obs.validate_record(rec))
 
 
 def test_cost_record_unknown_key_rejected():
@@ -302,7 +304,7 @@ def test_trace_fields_validate_as_v3():
                           path="serve.submit", dur_s=0.001,
                           trace_id="a" * 16, span_id="b" * 8,
                           parent_id="c" * 8)
-    assert rec["v"] == 3
+    assert rec["v"] == obs_sink.SCHEMA_VERSION
     assert obs.validate_record(rec) == []
     # wrong types are rejected
     bad = dict(rec, trace_id=123)

@@ -1,0 +1,107 @@
+"""The watch CLI: /jobs scrape, JSONL-dir reconstruction, frames."""
+
+import json
+
+import pytest
+
+from brainiak_tpu.obs import watch
+from brainiak_tpu.obs.http import TelemetryServer
+from brainiak_tpu.obs.progress import FitProgress
+
+
+def _progress_line(fit_id, chunk, step, ts, status=None, **extra):
+    rec = {"v": 4, "kind": "progress", "name": "fit_progress",
+           "ts": ts, "rank": 0, "fit_id": fit_id,
+           "estimator": "SRM.fit", "chunk": chunk, "step": step,
+           "n_iter": 10, "ratio": step / 10.0}
+    rec.update(extra)
+    return json.dumps(rec)
+
+
+def test_fits_from_url_scrapes_jobs():
+    srv = TelemetryServer(port=0, host="127.0.0.1").start()
+    try:
+        fp = FitProgress("SRM.fit", 10)
+        fp.observe({}, 2, 2, 0.1)
+        for url in (f"http://127.0.0.1:{srv.port}",
+                    f"http://127.0.0.1:{srv.port}/jobs"):
+            (fit,) = watch.fits_from_url(url)
+            assert fit["fit_id"] == fp.fit_id
+    finally:
+        srv.stop()
+
+
+def test_fits_from_dir_last_record_wins(tmp_path):
+    a, b = "a" * 16, "b" * 16
+    (tmp_path / "obs-0.jsonl").write_text("\n".join([
+        _progress_line(a, 1, 2, ts=10.0),
+        _progress_line(a, 2, 4, ts=11.0, objective=5.0),
+        _progress_line(b, 1, 2, ts=12.0),
+        json.dumps({"v": 4, "kind": "event", "ts": 13.0, "rank": 0,
+                    "name": "fit_finished", "fit_id": b,
+                    "attrs": {"status": "diverged"}}),
+    ]) + "\n")
+    fits = watch.fits_from_dir(str(tmp_path))
+    assert [f["fit_id"] for f in fits] == [a, b]
+    assert fits[0]["chunk"] == 2
+    assert fits[0]["objective"] == 5.0
+    assert fits[1]["status"] == "diverged"
+
+
+def test_render_frame_table_and_incidents(tmp_path):
+    incident = tmp_path / "incidents" / "incident-x"
+    incident.mkdir(parents=True)
+    (incident / "manifest.json").write_text(json.dumps(
+        {"trigger": "divergence_abort", "ts": 1000.0,
+         "fit_id": "c" * 16}))
+    fits = [{"fit_id": "a" * 16, "estimator": "SRM.fit",
+             "chunk": 2, "step": 4, "n_iter": 10, "ratio": 0.4,
+             "objective": 3.25, "eta_s": 90.0, "rollbacks": 1,
+             "status": "running"}]
+    incidents = watch.recent_incidents(str(tmp_path))
+    frame = watch.render_frame(fits, incidents, now=2000.0)
+    assert "SRM.fit" in frame
+    assert "a" * 16 in frame
+    assert "4/10" in frame
+    assert "3.25" in frame
+    assert "1.5m" in frame  # eta formatting
+    assert "divergence_abort" in frame
+    assert "c" * 16 in frame
+    # empty table renders a placeholder, not a crash
+    assert "no fits reported" in watch.render_frame([], [],
+                                                    now=2000.0)
+
+
+def test_recent_incidents_empty_and_missing(tmp_path):
+    assert watch.recent_incidents("") == []
+    assert watch.recent_incidents(str(tmp_path)) == []
+
+
+def test_watch_cli_once(tmp_path, capsys):
+    (tmp_path / "obs-0.jsonl").write_text(
+        _progress_line("d" * 16, 3, 6, ts=5.0) + "\n")
+    assert watch.main(["--dir", str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "d" * 16 in out
+    assert "6/10" in out
+
+
+def test_watch_cli_url_unreachable_once(capsys):
+    assert watch.main(["--url", "http://127.0.0.1:9/",
+                       "--once"]) == 1
+
+
+def test_watch_cli_requires_a_source(monkeypatch):
+    from brainiak_tpu.obs.sink import OBS_DIR_ENV
+    monkeypatch.delenv(OBS_DIR_ENV, raising=False)
+    with pytest.raises(SystemExit):
+        watch.main(["--once"])
+
+
+def test_watch_via_obs_main(tmp_path, capsys):
+    from brainiak_tpu.obs.__main__ import main as obs_main
+    (tmp_path / "obs-0.jsonl").write_text(
+        _progress_line("e" * 16, 1, 2, ts=5.0) + "\n")
+    assert obs_main(["watch", "--dir", str(tmp_path),
+                     "--once"]) == 0
+    assert "e" * 16 in capsys.readouterr().out

@@ -227,6 +227,80 @@ def test_preempt_fires_only_after_save(tmp_path):
     assert step == 2 and np.asarray(state["x"])[0] == 2.0
 
 
+def test_fit_id_and_wall_survive_preempt_resume(tmp_path):
+    """PR 19: the loop mints one fit_id, persists it (plus the
+    cumulative wall accounting) in the checkpoint, and a resumed
+    fit continues the same id with monotone chunk indices — while
+    the meta leaves never leak into the user's state dict."""
+    from brainiak_tpu.obs import sink as obs_sink
+
+    d = str(tmp_path / "ck")
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        with inject("preempt", at_step=4):
+            with pytest.raises(PreemptionError):
+                run_resilient_loop(
+                    _counting_chunk, {"x": np.zeros(1)}, 10,
+                    checkpoint_dir=d, checkpoint_every=2)
+        state, step = run_resilient_loop(
+            _counting_chunk, {"x": np.zeros(1)}, 10,
+            checkpoint_dir=d, checkpoint_every=2)
+    finally:
+        obs_sink.remove_sink(mem)
+    assert step == 10 and state["x"][0] == 10.0
+    assert set(state) == {"x"}  # no fit_id/fit_wall meta leaves
+    progress = [r for r in mem.records if r["kind"] == "progress"]
+    assert len({r["fit_id"] for r in progress}) == 1
+    assert [r["chunk"] for r in progress] == [1, 2, 3, 4, 5]
+    walls = [r["fit_wall_s"] for r in progress]
+    assert all(b > a for a, b in zip(walls, walls[1:]))
+    resumes = [r for r in mem.records if r["kind"] == "event"
+               and r["name"] == "rollback" or r["name"] == "resume"]
+    assert any(r.get("fit_id") == progress[0]["fit_id"]
+               for r in resumes)
+
+
+def test_fresh_checkpoint_dir_mints_fresh_fit_id(tmp_path):
+    from brainiak_tpu.obs import sink as obs_sink
+
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        run_resilient_loop(_counting_chunk, {"x": np.zeros(1)}, 4,
+                           checkpoint_dir=str(tmp_path / "a"),
+                           checkpoint_every=2)
+        run_resilient_loop(_counting_chunk, {"x": np.zeros(1)}, 4,
+                           checkpoint_dir=str(tmp_path / "b"),
+                           checkpoint_every=2)
+    finally:
+        obs_sink.remove_sink(mem)
+    ids = {r["fit_id"] for r in mem.records
+           if r["kind"] == "progress"}
+    assert len(ids) == 2
+
+
+def test_divergence_abort_reports_fit_id_and_diverged_status():
+    from brainiak_tpu.obs import progress as obs_progress
+    from brainiak_tpu.obs import sink as obs_sink
+
+    obs_progress.clear_registry()
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        with inject("nan", at_step=2, times=10):
+            with pytest.raises(DivergenceError):
+                run_resilient_loop(
+                    _counting_chunk, {"x": np.zeros(1)}, 6,
+                    checkpoint_every=2, max_rollbacks=1)
+    finally:
+        obs_sink.remove_sink(mem)
+    (abort,) = [r for r in mem.records if r["kind"] == "event"
+                and r["name"] == "divergence_abort"]
+    assert abort["fit_id"]
+    (snap,) = [s for s in obs_progress.active_fits()
+               if s["fit_id"] == abort["fit_id"]]
+    assert snap["status"] == "diverged"
+    assert snap["rollbacks"] == 2  # the aborting failure counts too
+
+
 def test_replicate_identity_cached():
     """The fetch_replicated fallback compiles once per mesh."""
     import jax.numpy as jnp

@@ -45,9 +45,9 @@ def test_run_checks_json_output():
     assert set(payload["gates"]) == {
         "external", "stdlib", "doc-defaults", "resilient-fits",
         "jaxlint", "jaxlint-deep", "jaxlint-ir", "obs", "obs-live",
-        "regress", "serve", "service", "federation", "fleet",
-        "distla", "encoding", "kernels", "data", "realtime",
-        "stats"}
+        "obs-fit", "regress", "serve", "service", "federation",
+        "fleet", "distla", "encoding", "kernels", "data",
+        "realtime", "stats"}
     assert payload["files"] > 100
     seconds = payload["gate_seconds"]
     assert set(seconds) == set(payload["gates"])
@@ -957,6 +957,84 @@ def test_obs_live_gate_classifies_failures(monkeypatch):
     rc.check_obs_live(findings)
     assert [f.code for f in findings] == ["OBS002"]
     assert "readyz_ready=False" in findings[0].message
+
+
+# -- ISSUE 19: the obs-fit gate (OBS003) ------------------------------
+
+def test_obs_fit_gate_passes_on_live_package():
+    """The obs-fit gate (OBS003): a child drives a chunked
+    resilient fit through preempt/resume and a NaN-divergence
+    incident, checking fit_id parity, precursor-before-guard
+    ordering, the auto-dumped snapshot, and the postmortem render.
+    Passing on the live tree IS the fit-telemetry acceptance at
+    process granularity."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_obs_fit(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_obs_fit_gate_classifies_failures(monkeypatch):
+    """A failing verdict is reported as OBS003 with the failure
+    mode named: schema drift, resume-parity breaks, a late
+    precursor, snapshot/postmortem failures, and hard child
+    crashes each classify distinctly."""
+    rc = _load_run_checks()
+
+    def fake_child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    base = {"ok": False, "fit_id_stable": True,
+            "chunks_monotone": True, "wall_cumulative": True,
+            "chunks": [1, 2, 3, 4, 5], "aborted": True,
+            "precursor_fired": True,
+            "precursor_before_guard": True, "n_snapshots": 1,
+            "snapshot_ok": True, "postmortem_rc": 0,
+            "postmortem_ok": True, "schema_errors": []}
+
+    monkeypatch.setattr(rc, "_OBS_FIT_CHILD", fake_child(
+        dict(base, schema_errors=["progress: missing key ratio"])))
+    findings = []
+    rc.check_obs_fit(findings)
+    assert [f.code for f in findings] == ["OBS003"]
+    assert "not schema-clean" in findings[0].message
+
+    monkeypatch.setattr(rc, "_OBS_FIT_CHILD", fake_child(
+        dict(base, fit_id_stable=False)))
+    findings = []
+    rc.check_obs_fit(findings)
+    assert [f.code for f in findings] == ["OBS003"]
+    assert "resume parity broke" in findings[0].message
+
+    monkeypatch.setattr(rc, "_OBS_FIT_CHILD", fake_child(
+        dict(base, precursor_before_guard=False)))
+    findings = []
+    rc.check_obs_fit(findings)
+    assert [f.code for f in findings] == ["OBS003"]
+    assert "did not fire before the guard" in findings[0].message
+
+    monkeypatch.setattr(rc, "_OBS_FIT_CHILD", fake_child(
+        dict(base, n_snapshots=0, snapshot_ok=False)))
+    findings = []
+    rc.check_obs_fit(findings)
+    assert [f.code for f in findings] == ["OBS003"]
+    assert "n_snapshots=0" in findings[0].message
+
+    monkeypatch.setattr(rc, "_OBS_FIT_CHILD", fake_child(
+        dict(base, error="RuntimeError: boom")))
+    findings = []
+    rc.check_obs_fit(findings)
+    assert [f.code for f in findings] == ["OBS003"]
+    assert "boom" in findings[0].message
+
+    monkeypatch.setattr(rc, "_OBS_FIT_CHILD",
+                        "raise SystemExit(3)")
+    findings = []
+    rc.check_obs_fit(findings)
+    assert [f.code for f in findings] == ["OBS003"]
+    assert "rc=3" in findings[0].message
 
 
 # -- jaxlint-ir gate --------------------------------------------------

@@ -45,6 +45,15 @@ obs-live   live telemetry plane (OBS002): a child process drives
            /healthz + /readyz, validates the Prometheus text with
            the in-repo parser, and requires the serve_*/slo_*
            series present and in agreement with the JSON summary
+obs-fit    fit-progress plane (OBS003): a child process drives a
+           chunked resilient fit through a preemption/resume
+           cycle and then a NaN-divergence incident under
+           ``BRAINIAK_TPU_OBS_DIR``, and requires one stable
+           fit_id with monotone chunk indices across the resume,
+           a divergence_precursor timestamped before the guard's
+           rollback, exactly one auto-dumped flight-recorder
+           snapshot naming the aborting fit, and a clean
+           ``obs postmortem`` render of it
 regress    runs ``python -m brainiak_tpu.obs regress`` on the
            committed tools/bench_fixture/ history and fails on
            a regression verdict (REG001) — the bench gate runs
@@ -140,9 +149,9 @@ from brainiak_tpu.analysis.core import (  # noqa: E402,F401
 MAX_COLS = 79
 GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
          "jaxlint", "jaxlint-deep", "jaxlint-ir", "obs", "obs-live",
-         "regress", "serve", "service", "federation", "fleet",
-         "distla", "encoding", "kernels", "data", "realtime",
-         "stats")
+         "obs-fit", "regress", "serve", "service", "federation",
+         "fleet", "distla", "encoding", "kernels", "data",
+         "realtime", "stats")
 
 
 def python_sources():
@@ -553,6 +562,92 @@ def check_obs_live(findings):
         f"healthz_ok={verdict.get('healthz_ok')} "
         f"readyz_ready={verdict.get('readyz_ready')} "
         f"metrics_status={verdict.get('metrics_status')}"))
+
+
+# -- obs-fit gate -----------------------------------------------------
+
+_OBS_FIT_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.obs.fitcheck import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_obs_fit(findings):
+    """Fit-progress gate (OBS003): run
+    :func:`brainiak_tpu.obs.fitcheck.selfcheck` in a CPU-pinned
+    child — a chunked resilient fit preempted and resumed, then a
+    NaN-divergence incident.  Fails when the fit_id does not
+    survive the resume, chunk indices break monotonicity, the
+    divergence precursor is not timestamped before the guard's
+    rollback, the abort does not auto-dump exactly one
+    flight-recorder snapshot naming the fit, or the postmortem CLI
+    cannot render that snapshot."""
+    rel = _rel(os.path.join(REPO, "brainiak_tpu", "obs",
+                            "fitcheck.py"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _OBS_FIT_CHILD],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     BENCH_FORCE_CPU="1"),
+            timeout=420)
+    except subprocess.TimeoutExpired:
+        findings.append(Finding(
+            rel, 1, "OBS003",
+            "obs-fit selfcheck timed out after 420s (hung "
+            "backend init?)"))
+        return
+    try:
+        verdict = json.loads(proc.stdout.splitlines()[-1])
+    except (ValueError, IndexError):
+        verdict = None
+    if verdict is None or proc.returncode not in (0, 1):
+        tail = (proc.stderr or proc.stdout or "").strip()
+        tail = "; ".join(tail.splitlines()[-3:])
+        findings.append(Finding(
+            rel, 1, "OBS003",
+            f"obs-fit selfcheck failed (rc={proc.returncode}): "
+            f"{tail or 'no JSON verdict'}"))
+        return
+    if verdict.get("ok"):
+        return
+    if verdict.get("error"):
+        findings.append(Finding(
+            rel, 1, "OBS003",
+            f"obs-fit drive crashed: {verdict['error']}"))
+        return
+    if verdict.get("schema_errors"):
+        for err in verdict["schema_errors"][:5]:
+            findings.append(Finding(
+                rel, 1, "OBS003",
+                f"progress stream is not schema-clean: {err}"))
+        return
+    if not verdict.get("fit_id_stable", True) \
+            or not verdict.get("chunks_monotone", True) \
+            or not verdict.get("wall_cumulative", True):
+        findings.append(Finding(
+            rel, 1, "OBS003",
+            "resume parity broke: "
+            f"fit_id_stable={verdict.get('fit_id_stable')} "
+            f"chunks={verdict.get('chunks')} "
+            f"wall_cumulative={verdict.get('wall_cumulative')}"))
+        return
+    if not verdict.get("precursor_before_guard", True):
+        findings.append(Finding(
+            rel, 1, "OBS003",
+            "divergence precursor did not fire before the guard "
+            f"(fired={verdict.get('precursor_fired')})"))
+        return
+    findings.append(Finding(
+        rel, 1, "OBS003",
+        "incident snapshot/postmortem failed: "
+        f"aborted={verdict.get('aborted')} "
+        f"n_snapshots={verdict.get('n_snapshots')} "
+        f"snapshot_ok={verdict.get('snapshot_ok')} "
+        f"postmortem_rc={verdict.get('postmortem_rc')}"))
 
 
 # -- regress gate -----------------------------------------------------
@@ -1508,6 +1603,8 @@ def run_gates(only=None):
         timed("obs", check_obs, findings)
     if "obs-live" in selected:
         timed("obs-live", check_obs_live, findings)
+    if "obs-fit" in selected:
+        timed("obs-fit", check_obs_fit, findings)
     if "regress" in selected:
         timed("regress", check_regress, findings)
     if "serve" in selected:
@@ -1549,9 +1646,10 @@ def run_gates(only=None):
         (["stdlib"] if "stdlib" in selected else []) + ran
         + [g for g in ("doc-defaults", "resilient-fits", "jaxlint",
                        "jaxlint-deep", "jaxlint-ir", "obs",
-                       "obs-live", "regress", "serve", "service",
-                       "federation", "fleet", "distla", "encoding",
-                       "kernels", "data", "realtime", "stats")
+                       "obs-live", "obs-fit", "regress", "serve",
+                       "service", "federation", "fleet", "distla",
+                       "encoding", "kernels", "data", "realtime",
+                       "stats")
            if g in selected])
     return {
         "ok": not findings,
