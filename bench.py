@@ -193,6 +193,26 @@ STATS_VOXELS = 4096
 STATS_CPU_VOXELS = 1024
 STATS_BASELINE_RESAMPLES = 64
 
+# jobs tier (fit-as-a-service scheduler, brainiak_tpu.jobs): a
+# Zipf/Pareto fit workload from the TrafficGenerator's fit mode —
+# two tenants, mixed priorities — driven through the Scheduler
+# (2 slots, 3-chunk grants) while a warm ServeService answers
+# co-scheduled transform waves.  Gated numbers: scheduled jobs/s
+# with ``vs_baseline`` = the ratio vs running the same fits
+# back-to-back solo (scheduling+parking overhead vs the slot
+# parallelism win), the co-scheduled serving p99 (lower_is_better —
+# throughput fits must not wreck the latency tier), and jobs_lost
+# (lower_is_better, zero baseline: a lost job is a regression at
+# any throughput).  BENCH_JOBS_COUNT overrides either backend's job
+# count.
+JOBS_COUNT = 8
+JOBS_CPU_COUNT = 6
+JOBS_N_ITER = 6
+JOBS_VOXELS = 16
+JOBS_SAMPLES = 20
+JOBS_MAX_SLOTS = 2
+JOBS_GRANT_CHUNKS = 3
+
 
 def _serve_n_requests():
     """The serve tier's request count: one reader for the env
@@ -766,6 +786,174 @@ def _stats_result_record(out):
     if out.get("stages"):
         rec["stages"] = out["stages"]
     return rec
+
+
+def _jobs_count():
+    """The jobs tier's job count (``BENCH_JOBS_COUNT`` overrides) —
+    one reader, same no-drift rule as the other tiers."""
+    import os
+
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    return int(os.environ.get(
+        "BENCH_JOBS_COUNT",
+        JOBS_COUNT if on_tpu else JOBS_CPU_COUNT))
+
+
+def jobs_tier_metrics(n_jobs, seed=0):
+    """The ``jobs`` tier: a two-tenant mixed-priority fit workload
+    (the :class:`~brainiak_tpu.serve.federation.traffic.
+    TrafficGenerator` fit mode — Zipf tenant mix, the same stream
+    the soak test replays) through one
+    :class:`~brainiak_tpu.jobs.scheduler.Scheduler`, co-scheduled
+    with a warm :class:`~brainiak_tpu.serve.service.ServeService`
+    answering fixed-shape transform waves the whole time.
+
+    The solo baseline runs the identical specs back-to-back through
+    :func:`~brainiak_tpu.jobs.runners.run_job` (no scheduler, no
+    parking) — ``vs_baseline`` on the throughput record is the
+    scheduled/solo rate ratio."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+
+    from brainiak_tpu.jobs.runners import run_job
+    from brainiak_tpu.jobs.scheduler import Scheduler
+    from brainiak_tpu.serve import BucketPolicy, ModelResidency
+    from brainiak_tpu.serve.__main__ import build_demo_model
+    from brainiak_tpu.serve.batching import Request
+    from brainiak_tpu.serve.federation.traffic import \
+        TrafficGenerator
+    from brainiak_tpu.serve.service import ServeService
+
+    with obs.span("bench.data_gen"):
+        gen = TrafficGenerator(seed=seed)
+        specs = gen.fit_jobs(
+            n_jobs, tenants=("hospital-a", "hospital-b"),
+            kinds=("srm",), priorities=(0, 1),
+            n_iter=JOBS_N_ITER, features=3,
+            voxels=JOBS_VOXELS, samples=JOBS_SAMPLES)
+        srm = build_demo_model(n_subjects=2, voxels=32, samples=32,
+                               features=4, n_iter=2, seed=seed)
+        counts = [w.shape[0] for w in srm.w_]
+        residency = ModelResidency(
+            budget_bytes=1 << 30,
+            policy=BucketPolicy(max_batch=8, max_wait_s=0.05))
+        residency.register("m", model=srm)
+        rng = np.random.RandomState(seed)
+        payloads = [rng.randn(counts[i % 2], 16).astype(np.float32)
+                    for i in range(4)]
+
+    latencies = []
+    tmp = tempfile.mkdtemp(prefix="bench-jobs-")
+    try:
+        with ServeService(residency, default_model="m") as service:
+
+            def wave(prefix):
+                reqs = [Request(request_id=f"{prefix}-{i}",
+                                x=payloads[i], subject=i % 2,
+                                model="m")
+                        for i in range(len(payloads))]
+                for ticket in service.submit_many(reqs):
+                    rec = ticket.result(timeout=60.0)
+                    if rec.ok and rec.latency_s is not None:
+                        latencies.append(rec.latency_s)
+
+            with obs.span("bench.warm"):
+                # pays every compile: the serving buckets AND the
+                # fit programs (an unmeasured solo pass), then times
+                # the WARM solo baseline — the vs_baseline ratio
+                # compares steady state to steady state, not a
+                # compile-paying run to a warm one
+                wave("warm")
+                for spec in specs:
+                    run_job(spec, os.path.join(tmp, "solo-warm"))
+                t0 = time.perf_counter()
+                for spec in specs:
+                    run_job(spec, os.path.join(tmp, "solo"))
+                solo_rate = n_jobs / (time.perf_counter() - t0)
+            latencies.clear()  # warm latencies are not the number
+
+            with obs.span("bench.steady"):
+                sched = Scheduler(
+                    os.path.join(tmp, "jobs"),
+                    max_slots=JOBS_MAX_SLOTS,
+                    grant_chunks=JOBS_GRANT_CHUNKS,
+                    serve_pressure_depth=1 << 20,
+                    tick_interval_s=0.01)
+                try:
+                    t0 = time.perf_counter()
+                    tickets = sched.submit_many(specs)
+                    k = 0
+                    while not all(t.done() for t in tickets):
+                        wave(f"co{k}")
+                        k += 1
+                        time.sleep(0.01)
+                    records = [t.result(timeout=600.0)
+                               for t in tickets]
+                    sched_rate = n_jobs \
+                        / (time.perf_counter() - t0)
+                finally:
+                    sched.close()
+        lost = [r["job_id"] for r in records
+                if r["state"] != "done"]
+        p99 = float(np.percentile(latencies, 99)) \
+            if latencies else 0.0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {"jobs_per_sec": sched_rate,
+            "solo_jobs_per_sec": solo_rate,
+            "coserve_p99_s": p99,
+            "n_serve_requests": len(latencies),
+            "jobs_lost": len(lost), "lost": lost,
+            "n_jobs": n_jobs, "n_iter": JOBS_N_ITER,
+            "backend": jax.default_backend()}
+
+
+def _jobs_result_records(out):
+    """The jobs tier's bench JSON lines — three records: scheduled
+    jobs/s (``vs_baseline`` = the scheduled/solo rate ratio),
+    co-scheduled serving p99 (``lower_is_better``), and jobs_lost
+    (``lower_is_better``, zero baseline).  Tier split mirrors every
+    other tier (``jobs`` on TPU, ``jobs_cpu_fallback``
+    otherwise)."""
+    tier = "jobs" if out.get("backend") == "tpu" \
+        else "jobs_cpu_fallback"
+    config = {"n_jobs": out["n_jobs"], "n_iter": out["n_iter"],
+              "n_tenants": 2, "kinds": ["srm"],
+              "max_slots": JOBS_MAX_SLOTS,
+              "grant_chunks": JOBS_GRANT_CHUNKS,
+              "backend": out.get("backend")}
+    commit = _git_commit()
+
+    def rec(metric, value, unit, vs=0.0, direction=None,
+            stages=None):
+        r = {"schema_version": BENCH_SCHEMA_VERSION,
+             "metric": metric, "value": round(float(value), 6),
+             "unit": unit, "vs_baseline": round(float(vs), 3),
+             "tier": tier, "config": config}
+        if direction:
+            r["direction"] = direction
+        if commit:
+            r["git_commit"] = commit
+        if stages:
+            r["stages"] = stages
+        return r
+
+    solo = out.get("solo_jobs_per_sec") or 0.0
+    return [
+        rec("jobs_scheduled_jobs_per_sec", out["jobs_per_sec"],
+            "jobs/sec",
+            vs=out["jobs_per_sec"] / solo if solo else 0.0,
+            stages=out.get("stages")),
+        rec("jobs_coserve_p99_latency_seconds",
+            out["coserve_p99_s"], "s",
+            direction="lower_is_better"),
+        rec("jobs_lost", out["jobs_lost"], "jobs",
+            direction="lower_is_better"),
+    ]
 
 
 def _kernels_shape():
@@ -1687,6 +1875,16 @@ def measure_tier(tier):
                           else "stats_cpu_fallback")
             out["stages"] = _stage_seconds(mem.records)
             return out
+        if tier == "jobs":
+            out = jobs_tier_metrics(_jobs_count())
+            # tier split by backend, same rule as every other tier
+            obs.gauge("bench_jobs_scheduled_jobs_per_sec",
+                      unit="jobs/sec").set(
+                          out["jobs_per_sec"],
+                          tier="jobs" if out["backend"] == "tpu"
+                          else "jobs_cpu_fallback")
+            out["stages"] = _stage_seconds(mem.records)
+            return out
         if tier == "streaming":
             out = streaming_tier_metrics(*_streaming_shape())
             # tier split by backend, same rule as every other tier
@@ -1842,6 +2040,7 @@ def main():
     _streaming_main(responsive)
     _realtime_main(responsive)
     _stats_main(responsive)
+    _jobs_main(responsive)
 
 
 def _aux_tier_main(responsive, tier, record_fn, timeout=420):
@@ -1920,6 +2119,14 @@ def _stats_main(responsive):
     """Stats tier: resampling-null surrogates/s through the chunked
     NullEngine, with the host-loop formulation as ``vs_baseline``."""
     _aux_tier_main(responsive, "stats", _stats_result_record)
+
+
+def _jobs_main(responsive):
+    """Jobs tier: the fit scheduler co-scheduled with warm serving
+    — three records (scheduled jobs/s vs the solo baseline,
+    co-scheduled serving p99, jobs lost; the latter two
+    lower-is-better)."""
+    _aux_tier_main(responsive, "jobs", _jobs_result_records)
 
 
 def _realtime_main(responsive):

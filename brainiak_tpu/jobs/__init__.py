@@ -1,0 +1,57 @@
+"""Fit-as-a-service: the multi-tenant training control plane.
+
+Serving is always-on; this package makes *fitting* always-on too.
+Tenants describe fits as :class:`~brainiak_tpu.jobs.spec.JobSpec`
+values (npz-codec batches over the wire), the
+:class:`~brainiak_tpu.jobs.scheduler.Scheduler` gang-schedules them
+as resumable chunk sequences through
+:func:`~brainiak_tpu.resilience.guards.run_resilient_loop` —
+priority preemption parks running fits via the universal
+``checkpoint_dir=`` contract, weighted fair-share
+(:class:`~brainiak_tpu.jobs.quota.FairShare`) keeps any one tenant
+from starving the rest, and per-tenant quotas wire into the serving
+tier's :class:`~brainiak_tpu.serve.federation.admission.
+AdmissionController`.  Scheduler state feeds the ``/jobs`` endpoint
+(rendered by ``python -m brainiak_tpu.obs watch``) and ``python -m
+brainiak_tpu.jobs submit|status|cancel`` speaks to a live fleet.
+
+See ``docs/jobs.md`` for the lifecycle state machine, the
+scheduling policy, the fair-share math, and the preemption
+contract.
+"""
+
+from .quota import FairShare  # noqa: F401
+from .scheduler import (  # noqa: F401
+    JobRecord,
+    JobTicket,
+    Scheduler,
+    SchedulerClosed,
+    scheduler_state,
+)
+from .spec import (  # noqa: F401
+    KINDS,
+    STATES,
+    TERMINAL_STATES,
+    JobSpec,
+    decode_jobs,
+    encode_jobs,
+    load_jobs,
+    save_jobs,
+)
+
+__all__ = [
+    "KINDS",
+    "STATES",
+    "TERMINAL_STATES",
+    "FairShare",
+    "JobRecord",
+    "JobSpec",
+    "JobTicket",
+    "Scheduler",
+    "SchedulerClosed",
+    "decode_jobs",
+    "encode_jobs",
+    "load_jobs",
+    "save_jobs",
+    "scheduler_state",
+]

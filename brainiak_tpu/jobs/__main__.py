@@ -1,0 +1,161 @@
+"""``python -m brainiak_tpu.jobs`` — the fleet-facing job client.
+
+Subcommands (all speak to a live scheduler's telemetry port — the
+:class:`~brainiak_tpu.obs.http.TelemetryServer` a
+``Scheduler(http_port=...)`` attaches its control plane to):
+
+- ``gen`` — write an npz job batch
+  (:func:`~brainiak_tpu.jobs.spec.save_jobs`) from CLI parameters;
+- ``submit`` — POST a job batch to ``<url>/jobs/submit``; prints the
+  accepted/shed verdict as JSON;
+- ``status`` — GET ``<url>/jobs`` and render the scheduler table
+  (or ``--json`` for the raw payload);
+- ``cancel`` — POST ``<url>/jobs/cancel?job_id=<id>``.
+
+Exit codes: 0 success, 1 request-level failure (shed, unknown job),
+2 usage / transport error.
+"""
+
+import argparse
+import json
+import sys
+from urllib.error import URLError
+from urllib.request import Request, urlopen
+
+from .spec import KINDS, JobSpec, save_jobs
+
+__all__ = ["main"]
+
+
+def _fetch(url, data=None, timeout=10.0):
+    req = Request(url, data=data,
+                  method="POST" if data is not None else "GET")
+    with urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _gen(args):
+    specs = []
+    for i in range(args.n):
+        specs.append(JobSpec(
+            tenant=args.tenant, kind=args.kind,
+            priority=args.priority, n_iter=args.n_iter,
+            features=args.features, seed=args.seed + i,
+            n_subjects=args.subjects, voxels=args.voxels,
+            samples=args.samples, deadline_s=args.deadline_s))
+    save_jobs(args.out, specs)
+    print(json.dumps({"written": args.out,
+                      "job_ids": [s.job_id for s in specs]},
+                     indent=2))
+    return 0
+
+
+def _submit(args):
+    with open(args.jobs, "rb") as fh:
+        body = fh.read()
+    try:
+        text = _fetch(args.url.rstrip("/") + "/jobs/submit",
+                      data=body, timeout=args.timeout)
+    except (URLError, OSError) as exc:
+        print(f"submit failed: {exc}", file=sys.stderr)
+        return 2
+    print(text.rstrip())
+    verdict = json.loads(text)
+    return 1 if verdict.get("shed") else 0
+
+
+def _render_status(payload):
+    scheduler = payload.get("scheduler")
+    if not scheduler:
+        return "no scheduler live (fits only: {} active)".format(
+            len(payload.get("fits", [])))
+    lines = ["{:<18} {:<10} {:>4} {:<9} {:>6} {:>8} {:>9}".format(
+        "JOB", "TENANT", "PRI", "STATE", "CHUNK", "PREEMPT",
+        "DEFICIT")]
+    tenants = scheduler.get("tenants", {})
+    for row in scheduler.get("jobs", []):
+        deficit = tenants.get(row["tenant"], {}).get("deficit", 0.0)
+        lines.append(
+            "{:<18} {:<10} {:>4} {:<9} {:>6.0f} {:>8} {:>9.2f}"
+            .format(row["job_id"][:16], row["tenant"][:10],
+                    row["priority"], row["state"], row["chunks"],
+                    row["n_preemptions"], deficit))
+    counts = scheduler.get("counts", {})
+    lines.append("states: " + ", ".join(
+        f"{state}={n}" for state, n in sorted(counts.items())))
+    return "\n".join(lines)
+
+
+def _status(args):
+    try:
+        text = _fetch(args.url.rstrip("/") + "/jobs",
+                      timeout=args.timeout)
+    except (URLError, OSError) as exc:
+        print(f"status failed: {exc}", file=sys.stderr)
+        return 2
+    payload = json.loads(text)
+    if args.json:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print(_render_status(payload))
+    return 0
+
+
+def _cancel(args):
+    try:
+        text = _fetch(
+            args.url.rstrip("/")
+            + f"/jobs/cancel?job_id={args.job_id}",
+            data=b"", timeout=args.timeout)
+    except (URLError, OSError) as exc:
+        print(f"cancel failed: {exc}", file=sys.stderr)
+        return 2
+    print(text.rstrip())
+    return 0 if json.loads(text).get("cancelled") else 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m brainiak_tpu.jobs",
+        description="job client for the fit scheduler")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    gen = sub.add_parser("gen", help="write an npz job batch")
+    gen.add_argument("--out", required=True)
+    gen.add_argument("--tenant", required=True)
+    gen.add_argument("--kind", choices=KINDS, default="srm")
+    gen.add_argument("--n", type=int, default=1)
+    gen.add_argument("--priority", type=int, default=0)
+    gen.add_argument("--n-iter", type=int, default=6)
+    gen.add_argument("--features", type=int, default=3)
+    gen.add_argument("--seed", type=int, default=0)
+    gen.add_argument("--subjects", type=int, default=3)
+    gen.add_argument("--voxels", type=int, default=16)
+    gen.add_argument("--samples", type=int, default=20)
+    gen.add_argument("--deadline-s", type=float, default=None)
+    gen.set_defaults(fn=_gen)
+
+    submit = sub.add_parser("submit", help="POST a job batch")
+    submit.add_argument("jobs", help="npz batch (see gen)")
+    submit.add_argument("--url", required=True)
+    submit.add_argument("--timeout", type=float, default=10.0)
+    submit.set_defaults(fn=_submit)
+
+    status = sub.add_parser("status", help="render /jobs")
+    status.add_argument("--url", required=True)
+    status.add_argument("--json", action="store_true")
+    status.add_argument("--timeout", type=float, default=10.0)
+    status.set_defaults(fn=_status)
+
+    cancel = sub.add_parser("cancel", help="cancel one job")
+    cancel.add_argument("job_id")
+    cancel.add_argument("--url", required=True)
+    cancel.add_argument("--timeout", type=float, default=10.0)
+    cancel.set_defaults(fn=_cancel)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

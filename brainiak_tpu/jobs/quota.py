@@ -1,0 +1,114 @@
+"""Weighted fair-share accounting across chunk grants.
+
+The scheduler's currency is the **chunk grant**: a running fit is
+allowed some number of resilient-loop chunks before it must yield
+(park via checkpoint) and requeue.  :class:`FairShare` keeps the
+ledger — per-tenant chunks consumed, normalized by weight — and
+answers the only question the scheduler asks: *of the tenants with
+runnable work, who is furthest behind its fair share?*
+
+The math is start-time fair queueing reduced to its virtual-time
+core.  Tenant *t* with weight :math:`w_t` has consumed :math:`u_t`
+chunks; its **virtual time** is :math:`v_t = u_t / w_t`.  The
+scheduler always grants the runnable tenant with minimal :math:`v_t`,
+which bounds any tenant's service lag behind its entitled share by
+one grant per competitor — a light tenant can be delayed at most
+``(n_tenants - 1) * grant_chunks`` chunks beyond its fair turn, never
+starved (the starvation test asserts the bound).  The reported
+**deficit** is entitlement minus consumption,
+
+.. math:: d_t = \\frac{w_t}{\\sum_s w_s} \\cdot U - u_t
+
+(:math:`U` = total chunks consumed): positive = under-served, and the
+``obs watch`` scheduler column renders it directly.
+"""
+
+import threading
+
+__all__ = ["FairShare"]
+
+
+class FairShare:
+    """Deficit ledger over chunk grants (thread-safe: the scheduler
+    tick and worker threads both charge it).
+
+    Parameters
+    ----------
+    weights : dict, optional
+        Tenant -> relative weight (> 0).  Unlisted tenants get
+        ``default_weight``.
+    default_weight : float
+        Weight for tenants without an explicit entry.
+    """
+
+    def __init__(self, weights=None, default_weight=1.0):
+        if default_weight <= 0:
+            raise ValueError(
+                f"default_weight must be > 0, got {default_weight}")
+        for tenant, w in (weights or {}).items():
+            if w <= 0:
+                raise ValueError(
+                    f"weight for tenant {tenant!r} must be > 0, "
+                    f"got {w}")
+        self.weights = dict(weights or {})
+        self.default_weight = float(default_weight)
+        self._lock = threading.Lock()
+        self._usage = {}  # guarded-by: _lock (tenant -> chunks)
+
+    def weight(self, tenant):
+        """The tenant's relative weight."""
+        return float(self.weights.get(tenant, self.default_weight))
+
+    def charge(self, tenant, chunks):
+        """Account ``chunks`` consumed by ``tenant``."""
+        if chunks < 0:
+            raise ValueError(f"chunks must be >= 0, got {chunks}")
+        with self._lock:
+            self._usage[tenant] = \
+                self._usage.get(tenant, 0.0) + float(chunks)
+
+    def usage(self, tenant):
+        """Raw chunks consumed by ``tenant``."""
+        with self._lock:
+            return self._usage.get(tenant, 0.0)
+
+    def virtual_time(self, tenant):
+        """``usage / weight`` — the quantity the scheduler
+        minimizes."""
+        return self.usage(tenant) / self.weight(tenant)
+
+    def pick(self, tenants):
+        """The tenant with minimal virtual time (deterministic
+        lexical tie-break), or None for an empty candidate set."""
+        candidates = sorted(set(tenants))
+        if not candidates:
+            return None
+        return min(candidates,
+                   key=lambda t: (self.virtual_time(t), t))
+
+    def deficits(self, tenants=None):
+        """Tenant -> entitlement-minus-consumption (see module
+        docstring); positive = under-served.  ``tenants`` widens the
+        answer to tenants that have not consumed anything yet."""
+        with self._lock:
+            usage = dict(self._usage)
+        for t in tenants or ():
+            usage.setdefault(t, 0.0)
+        if not usage:
+            return {}
+        total = sum(usage.values())
+        total_w = sum(self.weight(t) for t in usage)
+        return {t: (self.weight(t) / total_w) * total - u
+                for t, u in usage.items()}
+
+    def summary(self):
+        """The ledger as one JSON-serializable dict (the ``/jobs``
+        ``tenants`` payload)."""
+        with self._lock:
+            usage = dict(self._usage)
+        deficits = self.deficits()
+        return {t: {"usage": usage[t],
+                    "weight": self.weight(t),
+                    "virtual_time": usage[t] / self.weight(t),
+                    "deficit": deficits.get(t, 0.0)}
+                for t in sorted(usage)}

@@ -1,0 +1,188 @@
+"""Per-kind job runners: a JobSpec in, a finished fit out.
+
+Each runner drives one estimator-kind's ``fit(...,
+checkpoint_dir=)`` entry point — the universal resilient-fit
+contract — so EVERY job the scheduler runs is resumable and parkable
+at chunk granularity for free:
+
+- ``srm`` — :class:`~brainiak_tpu.funcalign.srm.SRM` EM over a
+  subject list (the streamed path when ``spec.data`` names a
+  ``write_store`` directory);
+- ``incremental_srm`` — :class:`~brainiak_tpu.data.streaming_fit.
+  IncrementalSRM` epochs over a :class:`~brainiak_tpu.data.store.
+  SubjectStore` (synthetic jobs materialize a small store under the
+  job's workdir once, then reuse it across park/resume cycles);
+- ``htfa`` — :class:`~brainiak_tpu.factoranalysis.htfa.HTFA` global
+  MAP rounds;
+- ``ridge_encoding`` — :class:`~brainiak_tpu.encoding.ridge.
+  RidgeEncoder` CV sweep in per-lambda blocks.
+
+Determinism is the load-bearing property: a runner invoked twice for
+the same spec builds bit-identical data (seeded from ``spec.seed``)
+and estimator config, so a parked job re-invoked with the same
+``checkpoint_dir`` resumes the SAME fit (same ``fit_id``, cumulative
+wall clock) and lands on bit-exact final parameters — the
+preempt-park-resume parity the tests and the JOB001 gate assert.
+
+The runner result is ``{"kind", "digest", "arrays"}`` where
+``digest`` is :func:`~brainiak_tpu.resilience.guards.array_digest`
+over the fitted parameters (the cheap cross-process parity probe)
+and ``arrays`` holds the parameters themselves for in-process
+bit-exact comparison.
+"""
+
+import os
+
+import numpy as np
+
+from .spec import KINDS
+
+__all__ = ["checkpoint_dir_for", "run_job", "synthetic_subjects"]
+
+
+def checkpoint_dir_for(spec, workdir):
+    """The job's checkpoint directory — ``workdir/<job_id>``, stable
+    across park/resume cycles (the preemption contract hinges on
+    re-invoking the fit with this exact path)."""
+    return os.path.join(workdir, spec.job_id)
+
+
+def synthetic_subjects(spec):
+    """Seeded per-subject data ``[voxels, samples]`` — bit-identical
+    across invocations for the same spec (see module docstring)."""
+    rng = np.random.RandomState(int(spec.seed) & 0x7FFFFFFF)
+    return [rng.randn(int(spec.voxels), int(spec.samples))
+            .astype(np.float64)
+            for _ in range(int(spec.n_subjects))]
+
+
+def _load_npz_subjects(path):
+    with np.load(path, allow_pickle=False) as archive:
+        xs = [archive[k] for k in sorted(
+            (k for k in archive.files if k.startswith("X.")),
+            key=lambda k: int(k.split(".", 1)[1]))]
+        y = archive["Y"] if "Y" in archive.files else None
+    return xs, y
+
+
+def _subject_data(spec):
+    """(subjects list, Y-or-None) from ``spec.data`` or synthesis."""
+    if spec.data is not None and os.path.isfile(spec.data):
+        return _load_npz_subjects(spec.data)
+    return synthetic_subjects(spec), None
+
+
+def _collect_arrays(model, names):
+    out = {}
+    for name in names:
+        value = getattr(model, name, None)
+        if value is None:
+            continue
+        if isinstance(value, (list, tuple)):
+            for i, item in enumerate(value):
+                out[f"{name}{i}"] = np.asarray(item)
+        else:
+            out[name] = np.asarray(value)
+    return out
+
+
+def _run_srm(spec, ckpt_dir):
+    from ..funcalign.srm import SRM
+
+    if spec.data is not None and os.path.isdir(spec.data):
+        from ..data.store import open_store
+        x = open_store(spec.data)  # streamed fit path
+    else:
+        x, _ = _subject_data(spec)
+    model = SRM(n_iter=int(spec.n_iter),
+                features=int(spec.features),
+                rand_seed=int(spec.seed))
+    model.fit(x, checkpoint_dir=ckpt_dir,
+              checkpoint_every=int(spec.checkpoint_every))
+    return _collect_arrays(model, ("w_", "s_", "rho2_"))
+
+
+def _run_incremental_srm(spec, ckpt_dir):
+    from ..data.store import open_store, write_store
+    from ..data.streaming_fit import IncrementalSRM
+
+    if spec.data is not None:
+        store = open_store(spec.data)
+    else:
+        store_dir = ckpt_dir + "-data"
+        if not os.path.isdir(store_dir):
+            write_store(store_dir, synthetic_subjects(spec))
+        store = open_store(store_dir)
+    model = IncrementalSRM(n_iter=int(spec.n_iter),
+                           features=int(spec.features),
+                           rand_seed=int(spec.seed))
+    model.fit(store, checkpoint_dir=ckpt_dir,
+              checkpoint_every=int(spec.checkpoint_every))
+    return _collect_arrays(model, ("s_",))
+
+
+def _run_htfa(spec, ckpt_dir):
+    from ..factoranalysis.htfa import HTFA
+
+    x, _ = _subject_data(spec)
+    rng = np.random.RandomState((int(spec.seed) + 1) & 0x7FFFFFFF)
+    coords = [rng.uniform(0.0, 10.0, size=(arr.shape[0], 3))
+              for arr in x]
+    model = HTFA(K=int(spec.features), n_subj=len(x),
+                 max_global_iter=int(spec.n_iter),
+                 max_local_iter=2)
+    model.fit(x, coords, checkpoint_dir=ckpt_dir,
+              checkpoint_every=int(spec.checkpoint_every))
+    return _collect_arrays(
+        model, ("global_posterior_", "local_posterior_"))
+
+
+def _run_ridge(spec, ckpt_dir):
+    from ..encoding.ridge import RidgeEncoder
+
+    if spec.data is not None:
+        xs, y = _load_npz_subjects(spec.data)
+        design, responses = xs[0], y
+    else:
+        rng = np.random.RandomState(int(spec.seed) & 0x7FFFFFFF)
+        t = max(int(spec.samples), 4 * 2)
+        design = rng.randn(t, int(spec.features))
+        responses = rng.randn(t, int(spec.voxels))
+    # one lambda per block: the sweep checkpoints (and parks) at
+    # per-lambda granularity, n_iter lambdas = n_iter loop steps
+    model = RidgeEncoder(
+        lambdas=np.logspace(-2.0, 2.0, int(spec.n_iter)),
+        n_folds=2, lambda_block=1)
+    model.fit(design, responses, checkpoint_dir=ckpt_dir,
+              checkpoint_every=int(spec.checkpoint_every))
+    return _collect_arrays(model, ("W_", "lambda_"))
+
+
+_RUNNERS = {
+    "srm": _run_srm,
+    "incremental_srm": _run_incremental_srm,
+    "htfa": _run_htfa,
+    "ridge_encoding": _run_ridge,
+}
+assert set(_RUNNERS) == set(KINDS)
+
+
+def run_job(spec, workdir):
+    """Run ``spec``'s fit to completion (or until parked — the
+    ambient :func:`~brainiak_tpu.resilience.guards.park_scope`
+    predicate applies, installed by the scheduler's worker).
+
+    Returns ``{"kind", "digest", "arrays"}`` (see module docstring).
+    Raises whatever the fit raises — :class:`~brainiak_tpu.
+    resilience.guards.FitParked`, :class:`~brainiak_tpu.resilience.
+    guards.DivergenceError`, injected faults — classification is the
+    scheduler's job, not the runner's.
+    """
+    from ..resilience.guards import array_digest
+
+    ckpt_dir = checkpoint_dir_for(spec, workdir)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    arrays = _RUNNERS[spec.kind](spec, ckpt_dir)
+    digest = array_digest(*(arrays[k] for k in sorted(arrays))) \
+        if arrays else 0.0
+    return {"kind": spec.kind, "digest": digest, "arrays": arrays}

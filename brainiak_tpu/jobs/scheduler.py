@@ -1,0 +1,771 @@
+"""The multi-tenant fit scheduler: gang-scheduling throughput fits
+alongside latency-bound serving on one pod.
+
+:class:`Scheduler` owns a job table (:class:`JobRecord` per
+submitted :class:`~brainiak_tpu.jobs.spec.JobSpec`) and a tick loop
+(daemon thread, the :class:`~brainiak_tpu.serve.service.ServeService`
+idiom) that makes all placement decisions:
+
+- **admission** — ``submit()`` consults the shared
+  :class:`~brainiak_tpu.serve.federation.admission.
+  AdmissionController` (global depth bound + per-tenant quotas): a
+  shed submission resolves its ticket immediately with a terminal
+  ``failed`` record carrying the typed shed verdict
+  (``shed_overload`` semantics, ``retry_after_s`` included) — never
+  an unbounded queue;
+- **fair share** — among runnable jobs of the top priority, the
+  tenant with minimal weighted virtual time
+  (:class:`~brainiak_tpu.jobs.quota.FairShare`) runs next; chunk
+  consumption is charged from the fit-progress stream, so a heavy
+  tenant's long fits push its virtual time up and a light tenant is
+  never starved (the deficit column ``obs watch`` renders comes
+  straight from this ledger);
+- **chunk grants** — a worker may run ``grant_chunks`` resilient-loop
+  chunks before it must yield: the park predicate
+  (:func:`~brainiak_tpu.resilience.guards.park_scope`) counts chunk
+  boundaries and parks the fit via its checkpoint — time-slicing
+  without killing work;
+- **priority preemption** — a higher-priority arrival parks the
+  lowest-priority running fit at its next chunk boundary (the
+  universal ``checkpoint_dir=`` contract: same ``fit_id``,
+  cumulative wall clock — PR 19 semantics); the parked job resumes
+  when capacity returns and lands on bit-exact final parameters;
+- **capacity signals** — the same series the
+  :class:`~brainiak_tpu.serve.federation.fleet.FleetSupervisor`
+  reads (``serve_service_ingress_depth`` + ``serve_service_
+  queue_depth`` gauges, ``serve_shed_total`` deltas,
+  ``admission.burning()``): under serving pressure the slot count
+  drops to ``pressure_slots`` and excess fits park until the burst
+  passes;
+- **outcome feedback** — a :func:`~brainiak_tpu.obs.progress.
+  add_finish_listener` hook folds every fit's terminal
+  ``FitProgress.finish(status)`` into the owning job record
+  (``fit_status``), so a diverged or retry-exhausted fit becomes a
+  terminal ``failed`` job with the flight-recorder snapshot path
+  attached — never a zombie "running" entry;
+- **crash containment** — a worker death
+  (:class:`~brainiak_tpu.resilience.faults.ReplicaCrashError`,
+  injectable at site ``jobs.worker``) requeues the job for a bounded
+  number of retries (the checkpoint preserves its progress), then
+  fails it terminally.  Every job reaches EXACTLY ONE terminal
+  state.
+
+State is published two ways: :func:`scheduler_state` (module-level,
+merged over live schedulers) feeds the ``/jobs`` HTTP payload, and
+``http_port=`` starts a :class:`~brainiak_tpu.obs.http.
+TelemetryServer` with the POST control plane attached so ``python -m
+brainiak_tpu.jobs submit|status|cancel`` works against the live
+process.
+"""
+
+import logging
+import threading
+import time
+from collections import deque
+
+from ..obs import flight as obs_flight
+from ..obs import metrics as obs_metrics
+from ..obs import progress as obs_progress
+from ..obs import sink as obs_sink
+from ..resilience import faults
+from ..resilience.guards import FitParked, park_scope
+from .quota import FairShare
+from .spec import (
+    TERMINAL_STATES,
+    JobSpec,
+    can_transition,
+    decode_jobs,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["JobRecord", "JobTicket", "Scheduler", "SchedulerClosed",
+           "scheduler_state"]
+
+#: Fault-injection site for worker crashes (see
+#: :func:`brainiak_tpu.resilience.faults.crash_point`).
+CRASH_SITE = "jobs.worker"
+
+_active_lock = threading.Lock()
+_active = []  # guarded-by: _active_lock (live Scheduler instances)
+
+
+def scheduler_state():
+    """Merged ``summary()`` of every live scheduler in this process
+    (None when there is none) — the ``scheduler`` key of the
+    ``/jobs`` payload."""
+    with _active_lock:
+        scheds = list(_active)
+    if not scheds:
+        return None
+    merged = {"jobs": [], "tenants": {}, "counts": {}, "slots": 0,
+              "pressure": False}
+    for sched in scheds:
+        summary = sched.summary()
+        merged["jobs"].extend(summary["jobs"])
+        merged["tenants"].update(summary["tenants"])
+        for state, n in summary["counts"].items():
+            merged["counts"][state] = \
+                merged["counts"].get(state, 0) + n
+        merged["slots"] += summary["slots"]
+        merged["pressure"] = merged["pressure"] \
+            or summary["pressure"]
+    return merged
+
+
+class SchedulerClosed(RuntimeError):
+    """Submission to a closed scheduler."""
+
+
+class JobTicket:
+    """Submission handle: resolves exactly once with the job's
+    terminal record dict (the :class:`~brainiak_tpu.serve.service.
+    ServiceTicket` idiom)."""
+
+    def __init__(self, job_id):
+        self.job_id = job_id
+        self._event = threading.Event()
+        self._record = None
+
+    def done(self):
+        """Whether the job has reached its terminal state."""
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """Block for the terminal record dict."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"job {self.job_id} not terminal after "
+                f"{timeout} s")
+        return self._record
+
+    def _resolve(self, record):
+        self._record = record
+        self._event.set()
+
+
+class JobRecord:
+    """One job's mutable scheduler state.  All fields are
+    guarded-by the owning scheduler's ``_cond`` lock except
+    ``park_event`` (a :class:`threading.Event`, safe lock-free) and
+    ``result`` arrays (written once by the worker before the done
+    outcome is queued)."""
+
+    def __init__(self, spec, seq):
+        self.spec = spec
+        self.seq = seq                  # FIFO tie-break
+        self.state = "queued"
+        self.submitted_ts = time.time()
+        self.started_ts = None
+        self.finished_ts = None
+        self.fit_id = None
+        self.fit_status = None
+        self.chunks = 0.0               # chunks charged to fair share
+        self.grants = 0                 # worker launches
+        self.n_preemptions = 0
+        self.crash_retries = 0
+        self.error = None
+        self.shed = None
+        self.snapshot_path = None
+        self.deadline_exceeded = False
+        self.result = None              # runner result (arrays incl.)
+        self.digest = None
+        self.park_event = threading.Event()
+        self.park_reason = None
+        self.cancel_requested = False
+        self.ticket = JobTicket(spec.job_id)
+
+    def to_dict(self):
+        """JSON-safe record (no arrays) — the ``/jobs`` row and the
+        ticket resolution payload."""
+        spec = self.spec
+        return {
+            "job_id": spec.job_id,
+            "tenant": spec.tenant,
+            "kind": spec.kind,
+            "priority": spec.priority,
+            "state": self.state,
+            "n_iter": spec.n_iter,
+            "submitted_ts": self.submitted_ts,
+            "started_ts": self.started_ts,
+            "finished_ts": self.finished_ts,
+            "fit_id": self.fit_id,
+            "fit_status": self.fit_status,
+            "chunks": self.chunks,
+            "grants": self.grants,
+            "n_preemptions": self.n_preemptions,
+            "crash_retries": self.crash_retries,
+            "error": self.error,
+            "shed": self.shed,
+            "snapshot_path": self.snapshot_path,
+            "deadline_s": spec.deadline_s,
+            "deadline_exceeded": self.deadline_exceeded,
+            "digest": self.digest,
+            "trace_id": spec.trace_id,
+        }
+
+
+class Scheduler:
+    """The control plane (see module docstring).
+
+    Parameters
+    ----------
+    workdir : str
+        Root for per-job checkpoint directories
+        (``workdir/<job_id>``) — the park/resume contract.
+    max_slots : int
+        Concurrent fit workers when serving is unpressured.
+    pressure_slots : int
+        Slot count while serving pressure holds (see
+        ``serve_pressure_depth``); excess running fits park.
+    grant_chunks : int or None
+        Resilient-loop chunks a worker may run per grant before it
+        yields (parks + requeues).  None = run to completion unless
+        preempted.
+    fair_share : :class:`~brainiak_tpu.jobs.quota.FairShare`, optional
+        The tenant ledger (default: equal weights).
+    admission : :class:`~brainiak_tpu.serve.federation.admission.
+        AdmissionController`, optional
+        Submission gate (global depth + per-tenant quotas) and the
+        SLO-burn capacity sensor.
+    serve_pressure_depth : int
+        Serving queue depth (``serve_service_ingress_depth`` +
+        ``serve_service_queue_depth`` gauge sum) at which the slot
+        count drops to ``pressure_slots``.
+    max_crash_retries : int
+        Worker crashes tolerated per job before terminal failure.
+    tick_interval_s : float
+        Scheduling-loop cadence.
+    http_port : int, optional
+        Start a :class:`~brainiak_tpu.obs.http.TelemetryServer` on
+        this port (0 = ephemeral) with the jobs control plane
+        attached (``POST /jobs/submit``, ``POST /jobs/cancel``).
+    name : str
+        Label for logs/metrics.
+    """
+
+    def __init__(self, workdir, *, max_slots=1, pressure_slots=None,
+                 grant_chunks=None, fair_share=None, admission=None,
+                 serve_pressure_depth=64, max_crash_retries=1,
+                 tick_interval_s=0.02, http_port=None, name="jobs"):
+        if max_slots < 1:
+            raise ValueError(
+                f"max_slots must be >= 1, got {max_slots}")
+        if grant_chunks is not None and grant_chunks < 1:
+            raise ValueError(
+                f"grant_chunks must be >= 1 or None, got "
+                f"{grant_chunks}")
+        self.workdir = workdir
+        self.max_slots = int(max_slots)
+        self.pressure_slots = int(
+            pressure_slots if pressure_slots is not None
+            else max(0, max_slots - 1))
+        self.grant_chunks = grant_chunks
+        self.fair = fair_share or FairShare()
+        self.admission = admission
+        self.serve_pressure_depth = int(serve_pressure_depth)
+        self.max_crash_retries = int(max_crash_retries)
+        self.tick_interval_s = float(tick_interval_s)
+        self.name = name
+        self._cond = threading.Condition()
+        self._jobs = {}       # guarded-by: _cond (job_id -> record)
+        self._order = []      # guarded-by: _cond (submission order)
+        self._outcomes = deque()  # guarded-by: _cond
+        self._seq = 0         # guarded-by: _cond
+        self._closing = False  # guarded-by: _cond
+        self._last_shed_total = self._serve_shed_total()
+        self._pressure = False
+        self._workers = {}    # guarded-by: _cond (job_id -> Thread)
+        obs_progress.add_finish_listener(self._on_fit_finish)
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{name}-scheduler", daemon=True)
+        self._thread.start()
+        self.http = None
+        if http_port is not None:
+            from ..obs.http import TelemetryServer
+            self.http = TelemetryServer(
+                port=http_port, control=self._control).start()
+        with _active_lock:
+            _active.append(self)
+
+    # -- submission (any thread) --------------------------------------
+
+    def submit(self, spec):
+        """Admit one job; returns its :class:`JobTicket`.
+
+        A shed verdict (global depth or tenant quota, see
+        :class:`~brainiak_tpu.serve.federation.admission.
+        AdmissionController`) resolves the ticket immediately with a
+        terminal ``failed`` record carrying ``shed`` — callers back
+        off ``retry_after_s`` and resubmit, exactly like a shed
+        serving request.
+        """
+        if not isinstance(spec, JobSpec):
+            raise TypeError(f"expected JobSpec, got {type(spec)!r}")
+        with self._cond:
+            if self._closing:
+                raise SchedulerClosed(
+                    f"scheduler {self.name!r} is closed")
+            if spec.job_id in self._jobs:
+                raise ValueError(
+                    f"duplicate job_id {spec.job_id!r}")
+            self._seq += 1
+            record = JobRecord(spec, self._seq)
+            shed = None
+            if self.admission is not None:
+                depth = sum(
+                    1 for j in self._jobs.values()
+                    if j.state not in TERMINAL_STATES)
+                tenant_depth = sum(
+                    1 for j in self._jobs.values()
+                    if j.spec.tenant == spec.tenant
+                    and j.state not in TERMINAL_STATES)
+                shed = self.admission.evaluate(
+                    depth, tenant=spec.tenant,
+                    tenant_depth=tenant_depth)
+            self._jobs[spec.job_id] = record
+            self._order.append(spec.job_id)
+            if shed is not None:
+                record.shed = {
+                    "reason": shed.reason,
+                    "retry_after_s": shed.retry_after_s,
+                    "depth": shed.depth, "bound": shed.bound,
+                }
+                record.error = f"shed:{shed.reason}"
+                self._finalize_locked(record, "failed")
+            else:
+                obs_sink.event(
+                    "job_submitted", job_id=spec.job_id,
+                    tenant=spec.tenant, kind=spec.kind,
+                    priority=spec.priority,
+                    trace_id=spec.trace_id)
+                obs_metrics.counter(
+                    "jobs_submitted_total",
+                    help="jobs admitted by the fit scheduler").inc(
+                        tenant=spec.tenant)
+            self._cond.notify_all()
+            return record.ticket
+
+    def submit_many(self, specs):
+        """Admit a batch; returns tickets in order."""
+        return [self.submit(spec) for spec in specs]
+
+    def cancel(self, job_id):
+        """Request cancellation; returns False for unknown/terminal
+        jobs.  Queued and parked jobs cancel immediately; a running
+        job parks at its next chunk boundary and then cancels (its
+        checkpoint survives for forensics)."""
+        with self._cond:
+            record = self._jobs.get(job_id)
+            if record is None or record.state in TERMINAL_STATES:
+                return False
+            record.cancel_requested = True
+            if record.state in ("queued", "parked"):
+                self._finalize_locked(record, "cancelled")
+            else:
+                record.park_reason = record.park_reason or "cancel"
+                record.park_event.set()
+            self._cond.notify_all()
+            return True
+
+    def job(self, job_id):
+        """The job's current record dict, or None."""
+        with self._cond:
+            record = self._jobs.get(job_id)
+            return record.to_dict() if record is not None else None
+
+    def drain(self, timeout=None):
+        """Block until every submitted job is terminal; returns
+        whether that happened within ``timeout``."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cond:
+            while any(j.state not in TERMINAL_STATES
+                      for j in self._jobs.values()):
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining
+                                if remaining is not None else 0.5)
+            return True
+
+    def close(self, timeout=10.0):
+        """Stop scheduling: park running fits, cancel whatever is
+        not terminal, stop the loop (idempotent)."""
+        with _active_lock:
+            if self in _active:
+                _active.remove(self)
+        with self._cond:
+            if self._closing:
+                already = True
+            else:
+                already = False
+                self._closing = True
+                for record in self._jobs.values():
+                    if record.state == "running":
+                        record.park_reason = \
+                            record.park_reason or "close"
+                        record.park_event.set()
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        obs_progress.remove_finish_listener(self._on_fit_finish)
+        if not already:
+            with self._cond:
+                for record in self._jobs.values():
+                    if record.state not in TERMINAL_STATES:
+                        self._finalize_locked(record, "cancelled")
+        if self.http is not None:
+            self.http.stop()
+            self.http = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+
+    # -- reporting (any thread) ---------------------------------------
+
+    def summary(self):
+        """The scheduler's full state as one JSON-safe dict (the
+        ``/jobs`` ``scheduler`` payload and the watch feed)."""
+        with self._cond:
+            jobs = [self._jobs[j].to_dict() for j in self._order]
+            pressure = self._pressure
+        tenants = {t: dict(v) for t, v in self.fair.summary().items()}
+        known = {row["tenant"] for row in jobs}
+        deficits = self.fair.deficits(known)
+        for tenant in known:
+            entry = tenants.setdefault(tenant, {
+                "usage": 0.0, "weight": self.fair.weight(tenant),
+                "virtual_time": 0.0, "deficit": 0.0})
+            entry["deficit"] = deficits.get(tenant, 0.0)
+        counts = {}
+        for row in jobs:
+            counts[row["state"]] = counts.get(row["state"], 0) + 1
+        return {"jobs": jobs, "tenants": tenants, "counts": counts,
+                "slots": self._slots(pressure),
+                "pressure": pressure}
+
+    # -- the control plane (http handler threads) ---------------------
+
+    def _control(self, action, payload):
+        if action == "submit":
+            try:
+                specs = decode_jobs(payload)
+            except Exception as exc:
+                raise ValueError(f"bad job archive: {exc}") from exc
+            verdict = {"accepted": [], "shed": []}
+            for spec in specs:
+                self.submit(spec)
+                with self._cond:
+                    shed = self._jobs[spec.job_id].shed
+                (verdict["shed"] if shed is not None
+                 else verdict["accepted"]).append(spec.job_id)
+            return verdict
+        if action == "cancel":
+            return {"job_id": payload,
+                    "cancelled": self.cancel(payload)}
+        raise ValueError(f"unknown control action {action!r}")
+
+    # -- capacity signals ---------------------------------------------
+
+    @staticmethod
+    def _serve_queue_depth():
+        total = 0.0
+        for gauge_name in ("serve_service_ingress_depth",
+                           "serve_service_queue_depth"):
+            for _, value in obs_metrics.gauge(gauge_name).samples():
+                total += value
+        return total
+
+    @staticmethod
+    def _serve_shed_total():
+        total = 0.0
+        for _, value in obs_metrics.counter(
+                "serve_shed_total").samples():
+            total += value
+        return total
+
+    def _poll_pressure(self):
+        """One tick's serving-pressure verdict — the series the
+        fleet supervisor reads: queue depth, shed delta, SLO burn."""
+        shed_total = self._serve_shed_total()
+        shed_delta = shed_total - self._last_shed_total
+        self._last_shed_total = shed_total
+        burning = self.admission.burning() \
+            if self.admission is not None else False
+        depth = self._serve_queue_depth()
+        return (depth >= self.serve_pressure_depth
+                or shed_delta > 0 or burning)
+
+    def _slots(self, pressure):
+        return self.pressure_slots if pressure else self.max_slots
+
+    # -- fit-progress feedback (fit worker threads) -------------------
+
+    def _on_fit_finish(self, snapshot):
+        """:func:`~brainiak_tpu.obs.progress.add_finish_listener`
+        hook: fold the fit outcome into the owning job record."""
+        job_id = snapshot.get("job_id")
+        if job_id is None:
+            return
+        with self._cond:
+            record = self._jobs.get(job_id)
+            if record is None:
+                return
+            self._sync_progress_locked(record, snapshot)
+            record.fit_status = snapshot.get("status")
+
+    def _sync_progress_locked(self, record, snapshot):
+        if snapshot.get("fit_id"):
+            record.fit_id = snapshot["fit_id"]
+        chunks = snapshot.get("chunk")
+        if chunks is not None and chunks > record.chunks:
+            self.fair.charge(record.spec.tenant,
+                             chunks - record.chunks)
+            record.chunks = float(chunks)
+
+    # -- the worker (one thread per running grant) --------------------
+
+    def _worker(self, record):
+        spec = record.spec
+        grant = self.grant_chunks
+        ran = {"chunks": 0}
+
+        def should_park():
+            # called once per persisted chunk (the park_scope
+            # contract) — lock-free: an event read and a counter
+            if record.park_event.is_set():
+                return True
+            ran["chunks"] += 1
+            return grant is not None and ran["chunks"] >= grant
+
+        outcome, info = "done", None
+        try:
+            faults.crash_point(record.grants, site=CRASH_SITE,
+                               name=spec.job_id)
+            from .runners import run_job
+            with obs_progress.fit_context(
+                    job_id=spec.job_id, tenant=spec.tenant,
+                    trace_id=spec.trace_id), park_scope(should_park):
+                info = run_job(spec, self.workdir)
+        except FitParked as exc:
+            outcome, info = "parked", exc
+        except faults.ReplicaCrashError as exc:
+            outcome, info = "crashed", exc
+        except BaseException as exc:  # divergence, retry-exhausted...
+            outcome, info = "failed", exc
+        with self._cond:
+            self._outcomes.append((record, outcome, info))
+            self._cond.notify_all()
+
+    # -- the tick loop (scheduler thread) -----------------------------
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                self._cond.wait(self.tick_interval_s)
+                self._drain_outcomes_locked()
+                self._sync_running_locked()
+                self._check_deadlines_locked()
+                self._pressure = pressure = self._poll_pressure()
+                if not self._closing:
+                    self._schedule_locked(pressure)
+                self._publish_gauges_locked()
+                running = [j for j in self._jobs.values()
+                           if j.state == "running"]
+                if self._closing and not running:
+                    break
+
+    def _drain_outcomes_locked(self):  # requires-lock: _cond
+        while self._outcomes:
+            record, outcome, info = self._outcomes.popleft()
+            thread = self._workers.pop(record.spec.job_id, None)
+            if thread is not None and thread.is_alive() \
+                    and thread is not threading.current_thread():
+                pass  # the outcome was queued last; thread is exiting
+            if record.state in TERMINAL_STATES:
+                continue  # cancel raced completion; terminal stands
+            if outcome == "done":
+                record.result = info
+                record.digest = info.get("digest")
+                self._finalize_locked(record, "done")
+            elif outcome == "parked":
+                reason = record.park_reason or "grant"
+                record.park_reason = None
+                record.park_event.clear()
+                if record.cancel_requested:
+                    self._finalize_locked(record, "cancelled")
+                elif self._closing:
+                    self._finalize_locked(record, "cancelled")
+                else:
+                    self._transition_locked(record, "parked")
+                    if reason in ("preempt", "pressure"):
+                        record.n_preemptions += 1
+                        obs_metrics.counter(
+                            "jobs_preempted_total",
+                            help="running fits parked by priority "
+                                 "preemption or serving "
+                                 "pressure").inc(
+                                tenant=record.spec.tenant)
+                    obs_sink.event(
+                        "job_parked", job_id=record.spec.job_id,
+                        tenant=record.spec.tenant, reason=reason,
+                        fit_id=record.fit_id)
+            elif outcome == "crashed":
+                record.crash_retries += 1
+                obs_sink.event(
+                    "job_worker_crash",
+                    job_id=record.spec.job_id,
+                    tenant=record.spec.tenant,
+                    attempt=record.crash_retries, error=str(info))
+                if record.cancel_requested:
+                    self._finalize_locked(record, "cancelled")
+                elif record.crash_retries > self.max_crash_retries:
+                    record.error = f"replica_crash: {info}"
+                    self._finalize_locked(record, "failed")
+                else:
+                    # the checkpoint survives the crash: requeue and
+                    # resume from it on the next grant
+                    self._transition_locked(record, "queued")
+            else:  # failed
+                record.error = repr(info)
+                dump = obs_flight.last_dump(
+                    fit_id=record.fit_id,
+                    since=record.started_ts)
+                if dump is not None:
+                    record.snapshot_path = dump["path"]
+                self._finalize_locked(record, "failed")
+
+    def _sync_running_locked(self):  # requires-lock: _cond
+        running = {j.spec.job_id: j for j in self._jobs.values()
+                   if j.state == "running"}
+        if not running:
+            return
+        for snap in obs_progress.active_fits():
+            record = running.get(snap.get("job_id"))
+            if record is not None:
+                self._sync_progress_locked(record, snap)
+
+    def _check_deadlines_locked(self):  # requires-lock: _cond
+        now = time.time()
+        for record in self._jobs.values():
+            deadline = record.spec.deadline_s
+            if deadline is None or record.deadline_exceeded \
+                    or record.state in TERMINAL_STATES:
+                continue
+            if now - record.submitted_ts > deadline:
+                record.deadline_exceeded = True
+                obs_sink.event(
+                    "job_deadline", job_id=record.spec.job_id,
+                    tenant=record.spec.tenant, deadline_s=deadline,
+                    waited_s=now - record.submitted_ts)
+
+    def _schedule_locked(self, pressure):  # requires-lock: _cond
+        slots = self._slots(pressure)
+        running = [j for j in self._jobs.values()
+                   if j.state == "running"]
+        # pressure park: shrink to the pressured slot count, lowest
+        # priority first (FIFO tie-break: park the newest)
+        excess = [j for j in running if not j.park_event.is_set()]
+        while len(excess) > slots:
+            victim = min(excess,
+                         key=lambda j: (j.spec.priority, -j.seq))
+            victim.park_reason = "pressure"
+            victim.park_event.set()
+            excess.remove(victim)
+        runnable = sorted(
+            (j for j in self._jobs.values()
+             if j.state in ("queued", "parked")
+             and not j.cancel_requested),
+            key=lambda j: (-j.spec.priority,
+                           self.fair.virtual_time(j.spec.tenant),
+                           j.seq))
+        free = slots - len(running)
+        for record in runnable:
+            if free <= 0:
+                break
+            self._start_locked(record)
+            free -= 1
+        if free <= 0 and runnable:
+            # priority preemption: the best waiter outranks the
+            # weakest running fit -> park it (one per tick: parks
+            # complete at chunk granularity, not instantly)
+            waiting = [j for j in runnable
+                       if j.state in ("queued", "parked")]
+            victims = [j for j in self._jobs.values()
+                       if j.state == "running"
+                       and not j.park_event.is_set()]
+            if waiting and victims:
+                best = waiting[0]
+                victim = min(victims,
+                             key=lambda j: (j.spec.priority,
+                                            -j.seq))
+                if best.spec.priority > victim.spec.priority:
+                    victim.park_reason = "preempt"
+                    victim.park_event.set()
+                    obs_sink.event(
+                        "job_preempt_requested",
+                        job_id=victim.spec.job_id,
+                        tenant=victim.spec.tenant,
+                        by_job=best.spec.job_id,
+                        by_priority=best.spec.priority)
+
+    def _start_locked(self, record):  # requires-lock: _cond
+        resumed = record.state == "parked"
+        self._transition_locked(record, "running")
+        record.park_event.clear()
+        record.park_reason = None
+        record.grants += 1
+        if record.started_ts is None:
+            record.started_ts = time.time()
+        thread = threading.Thread(
+            target=self._worker, args=(record,),
+            name=f"{self.name}-worker-{record.spec.job_id[:6]}",
+            daemon=True)
+        self._workers[record.spec.job_id] = thread
+        obs_sink.event(
+            "job_resumed" if resumed else "job_started",
+            job_id=record.spec.job_id, tenant=record.spec.tenant,
+            grant=record.grants, fit_id=record.fit_id,
+            trace_id=record.spec.trace_id)
+        thread.start()
+
+    def _transition_locked(self, record, new):  # requires-lock: _cond
+        if not can_transition(record.state, new):
+            raise RuntimeError(
+                f"illegal job transition {record.state} -> {new} "
+                f"for {record.spec.job_id}")
+        record.state = new
+
+    def _finalize_locked(self, record, state):  # requires-lock: _cond
+        """The ONLY path into a terminal state: transition, stamp,
+        resolve the ticket exactly once, count."""
+        if record.state in TERMINAL_STATES:
+            return  # exactly-one-terminal: first verdict stands
+        self._transition_locked(record, state)
+        record.finished_ts = time.time()
+        obs_sink.event(
+            f"job_{state}", job_id=record.spec.job_id,
+            tenant=record.spec.tenant, fit_id=record.fit_id,
+            error=record.error, fit_status=record.fit_status,
+            snapshot_path=record.snapshot_path)
+        obs_metrics.counter(
+            "jobs_terminal_total",
+            help="jobs that reached a terminal state").inc(
+                tenant=record.spec.tenant, state=state)
+        record.ticket._resolve(record.to_dict())
+        self._cond.notify_all()
+
+    def _publish_gauges_locked(self):  # requires-lock: _cond
+        counts = {}
+        for record in self._jobs.values():
+            counts[record.state] = counts.get(record.state, 0) + 1
+        gauge = obs_metrics.gauge(
+            "jobs_state_depth",
+            help="jobs per lifecycle state in the fit scheduler")
+        for state in ("queued", "running", "parked"):
+            gauge.set(counts.get(state, 0), state=state,
+                      scheduler=self.name)

@@ -1,0 +1,154 @@
+"""CI selfcheck for the fit scheduler (JOB001 gate).
+
+Run as a subprocess child by ``tools/run_checks.py`` on the 8-device
+CPU mesh: two tenants submit mixed-priority SRM fits to one
+:class:`~brainiak_tpu.jobs.scheduler.Scheduler` co-scheduled with a
+warm :class:`~brainiak_tpu.serve.service.ServeService`, and one
+priority preemption is injected (the high-priority arrival parks the
+running low-priority fit mid-run).  The gate proves:
+
+1. **zero lost jobs** — every submitted job reaches terminal
+   ``done`` (no failed/cancelled/zombie records);
+2. **resume parity** — the preempted-then-resumed fit's result
+   digest equals an uninterrupted solo run of the same spec
+   (bit-exact park/resume through the universal ``checkpoint_dir=``
+   contract);
+3. **fair share** — with equal weights and equal per-tenant work,
+   every tenant's deficit ends within tolerance (the
+   starvation-freedom ledger);
+4. **zero added serve retraces** — serving waves replayed after the
+   fits reuse every compiled ``serve.*`` program
+   (``serve_retrace_total`` delta stays 0): throughput fits must not
+   trash the latency tier's warm cache.
+"""
+
+import numpy as np
+
+__all__ = ["selfcheck"]
+
+
+def selfcheck(out=None):
+    """Prints a JSON verdict; returns 0 on pass, 1 on failure."""
+    import json
+    import os
+    import sys
+    import tempfile
+    import time
+
+    from ..serve import ModelResidency
+    from ..serve.batching import BucketPolicy, Request
+    from ..serve.service import ServeService, serve_retrace_total
+    from ..serve.__main__ import build_demo_model
+    from .runners import run_job
+    from .scheduler import Scheduler
+    from .spec import JobSpec
+
+    stream = out or sys.stdout
+
+    model = build_demo_model(n_subjects=2, voxels=24, samples=32,
+                             features=4, n_iter=2, seed=0)
+    counts = [w.shape[0] for w in model.w_]
+    residency = ModelResidency(
+        budget_bytes=1 << 30,
+        policy=BucketPolicy(max_batch=8, max_wait_s=0.05))
+    residency.register("m", model=model)
+
+    def serve_wave(service, prefix):
+        # fixed shapes each wave: any retrace after warmup is a real
+        # cache loss, not a new bucket
+        rng = np.random.RandomState(5)
+        reqs = [Request(request_id=f"{prefix}{i}",
+                        x=rng.randn(counts[i % 2], 16)
+                        .astype(np.float32),
+                        subject=i % 2, model="m")
+                for i in range(4)]
+        return [t.result(timeout=60.0)
+                for t in service.submit_many(reqs)]
+
+    fit_kwargs = dict(kind="srm", n_iter=24, features=3,
+                      checkpoint_every=1, n_subjects=3, voxels=16,
+                      samples=20)
+    low_spec = JobSpec(tenant="hospital-a", priority=0, seed=7,
+                       **fit_kwargs)
+    hi_spec = JobSpec(tenant="hospital-b", priority=1, seed=11,
+                      **fit_kwargs)
+
+    lost = []
+    serve_ok = True
+    parity_ok = False
+    preempt_ok = False
+    n_preempt = 0
+    max_deficit = float("inf")
+    fair_tol = 1.0  # chunks; equal work -> deficits ~0
+
+    with ServeService(residency, default_model="m") as service, \
+            tempfile.TemporaryDirectory() as tmp:
+        warm = serve_wave(service, "w")
+        serve_ok = all(r.error is None for r in warm)
+        retrace_warm = serve_retrace_total()
+
+        sched = Scheduler(os.path.join(tmp, "jobs"), max_slots=1,
+                          pressure_slots=1,
+                          serve_pressure_depth=1 << 20,
+                          tick_interval_s=0.01)
+        try:
+            low_ticket = sched.submit(low_spec)
+            # wait for the low-priority fit to be mid-run, then
+            # inject the preemption: a higher-priority arrival
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                row = sched.job(low_spec.job_id)
+                if row["state"] == "running" and row["chunks"] >= 1:
+                    break
+                time.sleep(0.02)
+            hi_ticket = sched.submit(hi_spec)
+            # co-scheduled serving while both fits are in flight
+            mid = serve_wave(service, "m")
+            serve_ok = serve_ok and all(r.error is None
+                                        for r in mid)
+            hi_rec = hi_ticket.result(timeout=300.0)
+            low_rec = low_ticket.result(timeout=300.0)
+
+            lost = [r["job_id"] for r in (low_rec, hi_rec)
+                    if r["state"] != "done"]
+            n_preempt = low_rec["n_preemptions"]
+            preempt_ok = n_preempt >= 1 \
+                and hi_rec["n_preemptions"] == 0
+
+            # parity: same fit params solo (fresh job_id, its own
+            # checkpoint tree, never parked) must reach the same
+            # digest as the preempted-and-resumed scheduled run
+            base = run_job(
+                JobSpec(tenant="solo", priority=0, seed=7,
+                        **fit_kwargs),
+                os.path.join(tmp, "solo"))
+            parity_ok = (low_rec["digest"] is not None
+                         and low_rec["digest"] == base["digest"])
+
+            summary = sched.summary()
+            deficits = [entry["deficit"]
+                        for entry in summary["tenants"].values()]
+            max_deficit = max(abs(d) for d in deficits) \
+                if deficits else float("inf")
+        finally:
+            sched.close()
+
+        after = serve_wave(service, "a")
+        serve_ok = serve_ok and all(r.error is None for r in after)
+        retrace_delta = serve_retrace_total() - retrace_warm
+
+    fairshare_ok = max_deficit <= fair_tol
+    ok = (not lost and parity_ok and preempt_ok and fairshare_ok
+          and serve_ok and retrace_delta == 0)
+    json.dump({"ok": bool(ok), "n_jobs": 2, "lost": lost,
+               "parity_ok": bool(parity_ok),
+               "preempt_ok": bool(preempt_ok),
+               "n_preemptions": int(n_preempt),
+               "max_deficit": float(max_deficit),
+               "fair_tol": fair_tol,
+               "fairshare_ok": bool(fairshare_ok),
+               "serve_ok": bool(serve_ok),
+               "serve_retrace_delta": float(retrace_delta)},
+              stream)
+    stream.write("\n")
+    return 0 if ok else 1

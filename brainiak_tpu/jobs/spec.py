@@ -1,0 +1,225 @@
+"""Job descriptions, the lifecycle state machine, and the npz codec.
+
+A **job** is one tenant-attributed fit request: which estimator kind
+to run (``srm`` / ``incremental_srm`` / ``htfa`` / ``ridge_encoding``
+— the chunked fits :func:`~brainiak_tpu.resilience.guards.
+run_resilient_loop` drives), its iteration budget, its data (a path,
+or a seeded synthetic shape), a scheduling priority, and an optional
+soft deadline.  :class:`JobSpec` is a frozen, JSON-serializable value
+object; everything mutable (state, fit_id, chunk counts, outcomes)
+lives in the scheduler's :class:`~brainiak_tpu.jobs.scheduler.
+JobRecord`.
+
+**Lifecycle state machine** (:data:`STATES` / :data:`TERMINAL_STATES`
+/ :func:`can_transition`)::
+
+    queued ──────► running ──────► done | failed
+       │             │  ▲
+       │   (preempt/ ▼  │ (resume)
+       │    grant)  parked ──► cancelled | failed
+       │             │
+       └─────────────┴──► cancelled
+
+plus ``running -> queued`` (a crashed worker requeues the job for a
+bounded retry).  Every job reaches EXACTLY ONE terminal state —
+``done``, ``failed`` or ``cancelled`` — which is what the JOB001 gate
+and the replica-crash test assert.
+
+**npz codec** (:func:`encode_jobs` / :func:`decode_jobs` /
+:func:`save_jobs` / :func:`load_jobs`): job batches travel as an npz
+archive — one ``job.<i>`` entry per spec (a JSON unicode scalar; no
+pickling, so ``allow_pickle=False`` round-trips) — the same wire
+idiom the serving tier uses for request payloads, so ``python -m
+brainiak_tpu.jobs submit`` can POST a job file to a live fleet's
+telemetry port.
+"""
+
+import dataclasses
+import io
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "CODEC_SCHEMA",
+    "KINDS",
+    "STATES",
+    "TERMINAL_STATES",
+    "JobSpec",
+    "can_transition",
+    "decode_jobs",
+    "encode_jobs",
+    "load_jobs",
+    "new_job_id",
+    "save_jobs",
+]
+
+#: Fit kinds the scheduler knows how to drive (see
+#: :mod:`brainiak_tpu.jobs.runners`).
+KINDS = ("srm", "incremental_srm", "htfa", "ridge_encoding")
+
+#: The lifecycle states (see module docstring for the machine).
+STATES = ("queued", "running", "parked", "done", "failed",
+          "cancelled")
+
+#: States a job never leaves.  Exactly one per job, ever.
+TERMINAL_STATES = frozenset({"done", "failed", "cancelled"})
+
+_TRANSITIONS = {
+    "queued": {"running", "cancelled", "failed"},
+    # running -> queued: crashed-worker requeue (bounded retry);
+    # running -> parked: preemption / chunk-grant exhaustion
+    "running": {"parked", "queued", "done", "failed", "cancelled"},
+    "parked": {"running", "cancelled", "failed"},
+    "done": set(),
+    "failed": set(),
+    "cancelled": set(),
+}
+
+#: npz codec schema version (bumped on incompatible key changes).
+CODEC_SCHEMA = 1
+
+
+def new_job_id():
+    """Mint a job id: 16 hex chars (the trace-/fit-id idiom)."""
+    return os.urandom(8).hex()
+
+
+def can_transition(old, new):
+    """Whether ``old -> new`` is a legal lifecycle edge."""
+    return new in _TRANSITIONS.get(old, set())
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One tenant's fit request (immutable; scheduler state lives in
+    the :class:`~brainiak_tpu.jobs.scheduler.JobRecord`).
+
+    Parameters
+    ----------
+    tenant : str
+        Owning tenant — the fair-share / quota accounting unit.
+    kind : str
+        One of :data:`KINDS`.
+    job_id : str
+        Stable id (minted when omitted).  Distinct from the fit's
+        ``fit_id``: the job id names the *request*, the fit id names
+        the *checkpoint stream* (the scheduler joins them through
+        :func:`brainiak_tpu.obs.progress.fit_context`).
+    priority : int
+        Higher runs first and may preempt lower (park via the
+        checkpoint contract).  Default 0 (throughput tier).
+    n_iter : int
+        Iteration budget forwarded to the estimator.
+    features : int
+        Model dimensionality (SRM/HTFA K, ridge feature count).
+    checkpoint_every : int
+        Chunk size in iterations — also the park/preempt granularity.
+    seed : int
+        Synthetic-data and estimator-init seed (bit-exact parity
+        between a preempted and an unpreempted run needs both pinned).
+    n_subjects, voxels, samples : int
+        Synthetic data shape (ignored when ``data`` is set).
+    data : str, optional
+        Path to the job's input — a ``write_store`` directory for
+        store-backed kinds, or an ``.npz`` of ``X.<i>`` subject
+        arrays (+ ``Y`` for ridge).  None = seeded synthetic data.
+    deadline_s : float, optional
+        Soft SLO: seconds from submit to a terminal state.  An
+        overrun marks ``deadline_exceeded`` on the record and emits
+        a ``job_deadline`` event; it never kills the fit.
+    trace_id : str, optional
+        Request-trace id propagated from the submitting client.
+    """
+
+    tenant: str
+    kind: str
+    job_id: str = dataclasses.field(default_factory=new_job_id)
+    priority: int = 0
+    n_iter: int = 6
+    features: int = 3
+    checkpoint_every: int = 1
+    seed: int = 0
+    n_subjects: int = 3
+    voxels: int = 16
+    samples: int = 20
+    data: Optional[str] = None
+    deadline_s: Optional[float] = None
+    trace_id: Optional[str] = None
+
+    def __post_init__(self):
+        if not self.tenant or not isinstance(self.tenant, str):
+            raise ValueError(
+                f"tenant must be a non-empty string, got "
+                f"{self.tenant!r}")
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"kind must be one of {KINDS}, got {self.kind!r}")
+        if int(self.n_iter) < 1:
+            raise ValueError(
+                f"n_iter must be >= 1, got {self.n_iter}")
+        if int(self.checkpoint_every) < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got "
+                f"{self.checkpoint_every}")
+
+    def to_dict(self):
+        """Plain JSON-serializable dict (the codec payload)."""
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        """Inverse of :meth:`to_dict`; unknown keys are rejected so
+        a forward-incompatible job file fails loudly, not silently."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown JobSpec keys: {sorted(unknown)}")
+        return cls(**d)
+
+
+# -- npz codec --------------------------------------------------------
+
+def encode_jobs(specs):
+    """Encode specs as npz bytes (``job.<i>`` JSON scalars; no
+    pickling)."""
+    arrays = {"codec_schema": np.array(CODEC_SCHEMA),
+              "n_jobs": np.array(len(specs))}
+    for i, spec in enumerate(specs):
+        if not isinstance(spec, JobSpec):
+            raise TypeError(f"expected JobSpec, got {type(spec)!r}")
+        arrays[f"job.{i}"] = np.array(json.dumps(spec.to_dict()))
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_jobs(data):
+    """Decode :func:`encode_jobs` bytes back into a JobSpec list."""
+    with np.load(io.BytesIO(data), allow_pickle=False) as archive:
+        schema = int(archive["codec_schema"])
+        if schema > CODEC_SCHEMA:
+            raise ValueError(
+                f"job archive codec_schema={schema} is newer than "
+                f"supported ({CODEC_SCHEMA})")
+        n = int(archive["n_jobs"])
+        return [JobSpec.from_dict(
+            json.loads(str(archive[f"job.{i}"])))
+            for i in range(n)]
+
+
+def save_jobs(path, specs):
+    """Write a job batch to ``path`` (npz); returns the path."""
+    data = encode_jobs(specs)
+    with open(path, "wb") as fh:
+        fh.write(data)
+    return path
+
+
+def load_jobs(path):
+    """Read a :func:`save_jobs` archive."""
+    with open(path, "rb") as fh:
+        return decode_jobs(fh.read())

@@ -42,6 +42,7 @@ __all__ = [
     "capacity",
     "clear",
     "dump",
+    "last_dump",
     "record",
     "records",
 ]
@@ -56,6 +57,7 @@ _lock = threading.Lock()
 _ring = None       # guarded-by: _lock (deque, maxlen = capacity)
 _ring_cap = None   # guarded-by: _lock (capacity _ring was built with)
 _seq = 0           # guarded-by: _lock (snapshot filename uniquifier)
+_last_dump = None  # guarded-by: _lock ((path, ts, fit_id, trigger))
 
 
 def capacity():
@@ -164,6 +166,9 @@ def dump(trigger, *, fit_id=None, trace_id=None, state=None,
     except OSError as exc:
         logger.warning("flight dump for %s failed: %s", trigger, exc)
         return None
+    global _last_dump
+    with _lock:
+        _last_dump = (path, now, fit_id, trigger)
     from . import sink
     if sink.enabled():
         sink.event("flight_dump", trigger=trigger, path=path,
@@ -171,6 +176,28 @@ def dump(trigger, *, fit_id=None, trace_id=None, state=None,
     logger.info("flight recorder dumped %d records to %s (trigger: "
                 "%s)", len(ring), path, trigger)
     return path
+
+
+def last_dump(fit_id=None, since=None):
+    """The most recent snapshot written by :func:`dump` (in this
+    process) as ``{"path", "ts", "fit_id", "trigger"}``, or None.
+
+    ``fit_id`` restricts the answer to a snapshot implicating that
+    fit; ``since`` (epoch seconds) to one written at/after that time.
+    The jobs scheduler uses this to attach the incident snapshot of a
+    diverged / retry-exhausted fit to the failed job's record.
+    """
+    with _lock:
+        hit = _last_dump
+    if hit is None:
+        return None
+    path, ts, hit_fit, trigger = hit
+    if fit_id is not None and hit_fit is not None and hit_fit != fit_id:
+        return None
+    if since is not None and ts < since:
+        return None
+    return {"path": path, "ts": ts, "fit_id": hit_fit,
+            "trigger": trigger}
 
 
 def _json_default(obj):

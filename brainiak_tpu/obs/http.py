@@ -22,7 +22,20 @@ one ``http.server`` daemon thread serving
   (:func:`brainiak_tpu.obs.progress.active_fits`) as JSON: every
   running (and recently finished) resilient fit with its progress
   ratio, ETA, objective trend, and rollback count — the live view
-  ``python -m brainiak_tpu.obs watch`` polls.
+  ``python -m brainiak_tpu.obs watch`` polls.  When a jobs
+  scheduler (:mod:`brainiak_tpu.jobs.scheduler`) is live in the
+  process the payload additionally carries ``scheduler`` — queue /
+  running / parked job records and per-tenant fair-share usage
+  (detected via ``sys.modules``: a serve-only process pays no
+  import).
+
+A process may also attach a **control** callback (``control=``) —
+the jobs scheduler wires job submission here — which enables POST:
+``POST /jobs/submit`` (body: the npz job codec,
+:func:`brainiak_tpu.jobs.spec.encode_jobs`) and
+``POST /jobs/cancel?job_id=<id>``, each answered with a JSON verdict.
+Without a control callback every POST is 405 — the plane stays
+read-only by default.
 
 Opt-in: nothing listens unless a port is given — programmatically,
 via ``serve service --http-port``, or through the
@@ -42,6 +55,7 @@ import json
 import logging
 import os
 import re
+import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -237,6 +251,22 @@ def parse_prometheus_text(text):
     return families, errors
 
 
+def _scheduler_state():
+    """Live jobs-scheduler state for the ``/jobs`` payload, or None.
+
+    Gated on ``sys.modules``: a process that never imported the jobs
+    scheduler (a serve-only replica, say) answers ``/jobs`` exactly
+    as before — no import is triggered from the exposition path."""
+    mod = sys.modules.get("brainiak_tpu.jobs.scheduler")
+    if mod is None:
+        return None
+    try:
+        return mod.scheduler_state()
+    except Exception:
+        logger.exception("scheduler state for /jobs failed")
+        return None
+
+
 class TelemetryServer:
     """The opt-in exposition daemon (see module docstring).
 
@@ -258,14 +288,20 @@ class TelemetryServer:
     registry : :class:`~brainiak_tpu.obs.metrics.MetricsRegistry`,
         optional
         Metrics source (default: the process default registry).
+    control : callable, optional
+        ``control(action, payload) -> dict`` handling POST control
+        requests (``action`` is ``"submit"`` with npz body bytes, or
+        ``"cancel"`` with a job-id string).  Raising ``ValueError``
+        maps to a 400.  Without one, POSTs answer 405.
     """
 
     def __init__(self, port=0, host="127.0.0.1", readiness=None,
-                 registry=None):
+                 registry=None, control=None):
         self.requested_port = int(port)
         self.host = host
         self.readiness = readiness
         self.registry = registry
+        self.control = control
         self._httpd = None   # guarded-by: _lock
         self._thread = None  # guarded-by: _lock
         self._lock = threading.Lock()
@@ -288,6 +324,9 @@ class TelemetryServer:
             class Handler(BaseHTTPRequestHandler):
                 def do_GET(self):  # noqa: N802 (stdlib API name)
                     server._handle(self)
+
+                def do_POST(self):  # noqa: N802 (stdlib API name)
+                    server._handle_post(self)
 
                 def log_message(self, fmt, *args):
                     logger.debug("obs http: " + fmt, *args)
@@ -344,9 +383,12 @@ class TelemetryServer:
                 self._ready(handler)
             elif path == "/jobs":
                 from . import progress as obs_progress
-                body = json.dumps(
-                    {"fits": obs_progress.active_fits()},
-                    indent=2, sort_keys=True) + "\n"
+                payload = {"fits": obs_progress.active_fits()}
+                scheduler = _scheduler_state()
+                if scheduler is not None:
+                    payload["scheduler"] = scheduler
+                body = json.dumps(payload, indent=2,
+                                  sort_keys=True) + "\n"
                 self._respond(handler, 200, body,
                               "application/json")
             else:
@@ -356,6 +398,52 @@ class TelemetryServer:
                               "text/plain")
         except Exception:  # exposition must never kill the server
             logger.exception("obs http handler failed for %s", path)
+            try:
+                self._respond(handler, 500, "internal error\n",
+                              "text/plain")
+            except Exception:
+                pass
+
+    def _handle_post(self, handler):
+        path, _, query = handler.path.partition("?")
+        try:
+            if self.control is None:
+                self._respond(
+                    handler, 405,
+                    "no control plane attached; POST disabled\n",
+                    "text/plain")
+                return
+            if path == "/jobs/submit":
+                length = int(handler.headers.get(
+                    "Content-Length", 0) or 0)
+                body = handler.rfile.read(length) if length else b""
+                verdict = self.control("submit", body)
+            elif path == "/jobs/cancel":
+                params = dict(
+                    part.split("=", 1) for part in query.split("&")
+                    if "=" in part)
+                job_id = params.get("job_id", "")
+                if not job_id:
+                    raise ValueError(
+                        "cancel requires ?job_id=<id>")
+                verdict = self.control("cancel", job_id)
+            else:
+                self._respond(
+                    handler, 404,
+                    f"unknown control path {path!r}; endpoints: "
+                    "/jobs/submit /jobs/cancel\n", "text/plain")
+                return
+            self._respond(
+                handler, 200,
+                json.dumps(verdict, indent=2, sort_keys=True) + "\n",
+                "application/json")
+        except ValueError as exc:
+            try:
+                self._respond(handler, 400, f"{exc}\n", "text/plain")
+            except Exception:
+                pass
+        except Exception:  # control must never kill the server
+            logger.exception("obs http control failed for %s", path)
             try:
                 self._respond(handler, 500, "internal error\n",
                               "text/plain")

@@ -72,6 +72,22 @@ def _fmt_ts(ts, t0):
         return " " * 10
 
 
+def _job_for_fit(records, fit_id):
+    """Job attribution (``job_id``/``tenant``/...) for ``fit_id``,
+    read from the newest ring record carrying scheduler context —
+    progress records and events both ride ``attrs`` (see
+    :func:`brainiak_tpu.obs.progress.fit_context`); ``None`` when
+    the fit was not a scheduled job."""
+    found = None
+    for rec in records:
+        if rec.get("fit_id") != fit_id:
+            continue
+        attrs = rec.get("attrs") or {}
+        if attrs.get("job_id"):
+            found = attrs
+    return found
+
+
 def _describe(rec):
     kind = rec.get("kind")
     name = rec.get("name", "?")
@@ -88,7 +104,8 @@ def _describe(rec):
     if kind == "event":
         attrs = rec.get("attrs") or {}
         keys = ("estimator", "site", "step", "reason", "leaves",
-                "slo", "replica", "error", "status")
+                "slo", "replica", "error", "status", "job_id",
+                "tenant")
         detail = ", ".join(f"{k}={attrs[k]}" for k in keys
                            if k in attrs)
         return f"event     {name}" + (f" [{detail}]" if detail
@@ -108,6 +125,13 @@ def render(manifest, records):
     lines.append(f"  trigger: {trigger}  at {when}")
     if manifest.get("fit_id"):
         lines.append(f"  fit_id: {manifest['fit_id']}")
+        job = _job_for_fit(records, manifest["fit_id"])
+        if job:
+            # the snapshot's fit belongs to a scheduled job: name
+            # the tenant + job so the on-call pages the right owner
+            lines.append(
+                f"  implicated job: tenant={job.get('tenant', '?')}"
+                f"  job_id={job.get('job_id', '?')}")
     if manifest.get("trace_id"):
         lines.append(f"  trace_id: {manifest['trace_id']}")
     state = manifest.get("state") or {}
@@ -135,7 +159,13 @@ def render(manifest, records):
         lines.append("")
         marker = "  <-- implicated" \
             if fit_id == manifest.get("fit_id") else ""
-        lines.append(f"fit {fit_id} [{cur['estimator']}]{marker}")
+        attrs = last.get("attrs") or {}
+        job = ""
+        if attrs.get("job_id"):
+            job = (f" (job {attrs['job_id']}, "
+                   f"tenant {attrs.get('tenant', '?')})")
+        lines.append(f"fit {fit_id} [{cur['estimator']}]{job}"
+                     f"{marker}")
         lines.append(
             f"  last chunk: {last.get('chunk')}"
             f" (step {last.get('step')}/{last.get('n_iter', '?')},"

@@ -38,6 +38,7 @@ non-finite and trips the precursor), or a callable
 must never break the fit.
 """
 
+import contextlib
 import math
 import os
 import threading
@@ -54,8 +55,12 @@ __all__ = [
     "PLATEAU_RTOL",
     "FitProgress",
     "active_fits",
+    "add_finish_listener",
     "clear_registry",
+    "current_context",
+    "fit_context",
     "new_fit_id",
+    "remove_finish_listener",
 ]
 
 #: Smoothing for the chunk-rate and objective-delta EWMAs.
@@ -91,6 +96,79 @@ def _finite_or_none(value):
     if value is None or not math.isfinite(value):
         return None
     return value
+
+
+# -- ambient fit context (job/tenant attribution) ---------------------
+
+_context_local = threading.local()
+
+
+def current_context():
+    """The ambient fit-context attrs for this thread (``{}`` outside
+    any :func:`fit_context`)."""
+    return dict(getattr(_context_local, "attrs", None) or {})
+
+
+@contextlib.contextmanager
+def fit_context(**attrs):
+    """Attribute every fit started on this thread to ``attrs``.
+
+    The jobs scheduler wraps each fit invocation in
+    ``fit_context(job_id=..., tenant=...)``; :class:`FitProgress`
+    captures the ambient attrs at construction and carries them on
+    every progress record (``attrs``), every fit event, and every
+    registry snapshot — so ``/jobs``, ``obs watch`` and ``obs
+    postmortem`` can join a fit back to the job that scheduled it.
+    ``None`` values are dropped; scopes nest (inner keys shadow
+    outer ones) and restore on exit.
+    """
+    prev = getattr(_context_local, "attrs", None)
+    merged = dict(prev or {})
+    merged.update({k: v for k, v in attrs.items() if v is not None})
+    _context_local.attrs = merged
+    try:
+        yield
+    finally:
+        _context_local.attrs = prev
+
+
+# -- finish listeners (job-record feedback) ---------------------------
+
+_listeners_lock = threading.Lock()
+_finish_listeners = []  # guarded-by: _listeners_lock
+
+
+def add_finish_listener(fn):
+    """Register ``fn(snapshot)`` to run whenever any fit finishes.
+
+    The snapshot is the final registry dict (fit_id, estimator,
+    terminal ``status`` — ``converged``/``completed``/``diverged``/
+    ``parked`` — plus any :func:`fit_context` attrs such as
+    ``job_id``/``tenant``).  Listener exceptions are swallowed:
+    telemetry must never break the fit.  Listeners run on the fit
+    thread.
+    """
+    with _listeners_lock:
+        if fn not in _finish_listeners:
+            _finish_listeners.append(fn)
+
+
+def remove_finish_listener(fn):
+    """Unregister a :func:`add_finish_listener` callback (no-op if
+    absent)."""
+    with _listeners_lock:
+        if fn in _finish_listeners:
+            _finish_listeners.remove(fn)
+
+
+def _notify_finish(snapshot):
+    with _listeners_lock:
+        listeners = list(_finish_listeners)
+    for fn in listeners:
+        try:
+            fn(dict(snapshot))
+        except Exception:
+            pass
 
 
 # -- in-process registry (feeds /jobs and the watch CLI) --------------
@@ -163,6 +241,7 @@ class FitProgress:
         self.objective_spec = objective
         self.direction = direction
         self.n_chunks = int(n_chunks) if n_chunks else None
+        self.context = current_context()
         self.chunk = int(chunks0)       # monotone observation count
         self.fit_wall_s = float(wall0)
         self.rollbacks = 0
@@ -190,7 +269,8 @@ class FitProgress:
             flight.record(rec)
 
     def _event(self, name, **attrs):
-        rec = sink.make_record("event", name, attrs=attrs or None,
+        merged = dict(self.context, **attrs)
+        rec = sink.make_record("event", name, attrs=merged or None,
                                fit_id=self.fit_id)
         self._emit_record(rec)
         return rec
@@ -294,7 +374,8 @@ class FitProgress:
             delta=_finite_or_none(delta), rollbacks=self.rollbacks,
             chunk_s=float(chunk_s), fit_wall_s=self.fit_wall_s,
             rate=self.rate, eta_s=self.eta_s,
-            plateaued=self.plateaued or None)
+            plateaued=self.plateaued or None,
+            attrs=self.context or None)
         self._emit_record(rec)
         # gauges update the in-process registry regardless (host-only
         # work); they emit metric records only while obs is enabled
@@ -316,19 +397,24 @@ class FitProgress:
 
     def finish(self, status):
         """Mark the fit finished (``converged`` / ``completed`` /
-        ``diverged``), emit the ``fit_finished`` event, and publish
-        the final registry snapshot."""
+        ``diverged`` / ``parked``), emit the ``fit_finished`` event,
+        publish the final registry snapshot, and notify any
+        :func:`add_finish_listener` callbacks with it — the hook the
+        jobs scheduler uses to fold the fit outcome back into the
+        owning job record (never a zombie "running" entry)."""
         self.status = status
         self._event("fit_finished", estimator=self.estimator,
                     status=status, chunk=self.chunk,
                     rollbacks=self.rollbacks,
                     fit_wall_s=self.fit_wall_s)
-        self._publish_snapshot(time.time(),
-                               self.objectives[-1][0]
-                               if self.objectives else None)
+        snap = self._publish_snapshot(time.time(),
+                                      self.objectives[-1][0]
+                                      if self.objectives else None)
+        _notify_finish(snap)
 
     def _publish_snapshot(self, ts, step):
-        _publish({
+        snap = dict(self.context)
+        snap.update({
             "fit_id": self.fit_id,
             "estimator": self.estimator,
             "status": self.status,
@@ -347,3 +433,5 @@ class FitProgress:
             "objective_tail": [v for _, v in self.objectives[-5:]],
             "ts": ts,
         })
+        _publish(snap)
+        return snap

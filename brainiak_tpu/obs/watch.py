@@ -28,19 +28,26 @@ import urllib.request
 
 from .sink import OBS_DIR_ENV
 
-__all__ = ["fits_from_dir", "fits_from_url", "main", "render_frame"]
+__all__ = ["fits_from_dir", "fits_from_url", "main",
+           "payload_from_url", "render_frame"]
 
 BAR_WIDTH = 20
+
+
+def payload_from_url(url, timeout=5.0):
+    """The full ``/jobs`` payload dict (``fits`` always; a live
+    scheduler adds ``scheduler`` — see
+    :mod:`brainiak_tpu.jobs.scheduler`)."""
+    if not url.rstrip("/").endswith("/jobs"):
+        url = url.rstrip("/") + "/jobs"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.load(resp)
 
 
 def fits_from_url(url, timeout=5.0):
     """Fit snapshots from a ``/jobs`` endpoint (``url`` may name the
     server root or the ``/jobs`` path)."""
-    if not url.rstrip("/").endswith("/jobs"):
-        url = url.rstrip("/") + "/jobs"
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
-        payload = json.load(resp)
-    return list(payload.get("fits", []))
+    return list(payload_from_url(url, timeout).get("fits", []))
 
 
 def fits_from_dir(directory):
@@ -124,14 +131,19 @@ def _fmt_eta(eta):
     return f"{eta:.0f}s"
 
 
-def render_frame(fits, incidents=(), now=None):
-    """One text frame: the fit table plus recent incidents."""
+def render_frame(fits, incidents=(), now=None, scheduler=None):
+    """One text frame: the fit table, the scheduler's job table
+    (when a live scheduler feeds the ``/jobs`` payload), and recent
+    incidents."""
     now = time.time() if now is None else now
     when = time.strftime("%H:%M:%S", time.localtime(now))
     lines = [f"obs watch  {when}  ({len(fits)} fit(s))"]
+    has_jobs = any(fit.get("tenant") or fit.get("job_id")
+                   for fit in fits)
     if fits:
+        tenant_head = f" {'tenant':10s}" if has_jobs else ""
         lines.append(
-            f"  {'fit_id':16s} {'estimator':20s} "
+            f"  {'fit_id':16s} {'estimator':20s}{tenant_head} "
             f"{'progress':{BAR_WIDTH + 2}s} {'step':>12s} "
             f"{'objective':>12s} {'eta':>7s} {'rb':>3s}  status")
     for fit in fits:
@@ -139,15 +151,48 @@ def render_frame(fits, incidents=(), now=None):
         objective = fit.get("objective")
         objective = "-" if objective is None else f"{objective:.5g}"
         status = fit.get("status", "running")
+        tenant_col = f" {str(fit.get('tenant') or '-')[:10]:10s}" \
+            if has_jobs else ""
         lines.append(
             f"  {str(fit.get('fit_id', '?')):16s} "
-            f"{str(fit.get('estimator', '?'))[:20]:20s} "
+            f"{str(fit.get('estimator', '?'))[:20]:20s}"
+            f"{tenant_col} "
             f"{_bar(fit.get('ratio'))} {step:>12s} "
             f"{objective:>12s} {_fmt_eta(fit.get('eta_s')):>7s} "
             f"{fit.get('rollbacks', 0):>3} "
             f" {status}")
     if not fits:
         lines.append("  (no fits reported yet)")
+    if scheduler:
+        jobs = scheduler.get("jobs", [])
+        tenants = scheduler.get("tenants", {})
+        counts = scheduler.get("counts", {})
+        state_summary = " ".join(
+            f"{state}={counts[state]}" for state in sorted(counts))
+        pressure = " [serving pressure]" \
+            if scheduler.get("pressure") else ""
+        lines.append("")
+        lines.append(
+            f"scheduler  slots={scheduler.get('slots', '?')}"
+            f"{pressure}  {state_summary}")
+        if jobs:
+            lines.append(
+                f"  {'job_id':16s} {'tenant':10s} {'kind':16s} "
+                f"{'pri':>3s} {'state':9s} {'chunks':>6s} "
+                f"{'preempt':>7s} {'deficit':>8s}")
+        for job in jobs:
+            deficit = tenants.get(job.get("tenant"), {}) \
+                .get("deficit")
+            deficit = "-" if deficit is None else f"{deficit:.2f}"
+            lines.append(
+                f"  {str(job.get('job_id', '?'))[:16]:16s} "
+                f"{str(job.get('tenant', '?'))[:10]:10s} "
+                f"{str(job.get('kind', '?'))[:16]:16s} "
+                f"{job.get('priority', 0):>3} "
+                f"{str(job.get('state', '?')):9s} "
+                f"{job.get('chunks', 0):>6.0f} "
+                f"{job.get('n_preemptions', 0):>7} "
+                f"{deficit:>8s}")
     if incidents:
         lines.append("")
         lines.append("recent incidents:")
@@ -186,9 +231,14 @@ def main(argv=None):
             parser.error(
                 f"give --url or --dir (or set ${OBS_DIR_ENV})")
     while True:
+        scheduler = None
         try:
-            fits = fits_from_url(args.url) if args.url \
-                else fits_from_dir(directory)
+            if args.url:
+                payload = payload_from_url(args.url)
+                fits = list(payload.get("fits", []))
+                scheduler = payload.get("scheduler")
+            else:
+                fits = fits_from_dir(directory)
         except OSError as exc:
             print(f"obs watch: source unreachable ({exc})",
                   file=sys.stderr)
@@ -197,7 +247,7 @@ def main(argv=None):
             fits = []
         incidents = recent_incidents(
             directory or os.environ.get(OBS_DIR_ENV) or "")
-        print(render_frame(fits, incidents))
+        print(render_frame(fits, incidents, scheduler=scheduler))
         if args.once:
             return 0
         try:

@@ -25,18 +25,22 @@ from .faults import (  # noqa: F401
 )
 from .guards import (  # noqa: F401
     DivergenceError,
+    FitParked,
     check_state,
+    park_scope,
     run_resilient_loop,
 )
 from .retry import retry  # noqa: F401
 
 __all__ = [
     "DivergenceError",
+    "FitParked",
     "InjectedIOError",
     "PreemptionError",
     "check_state",
     "faults",
     "inject",
+    "park_scope",
     "retry",
     "run_resilient_loop",
 ]

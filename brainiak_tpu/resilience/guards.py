@@ -33,7 +33,9 @@ iterates); host-driven loops additionally call :func:`check_state`
 per outer iteration inside their chunk callbacks.
 """
 
+import contextlib
 import logging
+import threading
 import time
 
 import numpy as np
@@ -49,9 +51,10 @@ from ..obs import spans as obs_spans
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["DivergenceError", "array_digest", "check_state",
-           "leaves_to_device", "make_device_carry_chunk",
-           "pack_rng_state", "run_resilient_loop", "unpack_rng_state"]
+__all__ = ["DivergenceError", "FitParked", "array_digest",
+           "check_state", "leaves_to_device", "make_device_carry_chunk",
+           "pack_rng_state", "park_scope", "run_resilient_loop",
+           "unpack_rng_state"]
 
 
 def array_digest(*arrays):
@@ -161,6 +164,77 @@ class DivergenceError(FloatingPointError):
         super().__init__(
             f"non-finite values{loop}{at} in state leaves: "
             f"{', '.join(self.leaves)}")
+
+
+class FitParked(RuntimeError):
+    """A resilient fit stopped at a chunk boundary on request.
+
+    Raised by :func:`run_resilient_loop` when the ambient
+    :func:`park_scope` predicate returns true right after a checkpoint
+    save — the fit's state is durably on disk, so re-invoking the same
+    fit entry point with the same ``checkpoint_dir`` resumes under the
+    same ``fit_id`` with cumulative wall-clock accounting.  This is the
+    preemption primitive the jobs scheduler builds on; it is NOT an
+    error in the fit itself.
+
+    Attributes
+    ----------
+    step : int
+        Iteration the checkpoint holds (where the resume will start).
+    fit_id : str or None
+        The fit's stable id (persisted in the checkpoint).
+    name : str or None
+        Loop label (``SRM.fit``, ...).
+    """
+
+    def __init__(self, step, fit_id=None, name=None):
+        self.step = step
+        self.fit_id = fit_id
+        self.name = name
+        loop = f"{name}: " if name else ""
+        super().__init__(
+            f"{loop}fit parked at iteration {step} "
+            f"(fit_id={fit_id}); re-run with the same checkpoint_dir "
+            f"to resume")
+
+
+_park_local = threading.local()
+
+
+@contextlib.contextmanager
+def park_scope(should_park):
+    """Make every :func:`run_resilient_loop` on this thread parkable.
+
+    ``should_park`` is a zero-argument callable consulted exactly once
+    per persisted chunk (right after the checkpoint save, and only when
+    the loop has a ``checkpoint_dir`` — parking without a checkpoint
+    would discard work).  When it returns true the loop finishes its
+    progress stream with status ``"parked"`` and raises
+    :class:`FitParked`.  Because the predicate fires once per chunk it
+    doubles as the scheduler's chunk-grant meter: a closure counting
+    its own invocations implements "run N chunks, then yield".
+
+    Scopes nest; the innermost predicate wins and the previous one is
+    restored on exit.  Predicate exceptions are swallowed (a broken
+    scheduler must not kill a healthy fit).
+    """
+    prev = getattr(_park_local, "pred", None)
+    _park_local.pred = should_park
+    try:
+        yield
+    finally:
+        _park_local.pred = prev
+
+
+def _should_park():
+    pred = getattr(_park_local, "pred", None)
+    if pred is None:
+        return False
+    try:
+        return bool(pred())
+    except Exception:
+        logger.exception("park predicate raised; ignoring")
+        return False
 
 
 def check_state(state, iteration=None, where=None, skip=(),
@@ -457,5 +531,19 @@ def run_resilient_loop(run_chunk, init_state, n_iter, *,
             obs_sink.event("checkpoint", estimator=name, step=step,
                            seconds=dt_save, fit_id=progress.fit_id)
         faults.preempt_point(step, site=name)
+        # park check: once per persisted chunk, checkpointed loops
+        # only (the predicate fires after the save, so the raised
+        # FitParked always has a durable resume point behind it);
+        # a finished fit is never parked — it returns normally below
+        if mngr is not None and step < n_iter and not done \
+                and _should_park():
+            obs_sink.event("parked", estimator=name, step=step,
+                           fit_id=progress.fit_id)
+            obs_metrics.counter(
+                "fit_parked_total",
+                help="resilient fits parked at a chunk boundary "
+                     "by a park_scope predicate").inc(estimator=name)
+            progress.finish("parked")
+            raise FitParked(step, fit_id=progress.fit_id, name=name)
     progress.finish("converged" if done else "completed")
     return state, step

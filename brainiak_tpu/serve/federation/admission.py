@@ -51,9 +51,10 @@ class Shed:
     evidence."""
 
     retry_after_s: float
-    reason: str          # "queue_full" | "slo_burn"
+    reason: str          # "queue_full" | "slo_burn" | "tenant_quota"
     depth: int
     bound: int
+    tenant: Optional[str] = None
 
 
 class AdmissionController:
@@ -80,11 +81,23 @@ class AdmissionController:
         fast path must not pay a burn evaluation per request).
     clock : callable
         Monotonic time source (tests inject a fake).
+    tenant_quotas : dict, optional
+        Per-tenant in-flight bounds (tenant name -> max jobs/requests
+        that tenant may hold admitted at once).  Consulted by
+        :meth:`evaluate` when the caller supplies ``tenant`` +
+        ``tenant_depth`` — the jobs scheduler passes a tenant's
+        queued+running+parked count so one tenant's thousand-subject
+        SRM backlog sheds at its own quota long before it can fill
+        the global ``max_depth``.  Tenants without an entry fall back
+        to ``default_tenant_quota`` (None = unbounded).
+    default_tenant_quota : int, optional
+        Quota applied to tenants absent from ``tenant_quotas``.
     """
 
     def __init__(self, max_depth=256, retry_after_s=0.05, slo=None,
                  brownout_factor=0.5, slo_poll_interval_s=0.25,
-                 clock=time.monotonic):
+                 clock=time.monotonic, tenant_quotas=None,
+                 default_tenant_quota=None):
         if max_depth < 0:
             raise ValueError(
                 f"max_depth must be >= 0, got {max_depth}")
@@ -98,6 +111,8 @@ class AdmissionController:
         self.brownout_factor = float(brownout_factor)
         self.slo_poll_interval_s = float(slo_poll_interval_s)
         self.clock = clock
+        self.tenant_quotas = dict(tenant_quotas or {})
+        self.default_tenant_quota = default_tenant_quota
         self._lock = threading.Lock()
         self._n_admitted = 0       # guarded-by: _lock
         self._n_shed = 0           # guarded-by: _lock
@@ -126,10 +141,36 @@ class AdmissionController:
             return False
         return bool(self._poll_slo())
 
-    def evaluate(self, queued_depth) -> Optional[Shed]:
+    def tenant_quota(self, tenant):
+        """The in-flight bound for ``tenant`` (None = unbounded)."""
+        if tenant in self.tenant_quotas:
+            return self.tenant_quotas[tenant]
+        return self.default_tenant_quota
+
+    def evaluate(self, queued_depth, tenant=None,
+                 tenant_depth=None) -> Optional[Shed]:
         """None to admit a request at ``queued_depth``, else the
         :class:`Shed` (O(1); the throttled SLO poll is the only
-        non-constant ingredient)."""
+        non-constant ingredient).
+
+        With ``tenant`` + ``tenant_depth`` supplied, the tenant's
+        quota (see ``tenant_quotas``) is checked first: a tenant at
+        or over its own bound sheds with reason ``tenant_quota``
+        even when the global queue has room.
+        """
+        if tenant is not None and tenant_depth is not None:
+            quota = self.tenant_quota(tenant)
+            if quota is not None and int(tenant_depth) >= int(quota):
+                overflow = int(tenant_depth) - int(quota)
+                retry = self.retry_after_s * min(
+                    8.0, 1.0 + overflow / max(int(quota), 1))
+                with self._lock:
+                    self._n_shed += 1
+                    self._shed_by_reason["tenant_quota"] = \
+                        self._shed_by_reason.get("tenant_quota", 0) + 1
+                return Shed(retry_after_s=retry, reason="tenant_quota",
+                            depth=int(tenant_depth), bound=int(quota),
+                            tenant=tenant)
         bound = self.depth_bound()
         depth = int(queued_depth)
         if depth < bound:

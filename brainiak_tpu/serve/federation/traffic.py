@@ -22,6 +22,14 @@ module builds that workload from the repo's own simulator:
 
 Everything is seeded and deterministic, so a bench round or CI gate
 replays the identical mix.
+
+The same generator also emits **fit workloads** for the training
+control plane (:mod:`brainiak_tpu.jobs`): :meth:`TrafficGenerator.
+fit_jobs` mints :class:`~brainiak_tpu.jobs.spec.JobSpec` batches
+with a Zipf-weighted tenant mix (one hospital dominates, a long
+tail of small labs) and :meth:`TrafficGenerator.job_schedule` gives
+them Pareto inter-arrival submission offsets — the jobs soak test
+and the ``jobs`` bench tier replay the identical stream.
 """
 
 import time
@@ -40,7 +48,9 @@ class TrafficGenerator:
     Parameters
     ----------
     model : fitted SRM/DetSRM (``w_`` per-subject maps — the demo
-        and fixture serving workload)
+        and fixture serving workload), or ``None`` for a fit-only
+        generator (:meth:`fit_jobs` / :meth:`job_schedule` never
+        touch the model; :meth:`requests` raises without one)
     model_name : str, optional
         Stamped on every request's ``model`` field (multi-model
         routing through the federation router).
@@ -54,7 +64,7 @@ class TrafficGenerator:
         Simulated TR length in seconds (drives the HRF kernel).
     """
 
-    def __init__(self, model, model_name=None, seed=0,
+    def __init__(self, model=None, model_name=None, seed=0,
                  tr_choices=(16, 32, 64, 128), alpha=1.5,
                  tr_duration=1.0):
         if alpha <= 1.0:
@@ -63,7 +73,8 @@ class TrafficGenerator:
                 f"{alpha}")
         self.model = model
         self.model_name = model_name
-        self.voxel_counts = [w.shape[0] for w in model.w_]
+        self.voxel_counts = [w.shape[0] for w in model.w_] \
+            if model is not None else []
         self.tr_choices = tuple(int(t) for t in tr_choices)
         self.alpha = float(alpha)
         self.tr_duration = float(tr_duration)
@@ -95,6 +106,10 @@ class TrafficGenerator:
     def requests(self, n, prefix="t", deadline_s=None):
         """``n`` deterministic requests: heavy-tailed scan-length
         mix, subjects round-robin, fmrisim payloads."""
+        if self.model is None:
+            raise ValueError(
+                "requests() needs a fitted model; this generator "
+                "was built fit-only (model=None)")
         out = []
         for i in range(n):
             subject = i % len(self.voxel_counts)
@@ -121,6 +136,52 @@ class TrafficGenerator:
         reqs = self.requests(n, prefix=prefix,
                              deadline_s=deadline_s)
         return list(zip(arrivals.tolist(), reqs))
+
+    def fit_jobs(self, n, tenants=("hospital-a", "hospital-b",
+                                   "lab-c"),
+                 kinds=("srm",), n_iter=6, features=3,
+                 n_subjects=3, voxels=16, samples=20,
+                 priorities=(0,), deadline_s=None):
+        """``n`` deterministic :class:`~brainiak_tpu.jobs.spec.
+        JobSpec` values with a Zipf-weighted tenant mix
+        (``P(tenant i) ∝ 1/(i+1)`` — the first tenant dominates,
+        the tail trickles) and uniform draws over ``kinds`` /
+        ``priorities``.  Seeds are minted per job so two jobs never
+        share a synthetic dataset."""
+        from ...jobs.spec import JobSpec
+
+        tenants = tuple(tenants)
+        weights = 1.0 / np.arange(1, len(tenants) + 1)
+        weights /= weights.sum()
+        specs = []
+        for i in range(n):
+            tenant = tenants[int(self.rng.choice(
+                len(tenants), p=weights))]
+            kind = kinds[int(self.rng.randint(len(kinds)))]
+            priority = int(priorities[int(self.rng.randint(
+                len(priorities)))])
+            specs.append(JobSpec(
+                tenant=tenant, kind=kind, priority=priority,
+                n_iter=n_iter, features=features,
+                seed=int(self.rng.randint(0, 2**31 - 1)),
+                n_subjects=n_subjects, voxels=voxels,
+                samples=samples, deadline_s=deadline_s))
+        return specs
+
+    def job_schedule(self, n, target_jobs_per_s, **kwargs):
+        """``[(arrival_offset_s, JobSpec)]`` — the fit-workload
+        twin of :meth:`schedule`: Pareto inter-arrival submission
+        times rescaled so the mean rate is ``target_jobs_per_s``,
+        over a :meth:`fit_jobs` batch (``kwargs`` pass through)."""
+        if target_jobs_per_s <= 0:
+            raise ValueError(
+                f"target_jobs_per_s must be > 0, got "
+                f"{target_jobs_per_s}")
+        gaps = self.rng.pareto(self.alpha, size=n) + 1.0
+        arrivals = np.cumsum(gaps)
+        arrivals *= n / (float(target_jobs_per_s) * arrivals[-1])
+        specs = self.fit_jobs(n, **kwargs)
+        return list(zip(arrivals.tolist(), specs))
 
 
 def replay(schedule, submit_many, time_scale=1.0,

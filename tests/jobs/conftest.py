@@ -1,0 +1,34 @@
+"""Jobs test isolation: clean obs surfaces (the scheduler writes
+events, metrics, progress and flight records) plus a leaked
+finish-listener guard — a test that forgets to ``close()`` its
+scheduler must not leave its hook observing later tests' fits."""
+
+import pytest
+
+from brainiak_tpu.obs import flight, metrics, progress, sink
+from brainiak_tpu.jobs import scheduler as sched_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    monkeypatch.delenv(sink.OBS_DIR_ENV, raising=False)
+    monkeypatch.delenv(sink.OBS_RANK_ENV, raising=False)
+    monkeypatch.delenv(flight.FLIGHT_DIR_ENV, raising=False)
+    monkeypatch.delenv(flight.FLIGHT_RECORDS_ENV, raising=False)
+    sink.close_all()
+    metrics.reset()
+    flight.clear()
+    progress.clear_registry()
+    yield
+    # close any scheduler a failing test left live (close() also
+    # detaches its finish listener and the _active entry)
+    with sched_mod._active_lock:
+        leaked = list(sched_mod._active)
+    for sched in leaked:
+        sched.close()
+    with progress._listeners_lock:
+        del progress._finish_listeners[:]
+    sink.close_all()
+    metrics.reset()
+    flight.clear()
+    progress.clear_registry()

@@ -1,0 +1,62 @@
+"""FairShare: the virtual-time ledger the scheduler picks by."""
+
+import pytest
+
+from brainiak_tpu.jobs.quota import FairShare
+
+
+def test_weight_validation():
+    with pytest.raises(ValueError):
+        FairShare(default_weight=0.0)
+    with pytest.raises(ValueError):
+        FairShare(weights={"t": -1.0})
+
+
+def test_charge_usage_virtual_time():
+    fair = FairShare(weights={"heavy": 2.0})
+    fair.charge("heavy", 4)
+    fair.charge("light", 1)
+    fair.charge("light", 1)
+    assert fair.usage("heavy") == 4.0
+    assert fair.usage("light") == 2.0
+    # vt normalizes by weight: heavy ran twice the chunks but has
+    # twice the weight, so the two tenants tie
+    assert fair.virtual_time("heavy") == fair.virtual_time("light")
+    with pytest.raises(ValueError):
+        fair.charge("light", -1)
+
+
+def test_pick_minimal_virtual_time_with_lexical_tiebreak():
+    fair = FairShare()
+    assert fair.pick([]) is None
+    assert fair.pick(["b", "a"]) == "a"  # vt tie -> lexical
+    fair.charge("a", 3)
+    assert fair.pick(["a", "b"]) == "b"
+    fair.charge("b", 5)
+    assert fair.pick(["a", "b"]) == "a"
+
+
+def test_deficits_entitlement_minus_consumption():
+    fair = FairShare(weights={"big": 3.0})
+    fair.charge("big", 4)
+    fair.charge("small", 4)
+    deficits = fair.deficits()
+    # total 8 chunks, weights 3:1 -> big entitled to 6, small to 2
+    assert deficits["big"] == pytest.approx(2.0)
+    assert deficits["small"] == pytest.approx(-2.0)
+    # widening includes a tenant that never consumed
+    wide = fair.deficits(["big", "small", "idle"])
+    assert wide["idle"] > 0.0
+    assert fair.deficits() != {} and FairShare().deficits() == {}
+
+
+def test_summary_is_json_shaped():
+    fair = FairShare(weights={"a": 2.0})
+    fair.charge("a", 6)
+    fair.charge("b", 1)
+    summary = fair.summary()
+    assert sorted(summary) == ["a", "b"]
+    assert summary["a"] == {"usage": 6.0, "weight": 2.0,
+                            "virtual_time": 3.0,
+                            "deficit": summary["a"]["deficit"]}
+    assert summary["a"]["deficit"] == pytest.approx(-4.0 / 3.0)

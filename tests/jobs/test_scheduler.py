@@ -1,0 +1,325 @@
+"""Scheduler lifecycle: preempt-park-resume parity, fair share,
+cancellation, crash containment, shed admission, and the control
+plane — the ISSUE-20 scenarios the JOB001 gate mirrors in CI."""
+
+import json
+import time
+
+import pytest
+
+from brainiak_tpu.jobs.quota import FairShare
+from brainiak_tpu.jobs.runners import run_job
+from brainiak_tpu.jobs.scheduler import (
+    Scheduler,
+    SchedulerClosed,
+    scheduler_state,
+)
+from brainiak_tpu.jobs.spec import JobSpec
+from brainiak_tpu.obs import flight, metrics
+from brainiak_tpu.resilience import faults
+from brainiak_tpu.serve.federation.admission import (
+    AdmissionController,
+)
+
+# tiny but real SRM fits: every chunk is one EM iteration persisted
+# through the checkpoint contract
+FIT = dict(kind="srm", features=2, checkpoint_every=1,
+           n_subjects=2, voxels=8, samples=12)
+
+
+def make_sched(tmp_path, **kwargs):
+    kwargs.setdefault("max_slots", 1)
+    kwargs.setdefault("serve_pressure_depth", 1 << 20)
+    kwargs.setdefault("tick_interval_s", 0.01)
+    return Scheduler(str(tmp_path / "jobs"), **kwargs)
+
+
+def poll(sched, job_id, predicate, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        row = sched.job(job_id)
+        if predicate(row):
+            return row
+        time.sleep(0.01)
+    raise AssertionError(
+        f"job {job_id} never satisfied predicate; last row: "
+        f"{sched.job(job_id)}")
+
+
+def test_single_job_runs_to_done(tmp_path):
+    spec = JobSpec(tenant="hospital-a", n_iter=3, seed=1, **FIT)
+    with make_sched(tmp_path) as sched:
+        ticket = sched.submit(spec)
+        record = ticket.result(timeout=120.0)
+        assert record["state"] == "done"
+        assert record["digest"] is not None
+        assert record["fit_id"] is not None
+        assert record["chunks"] == pytest.approx(3.0)
+        summary = sched.summary()
+        assert summary["counts"] == {"done": 1}
+        assert summary["tenants"]["hospital-a"]["usage"] == \
+            pytest.approx(3.0)
+        # the module-level merged view feeds the /jobs payload
+        merged = scheduler_state()
+        assert merged is not None
+        assert merged["counts"] == {"done": 1}
+    assert scheduler_state() is None  # closed schedulers unregister
+
+
+def test_preempt_park_resume_parity(tmp_path):
+    low = JobSpec(tenant="hospital-a", priority=0, n_iter=10,
+                  seed=7, **FIT)
+    hi = JobSpec(tenant="hospital-b", priority=1, n_iter=5,
+                 seed=11, **FIT)
+    with make_sched(tmp_path, pressure_slots=1) as sched:
+        low_ticket = sched.submit(low)
+        mid = poll(sched, low.job_id,
+                   lambda r: r["state"] == "running"
+                   and r["chunks"] >= 1)
+        fit_id = mid["fit_id"]
+        assert fit_id is not None
+        hi_ticket = sched.submit(hi)
+        hi_rec = hi_ticket.result(timeout=120.0)
+        low_rec = low_ticket.result(timeout=120.0)
+    assert hi_rec["state"] == "done"
+    assert low_rec["state"] == "done"
+    # the high-priority arrival parked the running low fit...
+    assert low_rec["n_preemptions"] >= 1
+    assert hi_rec["n_preemptions"] == 0
+    assert low_rec["grants"] >= 2
+    # ...which resumed the SAME fit (same fit_id, same checkpoint
+    # stream) and landed on bit-exact parameters: an uninterrupted
+    # solo run of the same spec reaches the identical digest
+    assert low_rec["fit_id"] == fit_id
+    solo = run_job(
+        JobSpec(tenant="solo", priority=0, n_iter=10, seed=7,
+                **FIT),
+        str(tmp_path / "solo"))
+    assert low_rec["digest"] == solo["digest"]
+
+
+def test_fair_share_bounds_light_tenant_makespan(tmp_path):
+    heavy = [JobSpec(tenant="heavy", n_iter=6, seed=20 + i, **FIT)
+             for i in range(2)]
+    light = JobSpec(tenant="light", n_iter=2, seed=30, **FIT)
+    with make_sched(tmp_path, grant_chunks=1) as sched:
+        heavy_tickets = sched.submit_many(heavy)
+        light_ticket = sched.submit(light)
+        light_rec = light_ticket.result(timeout=120.0)
+        heavy_recs = [t.result(timeout=120.0)
+                      for t in heavy_tickets]
+    assert light_rec["state"] == "done"
+    assert all(r["state"] == "done" for r in heavy_recs)
+    # chunk-granular grants interleave by virtual time: the light
+    # tenant (2 chunks) finishes before EITHER heavy job (6 chunks
+    # each) despite submitting last — it is never starved behind
+    # the heavy tenant's backlog
+    assert all(light_rec["finished_ts"] < r["finished_ts"]
+               for r in heavy_recs)
+    vt = {t: e["virtual_time"]
+          for t, e in sched.summary()["tenants"].items()}
+    assert vt["light"] < vt["heavy"]
+
+
+def test_weighted_fair_share_is_respected(tmp_path):
+    fair = FairShare(weights={"gold": 3.0, "bronze": 1.0})
+    specs = [JobSpec(tenant=t, n_iter=3, seed=40 + i, **FIT)
+             for i, t in enumerate(("gold", "bronze"))]
+    with make_sched(tmp_path, grant_chunks=1,
+                    fair_share=fair) as sched:
+        for t in sched.submit_many(specs):
+            assert t.result(timeout=120.0)["state"] == "done"
+        tenants = sched.summary()["tenants"]
+    assert tenants["gold"]["weight"] == 3.0
+    assert tenants["gold"]["virtual_time"] == pytest.approx(1.0)
+    assert tenants["bronze"]["virtual_time"] == pytest.approx(3.0)
+
+
+def test_cancel_while_parked_and_while_queued(tmp_path):
+    low = JobSpec(tenant="a", priority=0, n_iter=16, seed=3, **FIT)
+    hi = JobSpec(tenant="b", priority=1, n_iter=6, seed=4, **FIT)
+    queued = JobSpec(tenant="c", priority=0, n_iter=4, seed=5,
+                     **FIT)
+    with make_sched(tmp_path) as sched:
+        low_ticket = sched.submit(low)
+        poll(sched, low.job_id,
+             lambda r: r["state"] == "running" and r["chunks"] >= 1)
+        hi_ticket = sched.submit(hi)
+        queued_ticket = sched.submit(queued)
+        # the preemption parks low; the hi fit holds the only slot,
+        # so low STAYS parked — cancel it there
+        poll(sched, low.job_id, lambda r: r["state"] == "parked")
+        assert sched.cancel(queued.job_id) is True
+        assert sched.cancel(low.job_id) is True
+        low_rec = low_ticket.result(timeout=30.0)
+        queued_rec = queued_ticket.result(timeout=30.0)
+        hi_rec = hi_ticket.result(timeout=120.0)
+        # terminal jobs refuse a second cancel (exactly-one-terminal)
+        assert sched.cancel(low.job_id) is False
+        assert sched.cancel("no-such-job") is False
+    assert low_rec["state"] == "cancelled"
+    assert queued_rec["state"] == "cancelled"
+    assert queued_rec["fit_id"] is None  # never ran
+    assert hi_rec["state"] == "done"
+
+
+def _terminal_count(tenant):
+    total = 0.0
+    for labels, value in metrics.counter(
+            "jobs_terminal_total").samples():
+        if dict(labels).get("tenant") == tenant:
+            total += value
+    return total
+
+
+def test_replica_crash_requeues_then_done_exactly_once(tmp_path):
+    spec = JobSpec(tenant="crashy", n_iter=3, seed=6, **FIT)
+    with make_sched(tmp_path) as sched:
+        with faults.inject("replica_crash", at_step=0, times=1,
+                           target=spec.job_id) as fault:
+            record = sched.submit(spec).result(timeout=120.0)
+        assert fault.fired == 1
+    # the crash requeued the job (checkpoint intact) and the retry
+    # finished it: ONE terminal state, counted exactly once
+    assert record["state"] == "done"
+    assert record["crash_retries"] == 1
+    assert record["grants"] == 2
+    assert _terminal_count("crashy") == 1.0
+
+
+def test_replica_crash_exhausts_retries_to_terminal_failed(
+        tmp_path):
+    spec = JobSpec(tenant="doomed", n_iter=3, seed=6, **FIT)
+    with make_sched(tmp_path, max_crash_retries=1) as sched:
+        with faults.inject("replica_crash", at_step=0, times=5,
+                           target=spec.job_id) as fault:
+            record = sched.submit(spec).result(timeout=120.0)
+        assert fault.fired == 2  # initial grant + the single retry
+    assert record["state"] == "failed"
+    assert record["crash_retries"] == 2
+    assert "replica_crash" in record["error"]
+    assert _terminal_count("doomed") == 1.0
+
+
+def test_shed_submission_fails_fast_with_verdict(tmp_path):
+    admission = AdmissionController(
+        max_depth=256, tenant_quotas={"noisy": 0})
+    spec = JobSpec(tenant="noisy", n_iter=2, seed=8, **FIT)
+    with make_sched(tmp_path, admission=admission) as sched:
+        ticket = sched.submit(spec)
+        assert ticket.done()  # resolved synchronously, no queueing
+        record = ticket.result(timeout=1.0)
+    assert record["state"] == "failed"
+    assert record["error"] == "shed:tenant_quota"
+    assert record["shed"]["reason"] == "tenant_quota"
+    assert record["shed"]["retry_after_s"] > 0.0
+    assert record["fit_id"] is None
+
+
+def test_diverged_fit_fails_with_status_and_snapshot(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV,
+                       str(tmp_path / "incidents"))
+    spec = JobSpec(tenant="nan-lab", n_iter=4, seed=9, **FIT)
+    with make_sched(tmp_path) as sched:
+        # times=10: outlive the resilient loop's rollback budget so
+        # the divergence is terminal, not recovered
+        with faults.inject("nan", at_step=1, times=10):
+            record = sched.submit(spec).result(timeout=120.0)
+    assert record["state"] == "failed"
+    assert record["fit_status"] == "diverged"
+    assert "DivergenceError" in record["error"]
+    # the flight-recorder incident snapshot is attached, not lost
+    assert record["snapshot_path"] is not None
+    manifest = json.load(open(
+        record["snapshot_path"] + "/manifest.json"))
+    assert manifest["trigger"] == "divergence_abort"
+    assert manifest["fit_id"] == record["fit_id"]
+
+
+def test_serving_pressure_parks_excess_fits(tmp_path):
+    specs = [JobSpec(tenant=t, n_iter=10, seed=50 + i, **FIT)
+             for i, t in enumerate(("a", "b"))]
+    with make_sched(tmp_path, max_slots=2, pressure_slots=1,
+                    serve_pressure_depth=4) as sched:
+        tickets = sched.submit_many(specs)
+        for spec in specs:
+            poll(sched, spec.job_id,
+                 lambda r: r["state"] == "running")
+        # a serving burst: the depth gauge the fleet supervisor
+        # reads crosses the threshold -> slots shrink to 1
+        depth = metrics.gauge("serve_service_queue_depth")
+        depth.set(64.0, service="svc")
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            counts = sched.summary()["counts"]
+            if counts.get("parked", 0) >= 1:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError(
+                f"pressure never parked a fit: {counts}")
+        assert sched.summary()["pressure"] is True
+        depth.set(0.0, service="svc")  # burst over: resume
+        records = [t.result(timeout=120.0) for t in tickets]
+    assert all(r["state"] == "done" for r in records)
+    assert sum(r["n_preemptions"] for r in records) >= 1
+
+
+def test_deadline_overrun_marks_but_never_kills(tmp_path):
+    spec = JobSpec(tenant="slo", n_iter=2, seed=10,
+                   deadline_s=1e-9, **FIT)
+    with make_sched(tmp_path) as sched:
+        record = sched.submit(spec).result(timeout=120.0)
+    assert record["state"] == "done"
+    assert record["deadline_exceeded"] is True
+
+
+def test_submit_rejects_duplicates_bad_types_and_closed(tmp_path):
+    spec = JobSpec(tenant="t", n_iter=2, seed=11, **FIT)
+    sched = make_sched(tmp_path)
+    try:
+        sched.submit(spec)
+        with pytest.raises(ValueError, match="duplicate job_id"):
+            sched.submit(spec)
+        with pytest.raises(TypeError):
+            sched.submit({"tenant": "t"})
+        assert sched.drain(timeout=120.0) is True
+    finally:
+        sched.close()
+    with pytest.raises(SchedulerClosed):
+        sched.submit(JobSpec(tenant="t", n_iter=2, **FIT))
+
+
+def test_http_control_plane_and_cli_roundtrip(tmp_path, capsys):
+    from brainiak_tpu.jobs.__main__ import main
+
+    batch = str(tmp_path / "batch.npz")
+    rc = main(["gen", "--out", batch, "--tenant", "hospital-a",
+               "--n", "2", "--n-iter", "2", "--seed", "12",
+               "--voxels", "8", "--samples", "12",
+               "--features", "2", "--subjects", "2"])
+    assert rc == 0
+    job_ids = json.loads(capsys.readouterr().out)["job_ids"]
+
+    with make_sched(tmp_path, http_port=0) as sched:
+        url = f"http://127.0.0.1:{sched.http.port}"
+        assert main(["submit", batch, "--url", url]) == 0
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict == {"accepted": job_ids, "shed": []}
+        assert sched.drain(timeout=120.0) is True
+        # status renders the scheduler table from GET /jobs
+        assert main(["status", "--url", url, "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["scheduler"]["counts"] == {"done": 2}
+        states = {row["job_id"]: row["state"]
+                  for row in payload["scheduler"]["jobs"]}
+        assert states == {j: "done" for j in job_ids}
+        # plain-text rendering exercises _render_status
+        assert main(["status", "--url", url]) == 0
+        text = capsys.readouterr().out
+        assert "hospital-a" in text and "done=2" in text
+        # cancelling a terminal job reports failure (rc 1)
+        assert main(["cancel", job_ids[0], "--url", url]) == 1
+        assert json.loads(
+            capsys.readouterr().out)["cancelled"] is False

@@ -1,0 +1,119 @@
+"""JobSpec validation, the lifecycle table, and the npz codec."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from brainiak_tpu.jobs.spec import (
+    CODEC_SCHEMA,
+    KINDS,
+    STATES,
+    TERMINAL_STATES,
+    JobSpec,
+    can_transition,
+    decode_jobs,
+    encode_jobs,
+    load_jobs,
+    new_job_id,
+    save_jobs,
+)
+
+
+def test_new_job_id_is_16_hex_and_unique():
+    ids = {new_job_id() for _ in range(64)}
+    assert len(ids) == 64
+    for job_id in ids:
+        assert len(job_id) == 16
+        int(job_id, 16)
+
+
+def test_spec_defaults_mint_a_job_id():
+    a = JobSpec(tenant="t", kind="srm")
+    b = JobSpec(tenant="t", kind="srm")
+    assert a.job_id != b.job_id
+    assert a.priority == 0 and a.checkpoint_every == 1
+
+
+@pytest.mark.parametrize("bad", [
+    dict(tenant="", kind="srm"),
+    dict(tenant=None, kind="srm"),
+    dict(tenant="t", kind="svm"),
+    dict(tenant="t", kind="srm", n_iter=0),
+    dict(tenant="t", kind="srm", checkpoint_every=0),
+])
+def test_spec_validation_rejects(bad):
+    with pytest.raises(ValueError):
+        JobSpec(**bad)
+
+
+def test_lifecycle_table():
+    assert TERMINAL_STATES == {"done", "failed", "cancelled"}
+    assert set(STATES) >= TERMINAL_STATES
+    assert can_transition("queued", "running")
+    assert can_transition("running", "parked")
+    assert can_transition("running", "queued")   # crash requeue
+    assert can_transition("parked", "running")   # resume
+    assert can_transition("parked", "cancelled")
+    assert not can_transition("queued", "parked")
+    assert not can_transition("parked", "done")
+    for terminal in TERMINAL_STATES:
+        for state in STATES:
+            assert not can_transition(terminal, state)
+    assert not can_transition("nonsense", "running")
+
+
+def test_roundtrip_dict_rejects_unknown_keys():
+    spec = JobSpec(tenant="t", kind="htfa", n_iter=4, seed=9)
+    assert JobSpec.from_dict(spec.to_dict()) == spec
+    with pytest.raises(ValueError, match="unknown JobSpec keys"):
+        JobSpec.from_dict({**spec.to_dict(), "gpu_hours": 3})
+
+
+def test_codec_roundtrip_without_pickle():
+    specs = [JobSpec(tenant=f"t{i}", kind=KINDS[i % len(KINDS)],
+                     priority=i, n_iter=2 + i, deadline_s=1.5 * i
+                     if i else None)
+             for i in range(4)]
+    data = encode_jobs(specs)
+    assert decode_jobs(data) == specs
+    # the archive really is pickle-free npz
+    with np.load(io.BytesIO(data), allow_pickle=False) as archive:
+        assert int(archive["n_jobs"]) == 4
+        assert int(archive["codec_schema"]) == CODEC_SCHEMA
+
+
+def test_codec_rejects_non_spec_and_newer_schema():
+    with pytest.raises(TypeError):
+        encode_jobs([{"tenant": "t", "kind": "srm"}])
+    buf = io.BytesIO()
+    np.savez(buf, codec_schema=np.array(CODEC_SCHEMA + 1),
+             n_jobs=np.array(1),
+             **{"job.0": np.array(json.dumps(
+                 JobSpec(tenant="t", kind="srm").to_dict()))})
+    with pytest.raises(ValueError, match="codec_schema"):
+        decode_jobs(buf.getvalue())
+
+
+def test_save_load_file(tmp_path):
+    specs = [JobSpec(tenant="a", kind="srm"),
+             JobSpec(tenant="b", kind="ridge_encoding", n_iter=3)]
+    path = save_jobs(str(tmp_path / "batch.npz"), specs)
+    assert load_jobs(path) == specs
+
+
+def test_cli_gen_writes_loadable_batch(tmp_path, capsys):
+    from brainiak_tpu.jobs.__main__ import main
+
+    out = str(tmp_path / "jobs.npz")
+    rc = main(["gen", "--out", out, "--tenant", "hospital-a",
+               "--kind", "srm", "--n", "3", "--n-iter", "2",
+               "--seed", "5", "--priority", "1"])
+    assert rc == 0
+    verdict = json.loads(capsys.readouterr().out)
+    specs = load_jobs(out)
+    assert [s.job_id for s in specs] == verdict["job_ids"]
+    assert [s.seed for s in specs] == [5, 6, 7]
+    assert all(s.tenant == "hospital-a" and s.priority == 1
+               for s in specs)

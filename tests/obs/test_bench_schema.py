@@ -330,3 +330,41 @@ def test_realtime_tier_records_match_obs_schema(monkeypatch):
         assert rec["config"]["deadline_s"] == \
             bench.REALTIME_DEADLINE_S
         assert rec["direction"] == "lower_is_better"
+
+
+# -- ISSUE 20: jobs tier ----------------------------------------------
+
+def test_jobs_tier_records_match_obs_schema(monkeypatch):
+    """The jobs tier (ISSUE 20): a short in-process scheduled-fit
+    round co-scheduled with serving waves emits THREE schema-valid
+    records — scheduled jobs/s (vs_baseline = scheduled/solo rate),
+    co-scheduled serving p99 and jobs_lost (both lower_is_better,
+    jobs_lost against a zero baseline) — so `obs regress --only
+    jobs` gates control-plane throughput from day one."""
+    monkeypatch.setenv("BENCH_JOBS_COUNT", "2")
+    out = bench.measure_tier("jobs")
+    assert out["n_jobs"] == 2
+    assert out["jobs_per_sec"] > 0
+    assert out["solo_jobs_per_sec"] > 0
+    assert out["jobs_lost"] == 0 and out["lost"] == []
+    assert out["n_serve_requests"] > 0
+    stages = out["stages"]
+    assert set(bench.STAGE_KEYS) <= set(stages)
+    assert stages["warm_s"] > 0 and stages["steady_s"] > 0
+
+    recs = bench._jobs_result_records(out)
+    assert [r["metric"] for r in recs] == [
+        "jobs_scheduled_jobs_per_sec",
+        "jobs_coserve_p99_latency_seconds",
+        "jobs_lost"]
+    for rec in recs:
+        assert obs.validate_bench_record(rec) == []
+        # in-process run on the CPU test backend -> fallback tier
+        assert rec["tier"] == "jobs_cpu_fallback"
+        assert rec["config"]["n_jobs"] == 2
+        assert rec["config"]["n_tenants"] == 2
+        assert rec["config"]["max_slots"] == bench.JOBS_MAX_SLOTS
+    assert recs[0]["vs_baseline"] > 0
+    assert "direction" not in recs[0]
+    assert recs[1]["direction"] == "lower_is_better"
+    assert recs[2]["direction"] == "lower_is_better"

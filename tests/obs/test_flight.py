@@ -182,3 +182,34 @@ def test_postmortem_via_obs_main(tmp_path):
     from brainiak_tpu.obs.__main__ import main as obs_main
     path = _snapshot(tmp_path)
     assert obs_main(["postmortem", path]) == 0
+
+
+def test_postmortem_names_the_implicated_job(tmp_path, capsys):
+    """ISSUE 20: a scheduled fit's incident names the owning job
+    (tenant + job_id from the fit_context attrs) in the header and
+    in the per-fit section."""
+    fit = "b" * 16
+    for i in range(3):
+        flight.record(_rec(i, kind="progress", fit_id=fit,
+                           estimator="SRM.fit", chunk=i + 1,
+                           step=i + 1, n_iter=6,
+                           ratio=(i + 1) / 6.0, rollbacks=0,
+                           attrs={"job_id": "j" * 16,
+                                  "tenant": "hospital-a"}))
+    flight.record(_rec(3, fit_id=fit, name="divergence_abort",
+                       attrs={"estimator": "SRM.fit",
+                              "job_id": "j" * 16,
+                              "tenant": "hospital-a"}))
+    path = flight.dump("divergence_abort", fit_id=fit,
+                       directory=str(tmp_path))
+    assert postmortem.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "implicated job: tenant=hospital-a" in out
+    assert "job_id=" + "j" * 16 in out
+    assert "(job " + "j" * 16 in out
+
+
+def test_postmortem_without_job_attrs_stays_plain(tmp_path, capsys):
+    path = _snapshot(tmp_path)
+    assert postmortem.main([path]) == 0
+    assert "implicated job" not in capsys.readouterr().out

@@ -237,3 +237,74 @@ def test_objective_ring_is_bounded():
                objective=lambda i: float(i) * -1.0)
     assert len(fp.objectives) == progress.OBJECTIVE_RING
     assert math.isfinite(fp.objectives[-1][1])
+
+
+# -- ISSUE 20: fit context + finish listeners -------------------------
+
+def test_fit_context_nests_drops_none_and_restores():
+    assert progress.current_context() == {}
+    with progress.fit_context(job_id="j1", tenant="a",
+                              trace_id=None):
+        assert progress.current_context() == {"job_id": "j1",
+                                              "tenant": "a"}
+        with progress.fit_context(tenant="b"):
+            assert progress.current_context() == {"job_id": "j1",
+                                                  "tenant": "b"}
+        assert progress.current_context()["tenant"] == "a"
+    assert progress.current_context() == {}
+
+
+def test_fit_context_attrs_ride_records_and_registry():
+    mem = obs_sink.add_sink(obs_sink.MemorySink())
+    try:
+        with progress.fit_context(job_id="job-1",
+                                  tenant="hospital-a"):
+            fp = FitProgress("SRM.fit", 4, n_chunks=2)
+            _observe_n(fp, 2)
+            fp.finish("completed")
+    finally:
+        obs_sink.remove_sink(mem)
+    recs = [r for r in mem.records if r["kind"] == "progress"]
+    assert all(r["attrs"]["job_id"] == "job-1" for r in recs)
+    assert all(r["attrs"]["tenant"] == "hospital-a" for r in recs)
+    snap = progress.active_fits()[-1]
+    assert snap["fit_id"] == fp.fit_id
+    assert snap["job_id"] == "job-1"
+    assert snap["tenant"] == "hospital-a"
+
+
+def test_finish_listener_sees_terminal_snapshot_once():
+    seen = []
+    progress.add_finish_listener(seen.append)
+    progress.add_finish_listener(seen.append)  # dedup: once only
+    try:
+        with progress.fit_context(job_id="job-2"):
+            fp = FitProgress("SRM.fit", 4, n_chunks=2)
+            _observe_n(fp, 2)
+            fp.finish("converged")
+    finally:
+        progress.remove_finish_listener(seen.append)
+    assert len(seen) == 1
+    assert seen[0]["status"] == "converged"
+    assert seen[0]["fit_id"] == fp.fit_id
+    assert seen[0]["job_id"] == "job-2"
+    # removed listeners stay silent
+    fp2 = FitProgress("SRM.fit", 2, n_chunks=1)
+    fp2.finish("completed")
+    assert len(seen) == 1
+
+
+def test_finish_listener_exceptions_are_swallowed():
+    def boom(snapshot):
+        raise RuntimeError("telemetry must never break the fit")
+
+    calls = []
+    progress.add_finish_listener(boom)
+    progress.add_finish_listener(calls.append)
+    try:
+        fp = FitProgress("SRM.fit", 2, n_chunks=1)
+        fp.finish("completed")  # must not raise
+    finally:
+        progress.remove_finish_listener(boom)
+        progress.remove_finish_listener(calls.append)
+    assert len(calls) == 1

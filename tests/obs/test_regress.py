@@ -55,10 +55,12 @@ def test_fixture_history_passes_and_gates():
     # (ISSUE 16: 3 rounds x 3 metrics — chaos-soak requests/s,
     # post-failure p99, lost-ticket count held at zero) + the
     # stats_r01-r03 tier (ISSUE 18: 3 rounds x 1 metric — engine
-    # surrogates/s vs a host loop), all measured host-side ->
-    # *_cpu_fallback: eleven tiers gating independently from one
-    # directory
-    assert len(records) == 65
+    # surrogates/s vs a host loop) + the jobs_r01-r03 tier
+    # (ISSUE 20: 3 rounds x 3 metrics — scheduled jobs/s,
+    # co-scheduled serving p99, jobs lost held at zero), all
+    # measured host-side -> *_cpu_fallback: twelve tiers gating
+    # independently from one directory
+    assert len(records) == 74
     assert skipped == []
     # legacy rounds (no tier field) were normalized, not dropped
     tiers = {regress.tier_of(r) for r in records}
@@ -71,12 +73,14 @@ def test_fixture_history_passes_and_gates():
                      "federation_cpu_fallback",
                      "realtime_cpu_fallback",
                      "elastic_cpu_fallback",
-                     "stats_cpu_fallback"}
+                     "stats_cpu_fallback",
+                     "jobs_cpu_fallback"}
     result = regress.evaluate(records)
     assert result["verdict"] == "pass"
     multi = ("service_cpu_fallback", "kernels_cpu_fallback",
              "streaming_cpu_fallback", "federation_cpu_fallback",
-             "realtime_cpu_fallback", "elastic_cpu_fallback")
+             "realtime_cpu_fallback", "elastic_cpu_fallback",
+             "jobs_cpu_fallback")
     by_tier = {c["tier"]: c for c in result["checks"]
                if c["tier"] not in multi}
     by_metric = {c["metric"]: c for c in result["checks"]
@@ -103,7 +107,10 @@ def test_fixture_history_passes_and_gates():
                               "realtime_deadline_miss_ratio",
                               "elastic_soak_requests_per_sec",
                               "elastic_post_failure_p99_seconds",
-                              "elastic_lost_tickets"}
+                              "elastic_lost_tickets",
+                              "jobs_scheduled_jobs_per_sec",
+                              "jobs_coserve_p99_latency_seconds",
+                              "jobs_lost"}
     assert by_metric["service_obs_overhead_ratio"][
         "direction"] == "lower_is_better"
     # the ISSUE 13 streaming tier gates overlap the right way round
@@ -128,6 +135,12 @@ def test_fixture_history_passes_and_gates():
     assert by_metric["elastic_lost_tickets"]["value"] == 0.0
     assert by_metric["elastic_post_failure_p99_seconds"][
         "direction"] == "lower_is_better"
+    # the ISSUE 20 jobs tier gates co-scheduled serving latency and
+    # holds the lost-job count at ZERO
+    assert by_metric["jobs_coserve_p99_latency_seconds"][
+        "direction"] == "lower_is_better"
+    assert by_metric["jobs_lost"]["direction"] == "lower_is_better"
+    assert by_metric["jobs_lost"]["value"] == 0.0
     assert all(c["status"] == "ok" for c in by_metric.values())
     assert by_tier["cpu_fallback"]["status"] == "ok"
     assert by_tier["cpu_fallback"]["n_history"] == 4

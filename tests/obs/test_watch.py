@@ -105,3 +105,38 @@ def test_watch_via_obs_main(tmp_path, capsys):
     assert obs_main(["watch", "--dir", str(tmp_path),
                      "--once"]) == 0
     assert "e" * 16 in capsys.readouterr().out
+
+
+# -- ISSUE 20: scheduler view -----------------------------------------
+
+def test_render_frame_scheduler_table_and_tenant_column():
+    fits = [{"fit_id": "f" * 16, "estimator": "SRM", "chunk": 2,
+             "step": 4, "n_iter": 8, "ratio": 0.5,
+             "tenant": "hospital-a", "job_id": "j" * 16}]
+    scheduler = {
+        "slots": 2, "pressure": True,
+        "counts": {"running": 1, "done": 2},
+        "tenants": {"hospital-a": {"usage": 6.0, "weight": 1.0,
+                                   "virtual_time": 6.0,
+                                   "deficit": -1.25}},
+        "jobs": [{"job_id": "j" * 16, "tenant": "hospital-a",
+                  "kind": "srm", "priority": 1, "state": "running",
+                  "chunks": 4.0, "n_preemptions": 2}],
+    }
+    out = watch.render_frame(fits, scheduler=scheduler, now=0.0)
+    # the fit table grows a tenant column when jobs are attributed
+    assert "tenant" in out
+    assert "hospital-a" in out
+    # the scheduler block: header, pressure flag, job row, deficit
+    assert "slots=2" in out and "[serving pressure]" in out
+    assert "done=2" in out and "running=1" in out
+    assert "j" * 16 in out
+    assert "srm" in out and "-1.25" in out
+
+
+def test_render_frame_without_scheduler_has_no_job_table():
+    fits = [{"fit_id": "a" * 16, "estimator": "SRM", "step": 1,
+             "n_iter": 2, "ratio": 0.5}]
+    out = watch.render_frame(fits, now=0.0)
+    assert "scheduler" not in out
+    assert "tenant" not in out  # no jobs context -> classic table

@@ -6,8 +6,10 @@ import pytest
 from brainiak_tpu.resilience.faults import PreemptionError, inject
 from brainiak_tpu.resilience.guards import (
     DivergenceError,
+    FitParked,
     check_state,
     pack_rng_state,
+    park_scope,
     run_resilient_loop,
     unpack_rng_state,
 )
@@ -318,3 +320,67 @@ def test_replicate_identity_cached():
     out = fn(placed)
     assert out.sharding.is_fully_replicated
     assert np.allclose(np.asarray(out), np.asarray(x))
+
+
+# -- ISSUE 20: park_scope (the scheduler's preemption primitive) ------
+
+def test_park_scope_parks_after_grant_and_resumes_bitexact(
+        tmp_path):
+    d = str(tmp_path / "ck")
+    chunks = {"n": 0}
+
+    def two_chunk_grant():
+        chunks["n"] += 1
+        return chunks["n"] >= 2
+
+    with park_scope(two_chunk_grant):
+        with pytest.raises(FitParked) as excinfo:
+            run_resilient_loop(
+                _counting_chunk, {"x": np.zeros(1)}, 10,
+                checkpoint_dir=d, checkpoint_every=2)
+    parked = excinfo.value
+    # the predicate fired once per PERSISTED chunk: parked at the
+    # second checkpoint with the state durably on disk
+    assert parked.step == 4
+    assert parked.fit_id is not None
+    # re-running the same loop with the same checkpoint_dir resumes
+    # under the SAME fit_id and completes to the exact final state
+    state, step = run_resilient_loop(
+        _counting_chunk, {"x": np.zeros(1)}, 10,
+        checkpoint_dir=d, checkpoint_every=2)
+    assert step == 10 and state["x"][0] == 10.0
+
+
+def test_park_scope_ignored_without_checkpoint_dir():
+    # parking without a checkpoint would discard work: the predicate
+    # must never fire on an unpersisted loop
+    with park_scope(lambda: True):
+        state, step = run_resilient_loop(
+            _counting_chunk, {"x": np.zeros(1)}, 4,
+            checkpoint_every=2)
+    assert step == 4 and state["x"][0] == 4.0
+
+
+def test_park_scope_nests_and_restores(tmp_path):
+    d = str(tmp_path / "ck")
+    with park_scope(lambda: True):
+        with park_scope(lambda: False):  # innermost predicate wins
+            state, step = run_resilient_loop(
+                _counting_chunk, {"x": np.zeros(1)}, 4,
+                checkpoint_dir=d, checkpoint_every=2)
+        assert step == 4
+        with pytest.raises(FitParked):  # outer scope restored
+            run_resilient_loop(
+                _counting_chunk, {"x": np.zeros(1)}, 8,
+                checkpoint_dir=d, checkpoint_every=2)
+
+
+def test_park_scope_predicate_exceptions_are_swallowed(tmp_path):
+    def broken():
+        raise RuntimeError("scheduler bug")
+
+    with park_scope(broken):
+        state, step = run_resilient_loop(
+            _counting_chunk, {"x": np.zeros(1)}, 4,
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2)
+    assert step == 4 and state["x"][0] == 4.0

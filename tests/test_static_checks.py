@@ -47,7 +47,7 @@ def test_run_checks_json_output():
         "jaxlint", "jaxlint-deep", "jaxlint-ir", "obs", "obs-live",
         "obs-fit", "regress", "serve", "service", "federation",
         "fleet", "distla", "encoding", "kernels", "data",
-        "realtime", "stats"}
+        "realtime", "stats", "jobs"}
     assert payload["files"] > 100
     seconds = payload["gate_seconds"]
     assert set(seconds) == set(payload["gates"])
@@ -860,6 +860,80 @@ def test_stats_gate_classifies_failures(monkeypatch):
     findings = []
     rc.check_stats(findings)
     assert [f.code for f in findings] == ["STA001"]
+    assert "rc=3" in findings[0].message
+
+
+def test_jobs_gate_passes_on_live_package():
+    """The jobs gate (JOB001) smoke-runs the fit-scheduler
+    selfcheck — two tenants' mixed-priority fits co-scheduled with
+    warm serving, one injected priority preemption, zero lost jobs,
+    park/resume parity, fair-share within tolerance, zero added
+    serve retraces — and passes on the live tree (ISSUE 20)."""
+    rc = _load_run_checks()
+    findings = []
+    rc.check_jobs(findings)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_jobs_gate_classifies_failures(monkeypatch):
+    """A failing jobs selfcheck is reported as JOB001, with lost
+    jobs, broken park/resume parity, a missing preemption,
+    fair-share starvation, and serve-retrace regressions each named
+    distinctly."""
+    rc = _load_run_checks()
+
+    def fake_child(verdict):
+        return ("import json, sys\n"
+                f"print(json.dumps({verdict!r}))\n"
+                "sys.exit(1)\n")
+
+    base = {"ok": False, "n_jobs": 2, "lost": [],
+            "parity_ok": True, "preempt_ok": True,
+            "n_preemptions": 1, "max_deficit": 0.0,
+            "fair_tol": 1.0, "fairshare_ok": True,
+            "serve_ok": True, "serve_retrace_delta": 0.0}
+
+    monkeypatch.setattr(rc, "_JOBS_CHILD", fake_child(
+        dict(base, lost=["deadbeef00000000"])))
+    findings = []
+    rc.check_jobs(findings)
+    assert [f.code for f in findings] == ["JOB001"]
+    assert "lost job" in findings[0].message
+    assert "deadbeef00000000" in findings[0].message
+
+    monkeypatch.setattr(rc, "_JOBS_CHILD", fake_child(
+        dict(base, parity_ok=False)))
+    findings = []
+    rc.check_jobs(findings)
+    assert [f.code for f in findings] == ["JOB001"]
+    assert "parity" in findings[0].message
+
+    monkeypatch.setattr(rc, "_JOBS_CHILD", fake_child(
+        dict(base, preempt_ok=False, n_preemptions=0)))
+    findings = []
+    rc.check_jobs(findings)
+    assert [f.code for f in findings] == ["JOB001"]
+    assert "preemption never fired" in findings[0].message
+
+    monkeypatch.setattr(rc, "_JOBS_CHILD", fake_child(
+        dict(base, fairshare_ok=False, max_deficit=9.5)))
+    findings = []
+    rc.check_jobs(findings)
+    assert [f.code for f in findings] == ["JOB001"]
+    assert "starvation" in findings[0].message
+    assert "9.5" in findings[0].message
+
+    monkeypatch.setattr(rc, "_JOBS_CHILD", fake_child(
+        dict(base, serve_retrace_delta=2.0)))
+    findings = []
+    rc.check_jobs(findings)
+    assert [f.code for f in findings] == ["JOB001"]
+    assert "retrace delta=2.0" in findings[0].message
+
+    monkeypatch.setattr(rc, "_JOBS_CHILD", "raise SystemExit(3)")
+    findings = []
+    rc.check_jobs(findings)
+    assert [f.code for f in findings] == ["JOB001"]
     assert "rc=3" in findings[0].message
 
 

@@ -111,6 +111,20 @@ realtime   smoke-runs the closed-loop tier selfcheck
            after an injected preemption, and retrace stability
            across repeat sessions incl. the warm low-latency
            ServeService hop (RT001)
+stats      smoke-runs the resampling-statistics selfcheck
+           (``brainiak_tpu.stats.selfcheck``) on the 8-device
+           CPU mesh: count-vs-materialized p-value parity,
+           chunk invariance, exact pooling over both wire
+           formats, resume-at-chunk after an injected
+           preemption, and stats.* retrace stability (STA001)
+jobs       smoke-runs the fit-scheduler selfcheck
+           (``brainiak_tpu.jobs.selfcheck``) on the 8-device
+           CPU mesh: two tenants' mixed-priority fits
+           co-scheduled with warm serving, one injected
+           priority preemption — fails on a lost job, broken
+           park/resume parity, a fair-share deficit outside
+           tolerance (starvation), or any added serve.*
+           retrace (JOB001)
 ========== ===================================================
 
 ``# noqa`` suppresses stdlib/doc findings on a line; jaxlint uses
@@ -151,7 +165,7 @@ GATES = ("external", "stdlib", "doc-defaults", "resilient-fits",
          "jaxlint", "jaxlint-deep", "jaxlint-ir", "obs", "obs-live",
          "obs-fit", "regress", "serve", "service", "federation",
          "fleet", "distla", "encoding", "kernels", "data",
-         "realtime", "stats")
+         "realtime", "stats", "jobs")
 
 
 def python_sources():
@@ -1365,6 +1379,62 @@ def check_stats(findings):
         "stats", classify)
 
 
+# -- jobs gate --------------------------------------------------------
+
+_JOBS_CHILD = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from brainiak_tpu.jobs.selfcheck import selfcheck
+sys.exit(selfcheck())
+"""
+
+
+def check_jobs(findings):
+    """Fit-scheduler gate (JOB001): smoke-run the jobs selfcheck
+    (``brainiak_tpu.jobs.selfcheck``) on the 8-device CPU mesh: two
+    tenants submit mixed-priority SRM fits co-scheduled with a warm
+    ServeService, one priority preemption is injected, and the
+    verdict must show zero lost jobs (every job terminal ``done``),
+    bit-exact park/resume parity against an unpreempted solo run,
+    per-tenant fair-share deficits within tolerance (starvation
+    freedom), and zero added ``serve.*`` retraces (the throughput
+    fits must not evict the latency tier's compiled programs)."""
+
+    def classify(verdict):
+        lost = verdict.get("lost") or []
+        if lost:
+            return ("scheduler lost job(s) " + ", ".join(lost)
+                    + ": submitted fits did not reach terminal "
+                      "done (zombie/failed/cancelled records)")
+        if not verdict.get("parity_ok", True):
+            return ("preempted fit did not resume to bit-exact "
+                    "parity with the unpreempted solo run (the "
+                    "park/resume checkpoint contract drifted)")
+        if not verdict.get("preempt_ok", True):
+            return ("injected priority preemption never fired "
+                    f"(n_preemptions="
+                    f"{verdict.get('n_preemptions')}): the "
+                    "high-priority arrival did not park the "
+                    "running low-priority fit")
+        if not verdict.get("fairshare_ok", True):
+            return ("fair-share starvation: tenant deficit "
+                    f"{verdict.get('max_deficit')} exceeds "
+                    f"tolerance {verdict.get('fair_tol')} chunks "
+                    "under equal weights and equal work")
+        return ("co-scheduled serving regressed: "
+                f"serve retrace delta="
+                f"{verdict.get('serve_retrace_delta')} "
+                f"(serve_ok={verdict.get('serve_ok')}) — fits must "
+                "add zero serve.* retraces")
+
+    _run_selfcheck_gate(
+        findings, _JOBS_CHILD, "JOB001",
+        _rel(os.path.join(REPO, "brainiak_tpu", "jobs",
+                          "selfcheck.py")),
+        "jobs", classify)
+
+
 # -- external gate ----------------------------------------------------
 
 def run_external(findings):
@@ -1627,6 +1697,8 @@ def run_gates(only=None):
         timed("realtime", check_realtime, findings)
     if "stats" in selected:
         timed("stats", check_stats, findings)
+    if "jobs" in selected:
+        timed("jobs", check_jobs, findings)
 
     if baseline is not None:
         findings, stale = baseline.filter(findings)
@@ -1649,7 +1721,7 @@ def run_gates(only=None):
                        "obs-live", "obs-fit", "regress", "serve",
                        "service", "federation", "fleet", "distla",
                        "encoding", "kernels", "data", "realtime",
-                       "stats")
+                       "stats", "jobs")
            if g in selected])
     return {
         "ok": not findings,
